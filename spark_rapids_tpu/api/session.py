@@ -1,0 +1,5 @@
+"""Placeholder session (built out with the planner)."""
+
+
+class TpuSparkSession:
+    pass

@@ -1,0 +1,13 @@
+from spark_rapids_tpu.columnar.batch import (  # noqa: F401
+    DeviceColumn,
+    ColumnBatch,
+    concat_batches,
+    make_column,
+    next_capacity,
+    row_mask,
+)
+from spark_rapids_tpu.columnar.arrow_bridge import (  # noqa: F401
+    arrow_to_device,
+    device_to_arrow,
+    arrow_to_pandas,
+)

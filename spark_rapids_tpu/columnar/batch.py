@@ -1,0 +1,280 @@
+"""Device columnar batch — the `GpuColumnVector`/`ColumnarBatch` analog.
+
+The reference wraps cuDF device columns as Spark `ColumnarBatch` columns
+(`sql-plugin/src/main/java/com/nvidia/spark/rapids/GpuColumnVector.java:555`).
+Here the device format is designed for XLA on TPU instead of for cuDF:
+
+- Every batch has a **static row capacity** (power-of-two bucket) plus a
+  traced `num_rows` scalar. XLA compiles one program per (schema, capacity)
+  bucket; refills of the same bucket hit the jit cache. This is the answer
+  to "dynamic shapes on XLA" (SURVEY.md section 7 hard part #1): operators
+  whose output size is data-dependent (filter, join, aggregate) write into
+  full-capacity buffers and carry the logical row count as data.
+- Columns are validity-masked flat arrays; strings are a padded byte matrix
+  plus a length vector (see sqltypes.datatypes.StringType).
+- `ColumnBatch`/`DeviceColumn` are registered JAX pytrees so jitted kernels
+  take and return them natively, and `jax.device_put`/`device_get` move
+  whole batches for the spill tiers.
+
+Rows at index >= num_rows are garbage; every kernel masks with
+``row_mask(capacity, num_rows)``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.sqltypes import (
+    DataType,
+    StringType,
+    StructField,
+    StructType,
+)
+
+MIN_CAPACITY = 1024
+
+
+def next_capacity(rows: int, minimum: int = MIN_CAPACITY) -> int:
+    """Smallest power-of-two capacity bucket holding `rows`."""
+    cap = max(int(minimum), 1)
+    rows = max(int(rows), 1)
+    while cap < rows:
+        cap <<= 1
+    return cap
+
+
+def row_mask(capacity: int, num_rows) -> jnp.ndarray:
+    """Boolean [capacity] mask of logically-live rows."""
+    return jnp.arange(capacity, dtype=jnp.int32) < jnp.asarray(
+        num_rows, dtype=jnp.int32)
+
+
+class DeviceColumn:
+    """One device column: data (+ lengths for strings) + validity.
+
+    data:     [cap] of dtype.np_dtype, or [cap, max_bytes] uint8 for strings
+    lengths:  [cap] int32 (strings only)
+    validity: [cap] bool, True = valid (non-null)
+    """
+
+    __slots__ = ("dtype", "data", "validity", "lengths")
+
+    def __init__(self, dtype: DataType, data, validity, lengths=None):
+        self.dtype = dtype
+        self.data = data
+        self.validity = validity
+        self.lengths = lengths
+
+    @property
+    def is_string(self) -> bool:
+        return isinstance(self.dtype, StringType)
+
+    @property
+    def capacity(self) -> int:
+        return int(self.data.shape[0])
+
+    @property
+    def max_bytes(self) -> Optional[int]:
+        return int(self.data.shape[1]) if self.is_string else None
+
+    def device_size_bytes(self) -> int:
+        n = self.data.size * self.data.dtype.itemsize
+        n += self.validity.size  # bool = 1 byte
+        if self.lengths is not None:
+            n += self.lengths.size * 4
+        return n
+
+    def with_validity(self, validity) -> "DeviceColumn":
+        return DeviceColumn(self.dtype, self.data, validity, self.lengths)
+
+    def gather(self, indices) -> "DeviceColumn":
+        """Row gather; indices must be in [0, capacity)."""
+        return DeviceColumn(
+            self.dtype,
+            jnp.take(self.data, indices, axis=0),
+            jnp.take(self.validity, indices, axis=0),
+            None if self.lengths is None else jnp.take(self.lengths, indices,
+                                                       axis=0),
+        )
+
+    def _tree_flatten(self):
+        if self.lengths is None:
+            return (self.data, self.validity), (self.dtype, False)
+        return (self.data, self.validity, self.lengths), (self.dtype, True)
+
+    @classmethod
+    def _tree_unflatten(cls, aux, children):
+        dtype, has_len = aux
+        if has_len:
+            data, validity, lengths = children
+            return cls(dtype, data, validity, lengths)
+        data, validity = children
+        return cls(dtype, data, validity, None)
+
+
+jax.tree_util.register_pytree_node(
+    DeviceColumn,
+    lambda c: c._tree_flatten(),
+    DeviceColumn._tree_unflatten,
+)
+
+
+class ColumnBatch:
+    """A batch of device columns with shared capacity and row count.
+
+    `num_rows` may be a Python int or a traced/device int32 scalar; inside
+    jitted kernels it is always traced. `row_count()` forces a host value
+    (device sync) and caches it.
+    """
+
+    __slots__ = ("schema", "columns", "num_rows", "_host_rows")
+
+    def __init__(self, schema: StructType, columns: List[DeviceColumn],
+                 num_rows):
+        assert len(schema.fields) == len(columns)
+        self.schema = schema
+        self.columns = columns
+        self.num_rows = num_rows
+        self._host_rows = num_rows if isinstance(num_rows, int) else None
+
+    @property
+    def capacity(self) -> int:
+        if not self.columns:
+            return MIN_CAPACITY
+        return self.columns[0].capacity
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def row_count(self) -> int:
+        if self._host_rows is None:
+            self._host_rows = int(jax.device_get(self.num_rows))
+        return self._host_rows
+
+    def live_mask(self) -> jnp.ndarray:
+        return row_mask(self.capacity, self.num_rows)
+
+    def device_size_bytes(self) -> int:
+        return sum(c.device_size_bytes() for c in self.columns)
+
+    def column(self, name: str) -> DeviceColumn:
+        return self.columns[self.schema.field_index(name)]
+
+    def select(self, indices: Sequence[int]) -> "ColumnBatch":
+        return ColumnBatch(
+            StructType([self.schema.fields[i] for i in indices]),
+            [self.columns[i] for i in indices],
+            self.num_rows,
+        )
+
+    def gather(self, indices, new_num_rows) -> "ColumnBatch":
+        return ColumnBatch(
+            self.schema, [c.gather(indices) for c in self.columns],
+            new_num_rows)
+
+    def _tree_flatten(self):
+        return (tuple(self.columns), jnp.asarray(self.num_rows,
+                                                 jnp.int32)), self.schema
+
+    @classmethod
+    def _tree_unflatten(cls, schema, children):
+        columns, num_rows = children
+        return cls(schema, list(columns), num_rows)
+
+    def __repr__(self):
+        return (f"ColumnBatch(rows={self._host_rows or '?'}, "
+                f"cap={self.capacity}, cols={self.schema.names})")
+
+
+jax.tree_util.register_pytree_node(
+    ColumnBatch,
+    lambda b: b._tree_flatten(),
+    ColumnBatch._tree_unflatten,
+)
+
+
+def make_column(dtype: DataType, values: np.ndarray,
+                validity: Optional[np.ndarray], capacity: int,
+                lengths: Optional[np.ndarray] = None) -> DeviceColumn:
+    """Build a device column from host numpy data, padding to capacity.
+
+    For strings, `values` is a [n, max_bytes] uint8 matrix and `lengths`
+    the per-row byte counts.
+    """
+    n = len(values)
+    if validity is None:
+        validity = np.ones(n, dtype=np.bool_)
+    vpad = np.zeros(capacity, dtype=np.bool_)
+    vpad[:n] = validity
+    if isinstance(dtype, StringType):
+        assert values.ndim == 2 and values.dtype == np.uint8
+        data = np.zeros((capacity, values.shape[1]), dtype=np.uint8)
+        data[:n, :] = values
+        lpad = np.zeros(capacity, dtype=np.int32)
+        if lengths is not None:
+            lpad[:n] = lengths
+        return DeviceColumn(dtype, jnp.asarray(data), jnp.asarray(vpad),
+                            jnp.asarray(lpad))
+    data = np.zeros(capacity, dtype=dtype.np_dtype)
+    data[:n] = values
+    return DeviceColumn(dtype, jnp.asarray(data), jnp.asarray(vpad))
+
+
+def empty_like_schema(schema: StructType, capacity: int,
+                      string_bytes: int = 8) -> ColumnBatch:
+    cols = []
+    for f in schema.fields:
+        if isinstance(f.dataType, StringType):
+            cols.append(DeviceColumn(
+                f.dataType,
+                jnp.zeros((capacity, string_bytes), jnp.uint8),
+                jnp.zeros(capacity, jnp.bool_),
+                jnp.zeros(capacity, jnp.int32)))
+        else:
+            cols.append(DeviceColumn(
+                f.dataType,
+                jnp.zeros(capacity, f.dataType.np_dtype),
+                jnp.zeros(capacity, jnp.bool_)))
+    return ColumnBatch(schema, cols, 0)
+
+
+def concat_batches(batches: List[ColumnBatch]) -> ColumnBatch:
+    """Concatenate batches (cuDF `Table.concatenate` analog) — the engine of
+    coalescing (reference GpuCoalesceBatches.scala:250)."""
+    assert batches
+    if len(batches) == 1:
+        return batches[0]
+    schema = batches[0].schema
+    total = sum(b.row_count() for b in batches)
+    cap = next_capacity(total)
+    cols: List[DeviceColumn] = []
+    for ci, field in enumerate(schema.fields):
+        parts_data, parts_val, parts_len = [], [], []
+        for b in batches:
+            n = b.row_count()
+            c = b.columns[ci]
+            parts_data.append(c.data[:n])
+            parts_val.append(c.validity[:n])
+            if c.lengths is not None:
+                parts_len.append(c.lengths[:n])
+        if isinstance(field.dataType, StringType):
+            mb = max(int(p.shape[1]) for p in parts_data)
+            parts_data = [
+                jnp.pad(p, ((0, 0), (0, mb - p.shape[1]))) for p in parts_data
+            ]
+        data = jnp.concatenate(parts_data, axis=0)
+        pad = cap - total
+        if pad:
+            pad_width = ((0, pad),) + ((0, 0),) * (data.ndim - 1)
+            data = jnp.pad(data, pad_width)
+        val = jnp.pad(jnp.concatenate(parts_val), (0, pad))
+        lens = None
+        if parts_len:
+            lens = jnp.pad(jnp.concatenate(parts_len), (0, pad))
+        cols.append(DeviceColumn(field.dataType, data, val, lens))
+    return ColumnBatch(schema, cols, total)

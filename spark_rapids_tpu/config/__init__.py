@@ -1,0 +1,1 @@
+from spark_rapids_tpu.config.rapids_conf import RapidsConf, ConfEntry, conf_entries  # noqa: F401

@@ -1,0 +1,242 @@
+"""Typed configuration registry — the RapidsConf analog.
+
+The reference defines 209 typed `spark.rapids.*` entries with a builder DSL,
+defaults, startup-only flags and markdown doc generation
+(`sql-plugin/src/main/scala/com/nvidia/spark/rapids/RapidsConf.scala:121,260,319,2166`).
+This is the same design in Python: a module-level registry of `ConfEntry`
+objects, a `RapidsConf` snapshot view bound to a session, and
+`generate_docs()` producing docs/configs.md.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+_REGISTRY: Dict[str, "ConfEntry"] = {}
+_REG_LOCK = threading.Lock()
+
+
+class ConfEntry:
+    def __init__(
+        self,
+        key: str,
+        default: Any,
+        doc: str,
+        conf_type: type,
+        startup_only: bool = False,
+        internal: bool = False,
+        checker: Optional[Callable[[Any], bool]] = None,
+    ):
+        self.key = key
+        self.default = default
+        self.doc = doc
+        self.conf_type = conf_type
+        self.startup_only = startup_only
+        self.internal = internal
+        self.checker = checker
+
+    def convert(self, raw: Any) -> Any:
+        if raw is None:
+            return self.default
+        if self.conf_type is bool:
+            if isinstance(raw, bool):
+                v = raw
+            else:
+                v = str(raw).strip().lower() in ("true", "1", "yes")
+        elif self.conf_type in (int, float, str):
+            v = self.conf_type(raw)
+        else:
+            v = raw
+        if self.checker is not None and not self.checker(v):
+            raise ValueError(f"invalid value {v!r} for conf {self.key}")
+        return v
+
+
+def _register(entry: ConfEntry) -> ConfEntry:
+    with _REG_LOCK:
+        if entry.key in _REGISTRY:
+            raise ValueError(f"duplicate conf key {entry.key}")
+        _REGISTRY[entry.key] = entry
+    return entry
+
+
+def conf(key, default, doc, conf_type=str, **kw) -> ConfEntry:
+    return _register(ConfEntry(key, default, doc, conf_type, **kw))
+
+
+# --- Core entries (names follow the reference's spark.rapids.* namespace,
+# --- re-rooted at spark.rapids.tpu where TPU-specific). ---
+
+SQL_ENABLED = conf(
+    "spark.rapids.sql.enabled", True,
+    "Enable plan rewriting onto the TPU columnar engine.", bool)
+SQL_MODE = conf(
+    "spark.rapids.sql.mode", "executeOnGPU",
+    "executeOnGPU or explainOnly (tag the plan and report placement without "
+    "running on device; reference RapidsConf.scala:2048).", str,
+    checker=lambda v: v in ("executeOnGPU", "explainOnly"))
+EXPLAIN = conf(
+    "spark.rapids.sql.explain", "NONE",
+    "NONE, NOT_ON_GPU, or ALL — plan placement diagnostics "
+    "(reference GpuOverrides.scala:4763).", str,
+    checker=lambda v: v in ("NONE", "NOT_ON_GPU", "ALL"))
+BATCH_SIZE_BYTES = conf(
+    "spark.rapids.sql.batchSizeBytes", 1 << 30,
+    "Target device batch size (reference default 1GiB, RapidsConf.scala:559).",
+    int)
+BATCH_SIZE_ROWS = conf(
+    "spark.rapids.sql.batchSizeRows", 1 << 20,
+    "Target device batch row capacity; device batches are padded to "
+    "power-of-two capacity buckets so XLA compiles one program per bucket.",
+    int)
+CONCURRENT_TPU_TASKS = conf(
+    "spark.rapids.sql.concurrentGpuTasks", 2,
+    "Tasks allowed to hold device memory concurrently; semaphore permits = "
+    "1000/N (reference GpuSemaphore.scala:135-145).", int)
+MEMORY_FRACTION = conf(
+    "spark.rapids.memory.gpu.allocFraction", 0.85,
+    "Fraction of device HBM budgeted to the pool "
+    "(reference GpuDeviceManager.scala:229-272).", float, startup_only=True)
+MEMORY_LIMIT_BYTES = conf(
+    "spark.rapids.memory.gpu.maxAllocBytes", 0,
+    "Absolute device pool cap in bytes; 0 = derive from allocFraction. "
+    "Tests use this to force small pools for spill coverage.", int,
+    startup_only=True)
+HOST_SPILL_STORAGE_SIZE = conf(
+    "spark.rapids.memory.host.spillStorageSize", 4 << 30,
+    "Bytes of host memory for spilled device buffers before overflowing to "
+    "disk (reference RapidsHostMemoryStore).", int, startup_only=True)
+SPILL_DIR = conf(
+    "spark.rapids.memory.spillDir", "",
+    "Directory for disk-tier spill files; empty = temp dir.", str,
+    startup_only=True)
+OOM_INJECTION_MODE = conf(
+    "spark.rapids.memory.gpu.oomInjection.mode", "none",
+    "Fault injection for retry tests: none|once|always — injected at "
+    "allocation points, the RmmSpark forced-OOM analog "
+    "(reference test framework, SURVEY.md section 4).", str,
+    checker=lambda v: v in ("none", "once", "always"))
+RETRY_SPLIT_LIMIT = conf(
+    "spark.rapids.sql.retry.splitLimit", 16,
+    "Maximum times a batch may be halved by split-and-retry before the "
+    "query fails (reference GpuSplitAndRetryOOM taxonomy).", int)
+STRING_MAX_BYTES = conf(
+    "spark.rapids.tpu.string.maxBytes", 64,
+    "Default padded byte width of device string columns; longer strings "
+    "keep correctness via host fallback tagging.", int)
+SHUFFLE_MODE = conf(
+    "spark.rapids.shuffle.mode", "MULTITHREADED",
+    "MULTITHREADED (host-serialized, thread-pooled — reference "
+    "RapidsShuffleInternalManagerBase.scala:238) or ICI (device-resident "
+    "all-to-all collectives over the mesh, the UCX transport analog).", str,
+    checker=lambda v: v in ("MULTITHREADED", "ICI", "CACHE_ONLY"))
+SHUFFLE_PARTITIONS = conf(
+    "spark.sql.shuffle.partitions", 8,
+    "Number of shuffle output partitions.", int)
+MULTITHREADED_READ_NUM_THREADS = conf(
+    "spark.rapids.sql.multiThreadedRead.numThreads", 8,
+    "Shared reader thread pool size (reference Plugin.scala:262-274).", int)
+PARQUET_READER_TYPE = conf(
+    "spark.rapids.sql.format.parquet.reader.type", "AUTO",
+    "PERFILE, COALESCING, MULTITHREADED or AUTO "
+    "(reference RapidsConf.scala:965-981).", str,
+    checker=lambda v: v in ("AUTO", "PERFILE", "COALESCING", "MULTITHREADED"))
+CPU_ORACLE_ENABLED = conf(
+    "spark.rapids.tpu.test.cpuOracle", False,
+    "Internal: route this session through the CPU (pyarrow) backend; used "
+    "by the differential test harness.", bool, internal=True)
+METRICS_LEVEL = conf(
+    "spark.rapids.sql.metrics.level", "MODERATE",
+    "ESSENTIAL, MODERATE or DEBUG (reference RapidsConf.scala:674).", str,
+    checker=lambda v: v in ("ESSENTIAL", "MODERATE", "DEBUG"))
+ANSI_ENABLED = conf(
+    "spark.sql.ansi.enabled", False,
+    "ANSI mode: arithmetic overflow and invalid casts raise instead of "
+    "returning null/wrapping.", bool)
+CASE_SENSITIVE = conf(
+    "spark.sql.caseSensitive", False,
+    "Case sensitivity of column resolution.", bool)
+SESSION_TZ = conf(
+    "spark.sql.session.timeZone", "UTC",
+    "Session timezone; v1 device datetime ops require UTC like the "
+    "reference's default path (GpuTimeZoneDB handles others there).", str)
+MAX_READER_BATCH_SIZE_ROWS = conf(
+    "spark.rapids.sql.reader.batchSizeRows", 1 << 20,
+    "Soft cap on rows per scan batch (reference maxReadBatchSizeRows).", int)
+IMPROVED_FLOAT_OPS = conf(
+    "spark.rapids.sql.improvedFloatOps.enabled", True,
+    "Allow float aggregation whose ordering differs from CPU Spark "
+    "(reference hasNans/incompat float semantics).", bool)
+TEST_RETRY_OOM_INJECTION_FILTER = conf(
+    "spark.rapids.memory.gpu.oomInjection.filter", "",
+    "Restrict OOM injection to allocation sites whose tag contains this "
+    "substring.", str)
+
+
+def conf_entries() -> List[ConfEntry]:
+    return sorted(_REGISTRY.values(), key=lambda e: e.key)
+
+
+class RapidsConf:
+    """Immutable snapshot of the registry resolved against user settings."""
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        settings = dict(settings or {})
+        # Env var names are case-sensitive; "__" encodes "." so camelCase
+        # keys stay addressable: SPARK_RAPIDS_TPU_CONF_spark__rapids__sql__batchSizeRows
+        env_prefix = "SPARK_RAPIDS_TPU_CONF_"
+        for k, v in os.environ.items():
+            if k.startswith(env_prefix):
+                settings.setdefault(k[len(env_prefix):].replace("__", "."), v)
+        self._values: Dict[str, Any] = {}
+        unknown = []
+        for key, raw in settings.items():
+            entry = _REGISTRY.get(key)
+            if entry is None:
+                unknown.append(key)
+            else:
+                self._values[key] = entry.convert(raw)
+        self.unknown_keys = unknown
+
+    def get(self, entry: ConfEntry):
+        return self._values.get(entry.key, entry.default)
+
+    def __getitem__(self, key: str):
+        entry = _REGISTRY[key]
+        return self._values.get(key, entry.default)
+
+    # Convenience properties for hot confs.
+    @property
+    def is_sql_enabled(self):
+        return self.get(SQL_ENABLED)
+
+    @property
+    def is_explain_only(self):
+        return self.get(SQL_MODE) == "explainOnly"
+
+    @property
+    def batch_size_rows(self):
+        return self.get(BATCH_SIZE_ROWS)
+
+    @property
+    def shuffle_partitions(self):
+        return self.get(SHUFFLE_PARTITIONS)
+
+
+def generate_docs() -> str:
+    """Markdown table of all public confs (reference RapidsConf.scala:2166)."""
+    lines = [
+        "# spark-rapids-tpu configuration",
+        "",
+        "| Name | Default | Startup-only | Description |",
+        "|---|---|---|---|",
+    ]
+    for e in conf_entries():
+        if e.internal:
+            continue
+        lines.append(
+            f"| {e.key} | {e.default} | {'yes' if e.startup_only else ''} "
+            f"| {e.doc} |")
+    return "\n".join(lines) + "\n"

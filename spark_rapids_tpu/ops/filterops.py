@@ -1,0 +1,30 @@
+"""Filter/compaction kernels (cuDF `Table.filter`/`apply_boolean_mask`).
+
+TPU approach: compaction = stable sort on the keep-mask (kept rows first),
+then gather — a fixed-shape program; the data-dependent result size is
+carried as the batch's num_rows scalar (see columnar.batch docstring).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import ColumnBatch
+from spark_rapids_tpu.ops.common import sort_permutation
+
+
+def compact(batch: ColumnBatch, keep: jnp.ndarray) -> ColumnBatch:
+    """Keep rows where `keep` (and logically live); preserves order."""
+    live = batch.live_mask()
+    keep = keep & live
+    key = jnp.where(keep, 0, 1).astype(jnp.int32)
+    perm = sort_permutation([key], batch.capacity)
+    new_rows = jnp.sum(keep).astype(jnp.int32)
+    return batch.gather(perm, new_rows)
+
+
+def slice_head(batch: ColumnBatch, n: int) -> ColumnBatch:
+    """LIMIT n: logical truncation only — no data movement."""
+    new_rows = jnp.minimum(jnp.asarray(batch.num_rows, jnp.int32),
+                           jnp.int32(n))
+    return ColumnBatch(batch.schema, batch.columns, new_rows)

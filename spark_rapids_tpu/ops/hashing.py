@@ -1,0 +1,159 @@
+"""Spark-exact Murmur3_x86_32 as vectorized XLA integer ops.
+
+The reference gets bit-exact Spark hashes from the JNI `Hash` kernel
+(spark-rapids-jni, SURVEY.md section 2.12) because hash partitioning must
+agree with CPU Spark for correctness of mixed CPU/device plans. Same
+requirement here; this implements org.apache.spark.unsafe.hash.Murmur3_x86_32
+semantics (including Spark's nonstandard one-byte-at-a-time tail handling in
+hashUnsafeBytes) with int32 wraparound arithmetic, vectorized over rows.
+
+Null handling matches Spark's HashExpression: a null input leaves the
+running hash unchanged; the seed chains through columns left-to-right
+(seed 42 for partitioning).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+from jax import lax
+
+from spark_rapids_tpu.columnar.batch import DeviceColumn
+from spark_rapids_tpu.sqltypes import (
+    BooleanType,
+    DoubleType,
+    FloatType,
+    StringType,
+)
+
+_C1 = jnp.int32(0xCC9E2D51 - (1 << 32))
+_C2 = jnp.int32(0x1B873593)
+_M5 = jnp.int32(0xE6546B64 - (1 << 32))
+
+DEFAULT_SEED = 42
+
+
+def _rotl(x, r):
+    return (x << jnp.int32(r)) | lax.shift_right_logical(x, jnp.int32(32 - r))
+
+
+def _mix_k1(k1):
+    k1 = (k1 * _C1).astype(jnp.int32)
+    k1 = _rotl(k1, 15)
+    return (k1 * _C2).astype(jnp.int32)
+
+
+def _mix_h1(h1, k1):
+    h1 = h1 ^ k1
+    h1 = _rotl(h1, 13)
+    return (h1 * jnp.int32(5) + _M5).astype(jnp.int32)
+
+
+def _fmix(h1, length):
+    h1 = h1 ^ length
+    h1 = h1 ^ lax.shift_right_logical(h1, jnp.int32(16))
+    h1 = (h1 * jnp.int32(0x85EBCA6B - (1 << 32))).astype(jnp.int32)
+    h1 = h1 ^ lax.shift_right_logical(h1, jnp.int32(13))
+    h1 = (h1 * jnp.int32(0xC2B2AE35 - (1 << 32))).astype(jnp.int32)
+    return h1 ^ lax.shift_right_logical(h1, jnp.int32(16))
+
+
+def hash_int(v: jnp.ndarray, seed: jnp.ndarray) -> jnp.ndarray:
+    """Murmur3_x86_32.hashInt — v int32, seed int32 (both vectors)."""
+    return _fmix(_mix_h1(seed, _mix_k1(v)), jnp.int32(4))
+
+
+def hash_long(v: jnp.ndarray, seed: jnp.ndarray) -> jnp.ndarray:
+    """Murmur3_x86_32.hashLong — low word then high word."""
+    low = v.astype(jnp.int32)
+    high = lax.shift_right_logical(v.astype(jnp.int64),
+                                   jnp.int64(32)).astype(jnp.int32)
+    h1 = _mix_h1(seed, _mix_k1(low))
+    h1 = _mix_h1(h1, _mix_k1(high))
+    return _fmix(h1, jnp.int32(8))
+
+
+def hash_string(data: jnp.ndarray, lengths: jnp.ndarray,
+                seed: jnp.ndarray) -> jnp.ndarray:
+    """Murmur3_x86_32.hashUnsafeBytes over the padded byte matrix.
+
+    4-byte little-endian chunks for the aligned prefix, then remaining
+    bytes one at a time as sign-extended ints (Spark's exact tail rule).
+    """
+    n, mb = data.shape
+    nchunks = mb // 4
+    full_chunks = lengths // 4
+    tail = lengths - full_chunks * 4
+    h1 = seed
+    d32 = data.astype(jnp.int32)
+    for ci in range(nchunks):
+        b0 = d32[:, ci * 4]
+        b1 = d32[:, ci * 4 + 1]
+        b2 = d32[:, ci * 4 + 2]
+        b3 = d32[:, ci * 4 + 3]
+        chunk = b0 | (b1 << 8) | (b2 << 16) | (b3 << 24)
+        upd = _mix_h1(h1, _mix_k1(chunk))
+        h1 = jnp.where(ci < full_chunks, upd, h1)
+    signed = data.astype(jnp.int8).astype(jnp.int32)
+    base = full_chunks * 4
+    for ti in range(3):
+        pos = jnp.clip(base + ti, 0, mb - 1)
+        byte_val = jnp.take_along_axis(signed, pos[:, None], axis=1)[:, 0]
+        upd = _mix_h1(h1, _mix_k1(byte_val))
+        h1 = jnp.where(ti < tail, upd, h1)
+    return _fmix(h1, lengths.astype(jnp.int32))
+
+
+def hash_column(col: DeviceColumn, seed: jnp.ndarray) -> jnp.ndarray:
+    """Per-row murmur3 update for one column (ignores validity; caller
+    masks nulls)."""
+    dt = col.dtype
+    if isinstance(dt, StringType):
+        return hash_string(col.data, col.lengths, seed)
+    if isinstance(dt, BooleanType):
+        return hash_int(col.data.astype(jnp.int32), seed)
+    if isinstance(dt, FloatType):
+        f = col.data
+        f = jnp.where(f == 0.0, jnp.float32(0.0), f)  # -0.0 -> 0.0
+        bits = lax.bitcast_convert_type(f, jnp.int32)
+        bits = jnp.where(jnp.isnan(f), jnp.int32(0x7FC00000), bits)
+        return hash_int(bits, seed)
+    if isinstance(dt, DoubleType):
+        from spark_rapids_tpu.ops.common import supports_64bit_bitcast
+        f = col.data
+        f = jnp.where(f == 0.0, jnp.float64(0.0), f)
+        if supports_64bit_bitcast():
+            bits = lax.bitcast_convert_type(f, jnp.int64)
+            bits = jnp.where(jnp.isnan(f), jnp.int64(0x7FF8000000000000),
+                             bits)
+        else:
+            # TPU v5e: f64 compute is f32-demoted and 64-bit bitcast is
+            # unavailable; derive a self-consistent (not Spark-bit-exact)
+            # hash from the f32 bit pattern. Partitioning only requires
+            # agreement within this engine.
+            f32 = f.astype(jnp.float32)
+            b32 = lax.bitcast_convert_type(f32, jnp.int32)
+            b32 = jnp.where(jnp.isnan(f32), jnp.int32(0x7FC00000), b32)
+            bits = b32.astype(jnp.int64)
+        return hash_long(bits, seed)
+    np_itemsize = dt.np_dtype.itemsize
+    if np_itemsize <= 4:
+        return hash_int(col.data.astype(jnp.int32), seed)
+    return hash_long(col.data.astype(jnp.int64), seed)
+
+
+def murmur3_columns(cols: List[DeviceColumn],
+                    seed: int = DEFAULT_SEED) -> jnp.ndarray:
+    """Spark Murmur3Hash(cols, seed): chain seeds, skip nulls."""
+    cap = cols[0].capacity
+    h = jnp.full((cap,), jnp.int32(seed))
+    for c in cols:
+        h = jnp.where(c.validity, hash_column(c, h), h)
+    return h
+
+
+def pmod(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Positive modulus, Spark's Pmod used by HashPartitioning."""
+    r = x % jnp.int32(n)
+    return jnp.where(r < 0, r + jnp.int32(n), r)

@@ -1,0 +1,133 @@
+"""Equi-join kernels: sorted-build binary search + two-phase gather maps.
+
+cuDF builds device hash tables (`Table.innerJoinGatherMaps`,
+`GpuHashJoin.scala:403,490`). HLO has no dynamic hash tables, so the TPU
+formulation is a *sort-based* hash join replacement with the same
+gather-map contract:
+
+  phase 1 (jit, fixed shape): sort the build side by orderable join keys;
+    vectorized multi-key binary search gives each probe row its matching
+    build range [lo, hi) and count. Null join keys never match (SQL equi-
+    join semantics) — null-keyed build rows sort to the end and are
+    excluded by the live bound; null-keyed probe rows are forced to
+    count 0.
+  host: read total match count, pick the output capacity bucket.
+  phase 2 (jit, fixed shape per bucket): expand (lo, count) into
+    (probe_idx, build_idx) gather maps via searchsorted over the count
+    prefix sum — the cuDF GatherMap analog — then gather both sides.
+
+This two-phase shape-bucketing is the engine's general answer to
+data-dependent output sizes (SURVEY.md section 7 hard part #1/#2).
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import ColumnBatch
+from spark_rapids_tpu.ops.common import (
+    equality_keys,
+    normalize_floating,
+    sort_permutation,
+)
+
+
+class BuildTable(NamedTuple):
+    """Build side prepared for probing (device-resident, spillable)."""
+
+    batch: ColumnBatch             # sorted by join keys, null-keyed rows last
+    keys: List[jnp.ndarray]        # sorted orderable keys (excl. null rank)
+    valid_bound: jnp.ndarray       # scalar int32: rows with non-null keys
+
+
+def _join_keys(batch: ColumnBatch, key_idxs: Sequence[int],
+               live: jnp.ndarray) -> Tuple[List[jnp.ndarray], jnp.ndarray]:
+    """Orderable value keys + "all keys valid" mask (rank keys excluded —
+    validity is handled by the bound/count-0 rules)."""
+    vals: List[jnp.ndarray] = []
+    all_valid = live
+    for i in key_idxs:
+        col = normalize_floating(batch.columns[i])
+        ks = equality_keys(col, live)
+        all_valid = all_valid & col.validity
+        vals.extend(ks[1:])
+    return vals, all_valid
+
+
+def build_side(batch: ColumnBatch, key_idxs: Sequence[int]) -> BuildTable:
+    cap = batch.capacity
+    live = batch.live_mask()
+    vals, all_valid = _join_keys(batch, key_idxs, live)
+    # Sort null-keyed / dead rows to the end: leading rank 0 valid, 1 not.
+    rank = jnp.where(all_valid, 0, 1).astype(jnp.int64)
+    perm = sort_permutation([rank] + vals, cap)
+    sorted_batch = batch.gather(perm, batch.num_rows)
+    sorted_keys = [jnp.take(v, perm) for v in vals]
+    valid_bound = jnp.sum(all_valid).astype(jnp.int32)
+    return BuildTable(sorted_batch, sorted_keys, valid_bound)
+
+
+def _tuple_cmp_at(build_keys: List[jnp.ndarray], mid: jnp.ndarray,
+                  probe_keys: List[jnp.ndarray], strict: bool) -> jnp.ndarray:
+    """Lexicographic: build[mid] < probe (strict) or <= probe (not strict)."""
+    lt = jnp.zeros(mid.shape, dtype=bool)
+    decided = jnp.zeros(mid.shape, dtype=bool)
+    for bk, pk in zip(build_keys, probe_keys):
+        bv = jnp.take(bk, mid)
+        lt = jnp.where(~decided & (bv < pk), True, lt)
+        decided = decided | (bv != pk)
+    if strict:
+        return lt  # undecided (equal) -> False
+    return lt | ~decided  # equal counts as <=
+
+
+def _binary_search(build_keys: List[jnp.ndarray],
+                   probe_keys: List[jnp.ndarray], bound: jnp.ndarray,
+                   build_cap: int, upper: bool) -> jnp.ndarray:
+    """First index in [0, bound) where build[idx] >= probe (lower) or
+    > probe (upper); vectorized over probe rows."""
+    n = probe_keys[0].shape[0]
+    lo = jnp.zeros(n, dtype=jnp.int32)
+    hi = jnp.broadcast_to(bound.astype(jnp.int32), (n,))
+    iters = max(1, build_cap.bit_length())
+    for _ in range(iters):
+        active = lo < hi
+        mid = (lo + hi) >> 1
+        go_right = _tuple_cmp_at(build_keys, mid, probe_keys, strict=not upper)
+        new_lo = jnp.where(active & go_right, mid + 1, lo)
+        new_hi = jnp.where(active & ~go_right, mid, hi)
+        lo, hi = new_lo, new_hi
+    return lo
+
+
+def probe_ranges(build: BuildTable, probe: ColumnBatch,
+                 key_idxs: Sequence[int]) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-probe-row (lo, count) of matching build rows."""
+    live = probe.live_mask()
+    vals, all_valid = _join_keys(probe, key_idxs, live)
+    lo = _binary_search(build.keys, vals, build.valid_bound,
+                        build.batch.capacity, upper=False)
+    hi = _binary_search(build.keys, vals, build.valid_bound,
+                        build.batch.capacity, upper=True)
+    counts = jnp.where(all_valid, hi - lo, 0).astype(jnp.int32)
+    return lo, counts
+
+
+def expand_gather_maps(lo: jnp.ndarray, counts: jnp.ndarray,
+                       out_capacity: int
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(lo, counts) -> (probe_idx, build_idx, total) gather maps of static
+    size out_capacity; slots >= total are clamped garbage."""
+    csum = jnp.cumsum(counts.astype(jnp.int64))
+    total = csum[-1].astype(jnp.int32)
+    j = jnp.arange(out_capacity, dtype=jnp.int64)
+    probe_idx = jnp.searchsorted(csum, j, side="right").astype(jnp.int32)
+    probe_safe = jnp.clip(probe_idx, 0, counts.shape[0] - 1)
+    excl = csum - counts.astype(jnp.int64)
+    within = j - jnp.take(excl, probe_safe)
+    build_idx = (jnp.take(lo, probe_safe).astype(jnp.int64) + within).astype(
+        jnp.int32)
+    build_idx = jnp.clip(build_idx, 0, None)
+    return probe_safe, build_idx, total

@@ -1,0 +1,59 @@
+"""On-device partition slicing for shuffle — the `GpuPartitioning.scala:64`
+/ cuDF `Table.partition`/`contiguousSplit` analog.
+
+Rows are assigned a partition id (murmur3 pmod for hash partitioning,
+matching CPU Spark so device and host partitioning agree), then stably
+sorted by pid so each partition is one contiguous row range; per-partition
+counts come from a segment sum. The host slices the contiguous ranges when
+serializing (shuffle v1) or feeds them to the all-to-all collective
+(shuffle v2).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import ColumnBatch
+from spark_rapids_tpu.ops.common import sort_permutation
+from spark_rapids_tpu.ops.hashing import murmur3_columns, pmod
+
+
+class PartitionedBatch(NamedTuple):
+    batch: ColumnBatch          # rows grouped by partition id, dead rows last
+    counts: jnp.ndarray         # [num_partitions] int32 rows per partition
+
+
+def hash_partition_ids(batch: ColumnBatch, key_idxs: Sequence[int],
+                       num_partitions: int) -> jnp.ndarray:
+    cols = [batch.columns[i] for i in key_idxs]
+    return pmod(murmur3_columns(cols), num_partitions)
+
+
+def partition_by_ids(batch: ColumnBatch, pid: jnp.ndarray,
+                     num_partitions: int) -> PartitionedBatch:
+    live = batch.live_mask()
+    key = jnp.where(live, pid, num_partitions).astype(jnp.int64)
+    perm = sort_permutation([key], batch.capacity)
+    sorted_batch = batch.gather(perm, batch.num_rows)
+    ones = jnp.where(live, 1, 0).astype(jnp.int32)
+    counts = jax.ops.segment_sum(
+        ones, jnp.clip(pid, 0, num_partitions - 1).astype(jnp.int32),
+        num_segments=num_partitions)
+    return PartitionedBatch(sorted_batch, counts)
+
+
+def hash_partition(batch: ColumnBatch, key_idxs: Sequence[int],
+                   num_partitions: int) -> PartitionedBatch:
+    pid = hash_partition_ids(batch, key_idxs, num_partitions)
+    return partition_by_ids(batch, pid, num_partitions)
+
+
+def round_robin_partition(batch: ColumnBatch, num_partitions: int,
+                          start: int = 0) -> PartitionedBatch:
+    """GpuRoundRobinPartitioning analog (deterministic start per task)."""
+    pid = ((jnp.arange(batch.capacity, dtype=jnp.int32) + start)
+           % num_partitions)
+    return partition_by_ids(batch, pid, num_partitions)

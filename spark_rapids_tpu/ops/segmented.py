@@ -1,0 +1,104 @@
+"""Sort-based grouping + segmented reductions — the cuDF
+`Table.groupBy(...).aggregate(...)` replacement.
+
+cuDF uses a device hash-map groupby; HLO has no hash tables, but
+`lax.sort` + `jax.ops.segment_*` map perfectly onto TPU: sort rows by the
+orderable group keys, find segment boundaries, then segmented reductions
+with num_segments = capacity (static). Group outputs land compacted at
+segment-id positions, so the result batch needs no extra compaction pass.
+
+Reference: GpuAggregateExec.scala:175-400 (AggHelper pre-process ->
+groupby -> merge).
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import ColumnBatch, DeviceColumn
+from spark_rapids_tpu.ops.common import (
+    equality_keys,
+    normalize_floating,
+    rows_equal_adjacent,
+    sort_permutation,
+)
+
+
+class GroupedBatch(NamedTuple):
+    """Sorted-by-key view of a batch with segment structure."""
+
+    sorted_batch: ColumnBatch      # rows permuted so groups are contiguous
+    gid: jnp.ndarray               # [cap] int32 segment id per sorted row
+    live: jnp.ndarray              # [cap] bool live mask in sorted order
+    num_groups: jnp.ndarray        # scalar int32
+    first_pos: jnp.ndarray         # [cap] int32: sorted position of each
+    #                                group's first row (by gid)
+
+
+def group_by(batch: ColumnBatch, key_idxs: Sequence[int]) -> GroupedBatch:
+    cap = batch.capacity
+    live = batch.live_mask()
+    keys: List[jnp.ndarray] = []
+    for i in key_idxs:
+        keys.extend(equality_keys(normalize_floating(batch.columns[i]),
+                                  live))
+    perm = sort_permutation(keys, cap)
+    sorted_keys = [jnp.take(k, perm) for k in keys]
+    live_s = jnp.take(live, perm)
+    eq = rows_equal_adjacent(sorted_keys)
+    boundary = live_s & ~eq
+    gid = (jnp.cumsum(boundary.astype(jnp.int32)) - 1).astype(jnp.int32)
+    gid = jnp.clip(gid, 0, cap - 1)
+    num_groups = jnp.sum(boundary).astype(jnp.int32)
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    big = jnp.int32(cap)
+    first_pos = jax.ops.segment_min(jnp.where(live_s, pos, big), gid,
+                                    num_segments=cap)
+    sorted_batch = batch.gather(perm, batch.num_rows)
+    return GroupedBatch(sorted_batch, gid, live_s, num_groups, first_pos)
+
+
+# --- segmented reduction primitives (masked; num_segments = capacity) ---
+
+def seg_count(valid: jnp.ndarray, gid: jnp.ndarray, cap: int) -> jnp.ndarray:
+    return jax.ops.segment_sum(valid.astype(jnp.int64), gid,
+                               num_segments=cap)
+
+
+def seg_sum(values: jnp.ndarray, valid: jnp.ndarray, gid: jnp.ndarray,
+            cap: int) -> jnp.ndarray:
+    zero = jnp.zeros((), dtype=values.dtype)
+    return jax.ops.segment_sum(jnp.where(valid, values, zero), gid,
+                               num_segments=cap)
+
+
+def seg_min(values: jnp.ndarray, valid: jnp.ndarray, gid: jnp.ndarray,
+            cap: int) -> jnp.ndarray:
+    if jnp.issubdtype(values.dtype, jnp.floating):
+        ident = jnp.array(jnp.inf, dtype=values.dtype)
+    else:
+        ident = jnp.array(jnp.iinfo(values.dtype).max, dtype=values.dtype)
+    return jax.ops.segment_min(jnp.where(valid, values, ident), gid,
+                               num_segments=cap)
+
+
+def seg_max(values: jnp.ndarray, valid: jnp.ndarray, gid: jnp.ndarray,
+            cap: int) -> jnp.ndarray:
+    if jnp.issubdtype(values.dtype, jnp.floating):
+        ident = jnp.array(-jnp.inf, dtype=values.dtype)
+    else:
+        ident = jnp.array(jnp.iinfo(values.dtype).min, dtype=values.dtype)
+    return jax.ops.segment_max(jnp.where(valid, values, ident), gid,
+                               num_segments=cap)
+
+
+def seg_first(values: jnp.ndarray, first_pos_valid: jnp.ndarray
+              ) -> jnp.ndarray:
+    """First (by sorted position) value per segment; the caller supplies
+    per-group positions (e.g. seg_min over valid positions for
+    FIRST(ignore nulls), or GroupedBatch.first_pos for group keys)."""
+    safe = jnp.clip(first_pos_valid, 0, values.shape[0] - 1)
+    return jnp.take(values, safe)

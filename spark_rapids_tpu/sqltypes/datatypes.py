@@ -1,0 +1,320 @@
+"""Spark SQL data types and their TPU device representations.
+
+Mirrors the type universe the reference supports on device (see
+`sql-plugin/src/main/scala/com/nvidia/spark/rapids/TypeChecks.scala:168`
+TypeSig and `GpuColumnVector.java` type mapping), re-based on dtypes XLA
+compiles well for TPU:
+
+- integral / fractional / boolean / date / timestamp -> jnp arrays of the
+  matching width (x64 enabled; TPU v5 executes f64 and i64).
+- StringType -> a padded byte matrix [rows, max_bytes] uint8 plus an int32
+  length vector. This replaces cuDF's offset+data string columns: fixed
+  shapes keep every string kernel (equality, hash, lexicographic sort keys,
+  substring, case mapping) a static-shape XLA computation. max_bytes is a
+  per-column property chosen at ingest.
+- DecimalType(p<=18) -> scaled int64 (cuDF DECIMAL64 analog). p>18 is
+  unsupported in v1 (the reference uses DECIMAL128 + JNI DecimalUtils).
+
+All types are singletons except DecimalType/StructType, matching Spark.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class DataType:
+    """Base of the SQL type lattice."""
+
+    #: numpy dtype of the primary device buffer (None for StringType).
+    np_dtype: Optional[np.dtype] = None
+
+    @property
+    def simpleString(self) -> str:
+        return type(self).__name__.replace("Type", "").lower()
+
+    def __repr__(self) -> str:
+        return type(self).__name__ + "()"
+
+    def __eq__(self, other) -> bool:
+        return type(self) is type(other)
+
+    def __hash__(self) -> int:
+        return hash(type(self).__name__)
+
+    @property
+    def default_size(self) -> int:
+        """Bytes per value of the device representation (validity excluded)."""
+        if self.np_dtype is None:
+            return 8
+        return np.dtype(self.np_dtype).itemsize
+
+
+class NullType(DataType):
+    np_dtype = np.dtype(np.int8)  # carrier; every row is null
+
+
+class BooleanType(DataType):
+    np_dtype = np.dtype(np.bool_)
+
+
+class NumericType(DataType):
+    pass
+
+
+class IntegralType(NumericType):
+    pass
+
+
+class ByteType(IntegralType):
+    np_dtype = np.dtype(np.int8)
+
+    @property
+    def simpleString(self):
+        return "tinyint"
+
+
+class ShortType(IntegralType):
+    np_dtype = np.dtype(np.int16)
+
+    @property
+    def simpleString(self):
+        return "smallint"
+
+
+class IntegerType(IntegralType):
+    np_dtype = np.dtype(np.int32)
+
+    @property
+    def simpleString(self):
+        return "int"
+
+
+class LongType(IntegralType):
+    np_dtype = np.dtype(np.int64)
+
+    @property
+    def simpleString(self):
+        return "bigint"
+
+
+class FractionalType(NumericType):
+    pass
+
+
+class FloatType(FractionalType):
+    np_dtype = np.dtype(np.float32)
+
+
+class DoubleType(FractionalType):
+    np_dtype = np.dtype(np.float64)
+
+
+class StringType(DataType):
+    """UTF-8 string; device layout is (bytes[rows, max_bytes] u8, len[rows] i32)."""
+
+    np_dtype = None
+
+
+class DateType(DataType):
+    """Days since 1970-01-01, int32 — same physical encoding as Spark/cuDF."""
+
+    np_dtype = np.dtype(np.int32)
+
+
+class TimestampType(DataType):
+    """Microseconds since epoch UTC, int64 — Spark's TIMESTAMP physical encoding."""
+
+    np_dtype = np.dtype(np.int64)
+
+
+class DecimalType(FractionalType):
+    """Fixed-point decimal; device representation is scaled int64.
+
+    The reference supports precision<=38 via cuDF DECIMAL128 and JNI
+    `DecimalUtils` (`SURVEY.md` section 2.12); v1 here covers precision<=18
+    (DECIMAL64). 128-bit (two-limb int64) is a planned extension.
+    """
+
+    MAX_PRECISION = 38
+    MAX_LONG_DIGITS = 18
+    np_dtype = np.dtype(np.int64)
+
+    def __init__(self, precision: int = 10, scale: int = 0):
+        if not (1 <= precision <= self.MAX_PRECISION):
+            raise ValueError(f"precision {precision} out of range")
+        if not (0 <= scale <= precision):
+            raise ValueError(f"scale {scale} out of range for precision {precision}")
+        self.precision = precision
+        self.scale = scale
+
+    @property
+    def simpleString(self):
+        return f"decimal({self.precision},{self.scale})"
+
+    def __repr__(self):
+        return f"DecimalType({self.precision},{self.scale})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, DecimalType)
+            and other.precision == self.precision
+            and other.scale == self.scale
+        )
+
+    def __hash__(self):
+        return hash(("decimal", self.precision, self.scale))
+
+
+class StructField:
+    def __init__(self, name: str, dataType: DataType, nullable: bool = True):
+        self.name = name
+        self.dataType = dataType
+        self.nullable = nullable
+
+    def __repr__(self):
+        return f"StructField({self.name!r}, {self.dataType!r}, {self.nullable})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, StructField)
+            and self.name == other.name
+            and self.dataType == other.dataType
+            and self.nullable == other.nullable
+        )
+
+
+class StructType(DataType):
+    def __init__(self, fields: Optional[List[StructField]] = None):
+        self.fields = list(fields or [])
+
+    def add(self, name: str, dataType: DataType, nullable: bool = True) -> "StructType":
+        return StructType(self.fields + [StructField(name, dataType, nullable)])
+
+    @property
+    def names(self) -> List[str]:
+        return [f.name for f in self.fields]
+
+    def field_index(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(name)
+
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            return self.fields[key]
+        return self.fields[self.field_index(key)]
+
+    def __len__(self):
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __repr__(self):
+        return f"StructType({self.fields!r})"
+
+    def __eq__(self, other):
+        return isinstance(other, StructType) and self.fields == other.fields
+
+    def __hash__(self):
+        return hash(tuple((f.name, f.dataType, f.nullable) for f in self.fields))
+
+
+# Singleton instances, Spark-style module-level names.
+null_t = NullType()
+boolean = BooleanType()
+byte = ByteType()
+short = ShortType()
+integer = IntegerType()
+long = LongType()
+float_t = FloatType()
+double = DoubleType()
+string = StringType()
+date = DateType()
+timestamp = TimestampType()
+
+INTEGRAL_TYPES: Tuple[DataType, ...] = (byte, short, integer, long)
+FRACTIONAL_TYPES: Tuple[DataType, ...] = (float_t, double)
+NUMERIC_TYPES: Tuple[DataType, ...] = INTEGRAL_TYPES + FRACTIONAL_TYPES
+ATOMIC_TYPES: Tuple[DataType, ...] = (
+    (boolean,) + NUMERIC_TYPES + (string, date, timestamp)
+)
+
+
+@functools.lru_cache(maxsize=None)
+def _promote_table():
+    order = [byte, short, integer, long, float_t, double]
+    return {t: i for i, t in enumerate(order)}
+
+
+def numeric_promotion(a: DataType, b: DataType) -> DataType:
+    """Spark's binary-arithmetic common type for non-decimal numerics."""
+    tbl = _promote_table()
+    if isinstance(a, DecimalType) or isinstance(b, DecimalType):
+        raise ValueError("decimal promotion handled by caller")
+    order = [byte, short, integer, long, float_t, double]
+    return order[max(tbl[a], tbl[b])]
+
+
+def from_arrow_type(at) -> DataType:
+    """pyarrow DataType -> Spark DataType."""
+    import pyarrow as pa
+
+    if pa.types.is_boolean(at):
+        return boolean
+    if pa.types.is_int8(at):
+        return byte
+    if pa.types.is_int16(at):
+        return short
+    if pa.types.is_int32(at):
+        return integer
+    if pa.types.is_int64(at):
+        return long
+    if pa.types.is_float32(at):
+        return float_t
+    if pa.types.is_float64(at):
+        return double
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return string
+    if pa.types.is_date32(at):
+        return date
+    if pa.types.is_timestamp(at):
+        return timestamp
+    if pa.types.is_decimal(at):
+        if at.precision > DecimalType.MAX_LONG_DIGITS:
+            raise TypeError(
+                f"decimal precision {at.precision} > 18 is not supported "
+                "(DECIMAL64 representation, v1 — see DecimalType docstring)")
+        return DecimalType(at.precision, at.scale)
+    if pa.types.is_dictionary(at):
+        return from_arrow_type(at.value_type)
+    raise TypeError(f"unsupported arrow type {at}")
+
+
+def to_arrow_type(dt: DataType):
+    import pyarrow as pa
+
+    mapping = {
+        BooleanType: pa.bool_(),
+        ByteType: pa.int8(),
+        ShortType: pa.int16(),
+        IntegerType: pa.int32(),
+        LongType: pa.int64(),
+        FloatType: pa.float32(),
+        DoubleType: pa.float64(),
+        StringType: pa.string(),
+        DateType: pa.date32(),
+        TimestampType: pa.timestamp("us", tz="UTC"),
+        NullType: pa.null(),
+    }
+    if isinstance(dt, DecimalType):
+        return pa.decimal128(dt.precision, dt.scale)
+    try:
+        return mapping[type(dt)]
+    except KeyError:
+        raise TypeError(f"unsupported type {dt}")

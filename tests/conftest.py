@@ -1,0 +1,29 @@
+"""Test configuration: force the CPU backend with a virtual 8-device mesh.
+
+Mirrors the reference's testing stance (SURVEY.md section 4): correctness
+suites run without special hardware; distributed semantics are tested on a
+virtual device mesh. On this machine the axon TPU plugin's sitecustomize
+calls `jax.config.update("jax_platforms", "axon,cpu")` at interpreter
+start, overriding JAX_PLATFORMS env — so the override must be undone via
+jax.config after import, before any backend initializes.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices()
+    assert devs[0].platform == "cpu", devs
+    return devs

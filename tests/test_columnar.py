@@ -1,0 +1,65 @@
+"""Arrow <-> device round trips and batch utilities."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar import (
+    arrow_to_device,
+    concat_batches,
+    device_to_arrow,
+    next_capacity,
+)
+
+
+def _roundtrip(table: pa.Table) -> pa.Table:
+    return device_to_arrow(arrow_to_device(table))
+
+
+def test_primitive_roundtrip():
+    t = pa.table({
+        "a": pa.array([1, 2, None, 4], type=pa.int64()),
+        "b": pa.array([1.5, None, 3.25, 4.0], type=pa.float64()),
+        "c": pa.array([True, None, False, True]),
+        "d": pa.array([7, None, -3, 0], type=pa.int32()),
+    })
+    assert _roundtrip(t).to_pydict() == t.to_pydict()
+
+
+def test_string_roundtrip():
+    t = pa.table({
+        "s": pa.array(["hello", None, "", "world-longer-string!", "é↑"]),
+    })
+    assert _roundtrip(t).to_pydict() == t.to_pydict()
+
+
+def test_date_timestamp_roundtrip():
+    t = pa.table({
+        "d": pa.array([0, 1, None, 20000], type=pa.date32()),
+        "ts": pa.array([0, 1_000_000, None, 2_000_000_000_000],
+                       type=pa.timestamp("us", tz="UTC")),
+    })
+    assert _roundtrip(t).to_pydict() == t.to_pydict()
+
+
+def test_empty_table():
+    t = pa.table({"a": pa.array([], type=pa.int64())})
+    assert _roundtrip(t).num_rows == 0
+
+
+def test_next_capacity_buckets():
+    assert next_capacity(0) == 1024
+    assert next_capacity(1024) == 1024
+    assert next_capacity(1025) == 2048
+    assert next_capacity(1_000_000) == 1 << 20
+
+
+def test_concat_batches():
+    t1 = pa.table({"a": pa.array([1, 2], type=pa.int64()),
+                   "s": pa.array(["x", "yy"])})
+    t2 = pa.table({"a": pa.array([3, None], type=pa.int64()),
+                   "s": pa.array([None, "zzz"])})
+    out = device_to_arrow(
+        concat_batches([arrow_to_device(t1), arrow_to_device(t2)]))
+    assert out.to_pydict() == {
+        "a": [1, 2, 3, None], "s": ["x", "yy", None, "zzz"]}

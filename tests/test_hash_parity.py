@@ -1,0 +1,168 @@
+"""Spark Murmur3 parity: device kernels vs a pure-Python reference
+implementation of org.apache.spark.unsafe.hash.Murmur3_x86_32.
+
+The reference gets this parity from the JNI `Hash` kernel
+(spark-rapids-jni); hash partitioning must agree with CPU Spark.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import DeviceColumn
+from spark_rapids_tpu.ops import hashing
+from spark_rapids_tpu.sqltypes.datatypes import (
+    boolean, double, float_t, integer, long, string,
+)
+
+M = (1 << 32) - 1
+
+
+def _rotl(x, r):
+    return ((x << r) | (x >> (32 - r))) & M
+
+
+def _toi32(x):
+    x &= M
+    return x - (1 << 32) if x >= 1 << 31 else x
+
+
+def _mix_k1(k1):
+    k1 = (k1 * 0xCC9E2D51) & M
+    k1 = _rotl(k1, 15)
+    return (k1 * 0x1B873593) & M
+
+
+def _mix_h1(h1, k1):
+    h1 ^= k1
+    h1 = _rotl(h1, 13)
+    return (h1 * 5 + 0xE6546B64) & M
+
+
+def _fmix(h1, n):
+    h1 ^= n
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & M
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & M
+    h1 ^= h1 >> 16
+    return h1
+
+
+def ref_hash_int(v, seed=42):
+    return _toi32(_fmix(_mix_h1(seed & M, _mix_k1(v & M)), 4))
+
+
+def ref_hash_long(v, seed=42):
+    v &= (1 << 64) - 1
+    h1 = _mix_h1(seed & M, _mix_k1(v & M))
+    h1 = _mix_h1(h1, _mix_k1((v >> 32) & M))
+    return _toi32(_fmix(h1, 8))
+
+
+def ref_hash_bytes(b, seed=42):
+    h1 = seed & M
+    aligned = (len(b) // 4) * 4
+    for i in range(0, aligned, 4):
+        w = b[i] | (b[i + 1] << 8) | (b[i + 2] << 16) | (b[i + 3] << 24)
+        h1 = _mix_h1(h1, _mix_k1(w))
+    for i in range(aligned, len(b)):
+        x = b[i] - 256 if b[i] >= 128 else b[i]
+        h1 = _mix_h1(h1, _mix_k1(x & M))
+    return _toi32(_fmix(h1, len(b)))
+
+
+def _device_hash(dtype, np_vals, lengths=None):
+    n = len(np_vals)
+    if lengths is not None:
+        col = DeviceColumn(dtype, jnp.asarray(np_vals),
+                           jnp.ones(n, bool), jnp.asarray(lengths))
+    else:
+        col = DeviceColumn(dtype, jnp.asarray(np_vals), jnp.ones(n, bool))
+    return list(np.asarray(hashing.hash_column(
+        col, jnp.full(n, jnp.int32(42)))))
+
+
+def test_hash_int32():
+    vals = np.array([0, 1, -1, 42, 2**31 - 1, -(2**31), 12345], np.int32)
+    assert _device_hash(integer, vals) == [ref_hash_int(int(v)) for v in vals]
+
+
+def test_hash_int64():
+    vals = np.array([0, 1, -1, 2**63 - 1, -(2**63), 987654321012], np.int64)
+    assert _device_hash(long, vals) == [ref_hash_long(int(v)) for v in vals]
+
+
+def test_hash_known_spark_vectors():
+    # Cross-checked against org.apache.spark.sql.functions.hash on Spark 3.5.
+    assert ref_hash_int(1) == -559580957
+    assert ref_hash_long(1) == -1712319331
+    assert _device_hash(integer, np.array([1], np.int32)) == [-559580957]
+    assert _device_hash(long, np.array([1], np.int64)) == [-1712319331]
+
+
+def test_hash_double():
+    import struct
+
+    vals = np.array([0.0, -0.0, 1.5, -3.25, np.nan, np.inf], np.float64)
+
+    def bits(d):
+        if d != d:
+            return 0x7FF8000000000000
+        if d == 0.0:
+            d = 0.0
+        return struct.unpack("<q", struct.pack("<d", d))[0]
+
+    assert _device_hash(double, vals) == [
+        ref_hash_long(bits(float(v))) for v in vals
+    ]
+
+
+def test_hash_float():
+    import struct
+
+    vals = np.array([0.0, -0.0, 2.5, np.nan], np.float32)
+
+    def bits(f):
+        if f != f:
+            return 0x7FC00000
+        if f == 0.0:
+            f = 0.0
+        return struct.unpack("<i", struct.pack("<f", np.float32(f)))[0]
+
+    assert _device_hash(float_t, vals) == [
+        ref_hash_int(bits(float(v))) for v in vals
+    ]
+
+
+@pytest.mark.parametrize("mb", [8, 16, 32])
+def test_hash_string(mb):
+    strs = [b"", b"a", b"ab", b"abc", b"abcd", b"hello world",
+            b"\xc3\xa9tat", b"abcdefg"]
+    strs = [s for s in strs if len(s) <= mb]
+    mat = np.zeros((len(strs), mb), np.uint8)
+    lens = np.zeros(len(strs), np.int32)
+    for i, s in enumerate(strs):
+        mat[i, :len(s)] = list(s)
+        lens[i] = len(s)
+    assert _device_hash(string, mat, lens) == [
+        ref_hash_bytes(list(s)) for s in strs
+    ]
+
+
+def test_hash_null_chaining():
+    # Null column leaves running hash unchanged (Spark HashExpression).
+    a = DeviceColumn(integer, jnp.asarray(np.array([1, 1], np.int32)),
+                     jnp.asarray(np.array([True, True])))
+    b = DeviceColumn(integer, jnp.asarray(np.array([7, 0], np.int32)),
+                     jnp.asarray(np.array([False, False])))
+    h = np.asarray(hashing.murmur3_columns([a, b]))
+    expect = ref_hash_int(1, 42)
+    assert list(h) == [expect, expect]
+
+
+def test_pmod_non_negative():
+    x = jnp.asarray(np.array([-5, -1, 0, 3, 7], np.int32))
+    r = np.asarray(hashing.pmod(x, 4))
+    assert (r >= 0).all() and list(r) == [3, 3, 0, 3, 3]

@@ -1,0 +1,113 @@
+"""Relational kernel unit tests: filter, groupby, join, partition, sort."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import arrow_to_device, device_to_arrow
+from spark_rapids_tpu.ops import filterops, joinops, partition, segmented
+from spark_rapids_tpu.ops.common import orderable_keys, sort_permutation
+
+
+def _table():
+    return pa.table({
+        "k": pa.array([3, 1, 2, 1, None, 3, 1], type=pa.int64()),
+        "v": pa.array([10.0, 20.0, None, 40.0, 50.0, 60.0, 70.0],
+                      type=pa.float64()),
+        "s": pa.array(["c", "a", "b", "a", None, "c", "a"]),
+    })
+
+
+def test_filter_compact():
+    b = arrow_to_device(_table())
+    keep = b.columns[1].validity & (b.columns[1].data > 15.0)
+    out = device_to_arrow(filterops.compact(b, keep))
+    assert out.column("v").to_pylist() == [20.0, 40.0, 50.0, 60.0, 70.0]
+    assert out.column("k").to_pylist() == [1, 1, None, 3, 1]
+
+
+def test_slice_head():
+    b = arrow_to_device(_table())
+    out = device_to_arrow(filterops.slice_head(b, 3))
+    assert out.num_rows == 3
+    assert out.column("k").to_pylist() == [3, 1, 2]
+
+
+def test_group_by_with_nulls():
+    b = arrow_to_device(_table())
+    g = segmented.group_by(b, [0])
+    cap = b.capacity
+    assert int(g.num_groups) == 4  # null, 1, 2, 3
+    vcol = g.sorted_batch.columns[1]
+    valid = vcol.validity & g.live
+    cnt = np.asarray(segmented.seg_count(valid, g.gid, cap))[:4]
+    sm = np.asarray(segmented.seg_sum(vcol.data, valid, g.gid, cap))[:4]
+    # group order: null first, then 1, 2, 3
+    assert list(cnt) == [1, 3, 0, 2]
+    assert list(sm) == [50.0, 130.0, 0.0, 70.0]
+
+
+def test_group_by_string_keys():
+    b = arrow_to_device(_table())
+    g = segmented.group_by(b, [2])
+    assert int(g.num_groups) == 4  # null, a, b, c
+
+
+def test_inner_join_gather_maps():
+    b = arrow_to_device(_table())
+    dim = arrow_to_device(pa.table({
+        "k": pa.array([1, 2, 4], type=pa.int64()),
+        "name": pa.array(["one", "two", "four"]),
+    }))
+    bt = joinops.build_side(dim, [0])
+    lo, counts = joinops.probe_ranges(bt, b, [0])
+    assert list(np.asarray(counts)[:7]) == [0, 1, 1, 1, 0, 0, 1]
+    pi, bi, total = joinops.expand_gather_maps(lo, counts, 16)
+    assert int(total) == 4
+    probe_rows = list(np.asarray(pi)[:4])
+    build_rows = list(np.asarray(bi)[:4])
+    assert probe_rows == [1, 2, 3, 6]
+    # dim sorted by key: row0=k1, row1=k2
+    assert build_rows == [0, 1, 0, 0]
+
+
+def test_join_duplicate_build_keys():
+    probe = arrow_to_device(pa.table({"k": pa.array([1, 2], pa.int64())}))
+    build = arrow_to_device(pa.table({
+        "k": pa.array([1, 1, 1, 2], pa.int64())}))
+    bt = joinops.build_side(build, [0])
+    lo, counts = joinops.probe_ranges(bt, probe, [0])
+    assert list(np.asarray(counts)[:2]) == [3, 1]
+    pi, bi, total = joinops.expand_gather_maps(lo, counts, 8)
+    assert int(total) == 4
+
+
+def test_hash_partition_covers_all_rows():
+    b = arrow_to_device(_table())
+    pb = partition.hash_partition(b, [0], 4)
+    assert int(np.asarray(pb.counts).sum()) == 7
+
+
+def test_sort_floats_total_order():
+    t = pa.table({"f": pa.array(
+        [1.0, -0.0, 0.0, np.nan, -np.inf, np.inf, -2.5], pa.float64())})
+    b = arrow_to_device(t)
+    keys = orderable_keys(b.columns[0], True, True, b.live_mask())
+    perm = sort_permutation(keys, b.capacity)
+    out = b.gather(perm, b.num_rows)
+    vals = np.asarray(out.columns[0].data)[:7]
+    # -inf, -2.5, -0.0, 0.0, 1.0, inf, nan (Spark/Java double order)
+    assert vals[0] == -np.inf and vals[5] == np.inf and np.isnan(vals[6])
+    assert list(vals[1:5]) == [-2.5, -0.0, 0.0, 1.0]
+    assert np.signbit(vals[2]) and not np.signbit(vals[3])
+
+
+def test_sort_strings_desc_nulls_last():
+    t = pa.table({"s": pa.array(["b", "abc", None, "ab", "z", ""])})
+    b = arrow_to_device(t)
+    keys = orderable_keys(b.columns[0], False, False, b.live_mask())
+    perm = sort_permutation(keys, b.capacity)
+    out = device_to_arrow(b.gather(perm, b.num_rows))
+    assert out.column("s").to_pylist() == ["z", "b", "abc", "ab", "", None]
