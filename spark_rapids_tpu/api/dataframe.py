@@ -1,0 +1,310 @@
+"""DataFrame — the user-facing lazy query surface (pyspark-compatible
+subset), building logical plans that TpuOverrides plans onto the device.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+import pyarrow as pa
+
+from spark_rapids_tpu.api.column import Column
+from spark_rapids_tpu.api.functions import UnresolvedColumn
+from spark_rapids_tpu.expr import Alias, BoundReference
+from spark_rapids_tpu.expr.aggregates import AggregateFunction
+from spark_rapids_tpu.expr.core import Expression
+from spark_rapids_tpu.plan import logical as L
+
+
+def _resolve(expr, schema) -> Expression:
+    """Replace UnresolvedColumn markers with BoundReferences."""
+    if isinstance(expr, UnresolvedColumn):
+        i = _field_index(schema, expr.name)
+        f = schema.fields[i]
+        return BoundReference(i, f.dataType, f.nullable)
+    if isinstance(expr, Expression):
+        new_children = [_resolve(c, schema) for c in expr.children]
+        return expr.with_children(new_children)
+    raise TypeError(f"cannot resolve {expr!r}")
+
+
+def _field_index(schema, name: str) -> int:
+    lowered = [n.lower() for n in schema.names]
+    if name in schema.names:
+        return schema.names.index(name)
+    if name.lower() in lowered:
+        return lowered.index(name.lower())
+    raise KeyError(f"column {name!r} not in {schema.names}")
+
+
+def _named(expr: Expression, fallback: str) -> Alias:
+    if isinstance(expr, Alias):
+        return expr
+    return Alias(expr, fallback)
+
+
+class DataFrame:
+    def __init__(self, plan: L.LogicalPlan, session):
+        self._plan = plan
+        self.session = session
+
+    # --- schema ---
+
+    @property
+    def schema(self):
+        return self._plan.schema
+
+    @property
+    def columns(self) -> List[str]:
+        return self._plan.schema.names
+
+    def __getitem__(self, name: str) -> Column:
+        i = _field_index(self.schema, name)
+        f = self.schema.fields[i]
+        return Column(BoundReference(i, f.dataType, f.nullable), name)
+
+    # --- transformations ---
+
+    def _col_expr(self, c) -> Expression:
+        if isinstance(c, str):
+            return self[c].expr
+        if isinstance(c, Column):
+            return _resolve(c.expr, self.schema)
+        raise TypeError(repr(c))
+
+    def select(self, *cols) -> "DataFrame":
+        exprs = []
+        for i, c in enumerate(cols):
+            if isinstance(c, str) and c == "*":
+                for j, f in enumerate(self.schema.fields):
+                    exprs.append(Alias(BoundReference(j, f.dataType,
+                                                      f.nullable), f.name))
+                continue
+            name = c if isinstance(c, str) else c.name
+            e = self._col_expr(c)
+            exprs.append(_named(e, name if isinstance(name, str)
+                                else f"col{i}"))
+        return DataFrame(L.Project(exprs, self._plan), self.session)
+
+    def withColumn(self, name: str, c: Column) -> "DataFrame":
+        exprs = []
+        replaced = False
+        for j, f in enumerate(self.schema.fields):
+            if f.name == name:
+                exprs.append(Alias(self._col_expr(c), name))
+                replaced = True
+            else:
+                exprs.append(Alias(BoundReference(j, f.dataType, f.nullable),
+                                   f.name))
+        if not replaced:
+            exprs.append(Alias(self._col_expr(c), name))
+        return DataFrame(L.Project(exprs, self._plan), self.session)
+
+    def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
+        exprs = []
+        for j, f in enumerate(self.schema.fields):
+            exprs.append(Alias(BoundReference(j, f.dataType, f.nullable),
+                               new if f.name == old else f.name))
+        return DataFrame(L.Project(exprs, self._plan), self.session)
+
+    def drop(self, *names) -> "DataFrame":
+        keep = [f.name for f in self.schema.fields if f.name not in names]
+        return self.select(*keep)
+
+    def filter(self, condition) -> "DataFrame":
+        if isinstance(condition, str):
+            raise NotImplementedError("SQL string filters: use Column")
+        cond = self._col_expr(condition)
+        return DataFrame(L.Filter(cond, self._plan), self.session)
+
+    where = filter
+
+    def groupBy(self, *cols) -> "GroupedData":
+        return GroupedData(self, list(cols))
+
+    def agg(self, *cols) -> "DataFrame":
+        return GroupedData(self, []).agg(*cols)
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner"
+             ) -> "DataFrame":
+        how = {"outer": "full", "full_outer": "full", "leftouter": "left",
+               "rightouter": "right", "leftsemi": "left_semi",
+               "semi": "left_semi", "leftanti": "left_anti",
+               "anti": "left_anti", "cross": "inner"}.get(how, how)
+        if isinstance(on, str):
+            on = [on]
+        if isinstance(on, (list, tuple)) and on and isinstance(on[0], str):
+            lk = [self[c].expr for c in on]
+            rk = [other[c].expr for c in on]
+        else:
+            raise NotImplementedError(
+                "join requires column-name keys in v1")
+        # implicit cast to the common key type (Spark's ImplicitTypeCasts)
+        from spark_rapids_tpu.expr import Cast
+        from spark_rapids_tpu.sqltypes import NumericType
+        from spark_rapids_tpu.sqltypes.datatypes import numeric_promotion
+
+        left_plan, right_plan = self._plan, other._plan
+        lcast, rcast = [], []
+        for i, (a, b) in enumerate(zip(lk, rk)):
+            if a.dtype != b.dtype:
+                if isinstance(a.dtype, NumericType) and isinstance(
+                        b.dtype, NumericType):
+                    common = numeric_promotion(a.dtype, b.dtype)
+                    if a.dtype != common:
+                        lcast.append((i, common))
+                    if b.dtype != common:
+                        rcast.append((i, common))
+                else:
+                    raise TypeError(
+                        f"join key type mismatch: {a.dtype} vs {b.dtype}")
+        df_l, df_r = self, other
+        if lcast:
+            for i, common in lcast:
+                df_l = df_l.withColumn(on[i],
+                                       Column(Cast(lk[i], common)))
+            left_plan = df_l._plan
+            lk = [df_l[c].expr for c in on]
+        if rcast:
+            for i, common in rcast:
+                df_r = df_r.withColumn(on[i],
+                                       Column(Cast(rk[i], common)))
+            right_plan = df_r._plan
+            rk = [df_r[c].expr for c in on]
+        plan = L.Join(left_plan, right_plan, how, lk, rk)
+        return DataFrame(plan, self.session)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(L.Union([self._plan, other._plan]), self.session)
+
+    unionAll = union
+
+    def orderBy(self, *cols, ascending=None) -> "DataFrame":
+        orders = []
+        asc_list = (ascending if isinstance(ascending, (list, tuple))
+                    else [ascending] * len(cols))
+        for c, asc in zip(cols, asc_list):
+            a = True if asc is None else bool(asc)
+            orders.append(L.SortOrder(self._col_expr(c), a))
+        return DataFrame(L.Sort(orders, self._plan, global_sort=True),
+                         self.session)
+
+    sort = orderBy
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(L.Limit(n, self._plan), self.session)
+
+    def distinct(self) -> "DataFrame":
+        return self.groupBy(*self.columns).agg()
+
+    def repartition(self, n: int, *cols) -> "DataFrame":
+        keys = [self._col_expr(c) for c in cols] or None
+        return DataFrame(L.Repartition(self._plan, n, keys), self.session)
+
+    # --- actions ---
+
+    def _physical(self):
+        from spark_rapids_tpu.plan.overrides import plan_query
+
+        return plan_query(self._plan, self.session.rapids_conf)
+
+    def collect_arrow(self) -> pa.Table:
+        phys, _ = self._physical()
+        if self.session.rapids_conf.is_explain_only:
+            return pa.table({})
+        return phys.collect()
+
+    def collect(self) -> List[tuple]:
+        t = self.collect_arrow()
+        names = t.column_names
+        cols = [t.column(i).to_pylist() for i in range(t.num_columns)]
+        return [Row(zip(names, vals)) for vals in zip(*cols)] if cols \
+            else []
+
+    def toPandas(self):
+        return self.collect_arrow().to_pandas()
+
+    def count(self) -> int:
+        from spark_rapids_tpu.api import functions as F
+
+        agg_df = self.agg(F.count("*").alias("count"))
+        return agg_df.collect_arrow().column("count").to_pylist()[0]
+
+    def show(self, n: int = 20, truncate: bool = True):
+        print(self.limit(n).toPandas().to_string(index=False))
+
+    def explain(self, extended: bool = False):
+        phys, meta = self._physical()
+        print("== Physical Plan ==")
+        print(phys.pretty())
+        if extended:
+            print("== Device Placement ==")
+            print(meta.explain(only_not_on_device=False))
+
+    def write_parquet(self, path: str):
+        self.session.write_parquet(self, path)
+
+
+class Row(dict):
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError as e:
+            raise AttributeError(k) from e
+
+    def __repr__(self):
+        return "Row(" + ", ".join(f"{k}={v!r}" for k, v in
+                                  self.items()) + ")"
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, cols):
+        self.df = df
+        self.grouping = [
+            _named(df._col_expr(c), c if isinstance(c, str) else c.name)
+            for c in cols]
+
+    def agg(self, *cols) -> DataFrame:
+        aggs = []
+        for i, c in enumerate(cols):
+            e = self.df._col_expr(c)
+            base = e.children[0] if isinstance(e, Alias) else e
+            assert isinstance(base, AggregateFunction), \
+                f"agg() requires aggregate expressions, got {base!r}"
+            name = (e.name if isinstance(e, Alias)
+                    else f"{base.name}({_input_name(base)})")
+            aggs.append(Alias(base, name) if not isinstance(e, Alias) else e)
+        plan = L.Aggregate(self.grouping, aggs, self.df._plan)
+        return DataFrame(plan, self.df.session)
+
+    def count(self) -> DataFrame:
+        from spark_rapids_tpu.api import functions as F
+
+        return self.agg(F.count("*").alias("count"))
+
+    def _simple(self, fn, *cols) -> DataFrame:
+        from spark_rapids_tpu.api import functions as F
+
+        return self.agg(*[getattr(F, fn)(c).alias(f"{fn}({c})")
+                          for c in cols])
+
+    def sum(self, *cols):
+        return self._simple("sum", *cols)
+
+    def avg(self, *cols):
+        return self._simple("avg", *cols)
+
+    def min(self, *cols):
+        return self._simple("min", *cols)
+
+    def max(self, *cols):
+        return self._simple("max", *cols)
+
+
+def _input_name(fn: AggregateFunction) -> str:
+    if not fn.children:
+        return "*"
+    c = fn.children[0]
+    if isinstance(c, BoundReference):
+        return f"#{c.ordinal}"
+    return repr(c)

@@ -1,0 +1,146 @@
+"""pyspark.sql.functions-compatible surface (the subset backing v1)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from spark_rapids_tpu.api.column import Column, _expr
+from spark_rapids_tpu.expr import (
+    Abs, Alias, Average, CaseWhen, Cast, Coalesce, Concat, Count,
+    DayOfMonth, First, Hour, Length, Literal, Lower, Max, Min, Minute,
+    Murmur3Hash, Second, Substring, Sum, Upper, Year, Month,
+)
+
+# unresolved column marker: resolved by DataFrame against its schema
+
+
+class UnresolvedColumn:
+    def __init__(self, name: str):
+        self.name = name
+
+
+def col(name: str) -> Column:
+    return Column(UnresolvedColumn(name), name)  # type: ignore[arg-type]
+
+
+def lit(v: Any) -> Column:
+    return Column(Literal(v))
+
+
+def expr_of(c) -> Any:
+    if isinstance(c, Column):
+        return c.expr
+    if isinstance(c, str):
+        # bare strings name columns (pyspark convention for functions)
+        return UnresolvedColumn(c)
+    return _expr(c)
+
+
+# --- aggregates ---
+
+def sum(c) -> Column:  # noqa: A001
+    return Column(Sum(expr_of(c)))
+
+
+def count(c="*") -> Column:
+    if isinstance(c, str) and c == "*":
+        return Column(Count(None))
+    return Column(Count(expr_of(c)))
+
+
+def avg(c) -> Column:
+    return Column(Average(expr_of(c)))
+
+
+mean = avg
+
+
+def min(c) -> Column:  # noqa: A001
+    return Column(Min(expr_of(c)))
+
+
+def max(c) -> Column:  # noqa: A001
+    return Column(Max(expr_of(c)))
+
+
+def first(c, ignorenulls: bool = False) -> Column:
+    return Column(First(expr_of(c), ignore_nulls=ignorenulls))
+
+
+# --- scalar functions ---
+
+def abs(c) -> Column:  # noqa: A001
+    return Column(Abs(expr_of(c)))
+
+
+def coalesce(*cs) -> Column:
+    return Column(Coalesce(*[expr_of(c) for c in cs]))
+
+
+def concat(*cs) -> Column:
+    return Column(Concat(*[expr_of(c) for c in cs]))
+
+
+def substring(c, pos: int, length: int) -> Column:
+    return Column(Substring(expr_of(c), pos, length))
+
+
+def upper(c) -> Column:
+    return Column(Upper(expr_of(c)))
+
+
+def lower(c) -> Column:
+    return Column(Lower(expr_of(c)))
+
+
+def length(c) -> Column:
+    return Column(Length(expr_of(c)))
+
+
+def year(c) -> Column:
+    return Column(Year(expr_of(c)))
+
+
+def month(c) -> Column:
+    return Column(Month(expr_of(c)))
+
+
+def dayofmonth(c) -> Column:
+    return Column(DayOfMonth(expr_of(c)))
+
+
+def hour(c) -> Column:
+    return Column(Hour(expr_of(c)))
+
+
+def minute(c) -> Column:
+    return Column(Minute(expr_of(c)))
+
+
+def second(c) -> Column:
+    return Column(Second(expr_of(c)))
+
+
+def hash(*cs) -> Column:  # noqa: A001
+    return Column(Murmur3Hash(*[expr_of(c) for c in cs]))
+
+
+def when(condition: Column, value) -> "WhenBuilder":
+    return WhenBuilder([(expr_of(condition), expr_of(lit_or(value)))])
+
+
+def lit_or(v):
+    return v if isinstance(v, Column) else lit(v)
+
+
+class WhenBuilder(Column):
+    def __init__(self, branches):
+        self._branches = branches
+        super().__init__(CaseWhen(branches))
+
+    def when(self, condition: Column, value) -> "WhenBuilder":
+        return WhenBuilder(self._branches +
+                           [(expr_of(condition), expr_of(lit_or(value)))])
+
+    def otherwise(self, value) -> Column:
+        return Column(CaseWhen(self._branches, expr_of(lit_or(value))))

@@ -1,5 +1,184 @@
-"""Placeholder session (built out with the planner)."""
+"""TpuSparkSession — the plugin lifecycle + session entry point.
+
+Covers the responsibilities of the reference's driver/executor plugins
+(`Plugin.scala:412-684`): validate the device, initialize the memory
+pool/spill catalog (GpuDeviceManager.initializeGpuAndMemory), install the
+semaphore with the configured concurrency, and expose conf + read/write
+entry points. As a standalone engine it also owns what Spark itself would:
+session state, DataFrame creation, and the reader API.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import pyarrow as pa
+
+from spark_rapids_tpu.config import rapids_conf as rc
+
+
+class TpuSparkSessionBuilder:
+    def __init__(self):
+        self._conf: Dict[str, object] = {}
+
+    def config(self, key: str, value) -> "TpuSparkSessionBuilder":
+        self._conf[key] = value
+        return self
+
+    def master(self, _: str) -> "TpuSparkSessionBuilder":
+        return self
+
+    def appName(self, _: str) -> "TpuSparkSessionBuilder":
+        return self
+
+    def getOrCreate(self) -> "TpuSparkSession":
+        return TpuSparkSession(self._conf)
+
+
+class DataFrameReader:
+    def __init__(self, session: "TpuSparkSession"):
+        self.session = session
+        self._options: Dict[str, object] = {}
+        self._schema = None
+
+    def option(self, k, v):
+        self._options[k] = v
+        return self
+
+    def schema(self, s):
+        self._schema = s
+        return self
+
+    def parquet(self, *paths: str):
+        from spark_rapids_tpu.api.dataframe import DataFrame
+        from spark_rapids_tpu.columnar.arrow_bridge import schema_from_arrow
+        from spark_rapids_tpu.io.readers import infer_parquet_schema
+        from spark_rapids_tpu.plan.logical import FileScan
+
+        schema = self._schema or schema_from_arrow(
+            infer_parquet_schema(list(paths)))
+        return DataFrame(FileScan("parquet", list(paths), schema,
+                                  self._options), self.session)
+
+    def csv(self, path: str, header: bool = True, **kw):
+        from spark_rapids_tpu.api.dataframe import DataFrame
+        from spark_rapids_tpu.columnar.arrow_bridge import schema_from_arrow
+        from spark_rapids_tpu.io.readers import read_csv
+        from spark_rapids_tpu.plan.logical import FileScan
+
+        sample = read_csv(path, header=header, **kw)
+        schema = self._schema or schema_from_arrow(sample.schema)
+        opts = dict(self._options)
+        opts["header"] = header
+        return DataFrame(FileScan("csv", [path], schema, opts),
+                         self.session)
+
+    def json(self, path: str):
+        from spark_rapids_tpu.api.dataframe import DataFrame
+        from spark_rapids_tpu.columnar.arrow_bridge import schema_from_arrow
+        from spark_rapids_tpu.io.readers import read_json
+        from spark_rapids_tpu.plan.logical import FileScan
+
+        sample = read_json(path)
+        schema = self._schema or schema_from_arrow(sample.schema)
+        return DataFrame(FileScan("json", [path], schema, self._options),
+                         self.session)
+
+
+_active: Optional["TpuSparkSession"] = None
+_active_lock = threading.Lock()
 
 
 class TpuSparkSession:
-    pass
+    builder = None  # class attribute set below
+
+    def __init__(self, conf: Optional[Dict[str, object]] = None):
+        self._settings = dict(conf or {})
+        self.rapids_conf = rc.RapidsConf(self._settings)
+        self._init_runtime()
+        global _active
+        with _active_lock:
+            _active = self
+
+    def _init_runtime(self):
+        """Executor-plugin init path (Plugin.scala:484-545 analog)."""
+        from spark_rapids_tpu.runtime import memory, semaphore
+
+        memory.initialize_memory(self.rapids_conf, force=True)
+        semaphore.initialize(
+            self.rapids_conf.get(rc.CONCURRENT_TPU_TASKS))
+
+    # --- conf ---
+
+    class _ConfView:
+        def __init__(self, session):
+            self._s = session
+
+        def get(self, key: str, default=None):
+            try:
+                return self._s.rapids_conf[key]
+            except KeyError:
+                return self._s._settings.get(key, default)
+
+        def set(self, key: str, value):
+            self._s._settings[key] = value
+            self._s.rapids_conf = rc.RapidsConf(self._s._settings)
+
+    @property
+    def conf(self):
+        return TpuSparkSession._ConfView(self)
+
+    # --- data sources ---
+
+    @property
+    def read(self) -> DataFrameReader:
+        return DataFrameReader(self)
+
+    def createDataFrame(self, data, schema=None):
+        from spark_rapids_tpu.api.dataframe import DataFrame
+        from spark_rapids_tpu.plan.logical import LocalRelation
+
+        if isinstance(data, pa.Table):
+            table = data
+        elif hasattr(data, "dtypes") and hasattr(data, "columns"):
+            table = pa.Table.from_pandas(data, preserve_index=False)
+        elif isinstance(data, dict):
+            table = pa.table(data)
+        elif isinstance(data, list) and schema is not None:
+            names = schema if isinstance(schema, list) else schema.names
+            cols = list(zip(*data)) if data else [[] for _ in names]
+            table = pa.table({n: list(c) for n, c in zip(names, cols)})
+        else:
+            raise TypeError("createDataFrame accepts arrow Table, pandas "
+                            "DataFrame, dict of columns, or list of rows "
+                            "with schema")
+        return DataFrame(LocalRelation(table), self)
+
+    def range(self, start: int, end: Optional[int] = None, step: int = 1,
+              numPartitions: int = 1):
+        from spark_rapids_tpu.api.dataframe import DataFrame
+        from spark_rapids_tpu.plan.logical import Range
+
+        if end is None:
+            start, end = 0, start
+        return DataFrame(Range(start, end, step, numPartitions), self)
+
+    # --- write ---
+
+    def write_parquet(self, df, path: str):
+        from spark_rapids_tpu.io.readers import write_parquet
+
+        write_parquet(df.collect_arrow(), path)
+
+    def stop(self):
+        global _active
+        with _active_lock:
+            _active = None
+
+    @staticmethod
+    def active() -> Optional["TpuSparkSession"]:
+        return _active
+
+
+TpuSparkSession.builder = TpuSparkSessionBuilder()

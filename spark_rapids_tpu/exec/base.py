@@ -1,0 +1,107 @@
+"""Physical operator base — the GpuExec analog.
+
+Reference contract (`GpuExec.scala:214,377`): a physical operator exposes
+columnar execution over partitioned iterators of batches, with metrics
+and spill-aware state. Here:
+
+- `PhysicalPlan.execute_partition(pid, ctx)` returns an iterator of
+  payloads: device `ColumnBatch` for TPU operators, `pa.Table` for CPU
+  fallback operators. Transition nodes convert between them.
+- Exchanges are stage barriers: `TpuShuffleExchangeExec` materializes its
+  child's partitions into the in-process shuffle manager before reduce
+  partitions iterate.
+- `collect()` drives all partitions through a task thread pool, each task
+  guarded by the device semaphore (GpuSemaphore admission model).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator, List, Optional
+
+import pyarrow as pa
+
+from spark_rapids_tpu.columnar.batch import ColumnBatch
+from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime import semaphore as sem
+from spark_rapids_tpu.sqltypes import StructType
+
+_task_counter = itertools.count(1)
+
+
+class TaskContext:
+    def __init__(self, task_id: int, conf):
+        self.task_id = task_id
+        self.conf = conf
+
+
+class PhysicalPlan:
+    """Base physical node. is_tpu distinguishes device vs CPU operators."""
+
+    is_tpu = True
+
+    def __init__(self, children: List["PhysicalPlan"], schema: StructType,
+                 conf=None):
+        self.children = children
+        self.schema = schema
+        self.conf = conf
+        self.metrics = M.MetricsRegistry()
+
+    @property
+    def num_partitions(self) -> int:
+        return self.children[0].num_partitions if self.children else 1
+
+    def execute_partition(self, pid: int, ctx: TaskContext) -> Iterator:
+        raise NotImplementedError
+
+    # --- driver-side actions ---
+
+    def collect(self) -> pa.Table:
+        """Run all partitions -> one arrow table (driver collect)."""
+        from spark_rapids_tpu.columnar.arrow_bridge import device_to_arrow
+        from spark_rapids_tpu.sqltypes.datatypes import to_arrow_type
+
+        tables: List[Optional[pa.Table]] = [None] * self.num_partitions
+
+        def run(pid: int):
+            task_id = next(_task_counter)
+            ctx = TaskContext(task_id, self.conf)
+            parts = []
+            try:
+                for payload in self.execute_partition(pid, ctx):
+                    if isinstance(payload, ColumnBatch):
+                        parts.append(device_to_arrow(payload))
+                    else:
+                        parts.append(payload)
+            finally:
+                sem.get().release_if_necessary(task_id)
+            if parts:
+                tables[pid] = pa.concat_tables(parts, promote_options="none")
+
+        n = self.num_partitions
+        if n == 1:
+            run(0)
+        else:
+            with ThreadPoolExecutor(max_workers=min(8, n)) as pool:
+                list(pool.map(run, range(n)))
+        good = [t for t in tables if t is not None and t.num_rows >= 0]
+        if not good:
+            arrow_schema = pa.schema([
+                pa.field(f.name, to_arrow_type(f.dataType), f.nullable)
+                for f in self.schema.fields])
+            return pa.table({f.name: pa.array([], f.type)
+                             for f in arrow_schema},
+                            schema=arrow_schema)
+        return pa.concat_tables(good, promote_options="none")
+
+    def pretty(self, indent: int = 0) -> str:
+        marker = "Tpu" if self.is_tpu else "Cpu*"
+        s = "  " * indent + self._node_string()
+        for c in self.children:
+            s += "\n" + c.pretty(indent + 1)
+        return s
+
+    def _node_string(self) -> str:
+        return type(self).__name__

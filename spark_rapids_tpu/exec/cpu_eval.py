@@ -1,0 +1,302 @@
+"""CPU (pyarrow.compute) expression interpreter — the fallback backend.
+
+Plays two roles from the reference's world:
+1. CPU fallback for operators/expressions the device engine cannot run
+   (the reference falls back to CPU Spark per-operator via RapidsMeta
+   tagging; here per-operator CPU execs evaluate with this interpreter).
+2. The differential-test oracle: the test harness runs whole plans on
+   this backend and diffs against the TPU backend, mirroring
+   `assert_gpu_and_cpu_are_equal_collect` (integration_tests/asserts.py).
+
+Spark semantics notes: Kleene and/or via pc.*_kleene; divide-by-zero ->
+null; NaN equality/ordering handled explicitly; Spark `/` on integrals
+promotes to double.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from spark_rapids_tpu.expr import (
+    Abs, Add, Alias, And, BoundReference, Cast, CaseWhen, Coalesce, Concat,
+    Contains, Divide, EndsWith, EqualNullSafe, EqualTo, GreaterThan,
+    GreaterThanOrEqual, If, In, IntegralDivide, IsNaN, IsNotNull, IsNull,
+    Length, LessThan, LessThanOrEqual, Literal, Lower, Murmur3Hash, Not, Or,
+    Pmod, Remainder, StartsWith, Substring, Subtract, Multiply, UnaryMinus,
+    Upper, Year, Month, DayOfMonth, Hour, Minute, Second,
+)
+from spark_rapids_tpu.expr.core import Expression
+from spark_rapids_tpu.sqltypes import (
+    DecimalType,
+    DoubleType,
+    FloatType,
+    IntegralType,
+    StringType,
+)
+from spark_rapids_tpu.sqltypes.datatypes import to_arrow_type
+
+
+def eval_expr(expr: Expression, table: pa.Table) -> pa.ChunkedArray:
+    """Evaluate an expression against an arrow table -> arrow array."""
+    r = _ev(expr, table)
+    if isinstance(r, pa.Scalar):
+        r = pa.chunked_array([pa.array([r.as_py()] * table.num_rows,
+                                       type=r.type)])
+    if isinstance(r, pa.Array):
+        r = pa.chunked_array([r])
+    return r
+
+
+def _ev(e: Expression, t: pa.Table):
+    if isinstance(e, Alias):
+        return _ev(e.children[0], t)
+    if isinstance(e, BoundReference):
+        return t.column(e.ordinal)
+    if isinstance(e, Literal):
+        return pa.scalar(e.value, type=to_arrow_type(e.dtype))
+    if isinstance(e, Cast):
+        return _cast(e, t)
+    if isinstance(e, (Add, Subtract, Multiply)):
+        a, b = _ev(e.children[0], t), _ev(e.children[1], t)
+        out_t = to_arrow_type(e.dtype)
+        fn = {Add: pc.add_checked, Subtract: pc.subtract_checked,
+              Multiply: pc.multiply_checked}[type(e)]
+        if pa.types.is_decimal(out_t):
+            return pc.cast(fn(a, b), out_t)
+        # use unchecked wraparound for integrals like Java
+        fn2 = {Add: pc.add, Subtract: pc.subtract,
+               Multiply: pc.multiply}[type(e)]
+        return pc.cast(fn2(pc.cast(a, out_t), pc.cast(b, out_t)), out_t)
+    if isinstance(e, Divide):
+        a, b = _ev(e.children[0], t), _ev(e.children[1], t)
+        out_t = to_arrow_type(e.dtype)
+        if pa.types.is_decimal(out_t):
+            zero = pc.equal(pc.cast(b, pa.float64()), 0.0)
+            bf = pc.if_else(zero, pa.scalar(None, b.type), b)
+            return pc.cast(pc.divide(pc.cast(a, out_t), bf), out_t)
+        af = pc.cast(a, pa.float64())
+        bf = pc.cast(b, pa.float64())
+        zero = pc.equal(bf, 0.0)
+        bf = pc.if_else(zero, pa.scalar(None, pa.float64()), bf)
+        return pc.divide(af, bf)
+    if isinstance(e, IntegralDivide):
+        a = pc.cast(_ev(e.children[0], t), pa.int64())
+        b = pc.cast(_ev(e.children[1], t), pa.int64())
+        zero = pc.equal(b, 0)
+        b = pc.if_else(zero, pa.scalar(None, pa.int64()), b)
+        return pc.divide(a, b)  # arrow int division truncates toward zero
+    if isinstance(e, (Remainder, Pmod)):
+        out_t = to_arrow_type(e.dtype)
+        a = pc.cast(_ev(e.children[0], t), out_t)
+        b = pc.cast(_ev(e.children[1], t), out_t)
+        an, bn = a.to_numpy(zero_copy_only=False), b.to_numpy(
+            zero_copy_only=False)
+        mask = pc.or_kleene(pc.is_null(a), pc.or_kleene(
+            pc.is_null(b), pc.equal(pc.cast(b, pa.float64()), 0.0)))
+        with np.errstate(divide="ignore", invalid="ignore"):
+            bsafe = np.where(bn == 0, 1, bn)
+            if isinstance(e, Pmod):
+                r = np.mod(an, bsafe)
+                r = np.where(r < 0, r + np.abs(bsafe), r)
+            else:
+                r = np.fmod(an, bsafe)
+        return pa.array(r, type=out_t,
+                        mask=np.asarray(mask.to_numpy(zero_copy_only=False),
+                                        dtype=bool))
+    if isinstance(e, UnaryMinus):
+        return pc.negate(_ev(e.children[0], t))
+    if isinstance(e, Abs):
+        return pc.abs(_ev(e.children[0], t))
+    if isinstance(e, EqualTo):
+        a, b = _ev(e.children[0], t), _ev(e.children[1], t)
+        r = pc.equal(a, b)
+        if pa.types.is_floating(_type_of(a)):
+            both_nan = pc.and_(pc.is_nan(_fill_nonnull(a)),
+                               pc.is_nan(_fill_nonnull(b)))
+            r = pc.if_else(pc.and_kleene(pc.is_valid(a), pc.is_valid(b)),
+                           pc.or_(r, both_nan), pa.scalar(None, pa.bool_()))
+        return r
+    if isinstance(e, EqualNullSafe):
+        a, b = _ev(e.children[0], t), _ev(e.children[1], t)
+        an, bn = pc.is_null(a), pc.is_null(b)
+        eq = pc.fill_null(pc.equal(a, b), False)
+        if pa.types.is_floating(_type_of(a)):
+            both_nan = pc.and_(pc.is_nan(_fill_nonnull(a)),
+                               pc.is_nan(_fill_nonnull(b)))
+            eq = pc.or_(eq, pc.and_(both_nan, pc.and_(pc.is_valid(a),
+                                                      pc.is_valid(b))))
+        return pc.or_(pc.and_(an, bn), eq)
+    if isinstance(e, (LessThan, LessThanOrEqual, GreaterThan,
+                      GreaterThanOrEqual)):
+        return _compare(e, t)
+    if isinstance(e, And):
+        return pc.and_kleene(_ev(e.children[0], t), _ev(e.children[1], t))
+    if isinstance(e, Or):
+        return pc.or_kleene(_ev(e.children[0], t), _ev(e.children[1], t))
+    if isinstance(e, Not):
+        return pc.invert(_ev(e.children[0], t))
+    if isinstance(e, IsNull):
+        return pc.is_null(_ev(e.children[0], t))
+    if isinstance(e, IsNotNull):
+        return pc.is_valid(_ev(e.children[0], t))
+    if isinstance(e, IsNaN):
+        a = _ev(e.children[0], t)
+        return pc.fill_null(pc.is_nan(a), False)
+    if isinstance(e, In):
+        a = _ev(e.children[0], t)
+        non_null = [v for v in e.values if v is not None]
+        has_null = len(non_null) < len(e.values)
+        hit = pc.is_in(a, value_set=pa.array(non_null, type=_type_of(a)))
+        if has_null:
+            hit = pc.if_else(hit, True, pa.scalar(None, pa.bool_()))
+        return pc.if_else(pc.is_valid(a), hit, pa.scalar(None, pa.bool_()))
+    if isinstance(e, If):
+        return pc.if_else(pc.fill_null(_ev(e.children[0], t), False),
+                          _ev(e.children[1], t), _ev(e.children[2], t))
+    if isinstance(e, CaseWhen):
+        els = (_ev(e.children[-1], t) if e.has_else
+               else pa.scalar(None, to_arrow_type(e.dtype)))
+        out = els
+        for i in reversed(range(e.n_branches)):
+            cond = pc.fill_null(_ev(e.children[2 * i], t), False)
+            out = pc.if_else(cond, _ev(e.children[2 * i + 1], t), out)
+        return out
+    if isinstance(e, Coalesce):
+        out = _ev(e.children[0], t)
+        for c in e.children[1:]:
+            out = pc.if_else(pc.is_valid(out), out, _ev(c, t))
+        return out
+    if isinstance(e, Length):
+        return pc.cast(pc.utf8_length(_ev(e.children[0], t)), pa.int32())
+    if isinstance(e, Upper):
+        return pc.utf8_upper(_ev(e.children[0], t))
+    if isinstance(e, Lower):
+        return pc.utf8_lower(_ev(e.children[0], t))
+    if isinstance(e, Substring):
+        a = _ev(e.children[0], t)
+        # Spark 1-based pos; arrow slice is 0-based
+        if e.pos > 0:
+            start = e.pos - 1
+            stop = start + e.length
+            return pc.utf8_slice_codeunits(a, start, stop)
+        if e.pos == 0:
+            return pc.utf8_slice_codeunits(a, 0, e.length)
+        # negative: from end
+        start = e.pos
+        stop = None if e.length >= (1 << 30) else start + e.length
+        if stop is not None and stop >= 0:
+            stop = None
+        return pc.utf8_slice_codeunits(a, start, stop)
+    if isinstance(e, Concat):
+        args = [_ev(c, t) for c in e.children]
+        return pc.binary_join_element_wise(
+            *args, "", null_handling="emit_null")
+    if isinstance(e, StartsWith):
+        return pc.starts_with(_ev(e.children[0], t),
+                              e.needle.decode("utf-8"))
+    if isinstance(e, EndsWith):
+        return pc.ends_with(_ev(e.children[0], t), e.needle.decode("utf-8"))
+    if isinstance(e, Contains):
+        return pc.match_substring(_ev(e.children[0], t),
+                                  e.needle.decode("utf-8"))
+    if isinstance(e, Year):
+        return pc.cast(pc.year(_ev(e.children[0], t)), pa.int32())
+    if isinstance(e, Month):
+        return pc.cast(pc.month(_ev(e.children[0], t)), pa.int32())
+    if isinstance(e, DayOfMonth):
+        return pc.cast(pc.day(_ev(e.children[0], t)), pa.int32())
+    if isinstance(e, Hour):
+        return pc.cast(pc.hour(_ev(e.children[0], t)), pa.int32())
+    if isinstance(e, Minute):
+        return pc.cast(pc.minute(_ev(e.children[0], t)), pa.int32())
+    if isinstance(e, Second):
+        return pc.cast(pc.second(_ev(e.children[0], t)), pa.int32())
+    if isinstance(e, Murmur3Hash):
+        return _murmur3_cpu(e, t)
+    raise NotImplementedError(f"CPU eval for {type(e).__name__}")
+
+
+def _type_of(a):
+    return a.type
+
+
+def _fill_nonnull(a):
+    return pc.fill_null(a, 0.0)
+
+
+def _compare(e, t):
+    a, b = _ev(e.children[0], t), _ev(e.children[1], t)
+    op = {LessThan: pc.less, LessThanOrEqual: pc.less_equal,
+          GreaterThan: pc.greater,
+          GreaterThanOrEqual: pc.greater_equal}[type(e)]
+    r = op(a, b)
+    if pa.types.is_floating(_type_of(a)):
+        # Spark: NaN greatest, NaN == NaN
+        an = pc.fill_null(pc.is_nan(_fill_nonnull(a)), False)
+        bn = pc.fill_null(pc.is_nan(_fill_nonnull(b)), False)
+        if type(e) in (LessThan,):
+            r = pc.if_else(an, False, pc.if_else(bn, True, r))
+        elif type(e) in (GreaterThan,):
+            r = pc.if_else(bn, False, pc.if_else(an, True, r))
+        elif type(e) is LessThanOrEqual:
+            r = pc.if_else(bn, True, pc.if_else(an, False, r))
+        else:
+            r = pc.if_else(an, True, pc.if_else(bn, False, r))
+        r = pc.if_else(pc.and_kleene(pc.is_valid(a), pc.is_valid(b)), r,
+                       pa.scalar(None, pa.bool_()))
+    return r
+
+
+def _cast(e: Cast, t: pa.Table):
+    a = _ev(e.children[0], t)
+    frm, to = e.children[0].dtype, e.to
+    at = to_arrow_type(to)
+    if isinstance(to, StringType):
+        from spark_rapids_tpu.sqltypes import BooleanType, DateType
+
+        if isinstance(frm, (IntegralType, DecimalType)):
+            return pc.cast(a, pa.string())
+        if isinstance(frm, DateType):
+            return pc.strftime(a, format="%Y-%m-%d")
+        if isinstance(frm, BooleanType):
+            return pc.if_else(a, "true", "false")
+        return pc.cast(a, pa.string())
+    if isinstance(frm, (FloatType, DoubleType)) and isinstance(
+            to, IntegralType):
+        an = pc.cast(a, pa.float64()).to_numpy(zero_copy_only=False)
+        info = np.iinfo(to.np_dtype)
+        r = np.trunc(an)
+        with np.errstate(invalid="ignore"):
+            r = np.clip(r, float(info.min), float(info.max))
+        r = np.where(np.isnan(an), 0.0, r)
+        mask = np.asarray(pc.is_null(a).to_numpy(zero_copy_only=False),
+                          dtype=bool)
+        return pa.array(r.astype(to.np_dtype), type=at, mask=mask)
+    if isinstance(frm, IntegralType) and isinstance(to, IntegralType):
+        an = pc.cast(a, pa.int64()).to_numpy(zero_copy_only=False)
+        mask = np.asarray(pc.is_null(a).to_numpy(zero_copy_only=False),
+                          dtype=bool)
+        return pa.array(an.astype(to.np_dtype), type=at, mask=mask)  # wraps
+    return pc.cast(a, at, safe=False)
+
+
+def _murmur3_cpu(e: Murmur3Hash, t: pa.Table):
+    """Reference murmur3 on host via the same jnp kernels on numpy —
+    reuse device code through the CPU jax backend for exactness."""
+    from spark_rapids_tpu.columnar.arrow_bridge import arrow_to_device
+    from spark_rapids_tpu.expr.core import EvalContext
+
+    sub = pa.table({f"c{i}": eval_expr(c, t)
+                    for i, c in enumerate(e.children)})
+    b = arrow_to_device(sub)
+    from spark_rapids_tpu.expr import BoundReference as BR
+    from spark_rapids_tpu.expr.hashexpr import Murmur3Hash as MH
+
+    refs = [BR(i, f.dataType) for i, f in enumerate(b.schema.fields)]
+    col = MH(*refs, seed=e.seed).eval(EvalContext(b))
+    import jax
+
+    vals = np.asarray(jax.device_get(col.data))[:t.num_rows]
+    return pa.array(vals, type=pa.int32())

@@ -1,0 +1,923 @@
+"""Physical operators: TPU device execs + CPU fallback execs.
+
+TPU operators are the GpuExec family redesigned for XLA (SURVEY.md
+section 2.5): each hot path is a jitted function over ColumnBatch
+pytrees, compiled once per (expression tree, schema, capacity bucket) and
+cached by JAX. CPU operators execute the same semantics with pyarrow and
+serve as per-operator fallback AND the differential-test oracle.
+
+Operator -> reference mapping:
+- TpuProjectExec/TpuFilterExec   <- GpuProjectExec/GpuFilterExec
+  (basicPhysicalOperators.scala:350,783)
+- TpuHashAggregateExec           <- GpuHashAggregateExec
+  (GpuAggregateExec.scala:175-400): partial/final modes around an
+  exchange, sort-based device groupby.
+- TpuShuffleExchangeExec         <- GpuShuffleExchangeExecBase
+  (GpuShuffleExchangeExecBase.scala:261): device hash partition ->
+  contiguous slices -> shuffle manager; reduce side coalesces
+  (GpuShuffleCoalesceExec).
+- TpuShuffledHashJoinExec        <- GpuShuffledHashJoinExec
+  (GpuShuffledHashJoinExec.scala:107) via sorted-build gather maps.
+- TpuSortExec                    <- GpuSortExec (GpuSortExec.scala:151).
+- TpuFileScanExec                <- GpuFileSourceScanExec + multi-file
+  readers (GpuParquetScan.scala:1072,2051).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Iterator, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.columnar.arrow_bridge import (
+    arrow_to_device,
+    device_to_arrow,
+)
+from spark_rapids_tpu.columnar.batch import (
+    ColumnBatch,
+    DeviceColumn,
+    concat_batches,
+    next_capacity,
+)
+from spark_rapids_tpu.exec import cpu_eval
+from spark_rapids_tpu.exec.base import PhysicalPlan, TaskContext
+from spark_rapids_tpu.expr import Alias, BoundReference, EvalContext
+from spark_rapids_tpu.expr.aggregates import AggregateFunction
+from spark_rapids_tpu.io import readers
+from spark_rapids_tpu.ops import filterops, joinops, partition, segmented
+from spark_rapids_tpu.ops.common import orderable_keys, sort_permutation
+from spark_rapids_tpu.plan.logical import SortOrder
+from spark_rapids_tpu.runtime import semaphore as sem
+from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.shuffle.manager import get_shuffle_manager
+from spark_rapids_tpu.sqltypes import StructField, StructType
+from spark_rapids_tpu.sqltypes.datatypes import long, to_arrow_type
+
+
+def _acquire(ctx: TaskContext):
+    sem.get().acquire_if_necessary(ctx.task_id)
+
+
+# ---------------------------------------------------------------- sources
+
+class LocalRelationExec(PhysicalPlan):
+    is_tpu = False
+
+    def __init__(self, table: pa.Table, schema, conf, num_slices: int = 1):
+        super().__init__([], schema, conf)
+        self.table = table
+        self.num_slices = max(1, min(num_slices, max(1, table.num_rows)))
+
+    @property
+    def num_partitions(self):
+        return self.num_slices
+
+    def execute_partition(self, pid, ctx):
+        n = self.table.num_rows
+        per = (n + self.num_slices - 1) // self.num_slices
+        lo = min(pid * per, n)
+        hi = min(lo + per, n)
+        yield self.table.slice(lo, hi - lo)
+
+
+class RangeExec(PhysicalPlan):
+    """TPU range source (GpuRangeExec analog)."""
+
+    def __init__(self, start, end, step, num_partitions, schema, conf):
+        super().__init__([], schema, conf)
+        self.start, self.end, self.step = start, end, step
+        self._parts = max(1, num_partitions)
+
+    @property
+    def num_partitions(self):
+        return self._parts
+
+    def execute_partition(self, pid, ctx):
+        _acquire(ctx)
+        total = max(0, (self.end - self.start + self.step -
+                        (1 if self.step > 0 else -1)) // self.step)
+        per = (total + self._parts - 1) // self._parts
+        lo = min(pid * per, total)
+        hi = min(lo + per, total)
+        count = hi - lo
+        if count <= 0:
+            return
+        cap = next_capacity(count)
+        vals = (self.start +
+                (jnp.arange(cap, dtype=jnp.int64) + lo) * self.step)
+        col = DeviceColumn(long, vals, jnp.ones((cap,), bool))
+        yield ColumnBatch(self.schema, [col], count)
+
+
+class TpuFileScanExec(PhysicalPlan):
+    """Multi-file columnar scan; strategy per conf (PERFILE/COALESCING/
+    MULTITHREADED/AUTO)."""
+
+    def __init__(self, fmt: str, paths: List[str], schema, conf,
+                 pushed_columns: Optional[List[str]] = None):
+        super().__init__([], schema, conf)
+        self.fmt = fmt
+        self.paths = paths
+        self.pushed_columns = pushed_columns
+        from spark_rapids_tpu.config import rapids_conf as rc
+
+        self._batch_rows = conf.get(rc.MAX_READER_BATCH_SIZE_ROWS)
+        self._nthreads = conf.get(rc.MULTITHREADED_READ_NUM_THREADS)
+        self._strategy = conf.get(rc.PARQUET_READER_TYPE)
+        if fmt == "parquet":
+            coalesce_bytes = 128 << 20
+            self._tasks = readers.split_parquet_tasks(paths, coalesce_bytes)
+        else:
+            self._tasks = [[p] for p in readers.expand_paths(
+                paths, "." + fmt)]
+
+    @property
+    def num_partitions(self):
+        return max(1, len(self._tasks))
+
+    def execute_partition(self, pid, ctx):
+        if pid >= len(self._tasks) or not self._tasks[pid]:
+            return
+        files = self._tasks[pid]
+        cols = self.pushed_columns
+        if self.fmt == "parquet":
+            host_iter = readers.read_parquet_task(files, cols,
+                                                  self._batch_rows)
+        elif self.fmt == "csv":
+            host_iter = iter([readers.read_csv(f) for f in files])
+        elif self.fmt == "json":
+            host_iter = iter([readers.read_json(f) for f in files])
+        else:
+            raise ValueError(f"format {self.fmt}")
+        for table in host_iter:
+            _acquire(ctx)  # device admission right before H2D
+            self.metrics[M.NUM_INPUT_ROWS].add(table.num_rows)
+            yield arrow_to_device(table)
+
+
+class CpuFileScanExec(TpuFileScanExec):
+    is_tpu = False
+
+    def execute_partition(self, pid, ctx):
+        if pid >= len(self._tasks) or not self._tasks[pid]:
+            return
+        files = self._tasks[pid]
+        if self.fmt == "parquet":
+            yield from readers.read_parquet_task(
+                files, self.pushed_columns, self._batch_rows)
+        elif self.fmt == "csv":
+            for f in files:
+                yield readers.read_csv(f)
+        elif self.fmt == "json":
+            for f in files:
+                yield readers.read_json(f)
+
+
+# ------------------------------------------------------------ transitions
+
+class ArrowToDeviceExec(PhysicalPlan):
+    """Host arrow -> device batch (GpuRowToColumnarExec role)."""
+
+    def __init__(self, child, conf):
+        super().__init__([child], child.schema, conf)
+
+    def execute_partition(self, pid, ctx):
+        for table in self.children[0].execute_partition(pid, ctx):
+            _acquire(ctx)
+            yield arrow_to_device(table)
+
+
+class DeviceToArrowExec(PhysicalPlan):
+    """Device batch -> host arrow (GpuColumnarToRowExec role)."""
+
+    is_tpu = False
+
+    def __init__(self, child, conf):
+        super().__init__([child], child.schema, conf)
+
+    def execute_partition(self, pid, ctx):
+        for batch in self.children[0].execute_partition(pid, ctx):
+            yield device_to_arrow(batch)
+
+
+# ------------------------------------------------------- project / filter
+
+class TpuProjectExec(PhysicalPlan):
+    def __init__(self, exprs: List[Alias], child, schema, conf):
+        super().__init__([child], schema, conf)
+        self.exprs = exprs
+        self._jitted = jax.jit(self._run)
+
+    def _run(self, batch: ColumnBatch) -> ColumnBatch:
+        ctx = EvalContext(batch)
+        cols = [e.eval(ctx) for e in self.exprs]
+        return ColumnBatch(self.schema, cols, batch.num_rows)
+
+    def execute_partition(self, pid, ctx):
+        with self.metrics[M.OP_TIME].ns():
+            for batch in self.children[0].execute_partition(pid, ctx):
+                out = self._jitted(batch)
+                self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
+                yield out
+
+
+class CpuProjectExec(PhysicalPlan):
+    is_tpu = False
+
+    def __init__(self, exprs, child, schema, conf):
+        super().__init__([child], schema, conf)
+        self.exprs = exprs
+
+    def execute_partition(self, pid, ctx):
+        for table in self.children[0].execute_partition(pid, ctx):
+            arrays = [cpu_eval.eval_expr(e, table).combine_chunks()
+                      for e in self.exprs]
+            # from_arrays keeps duplicate output names (legal in Spark)
+            yield pa.Table.from_arrays(arrays,
+                                       names=[e.name for e in self.exprs])
+
+
+class TpuFilterExec(PhysicalPlan):
+    def __init__(self, condition, child, conf):
+        super().__init__([child], child.schema, conf)
+        self.condition = condition
+        self._jitted = jax.jit(self._run)
+
+    def _run(self, batch: ColumnBatch) -> ColumnBatch:
+        ctx = EvalContext(batch)
+        pred = self.condition.eval(ctx)
+        keep = pred.data & pred.validity
+        return filterops.compact(batch, keep)
+
+    def execute_partition(self, pid, ctx):
+        with self.metrics[M.FILTER_TIME].ns():
+            for batch in self.children[0].execute_partition(pid, ctx):
+                yield self._run_jit(batch)
+
+    def _run_jit(self, batch):
+        return self._jitted(batch)
+
+
+class CpuFilterExec(PhysicalPlan):
+    is_tpu = False
+
+    def __init__(self, condition, child, conf):
+        super().__init__([child], child.schema, conf)
+        self.condition = condition
+
+    def execute_partition(self, pid, ctx):
+        import pyarrow.compute as pc
+
+        for table in self.children[0].execute_partition(pid, ctx):
+            mask = cpu_eval.eval_expr(self.condition, table)
+            yield table.filter(pc.fill_null(mask, False))
+
+
+# -------------------------------------------------------------- aggregate
+
+def _buffer_schema(grouping: List[Alias], aggs: List[Alias]) -> StructType:
+    fields = [StructField(g.name, g.dtype, True) for g in grouping]
+    for i, a in enumerate(aggs):
+        fn: AggregateFunction = a.children[0]
+        for j, bt in enumerate(fn.buffer_types()):
+            fields.append(StructField(f"{a.name}#buf{j}", bt, True))
+    return StructType(fields)
+
+
+class TpuHashAggregateExec(PhysicalPlan):
+    """mode='partial' emits [keys..., buffers...]; mode='final' consumes
+    them post-shuffle and emits [keys..., results...]. mode='complete'
+    does both in one step (single-partition plans)."""
+
+    def __init__(self, mode: str, grouping: List[Alias], aggs: List[Alias],
+                 child, conf):
+        assert mode in ("partial", "final", "complete")
+        self.mode = mode
+        self.grouping = grouping
+        self.aggs = aggs
+        out_schema = (_buffer_schema(grouping, aggs) if mode == "partial"
+                      else StructType(
+                          [StructField(g.name, g.dtype, True)
+                           for g in grouping] +
+                          [StructField(a.name, a.dtype, True)
+                           for a in aggs]))
+        super().__init__([child], out_schema, conf)
+        self._jit_partial = jax.jit(self._partial)
+        self._jit_merge = jax.jit(self._merge_final)
+
+    # --- phases (each a single XLA program) ---
+
+    def _grouped(self, batch: ColumnBatch, key_idx):
+        return segmented.group_by(batch, key_idx)
+
+    def _partial(self, batch: ColumnBatch) -> ColumnBatch:
+        nkeys = len(self.grouping)
+        # evaluate grouping + agg inputs into a working batch
+        ctx = EvalContext(batch)
+        work_cols = [g.eval(ctx) for g in self.grouping]
+        input_cols = []
+        for a in self.aggs:
+            fn: AggregateFunction = a.children[0]
+            input_cols.append(fn.input.eval(ctx) if fn.input is not None
+                              else None)
+        fields = [StructField(g.name, g.dtype, True) for g in self.grouping]
+        concrete = [c for c in input_cols if c is not None]
+        for i, c in enumerate(concrete):
+            fields.append(StructField(f"in{i}", c.dtype, True))
+        work = ColumnBatch(StructType(fields), work_cols + concrete,
+                           batch.num_rows)
+        g = self._grouped(work, list(range(nkeys)))
+        cap = work.capacity
+        out_cols: List[DeviceColumn] = []
+        # group key columns: first row of each segment
+        for ki in range(nkeys):
+            col = g.sorted_batch.columns[ki]
+            safe = jnp.clip(g.first_pos, 0, cap - 1)
+            out_cols.append(DeviceColumn(
+                col.dtype, jnp.take(col.data, safe, axis=0),
+                jnp.take(col.validity, safe),
+                None if col.lengths is None else jnp.take(col.lengths, safe)))
+        ci = nkeys
+        for a, inp in zip(self.aggs, input_cols):
+            fn: AggregateFunction = a.children[0]
+            if inp is None:
+                vals = None
+            else:
+                vals = g.sorted_batch.columns[ci]
+                ci += 1
+            out_cols.extend(fn.update(vals, g.live, g.gid, cap))
+        return ColumnBatch(_buffer_schema(self.grouping, self.aggs),
+                           out_cols, g.num_groups)
+
+    def _merge_final(self, batch: ColumnBatch) -> ColumnBatch:
+        nkeys = len(self.grouping)
+        g = self._grouped(batch, list(range(nkeys)))
+        cap = batch.capacity
+        out_cols: List[DeviceColumn] = []
+        for ki in range(nkeys):
+            col = g.sorted_batch.columns[ki]
+            safe = jnp.clip(g.first_pos, 0, cap - 1)
+            out_cols.append(DeviceColumn(
+                col.dtype, jnp.take(col.data, safe, axis=0),
+                jnp.take(col.validity, safe),
+                None if col.lengths is None else jnp.take(col.lengths, safe)))
+        ci = nkeys
+        for a in self.aggs:
+            fn: AggregateFunction = a.children[0]
+            nb = len(fn.buffer_types())
+            bufs = [g.sorted_batch.columns[ci + j] for j in range(nb)]
+            ci += nb
+            merged = fn.merge(bufs, g.live, g.gid, cap)
+            out_cols.append(fn.evaluate(merged))
+        return ColumnBatch(self.schema, out_cols, g.num_groups)
+
+    def execute_partition(self, pid, ctx):
+        with self.metrics[M.AGG_TIME].ns():
+            batches = list(self.children[0].execute_partition(pid, ctx))
+            if not batches:
+                if len(self.grouping) == 0 and self.mode in ("final",
+                                                             "complete"):
+                    # global agg over empty input -> one default row
+                    yield self._empty_global_result()
+                return
+            merged = concat_batches(batches) if len(batches) > 1 \
+                else batches[0]
+            if self.mode == "partial":
+                yield self._jit_partial(merged)
+            elif self.mode == "final":
+                yield self._jit_merge(merged)
+            else:
+                part = self._jit_partial(merged)
+                yield self._jit_merge(part)
+
+    def _empty_global_result(self):
+        cols = []
+        for a in self.aggs:
+            fn = a.children[0]
+            from spark_rapids_tpu.expr.aggregates import Count
+
+            cap = 1024
+            if isinstance(fn, Count):
+                cols.append(DeviceColumn(
+                    long, jnp.zeros((cap,), jnp.int64),
+                    jnp.ones((cap,), bool)))
+            else:
+                dt = a.dtype
+                cols.append(DeviceColumn(
+                    dt, jnp.zeros((cap,), dt.np_dtype),
+                    jnp.zeros((cap,), bool)))
+        return ColumnBatch(self.schema, cols, 1)
+
+
+class CpuHashAggregateExec(PhysicalPlan):
+    """Arrow group_by fallback/oracle (complete mode only: runs before
+    any exchange on the gathered partition)."""
+
+    is_tpu = False
+
+    _ARROW_FN = {"sum": "sum", "count": "count", "min": "min", "max": "max",
+                 "avg": "mean", "first": "first"}
+
+    def __init__(self, grouping, aggs, child, schema, conf):
+        super().__init__([child], schema, conf)
+        self.grouping = grouping
+        self.aggs = aggs
+
+    def execute_partition(self, pid, ctx):
+        import pyarrow.compute as pc
+
+        tables = list(self.children[0].execute_partition(pid, ctx))
+        if not tables:
+            tables = []
+        table = (pa.concat_tables(tables, promote_options="none")
+                 if tables else None)
+        if table is None:
+            return
+        # evaluate grouping exprs + agg inputs as columns
+        cols = {}
+        for g_ in self.grouping:
+            cols[g_.name] = cpu_eval.eval_expr(g_, table)
+        in_names = []
+        for i, a in enumerate(self.aggs):
+            fn: AggregateFunction = a.children[0]
+            nm = f"__in{i}"
+            if fn.input is None:
+                cols[nm] = pa.chunked_array([
+                    pa.array(np.ones(table.num_rows, np.int64))])
+            else:
+                cols[nm] = cpu_eval.eval_expr(fn.input, table)
+            in_names.append(nm)
+        work = pa.table(cols)
+        key_names = [g_.name for g_ in self.grouping]
+        agg_specs = []
+        for i, a in enumerate(self.aggs):
+            fn = a.children[0]
+            arrow_fn = self._ARROW_FN[fn.name]
+            if fn.name == "count" and fn.input is None:
+                agg_specs.append((in_names[i], "sum"))
+            else:
+                agg_specs.append((in_names[i], arrow_fn))
+        if key_names:
+            res = work.group_by(key_names, use_threads=False).aggregate(
+                agg_specs)
+        else:
+            flat = {}
+            for (nm, fnname), a in zip(agg_specs, self.aggs):
+                val = getattr(pc, fnname)(work.column(nm))
+                flat[a.name] = pa.array([val.as_py()],
+                                        type=to_arrow_type(a.dtype))
+            yield pa.table(flat)
+            return
+        # rename result columns to output names and cast to Spark types
+        out = {}
+        for k in key_names:
+            out[k] = res.column(k)
+        for (nm, fnname), a in zip(agg_specs, self.aggs):
+            col = res.column(f"{nm}_{fnname}")
+            out[a.name] = pc.cast(col, to_arrow_type(a.dtype))
+        yield pa.table(out)
+
+
+# --------------------------------------------------------------- exchange
+
+class TpuShuffleExchangeExec(PhysicalPlan):
+    """Device hash/round-robin/single partitioning + in-process shuffle.
+
+    Map side runs once (driven by the first reduce task to arrive),
+    device-partitioning each child batch and storing contiguous arrow
+    slices; reduce side fetches + coalesces back to device.
+    """
+
+    def __init__(self, child, key_exprs: Optional[List], num_partitions,
+                 conf):
+        super().__init__([child], child.schema, conf)
+        self.key_exprs = key_exprs  # None -> round robin / single
+        self._nparts = max(1, num_partitions)
+        self._shuffle_id = None
+        self._map_done = False
+        import threading
+
+        self._lock = threading.Lock()
+        self._jit_partition = jax.jit(self._partition_batch)
+
+    @property
+    def num_partitions(self):
+        return self._nparts
+
+    def _partition_batch(self, batch: ColumnBatch):
+        if self.key_exprs:
+            ctx = EvalContext(batch)
+            key_cols = [e.eval(ctx) for e in self.key_exprs]
+            fields = list(batch.schema.fields) + [
+                StructField(f"__k{i}", c.dtype, True)
+                for i, c in enumerate(key_cols)]
+            work = ColumnBatch(StructType(fields),
+                               batch.columns + key_cols, batch.num_rows)
+            kidx = list(range(len(batch.columns),
+                              len(batch.columns) + len(key_cols)))
+            pid = partition.hash_partition_ids(work, kidx, self._nparts)
+            pb = partition.partition_by_ids(work, pid, self._nparts)
+            sorted_batch = pb.batch.select(list(range(len(batch.columns))))
+            return sorted_batch, pb.counts
+        pb = partition.round_robin_partition(batch, self._nparts)
+        return pb.batch, pb.counts
+
+    def _run_map_stage(self, ctx):
+        with self._lock:
+            if self._map_done:
+                return
+            mgr = get_shuffle_manager()
+            self._shuffle_id = mgr.new_shuffle_id()
+            nchild = self.children[0].num_partitions
+            for cpid in range(nchild):
+                for batch in self.children[0].execute_partition(cpid, ctx):
+                    if self._nparts == 1:
+                        mgr.put(self._shuffle_id, 0, device_to_arrow(batch))
+                        continue
+                    sorted_batch, counts = self._jit_partition(batch)
+                    host = device_to_arrow(sorted_batch)
+                    offs = np.concatenate(
+                        [[0], np.cumsum(np.asarray(counts))])
+                    for rp in range(self._nparts):
+                        lo, hi = int(offs[rp]), int(offs[rp + 1])
+                        if hi > lo:
+                            mgr.put(self._shuffle_id, rp,
+                                    host.slice(lo, hi - lo))
+            self._map_done = True
+
+    def execute_partition(self, pid, ctx):
+        self._run_map_stage(ctx)
+        mgr = get_shuffle_manager()
+        tables = mgr.fetch(self._shuffle_id, pid)
+        if not tables:
+            return
+        merged = pa.concat_tables(tables, promote_options="none")
+        _acquire(ctx)
+        # coalesce to device respecting batch size (ShuffleCoalesce)
+        from spark_rapids_tpu.config import rapids_conf as rc
+
+        max_rows = self.conf.get(rc.BATCH_SIZE_ROWS) if self.conf else 1 << 20
+        for off in range(0, max(merged.num_rows, 1), max_rows):
+            piece = merged.slice(off, min(max_rows,
+                                          merged.num_rows - off))
+            if piece.num_rows or merged.num_rows == 0:
+                yield arrow_to_device(piece)
+            if merged.num_rows == 0:
+                break
+
+
+class CpuShuffleExchangeExec(PhysicalPlan):
+    is_tpu = False
+
+    def __init__(self, child, key_exprs, num_partitions, conf):
+        super().__init__([child], child.schema, conf)
+        self.key_exprs = key_exprs
+        self._nparts = max(1, num_partitions)
+        self._shuffle_id = None
+        self._map_done = False
+        import threading
+
+        self._lock = threading.Lock()
+
+    @property
+    def num_partitions(self):
+        return self._nparts
+
+    def _run_map_stage(self, ctx):
+        with self._lock:
+            if self._map_done:
+                return
+            mgr = get_shuffle_manager()
+            self._shuffle_id = mgr.new_shuffle_id()
+            nchild = self.children[0].num_partitions
+            for cpid in range(nchild):
+                for table in self.children[0].execute_partition(cpid, ctx):
+                    if self._nparts == 1:
+                        mgr.put(self._shuffle_id, 0, table)
+                        continue
+                    if self.key_exprs is None:
+                        # round-robin (repartition(n) without keys)
+                        pid_arr = np.arange(table.num_rows) % self._nparts
+                        for rp in range(self._nparts):
+                            piece = table.filter(pa.array(pid_arr == rp))
+                            if piece.num_rows:
+                                mgr.put(self._shuffle_id, rp, piece)
+                        continue
+                    # CPU murmur3 partition matching device partitioning
+                    from spark_rapids_tpu.expr import Murmur3Hash
+
+                    h = cpu_eval.eval_expr(
+                        Murmur3Hash(*self.key_exprs), table)
+                    pid_arr = np.mod(np.asarray(h), self._nparts)
+                    pid_arr = np.where(pid_arr < 0, pid_arr + self._nparts,
+                                       pid_arr)
+                    for rp in range(self._nparts):
+                        mask = pa.array(pid_arr == rp)
+                        piece = table.filter(mask)
+                        if piece.num_rows:
+                            mgr.put(self._shuffle_id, rp, piece)
+            self._map_done = True
+
+    def execute_partition(self, pid, ctx):
+        self._run_map_stage(ctx)
+        tables = get_shuffle_manager().fetch(self._shuffle_id, pid)
+        if tables:
+            yield pa.concat_tables(tables, promote_options="none")
+
+
+# ------------------------------------------------------------------ joins
+
+class TpuShuffledHashJoinExec(PhysicalPlan):
+    """Partitioned equi-join; children must be co-partitioned by key
+    (the planner inserts exchanges). Right side is the build side."""
+
+    def __init__(self, left, right, join_type, left_keys, right_keys,
+                 schema, conf):
+        super().__init__([left, right], schema, conf)
+        self.join_type = join_type
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+
+    def execute_partition(self, pid, ctx):
+        with self.metrics[M.JOIN_TIME].ns():
+            right_batches = list(
+                self.children[1].execute_partition(pid, ctx))
+            left_batches = list(
+                self.children[0].execute_partition(pid, ctx))
+            out = self._join_partition(left_batches, right_batches)
+            if out is not None:
+                yield out
+
+    def _key_ordinals(self, side: int, keys) -> List[int]:
+        ords = []
+        for k in keys:
+            assert isinstance(k, BoundReference), \
+                "join keys must be column refs after planning"
+            ords.append(k.ordinal)
+        return ords
+
+    def _join_partition(self, left_batches, right_batches):
+        jt = self.join_type
+        if not left_batches and jt in ("inner", "left", "left_semi",
+                                       "left_anti"):
+            return None
+        if not right_batches and jt in ("inner", "left_semi"):
+            return None
+        lsch = self.children[0].schema
+        rsch = self.children[1].schema
+        left = (concat_batches(left_batches) if left_batches else None)
+        right = (concat_batches(right_batches) if right_batches else None)
+        lk = self._key_ordinals(0, self.left_keys)
+        rk = self._key_ordinals(1, self.right_keys)
+        if left is None:
+            if jt in ("right", "full"):
+                return self._right_only(right, rsch, lsch)
+            return None
+        if right is None:
+            if jt == "left_anti":
+                return left
+            if jt in ("left", "full"):
+                return self._left_unmatched_all(left, rsch)
+            return None
+
+        bt = joinops.build_side(right, rk)
+        lo, counts = joinops.probe_ranges(bt, left, lk)
+
+        if jt == "left_semi":
+            return filterops.compact(left, counts > 0)
+        if jt == "left_anti":
+            return filterops.compact(left, counts == 0)
+
+        eff_counts = counts
+        if jt in ("left", "full"):
+            live = left.live_mask()
+            eff_counts = jnp.where(live & (counts == 0), 1, counts)
+        total = int(jax.device_get(jnp.sum(eff_counts)))
+        extra = 0
+        matched_build = None
+        if jt == "full":
+            matched_build = self._matched_build_mask(bt, lo, counts)
+            extra = int(jax.device_get(
+                jnp.sum(~matched_build &
+                        bt.batch.live_mask())))
+        cap_out = next_capacity(total + extra)
+        pi, bi, _ = joinops.expand_gather_maps(lo, eff_counts, cap_out)
+        lcols = [c.gather(pi) for c in left.columns]
+        rcols = [c.gather(jnp.clip(bi, 0, right.capacity - 1))
+                 for c in bt.batch.columns]
+        if jt in ("left", "full"):
+            # rows that were fabricated for unmatched left rows: null right
+            unmatched = (counts == 0)
+            row_unmatched = jnp.take(unmatched, pi)
+            rcols = [DeviceColumn(c.dtype, c.data,
+                                  c.validity & ~row_unmatched, c.lengths)
+                     for c in rcols]
+        out_cols = lcols + rcols
+        out_schema = StructType(list(lsch.fields) + list(rsch.fields))
+        out = ColumnBatch(out_schema, out_cols, total)
+        if jt == "full" and extra > 0:
+            unmatched_right = filterops.compact(
+                bt.batch, ~matched_build)
+            pad = self._left_nulls_batch(lsch, unmatched_right)
+            out = concat_batches([out, pad])
+        return out
+
+    def _matched_build_mask(self, bt, lo, counts):
+        cap = bt.batch.capacity
+        delta = jnp.zeros((cap + 1,), jnp.int32)
+        hi = lo + counts
+        delta = delta.at[jnp.clip(lo, 0, cap)].add(
+            jnp.where(counts > 0, 1, 0))
+        delta = delta.at[jnp.clip(hi, 0, cap)].add(
+            jnp.where(counts > 0, -1, 0))
+        return jnp.cumsum(delta[:-1]) > 0
+
+    def _right_only(self, right, rsch, lsch):
+        pad = self._left_nulls_batch(lsch, right)
+        return pad
+
+    def _left_nulls_batch(self, lsch, right_batch):
+        """Rows with all-null left columns + the given right rows."""
+        cap = right_batch.capacity
+        from spark_rapids_tpu.columnar.batch import empty_like_schema
+
+        nulls = empty_like_schema(lsch, cap)
+        cols = nulls.columns + right_batch.columns
+        schema = StructType(list(lsch.fields) +
+                            list(right_batch.schema.fields))
+        return ColumnBatch(schema, cols, right_batch.num_rows)
+
+    def _left_unmatched_all(self, left, rsch):
+        cap = left.capacity
+        from spark_rapids_tpu.columnar.batch import empty_like_schema
+
+        nulls = empty_like_schema(rsch, cap)
+        schema = StructType(list(left.schema.fields) + list(rsch.fields))
+        return ColumnBatch(schema, left.columns + nulls.columns,
+                           left.num_rows)
+
+
+class CpuJoinExec(PhysicalPlan):
+    is_tpu = False
+
+    _ARROW_TYPE = {"inner": "inner", "left": "left outer",
+                   "right": "right outer", "full": "full outer",
+                   "left_semi": "left semi", "left_anti": "left anti"}
+
+    def __init__(self, left, right, join_type, left_keys, right_keys,
+                 schema, conf):
+        super().__init__([left, right], schema, conf)
+        self.join_type = join_type
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+
+    def execute_partition(self, pid, ctx):
+        lt = list(self.children[0].execute_partition(pid, ctx))
+        rt = list(self.children[1].execute_partition(pid, ctx))
+        if not lt and not rt:
+            return
+        lsch = self.children[0].schema
+        rsch = self.children[1].schema
+
+        def mk(tables, sch):
+            if tables:
+                return pa.concat_tables(tables, promote_options="none")
+            arrow_schema = pa.schema([
+                pa.field(f.name, to_arrow_type(f.dataType))
+                for f in sch.fields])
+            return arrow_schema.empty_table()
+
+        left = mk(lt, lsch)
+        right = mk(rt, rsch)
+        lnames = [lsch.names[k.ordinal] for k in self.left_keys]
+        rnames = [rsch.names[k.ordinal] for k in self.right_keys]
+        joined = left.join(
+            right, keys=lnames, right_keys=rnames,
+            join_type=self._ARROW_TYPE[self.join_type],
+            coalesce_keys=False)
+        # arrow drops right keys on coalesce; with coalesce_keys=False it
+        # keeps both and may reorder columns — normalize to schema order
+        want = self.schema.names
+        have = joined.column_names
+        cols = []
+        for i, nm in enumerate(want):
+            idx = have.index(nm)
+            cols.append(joined.column(idx))
+            have[idx] = None  # consume duplicates in order
+        yield pa.table(dict(zip(want, cols))) if len(set(want)) == len(
+            want) else pa.Table.from_arrays(
+                [c.combine_chunks() for c in cols], names=want)
+
+
+# ------------------------------------------------------------------- sort
+
+class TpuSortExec(PhysicalPlan):
+    def __init__(self, orders: List[SortOrder], child, conf):
+        super().__init__([child], child.schema, conf)
+        self.orders = orders
+        self._jitted = jax.jit(self._run)
+
+    def _run(self, batch: ColumnBatch) -> ColumnBatch:
+        live = batch.live_mask()
+        keys = []
+        ctx = EvalContext(batch)
+        for o in self.orders:
+            col = o.expr.eval(ctx)
+            keys.extend(orderable_keys(col, o.ascending, o.nulls_first,
+                                       live))
+        perm = sort_permutation(keys, batch.capacity)
+        return batch.gather(perm, batch.num_rows)
+
+    def execute_partition(self, pid, ctx):
+        with self.metrics[M.SORT_TIME].ns():
+            batches = list(self.children[0].execute_partition(pid, ctx))
+            if not batches:
+                return
+            merged = concat_batches(batches) if len(batches) > 1 \
+                else batches[0]
+            yield self._jitted(merged)
+
+
+class CpuSortExec(PhysicalPlan):
+    is_tpu = False
+
+    def __init__(self, orders, child, conf):
+        super().__init__([child], child.schema, conf)
+        self.orders = orders
+
+    def execute_partition(self, pid, ctx):
+        import pyarrow.compute as pc
+
+        tables = list(self.children[0].execute_partition(pid, ctx))
+        if not tables:
+            return
+        table = pa.concat_tables(tables, promote_options="none")
+        names = self.children[0].schema.names
+        sort_keys = []
+        placement = "at_start"
+        for o in self.orders:
+            assert isinstance(o.expr, BoundReference)
+            sort_keys.append((names[o.expr.ordinal],
+                              "ascending" if o.ascending else "descending"))
+            placement = "at_start" if o.nulls_first else "at_end"
+        idx = pc.sort_indices(
+            table, sort_keys=sort_keys, null_placement=placement)
+        yield table.take(idx)
+
+
+# ------------------------------------------------------------ limit/union
+
+class TpuLocalLimitExec(PhysicalPlan):
+    def __init__(self, n, child, conf):
+        super().__init__([child], child.schema, conf)
+        self.n = n
+
+    def execute_partition(self, pid, ctx):
+        remaining = self.n
+        for batch in self.children[0].execute_partition(pid, ctx):
+            if remaining <= 0:
+                return
+            out = filterops.slice_head(batch, remaining)
+            remaining -= out.row_count()
+            yield out
+
+
+class CpuLocalLimitExec(PhysicalPlan):
+    is_tpu = False
+
+    def __init__(self, n, child, conf):
+        super().__init__([child], child.schema, conf)
+        self.n = n
+
+    def execute_partition(self, pid, ctx):
+        remaining = self.n
+        for t in self.children[0].execute_partition(pid, ctx):
+            if remaining <= 0:
+                return
+            piece = t.slice(0, min(remaining, t.num_rows))
+            remaining -= piece.num_rows
+            yield piece
+
+
+class UnionExec(PhysicalPlan):
+    """Partition-concatenating union (GpuUnionExec analog); children's
+    partitions are appended."""
+
+    def __init__(self, children, schema, conf, tpu: bool):
+        super().__init__(children, schema, conf)
+        self.is_tpu = tpu
+
+    @property
+    def num_partitions(self):
+        return sum(c.num_partitions for c in self.children)
+
+    def execute_partition(self, pid, ctx):
+        for c in self.children:
+            if pid < c.num_partitions:
+                yield from c.execute_partition(pid, ctx)
+                return
+            pid -= c.num_partitions

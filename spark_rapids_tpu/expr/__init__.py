@@ -1,0 +1,29 @@
+from spark_rapids_tpu.expr.core import (  # noqa: F401
+    Expression,
+    BoundReference,
+    Literal,
+    EvalContext,
+    Alias,
+)
+from spark_rapids_tpu.expr.arith import (  # noqa: F401
+    Add, Subtract, Multiply, Divide, IntegralDivide, Remainder, Pmod,
+    UnaryMinus, Abs,
+)
+from spark_rapids_tpu.expr.predicates import (  # noqa: F401
+    EqualTo, EqualNullSafe, LessThan, LessThanOrEqual, GreaterThan,
+    GreaterThanOrEqual, And, Or, Not, IsNull, IsNotNull, IsNaN, In,
+)
+from spark_rapids_tpu.expr.conditional import (  # noqa: F401
+    If, CaseWhen, Coalesce,
+)
+from spark_rapids_tpu.expr.cast import Cast  # noqa: F401
+from spark_rapids_tpu.expr.strings import (  # noqa: F401
+    Length, Upper, Lower, Substring, Concat, StartsWith, EndsWith, Contains,
+)
+from spark_rapids_tpu.expr.datetimes import (  # noqa: F401
+    Year, Month, DayOfMonth, Hour, Minute, Second,
+)
+from spark_rapids_tpu.expr.aggregates import (  # noqa: F401
+    AggregateFunction, Sum, Count, Min, Max, Average, First,
+)
+from spark_rapids_tpu.expr.hashexpr import Murmur3Hash  # noqa: F401

@@ -1,0 +1,264 @@
+"""Declarative aggregate functions with partial/merge/final phases.
+
+Mirrors the reference's aggregate architecture
+(`org/apache/spark/sql/rapids/aggregate/aggregateFunctions.scala` +
+`GpuAggregateExec.scala:175-400`): each function declares
+- update: raw input values -> per-group partial buffers (segmented
+  reductions over the sorted/grouped batch),
+- merge: partial buffers from many batches/partitions -> combined
+  buffers (used after shuffle),
+- evaluate: buffers -> final value.
+
+Buffers are plain DeviceColumns, so partial-aggregate results travel
+through shuffle like any other batch.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import DeviceColumn
+from spark_rapids_tpu.expr.core import Expression
+from spark_rapids_tpu.ops import segmented
+from spark_rapids_tpu.sqltypes import (
+    DataType,
+    DecimalType,
+    DoubleType,
+    FloatType,
+)
+from spark_rapids_tpu.sqltypes.datatypes import double, long
+
+
+class AggregateFunction(Expression):
+    """Base; children[0] is the input expression (if any)."""
+
+    name: str = "agg"
+
+    @property
+    def input(self):
+        return self.children[0] if self.children else None
+
+    def buffer_types(self) -> List[DataType]:
+        raise NotImplementedError
+
+    def update(self, values: DeviceColumn, live, gid, cap
+               ) -> List[DeviceColumn]:
+        """Segmented partial aggregation over grouped input rows."""
+        raise NotImplementedError
+
+    def merge(self, buffers: List[DeviceColumn], live, gid, cap
+              ) -> List[DeviceColumn]:
+        """Combine partial buffers grouped by key."""
+        raise NotImplementedError
+
+    def evaluate(self, buffers: List[DeviceColumn]) -> DeviceColumn:
+        raise NotImplementedError
+
+
+def _sum_result_type(t: DataType) -> DataType:
+    if isinstance(t, (FloatType, DoubleType)):
+        return double
+    if isinstance(t, DecimalType):
+        p = min(DecimalType.MAX_LONG_DIGITS, t.precision + 10)
+        return DecimalType(p, t.scale)
+    return long
+
+
+class Sum(AggregateFunction):
+    name = "sum"
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return _sum_result_type(self.children[0].dtype)
+
+    def buffer_types(self):
+        return [self.dtype, long]  # (sum, count_nonnull)
+
+    def update(self, values, live, gid, cap):
+        out_t = self.dtype
+        valid = values.validity & live
+        data = values.data.astype(out_t.np_dtype)
+        s = segmented.seg_sum(data, valid, gid, cap)
+        cnt = segmented.seg_count(valid, gid, cap)
+        return [DeviceColumn(out_t, s, cnt > 0),
+                DeviceColumn(long, cnt, jnp.ones(cnt.shape, bool))]
+
+    def merge(self, buffers, live, gid, cap):
+        s = segmented.seg_sum(buffers[0].data,
+                              buffers[0].validity & live, gid, cap)
+        cnt = segmented.seg_sum(buffers[1].data, live, gid, cap)
+        return [DeviceColumn(buffers[0].dtype, s, cnt > 0),
+                DeviceColumn(long, cnt, jnp.ones(cnt.shape, bool))]
+
+    def evaluate(self, buffers):
+        return buffers[0]
+
+
+class Count(AggregateFunction):
+    """count(expr) skips nulls; count(*) counts rows (child=None)."""
+
+    name = "count"
+
+    def __init__(self, child: Expression = None):
+        super().__init__([child] if child is not None else [])
+
+    @property
+    def dtype(self):
+        return long
+
+    @property
+    def nullable(self):
+        return False
+
+    def buffer_types(self):
+        return [long]
+
+    def update(self, values, live, gid, cap):
+        if values is None:
+            valid = live
+        else:
+            valid = values.validity & live
+        cnt = segmented.seg_count(valid, gid, cap)
+        return [DeviceColumn(long, cnt, jnp.ones(cnt.shape, bool))]
+
+    def merge(self, buffers, live, gid, cap):
+        cnt = segmented.seg_sum(buffers[0].data, live, gid, cap)
+        return [DeviceColumn(long, cnt, jnp.ones(cnt.shape, bool))]
+
+    def evaluate(self, buffers):
+        return buffers[0]
+
+
+class _MinMax(AggregateFunction):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def buffer_types(self):
+        return [self.dtype]
+
+    def _seg(self, data, valid, gid, cap):
+        raise NotImplementedError
+
+    def update(self, values, live, gid, cap):
+        valid = values.validity & live
+        r = self._seg(values.data, valid, gid, cap)
+        cnt = segmented.seg_count(valid, gid, cap)
+        return [DeviceColumn(self.dtype, r, cnt > 0)]
+
+    def merge(self, buffers, live, gid, cap):
+        valid = buffers[0].validity & live
+        r = self._seg(buffers[0].data, valid, gid, cap)
+        cnt = segmented.seg_count(valid, gid, cap)
+        return [DeviceColumn(buffers[0].dtype, r, cnt > 0)]
+
+    def evaluate(self, buffers):
+        return buffers[0]
+
+
+class Min(_MinMax):
+    name = "min"
+
+    def _seg(self, data, valid, gid, cap):
+        return segmented.seg_min(data, valid, gid, cap)
+
+
+class Max(_MinMax):
+    name = "max"
+
+    def _seg(self, data, valid, gid, cap):
+        return segmented.seg_max(data, valid, gid, cap)
+
+
+class Average(AggregateFunction):
+    name = "avg"
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        # Spark: avg(decimal) -> decimal(p+4, s+4); others -> double.
+        t = self.children[0].dtype
+        if isinstance(t, DecimalType):
+            return DecimalType(min(18, t.precision + 4), min(18, t.scale + 4))
+        return double
+
+    def buffer_types(self):
+        return [_sum_result_type(self.children[0].dtype), long]
+
+    def update(self, values, live, gid, cap):
+        return Sum(self.children[0]).update(values, live, gid, cap)
+
+    def merge(self, buffers, live, gid, cap):
+        return Sum(self.children[0]).merge(buffers, live, gid, cap)
+
+    def evaluate(self, buffers):
+        s, cnt = buffers
+        out_t = self.dtype
+        safe = jnp.maximum(cnt.data, 1)
+        if isinstance(out_t, DecimalType):
+            in_t = self.children[0].dtype
+            up = out_t.scale - in_t.scale
+            num = s.data.astype(jnp.int64) * (10 ** up)
+            q = jnp.abs(num) // safe
+            rem = jnp.abs(num) - q * safe
+            q = q + (2 * rem >= safe).astype(jnp.int64)
+            data = jnp.sign(num) * q
+        else:
+            data = s.data.astype(jnp.float64) / safe.astype(jnp.float64)
+        return DeviceColumn(out_t, data, cnt.data > 0)
+
+
+class First(AggregateFunction):
+    name = "first"
+
+    def __init__(self, child: Expression, ignore_nulls: bool = True):
+        super().__init__([child])
+        self.ignore_nulls = ignore_nulls
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def key(self):
+        return ("first", self.ignore_nulls, self.children[0].key())
+
+    def buffer_types(self):
+        return [self.dtype]
+
+    def _first(self, values: DeviceColumn, valid, gid, cap):
+        pos = jnp.arange(values.data.shape[0], dtype=jnp.int32)
+        big = jnp.int32(values.data.shape[0])
+        import jax
+
+        fp = jax.ops.segment_min(jnp.where(valid, pos, big), gid,
+                                 num_segments=cap)
+        found = fp < big
+        safe = jnp.clip(fp, 0, values.data.shape[0] - 1)
+        data = jnp.take(values.data, safe, axis=0)
+        lengths = None if values.lengths is None else jnp.take(
+            values.lengths, safe)
+        return DeviceColumn(values.dtype, data,
+                            found & jnp.take(values.validity, safe), lengths)
+
+    def update(self, values, live, gid, cap):
+        valid = live & (values.validity if self.ignore_nulls
+                        else jnp.ones_like(live))
+        return [self._first(values, valid, gid, cap)]
+
+    def merge(self, buffers, live, gid, cap):
+        valid = live & (buffers[0].validity if self.ignore_nulls
+                        else jnp.ones_like(live))
+        return [self._first(buffers[0], valid, gid, cap)]
+
+    def evaluate(self, buffers):
+        return buffers[0]

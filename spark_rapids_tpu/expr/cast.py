@@ -1,0 +1,252 @@
+"""Cast — the Spark-compatible conversion matrix (GpuCast.scala analog).
+
+v1 device coverage (non-ANSI semantics):
+- numeric <-> numeric: integral narrowing wraps (Java semantics);
+  float -> integral saturates then truncates toward zero, NaN -> 0
+  (Java (long)(double) semantics); integral -> float is widening.
+- boolean <-> numeric.
+- date -> timestamp (midnight UTC) and timestamp -> date (floor days).
+- numeric/boolean/date -> string: digit-by-digit device formatting.
+- decimal <-> integral/decimal rescaling.
+- string -> int/long/double/date: NOT on device in v1; the planner tags
+  Cast(string -> x) for CPU fallback (the reference spent `CastStrings`
+  JNI kernels + 1,900 Scala lines here; a pallas parser is future work).
+
+Cast never raises in non-ANSI mode; invalid casts produce null.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import DeviceColumn
+from spark_rapids_tpu.expr.core import Expression
+from spark_rapids_tpu.sqltypes import (
+    BooleanType,
+    DataType,
+    DateType,
+    DecimalType,
+    DoubleType,
+    FloatType,
+    IntegralType,
+    LongType,
+    StringType,
+    TimestampType,
+)
+
+_US_PER_DAY = 86_400_000_000
+
+
+class Cast(Expression):
+    def __init__(self, child: Expression, to: DataType):
+        super().__init__([child])
+        self.to = to
+
+    @property
+    def dtype(self):
+        return self.to
+
+    def key(self):
+        return ("cast", repr(self.to), self.children[0].key())
+
+    def device_supported(self) -> bool:
+        frm = self.children[0].dtype
+        if isinstance(frm, StringType) and not isinstance(self.to, StringType):
+            return False
+        if isinstance(self.to, StringType) and isinstance(
+                frm, (TimestampType,)):
+            return False  # timestamp formatting: host fallback in v1
+        return True
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        frm, to = c.dtype, self.to
+        if frm == to:
+            return c
+        if isinstance(to, StringType):
+            return _cast_to_string(c)
+        if isinstance(frm, BooleanType):
+            data = c.data.astype(to.np_dtype)
+            return DeviceColumn(to, data, c.validity)
+        if isinstance(to, BooleanType):
+            return DeviceColumn(to, c.data != 0, c.validity)
+        if isinstance(frm, DateType) and isinstance(to, TimestampType):
+            return DeviceColumn(
+                to, c.data.astype(jnp.int64) * _US_PER_DAY, c.validity)
+        if isinstance(frm, TimestampType) and isinstance(to, DateType):
+            d = jnp.floor_divide(c.data, _US_PER_DAY).astype(jnp.int32)
+            return DeviceColumn(to, d, c.validity)
+        if isinstance(frm, DecimalType) or isinstance(to, DecimalType):
+            return _cast_decimal(c, frm, to)
+        if isinstance(frm, (FloatType, DoubleType)) and isinstance(
+                to, IntegralType):
+            # Java (int)/(long) of float: truncate toward zero, saturate,
+            # NaN -> 0.
+            f = c.data.astype(jnp.float64)
+            info = jnp.iinfo(to.np_dtype)
+            t = jnp.trunc(f)
+            t = jnp.clip(t, float(info.min), float(info.max))
+            t = jnp.where(jnp.isnan(f), 0.0, t)
+            return DeviceColumn(to, t.astype(to.np_dtype), c.validity)
+        # numeric widening/narrowing (wraps like Java) and int->float
+        return DeviceColumn(to, c.data.astype(to.np_dtype), c.validity)
+
+
+def _cast_decimal(c: DeviceColumn, frm: DataType, to: DataType
+                  ) -> DeviceColumn:
+    fs = frm.scale if isinstance(frm, DecimalType) else 0
+    if isinstance(to, DecimalType):
+        ts = to.scale
+        if isinstance(frm, (FloatType, DoubleType)):
+            # HALF_UP (Spark BigDecimal), not jnp.round's half-to-even
+            x = c.data.astype(jnp.float64) * (10.0 ** ts)
+            scaled = jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5)
+            data = scaled.astype(jnp.int64)
+            # overflow of the target precision -> null (non-ANSI)
+            limit = 10 ** min(18, to.precision)
+            valid = c.validity & (jnp.abs(scaled) < float(limit))
+            return DeviceColumn(to, data, valid)
+        src = c.data.astype(jnp.int64)
+        if ts >= fs:
+            data = src * (10 ** (ts - fs))
+        else:
+            f = 10 ** (fs - ts)
+            q = jnp.abs(src) // f
+            rem = jnp.abs(src) - q * f
+            q = q + (2 * rem >= f).astype(jnp.int64)  # HALF_UP
+            data = jnp.sign(src) * q
+        limit = 10 ** min(18, to.precision)
+        valid = c.validity & (jnp.abs(data) < limit)
+        return DeviceColumn(to, data, valid)
+    # decimal -> numeric
+    if isinstance(to, (FloatType, DoubleType)):
+        data = c.data.astype(jnp.float64) / (10.0 ** fs)
+        return DeviceColumn(to, data.astype(to.np_dtype), c.validity)
+    f = 10 ** fs
+    q = jnp.sign(c.data) * (jnp.abs(c.data.astype(jnp.int64)) // f)
+    return DeviceColumn(to, q.astype(to.np_dtype), c.validity)
+
+
+_MAX_DIGITS = 20
+
+
+def _cast_to_string(c: DeviceColumn) -> DeviceColumn:
+    """Integral/boolean/date -> UTF-8 padded byte matrix, fully on device."""
+    from spark_rapids_tpu.sqltypes.datatypes import string as string_t
+
+    if isinstance(c.dtype, BooleanType):
+        mb = 8
+        tmat = jnp.zeros((2, mb), jnp.uint8)
+        tmat = tmat.at[0, :5].set(jnp.asarray(list(b"false"), jnp.uint8))
+        tmat = tmat.at[1, :4].set(jnp.asarray(list(b"true"), jnp.uint8))
+        idx = c.data.astype(jnp.int32)
+        data = tmat[idx]
+        lengths = jnp.where(c.data, 4, 5).astype(jnp.int32)
+        return DeviceColumn(string_t, data, c.validity, lengths)
+    if isinstance(c.dtype, DateType):
+        return _date_to_string(c)
+    if isinstance(c.dtype, IntegralType):
+        return _int_to_string(c.data.astype(jnp.int64), c.validity)
+    if isinstance(c.dtype, DecimalType):
+        return _decimal_to_string(c)
+    raise TypeError(f"cast {c.dtype} -> string not supported on device")
+
+
+def _int_to_string(v: jnp.ndarray, validity: jnp.ndarray) -> DeviceColumn:
+    from spark_rapids_tpu.sqltypes.datatypes import string as string_t
+
+    n = v.shape[0]
+    neg = v < 0
+    # abs(INT64_MIN) overflows; handle via unsigned-style digit loop on
+    # negated positive magnitudes digit by digit.
+    mag = jnp.where(neg, -(v + 1), v)  # mag = |v| - 1 for negatives
+    digits = []
+    rest = mag
+    adj = neg.astype(jnp.int64)  # add back the 1 in the last digit
+    # produce digits least-significant first over |v| = mag + adj
+    carry = adj
+    for _ in range(_MAX_DIGITS):
+        d = rest % 10 + carry
+        carry = (d >= 10).astype(jnp.int64)
+        d = d % 10
+        digits.append(d)
+        rest = rest // 10
+    digs = jnp.stack(digits, axis=1)  # [n, MAX] LSB first
+    # significant digit count (>=1 so "0" renders)
+    nd = jnp.ones((n,), jnp.int32)
+    for i in range(1, _MAX_DIGITS):
+        nd = jnp.where(digs[:, i] > 0, i + 1, nd)
+    total_len = nd + neg.astype(jnp.int32)
+    mb = 32
+    pos = jnp.arange(mb, dtype=jnp.int32)[None, :]
+    # char at position p: '-' if p==0 and neg; else digit index
+    # (total_len-1-p) from LSB-first array
+    digit_idx = (total_len[:, None] - 1 - pos)
+    digit_idx_safe = jnp.clip(digit_idx, 0, _MAX_DIGITS - 1)
+    dchar = jnp.take_along_axis(digs, digit_idx_safe.astype(jnp.int64),
+                                axis=1) + ord("0")
+    out = jnp.where(neg[:, None] & (pos == 0), ord("-"), dchar)
+    mask = pos < total_len[:, None]
+    out = jnp.where(mask, out, 0).astype(jnp.uint8)
+    return DeviceColumn(string_t, out, validity, total_len)
+
+
+def _date_to_string(c: DeviceColumn) -> DeviceColumn:
+    """days since epoch -> 'YYYY-MM-DD' on device."""
+    from spark_rapids_tpu.expr.datetimes import civil_from_days
+    from spark_rapids_tpu.sqltypes.datatypes import string as string_t
+
+    y, m, d = civil_from_days(c.data.astype(jnp.int64))
+    mb = 16
+    n = c.data.shape[0]
+
+    def digit(x, p):
+        return (x // (10 ** p)) % 10 + ord("0")
+
+    cols = [
+        digit(y, 3), digit(y, 2), digit(y, 1), digit(y, 0),
+        jnp.full((n,), ord("-")),
+        digit(m, 1), digit(m, 0),
+        jnp.full((n,), ord("-")),
+        digit(d, 1), digit(d, 0),
+    ]
+    out = jnp.zeros((n, mb), jnp.uint8)
+    for i, col in enumerate(cols):
+        out = out.at[:, i].set(col.astype(jnp.uint8))
+    lengths = jnp.full((n,), 10, jnp.int32)
+    return DeviceColumn(string_t, out, c.validity, lengths)
+
+
+def _decimal_to_string(c: DeviceColumn) -> DeviceColumn:
+    from spark_rapids_tpu.sqltypes.datatypes import string as string_t
+
+    s = c.dtype.scale
+    if s == 0:
+        return _int_to_string(c.data.astype(jnp.int64), c.validity)
+    f = 10 ** s
+    whole = jnp.sign(c.data) * (jnp.abs(c.data.astype(jnp.int64)) // f)
+    frac = jnp.abs(c.data.astype(jnp.int64)) % f
+    w = _int_to_string(whole, c.validity)
+    neg_zero = (whole == 0) & (c.data < 0)
+    n = c.data.shape[0]
+    mb = 48
+    out = jnp.zeros((n, mb), jnp.uint8)
+    # shift whole part right by 1 where we need a '-' for -0.xx
+    wlen = w.lengths + neg_zero.astype(jnp.int32)
+    pos = jnp.arange(mb, dtype=jnp.int32)[None, :]
+    wsrc = jnp.clip(pos - neg_zero[:, None].astype(jnp.int32), 0,
+                    w.max_bytes - 1)
+    body = jnp.take_along_axis(
+        jnp.pad(w.data, ((0, 0), (0, mb - w.max_bytes))),
+        wsrc.astype(jnp.int64), axis=1)
+    body = jnp.where(neg_zero[:, None] & (pos == 0), ord("-"), body)
+    out = jnp.where(pos < wlen[:, None], body, 0)
+    # '.' then fraction digits (fixed s digits)
+    out = jnp.where(pos == wlen[:, None], ord("."), out)
+    fpos = pos - wlen[:, None] - 1
+    fdig = (frac[:, None] //
+            (10 ** jnp.clip(s - 1 - fpos, 0, 18))) % 10 + ord("0")
+    in_frac = (fpos >= 0) & (fpos < s)
+    out = jnp.where(in_frac, fdig, out).astype(jnp.uint8)
+    lengths = (wlen + 1 + s).astype(jnp.int32)
+    return DeviceColumn(string_t, out, c.validity, lengths)

@@ -1,0 +1,225 @@
+"""Expression tree core — the GpuExpression analog.
+
+The reference's expressions implement `columnarEval(batch) -> GpuColumnVector`
+(`sql-plugin/.../GpuExpressions.scala:155`), each node launching cuDF
+kernels. Here `Expression.eval(ctx)` emits jax/jnp ops instead; an entire
+projection/filter/aggregation expression tree is traced into ONE XLA
+program by the enclosing jitted operator, so per-node fusion is the
+compiler's job (the TPU answer to cuDF's AST fused-eval path,
+`GpuExpressions.scala:171` convertToAst).
+
+Null semantics follow Spark: every node declares nullability and
+propagates validity masks explicitly.
+
+`key()` returns a hashable structural description used to cache compiled
+operator programs across batches.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar.batch import ColumnBatch, DeviceColumn
+from spark_rapids_tpu.sqltypes import (
+    BooleanType,
+    DataType,
+    DateType,
+    DecimalType,
+    DoubleType,
+    FloatType,
+    IntegerType,
+    LongType,
+    StringType,
+    TimestampType,
+)
+
+
+class EvalContext:
+    """Carries the input batch plus derived values during tree evaluation."""
+
+    def __init__(self, batch: ColumnBatch):
+        self.batch = batch
+        self.live = batch.live_mask()
+
+    @property
+    def capacity(self) -> int:
+        return self.batch.capacity
+
+
+class Expression:
+    """Base expression node."""
+
+    def __init__(self, children: Sequence["Expression"] = ()):
+        self.children = list(children)
+
+    @property
+    def dtype(self) -> DataType:
+        raise NotImplementedError
+
+    @property
+    def nullable(self) -> bool:
+        return any(c.nullable for c in self.children)
+
+    def eval(self, ctx: EvalContext) -> DeviceColumn:
+        raise NotImplementedError
+
+    def key(self) -> Tuple:
+        return (type(self).__name__,
+                tuple(c.key() for c in self.children))
+
+    def references(self) -> List[int]:
+        out: List[int] = []
+        for c in self.children:
+            out.extend(c.references())
+        return out
+
+    def transform(self, fn) -> "Expression":
+        """Bottom-up rewrite; fn(node) returns node or a replacement."""
+        new_children = [c.transform(fn) for c in self.children]
+        node = self.with_children(new_children)
+        return fn(node)
+
+    def with_children(self, children: List["Expression"]) -> "Expression":
+        import copy
+
+        node = copy.copy(self)
+        node.children = list(children)
+        return node
+
+    def __repr__(self):
+        cs = ", ".join(repr(c) for c in self.children)
+        return f"{type(self).__name__}({cs})"
+
+
+class BoundReference(Expression):
+    """Reference to input column by ordinal (already resolved/bound)."""
+
+    def __init__(self, ordinal: int, dtype: DataType, nullable: bool = True):
+        super().__init__()
+        self.ordinal = ordinal
+        self._dtype = dtype
+        self._nullable = nullable
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self._nullable
+
+    def eval(self, ctx: EvalContext) -> DeviceColumn:
+        return ctx.batch.columns[self.ordinal]
+
+    def key(self):
+        return ("ref", self.ordinal, repr(self._dtype))
+
+    def references(self):
+        return [self.ordinal]
+
+    def __repr__(self):
+        return f"col#{self.ordinal}"
+
+
+class Literal(Expression):
+    def __init__(self, value: Any, dtype: Optional[DataType] = None):
+        super().__init__()
+        if dtype is None:
+            dtype = _infer_literal_type(value)
+        self.value = value
+        self._dtype = dtype
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self.value is None
+
+    def eval(self, ctx: EvalContext) -> DeviceColumn:
+        cap = ctx.capacity
+        dt = self._dtype
+        if isinstance(dt, StringType):
+            raw = (self.value or "").encode("utf-8")
+            mb = max(8, 1 << max(0, (len(raw) - 1)).bit_length())
+            mat = np.zeros((1, mb), np.uint8)
+            mat[0, :len(raw)] = list(raw)
+            data = jnp.broadcast_to(jnp.asarray(mat), (cap, mb))
+            lengths = jnp.full((cap,), np.int32(len(raw)))
+            valid = jnp.full((cap,), self.value is not None)
+            return DeviceColumn(dt, data, valid, lengths)
+        if self.value is None:
+            data = jnp.zeros((cap,), dt.np_dtype)
+            return DeviceColumn(dt, data, jnp.zeros((cap,), bool))
+        v = self.value
+        if isinstance(dt, DecimalType):
+            import decimal
+
+            v = int(decimal.Decimal(str(v)).scaleb(dt.scale)
+                    .to_integral_value())
+        data = jnp.full((cap,), v, dtype=dt.np_dtype)
+        return DeviceColumn(dt, data, jnp.ones((cap,), bool))
+
+    def key(self):
+        return ("lit", repr(self.value), repr(self._dtype))
+
+    def __repr__(self):
+        return f"lit({self.value!r})"
+
+
+def _infer_literal_type(v: Any) -> DataType:
+    from spark_rapids_tpu.sqltypes.datatypes import (
+        boolean, double, integer, long, string,
+    )
+
+    if v is None:
+        return LongType()
+    if isinstance(v, bool):
+        return boolean
+    if isinstance(v, int):
+        return integer if -(2**31) <= v < 2**31 else long
+    if isinstance(v, float):
+        return double
+    if isinstance(v, str):
+        return string
+    import decimal
+
+    if isinstance(v, decimal.Decimal):
+        sign, digits, exp = v.as_tuple()
+        scale = max(0, -exp)
+        return DecimalType(max(len(digits), scale), scale)
+    raise TypeError(f"cannot infer literal type for {v!r}")
+
+
+class Alias(Expression):
+    """Named wrapper — transparent at eval time."""
+
+    def __init__(self, child: Expression, name: str):
+        super().__init__([child])
+        self.name = name
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    @property
+    def nullable(self):
+        return self.children[0].nullable
+
+    def eval(self, ctx):
+        return self.children[0].eval(ctx)
+
+    def key(self):
+        return ("alias", self.children[0].key())
+
+    def __repr__(self):
+        return f"{self.children[0]!r} AS {self.name}"
+
+
+def binary_validity(left: DeviceColumn, right: DeviceColumn) -> jnp.ndarray:
+    return left.validity & right.validity

@@ -1,0 +1,118 @@
+"""Date/time expressions (UTC session timezone, like the reference's
+default device path; non-UTC zones there require GpuTimeZoneDB, here a
+planned extension via a device transition table).
+
+Date math uses Howard Hinnant's civil-from-days algorithm — pure integer
+ops, fully vectorized on the VPU.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import DeviceColumn
+from spark_rapids_tpu.expr.core import Expression
+from spark_rapids_tpu.sqltypes import DateType, TimestampType
+from spark_rapids_tpu.sqltypes.datatypes import integer
+
+_US_PER_DAY = 86_400_000_000
+
+
+def civil_from_days(z: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                             jnp.ndarray]:
+    """days-since-epoch -> (year, month, day), proleptic Gregorian."""
+    z = z.astype(jnp.int64) + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)
+
+
+def days_from_civil(y, m, d):
+    y = y - (m <= 2)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return (era * 146097 + doe - 719468).astype(jnp.int32)
+
+
+def _days_of(col: DeviceColumn) -> jnp.ndarray:
+    if isinstance(col.dtype, TimestampType):
+        return jnp.floor_divide(col.data, _US_PER_DAY)
+    return col.data.astype(jnp.int64)
+
+
+class _DatePart(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return integer
+
+    def _part(self, y, m, d):
+        raise NotImplementedError
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        y, m, d = civil_from_days(_days_of(c))
+        return DeviceColumn(integer, self._part(y, m, d), c.validity)
+
+
+class Year(_DatePart):
+    def _part(self, y, m, d):
+        return y
+
+
+class Month(_DatePart):
+    def _part(self, y, m, d):
+        return m
+
+
+class DayOfMonth(_DatePart):
+    def _part(self, y, m, d):
+        return d
+
+
+class _TimePart(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return integer
+
+    divisor = 1
+    modulus = 1
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        us_in_day = c.data - jnp.floor_divide(c.data, _US_PER_DAY) * \
+            _US_PER_DAY
+        val = (us_in_day // self.divisor) % self.modulus
+        return DeviceColumn(integer, val.astype(jnp.int32), c.validity)
+
+
+class Hour(_TimePart):
+    divisor = 3_600_000_000
+    modulus = 24
+
+
+class Minute(_TimePart):
+    divisor = 60_000_000
+    modulus = 60
+
+
+class Second(_TimePart):
+    divisor = 1_000_000
+    modulus = 60

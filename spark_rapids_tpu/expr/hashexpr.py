@@ -1,0 +1,36 @@
+"""Murmur3Hash expression (Spark `hash(...)`), bit-exact with CPU Spark.
+
+Backed by the vectorized kernels in ops/hashing.py (the JNI `Hash`
+replacement).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import DeviceColumn
+from spark_rapids_tpu.expr.core import Expression
+from spark_rapids_tpu.ops.hashing import DEFAULT_SEED, murmur3_columns
+from spark_rapids_tpu.sqltypes.datatypes import integer
+
+
+class Murmur3Hash(Expression):
+    def __init__(self, *exprs, seed: int = DEFAULT_SEED):
+        super().__init__(list(exprs))
+        self.seed = seed
+
+    @property
+    def dtype(self):
+        return integer
+
+    @property
+    def nullable(self):
+        return False
+
+    def key(self):
+        return ("murmur3", self.seed, tuple(c.key() for c in self.children))
+
+    def eval(self, ctx):
+        cols = [c.eval(ctx) for c in self.children]
+        h = murmur3_columns(cols, self.seed)
+        return DeviceColumn(integer, h, jnp.ones(h.shape, bool))

@@ -1,0 +1,286 @@
+"""Comparison and boolean predicates with Spark null semantics.
+
+Coverage model: the reference's predicate rules in `GpuOverrides.scala`
+(EqualTo/LessThan/.../And/Or/Not/IsNull/IsNotNull/IsNaN/InSet, from
+:920). And/Or are Kleene three-valued; comparisons propagate null;
+EqualNullSafe (`<=>`) never returns null. String comparison is
+lexicographic over UTF-8 bytes — identical to Spark's UTF8String binary
+ordering — via the packed orderable keys.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import DeviceColumn
+from spark_rapids_tpu.expr.core import EvalContext, Expression, binary_validity
+from spark_rapids_tpu.ops.common import _float_orderable, _string_orderable
+from spark_rapids_tpu.sqltypes import (
+    BooleanType,
+    DoubleType,
+    FloatType,
+    StringType,
+)
+from spark_rapids_tpu.sqltypes.datatypes import boolean
+
+
+def _comparable(col: DeviceColumn) -> List[jnp.ndarray]:
+    """Arrays whose tuple-wise lexicographic order == SQL comparison
+    order (Spark float comparisons use Java total order for </> with
+    NaN greatest)."""
+    if isinstance(col.dtype, StringType):
+        return _string_orderable(col)
+    if isinstance(col.dtype, (FloatType, DoubleType)):
+        return [_float_orderable(col.data)]
+    return [col.data.astype(jnp.int64)]
+
+
+def _tuple_lt(a: List[jnp.ndarray], b: List[jnp.ndarray]) -> jnp.ndarray:
+    lt = jnp.zeros(a[0].shape, bool)
+    decided = jnp.zeros(a[0].shape, bool)
+    for x, y in zip(a, b):
+        lt = jnp.where(~decided & (x < y), True, lt)
+        decided = decided | (x != y)
+    return lt
+
+
+def _tuple_eq(a: List[jnp.ndarray], b: List[jnp.ndarray]) -> jnp.ndarray:
+    eq = jnp.ones(a[0].shape, bool)
+    for x, y in zip(a, b):
+        eq = eq & (x == y)
+    return eq
+
+
+class BinaryComparison(Expression):
+    def __init__(self, left: Expression, right: Expression):
+        super().__init__([left, right])
+
+    @property
+    def dtype(self):
+        return boolean
+
+    def _operands(self, ctx: EvalContext):
+        lc = self.children[0].eval(ctx)
+        rc = self.children[1].eval(ctx)
+        # Pad string operands to a common byte width before keying.
+        if isinstance(lc.dtype, StringType) and lc.max_bytes != rc.max_bytes:
+            mb = max(lc.max_bytes, rc.max_bytes)
+            lc = _pad_string(lc, mb)
+            rc = _pad_string(rc, mb)
+        return lc, rc
+
+
+def _pad_string(col: DeviceColumn, mb: int) -> DeviceColumn:
+    if col.max_bytes == mb:
+        return col
+    return DeviceColumn(
+        col.dtype, jnp.pad(col.data, ((0, 0), (0, mb - col.max_bytes))),
+        col.validity, col.lengths)
+
+
+class EqualTo(BinaryComparison):
+    def eval(self, ctx):
+        lc, rc = self._operands(ctx)
+        # Spark EqualTo on floats: NaN == NaN is TRUE (total order), and
+        # -0.0 == 0.0 is TRUE (IEEE ==). Use IEEE eq for numerics, key eq
+        # with NaN canonicalization handled separately.
+        if isinstance(lc.dtype, (FloatType, DoubleType)):
+            both_nan = jnp.isnan(lc.data) & jnp.isnan(rc.data)
+            eq = (lc.data == rc.data) | both_nan
+        else:
+            eq = _tuple_eq(_comparable(lc), _comparable(rc))
+        return DeviceColumn(boolean, eq, binary_validity(lc, rc))
+
+
+class EqualNullSafe(BinaryComparison):
+    """<=>: null <=> null is true; never null."""
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx):
+        lc, rc = self._operands(ctx)
+        if isinstance(lc.dtype, (FloatType, DoubleType)):
+            both_nan = jnp.isnan(lc.data) & jnp.isnan(rc.data)
+            veq = (lc.data == rc.data) | both_nan
+        else:
+            veq = _tuple_eq(_comparable(lc), _comparable(rc))
+        both_null = ~lc.validity & ~rc.validity
+        both_valid = lc.validity & rc.validity
+        res = both_null | (both_valid & veq)
+        return DeviceColumn(boolean, res, jnp.ones(res.shape, bool))
+
+
+class LessThan(BinaryComparison):
+    def eval(self, ctx):
+        lc, rc = self._operands(ctx)
+        if isinstance(lc.dtype, (FloatType, DoubleType)):
+            r = lc.data < rc.data
+            # Spark: NaN is greater than everything incl. itself for <.
+            r = jnp.where(jnp.isnan(lc.data), False, r)
+            r = jnp.where(jnp.isnan(rc.data) & ~jnp.isnan(lc.data), True, r)
+        else:
+            r = _tuple_lt(_comparable(lc), _comparable(rc))
+        return DeviceColumn(boolean, r, binary_validity(lc, rc))
+
+
+class GreaterThan(BinaryComparison):
+    def eval(self, ctx):
+        return LessThan(self.children[1], self.children[0]).eval(ctx)
+
+
+class LessThanOrEqual(BinaryComparison):
+    def eval(self, ctx):
+        gt = LessThan(self.children[1], self.children[0]).eval(ctx)
+        return DeviceColumn(boolean, ~gt.data, gt.validity)
+
+
+class GreaterThanOrEqual(BinaryComparison):
+    def eval(self, ctx):
+        lt = LessThan(self.children[0], self.children[1]).eval(ctx)
+        return DeviceColumn(boolean, ~lt.data, lt.validity)
+
+
+class And(Expression):
+    """Kleene: false & null = false."""
+
+    def __init__(self, left, right):
+        super().__init__([left, right])
+
+    @property
+    def dtype(self):
+        return boolean
+
+    def eval(self, ctx):
+        lc = self.children[0].eval(ctx)
+        rc = self.children[1].eval(ctx)
+        lv = lc.validity
+        rv = rc.validity
+        false_l = lv & ~lc.data
+        false_r = rv & ~rc.data
+        res = lc.data & rc.data
+        valid = (lv & rv) | false_l | false_r
+        res = jnp.where(false_l | false_r, False, res)
+        return DeviceColumn(boolean, res, valid)
+
+
+class Or(Expression):
+    """Kleene: true | null = true."""
+
+    def __init__(self, left, right):
+        super().__init__([left, right])
+
+    @property
+    def dtype(self):
+        return boolean
+
+    def eval(self, ctx):
+        lc = self.children[0].eval(ctx)
+        rc = self.children[1].eval(ctx)
+        lv = lc.validity
+        rv = rc.validity
+        true_l = lv & lc.data
+        true_r = rv & rc.data
+        res = true_l | true_r
+        valid = (lv & rv) | true_l | true_r
+        return DeviceColumn(boolean, res, valid)
+
+
+class Not(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return boolean
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        return DeviceColumn(boolean, ~c.data, c.validity)
+
+
+class IsNull(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return boolean
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        return DeviceColumn(boolean, ~c.validity,
+                            jnp.ones(c.validity.shape, bool))
+
+
+class IsNotNull(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return boolean
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        return DeviceColumn(boolean, c.validity,
+                            jnp.ones(c.validity.shape, bool))
+
+
+class IsNaN(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return boolean
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        return DeviceColumn(boolean, jnp.isnan(c.data) & c.validity,
+                            jnp.ones(c.validity.shape, bool))
+
+
+class In(Expression):
+    """IN over a literal list (GpuInSet analog)."""
+
+    def __init__(self, child: Expression, values):
+        super().__init__([child])
+        self.values = list(values)
+
+    @property
+    def dtype(self):
+        return boolean
+
+    def key(self):
+        return ("in", self.children[0].key(), tuple(map(repr, self.values)))
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.expr.core import Literal
+
+        c = self.children[0].eval(ctx)
+        hit = jnp.zeros(c.data.shape[0], bool)
+        any_null = False
+        for v in self.values:
+            if v is None:
+                any_null = True
+                continue
+            eq = EqualTo(self.children[0], Literal(v, c.dtype)).eval(ctx)
+            hit = hit | (eq.data & eq.validity)
+        valid = c.validity & (hit | (not any_null))
+        return DeviceColumn(boolean, hit, valid)

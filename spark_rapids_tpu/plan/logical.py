@@ -1,0 +1,236 @@
+"""Logical plan nodes (the Catalyst-logical-plan role).
+
+The reference plugs into Spark's Catalyst and only sees physical plans;
+as a standalone engine we own the full stack, so this module provides the
+minimal logical algebra the DataFrame API builds: relation sources,
+project/filter/aggregate/join/sort/limit/union/range. Column resolution
+happens eagerly at construction (names -> BoundReference ordinals), so
+physical planning never deals with unresolved attributes.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+
+from spark_rapids_tpu.expr import Alias, BoundReference, Expression
+from spark_rapids_tpu.expr.aggregates import AggregateFunction
+from spark_rapids_tpu.sqltypes import StructField, StructType
+from spark_rapids_tpu.sqltypes.datatypes import long
+
+
+class LogicalPlan:
+    def __init__(self, children: Sequence["LogicalPlan"] = ()):
+        self.children = list(children)
+
+    @property
+    def schema(self) -> StructType:
+        raise NotImplementedError
+
+    def pretty(self, indent: int = 0) -> str:
+        s = "  " * indent + self._node_string()
+        for c in self.children:
+            s += "\n" + c.pretty(indent + 1)
+        return s
+
+    def _node_string(self) -> str:
+        return type(self).__name__
+
+
+class LocalRelation(LogicalPlan):
+    """In-memory arrow table source (createDataFrame)."""
+
+    def __init__(self, table: pa.Table):
+        super().__init__()
+        self.table = table
+        from spark_rapids_tpu.columnar.arrow_bridge import schema_from_arrow
+
+        self._schema = schema_from_arrow(table.schema)
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def _node_string(self):
+        return f"LocalRelation{self._schema.names}"
+
+
+class Range(LogicalPlan):
+    def __init__(self, start: int, end: int, step: int = 1,
+                 num_partitions: int = 1):
+        super().__init__()
+        self.start, self.end, self.step = start, end, step
+        self.num_partitions = num_partitions
+
+    @property
+    def schema(self):
+        return StructType([StructField("id", long, False)])
+
+    def _node_string(self):
+        return f"Range({self.start}, {self.end}, {self.step})"
+
+
+class FileScan(LogicalPlan):
+    def __init__(self, fmt: str, paths: List[str], schema: StructType,
+                 options: Optional[dict] = None):
+        super().__init__()
+        self.fmt = fmt
+        self.paths = paths
+        self._schema = schema
+        self.options = options or {}
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def _node_string(self):
+        return f"FileScan {self.fmt} ({len(self.paths)} files)"
+
+
+class Project(LogicalPlan):
+    def __init__(self, exprs: List[Alias], child: LogicalPlan):
+        super().__init__([child])
+        self.exprs = exprs
+
+    @property
+    def schema(self):
+        return StructType([
+            StructField(e.name, e.dtype, e.nullable) for e in self.exprs])
+
+    def _node_string(self):
+        return "Project [" + ", ".join(e.name for e in self.exprs) + "]"
+
+
+class Filter(LogicalPlan):
+    def __init__(self, condition: Expression, child: LogicalPlan):
+        super().__init__([child])
+        self.condition = condition
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def _node_string(self):
+        return f"Filter {self.condition!r}"
+
+
+class Aggregate(LogicalPlan):
+    """groupBy(grouping).agg(aggregates); grouping exprs are
+    BoundReferences in v1 (Spark-general grouping expressions become a
+    Project underneath)."""
+
+    def __init__(self, grouping: List[Alias], aggregates: List[Alias],
+                 child: LogicalPlan):
+        super().__init__([child])
+        self.grouping = grouping
+        self.aggregates = aggregates  # Alias-wrapped AggregateFunction
+        for a in aggregates:
+            assert isinstance(a.children[0], AggregateFunction), a
+
+    @property
+    def schema(self):
+        fields = [StructField(g.name, g.dtype, g.nullable)
+                  for g in self.grouping]
+        fields += [StructField(a.name, a.dtype, a.children[0].nullable)
+                   for a in self.aggregates]
+        return StructType(fields)
+
+    def _node_string(self):
+        return ("Aggregate [" + ", ".join(g.name for g in self.grouping) +
+                "] [" + ", ".join(a.name for a in self.aggregates) + "]")
+
+
+class Join(LogicalPlan):
+    SUPPORTED = ("inner", "left", "right", "left_semi", "left_anti", "full")
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 join_type: str, left_keys: List[Expression],
+                 right_keys: List[Expression]):
+        super().__init__([left, right])
+        assert join_type in self.SUPPORTED, join_type
+        self.join_type = join_type
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+
+    @property
+    def schema(self):
+        lt, rt = self.children[0].schema, self.children[1].schema
+        if self.join_type in ("left_semi", "left_anti"):
+            return lt
+        fields = list(lt.fields)
+        rn = [StructField(f.name, f.dataType,
+                          True if self.join_type in ("left", "full")
+                          else f.nullable)
+              for f in rt.fields]
+        if self.join_type in ("right", "full"):
+            fields = [StructField(f.name, f.dataType, True) for f in
+                      lt.fields]
+            rn = [StructField(f.name, f.dataType,
+                              f.nullable or self.join_type == "full")
+                  for f in rt.fields]
+        return StructType(fields + rn)
+
+    def _node_string(self):
+        return f"Join {self.join_type}"
+
+
+class SortOrder:
+    def __init__(self, expr: Expression, ascending: bool = True,
+                 nulls_first: Optional[bool] = None):
+        self.expr = expr
+        self.ascending = ascending
+        # Spark default: asc -> nulls first, desc -> nulls last
+        self.nulls_first = (ascending if nulls_first is None
+                            else nulls_first)
+
+
+class Sort(LogicalPlan):
+    def __init__(self, orders: List[SortOrder], child: LogicalPlan,
+                 global_sort: bool = True):
+        super().__init__([child])
+        self.orders = orders
+        self.global_sort = global_sort
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def _node_string(self):
+        return f"Sort global={self.global_sort}"
+
+
+class Limit(LogicalPlan):
+    def __init__(self, n: int, child: LogicalPlan):
+        super().__init__([child])
+        self.n = n
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def _node_string(self):
+        return f"Limit {self.n}"
+
+
+class Union(LogicalPlan):
+    def __init__(self, children: List[LogicalPlan]):
+        super().__init__(children)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+
+class Repartition(LogicalPlan):
+    """repartition(n) / repartition(n, cols) — explicit exchange."""
+
+    def __init__(self, child: LogicalPlan, num_partitions: int,
+                 keys: Optional[List[Expression]] = None):
+        super().__init__([child])
+        self.num_partitions = num_partitions
+        self.keys = keys
+
+    @property
+    def schema(self):
+        return self.children[0].schema

@@ -1,0 +1,302 @@
+"""TpuOverrides — the planner/override engine (GpuOverrides analog).
+
+Reference behavior being reproduced (`GpuOverrides.scala:4619-4775`,
+`RapidsMeta.scala`, `GpuTransitionOverrides.scala`):
+- wrap every logical node in a meta, tag device support with reasons
+  (per-operator granularity; one unsupported expression sends just that
+  operator to CPU),
+- convert the plan to physical operators (Tpu* or Cpu* fallback),
+- insert the physical necessities: partial/final aggregation around
+  exchanges, co-partitioning exchanges for joins, single-partition
+  exchange for global sort/limit, and host<->device transitions at every
+  backend boundary (GpuRowToColumnarExec/GpuColumnarToRowExec roles),
+- explain-only mode: report the would-be placement without executing
+  (`spark.rapids.sql.mode=explainOnly`, `explainPotentialGpuPlan`
+  GpuOverrides.scala:4500).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from spark_rapids_tpu.config import rapids_conf as rc
+from spark_rapids_tpu.exec import operators as ops
+from spark_rapids_tpu.exec.base import PhysicalPlan
+from spark_rapids_tpu.expr import Alias, BoundReference
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.typesig import expr_unsupported_reasons
+
+
+class PlanMeta:
+    """Tagging record for one logical node (RapidsMeta analog)."""
+
+    def __init__(self, node: L.LogicalPlan):
+        self.node = node
+        self.reasons: List[str] = []
+        self.children: List[PlanMeta] = []
+
+    @property
+    def can_run_on_device(self) -> bool:
+        return not self.reasons
+
+    def cannot_run(self, reason: str):
+        self.reasons.append(reason)
+
+    def explain(self, indent: int = 0, only_not_on_device=True) -> str:
+        tag = ("*" if self.can_run_on_device else
+               "!NOT_ON_TPU " + "; ".join(self.reasons))
+        lines = []
+        if not only_not_on_device or not self.can_run_on_device:
+            lines.append("  " * indent +
+                         f"{type(self.node).__name__} {tag}")
+        for c in self.children:
+            sub = c.explain(indent + 1, only_not_on_device)
+            if sub:
+                lines.append(sub)
+        return "\n".join([ln for ln in lines if ln])
+
+
+class TpuOverrides:
+    def __init__(self, conf: rc.RapidsConf):
+        self.conf = conf
+        self.metas: List[PlanMeta] = []
+
+    # ----- tagging -----
+
+    def tag(self, node: L.LogicalPlan) -> PlanMeta:
+        meta = PlanMeta(node)
+        if not self.conf.get(rc.SQL_ENABLED):
+            meta.cannot_run("spark.rapids.sql.enabled is false")
+        if self.conf.get(rc.CPU_ORACLE_ENABLED):
+            meta.cannot_run("cpu-oracle session")
+        elif isinstance(node, L.Project):
+            for e in node.exprs:
+                for r in expr_unsupported_reasons(e):
+                    meta.cannot_run(r)
+        elif isinstance(node, L.Filter):
+            for r in expr_unsupported_reasons(node.condition):
+                meta.cannot_run(r)
+        elif isinstance(node, L.Aggregate):
+            for e in node.grouping + node.aggregates:
+                for r in expr_unsupported_reasons(e):
+                    meta.cannot_run(r)
+        elif isinstance(node, L.Join):
+            for e in node.left_keys + node.right_keys:
+                for r in expr_unsupported_reasons(e):
+                    meta.cannot_run(r)
+        elif isinstance(node, L.Sort):
+            for o in node.orders:
+                for r in expr_unsupported_reasons(o.expr):
+                    meta.cannot_run(r)
+        elif isinstance(node, L.LocalRelation):
+            meta.cannot_run("in-memory relation stays host-side until "
+                            "first device operator")
+        meta.children = [self.tag(c) for c in node.children]
+        self.metas.append(meta)
+        return meta
+
+    # ----- conversion -----
+
+    def apply(self, plan: L.LogicalPlan) -> Tuple[PhysicalPlan, PlanMeta]:
+        meta = self.tag(plan)
+        phys = self._convert(meta)
+        explain_mode = self.conf.get(rc.EXPLAIN)
+        if explain_mode != "NONE":
+            txt = meta.explain(only_not_on_device=explain_mode ==
+                               "NOT_ON_GPU")
+            if txt:
+                print(txt)
+        return phys, meta
+
+    def _to_device(self, child: PhysicalPlan) -> PhysicalPlan:
+        if child.is_tpu:
+            return child
+        return ops.ArrowToDeviceExec(child, self.conf)
+
+    def _to_host(self, child: PhysicalPlan) -> PhysicalPlan:
+        if not child.is_tpu:
+            return child
+        return ops.DeviceToArrowExec(child, self.conf)
+
+    def _convert(self, meta: PlanMeta) -> PhysicalPlan:
+        node = meta.node
+        conf = self.conf
+        on_device = meta.can_run_on_device
+
+        if isinstance(node, L.LocalRelation):
+            return ops.LocalRelationExec(node.table, node.schema, conf)
+        if isinstance(node, L.Range):
+            return ops.RangeExec(node.start, node.end, node.step,
+                                 node.num_partitions, node.schema, conf)
+        if isinstance(node, L.FileScan):
+            cols = node.schema.names
+            if on_device:
+                return ops.TpuFileScanExec(node.fmt, node.paths, node.schema,
+                                           conf, pushed_columns=cols)
+            return ops.CpuFileScanExec(node.fmt, node.paths, node.schema,
+                                       conf, pushed_columns=cols)
+
+        children = [self._convert(c) for c in meta.children]
+
+        if isinstance(node, L.Project):
+            if on_device:
+                return ops.TpuProjectExec(node.exprs,
+                                          self._to_device(children[0]),
+                                          node.schema, conf)
+            return ops.CpuProjectExec(node.exprs, self._to_host(children[0]),
+                                      node.schema, conf)
+        if isinstance(node, L.Filter):
+            if on_device:
+                return ops.TpuFilterExec(node.condition,
+                                         self._to_device(children[0]), conf)
+            return ops.CpuFilterExec(node.condition,
+                                     self._to_host(children[0]), conf)
+        if isinstance(node, L.Aggregate):
+            return self._convert_aggregate(node, children[0], on_device)
+        if isinstance(node, L.Join):
+            return self._convert_join(node, children, on_device)
+        if isinstance(node, L.Sort):
+            return self._convert_sort(node, children[0], on_device)
+        if isinstance(node, L.Limit):
+            return self._convert_limit(node, children[0], on_device)
+        if isinstance(node, L.Union):
+            tpu = all(c.is_tpu for c in children)
+            kids = ([self._to_device(c) for c in children] if tpu
+                    else [self._to_host(c) for c in children])
+            return ops.UnionExec(kids, node.schema, conf, tpu)
+        if isinstance(node, L.Repartition):
+            child = children[0]
+            keys = node.keys
+            if child.is_tpu or keys is not None:
+                return ops.TpuShuffleExchangeExec(
+                    self._to_device(child), keys, node.num_partitions, conf)
+            return ops.CpuShuffleExchangeExec(child, keys,
+                                              node.num_partitions, conf)
+        raise NotImplementedError(f"logical node {type(node).__name__}")
+
+    def _convert_aggregate(self, node: L.Aggregate, child: PhysicalPlan,
+                           on_device: bool) -> PhysicalPlan:
+        conf = self.conf
+        shuffle_parts = conf.get(rc.SHUFFLE_PARTITIONS)
+        if not on_device:
+            return ops.CpuHashAggregateExec(
+                node.grouping, node.aggregates,
+                ops.CpuShuffleExchangeExec(
+                    self._to_host(child), None, 1, conf)
+                if child.num_partitions > 1 else self._to_host(child),
+                node.schema, conf)
+        child = self._to_device(child)
+        if child.num_partitions == 1:
+            return ops.TpuHashAggregateExec(
+                "complete", node.grouping, node.aggregates, child, conf)
+        partial = ops.TpuHashAggregateExec(
+            "partial", node.grouping, node.aggregates, child, conf)
+        if node.grouping:
+            key_refs = [BoundReference(i, g.dtype)
+                        for i, g in enumerate(node.grouping)]
+            exchange = ops.TpuShuffleExchangeExec(
+                partial, key_refs, shuffle_parts, conf)
+        else:
+            exchange = ops.TpuShuffleExchangeExec(partial, None, 1, conf)
+        return ops.TpuHashAggregateExec(
+            "final", node.grouping, node.aggregates, exchange, conf)
+
+    def _convert_join(self, node: L.Join, children: List[PhysicalPlan],
+                      on_device: bool) -> PhysicalPlan:
+        conf = self.conf
+        left, right = children
+        if not on_device:
+            return ops.CpuJoinExec(
+                self._single(self._to_host(left)),
+                self._single(self._to_host(right)),
+                node.join_type, node.left_keys, node.right_keys,
+                node.schema, conf)
+        shuffle_parts = conf.get(rc.SHUFFLE_PARTITIONS)
+        left = self._to_device(left)
+        right = self._to_device(right)
+        join_type = node.join_type
+        left_keys, right_keys = node.left_keys, node.right_keys
+        swapped = join_type == "right"
+        if swapped:
+            # right outer = swapped left outer + column reorder
+            left, right = right, left
+            left_keys, right_keys = right_keys, left_keys
+            join_type = "left"
+        both_single = (left.num_partitions == 1 and
+                       right.num_partitions == 1)
+        if not both_single:
+            left = ops.TpuShuffleExchangeExec(
+                left, left_keys, shuffle_parts, conf)
+            right = ops.TpuShuffleExchangeExec(
+                right, right_keys, shuffle_parts, conf)
+        if not swapped:
+            return ops.TpuShuffledHashJoinExec(
+                left, right, join_type, left_keys, right_keys,
+                node.schema, conf)
+        from spark_rapids_tpu.sqltypes import StructField, StructType
+
+        lsch = left.schema    # original right side
+        rsch = right.schema   # original left side
+        swapped_schema = StructType(
+            [StructField(f.name, f.dataType, True) for f in lsch.fields] +
+            [StructField(f.name, f.dataType, f.nullable)
+             for f in rsch.fields])
+        joined = ops.TpuShuffledHashJoinExec(
+            left, right, join_type, left_keys, right_keys,
+            swapped_schema, conf)
+        n_r = len(lsch.fields)
+        n_l = len(rsch.fields)
+        reorder = [Alias(BoundReference(n_r + i,
+                                        swapped_schema.fields[n_r + i]
+                                        .dataType, True),
+                         swapped_schema.fields[n_r + i].name)
+                   for i in range(n_l)]
+        reorder += [Alias(BoundReference(i,
+                                         swapped_schema.fields[i].dataType,
+                                         True),
+                          swapped_schema.fields[i].name)
+                    for i in range(n_r)]
+        return ops.TpuProjectExec(reorder, joined, node.schema, conf)
+
+    def _single(self, plan: PhysicalPlan) -> PhysicalPlan:
+        if plan.num_partitions == 1:
+            return plan
+        if plan.is_tpu:
+            return ops.TpuShuffleExchangeExec(plan, None, 1, self.conf)
+        return ops.CpuShuffleExchangeExec(plan, None, 1, self.conf)
+
+    def _convert_sort(self, node: L.Sort, child: PhysicalPlan,
+                      on_device: bool) -> PhysicalPlan:
+        conf = self.conf
+        if not on_device:
+            return ops.CpuSortExec(node.orders,
+                                   self._single(self._to_host(child)), conf)
+        child = self._to_device(child)
+        if node.global_sort and child.num_partitions > 1:
+            # v1 global sort: gather to one partition then sort; range
+            # partitioning + out-of-core merge is the planned upgrade.
+            child = ops.TpuShuffleExchangeExec(child, None, 1, conf)
+        return ops.TpuSortExec(node.orders, child, conf)
+
+    def _convert_limit(self, node: L.Limit, child: PhysicalPlan,
+                       on_device: bool) -> PhysicalPlan:
+        conf = self.conf
+        if not on_device:
+            local = ops.CpuLocalLimitExec(node.n, self._to_host(child), conf)
+            if local.num_partitions > 1:
+                local = ops.CpuLocalLimitExec(
+                    node.n, ops.CpuShuffleExchangeExec(local, None, 1, conf),
+                    conf)
+            return local
+        child = self._to_device(child)
+        local = ops.TpuLocalLimitExec(node.n, child, conf)
+        if local.num_partitions > 1:
+            local = ops.TpuLocalLimitExec(
+                node.n, ops.TpuShuffleExchangeExec(local, None, 1, conf),
+                conf)
+        return local
+
+
+def plan_query(logical: L.LogicalPlan, conf: rc.RapidsConf
+               ) -> Tuple[PhysicalPlan, PlanMeta]:
+    return TpuOverrides(conf).apply(logical)

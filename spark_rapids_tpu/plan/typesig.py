@@ -1,0 +1,85 @@
+"""Device-support checks — the TypeSig/TypeChecks analog.
+
+The reference's `TypeSig` algebra (`TypeChecks.scala:168,543`) declares,
+per operator and per parameter, which Spark types run on device, and
+produces tagging reasons + docs/supported_ops.md. This is the same idea
+sized for the v1 surface: a per-expression-class registry of checks that
+return a reason string when something must fall back to CPU.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Type
+
+from spark_rapids_tpu.expr import Cast
+from spark_rapids_tpu.expr.core import Expression, Literal
+from spark_rapids_tpu.sqltypes import (
+    BooleanType,
+    DataType,
+    DateType,
+    DecimalType,
+    DoubleType,
+    FloatType,
+    IntegralType,
+    NullType,
+    StringType,
+    TimestampType,
+)
+
+DEVICE_TYPES = (BooleanType, IntegralType, FloatType, DoubleType,
+                StringType, DateType, TimestampType, DecimalType)
+
+
+def type_supported(dt: DataType) -> Optional[str]:
+    if isinstance(dt, DecimalType) and dt.precision > 18:
+        return f"decimal precision {dt.precision} > 18 (DECIMAL64 only)"
+    if isinstance(dt, NullType):
+        return None
+    if not isinstance(dt, DEVICE_TYPES):
+        return f"type {dt} not supported on device"
+    return None
+
+
+_checks: Dict[Type[Expression], Callable[[Expression], Optional[str]]] = {}
+
+
+def register_check(cls):
+    def deco(fn):
+        _checks[cls] = fn
+        return fn
+    return deco
+
+
+@register_check(Cast)
+def _cast_check(e: Cast) -> Optional[str]:
+    if not e.device_supported():
+        return (f"cast {e.children[0].dtype.simpleString} -> "
+                f"{e.to.simpleString} runs on CPU in v1")
+    return None
+
+
+def expr_unsupported_reasons(expr: Expression) -> List[str]:
+    """Walk an expression tree; collect every reason it cannot run on
+    device. Empty list == fully supported."""
+    reasons: List[str] = []
+
+    from spark_rapids_tpu.expr.aggregates import AggregateFunction
+
+    def walk(e: Expression):
+        r = type_supported(e.dtype)
+        if r:
+            reasons.append(f"{type(e).__name__}: {r}")
+        chk = _checks.get(type(e))
+        if chk:
+            r = chk(e)
+            if r:
+                reasons.append(r)
+        if (type(e).eval is Expression.eval and not isinstance(e, Literal)
+                and not isinstance(e, AggregateFunction)):
+            reasons.append(
+                f"{type(e).__name__} has no device implementation")
+        for c in e.children:
+            walk(c)
+
+    walk(expr)
+    return reasons

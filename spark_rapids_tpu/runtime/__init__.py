@@ -1,0 +1,21 @@
+from spark_rapids_tpu.runtime.errors import (  # noqa: F401
+    TpuRetryOOM,
+    TpuSplitAndRetryOOM,
+    TpuOOMError,
+)
+from spark_rapids_tpu.runtime.memory import (  # noqa: F401
+    DeviceMemoryPool,
+    SpillCatalog,
+    SpillableBatch,
+    SpillPriority,
+    get_catalog,
+    initialize_memory,
+    shutdown_memory,
+)
+from spark_rapids_tpu.runtime.retry import (  # noqa: F401
+    with_retry,
+    with_retry_no_split,
+    split_spillable_in_half_by_rows,
+)
+from spark_rapids_tpu.runtime.semaphore import TpuSemaphore  # noqa: F401
+from spark_rapids_tpu.runtime.metrics import TpuMetric, MetricsRegistry  # noqa: F401

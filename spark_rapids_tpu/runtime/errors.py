@@ -1,0 +1,23 @@
+"""OOM taxonomy — the GpuRetryOOM / GpuSplitAndRetryOOM analog.
+
+The reference's spark-rapids-jni RmmSpark injects these as thread-
+targeted exceptions when the RMM pool cannot satisfy an allocation
+(`RmmRapidsRetryIterator.scala:194-197`). Here the reservation-based
+DeviceMemoryPool raises them synchronously at reservation points, which
+gives the same control flow without needing allocator callbacks from
+PJRT (SURVEY.md section 7 hard part #3).
+"""
+
+
+class TpuOOMError(MemoryError):
+    """Unrecoverable device OOM (after retry/split budget exhausted)."""
+
+
+class TpuRetryOOM(TpuOOMError):
+    """Transient: spill happened or may happen; roll back to the last
+    checkpoint and re-execute the same work."""
+
+
+class TpuSplitAndRetryOOM(TpuOOMError):
+    """The work unit cannot fit even after spilling: split the input
+    (usually in half by rows) and retry the pieces."""

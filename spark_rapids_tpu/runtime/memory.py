@@ -1,0 +1,342 @@
+"""Device memory pool + tiered spill catalog.
+
+Reference architecture being reproduced (SURVEY.md section 2.3):
+- `RapidsBufferCatalog` (RapidsBufferCatalog.scala:62): catalog of
+  spillable buffers across DEVICE -> HOST -> DISK tiers, synchronous
+  spill on allocation failure (:592).
+- `DeviceMemoryEventHandler`: alloc-failure -> spill-N-bytes callback.
+- `SpillableColumnarBatch`: operator state parked spillable between
+  per-batch steps (SpillableColumnarBatch.scala).
+- `SpillPriorities`: lower value spills first.
+
+TPU redesign: PJRT gives no per-allocation failure callback, so the pool
+is a *reservation ledger* sitting in front of JAX: every operator batch
+is registered with its byte size; `reserve()` checks the ledger against
+the budget, synchronously spilling coldest-first (device_get -> pinned
+numpy -> .npy file) until the reservation fits, then raises TpuRetryOOM /
+TpuSplitAndRetryOOM exactly where RmmSpark would inject them. Tests force
+tiny budgets + injection to exercise every path (the reference's
+*RetrySuite strategy, SURVEY.md section 4 tier 2).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import uuid
+from enum import Enum
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from spark_rapids_tpu.columnar.batch import ColumnBatch
+from spark_rapids_tpu.runtime.errors import TpuRetryOOM, TpuSplitAndRetryOOM
+
+
+class SpillTier(Enum):
+    DEVICE = 0
+    HOST = 1
+    DISK = 2
+
+
+class SpillPriority:
+    """Lower spills first (reference SpillPriorities.scala)."""
+
+    INPUT_FROM_SHUFFLE = -200
+    ACTIVE_BATCHING = -100
+    ACTIVE_ON_DECK = 0
+    HOST_MEMORY = 100
+
+
+class SpillableBatch:
+    """A registered, spillable columnar batch (SpillableColumnarBatch
+    analog). Not thread-safe per instance; the catalog lock serializes
+    tier moves."""
+
+    def __init__(self, catalog: "SpillCatalog", batch: ColumnBatch,
+                 priority: int):
+        self._catalog = catalog
+        self._priority = priority
+        self._tier = SpillTier.DEVICE
+        self._device_batch: Optional[ColumnBatch] = batch
+        self._host_data = None
+        self._disk_path: Optional[str] = None
+        self._treedef = None
+        self.size_bytes = batch.device_size_bytes()
+        self._rows = batch.row_count()
+        self.id = uuid.uuid4().hex[:12]
+        self.closed = False
+
+    @property
+    def tier(self) -> SpillTier:
+        return self._tier
+
+    def row_count(self) -> int:
+        return self._rows
+
+    # --- tier transitions (called under catalog lock) ---
+
+    def _to_host(self):
+        assert self._tier == SpillTier.DEVICE
+        leaves, treedef = jax.tree_util.tree_flatten(self._device_batch)
+        self._host_data = [np.asarray(jax.device_get(x)) for x in leaves]
+        self._treedef = treedef
+        self._device_batch = None
+        self._tier = SpillTier.HOST
+
+    def _to_disk(self):
+        assert self._tier == SpillTier.HOST
+        path = os.path.join(self._catalog.spill_dir, f"spill-{self.id}.npz")
+        np.savez(path, *self._host_data)
+        self._disk_path = path
+        self._host_data = None
+        self._tier = SpillTier.DISK
+
+    def _host_from_disk(self):
+        assert self._tier == SpillTier.DISK
+        with np.load(self._disk_path) as z:
+            self._host_data = [z[k] for k in z.files]
+        os.unlink(self._disk_path)
+        self._disk_path = None
+        self._tier = SpillTier.HOST
+
+    def _to_device(self):
+        if self._tier == SpillTier.DISK:
+            self._host_from_disk()
+        if self._tier == SpillTier.HOST:
+            leaves = [jax.device_put(x) for x in self._host_data]
+            self._device_batch = jax.tree_util.tree_unflatten(
+                self._treedef, leaves)
+            self._host_data = None
+            self._tier = SpillTier.DEVICE
+
+    # --- public API ---
+
+    def get_batch(self) -> ColumnBatch:
+        """Materialize on device (unspilling if needed; reserves budget)."""
+        self._catalog.unspill(self)
+        return self._device_batch
+
+    def close(self):
+        if self.closed:
+            return
+        self.closed = True
+        self._catalog.remove(self)
+        if self._disk_path and os.path.exists(self._disk_path):
+            os.unlink(self._disk_path)
+        self._device_batch = None
+        self._host_data = None
+
+
+class DeviceMemoryPool:
+    """Reservation ledger for device HBM (the Rmm pool analog)."""
+
+    def __init__(self, limit_bytes: int):
+        self.limit = limit_bytes
+        self.reserved = 0
+        self.peak = 0
+        self._lock = threading.RLock()
+
+    def try_reserve(self, nbytes: int) -> bool:
+        with self._lock:
+            if self.reserved + nbytes > self.limit:
+                return False
+            self.reserved += nbytes
+            self.peak = max(self.peak, self.reserved)
+            return True
+
+    def release(self, nbytes: int):
+        with self._lock:
+            self.reserved = max(0, self.reserved - nbytes)
+
+
+class SpillCatalog:
+    """RapidsBufferCatalog analog: tracks spillables, performs synchronous
+    coldest-first spill when device reservations fail."""
+
+    def __init__(self, device_limit: int, host_limit: int,
+                 spill_dir: Optional[str] = None,
+                 oom_injection_mode: str = "none",
+                 oom_injection_filter: str = ""):
+        self.pool = DeviceMemoryPool(device_limit)
+        self.host_limit = host_limit
+        self.host_used = 0
+        self.spill_dir = spill_dir or tempfile.mkdtemp(prefix="srtpu-spill-")
+        self._buffers: Dict[str, SpillableBatch] = {}
+        self._lock = threading.RLock()
+        self._oom_mode = oom_injection_mode
+        self._oom_filter = oom_injection_filter
+        self._oom_armed = oom_injection_mode in ("once", "always")
+        self.metrics = {
+            "spill_to_host": 0, "spill_to_disk": 0, "unspill": 0,
+            "retry_oom_injected": 0,
+        }
+
+    # --- registration ---
+
+    def add_batch(self, batch: ColumnBatch,
+                  priority: int = SpillPriority.ACTIVE_ON_DECK
+                  ) -> SpillableBatch:
+        sb = SpillableBatch(self, batch, priority)
+        self.reserve(sb.size_bytes, tag="add_batch")
+        with self._lock:
+            self._buffers[sb.id] = sb
+        return sb
+
+    def remove(self, sb: SpillableBatch):
+        with self._lock:
+            if self._buffers.pop(sb.id, None) is None:
+                return
+            if sb.tier == SpillTier.DEVICE:
+                self.pool.release(sb.size_bytes)
+            elif sb.tier == SpillTier.HOST:
+                self.host_used -= sb.size_bytes
+
+    # --- reservation with synchronous spill ---
+
+    def _maybe_inject_oom(self, tag: str):
+        if not self._oom_armed:
+            return
+        if self._oom_filter and self._oom_filter not in tag:
+            return
+        if self._oom_mode == "once":
+            self._oom_armed = False
+        self.metrics["retry_oom_injected"] += 1
+        raise TpuRetryOOM(f"injected OOM at {tag}")
+
+    def reserve(self, nbytes: int, tag: str = ""):
+        """Reserve device bytes; spill synchronously if needed; raise
+        TpuRetryOOM when spilling freed something (caller must retry) or
+        TpuSplitAndRetryOOM when nothing can free enough."""
+        self._maybe_inject_oom(tag)
+        if self.pool.try_reserve(nbytes):
+            return
+        shortfall = max(0, nbytes - (self.pool.limit - self.pool.reserved))
+        freed = self.spill_device_bytes(shortfall)
+        if self.pool.try_reserve(nbytes):
+            return
+        if freed > 0:
+            raise TpuRetryOOM(
+                f"device pool exhausted reserving {nbytes} (tag={tag}); "
+                f"spilled {freed} bytes, retry")
+        raise TpuSplitAndRetryOOM(
+            f"device pool cannot fit {nbytes} (tag={tag}, "
+            f"limit={self.pool.limit}, reserved={self.pool.reserved}); "
+            "split the input and retry")
+
+    def release(self, nbytes: int):
+        self.pool.release(nbytes)
+
+    def spill_device_bytes(self, target: int) -> int:
+        """Spill coldest (lowest priority, largest first) device buffers
+        until `target` bytes are freed (RapidsBufferCatalog.synchronousSpill
+        analog)."""
+        freed = 0
+        with self._lock:
+            candidates = sorted(
+                (b for b in self._buffers.values()
+                 if b.tier == SpillTier.DEVICE and not b.closed),
+                key=lambda b: (b._priority, -b.size_bytes))
+            for b in candidates:
+                if freed >= target:
+                    break
+                self._spill_one(b)
+                freed += b.size_bytes
+        return freed
+
+    def _spill_one(self, b: SpillableBatch):
+        b._to_host()
+        self.pool.release(b.size_bytes)
+        self.host_used += b.size_bytes
+        self.metrics["spill_to_host"] += 1
+        if self.host_used > self.host_limit:
+            # overflow host tier to disk, coldest first
+            host_bufs = sorted(
+                (x for x in self._buffers.values()
+                 if x.tier == SpillTier.HOST),
+                key=lambda x: (x._priority, -x.size_bytes))
+            for hb in host_bufs:
+                if self.host_used <= self.host_limit:
+                    break
+                hb._to_disk()
+                self.host_used -= hb.size_bytes
+                self.metrics["spill_to_disk"] += 1
+
+    def unspill(self, sb: SpillableBatch):
+        with self._lock:
+            if sb.tier == SpillTier.DEVICE:
+                return
+            was_host = sb.tier == SpillTier.HOST
+            # reserve device room first (may cascade-spill others)
+            self.reserve(sb.size_bytes, tag="unspill")
+            sb._to_device()
+            if was_host:
+                self.host_used -= sb.size_bytes
+            self.metrics["unspill"] += 1
+
+    # --- stats ---
+
+    def device_reserved(self) -> int:
+        return self.pool.reserved
+
+    def buffer_count(self) -> int:
+        with self._lock:
+            return len(self._buffers)
+
+
+_catalog: Optional[SpillCatalog] = None
+_catalog_lock = threading.Lock()
+
+
+def initialize_memory(conf=None, force: bool = False) -> SpillCatalog:
+    """GpuDeviceManager.initializeMemory analog (reference
+    GpuDeviceManager.scala:275-385): size the pool from conf/HBM and
+    install the global catalog. force=True rebuilds with the new conf
+    (used by session init so startup-only memory confs of a fresh
+    session are honored; live spillables keep referencing their old
+    catalog until closed)."""
+    global _catalog
+    from spark_rapids_tpu.config import rapids_conf as rc
+
+    conf = conf or rc.RapidsConf()
+    with _catalog_lock:
+        if _catalog is not None and not force:
+            return _catalog
+        limit = conf.get(rc.MEMORY_LIMIT_BYTES)
+        if not limit:
+            hbm = _detect_hbm_bytes()
+            limit = int(hbm * conf.get(rc.MEMORY_FRACTION))
+        _catalog = SpillCatalog(
+            device_limit=limit,
+            host_limit=conf.get(rc.HOST_SPILL_STORAGE_SIZE),
+            spill_dir=conf.get(rc.SPILL_DIR) or None,
+            oom_injection_mode=conf.get(rc.OOM_INJECTION_MODE),
+            oom_injection_filter=conf.get(rc.TEST_RETRY_OOM_INJECTION_FILTER),
+        )
+        return _catalog
+
+
+def _detect_hbm_bytes() -> int:
+    try:
+        d = jax.devices()[0]
+        stats = d.memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+    except Exception:
+        pass
+    # CPU backend / unknown: pretend 16 GiB (v5e HBM size)
+    return 16 << 30
+
+
+def get_catalog() -> SpillCatalog:
+    if _catalog is None:
+        return initialize_memory()
+    return _catalog
+
+
+def shutdown_memory():
+    global _catalog
+    with _catalog_lock:
+        _catalog = None

@@ -1,0 +1,74 @@
+"""Operator metrics — the GpuMetric analog (GpuExec.scala:49-330).
+
+Levels ESSENTIAL/MODERATE/DEBUG mirror `RapidsConf.scala:674`; standard
+names match the reference so dashboards translate: numOutputRows,
+numOutputBatches, opTime, semaphoreWaitTime, spillToHostTime, ...
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict
+
+ESSENTIAL = 0
+MODERATE = 1
+DEBUG = 2
+
+NUM_OUTPUT_ROWS = "numOutputRows"
+NUM_OUTPUT_BATCHES = "numOutputBatches"
+NUM_INPUT_ROWS = "numInputRows"
+NUM_INPUT_BATCHES = "numInputBatches"
+OP_TIME = "opTime"
+SEMAPHORE_WAIT_TIME = "semaphoreWaitTime"
+SPILL_TIME = "spillTime"
+BUILD_TIME = "buildTime"
+JOIN_TIME = "joinTime"
+SORT_TIME = "sortTime"
+AGG_TIME = "aggTime"
+FILTER_TIME = "filterTime"
+PARTITION_TIME = "partitionTime"
+
+
+class TpuMetric:
+    __slots__ = ("name", "level", "value", "_lock")
+
+    def __init__(self, name: str, level: int = MODERATE):
+        self.name = name
+        self.level = level
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def add(self, v: int):
+        with self._lock:
+            self.value += int(v)
+
+    @contextmanager
+    def ns(self):
+        """Nanosecond-scoped timing (GpuExec.scala:134 `ns` helper)."""
+        t0 = time.monotonic_ns()
+        try:
+            yield
+        finally:
+            self.add(time.monotonic_ns() - t0)
+
+
+class MetricsRegistry:
+    """Per-operator metric set."""
+
+    def __init__(self, level: int = MODERATE):
+        self.level = level
+        self._metrics: Dict[str, TpuMetric] = {}
+
+    def metric(self, name: str, level: int = MODERATE) -> TpuMetric:
+        if name not in self._metrics:
+            self._metrics[name] = TpuMetric(name, level)
+        return self._metrics[name]
+
+    def __getitem__(self, name: str) -> TpuMetric:
+        return self.metric(name)
+
+    def snapshot(self) -> Dict[str, int]:
+        return {m.name: m.value for m in self._metrics.values()
+                if m.level <= self.level}

@@ -1,0 +1,110 @@
+"""Retry / split-and-retry execution — the RmmRapidsRetryIterator analog.
+
+Reference semantics (`RmmRapidsRetryIterator.scala:62-197`):
+- `withRetry(input, splitPolicy)(fn)`: run fn over a spillable input;
+  on GpuRetryOOM re-run the same attempt (the spill already happened);
+  on GpuSplitAndRetryOOM split the input (usually halving rows) and
+  process the pieces, possibly splitting again, with a bound.
+- `withRetryNoSplit`: same but split is not legal (fn not splittable).
+- Inputs must be spillable so a retry can rematerialize them.
+
+Here fn takes a SpillableBatch and returns a result; results are yielded
+as a generator exactly like the reference's iterator contract.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Iterator, List, Optional, TypeVar
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import ColumnBatch, next_capacity
+from spark_rapids_tpu.runtime.errors import (
+    TpuOOMError,
+    TpuRetryOOM,
+    TpuSplitAndRetryOOM,
+)
+from spark_rapids_tpu.runtime.memory import (
+    SpillableBatch,
+    SpillPriority,
+    get_catalog,
+)
+
+T = TypeVar("T")
+
+
+def split_spillable_in_half_by_rows(sb: SpillableBatch
+                                    ) -> List[SpillableBatch]:
+    """The default split policy (reference splitSpillableInHalfByRows,
+    used e.g. GpuAggregateExec.scala:306)."""
+    catalog = get_catalog()
+    batch = sb.get_batch()
+    n = sb.row_count()
+    if n <= 1:
+        raise TpuOOMError("cannot split a batch of <=1 rows further")
+    half = n // 2
+    first = _slice_rows(batch, 0, half)
+    second = _slice_rows(batch, half, n - half)
+    out = [catalog.add_batch(first, SpillPriority.ACTIVE_ON_DECK),
+           catalog.add_batch(second, SpillPriority.ACTIVE_ON_DECK)]
+    sb.close()
+    return out
+
+
+def _slice_rows(batch: ColumnBatch, start: int, count: int) -> ColumnBatch:
+    cap = next_capacity(count)
+    idx = jnp.arange(cap, dtype=jnp.int32) + start
+    idx = jnp.clip(idx, 0, batch.capacity - 1)
+    return batch.gather(idx, count)
+
+
+def with_retry(
+    inputs,
+    fn: Callable[[SpillableBatch], T],
+    split_policy: Optional[Callable[[SpillableBatch],
+                                    List[SpillableBatch]]] =
+        split_spillable_in_half_by_rows,
+    split_limit: int = 16,
+) -> Iterator[T]:
+    """Run fn over each spillable input with OOM retry/split semantics.
+
+    fn MUST be idempotent w.r.t. its input (it can be called again with
+    the same SpillableBatch after a TpuRetryOOM) and must not close its
+    input — the framework does.
+    """
+    if isinstance(inputs, SpillableBatch):
+        inputs = [inputs]
+    queue = deque(inputs)
+    while queue:
+        sb = queue.popleft()
+        splits = 0
+        while True:
+            try:
+                result = fn(sb)
+                sb.close()
+                yield result
+                break
+            except TpuSplitAndRetryOOM:
+                if split_policy is None:
+                    sb.close()
+                    raise
+                splits += 1
+                if splits > split_limit:
+                    sb.close()
+                    raise TpuOOMError(
+                        f"split limit {split_limit} exceeded")
+                pieces = split_policy(sb)
+                # process first piece now, queue the rest in order
+                sb = pieces[0]
+                for p in reversed(pieces[1:]):
+                    queue.appendleft(p)
+            except TpuRetryOOM:
+                continue  # spill already happened; same attempt again
+
+
+def with_retry_no_split(sb: SpillableBatch, fn: Callable[[SpillableBatch], T]
+                        ) -> T:
+    """withRetryNoSplit: retries on TpuRetryOOM, propagates split OOMs."""
+    out = next(with_retry([sb], fn, split_policy=None))
+    return out

@@ -1,0 +1,65 @@
+"""Task-admission semaphore — the GpuSemaphore analog.
+
+Reference (`GpuSemaphore.scala:100-421`): limits how many tasks hold
+device memory concurrently; permits = 1000 / concurrentGpuTasks; tracks
+wait time for task metrics. Same design: a counted semaphore keyed by
+task id so re-entrant acquires are free, with wait-time accounting.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+MAX_PERMITS = 1000
+
+
+class TpuSemaphore:
+    def __init__(self, concurrent_tasks: int = 2):
+        concurrent_tasks = max(1, concurrent_tasks)
+        self._permits_per_task = max(1, MAX_PERMITS // concurrent_tasks)
+        self._available = MAX_PERMITS
+        self._cv = threading.Condition()
+        self._holders: Dict[int, int] = {}
+        self.total_wait_ns = 0
+
+    def acquire_if_necessary(self, task_id: int):
+        with self._cv:
+            if task_id in self._holders:
+                return
+            start = time.monotonic_ns()
+            while self._available < self._permits_per_task:
+                self._cv.wait()
+            self.total_wait_ns += time.monotonic_ns() - start
+            self._available -= self._permits_per_task
+            self._holders[task_id] = self._permits_per_task
+
+    def release_if_necessary(self, task_id: int):
+        with self._cv:
+            permits = self._holders.pop(task_id, None)
+            if permits:
+                self._available += permits
+                self._cv.notify_all()
+
+    def holders(self) -> int:
+        with self._cv:
+            return len(self._holders)
+
+
+_instance: Optional[TpuSemaphore] = None
+_lock = threading.Lock()
+
+
+def initialize(concurrent_tasks: int):
+    global _instance
+    with _lock:
+        _instance = TpuSemaphore(concurrent_tasks)
+
+
+def get() -> TpuSemaphore:
+    global _instance
+    with _lock:
+        if _instance is None:
+            _instance = TpuSemaphore()
+        return _instance

@@ -1,0 +1,58 @@
+"""Shuffle manager v1 — the MULTITHREADED / CACHE_ONLY transport analog.
+
+Reference (`RapidsShuffleInternalManagerBase.scala:238,569,1183`): the
+MULTITHREADED mode serializes device batches on a writer thread pool into
+host shuffle storage, readers fetch and coalesce back onto the device
+(`GpuShuffleCoalesceExec`). The UCX device-to-device transport is the ICI
+collective path in shuffle/ici.py.
+
+This in-process manager keeps shuffle blocks as host Arrow tables
+registered with the spill catalog's host budget (CACHE_ONLY semantics);
+a multi-host version would write the same blocks through the
+serialization in shuffle/serde.py.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+import pyarrow as pa
+
+
+class ShuffleManager:
+    """Maps (shuffle_id, reduce_pid) -> list of host tables."""
+
+    def __init__(self):
+        self._blocks: Dict[Tuple[int, int], List[pa.Table]] = defaultdict(
+            list)
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self.bytes_written = 0
+
+    def new_shuffle_id(self) -> int:
+        with self._lock:
+            self._next_id += 1
+            return self._next_id
+
+    def put(self, shuffle_id: int, reduce_pid: int, table: pa.Table):
+        with self._lock:
+            self._blocks[(shuffle_id, reduce_pid)].append(table)
+            self.bytes_written += table.nbytes
+
+    def fetch(self, shuffle_id: int, reduce_pid: int) -> List[pa.Table]:
+        with self._lock:
+            return list(self._blocks.get((shuffle_id, reduce_pid), []))
+
+    def remove_shuffle(self, shuffle_id: int):
+        with self._lock:
+            for k in [k for k in self._blocks if k[0] == shuffle_id]:
+                del self._blocks[k]
+
+
+_manager = ShuffleManager()
+
+
+def get_shuffle_manager() -> ShuffleManager:
+    return _manager

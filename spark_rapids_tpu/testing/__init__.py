@@ -1,0 +1,6 @@
+from spark_rapids_tpu.testing.asserts import (  # noqa: F401
+    assert_tpu_and_cpu_are_equal_collect,
+    assert_tpu_fallback_collect,
+    with_cpu_session,
+    with_tpu_session,
+)

@@ -1,0 +1,122 @@
+"""Differential test harness — the integration-test core of the
+reference, re-provided as a library.
+
+Reference pattern (`integration_tests/src/main/python/asserts.py:475-579`):
+run the same dataframe function under a CPU session and a device session
+and diff collected results; `assert_gpu_fallback_collect` additionally
+asserts that a given operator did NOT run on device. Same surface here:
+
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.read.parquet(p).groupBy("a").sum("b"))
+
+The CPU session is this engine with every operator forced to the pyarrow
+backend (spark.rapids.tpu.test.cpuOracle=true), the moral equivalent of
+running vanilla CPU Spark.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Optional
+
+import pyarrow as pa
+
+from spark_rapids_tpu.api.session import TpuSparkSession
+
+
+def with_tpu_session(fn, conf: Optional[Dict] = None):
+    settings = dict(conf or {})
+    spark = TpuSparkSession(settings)
+    try:
+        return fn(spark)
+    finally:
+        spark.stop()
+
+
+def with_cpu_session(fn, conf: Optional[Dict] = None):
+    settings = dict(conf or {})
+    settings["spark.rapids.tpu.test.cpuOracle"] = True
+    spark = TpuSparkSession(settings)
+    try:
+        return fn(spark)
+    finally:
+        spark.stop()
+
+
+def _sort_table(t: pa.Table) -> pa.Table:
+    import pyarrow.compute as pc
+
+    if t.num_rows <= 1 or t.num_columns == 0:
+        return t
+    keys = [(n, "ascending") for n in t.column_names]
+    try:
+        return t.take(pc.sort_indices(t, sort_keys=keys,
+                                      null_placement="at_start"))
+    except pa.ArrowNotImplementedError:
+        return t
+
+
+def _values_equal(a, b, rel_tol: float) -> bool:
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, float) or isinstance(b, float):
+        fa, fb = float(a), float(b)
+        if math.isnan(fa) or math.isnan(fb):
+            return math.isnan(fa) and math.isnan(fb)
+        return math.isclose(fa, fb, rel_tol=rel_tol, abs_tol=1e-11)
+    return a == b
+
+
+def assert_tables_equal(tpu: pa.Table, cpu: pa.Table,
+                        ignore_order: bool = True,
+                        rel_tol: float = 1e-9):
+    assert tpu.column_names == cpu.column_names, \
+        f"column mismatch: {tpu.column_names} vs {cpu.column_names}"
+    assert tpu.num_rows == cpu.num_rows, \
+        f"row count mismatch: tpu={tpu.num_rows} cpu={cpu.num_rows}"
+    if ignore_order:
+        tpu, cpu = _sort_table(tpu), _sort_table(cpu)
+    for name in tpu.column_names:
+        av = tpu.column(name).to_pylist()
+        bv = cpu.column(name).to_pylist()
+        for i, (x, y) in enumerate(zip(av, bv)):
+            assert _values_equal(x, y, rel_tol), (
+                f"column {name!r} row {i}: tpu={x!r} cpu={y!r}")
+
+
+def assert_tpu_and_cpu_are_equal_collect(
+        df_fn: Callable, conf: Optional[Dict] = None,
+        ignore_order: bool = True, rel_tol: float = 1e-9):
+    """Run df_fn under both backends and diff the collected tables."""
+    tpu = with_tpu_session(lambda s: df_fn(s).collect_arrow(), conf)
+    cpu = with_cpu_session(lambda s: df_fn(s).collect_arrow(), conf)
+    assert_tables_equal(tpu, cpu, ignore_order=ignore_order,
+                        rel_tol=rel_tol)
+    return tpu
+
+
+def assert_tpu_fallback_collect(df_fn: Callable, fallback_class: str,
+                                conf: Optional[Dict] = None):
+    """Assert the plan places `fallback_class` on CPU yet results still
+    match (assert_gpu_fallback_collect analog, asserts.py:439)."""
+    captured = {}
+
+    def run(spark):
+        df = df_fn(spark)
+        phys, meta = df._physical()
+        captured["phys"] = phys
+        return phys.collect()
+
+    tpu = with_tpu_session(run, conf)
+
+    def walk(p):
+        yield p
+        for c in p.children:
+            yield from walk(c)
+
+    names = [type(p).__name__ for p in walk(captured["phys"])]
+    assert any(n == fallback_class for n in names), (
+        f"expected {fallback_class} in physical plan, got {names}")
+    cpu = with_cpu_session(lambda s: df_fn(s).collect_arrow(), conf)
+    assert_tables_equal(tpu, cpu)
+    return tpu

@@ -1,0 +1,183 @@
+"""Seeded, composable data generators — the data_gen.py / datagen module
+analog (reference `integration_tests/src/main/python/data_gen.py` and the
+Scala `datagen/` module): deterministic generation with null ratios,
+cardinality control, and special-value injection, producing pyarrow
+tables.
+"""
+
+from __future__ import annotations
+
+import string as _string
+from typing import List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+
+class DataGen:
+    arrow_type: pa.DataType = None
+
+    def __init__(self, nullable: bool = True, null_ratio: float = 0.1):
+        self.nullable = nullable
+        self.null_ratio = null_ratio if nullable else 0.0
+
+    def generate(self, n: int, rng: np.random.Generator) -> pa.Array:
+        vals = self._values(n, rng)
+        if self.null_ratio > 0:
+            mask = rng.random(n) < self.null_ratio
+        else:
+            mask = None
+        return pa.array(vals, type=self.arrow_type, mask=mask)
+
+    def _values(self, n, rng):
+        raise NotImplementedError
+
+
+class IntGen(DataGen):
+    arrow_type = pa.int32()
+
+    def __init__(self, lo=-(2**31), hi=2**31 - 1, **kw):
+        super().__init__(**kw)
+        self.lo, self.hi = lo, hi
+
+    def _values(self, n, rng):
+        base = rng.integers(self.lo, self.hi, size=n, dtype=np.int64,
+                            endpoint=True).astype(np.int32)
+        # inject boundary values like the reference's special cases
+        for i, v in enumerate([0, self.lo, self.hi]):
+            if n > i:
+                base[i] = v
+        return base
+
+
+class LongGen(DataGen):
+    arrow_type = pa.int64()
+
+    def __init__(self, lo=-(2**63), hi=2**63 - 1, **kw):
+        super().__init__(**kw)
+        self.lo, self.hi = lo, hi
+
+    def _values(self, n, rng):
+        base = rng.integers(self.lo // 2, self.hi // 2, size=n,
+                            dtype=np.int64)
+        for i, v in enumerate([0, self.lo, self.hi]):
+            if n > i:
+                base[i] = v
+        return base
+
+
+class DoubleGen(DataGen):
+    arrow_type = pa.float64()
+
+    def __init__(self, include_specials: bool = True, **kw):
+        super().__init__(**kw)
+        self.include_specials = include_specials
+
+    def _values(self, n, rng):
+        base = (rng.random(n) - 0.5) * 1e6
+        if self.include_specials:
+            specials = [0.0, -0.0, np.inf, -np.inf, np.nan, 1e-300, -1e300]
+            for i, v in enumerate(specials):
+                if n > i + 3:
+                    base[i + 3] = v
+        return base
+
+
+class FloatGen(DoubleGen):
+    arrow_type = pa.float32()
+
+    def _values(self, n, rng):
+        return super()._values(n, rng).astype(np.float32)
+
+
+class BooleanGen(DataGen):
+    arrow_type = pa.bool_()
+
+    def _values(self, n, rng):
+        return rng.random(n) < 0.5
+
+
+class StringGen(DataGen):
+    arrow_type = pa.string()
+
+    def __init__(self, max_len: int = 12, charset: str = None,
+                 cardinality: Optional[int] = None, **kw):
+        super().__init__(**kw)
+        self.max_len = max_len
+        self.charset = charset or (_string.ascii_letters + _string.digits)
+        self.cardinality = cardinality
+
+    def _values(self, n, rng):
+        def one():
+            ln = int(rng.integers(0, self.max_len + 1))
+            return "".join(rng.choice(list(self.charset), size=ln))
+
+        if self.cardinality:
+            pool = [one() for _ in range(self.cardinality)]
+            return [pool[int(rng.integers(0, len(pool)))]
+                    for _ in range(n)]
+        return [one() for _ in range(n)]
+
+
+class DateGen(DataGen):
+    arrow_type = pa.date32()
+
+    def __init__(self, lo_days=-25567, hi_days=25567, **kw):  # 1900..2040
+        super().__init__(**kw)
+        self.lo, self.hi = lo_days, hi_days
+
+    def _values(self, n, rng):
+        return rng.integers(self.lo, self.hi, size=n).astype(np.int32)
+
+
+class TimestampGen(DataGen):
+    arrow_type = pa.timestamp("us", tz="UTC")
+
+    def _values(self, n, rng):
+        return rng.integers(-2_208_988_800_000_000, 2_524_608_000_000_000,
+                            size=n)  # ~1900..2050
+
+
+class DecimalGen(DataGen):
+    def __init__(self, precision=9, scale=2, **kw):
+        super().__init__(**kw)
+        self.precision, self.scale = precision, scale
+        self.arrow_type = pa.decimal128(precision, scale)
+
+    def _values(self, n, rng):
+        import decimal
+
+        hi = 10 ** min(self.precision, 18) - 1
+        ints = rng.integers(-hi, hi, size=n)
+        return [decimal.Decimal(int(v)).scaleb(-self.scale) for v in ints]
+
+
+class RepeatSeqGen(DataGen):
+    """Low-cardinality key generator (group/join keys with controlled
+    cardinality + skew — the datagen module's key feature)."""
+
+    def __init__(self, child: DataGen, cardinality: int, **kw):
+        super().__init__(nullable=child.nullable,
+                         null_ratio=child.null_ratio)
+        self.child = child
+        self.cardinality = cardinality
+        self.arrow_type = child.arrow_type
+
+    def _values(self, n, rng):
+        pool = self.child._values(self.cardinality, rng)
+        idx = rng.integers(0, self.cardinality, size=n)
+        if isinstance(pool, np.ndarray):
+            return pool[idx]
+        return [pool[i] for i in idx]
+
+
+def gen_table(gens: List[Tuple[str, DataGen]], n: int,
+              seed: int = 0) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    return pa.table({name: g.generate(n, rng) for name, g in gens})
+
+
+# Standard gen sets (reference data_gen.py naming)
+numeric_gens = [IntGen(), LongGen(), DoubleGen()]
+all_basic_gens = [BooleanGen(), IntGen(), LongGen(), FloatGen(),
+                  DoubleGen(), StringGen(), DateGen(), TimestampGen()]

@@ -1,0 +1,248 @@
+"""Differential integration tests: TPU backend vs CPU (pyarrow) oracle —
+the reference's primary correctness net
+(`assert_gpu_and_cpu_are_equal_collect`, integration_tests/asserts.py:579),
+over seeded generated data with nulls and special values.
+"""
+
+import os
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.testing.asserts import (
+    assert_tpu_and_cpu_are_equal_collect,
+    assert_tpu_fallback_collect,
+)
+from spark_rapids_tpu.testing.datagen import (
+    DateGen,
+    DecimalGen,
+    DoubleGen,
+    IntGen,
+    LongGen,
+    RepeatSeqGen,
+    StringGen,
+    gen_table,
+)
+
+_CONF = {"spark.sql.shuffle.partitions": 4}
+
+
+@pytest.fixture(scope="module")
+def sales_path(tmp_path_factory):
+    d = tmp_path_factory.mktemp("data")
+    t = gen_table([
+        ("store", RepeatSeqGen(IntGen(0, 50, nullable=True), 40)),
+        ("amount", DoubleGen(include_specials=False)),
+        ("qty", LongGen(lo=-1000, hi=1000)),
+        ("name", StringGen(max_len=10, cardinality=30)),
+        ("day", DateGen()),
+    ], n=5000, seed=42)
+    # write as several files to exercise multi-file scan
+    for i in range(3):
+        pq.write_table(t.slice(i * 1700, 1700),
+                       os.path.join(d, f"part-{i}.parquet"))
+    return str(d)
+
+
+def test_scan_roundtrip(sales_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(sales_path), conf=_CONF)
+
+
+def test_filter_project(sales_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(sales_path)
+        .filter(F.col("amount") > 0.0)
+        .select("store", (F.col("amount") * 2 + 1).alias("x"),
+                F.col("qty").alias("q")),
+        conf=_CONF)
+
+
+def test_groupby_agg(sales_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(sales_path)
+        .groupBy("store")
+        .agg(F.sum("amount").alias("total"),
+             F.count("*").alias("n"),
+             F.min("qty").alias("mn"),
+             F.max("qty").alias("mx"),
+             F.avg("amount").alias("m")),
+        conf=_CONF)
+
+
+def test_global_agg(sales_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(sales_path)
+        .agg(F.sum("qty").alias("t"), F.count("*").alias("n")),
+        conf=_CONF)
+
+
+def test_groupby_string_key(sales_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(sales_path)
+        .groupBy("name").agg(F.count("*").alias("n"),
+                             F.sum("qty").alias("q")),
+        conf=_CONF)
+
+
+def test_distinct(sales_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(sales_path).select("store").distinct(),
+        conf=_CONF)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "left_semi", "left_anti"])
+def test_joins(sales_path, how):
+    def q(s):
+        fact = s.read.parquet(sales_path)
+        dim = s.createDataFrame({
+            "store": list(range(0, 50, 2)),
+            "city": [f"city{i}" for i in range(25)],
+        })
+        joined = fact.join(dim, on="store", how=how)
+        if how in ("left_semi", "left_anti"):
+            return joined.select("store", "qty")
+        return joined.select("store", "qty", "city")
+
+    assert_tpu_and_cpu_are_equal_collect(q, conf=_CONF)
+
+
+def test_sort(sales_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(sales_path)
+        .select("store", "qty").orderBy("store", "qty"),
+        conf=_CONF, ignore_order=False)
+
+
+def test_sort_desc(sales_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(sales_path)
+        .select("qty").orderBy("qty", ascending=False),
+        conf=_CONF, ignore_order=False)
+
+
+def test_conditional_and_case(sales_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(sales_path).select(
+            "store",
+            F.when(F.col("qty") > 0, "pos").when(F.col("qty") < 0, "neg")
+            .otherwise("zero").alias("sign"),
+            F.coalesce("store", F.lit(-1)).alias("s2")),
+        conf=_CONF)
+
+
+def test_string_functions(sales_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(sales_path).select(
+            F.upper("name").alias("u"),
+            F.length("name").alias("l"),
+            F.substring("name", 2, 3).alias("sub"),
+            F.concat("name", F.lit("_x")).alias("c")),
+        conf=_CONF)
+
+
+def test_date_functions(sales_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(sales_path).select(
+            F.year("day").alias("y"), F.month("day").alias("m"),
+            F.dayofmonth("day").alias("d")),
+        conf=_CONF)
+
+
+def test_union_and_limit(sales_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(sales_path).select("store")
+        .union(s.read.parquet(sales_path).select("store")),
+        conf=_CONF)
+
+
+def test_decimal_agg():
+    t = gen_table([
+        ("k", RepeatSeqGen(IntGen(0, 10), 8)),
+        ("d", DecimalGen(precision=12, scale=2)),
+    ], n=500, seed=7)
+
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("k")
+        .agg(F.sum("d").alias("t"), F.min("d").alias("mn"),
+             F.max("d").alias("mx")),
+        conf=_CONF)
+
+
+def test_hash_expression_matches(sales_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(sales_path).select(
+            "store", F.hash("store", "qty").alias("h")),
+        conf=_CONF)
+
+
+def test_fallback_string_cast(sales_path):
+    """Cast(string -> int) is CPU-only in v1: assert fallback placement
+    and result parity (assert_gpu_fallback_collect analog)."""
+    from spark_rapids_tpu.sqltypes.datatypes import integer
+
+    assert_tpu_fallback_collect(
+        lambda s: s.createDataFrame({"x": ["1", "22", "333"]})
+        .select(F.col("x").cast(integer).alias("i")),
+        fallback_class="CpuProjectExec",
+        conf=_CONF)
+
+
+def test_q5_shape(sales_path):
+    """The minimum end-to-end slice (SURVEY.md section 7): scan ->
+    filter -> project -> partial agg -> exchange -> final agg -> sort."""
+    def q(s):
+        fact = s.read.parquet(sales_path)
+        dim = s.createDataFrame({
+            "store": list(range(0, 50, 2)),
+            "city": [f"city{i}" for i in range(25)],
+        })
+        return (fact.filter(F.col("amount") > 0.0)
+                .join(dim, on="store", how="inner")
+                .groupBy("city")
+                .agg(F.sum("amount").alias("revenue"),
+                     F.count("*").alias("sales"))
+                .orderBy("city"))
+
+    assert_tpu_and_cpu_are_equal_collect(q, conf=_CONF,
+                                         ignore_order=False)
+
+
+def test_right_join(sales_path):
+    """Right outer = swapped left outer + reorder (planner rewrite)."""
+    def q(s):
+        fact = s.read.parquet(sales_path)
+        dim = s.createDataFrame({
+            "store": list(range(45, 60)),  # some stores unmatched
+            "city": [f"c{i}" for i in range(15)],
+        })
+        return fact.join(dim, on="store", how="right") \
+            .select("store", "qty", "city")
+
+    assert_tpu_and_cpu_are_equal_collect(q, conf=_CONF)
+
+
+def test_full_outer_join():
+    def q(s):
+        a = s.createDataFrame({"k": [1, 2, 3], "x": [10, 20, 30]})
+        b = s.createDataFrame({"k": [2, 3, 4], "y": [200, 300, 400]})
+        return a.join(b, on="k", how="full").select("x", "y")
+
+    assert_tpu_and_cpu_are_equal_collect(q, conf=_CONF)
+
+
+def test_substring_negative_past_start():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame({"s": ["abc", "ab", "a", ""]})
+        .select(F.substring("s", -5, 2).alias("r"),
+                F.substring("s", -2, 5).alias("r2")),
+        conf=_CONF)
+
+
+def test_repartition_round_robin(sales_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(sales_path).repartition(3)
+        .select("store", "qty"),
+        conf=_CONF)
