@@ -1,0 +1,94 @@
+"""Benchmark: flagship q5-shaped columnar pipeline on the device.
+
+Measures the fused scan->filter->project->hash-aggregate stage (the
+TPC-DS q5 minimum slice, SURVEY.md section 7) as device throughput in
+GB/s of columnar input processed, against a pyarrow CPU baseline running
+the same query — the stand-in for the reference's CPU-Spark baseline
+(BASELINE.md metric: per-chip GB/s columnar scan).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+ROWS = 4_000_000
+REPEATS = 5
+
+
+def build_table(rows: int) -> pa.Table:
+    rng = np.random.default_rng(0)
+    return pa.table({
+        "store": pa.array(rng.integers(0, 200, rows), type=pa.int64()),
+        "amount": pa.array(rng.random(rows) * 100.0, type=pa.float64()),
+        "qty": pa.array(rng.integers(1, 100, rows), type=pa.int64()),
+    })
+
+
+def cpu_query(table: pa.Table):
+    f = table.filter(pc.greater(table.column("amount"), 10.0))
+    rev = pc.multiply(f.column("amount"), pc.cast(f.column("qty"),
+                                                  pa.float64()))
+    work = pa.table({"store": f.column("store"), "revenue": rev,
+                     "amount": f.column("amount")})
+    return work.group_by("store").aggregate(
+        [("revenue", "sum"), ("amount", "mean"), ("store", "count")])
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    from spark_rapids_tpu.columnar import arrow_to_device
+
+    import importlib.util
+    import os
+
+    entry_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "__graft_entry__.py")
+    spec = importlib.util.spec_from_file_location("graft_entry", entry_path)
+    ge = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ge)
+
+    table = build_table(ROWS)
+    input_bytes = table.nbytes
+
+    # ---- CPU baseline (pyarrow, the vectorized CPU engine) ----
+    cpu_query(table.slice(0, 100_000))  # warm
+    t0 = time.perf_counter()
+    for _ in range(max(1, REPEATS // 2)):
+        cpu_query(table)
+    cpu_time = (time.perf_counter() - t0) / max(1, REPEATS // 2)
+    cpu_gbps = input_bytes / cpu_time / 1e9
+
+    # ---- device pipeline ----
+    query_step, _ = ge.entry()
+    batch = arrow_to_device(table)
+    jitted = jax.jit(query_step)
+    out = jitted(batch)  # compile + run
+    jax.block_until_ready(jax.tree_util.tree_leaves(out))
+    t0 = time.perf_counter()
+    for _ in range(REPEATS):
+        out = jitted(batch)
+        jax.block_until_ready(jax.tree_util.tree_leaves(out))
+    dev_time = (time.perf_counter() - t0) / REPEATS
+    dev_gbps = input_bytes / dev_time / 1e9
+
+    backend = jax.devices()[0].platform
+    print(json.dumps({
+        "metric": f"q5-slice columnar pipeline throughput ({backend}, "
+                  f"{ROWS} rows)",
+        "value": round(dev_gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(dev_gbps / cpu_gbps, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -178,8 +178,10 @@ class ColumnBatch:
             new_num_rows)
 
     def _tree_flatten(self):
-        return (tuple(self.columns), jnp.asarray(self.num_rows,
-                                                 jnp.int32)), self.schema
+        nr = self.num_rows
+        if isinstance(nr, (int, np.integer)):
+            nr = jnp.asarray(nr, jnp.int32)
+        return (tuple(self.columns), nr), self.schema
 
     @classmethod
     def _tree_unflatten(cls, schema, children):

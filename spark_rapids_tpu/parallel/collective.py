@@ -1,0 +1,128 @@
+"""ICI collective shuffle — the UCX P2P transport replacement.
+
+The reference moves shuffle blocks device-to-device over RDMA/NVLink with
+UCX (shuffle-plugin/.../ucx/UCX.scala, RapidsShuffleClient/Server,
+bounce-buffer pools; SURVEY.md section 2.7). On TPU the fabric is ICI and
+the idiomatic transport is an XLA collective inside one SPMD program
+(SURVEY.md section 5.8): no server threads, no bounce buffers, no
+flatbuffer metadata plane — `lax.all_to_all` over a `jax.sharding.Mesh`
+axis moves every shard's partitioned rows in a single fused step, and the
+"metadata plane" is just an all-to-all of per-destination row counts.
+
+Design:
+- Each device holds a fixed-capacity shard of rows (the same
+  capacity-bucket discipline as single-chip batches).
+- `all_to_all_batch` scatters rows into [n_dest, slot] send buffers by
+  partition id (stable on-device sort, like GpuPartitioning), exchanges
+  with one all_to_all, and compacts received rows back to a single
+  shard, returning the new logical row count per device.
+- Slot capacity is a static choice; rows beyond a destination's slot
+  are dropped by scatter — callers size slots via `slot_capacity` with
+  the same split-and-retry discipline the single-chip path uses for
+  data-dependent sizes (TpuSplitAndRetryOOM when exceeded; checked via
+  the returned overflow flag).
+
+Everything here is shard_map-compatible pure function code: jit once,
+run on N real TPU chips over ICI or N host devices for validation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from spark_rapids_tpu.columnar.batch import ColumnBatch
+from spark_rapids_tpu.ops.common import sort_permutation
+
+
+def slot_capacity(shard_capacity: int, n_devices: int,
+                  skew_factor: int = 4) -> int:
+    """Static per-destination slot size: expected rows/dest times a skew
+    allowance, rounded up to a power of two, capped at shard capacity."""
+    expected = max(1, shard_capacity // max(1, n_devices))
+    slot = 1
+    while slot < expected * skew_factor:
+        slot <<= 1
+    return min(slot, shard_capacity)
+
+
+def _scatter_to_slots(arr: jnp.ndarray, dest: jnp.ndarray,
+                      rank_in_dest: jnp.ndarray, n_dest: int, slot: int
+                      ) -> jnp.ndarray:
+    """Place row i at [dest[i], rank_in_dest[i]].
+
+    Dead rows carry dest == n_dest and slot-overflow rows carry
+    rank >= slot: both indices are out of bounds, and scatter
+    mode="drop" discards exactly those updates — no clipping, which
+    would silently overwrite real slots."""
+    out_shape = (n_dest, slot) + arr.shape[1:]
+    out = jnp.zeros(out_shape, dtype=arr.dtype)
+    return out.at[dest, rank_in_dest].set(arr, mode="drop",
+                                          unique_indices=False)
+
+
+def all_to_all_batch(batch: ColumnBatch, pid: jnp.ndarray, n_dest: int,
+                     slot: int, axis_name: str
+                     ) -> Tuple[ColumnBatch, jnp.ndarray]:
+    """Inside shard_map: exchange rows of this device's shard so row i
+    lands on device pid[i]. Returns (new shard batch, overflow_flag).
+
+    The received shard's capacity is n_dest * slot.
+    """
+    cap = batch.capacity
+    live = batch.live_mask()
+    dest = jnp.where(live, pid, n_dest)  # dead rows -> dropped
+    # rank of each row within its destination: stable sort by dest then
+    # rank = position - first_position_of_dest
+    key = dest.astype(jnp.int64)
+    perm = sort_permutation([key], cap)
+    sorted_dest = jnp.take(dest, perm)
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    counts_all = jax.ops.segment_sum(
+        live.astype(jnp.int32), jnp.clip(dest, 0, n_dest),
+        num_segments=n_dest + 1)
+    starts_all = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                  jnp.cumsum(counts_all)[:-1]])
+    rank_sorted = pos - jnp.take(starts_all, sorted_dest)
+    # un-permute rank back to original row order
+    rank = jnp.zeros((cap,), jnp.int32).at[perm].set(rank_sorted)
+    overflow = jnp.any(jnp.where(live, rank, 0) >= slot)
+
+    recv_counts_per_src = lax.all_to_all(
+        jnp.minimum(counts_all[:n_dest], slot)[:, None], axis_name, 0, 0
+    ).reshape(-1)  # [n_src]
+
+    def exchange_leaf(arr):
+        send = _scatter_to_slots(arr, dest, rank, n_dest, slot)
+        # all_to_all splits axis 0 (dest) across devices and concats the
+        # received blocks along a new leading axis -> [n_src, slot, ...]
+        recv = lax.all_to_all(send, axis_name, 0, 0)
+        flat = recv.reshape((n_dest * slot,) + arr.shape[1:])
+        return flat
+
+    # compact received rows: row j of source s is live iff
+    # j < recv_counts_per_src[s]
+    new_cols = []
+    for col in batch.columns:
+        data = exchange_leaf(col.data)
+        validity = exchange_leaf(col.validity)
+        lengths = (None if col.lengths is None
+                   else exchange_leaf(col.lengths))
+        from spark_rapids_tpu.columnar.batch import DeviceColumn
+
+        new_cols.append(DeviceColumn(col.dtype, data, validity, lengths))
+    recv_cap = n_dest * slot
+    slot_pos = jnp.tile(jnp.arange(slot, dtype=jnp.int32), n_dest)
+    src_id = jnp.repeat(jnp.arange(n_dest, dtype=jnp.int32), slot)
+    live_recv = slot_pos < jnp.take(recv_counts_per_src, src_id)
+    total = jnp.sum(live_recv).astype(jnp.int32)
+    interim = ColumnBatch(batch.schema, new_cols, recv_cap)
+    # compact live rows to the front
+    ckey = jnp.where(live_recv, 0, 1).astype(jnp.int64)
+    cperm = sort_permutation([ckey], recv_cap)
+    out = interim.gather(cperm, total)
+    return out, overflow

@@ -1,0 +1,201 @@
+"""Mesh-distributed query execution — SPMD over jax.sharding.Mesh.
+
+This is the multi-chip execution mode: instead of the in-process
+shuffle manager moving host tables between thread-pool tasks (shuffle
+v1), the WHOLE query stage is one shard_map'd XLA program over a device
+mesh; shuffles are `all_to_all` collectives riding ICI (SURVEY.md
+section 5.8's target design). Spark's data parallelism maps to the mesh
+"data" axis: every device owns one shard of rows.
+
+`make_distributed_agg` builds the flagship fused stage:
+  local partial hash-aggregate
+  -> ICI all-to-all repartition by group-key hash
+  -> final merge aggregate
+which is exactly the physical shape of the single-chip
+TpuHashAggregateExec(partial) -> TpuShuffleExchangeExec ->
+TpuHashAggregateExec(final) pipeline, fused into one compiled program.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_tpu.columnar.batch import ColumnBatch, DeviceColumn
+from spark_rapids_tpu.ops.hashing import murmur3_columns, pmod
+from spark_rapids_tpu.parallel.collective import all_to_all_batch
+
+AXIS = "data"
+
+
+def make_mesh(n_devices: int) -> Mesh:
+    devs = jax.devices()[:n_devices]
+    if len(devs) < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} devices, have {len(jax.devices())}")
+    return Mesh(np.array(devs), (AXIS,))
+
+
+def shard_batch(mesh: Mesh, batch: ColumnBatch) -> ColumnBatch:
+    """Place a host-built batch row-sharded across the mesh; capacity
+    must divide evenly by the axis size.
+
+    The logical row count becomes a per-device [1] count (sharded from
+    an [n] array): rows are contiguous, so shard s holds
+    clip(global_rows - s*shard_cap, 0, shard_cap) live rows. Inside
+    shard_map, `local.num_rows` is that shard's own count (shape [1],
+    which broadcasts wherever a scalar is expected)."""
+    n = mesh.shape[AXIS]
+    assert batch.capacity % n == 0, (batch.capacity, n)
+    shard_cap = batch.capacity // n
+    global_rows = batch.row_count()
+    per_shard = np.clip(global_rows - np.arange(n) * shard_cap, 0,
+                        shard_cap).astype(np.int32)
+
+    def put_rows(leaf):
+        return jax.device_put(leaf, NamedSharding(mesh, P(AXIS)))
+
+    cols = jax.tree_util.tree_map(put_rows, tuple(batch.columns))
+    counts = jax.device_put(jnp.asarray(per_shard),
+                            NamedSharding(mesh, P(AXIS)))
+    return ColumnBatch(batch.schema, list(cols), counts)
+
+
+def batch_specs(tree, row_spec):
+    """Per-leaf PartitionSpecs for a ColumnBatch pytree (or ShapeDtype
+    tree): row arrays sharded, scalar leaves (per-shard num_rows)
+    replicated."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    specs = [P() if getattr(x, "ndim", 0) == 0 else row_spec
+             for x in leaves]
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def input_batch_specs(tree, row_spec):
+    """Specs for a batch produced by shard_batch: EVERY leaf (including
+    the [n] per-shard row-count array) shards over the row axis."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return jax.tree_util.tree_unflatten(treedef,
+                                        [row_spec] * len(leaves))
+
+
+def make_distributed_agg(mesh: Mesh, template: ColumnBatch,
+                         partial_fn: Callable[[ColumnBatch], ColumnBatch],
+                         final_fn: Callable[[ColumnBatch], ColumnBatch],
+                         key_ordinals: List[int], slot: int):
+    """Jit the full distributed aggregate step over the mesh.
+
+    partial_fn/final_fn are the SAME single-shard phase functions the
+    single-chip TpuHashAggregateExec jits; the per-shard shapes seen
+    under shard_map are template.capacity // n rows.
+    """
+    n = mesh.shape[AXIS]
+
+    def step(local: ColumnBatch):
+        part = partial_fn(local)
+        key_cols = [part.columns[i] for i in key_ordinals]
+        pid = pmod(murmur3_columns(key_cols), n)
+        exchanged, overflow = all_to_all_batch(part, pid, n, slot, AXIS)
+        out = final_fn(exchanged)
+        # Re-home the per-shard row count as a [1] array so the output
+        # batch's num_rows leaf shards over the axis (a replicated
+        # scalar out-spec would be ill-defined: every shard differs).
+        # After jit, out.num_rows is the [n] per-shard count vector that
+        # gather_result consumes.
+        out = ColumnBatch(out.schema, out.columns,
+                          jnp.asarray(out.num_rows, jnp.int32).reshape(1))
+        return out, overflow.reshape(1)
+
+    from jax import shard_map
+
+    local_template = _local_view(template, n)
+    out_shape = jax.eval_shape(
+        lambda b: _shape_stub(b, partial_fn, final_fn, n, slot),
+        local_template)
+    in_specs = input_batch_specs(template, P(AXIS))
+    out_specs = (batch_specs(out_shape, P(AXIS)), P(AXIS))
+    smapped = shard_map(step, mesh=mesh, in_specs=(in_specs,),
+                        out_specs=out_specs, check_vma=False)
+    jitted = jax.jit(smapped)
+
+    def run(sharded_batch: ColumnBatch) -> ColumnBatch:
+        """Execute; raises TpuSplitAndRetryOOM if any destination slot
+        overflowed (the same split-retry discipline as the single-chip
+        path — callers shrink the shard or raise `slot`)."""
+        out, overflow = jitted(sharded_batch)
+        import numpy as onp
+
+        if bool(onp.asarray(jax.device_get(overflow)).any()):
+            from spark_rapids_tpu.runtime.errors import TpuSplitAndRetryOOM
+
+            raise TpuSplitAndRetryOOM(
+                f"all_to_all slot capacity {slot} overflowed; "
+                "re-run with a larger slot or smaller shards")
+        return out
+
+    run.jitted = jitted
+    return run
+
+
+def _local_view(batch: ColumnBatch, n: int) -> ColumnBatch:
+    """Shape template of one device's shard (capacity / n rows)."""
+    cols = []
+    per = batch.capacity // n
+    for c in batch.columns:
+        cols.append(DeviceColumn(
+            c.dtype,
+            jax.ShapeDtypeStruct((per,) + c.data.shape[1:], c.data.dtype),
+            jax.ShapeDtypeStruct((per,), jnp.bool_),
+            None if c.lengths is None
+            else jax.ShapeDtypeStruct((per,), jnp.int32)))
+    return ColumnBatch(batch.schema, cols,
+                       jax.ShapeDtypeStruct((1,), jnp.int32))
+
+
+def _shape_stub(b: ColumnBatch, partial_fn, final_fn, n: int, slot: int
+                ) -> ColumnBatch:
+    """Shape-equivalent single-device stand-in for eval_shape: the
+    all_to_all reshapes every leaf from [cap,...] to [n*slot,...]."""
+    part = partial_fn(b)
+    cols = []
+    for c in part.columns:
+        cap = c.data.shape[0]
+        reps = -(-(n * slot) // cap)
+        data = jnp.tile(c.data, (reps,) + (1,) * (c.data.ndim - 1))[
+            :n * slot]
+        validity = jnp.tile(c.validity, reps)[:n * slot]
+        lengths = None if c.lengths is None else jnp.tile(
+            c.lengths, reps)[:n * slot]
+        cols.append(DeviceColumn(c.dtype, data, validity, lengths))
+    fake = ColumnBatch(part.schema, cols, jnp.int32(0))
+    out = final_fn(fake)
+    return ColumnBatch(out.schema, out.columns,
+                       jnp.asarray(out.num_rows, jnp.int32).reshape(1))
+
+
+def gather_result(out: ColumnBatch, n: int) -> ColumnBatch:
+    """Collect a sharded result to one host-side logical batch: shard s
+    contributes its first out.num_rows[s] rows (the num_rows leaf of a
+    distributed-step output is the [n] per-shard count vector)."""
+    import numpy as onp
+
+    counts = out.num_rows
+    leaves, treedef = jax.tree_util.tree_flatten(out)
+    host = jax.tree_util.tree_unflatten(
+        treedef, [onp.asarray(jax.device_get(x)) for x in leaves])
+    counts = onp.asarray(jax.device_get(counts)).reshape(-1)
+    global_cap = host.columns[0].data.shape[0]
+    shard_cap = global_cap // n
+    keep = onp.zeros(global_cap, dtype=bool)
+    for s in range(n):
+        c = min(int(counts[s]), shard_cap)
+        keep[s * shard_cap: s * shard_cap + c] = True
+    idx = onp.nonzero(keep)[0]
+    total = len(idx)
+    if total == 0:
+        idx = onp.zeros(1, dtype=onp.int64)
+    return host.gather(jnp.asarray(idx), total)
