@@ -130,8 +130,40 @@ class Column:
     def contains(self, s: str):
         return Column(Contains(self.expr, s))
 
+    # sort direction / window
+
+    def asc(self) -> "SortColumn":
+        return SortColumn(self.expr, True)
+
+    def desc(self) -> "SortColumn":
+        return SortColumn(self.expr, False)
+
+    def asc_nulls_last(self) -> "SortColumn":
+        return SortColumn(self.expr, True, nulls_first=False)
+
+    def desc_nulls_first(self) -> "SortColumn":
+        return SortColumn(self.expr, False, nulls_first=True)
+
+    def over(self, window_spec) -> "Column":
+        from spark_rapids_tpu.expr.windows import WindowExpression
+
+        base = self.expr.children[0] if isinstance(self.expr, Alias) \
+            else self.expr
+        return Column(WindowExpression(base, window_spec.to_spec_def()))
+
     def __repr__(self):
         return f"Column<{self.expr!r}>"
 
     def __hash__(self):
         return id(self)
+
+
+class SortColumn:
+    """Column + sort direction marker (Column.asc()/desc()); consumed by
+    orderBy on DataFrame and WindowSpec."""
+
+    def __init__(self, expr, ascending: bool, nulls_first=None):
+        self.expr = expr
+        self.ascending = ascending
+        self.nulls_first = (ascending if nulls_first is None
+                            else nulls_first)

@@ -84,7 +84,44 @@ class DataFrame:
             e = self._col_expr(c)
             exprs.append(_named(e, name if isinstance(name, str)
                                 else f"col{i}"))
-        return DataFrame(L.Project(exprs, self._plan), self.session)
+        return self._finish_project(exprs)
+
+    def _finish_project(self, exprs: List[Alias]) -> "DataFrame":
+        """Emit Project, extracting window expressions into Window nodes
+        first (Spark's ExtractWindowExpressions rule)."""
+        from spark_rapids_tpu.expr.windows import (
+            WindowExpression,
+            contains_window,
+        )
+
+        if not any(contains_window(e) for e in exprs):
+            return DataFrame(L.Project(exprs, self._plan), self.session)
+        plan = self._plan
+        n_base = len(plan.schema.fields)
+        groups = {}  # sort_key -> [Alias(WindowExpression)]
+        for e in exprs:
+            base = e.children[0]
+            if isinstance(base, WindowExpression):
+                groups.setdefault(base.spec.sort_key(), []).append(e)
+            elif contains_window(e):
+                raise NotImplementedError(
+                    "window expressions must be top-level in v1 "
+                    "(wrap arithmetic around them in a second select)")
+        appended = {}
+        ordinal = n_base
+        for key, aliases in groups.items():
+            plan = L.Window(aliases, plan)
+            for a in aliases:
+                appended[id(a)] = ordinal
+                ordinal += 1
+        out = []
+        for e in exprs:
+            if id(e) in appended:
+                out.append(Alias(
+                    BoundReference(appended[id(e)], e.dtype, True), e.name))
+            else:
+                out.append(e)
+        return DataFrame(L.Project(out, plan), self.session)
 
     def withColumn(self, name: str, c: Column) -> "DataFrame":
         exprs = []
@@ -98,7 +135,7 @@ class DataFrame:
                                    f.name))
         if not replaced:
             exprs.append(Alias(self._col_expr(c), name))
-        return DataFrame(L.Project(exprs, self._plan), self.session)
+        return self._finish_project(exprs)
 
     def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
         exprs = []
@@ -112,9 +149,16 @@ class DataFrame:
         return self.select(*keep)
 
     def filter(self, condition) -> "DataFrame":
+        from spark_rapids_tpu.expr.windows import contains_window
+
         if isinstance(condition, str):
             raise NotImplementedError("SQL string filters: use Column")
         cond = self._col_expr(condition)
+        if contains_window(cond):
+            raise ValueError(
+                "window functions are not allowed in filter conditions; "
+                "materialize with select/withColumn first (Spark analysis "
+                "rule)")
         return DataFrame(L.Filter(cond, self._plan), self.session)
 
     where = filter
@@ -180,12 +224,24 @@ class DataFrame:
     unionAll = union
 
     def orderBy(self, *cols, ascending=None) -> "DataFrame":
+        from spark_rapids_tpu.api.column import SortColumn
+        from spark_rapids_tpu.expr.windows import contains_window
+
         orders = []
         asc_list = (ascending if isinstance(ascending, (list, tuple))
                     else [ascending] * len(cols))
         for c, asc in zip(cols, asc_list):
+            if isinstance(c, SortColumn):
+                orders.append(L.SortOrder(_resolve(c.expr, self.schema),
+                                          c.ascending, c.nulls_first))
+                continue
             a = True if asc is None else bool(asc)
             orders.append(L.SortOrder(self._col_expr(c), a))
+        for o in orders:
+            if contains_window(o.expr):
+                raise ValueError(
+                    "window functions are not allowed in orderBy; "
+                    "materialize with select/withColumn first")
         return DataFrame(L.Sort(orders, self._plan, global_sort=True),
                          self.session)
 
@@ -259,15 +315,28 @@ class Row(dict):
 
 class GroupedData:
     def __init__(self, df: DataFrame, cols):
+        from spark_rapids_tpu.expr.windows import contains_window
+
         self.df = df
         self.grouping = [
             _named(df._col_expr(c), c if isinstance(c, str) else c.name)
             for c in cols]
+        for g in self.grouping:
+            if contains_window(g):
+                raise ValueError(
+                    "window functions are not allowed as grouping keys; "
+                    "materialize with select/withColumn first")
 
     def agg(self, *cols) -> DataFrame:
+        from spark_rapids_tpu.expr.windows import contains_window
+
         aggs = []
         for i, c in enumerate(cols):
             e = self.df._col_expr(c)
+            if contains_window(e):
+                raise ValueError(
+                    "window functions are not allowed in groupBy.agg(); "
+                    "use select/withColumn")
             base = e.children[0] if isinstance(e, Alias) else e
             assert isinstance(base, AggregateFunction), \
                 f"agg() requires aggregate expressions, got {base!r}"

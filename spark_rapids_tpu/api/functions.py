@@ -125,6 +125,58 @@ def hash(*cs) -> Column:  # noqa: A001
     return Column(Murmur3Hash(*[expr_of(c) for c in cs]))
 
 
+# --- window functions ---
+
+def row_number() -> Column:
+    from spark_rapids_tpu.expr.windows import RowNumber
+
+    return Column(RowNumber())
+
+
+def rank() -> Column:
+    from spark_rapids_tpu.expr.windows import Rank
+
+    return Column(Rank())
+
+
+def dense_rank() -> Column:
+    from spark_rapids_tpu.expr.windows import DenseRank
+
+    return Column(DenseRank())
+
+
+def percent_rank() -> Column:
+    from spark_rapids_tpu.expr.windows import PercentRank
+
+    return Column(PercentRank())
+
+
+def cume_dist() -> Column:
+    from spark_rapids_tpu.expr.windows import CumeDist
+
+    return Column(CumeDist())
+
+
+def ntile(n: int) -> Column:
+    from spark_rapids_tpu.expr.windows import NTile
+
+    return Column(NTile(n))
+
+
+def lead(c, offset: int = 1, default=None) -> Column:
+    from spark_rapids_tpu.expr.windows import Lead
+
+    d = None if default is None else _expr(lit_or(default))
+    return Column(Lead(expr_of(c), offset, d))
+
+
+def lag(c, offset: int = 1, default=None) -> Column:
+    from spark_rapids_tpu.expr.windows import Lag
+
+    d = None if default is None else _expr(lit_or(default))
+    return Column(Lag(expr_of(c), offset, d))
+
+
 def when(condition: Column, value) -> "WhenBuilder":
     return WhenBuilder([(expr_of(condition), expr_of(lit_or(value)))])
 

@@ -921,3 +921,178 @@ class UnionExec(PhysicalPlan):
                 yield from c.execute_partition(pid, ctx)
                 return
             pid -= c.num_partitions
+
+
+# ----------------------------------------------------------------- window
+
+class TpuWindowExec(PhysicalPlan):
+    """Window operator (GpuWindowExec analog, window/GpuWindowExecMeta
+    .scala:673): one sorted pass per (partitionBy, orderBy) spec
+    evaluates every frame/function in a single XLA program — prefix sums
+    for sum/count frames, a doubling sparse table for min/max frames,
+    binary search for RANGE value bounds (ops/windowops.py). Input rows
+    are preserved; window columns are appended."""
+
+    def __init__(self, window_exprs: List[Alias], child, conf):
+        from spark_rapids_tpu.expr import windows as we
+
+        base = child.schema
+        extra = [StructField(a.name, a.dtype, True) for a in window_exprs]
+        super().__init__([child], StructType(list(base.fields) + extra),
+                         conf)
+        self.window_exprs = window_exprs
+        self.spec0: we.WindowSpecDef = window_exprs[0].children[0].spec
+        self._jitted = jax.jit(self._run)
+
+    def _run(self, batch: ColumnBatch) -> ColumnBatch:
+        from spark_rapids_tpu.expr import windows as we
+        from spark_rapids_tpu.expr.aggregates import (
+            Average, Count, First, Max, Min, Sum,
+        )
+        from spark_rapids_tpu.ops import windowops as W
+        from spark_rapids_tpu.sqltypes import StringType
+
+        ctx = EvalContext(batch)
+        spec0 = self.spec0
+        part_cols = [p.eval(ctx) for p in spec0.partitions]
+        order_cols = [(o.expr.eval(ctx), o.ascending, o.nulls_first)
+                      for o in spec0.orders]
+        sw = W.sort_for_window(batch, part_cols, order_cols)
+        has_order = bool(spec0.orders)
+        cap = batch.capacity
+        new_cols: List[DeviceColumn] = []
+
+        def to_original(data, valid):
+            return (jnp.take(data, sw.inv, axis=0),
+                    jnp.take(valid, sw.inv))
+
+        for alias in self.window_exprs:
+            wexpr: we.WindowExpression = alias.children[0]
+            fn = wexpr.function
+            frame = wexpr.spec.frame
+            dt = wexpr.dtype
+
+            if isinstance(fn, we.RowNumber):
+                d, v = W.row_number(sw), jnp.ones((cap,), bool)
+            elif isinstance(fn, we.Rank):
+                d, v = W.rank(sw), jnp.ones((cap,), bool)
+            elif isinstance(fn, we.DenseRank):
+                d, v = W.dense_rank(sw), jnp.ones((cap,), bool)
+            elif isinstance(fn, we.PercentRank):
+                d, v = W.percent_rank(sw), jnp.ones((cap,), bool)
+            elif isinstance(fn, we.CumeDist):
+                d, v = W.cume_dist(sw), jnp.ones((cap,), bool)
+            elif isinstance(fn, we.NTile):
+                d, v = W.ntile(sw, fn.n), jnp.ones((cap,), bool)
+            elif isinstance(fn, we.Lead):  # Lag subclasses Lead
+                col = fn.input.eval(ctx)
+                sorted_col = col.gather(sw.perm)
+                vals, ok, inside = W.lead_lag(
+                    sorted_col.data, sorted_col.validity, sw, fn.offset)
+                lens = None
+                if sorted_col.lengths is not None:
+                    lens, _, _ = W.lead_lag(sorted_col.lengths,
+                                            sorted_col.validity, sw,
+                                            fn.offset)
+                if fn.default is not None:
+                    dcol = fn.default.eval(ctx).gather(sw.perm)
+                    vals = jnp.where(
+                        inside if vals.ndim == 1 else inside[:, None],
+                        vals, dcol.data)
+                    ok = jnp.where(inside, ok, dcol.validity)
+                    if lens is not None:
+                        lens = jnp.where(inside, lens, dcol.lengths)
+                d_o, v_o = to_original(vals, ok)
+                lens_o = None if lens is None else jnp.take(lens, sw.inv)
+                new_cols.append(DeviceColumn(dt, d_o, v_o, lens_o))
+                continue
+            else:
+                # aggregate over frames
+                inp = fn.input.eval(ctx) if fn.input is not None else None
+                inp_s = inp.gather(sw.perm) if inp is not None else None
+                if frame is None:
+                    start, end = W.default_frame_bounds(sw, has_order)
+                elif frame.frame_type == "rows":
+                    start, end = W.rows_frame_bounds(sw, frame.lower,
+                                                     frame.upper)
+                else:
+                    oc_s = order_cols[0][0].gather(sw.perm)
+                    start, end = W.range_frame_bounds(
+                        sw, oc_s, W.segment_ids_sorted(sw),
+                        frame.lower, frame.upper,
+                        nulls_first=spec0.orders[0].nulls_first)
+                if isinstance(fn, Count):
+                    valid_s = (inp_s.validity if inp_s is not None
+                               else jnp.ones((cap,), bool))
+                    d = W.frame_count(valid_s, sw, start, end)
+                    v = jnp.ones((cap,), bool)
+                elif isinstance(fn, Sum):
+                    cnt = W.frame_count(inp_s.validity, sw, start, end)
+                    d = W.frame_sum(inp_s.data, inp_s.validity, sw, start,
+                                    end, dt.np_dtype)
+                    v = cnt > 0
+                elif isinstance(fn, Average):
+                    cnt = W.frame_count(inp_s.validity, sw, start, end)
+                    s = W.frame_sum(inp_s.data, inp_s.validity, sw, start,
+                                    end, jnp.float64)
+                    d = s / jnp.maximum(cnt, 1).astype(jnp.float64)
+                    v = cnt > 0
+                elif isinstance(fn, (Min, Max)):
+                    cnt = W.frame_count(inp_s.validity, sw, start, end)
+                    d = W.frame_minmax(inp_s.data, inp_s.validity, sw,
+                                       start, end, isinstance(fn, Max))
+                    d = d.astype(inp_s.data.dtype)
+                    v = cnt > 0
+                elif isinstance(fn, First):
+                    d, v = W.frame_first_last(
+                        inp_s.data, inp_s.validity, sw, start, end,
+                        last=False, ignore_nulls=fn.ignore_nulls)
+                    if isinstance(dt, StringType):
+                        lens, _ = W.frame_first_last(
+                            inp_s.lengths, inp_s.validity, sw, start, end,
+                            last=False, ignore_nulls=fn.ignore_nulls)
+                        d_o, v_o = to_original(d, v)
+                        new_cols.append(DeviceColumn(
+                            dt, d_o, v_o, jnp.take(lens, sw.inv)))
+                        continue
+                else:
+                    raise NotImplementedError(
+                        f"window function {type(fn).__name__}")
+            d_o, v_o = to_original(d, v)
+            new_cols.append(DeviceColumn(dt, d_o, v_o))
+        return ColumnBatch(self.schema, list(batch.columns) + new_cols,
+                           batch.num_rows)
+
+    def execute_partition(self, pid, ctx):
+        with self.metrics[M.WINDOW_TIME].ns():
+            _acquire(ctx)
+            batches = list(self.children[0].execute_partition(pid, ctx))
+            if not batches:
+                return
+            merged = concat_batches(batches) if len(batches) > 1 \
+                else batches[0]
+            yield self._jitted(merged)
+
+
+class CpuWindowExec(PhysicalPlan):
+    """Brute-force window oracle over arrow tables (per-row frame scan) —
+    intentionally simple; it is the differential-test truth, not a fast
+    path."""
+
+    is_tpu = False
+
+    def __init__(self, window_exprs: List[Alias], child, schema, conf):
+        super().__init__([child], schema, conf)
+        self.window_exprs = window_exprs
+
+    def execute_partition(self, pid, ctx):
+        tables = list(self.children[0].execute_partition(pid, ctx))
+        if not tables:
+            return
+        table = pa.concat_tables(tables, promote_options="none")
+        yield self._compute(table)
+
+    def _compute(self, table: pa.Table) -> pa.Table:
+        from spark_rapids_tpu.exec.window_oracle import compute_windows
+
+        return compute_windows(table, self.window_exprs)

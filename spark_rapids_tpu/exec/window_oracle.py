@@ -1,0 +1,263 @@
+"""Brute-force window evaluation over arrow tables — the CPU oracle for
+the differential test harness (reference pattern: CPU Spark runs the real
+thing; here a deliberately-naive per-row implementation of Spark's window
+semantics, independent of the device kernels in ops/windowops.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import List
+
+import pyarrow as pa
+
+from spark_rapids_tpu.exec import cpu_eval
+from spark_rapids_tpu.expr import Alias
+from spark_rapids_tpu.expr.aggregates import (
+    Average,
+    Count,
+    First,
+    Max,
+    Min,
+    Sum,
+)
+from spark_rapids_tpu.expr import windows as we
+from spark_rapids_tpu.sqltypes.datatypes import to_arrow_type
+
+
+def _cmp_vals(a, b):
+    """Spark ordering for one ascending, nulls-first key; None < NaN-free
+    values, NaN greater than +inf (Double.compare semantics)."""
+    if a is None or b is None:
+        if a is None and b is None:
+            return 0
+        return -1 if a is None else 1
+    a_nan = isinstance(a, float) and math.isnan(a)
+    b_nan = isinstance(b, float) and math.isnan(b)
+    if a_nan or b_nan:
+        if a_nan and b_nan:
+            return 0
+        return 1 if a_nan else -1
+    if isinstance(a, float) and isinstance(b, float) and a == 0.0 \
+            and b == 0.0:
+        # Java Double.compare: -0.0 < 0.0 (matches the device total-order
+        # key in ops/common.py)
+        sa, sb = math.copysign(1.0, a), math.copysign(1.0, b)
+        return 0 if sa == sb else (-1 if sa < sb else 1)
+    if a == b:
+        return 0
+    return -1 if a < b else 1
+
+
+def compute_windows(table: pa.Table, window_exprs: List[Alias]) -> pa.Table:
+    n = table.num_rows
+    spec0: we.WindowSpecDef = window_exprs[0].children[0].spec
+
+    part_vals = [cpu_eval.eval_expr(p, table).to_pylist()
+                 for p in spec0.partitions]
+    order_vals = [(cpu_eval.eval_expr(o.expr, table).to_pylist(),
+                   o.ascending, o.nulls_first) for o in spec0.orders]
+
+    groups = {}
+    for i in range(n):
+        key = tuple(_hashable(pv[i]) for pv in part_vals)
+        groups.setdefault(key, []).append(i)
+
+    def row_cmp(i, j):
+        for vals, asc, nulls_first in order_vals:
+            a, b = vals[i], vals[j]
+            a_null, b_null = a is None, b is None
+            if a_null or b_null:
+                if a_null and b_null:
+                    continue
+                first = -1 if nulls_first else 1
+                return first if a_null else -first
+            c = _cmp_vals(a, b)
+            if c:
+                return c if asc else -c
+        return 0
+
+    for key in groups:
+        groups[key].sort(key=functools.cmp_to_key(row_cmp))
+
+    out_arrays = []
+    for alias in window_exprs:
+        wexpr: we.WindowExpression = alias.children[0]
+        fn = wexpr.function
+        frame = wexpr.spec.frame
+        result = [None] * n
+
+        inp_vals = None
+        if isinstance(fn, (we.Lead,)):
+            inp_vals = cpu_eval.eval_expr(fn.input, table).to_pylist()
+            default_vals = (cpu_eval.eval_expr(fn.default, table).to_pylist()
+                            if fn.default is not None else [None] * n)
+        elif not isinstance(fn, we.WindowFunction) and fn.input is not None:
+            inp_vals = cpu_eval.eval_expr(fn.input, table).to_pylist()
+
+        for key, idxs in groups.items():
+            m = len(idxs)
+            # peer runs (for rank-family and default RANGE frame)
+            peer_start = [0] * m
+            peer_end = [0] * m
+            s = 0
+            for p in range(m):
+                if p > 0 and row_cmp(idxs[p - 1], idxs[p]) != 0:
+                    s = p
+                peer_start[p] = s
+            e = m - 1
+            for p in range(m - 1, -1, -1):
+                if p < m - 1 and row_cmp(idxs[p], idxs[p + 1]) != 0:
+                    e = p
+                peer_end[p] = e
+
+            if isinstance(fn, we.RowNumber):
+                for p, i in enumerate(idxs):
+                    result[i] = p + 1
+            elif isinstance(fn, we.Rank):
+                for p, i in enumerate(idxs):
+                    result[i] = peer_start[p] + 1
+            elif isinstance(fn, we.DenseRank):
+                d = 0
+                for p, i in enumerate(idxs):
+                    if p == 0 or row_cmp(idxs[p - 1], i) != 0:
+                        d += 1
+                    result[i] = d
+            elif isinstance(fn, we.PercentRank):
+                for p, i in enumerate(idxs):
+                    result[i] = (peer_start[p] / (m - 1)) if m > 1 else 0.0
+            elif isinstance(fn, we.CumeDist):
+                for p, i in enumerate(idxs):
+                    result[i] = (peer_end[p] + 1) / m
+            elif isinstance(fn, we.NTile):
+                q, r = divmod(m, fn.n)
+                for p, i in enumerate(idxs):
+                    if p < r * (q + 1):
+                        result[i] = p // (q + 1) + 1
+                    else:
+                        result[i] = r + (p - r * (q + 1)) // max(q, 1) + 1
+            elif isinstance(fn, we.Lead):
+                for p, i in enumerate(idxs):
+                    t = p + fn.offset
+                    result[i] = (inp_vals[idxs[t]] if 0 <= t < m
+                                 else default_vals[i])
+            else:
+                # aggregate over frames
+                for p, i in enumerate(idxs):
+                    lo, hi = _frame_bounds(frame, p, m, peer_start,
+                                           peer_end, order_vals, idxs)
+                    vals = []
+                    if fn.input is None:
+                        count_star = max(0, hi - lo + 1)
+                    else:
+                        vals = [inp_vals[idxs[t]]
+                                for t in range(max(lo, 0),
+                                               min(hi, m - 1) + 1)
+                                if inp_vals[idxs[t]] is not None] \
+                            if hi >= lo else []
+                    if isinstance(fn, Count):
+                        result[i] = (count_star if fn.input is None
+                                     else len(vals))
+                    elif isinstance(fn, Sum):
+                        result[i] = _pysum(vals) if vals else None
+                    elif isinstance(fn, Average):
+                        result[i] = (float(_pysum(vals)) / len(vals)
+                                     if vals else None)
+                    elif isinstance(fn, Min):
+                        result[i] = _pymin(vals) if vals else None
+                    elif isinstance(fn, Max):
+                        result[i] = _pymax(vals) if vals else None
+                    elif isinstance(fn, First):
+                        if fn.ignore_nulls:
+                            result[i] = vals[0] if vals else None
+                        else:
+                            result[i] = (inp_vals[idxs[lo]] if hi >= lo
+                                         else None)
+                    else:
+                        raise NotImplementedError(type(fn).__name__)
+        out_arrays.append(pa.array(result,
+                                   type=to_arrow_type(wexpr.dtype)))
+
+    result_table = table
+    for alias, arr in zip(window_exprs, out_arrays):
+        result_table = result_table.append_column(alias.name, arr)
+    return result_table
+
+
+def _frame_bounds(frame, p, m, peer_start, peer_end, order_vals, idxs):
+    if frame is None:
+        if order_vals:
+            return 0, peer_end[p]
+        return 0, m - 1
+    if frame.frame_type == "rows":
+        lo = 0 if frame.lower is None else max(0, p + frame.lower)
+        hi = m - 1 if frame.upper is None else min(m - 1, p + frame.upper)
+        return lo, hi
+    # range: with a descending key, "preceding" rows hold LARGER values —
+    # the frame interval is [v - upper, v - lower] instead of
+    # [v + lower, v + upper]
+    vals, asc, _nf = order_vals[0]
+    v = vals[idxs[p]]
+    if frame.lower is None:
+        lo = 0
+    elif frame.lower == 0:
+        lo = peer_start[p]
+    elif v is None:
+        lo = peer_start[p]
+    else:
+        lo = m
+        for t in range(m):
+            tv = vals[idxs[t]]
+            if tv is None:
+                continue
+            if (tv >= v + frame.lower) if asc else (tv <= v - frame.lower):
+                lo = t
+                break
+    if frame.upper is None:
+        hi = m - 1
+    elif frame.upper == 0:
+        hi = peer_end[p]
+    elif v is None:
+        hi = peer_end[p]
+    else:
+        hi = -1
+        for t in range(m - 1, -1, -1):
+            tv = vals[idxs[t]]
+            if tv is None:
+                continue
+            if (tv <= v + frame.upper) if asc else (tv >= v - frame.upper):
+                hi = t
+                break
+    return lo, hi
+
+
+def _hashable(v):
+    if isinstance(v, float) and math.isnan(v):
+        return "__nan__"
+    if isinstance(v, float) and v == 0.0:
+        return 0.0  # -0.0 folds into +0.0
+    return v
+
+
+def _pysum(vals):
+    total = vals[0]
+    for v in vals[1:]:
+        total = total + v
+    return total
+
+
+def _pymin(vals):
+    best = vals[0]
+    for v in vals[1:]:
+        if _cmp_vals(v, best) < 0:
+            best = v
+    return best
+
+
+def _pymax(vals):
+    best = vals[0]
+    for v in vals[1:]:
+        if _cmp_vals(v, best) > 0:
+            best = v
+    return best

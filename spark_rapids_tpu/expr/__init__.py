@@ -27,3 +27,7 @@ from spark_rapids_tpu.expr.aggregates import (  # noqa: F401
     AggregateFunction, Sum, Count, Min, Max, Average, First,
 )
 from spark_rapids_tpu.expr.hashexpr import Murmur3Hash  # noqa: F401
+from spark_rapids_tpu.expr.windows import (  # noqa: F401
+    CumeDist, DenseRank, Lag, Lead, NTile, PercentRank, Rank, RowNumber,
+    WindowExpression, WindowFrame, WindowSpecDef,
+)

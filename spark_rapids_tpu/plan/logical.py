@@ -200,6 +200,28 @@ class Sort(LogicalPlan):
         return f"Sort global={self.global_sort}"
 
 
+class Window(LogicalPlan):
+    """Appends window-function columns; all exprs share one
+    (partitionBy, orderBy) sort pass (reference GpuWindowExec contract:
+    window operators preserve input rows and add result columns)."""
+
+    def __init__(self, window_exprs: List[Expression], child: LogicalPlan):
+        super().__init__([child])
+        self.window_exprs = window_exprs  # List[Alias(WindowExpression)]
+
+    @property
+    def schema(self):
+        from spark_rapids_tpu.sqltypes import StructField, StructType
+
+        base = self.children[0].schema
+        extra = [StructField(a.name, a.dtype, a.nullable)
+                 for a in self.window_exprs]
+        return StructType(list(base.fields) + extra)
+
+    def _node_string(self):
+        return f"Window [{', '.join(a.name for a in self.window_exprs)}]"
+
+
 class Limit(LogicalPlan):
     def __init__(self, n: int, child: LogicalPlan):
         super().__init__([child])

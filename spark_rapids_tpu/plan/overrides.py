@@ -88,12 +88,63 @@ class TpuOverrides:
             for o in node.orders:
                 for r in expr_unsupported_reasons(o.expr):
                     meta.cannot_run(r)
+        elif isinstance(node, L.Window):
+            self._tag_window(node, meta)
         elif isinstance(node, L.LocalRelation):
             meta.cannot_run("in-memory relation stays host-side until "
                             "first device operator")
         meta.children = [self.tag(c) for c in node.children]
         self.metas.append(meta)
         return meta
+
+    def _tag_window(self, node: "L.Window", meta: PlanMeta):
+        from spark_rapids_tpu.expr import windows as we
+        from spark_rapids_tpu.expr.aggregates import (
+            Average, Count, First, Max, Min, Sum,
+        )
+        from spark_rapids_tpu.sqltypes import NumericType, StringType
+
+        supported_aggs = (Sum, Count, Min, Max, Average, First)
+        for a in node.window_exprs:
+            wexpr = a.children[0]
+            for e in wexpr.spec.partitions:
+                for r in expr_unsupported_reasons(e):
+                    meta.cannot_run(r)
+            for o in wexpr.spec.orders:
+                for r in expr_unsupported_reasons(o.expr):
+                    meta.cannot_run(r)
+            fn = wexpr.function
+            if isinstance(fn, we.WindowFunction):
+                if fn.needs_order and not wexpr.spec.orders:
+                    meta.cannot_run(
+                        f"{type(fn).__name__} requires ORDER BY")
+                if isinstance(fn, we.Lead):
+                    for r in expr_unsupported_reasons(fn.input):
+                        meta.cannot_run(r)
+                    if fn.default is not None:
+                        for r in expr_unsupported_reasons(fn.default):
+                            meta.cannot_run(r)
+            elif isinstance(fn, supported_aggs):
+                if fn.input is not None:
+                    for r in expr_unsupported_reasons(fn.input):
+                        meta.cannot_run(r)
+                if (isinstance(fn, (Min, Max)) and
+                        isinstance(fn.input.dtype, StringType)):
+                    meta.cannot_run(
+                        "string min/max over window frames runs on CPU")
+            else:
+                meta.cannot_run(f"window function {type(fn).__name__} "
+                                "has no device implementation")
+            frame = wexpr.spec.frame
+            if (frame is not None and frame.frame_type == "range" and
+                    (frame.lower not in (None, 0) or
+                     frame.upper not in (None, 0))):
+                orders = wexpr.spec.orders
+                if (len(orders) != 1 or not orders[0].ascending or
+                        not isinstance(orders[0].expr.dtype, NumericType)):
+                    meta.cannot_run(
+                        "RANGE frame offsets need one ascending numeric "
+                        "ORDER BY key on device")
 
     # ----- conversion -----
 
@@ -157,6 +208,8 @@ class TpuOverrides:
             return self._convert_join(node, children, on_device)
         if isinstance(node, L.Sort):
             return self._convert_sort(node, children[0], on_device)
+        if isinstance(node, L.Window):
+            return self._convert_window(node, children[0], on_device)
         if isinstance(node, L.Limit):
             return self._convert_limit(node, children[0], on_device)
         if isinstance(node, L.Union):
@@ -277,6 +330,24 @@ class TpuOverrides:
             # partitioning + out-of-core merge is the planned upgrade.
             child = ops.TpuShuffleExchangeExec(child, None, 1, conf)
         return ops.TpuSortExec(node.orders, child, conf)
+
+    def _convert_window(self, node: "L.Window", child: PhysicalPlan,
+                        on_device: bool) -> PhysicalPlan:
+        conf = self.conf
+        if not on_device:
+            return ops.CpuWindowExec(
+                node.window_exprs, self._single(self._to_host(child)),
+                node.schema, conf)
+        child = self._to_device(child)
+        spec = node.window_exprs[0].children[0].spec
+        if child.num_partitions > 1:
+            if spec.partitions:
+                child = ops.TpuShuffleExchangeExec(
+                    child, spec.partitions,
+                    conf.get(rc.SHUFFLE_PARTITIONS), conf)
+            else:
+                child = ops.TpuShuffleExchangeExec(child, None, 1, conf)
+        return ops.TpuWindowExec(node.window_exprs, child, conf)
 
     def _convert_limit(self, node: L.Limit, child: PhysicalPlan,
                        on_device: bool) -> PhysicalPlan:

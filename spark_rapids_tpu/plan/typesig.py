@@ -64,6 +64,13 @@ def expr_unsupported_reasons(expr: Expression) -> List[str]:
     reasons: List[str] = []
 
     from spark_rapids_tpu.expr.aggregates import AggregateFunction
+    from spark_rapids_tpu.expr.windows import (
+        WindowExpression,
+        WindowFunction,
+    )
+
+    operator_evaluated = (AggregateFunction, WindowFunction,
+                          WindowExpression)
 
     def walk(e: Expression):
         r = type_supported(e.dtype)
@@ -75,7 +82,7 @@ def expr_unsupported_reasons(expr: Expression) -> List[str]:
             if r:
                 reasons.append(r)
         if (type(e).eval is Expression.eval and not isinstance(e, Literal)
-                and not isinstance(e, AggregateFunction)):
+                and not isinstance(e, operator_evaluated)):
             reasons.append(
                 f"{type(e).__name__} has no device implementation")
         for c in e.children:

@@ -29,6 +29,7 @@ SORT_TIME = "sortTime"
 AGG_TIME = "aggTime"
 FILTER_TIME = "filterTime"
 PARTITION_TIME = "partitionTime"
+WINDOW_TIME = "windowTime"
 
 
 class TpuMetric:

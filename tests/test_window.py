@@ -1,0 +1,326 @@
+"""Window function differential tests (reference:
+integration_tests/src/main/python/window_function_test.py pattern —
+same query on device and CPU-oracle sessions, diff results)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.window import Window
+from spark_rapids_tpu.testing.asserts import (
+    assert_tpu_and_cpu_are_equal_collect,
+)
+
+
+def _table(n=500, seed=0, with_nulls=True):
+    rng = np.random.default_rng(seed)
+    cat = rng.integers(0, 7, n)
+    val = rng.integers(-50, 50, n).astype("int64")
+    ts = rng.permutation(n).astype("int64")  # unique -> deterministic order
+    amt = rng.random(n) * 100.0
+    val_mask = rng.random(n) < 0.15 if with_nulls else np.zeros(n, bool)
+    return pa.table({
+        "cat": pa.array(cat, type=pa.int64()),
+        "ts": pa.array(ts, type=pa.int64()),
+        "val": pa.array(val, type=pa.int64(), mask=val_mask),
+        "amt": pa.array(amt, type=pa.float64()),
+    })
+
+
+def _df(spark, **kw):
+    return spark.createDataFrame(_table(**kw))
+
+
+def test_row_number_rank_dense_rank():
+    w = Window.partitionBy("cat").orderBy("ts")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: _df(spark).select(
+            "cat", "ts",
+            F.row_number().over(w).alias("rn"),
+            F.rank().over(w).alias("rk"),
+            F.dense_rank().over(w).alias("drk")))
+
+
+def test_rank_with_ties():
+    # order by a low-cardinality key -> real peer groups
+    w = Window.partitionBy("cat").orderBy("val")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: _df(spark, with_nulls=False).select(
+            "cat", "val",
+            F.rank().over(w).alias("rk"),
+            F.dense_rank().over(w).alias("drk"),
+            F.percent_rank().over(w).alias("prk"),
+            F.cume_dist().over(w).alias("cd")))
+
+
+def test_ntile():
+    w = Window.partitionBy("cat").orderBy("ts")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: _df(spark).select(
+            "cat", "ts", F.ntile(4).over(w).alias("q")))
+
+
+def test_lead_lag():
+    w = Window.partitionBy("cat").orderBy("ts")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: _df(spark).select(
+            "cat", "ts", "val",
+            F.lead("val", 1).over(w).alias("nxt"),
+            F.lag("val", 2).over(w).alias("prv"),
+            F.lead("val", 1, default=-999).over(w).alias("nxt_d"),
+            F.lag("amt", 1).over(w).alias("prv_amt")))
+
+
+def test_running_aggregates():
+    # default frame with ORDER BY: range unbounded preceding..current row
+    w = Window.partitionBy("cat").orderBy("ts")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: _df(spark).select(
+            "cat", "ts", "val",
+            F.sum("val").over(w).alias("run_sum"),
+            F.count("val").over(w).alias("run_cnt"),
+            F.min("val").over(w).alias("run_min"),
+            F.max("val").over(w).alias("run_max"),
+            F.avg("amt").over(w).alias("run_avg")))
+
+
+def test_running_aggregates_with_peer_ties():
+    # low-cardinality order key: the default RANGE frame includes full
+    # peer runs — a real semantic difference from ROWS
+    w = Window.partitionBy("cat").orderBy("val")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: _df(spark, with_nulls=False).select(
+            "cat", "val",
+            F.sum("amt").over(w).alias("run_sum"),
+            F.count("*").over(w).alias("run_cnt")))
+
+
+def test_whole_partition_aggregate():
+    w = Window.partitionBy("cat")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: _df(spark).select(
+            "cat", "val",
+            F.sum("val").over(w).alias("part_sum"),
+            F.max("amt").over(w).alias("part_max"),
+            F.count("*").over(w).alias("part_cnt")))
+
+
+@pytest.mark.parametrize("lo,hi", [(-2, 2), (-3, 0), (0, 3),
+                                   (Window.unboundedPreceding, 1),
+                                   (-1, Window.unboundedFollowing)])
+def test_rows_frames(lo, hi):
+    w = Window.partitionBy("cat").orderBy("ts").rowsBetween(lo, hi)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: _df(spark).select(
+            "cat", "ts", "val",
+            F.sum("val").over(w).alias("s"),
+            F.min("val").over(w).alias("mn"),
+            F.max("val").over(w).alias("mx"),
+            F.count("val").over(w).alias("c"),
+            F.avg("amt").over(w).alias("a")))
+
+
+def test_range_frame_value_offsets():
+    w = Window.partitionBy("cat").orderBy("val").rangeBetween(-10, 10)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: _df(spark).select(
+            "cat", "val",
+            F.sum("amt").over(w).alias("s"),
+            F.count("amt").over(w).alias("c"),
+            F.min("val").over(w).alias("mn")))
+
+
+def test_range_frame_double_key():
+    w = Window.partitionBy("cat").orderBy("amt").rangeBetween(-25.0, 25.0)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: _df(spark, with_nulls=False).select(
+            "cat", "amt",
+            F.count("*").over(w).alias("c"),
+            F.sum("amt").over(w).alias("s")))
+
+
+def test_desc_order():
+    from spark_rapids_tpu.api.functions import col
+
+    w = Window.partitionBy("cat").orderBy(col("ts").desc())
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: _df(spark).select(
+            "cat", "ts",
+            F.row_number().over(w).alias("rn"),
+            F.sum("val").over(w).alias("s")))
+
+
+def test_no_partition_by():
+    w = Window.orderBy("ts")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: _df(spark, n=200).select(
+            "ts", F.row_number().over(w).alias("rn"),
+            F.sum("val").over(w).alias("s")))
+
+
+def test_multiple_specs_in_one_select():
+    w1 = Window.partitionBy("cat").orderBy("ts")
+    w2 = Window.partitionBy("val").orderBy("ts")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: _df(spark).select(
+            "cat", "ts", "val",
+            F.row_number().over(w1).alias("rn_cat"),
+            F.count("*").over(w2).alias("cnt_val")))
+
+
+def test_window_then_filter():
+    w = Window.partitionBy("cat").orderBy("ts")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: _df(spark)
+        .withColumn("rn", F.row_number().over(w))
+        .filter(F.col("rn") <= 3))
+
+
+def test_string_min_max_falls_back():
+    from spark_rapids_tpu.testing.asserts import assert_tpu_fallback_collect
+
+    w = Window.partitionBy("cat")
+
+    def q(spark):
+        t = pa.table({
+            "cat": pa.array([1, 1, 2, 2, 3], type=pa.int64()),
+            "s": pa.array(["b", "a", "z", "x", "m"]),
+        })
+        return spark.createDataFrame(t).select(
+            "cat", F.min("s").over(w).alias("mn"))
+
+    assert_tpu_fallback_collect(q, "CpuWindowExec")
+
+
+def test_first_value_over_window():
+    w = Window.partitionBy("cat").orderBy("ts")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: _df(spark).select(
+            "cat", "ts",
+            F.first("val").over(w).alias("fv")))
+
+
+def test_range_frame_nulls_last():
+    from spark_rapids_tpu.api.functions import col
+
+    w = (Window.partitionBy("cat").orderBy(col("val").asc_nulls_last())
+         .rangeBetween(-2, 2))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: _df(spark).select(
+            "cat", "val", F.sum("val").over(w).alias("s"),
+            F.count("val").over(w).alias("c")))
+
+
+def test_range_frame_desc_cpu_oracle_semantics():
+    # desc RANGE offsets fall back to CpuWindowExec; check Spark truth
+    from spark_rapids_tpu.api.functions import col
+    from spark_rapids_tpu.testing.asserts import with_tpu_session
+
+    w = (Window.partitionBy("cat").orderBy(col("v").desc())
+         .rangeBetween(-2, 2))
+
+    def q(spark):
+        t = pa.table({"cat": pa.array([1, 1, 1, 1], type=pa.int64()),
+                      "v": pa.array([1, 3, 7, 9], type=pa.int64()),
+                      "amt": pa.array([1.0, 2.0, 4.0, 6.0])})
+        return (spark.createDataFrame(t)
+                .select("v", F.sum("amt").over(w).alias("s"))
+                .orderBy("v"))
+
+    out = with_tpu_session(lambda s: q(s).collect_arrow())
+    assert out.column("s").to_pylist() == [3.0, 3.0, 10.0, 10.0]
+
+
+def test_fractional_range_bounds():
+    w = Window.partitionBy("cat").orderBy("amt").rangeBetween(-0.5, 0.5)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: _df(spark, with_nulls=False).select(
+            "cat", "amt", F.count("*").over(w).alias("c")))
+
+
+def test_negative_zero_order_key():
+    w = Window.orderBy("x")
+
+    def q(spark):
+        t = pa.table({"x": pa.array([-0.0, 0.0, 1.0], type=pa.float64())})
+        return spark.createDataFrame(t).select(
+            "x", F.rank().over(w).alias("rk"))
+
+    assert_tpu_and_cpu_are_equal_collect(q, ignore_order=False)
+
+
+def test_window_in_filter_rejected():
+    from spark_rapids_tpu.testing.asserts import with_tpu_session
+
+    w = Window.partitionBy("cat").orderBy("ts")
+
+    def q(spark):
+        df = _df(spark, n=50)
+        try:
+            df.filter(F.row_number().over(w) <= 1)
+        except ValueError as e:
+            return str(e)
+        return None
+
+    msg = with_tpu_session(q)
+    assert msg and "window functions are not allowed" in msg
+
+
+def test_nan_min_max_over_frames():
+    w = Window.partitionBy("cat").orderBy("ts").rowsBetween(-10, 10)
+
+    def q(spark):
+        t = pa.table({
+            "cat": pa.array([1, 1, 1, 2, 2], type=pa.int64()),
+            "ts": pa.array([1, 2, 3, 1, 2], type=pa.int64()),
+            "v": pa.array([1.0, float("nan"), 3.0, float("nan"),
+                           float("nan")]),
+        })
+        return spark.createDataFrame(t).select(
+            "cat", "ts",
+            F.min("v").over(w).alias("mn"),
+            F.max("v").over(w).alias("mx"))
+
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_float_range_offsets_over_int_key():
+    w = (Window.partitionBy("cat").orderBy("val")
+         .rangeBetween(-1.5, 1.5))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: _df(spark, with_nulls=False).select(
+            "cat", "val", F.count("*").over(w).alias("c")))
+
+
+def test_negative_lag_is_lead():
+    w = Window.partitionBy("cat").orderBy("ts")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: _df(spark).select(
+            "cat", "ts",
+            F.lag("val", -1).over(w).alias("a"),
+            F.lead("val", 1).over(w).alias("b")))
+
+
+def test_range_frame_without_order_rejected():
+    import pytest as _pytest
+
+    w = Window.partitionBy("cat").rangeBetween(0, 0)
+    with _pytest.raises(ValueError, match="requires\\s+ORDER BY"):
+        F.sum("val").over(w)
+
+
+def test_window_in_orderby_rejected():
+    from spark_rapids_tpu.testing.asserts import with_tpu_session
+
+    w = Window.partitionBy("cat").orderBy("ts")
+
+    def q(spark):
+        df = _df(spark, n=50)
+        try:
+            df.orderBy(F.row_number().over(w))
+        except ValueError as e:
+            return str(e)
+        return None
+
+    assert "not allowed in orderBy" in with_tpu_session(q)
