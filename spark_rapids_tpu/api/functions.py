@@ -196,3 +196,175 @@ class WhenBuilder(Column):
 
     def otherwise(self, value) -> Column:
         return Column(CaseWhen(self._branches, expr_of(lit_or(value))))
+
+
+# --- math / bitwise (reference arithmetic.scala + mathExpressions rules) ---
+
+def _u(cls):
+    def f(c):
+        return Column(cls(expr_of(c)))
+    f.__name__ = cls.__name__.lower()
+    return f
+
+
+from spark_rapids_tpu.expr import (  # noqa: E402
+    Acos, Acosh, Ascii, Asin, Asinh, Atan, Atan2, Atanh, BitwiseNot, BRound,
+    Cbrt, Ceil, Chr, ConcatWs, Cos, Cosh, Cot, Exp, Expm1, Floor, Greatest,
+    Hex, Hypot, InitCap, Least, Log, Log10, Log1p, Log2, Logarithm, NaNvl,
+    Nvl2, Pow, Rint, Round, ShiftLeft, ShiftRight, ShiftRightUnsigned,
+    Signum, Sin, Sinh, Sqrt, StringInstr, StringLocate, StringLPad,
+    StringRepeat, StringReplace, StringReverse, StringRPad, StringTranslate,
+    StringTrim, StringTrimLeft, StringTrimRight, SubstringIndex, Tan, Tanh,
+    ToDegrees, ToRadians, XxHash64,
+)
+
+sqrt = _u(Sqrt)
+exp = _u(Exp)
+expm1 = _u(Expm1)
+cbrt = _u(Cbrt)
+rint = _u(Rint)
+signum = _u(Signum)
+sin = _u(Sin)
+cos = _u(Cos)
+tan = _u(Tan)
+cot = _u(Cot)
+asin = _u(Asin)
+acos = _u(Acos)
+atan = _u(Atan)
+sinh = _u(Sinh)
+cosh = _u(Cosh)
+tanh = _u(Tanh)
+asinh = _u(Asinh)
+acosh = _u(Acosh)
+atanh = _u(Atanh)
+degrees = _u(ToDegrees)
+radians = _u(ToRadians)
+log10 = _u(Log10)
+log2 = _u(Log2)
+log1p = _u(Log1p)
+bitwise_not = _u(BitwiseNot)
+bitwiseNOT = bitwise_not
+hex = _u(Hex)  # noqa: A001
+ascii = _u(Ascii)  # noqa: A001
+initcap = _u(InitCap)
+reverse = _u(StringReverse)
+ltrim = _u(StringTrimLeft)
+rtrim = _u(StringTrimRight)
+trim = _u(StringTrim)
+
+
+def log(arg1, arg2=None) -> Column:
+    """log(col) = natural log; log(base, col) = log base."""
+    if arg2 is None:
+        return Column(Log(expr_of(arg1)))
+    return Column(Logarithm(expr_of(lit_or(arg1)), expr_of(arg2)))
+
+
+def pow(base, exponent) -> Column:  # noqa: A001
+    return Column(Pow(expr_of(lit_or(base)), expr_of(lit_or(exponent))))
+
+
+def atan2(y, x) -> Column:
+    return Column(Atan2(expr_of(lit_or(y)), expr_of(lit_or(x))))
+
+
+def hypot(a, b) -> Column:
+    return Column(Hypot(expr_of(lit_or(a)), expr_of(lit_or(b))))
+
+
+def round(c, scale: int = 0) -> Column:  # noqa: A001
+    return Column(Round(expr_of(c), scale))
+
+
+def bround(c, scale: int = 0) -> Column:
+    return Column(BRound(expr_of(c), scale))
+
+
+def ceil(c) -> Column:
+    return Column(Ceil(expr_of(c)))
+
+
+def floor(c) -> Column:
+    return Column(Floor(expr_of(c)))
+
+
+def shiftleft(c, n: int) -> Column:
+    return Column(ShiftLeft(expr_of(c), expr_of(lit(n))))
+
+
+def shiftright(c, n: int) -> Column:
+    return Column(ShiftRight(expr_of(c), expr_of(lit(n))))
+
+
+def shiftrightunsigned(c, n: int) -> Column:
+    return Column(ShiftRightUnsigned(expr_of(c), expr_of(lit(n))))
+
+
+def greatest(*cs) -> Column:
+    return Column(Greatest(*[expr_of(c) for c in cs]))
+
+
+def least(*cs) -> Column:
+    return Column(Least(*[expr_of(c) for c in cs]))
+
+
+def nvl(a, b) -> Column:
+    return Column(Coalesce(expr_of(a), expr_of(lit_or(b))))
+
+
+def nvl2(a, b, c) -> Column:
+    return Column(Nvl2(expr_of(a), expr_of(lit_or(b)), expr_of(lit_or(c))))
+
+
+def nanvl(a, b) -> Column:
+    return Column(NaNvl(expr_of(a), expr_of(lit_or(b))))
+
+
+def xxhash64(*cs) -> Column:
+    return Column(XxHash64(*[expr_of(c) for c in cs]))
+
+
+# --- string breadth ---
+
+def lpad(c, length: int, pad: str = " ") -> Column:
+    return Column(StringLPad(expr_of(c), length, pad))
+
+
+def rpad(c, length: int, pad: str = " ") -> Column:
+    return Column(StringRPad(expr_of(c), length, pad))
+
+
+def repeat(c, n: int) -> Column:
+    return Column(StringRepeat(expr_of(c), n))
+
+
+def instr(c, substr: str) -> Column:
+    return Column(StringInstr(expr_of(c), substr))
+
+
+def locate(substr: str, c, pos: int = 1) -> Column:
+    return Column(StringLocate(expr_of(c), substr, pos))
+
+
+def translate(c, matching: str, replace: str) -> Column:
+    return Column(StringTranslate(expr_of(c), matching, replace))
+
+
+def regexp_replace_literal(c, search: str, replacement: str) -> Column:
+    """Literal (non-regex) replace — Spark's `replace`."""
+    return Column(StringReplace(expr_of(c), search, replacement))
+
+
+replace = regexp_replace_literal
+
+
+def concat_ws(sep: str, *cs) -> Column:
+    return Column(ConcatWs(sep, *[expr_of(c) for c in cs]))
+
+
+def chr_(c) -> Column:
+    return Column(Chr(expr_of(lit_or(c))))
+
+
+def substring_index(c, delim: str, count: int) -> Column:
+    return Column(SubstringIndex(expr_of(c), delim, count))

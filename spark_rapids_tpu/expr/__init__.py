@@ -9,16 +9,26 @@ from spark_rapids_tpu.expr.arith import (  # noqa: F401
     Add, Subtract, Multiply, Divide, IntegralDivide, Remainder, Pmod,
     UnaryMinus, Abs,
 )
+from spark_rapids_tpu.expr.mathexpr import (  # noqa: F401
+    Acos, Acosh, Asin, Asinh, Atan, Atan2, Atanh, BitwiseAnd, BitwiseNot,
+    BitwiseOr, BitwiseXor, BRound, Cbrt, Ceil, Cos, Cosh, Cot, Exp, Expm1,
+    Floor, Hex, Hypot, Log, Log10, Log1p, Log2, Logarithm, Pow, Rint,
+    Round, ShiftLeft, ShiftRight, ShiftRightUnsigned, Signum, Sin, Sinh,
+    Sqrt, Tan, Tanh, ToDegrees, ToRadians,
+)
 from spark_rapids_tpu.expr.predicates import (  # noqa: F401
     EqualTo, EqualNullSafe, LessThan, LessThanOrEqual, GreaterThan,
     GreaterThanOrEqual, And, Or, Not, IsNull, IsNotNull, IsNaN, In,
 )
 from spark_rapids_tpu.expr.conditional import (  # noqa: F401
-    If, CaseWhen, Coalesce,
+    If, CaseWhen, Coalesce, Greatest, Least, NaNvl, Nvl2,
 )
 from spark_rapids_tpu.expr.cast import Cast  # noqa: F401
 from spark_rapids_tpu.expr.strings import (  # noqa: F401
-    Length, Upper, Lower, Substring, Concat, StartsWith, EndsWith, Contains,
+    Ascii, Chr, Concat, ConcatWs, Contains, EndsWith, InitCap, Length,
+    Lower, StartsWith, StringInstr, StringLocate, StringLPad, StringRepeat,
+    StringReplace, StringReverse, StringRPad, StringTranslate, StringTrim,
+    StringTrimLeft, StringTrimRight, Substring, SubstringIndex, Upper,
 )
 from spark_rapids_tpu.expr.datetimes import (  # noqa: F401
     Year, Month, DayOfMonth, Hour, Minute, Second,
@@ -26,7 +36,7 @@ from spark_rapids_tpu.expr.datetimes import (  # noqa: F401
 from spark_rapids_tpu.expr.aggregates import (  # noqa: F401
     AggregateFunction, Sum, Count, Min, Max, Average, First,
 )
-from spark_rapids_tpu.expr.hashexpr import Murmur3Hash  # noqa: F401
+from spark_rapids_tpu.expr.hashexpr import Murmur3Hash, XxHash64  # noqa: F401
 from spark_rapids_tpu.expr.windows import (  # noqa: F401
     CumeDist, DenseRank, Lag, Lead, NTile, PercentRank, Rank, RowNumber,
     WindowExpression, WindowFrame, WindowSpecDef,

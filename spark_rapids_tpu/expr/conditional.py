@@ -132,3 +132,130 @@ class Coalesce(Expression):
         for c in cols[1:]:
             out = _select(out.validity, out, c)
         return out
+
+
+class Greatest(Expression):
+    """greatest(...): max skipping nulls; NaN is greatest (Spark
+    ordering); null only when all inputs are null."""
+
+    _is_greatest = True
+
+    def __init__(self, *exprs):
+        super().__init__(list(exprs))
+
+    @property
+    def dtype(self):
+        from spark_rapids_tpu.sqltypes.datatypes import numeric_promotion
+
+        t = self.children[0].dtype
+        for c in self.children[1:]:
+            t = numeric_promotion(t, c.dtype)
+        return t
+
+    def eval(self, ctx):
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.sqltypes import DoubleType, FloatType
+
+        out_t = self.dtype
+        cols = [c.eval(ctx) for c in self.children]
+        is_float = isinstance(out_t, (FloatType, DoubleType))
+        datas = [c.data.astype(out_t.np_dtype) for c in cols]
+        valids = [c.validity for c in cols]
+        any_valid = valids[0]
+        for v in valids[1:]:
+            any_valid = any_valid | v
+        if is_float:
+            # Spark orders NaN greatest: greatest() is NaN iff ANY valid
+            # input is NaN; least() is NaN iff ALL valid inputs are NaN.
+            inf = jnp.asarray(jnp.inf, out_t.np_dtype)
+            neutral = -inf if self._is_greatest else inf
+            acc = jnp.full(datas[0].shape, neutral, out_t.np_dtype)
+            any_nan = jnp.zeros(datas[0].shape, bool)
+            all_nan = jnp.ones(datas[0].shape, bool)
+            for d, v in zip(datas, valids):
+                isnan = jnp.isnan(d) & v
+                any_nan = any_nan | isnan
+                all_nan = all_nan & (~v | jnp.isnan(d))
+                key = jnp.where(v & ~isnan, d, neutral)
+                acc = jnp.maximum(acc, key) if self._is_greatest \
+                    else jnp.minimum(acc, key)
+            nan_wins = any_nan if self._is_greatest \
+                else (all_nan & any_valid)
+            acc = jnp.where(nan_wins, jnp.asarray(jnp.nan, out_t.np_dtype),
+                            acc)
+        else:
+            lo = jnp.iinfo(out_t.np_dtype).min
+            hi = jnp.iinfo(out_t.np_dtype).max
+            neutral = lo if self._is_greatest else hi
+            acc = jnp.full(datas[0].shape, neutral, out_t.np_dtype)
+            for d, v in zip(datas, valids):
+                key = jnp.where(v, d, neutral)
+                acc = jnp.maximum(acc, key) if self._is_greatest \
+                    else jnp.minimum(acc, key)
+        from spark_rapids_tpu.columnar.batch import DeviceColumn
+
+        return DeviceColumn(out_t, acc, any_valid)
+
+
+class Least(Greatest):
+    _is_greatest = False
+
+
+class Nvl2(Expression):
+    """nvl2(a, b, c): b when a is not null else c."""
+
+    def __init__(self, a, b, c):
+        super().__init__([a, b, c])
+
+    @property
+    def dtype(self):
+        return self.children[1].dtype
+
+    def eval(self, ctx):
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.columnar.batch import DeviceColumn
+
+        a = self.children[0].eval(ctx)
+        b = self.children[1].eval(ctx)
+        c = self.children[2].eval(ctx)
+        cond = a.validity
+        if b.lengths is not None:
+            mb = max(b.max_bytes, c.max_bytes)
+            bd = jnp.pad(b.data, ((0, 0), (0, mb - b.max_bytes)))
+            cd = jnp.pad(c.data, ((0, 0), (0, mb - c.max_bytes)))
+            data = jnp.where(cond[:, None], bd, cd)
+            lens = jnp.where(cond, b.lengths, c.lengths)
+            return DeviceColumn(self.dtype, data,
+                                jnp.where(cond, b.validity, c.validity),
+                                lens)
+        data = jnp.where(cond, b.data, c.data)
+        return DeviceColumn(self.dtype, data,
+                            jnp.where(cond, b.validity, c.validity))
+
+
+class NaNvl(Expression):
+    """nanvl(a, b): b when a is NaN else a (doubles)."""
+
+    def __init__(self, a, b):
+        super().__init__([a, b])
+
+    @property
+    def dtype(self):
+        from spark_rapids_tpu.sqltypes.datatypes import double
+
+        return double
+
+    def eval(self, ctx):
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.columnar.batch import DeviceColumn
+
+        a = self.children[0].eval(ctx)
+        b = self.children[1].eval(ctx)
+        ad = a.data.astype(jnp.float64)
+        bd = b.data.astype(jnp.float64)
+        isnan = jnp.isnan(ad) & a.validity
+        return DeviceColumn(self.dtype, jnp.where(isnan, bd, ad),
+                            jnp.where(isnan, b.validity, a.validity))

@@ -34,3 +34,33 @@ class Murmur3Hash(Expression):
         cols = [c.eval(ctx) for c in self.children]
         h = murmur3_columns(cols, self.seed)
         return DeviceColumn(integer, h, jnp.ones(h.shape, bool))
+
+
+class XxHash64(Expression):
+    """Spark `xxhash64(...)` (seed 42), long result — reference JNI
+    Hash.xxhash64."""
+
+    def __init__(self, *exprs, seed: int = 42):
+        super().__init__(list(exprs))
+        self.seed = seed
+
+    @property
+    def dtype(self):
+        from spark_rapids_tpu.sqltypes.datatypes import long
+
+        return long
+
+    @property
+    def nullable(self):
+        return False
+
+    def key(self):
+        return ("xxhash64", self.seed,
+                tuple(c.key() for c in self.children))
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.ops.hashing import xxhash64_columns
+
+        cols = [c.eval(ctx) for c in self.children]
+        h = xxhash64_columns(cols, self.seed)
+        return DeviceColumn(self.dtype, h, jnp.ones(h.shape, bool))

@@ -245,3 +245,571 @@ class Contains(Expression):
         in_range = starts <= (c.lengths - nb)[:, None]
         found = (ok_at & in_range).any(axis=1)
         return DeviceColumn(boolean, found, c.validity)
+
+
+# ---------------------------------------------------------------------------
+# Extended string family (reference stringFunctions.scala breadth): trim/pad/
+# repeat/reverse/initcap/instr/locate/translate/replace/concat_ws/ascii/chr/
+# substring_index. All are fixed-shape VPU computations; variable-length
+# outputs use the argsort-compaction idiom (stable sort of ~keep) or
+# per-position gather with computed source indices.
+# ---------------------------------------------------------------------------
+
+from jax import lax as _lax  # noqa: E402
+
+
+def _compact_bytes(data, keep, mb_out=None):
+    """Keep marked bytes, shifted left per row; returns (data, lengths)."""
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    out = jnp.take_along_axis(data, order, axis=1)
+    new_len = keep.sum(axis=1).astype(jnp.int32)
+    mb = data.shape[1]
+    pos = jnp.arange(mb, dtype=jnp.int32)[None, :]
+    out = jnp.where(pos < new_len[:, None], out, 0).astype(jnp.uint8)
+    if mb_out is not None and mb_out != mb:
+        out = out[:, :mb_out] if mb_out < mb else jnp.pad(
+            out, ((0, 0), (0, mb_out - mb)))
+    return out, new_len
+
+
+def _find_candidates(data, lengths, needle: bytes):
+    """[n, mb] bool: a match of `needle` begins at this byte position."""
+    n, mb = data.shape
+    nb = len(needle)
+    if nb == 0 or nb > mb:
+        return jnp.zeros((n, mb), bool)
+    ok_at = jnp.ones((n, mb - nb + 1), bool)
+    for i, byte in enumerate(needle):
+        ok_at = ok_at & (data[:, i:i + mb - nb + 1] == byte)
+    starts = jnp.arange(mb - nb + 1, dtype=jnp.int32)[None, :]
+    ok_at = ok_at & (starts + nb <= lengths[:, None])
+    return jnp.pad(ok_at, ((0, 0), (0, nb - 1))) if nb > 1 else ok_at
+
+
+def _select_nonoverlapping(cand, match_len: int):
+    """Greedy left-to-right non-overlapping match selection (the semantics
+    of repeated indexOf in Java replace/substring_index)."""
+    n, mb = cand.shape
+    positions = jnp.arange(mb, dtype=jnp.int32)
+
+    def step(next_free, xs):
+        c, i = xs
+        sel = c & (i >= next_free)
+        return jnp.where(sel, i + match_len, next_free), sel
+
+    _, sels = _lax.scan(step, jnp.zeros((n,), jnp.int32),
+                        (cand.T, positions))
+    return sels.T
+
+
+class StringTrimBase(Expression):
+    _leading = True
+    _trailing = True
+
+    def __init__(self, child, trim_str: str = " "):
+        super().__init__([child])
+        self.trim_bytes = trim_str.encode("utf-8")
+
+    @property
+    def dtype(self):
+        return string_t
+
+    def key(self):
+        return (type(self).__name__.lower(), self.trim_bytes,
+                self.children[0].key())
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        in_str = _position_mask(c)
+        in_set = jnp.zeros(c.data.shape, bool)
+        for b in set(self.trim_bytes):
+            in_set = in_set | (c.data == b)
+        keep = in_str
+        if self._leading:
+            lead = jnp.cumprod(in_set.astype(jnp.int32), axis=1) > 0
+            keep = keep & ~lead
+        if self._trailing:
+            t = in_set | ~in_str
+            rev = jnp.flip(
+                jnp.cumprod(jnp.flip(t, axis=1).astype(jnp.int32),
+                            axis=1) > 0, axis=1)
+            keep = keep & ~rev
+        data, lens = _compact_bytes(c.data, keep)
+        return DeviceColumn(string_t, data, c.validity, lens)
+
+
+class StringTrim(StringTrimBase):
+    pass
+
+
+class StringTrimLeft(StringTrimBase):
+    _trailing = False
+
+
+class StringTrimRight(StringTrimBase):
+    _leading = False
+
+
+class _PadBase(Expression):
+    """lpad/rpad to `length` characters with an ASCII pad string."""
+
+    def __init__(self, child, length: int, pad: str = " "):
+        super().__init__([child])
+        self.length = int(length)
+        self.pad = pad.encode("utf-8")
+        assert all(b < 0x80 for b in self.pad), "ASCII pad strings only"
+
+    @property
+    def dtype(self):
+        return string_t
+
+    def key(self):
+        return (type(self).__name__.lower(), self.length, self.pad,
+                self.children[0].key())
+
+    def _layout(self, c):
+        target = max(self.length, 0)
+        in_str = _position_mask(c)
+        is_char = in_str & ~_is_continuation(c.data)
+        nchars = is_char.sum(axis=1).astype(jnp.int32)
+        char_idx = jnp.cumsum(is_char.astype(jnp.int32), axis=1) - 1
+        keep = in_str & (char_idx < target)
+        kept_len = keep.sum(axis=1).astype(jnp.int32)
+        npad = jnp.maximum(target - nchars, 0).astype(jnp.int32)
+        mb_out = max(8, 1 << max(0, target + c.max_bytes - 1).bit_length())
+        lp = max(len(self.pad), 1)
+        pos = jnp.arange(mb_out, dtype=jnp.int32)
+        padvec = jnp.asarray(
+            [(self.pad or b" ")[i % lp] for i in range(mb_out)], jnp.uint8)
+        data_wide = jnp.pad(c.data, ((0, 0), (0, mb_out - c.max_bytes))) \
+            if mb_out > c.max_bytes else c.data[:, :mb_out]
+        return target, kept_len, npad, mb_out, pos, padvec, data_wide
+
+
+class StringLPad(_PadBase):
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        (target, kept_len, npad, mb_out, pos, padvec,
+         data_wide) = self._layout(c)
+        src_idx = jnp.clip(pos[None, :] - npad[:, None], 0, mb_out - 1)
+        src = jnp.take_along_axis(data_wide, src_idx.astype(jnp.int64),
+                                  axis=1)
+        out_len = npad + kept_len
+        out = jnp.where(pos[None, :] < npad[:, None], padvec[None, :], src)
+        out = jnp.where(pos[None, :] < out_len[:, None], out, 0)
+        return DeviceColumn(string_t, out.astype(jnp.uint8), c.validity,
+                            out_len)
+
+
+class StringRPad(_PadBase):
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        (target, kept_len, npad, mb_out, pos, padvec,
+         data_wide) = self._layout(c)
+        # pad characters appended after the kept prefix; pad cycle restarts
+        # at the append point (Java StringUtils behavior)
+        pad_idx = jnp.clip(pos[None, :] - kept_len[:, None], 0, mb_out - 1)
+        lp = max(len(self.pad), 1)
+        padmat = jnp.asarray(list(self.pad or b" "), jnp.uint8)[
+            pad_idx % lp]
+        out = jnp.where(pos[None, :] < kept_len[:, None], data_wide, padmat)
+        out_len = kept_len + npad
+        out = jnp.where(pos[None, :] < out_len[:, None], out, 0)
+        return DeviceColumn(string_t, out.astype(jnp.uint8), c.validity,
+                            out_len)
+
+
+class StringRepeat(Expression):
+    def __init__(self, child, times: int):
+        super().__init__([child])
+        self.times = int(times)
+
+    @property
+    def dtype(self):
+        return string_t
+
+    def key(self):
+        return ("repeat", self.times, self.children[0].key())
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        n = max(self.times, 0)
+        if n == 0:
+            cap = c.data.shape[0]
+            return DeviceColumn(string_t, jnp.zeros((cap, 8), jnp.uint8),
+                                c.validity, jnp.zeros((cap,), jnp.int32))
+        mb_out = max(8, 1 << max(0, c.max_bytes * n - 1).bit_length())
+        pos = jnp.arange(mb_out, dtype=jnp.int32)[None, :]
+        safe_len = jnp.maximum(c.lengths, 1)[:, None]
+        src_idx = jnp.clip(pos % safe_len, 0, c.max_bytes - 1)
+        src = jnp.take_along_axis(c.data, src_idx.astype(jnp.int64), axis=1)
+        out_len = (c.lengths * n).astype(jnp.int32)
+        out = jnp.where(pos < out_len[:, None], src, 0).astype(jnp.uint8)
+        return DeviceColumn(string_t, out, c.validity, out_len)
+
+
+class StringReverse(Expression):
+    """Character-aware (UTF-8) reverse."""
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return string_t
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        mb = c.max_bytes
+        in_str = _position_mask(c)
+        is_char = in_str & ~_is_continuation(c.data)
+        nchars = is_char.sum(axis=1).astype(jnp.int32)
+        char_idx = jnp.cumsum(is_char.astype(jnp.int32), axis=1) - 1
+        pos = jnp.arange(mb, dtype=jnp.int32)[None, :]
+        lead_pos = _lax.cummax(jnp.where(is_char, pos, -1), axis=1)
+        within = pos - lead_pos
+        key = (nchars[:, None] - 1 - char_idx) * mb + within
+        key = jnp.where(in_str, key, jnp.int32(1 << 30))
+        order = jnp.argsort(key, axis=1, stable=True)
+        out = jnp.take_along_axis(c.data, order, axis=1)
+        out = jnp.where(pos < c.lengths[:, None], out, 0).astype(jnp.uint8)
+        return DeviceColumn(string_t, out, c.validity, c.lengths)
+
+
+class InitCap(Expression):
+    """Uppercase first letter of each space-delimited word; lowercase the
+    rest (ASCII letters)."""
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return string_t
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        d = c.data
+        prev_space = jnp.concatenate(
+            [jnp.ones((d.shape[0], 1), bool), d[:, :-1] == 0x20], axis=1)
+        is_up = (d >= 0x41) & (d <= 0x5A)
+        is_lo = (d >= 0x61) & (d <= 0x7A)
+        lowered = jnp.where(is_up, d + 32, d)
+        out = jnp.where(prev_space & is_lo, d - 32,
+                        jnp.where(~prev_space, lowered, d))
+        return DeviceColumn(string_t, out.astype(jnp.uint8), c.validity,
+                            c.lengths)
+
+
+class StringInstr(Expression):
+    """instr(str, substr): 1-based char position of first match, 0 if
+    absent, 1 for empty substr."""
+
+    def __init__(self, child, substr: str):
+        super().__init__([child])
+        self.needle = substr.encode("utf-8")
+
+    @property
+    def dtype(self):
+        return integer
+
+    def key(self):
+        return ("instr", self.needle, self.children[0].key())
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        return DeviceColumn(integer, _first_match_charpos(c, self.needle, 0),
+                            c.validity)
+
+
+class StringLocate(Expression):
+    """locate(substr, str, start): like instr but from a 1-based char
+    start; start <= 0 -> 0."""
+
+    def __init__(self, child, substr: str, start: int = 1):
+        super().__init__([child])
+        self.needle = substr.encode("utf-8")
+        self.start = int(start)
+
+    @property
+    def dtype(self):
+        return integer
+
+    def key(self):
+        return ("locate", self.needle, self.start, self.children[0].key())
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        if self.start <= 0:
+            return DeviceColumn(
+                integer, jnp.zeros(c.lengths.shape, jnp.int32), c.validity)
+        r = _first_match_charpos(c, self.needle, self.start - 1)
+        return DeviceColumn(integer, r, c.validity)
+
+
+def _first_match_charpos(c, needle: bytes, min_char: int) -> jnp.ndarray:
+    """1-based char position of first occurrence at char >= min_char."""
+    mb = c.max_bytes
+    in_str = _position_mask(c)
+    is_char = in_str & ~_is_continuation(c.data)
+    char_idx = jnp.cumsum(is_char.astype(jnp.int32), axis=1) - 1
+    nchars = is_char.sum(axis=1).astype(jnp.int32)
+    if len(needle) == 0:
+        hit = jnp.minimum(jnp.int32(min_char), nchars) + 1
+        return jnp.where(min_char <= nchars, hit, 0).astype(jnp.int32)
+    cand = _find_candidates(c.data, c.lengths, needle)
+    cand = cand & (char_idx >= min_char) & is_char
+    found = cand.any(axis=1)
+    first_byte = jnp.argmax(cand, axis=1)
+    first_char = jnp.take_along_axis(
+        char_idx, first_byte[:, None].astype(jnp.int64), axis=1)[:, 0]
+    return jnp.where(found, first_char + 1, 0).astype(jnp.int32)
+
+
+class StringTranslate(Expression):
+    """translate(str, match, replace): per-byte LUT; chars in `match`
+    beyond len(replace) are deleted (ASCII alphabets)."""
+
+    def __init__(self, child, matching: str, replace: str):
+        super().__init__([child])
+        self.matching = matching.encode("utf-8")
+        self.replace = replace.encode("utf-8")
+
+    @property
+    def dtype(self):
+        return string_t
+
+    def key(self):
+        return ("translate", self.matching, self.replace,
+                self.children[0].key())
+
+    def eval(self, ctx):
+        import numpy as _np
+
+        c = self.children[0].eval(ctx)
+        lut = _np.arange(256, dtype=_np.uint8)
+        delete = _np.zeros(256, dtype=bool)
+        seen = set()
+        for i, m in enumerate(self.matching):
+            if m in seen:  # first mapping wins (Spark)
+                continue
+            seen.add(m)
+            if i < len(self.replace):
+                lut[m] = self.replace[i]
+            else:
+                delete[m] = True
+        mapped = jnp.asarray(lut)[c.data.astype(jnp.int32)]
+        in_str = _position_mask(c)
+        keep = in_str & ~jnp.asarray(delete)[c.data.astype(jnp.int32)]
+        data, lens = _compact_bytes(mapped, keep)
+        return DeviceColumn(string_t, data, c.validity, lens)
+
+
+class StringReplace(Expression):
+    """replace(str, search, replacement): all non-overlapping occurrences,
+    leftmost-greedy (Java String.replace)."""
+
+    def __init__(self, child, search: str, replacement: str = ""):
+        super().__init__([child])
+        self.search = search.encode("utf-8")
+        self.replacement = replacement.encode("utf-8")
+
+    @property
+    def dtype(self):
+        return string_t
+
+    def key(self):
+        return ("replace", self.search, self.replacement,
+                self.children[0].key())
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        ls, lr = len(self.search), len(self.replacement)
+        if ls == 0:
+            return c
+        mb = c.max_bytes
+        cand = _find_candidates(c.data, c.lengths, self.search)
+        sel = _select_nonoverlapping(cand, ls)
+        pos = jnp.arange(mb, dtype=jnp.int32)[None, :]
+        # covered[i] = i falls strictly inside a selected match
+        sel_start = jnp.where(sel, pos, -(1 << 20))
+        last_start = _lax.cummax(sel_start, axis=1)
+        covered = (pos < last_start + ls) & (last_start >= 0)
+        in_str = _position_mask(c)
+        emit_n = jnp.where(sel, lr,
+                           jnp.where(covered | ~in_str, 0, 1))
+        offsets = jnp.cumsum(emit_n, axis=1) - emit_n  # exclusive
+        out_len = emit_n.sum(axis=1).astype(jnp.int32)
+        e = max(lr, 1)
+        # emission matrix [n, mb, e]: replacement bytes at selected starts,
+        # the original byte in slot 0 otherwise
+        repl = jnp.asarray(list(self.replacement or b"\x00"), jnp.uint8)
+        slot = jnp.arange(e, dtype=jnp.int32)
+        emat = jnp.where(sel[:, :, None], repl[None, None, :e],
+                         c.data[:, :, None])
+        emask = slot[None, None, :] < emit_n[:, :, None]
+        flat_bytes = emat.reshape(emat.shape[0], mb * e)
+        flat_mask = emask.reshape(emat.shape[0], mb * e)
+        need = mb * max(1, lr)
+        mb_out = max(8, 1 << max(0, need - 1).bit_length())
+        data, lens = _compact_bytes(flat_bytes, flat_mask, mb_out=mb_out)
+        return DeviceColumn(string_t, data, c.validity, lens)
+
+
+class ConcatWs(Expression):
+    """concat_ws(sep, s1, s2, ...): null inputs are skipped; result is
+    non-null (Spark semantics with a literal separator)."""
+
+    def __init__(self, sep: str, *exprs):
+        super().__init__(list(exprs))
+        self.sep = sep.encode("utf-8")
+
+    @property
+    def dtype(self):
+        return string_t
+
+    @property
+    def nullable(self):
+        return False
+
+    def key(self):
+        return ("concat_ws", self.sep,
+                tuple(c.key() for c in self.children))
+
+    def eval(self, ctx):
+        cols = [c.eval(ctx) for c in self.children]
+        lsep = len(self.sep)
+        total = sum(c.max_bytes for c in cols) + lsep * max(
+            0, len(cols) - 1)
+        mb = max(8, 1 << max(0, total - 1).bit_length())
+        n = cols[0].data.shape[0]
+        out = jnp.zeros((n, mb), jnp.uint8)
+        offset = jnp.zeros((n,), jnp.int32)
+        emitted_any = jnp.zeros((n,), bool)
+        pos = jnp.arange(mb, dtype=jnp.int32)[None, :]
+        sep_mat = jnp.asarray(list(self.sep or b"\x00"), jnp.uint8)
+        for c in cols:
+            use = c.validity
+            # separator first (only between two emitted values)
+            if lsep:
+                sep_here = use & emitted_any
+                sep_off = offset
+                idx = jnp.clip(pos - sep_off[:, None], 0, max(lsep - 1, 0))
+                span = (pos >= sep_off[:, None]) & \
+                    (pos < (sep_off + lsep)[:, None]) & sep_here[:, None]
+                out = jnp.where(span, sep_mat[idx], out)
+                offset = jnp.where(sep_here, offset + lsep, offset)
+            gathered = jnp.take_along_axis(
+                jnp.pad(c.data, ((0, 0), (0, max(0, mb - c.max_bytes)))),
+                jnp.clip(pos - offset[:, None], 0, mb - 1).astype(jnp.int64),
+                axis=1)
+            span = (pos >= offset[:, None]) & \
+                (pos < (offset + c.lengths)[:, None]) & use[:, None]
+            out = jnp.where(span, gathered, out)
+            offset = jnp.where(use, offset + c.lengths, offset)
+            emitted_any = emitted_any | use
+        return DeviceColumn(string_t, out.astype(jnp.uint8),
+                            jnp.ones((n,), bool), offset)
+
+
+class Ascii(Expression):
+    """ascii(str): codepoint of the first character (first byte for
+    ASCII); 0 for empty string."""
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return integer
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        first = c.data[:, 0].astype(jnp.int32)
+        return DeviceColumn(integer,
+                            jnp.where(c.lengths > 0, first, 0), c.validity)
+
+
+class Chr(Expression):
+    """chr(n): the character for code n & 0xFF. Spark: n < 0 -> "";
+    (n & 0xFF) == 0 -> the 1-char NUL string; 128-255 encode as 2-byte
+    UTF-8."""
+
+    def __init__(self, child):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return string_t
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        code = (c.data.astype(jnp.int64) & 0xFF).astype(jnp.int32)
+        neg = c.data.astype(jnp.int64) < 0
+        two_byte = code >= 0x80
+        n = c.data.shape[0]
+        b0 = jnp.where(two_byte, 0xC0 | (code >> 6), code)
+        b1 = jnp.where(two_byte, 0x80 | (code & 0x3F), 0)
+        data = jnp.zeros((n, 8), jnp.uint8)
+        data = data.at[:, 0].set(jnp.where(neg, 0, b0).astype(jnp.uint8))
+        data = data.at[:, 1].set(jnp.where(neg, 0, b1).astype(jnp.uint8))
+        lens = jnp.where(neg, 0, jnp.where(two_byte, 2, 1)).astype(jnp.int32)
+        return DeviceColumn(string_t, data, c.validity, lens)
+
+
+class SubstringIndex(Expression):
+    """substring_index(str, delim, count).
+
+    Known incompat: for negative counts with self-overlapping delimiters
+    (e.g. delim 'aa' in 'aaa') occurrences are counted left-greedy while
+    Spark scans lastIndexOf from the right; results agree whenever the
+    delimiter does not overlap itself."""
+
+    def __init__(self, child, delim: str, count: int):
+        super().__init__([child])
+        self.delim = delim.encode("utf-8")
+        self.count = int(count)
+
+    @property
+    def dtype(self):
+        return string_t
+
+    def key(self):
+        return ("substring_index", self.delim, self.count,
+                self.children[0].key())
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        cnt = self.count
+        ld = len(self.delim)
+        cap = c.data.shape[0]
+        if cnt == 0 or ld == 0:
+            return DeviceColumn(string_t, jnp.zeros((cap, 8), jnp.uint8),
+                                c.validity, jnp.zeros((cap,), jnp.int32))
+        mb = c.max_bytes
+        cand = _find_candidates(c.data, c.lengths, self.delim)
+        sel = _select_nonoverlapping(cand, ld)
+        occ = jnp.cumsum(sel.astype(jnp.int32), axis=1)
+        total = occ[:, -1]
+        pos = jnp.arange(mb, dtype=jnp.int32)[None, :]
+        in_str = _position_mask(c)
+        if cnt > 0:
+            # cut before the cnt-th occurrence
+            is_kth = sel & (occ == cnt)
+            has = total >= cnt
+            cut = jnp.where(has,
+                            jnp.where(is_kth, pos, mb).min(axis=1),
+                            c.lengths).astype(jnp.int32)
+            keep = in_str & (pos < cut[:, None])
+        else:
+            k = -cnt
+            # keep after the (total-k+1)-th occurrence's end
+            target = total - k + 1
+            is_kth = sel & (occ == target[:, None]) & (target[:, None] >= 1)
+            has = total >= k
+            start = jnp.where(
+                has, jnp.where(is_kth, pos, -1).max(axis=1) + ld,
+                0).astype(jnp.int32)
+            keep = in_str & (pos >= start[:, None])
+        data, lens = _compact_bytes(c.data, keep)
+        return DeviceColumn(string_t, data, c.validity, lens)

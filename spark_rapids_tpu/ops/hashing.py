@@ -157,3 +157,152 @@ def pmod(x: jnp.ndarray, n: int) -> jnp.ndarray:
     """Positive modulus, Spark's Pmod used by HashPartitioning."""
     r = x % jnp.int32(n)
     return jnp.where(r < 0, r + jnp.int32(n), r)
+
+
+# ---------------------------------------------------------------------------
+# XxHash64 (Spark `xxhash64(...)`, seed 42) — the second Spark-exact hash
+# the JNI `Hash` kernel provides (reference spark-rapids-jni Hash.xxhash64).
+# Vectorized uint64 arithmetic; wraparound multiply is exact under XLA's
+# 64-bit integer emulation on TPU.
+# ---------------------------------------------------------------------------
+
+_P1 = jnp.uint64(0x9E3779B185EBCA87)
+_P2 = jnp.uint64(0xC2B2AE3D27D4EB4F)
+_P3 = jnp.uint64(0x165667B19E3779F9)
+_P4 = jnp.uint64(0x85EBCA77C2B2AE63)
+_P5 = jnp.uint64(0x27D4EB2F165667C5)
+
+XXHASH_DEFAULT_SEED = 42
+
+
+def _rotl64(x, r):
+    return (x << jnp.uint64(r)) | (x >> jnp.uint64(64 - r))
+
+
+def _xxh_fmix(h):
+    h = h ^ (h >> jnp.uint64(33))
+    h = h * _P2
+    h = h ^ (h >> jnp.uint64(29))
+    h = h * _P3
+    h = h ^ (h >> jnp.uint64(32))
+    return h
+
+
+def xxh64_int(v: jnp.ndarray, seed: jnp.ndarray) -> jnp.ndarray:
+    """XXH64.hashInt: v int32 vector, seed uint64 vector."""
+    h = seed + _P5 + jnp.uint64(4)
+    u = v.astype(jnp.uint32).astype(jnp.uint64)
+    h = h ^ (u * _P1)
+    h = _rotl64(h, 23) * _P2 + _P3
+    return _xxh_fmix(h)
+
+
+def xxh64_long(v: jnp.ndarray, seed: jnp.ndarray) -> jnp.ndarray:
+    """XXH64.hashLong: v int64 vector, seed uint64 vector."""
+    h = seed + _P5 + jnp.uint64(8)
+    k1 = _rotl64(v.astype(jnp.uint64) * _P2, 31) * _P1
+    h = h ^ k1
+    h = _rotl64(h, 27) * _P1 + _P4
+    return _xxh_fmix(h)
+
+
+def xxh64_bytes(data: jnp.ndarray, lengths: jnp.ndarray,
+                seed: jnp.ndarray) -> jnp.ndarray:
+    """XXH64.hashUnsafeBytes over the padded byte matrix (any length)."""
+    n, mb = data.shape
+    pad_mb = ((mb + 31) // 32) * 32
+    if pad_mb != mb:
+        data = jnp.pad(data, ((0, 0), (0, pad_mb - mb)))
+    nw = pad_mb // 8
+    d64 = data.astype(jnp.uint64).reshape(n, nw, 8)
+    shifts = jnp.arange(8, dtype=jnp.uint64) * 8
+    words = (d64 << shifts[None, None, :]).sum(axis=2,
+                                               dtype=jnp.uint64)
+    lens64 = lengths.astype(jnp.uint64)
+    nblocks = lengths // 32
+    v1 = seed + _P1 + _P2
+    v2 = seed + _P2
+    v3 = seed
+    v4 = seed - _P1
+    vs = [v1, v2, v3, v4]
+    for bi in range(pad_mb // 32):
+        active = bi < nblocks
+        for lane in range(4):
+            w = words[:, bi * 4 + lane]
+            upd = _rotl64(vs[lane] + w * _P2, 31) * _P1
+            vs[lane] = jnp.where(active, upd, vs[lane])
+    hash_ge = (_rotl64(vs[0], 1) + _rotl64(vs[1], 7) +
+               _rotl64(vs[2], 12) + _rotl64(vs[3], 18))
+    for v in vs:
+        hash_ge = (hash_ge ^ (_rotl64(v * _P2, 31) * _P1)) * _P1 + _P4
+    h = jnp.where(lengths >= 32, hash_ge, seed + _P5)
+    h = h + lens64
+    # trailing 8-byte words (at most 3 since remainder < 32)
+    base_w = (nblocks * 4).astype(jnp.int32)
+    n8 = (lengths - nblocks * 32) // 8
+    for wi in range(3):
+        widx = jnp.clip(base_w + wi, 0, nw - 1).astype(jnp.int64)
+        w = jnp.take_along_axis(words, widx[:, None], axis=1)[:, 0]
+        upd = _rotl64(h ^ (_rotl64(w * _P2, 31) * _P1), 27) * _P1 + _P4
+        h = jnp.where(wi < n8, upd, h)
+    # optional 4-byte lane
+    off = (nblocks * 32 + n8 * 8).astype(jnp.int32)
+    rem = lengths - off
+    has4 = rem >= 4
+    bidx = jnp.clip(off[:, None] + jnp.arange(4, dtype=jnp.int32)[None, :],
+                    0, pad_mb - 1).astype(jnp.int64)
+    b4 = jnp.take_along_axis(data, bidx, axis=1).astype(jnp.uint64)
+    u32 = (b4[:, 0] | (b4[:, 1] << jnp.uint64(8)) |
+           (b4[:, 2] << jnp.uint64(16)) | (b4[:, 3] << jnp.uint64(24)))
+    upd = _rotl64(h ^ (u32 * _P1), 23) * _P2 + _P3
+    h = jnp.where(has4, upd, h)
+    off = off + jnp.where(has4, 4, 0)
+    # final bytes (at most 3)
+    for ti in range(3):
+        bpos = jnp.clip(off + ti, 0, pad_mb - 1).astype(jnp.int64)
+        byte = jnp.take_along_axis(data, bpos[:, None],
+                                   axis=1)[:, 0].astype(jnp.uint64)
+        upd = _rotl64(h ^ (byte * _P5), 11) * _P1
+        h = jnp.where(off + ti < lengths, upd, h)
+    return _xxh_fmix(h)
+
+
+def xxh64_column(col: DeviceColumn, seed: jnp.ndarray) -> jnp.ndarray:
+    dt = col.dtype
+    if isinstance(dt, StringType):
+        return xxh64_bytes(col.data, col.lengths, seed)
+    if isinstance(dt, BooleanType):
+        return xxh64_int(col.data.astype(jnp.int32), seed)
+    if isinstance(dt, FloatType):
+        f = col.data
+        f = jnp.where(f == 0.0, jnp.float32(0.0), f)
+        bits = lax.bitcast_convert_type(f, jnp.int32)
+        bits = jnp.where(jnp.isnan(f), jnp.int32(0x7FC00000), bits)
+        return xxh64_int(bits, seed)
+    if isinstance(dt, DoubleType):
+        from spark_rapids_tpu.ops.common import supports_64bit_bitcast
+        f = col.data
+        f = jnp.where(f == 0.0, jnp.float64(0.0), f)
+        if supports_64bit_bitcast():
+            bits = lax.bitcast_convert_type(f, jnp.int64)
+            bits = jnp.where(jnp.isnan(f), jnp.int64(0x7FF8000000000000),
+                             bits)
+        else:
+            f32 = f.astype(jnp.float32)
+            b32 = lax.bitcast_convert_type(f32, jnp.int32)
+            b32 = jnp.where(jnp.isnan(f32), jnp.int32(0x7FC00000), b32)
+            bits = b32.astype(jnp.int64)
+        return xxh64_long(bits, seed)
+    if dt.np_dtype.itemsize <= 4:
+        return xxh64_int(col.data.astype(jnp.int32), seed)
+    return xxh64_long(col.data.astype(jnp.int64), seed)
+
+
+def xxhash64_columns(cols: List[DeviceColumn],
+                     seed: int = XXHASH_DEFAULT_SEED) -> jnp.ndarray:
+    """Spark XxHash64(cols, seed): chain seeds, skip nulls; int64 out."""
+    cap = cols[0].capacity
+    h = jnp.full((cap,), jnp.uint64(seed))
+    for c in cols:
+        h = jnp.where(c.validity, xxh64_column(c, h), h)
+    return h.astype(jnp.int64)

@@ -1,0 +1,422 @@
+"""Extended expression family: math/bitwise/string-breadth/conditional.
+
+Each case is checked two ways, mirroring the reference's differential
+strategy (integration_tests asserts.py): (1) device result vs the CPU
+oracle for the same expression tree, and (2) anchored expectations
+hand-derived from Spark 3.5 semantics for the corner cases.
+"""
+
+import math
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar import arrow_to_device, device_to_arrow
+from spark_rapids_tpu.columnar.batch import ColumnBatch
+from spark_rapids_tpu.exec.cpu_eval import eval_expr
+from spark_rapids_tpu.expr import (
+    Acos, Ascii, Asin, Atan2, BitwiseAnd, BitwiseNot, BitwiseOr, BitwiseXor,
+    BoundReference, BRound, Cbrt, Ceil, Chr, ConcatWs, Cos, EvalContext,
+    Exp, Floor, Greatest, Hex, Hypot, InitCap, Least, Literal, Log, Log1p,
+    Logarithm, NaNvl, Nvl2, Pow, Rint, Round, ShiftLeft, ShiftRight,
+    ShiftRightUnsigned, Signum, Sin, Sqrt, StringInstr, StringLocate,
+    StringLPad, StringRepeat, StringReplace, StringReverse, StringRPad,
+    StringTranslate, StringTrim, StringTrimLeft, StringTrimRight,
+    SubstringIndex, Tanh, XxHash64,
+)
+from spark_rapids_tpu.sqltypes import StructField, StructType
+from spark_rapids_tpu.sqltypes.datatypes import (
+    double, integer, long, string,
+)
+
+
+def _device_eval(table: pa.Table, expr):
+    b = arrow_to_device(table)
+    col = expr.eval(EvalContext(b))
+    out = ColumnBatch(StructType([StructField("r", col.dtype, True)]),
+                      [col], b.num_rows)
+    return device_to_arrow(out).column("r").to_pylist()
+
+
+def _both(table: pa.Table, expr):
+    dev = _device_eval(table, expr)
+    cpu = eval_expr(expr, table).to_pylist()
+    return dev, cpu
+
+
+def _assert_parity(table, expr, rel=1e-9):
+    dev, cpu = _both(table, expr)
+    assert len(dev) == len(cpu)
+    for d, c in zip(dev, cpu):
+        if c is None:
+            assert d is None, (d, c)
+        elif isinstance(c, float):
+            if math.isnan(c):
+                assert d is not None and math.isnan(d), (d, c)
+            else:
+                assert d == pytest.approx(c, rel=rel), (d, c)
+        else:
+            assert d == c, (d, c)
+
+
+def ref(i, dt=long):
+    return BoundReference(i, dt, True)
+
+
+FL = pa.table({"x": pa.array([4.0, -1.0, 0.0, None, 2.25, float("nan")],
+                             pa.float64())})
+IN = pa.table({"a": pa.array([7, -7, 0, None, 123456], pa.int64()),
+               "b": pa.array([3, 2, 5, 4, None], pa.int64())})
+ST = pa.table({"s": pa.array(["  hi  ", "héllo wörld", "", None, "a.b.c"],
+                             pa.string())})
+
+
+@pytest.mark.parametrize("cls", [Sqrt, Exp, Cbrt, Sin, Cos, Tanh, Signum,
+                                 Rint])
+def test_unary_math_parity(cls):
+    _assert_parity(FL, cls(ref(0, double)))
+
+
+@pytest.mark.parametrize("cls", [Asin, Acos])
+def test_inverse_trig_domain(cls):
+    t = pa.table({"x": pa.array([0.5, -2.0, 1.0, None], pa.float64())})
+    _assert_parity(t, cls(ref(0, double)))
+
+
+def test_log_domain_nulls():
+    dev, cpu = _both(FL, Log(ref(0, double)))
+    assert dev[1] is None and dev[2] is None  # log(-1), log(0) -> NULL
+    assert math.isnan(dev[5]) and math.isnan(cpu[5])  # log(NaN) -> NaN
+    assert dev[0] == pytest.approx(cpu[0])
+
+
+def test_log1p_domain():
+    t = pa.table({"x": pa.array([-0.5, -1.0, -2.0, 1.0], pa.float64())})
+    dev, cpu = _both(t, Log1p(ref(0, double)))
+    assert dev[1] is None and dev[2] is None
+    assert dev[0] == pytest.approx(cpu[0])
+
+
+def test_logarithm_base():
+    t = pa.table({"b": pa.array([2.0, 10.0, -1.0], pa.float64()),
+                  "x": pa.array([8.0, 1000.0, 5.0], pa.float64())})
+    dev, cpu = _both(t, Logarithm(ref(0, double), ref(1, double)))
+    assert dev[0] == pytest.approx(3.0)
+    assert dev[1] == pytest.approx(3.0)
+    assert dev[2] is None and cpu[2] is None
+
+
+def test_pow_atan2_hypot():
+    t = pa.table({"a": pa.array([2.0, 3.0, None], pa.float64()),
+                  "b": pa.array([10.0, 4.0, 1.0], pa.float64())})
+    for cls in (Pow, Atan2, Hypot):
+        _assert_parity(t, cls(ref(0, double), ref(1, double)))
+
+
+def test_round_half_up_vs_bround_half_even():
+    t = pa.table({"x": pa.array([2.5, 3.5, -2.5, 2.45, None], pa.float64())})
+    assert _device_eval(t, Round(ref(0, double), 0)) == \
+        [3.0, 4.0, -3.0, 2.0, None]
+    assert _device_eval(t, BRound(ref(0, double), 0)) == \
+        [2.0, 4.0, -2.0, 2.0, None]
+    assert _device_eval(t, Round(ref(0, double), 1)) == \
+        [2.5, 3.5, -2.5, 2.5, None]
+
+
+def test_round_integral_negative_scale():
+    t = pa.table({"x": pa.array([125, -125, 114, None], pa.int64())})
+    assert _device_eval(t, Round(ref(0), -1)) == [130, -130, 110, None]
+    assert _device_eval(t, BRound(ref(0), -1)) == [120, -120, 110, None]
+
+
+def test_ceil_floor_long():
+    t = pa.table({"x": pa.array([2.1, -2.1, 5.0, None], pa.float64())})
+    assert _device_eval(t, Ceil(ref(0, double))) == [3, -2, 5, None]
+    assert _device_eval(t, Floor(ref(0, double))) == [2, -3, 5, None]
+
+
+def test_bitwise_ops():
+    for cls in (BitwiseAnd, BitwiseOr, BitwiseXor):
+        _assert_parity(IN, cls(ref(0), ref(1)))
+    _assert_parity(IN, BitwiseNot(ref(0)))
+
+
+def test_shifts_java_mask():
+    t = pa.table({"x": pa.array([1, -8, 1], pa.int64()),
+                  "n": pa.array([65, 1, 63], pa.int64())})
+    # 65 & 63 == 1 (Java masks the count)
+    assert _device_eval(t, ShiftLeft(ref(0), ref(1))) == \
+        [2, -16, -9223372036854775808]
+    assert _device_eval(t, ShiftRight(ref(0), ref(1))) == [0, -4, 0]
+    assert _device_eval(t, ShiftRightUnsigned(ref(0), ref(1))) == \
+        [0, 9223372036854775804, 0]
+    for cls in (ShiftLeft, ShiftRight, ShiftRightUnsigned):
+        _assert_parity(t, cls(ref(0), ref(1)))
+
+
+def test_hex():
+    t = pa.table({"x": pa.array([255, 0, -1, 291, None], pa.int64())})
+    assert _device_eval(t, Hex(ref(0))) == \
+        ["FF", "0", "FFFFFFFFFFFFFFFF", "123", None]
+    _assert_parity(t, Hex(ref(0)))
+
+
+def test_greatest_least_skip_nulls():
+    t = pa.table({"a": pa.array([1, None, None, 5], pa.int64()),
+                  "b": pa.array([3, 2, None, 1], pa.int64()),
+                  "c": pa.array([2, None, None, None], pa.int64())})
+    e = Greatest(ref(0), ref(1), ref(2))
+    assert _device_eval(t, e) == [3, 2, None, 5]
+    _assert_parity(t, e)
+    e = Least(ref(0), ref(1), ref(2))
+    assert _device_eval(t, e) == [1, 2, None, 1]
+    _assert_parity(t, e)
+
+
+def test_greatest_nan_is_largest():
+    t = pa.table({"a": pa.array([1.0, float("nan")], pa.float64()),
+                  "b": pa.array([float("nan"), 2.0], pa.float64())})
+    r = _device_eval(t, Greatest(ref(0, double), ref(1, double)))
+    assert all(math.isnan(v) for v in r)
+    r = _device_eval(t, Least(ref(0, double), ref(1, double)))
+    assert r == [1.0, 2.0]
+
+
+def test_nvl2_nanvl():
+    t = pa.table({"a": pa.array([1.0, None, float("nan")], pa.float64()),
+                  "b": pa.array([10.0, 20.0, 30.0], pa.float64())})
+    assert _device_eval(t, Nvl2(ref(0, double), ref(1, double),
+                                Literal(-1.0))) == [10.0, -1.0, 30.0]
+    assert _device_eval(t, NaNvl(ref(0, double), ref(1, double))) == \
+        [1.0, None, 30.0]
+
+
+# --- strings ---
+
+
+def test_trim_family():
+    for cls, exp in [(StringTrim, ["hi", "héllo wörld", "", None, "a.b.c"]),
+                     (StringTrimLeft, ["hi  ", "héllo wörld", "", None,
+                                       "a.b.c"]),
+                     (StringTrimRight, ["  hi", "héllo wörld", "", None,
+                                        "a.b.c"])]:
+        assert _device_eval(ST, cls(BoundReference(0, string, True))) == exp
+        _assert_parity(ST, cls(BoundReference(0, string, True)))
+
+
+def test_trim_custom_chars():
+    t = pa.table({"s": pa.array(["xxabcxx", "xyyx", "abc"], pa.string())})
+    e = StringTrim(BoundReference(0, string, True), "xy")
+    assert _device_eval(t, e) == ["abc", "", "abc"]
+
+
+def test_pad():
+    t = pa.table({"s": pa.array(["abc", "abcdef", "", None], pa.string())})
+    s = BoundReference(0, string, True)
+    assert _device_eval(t, StringLPad(s, 5, "*")) == \
+        ["**abc", "abcde", "*****", None]
+    assert _device_eval(t, StringRPad(s, 5, "*")) == \
+        ["abc**", "abcde", "*****", None]
+    assert _device_eval(t, StringLPad(s, 5, "xy")) == \
+        ["xyabc", "abcde", "xyxyx", None]
+    for e in (StringLPad(s, 5, "xy"), StringRPad(s, 6, "ab")):
+        _assert_parity(t, e)
+
+
+def test_repeat_reverse():
+    t = pa.table({"s": pa.array(["ab", "", "xyz", None], pa.string())})
+    s = BoundReference(0, string, True)
+    assert _device_eval(t, StringRepeat(s, 3)) == \
+        ["ababab", "", "xyzxyzxyz", None]
+    assert _device_eval(t, StringRepeat(s, 0)) == ["", "", "", None]
+    assert _device_eval(t, StringReverse(s)) == ["ba", "", "zyx", None]
+
+
+def test_reverse_utf8_chars():
+    t = pa.table({"s": pa.array(["héllo"], pa.string())})
+    assert _device_eval(t, StringReverse(
+        BoundReference(0, string, True))) == ["olléh"]
+
+
+def test_initcap():
+    t = pa.table({"s": pa.array(["hello world", "SPARK sql", "a  b", None],
+                                pa.string())})
+    e = InitCap(BoundReference(0, string, True))
+    assert _device_eval(t, e) == ["Hello World", "Spark Sql", "A  B", None]
+    _assert_parity(t, e)
+
+
+def test_instr_locate():
+    t = pa.table({"s": pa.array(["hello", "ababab", "", None], pa.string())})
+    s = BoundReference(0, string, True)
+    assert _device_eval(t, StringInstr(s, "l")) == [3, 0, 0, None]
+    assert _device_eval(t, StringInstr(s, "ab")) == [0, 1, 0, None]
+    assert _device_eval(t, StringLocate(s, "ab", 2)) == [0, 3, 0, None]
+    assert _device_eval(t, StringLocate(s, "ab", 0)) == [0, 0, 0, None]
+    for e in (StringInstr(s, "ab"), StringLocate(s, "ab", 2)):
+        _assert_parity(t, e)
+
+
+def test_translate_with_delete():
+    t = pa.table({"s": pa.array(["AaBbCc", "translate"], pa.string())})
+    s = BoundReference(0, string, True)
+    e = StringTranslate(s, "abc", "12")  # c deleted
+    assert _device_eval(t, e) == ["A1B2C", "tr1nsl1te"]
+    _assert_parity(t, e)
+
+
+def test_replace_expanding_and_deleting():
+    t = pa.table({"s": pa.array(["aaa", "banana", "", None], pa.string())})
+    s = BoundReference(0, string, True)
+    assert _device_eval(t, StringReplace(s, "a", "XY")) == \
+        ["XYXYXY", "bXYnXYnXY", "", None]
+    assert _device_eval(t, StringReplace(s, "an", "")) == \
+        ["aaa", "ba", "", None]
+    assert _device_eval(t, StringReplace(s, "aa", "b")) == \
+        ["ba", "banana", "", None]
+    for e in (StringReplace(s, "a", "XY"), StringReplace(s, "an", "")):
+        _assert_parity(t, e)
+
+
+def test_concat_ws_skips_nulls():
+    t = pa.table({"a": pa.array(["x", None, "p"], pa.string()),
+                  "b": pa.array(["y", "z", None], pa.string())})
+    e = ConcatWs(",", BoundReference(0, string, True),
+                 BoundReference(1, string, True))
+    assert _device_eval(t, e) == ["x,y", "z", "p"]
+    _assert_parity(t, e)
+
+
+def test_ascii_chr():
+    t = pa.table({"s": pa.array(["Abc", "", None], pa.string())})
+    e = Ascii(BoundReference(0, string, True))
+    assert _device_eval(t, e) == [65, 0, None]
+    _assert_parity(t, e)
+    t2 = pa.table({"n": pa.array([65, 97 + 256, 0, -5, 200, None],
+                                 pa.int64())})
+    # Spark: n<0 -> "", (n & 0xFF)==0 -> NUL char, 128-255 -> 2-byte UTF-8
+    assert _device_eval(t2, Chr(ref(0))) == \
+        ["A", "a", "\x00", "", "È", None]
+    _assert_parity(t2, Chr(ref(0)))
+
+
+def test_substring_index():
+    t = pa.table({"s": pa.array(["a.b.c", "abc", "", None], pa.string())})
+    s = BoundReference(0, string, True)
+    assert _device_eval(t, SubstringIndex(s, ".", 2)) == \
+        ["a.b", "abc", "", None]
+    assert _device_eval(t, SubstringIndex(s, ".", -2)) == \
+        ["b.c", "abc", "", None]
+    assert _device_eval(t, SubstringIndex(s, ".", 5)) == \
+        ["a.b.c", "abc", "", None]
+    assert _device_eval(t, SubstringIndex(s, ".", 0)) == ["", "", "", None]
+    for e in (SubstringIndex(s, ".", 2), SubstringIndex(s, ".", -1)):
+        _assert_parity(t, e)
+
+
+# --- xxhash64 vs canonical reference implementation ---
+
+_XP1 = 0x9E3779B185EBCA87
+_XP2 = 0xC2B2AE3D27D4EB4F
+_XP3 = 0x165667B19E3779F9
+_XP4 = 0x85EBCA77C2B2AE63
+_XP5 = 0x27D4EB2F165667C5
+_M = (1 << 64) - 1
+
+
+def _rotl(x, r):
+    return ((x << r) | (x >> (64 - r))) & _M
+
+
+def _fmix(h):
+    h ^= h >> 33
+    h = (h * _XP2) & _M
+    h ^= h >> 29
+    h = (h * _XP3) & _M
+    h ^= h >> 32
+    return h
+
+
+def _xxh64_py(data: bytes, seed: int) -> int:
+    """Canonical XXH64 (public spec), little-endian."""
+    n = len(data)
+    i = 0
+    if n >= 32:
+        v1 = (seed + _XP1 + _XP2) & _M
+        v2 = (seed + _XP2) & _M
+        v3 = seed & _M
+        v4 = (seed - _XP1) & _M
+        while i + 32 <= n:
+            for off, v in enumerate((v1, v2, v3, v4)):
+                pass
+            w = [int.from_bytes(data[i + 8 * k:i + 8 * k + 8], "little")
+                 for k in range(4)]
+            v1 = (_rotl((v1 + w[0] * _XP2) & _M, 31) * _XP1) & _M
+            v2 = (_rotl((v2 + w[1] * _XP2) & _M, 31) * _XP1) & _M
+            v3 = (_rotl((v3 + w[2] * _XP2) & _M, 31) * _XP1) & _M
+            v4 = (_rotl((v4 + w[3] * _XP2) & _M, 31) * _XP1) & _M
+            i += 32
+        h = (_rotl(v1, 1) + _rotl(v2, 7) + _rotl(v3, 12) +
+             _rotl(v4, 18)) & _M
+        for v in (v1, v2, v3, v4):
+            h = ((h ^ (_rotl((v * _XP2) & _M, 31) * _XP1) & _M)
+                 * _XP1 + _XP4) & _M
+    else:
+        h = (seed + _XP5) & _M
+    h = (h + n) & _M
+    while i + 8 <= n:
+        w = int.from_bytes(data[i:i + 8], "little")
+        h = (_rotl(h ^ ((_rotl((w * _XP2) & _M, 31) * _XP1) & _M), 27)
+             * _XP1 + _XP4) & _M
+        i += 8
+    if i + 4 <= n:
+        w = int.from_bytes(data[i:i + 4], "little")
+        h = (_rotl(h ^ ((w * _XP1) & _M), 23) * _XP2 + _XP3) & _M
+        i += 4
+    while i < n:
+        h = (_rotl(h ^ ((data[i] * _XP5) & _M), 11) * _XP1) & _M
+        i += 1
+    return _fmix(h)
+
+
+def _signed(x):
+    return x - (1 << 64) if x >= (1 << 63) else x
+
+
+def test_xxhash64_long_matches_reference():
+    vals = [0, 1, -1, 42, 2**62, -(2**40)]
+    t = pa.table({"x": pa.array(vals, pa.int64())})
+    dev = _device_eval(t, XxHash64(ref(0)))
+    exp = [_signed(_xxh64_py((v & _M).to_bytes(8, "little"), 42))
+           for v in vals]
+    assert dev == exp
+
+
+def test_xxhash64_int_matches_reference():
+    vals = [0, 1, -1, 123456]
+    t = pa.table({"x": pa.array(vals, pa.int32())})
+    dev = _device_eval(t, XxHash64(ref(0, integer)))
+    exp = [_signed(_xxh64_py((v & 0xFFFFFFFF).to_bytes(4, "little"), 42))
+           for v in vals]
+    assert dev == exp
+
+
+def test_xxhash64_string_matches_reference():
+    vals = ["", "a", "abcd", "hello wo", "The quick brown fox jumps over",
+            "0123456789012345678901234567890123456789"]  # >32 bytes
+    t = pa.table({"s": pa.array(vals, pa.string())})
+    dev = _device_eval(t, XxHash64(BoundReference(0, string, True)))
+    exp = [_signed(_xxh64_py(v.encode(), 42)) for v in vals]
+    assert dev == exp
+
+
+def test_xxhash64_null_keeps_seed_chain():
+    t = pa.table({"a": pa.array([1, None], pa.int64()),
+                  "b": pa.array([2, 2], pa.int64())})
+    dev = _device_eval(t, XxHash64(ref(0), ref(1)))
+    h0 = _xxh64_py((2).to_bytes(8, "little"),
+                   _xxh64_py((1).to_bytes(8, "little"), 42))
+    h1 = _xxh64_py((2).to_bytes(8, "little"), 42)
+    assert dev == [_signed(h0), _signed(h1)]
+    _assert_parity(t, XxHash64(ref(0), ref(1)))
