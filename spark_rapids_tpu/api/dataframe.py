@@ -61,7 +61,10 @@ class DataFrame:
     def __getitem__(self, name: str) -> Column:
         i = _field_index(self.schema, name)
         f = self.schema.fields[i]
-        return Column(BoundReference(i, f.dataType, f.nullable), name)
+        ref = BoundReference(i, f.dataType, f.nullable)
+        # provenance for join-condition resolution (df1.a == df2.b)
+        ref._origin_plan = self._plan
+        return Column(ref, name)
 
     # --- transformations ---
 
@@ -169,53 +172,151 @@ class DataFrame:
     def agg(self, *cols) -> "DataFrame":
         return GroupedData(self, []).agg(*cols)
 
+    def crossJoin(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(
+            L.Join(self._plan, other._plan, "cross", [], []), self.session)
+
+    def _resolve_combined(self, other: "DataFrame", e) -> Expression:
+        """Resolve an expression against [left fields | right fields]:
+        UnresolvedColumn binds left-first; BoundReferences originating
+        from `other` (df2["x"]) shift into the right half."""
+        n_l = len(self.schema.fields)
+
+        def go(node):
+            if isinstance(node, UnresolvedColumn):
+                try:
+                    i = _field_index(self.schema, node.name)
+                    f = self.schema.fields[i]
+                    return BoundReference(i, f.dataType, f.nullable)
+                except KeyError:
+                    i = _field_index(other.schema, node.name)
+                    f = other.schema.fields[i]
+                    return BoundReference(n_l + i, f.dataType, f.nullable)
+            if isinstance(node, BoundReference):
+                org = getattr(node, "_origin_plan", None)
+                if org is other._plan:
+                    return BoundReference(node.ordinal + n_l, node.dtype,
+                                          node.nullable)
+                if org is None or org is self._plan:
+                    return node
+                raise ValueError(
+                    "join condition references a column from a DataFrame "
+                    "that is neither side of this join; re-derive it from "
+                    "the joined inputs (e.g. use the filtered/projected "
+                    "DataFrame's own columns)")
+            if isinstance(node, Expression):
+                return node.with_children([go(c) for c in node.children])
+            raise TypeError(f"cannot resolve {node!r}")
+
+        return go(e)
+
+    @staticmethod
+    def _promote_keys(lk, rk):
+        """Implicit numeric promotion of mismatched key types
+        (Spark's ImplicitTypeCasts)."""
+        from spark_rapids_tpu.expr import Cast
+        from spark_rapids_tpu.sqltypes import NumericType
+        from spark_rapids_tpu.sqltypes.datatypes import numeric_promotion
+
+        out_l, out_r = [], []
+        for a, b in zip(lk, rk):
+            if a.dtype != b.dtype:
+                if isinstance(a.dtype, NumericType) and isinstance(
+                        b.dtype, NumericType):
+                    common = numeric_promotion(a.dtype, b.dtype)
+                    a = a if a.dtype == common else Cast(a, common)
+                    b = b if b.dtype == common else Cast(b, common)
+                else:
+                    raise TypeError(
+                        f"join key type mismatch: {a.dtype} vs {b.dtype}")
+            out_l.append(a)
+            out_r.append(b)
+        return out_l, out_r
+
+    @staticmethod
+    def _split_conjuncts(e: Expression) -> List[Expression]:
+        from spark_rapids_tpu.expr import And
+
+        if isinstance(e, And):
+            return (DataFrame._split_conjuncts(e.children[0]) +
+                    DataFrame._split_conjuncts(e.children[1]))
+        return [e]
+
+    def _extract_equi_keys(self, cond: Expression):
+        """Spark's ExtractEquiJoinKeys: pull EqualTo conjuncts whose
+        sides reference only one input each; remainder stays a
+        condition."""
+        from spark_rapids_tpu.expr import And, EqualTo
+
+        n_l = len(self.schema.fields)
+        lk, rk, rest = [], [], []
+        for c in self._split_conjuncts(cond):
+            if isinstance(c, EqualTo):
+                a, b = c.children
+                ra, rb = a.references(), b.references()
+                if ra and rb:
+                    if max(ra) < n_l <= min(rb):
+                        lk.append(a)
+                        rk.append(b)
+                        continue
+                    if max(rb) < n_l <= min(ra):
+                        lk.append(b)
+                        rk.append(a)
+                        continue
+            rest.append(c)
+        from spark_rapids_tpu.exec.joins import remap_refs
+
+        rk = [remap_refs(k, lambda o: o - n_l) for k in rk]
+        remainder = None
+        for c in rest:
+            remainder = c if remainder is None else And(remainder, c)
+        return lk, rk, remainder
+
     def join(self, other: "DataFrame", on=None, how: str = "inner"
              ) -> "DataFrame":
         how = {"outer": "full", "full_outer": "full", "leftouter": "left",
                "rightouter": "right", "leftsemi": "left_semi",
                "semi": "left_semi", "leftanti": "left_anti",
-               "anti": "left_anti", "cross": "inner"}.get(how, how)
+               "anti": "left_anti"}.get(how, how)
+        if on is None or how == "cross":
+            assert on is None, "cross join takes no join keys"
+            if how not in ("inner", "cross"):
+                raise ValueError(
+                    f"join type {how!r} requires join keys or a condition")
+            return self.crossJoin(other)
         if isinstance(on, str):
             on = [on]
+        if isinstance(on, Column) or isinstance(on, Expression):
+            cond = self._resolve_combined(
+                other, on.expr if isinstance(on, Column) else on)
+            lk, rk, remainder = self._extract_equi_keys(cond)
+            lk, rk = self._promote_keys(lk, rk)
+            jt = "cross" if not lk and remainder is None else how
+            plan = L.Join(self._plan, other._plan, jt, lk, rk,
+                          condition=remainder)
+            return DataFrame(plan, self.session)
         if isinstance(on, (list, tuple)) and on and isinstance(on[0], str):
             lk = [self[c].expr for c in on]
             rk = [other[c].expr for c in on]
         else:
-            raise NotImplementedError(
-                "join requires column-name keys in v1")
-        # implicit cast to the common key type (Spark's ImplicitTypeCasts)
-        from spark_rapids_tpu.expr import Cast
-        from spark_rapids_tpu.sqltypes import NumericType
-        from spark_rapids_tpu.sqltypes.datatypes import numeric_promotion
-
-        left_plan, right_plan = self._plan, other._plan
-        lcast, rcast = [], []
-        for i, (a, b) in enumerate(zip(lk, rk)):
-            if a.dtype != b.dtype:
-                if isinstance(a.dtype, NumericType) and isinstance(
-                        b.dtype, NumericType):
-                    common = numeric_promotion(a.dtype, b.dtype)
-                    if a.dtype != common:
-                        lcast.append((i, common))
-                    if b.dtype != common:
-                        rcast.append((i, common))
-                else:
-                    raise TypeError(
-                        f"join key type mismatch: {a.dtype} vs {b.dtype}")
+            raise TypeError(
+                "join `on` must be column name(s) or a Column expression")
+        # name-keyed joins rewrite mismatched key columns to the common
+        # type in place (the joined output carries the promoted type,
+        # matching Spark's ImplicitTypeCasts on USING joins)
+        plk, prk = self._promote_keys(lk, rk)
         df_l, df_r = self, other
-        if lcast:
-            for i, common in lcast:
-                df_l = df_l.withColumn(on[i],
-                                       Column(Cast(lk[i], common)))
-            left_plan = df_l._plan
+        if any(p is not o for p, o in zip(plk, lk)):
+            for i, (p, o) in enumerate(zip(plk, lk)):
+                if p is not o:
+                    df_l = df_l.withColumn(on[i], Column(p))
             lk = [df_l[c].expr for c in on]
-        if rcast:
-            for i, common in rcast:
-                df_r = df_r.withColumn(on[i],
-                                       Column(Cast(rk[i], common)))
-            right_plan = df_r._plan
+        if any(p is not o for p, o in zip(prk, rk)):
+            for i, (p, o) in enumerate(zip(prk, rk)):
+                if p is not o:
+                    df_r = df_r.withColumn(on[i], Column(p))
             rk = [df_r[c].expr for c in on]
-        plan = L.Join(left_plan, right_plan, how, lk, rk)
+        plan = L.Join(df_l._plan, df_r._plan, how, lk, rk)
         return DataFrame(plan, self.session)
 
     def union(self, other: "DataFrame") -> "DataFrame":

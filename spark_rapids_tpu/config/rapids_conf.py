@@ -135,6 +135,10 @@ SHUFFLE_MODE = conf(
 SHUFFLE_PARTITIONS = conf(
     "spark.sql.shuffle.partitions", 8,
     "Number of shuffle output partitions.", int)
+BROADCAST_THRESHOLD = conf(
+    "spark.sql.autoBroadcastJoinThreshold", 10 << 20,
+    "Max estimated build-side bytes for broadcast joins; -1 disables "
+    "(Spark conf honored by the reference planner).", int)
 MULTITHREADED_READ_NUM_THREADS = conf(
     "spark.rapids.sql.multiThreadedRead.numThreads", 8,
     "Shared reader thread pool size (reference Plugin.scala:262-274).", int)

@@ -48,7 +48,7 @@ from spark_rapids_tpu.exec.base import PhysicalPlan, TaskContext
 from spark_rapids_tpu.expr import Alias, BoundReference, EvalContext
 from spark_rapids_tpu.expr.aggregates import AggregateFunction
 from spark_rapids_tpu.io import readers
-from spark_rapids_tpu.ops import filterops, joinops, partition, segmented
+from spark_rapids_tpu.ops import filterops, partition, segmented
 from spark_rapids_tpu.ops.common import orderable_keys, sort_permutation
 from spark_rapids_tpu.plan.logical import SortOrder
 from spark_rapids_tpu.runtime import semaphore as sem
@@ -630,187 +630,14 @@ class CpuShuffleExchangeExec(PhysicalPlan):
 
 
 # ------------------------------------------------------------------ joins
+# (join family lives in exec/joins.py; re-exported for planner use)
 
-class TpuShuffledHashJoinExec(PhysicalPlan):
-    """Partitioned equi-join; children must be co-partitioned by key
-    (the planner inserts exchanges). Right side is the build side."""
-
-    def __init__(self, left, right, join_type, left_keys, right_keys,
-                 schema, conf):
-        super().__init__([left, right], schema, conf)
-        self.join_type = join_type
-        self.left_keys = left_keys
-        self.right_keys = right_keys
-
-    def execute_partition(self, pid, ctx):
-        with self.metrics[M.JOIN_TIME].ns():
-            right_batches = list(
-                self.children[1].execute_partition(pid, ctx))
-            left_batches = list(
-                self.children[0].execute_partition(pid, ctx))
-            out = self._join_partition(left_batches, right_batches)
-            if out is not None:
-                yield out
-
-    def _key_ordinals(self, side: int, keys) -> List[int]:
-        ords = []
-        for k in keys:
-            assert isinstance(k, BoundReference), \
-                "join keys must be column refs after planning"
-            ords.append(k.ordinal)
-        return ords
-
-    def _join_partition(self, left_batches, right_batches):
-        jt = self.join_type
-        if not left_batches and jt in ("inner", "left", "left_semi",
-                                       "left_anti"):
-            return None
-        if not right_batches and jt in ("inner", "left_semi"):
-            return None
-        lsch = self.children[0].schema
-        rsch = self.children[1].schema
-        left = (concat_batches(left_batches) if left_batches else None)
-        right = (concat_batches(right_batches) if right_batches else None)
-        lk = self._key_ordinals(0, self.left_keys)
-        rk = self._key_ordinals(1, self.right_keys)
-        if left is None:
-            if jt in ("right", "full"):
-                return self._right_only(right, rsch, lsch)
-            return None
-        if right is None:
-            if jt == "left_anti":
-                return left
-            if jt in ("left", "full"):
-                return self._left_unmatched_all(left, rsch)
-            return None
-
-        bt = joinops.build_side(right, rk)
-        lo, counts = joinops.probe_ranges(bt, left, lk)
-
-        if jt == "left_semi":
-            return filterops.compact(left, counts > 0)
-        if jt == "left_anti":
-            return filterops.compact(left, counts == 0)
-
-        eff_counts = counts
-        if jt in ("left", "full"):
-            live = left.live_mask()
-            eff_counts = jnp.where(live & (counts == 0), 1, counts)
-        total = int(jax.device_get(jnp.sum(eff_counts)))
-        extra = 0
-        matched_build = None
-        if jt == "full":
-            matched_build = self._matched_build_mask(bt, lo, counts)
-            extra = int(jax.device_get(
-                jnp.sum(~matched_build &
-                        bt.batch.live_mask())))
-        cap_out = next_capacity(total + extra)
-        pi, bi, _ = joinops.expand_gather_maps(lo, eff_counts, cap_out)
-        lcols = [c.gather(pi) for c in left.columns]
-        rcols = [c.gather(jnp.clip(bi, 0, right.capacity - 1))
-                 for c in bt.batch.columns]
-        if jt in ("left", "full"):
-            # rows that were fabricated for unmatched left rows: null right
-            unmatched = (counts == 0)
-            row_unmatched = jnp.take(unmatched, pi)
-            rcols = [DeviceColumn(c.dtype, c.data,
-                                  c.validity & ~row_unmatched, c.lengths)
-                     for c in rcols]
-        out_cols = lcols + rcols
-        out_schema = StructType(list(lsch.fields) + list(rsch.fields))
-        out = ColumnBatch(out_schema, out_cols, total)
-        if jt == "full" and extra > 0:
-            unmatched_right = filterops.compact(
-                bt.batch, ~matched_build)
-            pad = self._left_nulls_batch(lsch, unmatched_right)
-            out = concat_batches([out, pad])
-        return out
-
-    def _matched_build_mask(self, bt, lo, counts):
-        cap = bt.batch.capacity
-        delta = jnp.zeros((cap + 1,), jnp.int32)
-        hi = lo + counts
-        delta = delta.at[jnp.clip(lo, 0, cap)].add(
-            jnp.where(counts > 0, 1, 0))
-        delta = delta.at[jnp.clip(hi, 0, cap)].add(
-            jnp.where(counts > 0, -1, 0))
-        return jnp.cumsum(delta[:-1]) > 0
-
-    def _right_only(self, right, rsch, lsch):
-        pad = self._left_nulls_batch(lsch, right)
-        return pad
-
-    def _left_nulls_batch(self, lsch, right_batch):
-        """Rows with all-null left columns + the given right rows."""
-        cap = right_batch.capacity
-        from spark_rapids_tpu.columnar.batch import empty_like_schema
-
-        nulls = empty_like_schema(lsch, cap)
-        cols = nulls.columns + right_batch.columns
-        schema = StructType(list(lsch.fields) +
-                            list(right_batch.schema.fields))
-        return ColumnBatch(schema, cols, right_batch.num_rows)
-
-    def _left_unmatched_all(self, left, rsch):
-        cap = left.capacity
-        from spark_rapids_tpu.columnar.batch import empty_like_schema
-
-        nulls = empty_like_schema(rsch, cap)
-        schema = StructType(list(left.schema.fields) + list(rsch.fields))
-        return ColumnBatch(schema, left.columns + nulls.columns,
-                           left.num_rows)
-
-
-class CpuJoinExec(PhysicalPlan):
-    is_tpu = False
-
-    _ARROW_TYPE = {"inner": "inner", "left": "left outer",
-                   "right": "right outer", "full": "full outer",
-                   "left_semi": "left semi", "left_anti": "left anti"}
-
-    def __init__(self, left, right, join_type, left_keys, right_keys,
-                 schema, conf):
-        super().__init__([left, right], schema, conf)
-        self.join_type = join_type
-        self.left_keys = left_keys
-        self.right_keys = right_keys
-
-    def execute_partition(self, pid, ctx):
-        lt = list(self.children[0].execute_partition(pid, ctx))
-        rt = list(self.children[1].execute_partition(pid, ctx))
-        if not lt and not rt:
-            return
-        lsch = self.children[0].schema
-        rsch = self.children[1].schema
-
-        def mk(tables, sch):
-            if tables:
-                return pa.concat_tables(tables, promote_options="none")
-            arrow_schema = pa.schema([
-                pa.field(f.name, to_arrow_type(f.dataType))
-                for f in sch.fields])
-            return arrow_schema.empty_table()
-
-        left = mk(lt, lsch)
-        right = mk(rt, rsch)
-        lnames = [lsch.names[k.ordinal] for k in self.left_keys]
-        rnames = [rsch.names[k.ordinal] for k in self.right_keys]
-        joined = left.join(
-            right, keys=lnames, right_keys=rnames,
-            join_type=self._ARROW_TYPE[self.join_type],
-            coalesce_keys=False)
-        # arrow drops right keys on coalesce; with coalesce_keys=False it
-        # keeps both and may reorder columns — normalize to schema order
-        want = self.schema.names
-        have = joined.column_names
-        cols = []
-        for i, nm in enumerate(want):
-            idx = have.index(nm)
-            cols.append(joined.column(idx))
-            have[idx] = None  # consume duplicates in order
-        yield pa.table(dict(zip(want, cols))) if len(set(want)) == len(
-            want) else pa.Table.from_arrays(
-                [c.combine_chunks() for c in cols], names=want)
+from spark_rapids_tpu.exec.joins import (  # noqa: E402,F401
+    CpuJoinExec,
+    TpuBroadcastHashJoinExec,
+    TpuBroadcastNestedLoopJoinExec,
+    TpuShuffledHashJoinExec,
+)
 
 
 # ------------------------------------------------------------------- sort

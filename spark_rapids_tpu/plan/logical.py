@@ -142,22 +142,34 @@ class Aggregate(LogicalPlan):
 
 
 class Join(LogicalPlan):
-    SUPPORTED = ("inner", "left", "right", "left_semi", "left_anti", "full")
+    SUPPORTED = ("inner", "left", "right", "left_semi", "left_anti", "full",
+                 "cross", "existence")
 
     def __init__(self, left: LogicalPlan, right: LogicalPlan,
                  join_type: str, left_keys: List[Expression],
-                 right_keys: List[Expression]):
+                 right_keys: List[Expression],
+                 condition: Optional[Expression] = None,
+                 exists_name: str = "exists"):
         super().__init__([left, right])
         assert join_type in self.SUPPORTED, join_type
         self.join_type = join_type
         self.left_keys = left_keys
         self.right_keys = right_keys
+        # bound against [left fields | right fields] ordinals
+        self.condition = condition
+        self.exists_name = exists_name
 
     @property
     def schema(self):
+        from spark_rapids_tpu.sqltypes.datatypes import boolean
+
         lt, rt = self.children[0].schema, self.children[1].schema
         if self.join_type in ("left_semi", "left_anti"):
             return lt
+        if self.join_type == "existence":
+            return StructType(list(lt.fields) +
+                              [StructField(self.exists_name, boolean,
+                                           False)])
         fields = list(lt.fields)
         rn = [StructField(f.name, f.dataType,
                           True if self.join_type in ("left", "full")
@@ -256,3 +268,36 @@ class Repartition(LogicalPlan):
     @property
     def schema(self):
         return self.children[0].schema
+
+
+def estimate_size_bytes(plan: LogicalPlan) -> Optional[int]:
+    """Best-effort plan-size estimate for broadcast decisions (the
+    reference relies on Spark's statistics + autoBroadcastJoinThreshold;
+    standalone, we estimate from source sizes and propagate up).
+    Returns None when unknown (joins/aggregates change cardinality)."""
+    import os
+
+    if isinstance(plan, LocalRelation):
+        return plan.table.nbytes
+    if isinstance(plan, Range):
+        step = plan.step or 1
+        total = max(0, (plan.end - plan.start + step -
+                        (1 if step > 0 else -1)) // step)
+        return total * 8
+    if isinstance(plan, FileScan):
+        from spark_rapids_tpu.io import readers
+
+        try:
+            files = readers.expand_paths(plan.paths, "." + plan.fmt)
+            return sum(os.path.getsize(f) for f in files)
+        except OSError:
+            return None
+    if isinstance(plan, (Project, Filter, Sort, Limit, Repartition,
+                         Window)):
+        return estimate_size_bytes(plan.children[0])
+    if isinstance(plan, Union):
+        sizes = [estimate_size_bytes(c) for c in plan.children]
+        if any(s is None for s in sizes):
+            return None
+        return sum(sizes)
+    return None

@@ -84,6 +84,9 @@ class TpuOverrides:
             for e in node.left_keys + node.right_keys:
                 for r in expr_unsupported_reasons(e):
                     meta.cannot_run(r)
+            if node.condition is not None:
+                for r in expr_unsupported_reasons(node.condition):
+                    meta.cannot_run(r)
         elif isinstance(node, L.Sort):
             for o in node.orders:
                 for r in expr_unsupported_reasons(o.expr):
@@ -256,6 +259,8 @@ class TpuOverrides:
 
     def _convert_join(self, node: L.Join, children: List[PhysicalPlan],
                       on_device: bool) -> PhysicalPlan:
+        from spark_rapids_tpu.exec.joins import swap_condition
+
         conf = self.conf
         left, right = children
         if not on_device:
@@ -263,42 +268,39 @@ class TpuOverrides:
                 self._single(self._to_host(left)),
                 self._single(self._to_host(right)),
                 node.join_type, node.left_keys, node.right_keys,
-                node.schema, conf)
+                node.schema, conf, condition=node.condition)
         shuffle_parts = conf.get(rc.SHUFFLE_PARTITIONS)
         left = self._to_device(left)
         right = self._to_device(right)
         join_type = node.join_type
         left_keys, right_keys = node.left_keys, node.right_keys
+        condition = node.condition
+        n_l = len(node.children[0].schema.fields)
+        n_r = len(node.children[1].schema.fields)
+        build_logical = node.children[1]
         swapped = join_type == "right"
         if swapped:
             # right outer = swapped left outer + column reorder
             left, right = right, left
             left_keys, right_keys = right_keys, left_keys
             join_type = "left"
-        both_single = (left.num_partitions == 1 and
-                       right.num_partitions == 1)
-        if not both_single:
-            left = ops.TpuShuffleExchangeExec(
-                left, left_keys, shuffle_parts, conf)
-            right = ops.TpuShuffleExchangeExec(
-                right, right_keys, shuffle_parts, conf)
+            build_logical = node.children[0]
+            if condition is not None:
+                condition = swap_condition(condition, n_l, n_r)
+        exec_schema = (self._swapped_schema(left, right) if swapped
+                       else node.schema)
+        if not left_keys or join_type == "cross":
+            joined = self._nested_loop_join(
+                left, right, join_type, condition, exec_schema)
+        else:
+            joined = self._hash_join(
+                left, right, join_type, left_keys, right_keys, condition,
+                exec_schema, build_logical, shuffle_parts)
         if not swapped:
-            return ops.TpuShuffledHashJoinExec(
-                left, right, join_type, left_keys, right_keys,
-                node.schema, conf)
-        from spark_rapids_tpu.sqltypes import StructField, StructType
-
-        lsch = left.schema    # original right side
-        rsch = right.schema   # original left side
-        swapped_schema = StructType(
-            [StructField(f.name, f.dataType, True) for f in lsch.fields] +
-            [StructField(f.name, f.dataType, f.nullable)
-             for f in rsch.fields])
-        joined = ops.TpuShuffledHashJoinExec(
-            left, right, join_type, left_keys, right_keys,
-            swapped_schema, conf)
-        n_r = len(lsch.fields)
-        n_l = len(rsch.fields)
+            return joined
+        # swapped layout is [orig-right fields | orig-left fields];
+        # reorder back to node.schema = [left | right]
+        swapped_schema = joined.schema
         reorder = [Alias(BoundReference(n_r + i,
                                         swapped_schema.fields[n_r + i]
                                         .dataType, True),
@@ -310,6 +312,49 @@ class TpuOverrides:
                           swapped_schema.fields[i].name)
                     for i in range(n_r)]
         return ops.TpuProjectExec(reorder, joined, node.schema, conf)
+
+    def _swapped_schema(self, left, right):
+        from spark_rapids_tpu.sqltypes import StructField, StructType
+
+        return StructType(
+            [StructField(f.name, f.dataType, True)
+             for f in left.schema.fields] +
+            [StructField(f.name, f.dataType, f.nullable)
+             for f in right.schema.fields])
+
+    def _hash_join(self, left, right, join_type, left_keys, right_keys,
+                   condition, exec_schema, build_logical, shuffle_parts):
+        conf = self.conf
+        threshold = conf.get(rc.BROADCAST_THRESHOLD)
+        est = L.estimate_size_bytes(build_logical)
+        broadcastable = (threshold >= 0 and est is not None and
+                         est <= threshold and
+                         join_type in ("inner", "left", "left_semi",
+                                       "left_anti", "existence"))
+        if broadcastable:
+            return ops.TpuBroadcastHashJoinExec(
+                left, right, join_type, left_keys, right_keys,
+                exec_schema, conf, condition=condition)
+        both_single = (left.num_partitions == 1 and
+                       right.num_partitions == 1)
+        if not both_single:
+            left = ops.TpuShuffleExchangeExec(
+                left, left_keys, shuffle_parts, conf)
+            right = ops.TpuShuffleExchangeExec(
+                right, right_keys, shuffle_parts, conf)
+        return ops.TpuShuffledHashJoinExec(
+            left, right, join_type, left_keys, right_keys,
+            exec_schema, conf, condition=condition)
+
+    def _nested_loop_join(self, left, right, join_type, condition,
+                          exec_schema):
+        conf = self.conf
+        if join_type == "full":
+            # build-match tracking must be partition-local
+            left = self._single(left)
+        return ops.TpuBroadcastNestedLoopJoinExec(
+            left, right, join_type, exec_schema, conf,
+            condition=condition)
 
     def _single(self, plan: PhysicalPlan) -> PhysicalPlan:
         if plan.num_partitions == 1:

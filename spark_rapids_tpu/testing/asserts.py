@@ -48,9 +48,13 @@ def _sort_table(t: pa.Table) -> pa.Table:
 
     if t.num_rows <= 1 or t.num_columns == 0:
         return t
-    keys = [(n, "ascending") for n in t.column_names]
+    # duplicate output names are legal (join keeps both sides' columns);
+    # sort through a uniquely-renamed view
+    uniq = [f"c{i}" for i in range(t.num_columns)]
+    view = t.rename_columns(uniq)
+    keys = [(n, "ascending") for n in uniq]
     try:
-        return t.take(pc.sort_indices(t, sort_keys=keys,
+        return t.take(pc.sort_indices(view, sort_keys=keys,
                                       null_placement="at_start"))
     except pa.ArrowNotImplementedError:
         return t
@@ -76,9 +80,9 @@ def assert_tables_equal(tpu: pa.Table, cpu: pa.Table,
         f"row count mismatch: tpu={tpu.num_rows} cpu={cpu.num_rows}"
     if ignore_order:
         tpu, cpu = _sort_table(tpu), _sort_table(cpu)
-    for name in tpu.column_names:
-        av = tpu.column(name).to_pylist()
-        bv = cpu.column(name).to_pylist()
+    for ci, name in enumerate(tpu.column_names):
+        av = tpu.column(ci).to_pylist()
+        bv = cpu.column(ci).to_pylist()
         for i, (x, y) in enumerate(zip(av, bv)):
             assert _values_equal(x, y, rel_tol), (
                 f"column {name!r} row {i}: tpu={x!r} cpu={y!r}")

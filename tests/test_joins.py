@@ -1,0 +1,212 @@
+"""Join family differential tests: cross, conditional (mixed), broadcast
+hash, nested-loop, and existence joins — TPU device path vs CPU oracle
+(reference: integration_tests join_test.py; GpuHashJoin.scala,
+GpuBroadcastHashJoinExecBase.scala, GpuBroadcastNestedLoopJoinExecBase
+.scala, ExistenceJoin.scala).
+"""
+
+import pytest
+
+from spark_rapids_tpu.testing.asserts import (
+    assert_tpu_and_cpu_are_equal_collect,
+    with_cpu_session,
+    with_tpu_session,
+)
+
+_CONF = {"spark.sql.shuffle.partitions": 4}
+_NO_BROADCAST = {"spark.sql.shuffle.partitions": 4,
+                 "spark.sql.autoBroadcastJoinThreshold": -1}
+
+
+def _ab(s, n=40):
+    a = s.createDataFrame({
+        "k": [i % 7 for i in range(n)],
+        "x": [i * 3 % 11 for i in range(n)],
+    })
+    b = s.createDataFrame({
+        "k": [i % 5 for i in range(15)],
+        "y": [i * 2 for i in range(15)],
+    })
+    return a, b
+
+
+def test_cross_join():
+    def q(s):
+        a, b = _ab(s, 12)
+        return a.crossJoin(b)
+
+    assert_tpu_and_cpu_are_equal_collect(q, conf=_CONF)
+
+
+def test_cross_join_empty_side():
+    def q(s):
+        import pyarrow as pa
+
+        a, _ = _ab(s, 6)
+        e = s.createDataFrame(pa.table({
+            "k": pa.array([], type=pa.int64()),
+            "y": pa.array([], type=pa.int64())}))
+        return a.crossJoin(e)
+
+    assert_tpu_and_cpu_are_equal_collect(q, conf=_CONF)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "full", "left_semi",
+                                 "left_anti"])
+def test_conditional_equi_join(how):
+    """Equi keys + an extra non-equi condition (cuDF mixed-join analog)."""
+
+    def q(s):
+        a, b = _ab(s)
+        joined = a.join(b, (a["k"] == b["k"]) & (a["x"] < b["y"]), how=how)
+        if how in ("left_semi", "left_anti"):
+            return joined.select("k", "x")
+        return joined
+
+    assert_tpu_and_cpu_are_equal_collect(q, conf=_CONF)
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+def test_condition_only_join(how):
+    """No equi keys at all -> nested loop join."""
+
+    def q(s):
+        a, b = _ab(s, 15)
+        return a.join(b, a["x"] < b["y"], how=how)
+
+    assert_tpu_and_cpu_are_equal_collect(q, conf=_CONF)
+
+
+def test_condition_only_full_join():
+    def q(s):
+        a, b = _ab(s, 10)
+        return a.join(b, a["x"] < b["y"], how="full")
+
+    assert_tpu_and_cpu_are_equal_collect(q, conf=_CONF)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full"])
+def test_expression_join_keys_only(how):
+    """Expression condition that is entirely equi-conjuncts."""
+
+    def q(s):
+        a, b = _ab(s)
+        return a.join(b, a["k"] == b["k"], how=how)
+
+    assert_tpu_and_cpu_are_equal_collect(q, conf=_CONF)
+
+
+def test_right_join_with_condition():
+    def q(s):
+        a, b = _ab(s)
+        return a.join(b, (a["k"] == b["k"]) & (b["y"] > 4), how="right")
+
+    assert_tpu_and_cpu_are_equal_collect(q, conf=_CONF)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "left_semi", "left_anti"])
+def test_broadcast_vs_shuffled_same_result(how):
+    """The broadcast planning path (small build side) must agree with the
+    forced-shuffle path."""
+
+    def q(s):
+        a, b = _ab(s, 60)
+        joined = a.join(b, on="k", how=how)
+        cols = ["k", "x"] if how in ("left_semi", "left_anti") \
+            else ["k", "x", "y"]
+        return joined.select(*cols)
+
+    bcast = with_tpu_session(lambda s: q(s).collect_arrow(), conf=_CONF)
+    shuf = with_tpu_session(lambda s: q(s).collect_arrow(),
+                            conf=_NO_BROADCAST)
+    cpu = with_cpu_session(lambda s: q(s).collect_arrow(), conf=_CONF)
+    from spark_rapids_tpu.testing.asserts import assert_tables_equal
+
+    assert_tables_equal(bcast, cpu)
+    assert_tables_equal(shuf, cpu)
+
+
+def test_broadcast_plan_selected():
+    """Plan inspection: small build side -> broadcast hash join exec."""
+    from spark_rapids_tpu.exec.joins import (
+        TpuBroadcastHashJoinExec,
+        TpuShuffledHashJoinExec,
+    )
+
+    def plan_of(s, conf_threshold):
+        a, b = _ab(s, 60)
+        df = a.join(b, on="k", how="inner")
+        phys, _ = df._physical()
+        kinds = set()
+
+        def walk(p):
+            kinds.add(type(p))
+            for c in p.children:
+                walk(c)
+
+        walk(phys)
+        return kinds
+
+    kinds = with_tpu_session(lambda s: plan_of(s, None), conf=_CONF)
+    assert TpuBroadcastHashJoinExec in kinds
+    kinds = with_tpu_session(lambda s: plan_of(s, -1), conf=_NO_BROADCAST)
+    assert TpuShuffledHashJoinExec in kinds
+    assert TpuBroadcastHashJoinExec not in kinds
+
+
+def test_existence_join():
+    """Existence join (IN-subquery planning shape): left rows + bool."""
+    import pyarrow as pa
+
+    from spark_rapids_tpu.plan import logical as L
+    from spark_rapids_tpu.testing.asserts import assert_tables_equal
+
+    def run(s):
+        a, b = _ab(s, 20)
+        plan = L.Join(a._plan, b._plan, "existence",
+                      [a["k"].expr], [b["k"].expr], exists_name="has_dim")
+        from spark_rapids_tpu.api.dataframe import DataFrame
+
+        return DataFrame(plan, s).collect_arrow()
+
+    tpu = with_tpu_session(run, conf=_CONF)
+    cpu = with_cpu_session(run, conf=_CONF)
+    assert isinstance(tpu, pa.Table)
+    assert tpu.column("has_dim").type == pa.bool_()
+    assert_tables_equal(tpu, cpu)
+
+
+def test_join_key_type_promotion_expression():
+    def q(s):
+        a = s.createDataFrame({"k": [1, 2, 3, 4],
+                               "x": [1.0, 2.0, 3.0, 4.0]})
+        b = s.createDataFrame({"j": [2.0, 3.0, 5.0], "y": [20, 30, 50]})
+        return a.join(b, a["k"] == b["j"], how="inner")
+
+    assert_tpu_and_cpu_are_equal_collect(q, conf=_CONF)
+
+
+def test_self_join_same_names():
+    def q(s):
+        a, b = _ab(s, 25)
+        c = b.withColumnRenamed("y", "x")
+        return a.join(c, on="k", how="inner")
+
+    assert_tpu_and_cpu_are_equal_collect(q, conf=_CONF)
+
+
+def test_conditional_join_with_nulls():
+    def q(s):
+        import pyarrow as pa
+
+        a = s.createDataFrame(pa.table({
+            "k": pa.array([1, None, 2, 3, None, 2], type=pa.int64()),
+            "x": pa.array([1, 2, None, 4, 5, 6], type=pa.int64()),
+        }))
+        b = s.createDataFrame(pa.table({
+            "k": pa.array([2, 3, None, 4], type=pa.int64()),
+            "y": pa.array([5, None, 7, 8], type=pa.int64()),
+        }))
+        return a.join(b, (a["k"] == b["k"]) & (a["x"] < b["y"]), how="left")
+
+    assert_tpu_and_cpu_are_equal_collect(q, conf=_CONF)
