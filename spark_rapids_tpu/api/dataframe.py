@@ -278,12 +278,14 @@ class DataFrame:
                "rightouter": "right", "leftsemi": "left_semi",
                "semi": "left_semi", "leftanti": "left_anti",
                "anti": "left_anti"}.get(how, how)
-        if on is None or how == "cross":
-            assert on is None, "cross join takes no join keys"
+        if on is None:
             if how not in ("inner", "cross"):
                 raise ValueError(
                     f"join type {how!r} requires join keys or a condition")
             return self.crossJoin(other)
+        if how == "cross":
+            # keys given: Spark treats cross-with-keys as an equi join
+            how = "inner"
         if isinstance(on, str):
             on = [on]
         if isinstance(on, Column) or isinstance(on, Expression):

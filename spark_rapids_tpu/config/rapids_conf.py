@@ -114,10 +114,11 @@ SPILL_DIR = conf(
     startup_only=True)
 OOM_INJECTION_MODE = conf(
     "spark.rapids.memory.gpu.oomInjection.mode", "none",
-    "Fault injection for retry tests: none|once|always — injected at "
-    "allocation points, the RmmSpark forced-OOM analog "
-    "(reference test framework, SURVEY.md section 4).", str,
-    checker=lambda v: v in ("none", "once", "always"))
+    "Fault injection for retry tests: none|once|always|split_once — "
+    "injected at allocation points, the RmmSpark forced-OOM analog "
+    "(reference test framework, SURVEY.md section 4). split_once raises "
+    "TpuSplitAndRetryOOM (the GpuSplitAndRetryOOM analog) one time.", str,
+    checker=lambda v: v in ("none", "once", "always", "split_once"))
 RETRY_SPLIT_LIMIT = conf(
     "spark.rapids.sql.retry.splitLimit", 16,
     "Maximum times a batch may be halved by split-and-retry before the "

@@ -306,12 +306,38 @@ class _DeviceJoinBase(PhysicalPlan):
 
 class TpuShuffledHashJoinExec(_DeviceJoinBase):
     """Partitioned equi-join; children must be co-partitioned by key
-    (the planner inserts exchanges). Right side is the build side."""
+    (the planner inserts exchanges). Right side is the build side.
+    Oversized build sides fall back to key-hash sub-partitioning
+    (GpuSubPartitionHashJoin.scala): both sides are split into K
+    co-partitioned pieces joined independently, bounding the working
+    set."""
 
     def __init__(self, left, right, join_type, left_keys, right_keys,
                  schema, conf, condition: Optional[Expression] = None):
         super().__init__(left, right, join_type, left_keys, right_keys,
                          condition, schema, conf)
+
+    def _build_size_target(self) -> int:
+        from spark_rapids_tpu.config import rapids_conf as rc
+
+        return (self.conf.get(rc.BATCH_SIZE_BYTES) if self.conf
+                else 1 << 30)
+
+    def _hash_split(self, batch: ColumnBatch, keys, nparts: int
+                    ) -> List[Optional[ColumnBatch]]:
+        """Split one batch into nparts key-hash co-partitions (seeded
+        differently from the shuffle so the split is non-degenerate
+        post-exchange)."""
+        from spark_rapids_tpu.ops import partition as P
+
+        work, kidx = self._prepare_keys(batch, keys)
+        parts = P.split_to_slices(work, kidx, nparts,
+                                  seed=P.SUB_PARTITION_SEED)
+        if len(work.columns) != len(batch.columns):
+            n0 = len(batch.columns)
+            parts = [p.select(list(range(n0))) if p is not None else None
+                     for p in parts]
+        return parts
 
     def execute_partition(self, pid, ctx):
         with self.metrics[M.JOIN_TIME].ns():
@@ -319,6 +345,22 @@ class TpuShuffledHashJoinExec(_DeviceJoinBase):
                 self.children[1].execute_partition(pid, ctx))
             left_batches = list(
                 self.children[0].execute_partition(pid, ctx))
+            build_bytes = sum(b.device_size_bytes()
+                              for b in right_batches)
+            target = self._build_size_target()
+            if build_bytes > target and left_batches and right_batches:
+                nparts = max(2, -(-build_bytes // target))
+                right = concat_batches(right_batches)
+                left = concat_batches(left_batches)
+                rparts = self._hash_split(right, self.right_keys, nparts)
+                lparts = self._hash_split(left, self.left_keys, nparts)
+                for lp, rp in zip(lparts, rparts):
+                    out = self._join_batches(
+                        [lp] if lp is not None else [],
+                        [rp] if rp is not None else [])
+                    if out is not None:
+                        yield out
+                return
             out = self._join_batches(left_batches, right_batches)
             if out is not None:
                 yield out

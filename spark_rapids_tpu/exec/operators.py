@@ -49,7 +49,6 @@ from spark_rapids_tpu.expr import Alias, BoundReference, EvalContext
 from spark_rapids_tpu.expr.aggregates import AggregateFunction
 from spark_rapids_tpu.io import readers
 from spark_rapids_tpu.ops import filterops, partition, segmented
-from spark_rapids_tpu.ops.common import orderable_keys, sort_permutation
 from spark_rapids_tpu.plan.logical import SortOrder
 from spark_rapids_tpu.runtime import semaphore as sem
 from spark_rapids_tpu.runtime import metrics as M
@@ -308,6 +307,7 @@ class TpuHashAggregateExec(PhysicalPlan):
         super().__init__([child], out_schema, conf)
         self._jit_partial = jax.jit(self._partial)
         self._jit_merge = jax.jit(self._merge_final)
+        self._jit_merge_buffers = jax.jit(self._merge_buffers)
 
     # --- phases (each a single XLA program) ---
 
@@ -353,10 +353,8 @@ class TpuHashAggregateExec(PhysicalPlan):
         return ColumnBatch(_buffer_schema(self.grouping, self.aggs),
                            out_cols, g.num_groups)
 
-    def _merge_final(self, batch: ColumnBatch) -> ColumnBatch:
-        nkeys = len(self.grouping)
-        g = self._grouped(batch, list(range(nkeys)))
-        cap = batch.capacity
+    def _merge_keys_prefix(self, g, nkeys: int, cap: int
+                           ) -> List[DeviceColumn]:
         out_cols: List[DeviceColumn] = []
         for ki in range(nkeys):
             col = g.sorted_batch.columns[ki]
@@ -365,6 +363,13 @@ class TpuHashAggregateExec(PhysicalPlan):
                 col.dtype, jnp.take(col.data, safe, axis=0),
                 jnp.take(col.validity, safe),
                 None if col.lengths is None else jnp.take(col.lengths, safe)))
+        return out_cols
+
+    def _merge_final(self, batch: ColumnBatch) -> ColumnBatch:
+        nkeys = len(self.grouping)
+        g = self._grouped(batch, list(range(nkeys)))
+        cap = batch.capacity
+        out_cols = self._merge_keys_prefix(g, nkeys, cap)
         ci = nkeys
         for a in self.aggs:
             fn: AggregateFunction = a.children[0]
@@ -375,24 +380,116 @@ class TpuHashAggregateExec(PhysicalPlan):
             out_cols.append(fn.evaluate(merged))
         return ColumnBatch(self.schema, out_cols, g.num_groups)
 
+    def _merge_buffers(self, batch: ColumnBatch) -> ColumnBatch:
+        """Merge partial buffers into compacted buffers WITHOUT final
+        evaluation — the reference's merge pass over concatenated
+        partials (GpuAggregateExec merge mode), used to bound memory
+        while more input is still arriving."""
+        nkeys = len(self.grouping)
+        g = self._grouped(batch, list(range(nkeys)))
+        cap = batch.capacity
+        out_cols = self._merge_keys_prefix(g, nkeys, cap)
+        ci = nkeys
+        for a in self.aggs:
+            fn: AggregateFunction = a.children[0]
+            nb = len(fn.buffer_types())
+            bufs = [g.sorted_batch.columns[ci + j] for j in range(nb)]
+            ci += nb
+            out_cols.extend(fn.merge(bufs, g.live, g.gid, cap))
+        return ColumnBatch(_buffer_schema(self.grouping, self.aggs),
+                           out_cols, g.num_groups)
+
+    # --- out-of-core driver ---
+
     def execute_partition(self, pid, ctx):
+        from spark_rapids_tpu.config import rapids_conf as rc
+        from spark_rapids_tpu.runtime.memory import get_catalog
+        from spark_rapids_tpu.runtime.retry import with_retry
+
+        from spark_rapids_tpu.runtime.retry import retry_on_oom
+
+        catalog = get_catalog()
+        target_rows = (self.conf.get(rc.BATCH_SIZE_ROWS) if self.conf
+                       else 1 << 20)
+
+        def park(b):
+            return retry_on_oom(lambda: catalog.add_batch(b))
+
         with self.metrics[M.AGG_TIME].ns():
-            batches = list(self.children[0].execute_partition(pid, ctx))
-            if not batches:
+            pending = []  # spillable buffer-schema batches
+            pending_rows = 0
+
+            def reduce_pending():
+                nonlocal pending, pending_rows
+
+                def step():
+                    batches = [sb.get_batch() for sb in pending]
+                    merged = concat_batches(batches) if len(batches) > 1 \
+                        else batches[0]
+                    with catalog.reserved(merged.device_size_bytes(),
+                                          "agg_merge"):
+                        return self._jit_merge_buffers(merged)
+
+                compacted = retry_on_oom(step)
+                for sb in pending:
+                    sb.close()
+                pending = [park(compacted)]
+                pending_rows = compacted.row_count()
+
+            for batch in self.children[0].execute_partition(pid, ctx):
+                if self.mode == "final":
+                    pending.append(park(batch))
+                    pending_rows += batch.row_count()
+                else:
+                    sb = park(batch)
+
+                    def part_fn(s):
+                        b = s.get_batch()
+                        with catalog.reserved(b.device_size_bytes(),
+                                              "agg_partial"):
+                            return self._jit_partial(b)
+
+                    for part in with_retry(sb, part_fn):
+                        pending.append(park(part))
+                        pending_rows += part.row_count()
+                if len(pending) > 1 and pending_rows > 2 * target_rows:
+                    reduce_pending()
+
+            if not pending:
                 if len(self.grouping) == 0 and self.mode in ("final",
                                                              "complete"):
                     # global agg over empty input -> one default row
                     yield self._empty_global_result()
                 return
+            batches = [sb.get_batch() for sb in pending]
             merged = concat_batches(batches) if len(batches) > 1 \
                 else batches[0]
+            for sb in pending:
+                sb.close()
             if self.mode == "partial":
-                yield self._jit_partial(merged)
-            elif self.mode == "final":
-                yield self._jit_merge(merged)
+                yield self._jit_merge_buffers(merged)
+                return
+            if (self.grouping and
+                    merged.row_count() > max(target_rows, 1)):
+                # high-cardinality fallback: re-partition buffers by key
+                # hash and finalize each part separately (the reference's
+                # repartition-based agg fallback, GpuAggregateExec)
+                yield from self._finalize_partitioned(merged)
             else:
-                part = self._jit_partial(merged)
-                yield self._jit_merge(part)
+                yield self._jit_merge(merged)
+
+    def _finalize_partitioned(self, merged: ColumnBatch):
+        from spark_rapids_tpu.config import rapids_conf as rc
+        from spark_rapids_tpu.ops import partition as P
+
+        target_rows = (self.conf.get(rc.BATCH_SIZE_ROWS) if self.conf
+                       else 1 << 20)
+        nparts = max(2, -(-merged.row_count() // max(target_rows, 1)))
+        key_idx = list(range(len(self.grouping)))
+        for piece in P.split_to_slices(merged, key_idx, nparts,
+                                       seed=P.SUB_PARTITION_SEED):
+            if piece is not None:
+                yield self._jit_merge(piece)
 
     def _empty_global_result(self):
         cols = []
@@ -643,30 +740,78 @@ from spark_rapids_tpu.exec.joins import (  # noqa: E402,F401
 # ------------------------------------------------------------------- sort
 
 class TpuSortExec(PhysicalPlan):
+    """Out-of-core sort (GpuSortExec.scala:151-633): sort each input
+    batch into a spillable run, then merge runs pairwise with the
+    no-resort merge kernel. Peak device residency is two runs + output;
+    parked runs spill under pressure and per-run work retries/splits on
+    OOM."""
+
     def __init__(self, orders: List[SortOrder], child, conf):
         super().__init__([child], child.schema, conf)
         self.orders = orders
         self._jitted = jax.jit(self._run)
+        from spark_rapids_tpu.ops import sortops
+
+        self._jit_merge = jax.jit(
+            lambda a, b, cap: sortops.merge_sorted(a, b, self.orders,
+                                                   out_cap=cap),
+            static_argnums=2)
 
     def _run(self, batch: ColumnBatch) -> ColumnBatch:
-        live = batch.live_mask()
-        keys = []
-        ctx = EvalContext(batch)
-        for o in self.orders:
-            col = o.expr.eval(ctx)
-            keys.extend(orderable_keys(col, o.ascending, o.nulls_first,
-                                       live))
-        perm = sort_permutation(keys, batch.capacity)
-        return batch.gather(perm, batch.num_rows)
+        from spark_rapids_tpu.ops import sortops
+
+        return sortops.sort_batch(batch, self.orders)
 
     def execute_partition(self, pid, ctx):
+        from spark_rapids_tpu.runtime.memory import get_catalog
+        from spark_rapids_tpu.runtime.retry import with_retry
+
+        from spark_rapids_tpu.runtime.retry import retry_on_oom
+
+        catalog = get_catalog()
         with self.metrics[M.SORT_TIME].ns():
-            batches = list(self.children[0].execute_partition(pid, ctx))
-            if not batches:
+            runs = []  # spillable sorted runs
+            for batch in self.children[0].execute_partition(pid, ctx):
+                sb = retry_on_oom(lambda b=batch: catalog.add_batch(b))
+
+                def sort_fn(s):
+                    b = s.get_batch()
+                    with catalog.reserved(b.device_size_bytes(),
+                                          "sort_batch"):
+                        return self._jitted(b)
+
+                for run in with_retry(sb, sort_fn):
+                    runs.append(retry_on_oom(
+                        lambda r=run: catalog.add_batch(r)))
+            if not runs:
                 return
-            merged = concat_batches(batches) if len(batches) > 1 \
-                else batches[0]
-            yield self._jitted(merged)
+            while len(runs) > 1:
+                nxt = []
+                for i in range(0, len(runs) - 1, 2):
+                    from spark_rapids_tpu.runtime.retry import retry_on_oom
+
+                    out_cap = next_capacity(runs[i].row_count() +
+                                            runs[i + 1].row_count())
+
+                    def step(ra=runs[i], rb=runs[i + 1], cap=out_cap):
+                        a = ra.get_batch()
+                        b = rb.get_batch()
+                        with catalog.reserved(
+                                a.device_size_bytes() +
+                                b.device_size_bytes(), "sort_merge"):
+                            return self._jit_merge(a, b, cap)
+
+                    m = retry_on_oom(step)
+                    runs[i].close()
+                    runs[i + 1].close()
+                    nxt.append(retry_on_oom(
+                        lambda mm=m: catalog.add_batch(mm)))
+                if len(runs) % 2:
+                    nxt.append(runs[-1])
+                runs = nxt
+            out = runs[0].get_batch()
+            runs[0].close()
+            yield out
 
 
 class CpuSortExec(PhysicalPlan):

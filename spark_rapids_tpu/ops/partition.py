@@ -57,3 +57,34 @@ def round_robin_partition(batch: ColumnBatch, num_partitions: int,
     pid = ((jnp.arange(batch.capacity, dtype=jnp.int32) + start)
            % num_partitions)
     return partition_by_ids(batch, pid, num_partitions)
+
+
+# Distinct from the shuffle's seed-42 partitioning so re-partitioning
+# data that already went through an exchange is non-degenerate
+# (GpuSubPartitionHashJoin uses a different seed for the same reason).
+SUB_PARTITION_SEED = 1091
+
+
+def split_to_slices(batch: ColumnBatch, key_idxs: Sequence[int],
+                    num_partitions: int, seed: int):
+    """Key-hash split into per-partition device batches (None for empty
+    parts) — the sub-partitioning engine for oversized joins/aggregates."""
+    import numpy as np
+
+    from spark_rapids_tpu.columnar.batch import next_capacity
+
+    cols = [batch.columns[i] for i in key_idxs]
+    pid = pmod(murmur3_columns(cols, seed), num_partitions)
+    pb = partition_by_ids(batch, pid, num_partitions)
+    offs = np.concatenate([[0], np.cumsum(np.asarray(pb.counts))])
+    out = []
+    for k in range(num_partitions):
+        lo, hi = int(offs[k]), int(offs[k + 1])
+        if hi <= lo:
+            out.append(None)
+            continue
+        cap = next_capacity(hi - lo)
+        idx = jnp.clip(jnp.arange(cap, dtype=jnp.int32) + lo, 0,
+                       batch.capacity - 1)
+        out.append(pb.batch.gather(idx, hi - lo))
+    return out

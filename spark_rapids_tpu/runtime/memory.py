@@ -168,7 +168,8 @@ class SpillCatalog:
         self._lock = threading.RLock()
         self._oom_mode = oom_injection_mode
         self._oom_filter = oom_injection_filter
-        self._oom_armed = oom_injection_mode in ("once", "always")
+        self._oom_armed = oom_injection_mode in ("once", "always",
+                                                 "split_once")
         self.metrics = {
             "spill_to_host": 0, "spill_to_disk": 0, "unspill": 0,
             "retry_oom_injected": 0,
@@ -201,9 +202,11 @@ class SpillCatalog:
             return
         if self._oom_filter and self._oom_filter not in tag:
             return
-        if self._oom_mode == "once":
+        if self._oom_mode in ("once", "split_once"):
             self._oom_armed = False
         self.metrics["retry_oom_injected"] += 1
+        if self._oom_mode == "split_once":
+            raise TpuSplitAndRetryOOM(f"injected split OOM at {tag}")
         raise TpuRetryOOM(f"injected OOM at {tag}")
 
     def reserve(self, nbytes: int, tag: str = ""):
@@ -228,6 +231,22 @@ class SpillCatalog:
 
     def release(self, nbytes: int):
         self.pool.release(nbytes)
+
+    def reserved(self, nbytes: int, tag: str = ""):
+        """Scoped reservation — operators wrap device compute whose
+        output is ~nbytes so allocation pressure (and injected OOM)
+        surfaces at a retryable point."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _scope():
+            self.reserve(nbytes, tag=tag)
+            try:
+                yield
+            finally:
+                self.release(nbytes)
+
+        return _scope()
 
     def spill_device_bytes(self, target: int) -> int:
         """Spill coldest (lowest priority, largest first) device buffers

@@ -108,3 +108,17 @@ def with_retry_no_split(sb: SpillableBatch, fn: Callable[[SpillableBatch], T]
     """withRetryNoSplit: retries on TpuRetryOOM, propagates split OOMs."""
     out = next(with_retry([sb], fn, split_policy=None))
     return out
+
+
+def retry_on_oom(fn: Callable[[], T], max_attempts: int = 8) -> T:
+    """Re-attempt a non-splittable device step after TpuRetryOOM (the
+    spill already freed memory); propagate split OOMs and give up after
+    max_attempts."""
+    attempts = 0
+    while True:
+        try:
+            return fn()
+        except TpuRetryOOM:
+            attempts += 1
+            if attempts >= max_attempts:
+                raise

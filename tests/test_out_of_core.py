@@ -1,0 +1,210 @@
+"""Out-of-core + fault-injection tests — the reference's *RetrySuite
+family (HashAggregateRetrySuite, GpuSortRetrySuite, RmmSparkRetrySuiteBase
+forced-OOM pattern, SURVEY.md section 4 tier 2): force OOM/split at
+specific allocation points and assert queries still produce oracle-equal
+results; force tiny budgets and assert spill actually happened.
+"""
+
+import os
+
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.testing.asserts import (
+    assert_tpu_and_cpu_are_equal_collect,
+    with_cpu_session,
+    with_tpu_session,
+)
+from spark_rapids_tpu.testing.datagen import (
+    DoubleGen,
+    IntGen,
+    LongGen,
+    RepeatSeqGen,
+    StringGen,
+    gen_table,
+)
+
+_CONF = {"spark.sql.shuffle.partitions": 2}
+
+
+@pytest.fixture(scope="module")
+def data_path(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ooc")
+    t = gen_table([
+        ("store", RepeatSeqGen(IntGen(0, 60, nullable=True), 17)),
+        ("amount", DoubleGen(include_specials=False)),
+        ("qty", LongGen(lo=-50, hi=50)),
+        ("name", StringGen(max_len=8, cardinality=40)),
+    ], n=4000, seed=7)
+    for i in range(4):
+        pq.write_table(t.slice(i * 1000, 1000),
+                       os.path.join(d, f"p{i}.parquet"))
+    return str(d)
+
+
+def _agg_query(s, path):
+    return (s.read.parquet(path)
+            .groupBy("store")
+            .agg(F.sum("amount").alias("rev"),
+                 F.count("*").alias("n"),
+                 F.max("qty").alias("mq")))
+
+
+def _sort_query(s, path):
+    return s.read.parquet(path).select("store", "qty", "name") \
+        .orderBy("store", "qty", "name")
+
+
+def test_agg_small_batches_merge_and_fallback(data_path):
+    """Tiny batch target forces incremental buffer merges AND the
+    high-cardinality re-partition finalize fallback."""
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _agg_query(s, data_path),
+        conf={**_CONF,
+              "spark.rapids.sql.batchSizeRows": 32,
+              "spark.rapids.sql.reader.batchSizeRows": 512})
+
+
+def test_sort_out_of_core_merge(data_path):
+    """Many small scan batches -> many sorted runs -> pairwise merges."""
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _sort_query(s, data_path),
+        conf={**_CONF,
+              "spark.rapids.sql.reader.batchSizeRows": 300},
+        ignore_order=False)
+
+
+@pytest.mark.parametrize("tag", ["agg_partial", "agg_merge"])
+def test_agg_retry_oom_injection(data_path, tag):
+    """Injected TpuRetryOOM at each agg allocation point: query retries
+    and still matches the oracle."""
+    conf = {**_CONF,
+            "spark.rapids.sql.reader.batchSizeRows": 512,
+            "spark.rapids.sql.batchSizeRows": 16,
+            "spark.rapids.memory.gpu.oomInjection.mode": "once",
+            "spark.rapids.memory.gpu.oomInjection.filter": tag}
+
+    def run(s):
+        from spark_rapids_tpu.runtime.memory import get_catalog
+
+        out = _agg_query(s, data_path).collect_arrow()
+        return out, dict(get_catalog().metrics)
+
+    tpu, metrics = with_tpu_session(run, conf=conf)
+    assert metrics["retry_oom_injected"] >= 1, metrics
+    cpu = with_cpu_session(
+        lambda s: _agg_query(s, data_path).collect_arrow(), conf=_CONF)
+    from spark_rapids_tpu.testing.asserts import assert_tables_equal
+
+    assert_tables_equal(tpu, cpu)
+
+
+def test_agg_split_and_retry_injection(data_path):
+    """Injected TpuSplitAndRetryOOM: the input batch is halved and both
+    halves aggregated; result still matches."""
+    conf = {**_CONF,
+            "spark.rapids.memory.gpu.oomInjection.mode": "split_once",
+            "spark.rapids.memory.gpu.oomInjection.filter": "agg_partial"}
+
+    def run(s):
+        from spark_rapids_tpu.runtime.memory import get_catalog
+
+        out = _agg_query(s, data_path).collect_arrow()
+        return out, dict(get_catalog().metrics)
+
+    tpu, metrics = with_tpu_session(run, conf=conf)
+    assert metrics["retry_oom_injected"] >= 1, metrics
+    cpu = with_cpu_session(
+        lambda s: _agg_query(s, data_path).collect_arrow(), conf=_CONF)
+    from spark_rapids_tpu.testing.asserts import assert_tables_equal
+
+    assert_tables_equal(tpu, cpu)
+
+
+def test_sort_retry_oom_injection(data_path):
+    conf = {**_CONF,
+            "spark.rapids.sql.reader.batchSizeRows": 600,
+            "spark.rapids.memory.gpu.oomInjection.mode": "once",
+            "spark.rapids.memory.gpu.oomInjection.filter": "sort_batch"}
+
+    def run(s):
+        from spark_rapids_tpu.runtime.memory import get_catalog
+
+        out = _sort_query(s, data_path).collect_arrow()
+        return out, dict(get_catalog().metrics)
+
+    tpu, metrics = with_tpu_session(run, conf=conf)
+    assert metrics["retry_oom_injected"] >= 1, metrics
+    cpu = with_cpu_session(
+        lambda s: _sort_query(s, data_path).collect_arrow(), conf=_CONF)
+    from spark_rapids_tpu.testing.asserts import assert_tables_equal
+
+    assert_tables_equal(tpu, cpu, ignore_order=False)
+
+
+def test_sort_spills_under_memory_pressure(data_path):
+    """A pool far smaller than the working set forces device->host spill
+    of parked runs; the query still completes correctly."""
+    conf = {**_CONF,
+            "spark.rapids.sql.reader.batchSizeRows": 500,
+            "spark.rapids.memory.gpu.maxAllocBytes": 150_000}
+
+    def run(s):
+        from spark_rapids_tpu.runtime.memory import get_catalog
+
+        out = _sort_query(s, data_path).collect_arrow()
+        return out, dict(get_catalog().metrics)
+
+    tpu, metrics = with_tpu_session(run, conf=conf)
+    assert metrics["spill_to_host"] >= 1, metrics
+    cpu = with_cpu_session(
+        lambda s: _sort_query(s, data_path).collect_arrow(), conf=_CONF)
+    from spark_rapids_tpu.testing.asserts import assert_tables_equal
+
+    assert_tables_equal(tpu, cpu, ignore_order=False)
+
+
+def test_sub_partitioned_join(data_path):
+    """Build side larger than batchSizeBytes -> key-hash sub-partitioned
+    join, still oracle-equal."""
+    def q(s):
+        fact = s.read.parquet(data_path)
+        dim = s.createDataFrame({
+            "store": list(range(0, 60)),
+            "city": [f"c{i % 9}" for i in range(60)],
+        })
+        return fact.join(dim, on="store", how="inner") \
+            .select("store", "qty", "city")
+
+    assert_tpu_and_cpu_are_equal_collect(
+        q, conf={**_CONF,
+                 "spark.sql.autoBroadcastJoinThreshold": -1,
+                 "spark.rapids.sql.batchSizeBytes": 4096})
+
+
+def test_merge_sorted_kernel_direct():
+    """Unit: merge of two sorted runs == sort of the concat."""
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.columnar import arrow_to_device, device_to_arrow
+    from spark_rapids_tpu.columnar.batch import concat_batches
+    from spark_rapids_tpu.expr import BoundReference
+    from spark_rapids_tpu.ops import sortops
+    from spark_rapids_tpu.plan.logical import SortOrder
+    from spark_rapids_tpu.sqltypes.datatypes import long
+
+    rng = np.random.default_rng(3)
+    orders = [SortOrder(BoundReference(0, long, True), ascending=True)]
+
+    a_vals = np.sort(rng.integers(0, 100, 37))
+    b_vals = np.sort(rng.integers(0, 100, 53))
+    a = arrow_to_device(pa.table({"k": pa.array(a_vals, type=pa.int64())}))
+    b = arrow_to_device(pa.table({"k": pa.array(b_vals, type=pa.int64())}))
+    merged = sortops.merge_sorted(a, b, orders)
+    expect = sortops.sort_batch(concat_batches([a, b]), orders)
+    got = device_to_arrow(merged).column("k").to_pylist()
+    want = device_to_arrow(expect).column("k").to_pylist()
+    assert got == want
+    assert got == sorted(list(a_vals) + list(b_vals))
