@@ -1,0 +1,424 @@
+// sparktpu native host runtime — the C++ layer the reference gets from
+// cuDF-Java/spark-rapids-jni (SURVEY.md section 2.12), re-provided for the
+// TPU engine's HOST side (device compute is XLA):
+//
+// - columnar wire format pack/unpack (JCudfSerialization analog,
+//   reference GpuColumnarBatchSerializer.scala:82,170): N raw buffers ->
+//   one contiguous 64-byte-aligned framed buffer, and back.
+// - spark-exact Murmur3_x86_32 and XXH64 batch hashing over typed column
+//   arrays (the JNI `Hash` kernel analog) for host-side partitioning that
+//   bit-agrees with the device kernels in ops/hashing.py.
+// - fixed-width row<->column transpose (the JNI `RowConversion` analog,
+//   reference InternalRowToColumnarBatchIterator.java / CudfUnsafeRow).
+// - a bounded host buffer pool with freelist reuse + stats (HostAlloc
+//   analog, reference HostAlloc.scala).
+//
+// Pure C++17, no dependencies; built by spark_rapids_tpu/native/__init__.py
+// with g++ -O3 and loaded via ctypes.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <new>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- wire format
+
+static const uint64_t STPU_MAGIC = 0x53545055434F4C31ULL;  // "STPUCOL1"
+static const int64_t ALIGN = 64;
+
+static inline int64_t align_up(int64_t v) { return (v + ALIGN - 1) & ~(ALIGN - 1); }
+
+// header: [u64 magic][i32 version][i32 nbufs][i64 sizes[nbufs]] padded to 64
+static inline int64_t header_size(int32_t n) {
+  return align_up(8 + 4 + 4 + 8 * (int64_t)n);
+}
+
+int64_t stpu_packed_size(const int64_t* sizes, int32_t n) {
+  int64_t total = header_size(n);
+  for (int32_t i = 0; i < n; i++) total += align_up(sizes[i]);
+  return total;
+}
+
+int64_t stpu_pack(const uint8_t** bufs, const int64_t* sizes, int32_t n,
+                  uint8_t* out) {
+  uint8_t* p = out;
+  std::memcpy(p, &STPU_MAGIC, 8);
+  int32_t version = 1;
+  std::memcpy(p + 8, &version, 4);
+  std::memcpy(p + 12, &n, 4);
+  std::memcpy(p + 16, sizes, 8 * (size_t)n);
+  int64_t off = header_size(n);
+  for (int32_t i = 0; i < n; i++) {
+    if (sizes[i] > 0) std::memcpy(out + off, bufs[i], (size_t)sizes[i]);
+    off += align_up(sizes[i]);
+  }
+  return off;
+}
+
+int32_t stpu_unpack_count(const uint8_t* data) {
+  uint64_t magic;
+  std::memcpy(&magic, data, 8);
+  if (magic != STPU_MAGIC) return -1;
+  int32_t n;
+  std::memcpy(&n, data + 12, 4);
+  return n;
+}
+
+// offsets[i], sizes[i] filled; returns total packed length or -1
+int64_t stpu_unpack_offsets(const uint8_t* data, int64_t* offsets,
+                            int64_t* sizes) {
+  int32_t n = stpu_unpack_count(data);
+  if (n < 0) return -1;
+  std::memcpy(sizes, data + 16, 8 * (size_t)n);
+  int64_t off = header_size(n);
+  for (int32_t i = 0; i < n; i++) {
+    offsets[i] = off;
+    off += align_up(sizes[i]);
+  }
+  return off;
+}
+
+// -------------------------------------------------------- murmur3 (Spark)
+
+static inline int32_t rotl32(int32_t x, int32_t r) {
+  uint32_t u = (uint32_t)x;
+  return (int32_t)((u << r) | (u >> (32 - r)));
+}
+
+static inline int32_t mm_mix_k1(int32_t k1) {
+  k1 = (int32_t)((uint32_t)k1 * 0xCC9E2D51u);
+  k1 = rotl32(k1, 15);
+  return (int32_t)((uint32_t)k1 * 0x1B873593u);
+}
+
+static inline int32_t mm_mix_h1(int32_t h1, int32_t k1) {
+  h1 ^= k1;
+  h1 = rotl32(h1, 13);
+  return (int32_t)((uint32_t)h1 * 5u + 0xE6546B64u);
+}
+
+static inline int32_t mm_fmix(int32_t h1, int32_t length) {
+  h1 ^= length;
+  h1 ^= (int32_t)((uint32_t)h1 >> 16);
+  h1 = (int32_t)((uint32_t)h1 * 0x85EBCA6Bu);
+  h1 ^= (int32_t)((uint32_t)h1 >> 13);
+  h1 = (int32_t)((uint32_t)h1 * 0xC2B2AE35u);
+  return h1 ^ (int32_t)((uint32_t)h1 >> 16);
+}
+
+static inline int32_t mm_hash_int(int32_t v, int32_t seed) {
+  return mm_fmix(mm_mix_h1(seed, mm_mix_k1(v)), 4);
+}
+
+static inline int32_t mm_hash_long(int64_t v, int32_t seed) {
+  int32_t low = (int32_t)v;
+  int32_t high = (int32_t)((uint64_t)v >> 32);
+  int32_t h1 = mm_mix_h1(seed, mm_mix_k1(low));
+  h1 = mm_mix_h1(h1, mm_mix_k1(high));
+  return mm_fmix(h1, 8);
+}
+
+// Spark hashUnsafeBytes: 4-byte LE words then one signed byte at a time.
+static inline int32_t mm_hash_bytes(const uint8_t* p, int32_t len,
+                                    int32_t seed) {
+  int32_t h1 = seed;
+  int32_t nwords = len / 4;
+  for (int32_t i = 0; i < nwords; i++) {
+    int32_t w;
+    std::memcpy(&w, p + i * 4, 4);  // little-endian host
+    h1 = mm_mix_h1(h1, mm_mix_k1(w));
+  }
+  for (int32_t i = nwords * 4; i < len; i++) {
+    h1 = mm_mix_h1(h1, mm_mix_k1((int32_t)(int8_t)p[i]));
+  }
+  return mm_fmix(h1, len);
+}
+
+// h: inout running hash per row (seed chaining across columns); null rows
+// (valid[i]==0) leave the hash unchanged, matching Spark HashExpression.
+void stpu_murmur3_int(const int32_t* v, const uint8_t* valid, int64_t n,
+                      int32_t* h) {
+  for (int64_t i = 0; i < n; i++)
+    if (!valid || valid[i]) h[i] = mm_hash_int(v[i], h[i]);
+}
+
+void stpu_murmur3_long(const int64_t* v, const uint8_t* valid, int64_t n,
+                       int32_t* h) {
+  for (int64_t i = 0; i < n; i++)
+    if (!valid || valid[i]) h[i] = mm_hash_long(v[i], h[i]);
+}
+
+void stpu_murmur3_double(const double* v, const uint8_t* valid, int64_t n,
+                         int32_t* h) {
+  for (int64_t i = 0; i < n; i++) {
+    if (valid && !valid[i]) continue;
+    double d = v[i] == 0.0 ? 0.0 : v[i];
+    int64_t bits;
+    if (d != d) bits = 0x7FF8000000000000LL;  // canonical NaN
+    else std::memcpy(&bits, &d, 8);
+    h[i] = mm_hash_long(bits, h[i]);
+  }
+}
+
+void stpu_murmur3_float(const float* v, const uint8_t* valid, int64_t n,
+                        int32_t* h) {
+  for (int64_t i = 0; i < n; i++) {
+    if (valid && !valid[i]) continue;
+    float f = v[i] == 0.0f ? 0.0f : v[i];
+    int32_t bits;
+    if (f != f) bits = 0x7FC00000;
+    else std::memcpy(&bits, &f, 4);
+    h[i] = mm_hash_int(bits, h[i]);
+  }
+}
+
+// data: [n, stride] padded byte matrix; lens: per-row byte counts
+void stpu_murmur3_bytes(const uint8_t* data, const int32_t* lens,
+                        int64_t stride, const uint8_t* valid, int64_t n,
+                        int32_t* h) {
+  for (int64_t i = 0; i < n; i++)
+    if (!valid || valid[i])
+      h[i] = mm_hash_bytes(data + i * stride, lens[i], h[i]);
+}
+
+// ---------------------------------------------------------- XXH64 (Spark)
+
+static const uint64_t XP1 = 0x9E3779B185EBCA87ULL;
+static const uint64_t XP2 = 0xC2B2AE3D27D4EB4FULL;
+static const uint64_t XP3 = 0x165667B19E3779F9ULL;
+static const uint64_t XP4 = 0x85EBCA77C2B2AE63ULL;
+static const uint64_t XP5 = 0x27D4EB2F165667C5ULL;
+
+static inline uint64_t rotl64(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+
+static inline uint64_t xx_fmix(uint64_t h) {
+  h ^= h >> 33; h *= XP2; h ^= h >> 29; h *= XP3; h ^= h >> 32;
+  return h;
+}
+
+static inline uint64_t xx_hash_int(int32_t v, uint64_t seed) {
+  uint64_t h = seed + XP5 + 4;
+  h ^= ((uint64_t)(uint32_t)v) * XP1;
+  h = rotl64(h, 23) * XP2 + XP3;
+  return xx_fmix(h);
+}
+
+static inline uint64_t xx_hash_long(int64_t v, uint64_t seed) {
+  uint64_t h = seed + XP5 + 8;
+  uint64_t k1 = rotl64((uint64_t)v * XP2, 31) * XP1;
+  h ^= k1;
+  h = rotl64(h, 27) * XP1 + XP4;
+  return xx_fmix(h);
+}
+
+static inline uint64_t xx_hash_bytes(const uint8_t* p, int32_t len,
+                                     uint64_t seed) {
+  const uint8_t* end = p + len;
+  uint64_t h;
+  if (len >= 32) {
+    uint64_t v1 = seed + XP1 + XP2, v2 = seed + XP2, v3 = seed,
+             v4 = seed - XP1;
+    const uint8_t* limit = end - 32;
+    do {
+      uint64_t w;
+      std::memcpy(&w, p, 8); v1 = rotl64(v1 + w * XP2, 31) * XP1; p += 8;
+      std::memcpy(&w, p, 8); v2 = rotl64(v2 + w * XP2, 31) * XP1; p += 8;
+      std::memcpy(&w, p, 8); v3 = rotl64(v3 + w * XP2, 31) * XP1; p += 8;
+      std::memcpy(&w, p, 8); v4 = rotl64(v4 + w * XP2, 31) * XP1; p += 8;
+    } while (p <= limit);
+    h = rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) + rotl64(v4, 18);
+    h = (h ^ (rotl64(v1 * XP2, 31) * XP1)) * XP1 + XP4;
+    h = (h ^ (rotl64(v2 * XP2, 31) * XP1)) * XP1 + XP4;
+    h = (h ^ (rotl64(v3 * XP2, 31) * XP1)) * XP1 + XP4;
+    h = (h ^ (rotl64(v4 * XP2, 31) * XP1)) * XP1 + XP4;
+  } else {
+    h = seed + XP5;
+  }
+  h += (uint64_t)len;
+  while (p + 8 <= end) {
+    uint64_t w;
+    std::memcpy(&w, p, 8);
+    h = rotl64(h ^ (rotl64(w * XP2, 31) * XP1), 27) * XP1 + XP4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    uint32_t w;
+    std::memcpy(&w, p, 4);
+    h = rotl64(h ^ ((uint64_t)w * XP1), 23) * XP2 + XP3;
+    p += 4;
+  }
+  while (p < end) {
+    h = rotl64(h ^ ((uint64_t)*p * XP5), 11) * XP1;
+    p++;
+  }
+  return xx_fmix(h);
+}
+
+void stpu_xxhash64_int(const int32_t* v, const uint8_t* valid, int64_t n,
+                       uint64_t* h) {
+  for (int64_t i = 0; i < n; i++)
+    if (!valid || valid[i]) h[i] = xx_hash_int(v[i], h[i]);
+}
+
+void stpu_xxhash64_long(const int64_t* v, const uint8_t* valid, int64_t n,
+                        uint64_t* h) {
+  for (int64_t i = 0; i < n; i++)
+    if (!valid || valid[i]) h[i] = xx_hash_long(v[i], h[i]);
+}
+
+void stpu_xxhash64_float(const float* v, const uint8_t* valid, int64_t n,
+                         uint64_t* h) {
+  for (int64_t i = 0; i < n; i++) {
+    if (valid && !valid[i]) continue;
+    float f = v[i] == 0.0f ? 0.0f : v[i];
+    int32_t bits;
+    if (f != f) bits = 0x7FC00000;
+    else std::memcpy(&bits, &f, 4);
+    h[i] = xx_hash_int(bits, h[i]);
+  }
+}
+
+void stpu_xxhash64_double(const double* v, const uint8_t* valid, int64_t n,
+                          uint64_t* h) {
+  for (int64_t i = 0; i < n; i++) {
+    if (valid && !valid[i]) continue;
+    double d = v[i] == 0.0 ? 0.0 : v[i];
+    int64_t bits;
+    if (d != d) bits = 0x7FF8000000000000LL;
+    else std::memcpy(&bits, &d, 8);
+    h[i] = xx_hash_long(bits, h[i]);
+  }
+}
+
+void stpu_xxhash64_bytes(const uint8_t* data, const int32_t* lens,
+                         int64_t stride, const uint8_t* valid, int64_t n,
+                         uint64_t* h) {
+  for (int64_t i = 0; i < n; i++)
+    if (!valid || valid[i])
+      h[i] = xx_hash_bytes(data + i * stride, lens[i], h[i]);
+}
+
+// ------------------------------------------------- row <-> column transpose
+
+// Fixed-width columns to packed rows. Row layout: one validity byte per
+// column, then each column's value at its offset (naturally packed in
+// column order). widths[i] in {1,2,4,8}.
+void stpu_columns_to_rows(int32_t ncols, const uint8_t** col_data,
+                          const int32_t* widths, const uint8_t** valids,
+                          int64_t nrows, uint8_t* rows_out,
+                          int64_t row_stride) {
+  int64_t val_base = 0;  // validity bytes first
+  std::vector<int64_t> offs(ncols);
+  int64_t off = ncols;  // after validity bytes
+  for (int32_t c = 0; c < ncols; c++) { offs[c] = off; off += widths[c]; }
+  for (int64_t r = 0; r < nrows; r++) {
+    uint8_t* row = rows_out + r * row_stride;
+    for (int32_t c = 0; c < ncols; c++) {
+      row[val_base + c] = valids[c] ? valids[c][r] : 1;
+      std::memcpy(row + offs[c], col_data[c] + r * widths[c], widths[c]);
+    }
+  }
+}
+
+void stpu_rows_to_columns(int32_t ncols, uint8_t** col_data,
+                          const int32_t* widths, uint8_t** valids,
+                          int64_t nrows, const uint8_t* rows_in,
+                          int64_t row_stride) {
+  std::vector<int64_t> offs(ncols);
+  int64_t off = ncols;
+  for (int32_t c = 0; c < ncols; c++) { offs[c] = off; off += widths[c]; }
+  for (int64_t r = 0; r < nrows; r++) {
+    const uint8_t* row = rows_in + r * row_stride;
+    for (int32_t c = 0; c < ncols; c++) {
+      if (valids[c]) valids[c][r] = row[c];
+      std::memcpy(col_data[c] + r * widths[c], row + offs[c], widths[c]);
+    }
+  }
+}
+
+int64_t stpu_row_stride(int32_t ncols, const int32_t* widths) {
+  int64_t off = ncols;
+  for (int32_t c = 0; c < ncols; c++) off += widths[c];
+  return (off + 7) & ~7LL;  // 8-byte aligned row size
+}
+
+// ------------------------------------------------------- host buffer pool
+
+struct StpuPool {
+  int64_t capacity;
+  std::atomic<int64_t> in_use{0};
+  std::atomic<int64_t> peak{0};
+  std::atomic<int64_t> alloc_count{0};
+  std::mutex mu;
+  std::multimap<int64_t, void*> freelist;  // size -> block
+  std::map<void*, int64_t> sizes;          // live + freed block sizes
+};
+
+void* stpu_pool_create(int64_t capacity) {
+  return new (std::nothrow) StpuPool{capacity};
+}
+
+void stpu_pool_destroy(void* pv) {
+  auto* p = (StpuPool*)pv;
+  if (!p) return;
+  // `sizes` tracks every block ever allocated (freelist is a subset)
+  for (auto& kv : p->sizes) ::operator delete(kv.first);
+  delete p;
+}
+
+// nullptr when the pool budget would be exceeded (caller spills and
+// retries — the HostAlloc blocking/retry analog, done Python-side).
+void* stpu_pool_alloc(void* pv, int64_t n) {
+  auto* p = (StpuPool*)pv;
+  if (n <= 0) n = 1;
+  {
+    std::lock_guard<std::mutex> g(p->mu);
+    auto it = p->freelist.lower_bound(n);
+    if (it != p->freelist.end() && it->first <= n * 2) {
+      void* blk = it->second;
+      int64_t sz = it->first;
+      p->freelist.erase(it);
+      int64_t now = p->in_use.fetch_add(sz) + sz;
+      int64_t pk = p->peak.load();
+      while (now > pk && !p->peak.compare_exchange_weak(pk, now)) {}
+      p->alloc_count++;
+      return blk;
+    }
+  }
+  if (p->in_use.load() + n > p->capacity) return nullptr;
+  void* blk = ::operator new((size_t)n, std::nothrow);
+  if (!blk) return nullptr;
+  {
+    std::lock_guard<std::mutex> g(p->mu);
+    p->sizes[blk] = n;
+  }
+  int64_t now = p->in_use.fetch_add(n) + n;
+  int64_t pk = p->peak.load();
+  while (now > pk && !p->peak.compare_exchange_weak(pk, now)) {}
+  p->alloc_count++;
+  return blk;
+}
+
+void stpu_pool_free(void* pv, void* blk) {
+  auto* p = (StpuPool*)pv;
+  std::lock_guard<std::mutex> g(p->mu);
+  auto it = p->sizes.find(blk);
+  if (it == p->sizes.end()) return;
+  p->in_use.fetch_sub(it->second);
+  p->freelist.emplace(it->second, blk);
+}
+
+int64_t stpu_pool_in_use(void* pv) { return ((StpuPool*)pv)->in_use.load(); }
+int64_t stpu_pool_peak(void* pv) { return ((StpuPool*)pv)->peak.load(); }
+int64_t stpu_pool_alloc_count(void* pv) {
+  return ((StpuPool*)pv)->alloc_count.load();
+}
+
+}  // extern "C"

@@ -108,6 +108,13 @@ class TpuSparkSession:
         memory.initialize_memory(self.rapids_conf, force=True)
         semaphore.initialize(
             self.rapids_conf.get(rc.CONCURRENT_TPU_TASKS))
+        from spark_rapids_tpu.shuffle.manager import configure_shuffle
+
+        configure_shuffle(
+            self.rapids_conf.get(rc.SHUFFLE_MODE),
+            shuffle_dir=self.rapids_conf.get(rc.SPILL_DIR) or None,
+            num_threads=self.rapids_conf.get(
+                rc.MULTITHREADED_READ_NUM_THREADS))
 
     # --- conf ---
 

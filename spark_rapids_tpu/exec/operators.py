@@ -404,9 +404,7 @@ class TpuHashAggregateExec(PhysicalPlan):
     def execute_partition(self, pid, ctx):
         from spark_rapids_tpu.config import rapids_conf as rc
         from spark_rapids_tpu.runtime.memory import get_catalog
-        from spark_rapids_tpu.runtime.retry import with_retry
-
-        from spark_rapids_tpu.runtime.retry import retry_on_oom
+        from spark_rapids_tpu.runtime.retry import retry_on_oom, with_retry
 
         catalog = get_catalog()
         target_rows = (self.conf.get(rc.BATCH_SIZE_ROWS) if self.conf
@@ -764,9 +762,7 @@ class TpuSortExec(PhysicalPlan):
 
     def execute_partition(self, pid, ctx):
         from spark_rapids_tpu.runtime.memory import get_catalog
-        from spark_rapids_tpu.runtime.retry import with_retry
-
-        from spark_rapids_tpu.runtime.retry import retry_on_oom
+        from spark_rapids_tpu.runtime.retry import retry_on_oom, with_retry
 
         catalog = get_catalog()
         with self.metrics[M.SORT_TIME].ns():
@@ -788,8 +784,6 @@ class TpuSortExec(PhysicalPlan):
             while len(runs) > 1:
                 nxt = []
                 for i in range(0, len(runs) - 1, 2):
-                    from spark_rapids_tpu.runtime.retry import retry_on_oom
-
                     out_cap = next_capacity(runs[i].row_count() +
                                             runs[i + 1].row_count())
 

@@ -77,9 +77,18 @@ class TpuOverrides:
             for r in expr_unsupported_reasons(node.condition):
                 meta.cannot_run(r)
         elif isinstance(node, L.Aggregate):
+            from spark_rapids_tpu.expr.aggregates import Max, Min
+            from spark_rapids_tpu.sqltypes import StringType
+
             for e in node.grouping + node.aggregates:
                 for r in expr_unsupported_reasons(e):
                     meta.cannot_run(r)
+            for a in node.aggregates:
+                fn = a.children[0]
+                if (isinstance(fn, (Min, Max)) and fn.input is not None
+                        and isinstance(fn.input.dtype, StringType)):
+                    meta.cannot_run(
+                        "string min/max aggregation runs on CPU in v1")
         elif isinstance(node, L.Join):
             for e in node.left_keys + node.right_keys:
                 for r in expr_unsupported_reasons(e):

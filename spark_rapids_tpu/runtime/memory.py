@@ -21,6 +21,7 @@ tiny budgets + injection to exercise every path (the reference's
 
 from __future__ import annotations
 
+import contextlib
 import os
 import tempfile
 import threading
@@ -232,21 +233,16 @@ class SpillCatalog:
     def release(self, nbytes: int):
         self.pool.release(nbytes)
 
+    @contextlib.contextmanager
     def reserved(self, nbytes: int, tag: str = ""):
         """Scoped reservation — operators wrap device compute whose
         output is ~nbytes so allocation pressure (and injected OOM)
         surfaces at a retryable point."""
-        import contextlib
-
-        @contextlib.contextmanager
-        def _scope():
-            self.reserve(nbytes, tag=tag)
-            try:
-                yield
-            finally:
-                self.release(nbytes)
-
-        return _scope()
+        self.reserve(nbytes, tag=tag)
+        try:
+            yield
+        finally:
+            self.release(nbytes)
 
     def spill_device_bytes(self, target: int) -> int:
         """Spill coldest (lowest priority, largest first) device buffers

@@ -1,35 +1,54 @@
-"""Shuffle manager v1 — the MULTITHREADED / CACHE_ONLY transport analog.
+"""Shuffle manager — the MULTITHREADED / CACHE_ONLY transport analog.
 
 Reference (`RapidsShuffleInternalManagerBase.scala:238,569,1183`): the
 MULTITHREADED mode serializes device batches on a writer thread pool into
-host shuffle storage, readers fetch and coalesce back onto the device
-(`GpuShuffleCoalesceExec`). The UCX device-to-device transport is the ICI
-collective path in shuffle/ici.py.
+host shuffle storage (files), readers fetch and coalesce back onto the
+device (`GpuShuffleCoalesceExec`). The UCX device-to-device transport's
+analog is the ICI collective path (parallel/collective.py).
 
-This in-process manager keeps shuffle blocks as host Arrow tables
-registered with the spill catalog's host budget (CACHE_ONLY semantics);
-a multi-host version would write the same blocks through the
-serialization in shuffle/serde.py.
+Modes here (conf spark.rapids.shuffle.mode):
+- CACHE_ONLY: blocks stay as in-process host Arrow tables.
+- MULTITHREADED: blocks are serialized through the native wire format
+  (shuffle/serde.py, the JCudfSerialization analog) and written to
+  shuffle files by a writer thread pool; readers block on the in-flight
+  writes for their partition then deserialize.
 """
 
 from __future__ import annotations
 
+import os
+import tempfile
 import threading
 from collections import defaultdict
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, List, Tuple
 
+import numpy as np
 import pyarrow as pa
 
 
 class ShuffleManager:
-    """Maps (shuffle_id, reduce_pid) -> list of host tables."""
+    """Maps (shuffle_id, reduce_pid) -> shuffle blocks."""
 
-    def __init__(self):
+    def __init__(self, mode: str = "CACHE_ONLY", shuffle_dir: str = None,
+                 num_threads: int = 8):
+        self.mode = mode
         self._blocks: Dict[Tuple[int, int], List[pa.Table]] = defaultdict(
+            list)
+        self._files: Dict[Tuple[int, int], List[Future]] = defaultdict(
             list)
         self._lock = threading.Lock()
         self._next_id = 0
         self.bytes_written = 0
+        self._dir = shuffle_dir
+        self._pool = None
+        self._seq = 0
+        if mode == "MULTITHREADED":
+            self._dir = shuffle_dir or tempfile.mkdtemp(
+                prefix="srtpu-shuffle-")
+            self._pool = ThreadPoolExecutor(
+                max_workers=max(1, num_threads),
+                thread_name_prefix="shuffle-writer")
 
     def new_shuffle_id(self) -> int:
         with self._lock:
@@ -37,21 +56,81 @@ class ShuffleManager:
             return self._next_id
 
     def put(self, shuffle_id: int, reduce_pid: int, table: pa.Table):
+        if self.mode != "MULTITHREADED":
+            with self._lock:
+                self._blocks[(shuffle_id, reduce_pid)].append(table)
+                self.bytes_written += table.nbytes
+            return
         with self._lock:
-            self._blocks[(shuffle_id, reduce_pid)].append(table)
-            self.bytes_written += table.nbytes
+            self._seq += 1
+            seq = self._seq
+        path = os.path.join(
+            self._dir, f"shuffle-{shuffle_id}-{reduce_pid}-{seq}.stpu")
+
+        def write():
+            from spark_rapids_tpu.shuffle import serde
+
+            buf = serde.serialize_table(table)
+            with open(path, "wb") as f:
+                buf.tofile(f)
+            with self._lock:
+                self.bytes_written += buf.nbytes
+            return path
+
+        fut = self._pool.submit(write)
+        with self._lock:
+            self._files[(shuffle_id, reduce_pid)].append(fut)
 
     def fetch(self, shuffle_id: int, reduce_pid: int) -> List[pa.Table]:
+        if self.mode != "MULTITHREADED":
+            with self._lock:
+                return list(self._blocks.get((shuffle_id, reduce_pid), []))
         with self._lock:
-            return list(self._blocks.get((shuffle_id, reduce_pid), []))
+            futs = list(self._files.get((shuffle_id, reduce_pid), []))
+        from spark_rapids_tpu.shuffle import serde
+
+        tables = []
+        for fut in futs:
+            path = fut.result()  # blocks on in-flight writes
+            data = np.fromfile(path, dtype=np.uint8)
+            tables.append(serde.deserialize_table(data))
+        return tables
 
     def remove_shuffle(self, shuffle_id: int):
         with self._lock:
             for k in [k for k in self._blocks if k[0] == shuffle_id]:
                 del self._blocks[k]
+            futs = []
+            for k in [k for k in self._files if k[0] == shuffle_id]:
+                futs.extend(self._files.pop(k))
+        # wait + unlink OUTSIDE the lock so unrelated shuffles proceed
+        for fut in futs:
+            try:
+                os.unlink(fut.result())
+            except Exception:
+                pass
+
+    def shutdown(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
 
 
 _manager = ShuffleManager()
+_mgr_lock = threading.Lock()
+
+
+def configure_shuffle(mode: str, shuffle_dir: str = None,
+                      num_threads: int = 8):
+    """Install a manager for the session's shuffle settings (reference
+    GpuShuffleEnv.initShuffleManager, Plugin.scala:531)."""
+    global _manager
+    with _mgr_lock:
+        settings = (mode, shuffle_dir, num_threads)
+        if getattr(_manager, "_settings", None) != settings:
+            _manager.shutdown()
+            _manager = ShuffleManager(mode, shuffle_dir, num_threads)
+            _manager._settings = settings
+    return _manager
 
 
 def get_shuffle_manager() -> ShuffleManager:

@@ -1,0 +1,63 @@
+"""Columnar shuffle wire format — the JCudfSerialization analog
+(reference GpuColumnarBatchSerializer.scala:82,170: cuDF serialized
+tables, header + raw buffers, written to shuffle streams).
+
+A table serializes to ONE contiguous framed buffer: [schema IPC bytes,
+meta JSON, column buffers...] packed by the native runtime
+(native/sparktpu_runtime.cpp stpu_pack) with 64-byte alignment so
+deserialization is zero-copy buffer slicing. Flat types only (primitives,
+strings, dates/timestamps/decimals) — the engine's device surface.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import native
+
+
+def serialize_table(table: pa.Table) -> np.ndarray:
+    """Arrow table -> one contiguous uint8 buffer."""
+    schema_buf = np.frombuffer(table.schema.serialize(), dtype=np.uint8)
+    bufs: List[np.ndarray] = []
+    col_specs = []
+    for col in table.columns:
+        arr = col.combine_chunks()
+        if arr.offset != 0:
+            arr = arr.take(pa.array(np.arange(len(arr))))
+        spec = {"nbufs": 0, "present": []}
+        for b in arr.buffers():
+            if b is None:
+                spec["present"].append(False)
+                continue
+            spec["present"].append(True)
+            bufs.append(np.frombuffer(b, dtype=np.uint8))
+            spec["nbufs"] += 1
+        col_specs.append(spec)
+    meta = json.dumps({"nrows": table.num_rows,
+                       "cols": col_specs}).encode()
+    meta_buf = np.frombuffer(meta, dtype=np.uint8)
+    return native.pack_buffers([schema_buf, meta_buf] + bufs)
+
+
+def deserialize_table(data: np.ndarray) -> pa.Table:
+    parts = native.unpack_buffers(data)
+    schema = pa.ipc.read_schema(pa.py_buffer(parts[0].tobytes()))
+    meta = json.loads(bytes(parts[1]))
+    arrays = []
+    bi = 2
+    for field, spec in zip(schema, meta["cols"]):
+        buffers = []
+        for present in spec["present"]:
+            if present:
+                buffers.append(pa.py_buffer(parts[bi].tobytes()))
+                bi += 1
+            else:
+                buffers.append(None)
+        arrays.append(pa.Array.from_buffers(field.type, meta["nrows"],
+                                            buffers))
+    return pa.Table.from_arrays(arrays, schema=schema)
