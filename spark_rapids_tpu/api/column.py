@@ -130,6 +130,11 @@ class Column:
     def contains(self, s: str):
         return Column(Contains(self.expr, s))
 
+    def rlike(self, pattern: str):
+        from spark_rapids_tpu.expr.regexexpr import RLike
+
+        return Column(RLike(self.expr, pattern))
+
     # sort direction / window
 
     def asc(self) -> "SortColumn":

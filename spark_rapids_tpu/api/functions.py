@@ -368,3 +368,21 @@ def chr_(c) -> Column:
 
 def substring_index(c, delim: str, count: int) -> Column:
     return Column(SubstringIndex(expr_of(c), delim, count))
+
+
+def rlike(c, pattern: str) -> Column:
+    from spark_rapids_tpu.expr.regexexpr import RLike
+
+    return Column(RLike(expr_of(c), pattern))
+
+
+def regexp_extract(c, pattern: str, idx: int = 1) -> Column:
+    from spark_rapids_tpu.expr.regexexpr import RegexpExtract
+
+    return Column(RegexpExtract(expr_of(c), pattern, idx))
+
+
+def regexp_replace(c, pattern: str, replacement: str) -> Column:
+    from spark_rapids_tpu.expr.regexexpr import RegexpReplace
+
+    return Column(RegexpReplace(expr_of(c), pattern, replacement))

@@ -509,8 +509,72 @@ def _round_oracle(e, t):
     return pa.array(out, out_t)
 
 
+def _ev_regex(e: Expression, t: pa.Table):
+    """Regex oracle/fallback via Python re (the common Java/Python
+    subset; Java-only constructs would need translation, mirrored by the
+    reference's transpiler fallback)."""
+    import re
+
+    from spark_rapids_tpu.expr.regexexpr import (
+        RegexpExtract,
+        RegexpReplace,
+        RLike,
+    )
+
+    cls = type(e)
+    if cls not in (RLike, RegexpExtract, RegexpReplace):
+        return None
+    xs = _as_list(_ev(e.children[0], t), t)
+    rx = re.compile(e.pattern)
+    if cls is RLike:
+        return pa.array([None if v is None else rx.search(v) is not None
+                         for v in xs], pa.bool_())
+    if cls is RegexpExtract:
+        out = []
+        for v in xs:
+            if v is None:
+                out.append(None)
+                continue
+            m = rx.search(v)
+            # Spark: no match or unmatched group -> empty string
+            out.append("" if m is None or m.group(e.idx) is None
+                       else m.group(e.idx))
+        return pa.array(out, pa.string())
+    repl = _java_replacement_to_python(e.replacement)
+    return pa.array([None if v is None else rx.sub(repl, v)
+                     for v in xs], pa.string())
+
+
+def _java_replacement_to_python(r: str) -> str:
+    """Java Matcher.replaceAll replacement -> re.sub replacement:
+    Java `$N` is a group ref (Python `\\N`); Java `\\x` escapes x
+    literally; literal backslashes must be doubled for re.sub."""
+    out = []
+    i = 0
+    while i < len(r):
+        c = r[i]
+        if c == "\\" and i + 1 < len(r):
+            nxt = r[i + 1]
+            out.append("\\\\" if nxt == "\\" else nxt)
+            i += 2
+            continue
+        if c == "$" and i + 1 < len(r) and r[i + 1].isdigit():
+            j = i + 1
+            while j < len(r) and r[j].isdigit():
+                j += 1
+            out.append("\\g<" + r[i + 1:j] + ">")
+            i = j
+            continue
+        out.append(c.replace("\\", "\\\\"))
+        i += 1
+    return "".join(out)
+
+
 def _ev_ext_strings(e: Expression, t: pa.Table):
     cls = type(e)
+    r = _ev_regex(e, t)
+    if r is not None:
+        return r
     str_classes = (StringTrim, StringTrimLeft, StringTrimRight, StringLPad,
                    StringRPad, StringRepeat, StringReverse, InitCap,
                    StringInstr, StringLocate, StringTranslate,

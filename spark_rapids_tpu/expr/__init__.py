@@ -41,3 +41,6 @@ from spark_rapids_tpu.expr.windows import (  # noqa: F401
     CumeDist, DenseRank, Lag, Lead, NTile, PercentRank, Rank, RowNumber,
     WindowExpression, WindowFrame, WindowSpecDef,
 )
+from spark_rapids_tpu.expr.regexexpr import (  # noqa: F401
+    RegexpExtract, RegexpReplace, RLike,
+)

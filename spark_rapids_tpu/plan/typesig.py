@@ -90,3 +90,11 @@ def expr_unsupported_reasons(expr: Expression) -> List[str]:
 
     walk(expr)
     return reasons
+
+
+from spark_rapids_tpu.expr.regexexpr import RLike  # noqa: E402
+
+
+@register_check(RLike)
+def _rlike_check(e: "RLike") -> Optional[str]:
+    return e.device_supported()

@@ -1,0 +1,5 @@
+from spark_rapids_tpu.regex.transpiler import (  # noqa: F401
+    CompiledRegex,
+    RegexUnsupported,
+    compile_search,
+)

@@ -1,0 +1,466 @@
+"""Regex transpiler: Java-regex subset -> byte-class DFA tables.
+
+The reference transpiles Java regexes to the cuDF regex dialect
+(`RegexParser.scala`, 2,009 LoC) because device regex must agree with
+Spark's Java semantics; unsupported constructs fall back to CPU with a
+tagging reason, bounded by `RegexComplexityEstimator.scala`.
+
+The TPU has no regex engine at all, so the approach is compile-time
+heavier and run-time simpler: parse the (common Java/cuDF/Python) regex
+subset into an AST, build a Thompson NFA, and determinize to a DFA over
+**byte equivalence classes** — then matching is a dense table walk, which
+is exactly the shape XLA loves (one gather per character step, vectorized
+over all rows; see ops/regexops.py).
+
+Search (Spark RLIKE / Matcher.find) semantics are compiled in: a
+self-loop on the start state unless the pattern starts with `^`, and
+absorbing accept states unless it ends with `$`.
+
+Unsupported (-> RegexUnsupported, operator falls back to CPU):
+backreferences, lookaround, lazy/possessive quantifiers beyond syntax
+acceptance, inline flags, named groups, unicode classes, and DFAs larger
+than MAX_STATES. Matching is byte-oriented (UTF-8): multi-byte
+characters match `.`/negated classes per byte — same caveat class as the
+cuDF dialect differences documented by the reference.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+MAX_STATES = 192
+MAX_REPEAT = 64
+
+
+class RegexUnsupported(Exception):
+    """Pattern outside the transpilable subset (CPU fallback reason)."""
+
+
+# ------------------------------------------------------------------- AST
+
+class _Node:
+    pass
+
+
+class _Chars(_Node):
+    """One byte-set."""
+
+    def __init__(self, mask: np.ndarray):
+        self.mask = mask  # [256] bool
+
+
+class _Concat(_Node):
+    def __init__(self, parts: List[_Node]):
+        self.parts = parts
+
+
+class _Alt(_Node):
+    def __init__(self, options: List[_Node]):
+        self.options = options
+
+
+class _Repeat(_Node):
+    def __init__(self, child: _Node, lo: int, hi: Optional[int]):
+        self.child = child
+        self.lo = lo
+        self.hi = hi  # None = unbounded
+
+
+def _mask_of(*ranges, chars=""):
+    m = np.zeros(256, dtype=bool)
+    for lo, hi in ranges:
+        m[lo:hi + 1] = True
+    for c in chars:
+        m[ord(c)] = True
+    return m
+
+
+_DIGIT = _mask_of((ord("0"), ord("9")))
+_WORD = _mask_of((ord("a"), ord("z")), (ord("A"), ord("Z")),
+                 (ord("0"), ord("9")), chars="_")
+_SPACE = _mask_of(chars=" \t\n\x0b\f\r")
+_DOT = ~_mask_of(chars="\n")  # Java default: . matches all but \n
+_ANY = np.ones(256, dtype=bool)
+
+_ESCAPES = {
+    "d": _DIGIT, "D": ~_DIGIT, "w": _WORD, "W": ~_WORD,
+    "s": _SPACE, "S": ~_SPACE,
+}
+_CTRL = {"n": "\n", "t": "\t", "r": "\r", "f": "\f", "a": "\x07",
+         "e": "\x1b", "0": "\0"}
+
+
+class _Parser:
+    def __init__(self, pattern: str):
+        self.p = pattern
+        self.i = 0
+        self.anchored_start = False
+        self.anchored_end = False
+
+    def error(self, msg):
+        raise RegexUnsupported(f"{msg} at {self.i} in {self.p!r}")
+
+    def peek(self) -> Optional[str]:
+        return self.p[self.i] if self.i < len(self.p) else None
+
+    def take(self) -> str:
+        c = self.p[self.i]
+        self.i += 1
+        return c
+
+    def parse(self) -> _Node:
+        if self.peek() == "^":
+            self.anchored_start = True
+            self.take()
+        node = self.alt(top=True)
+        if self.i < len(self.p):
+            self.error("unexpected trailing input")
+        return node
+
+    def alt(self, top=False) -> _Node:
+        options = [self.concat(top)]
+        while self.peek() == "|":
+            self.take()
+            options.append(self.concat(top))
+        if top and len(options) > 1 and (self.anchored_start or
+                                         self.anchored_end):
+            # Java binds anchors per-branch ("^a|b" = (^a)|b); our global
+            # anchor flags would wrongly anchor every branch
+            raise RegexUnsupported(
+                f"anchors with top-level alternation in {self.p!r} "
+                "(per-branch anchoring)")
+        return options[0] if len(options) == 1 else _Alt(options)
+
+    def concat(self, top=False) -> _Node:
+        parts: List[_Node] = []
+        while True:
+            c = self.peek()
+            if c is None or c in "|)":
+                break
+            if c == "$":
+                # only supported as the final char of the whole pattern
+                if top and self.i == len(self.p) - 1:
+                    self.anchored_end = True
+                    self.take()
+                    break
+                self.error("'$' only supported at pattern end")
+            parts.append(self.repeat())
+        if not parts:
+            return _Concat([])
+        return parts[0] if len(parts) == 1 else _Concat(parts)
+
+    def repeat(self) -> _Node:
+        atom = self.atom()
+        c = self.peek()
+        if c not in ("*", "+", "?", "{"):
+            return atom
+        if c == "{":
+            save = self.i
+            self.take()
+            lo, hi = self._braces(save)
+        else:
+            self.take()
+            lo, hi = {"*": (0, None), "+": (1, None), "?": (0, 1)}[c]
+        # lazy / possessive suffixes: match-only semantics are identical
+        if self.peek() == "?":
+            self.take()
+        elif self.peek() == "+":
+            self.error("possessive quantifiers unsupported")
+        return _Repeat(atom, lo, hi)
+
+    def _braces(self, save) -> Tuple[int, Optional[int]]:
+        digits = ""
+        while self.peek() and self.peek().isdigit():
+            digits += self.take()
+        if not digits:
+            self.error("bad {m,n}")
+        lo = int(digits)
+        hi: Optional[int] = lo
+        if self.peek() == ",":
+            self.take()
+            digits = ""
+            while self.peek() and self.peek().isdigit():
+                digits += self.take()
+            hi = int(digits) if digits else None
+        if self.peek() != "}":
+            self.error("bad {m,n}")
+        self.take()
+        if hi is not None and hi < lo:
+            self.error("bad repeat range")
+        if (hi or lo) > MAX_REPEAT:
+            raise RegexUnsupported(
+                f"repeat bound > {MAX_REPEAT} in {self.p!r}")
+        return lo, hi
+
+    def atom(self) -> _Node:
+        c = self.take()
+        if c == "(":
+            if self.peek() == "?":
+                self.take()
+                nxt = self.peek()
+                if nxt == ":":
+                    self.take()
+                else:
+                    self.error("only (?:...) groups supported")
+            node = self.alt()
+            if self.peek() != ")":
+                self.error("unbalanced group")
+            self.take()
+            return node
+        if c == "[":
+            return _Chars(self._char_class())
+        if c == ".":
+            return _Chars(_DOT.copy())
+        if c == "\\":
+            return _Chars(self._escape())
+        if c in "*+?{":
+            self.error(f"dangling quantifier {c!r}")
+        if c == "^":
+            self.error("'^' only supported at pattern start")
+        b = c.encode("utf-8")
+        if len(b) == 1:
+            return _Chars(_mask_of(chars=c))
+        # multi-byte literal char: byte sequence
+        return _Concat([_Chars(_mask_of((x, x))) for x in b])
+
+    def _escape(self) -> np.ndarray:
+        c = self.peek()
+        if c is None:
+            self.error("trailing backslash")
+        self.take()
+        if c in _ESCAPES:
+            return _ESCAPES[c].copy()
+        if c in _CTRL:
+            return _mask_of(chars=_CTRL[c])
+        if c == "x":
+            h = self.p[self.i:self.i + 2]
+            if len(h) != 2 or not all(x in "0123456789abcdefABCDEF"
+                                      for x in h):
+                self.error("bad \\x escape")
+            self.i += 2
+            return _mask_of((int(h, 16), int(h, 16)))
+        if c.isdigit():
+            raise RegexUnsupported(f"backreference \\{c} in {self.p!r}")
+        if c.isalpha():
+            raise RegexUnsupported(f"escape \\{c} unsupported")
+        return _mask_of(chars=c)  # escaped metachar
+
+    def _char_class(self) -> np.ndarray:
+        negate = False
+        if self.peek() == "^":
+            negate = True
+            self.take()
+        mask = np.zeros(256, dtype=bool)
+        first = True
+        while True:
+            c = self.peek()
+            if c is None:
+                self.error("unterminated character class")
+            if c == "]" and not first:
+                self.take()
+                break
+            first = False
+            if c == "\\":
+                self.take()
+                mask |= self._escape()
+                continue
+            self.take()
+            lo_ch = c
+            if (self.peek() == "-" and self.i + 1 < len(self.p) and
+                    self.p[self.i + 1] != "]"):
+                self.take()
+                hi_ch = self.take()
+                if hi_ch == "\\":
+                    self.error("escape as range endpoint unsupported")
+                lo_b, hi_b = ord(lo_ch), ord(hi_ch)
+                if lo_b > 127 or hi_b > 127:
+                    # code points are not bytes beyond ASCII (UTF-8)
+                    raise RegexUnsupported(
+                        "non-ASCII range in character class")
+                if lo_b > hi_b:
+                    self.error("bad class range")
+                mask[lo_b:hi_b + 1] = True
+            else:
+                b = lo_ch.encode("utf-8")
+                if len(b) > 1:
+                    raise RegexUnsupported(
+                        "non-ASCII in character class")
+                mask[b[0]] = True
+        return ~mask if negate else mask
+
+
+# ------------------------------------------------------------ NFA -> DFA
+
+class _NFA:
+    def __init__(self):
+        self.eps: List[List[int]] = []      # state -> eps targets
+        self.trans: List[List[Tuple[int, int]]] = []  # (mask_id, target)
+        self.masks: List[np.ndarray] = []
+
+    def new_state(self) -> int:
+        self.eps.append([])
+        self.trans.append([])
+        return len(self.eps) - 1
+
+    def add_mask(self, mask: np.ndarray) -> int:
+        for i, m in enumerate(self.masks):
+            if np.array_equal(m, mask):
+                return i
+        self.masks.append(mask)
+        return len(self.masks) - 1
+
+
+def _build(nfa: _NFA, node: _Node, start: int) -> int:
+    """Wire `node` from `start`; return its end state."""
+    if isinstance(node, _Chars):
+        end = nfa.new_state()
+        nfa.trans[start].append((nfa.add_mask(node.mask), end))
+        return end
+    if isinstance(node, _Concat):
+        cur = start
+        for part in node.parts:
+            cur = _build(nfa, part, cur)
+        return cur
+    if isinstance(node, _Alt):
+        end = nfa.new_state()
+        for opt in node.options:
+            s = nfa.new_state()
+            nfa.eps[start].append(s)
+            e = _build(nfa, opt, s)
+            nfa.eps[e].append(end)
+        return end
+    if isinstance(node, _Repeat):
+        cur = start
+        for _ in range(node.lo):
+            cur = _build(nfa, node.child, cur)
+        if node.hi is None:
+            # loop: child from cur back to cur
+            s = nfa.new_state()
+            nfa.eps[cur].append(s)
+            e = _build(nfa, node.child, s)
+            nfa.eps[e].append(s)
+            end = nfa.new_state()
+            nfa.eps[cur].append(end)
+            nfa.eps[e].append(end)
+            return end
+        for _ in range(node.hi - node.lo):
+            nxt = _build(nfa, node.child, cur)
+            nfa.eps[cur].append(nxt)  # optional
+            cur = nxt
+        return cur
+    raise AssertionError(node)
+
+
+class CompiledRegex:
+    """DFA tables ready for the device kernel.
+
+    table:   [n_states, n_classes] int32 next-state
+    classes: [256] int32 byte -> class
+    accept:  [n_states] bool
+    start:   int
+    """
+
+    def __init__(self, table, classes, accept, start, pattern):
+        self.table = table
+        self.classes = classes
+        self.accept = accept
+        self.start = start
+        self.pattern = pattern
+
+    @property
+    def n_states(self):
+        return self.table.shape[0]
+
+    def match_host(self, data: bytes) -> bool:
+        """Reference host implementation (tests / CPU path). Accept is
+        only checked at end-of-input: unanchored-end patterns have
+        absorbing accept states, so mid-string matches stick."""
+        s = self.start
+        for b in data:
+            s = int(self.table[s, self.classes[b]])
+        return bool(self.accept[s])
+
+
+def compile_search(pattern: str) -> CompiledRegex:
+    """Compile a pattern with Spark RLIKE (find-anywhere) semantics."""
+    parser = _Parser(pattern)
+    ast = parser.parse()
+    nfa = _NFA()
+    start = nfa.new_state()
+    if not parser.anchored_start:
+        nfa.trans[start].append((nfa.add_mask(_ANY.copy()), start))
+    final = _build(nfa, ast, start)
+    accept_nfa = {final}
+    n = len(nfa.eps)
+
+    # epsilon closures
+    closures: List[frozenset] = []
+    for s in range(n):
+        seen = {s}
+        stack = [s]
+        while stack:
+            x = stack.pop()
+            for t in nfa.eps[x]:
+                if t not in seen:
+                    seen.add(t)
+                    stack.append(t)
+        closures.append(frozenset(seen))
+
+    # byte -> class partition by signature across masks
+    nmasks = len(nfa.masks)
+    sig = np.zeros((256, nmasks), dtype=bool)
+    for mi, m in enumerate(nfa.masks):
+        sig[:, mi] = m
+    _, classes = np.unique(sig, axis=0, return_inverse=True)
+    n_classes = int(classes.max()) + 1
+    # class -> representative byte
+    rep = np.zeros(n_classes, dtype=np.int32)
+    for cl in range(n_classes):
+        rep[cl] = int(np.argmax(classes == cl))
+
+    # subset construction
+    start_set = closures[start]
+    dfa_states = {start_set: 0}
+    order = [start_set]
+    table_rows: List[List[int]] = []
+    accept_flags: List[bool] = []
+    i = 0
+    while i < len(order):
+        cur = order[i]
+        i += 1
+        is_acc = any(s in accept_nfa for s in cur)
+        accept_flags.append(is_acc)
+        row = []
+        for cl in range(n_classes):
+            b = rep[cl]
+            nxt = set()
+            if is_acc and not parser.anchored_end:
+                # absorbing accept: once found, stay accepted
+                row.append(-1)  # patched below
+                continue
+            for s in cur:
+                for mid, tgt in nfa.trans[s]:
+                    if nfa.masks[mid][b]:
+                        nxt |= closures[tgt]
+            key = frozenset(nxt)
+            if key not in dfa_states:
+                if len(dfa_states) >= MAX_STATES:
+                    raise RegexUnsupported(
+                        f"DFA exceeds {MAX_STATES} states for "
+                        f"{pattern!r}")
+                dfa_states[key] = len(order)
+                order.append(key)
+            row.append(dfa_states[key])
+        table_rows.append(row)
+
+    table = np.array(table_rows, dtype=np.int32)
+    accept = np.array(accept_flags, dtype=bool)
+    # patch absorbing accepts: self-loop
+    for si in range(table.shape[0]):
+        for cl in range(table.shape[1]):
+            if table[si, cl] == -1:
+                table[si, cl] = si
+    return CompiledRegex(table, classes.astype(np.int32), accept, 0,
+                         pattern)

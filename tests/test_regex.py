@@ -1,0 +1,181 @@
+"""Regex transpiler + device DFA tests (the RegexParser.scala test family
+analog): transpiled-DFA vs Python `re` oracle over pattern batteries,
+device rlike differential tests, and clean CPU fallback for
+untranspilable patterns / capture-group functions.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.regex import RegexUnsupported, compile_search
+from spark_rapids_tpu.testing.asserts import (
+    assert_tpu_and_cpu_are_equal_collect,
+    assert_tpu_fallback_collect,
+)
+
+PATTERNS = [
+    r"abc",
+    r"a.c",
+    r"^abc",
+    r"abc$",
+    r"^abc$",
+    r"a*b",
+    r"a+b+",
+    r"ab?c",
+    r"a{2,4}",
+    r"a{3}",
+    r"a{2,}b",
+    r"[abc]+",
+    r"[a-f0-9]{2}",
+    r"[^0-9]+$",
+    r"\d+",
+    r"\w+@\w+",
+    r"\s",
+    r"(ab|cd)+e",
+    r"(?:foo|bar|baz)",
+    r"x|y|z",
+    r"colou?r",
+    r"^$",
+    r"a|",
+    r"\.com$",
+    r"ERROR|WARN(ING)?",
+    r"[A-Z][a-z]*",
+]
+
+
+def _corpus(rng, n=300):
+    alphabet = "abcdefxyz0123456789 .@ABCDE-_|"
+    out = []
+    for i in range(n):
+        ln = int(rng.integers(0, 16))
+        out.append("".join(rng.choice(list(alphabet), ln)))
+    out += ["", "abc", "aabbcc", "aaaab", "colour", "color",
+            "foo@bar", "ERROR", "WARNING", "x", "ab cd e", "abcabc",
+            "aaa", "AbcDef", "12.com", "no match here!"]
+    return out
+
+
+@pytest.mark.parametrize("pattern", PATTERNS)
+def test_transpiled_dfa_matches_re(pattern):
+    rng = np.random.default_rng(hash(pattern) % 2**31)
+    rx = compile_search(pattern)
+    prx = re.compile(pattern)
+    for s in _corpus(rng):
+        want = prx.search(s) is not None
+        got = rx.match_host(s.encode())
+        assert got == want, (pattern, s, got, want)
+
+
+@pytest.mark.parametrize("pattern", [r"(a)\1", r"a{100}", r"\bword",
+                                     r"(?=look)", r"a|b$", r"^a|b",
+                                     r"[À-Ý]", r"\xzz"])
+def test_unsupported_patterns_raise(pattern):
+    """Untranspilable shapes (incl. per-branch anchors and non-ASCII
+    ranges, which would silently mis-match) raise for CPU fallback."""
+    with pytest.raises(RegexUnsupported):
+        compile_search(pattern)
+
+
+def test_regexp_replace_java_group_refs():
+    """Java $N group references in the replacement string."""
+
+    def q(s):
+        df = s.createDataFrame({"s": ["a-b", "c-d", "nodash"]})
+        return df.select(
+            F.regexp_replace(df["s"], r"(\w)-(\w)", "$2_$1")
+            .alias("swapped"))
+
+    import pyarrow as pa
+
+    from spark_rapids_tpu.testing.asserts import with_tpu_session
+
+    out = with_tpu_session(lambda s: q(s).collect_arrow())
+    assert out.column("swapped").to_pylist() == ["b_a", "d_c", "nodash"]
+
+
+def test_device_dfa_kernel():
+    import jax
+
+    from spark_rapids_tpu.columnar import arrow_to_device
+    from spark_rapids_tpu.ops import regexops
+
+    import pyarrow as pa
+
+    vals = ["hello42", "world", "h4x0r", "", "42", "no digits!",
+            None, "tail9"]
+    t = pa.table({"s": pa.array(vals, type=pa.string())})
+    batch = arrow_to_device(t)
+    rx = compile_search(r"\d+")
+    m = jax.jit(lambda c: regexops.dfa_match(c.data, c.lengths, rx))(
+        batch.columns[0])
+    got = np.asarray(m)[:batch.row_count()]
+    want = [s is not None and re.search(r"\d+", s) is not None
+            for s in vals]
+    got_masked = [bool(g) and v is not None for g, v in zip(got, vals)]
+    assert got_masked == want
+
+
+@pytest.mark.parametrize("pattern", [r"^name[0-4]$", r"\d{2,}",
+                                     r"(?:ab|cd)+", r"e$"])
+def test_rlike_query_differential(pattern):
+    def q(s):
+        df = s.createDataFrame({
+            "s": [f"name{i % 7}" if i % 3 else f"v{i}{'ab' * (i % 4)}e"
+                  for i in range(100)],
+        })
+        return df.withColumn("m", df["s"].rlike(pattern))
+
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_rlike_filter_on_device():
+    def q(s):
+        df = s.createDataFrame({
+            "s": [f"id-{i:03d}" if i % 2 else f"x{i}" for i in range(60)],
+            "v": list(range(60)),
+        })
+        return df.filter(df["s"].rlike(r"^id-\d+$")).select("s", "v")
+
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_rlike_unsupported_falls_back():
+    """Backreference: untranspilable -> operator runs on CPU, result
+    still correct (the reference's fallback tagging path)."""
+
+    def q(s):
+        df = s.createDataFrame({
+            "s": ["abab", "abcd", "aa", "ab", "xyxy"],
+        })
+        return df.withColumn("m", df["s"].rlike(r"(ab)\1"))
+
+    assert_tpu_fallback_collect(q, "CpuProjectExec")
+
+
+def test_regexp_extract_replace_fallback():
+    def q(s):
+        df = s.createDataFrame({
+            "s": [f"user{i}@host{i % 3}.com" for i in range(20)],
+        })
+        return df.select(
+            F.regexp_extract(df["s"], r"(\w+)@", 1).alias("user"),
+            F.regexp_replace(df["s"], r"@host\d", "@example")
+            .alias("fixed"))
+
+    assert_tpu_and_cpu_are_equal_collect(q)
+
+
+def test_rlike_with_nulls():
+    import pyarrow as pa
+
+    def q(s):
+        df = s.createDataFrame(pa.table({
+            "s": pa.array(["abc", None, "def", None, "abcdef"],
+                          type=pa.string()),
+        }))
+        return df.withColumn("m", df["s"].rlike("abc"))
+
+    assert_tpu_and_cpu_are_equal_collect(q)
