@@ -17,14 +17,28 @@ from spark_rapids_tpu.plan import logical as L
 
 
 def _resolve(expr, schema) -> Expression:
-    """Replace UnresolvedColumn markers with BoundReferences."""
+    """Replace UnresolvedColumn markers with BoundReferences; attempt
+    UDF bytecode compilation once argument types are concrete."""
     if isinstance(expr, UnresolvedColumn):
         i = _field_index(schema, expr.name)
         f = schema.fields[i]
         return BoundReference(i, f.dataType, f.nullable)
     if isinstance(expr, Expression):
         new_children = [_resolve(c, schema) for c in expr.children]
-        return expr.with_children(new_children)
+        node = expr.with_children(new_children)
+        if getattr(node, "_wants_compile", False):
+            from spark_rapids_tpu.expr import Cast
+            from spark_rapids_tpu.udf import UdfCompileError, compile_udf
+
+            try:
+                compiled = compile_udf(node.fn, new_children)
+                if compiled.dtype != node.dtype:
+                    compiled = Cast(compiled, node.dtype)
+                return compiled
+            except UdfCompileError as e:
+                node.compile_error = str(e)
+                node._wants_compile = False
+        return node
     raise TypeError(f"cannot resolve {expr!r}")
 
 

@@ -386,3 +386,34 @@ def regexp_replace(c, pattern: str, replacement: str) -> Column:
     from spark_rapids_tpu.expr.regexexpr import RegexpReplace
 
     return Column(RegexpReplace(expr_of(c), pattern, replacement))
+
+
+def udf(f=None, returnType=None):
+    """Compile a Python function to device expressions (the udf-compiler
+    analog); uncompilable functions fall back to rowwise host execution.
+
+        my_fn = F.udf(lambda x: x * 2 + 1, returnType=long)
+        df.select(my_fn(df["v"]).alias("out"))
+    """
+    from spark_rapids_tpu.sqltypes.datatypes import double as _dbl
+
+    rtype = returnType if returnType is not None else _dbl
+
+    def wrap(fn):
+        def apply(*cols) -> Column:
+            from spark_rapids_tpu.udf.pyudf import PythonUDF
+
+            exprs = [expr_of(c) for c in cols]
+            # compilation is deferred to column resolution, when the
+            # argument expressions carry concrete types
+            marker = PythonUDF(fn, exprs, rtype)
+            marker._wants_compile = True
+            return Column(marker, getattr(fn, "__name__", "udf"))
+
+        apply.fn = fn
+        apply.returnType = rtype
+        return apply
+
+    if f is not None:
+        return wrap(f)
+    return wrap

@@ -349,8 +349,16 @@ _LOG_BOUNDS = {Log: (0.0, _math.log), Log10: (0.0, _math.log10),
                Log1p: (-1.0, _math.log1p)}
 
 
+from spark_rapids_tpu.udf.pyudf import PythonUDF  # noqa: E402
+
+
 def _ev_ext(e: Expression, t: pa.Table):
     """Extended-expression oracle; returns None when not handled here."""
+    if isinstance(e, PythonUDF):
+        cols = [_as_list(_ev(c, t), t) for c in e.children]
+        out = [e.fn(*row) for row in zip(*cols)] if cols else \
+            [e.fn() for _ in range(t.num_rows)]
+        return pa.array(out, to_arrow_type(e.dtype))
     cls = type(e)
     if cls in _UNARY_MATH_PY and cls is not Rint:
         xs = _pylist_f(_ev(e.children[0], t), t)

@@ -1,0 +1,5 @@
+from spark_rapids_tpu.udf.compiler import (  # noqa: F401
+    UdfCompileError,
+    compile_udf,
+)
+from spark_rapids_tpu.udf.pyudf import PythonUDF  # noqa: F401

@@ -1,0 +1,40 @@
+"""PythonUDF — rowwise host fallback for uncompilable UDFs.
+
+The reference runs uncompiled UDFs as black-box JVM calls on the CPU
+plan; here the fallback expression has no device implementation, so the
+planner tags its operator to the CPU backend (typesig's generic
+no-device-impl rule) and cpu_eval applies the function rowwise.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from spark_rapids_tpu.expr.core import Expression
+from spark_rapids_tpu.sqltypes import DataType
+
+
+class PythonUDF(Expression):
+    def __init__(self, fn, children, return_type: DataType,
+                 name: Optional[str] = None,
+                 compile_error: Optional[str] = None):
+        super().__init__(list(children))
+        self.fn = fn
+        self._return_type = return_type
+        self.udf_name = name or getattr(fn, "__name__", "udf")
+        self.compile_error = compile_error
+
+    @property
+    def dtype(self):
+        return self._return_type
+
+    @property
+    def nullable(self):
+        return True
+
+    def key(self):
+        return ("pyudf", id(self.fn),
+                tuple(c.key() for c in self.children))
+
+    def __repr__(self):
+        return f"PythonUDF({self.udf_name})"
