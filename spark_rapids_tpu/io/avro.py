@@ -1,0 +1,281 @@
+"""Pure-Python Avro Object Container File reader -> arrow tables.
+
+The reference reads Avro with its own pure-Scala block parser
+(`AvroDataFileReader.scala`, 478 LoC) feeding device decode — no
+external Avro library — because only the container framing and a small
+record subset are needed. Same stance here: header/schema/sync parsing,
+null+deflate codecs, records of primitives, nullable ["null", T] unions,
+and the common logical types (date, timestamp-micros/millis).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import pyarrow as pa
+
+MAGIC = b"Obj\x01"
+
+
+class AvroError(Exception):
+    pass
+
+
+class _Reader:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def read(self, n: int) -> bytes:
+        b = self.buf[self.pos:self.pos + n]
+        if len(b) != n:
+            raise AvroError("truncated avro data")
+        self.pos += n
+        return b
+
+    def at_end(self) -> bool:
+        return self.pos >= len(self.buf)
+
+    def zigzag_long(self) -> int:
+        shift = 0
+        acc = 0
+        while True:
+            b = self.buf[self.pos]
+            self.pos += 1
+            acc |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                break
+            shift += 7
+        return (acc >> 1) ^ -(acc & 1)
+
+    def bytes_(self) -> bytes:
+        n = self.zigzag_long()
+        return self.read(n)
+
+    def string(self) -> str:
+        return self.bytes_().decode("utf-8")
+
+    def float_(self) -> float:
+        return struct.unpack("<f", self.read(4))[0]
+
+    def double(self) -> float:
+        return struct.unpack("<d", self.read(8))[0]
+
+
+def _arrow_type(schema) -> pa.DataType:
+    if isinstance(schema, str):
+        return {
+            "null": pa.null(), "boolean": pa.bool_(), "int": pa.int32(),
+            "long": pa.int64(), "float": pa.float32(),
+            "double": pa.float64(), "bytes": pa.binary(),
+            "string": pa.string(),
+        }[schema]
+    if isinstance(schema, dict):
+        t = schema["type"]
+        lt = schema.get("logicalType")
+        if lt == "date" and t == "int":
+            return pa.date32()
+        if lt == "timestamp-micros" and t == "long":
+            return pa.timestamp("us")
+        if lt == "timestamp-millis" and t == "long":
+            return pa.timestamp("ms")
+        if lt == "decimal":
+            raise AvroError("avro decimal unsupported")
+        return _arrow_type(t)
+    if isinstance(schema, list):  # union
+        non_null = [s for s in schema if s != "null"]
+        if len(non_null) != 1:
+            raise AvroError(f"general unions unsupported: {schema}")
+        return _arrow_type(non_null[0])
+    raise AvroError(f"avro type {schema!r} unsupported")
+
+
+def _read_value(r: _Reader, schema) -> Any:
+    if isinstance(schema, str):
+        if schema == "null":
+            return None
+        if schema == "boolean":
+            return r.read(1) == b"\x01"
+        if schema in ("int", "long"):
+            return r.zigzag_long()
+        if schema == "float":
+            return r.float_()
+        if schema == "double":
+            return r.double()
+        if schema == "bytes":
+            return r.bytes_()
+        if schema == "string":
+            return r.string()
+        raise AvroError(f"avro type {schema!r} unsupported")
+    if isinstance(schema, dict):
+        return _read_value(r, schema["type"]) \
+            if not isinstance(schema["type"], dict) else \
+            _read_value(r, schema["type"])
+    if isinstance(schema, list):  # union: branch index then value
+        idx = r.zigzag_long()
+        if idx < 0 or idx >= len(schema):
+            raise AvroError("bad union branch")
+        return _read_value(r, schema[idx])
+    raise AvroError(f"avro type {schema!r} unsupported")
+
+
+def read_avro(path: str) -> pa.Table:
+    with open(path, "rb") as f:
+        data = f.read()
+    r = _Reader(data)
+    if r.read(4) != MAGIC:
+        raise AvroError(f"{path}: not an avro container file")
+    # file metadata map
+    meta: Dict[str, bytes] = {}
+    while True:
+        n = r.zigzag_long()
+        if n == 0:
+            break
+        if n < 0:  # block with byte size prefix
+            r.zigzag_long()
+            n = -n
+        for _ in range(n):
+            k = r.string()
+            v = r.bytes_()
+            meta[k] = v
+    sync = r.read(16)
+    schema = json.loads(meta["avro.schema"])
+    codec = meta.get("avro.codec", b"null").decode()
+    if schema.get("type") != "record":
+        raise AvroError("top-level avro schema must be a record")
+    fields = schema["fields"]
+
+    cols: Dict[str, List] = {f["name"]: [] for f in fields}
+    while not r.at_end():
+        nrecords = r.zigzag_long()
+        nbytes = r.zigzag_long()
+        block = r.read(nbytes)
+        if codec == "deflate":
+            block = zlib.decompress(block, -15)
+        elif codec != "null":
+            raise AvroError(f"avro codec {codec!r} unsupported")
+        br = _Reader(block)
+        for _ in range(nrecords):
+            for fld in fields:
+                cols[fld["name"]].append(_read_value(br, fld["type"]))
+        if r.read(16) != sync:
+            raise AvroError("sync marker mismatch")
+
+    arrays = []
+    names = []
+    for fld in fields:
+        at = _arrow_type(fld["type"])
+        vals = cols[fld["name"]]
+        if pa.types.is_date32(at):
+            arrays.append(pa.array(vals, type=pa.int32()).cast(at))
+        elif pa.types.is_timestamp(at):
+            arrays.append(pa.array(vals, type=pa.int64()).cast(at))
+        else:
+            arrays.append(pa.array(vals, type=at))
+        names.append(fld["name"])
+    return pa.Table.from_arrays(arrays, names=names)
+
+
+# --- writer (round-trip support for tests + export) ---
+
+def _zigzag_encode(v: int) -> bytes:
+    u = (v << 1) ^ (v >> 63) if v < 0 else v << 1
+    out = bytearray()
+    while True:
+        b = u & 0x7F
+        u >>= 7
+        if u:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _write_value(out: bytearray, schema, v):
+    if isinstance(schema, list):
+        non_null_idx = next(i for i, s in enumerate(schema)
+                            if s != "null")
+        null_idx = next(i for i, s in enumerate(schema) if s == "null")
+        if v is None:
+            out += _zigzag_encode(null_idx)
+            return
+        out += _zigzag_encode(non_null_idx)
+        _write_value(out, schema[non_null_idx], v)
+        return
+    if isinstance(schema, dict):
+        _write_value(out, schema["type"], v)
+        return
+    if schema == "null":
+        return
+    if schema == "boolean":
+        out += b"\x01" if v else b"\x00"
+    elif schema in ("int", "long"):
+        out += _zigzag_encode(int(v))
+    elif schema == "float":
+        out += struct.pack("<f", v)
+    elif schema == "double":
+        out += struct.pack("<d", v)
+    elif schema == "bytes":
+        out += _zigzag_encode(len(v)) + v
+    elif schema == "string":
+        b = v.encode("utf-8")
+        out += _zigzag_encode(len(b)) + b
+    else:
+        raise AvroError(f"cannot write {schema!r}")
+
+
+def _avro_schema_of(at: pa.DataType):
+    m = {pa.bool_(): "boolean", pa.int32(): "int", pa.int64(): "long",
+         pa.float32(): "float", pa.float64(): "double",
+         pa.binary(): "bytes", pa.string(): "string"}
+    if at in m:
+        return m[at]
+    if pa.types.is_date32(at):
+        return {"type": "int", "logicalType": "date"}
+    if pa.types.is_timestamp(at):
+        return {"type": "long", "logicalType": "timestamp-micros"}
+    raise AvroError(f"cannot write arrow type {at}")
+
+
+def write_avro(table: pa.Table, path: str, codec: str = "deflate"):
+    fields = []
+    for f in table.schema:
+        fields.append({"name": f.name,
+                       "type": ["null", _avro_schema_of(f.type)]})
+    schema = {"type": "record", "name": "row", "fields": fields}
+    meta_out = bytearray()
+    meta_out += _zigzag_encode(2)
+    for k, v in (("avro.schema", json.dumps(schema).encode()),
+                 ("avro.codec", codec.encode())):
+        kb = k.encode()
+        meta_out += _zigzag_encode(len(kb)) + kb
+        meta_out += _zigzag_encode(len(v)) + v
+    meta_out += _zigzag_encode(0)
+    sync = b"SPARKTPUAVROSYNC"  # 16 bytes
+    body = bytearray()
+    cols = [c.combine_chunks() for c in table.columns]
+    # timestamps serialize as micros since epoch
+    norm = []
+    for c, f in zip(cols, table.schema):
+        if pa.types.is_timestamp(f.type):
+            norm.append(c.cast(pa.timestamp("us")).cast(pa.int64()))
+        elif pa.types.is_date32(f.type):
+            norm.append(c.cast(pa.int32()))
+        else:
+            norm.append(c)
+    n = table.num_rows
+    block = bytearray()
+    for i in range(n):
+        for c, fld in zip(norm, fields):
+            _write_value(block, fld["type"], c[i].as_py())
+    payload = bytes(block)
+    if codec == "deflate":
+        co = zlib.compressobj(wbits=-15)
+        payload = co.compress(payload) + co.flush()
+    body += _zigzag_encode(n) + _zigzag_encode(len(payload)) + payload
+    body += sync
+    with open(path, "wb") as f:
+        f.write(MAGIC + bytes(meta_out) + sync + bytes(body))

@@ -139,3 +139,64 @@ def read_json(path: str) -> pa.Table:
 
 def write_parquet(table: pa.Table, path: str, **options):
     pq.write_table(table, path, **options)
+
+
+def read_orc(path: str, columns: Optional[List[str]] = None) -> pa.Table:
+    from pyarrow import orc as pa_orc
+
+    t = pa_orc.read_table(path, columns=columns)
+    return t
+
+
+def infer_orc_schema(paths: List[str]) -> pa.Schema:
+    from pyarrow import orc as pa_orc
+
+    files = expand_paths(paths, ".orc")
+    if not files:
+        raise FileNotFoundError(f"no orc files in {paths}")
+    return pa_orc.ORCFile(files[0]).schema
+
+
+def infer_avro_schema(paths: List[str]) -> pa.Schema:
+    from spark_rapids_tpu.io.avro import read_avro
+
+    files = expand_paths(paths, ".avro")
+    if not files:
+        raise FileNotFoundError(f"no avro files in {paths}")
+    return read_avro(files[0]).schema
+
+
+def split_file_tasks(paths: List[str], suffix: str,
+                     coalesce_target_bytes: int) -> List[List[str]]:
+    """COALESCING task split for any single-file format."""
+    files = expand_paths(paths, suffix)
+    tasks: List[List[str]] = []
+    cur: List[str] = []
+    cur_bytes = 0
+    for f in files:
+        sz = os.path.getsize(f)
+        if cur and cur_bytes + sz > coalesce_target_bytes:
+            tasks.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(f)
+        cur_bytes += sz
+    if cur:
+        tasks.append(cur)
+    return tasks or [[]]
+
+
+def read_parquet_task_filtered(files: List[str],
+                               columns: Optional[List[str]],
+                               batch_rows: int,
+                               filters) -> Iterator[pa.Table]:
+    """Parquet read with row-group statistics pruning via pushed filter
+    tuples (reference predicate pushdown, GpuParquetScan.scala:556)."""
+    if not filters:
+        yield from read_parquet_task(files, columns, batch_rows)
+        return
+    for f in files:
+        t = pq.read_table(f, columns=columns, filters=filters)
+        for off in range(0, max(t.num_rows, 1), batch_rows):
+            piece = t.slice(off, min(batch_rows, t.num_rows - off))
+            if piece.num_rows:
+                yield piece
