@@ -382,24 +382,41 @@ void* stpu_pool_alloc(void* pv, int64_t n) {
     std::lock_guard<std::mutex> g(p->mu);
     auto it = p->freelist.lower_bound(n);
     if (it != p->freelist.end() && it->first <= n * 2) {
-      void* blk = it->second;
+      // Reused blocks also reserve budget via CAS: the block's bytes left
+      // in_use at free time, so taking it back must re-check capacity or
+      // the freelist path oversubscribes the hard bound.
       int64_t sz = it->first;
-      p->freelist.erase(it);
-      int64_t now = p->in_use.fetch_add(sz) + sz;
-      int64_t pk = p->peak.load();
-      while (now > pk && !p->peak.compare_exchange_weak(pk, now)) {}
-      p->alloc_count++;
-      return blk;
+      int64_t cur = p->in_use.load();
+      bool fits = true;
+      do {
+        if (cur + sz > p->capacity) { fits = false; break; }
+      } while (!p->in_use.compare_exchange_weak(cur, cur + sz));
+      if (fits) {
+        void* blk = it->second;
+        p->freelist.erase(it);
+        int64_t now = cur + sz;
+        int64_t pk = p->peak.load();
+        while (now > pk && !p->peak.compare_exchange_weak(pk, now)) {}
+        p->alloc_count++;
+        return blk;
+      }
+      // an oversized reuse block does not fit the budget; fall through to
+      // an exact-size fresh allocation, which re-checks capacity
     }
   }
-  if (p->in_use.load() + n > p->capacity) return nullptr;
+  // Reserve budget with a CAS loop so capacity is a hard bound even under
+  // concurrent allocations (non-atomic check-then-add could oversubscribe).
+  int64_t cur = p->in_use.load();
+  do {
+    if (cur + n > p->capacity) return nullptr;
+  } while (!p->in_use.compare_exchange_weak(cur, cur + n));
   void* blk = ::operator new((size_t)n, std::nothrow);
-  if (!blk) return nullptr;
+  if (!blk) { p->in_use.fetch_sub(n); return nullptr; }
   {
     std::lock_guard<std::mutex> g(p->mu);
     p->sizes[blk] = n;
   }
-  int64_t now = p->in_use.fetch_add(n) + n;
+  int64_t now = cur + n;
   int64_t pk = p->peak.load();
   while (now > pk && !p->peak.compare_exchange_weak(pk, now)) {}
   p->alloc_count++;

@@ -393,6 +393,18 @@ def compile_search(pattern: str) -> CompiledRegex:
         nfa.trans[start].append((nfa.add_mask(_ANY.copy()), start))
     final = _build(nfa, ast, start)
     accept_nfa = {final}
+    if parser.anchored_end:
+        # `$` matches at end-of-input OR just before one final '\n' —
+        # the Python-re semantics the engine's CPU oracle uses. (Java
+        # Matcher additionally treats \r, \r\n and the unicode line
+        # separators U+0085/U+2028/U+2029 as terminators; those stay
+        # outside the transpiled subset, the same caveat class as the
+        # byte-oriented `.` documented above.)
+        nl = np.zeros(256, dtype=bool)
+        nl[0x0A] = True
+        final_nl = nfa.new_state()
+        nfa.trans[final].append((nfa.add_mask(nl), final_nl))
+        accept_nfa.add(final_nl)
     n = len(nfa.eps)
 
     # epsilon closures

@@ -31,7 +31,8 @@ from spark_rapids_tpu.expr import (
     Abs, Add, And, BRound, Cast, Concat, Divide, EndsWith,
     EqualTo, GreaterThan, GreaterThanOrEqual, Greatest, If, In,
     IntegralDivide, IsNull, Least, Length, LessThan, LessThanOrEqual,
-    Literal, Lower, Multiply, Not, Or, Pmod, Pow, ShiftLeft, ShiftRight,
+    Literal, Lower, Multiply, Not, Or, Pow, Remainder,
+    ShiftLeft, ShiftRight,
     StartsWith, StringReplace, StringTrim, StringTrimLeft,
     StringTrimRight, Subtract, UnaryMinus, Upper,
 )
@@ -119,12 +120,33 @@ def _binary(op: str, a, b):
     if op == "//":
         if (isinstance(a.dtype, IntegralType) and
                 isinstance(b.dtype, IntegralType)):
-            # Python floors; Spark IntegralDivide truncates — exact
-            # integer floor: (a - pymod(a, b)) div b
-            return IntegralDivide(Subtract(a, Pmod(a, b)), b)
+            # Python floors toward -inf for EITHER divisor sign; Spark
+            # IntegralDivide truncates toward zero. q_floor = q_trunc - 1
+            # when a nonzero remainder disagrees in sign with b.
+            q = IntegralDivide(a, b)
+            r = Remainder(a, b)
+            needs_fix = And(
+                Not(EqualTo(r, Literal(0, long))),
+                Not(EqualTo(LessThan(r, Literal(0, long)),
+                            LessThan(b, Literal(0, long)))))
+            return If(needs_fix, Subtract(q, Literal(1, long)), q)
         raise UdfCompileError("float // unsupported")
     if op == "%":
-        return Pmod(a, b)  # Python sign-of-divisor == Spark pmod
+        # Python % takes the sign of the divisor; the engine's Remainder
+        # is Java-truncated (sign of dividend). Correct with r_trunc + b
+        # when a nonzero truncated remainder disagrees in sign with b —
+        # for both integral and floating operands (Pmod would diverge
+        # from Python whenever b < 0).
+        if (isinstance(a.dtype, IntegralType) and
+                isinstance(b.dtype, IntegralType)):
+            zero = Literal(0, long)
+        else:
+            zero = Literal(0.0, double)
+        r = Remainder(a, b)
+        needs_fix = And(
+            Not(EqualTo(r, zero)),
+            Not(EqualTo(LessThan(r, zero), LessThan(b, zero))))
+        return If(needs_fix, Add(r, b), r)
     if op == "**":
         return Pow(Cast(a, double), Cast(b, double))
     if op == "&":

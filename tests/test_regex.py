@@ -54,7 +54,9 @@ def _corpus(rng, n=300):
         out.append("".join(rng.choice(list(alphabet), ln)))
     out += ["", "abc", "aabbcc", "aaaab", "colour", "color",
             "foo@bar", "ERROR", "WARNING", "x", "ab cd e", "abcabc",
-            "aaa", "AbcDef", "12.com", "no match here!"]
+            "aaa", "AbcDef", "12.com", "no match here!",
+            # `$` matches before one final newline (Java Matcher / re)
+            "abc\n", "abc\n\n", "\n", "12.com\n", "abc\ndef"]
     return out
 
 

@@ -42,6 +42,11 @@ NUMERIC_UDFS = [
     (lambda x: (x & 255) ^ (x >> 3 & 15), long),
     (lambda x: x in (1, 5, 9, 42), boolean),
     (lambda x: round(x / 7, 2), double),
+    (lambda x: x // -3, long),
+    (lambda x: x % -3, long),
+    (lambda x: x % -2.5, double),
+    (lambda x: (x / 2) % -3.0, double),
+    (lambda x: (x % 2 == 0) and (x // -7) % 5 > 1, boolean),
 ]
 
 
