@@ -1,39 +1,94 @@
-"""Benchmark: flagship q5-shaped columnar pipeline on the device.
+"""Benchmark: the ENGINE end-to-end on the q5-shaped slice.
 
-Measures the fused scan->filter->project->hash-aggregate stage (the
-TPC-DS q5 minimum slice, SURVEY.md section 7) as device throughput in
-GB/s of columnar input processed, against a pyarrow CPU baseline running
-the same query — the stand-in for the reference's CPU-Spark baseline
-(BASELINE.md metric: per-chip GB/s columnar scan).
+Unlike a fused-kernel microbench, this drives the full stack the way a
+user query does: session -> optimizer -> planner (TpuOverrides) ->
+TpuFileScanExec (parquet decode + H2D) -> jitted filter/project ->
+out-of-core hash aggregate (partial) -> shuffle exchange -> final
+aggregate -> D2H collect, with the semaphore, reservation ledger, and
+spill catalog all live (round-2 verdict item: bench the engine, not the
+demo).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Input is a >= 1 GiB parquet dataset (written once, cached in /tmp).
+Reports the MEDIAN of N engine runs with inter-quartile dispersion, the
+CPU (pyarrow) baseline on the same query, and the HBM-roofline fraction
+(input bytes / elapsed / device peak memory bandwidth) so absolute
+numbers are diagnosable across environments.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 import json
-import sys
+import os
+import statistics
 import time
 
 import numpy as np
 import pyarrow as pa
 import pyarrow.compute as pc
+import pyarrow.parquet as pq
 
-ROWS = 4_000_000
+ROWS = 36_000_000          # 4 x 8B columns ~= 1.07 GiB
+FILES = 8
 REPEATS = 5
+DATA_DIR = "/tmp/srtpu_bench_data_v3"
+
+# peak HBM bandwidth per chip, bytes/s (public TPU specs; cpu backend
+# gets a nominal DDR figure so the fraction stays meaningful)
+_PEAK_BW = {
+    "TPU v4": 1.2e12,
+    "TPU v5e": 8.19e11,
+    "TPU v5 lite": 8.19e11,
+    "TPU v5p": 2.765e12,
+    "TPU v6e": 1.64e12,
+    "cpu": 5.0e10,
+}
 
 
-def build_table(rows: int) -> pa.Table:
+def ensure_data() -> int:
+    """Write the dataset once; return total bytes (arrow buffer size)."""
+    marker = os.path.join(DATA_DIR, "_DONE")
+    per = ROWS // FILES
+    if os.path.exists(marker):
+        return int(open(marker).read())
+    os.makedirs(DATA_DIR, exist_ok=True)
     rng = np.random.default_rng(0)
-    return pa.table({
-        "store": pa.array(rng.integers(0, 200, rows), type=pa.int64()),
-        "amount": pa.array(rng.random(rows) * 100.0, type=pa.float64()),
-        "qty": pa.array(rng.integers(1, 100, rows), type=pa.int64()),
-    })
+    total = 0
+    for i in range(FILES):
+        t = pa.table({
+            "store": pa.array(rng.integers(0, 2000, per),
+                              type=pa.int64()),
+            "amount": pa.array(rng.random(per) * 100.0,
+                               type=pa.float64()),
+            "qty": pa.array(rng.integers(1, 100, per), type=pa.int64()),
+            "day": pa.array(rng.integers(0, 365, per), type=pa.int64()),
+        })
+        total += t.nbytes
+        pq.write_table(t, os.path.join(DATA_DIR, f"part-{i}.parquet"),
+                       row_group_size=1 << 21)
+    with open(marker, "w") as f:
+        f.write(str(total))
+    return total
 
 
-def cpu_query(table: pa.Table):
-    f = table.filter(pc.greater(table.column("amount"), 10.0))
-    rev = pc.multiply(f.column("amount"), pc.cast(f.column("qty"),
-                                                  pa.float64()))
+def engine_query(spark, path):
+    from spark_rapids_tpu.api import functions as F
+
+    return (spark.read.parquet(path)
+            .filter(F.col("amount") > 10.0)
+            .select("store",
+                    (F.col("amount") * F.col("qty")).alias("revenue"),
+                    "amount")
+            .groupBy("store")
+            .agg(F.sum("revenue").alias("rev"),
+                 F.avg("amount").alias("avg_amount"),
+                 F.count("*").alias("sales")))
+
+
+def cpu_query(path):
+    t = pq.read_table(path)
+    f = t.filter(pc.greater(t.column("amount"), 10.0))
+    rev = pc.multiply(f.column("amount"),
+                      pc.cast(f.column("qty"), pa.float64()))
     work = pa.table({"store": f.column("store"), "revenue": rev,
                      "amount": f.column("amount")})
     return work.group_by("store").aggregate(
@@ -45,48 +100,59 @@ def main():
 
     jax.config.update("jax_enable_x64", True)
 
-    from spark_rapids_tpu.columnar import arrow_to_device
+    input_bytes = ensure_data()
 
-    import importlib.util
-    import os
+    from spark_rapids_tpu.api.session import TpuSparkSession
 
-    entry_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              "__graft_entry__.py")
-    spec = importlib.util.spec_from_file_location("graft_entry", entry_path)
-    ge = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(ge)
-
-    table = build_table(ROWS)
-    input_bytes = table.nbytes
+    spark = TpuSparkSession({
+        "spark.sql.shuffle.partitions": 8,
+        "spark.rapids.sql.reader.batchSizeRows": 1 << 22,
+        "spark.rapids.sql.batchSizeRows": 1 << 22,
+    })
 
     # ---- CPU baseline (pyarrow, the vectorized CPU engine) ----
-    cpu_query(table.slice(0, 100_000))  # warm
-    t0 = time.perf_counter()
-    for _ in range(max(1, REPEATS // 2)):
-        cpu_query(table)
-    cpu_time = (time.perf_counter() - t0) / max(1, REPEATS // 2)
-    cpu_gbps = input_bytes / cpu_time / 1e9
+    cpu_times = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        cpu_out = cpu_query(DATA_DIR)
+        cpu_times.append(time.perf_counter() - t0)
+    cpu_gbps = input_bytes / min(cpu_times) / 1e9
 
-    # ---- device pipeline ----
-    query_step, _ = ge.entry()
-    batch = arrow_to_device(table)
-    jitted = jax.jit(query_step)
-    out = jitted(batch)  # compile + run
-    jax.block_until_ready(jax.tree_util.tree_leaves(out))
-    t0 = time.perf_counter()
+    # ---- engine (planner -> operators -> shuffle -> collect) ----
+    df = engine_query(spark, DATA_DIR)
+    out = df.collect_arrow()  # warm: compile caches, reader pools
+    assert out.num_rows == cpu_out.num_rows, (out.num_rows,
+                                              cpu_out.num_rows)
+    times = []
     for _ in range(REPEATS):
-        out = jitted(batch)
-        jax.block_until_ready(jax.tree_util.tree_leaves(out))
-    dev_time = (time.perf_counter() - t0) / REPEATS
-    dev_gbps = input_bytes / dev_time / 1e9
+        t0 = time.perf_counter()
+        out = df.collect_arrow()
+        times.append(time.perf_counter() - t0)
+    med = statistics.median(times)
+    times_sorted = sorted(times)
+    q1 = times_sorted[len(times) // 4]
+    q3 = times_sorted[(3 * len(times)) // 4]
+    spread_pct = 100.0 * (q1 and (q3 - q1) / med or 0.0)
+    dev_gbps = input_bytes / med / 1e9
 
-    backend = jax.devices()[0].platform
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", dev.platform)
+    peak = next((v for k, v in _PEAK_BW.items()
+                 if k.lower() in str(kind).lower()),
+                _PEAK_BW["cpu"])
+    roofline = dev_gbps * 1e9 / peak
+
     print(json.dumps({
-        "metric": f"q5-slice columnar pipeline throughput ({backend}, "
-                  f"{ROWS} rows)",
+        "metric": f"q5-slice engine end-to-end throughput ({dev.platform},"
+                  f" {ROWS} rows, {input_bytes >> 20} MiB)",
         "value": round(dev_gbps, 3),
         "unit": "GB/s",
         "vs_baseline": round(dev_gbps / cpu_gbps, 3),
+        "median_s": round(med, 3),
+        "spread_pct": round(spread_pct, 1),
+        "cpu_baseline_gbps": round(cpu_gbps, 3),
+        "roofline_frac": round(roofline, 4),
+        "device_kind": str(kind),
     }))
 
 

@@ -40,7 +40,11 @@ class Column:
             else self.expr
         return Column(Alias(base, name), name)
 
-    def cast(self, to: DataType) -> "Column":
+    def cast(self, to) -> "Column":
+        if isinstance(to, str):
+            from spark_rapids_tpu.sqltypes.datatypes import parse_type_name
+
+            to = parse_type_name(to)
         return Column(Cast(self.expr, to))
 
     # arithmetic

@@ -377,14 +377,29 @@ class DataFrame:
     # --- actions ---
 
     def _physical(self):
+        from spark_rapids_tpu.plan.optimizer import optimize
         from spark_rapids_tpu.plan.overrides import plan_query
 
-        return plan_query(self._plan, self.session.rapids_conf)
+        return plan_query(optimize(self._plan), self.session.rapids_conf)
 
     def collect_arrow(self) -> pa.Table:
+        from spark_rapids_tpu.config import rapids_conf as rc
+
         phys, _ = self._physical()
         if self.session.rapids_conf.is_explain_only:
             return pa.table({})
+        mesh_n = self.session.rapids_conf.get(rc.MESH_SIZE)
+        if mesh_n:
+            from spark_rapids_tpu.parallel.plan_compiler import (
+                MeshCompileError,
+                MeshQueryExecutor,
+            )
+
+            try:
+                return MeshQueryExecutor.for_devices(
+                    mesh_n, self.session.rapids_conf).execute(phys)
+            except MeshCompileError:
+                pass  # operator without a mesh lowering: thread-pool path
         return phys.collect()
 
     def collect(self) -> List[tuple]:

@@ -417,3 +417,38 @@ def udf(f=None, returnType=None):
     if f is not None:
         return wrap(f)
     return wrap
+
+
+def pandas_udf(f=None, returnType=None):
+    """Scalar pandas UDF: runs over pandas Series in a worker-process
+    pool via Arrow IPC (the GpuArrowEvalPythonExec exchange analog,
+    udf/pandas_udf.py).
+
+        @F.pandas_udf(returnType=double)
+        def plus_one(s):
+            return s + 1.0
+        df.select(plus_one(df["v"]).alias("out"))
+    """
+    from spark_rapids_tpu.sqltypes.datatypes import double as _dbl
+
+    rtype = returnType if returnType is not None else _dbl
+    if isinstance(rtype, str):
+        from spark_rapids_tpu.sqltypes.datatypes import parse_type_name
+
+        rtype = parse_type_name(rtype)
+
+    def wrap(fn):
+        def apply(*cols) -> Column:
+            from spark_rapids_tpu.udf.pandas_udf import PandasUDF
+
+            exprs = [expr_of(c) for c in cols]
+            return Column(PandasUDF(fn, rtype, exprs),
+                          getattr(fn, "__name__", "pandas_udf"))
+
+        apply.fn = fn
+        apply.returnType = rtype
+        return apply
+
+    if f is not None:
+        return wrap(f)
+    return wrap

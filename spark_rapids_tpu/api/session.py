@@ -85,6 +85,28 @@ class DataFrameReader:
         return DataFrame(FileScan("json", [path], schema, self._options),
                          self.session)
 
+    def orc(self, *paths: str):
+        from spark_rapids_tpu.api.dataframe import DataFrame
+        from spark_rapids_tpu.columnar.arrow_bridge import schema_from_arrow
+        from spark_rapids_tpu.io.readers import infer_orc_schema
+        from spark_rapids_tpu.plan.logical import FileScan
+
+        schema = self._schema or schema_from_arrow(
+            infer_orc_schema(list(paths)))
+        return DataFrame(FileScan("orc", list(paths), schema,
+                                  self._options), self.session)
+
+    def avro(self, *paths: str):
+        from spark_rapids_tpu.api.dataframe import DataFrame
+        from spark_rapids_tpu.columnar.arrow_bridge import schema_from_arrow
+        from spark_rapids_tpu.io.readers import infer_avro_schema
+        from spark_rapids_tpu.plan.logical import FileScan
+
+        schema = self._schema or schema_from_arrow(
+            infer_avro_schema(list(paths)))
+        return DataFrame(FileScan("avro", list(paths), schema,
+                                  self._options), self.session)
+
 
 _active: Optional["TpuSparkSession"] = None
 _active_lock = threading.Lock()
@@ -114,7 +136,10 @@ class TpuSparkSession:
             self.rapids_conf.get(rc.SHUFFLE_MODE),
             shuffle_dir=self.rapids_conf.get(rc.SPILL_DIR) or None,
             num_threads=self.rapids_conf.get(
-                rc.MULTITHREADED_READ_NUM_THREADS))
+                rc.MULTITHREADED_READ_NUM_THREADS),
+            codec=self.rapids_conf.get(rc.SHUFFLE_COMPRESSION_CODEC),
+            spill_threshold=self.rapids_conf.get(
+                rc.SHUFFLE_SPILL_THRESHOLD))
 
     # --- conf ---
 

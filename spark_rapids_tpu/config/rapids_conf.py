@@ -133,6 +133,17 @@ SHUFFLE_MODE = conf(
     "RapidsShuffleInternalManagerBase.scala:238) or ICI (device-resident "
     "all-to-all collectives over the mesh, the UCX transport analog).", str,
     checker=lambda v: v in ("MULTITHREADED", "ICI", "CACHE_ONLY"))
+SHUFFLE_COMPRESSION_CODEC = conf(
+    "spark.rapids.shuffle.compression.codec", "zstd",
+    "Codec for serialized shuffle blocks: none|zstd|zlib (the reference "
+    "compresses shuffle payloads with nvcomp LZ4/ZSTD, "
+    "TableCompressionCodec.scala; zstd level 1 here).", str,
+    checker=lambda v: v in ("none", "zstd", "zlib"))
+SHUFFLE_SPILL_THRESHOLD = conf(
+    "spark.rapids.shuffle.spillThresholdBytes", 2 << 30,
+    "Host bytes of in-memory shuffle blocks before blocks degrade to "
+    "compressed disk files (the ShuffleBufferCatalog spill integration "
+    "role).", int)
 SHUFFLE_PARTITIONS = conf(
     "spark.sql.shuffle.partitions", 8,
     "Number of shuffle output partitions.", int)
@@ -148,6 +159,17 @@ PARQUET_READER_TYPE = conf(
     "PERFILE, COALESCING, MULTITHREADED or AUTO "
     "(reference RapidsConf.scala:965-981).", str,
     checker=lambda v: v in ("AUTO", "PERFILE", "COALESCING", "MULTITHREADED"))
+CONCURRENT_PYTHON_WORKERS = conf(
+    "spark.rapids.python.concurrentPythonWorkers", 4,
+    "Worker processes for the pandas-UDF Arrow exchange (reference "
+    "PythonWorkerSemaphore.scala).", int)
+MESH_SIZE = conf(
+    "spark.rapids.tpu.mesh", 0,
+    "Execute plans as ONE shard_map'd SPMD program over an N-device "
+    "jax.sharding.Mesh with all_to_all collectives as the shuffle "
+    "transport (the UCX P2P transport role, SURVEY.md 5.8); 0 = "
+    "single-chip thread-pool engine. Plans with no mesh lowering fall "
+    "back to the single-chip engine automatically.", int)
 CPU_ORACLE_ENABLED = conf(
     "spark.rapids.tpu.test.cpuOracle", False,
     "Internal: route this session through the CPU (pyarrow) backend; used "
@@ -228,6 +250,15 @@ class RapidsConf:
     @property
     def shuffle_partitions(self):
         return self.get(SHUFFLE_PARTITIONS)
+
+
+def ansi_enabled() -> bool:
+    """ANSI mode of the active session (expressions evaluate without a
+    conf handle; the session is a process singleton, Plugin.scala-style)."""
+    from spark_rapids_tpu.api.session import TpuSparkSession
+
+    s = TpuSparkSession.active()
+    return bool(s and s.rapids_conf.get(ANSI_ENABLED))
 
 
 def generate_docs() -> str:

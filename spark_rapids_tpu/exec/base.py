@@ -37,6 +37,11 @@ class TaskContext:
         self.conf = conf
 
 
+def new_task_context(conf) -> TaskContext:
+    """Fresh task identity (semaphore accounting is per task id)."""
+    return TaskContext(next(_task_counter), conf)
+
+
 class PhysicalPlan:
     """Base physical node. is_tpu distinguishes device vs CPU operators."""
 

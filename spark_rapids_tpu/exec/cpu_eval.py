@@ -34,6 +34,7 @@ from spark_rapids_tpu.sqltypes import (
     FloatType,
     IntegralType,
     StringType,
+    TimestampType,
 )
 from spark_rapids_tpu.sqltypes.datatypes import to_arrow_type
 
@@ -215,6 +216,18 @@ def _ev(e: Expression, t: pa.Table):
         return pc.cast(pc.second(_ev(e.children[0], t)), pa.int32())
     if isinstance(e, Murmur3Hash):
         return _murmur3_cpu(e, t)
+    from spark_rapids_tpu.udf.pandas_udf import PandasUDF
+
+    if isinstance(e, PandasUDF):
+        from spark_rapids_tpu.config import rapids_conf as _rc
+        from spark_rapids_tpu.udf.pandas_udf import eval_pandas_udf
+
+        from spark_rapids_tpu.api.session import TpuSparkSession
+
+        s = TpuSparkSession.active()
+        workers = (s.rapids_conf.get(_rc.CONCURRENT_PYTHON_WORKERS)
+                   if s else 4)
+        return eval_pandas_udf(e, t, num_workers=workers)
     r = _ev_ext(e, t)
     if r is not None:
         return r
@@ -252,10 +265,130 @@ def _compare(e, t):
     return r
 
 
+class CastError(ValueError):
+    """ANSI-mode cast failure ([CAST_INVALID_INPUT] /
+    [CAST_OVERFLOW] role, Spark SparkArithmeticException)."""
+
+
+_WS = "".join(chr(i) for i in range(0x21))
+
+
+def _host_parse_string(values, to, ansi: bool):
+    """Host-side string cast matching the device grammar
+    (ops/stringcast.py docstring); invalid -> None, or CastError in
+    ANSI mode."""
+    import re
+
+    from spark_rapids_tpu.sqltypes import BooleanType, DateType
+
+    int_re = re.compile(r"^[+-]?\d+$")
+    num_re = re.compile(
+        r"^[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?$")
+    date_re = re.compile(r"^(\d{1,7})(?:-(\d{1,2})(?:-(\d{1,2}))?)?"
+                         r"(?:[T ].*)?$")
+    ts_re = re.compile(
+        r"^(\d{1,7})(?:-(\d{1,2})(?:-(\d{1,2}))?)?"
+        r"(?:[T ](\d{1,2}):(\d{1,2})(?::(\d{1,2})(?:\.(\d{1,6}))?)?)?$")
+
+    def fail(s):
+        if ansi:
+            raise CastError(
+                f"[CAST_INVALID_INPUT] {s!r} cannot be cast to "
+                f"{to.simpleString} (ANSI mode)")
+        return None
+
+    def one(s):
+        if s is None:
+            return None
+        ts = s.strip(_WS)
+        if isinstance(to, BooleanType):
+            low = ts.lower()
+            if low in ("true", "t", "yes", "y", "1"):
+                return True
+            if low in ("false", "f", "no", "n", "0"):
+                return False
+            return fail(s)
+        if isinstance(to, IntegralType):
+            if not int_re.match(ts):
+                return fail(s)
+            v = int(ts)
+            info = np.iinfo(to.np_dtype)
+            if not (info.min <= v <= info.max):
+                return fail(s)
+            return v
+        if isinstance(to, (FloatType, DoubleType)):
+            # strip at most ONE sign (device accepts exactly one)
+            body = ts[1:] if ts[:1] in "+-" else ts
+            low = body.lower()
+            if low in ("infinity", "inf"):
+                return float("-inf") if ts.startswith("-") else \
+                    float("inf")
+            if low == "nan":
+                return float("nan")
+            if not num_re.match(ts):
+                return fail(s)
+            return float(ts)
+        if isinstance(to, DecimalType):
+            if not num_re.match(ts):
+                return fail(s)
+            import decimal
+
+            with decimal.localcontext() as dctx:
+                dctx.rounding = decimal.ROUND_HALF_UP
+                try:
+                    d = decimal.Decimal(ts).quantize(
+                        decimal.Decimal(1).scaleb(-to.scale))
+                except decimal.InvalidOperation:
+                    return fail(s)
+            if abs(int(d.scaleb(to.scale))) >= 10 ** min(
+                    18, to.precision):
+                return fail(s)
+            return d
+        if isinstance(to, DateType):
+            m = date_re.match(ts)
+            if not m:
+                return fail(s)
+            import datetime
+
+            y = int(m.group(1))
+            mo = int(m.group(2) or 1)
+            dd = int(m.group(3) or 1)
+            try:
+                return datetime.date(y, mo, dd)
+            except ValueError:
+                return fail(s)
+        if isinstance(to, TimestampType):
+            m = ts_re.match(ts)
+            if not m:
+                return fail(s)
+            import datetime
+
+            try:
+                frac = (m.group(7) or "").ljust(6, "0")
+                return datetime.datetime(
+                    int(m.group(1)), int(m.group(2) or 1),
+                    int(m.group(3) or 1),
+                    int(m.group(4) or 0), int(m.group(5) or 0),
+                    int(m.group(6) or 0), int(frac or 0))
+            except ValueError:
+                return fail(s)
+        raise TypeError(f"host string cast to {to}")
+
+    return [one(s) for s in values]
+
+
 def _cast(e: Cast, t: pa.Table):
+    from spark_rapids_tpu.config.rapids_conf import ansi_enabled
+
     a = _ev(e.children[0], t)
     frm, to = e.children[0].dtype, e.to
     at = to_arrow_type(to)
+    ansi = ansi_enabled()
+    if isinstance(frm, StringType) and not isinstance(to, StringType):
+        vals = _host_parse_string(
+            a.to_pylist() if hasattr(a, "to_pylist") else list(a), to,
+            ansi)
+        return pa.array(vals, type=at)
     if isinstance(to, StringType):
         from spark_rapids_tpu.sqltypes import BooleanType, DateType
 
@@ -270,29 +403,95 @@ def _cast(e: Cast, t: pa.Table):
             to, IntegralType):
         an = pc.cast(a, pa.float64()).to_numpy(zero_copy_only=False)
         info = np.iinfo(to.np_dtype)
+        mask = np.asarray(pc.is_null(a).to_numpy(zero_copy_only=False),
+                          dtype=bool)
         r = np.trunc(an)
+        if ansi:
+            with np.errstate(invalid="ignore"):
+                bad = (~mask) & (np.isnan(an) |
+                                 (r < float(info.min)) |
+                                 (r > float(info.max)))
+            if bad.any():
+                raise CastError(
+                    f"[CAST_OVERFLOW] {to.simpleString} cast overflow "
+                    "(ANSI mode)")
         with np.errstate(invalid="ignore"):
             r = np.clip(r, float(info.min), float(info.max))
         r = np.where(np.isnan(an), 0.0, r)
-        mask = np.asarray(pc.is_null(a).to_numpy(zero_copy_only=False),
-                          dtype=bool)
         return pa.array(r.astype(to.np_dtype), type=at, mask=mask)
     if isinstance(frm, IntegralType) and isinstance(to, IntegralType):
         an = pc.cast(a, pa.int64()).to_numpy(zero_copy_only=False)
         mask = np.asarray(pc.is_null(a).to_numpy(zero_copy_only=False),
                           dtype=bool)
+        info = np.iinfo(to.np_dtype)
+        if ansi:
+            bad = (~mask) & ((an < info.min) | (an > info.max))
+            if bad.any():
+                raise CastError(
+                    f"[CAST_OVERFLOW] {to.simpleString} cast overflow "
+                    "(ANSI mode)")
         return pa.array(an.astype(to.np_dtype), type=at, mask=mask)  # wraps
     return pc.cast(a, at, safe=False)
 
 
+def _native_hash_columns(sub: pa.Table):
+    """Arrow columns -> the native hashing column spec
+    ((values, validity) or (byte_matrix, lengths, validity)); None if a
+    column type has no native path."""
+    cols = []
+    for col in sub.columns:
+        arr = col.combine_chunks()
+        valid = (None if arr.null_count == 0 else
+                 np.asarray(arr.is_valid()).astype(np.uint8))
+        typ = arr.type
+        if pa.types.is_string(typ) or pa.types.is_binary(typ):
+            barr = arr.cast(pa.binary()) if pa.types.is_string(typ) else arr
+            lens = np.asarray(pc.binary_length(
+                barr.fill_null(b""))).astype(np.int32)
+            offs = np.concatenate([[0], np.cumsum(lens.astype(np.int64))])
+            flat = np.frombuffer(
+                b"".join(barr.fill_null(b"").to_pylist()), dtype=np.uint8)
+            mb = max(1, int(lens.max()) if len(lens) else 1)
+            idx = offs[:-1, None] + np.arange(mb)[None, :]
+            inb = np.arange(mb)[None, :] < lens[:, None]
+            mat = np.where(
+                inb, np.pad(flat, (0, mb))[np.clip(idx, 0, None)], 0
+            ).astype(np.uint8)
+            cols.append((mat, lens, valid))
+        elif (pa.types.is_integer(typ) or pa.types.is_floating(typ) or
+              pa.types.is_boolean(typ) or pa.types.is_date(typ) or
+              pa.types.is_timestamp(typ)):
+            if pa.types.is_boolean(typ):
+                vals = np.asarray(arr.fill_null(False)).astype(np.int32)
+            elif pa.types.is_date(typ):
+                vals = np.asarray(arr.fill_null(0).cast(pa.int32()))
+            elif pa.types.is_timestamp(typ):
+                vals = np.asarray(
+                    pc.cast(arr.fill_null(0), pa.int64(), safe=False))
+            else:
+                vals = np.asarray(arr.fill_null(0))
+            cols.append((vals, valid))
+        else:
+            return None
+    return cols
+
+
 def _murmur3_cpu(e: Murmur3Hash, t: pa.Table):
-    """Reference murmur3 on host via the same jnp kernels on numpy —
-    reuse device code through the CPU jax backend for exactness."""
+    """Spark-exact murmur3 on host: native C++ kernel when available
+    (native/sparktpu_runtime.cpp, the shuffle-partitioning hot path),
+    else the same jnp kernels the device uses via the CPU jax backend."""
+    sub = pa.table({f"c{i}": eval_expr(c, t)
+                    for i, c in enumerate(e.children)})
+    from spark_rapids_tpu import native
+
+    if native.get_lib() is not None and t.num_rows:
+        cols = _native_hash_columns(sub)
+        if cols is not None:
+            return pa.array(native.murmur3_host(cols, seed=e.seed),
+                            type=pa.int32())
     from spark_rapids_tpu.columnar.arrow_bridge import arrow_to_device
     from spark_rapids_tpu.expr.core import EvalContext
 
-    sub = pa.table({f"c{i}": eval_expr(c, t)
-                    for i, c in enumerate(e.children)})
     b = arrow_to_device(sub)
     from spark_rapids_tpu.expr import BoundReference as BR
     from spark_rapids_tpu.expr.hashexpr import Murmur3Hash as MH

@@ -135,13 +135,17 @@ class _DeviceJoinBase(PhysicalPlan):
 
     def _finish_from_pairs(self, left: ColumnBatch, build: ColumnBatch,
                            pi, bi, ok, total_cap: int,
-                           pair_batch: Optional[ColumnBatch] = None
+                           pair_batch: Optional[ColumnBatch] = None,
+                           jt_override: Optional[str] = None
                            ) -> ColumnBatch:
         """Derive any join type from candidate pairs (pi, bi) and the
         surviving-pair mask ok (condition AND key-equality AND live).
         `pair_batch` reuses an already-gathered pair table (from
-        condition evaluation) to avoid a second full gather."""
-        jt = self.join_type
+        condition evaluation) to avoid a second full gather.
+        `jt_override` lets chunked drivers run a full-outer join as
+        per-chunk left-outer while they accumulate build-match state
+        themselves (GpuBroadcastNestedLoopJoinExecBase splitting)."""
+        jt = jt_override or self.join_type
         lsch = self.children[0].schema
         rsch = self.children[1].schema
         matched_l = (jnp.zeros((left.capacity,), jnp.int32)
@@ -440,7 +444,42 @@ class TpuBroadcastNestedLoopJoinExec(_BroadcastBuildMixin, _DeviceJoinBase):
                          schema, conf)
         self._init_broadcast()
 
+    def _nlj_chunk(self, left: ColumnBatch, right: ColumnBatch
+                   ) -> Optional[ColumnBatch]:
+        """Join one probe chunk against the whole build side. For full
+        outer, runs as left-outer and accumulates the build-match mask
+        into self._nlj_matched_build; the driver pads unmatched build
+        rows once after all chunks."""
+        jt = self.join_type
+        n_l = left.row_count()
+        n_r = right.row_count()
+        cap = next_capacity(max(n_l * n_r, 1))
+        counts = jnp.where(left.live_mask(),
+                           jnp.int32(n_r), jnp.int32(0))
+        lo = jnp.zeros((left.capacity,), jnp.int32)
+        pi, bi, _ = joinops.expand_gather_maps(lo, counts, cap)
+        total = n_l * n_r
+        ok = jnp.arange(cap, dtype=jnp.int64) < total
+        pair_batch = None
+        if self.condition is not None:
+            pair_batch = self._gather_pairs(left, right, pi, bi, total)
+            pred = self.condition.eval(EvalContext(pair_batch))
+            ok = ok & pred.data & pred.validity
+        jt_override = None
+        if jt == "full":
+            matched_b = (jnp.zeros((right.capacity,), jnp.int32)
+                         .at[jnp.clip(bi, 0, right.capacity - 1)]
+                         .max(jnp.where(ok, 1, 0)) > 0)
+            self._nlj_matched_build = self._nlj_matched_build | matched_b
+            jt_override = "left"
+        return self._finish_from_pairs(left, right, pi, bi, ok, cap,
+                                       pair_batch=pair_batch,
+                                       jt_override=jt_override)
+
     def execute_partition(self, pid, ctx):
+        from spark_rapids_tpu.runtime.memory import get_catalog
+        from spark_rapids_tpu.runtime.retry import retry_on_oom, with_retry
+
         with self.metrics[M.JOIN_TIME].ns():
             build = self._broadcast_build(ctx)
             left_batches = list(
@@ -463,24 +502,35 @@ class TpuBroadcastNestedLoopJoinExec(_BroadcastBuildMixin, _DeviceJoinBase):
                     yield self._right_nulls_batch(left, rsch)
                 return
             right = build[0]
-            n_l = left.row_count()
-            n_r = right.row_count()
-            cap = next_capacity(max(n_l * n_r, 1))
-            counts = jnp.where(left.live_mask(),
-                               jnp.int32(n_r), jnp.int32(0))
-            lo = jnp.zeros((left.capacity,), jnp.int32)
-            pi, bi, _ = joinops.expand_gather_maps(lo, counts, cap)
-            total = n_l * n_r
-            ok = jnp.arange(cap, dtype=jnp.int64) < total
-            pair_batch = None
-            if self.condition is not None:
-                pair_batch = self._gather_pairs(left, right, pi, bi, total)
-                pred = self.condition.eval(EvalContext(pair_batch))
-                ok = ok & pred.data & pred.validity
-            out = self._finish_from_pairs(left, right, pi, bi, ok, cap,
-                                          pair_batch=pair_batch)
-            if out is not None:
-                yield out
+            # Ledger honesty: the real allocation of a nested-loop join
+            # is the expanded pair set (n_l * n_r rows), invisible to the
+            # output-only reservation the other operators use. Reserve it
+            # up front and split the probe side in half on
+            # TpuSplitAndRetryOOM (GpuBroadcastNestedLoopJoinExecBase
+            # split machinery).
+            catalog = get_catalog()
+            row_bytes = (
+                left.device_size_bytes() // max(1, left.capacity) +
+                right.device_size_bytes() // max(1, right.capacity))
+            self._nlj_matched_build = jnp.zeros((right.capacity,), bool)
+            sb = retry_on_oom(lambda: catalog.add_batch(left))
+
+            def step(s):
+                chunk = s.get_batch()
+                pair_cap = next_capacity(
+                    max(chunk.row_count() * right.row_count(), 1))
+                with catalog.reserved(pair_cap * row_bytes, "nlj_pairs"):
+                    return self._nlj_chunk(chunk, right)
+
+            for out in with_retry(sb, step):
+                if out is not None:
+                    yield out
+            if jt == "full":
+                unmatched = filterops.compact(
+                    right,
+                    ~self._nlj_matched_build & right.live_mask())
+                if unmatched.row_count() > 0:
+                    yield self._left_nulls_batch(lsch, unmatched)
 
 
 class CpuJoinExec(PhysicalPlan):

@@ -114,22 +114,39 @@ class RangeExec(PhysicalPlan):
 
 class TpuFileScanExec(PhysicalPlan):
     """Multi-file columnar scan; strategy per conf (PERFILE/COALESCING/
-    MULTITHREADED/AUTO)."""
+    MULTITHREADED/AUTO — GpuParquetScan.scala:1072,2051):
+    - PERFILE: one read task per file,
+    - COALESCING (and AUTO, for local files): pack small files into one
+      task up to the coalesce target,
+    - MULTITHREADED: same task split, but decode runs on the shared
+      reader pool overlapping the consumer's device compute.
+    Pushed row-group filters (predicate pushdown) come from the logical
+    optimizer via FileScan.pushed_filters."""
 
     def __init__(self, fmt: str, paths: List[str], schema, conf,
-                 pushed_columns: Optional[List[str]] = None):
+                 pushed_columns: Optional[List[str]] = None,
+                 pushed_filters=None):
         super().__init__([], schema, conf)
         self.fmt = fmt
         self.paths = paths
         self.pushed_columns = pushed_columns
+        self.pushed_filters = pushed_filters or None
         from spark_rapids_tpu.config import rapids_conf as rc
 
         self._batch_rows = conf.get(rc.MAX_READER_BATCH_SIZE_ROWS)
         self._nthreads = conf.get(rc.MULTITHREADED_READ_NUM_THREADS)
         self._strategy = conf.get(rc.PARQUET_READER_TYPE)
+        coalesce_bytes = 128 << 20
         if fmt == "parquet":
-            coalesce_bytes = 128 << 20
-            self._tasks = readers.split_parquet_tasks(paths, coalesce_bytes)
+            if self._strategy == "PERFILE":
+                self._tasks = [[f] for f in readers.expand_paths(
+                    paths, ".parquet")] or [[]]
+            else:
+                self._tasks = readers.split_parquet_tasks(
+                    paths, coalesce_bytes)
+        elif fmt in ("orc", "avro"):
+            self._tasks = readers.split_file_tasks(paths, "." + fmt,
+                                                   coalesce_bytes)
         else:
             self._tasks = [[p] for p in readers.expand_paths(
                 paths, "." + fmt)]
@@ -138,21 +155,34 @@ class TpuFileScanExec(PhysicalPlan):
     def num_partitions(self):
         return max(1, len(self._tasks))
 
+    def _host_tables(self, files) -> Iterator[pa.Table]:
+        cols = self.pushed_columns
+        if self.fmt == "parquet":
+            if self._strategy == "MULTITHREADED":
+                return readers.read_parquet_multithreaded(
+                    files, cols, self._batch_rows, self._nthreads,
+                    filters=self.pushed_filters)
+            if self.pushed_filters:
+                return readers.read_parquet_task_filtered(
+                    files, cols, self._batch_rows, self.pushed_filters)
+            return readers.read_parquet_task(files, cols, self._batch_rows)
+        if self.fmt == "csv":
+            return iter([readers.read_csv(f) for f in files])
+        if self.fmt == "json":
+            return iter([readers.read_json(f) for f in files])
+        if self.fmt == "orc":
+            return iter([readers.read_orc(f, columns=cols) for f in files])
+        if self.fmt == "avro":
+            from spark_rapids_tpu.io.avro import read_avro
+
+            return iter([read_avro(f).select(cols) if cols
+                         else read_avro(f) for f in files])
+        raise ValueError(f"format {self.fmt}")
+
     def execute_partition(self, pid, ctx):
         if pid >= len(self._tasks) or not self._tasks[pid]:
             return
-        files = self._tasks[pid]
-        cols = self.pushed_columns
-        if self.fmt == "parquet":
-            host_iter = readers.read_parquet_task(files, cols,
-                                                  self._batch_rows)
-        elif self.fmt == "csv":
-            host_iter = iter([readers.read_csv(f) for f in files])
-        elif self.fmt == "json":
-            host_iter = iter([readers.read_json(f) for f in files])
-        else:
-            raise ValueError(f"format {self.fmt}")
-        for table in host_iter:
+        for table in self._host_tables(self._tasks[pid]):
             _acquire(ctx)  # device admission right before H2D
             self.metrics[M.NUM_INPUT_ROWS].add(table.num_rows)
             yield arrow_to_device(table)
@@ -164,16 +194,7 @@ class CpuFileScanExec(TpuFileScanExec):
     def execute_partition(self, pid, ctx):
         if pid >= len(self._tasks) or not self._tasks[pid]:
             return
-        files = self._tasks[pid]
-        if self.fmt == "parquet":
-            yield from readers.read_parquet_task(
-                files, self.pushed_columns, self._batch_rows)
-        elif self.fmt == "csv":
-            for f in files:
-                yield readers.read_csv(f)
-        elif self.fmt == "json":
-            for f in files:
-                yield readers.read_json(f)
+        yield from self._host_tables(self._tasks[pid])
 
 
 # ------------------------------------------------------------ transitions
@@ -207,9 +228,14 @@ class DeviceToArrowExec(PhysicalPlan):
 
 class TpuProjectExec(PhysicalPlan):
     def __init__(self, exprs: List[Alias], child, schema, conf):
+        from spark_rapids_tpu.runtime.jit_cache import aliases_key, cached_jit
+
         super().__init__([child], schema, conf)
         self.exprs = exprs
-        self._jitted = jax.jit(self._run)
+        from spark_rapids_tpu.runtime.jit_cache import detached
+
+        self._jitted = cached_jit(("project", aliases_key(exprs)),
+                                  lambda: detached(self)._run)
 
     def _run(self, batch: ColumnBatch) -> ColumnBatch:
         ctx = EvalContext(batch)
@@ -242,9 +268,14 @@ class CpuProjectExec(PhysicalPlan):
 
 class TpuFilterExec(PhysicalPlan):
     def __init__(self, condition, child, conf):
+        from spark_rapids_tpu.runtime.jit_cache import cached_jit
+
         super().__init__([child], child.schema, conf)
         self.condition = condition
-        self._jitted = jax.jit(self._run)
+        from spark_rapids_tpu.runtime.jit_cache import detached
+
+        self._jitted = cached_jit(("filter", condition.key()),
+                                  lambda: detached(self)._run)
 
     def _run(self, batch: ColumnBatch) -> ColumnBatch:
         ctx = EvalContext(batch)
@@ -305,9 +336,18 @@ class TpuHashAggregateExec(PhysicalPlan):
                           [StructField(a.name, a.dtype, True)
                            for a in aggs]))
         super().__init__([child], out_schema, conf)
-        self._jit_partial = jax.jit(self._partial)
-        self._jit_merge = jax.jit(self._merge_final)
-        self._jit_merge_buffers = jax.jit(self._merge_buffers)
+        from spark_rapids_tpu.runtime.jit_cache import aliases_key, cached_jit
+
+        from spark_rapids_tpu.runtime.jit_cache import detached
+
+        base_key = ("agg", mode, aliases_key(grouping), aliases_key(aggs))
+        det = detached(self)
+        self._jit_partial = cached_jit(base_key + ("partial",),
+                                       lambda: det._partial)
+        self._jit_merge = cached_jit(base_key + ("merge_final",),
+                                     lambda: det._merge_final)
+        self._jit_merge_buffers = cached_jit(base_key + ("merge_buffers",),
+                                             lambda: det._merge_buffers)
 
     # --- phases (each a single XLA program) ---
 
@@ -330,6 +370,11 @@ class TpuHashAggregateExec(PhysicalPlan):
             fields.append(StructField(f"in{i}", c.dtype, True))
         work = ColumnBatch(StructType(fields), work_cols + concrete,
                            batch.num_rows)
+        if not work.columns:
+            # global COUNT(*): no key or input columns — group the source
+            # batch so capacity/live-mask come from the real data (a
+            # zero-column batch reports the minimum capacity bucket)
+            work = ColumnBatch(batch.schema, batch.columns, batch.num_rows)
         g = self._grouped(work, list(range(nkeys)))
         cap = work.capacity
         out_cols: List[DeviceColumn] = []
@@ -597,7 +642,15 @@ class TpuShuffleExchangeExec(PhysicalPlan):
         import threading
 
         self._lock = threading.Lock()
-        self._jit_partition = jax.jit(self._partition_batch)
+        from spark_rapids_tpu.runtime.jit_cache import cached_jit
+
+        kkey = (tuple(k.key() for k in key_exprs)
+                if key_exprs else None)
+        from spark_rapids_tpu.runtime.jit_cache import detached
+
+        self._jit_partition = cached_jit(
+            ("exchange_partition", kkey, self._nparts),
+            lambda: detached(self)._partition_batch)
 
     @property
     def num_partitions(self):
@@ -621,6 +674,31 @@ class TpuShuffleExchangeExec(PhysicalPlan):
         pb = partition.round_robin_partition(batch, self._nparts)
         return pb.batch, pb.counts
 
+    def _map_one(self, mgr, cpid: int):
+        """One map task: execute a child partition, device-partition its
+        batches, store contiguous slices (per-map-task parallel, the
+        reference's writer slots —
+        RapidsShuffleInternalManagerBase.scala:238)."""
+        from spark_rapids_tpu.exec.base import new_task_context
+
+        tctx = new_task_context(self.conf)
+        try:
+            for batch in self.children[0].execute_partition(cpid, tctx):
+                if self._nparts == 1:
+                    mgr.put(self._shuffle_id, 0, device_to_arrow(batch))
+                    continue
+                sorted_batch, counts = self._jit_partition(batch)
+                host = device_to_arrow(sorted_batch)
+                offs = np.concatenate(
+                    [[0], np.cumsum(np.asarray(counts))])
+                for rp in range(self._nparts):
+                    lo, hi = int(offs[rp]), int(offs[rp + 1])
+                    if hi > lo:
+                        mgr.put(self._shuffle_id, rp,
+                                host.slice(lo, hi - lo))
+        finally:
+            sem.get().release_if_necessary(tctx.task_id)
+
     def _run_map_stage(self, ctx):
         with self._lock:
             if self._map_done:
@@ -628,20 +706,16 @@ class TpuShuffleExchangeExec(PhysicalPlan):
             mgr = get_shuffle_manager()
             self._shuffle_id = mgr.new_shuffle_id()
             nchild = self.children[0].num_partitions
-            for cpid in range(nchild):
-                for batch in self.children[0].execute_partition(cpid, ctx):
-                    if self._nparts == 1:
-                        mgr.put(self._shuffle_id, 0, device_to_arrow(batch))
-                        continue
-                    sorted_batch, counts = self._jit_partition(batch)
-                    host = device_to_arrow(sorted_batch)
-                    offs = np.concatenate(
-                        [[0], np.cumsum(np.asarray(counts))])
-                    for rp in range(self._nparts):
-                        lo, hi = int(offs[rp]), int(offs[rp + 1])
-                        if hi > lo:
-                            mgr.put(self._shuffle_id, rp,
-                                    host.slice(lo, hi - lo))
+            if nchild == 1:
+                self._map_one(mgr, 0)
+            else:
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(
+                        max_workers=min(8, nchild),
+                        thread_name_prefix="shuffle-map") as pool:
+                    list(pool.map(lambda c: self._map_one(mgr, c),
+                                  range(nchild)))
             self._map_done = True
 
     def execute_partition(self, pid, ctx):
@@ -665,6 +739,80 @@ class TpuShuffleExchangeExec(PhysicalPlan):
                 break
 
 
+class TpuRangeShuffleExchangeExec(TpuShuffleExchangeExec):
+    """Sample-based range exchange (GpuRangePartitioner.scala +
+    GpuShuffleExchangeExecBase): the map stage parks every child batch
+    spillable, samples the sort keys to derive num_partitions-1 bounds,
+    then range-partitions each batch by vectorized lexicographic binary
+    search against the bounds. Partition p holds the p-th global key
+    range, so per-partition sorts concatenate into a total order —
+    global sort no longer funnels through one partition."""
+
+    def __init__(self, child, orders: List[SortOrder], num_partitions,
+                 conf, samples_per_batch: int = 64):
+        super().__init__(child, None, num_partitions, conf)
+        self.orders = orders
+        self._samples = samples_per_batch
+
+    def _run_map_stage(self, ctx):
+        from spark_rapids_tpu.ops import sortops
+        from spark_rapids_tpu.ops.common import sort_permutation
+        from spark_rapids_tpu.ops.joinops import _binary_search
+        from spark_rapids_tpu.runtime.memory import get_catalog
+        from spark_rapids_tpu.runtime.retry import retry_on_oom
+
+        with self._lock:
+            if self._map_done:
+                return
+            mgr = get_shuffle_manager()
+            self._shuffle_id = mgr.new_shuffle_id()
+            catalog = get_catalog()
+            parked = []
+            nchild = self.children[0].num_partitions
+            for cpid in range(nchild):
+                for b in self.children[0].execute_partition(cpid, ctx):
+                    parked.append(retry_on_oom(
+                        lambda bb=b: catalog.add_batch(bb)))
+            if not parked:
+                self._map_done = True
+                return
+            npt = self._nparts
+            samples = None
+            for sb in parked:
+                b = sb.get_batch()
+                keys = sortops.order_keys(b, self.orders)
+                s_n = min(self._samples, b.capacity)
+                pos = (jnp.arange(s_n, dtype=jnp.int32) * b.capacity) // s_n
+                samp = [jnp.take(k, pos) for k in keys]
+                samples = (samp if samples is None else
+                           [jnp.concatenate([a, c])
+                            for a, c in zip(samples, samp)])
+            total_s = int(samples[0].shape[0])
+            perm = sort_permutation(samples, total_s)
+            skeys = [jnp.take(g, perm) for g in samples]
+            # garbage/dead sample rows carry leading null-rank 2
+            live_ct = jnp.sum(skeys[0] < 2).astype(jnp.int32)
+            j = jnp.clip((jnp.arange(npt - 1, dtype=jnp.int32) + 1) *
+                         live_ct // npt, 0, total_s - 1)
+            bounds = [jnp.take(k, j) for k in skeys]
+            for sb in parked:
+                b = sb.get_batch()
+                keys = sortops.order_keys(b, self.orders)
+                dest = _binary_search(bounds, keys, jnp.int32(npt - 1),
+                                      max(npt - 1, 1), upper=True)
+                pb = partition.partition_by_ids(b, dest, npt)
+                host = device_to_arrow(pb.batch)
+                offs = np.concatenate([[0],
+                                       np.cumsum(np.asarray(pb.counts))])
+                for rp in range(npt):
+                    lo, hi = int(offs[rp]), int(offs[rp + 1])
+                    if hi > lo:
+                        mgr.put(self._shuffle_id, rp,
+                                host.slice(lo, hi - lo))
+                sb.close()
+            self._map_done = True
+
+
 class CpuShuffleExchangeExec(PhysicalPlan):
     is_tpu = False
 
@@ -682,6 +830,34 @@ class CpuShuffleExchangeExec(PhysicalPlan):
     def num_partitions(self):
         return self._nparts
 
+    def _map_one(self, mgr, cpid: int, ctx):
+        for table in self.children[0].execute_partition(cpid, ctx):
+            if self._nparts == 1:
+                mgr.put(self._shuffle_id, 0, table)
+                continue
+            if self.key_exprs is None:
+                # round-robin (repartition(n) without keys)
+                pid_arr = np.arange(table.num_rows) % self._nparts
+                for rp in range(self._nparts):
+                    piece = table.filter(pa.array(pid_arr == rp))
+                    if piece.num_rows:
+                        mgr.put(self._shuffle_id, rp, piece)
+                continue
+            # CPU murmur3 partition matching device partitioning
+            # (native murmur3_host kernel via cpu_eval when available)
+            from spark_rapids_tpu.expr import Murmur3Hash
+
+            h = cpu_eval.eval_expr(
+                Murmur3Hash(*self.key_exprs), table)
+            pid_arr = np.mod(np.asarray(h), self._nparts)
+            pid_arr = np.where(pid_arr < 0, pid_arr + self._nparts,
+                               pid_arr)
+            for rp in range(self._nparts):
+                mask = pa.array(pid_arr == rp)
+                piece = table.filter(mask)
+                if piece.num_rows:
+                    mgr.put(self._shuffle_id, rp, piece)
+
     def _run_map_stage(self, ctx):
         with self._lock:
             if self._map_done:
@@ -689,32 +865,17 @@ class CpuShuffleExchangeExec(PhysicalPlan):
             mgr = get_shuffle_manager()
             self._shuffle_id = mgr.new_shuffle_id()
             nchild = self.children[0].num_partitions
-            for cpid in range(nchild):
-                for table in self.children[0].execute_partition(cpid, ctx):
-                    if self._nparts == 1:
-                        mgr.put(self._shuffle_id, 0, table)
-                        continue
-                    if self.key_exprs is None:
-                        # round-robin (repartition(n) without keys)
-                        pid_arr = np.arange(table.num_rows) % self._nparts
-                        for rp in range(self._nparts):
-                            piece = table.filter(pa.array(pid_arr == rp))
-                            if piece.num_rows:
-                                mgr.put(self._shuffle_id, rp, piece)
-                        continue
-                    # CPU murmur3 partition matching device partitioning
-                    from spark_rapids_tpu.expr import Murmur3Hash
+            if nchild == 1:
+                self._map_one(mgr, 0, ctx)
+            else:
+                from concurrent.futures import ThreadPoolExecutor
 
-                    h = cpu_eval.eval_expr(
-                        Murmur3Hash(*self.key_exprs), table)
-                    pid_arr = np.mod(np.asarray(h), self._nparts)
-                    pid_arr = np.where(pid_arr < 0, pid_arr + self._nparts,
-                                       pid_arr)
-                    for rp in range(self._nparts):
-                        mask = pa.array(pid_arr == rp)
-                        piece = table.filter(mask)
-                        if piece.num_rows:
-                            mgr.put(self._shuffle_id, rp, piece)
+                with ThreadPoolExecutor(
+                        max_workers=min(8, nchild),
+                        thread_name_prefix="shuffle-map") as pool:
+                    list(pool.map(
+                        lambda c: self._map_one(mgr, c, ctx),
+                        range(nchild)))
             self._map_done = True
 
     def execute_partition(self, pid, ctx):
@@ -744,15 +905,23 @@ class TpuSortExec(PhysicalPlan):
     parked runs spill under pressure and per-run work retries/splits on
     OOM."""
 
-    def __init__(self, orders: List[SortOrder], child, conf):
+    def __init__(self, orders: List[SortOrder], child, conf,
+                 chunk_rows: Optional[int] = None):
         super().__init__([child], child.schema, conf)
         self.orders = orders
-        self._jitted = jax.jit(self._run)
+        self.chunk_rows = chunk_rows
         from spark_rapids_tpu.ops import sortops
+        from spark_rapids_tpu.runtime.jit_cache import cached_jit, orders_key
 
-        self._jit_merge = jax.jit(
-            lambda a, b, cap: sortops.merge_sorted(a, b, self.orders,
-                                                   out_cap=cap),
+        from spark_rapids_tpu.runtime.jit_cache import detached
+
+        okey = orders_key(orders)
+        det = detached(self)
+        self._jitted = cached_jit(("sort", okey), lambda: det._run)
+        self._jit_merge = cached_jit(
+            ("sort_merge", okey),
+            lambda: (lambda a, b, cap: sortops.merge_sorted(
+                a, b, det.orders, out_cap=cap)),
             static_argnums=2)
 
     def _run(self, batch: ColumnBatch) -> ColumnBatch:
@@ -803,9 +972,31 @@ class TpuSortExec(PhysicalPlan):
                 if len(runs) % 2:
                     nxt.append(runs[-1])
                 runs = nxt
-            out = runs[0].get_batch()
-            runs[0].close()
-            yield out
+            if self.chunk_rows is None:
+                out = runs[0].get_batch()
+                runs[0].close()
+                yield out
+                return
+            # chunked emission: slice the merged run into bounded
+            # batches so downstream operators (batched window) never
+            # hold the whole partition's intermediates
+            final = runs[0]
+            total = final.row_count()
+            for lo in range(0, max(total, 1), self.chunk_rows):
+                count = min(self.chunk_rows, total - lo)
+                if count <= 0:
+                    break
+
+                def slice_step(sb=final, lo=lo, count=count):
+                    b = sb.get_batch()
+                    cap = next_capacity(count)
+                    idx = jnp.clip(
+                        jnp.arange(cap, dtype=jnp.int32) + lo, 0,
+                        b.capacity - 1)
+                    return b.gather(idx, count)
+
+                yield retry_on_oom(slice_step)
+            final.close()
 
 
 class CpuSortExec(PhysicalPlan):
@@ -891,15 +1082,48 @@ class UnionExec(PhysicalPlan):
 
 # ----------------------------------------------------------------- window
 
+def window_halo(window_exprs: List[Alias]) -> Optional[int]:
+    """Rows of context a chunked window evaluation needs on each side, or
+    None when the spec is not chunkable (ranking / running / unbounded /
+    RANGE frames need whole-partition or carried state). Chunkable: ROWS
+    frames with finite bounds, and lead/lag (bounded by |offset|) — the
+    GpuBatchedBoundedWindowExec case."""
+    from spark_rapids_tpu.expr import windows as we
+
+    halo = 0
+    for a in window_exprs:
+        wexpr = a.children[0]
+        fn = wexpr.function
+        frame = wexpr.spec.frame
+        if isinstance(fn, we.Lead):  # Lag subclasses Lead
+            halo = max(halo, abs(fn.offset))
+            continue
+        if isinstance(fn, we.WindowFunction):
+            return None  # ranking family: needs partition-prefix state
+        if (frame is None or frame.frame_type != "rows" or
+                frame.lower is None or frame.upper is None):
+            return None
+        halo = max(halo, abs(frame.lower), abs(frame.upper))
+    return halo
+
+
 class TpuWindowExec(PhysicalPlan):
     """Window operator (GpuWindowExec analog, window/GpuWindowExecMeta
     .scala:673): one sorted pass per (partitionBy, orderBy) spec
     evaluates every frame/function in a single XLA program — prefix sums
     for sum/count frames, a doubling sparse table for min/max frames,
     binary search for RANGE value bounds (ops/windowops.py). Input rows
-    are preserved; window columns are appended."""
+    are preserved; window columns are appended.
 
-    def __init__(self, window_exprs: List[Alias], child, conf):
+    With presorted=True + halo=H (planner pairs this exec with a chunked
+    TpuSortExec on the partition+order keys), execution is BATCHED: each
+    sorted chunk is evaluated with H rows of carried prefix and H rows of
+    peeked suffix, so device intermediates are bounded by the chunk size
+    instead of the whole partition (GpuBatchedBoundedWindowExec.scala
+    role)."""
+
+    def __init__(self, window_exprs: List[Alias], child, conf,
+                 presorted: bool = False, halo: Optional[int] = None):
         from spark_rapids_tpu.expr import windows as we
 
         base = child.schema
@@ -907,8 +1131,15 @@ class TpuWindowExec(PhysicalPlan):
         super().__init__([child], StructType(list(base.fields) + extra),
                          conf)
         self.window_exprs = window_exprs
+        self.presorted = presorted
+        self.halo = halo
         self.spec0: we.WindowSpecDef = window_exprs[0].children[0].spec
-        self._jitted = jax.jit(self._run)
+        from spark_rapids_tpu.runtime.jit_cache import aliases_key, cached_jit
+
+        self._jitted = cached_jit(
+            ("window", aliases_key(window_exprs)),
+            lambda: __import__("spark_rapids_tpu.runtime.jit_cache",
+                               fromlist=["detached"]).detached(self)._run)
 
     def _run(self, batch: ColumnBatch) -> ColumnBatch:
         from spark_rapids_tpu.expr import windows as we
@@ -1032,12 +1263,80 @@ class TpuWindowExec(PhysicalPlan):
     def execute_partition(self, pid, ctx):
         with self.metrics[M.WINDOW_TIME].ns():
             _acquire(ctx)
-            batches = list(self.children[0].execute_partition(pid, ctx))
-            if not batches:
+            if self.presorted and self.halo is not None:
+                yield from self._execute_batched(pid, ctx)
                 return
-            merged = concat_batches(batches) if len(batches) > 1 \
-                else batches[0]
-            yield self._jitted(merged)
+            from spark_rapids_tpu.runtime.memory import get_catalog
+            from spark_rapids_tpu.runtime.retry import retry_on_oom
+
+            catalog = get_catalog()
+            pending = []
+            for batch in self.children[0].execute_partition(pid, ctx):
+                pending.append(retry_on_oom(
+                    lambda b=batch: catalog.add_batch(b)))
+            if not pending:
+                return
+
+            def step():
+                batches = [sb.get_batch() for sb in pending]
+                merged = concat_batches(batches) if len(batches) > 1 \
+                    else batches[0]
+                with catalog.reserved(2 * merged.device_size_bytes(),
+                                      "window_concat"):
+                    return self._jitted(merged)
+
+            out = retry_on_oom(step)
+            for sb in pending:
+                sb.close()
+            yield out
+
+    # --- bounded-frame batched path ---
+
+    @staticmethod
+    def _slice_rows(batch: ColumnBatch, start: int, count: int
+                    ) -> ColumnBatch:
+        cap = next_capacity(count)
+        idx = jnp.clip(jnp.arange(cap, dtype=jnp.int32) + start, 0,
+                       batch.capacity - 1)
+        return batch.gather(idx, count)
+
+    def _window_chunk(self, prefix: Optional[ColumnBatch],
+                      chunk: ColumnBatch,
+                      suffix: Optional[ColumnBatch]) -> ColumnBatch:
+        """Evaluate one sorted chunk with halo context and slice out the
+        chunk's own rows. Input order == sorted order (the child is a
+        chunked TpuSortExec), so row positions survive the exec's stable
+        internal sort."""
+        parts = [p for p in (prefix, chunk, suffix) if p is not None]
+        merged = concat_batches(parts) if len(parts) > 1 else parts[0]
+        out = self._jitted(merged)
+        start = prefix.row_count() if prefix is not None else 0
+        return self._slice_rows(out, start, chunk.row_count())
+
+    def _execute_batched(self, pid, ctx):
+        from spark_rapids_tpu.runtime.memory import get_catalog
+        from spark_rapids_tpu.runtime.retry import retry_on_oom
+
+        catalog = get_catalog()
+        h = max(self.halo, 1)
+        prefix: Optional[ColumnBatch] = None  # last h rows seen
+        pending: Optional[ColumnBatch] = None  # chunk awaiting suffix
+        for batch in self.children[0].execute_partition(pid, ctx):
+            if pending is not None:
+                suffix = self._slice_rows(
+                    batch, 0, min(h, batch.row_count()))
+                yield retry_on_oom(
+                    lambda p=prefix, c=pending, s=suffix:
+                    self._window_chunk(p, c, s))
+                joined = (concat_batches([prefix, pending])
+                          if prefix is not None else pending)
+                tail_n = min(h, joined.row_count())
+                prefix = self._slice_rows(
+                    joined, joined.row_count() - tail_n, tail_n)
+            pending = batch
+        if pending is not None:
+            yield retry_on_oom(
+                lambda p=prefix, c=pending: self._window_chunk(p, c, None))
 
 
 class CpuWindowExec(PhysicalPlan):

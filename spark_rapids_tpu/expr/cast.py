@@ -51,18 +51,37 @@ class Cast(Expression):
 
     def device_supported(self) -> bool:
         frm = self.children[0].dtype
-        if isinstance(frm, StringType) and not isinstance(self.to, StringType):
-            return False
         if isinstance(self.to, StringType) and isinstance(
                 frm, (TimestampType,)):
             return False  # timestamp formatting: host fallback in v1
         return True
+
+    def can_fail(self) -> bool:
+        """True when this cast can produce an error in ANSI mode
+        (invalid parse, overflow). The planner keeps ANSI-mode failable
+        casts on the CPU path, where errors raise eagerly
+        (spark.sql.ansi.enabled handling; GpuCast ansi kernels are the
+        device-side follow-up)."""
+        frm = self.children[0].dtype
+        to = self.to
+        if isinstance(frm, StringType) and not isinstance(to, StringType):
+            return True
+        if isinstance(frm, (FloatType, DoubleType)) and isinstance(
+                to, IntegralType):
+            return True
+        if isinstance(frm, IntegralType) and isinstance(to, IntegralType):
+            return _int_width(to) < _int_width(frm)
+        if isinstance(to, DecimalType):
+            return True
+        return False
 
     def eval(self, ctx):
         c = self.children[0].eval(ctx)
         frm, to = c.dtype, self.to
         if frm == to:
             return c
+        if isinstance(frm, StringType):
+            return _cast_from_string(c, to)
         if isinstance(to, StringType):
             return _cast_to_string(c)
         if isinstance(frm, BooleanType):
@@ -90,6 +109,32 @@ class Cast(Expression):
             return DeviceColumn(to, t.astype(to.np_dtype), c.validity)
         # numeric widening/narrowing (wraps like Java) and int->float
         return DeviceColumn(to, c.data.astype(to.np_dtype), c.validity)
+
+
+def _int_width(dt: DataType) -> int:
+    import numpy as np
+
+    return np.dtype(dt.np_dtype).itemsize
+
+
+def _cast_from_string(c: DeviceColumn, to: DataType) -> DeviceColumn:
+    """Device string parsing (ops/stringcast.py; the CastStrings JNI
+    kernel role). Invalid input -> null (non-ANSI)."""
+    from spark_rapids_tpu.ops import stringcast as SC
+
+    if isinstance(to, BooleanType):
+        return SC.parse_bool(c, to)
+    if isinstance(to, IntegralType):
+        return SC.parse_long(c, to)
+    if isinstance(to, (FloatType, DoubleType)):
+        return SC.parse_double(c, to)
+    if isinstance(to, DecimalType):
+        return SC.parse_decimal(c, to)
+    if isinstance(to, DateType):
+        return SC.parse_date(c, to)
+    if isinstance(to, TimestampType):
+        return SC.parse_timestamp(c, to)
+    raise TypeError(f"cast string -> {to} not supported on device")
 
 
 def _cast_decimal(c: DeviceColumn, frm: DataType, to: DataType

@@ -87,8 +87,7 @@ def split_parquet_tasks(paths: List[str], coalesce_target_bytes: int
 
 
 def read_parquet_task(files: List[str], columns: Optional[List[str]],
-                      batch_rows: int,
-                      filters=None) -> Iterator[pa.Table]:
+                      batch_rows: int) -> Iterator[pa.Table]:
     """Decode one task's files, yielding row-capped tables (the chunked
     reader analog, GpuParquetScan.scala:2674)."""
     for f in files:
@@ -97,26 +96,63 @@ def read_parquet_task(files: List[str], columns: Optional[List[str]],
             yield pa.Table.from_batches([rb])
 
 
-def read_parquet_multithreaded(tasks: List[List[str]],
+_PREFETCH_DONE = object()
+
+
+def read_parquet_multithreaded(files: List[str],
                                columns: Optional[List[str]],
                                batch_rows: int,
-                               num_threads: int) -> List[Iterator[pa.Table]]:
-    """MULTITHREADED strategy: submit whole-task reads to the shared pool;
-    each partition's iterator consumes its future (fetch/decode overlaps
-    the consumer's device compute)."""
+                               num_threads: int,
+                               filters=None,
+                               queue_depth: int = 4) -> Iterator[pa.Table]:
+    """MULTITHREADED strategy: a shared-pool thread decodes this task's
+    batches into a bounded queue so fetch+decode overlaps the consumer's
+    device compute (MultiFileCloudParquetPartitionReader analog,
+    GpuParquetScan.scala:2051; pool per GpuMultiFileReader.scala:121).
+    The queue depth bounds in-flight host memory like the reference's
+    bytes-in-flight limiter."""
+    import queue as _queue
+
     pool = reader_thread_pool(num_threads)
+    q: "_queue.Queue" = _queue.Queue(maxsize=max(1, queue_depth))
+    abandoned = threading.Event()
 
-    def read_all(files):
-        return list(read_parquet_task(files, columns, batch_rows))
+    def produce():
+        try:
+            src = (read_parquet_task_filtered(files, columns, batch_rows,
+                                              filters) if filters
+                   else read_parquet_task(files, columns, batch_rows))
+            for t in src:
+                # bounded put that gives up if the consumer abandoned the
+                # iterator (e.g. LIMIT stopped early) — otherwise this
+                # shared-pool thread would block forever on a full queue
+                while not abandoned.is_set():
+                    try:
+                        q.put(t, timeout=0.2)
+                        break
+                    except _queue.Full:
+                        continue
+                if abandoned.is_set():
+                    return
+            q.put(_PREFETCH_DONE)
+        except BaseException as e:  # surfaced on the consumer side
+            if not abandoned.is_set():
+                q.put(e)
 
-    futures = [pool.submit(read_all, task) for task in tasks]
-    return [iter_future(f) for f in futures]
+    pool.submit(produce)
 
-
-def iter_future(fut) -> Iterator[pa.Table]:
     def gen():
-        for t in fut.result():
-            yield t
+        try:
+            while True:
+                item = q.get()
+                if item is _PREFETCH_DONE:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            abandoned.set()
+
     return gen()
 
 
@@ -185,18 +221,53 @@ def split_file_tasks(paths: List[str], suffix: str,
     return tasks or [[]]
 
 
+def _row_group_may_match(rg_meta, filters, schema: pa.Schema) -> bool:
+    """Conservative row-group pruning from parquet column statistics:
+    False only when a pushed (col, op, value) provably excludes every
+    row (missing/partial stats keep the group)."""
+    col_index = {schema.names[i]: i for i in range(len(schema.names))}
+    for name, op, val in filters:
+        i = col_index.get(name)
+        if i is None or i >= rg_meta.num_columns:
+            continue
+        stats = rg_meta.column(i).statistics
+        if stats is None or not stats.has_min_max:
+            continue
+        lo, hi = stats.min, stats.max
+        try:
+            if op == "=" and (val < lo or val > hi):
+                return False
+            if op in ("<",) and lo >= val:
+                return False
+            if op in ("<=",) and lo > val:
+                return False
+            if op in (">",) and hi <= val:
+                return False
+            if op in (">=",) and hi < val:
+                return False
+        except TypeError:
+            continue  # incomparable stats type: keep the group
+    return True
+
+
 def read_parquet_task_filtered(files: List[str],
                                columns: Optional[List[str]],
                                batch_rows: int,
                                filters) -> Iterator[pa.Table]:
     """Parquet read with row-group statistics pruning via pushed filter
-    tuples (reference predicate pushdown, GpuParquetScan.scala:556)."""
+    tuples (reference predicate pushdown, GpuParquetScan.scala:556).
+    Surviving row groups stream through the chunked reader — the whole
+    file is never materialized."""
     if not filters:
         yield from read_parquet_task(files, columns, batch_rows)
         return
     for f in files:
-        t = pq.read_table(f, columns=columns, filters=filters)
-        for off in range(0, max(t.num_rows, 1), batch_rows):
-            piece = t.slice(off, min(batch_rows, t.num_rows - off))
-            if piece.num_rows:
-                yield piece
+        pf = pq.ParquetFile(f)
+        keep = [i for i in range(pf.num_row_groups)
+                if _row_group_may_match(pf.metadata.row_group(i), filters,
+                                        pf.schema_arrow)]
+        if not keep:
+            continue
+        for rb in pf.iter_batches(batch_size=batch_rows, row_groups=keep,
+                                  columns=columns):
+            yield pa.Table.from_batches([rb])

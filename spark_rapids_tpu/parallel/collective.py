@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from spark_rapids_tpu.columnar.batch import ColumnBatch
+from spark_rapids_tpu.columnar.batch import ColumnBatch, DeviceColumn
 from spark_rapids_tpu.ops.common import sort_permutation
 
 
@@ -126,3 +126,43 @@ def all_to_all_batch(batch: ColumnBatch, pid: jnp.ndarray, n_dest: int,
     cperm = sort_permutation([ckey], recv_cap)
     out = interim.gather(cperm, total)
     return out, overflow
+
+
+def all_gather_batch(batch: ColumnBatch, axis_name: str, n: int
+                     ) -> ColumnBatch:
+    """Inside shard_map: concatenate every shard's live rows onto every
+    device — the broadcast-build transport (GpuBroadcastExchangeExec role
+    over ICI instead of a host broadcast). Returns a batch of capacity
+    n * cap with live rows compacted to the front, replicated on every
+    shard."""
+    cap = batch.capacity
+    counts = lax.all_gather(
+        jnp.asarray(batch.num_rows, jnp.int32).reshape(()), axis_name)
+
+    def g(arr):
+        out = lax.all_gather(arr, axis_name)  # [n, cap, ...]
+        return out.reshape((n * cap,) + arr.shape[1:])
+
+    new_cols = [DeviceColumn(c.dtype, g(c.data), g(c.validity),
+                             None if c.lengths is None else g(c.lengths))
+                for c in batch.columns]
+    blk = jnp.repeat(jnp.arange(n, dtype=jnp.int32), cap)
+    pos = jnp.tile(jnp.arange(cap, dtype=jnp.int32), n)
+    live = pos < jnp.take(counts, blk)
+    total = jnp.sum(live).astype(jnp.int32)
+    interim = ColumnBatch(batch.schema, new_cols, n * cap)
+    key = jnp.where(live, 0, 1).astype(jnp.int64)
+    perm = sort_permutation([key], n * cap)
+    return interim.gather(perm, total)
+
+
+def gather_to_one(batch: ColumnBatch, axis_name: str, n: int
+                  ) -> ColumnBatch:
+    """Single-partition exchange: every row moves to shard 0 (other
+    shards end up logically empty). The SPMD analog of the planner's
+    TpuShuffleExchangeExec(num_partitions=1)."""
+    out = all_gather_batch(batch, axis_name, n)
+    me = lax.axis_index(axis_name)
+    nr = jnp.where(me == 0,
+                   jnp.asarray(out.num_rows, jnp.int32), jnp.int32(0))
+    return ColumnBatch(out.schema, out.columns, nr)

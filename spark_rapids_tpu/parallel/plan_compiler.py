@@ -1,0 +1,523 @@
+"""Planner-driven SPMD execution — compile a physical plan into ONE
+shard_map'd XLA program over a device mesh.
+
+The single-chip engine executes planner output as thread-pool tasks with
+an in-process shuffle manager. In mesh mode (`spark.rapids.tpu.mesh=N`)
+the SAME planner output compiles into a single SPMD program over an
+N-device `jax.sharding.Mesh`:
+
+- every `TpuShuffleExchangeExec` becomes an `all_to_all` collective
+  riding ICI (the reference's UCX P2P transport role,
+  `shuffle/RapidsShuffleTransport.scala:303`, `RapidsShuffleClient.scala:95`,
+  `shuffle-plugin/.../ucx/UCX.scala` — replaced by compiled collectives,
+  SURVEY.md section 5.8),
+- broadcast-join builds become `all_gather` (GpuBroadcastExchangeExec),
+- global sort becomes a sample-based range exchange + per-shard sort
+  (GpuRangePartitioner.scala + GpuSortExec, distributed),
+- unary operators (project/filter/aggregate phases/limit) trace their
+  per-shard phase functions inline, fused by XLA.
+
+Data-dependent sizes use the engine's standard static-capacity +
+overflow-flag discipline: each collective slot / join expansion has a
+static capacity; any overflow raises TpuSplitAndRetryOOM on the host and
+the whole program recompiles with a doubled expansion factor.
+
+Plans containing operators without a mesh lowering raise
+MeshCompileError; the session falls back to the thread-pool engine.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from spark_rapids_tpu.columnar.arrow_bridge import (
+    arrow_to_device,
+    device_to_arrow,
+)
+from spark_rapids_tpu.columnar.batch import (
+    ColumnBatch,
+    DeviceColumn,
+    next_capacity,
+)
+from spark_rapids_tpu.exec import joins as J
+from spark_rapids_tpu.exec import operators as ops
+from spark_rapids_tpu.exec.base import PhysicalPlan
+from spark_rapids_tpu.expr import EvalContext
+from spark_rapids_tpu.ops import filterops, joinops
+from spark_rapids_tpu.ops.hashing import murmur3_columns, pmod
+from spark_rapids_tpu.ops.joinops import _binary_search
+from spark_rapids_tpu.ops.sortops import order_keys, sort_batch
+from spark_rapids_tpu.parallel import mesh_exec
+from spark_rapids_tpu.parallel.collective import (
+    all_gather_batch,
+    all_to_all_batch,
+    gather_to_one,
+    slot_capacity,
+)
+from spark_rapids_tpu.runtime.errors import TpuSplitAndRetryOOM
+from spark_rapids_tpu.sqltypes import StringType, StructType
+
+AXIS = mesh_exec.AXIS
+
+
+class MeshCompileError(NotImplementedError):
+    """Plan contains an operator with no mesh lowering (caller falls back
+    to the single-chip thread-pool engine)."""
+
+
+# --------------------------------------------------- trace-safe helpers
+
+def concat_traced(batches: List[ColumnBatch]) -> ColumnBatch:
+    """Trace-safe concat: static capacity = sum of capacities, live rows
+    compacted to the front (the jit-compatible sibling of
+    columnar.batch.concat_batches, which syncs row counts to the host)."""
+    if len(batches) == 1:
+        return batches[0]
+    schema = batches[0].schema
+    caps = [b.capacity for b in batches]
+    total_cap = sum(caps)
+    live = jnp.concatenate([b.live_mask() for b in batches])
+    cols: List[DeviceColumn] = []
+    for ci, field in enumerate(schema.fields):
+        parts = [b.columns[ci] for b in batches]
+        if isinstance(field.dataType, StringType):
+            mb = max(int(p.data.shape[1]) for p in parts)
+            datas = [jnp.pad(p.data, ((0, 0), (0, mb - p.data.shape[1])))
+                     for p in parts]
+        else:
+            datas = [p.data for p in parts]
+        data = jnp.concatenate(datas, axis=0)
+        val = jnp.concatenate([p.validity for p in parts])
+        lens = None
+        if parts[0].lengths is not None:
+            lens = jnp.concatenate([p.lengths for p in parts])
+        cols.append(DeviceColumn(field.dataType, data, val, lens))
+    interim = ColumnBatch(schema, cols, total_cap)
+    from spark_rapids_tpu.ops.common import sort_permutation
+
+    key = jnp.where(live, 0, 1).astype(jnp.int64)
+    perm = sort_permutation([key], total_cap)
+    total = jnp.sum(live).astype(jnp.int32)
+    return interim.gather(perm, total)
+
+
+def shard_equi_join(node: J._DeviceJoinBase, left: ColumnBatch,
+                    right: ColumnBatch, out_cap: int
+                    ) -> Tuple[ColumnBatch, jnp.ndarray]:
+    """Trace-safe per-shard equi-join with a static output capacity.
+    Returns (batch, overflow_flag); overflow means the true pair count
+    exceeded out_cap and the caller must recompile bigger.
+
+    Same gather-map algorithm as the eager join family (exec/joins.py),
+    minus the host syncs that pick capacity buckets dynamically."""
+    jt = node.join_type
+    lsch = node.children[0].schema
+    rsch = node.children[1].schema
+    no_ovf = jnp.zeros((), bool)
+    bt = node._build_table(right)
+    work_l, lk = node._prepare_keys(left, node.left_keys)
+    lo, counts = joinops.probe_ranges(bt, work_l, lk)
+
+    if node.condition is None:
+        if jt == "left_semi":
+            return filterops.compact(left, counts > 0), no_ovf
+        if jt == "left_anti":
+            return filterops.compact(left, counts == 0), no_ovf
+        if jt == "existence":
+            return node._exists_batch(left, counts > 0), no_ovf
+        eff = counts
+        if jt in ("left", "full"):
+            eff = jnp.where(left.live_mask() & (counts == 0), 1, counts)
+        pi, bi, total = joinops.expand_gather_maps(lo, eff, out_cap)
+        overflow = total > out_cap
+        lcols = [c.gather(pi) for c in left.columns]
+        safe_bi = jnp.clip(bi, 0, bt.batch.capacity - 1)
+        rcols = [c.gather(safe_bi) for c in bt.batch.columns]
+        if jt in ("left", "full"):
+            row_un = jnp.take(counts == 0, pi)
+            rcols = [DeviceColumn(c.dtype, c.data,
+                                  c.validity & ~row_un, c.lengths)
+                     for c in rcols]
+        out_schema = StructType(list(lsch.fields) + list(rsch.fields))
+        out = ColumnBatch(out_schema, lcols + rcols,
+                          jnp.minimum(total, out_cap))
+        if jt == "full":
+            matched_b = node._matched_build_mask(bt, lo, counts)
+            un_b = filterops.compact(bt.batch, ~matched_b)
+            out = concat_traced([out, node._left_nulls_batch(lsch, un_b)])
+        return out, overflow
+
+    # conditional equi-join: materialize candidate pairs, evaluate the
+    # bound condition over the gathered pair batch, derive the type
+    pi, bi, total = joinops.expand_gather_maps(lo, counts, out_cap)
+    overflow = total > out_cap
+    pair_live = jnp.arange(out_cap, dtype=jnp.int64) < total
+    pair_batch = node._gather_pairs(left, bt.batch, pi, bi,
+                                    jnp.minimum(total, out_cap))
+    pred = node.condition.eval(EvalContext(pair_batch))
+    ok = pair_live & pred.data & pred.validity
+
+    matched_l = (jnp.zeros((left.capacity,), jnp.int32)
+                 .at[pi].max(jnp.where(ok, 1, 0)) > 0)
+    if jt == "left_semi":
+        return filterops.compact(left, matched_l), overflow
+    if jt == "left_anti":
+        return filterops.compact(left, ~matched_l), overflow
+    if jt == "existence":
+        return node._exists_batch(left, matched_l), overflow
+    n_pairs = jnp.sum(jnp.where(ok, 1, 0)).astype(jnp.int32)
+    from spark_rapids_tpu.ops.common import sort_permutation
+
+    key = jnp.where(ok, 0, 1).astype(jnp.int32)
+    perm = sort_permutation([key], out_cap)
+    survivors = pair_batch.gather(perm, n_pairs)
+    if jt in ("inner", "cross"):
+        return survivors, overflow
+    parts = [survivors]
+    if jt in ("left", "full"):
+        left_un = filterops.compact(left, ~matched_l)
+        parts.append(node._right_nulls_batch(left_un, rsch))
+    if jt == "full":
+        matched_b = (jnp.zeros((bt.batch.capacity,), jnp.int32)
+                     .at[jnp.clip(bi, 0, bt.batch.capacity - 1)]
+                     .max(jnp.where(ok, 1, 0)) > 0)
+        right_un = filterops.compact(bt.batch, ~matched_b)
+        parts.append(node._left_nulls_batch(lsch, right_un))
+    out = concat_traced(parts)
+    return ColumnBatch(node.schema, out.columns, out.num_rows), overflow
+
+
+def range_exchange_sort(batch: ColumnBatch, orders, n: int, axis: str,
+                        slot: int, samples_per_shard: int = 64
+                        ) -> Tuple[ColumnBatch, jnp.ndarray]:
+    """Distributed global sort: sample-based range bounds (all_gather of
+    per-shard key samples), all_to_all range exchange, per-shard sort.
+    Shard s holds the s-th global key range, so concatenating shards in
+    order IS the global order (GpuRangePartitioner.scala +
+    GpuSortExec.scala, fused into the SPMD program)."""
+    keys = order_keys(batch, orders)
+    cap = batch.capacity
+    s_n = min(samples_per_shard, cap)
+    pos = (jnp.arange(s_n, dtype=jnp.int32) * cap) // s_n
+    gathered = [lax.all_gather(jnp.take(k, pos), axis).reshape(-1)
+                for k in keys]
+    from spark_rapids_tpu.ops.common import sort_permutation
+
+    total_s = n * s_n
+    perm = sort_permutation(gathered, total_s)
+    skeys = [jnp.take(g, perm) for g in gathered]
+    # dead/garbage sample rows carry leading null-rank 2 and sort last
+    live_ct = jnp.sum(skeys[0] < 2).astype(jnp.int32)
+    j = jnp.clip((jnp.arange(n - 1, dtype=jnp.int32) + 1) * live_ct // n,
+                 0, total_s - 1)
+    bounds = [jnp.take(k, j) for k in skeys]
+    dest = _binary_search(bounds, keys, jnp.int32(n - 1), max(n - 1, 1),
+                          upper=True)
+    exchanged, overflow = all_to_all_batch(batch, dest, n, slot, axis)
+    return sort_batch(exchanged, orders), overflow
+
+
+# --------------------------------------------------------- the executor
+
+_SOURCE_TYPES = (ops.LocalRelationExec, ops.RangeExec, ops.TpuFileScanExec,
+                 ops.ArrowToDeviceExec)
+
+_SUPPORTED = (ops.TpuProjectExec, ops.TpuFilterExec,
+              ops.TpuHashAggregateExec, ops.TpuShuffleExchangeExec,
+              ops.TpuSortExec, ops.TpuLocalLimitExec, ops.UnionExec,
+              J.TpuShuffledHashJoinExec, J.TpuBroadcastHashJoinExec)
+
+
+def _plan_key(node: PhysicalPlan) -> tuple:
+    """Structural key of a physical plan for caching the compiled SPMD
+    program (the jit_cache discipline applied to whole-plan programs).
+    Two plans with equal keys trace to identical programs."""
+    from spark_rapids_tpu.runtime.jit_cache import (
+        aliases_key,
+        orders_key,
+        schema_key,
+    )
+
+    t = type(node).__name__
+    if isinstance(node, ops.TpuProjectExec):
+        own = aliases_key(node.exprs)
+    elif isinstance(node, ops.TpuFilterExec):
+        own = node.condition.key()
+    elif isinstance(node, ops.TpuHashAggregateExec):
+        own = (node.mode, aliases_key(node.grouping),
+               aliases_key(node.aggs))
+    elif isinstance(node, ops.TpuSortExec):
+        own = orders_key(node.orders)
+    elif isinstance(node, ops.TpuRangeShuffleExchangeExec):
+        own = (orders_key(node.orders), node.num_partitions)
+    elif isinstance(node, ops.TpuShuffleExchangeExec):
+        own = (tuple(k.key() for k in node.key_exprs)
+               if node.key_exprs else None, node.num_partitions)
+    elif isinstance(node, ops.TpuLocalLimitExec):
+        own = (node.n,)
+    elif isinstance(node, (J.TpuShuffledHashJoinExec,
+                           J.TpuBroadcastHashJoinExec)):
+        own = (node.join_type,
+               tuple(k.key() for k in node.left_keys),
+               tuple(k.key() for k in node.right_keys),
+               node.condition.key() if node.condition is not None
+               else None,
+               schema_key(node.schema))
+    else:
+        own = schema_key(node.schema)
+    return (t, own, tuple(_plan_key(c) for c in node.children))
+
+
+class MeshQueryExecutor:
+    """Compile + run one physical plan as a single SPMD program."""
+
+    def __init__(self, mesh, conf=None, expansion: int = 4):
+        self.mesh = mesh
+        self.conf = conf
+        self.n = mesh.shape[AXIS]
+        self._expansion = expansion
+
+    _mesh_cache: Dict[int, object] = {}
+
+    @classmethod
+    def for_devices(cls, n_devices: int, conf=None) -> "MeshQueryExecutor":
+        mesh = cls._mesh_cache.get(n_devices)
+        if mesh is None:
+            mesh = mesh_exec.make_mesh(n_devices)
+            cls._mesh_cache[n_devices] = mesh
+        return cls(mesh, conf)
+
+    # --- plan walking ---
+
+    def _collect_sources(self, node: PhysicalPlan,
+                         out: List[PhysicalPlan]) -> None:
+        if isinstance(node, _SOURCE_TYPES) or not node.is_tpu:
+            out.append(node)
+            return
+        if not isinstance(node, _SUPPORTED):
+            raise MeshCompileError(
+                f"{type(node).__name__} has no mesh lowering")
+        if isinstance(node, ops.UnionExec) and not node.is_tpu:
+            raise MeshCompileError("host-side union")
+        for c in node.children:
+            self._collect_sources(c, out)
+
+    def _materialize(self, source: PhysicalPlan) -> ColumnBatch:
+        """Run a source subtree on the host engine and build one padded
+        device batch whose capacity divides the mesh size."""
+        table = source.collect()
+        cap = next_capacity(max(table.num_rows, 1))
+        if cap % self.n:
+            cap = -(-cap // self.n) * self.n
+        return arrow_to_device(table, capacity=cap)
+
+    # --- execution ---
+
+    def execute(self, phys: PhysicalPlan) -> pa.Table:
+        sources: List[PhysicalPlan] = []
+        self._collect_sources(phys, sources)
+        host_batches = [self._materialize(s) for s in sources]
+        expansion = self._expansion
+        while True:
+            try:
+                return self._run(phys, sources, host_batches, expansion)
+            except TpuSplitAndRetryOOM:
+                if expansion >= 256:
+                    raise
+                expansion *= 2
+
+    def _run(self, phys: PhysicalPlan, sources: List[PhysicalPlan],
+             host_batches: List[ColumnBatch], expansion: int) -> pa.Table:
+        n = self.n
+        sharded = [mesh_exec.shard_batch(self.mesh, hb)
+                   for hb in host_batches]
+        src_index: Dict[int, int] = {id(s): i for i, s in
+                                     enumerate(sources)}
+
+        def step(*shards):
+            overflow = jnp.zeros((), bool)
+
+            def track(pair):
+                nonlocal overflow
+                out, ovf = pair
+                overflow = overflow | ovf
+                return out
+
+            def emit(node: PhysicalPlan) -> ColumnBatch:
+                if id(node) in src_index:
+                    return shards[src_index[id(node)]]
+                if isinstance(node, ops.TpuProjectExec):
+                    return node._run(emit(node.children[0]))
+                if isinstance(node, ops.TpuFilterExec):
+                    return node._run(emit(node.children[0]))
+                if isinstance(node, ops.TpuLocalLimitExec):
+                    return self._shard_prefix_limit(
+                        emit(node.children[0]), node.n)
+                if isinstance(node, ops.UnionExec):
+                    return concat_traced(
+                        [emit(c) for c in node.children])
+                if isinstance(node, ops.TpuHashAggregateExec):
+                    return self._emit_agg(node, emit, track, expansion)
+                if isinstance(node, ops.TpuShuffleExchangeExec):
+                    return self._emit_exchange(
+                        node, emit(node.children[0]), track, expansion)
+                if isinstance(node, ops.TpuSortExec):
+                    child = node.children[0]
+                    if (isinstance(child, ops.TpuRangeShuffleExchangeExec)
+                            or (isinstance(child,
+                                           ops.TpuShuffleExchangeExec)
+                                and child.key_exprs is None
+                                and child.num_partitions == 1)):
+                        # the mesh sort does its own range exchange
+                        child = child.children[0]
+                    cb = emit(child)
+                    slot = slot_capacity(cb.capacity, n, expansion)
+                    return track(range_exchange_sort(
+                        cb, node.orders, n, AXIS, slot))
+                if isinstance(node, J.TpuShuffledHashJoinExec):
+                    # the join owns co-partitioning: each side rides one
+                    # all_to_all keyed by its join keys. Planner-inserted
+                    # exchanges carrying exactly those keys are bypassed
+                    # (they would be a redundant second shuffle).
+                    lc = self._skip_keyed_exchange(node.children[0],
+                                                   node.left_keys)
+                    rc = self._skip_keyed_exchange(node.children[1],
+                                                   node.right_keys)
+                    lb = self._key_exchange(emit(lc), node.left_keys,
+                                            track, expansion)
+                    rb = self._key_exchange(emit(rc), node.right_keys,
+                                            track, expansion)
+                    out_cap = next_capacity(
+                        expansion * max(lb.capacity, rb.capacity))
+                    return track(shard_equi_join(node, lb, rb, out_cap))
+                if isinstance(node, J.TpuBroadcastHashJoinExec):
+                    lb = emit(node.children[0])
+                    rb = all_gather_batch(emit(node.children[1]), AXIS, n)
+                    out_cap = next_capacity(
+                        expansion * max(lb.capacity, rb.capacity))
+                    return track(shard_equi_join(node, lb, rb, out_cap))
+                raise MeshCompileError(type(node).__name__)
+
+            out = emit(phys)
+            out = ColumnBatch(
+                out.schema, out.columns,
+                jnp.asarray(out.num_rows, jnp.int32).reshape(1))
+            return out, overflow.reshape(1)
+
+        from jax import shard_map
+
+        from spark_rapids_tpu.runtime.jit_cache import cached_jit
+
+        shape_key = tuple(
+            tuple((tuple(c.data.shape), str(c.data.dtype))
+                  for c in hb.columns) + ((hb.capacity,),)
+            for hb in host_batches)
+        key = ("mesh_plan", _plan_key(phys), n, expansion, shape_key)
+        jitted = cached_jit(
+            key,
+            lambda: shard_map(step, mesh=self.mesh,
+                              in_specs=tuple(P(AXIS) for _ in sharded),
+                              out_specs=(P(AXIS), P(AXIS)),
+                              check_vma=False))
+        out, ovf = jitted(*sharded)
+        jax.block_until_ready(jax.tree_util.tree_leaves(out))
+        if bool(np.asarray(jax.device_get(ovf)).any()):
+            raise TpuSplitAndRetryOOM(
+                "mesh collective slot / join expansion overflowed; "
+                "recompiling with a larger expansion factor")
+        host = mesh_exec.gather_result(out, self.n)
+        return device_to_arrow(host)
+
+    # --- node lowerings needing state ---
+
+    @staticmethod
+    def _skip_keyed_exchange(child: PhysicalPlan, keys) -> PhysicalPlan:
+        if (isinstance(child, ops.TpuShuffleExchangeExec)
+                and child.key_exprs is not None
+                and len(child.key_exprs) == len(keys)
+                and all(a is b for a, b in zip(child.key_exprs, keys))):
+            return child.children[0]
+        return child
+
+    def _key_exchange(self, batch: ColumnBatch, keys, track,
+                      expansion: int) -> ColumnBatch:
+        ctx = EvalContext(batch)
+        kcols = [k.eval(ctx) for k in keys]
+        dest = pmod(murmur3_columns(kcols), self.n)
+        slot = slot_capacity(batch.capacity, self.n, expansion)
+        return track(all_to_all_batch(batch, dest, self.n, slot, AXIS))
+
+    def _shard_prefix_limit(self, batch: ColumnBatch,
+                            k: int) -> ColumnBatch:
+        """Global prefix limit across shard order: shard s keeps
+        max(0, min(rows_s, k - rows_before_s)). Correct for range-sorted
+        shards (ordered limit) and for gathered single-shard data; always
+        yields <= k rows total."""
+        nr = jnp.asarray(batch.num_rows, jnp.int32).reshape(())
+        counts = lax.all_gather(nr, AXIS)
+        me = lax.axis_index(AXIS)
+        start = jnp.sum(jnp.where(
+            jnp.arange(self.n, dtype=jnp.int32) < me, counts, 0))
+        keep = jnp.clip(jnp.int32(k) - start, 0, nr)
+        return ColumnBatch(batch.schema, batch.columns, keep)
+
+    def _emit_agg(self, node: ops.TpuHashAggregateExec, emit, track,
+                  expansion: int) -> ColumnBatch:
+        n = self.n
+        if node.mode == "partial":
+            return node._partial(emit(node.children[0]))
+        if node.mode == "final":
+            return self._first_shard_only(
+                node._merge_final(emit(node.children[0])), node)
+        # complete: the planner saw one partition; distribute it as
+        # partial -> key-hash all_to_all -> final (the same shape the
+        # planner emits for multi-partition children)
+        child = emit(node.children[0])
+        part = node._partial(child)
+        nk = len(node.grouping)
+        if nk:
+            key_cols = [part.columns[i] for i in range(nk)]
+            dest = pmod(murmur3_columns(key_cols), n)
+            slot = slot_capacity(part.capacity, n, expansion)
+            ex = track(all_to_all_batch(part, dest, n, slot, AXIS))
+        else:
+            ex = gather_to_one(part, AXIS, n)
+        return self._first_shard_only(node._merge_final(ex), node)
+
+    @staticmethod
+    def _first_shard_only(out: ColumnBatch,
+                          node: ops.TpuHashAggregateExec) -> ColumnBatch:
+        """A global (ungrouped) aggregate emits exactly one row — on
+        shard 0, where gather_to_one put the buffers; the per-shard merge
+        would otherwise emit its 'one row on empty input' everywhere."""
+        if node.grouping:
+            return out
+        me = lax.axis_index(AXIS)
+        nr = jnp.where(me == 0,
+                       jnp.asarray(out.num_rows, jnp.int32).reshape(()),
+                       jnp.int32(0))
+        return ColumnBatch(out.schema, out.columns, nr)
+
+    def _emit_exchange(self, node: ops.TpuShuffleExchangeExec,
+                       child: ColumnBatch, track,
+                       expansion: int) -> ColumnBatch:
+        n = self.n
+        if node.key_exprs:
+            ctx = EvalContext(child)
+            kcols = [e.eval(ctx) for e in node.key_exprs]
+            dest = pmod(murmur3_columns(kcols), n)
+            slot = slot_capacity(child.capacity, n, expansion)
+            return track(all_to_all_batch(child, dest, n, slot, AXIS))
+        if node.num_partitions == 1:
+            return gather_to_one(child, AXIS, n)
+        # round-robin repartition: balance rows across shards
+        dest = jnp.arange(child.capacity, dtype=jnp.int32) % n
+        slot = slot_capacity(child.capacity, n, expansion)
+        return track(all_to_all_batch(child, dest, n, slot, AXIS))

@@ -193,11 +193,25 @@ class TpuOverrides:
                                  node.num_partitions, node.schema, conf)
         if isinstance(node, L.FileScan):
             cols = node.schema.names
+            filters = getattr(node, "pushed_filters", None)
             if on_device:
                 return ops.TpuFileScanExec(node.fmt, node.paths, node.schema,
-                                           conf, pushed_columns=cols)
+                                           conf, pushed_columns=cols,
+                                           pushed_filters=filters)
             return ops.CpuFileScanExec(node.fmt, node.paths, node.schema,
-                                       conf, pushed_columns=cols)
+                                       conf, pushed_columns=cols,
+                                       pushed_filters=filters)
+
+        if isinstance(node, L.Limit):
+            smeta = meta.children[0]
+            if (isinstance(smeta.node, L.Sort) and smeta.node.global_sort
+                    and on_device and smeta.can_run_on_device):
+                # TakeOrderedAndProject fusion (GpuOverrides.scala:4084):
+                # per-partition sort+limit, gather, final sort+limit —
+                # never materializes more than n rows per partition
+                inner = self._to_device(self._convert(smeta.children[0]))
+                return self._take_ordered(node.n, smeta.node.orders,
+                                          inner)
 
         children = [self._convert(c) for c in meta.children]
 
@@ -372,6 +386,19 @@ class TpuOverrides:
             return ops.TpuShuffleExchangeExec(plan, None, 1, self.conf)
         return ops.CpuShuffleExchangeExec(plan, None, 1, self.conf)
 
+    def _take_ordered(self, n: int, orders, child: PhysicalPlan
+                      ) -> PhysicalPlan:
+        conf = self.conf
+        local = ops.TpuLocalLimitExec(
+            n, ops.TpuSortExec(orders, child, conf), conf)
+        if local.num_partitions > 1:
+            local = ops.TpuLocalLimitExec(
+                n, ops.TpuSortExec(
+                    orders,
+                    ops.TpuShuffleExchangeExec(local, None, 1, conf),
+                    conf), conf)
+        return local
+
     def _convert_sort(self, node: L.Sort, child: PhysicalPlan,
                       on_device: bool) -> PhysicalPlan:
         conf = self.conf
@@ -380,9 +407,11 @@ class TpuOverrides:
                                    self._single(self._to_host(child)), conf)
         child = self._to_device(child)
         if node.global_sort and child.num_partitions > 1:
-            # v1 global sort: gather to one partition then sort; range
-            # partitioning + out-of-core merge is the planned upgrade.
-            child = ops.TpuShuffleExchangeExec(child, None, 1, conf)
+            # distributed global sort: sample-based range exchange, then
+            # per-partition out-of-core sort; partition order == global
+            # order (GpuRangePartitioner.scala + GpuSortExec.scala)
+            child = ops.TpuRangeShuffleExchangeExec(
+                child, node.orders, conf.get(rc.SHUFFLE_PARTITIONS), conf)
         return ops.TpuSortExec(node.orders, child, conf)
 
     def _convert_window(self, node: "L.Window", child: PhysicalPlan,
@@ -401,6 +430,25 @@ class TpuOverrides:
                     conf.get(rc.SHUFFLE_PARTITIONS), conf)
             else:
                 child = ops.TpuShuffleExchangeExec(child, None, 1, conf)
+        halo = ops.window_halo(node.window_exprs)
+        chunk_rows = conf.get(rc.BATCH_SIZE_ROWS)
+        if halo is not None and halo > chunk_rows // 2:
+            # the batched path peeks at most one following chunk for the
+            # suffix halo; frames wider than half a chunk must take the
+            # whole-partition path for correctness
+            halo = None
+        if halo is not None and (spec.partitions or spec.orders):
+            # bounded-frame batched window: out-of-core sort on the
+            # partition+order keys emitting bounded chunks, evaluated
+            # with halo context (GpuBatchedBoundedWindowExec role)
+            from spark_rapids_tpu.plan.logical import SortOrder
+
+            orders = ([SortOrder(p, True) for p in spec.partitions] +
+                      list(spec.orders))
+            child = ops.TpuSortExec(orders, child, conf,
+                                    chunk_rows=chunk_rows)
+            return ops.TpuWindowExec(node.window_exprs, child, conf,
+                                     presorted=True, halo=halo)
         return ops.TpuWindowExec(node.window_exprs, child, conf)
 
     def _convert_limit(self, node: L.Limit, child: PhysicalPlan,

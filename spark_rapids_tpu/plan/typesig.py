@@ -55,6 +55,13 @@ def _cast_check(e: Cast) -> Optional[str]:
     if not e.device_supported():
         return (f"cast {e.children[0].dtype.simpleString} -> "
                 f"{e.to.simpleString} runs on CPU in v1")
+    from spark_rapids_tpu.config.rapids_conf import ansi_enabled
+
+    if ansi_enabled() and e.can_fail():
+        return (f"ANSI mode: failable cast "
+                f"{e.children[0].dtype.simpleString} -> "
+                f"{e.to.simpleString} runs on CPU so errors raise "
+                "eagerly")
     return None
 
 
@@ -98,3 +105,12 @@ from spark_rapids_tpu.expr.regexexpr import RLike  # noqa: E402
 @register_check(RLike)
 def _rlike_check(e: "RLike") -> Optional[str]:
     return e.device_supported()
+
+
+from spark_rapids_tpu.udf.pandas_udf import PandasUDF  # noqa: E402
+
+
+@register_check(PandasUDF)
+def _pandas_udf_check(e: "PandasUDF") -> Optional[str]:
+    return ("pandas UDF runs via the Arrow worker-process exchange "
+            "(GpuArrowEvalPythonExec role, host-side)")

@@ -4,14 +4,20 @@ Reference (`RapidsShuffleInternalManagerBase.scala:238,569,1183`): the
 MULTITHREADED mode serializes device batches on a writer thread pool into
 host shuffle storage (files), readers fetch and coalesce back onto the
 device (`GpuShuffleCoalesceExec`). The UCX device-to-device transport's
-analog is the ICI collective path (parallel/collective.py).
+analog is the ICI collective path (parallel/collective.py +
+parallel/plan_compiler.py).
 
 Modes here (conf spark.rapids.shuffle.mode):
-- CACHE_ONLY: blocks stay as in-process host Arrow tables.
+- CACHE_ONLY: blocks live as in-process host Arrow tables under a host
+  byte ledger; when in-memory block bytes exceed the spill threshold the
+  coldest blocks degrade to compressed disk files (the
+  ShuffleBufferCatalog spill-integration role — blocks are never lost,
+  they move tiers).
 - MULTITHREADED: blocks are serialized through the native wire format
-  (shuffle/serde.py, the JCudfSerialization analog) and written to
-  shuffle files by a writer thread pool; readers block on the in-flight
-  writes for their partition then deserialize.
+  (shuffle/serde.py, the JCudfSerialization analog), optionally
+  compressed (TableCompressionCodec role), and written to shuffle files
+  by a writer thread pool; readers block on the in-flight writes for
+  their partition then deserialize.
 """
 
 from __future__ import annotations
@@ -21,25 +27,40 @@ import tempfile
 import threading
 from collections import defaultdict
 from concurrent.futures import Future, ThreadPoolExecutor
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 import pyarrow as pa
+
+
+class _MemBlock:
+    __slots__ = ("table", "path", "nbytes", "seq")
+
+    def __init__(self, table: Optional[pa.Table], nbytes: int, seq: int):
+        self.table = table          # None once spilled
+        self.path: Optional[str] = None
+        self.nbytes = nbytes
+        self.seq = seq
 
 
 class ShuffleManager:
     """Maps (shuffle_id, reduce_pid) -> shuffle blocks."""
 
     def __init__(self, mode: str = "CACHE_ONLY", shuffle_dir: str = None,
-                 num_threads: int = 8):
+                 num_threads: int = 8, codec: str = "none",
+                 spill_threshold: int = 2 << 30):
         self.mode = mode
-        self._blocks: Dict[Tuple[int, int], List[pa.Table]] = defaultdict(
+        self.codec = codec
+        self.spill_threshold = spill_threshold
+        self._blocks: Dict[Tuple[int, int], List[_MemBlock]] = defaultdict(
             list)
         self._files: Dict[Tuple[int, int], List[Future]] = defaultdict(
             list)
         self._lock = threading.Lock()
         self._next_id = 0
         self.bytes_written = 0
+        self.bytes_in_memory = 0
+        self.blocks_spilled = 0
         self._dir = shuffle_dir
         self._pool = None
         self._seq = 0
@@ -55,11 +76,43 @@ class ShuffleManager:
             self._next_id += 1
             return self._next_id
 
+    def _spill_dir(self) -> str:
+        if self._dir is None:
+            self._dir = tempfile.mkdtemp(prefix="srtpu-shuffle-")
+        return self._dir
+
+    def _spill_mem_blocks(self):
+        """Under lock: move coldest (oldest) in-memory blocks to
+        compressed disk files until under the threshold."""
+        from spark_rapids_tpu.shuffle import serde
+
+        victims: List[_MemBlock] = []
+        for blocks in self._blocks.values():
+            victims.extend(b for b in blocks if b.table is not None)
+        victims.sort(key=lambda b: b.seq)
+        for b in victims:
+            if self.bytes_in_memory <= self.spill_threshold:
+                break
+            path = os.path.join(self._spill_dir(),
+                                f"shuffle-spill-{b.seq}.stpu")
+            serde.serialize_table(b.table, codec=self.codec).tofile(path)
+            # path BEFORE table: fetch() snapshots (table, path) and
+            # must never observe both unset
+            b.path = path
+            b.table = None
+            self.bytes_in_memory -= b.nbytes
+            self.blocks_spilled += 1
+
     def put(self, shuffle_id: int, reduce_pid: int, table: pa.Table):
         if self.mode != "MULTITHREADED":
             with self._lock:
-                self._blocks[(shuffle_id, reduce_pid)].append(table)
+                self._seq += 1
+                blk = _MemBlock(table, table.nbytes, self._seq)
+                self._blocks[(shuffle_id, reduce_pid)].append(blk)
                 self.bytes_written += table.nbytes
+                self.bytes_in_memory += table.nbytes
+                if self.bytes_in_memory > self.spill_threshold:
+                    self._spill_mem_blocks()
             return
         with self._lock:
             self._seq += 1
@@ -70,7 +123,7 @@ class ShuffleManager:
         def write():
             from spark_rapids_tpu.shuffle import serde
 
-            buf = serde.serialize_table(table)
+            buf = serde.serialize_table(table, codec=self.codec)
             with open(path, "wb") as f:
                 buf.tofile(f)
             with self._lock:
@@ -82,13 +135,22 @@ class ShuffleManager:
             self._files[(shuffle_id, reduce_pid)].append(fut)
 
     def fetch(self, shuffle_id: int, reduce_pid: int) -> List[pa.Table]:
-        if self.mode != "MULTITHREADED":
-            with self._lock:
-                return list(self._blocks.get((shuffle_id, reduce_pid), []))
-        with self._lock:
-            futs = list(self._files.get((shuffle_id, reduce_pid), []))
         from spark_rapids_tpu.shuffle import serde
 
+        if self.mode != "MULTITHREADED":
+            with self._lock:
+                snap = [(b.table, b.path) for b in
+                        self._blocks.get((shuffle_id, reduce_pid), [])]
+            out = []
+            for table, path in snap:
+                if table is not None:
+                    out.append(table)
+                else:
+                    data = np.fromfile(path, dtype=np.uint8)
+                    out.append(serde.deserialize_table(data))
+            return out
+        with self._lock:
+            futs = list(self._files.get((shuffle_id, reduce_pid), []))
         tables = []
         for fut in futs:
             path = fut.result()  # blocks on in-flight writes
@@ -98,12 +160,22 @@ class ShuffleManager:
 
     def remove_shuffle(self, shuffle_id: int):
         with self._lock:
+            spilled_paths = []
             for k in [k for k in self._blocks if k[0] == shuffle_id]:
-                del self._blocks[k]
+                for b in self._blocks.pop(k):
+                    if b.table is not None:
+                        self.bytes_in_memory -= b.nbytes
+                    elif b.path:
+                        spilled_paths.append(b.path)
             futs = []
             for k in [k for k in self._files if k[0] == shuffle_id]:
                 futs.extend(self._files.pop(k))
         # wait + unlink OUTSIDE the lock so unrelated shuffles proceed
+        for p in spilled_paths:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
         for fut in futs:
             try:
                 os.unlink(fut.result())
@@ -120,15 +192,18 @@ _mgr_lock = threading.Lock()
 
 
 def configure_shuffle(mode: str, shuffle_dir: str = None,
-                      num_threads: int = 8):
+                      num_threads: int = 8, codec: str = "none",
+                      spill_threshold: int = 2 << 30):
     """Install a manager for the session's shuffle settings (reference
     GpuShuffleEnv.initShuffleManager, Plugin.scala:531)."""
     global _manager
     with _mgr_lock:
-        settings = (mode, shuffle_dir, num_threads)
+        settings = (mode, shuffle_dir, num_threads, codec,
+                    spill_threshold)
         if getattr(_manager, "_settings", None) != settings:
             _manager.shutdown()
-            _manager = ShuffleManager(mode, shuffle_dir, num_threads)
+            _manager = ShuffleManager(mode, shuffle_dir, num_threads,
+                                      codec, spill_threshold)
             _manager._settings = settings
     return _manager
 

@@ -7,11 +7,18 @@ meta JSON, column buffers...] packed by the native runtime
 (native/sparktpu_runtime.cpp stpu_pack) with 64-byte alignment so
 deserialization is zero-copy buffer slicing. Flat types only (primitives,
 strings, dates/timestamps/decimals) — the engine's device surface.
+
+Optional block compression (`codec=`) wraps the packed frame with a
+10-byte header [magic u8, codec u8, raw_len i64] — the
+TableCompressionCodec / NvcompLZ4CompressionCodec role (reference
+compresses shuffle payloads with nvcomp LZ4/ZSTD; here zstd level 1 or
+zlib on the host).
 """
 
 from __future__ import annotations
 
 import json
+import struct
 from typing import List
 
 import numpy as np
@@ -19,9 +26,39 @@ import pyarrow as pa
 
 from spark_rapids_tpu import native
 
+_CODEC_MAGIC = 0xC7
+_CODECS = {"none": 0, "zstd": 1, "zlib": 2}
+_CODEC_NAMES = {v: k for k, v in _CODECS.items()}
 
-def serialize_table(table: pa.Table) -> np.ndarray:
-    """Arrow table -> one contiguous uint8 buffer."""
+
+def _compress(raw: bytes, codec: str) -> bytes:
+    if codec == "zstd":
+        import zstandard
+
+        return zstandard.ZstdCompressor(level=1).compress(raw)
+    if codec == "zlib":
+        import zlib
+
+        return zlib.compress(raw, level=1)
+    return raw
+
+
+def _decompress(payload: bytes, codec: str, raw_len: int) -> bytes:
+    if codec == "zstd":
+        import zstandard
+
+        return zstandard.ZstdDecompressor().decompress(
+            payload, max_output_size=raw_len)
+    if codec == "zlib":
+        import zlib
+
+        return zlib.decompress(payload)
+    return payload
+
+
+def serialize_table(table: pa.Table, codec: str = "none") -> np.ndarray:
+    """Arrow table -> one contiguous uint8 buffer (optionally
+    codec-compressed)."""
     schema_buf = np.frombuffer(table.schema.serialize(), dtype=np.uint8)
     bufs: List[np.ndarray] = []
     col_specs = []
@@ -41,10 +78,23 @@ def serialize_table(table: pa.Table) -> np.ndarray:
     meta = json.dumps({"nrows": table.num_rows,
                        "cols": col_specs}).encode()
     meta_buf = np.frombuffer(meta, dtype=np.uint8)
-    return native.pack_buffers([schema_buf, meta_buf] + bufs)
+    packed = native.pack_buffers([schema_buf, meta_buf] + bufs)
+    if codec == "none":
+        return packed
+    raw = packed.tobytes()
+    payload = _compress(raw, codec)
+    header = struct.pack("<BBq", _CODEC_MAGIC, _CODECS[codec], len(raw))
+    return np.frombuffer(header + payload, dtype=np.uint8)
 
 
 def deserialize_table(data: np.ndarray) -> pa.Table:
+    if data.size >= 10 and int(data[0]) == _CODEC_MAGIC and \
+            int(data[1]) in (1, 2):
+        magic, codec_id, raw_len = struct.unpack("<BBq",
+                                                 data[:10].tobytes())
+        raw = _decompress(data[10:].tobytes(), _CODEC_NAMES[codec_id],
+                          raw_len)
+        data = np.frombuffer(raw, dtype=np.uint8)
     parts = native.unpack_buffers(data)
     schema = pa.ipc.read_schema(pa.py_buffer(parts[0].tobytes()))
     meta = json.loads(bytes(parts[1]))

@@ -318,3 +318,31 @@ def to_arrow_type(dt: DataType):
         return mapping[type(dt)]
     except KeyError:
         raise TypeError(f"unsupported type {dt}")
+
+
+def parse_type_name(name: str) -> DataType:
+    """PySpark-style type-name strings ('int', 'bigint', 'decimal(p,s)',
+    ...) -> DataType (Column.cast('long') support)."""
+    n = name.strip().lower()
+    simple = {
+        "boolean": boolean, "bool": boolean,
+        "byte": byte, "tinyint": byte,
+        "short": short, "smallint": short,
+        "int": integer, "integer": integer,
+        "long": long, "bigint": long,
+        "float": float_t, "real": float_t,
+        "double": double,
+        "string": string, "str": string,
+        "date": date,
+        "timestamp": timestamp,
+    }
+    if n in simple:
+        return simple[n]
+    if n.startswith("decimal"):
+        inner = n[len("decimal"):].strip()
+        if not inner:
+            return DecimalType(10, 0)
+        inner = inner.strip("()")
+        p, _, s = inner.partition(",")
+        return DecimalType(int(p), int(s or 0))
+    raise ValueError(f"cannot parse type name {name!r}")

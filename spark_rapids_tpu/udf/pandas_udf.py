@@ -1,0 +1,141 @@
+"""Pandas/Arrow UDF exchange — the GpuArrowEvalPythonExec analog.
+
+Reference (`execution/python/GpuArrowEvalPythonExec.scala` + 13 files,
+`python/PythonWorkerSemaphore.scala`, SURVEY.md 2.8): device batches are
+serialized to Arrow IPC, shipped to Python worker processes that run the
+user's pandas function over pandas Series, and the results stream back
+as Arrow; a semaphore caps concurrent workers.
+
+Here the engine itself is Python, so the exchange's purpose is true
+parallelism + isolation: each chunk ships as Arrow IPC bytes to a
+process-pool worker (cloudpickle'd function, GIL-free), results return
+as Arrow IPC. The pool size is the worker-semaphore analog
+(spark.rapids.python.concurrentPythonWorkers role).
+
+scalar pandas_udf only in v1 (Series... -> Series); grouped-map /
+grouped-agg variants are follow-ups.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ProcessPoolExecutor
+from typing import Callable, List, Optional
+
+import pyarrow as pa
+
+from spark_rapids_tpu.expr.core import Expression
+from spark_rapids_tpu.sqltypes import DataType
+
+_pool: Optional[ProcessPoolExecutor] = None
+_pool_workers = 0
+_pool_lock = threading.Lock()
+
+
+def get_worker_pool(num_workers: int = 4) -> ProcessPoolExecutor:
+    global _pool, _pool_workers
+    import multiprocessing
+
+    with _pool_lock:
+        if _pool is None or _pool_workers != num_workers:
+            if _pool is not None:
+                _pool.shutdown(wait=False)
+            # forkserver, not fork: the parent runs JAX's thread pools
+            # and a direct fork can deadlock on their held locks; the
+            # forkserver is exec'd fresh and forks clean children (and
+            # unlike spawn it does not re-run __main__)
+            _pool = ProcessPoolExecutor(
+                max_workers=num_workers,
+                mp_context=multiprocessing.get_context("forkserver"))
+            _pool_workers = num_workers
+        return _pool
+
+
+# The worker entry lives in the dependency-free top-level module
+# srtpu_pandas_worker so worker processes never import this package
+# (package import initializes the JAX backend).
+from srtpu_pandas_worker import (  # noqa: E402
+    ipc_bytes as _ipc_bytes,
+    ipc_table as _ipc_table,
+    worker_apply as _worker_apply,
+)
+
+
+class PandasUDF(Expression):
+    """Scalar pandas UDF expression: evaluated on the host via the Arrow
+    worker-process exchange; the planner's type checks route the
+    enclosing operator to the CPU path (GpuArrowEvalPythonExec is a
+    host-side exec in the reference too — only the batch transport
+    touches the device)."""
+
+    def __init__(self, fn: Callable, return_type: DataType,
+                 children: List[Expression]):
+        super().__init__(children)
+        self.fn = fn
+        self._dtype = return_type
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return True
+
+    def key(self):
+        return ("pandas_udf", id(self.fn),
+                tuple(c.key() for c in self.children))
+
+    def __repr__(self):
+        return (f"pandas_udf({getattr(self.fn, '__name__', 'fn')}, "
+                f"{self._dtype.simpleString})")
+
+
+def eval_pandas_udf(e: PandasUDF, table: pa.Table,
+                    chunk_rows: int = 65536,
+                    num_workers: int = 4) -> pa.ChunkedArray:
+    """Host evaluation: chunk the input, ship chunks to the worker pool
+    concurrently, reassemble in order."""
+    import cloudpickle
+
+    from spark_rapids_tpu.exec import cpu_eval
+    from spark_rapids_tpu.sqltypes.datatypes import to_arrow_type
+
+    cols = {f"c{i}": cpu_eval.eval_expr(c, table)
+            for i, c in enumerate(e.children)}
+    work = pa.table(cols)
+    out_type = to_arrow_type(e.dtype)
+    type_blob = pa.schema([pa.field("r", out_type)]).serialize() \
+        .to_pybytes()
+    # pickle the UDF by value: a by-reference pickle would make workers
+    # import the user's module (and transitively this package, whose
+    # import initializes the JAX backend)
+    import inspect
+
+    mod = inspect.getmodule(e.fn)
+    registered = False
+    if mod is not None and getattr(mod, "__name__", "__main__") not in (
+            "builtins",):
+        try:
+            cloudpickle.register_pickle_by_value(mod)
+            registered = True
+        except Exception:
+            pass
+    try:
+        fn_bytes = cloudpickle.dumps(e.fn)
+    finally:
+        if registered:
+            cloudpickle.unregister_pickle_by_value(mod)
+    pool = get_worker_pool(num_workers)
+    futures = []
+    for off in range(0, max(work.num_rows, 1), chunk_rows):
+        piece = work.slice(off, min(chunk_rows, work.num_rows - off))
+        if piece.num_rows == 0 and work.num_rows > 0:
+            break
+        futures.append(pool.submit(_worker_apply, fn_bytes,
+                                   _ipc_bytes(piece), type_blob))
+    chunks = [_ipc_table(f.result()).column("r") for f in futures]
+    if not chunks:
+        return pa.chunked_array([pa.array([], type=out_type)])
+    return pa.chunked_array(
+        [c for ch in chunks for c in ch.chunks])

@@ -1,0 +1,42 @@
+"""Worker-process entry for the pandas-UDF Arrow exchange
+(spark_rapids_tpu/udf/pandas_udf.py).
+
+Deliberately a TOP-LEVEL module with only pyarrow/cloudpickle imports:
+worker processes unpickle functions by module reference, and importing
+the spark_rapids_tpu package would initialize the JAX backend inside
+every worker (slow on TPU machines, and fatal when the device tunnel is
+unavailable). The reference keeps its Python workers equally minimal
+(python/rapids/worker.py) for the same reason.
+"""
+
+from __future__ import annotations
+
+import pyarrow as pa
+
+
+def ipc_bytes(table: pa.Table) -> bytes:
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    return sink.getvalue().to_pybytes()
+
+
+def ipc_table(data: bytes) -> pa.Table:
+    with pa.ipc.open_stream(pa.py_buffer(data)) as r:
+        return r.read_all()
+
+
+def worker_apply(fn_bytes: bytes, payload: bytes,
+                 schema_blob: bytes) -> bytes:
+    """Arrow in, pandas apply, Arrow out."""
+    import cloudpickle
+
+    fn = cloudpickle.loads(fn_bytes)
+    table = ipc_table(payload)
+    series = [table.column(i).to_pandas()
+              for i in range(table.num_columns)]
+    result = fn(*series)
+    out_type = pa.ipc.read_schema(
+        pa.py_buffer(schema_blob)).field(0).type
+    arr = pa.Array.from_pandas(result, type=out_type)
+    return ipc_bytes(pa.table({"r": arr}))

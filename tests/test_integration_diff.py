@@ -178,14 +178,29 @@ def test_hash_expression_matches(sales_path):
         conf=_CONF)
 
 
-def test_fallback_string_cast(sales_path):
-    """Cast(string -> int) is CPU-only in v1: assert fallback placement
-    and result parity (assert_gpu_fallback_collect analog)."""
+def test_string_cast_on_device(sales_path):
+    """Cast(string -> int) runs on device (ops/stringcast.py); result
+    parity with the oracle."""
     from spark_rapids_tpu.sqltypes.datatypes import integer
 
-    assert_tpu_fallback_collect(
-        lambda s: s.createDataFrame({"x": ["1", "22", "333"]})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame({"x": ["1", "22", "333", "nope"]})
         .select(F.col("x").cast(integer).alias("i")),
+        conf=_CONF)
+
+
+def test_fallback_timestamp_to_string_cast(sales_path):
+    """Cast(timestamp -> string) stays CPU-only: assert fallback
+    placement and result parity (assert_gpu_fallback_collect analog)."""
+    import datetime
+
+    from spark_rapids_tpu.sqltypes.datatypes import string as string_t
+
+    assert_tpu_fallback_collect(
+        lambda s: s.createDataFrame({"t": [
+            datetime.datetime(2020, 1, 1, 12, 0, 0),
+            datetime.datetime(2021, 6, 15, 23, 59, 59)]})
+        .select(F.col("t").cast(string_t).alias("s")),
         fallback_class="CpuProjectExec",
         conf=_CONF)
 

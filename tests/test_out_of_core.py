@@ -208,3 +208,59 @@ def test_merge_sorted_kernel_direct():
     want = device_to_arrow(expect).column("k").to_pylist()
     assert got == want
     assert got == sorted(list(a_vals) + list(b_vals))
+
+
+def _nlj_query(s, how="inner"):
+    import pyarrow as pa
+
+    left = s.createDataFrame(pa.table({
+        "a": list(range(400)),
+        "x": [float(i % 7) for i in range(400)],
+    }))
+    right = s.createDataFrame(pa.table({
+        "b": list(range(0, 800, 2)),
+        "y": [float(i % 5) for i in range(400)],
+    }))
+    return left.join(right, on=F.col("a") < F.col("b"), how=how)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "full"])
+def test_nested_loop_join_split_injection(how):
+    """Injected TpuSplitAndRetryOOM at the nested-loop pair-expansion
+    reservation: the probe side is halved (possibly repeatedly) and the
+    chunked join still matches the oracle — including full-outer
+    build-side padding accumulated across chunks."""
+    conf = {"spark.rapids.memory.gpu.oomInjection.mode": "split_once",
+            "spark.rapids.memory.gpu.oomInjection.filter": "nlj_pairs"}
+
+    def run(s):
+        from spark_rapids_tpu.runtime.memory import get_catalog
+
+        out = _nlj_query(s, how).collect_arrow()
+        return out, dict(get_catalog().metrics)
+
+    tpu, metrics = with_tpu_session(run, conf=conf)
+    assert metrics["retry_oom_injected"] >= 1, metrics
+    cpu = with_cpu_session(
+        lambda s: _nlj_query(s, how).collect_arrow())
+    from spark_rapids_tpu.testing.asserts import assert_tables_equal
+
+    assert_tables_equal(tpu, cpu)
+
+
+def test_nested_loop_join_reserves_pair_bytes():
+    """The pair-expansion reservation must be visible to the ledger: peak
+    reserved bytes during a cross join >= the expanded pair-set size."""
+
+    def run(s):
+        from spark_rapids_tpu.runtime.memory import get_catalog
+
+        left = s.createDataFrame({"a": list(range(512))})
+        right = s.createDataFrame({"b": list(range(512))})
+        out = left.crossJoin(right).count()
+        return out, get_catalog().pool.peak
+
+    n, peak = with_tpu_session(run, conf={})
+    assert n == 512 * 512
+    # 512*512 pairs x 2 int64 columns = 4 MiB minimum
+    assert peak >= 512 * 512 * 16, peak

@@ -1,0 +1,98 @@
+"""Pandas UDF Arrow worker-process exchange tests
+(GpuArrowEvalPythonExec role)."""
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.testing.asserts import with_tpu_session
+
+_CONF = {"spark.sql.shuffle.partitions": 2}
+
+
+def _df(s, n=2000, seed=5):
+    rng = np.random.default_rng(seed)
+    return s.createDataFrame(pa.table({
+        "a": pa.array(rng.integers(0, 100, n), type=pa.int64()),
+        "b": pa.array(rng.random(n) * 10, type=pa.float64()),
+    }))
+
+
+def test_pandas_udf_scalar():
+    @F.pandas_udf(returnType="double")
+    def plus_one(s):
+        return s + 1.0
+
+    def run(spark):
+        df = _df(spark)
+        return df.select(plus_one(df["b"]).alias("x")).collect_arrow()
+
+    out = with_tpu_session(run, _CONF)
+    want = with_tpu_session(
+        lambda s: _df(s).select((F.col("b") + 1.0).alias("x"))
+        .collect_arrow(), _CONF)
+    got = np.asarray(out.column("x"))
+    exp = np.asarray(want.column("x"))
+    assert np.allclose(got, exp)
+
+
+def test_pandas_udf_two_args_and_chunking():
+    @F.pandas_udf(returnType="double")
+    def mix(a, b):
+        return a * 0.5 + b
+
+    def run(spark):
+        df = _df(spark, n=5000)
+        return df.select(mix(df["a"], df["b"]).alias("x")) \
+            .collect_arrow()
+
+    out = with_tpu_session(run, _CONF)
+    assert out.num_rows == 5000
+    # spot check
+    back = with_tpu_session(
+        lambda s: _df(s, n=5000).select(
+            (F.col("a") * 0.5 + F.col("b")).alias("x")).collect_arrow(),
+        _CONF)
+    assert np.allclose(np.asarray(out.column("x")),
+                       np.asarray(back.column("x")))
+
+
+def test_pandas_udf_plans_host_exchange():
+    """The planner routes pandas-UDF projections to the host path with
+    the exchange reason."""
+
+    @F.pandas_udf(returnType="long")
+    def f(a):
+        return a * 2
+
+    def run(spark):
+        df = _df(spark, n=100)
+        df2 = df.select(f(df["a"]).alias("x"))
+        phys, meta = df2._physical()
+        return type(phys).__name__, meta.explain(only_not_on_device=True)
+
+    name, explain = with_tpu_session(run, _CONF)
+    assert name == "CpuProjectExec", name
+    assert "Arrow worker-process exchange" in explain
+
+
+def test_pandas_udf_runs_in_worker_process():
+    import os
+
+    parent = os.getpid()
+
+    @F.pandas_udf(returnType="long")
+    def pid_probe(a):
+        import os as _os
+
+        import pandas as pd
+
+        return pd.Series([_os.getpid()] * len(a))
+
+    def run(spark):
+        df = _df(spark, n=10)
+        return df.select(pid_probe(df["a"]).alias("p")).collect_arrow()
+
+    out = with_tpu_session(run, _CONF)
+    pids = set(out.column("p").to_pylist())
+    assert pids and parent not in pids, (parent, pids)

@@ -1,0 +1,163 @@
+"""Device string-cast tests (GpuCast.scala:1-120 edge-case list):
+leading/trailing whitespace, signs, overflow, inf/nan, malformed input —
+device parse vs the host oracle, plus ANSI raise behavior."""
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.exec import operators as ops
+from spark_rapids_tpu.testing.asserts import (
+    assert_tpu_and_cpu_are_equal_collect,
+    with_tpu_session,
+)
+
+_CONF = {"spark.sql.shuffle.partitions": 2}
+
+_INT_STRINGS = [
+    "0", "1", "-1", "+42", "  17  ", "\t-8\n", "2147483647",
+    "2147483648", "-2147483648", "-2147483649",
+    "9223372036854775807", "-9223372036854775808",
+    "9223372036854775808", "-9223372036854775809",
+    "", "  ", "abc", "1.5", "1e3", "--5", "+-5", "5-", "00012",
+    "999999999999999999999999", None, "+", "-",
+]
+
+_FLOAT_STRINGS = [
+    "0", "1.5", "-2.25", "+3.", ".5", "-.5", "1e3", "1E-3", "2.5e+2",
+    "  7.25 ", "Infinity", "-Infinity", "+Infinity", "inf", "-inf",
+    "NaN", "nan", "1e999", "1e-999", "", "abc", "1.2.3", "1e", "e5",
+    "1.5e2.5", None, "00.50", "9007199254740993",
+]
+
+_DATE_STRINGS = [
+    "2020-01-01", "2020-1-1", "2020-12-31", "2020-02-29", "2021-02-29",
+    "1999-13-01", "1999-00-10", "2020-06-31", "2020", "2020-06",
+    "2020-06-15T12:00:00", "2020-06-15 anything", "  2020-06-15  ",
+    "0001-01-01", "20-1-1", "abc", "", None, "2020-6-15-3",
+]
+
+_TS_STRINGS = [
+    "2020-01-01 00:00:00", "2020-01-01T23:59:59", "2020-01-01 12:30",
+    "2020-01-01 1:2:3", "2020-01-01 12:30:45.5",
+    "2020-01-01 12:30:45.123456", "2020-01-01", "2020-02-29 10:00:00",
+    "2021-02-29 10:00:00", "2020-01-01 24:00:00", "2020-01-01 12:61:00",
+    "abc", "", None, "  2020-01-01 06:07:08  ",
+]
+
+_BOOL_STRINGS = ["true", "TRUE", " t ", "yes", "Y", "1", "false", "F",
+                 "no", "N", "0", "tr", "2", "", None]
+
+_DEC_STRINGS = ["0", "1.23", "-4.567", "  12.5  ", "1e2", "0.005",
+                "123456789.12", "99999999999", "abc", "", None, "-0.004"]
+
+
+def _cast_query(values, to_type):
+    def q(s):
+        df = s.createDataFrame(pa.table({"s": pa.array(values,
+                                                       type=pa.string())}))
+        return df.select(F.col("s").cast(to_type).alias("v"))
+
+    return q
+
+
+@pytest.mark.parametrize("to_type,vals", [
+    ("int", _INT_STRINGS),
+    ("long", _INT_STRINGS),
+    ("short", _INT_STRINGS),
+    ("double", _FLOAT_STRINGS),
+    ("float", _FLOAT_STRINGS),
+    ("boolean", _BOOL_STRINGS),
+    ("date", _DATE_STRINGS),
+    ("timestamp", _TS_STRINGS),
+])
+def test_string_cast_matches_oracle(to_type, vals):
+    assert_tpu_and_cpu_are_equal_collect(
+        _cast_query(vals, to_type), conf=_CONF, ignore_order=False)
+
+
+def test_string_cast_decimal_matches_oracle():
+    from spark_rapids_tpu.sqltypes import DecimalType
+
+    assert_tpu_and_cpu_are_equal_collect(
+        _cast_query(_DEC_STRINGS, DecimalType(12, 3)), conf=_CONF,
+        ignore_order=False)
+
+
+def test_string_cast_runs_on_device():
+    """The planner no longer tags string casts for CPU fallback."""
+
+    def run(spark):
+        df = spark.createDataFrame(
+            pa.table({"s": pa.array(["1", "2"], type=pa.string())}))
+        df = df.select(F.col("s").cast("long").alias("v"))
+        phys, meta = df._physical()
+        return phys, meta
+
+    phys, meta = with_tpu_session(run, _CONF)
+
+    def walk(p):
+        yield p
+        for c in p.children:
+            yield from walk(c)
+
+    names = [type(p).__name__ for p in walk(phys)]
+    assert "TpuProjectExec" in names, names
+    assert "CpuProjectExec" not in names, names
+
+
+# ------------------------------------------------------------- ANSI mode
+
+def test_ansi_invalid_string_cast_raises():
+    from spark_rapids_tpu.exec.cpu_eval import CastError
+
+    conf = {**_CONF, "spark.sql.ansi.enabled": True}
+    with pytest.raises(CastError, match="CAST_INVALID_INPUT"):
+        with_tpu_session(
+            lambda s: _cast_query(["1", "abc"], "long")(s)
+            .collect_arrow(), conf)
+
+
+def test_ansi_overflow_raises():
+    from spark_rapids_tpu.exec.cpu_eval import CastError
+
+    conf = {**_CONF, "spark.sql.ansi.enabled": True}
+
+    def q(s):
+        df = s.createDataFrame(pa.table({
+            "v": pa.array([1.0, 3.0e10], type=pa.float64())}))
+        return df.select(F.col("v").cast("int").alias("i"))
+
+    with pytest.raises(CastError, match="CAST_OVERFLOW"):
+        with_tpu_session(lambda s: q(s).collect_arrow(), conf)
+
+
+def test_ansi_valid_cast_still_works():
+    conf = {**_CONF, "spark.sql.ansi.enabled": True}
+    out = with_tpu_session(
+        lambda s: _cast_query(["1", " 2 ", "-3"], "long")(s)
+        .collect_arrow(), conf)
+    assert out.column("v").to_pylist() == [1, 2, -3]
+
+
+def test_ansi_failable_cast_falls_back_to_cpu():
+    """ANSI mode places failable casts on the CPU path (errors must
+    raise eagerly; device ANSI kernels are future work)."""
+
+    def run(spark):
+        df = spark.createDataFrame(
+            pa.table({"s": pa.array(["1"], type=pa.string())}))
+        df = df.select(F.col("s").cast("long").alias("v"))
+        phys, _ = df._physical()
+        return phys
+
+    conf = {**_CONF, "spark.sql.ansi.enabled": True}
+    phys = with_tpu_session(run, conf)
+
+    def walk(p):
+        yield p
+        for c in p.children:
+            yield from walk(c)
+
+    names = [type(p).__name__ for p in walk(phys)]
+    assert "CpuProjectExec" in names, names
