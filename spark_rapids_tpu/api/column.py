@@ -40,6 +40,11 @@ class Column:
             else self.expr
         return Column(Alias(base, name), name)
 
+    def getItem(self, index) -> "Column":
+        from spark_rapids_tpu.expr.collections import GetArrayItem
+
+        return Column(GetArrayItem(self.expr, _expr(index)), "getItem")
+
     def cast(self, to) -> "Column":
         if isinstance(to, str):
             from spark_rapids_tpu.sqltypes.datatypes import parse_type_name

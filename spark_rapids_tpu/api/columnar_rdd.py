@@ -1,0 +1,50 @@
+"""Zero-copy ML handoff — the ColumnarRdd analog (reference
+ColumnarRdd.scala:42-51, InternalColumnarRddConverter.scala: exports the
+device-resident cuDF tables of a query to XGBoost-style consumers
+without a host round trip).
+
+Here the device currency is the ColumnBatch pytree of jax arrays, which
+IS the native input format for JAX/flax ML code — so the handoff is the
+identity: execute the plan and hand out the device batches (or a single
+stacked dict of jnp arrays for a whole partition set)."""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Tuple
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import ColumnBatch, concat_batches
+
+
+class ColumnarRdd:
+    @staticmethod
+    def convert(df) -> Iterator[ColumnBatch]:
+        """Execute the plan, yielding DEVICE ColumnBatches per partition
+        (no host conversion for device-resident operators)."""
+        from spark_rapids_tpu.columnar.arrow_bridge import arrow_to_device
+        from spark_rapids_tpu.exec.base import new_task_context
+
+        phys, _ = df._physical()
+        for pid in range(phys.num_partitions):
+            ctx = new_task_context(df.session.rapids_conf)
+            for payload in phys.execute_partition(pid, ctx):
+                if isinstance(payload, ColumnBatch):
+                    yield payload
+                else:
+                    yield arrow_to_device(payload)
+
+    @staticmethod
+    def to_jax(df) -> Dict[str, Tuple[jnp.ndarray, jnp.ndarray]]:
+        """Whole-result handoff: {column -> (values[:n], validity[:n])}
+        of device arrays, ready for jnp/flax consumption."""
+        batches = list(ColumnarRdd.convert(df))
+        if not batches:
+            raise ValueError("empty result")
+        merged = concat_batches(batches) if len(batches) > 1 else \
+            batches[0]
+        n = merged.row_count()
+        out = {}
+        for f, c in zip(merged.schema.fields, merged.columns):
+            out[f.name] = (c.data[:n], c.validity[:n])
+        return out

@@ -105,11 +105,38 @@ class DataFrame:
 
     def _finish_project(self, exprs: List[Alias]) -> "DataFrame":
         """Emit Project, extracting window expressions into Window nodes
-        first (Spark's ExtractWindowExpressions rule)."""
+        and generators into Generate nodes first (Spark's
+        ExtractWindowExpressions / ExtractGenerator rules)."""
+        from spark_rapids_tpu.expr.generators import (
+            Explode,
+            PosExplode,
+            contains_generator,
+        )
         from spark_rapids_tpu.expr.windows import (
             WindowExpression,
             contains_window,
         )
+
+        if any(contains_generator(e) for e in exprs):
+            if any(contains_window(e) for e in exprs):
+                raise ValueError(
+                    "explode combined with window expressions in one "
+                    "select is not supported; materialize the window "
+                    "column with a prior select first")
+            gens = [e for e in exprs
+                    if isinstance(e.children[0], Explode)]
+            others = [e for e in exprs
+                      if not isinstance(e.children[0], Explode)]
+            if len(gens) != 1 or any(contains_generator(e)
+                                     for e in others):
+                raise ValueError(
+                    "exactly one top-level explode/posexplode per "
+                    "select (Spark's one-generator rule)")
+            gen = gens[0]
+            plan = L.Generate(others, gen, self._plan,
+                              position=isinstance(gen.children[0],
+                                                  PosExplode))
+            return DataFrame(plan, self.session)
 
         if not any(contains_window(e) for e in exprs):
             return DataFrame(L.Project(exprs, self._plan), self.session)
@@ -431,6 +458,80 @@ class DataFrame:
 
     def write_parquet(self, path: str):
         self.session.write_parquet(self, path)
+
+    @property
+    def write(self) -> "DataFrameWriter":
+        return DataFrameWriter(self)
+
+
+class DataFrameWriter:
+    """df.write.format(...).mode(...).partitionBy(...).save(path) — the
+    columnar write path (ColumnarOutputWriter / GpuFileFormatDataWriter
+    roles, io/writers.py) plus the Delta Lake commit protocol
+    (lakehouse/delta.py)."""
+
+    def __init__(self, df: DataFrame):
+        self._df = df
+        self._format = "parquet"
+        self._mode = "error"
+        self._partition_by: List[str] = []
+        self._options: dict = {}
+
+    def format(self, fmt: str) -> "DataFrameWriter":
+        self._format = fmt
+        return self
+
+    def mode(self, m: str) -> "DataFrameWriter":
+        self._mode = {"errorifexists": "error"}.get(m, m)
+        return self
+
+    def option(self, k, v) -> "DataFrameWriter":
+        self._options[k] = v
+        return self
+
+    def partitionBy(self, *cols) -> "DataFrameWriter":
+        self._partition_by = list(cols)
+        return self
+
+    def save(self, path: str):
+        if self._format == "delta":
+            from spark_rapids_tpu.lakehouse.delta import write_delta
+
+            write_delta(self._df, path, mode=self._mode,
+                        partition_by=self._partition_by)
+            return
+        from spark_rapids_tpu.io.writers import (
+            WriteStats,
+            prepare_dir,
+            write_task,
+        )
+
+        if not prepare_dir(path, self._mode):
+            return
+        table = self._df.collect_arrow()
+        stats = WriteStats()
+        write_task(self._format, table, path, 0,
+                   self._partition_by or None, stats,
+                   options=self._options)
+        return stats
+
+    def parquet(self, path: str):
+        return self.format("parquet").save(path)
+
+    def orc(self, path: str):
+        return self.format("orc").save(path)
+
+    def csv(self, path: str):
+        return self.format("csv").save(path)
+
+    def json(self, path: str):
+        return self.format("json").save(path)
+
+    def avro(self, path: str):
+        return self.format("avro").save(path)
+
+    def delta(self, path: str):
+        return self.format("delta").save(path)
 
 
 class Row(dict):

@@ -452,3 +452,125 @@ def pandas_udf(f=None, returnType=None):
     if f is not None:
         return wrap(f)
     return wrap
+
+
+# --- collection functions (collectionOperations.scala analog) ---
+
+def size(c) -> Column:
+    from spark_rapids_tpu.expr.collections import Size
+
+    return Column(Size(expr_of(c)), "size")
+
+
+def array_contains(c, value) -> Column:
+    from spark_rapids_tpu.expr.collections import ArrayContains
+
+    return Column(ArrayContains(expr_of(c), expr_of(value)),
+                  "array_contains")
+
+
+def element_at(c, index) -> Column:
+    from spark_rapids_tpu.expr.collections import ElementAt
+
+    return Column(ElementAt(expr_of(c), expr_of(index)), "element_at")
+
+
+def array(*cols) -> Column:
+    from spark_rapids_tpu.expr.collections import CreateArray
+
+    return Column(CreateArray(*[expr_of(c) for c in cols]), "array")
+
+
+def get_item(c, index) -> Column:
+    from spark_rapids_tpu.expr.collections import GetArrayItem
+
+    return Column(GetArrayItem(expr_of(c), expr_of(index)), "getItem")
+
+
+def explode(c) -> Column:
+    from spark_rapids_tpu.expr.generators import Explode
+
+    return Column(Explode(expr_of(c)), "col")
+
+
+def posexplode(c) -> Column:
+    from spark_rapids_tpu.expr.generators import PosExplode
+
+    return Column(PosExplode(expr_of(c)), "col")
+
+
+def device_udf(f=None, returnType=None):
+    """Columnar device UDF (the RapidsUDF analog, expr/deviceudf.py):
+    the function receives jnp value/validity arrays and is traced into
+    the enclosing XLA program.
+
+        @F.device_udf(returnType=double)
+        def scaled(v, v_valid):
+            return v * 2.0 + 1.0, v_valid
+    """
+    from spark_rapids_tpu.sqltypes.datatypes import double as _dbl
+
+    rtype = returnType if returnType is not None else _dbl
+    if isinstance(rtype, str):
+        from spark_rapids_tpu.sqltypes.datatypes import parse_type_name
+
+        rtype = parse_type_name(rtype)
+
+    def wrap(fn):
+        def apply(*cols) -> Column:
+            from spark_rapids_tpu.expr.deviceudf import DeviceUDF
+
+            return Column(DeviceUDF(fn, rtype,
+                                    [expr_of(c) for c in cols]),
+                          getattr(fn, "__name__", "device_udf"))
+
+        apply.fn = fn
+        return apply
+
+    if f is not None:
+        return wrap(f)
+    return wrap
+
+
+def transform(c, fn) -> Column:
+    """transform(arr, x -> f(x)): fn takes and returns a Column; the
+    lambda runs ON DEVICE, fused into the projection
+    (higherOrderFunctions.scala analog). The lambda tree is built once
+    the array column resolves to a concrete type."""
+    from spark_rapids_tpu.expr.collections import ArrayTransform
+
+    return Column(ArrayTransform(expr_of(c), fn=fn), "transform")
+
+
+def filter_array(c, fn) -> Column:
+    """filter(arr, x -> pred(x)) on device."""
+    from spark_rapids_tpu.expr.collections import ArrayFilter
+
+    return Column(ArrayFilter(expr_of(c), fn=fn), "filter")
+
+
+def array_max(c) -> Column:
+    from spark_rapids_tpu.expr.collections import ArrayMax
+
+    return Column(ArrayMax(expr_of(c)), "array_max")
+
+
+def array_min(c) -> Column:
+    from spark_rapids_tpu.expr.collections import ArrayMin
+
+    return Column(ArrayMin(expr_of(c)), "array_min")
+
+
+def sort_array(c, asc: bool = True) -> Column:
+    from spark_rapids_tpu.expr.collections import SortArray
+
+    return Column(SortArray(expr_of(c), asc), "sort_array")
+
+
+def get_json_object(c, path) -> Column:
+    """get_json_object(json_str, '$.a.b[0]') — host-evaluated in v1
+    (GpuGetJsonObject + JSONUtils JNI in the reference; the planner
+    tags the operator for CPU fallback)."""
+    from spark_rapids_tpu.expr.jsonexpr import GetJsonObject
+
+    return Column(GetJsonObject(expr_of(c), path), "get_json_object")

@@ -41,6 +41,7 @@ class DataFrameReader:
         self.session = session
         self._options: Dict[str, object] = {}
         self._schema = None
+        self._format = "parquet"
 
     def option(self, k, v):
         self._options[k] = v
@@ -49,6 +50,20 @@ class DataFrameReader:
     def schema(self, s):
         self._schema = s
         return self
+
+    def format(self, fmt: str):
+        self._format = fmt
+        return self
+
+    def load(self, path: str):
+        if self._format == "delta":
+            return self.delta(path)
+        return getattr(self, self._format)(path)
+
+    def delta(self, path: str):
+        from spark_rapids_tpu.lakehouse.delta import read_delta
+
+        return read_delta(self.session, path)
 
     def parquet(self, *paths: str):
         from spark_rapids_tpu.api.dataframe import DataFrame
@@ -202,6 +217,18 @@ class TpuSparkSession:
         from spark_rapids_tpu.io.readers import write_parquet
 
         write_parquet(df.collect_arrow(), path)
+
+    # --- profiling (NvtxWithMetrics / nvtx_profiling.md analog) ---
+
+    def startProfiler(self, log_dir: str):
+        from spark_rapids_tpu.runtime import profiler
+
+        profiler.start_trace(log_dir)
+
+    def stopProfiler(self):
+        from spark_rapids_tpu.runtime import profiler
+
+        profiler.stop_trace()
 
     def stop(self):
         global _active

@@ -29,6 +29,7 @@ from spark_rapids_tpu.columnar.batch import (
     next_capacity,
 )
 from spark_rapids_tpu.sqltypes import (
+    ArrayType,
     DataType,
     DecimalType,
     StringType,
@@ -97,6 +98,59 @@ def _matrix_to_string(data: np.ndarray, lengths: np.ndarray,
     return arr
 
 
+def _list_to_matrix(arr: pa.Array, elem_dtype: DataType):
+    """Arrow list<primitive> -> ([n, max_elems] element matrix,
+    lengths int32, elem_validity [n, max_elems]) vectorized."""
+    arr = arr.cast(pa.large_list(arr.type.value_type)) \
+        if pa.types.is_list(arr.type) else arr
+    offsets = np.asarray(arr.offsets).astype(np.int64)
+    values = arr.values  # flat child array
+    lengths = (offsets[1:] - offsets[:-1]).astype(np.int32)
+    n = len(arr)
+    max_len = int(lengths.max()) if len(lengths) else 0
+    me = _round_up_pow2(max(max_len, 1), minimum=4)
+    flat_vals, flat_valid = _primitive_np(values, elem_dtype)
+    if len(flat_vals) == 0:
+        flat_vals = np.zeros(1, dtype=elem_dtype.np_dtype)
+        flat_valid = np.zeros(1, dtype=np.bool_)
+    idx = offsets[:-1, None] + np.arange(me, dtype=np.int64)[None, :]
+    in_row = np.arange(me, dtype=np.int32)[None, :] < lengths[:, None]
+    safe = np.clip(idx, 0, len(flat_vals) - 1)
+    mat = np.where(in_row, flat_vals[safe], 0).astype(elem_dtype.np_dtype)
+    ev = np.where(in_row, flat_valid[safe], False)
+    return mat, lengths, ev
+
+
+def _matrix_to_list(data: np.ndarray, lengths: np.ndarray,
+                    validity: np.ndarray, ev: np.ndarray,
+                    elem_dtype: DataType) -> pa.Array:
+    """Device array layout -> Arrow list<primitive>."""
+    n = len(lengths)
+    at = to_arrow_type(elem_dtype)
+    if n == 0:
+        return pa.array([], type=pa.list_(at))
+    me = data.shape[1]
+    lengths = np.minimum(lengths.astype(np.int64), me)
+    in_row = np.arange(me)[None, :] < lengths[:, None]
+    flat = data[in_row]
+    flat_valid = ev[in_row]
+    offsets = np.zeros(n + 1, dtype=np.int32)
+    np.cumsum(lengths, out=offsets[1:])
+    if isinstance(elem_dtype, DecimalType):
+        import decimal as _dec
+
+        s = elem_dtype.scale
+        child = pa.array(
+            [_dec.Decimal(int(v)).scaleb(-s) if ok else None
+             for v, ok in zip(flat, flat_valid)], type=at)
+    else:
+        child = pa.array(flat, type=at,
+                         mask=None if flat_valid.all() else ~flat_valid)
+    mask = None if validity.all() else pa.array(~validity)
+    return pa.ListArray.from_arrays(pa.array(offsets, type=pa.int32()),
+                                    child, mask=mask)
+
+
 def _primitive_np(arr: pa.Array, dtype: DataType):
     """Arrow primitive array -> (np values with nulls zero-filled, validity)."""
     validity = np.asarray(arr.is_valid())
@@ -149,6 +203,12 @@ def arrow_to_device(table, capacity: Optional[int] = None,
             validity = np.asarray(arr.is_valid())
             cols.append(make_column(field.dataType, mat, validity, cap,
                                     lengths=lengths))
+        elif isinstance(field.dataType, ArrayType):
+            mat, lengths, ev = _list_to_matrix(
+                arr, field.dataType.elementType)
+            validity = np.asarray(arr.is_valid())
+            cols.append(make_column(field.dataType, mat, validity, cap,
+                                    lengths=lengths, elem_validity=ev))
         else:
             vals, validity = _primitive_np(arr, field.dataType)
             cols.append(make_column(field.dataType, vals, validity, cap))
@@ -168,6 +228,12 @@ def device_to_arrow(batch: ColumnBatch) -> pa.Table:
             arrays.append(_matrix_to_string(
                 np.asarray(col.data[:n]), np.asarray(col.lengths[:n]),
                 validity))
+            continue
+        if isinstance(field.dataType, ArrayType):
+            arrays.append(_matrix_to_list(
+                np.asarray(col.data[:n]), np.asarray(col.lengths[:n]),
+                validity, np.asarray(col.elem_validity[:n]),
+                field.dataType.elementType))
             continue
         vals = np.asarray(col.data[:n])
         at = to_arrow_type(field.dataType)

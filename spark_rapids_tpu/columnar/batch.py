@@ -54,24 +54,34 @@ def row_mask(capacity: int, num_rows) -> jnp.ndarray:
 
 
 class DeviceColumn:
-    """One device column: data (+ lengths for strings) + validity.
+    """One device column: data (+ lengths for strings/arrays) + validity.
 
-    data:     [cap] of dtype.np_dtype, or [cap, max_bytes] uint8 for strings
-    lengths:  [cap] int32 (strings only)
-    validity: [cap] bool, True = valid (non-null)
+    data:     [cap] of dtype.np_dtype; [cap, max_bytes] uint8 for strings;
+              [cap, max_elems] of element np_dtype for arrays
+    lengths:  [cap] int32 (strings: byte count; arrays: element count)
+    validity: [cap] bool, True = valid (non-null row)
+    elem_validity: [cap, max_elems] bool (arrays only): per-element nulls
     """
 
-    __slots__ = ("dtype", "data", "validity", "lengths")
+    __slots__ = ("dtype", "data", "validity", "lengths", "elem_validity")
 
-    def __init__(self, dtype: DataType, data, validity, lengths=None):
+    def __init__(self, dtype: DataType, data, validity, lengths=None,
+                 elem_validity=None):
         self.dtype = dtype
         self.data = data
         self.validity = validity
         self.lengths = lengths
+        self.elem_validity = elem_validity
 
     @property
     def is_string(self) -> bool:
         return isinstance(self.dtype, StringType)
+
+    @property
+    def is_array(self) -> bool:
+        from spark_rapids_tpu.sqltypes import ArrayType
+
+        return isinstance(self.dtype, ArrayType)
 
     @property
     def capacity(self) -> int:
@@ -81,15 +91,22 @@ class DeviceColumn:
     def max_bytes(self) -> Optional[int]:
         return int(self.data.shape[1]) if self.is_string else None
 
+    @property
+    def max_elems(self) -> Optional[int]:
+        return int(self.data.shape[1]) if self.is_array else None
+
     def device_size_bytes(self) -> int:
         n = self.data.size * self.data.dtype.itemsize
         n += self.validity.size  # bool = 1 byte
         if self.lengths is not None:
             n += self.lengths.size * 4
+        if self.elem_validity is not None:
+            n += self.elem_validity.size
         return n
 
     def with_validity(self, validity) -> "DeviceColumn":
-        return DeviceColumn(self.dtype, self.data, validity, self.lengths)
+        return DeviceColumn(self.dtype, self.data, validity, self.lengths,
+                            self.elem_validity)
 
     def gather(self, indices) -> "DeviceColumn":
         """Row gather; indices must be in [0, capacity)."""
@@ -99,21 +116,28 @@ class DeviceColumn:
             jnp.take(self.validity, indices, axis=0),
             None if self.lengths is None else jnp.take(self.lengths, indices,
                                                        axis=0),
+            None if self.elem_validity is None else jnp.take(
+                self.elem_validity, indices, axis=0),
         )
 
     def _tree_flatten(self):
-        if self.lengths is None:
-            return (self.data, self.validity), (self.dtype, False)
-        return (self.data, self.validity, self.lengths), (self.dtype, True)
+        leaves = [self.data, self.validity]
+        if self.lengths is not None:
+            leaves.append(self.lengths)
+        if self.elem_validity is not None:
+            leaves.append(self.elem_validity)
+        return tuple(leaves), (self.dtype, self.lengths is not None,
+                               self.elem_validity is not None)
 
     @classmethod
     def _tree_unflatten(cls, aux, children):
-        dtype, has_len = aux
-        if has_len:
-            data, validity, lengths = children
-            return cls(dtype, data, validity, lengths)
-        data, validity = children
-        return cls(dtype, data, validity, None)
+        dtype, has_len, has_ev = aux
+        it = iter(children)
+        data = next(it)
+        validity = next(it)
+        lengths = next(it) if has_len else None
+        ev = next(it) if has_ev else None
+        return cls(dtype, data, validity, lengths, ev)
 
 
 jax.tree_util.register_pytree_node(
@@ -202,12 +226,17 @@ jax.tree_util.register_pytree_node(
 
 def make_column(dtype: DataType, values: np.ndarray,
                 validity: Optional[np.ndarray], capacity: int,
-                lengths: Optional[np.ndarray] = None) -> DeviceColumn:
+                lengths: Optional[np.ndarray] = None,
+                elem_validity: Optional[np.ndarray] = None) -> DeviceColumn:
     """Build a device column from host numpy data, padding to capacity.
 
     For strings, `values` is a [n, max_bytes] uint8 matrix and `lengths`
-    the per-row byte counts.
+    the per-row byte counts. For arrays, `values` is [n, max_elems] of
+    the element dtype, `lengths` the element counts, and `elem_validity`
+    the per-element null mask.
     """
+    from spark_rapids_tpu.sqltypes import ArrayType
+
     n = len(values)
     if validity is None:
         validity = np.ones(n, dtype=np.bool_)
@@ -222,6 +251,19 @@ def make_column(dtype: DataType, values: np.ndarray,
             lpad[:n] = lengths
         return DeviceColumn(dtype, jnp.asarray(data), jnp.asarray(vpad),
                             jnp.asarray(lpad))
+    if isinstance(dtype, ArrayType):
+        assert values.ndim == 2
+        data = np.zeros((capacity, values.shape[1]),
+                        dtype=dtype.elementType.np_dtype)
+        data[:n, :] = values
+        lpad = np.zeros(capacity, dtype=np.int32)
+        if lengths is not None:
+            lpad[:n] = lengths
+        ev = np.zeros((capacity, values.shape[1]), dtype=np.bool_)
+        if elem_validity is not None:
+            ev[:n, :] = elem_validity
+        return DeviceColumn(dtype, jnp.asarray(data), jnp.asarray(vpad),
+                            jnp.asarray(lpad), jnp.asarray(ev))
     data = np.zeros(capacity, dtype=dtype.np_dtype)
     data[:n] = values
     return DeviceColumn(dtype, jnp.asarray(data), jnp.asarray(vpad))
@@ -256,7 +298,7 @@ def concat_batches(batches: List[ColumnBatch]) -> ColumnBatch:
     cap = next_capacity(total)
     cols: List[DeviceColumn] = []
     for ci, field in enumerate(schema.fields):
-        parts_data, parts_val, parts_len = [], [], []
+        parts_data, parts_val, parts_len, parts_ev = [], [], [], []
         for b in batches:
             n = b.row_count()
             c = b.columns[ci]
@@ -264,10 +306,15 @@ def concat_batches(batches: List[ColumnBatch]) -> ColumnBatch:
             parts_val.append(c.validity[:n])
             if c.lengths is not None:
                 parts_len.append(c.lengths[:n])
-        if isinstance(field.dataType, StringType):
+            if c.elem_validity is not None:
+                parts_ev.append(c.elem_validity[:n])
+        if parts_data[0].ndim == 2:  # strings / arrays: align widths
             mb = max(int(p.shape[1]) for p in parts_data)
             parts_data = [
                 jnp.pad(p, ((0, 0), (0, mb - p.shape[1]))) for p in parts_data
+            ]
+            parts_ev = [
+                jnp.pad(p, ((0, 0), (0, mb - p.shape[1]))) for p in parts_ev
             ]
         data = jnp.concatenate(parts_data, axis=0)
         pad = cap - total
@@ -278,5 +325,10 @@ def concat_batches(batches: List[ColumnBatch]) -> ColumnBatch:
         lens = None
         if parts_len:
             lens = jnp.pad(jnp.concatenate(parts_len), (0, pad))
-        cols.append(DeviceColumn(field.dataType, data, val, lens))
+        ev = None
+        if parts_ev:
+            ev = jnp.concatenate(parts_ev, axis=0)
+            if pad:
+                ev = jnp.pad(ev, ((0, pad), (0, 0)))
+        cols.append(DeviceColumn(field.dataType, data, val, lens, ev))
     return ColumnBatch(schema, cols, total)

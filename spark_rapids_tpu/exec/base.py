@@ -71,15 +71,18 @@ class PhysicalPlan:
         tables: List[Optional[pa.Table]] = [None] * self.num_partitions
 
         def run(pid: int):
+            from spark_rapids_tpu.runtime.profiler import annotate
+
             task_id = next(_task_counter)
             ctx = TaskContext(task_id, self.conf)
             parts = []
             try:
-                for payload in self.execute_partition(pid, ctx):
-                    if isinstance(payload, ColumnBatch):
-                        parts.append(device_to_arrow(payload))
-                    else:
-                        parts.append(payload)
+                with annotate(f"{type(self).__name__}.p{pid}"):
+                    for payload in self.execute_partition(pid, ctx):
+                        if isinstance(payload, ColumnBatch):
+                            parts.append(device_to_arrow(payload))
+                        else:
+                            parts.append(payload)
             finally:
                 sem.get().release_if_necessary(task_id)
             if parts:

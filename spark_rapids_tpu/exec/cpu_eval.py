@@ -228,10 +228,144 @@ def _ev(e: Expression, t: pa.Table):
         workers = (s.rapids_conf.get(_rc.CONCURRENT_PYTHON_WORKERS)
                    if s else 4)
         return eval_pandas_udf(e, t, num_workers=workers)
+    r = _ev_collections(e, t)
+    if r is not None:
+        return r
     r = _ev_ext(e, t)
     if r is not None:
         return r
     raise NotImplementedError(f"CPU eval for {type(e).__name__}")
+
+
+def _ev_collections(e: Expression, t: pa.Table):
+    """Collection-expression oracle (Spark semantics over pyarrow)."""
+    from spark_rapids_tpu.expr.collections import (
+        ArrayContains,
+        CreateArray,
+        ElementAt,
+        GetArrayItem,
+        Size,
+    )
+
+    if isinstance(e, Size):
+        a = _ev(e.children[0], t)
+        n = pc.list_value_length(a)
+        return pc.fill_null(pc.cast(n, pa.int32()), pa.scalar(-1,
+                                                              pa.int32()))
+    if isinstance(e, ArrayContains):
+        a = _ev(e.children[0], t)
+        v = _ev(e.children[1], t)
+        arrs = (a.to_pylist() if hasattr(a, "to_pylist") else list(a))
+        if isinstance(v, pa.Scalar):
+            vals = [v.as_py()] * t.num_rows
+        else:
+            vals = v.to_pylist()
+        out = []
+        for arr, val in zip(arrs, vals):
+            if arr is None or val is None:
+                out.append(None)
+            elif val in [x for x in arr if x is not None]:
+                out.append(True)
+            elif any(x is None for x in arr):
+                out.append(None)
+            else:
+                out.append(False)
+        return pa.array(out, type=pa.bool_())
+    if isinstance(e, (GetArrayItem, ElementAt)):
+        a = _ev(e.children[0], t)
+        i = _ev(e.children[1], t)
+        arrs = a.to_pylist() if hasattr(a, "to_pylist") else list(a)
+        if isinstance(i, pa.Scalar):
+            idxs = [i.as_py()] * t.num_rows
+        else:
+            idxs = i.to_pylist()
+        one_based = isinstance(e, ElementAt)
+        out = []
+        for arr, ix in zip(arrs, idxs):
+            if arr is None or ix is None:
+                out.append(None)
+                continue
+            if one_based:
+                if ix == 0:
+                    out.append(None)
+                    continue
+                ix = ix - 1 if ix > 0 else len(arr) + ix
+            if 0 <= ix < len(arr):
+                out.append(arr[ix])
+            else:
+                out.append(None)
+        return pa.array(out, type=to_arrow_type(e.dtype))
+    if isinstance(e, CreateArray):
+        cols = [eval_expr(c, t).to_pylist() for c in e.children]
+        rows = [list(v) for v in zip(*cols)] if cols else \
+            [[] for _ in range(t.num_rows)]
+        return pa.array(rows, type=to_arrow_type(e.dtype))
+    from spark_rapids_tpu.expr.collections import (
+        ArrayFilter,
+        ArrayMax,
+        ArrayMin,
+        ArrayTransform,
+        LambdaVar,
+        SortArray,
+    )
+    from spark_rapids_tpu.expr.jsonexpr import GetJsonObject, extract_json
+
+    if isinstance(e, GetJsonObject):
+        docs = _ev(e.children[0], t).to_pylist()
+        return pa.array([None if d is None else extract_json(d, e.steps)
+                         for d in docs], type=pa.string())
+    if isinstance(e, (ArrayTransform, ArrayFilter)):
+        a = eval_expr(e.children[0], t).combine_chunks()
+        flat = pc.list_flatten(a)
+        lam = e.children[1].transform(
+            lambda node: BoundReference(0, node.dtype)
+            if isinstance(node, LambdaVar) else node)
+        out = eval_expr(lam, pa.table({"x": flat})).to_pylist()
+        arrs = a.to_pylist()
+        res = []
+        k = 0
+        for arr in arrs:
+            if arr is None:
+                res.append(None)
+                continue
+            seg = out[k:k + len(arr)]
+            k += len(arr)
+            if isinstance(e, ArrayTransform):
+                res.append(seg)
+            else:
+                res.append([v for v, keep in zip(arr, seg)
+                            if keep is True])
+        return pa.array(res, type=to_arrow_type(e.dtype))
+    if isinstance(e, (ArrayMax, ArrayMin)):
+        import math as _m
+
+        arrs = eval_expr(e.children[0], t).to_pylist()
+
+        def nan_rank(x):
+            # Spark total order: NaN greatest (stable for ints too)
+            is_nan = isinstance(x, float) and _m.isnan(x)
+            return (is_nan, 0.0 if is_nan else x)
+
+        agg = max if isinstance(e, ArrayMax) else min
+        out = []
+        for arr in arrs:
+            vals = [x for x in (arr or []) if x is not None]
+            out.append(agg(vals, key=nan_rank)
+                       if arr is not None and vals else None)
+        return pa.array(out, type=to_arrow_type(e.dtype))
+    if isinstance(e, SortArray):
+        arrs = _ev(e.children[0], t).to_pylist()
+        out = []
+        for arr in arrs:
+            if arr is None:
+                out.append(None)
+                continue
+            nn = sorted([x for x in arr if x is not None],
+                        reverse=not e.ascending)
+            nulls = [None] * (len(arr) - len(nn))
+            out.append(nulls + nn if e.ascending else nn + nulls)
+        return pa.array(out, type=to_arrow_type(e.dtype))
+    return None
 
 
 def _type_of(a):

@@ -570,8 +570,12 @@ class CpuJoinExec(PhysicalPlan):
 
         left = mk(lt, lsch)
         right = mk(rt, rsch)
+        nested_payload = any(
+            pa.types.is_nested(f.type)
+            for f in list(left.schema) + list(right.schema))
         if (self.condition is None and self.left_keys and
                 self.join_type in self._ARROW_TYPE and
+                not nested_payload and
                 all(isinstance(k, BoundReference)
                     for k in list(self.left_keys) + list(self.right_keys))):
             yield self._arrow_join(left, right, lsch, rsch)

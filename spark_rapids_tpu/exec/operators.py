@@ -1080,6 +1080,107 @@ class UnionExec(PhysicalPlan):
             pid -= c.num_partitions
 
 
+# --------------------------------------------------------------- generate
+
+class TpuGenerateExec(PhysicalPlan):
+    """explode/posexplode over the padded-matrix array layout
+    (GpuGenerateExec.scala analog). Two-phase data-dependent expansion:
+    a count pass picks the output capacity bucket on the host, then one
+    gather program materializes (row, element) pairs — the same
+    discipline as the join gather maps."""
+
+    def __init__(self, pass_through: List[Alias], gen_alias: Alias,
+                 position: bool, child, conf):
+        from spark_rapids_tpu.sqltypes.datatypes import integer
+
+        fields = [StructField(a.name, a.dtype, a.nullable)
+                  for a in pass_through]
+        if position:
+            fields.append(StructField("pos", integer, False))
+        fields.append(StructField(gen_alias.name, gen_alias.dtype, True))
+        super().__init__([child], StructType(fields), conf)
+        self.pass_through = pass_through
+        self.gen_alias = gen_alias
+        self.position = position
+
+    def _explode_batch(self, batch: ColumnBatch) -> ColumnBatch:
+        from spark_rapids_tpu.ops import joinops
+        from spark_rapids_tpu.runtime.memory import get_catalog
+        from spark_rapids_tpu.sqltypes.datatypes import integer
+
+        ectx = EvalContext(batch)
+        arr = self.gen_alias.children[0].children[0].eval(ectx)
+        counts = jnp.where(batch.live_mask() & arr.validity,
+                           arr.lengths, 0).astype(jnp.int32)
+        total = int(jax.device_get(jnp.sum(counts)))
+        cap_out = next_capacity(max(total, 1))
+        row_bytes = batch.device_size_bytes() // max(1, batch.capacity)
+        with get_catalog().reserved(cap_out * (row_bytes + 16),
+                                    "generate"):
+            lo = jnp.zeros((batch.capacity,), jnp.int32)
+            pi, ei, _ = joinops.expand_gather_maps(lo, counts, cap_out)
+            cols = [a.eval(ectx).gather(pi) for a in self.pass_through]
+            if self.position:
+                cols.append(DeviceColumn(
+                    integer, ei.astype(jnp.int32),
+                    jnp.ones((cap_out,), bool)))
+            safe_e = jnp.clip(ei, 0, arr.data.shape[1] - 1)
+            vals = arr.data[pi, safe_e]
+            ev = arr.elem_validity[pi, safe_e]
+            cols.append(DeviceColumn(self.gen_alias.dtype, vals, ev))
+            return ColumnBatch(self.schema, cols, total)
+
+    def execute_partition(self, pid, ctx):
+        from spark_rapids_tpu.runtime.retry import retry_on_oom
+
+        for batch in self.children[0].execute_partition(pid, ctx):
+            out = retry_on_oom(lambda b=batch: self._explode_batch(b))
+            if out.row_count() > 0:
+                yield out
+
+
+class CpuGenerateExec(PhysicalPlan):
+    is_tpu = False
+
+    def __init__(self, pass_through, gen_alias, position, child, conf):
+        from spark_rapids_tpu.sqltypes.datatypes import integer
+
+        fields = [StructField(a.name, a.dtype, a.nullable)
+                  for a in pass_through]
+        if position:
+            fields.append(StructField("pos", integer, False))
+        fields.append(StructField(gen_alias.name, gen_alias.dtype, True))
+        super().__init__([child], StructType(fields), conf)
+        self.pass_through = pass_through
+        self.gen_alias = gen_alias
+        self.position = position
+
+    def execute_partition(self, pid, ctx):
+        import pyarrow.compute as pc
+
+        for table in self.children[0].execute_partition(pid, ctx):
+            arr = cpu_eval.eval_expr(
+                self.gen_alias.children[0].children[0],
+                table).combine_chunks()
+            parent = pc.list_parent_indices(arr)
+            flat = pc.list_flatten(arr)
+            arrays = []
+            names = []
+            for a in self.pass_through:
+                arrays.append(cpu_eval.eval_expr(a, table)
+                              .combine_chunks().take(parent))
+                names.append(a.name)
+            if self.position:
+                p = np.asarray(parent)
+                pos = np.arange(len(p)) - np.searchsorted(p, p,
+                                                          side="left")
+                arrays.append(pa.array(pos.astype(np.int32)))
+                names.append("pos")
+            arrays.append(flat)
+            names.append(self.gen_alias.name)
+            yield pa.Table.from_arrays(arrays, names=names)
+
+
 # ----------------------------------------------------------------- window
 
 def window_halo(window_exprs: List[Alias]) -> Optional[int]:

@@ -44,3 +44,11 @@ from spark_rapids_tpu.expr.windows import (  # noqa: F401
 from spark_rapids_tpu.expr.regexexpr import (  # noqa: F401
     RegexpExtract, RegexpReplace, RLike,
 )
+from spark_rapids_tpu.expr.collections import (  # noqa: F401
+    ArrayContains,
+    CreateArray,
+    ElementAt,
+    GetArrayItem,
+    Size,
+)
+from spark_rapids_tpu.expr.generators import Explode, PosExplode  # noqa: F401

@@ -1,0 +1,406 @@
+"""Collection expressions over the padded-matrix array layout — the
+collectionOperations.scala analog (reference: GpuSize, GpuArrayContains,
+GpuElementAt/GetArrayItem, GpuCreateArray; cuDF list kernels).
+
+All device evals are vectorized jnp over [cap, max_elems] matrices; null
+semantics follow Spark:
+- size(null) = -1 (legacy sizeOfNull=true default),
+- array_contains: null if the array is null; true if found; null if not
+  found but the array has null elements; else false,
+- getItem / element_at out of bounds -> null (non-ANSI),
+- array(...) of N children builds a fixed-N array per row.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import DeviceColumn
+from spark_rapids_tpu.expr.core import Expression, Literal
+from spark_rapids_tpu.sqltypes import ArrayType, IntegerType
+from spark_rapids_tpu.sqltypes.datatypes import boolean, integer
+
+
+class Size(Expression):
+    """size(array): element count; -1 for null (Spark legacy default)."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return integer
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        n = c.lengths.astype(jnp.int32)
+        data = jnp.where(c.validity, n, jnp.int32(-1))
+        return DeviceColumn(integer, data,
+                            jnp.ones(data.shape, bool))
+
+
+class ArrayContains(Expression):
+    def __init__(self, arr: Expression, value: Expression):
+        super().__init__([arr, value])
+
+    @property
+    def dtype(self):
+        return boolean
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        v = self.children[1].eval(ctx)
+        me = c.data.shape[1]
+        in_row = (jnp.arange(me, dtype=jnp.int32)[None, :] <
+                  c.lengths[:, None])
+        elem_ok = in_row & c.elem_validity
+        hit = jnp.any(elem_ok & (c.data == v.data[:, None]), axis=1)
+        has_null_elem = jnp.any(in_row & ~c.elem_validity, axis=1)
+        valid = c.validity & v.validity & (hit | ~has_null_elem)
+        return DeviceColumn(boolean, hit, valid)
+
+
+class GetArrayItem(Expression):
+    """array[index]; out-of-bounds or null element -> null."""
+
+    def __init__(self, arr: Expression, index: Expression):
+        super().__init__([arr, index])
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype.elementType
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        i = self.children[1].eval(ctx)
+        idx = i.data.astype(jnp.int32)
+        in_bounds = (idx >= 0) & (idx < c.lengths)
+        safe = jnp.clip(idx, 0, c.data.shape[1] - 1)
+        vals = jnp.take_along_axis(c.data, safe[:, None].astype(jnp.int64),
+                                   axis=1)[:, 0]
+        ev = jnp.take_along_axis(c.elem_validity,
+                                 safe[:, None].astype(jnp.int64),
+                                 axis=1)[:, 0]
+        valid = c.validity & i.validity & in_bounds & ev
+        return DeviceColumn(self.dtype, vals, valid)
+
+
+class ElementAt(Expression):
+    """element_at(array, i): 1-based, negative counts from the end."""
+
+    def __init__(self, arr: Expression, index: Expression):
+        super().__init__([arr, index])
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype.elementType
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        i = self.children[1].eval(ctx)
+        raw = i.data.astype(jnp.int32)
+        idx = jnp.where(raw > 0, raw - 1, c.lengths + raw)
+        in_bounds = (idx >= 0) & (idx < c.lengths) & (raw != 0)
+        safe = jnp.clip(idx, 0, c.data.shape[1] - 1)
+        vals = jnp.take_along_axis(c.data, safe[:, None].astype(jnp.int64),
+                                   axis=1)[:, 0]
+        ev = jnp.take_along_axis(c.elem_validity,
+                                 safe[:, None].astype(jnp.int64),
+                                 axis=1)[:, 0]
+        valid = c.validity & i.validity & in_bounds & ev
+        return DeviceColumn(self.dtype, vals, valid)
+
+
+class CreateArray(Expression):
+    """array(e1, ..., eN): fixed-width array per row."""
+
+    def __init__(self, *children: Expression):
+        super().__init__(list(children))
+
+    @property
+    def dtype(self):
+        et = (self.children[0].dtype if self.children
+              else IntegerType())
+        return ArrayType(et)
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx):
+        if not self.children:
+            cap = ctx.capacity
+            return DeviceColumn(
+                self.dtype,
+                jnp.zeros((cap, 1), self.dtype.elementType.np_dtype),
+                jnp.ones((cap,), bool),
+                jnp.zeros((cap,), jnp.int32),
+                jnp.zeros((cap, 1), bool))
+        cols = [c.eval(ctx) for c in self.children]
+        n = len(cols)
+        data = jnp.stack([c.data for c in cols], axis=1)
+        ev = jnp.stack([c.validity for c in cols], axis=1)
+        cap = data.shape[0]
+        lengths = jnp.full((cap,), jnp.int32(n))
+        return DeviceColumn(self.dtype, data,
+                            jnp.ones((cap,), bool), lengths, ev)
+
+
+# ----------------------- higher-order functions (higherOrderFunctions.scala)
+
+class LambdaVar(Expression):
+    """Element placeholder inside an array lambda; eval reads the bound
+    flattened element column off the context (set by the enclosing
+    higher-order expression)."""
+
+    _SLOT = "_lambda_elem"
+
+    def __init__(self, dtype):
+        super().__init__()
+        self._dtype = dtype
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval(self, ctx):
+        col = getattr(ctx, self._SLOT, None)
+        if col is None:
+            raise RuntimeError("lambda variable outside a lambda")
+        return col
+
+    def key(self):
+        return ("lambda_var", repr(self._dtype))
+
+
+def _flat_elems(c: DeviceColumn) -> DeviceColumn:
+    """[cap, me] array column -> flattened [cap*me] element column."""
+    return DeviceColumn(c.dtype.elementType, c.data.reshape(-1),
+                        c.elem_validity.reshape(-1))
+
+
+def _eval_lambda(lam: Expression, c: DeviceColumn) -> DeviceColumn:
+    """Evaluate the lambda tree over the flattened elements in a
+    context sized [cap*me] (literals/etc. broadcast to element count,
+    not row count)."""
+    from spark_rapids_tpu.columnar.batch import ColumnBatch
+    from spark_rapids_tpu.expr.core import EvalContext
+    from spark_rapids_tpu.sqltypes import StructField, StructType
+
+    flat = _flat_elems(c)
+    fb = ColumnBatch(StructType([StructField("x", flat.dtype, True)]),
+                     [flat], int(c.data.size))
+    fctx = EvalContext(fb)
+    setattr(fctx, LambdaVar._SLOT, flat)
+    return lam.eval(fctx)
+
+
+class _HigherOrder(Expression):
+    """Shared deferred-lambda machinery: the user's python fn builds the
+    lambda expression tree once the array child resolves to a concrete
+    ArrayType (Column resolution calls with_children bottom-up)."""
+
+    def __init__(self, arr: Expression, lam: Expression = None,
+                 fn=None):
+        children = [arr] + ([lam] if lam is not None else [])
+        super().__init__(children)
+        self.fn = fn
+        if lam is None and fn is not None:
+            self._try_build()
+
+    def _try_build(self):
+        arr = self.children[0]
+        try:
+            at = arr.dtype
+        except Exception:
+            return
+        if isinstance(at, ArrayType):
+            from spark_rapids_tpu.api.column import Column
+
+            var = LambdaVar(at.elementType)
+            lam_col = self.fn(Column(var, "x"))
+            lam = lam_col.expr if hasattr(lam_col, "expr") else lam_col
+            if lam.references():
+                raise ValueError(
+                    "array lambdas may reference only the element in v1")
+            self.children.append(lam)
+
+    def with_children(self, children):
+        node = type(self)(children[0],
+                          children[1] if len(children) > 1 else None,
+                          fn=self.fn)
+        if len(node.children) == 1 and node.fn is not None:
+            node._try_build()
+        return node
+
+    @property
+    def _lam(self):
+        if len(self.children) < 2:
+            raise RuntimeError(
+                "higher-order lambda unresolved (array child has no "
+                "concrete type yet)")
+        return self.children[1]
+
+
+class ArrayTransform(_HigherOrder):
+    """transform(arr, x -> f(x)) evaluated ON DEVICE: the lambda's
+    scalar expression tree runs elementwise over the flattened element
+    matrix — XLA fuses it with the rest of the projection (the
+    reference needs cuDF transform kernels per lambda;
+    higherOrderFunctions.scala)."""
+
+    @property
+    def dtype(self):
+        return ArrayType(self._lam.dtype)
+
+    @property
+    def nullable(self):
+        return self.children[0].nullable
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        cap, me = c.data.shape
+        out = _eval_lambda(self._lam, c)
+        in_row = (jnp.arange(me, dtype=jnp.int32)[None, :] <
+                  c.lengths[:, None])
+        data = out.data.reshape(cap, me)
+        ev = out.validity.reshape(cap, me) & in_row
+        return DeviceColumn(self.dtype, data, c.validity, c.lengths, ev)
+
+
+class ArrayFilter(_HigherOrder):
+    """filter(arr, x -> pred(x)): keeps elements where the predicate is
+    true, compacting within each row (stable argsort on the keep mask)."""
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    @property
+    def nullable(self):
+        return self.children[0].nullable
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        cap, me = c.data.shape
+        pred = _eval_lambda(self._lam, c)
+        in_row = (jnp.arange(me, dtype=jnp.int32)[None, :] <
+                  c.lengths[:, None])
+        keep = pred.data.reshape(cap, me) & pred.validity.reshape(
+            cap, me) & in_row
+        order = jnp.argsort(~keep, axis=1, stable=True)
+        data = jnp.take_along_axis(c.data, order, axis=1)
+        ev = jnp.take_along_axis(c.elem_validity & keep, order, axis=1)
+        lengths = jnp.sum(keep, axis=1).astype(jnp.int32)
+        return DeviceColumn(self.dtype, data, c.validity, lengths, ev)
+
+
+class _ArrayReduce(Expression):
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype.elementType
+
+    @property
+    def nullable(self):
+        return True
+
+    def _mask(self, c):
+        me = c.data.shape[1]
+        in_row = (jnp.arange(me, dtype=jnp.int32)[None, :] <
+                  c.lengths[:, None])
+        return in_row & c.elem_validity
+
+
+class ArrayMax(_ArrayReduce):
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        ok = self._mask(c)
+        if jnp.issubdtype(c.data.dtype, jnp.floating):
+            ident = jnp.array(-jnp.inf, c.data.dtype)
+        else:
+            ident = jnp.array(jnp.iinfo(c.data.dtype).min, c.data.dtype)
+        vals = jnp.max(jnp.where(ok, c.data, ident), axis=1)
+        valid = c.validity & jnp.any(ok, axis=1)
+        return DeviceColumn(self.dtype, vals, valid)
+
+
+class ArrayMin(_ArrayReduce):
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        ok = self._mask(c)
+        if jnp.issubdtype(c.data.dtype, jnp.floating):
+            # Spark orders NaN greatest: the min is the smallest non-NaN
+            # value, NaN only when every element is NaN
+            data = jnp.where(jnp.isnan(c.data), jnp.inf, c.data)
+            vals = jnp.min(jnp.where(ok, data, jnp.inf), axis=1)
+            all_nan = ~jnp.any(ok & ~jnp.isnan(c.data), axis=1)
+            vals = jnp.where(all_nan & jnp.any(ok, axis=1), jnp.nan,
+                             vals)
+        else:
+            ident = jnp.array(jnp.iinfo(c.data.dtype).max, c.data.dtype)
+            vals = jnp.min(jnp.where(ok, c.data, ident), axis=1)
+        valid = c.validity & jnp.any(ok, axis=1)
+        return DeviceColumn(self.dtype, vals, valid)
+
+
+class SortArray(Expression):
+    """sort_array(arr, asc): per-row element sort; nulls first for
+    ascending, last for descending (Spark semantics)."""
+
+    def __init__(self, child: Expression, ascending: bool = True):
+        super().__init__([child])
+        self.ascending = ascending
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    @property
+    def nullable(self):
+        return self.children[0].nullable
+
+    def key(self):
+        return ("sort_array", self.ascending, self.children[0].key())
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        me = c.data.shape[1]
+        in_row = (jnp.arange(me, dtype=jnp.int32)[None, :] <
+                  c.lengths[:, None])
+        # rank: dead slots always last; nulls first (asc) / last (desc)
+        if self.ascending:
+            rank = jnp.where(in_row & c.elem_validity, 1,
+                             jnp.where(in_row, 0, 2))
+        else:
+            rank = jnp.where(in_row & c.elem_validity, 0,
+                             jnp.where(in_row, 1, 2))
+        key = c.data
+        if jnp.issubdtype(key.dtype, jnp.bool_):
+            key = key.astype(jnp.int32)
+        if jnp.issubdtype(key.dtype, jnp.floating):
+            key = jnp.where(jnp.isnan(key), jnp.inf, key)
+        if not self.ascending:
+            key = -key
+        order = jnp.lexsort((key, rank), axis=1)
+        data = jnp.take_along_axis(c.data, order, axis=1)
+        ev = jnp.take_along_axis(c.elem_validity, order, axis=1)
+        return DeviceColumn(self.dtype, data, c.validity, c.lengths, ev)

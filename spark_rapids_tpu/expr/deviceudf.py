@@ -1,0 +1,57 @@
+"""User device UDFs — the RapidsUDF analog (reference
+sql-plugin-api/.../RapidsUDF.java:22-68: a user function that evaluates
+COLUMNAR on device; GpuUserDefinedFunction.scala:33-40 runs it inside
+the operator's device pipeline).
+
+Here the user supplies a function over jnp arrays:
+
+    def my_fn(values, validity):        # [cap] arrays
+        return values * 2 + 1, validity
+
+and the expression evaluates it INSIDE the enclosing jitted operator —
+XLA fuses it with the rest of the projection, which is strictly better
+than the reference's separately-launched UDF kernel."""
+
+from __future__ import annotations
+
+from typing import Callable, List
+
+from spark_rapids_tpu.columnar.batch import DeviceColumn
+from spark_rapids_tpu.expr.core import Expression
+from spark_rapids_tpu.sqltypes import DataType
+
+
+class DeviceUDF(Expression):
+    """fn(values..., validities...) -> (values, validity); traced into
+    the enclosing XLA program."""
+
+    def __init__(self, fn: Callable, return_type: DataType,
+                 children: List[Expression]):
+        super().__init__(children)
+        self.fn = fn
+        self._dtype = return_type
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return True
+
+    def key(self):
+        # id(fn) is stable for the process lifetime, which is the
+        # lifetime of the jit cache
+        return ("device_udf", id(self.fn),
+                tuple(c.key() for c in self.children))
+
+    def eval(self, ctx):
+        cols = [c.eval(ctx) for c in self.children]
+        out = self.fn(*[c.data for c in cols],
+                      *[c.validity for c in cols])
+        if isinstance(out, tuple):
+            data, validity = out
+        else:
+            data = out
+            validity = cols[0].validity if cols else None
+        return DeviceColumn(self._dtype, data, validity)

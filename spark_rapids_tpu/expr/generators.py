@@ -1,0 +1,45 @@
+"""Generator expressions (explode/posexplode) — markers consumed by the
+planner's Generate conversion (GpuGenerateExec analog,
+GpuGenerateExec.scala). A generator never evaluates inline: the
+DataFrame layer extracts it from a projection into an L.Generate node,
+like Spark's ExtractGenerator analysis rule."""
+
+from __future__ import annotations
+
+from spark_rapids_tpu.expr.core import Expression
+
+
+class Explode(Expression):
+    """explode(array): one output row per (non-null) array element."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype.elementType
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval(self, ctx):
+        raise RuntimeError(
+            "generator expressions are planned as Generate nodes, "
+            "never evaluated inline")
+
+    def key(self):
+        return ("explode", self.children[0].key())
+
+
+class PosExplode(Explode):
+    """posexplode(array): (pos, col) rows."""
+
+    def key(self):
+        return ("posexplode", self.children[0].key())
+
+
+def contains_generator(e: Expression) -> bool:
+    if isinstance(e, Explode):
+        return True
+    return any(contains_generator(c) for c in e.children)

@@ -1,0 +1,74 @@
+"""JSON expressions — GpuGetJsonObject / JSONUtils role. v1 evaluates on
+the host (the planner's type checks route the operator to the CPU path
+with a tagged reason); a device byte-level JSON scanner in the
+stringcast/regex DFA style is the follow-up."""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import List
+
+from spark_rapids_tpu.expr.core import Expression
+from spark_rapids_tpu.sqltypes.datatypes import string as string_t
+
+_STEP = re.compile(r"\.([A-Za-z_][A-Za-z0-9_]*)|\[(\d+)\]")
+
+
+def parse_json_path(path: str) -> List[object]:
+    """'$.a.b[0]' -> ['a', 'b', 0]; raises on malformed paths."""
+    if not path.startswith("$"):
+        raise ValueError(f"JSON path must start with $: {path!r}")
+    steps: List[object] = []
+    pos = 1
+    while pos < len(path):
+        m = _STEP.match(path, pos)
+        if not m:
+            raise ValueError(f"bad JSON path {path!r} at {pos}")
+        steps.append(m.group(1) if m.group(1) is not None
+                     else int(m.group(2)))
+        pos = m.end()
+    return steps
+
+
+def extract_json(doc: str, steps: List[object]):
+    """Spark get_json_object semantics: invalid JSON / missing path ->
+    null; scalar results unquoted, nested results re-serialized."""
+    try:
+        v = json.loads(doc)
+    except (ValueError, TypeError):
+        return None
+    for s in steps:
+        if isinstance(s, int):
+            if not isinstance(v, list) or s >= len(v):
+                return None
+            v = v[s]
+        else:
+            if not isinstance(v, dict) or s not in v:
+                return None
+            v = v[s]
+    if v is None:
+        return None
+    if isinstance(v, (dict, list)):
+        return json.dumps(v, separators=(",", ":"))
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)
+
+
+class GetJsonObject(Expression):
+    def __init__(self, child: Expression, path: str):
+        super().__init__([child])
+        self.path = path
+        self.steps = parse_json_path(path)
+
+    @property
+    def dtype(self):
+        return string_t
+
+    @property
+    def nullable(self):
+        return True
+
+    def key(self):
+        return ("get_json_object", self.path, self.children[0].key())

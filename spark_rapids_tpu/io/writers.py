@@ -38,15 +38,36 @@ class WriteStats:
                 pass
 
 
-def _write_one(fmt: str, table: pa.Table, path: str):
+_KNOWN_OPTIONS = {
+    "parquet": {"compression", "row_group_size"},
+    "orc": set(),
+    "csv": {"header"},
+    "json": set(),
+    "avro": set(),
+}
+
+
+def _write_one(fmt: str, table: pa.Table, path: str,
+               options: Optional[Dict] = None):
+    options = options or {}
+    unknown = set(options) - _KNOWN_OPTIONS.get(fmt, set())
+    if unknown:
+        import warnings
+
+        warnings.warn(f"ignoring unsupported {fmt} writer options: "
+                      f"{sorted(unknown)}")
     if fmt == "parquet":
-        pq.write_table(table, path)
+        kw = {k: options[k] for k in ("compression", "row_group_size")
+              if k in options}
+        pq.write_table(table, path, **kw)
     elif fmt == "orc":
         from pyarrow import orc as pa_orc
 
         pa_orc.write_table(table, path)
     elif fmt == "csv":
-        pa_csv.write_csv(table, path)
+        wopts = pa_csv.WriteOptions(
+            include_header=bool(options.get("header", True)))
+        pa_csv.write_csv(table, path, write_options=wopts)
     elif fmt == "json":
         import json as _json
 
@@ -83,14 +104,15 @@ def prepare_dir(path: str, mode: str):
 
 def write_task(fmt: str, table: pa.Table, out_dir: str, pid: int,
                partition_by: Optional[List[str]],
-               stats: WriteStats) -> None:
+               stats: WriteStats,
+               options: Optional[Dict] = None) -> None:
     """Write one task partition's data (GpuDynamicPartitionDataWriter
     when partition_by is set)."""
     if table.num_rows == 0:
         return
     if not partition_by:
         path = os.path.join(out_dir, f"part-{pid:05d}{_EXT[fmt]}")
-        _write_one(fmt, table, path)
+        _write_one(fmt, table, path, options)
         stats.file_written(path, table.num_rows)
         return
     # hive-style dynamic partitioning: group rows by partition tuple
@@ -110,5 +132,5 @@ def write_task(fmt: str, table: pa.Table, out_dir: str, pid: int,
         d = os.path.join(out_dir, *parts)
         os.makedirs(d, exist_ok=True)
         path = os.path.join(d, f"part-{pid:05d}{_EXT[fmt]}")
-        _write_one(fmt, sub, path)
+        _write_one(fmt, sub, path, options)
         stats.file_written(path, sub.num_rows)

@@ -1,0 +1,5 @@
+from spark_rapids_tpu.lakehouse.delta import (  # noqa: F401
+    DeltaTable,
+    read_delta,
+    write_delta,
+)

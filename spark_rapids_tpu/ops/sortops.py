@@ -55,18 +55,21 @@ def align_string_widths(a: ColumnBatch, b: ColumnBatch
     def pad(batch: ColumnBatch, widths: List[int]) -> ColumnBatch:
         cols = []
         for c, w in zip(batch.columns, widths):
-            if c.is_string and c.max_bytes < w:
-                data = jnp.pad(c.data, ((0, 0), (0, w - c.max_bytes)))
+            if w and c.data.shape[1] < w:
+                data = jnp.pad(c.data, ((0, 0), (0, w - c.data.shape[1])))
+                ev = (None if c.elem_validity is None else jnp.pad(
+                    c.elem_validity,
+                    ((0, 0), (0, w - c.elem_validity.shape[1]))))
                 cols.append(DeviceColumn(c.dtype, data, c.validity,
-                                         c.lengths))
+                                         c.lengths, ev))
             else:
                 cols.append(c)
         return ColumnBatch(batch.schema, cols, batch.num_rows)
 
     widths = []
     for ca, cb in zip(a.columns, b.columns):
-        widths.append(max(ca.max_bytes or 0, cb.max_bytes or 0)
-                      if ca.is_string else 0)
+        widths.append(max(int(ca.data.shape[1]), int(cb.data.shape[1]))
+                      if ca.data.ndim == 2 else 0)
     return pad(a, widths), pad(b, widths)
 
 
@@ -98,7 +101,7 @@ def merge_sorted(a: ColumnBatch, b: ColumnBatch, orders,
 
     cols: List[DeviceColumn] = []
     for fa, fb in zip(a.columns, b.columns):
-        if fa.is_string:
+        if fa.data.ndim == 2:  # strings / arrays
             data = jnp.zeros((out_cap, fa.data.shape[1]), fa.data.dtype)
             data = data.at[dest_b].set(fb.data, mode="drop")
             data = data.at[dest_a].set(fa.data, mode="drop")
@@ -110,8 +113,14 @@ def merge_sorted(a: ColumnBatch, b: ColumnBatch, orders,
             data = data.at[dest_b].set(fb.data, mode="drop")
             data = data.at[dest_a].set(fa.data, mode="drop")
             lens = None
+        ev = None
+        if fa.elem_validity is not None:
+            ev = jnp.zeros((out_cap, fa.elem_validity.shape[1]),
+                           jnp.bool_)
+            ev = ev.at[dest_b].set(fb.elem_validity, mode="drop")
+            ev = ev.at[dest_a].set(fa.elem_validity, mode="drop")
         val = jnp.zeros((out_cap,), jnp.bool_)
         val = val.at[dest_b].set(fb.validity, mode="drop")
         val = val.at[dest_a].set(fa.validity, mode="drop")
-        cols.append(DeviceColumn(fa.dtype, data, val, lens))
+        cols.append(DeviceColumn(fa.dtype, data, val, lens, ev))
     return ColumnBatch(a.schema, cols, na + nb)

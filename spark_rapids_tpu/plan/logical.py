@@ -234,6 +234,35 @@ class Window(LogicalPlan):
         return f"Window [{', '.join(a.name for a in self.window_exprs)}]"
 
 
+class Generate(LogicalPlan):
+    """Generator (explode/posexplode) over a child: emits pass-through
+    columns plus [pos,] element per array element (Spark's Generate,
+    reference GpuGenerateExec.scala)."""
+
+    def __init__(self, pass_through: List[Alias], gen_alias: Alias,
+                 child: LogicalPlan, position: bool = False):
+        super().__init__([child])
+        self.pass_through = pass_through
+        self.gen_alias = gen_alias  # Alias(Explode(input_expr))
+        self.position = position
+
+    @property
+    def schema(self):
+        from spark_rapids_tpu.sqltypes import StructField, StructType
+        from spark_rapids_tpu.sqltypes.datatypes import integer
+
+        fields = [StructField(a.name, a.dtype, a.nullable)
+                  for a in self.pass_through]
+        if self.position:
+            fields.append(StructField("pos", integer, False))
+        fields.append(StructField(self.gen_alias.name,
+                                  self.gen_alias.dtype, True))
+        return StructType(fields)
+
+    def _node_string(self):
+        return f"Generate [{self.gen_alias.name}]"
+
+
 class Limit(LogicalPlan):
     def __init__(self, n: int, child: LogicalPlan):
         super().__init__([child])

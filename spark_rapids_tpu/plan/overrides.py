@@ -24,7 +24,10 @@ from spark_rapids_tpu.exec import operators as ops
 from spark_rapids_tpu.exec.base import PhysicalPlan
 from spark_rapids_tpu.expr import Alias, BoundReference
 from spark_rapids_tpu.plan import logical as L
-from spark_rapids_tpu.plan.typesig import expr_unsupported_reasons
+from spark_rapids_tpu.plan.typesig import (
+    expr_unsupported_reasons,
+    key_type_supported,
+)
 
 
 class PlanMeta:
@@ -83,6 +86,10 @@ class TpuOverrides:
             for e in node.grouping + node.aggregates:
                 for r in expr_unsupported_reasons(e):
                     meta.cannot_run(r)
+            for g in node.grouping:
+                r = key_type_supported(g.dtype)
+                if r:
+                    meta.cannot_run(r)
             for a in node.aggregates:
                 fn = a.children[0]
                 if (isinstance(fn, (Min, Max)) and fn.input is not None
@@ -93,6 +100,9 @@ class TpuOverrides:
             for e in node.left_keys + node.right_keys:
                 for r in expr_unsupported_reasons(e):
                     meta.cannot_run(r)
+                r = key_type_supported(e.dtype)
+                if r:
+                    meta.cannot_run(r)
             if node.condition is not None:
                 for r in expr_unsupported_reasons(node.condition):
                     meta.cannot_run(r)
@@ -100,6 +110,16 @@ class TpuOverrides:
             for o in node.orders:
                 for r in expr_unsupported_reasons(o.expr):
                     meta.cannot_run(r)
+                r = key_type_supported(o.expr.dtype)
+                if r:
+                    meta.cannot_run(r)
+        elif isinstance(node, L.Generate):
+            for e in node.pass_through:
+                for r in expr_unsupported_reasons(e):
+                    meta.cannot_run(r)
+            gen_input = node.gen_alias.children[0].children[0]
+            for r in expr_unsupported_reasons(gen_input):
+                meta.cannot_run(r)
         elif isinstance(node, L.Window):
             self._tag_window(node, meta)
         elif isinstance(node, L.LocalRelation):
@@ -234,6 +254,14 @@ class TpuOverrides:
             return self._convert_join(node, children, on_device)
         if isinstance(node, L.Sort):
             return self._convert_sort(node, children[0], on_device)
+        if isinstance(node, L.Generate):
+            if on_device:
+                return ops.TpuGenerateExec(
+                    node.pass_through, node.gen_alias, node.position,
+                    self._to_device(children[0]), conf)
+            return ops.CpuGenerateExec(
+                node.pass_through, node.gen_alias, node.position,
+                self._to_host(children[0]), conf)
         if isinstance(node, L.Window):
             return self._convert_window(node, children[0], on_device)
         if isinstance(node, L.Limit):

@@ -31,13 +31,31 @@ DEVICE_TYPES = (BooleanType, IntegralType, FloatType, DoubleType,
 
 
 def type_supported(dt: DataType) -> Optional[str]:
+    from spark_rapids_tpu.sqltypes import ArrayType
+
     if isinstance(dt, DecimalType) and dt.precision > 18:
         return f"decimal precision {dt.precision} > 18 (DECIMAL64 only)"
     if isinstance(dt, NullType):
         return None
+    if isinstance(dt, ArrayType):
+        et = dt.elementType
+        if isinstance(et, (StringType, ArrayType)):
+            return (f"array element type {et.simpleString} runs on CPU "
+                    "(device arrays hold primitive elements in v1)")
+        return type_supported(et)
     if not isinstance(dt, DEVICE_TYPES):
         return f"type {dt} not supported on device"
     return None
+
+
+def key_type_supported(dt: DataType) -> Optional[str]:
+    """Grouping/join/sort keys additionally need orderable device keys;
+    arrays have no orderable-key lowering yet."""
+    from spark_rapids_tpu.sqltypes import ArrayType
+
+    if isinstance(dt, ArrayType):
+        return "array-typed keys run on CPU (no orderable device keys)"
+    return type_supported(dt)
 
 
 _checks: Dict[Type[Expression], Callable[[Expression], Optional[str]]] = {}

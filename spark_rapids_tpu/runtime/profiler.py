@@ -1,0 +1,53 @@
+"""Tracing/profiling — the NVTX-range integration analog (reference
+NvtxWithMetrics.scala:21-34 threads named ranges + metrics through every
+operator; docs/dev/nvtx_profiling.md workflow).
+
+On TPU the equivalents are jax.profiler traces (viewable in
+TensorBoard/Perfetto) and TraceAnnotation named ranges. The session
+exposes start/stop; operators annotate their partition execution so
+device work attributes to plan nodes in the timeline."""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+_active = False
+_lock = threading.Lock()
+
+
+def start_trace(log_dir: str) -> None:
+    """Begin a profiler session (jax.profiler.start_trace); view with
+    TensorBoard or Perfetto."""
+    global _active
+    import jax
+
+    with _lock:
+        if not _active:
+            jax.profiler.start_trace(log_dir)
+            _active = True
+
+
+def stop_trace() -> None:
+    global _active
+    import jax
+
+    with _lock:
+        if _active:
+            jax.profiler.stop_trace()
+            _active = False
+
+
+def is_active() -> bool:
+    return _active
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named range around operator work (NvtxWithMetrics role). Cheap
+    enough to leave on unconditionally — annotations no-op outside a
+    profiler session."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

@@ -169,6 +169,33 @@ class DecimalType(FractionalType):
         return hash(("decimal", self.precision, self.scale))
 
 
+class ArrayType(DataType):
+    """Variable-length list of a primitive element type. Device layout
+    (columnar.batch): a [cap, max_elems] padded element matrix + per-row
+    element counts + per-element validity — the same padded-matrix
+    discipline as strings, sized per capacity bucket (the cuDF
+    offsets+child layout rethought for XLA static shapes)."""
+
+    def __init__(self, elementType: DataType, containsNull: bool = True):
+        self.elementType = elementType
+        self.containsNull = containsNull
+
+    @property
+    def simpleString(self):
+        return f"array<{self.elementType.simpleString}>"
+
+    def __repr__(self):
+        return f"ArrayType({self.elementType!r}, {self.containsNull})"
+
+    def __eq__(self, other):
+        return (isinstance(other, ArrayType)
+                and other.elementType == self.elementType
+                and other.containsNull == self.containsNull)
+
+    def __hash__(self):
+        return hash(("array", self.elementType, self.containsNull))
+
+
 class StructField:
     def __init__(self, name: str, dataType: DataType, nullable: bool = True):
         self.name = name
@@ -291,6 +318,8 @@ def from_arrow_type(at) -> DataType:
                 f"decimal precision {at.precision} > 18 is not supported "
                 "(DECIMAL64 representation, v1 — see DecimalType docstring)")
         return DecimalType(at.precision, at.scale)
+    if pa.types.is_list(at) or pa.types.is_large_list(at):
+        return ArrayType(from_arrow_type(at.value_type))
     if pa.types.is_dictionary(at):
         return from_arrow_type(at.value_type)
     raise TypeError(f"unsupported arrow type {at}")
@@ -314,6 +343,8 @@ def to_arrow_type(dt: DataType):
     }
     if isinstance(dt, DecimalType):
         return pa.decimal128(dt.precision, dt.scale)
+    if isinstance(dt, ArrayType):
+        return pa.list_(to_arrow_type(dt.elementType))
     try:
         return mapping[type(dt)]
     except KeyError:

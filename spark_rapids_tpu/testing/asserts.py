@@ -49,14 +49,18 @@ def _sort_table(t: pa.Table) -> pa.Table:
     if t.num_rows <= 1 or t.num_columns == 0:
         return t
     # duplicate output names are legal (join keeps both sides' columns);
-    # sort through a uniquely-renamed view
+    # sort through a uniquely-renamed view. Nested columns are not
+    # sortable in arrow: key on the sortable subset only.
     uniq = [f"c{i}" for i in range(t.num_columns)]
     view = t.rename_columns(uniq)
-    keys = [(n, "ascending") for n in uniq]
+    keys = [(n, "ascending") for n, f in zip(uniq, t.schema)
+            if not pa.types.is_nested(f.type)]
+    if not keys:
+        return t
     try:
         return t.take(pc.sort_indices(view, sort_keys=keys,
                                       null_placement="at_start"))
-    except pa.ArrowNotImplementedError:
+    except (pa.ArrowNotImplementedError, pa.ArrowTypeError):
         return t
 
 

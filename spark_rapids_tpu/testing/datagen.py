@@ -171,10 +171,87 @@ class RepeatSeqGen(DataGen):
         return [pool[i] for i in idx]
 
 
+class SkewedKeyGen(DataGen):
+    """Zipf-skewed key picks over a pool (the datagen module's skew
+    control, reference datagen/README.md): a few hot keys dominate, the
+    tail follows a power law — the shape that breaks naive partitioning."""
+
+    def __init__(self, child: DataGen, cardinality: int,
+                 skew: float = 1.5, **kw):
+        super().__init__(nullable=child.nullable,
+                         null_ratio=child.null_ratio)
+        self.child = child
+        self.cardinality = cardinality
+        self.skew = skew
+        self.arrow_type = child.arrow_type
+
+    def _values(self, n, rng):
+        pool = self.child._values(self.cardinality, rng)
+        ranks = np.arange(1, self.cardinality + 1, dtype=np.float64)
+        p = ranks ** (-self.skew)
+        p /= p.sum()
+        idx = rng.choice(self.cardinality, size=n, p=p)
+        if isinstance(pool, np.ndarray):
+            return pool[idx]
+        return [pool[i] for i in idx]
+
+
+class CorrelatedGen(DataGen):
+    """Value derived from another generated column plus noise (the
+    datagen module's correlation control): fn(other_values, rng) -> np
+    array. Requires gen_table, which passes prior columns through."""
+
+    arrow_type = pa.float64()
+
+    def __init__(self, source: str, fn, **kw):
+        super().__init__(**kw)
+        self.source = source
+        self.fn = fn
+
+    def generate_with(self, n, rng, built: dict) -> pa.Array:
+        src = built[self.source]
+        src_np = np.asarray(src.to_pandas())
+        vals = self.fn(src_np, rng)
+        mask = (rng.random(n) < self.null_ratio) if self.null_ratio \
+            else None
+        return pa.array(np.asarray(vals, dtype=np.float64),
+                        type=self.arrow_type, mask=mask)
+
+    def _values(self, n, rng):
+        raise RuntimeError("CorrelatedGen requires gen_table")
+
+
+class ArrayGen(DataGen):
+    """Lists of a primitive child generator (nested-type coverage)."""
+
+    def __init__(self, child: DataGen, max_len: int = 5, **kw):
+        super().__init__(**kw)
+        self.child = child
+        self.max_len = max_len
+        self.arrow_type = pa.list_(child.arrow_type)
+
+    def _values(self, n, rng):
+        lens = rng.integers(0, self.max_len + 1, size=n)
+        flat = self.child.generate(int(lens.sum()), rng)
+        out = []
+        off = 0
+        flat_list = flat.to_pylist()
+        for ln in lens:
+            out.append(flat_list[off:off + int(ln)])
+            off += int(ln)
+        return out
+
+
 def gen_table(gens: List[Tuple[str, DataGen]], n: int,
               seed: int = 0) -> pa.Table:
     rng = np.random.default_rng(seed)
-    return pa.table({name: g.generate(n, rng) for name, g in gens})
+    built = {}
+    for name, g in gens:
+        if isinstance(g, CorrelatedGen):
+            built[name] = g.generate_with(n, rng, built)
+        else:
+            built[name] = g.generate(n, rng)
+    return pa.table(built)
 
 
 # Standard gen sets (reference data_gen.py naming)

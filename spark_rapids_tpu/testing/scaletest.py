@@ -1,0 +1,207 @@
+"""Scale-test harness — the ScaleTest module analog (reference
+integration_tests/ScaleTest.md + tests/scaletest/: a deterministic
+join/agg/window-heavy query set q1..q10 over generated tables, used for
+perf regression and memory-pressure coverage at configurable scale).
+
+Data model (scaled by `scale_factor`; seeded, reproducible):
+- fact   : wide fact table with skewed join key (SkewedKeyGen)
+- dim    : small dimension keyed 0..card-1 (broadcast-size)
+- events : timestamped rows for window/sort queries
+
+Run programmatically (`run_scale_test`) or as a CLI:
+    python -m spark_rapids_tpu.testing.scaletest --scale 1 --queries q1,q5
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Dict, List, Optional
+
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.testing.datagen import (
+    ArrayGen,
+    CorrelatedGen,
+    DateGen,
+    DoubleGen,
+    IntGen,
+    LongGen,
+    RepeatSeqGen,
+    SkewedKeyGen,
+    StringGen,
+    gen_table,
+)
+
+BASE_ROWS = 100_000
+DIM_CARD = 1_000
+
+
+def generate_data(out_dir: str, scale_factor: float = 1.0,
+                  seed: int = 42, files_per_table: int = 4) -> Dict[str,
+                                                                    str]:
+    """Write the test tables as multi-file parquet; returns table paths."""
+    n_fact = max(1000, int(BASE_ROWS * scale_factor))
+    n_events = max(1000, int(BASE_ROWS * scale_factor // 2))
+    fact = gen_table([
+        ("k", SkewedKeyGen(IntGen(0, DIM_CARD - 1, nullable=False),
+                           DIM_CARD, skew=1.2, nullable=False)),
+        ("amount", DoubleGen(include_specials=False)),
+        ("qty", LongGen(lo=1, hi=100, nullable=False)),
+        ("rebate", CorrelatedGen(
+            "amount", lambda a, rng: a * 0.1 + rng.random(len(a)))),
+        ("tags", ArrayGen(IntGen(0, 50, nullable=False), max_len=4)),
+        ("day", DateGen()),
+    ], n=n_fact, seed=seed)
+    dim = gen_table([
+        ("k", RepeatSeqGen(IntGen(0, DIM_CARD - 1, nullable=False),
+                           DIM_CARD, nullable=False)),
+        ("region", IntGen(0, 25, nullable=False)),
+        ("name", StringGen(max_len=10, cardinality=200)),
+    ], n=DIM_CARD, seed=seed + 1)
+    events = gen_table([
+        ("user", RepeatSeqGen(IntGen(0, 500, nullable=False), 500,
+                              nullable=False)),
+        ("ts", LongGen(lo=0, hi=10_000_000, nullable=False)),
+        ("value", DoubleGen(include_specials=False)),
+    ], n=n_events, seed=seed + 2)
+    paths = {}
+    for name, t in (("fact", fact), ("dim", dim), ("events", events)):
+        d = os.path.join(out_dir, name)
+        os.makedirs(d, exist_ok=True)
+        per = max(1, t.num_rows // files_per_table)
+        for i in range(0, t.num_rows, per):
+            pq.write_table(t.slice(i, per),
+                           os.path.join(d, f"part-{i // per:04d}.parquet"))
+        paths[name] = d
+    return paths
+
+
+# ------------------------------------------------------------ query set
+
+def _q1(s, p):
+    """group-by agg over the skewed key."""
+    return (s.read.parquet(p["fact"]).groupBy("k")
+            .agg(F.sum("amount").alias("rev"),
+                 F.count("*").alias("n"), F.avg("qty").alias("aq")))
+
+
+def _q2(s, p):
+    """global aggregate."""
+    return s.read.parquet(p["fact"]).agg(
+        F.sum("amount").alias("t"), F.count("*").alias("n"))
+
+
+def _q3(s, p):
+    """filter + projection arithmetic + agg."""
+    return (s.read.parquet(p["fact"])
+            .filter(F.col("amount") > 10.0)
+            .select("k", (F.col("amount") * F.col("qty")).alias("rev"))
+            .groupBy("k").agg(F.sum("rev").alias("total")))
+
+
+def _q4(s, p):
+    """broadcast join + agg."""
+    fact = s.read.parquet(p["fact"])
+    dim = s.read.parquet(p["dim"])
+    return (fact.join(dim, on="k", how="inner")
+            .groupBy("region").agg(F.sum("amount").alias("rev")))
+
+
+def _q5(s, p):
+    """shuffled join + agg + sort (the NDS-q5-shaped slice)."""
+    fact = s.read.parquet(p["fact"])
+    dim = s.read.parquet(p["dim"])
+    return (fact.filter(F.col("amount") > 5.0)
+            .join(dim, on="k", how="inner")
+            .groupBy("region")
+            .agg(F.sum("amount").alias("rev"),
+                 F.count("*").alias("n"))
+            .orderBy(F.col("rev").desc()))
+
+
+def _q6(s, p):
+    """window ranking over partitions."""
+    from spark_rapids_tpu.api.window import Window
+
+    ev = s.read.parquet(p["events"])
+    w = Window.partitionBy("user").orderBy("ts")
+    return ev.select("user", "ts",
+                     F.row_number().over(w).alias("rn"))
+
+
+def _q7(s, p):
+    """global sort + limit (TopN)."""
+    return (s.read.parquet(p["fact"])
+            .orderBy(F.col("amount").desc()).limit(100))
+
+
+def _q8(s, p):
+    """explode nested arrays + agg."""
+    return (s.read.parquet(p["fact"])
+            .select("k", F.explode(F.col("tags")).alias("tag"))
+            .groupBy("tag").agg(F.count("*").alias("n")))
+
+
+def _q9(s, p):
+    """left anti join (dim keys never sold)."""
+    fact = s.read.parquet(p["fact"])
+    dim = s.read.parquet(p["dim"])
+    return dim.join(fact, on="k", how="left_anti").select("k", "region")
+
+
+def _q10(s, p):
+    """distinct + order (dedup pipeline)."""
+    return (s.read.parquet(p["fact"]).select("k", "qty")
+            .distinct().orderBy("k", "qty"))
+
+
+QUERIES: Dict[str, Callable] = {
+    "q1": _q1, "q2": _q2, "q3": _q3, "q4": _q4, "q5": _q5,
+    "q6": _q6, "q7": _q7, "q8": _q8, "q9": _q9, "q10": _q10,
+}
+
+
+def run_scale_test(spark, paths: Dict[str, str],
+                   queries: Optional[List[str]] = None,
+                   iterations: int = 1) -> Dict[str, dict]:
+    """Run the query set; returns {query: {elapsed_s, rows}}."""
+    results = {}
+    for name in (queries or sorted(QUERIES)):
+        fn = QUERIES[name]
+        best = None
+        rows = 0
+        for _ in range(iterations):
+            t0 = time.perf_counter()
+            out = fn(spark, paths).collect_arrow()
+            dt = time.perf_counter() - t0
+            rows = out.num_rows
+            best = dt if best is None else min(best, dt)
+        results[name] = {"elapsed_s": round(best, 4), "rows": rows}
+    return results
+
+
+def main():
+    import argparse
+    import json
+    import tempfile
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--queries", type=str, default="")
+    ap.add_argument("--data-dir", type=str, default="")
+    ap.add_argument("--iterations", type=int, default=1)
+    args = ap.parse_args()
+    from spark_rapids_tpu.api.session import TpuSparkSession
+
+    out_dir = args.data_dir or tempfile.mkdtemp(prefix="srtpu-scale-")
+    paths = generate_data(out_dir, args.scale)
+    spark = TpuSparkSession({})
+    queries = [q for q in args.queries.split(",") if q] or None
+    print(json.dumps(run_scale_test(spark, paths, queries,
+                                    args.iterations), indent=2))
+
+
+if __name__ == "__main__":
+    main()
