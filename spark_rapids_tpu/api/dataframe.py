@@ -409,8 +409,46 @@ class DataFrame:
 
         return plan_query(optimize(self._plan), self.session.rapids_conf)
 
+    # --- caching (ParquetCachedBatchSerializer analog: df.cache() data
+    # --- lives as compressed parquet blobs, decoded on reuse) ---
+
+    def cache(self) -> "DataFrame":
+        self._cached = True
+        return self
+
+    def persist(self, *_a, **_k) -> "DataFrame":
+        return self.cache()
+
+    def unpersist(self) -> "DataFrame":
+        self._cached = False
+        self._cache_blob = None
+        return self
+
+    def _cache_store(self, table: pa.Table):
+        import io as _io
+
+        import pyarrow.parquet as pq
+
+        buf = _io.BytesIO()
+        pq.write_table(table, buf, compression="snappy")
+        self._cache_blob = buf.getvalue()
+
+    def _cache_load(self) -> Optional[pa.Table]:
+        blob = getattr(self, "_cache_blob", None)
+        if blob is None:
+            return None
+        import io as _io
+
+        import pyarrow.parquet as pq
+
+        return pq.read_table(_io.BytesIO(blob))
+
     def collect_arrow(self) -> pa.Table:
         from spark_rapids_tpu.config import rapids_conf as rc
+
+        cached = self._cache_load()
+        if cached is not None:
+            return cached
 
         phys, _ = self._physical()
         if self.session.rapids_conf.is_explain_only:
@@ -423,11 +461,17 @@ class DataFrame:
             )
 
             try:
-                return MeshQueryExecutor.for_devices(
+                out = MeshQueryExecutor.for_devices(
                     mesh_n, self.session.rapids_conf).execute(phys)
+                if getattr(self, "_cached", False):
+                    self._cache_store(out)
+                return out
             except MeshCompileError:
                 pass  # operator without a mesh lowering: thread-pool path
-        return phys.collect()
+        out = phys.collect()
+        if getattr(self, "_cached", False):
+            self._cache_store(out)
+        return out
 
     def collect(self) -> List[tuple]:
         t = self.collect_arrow()

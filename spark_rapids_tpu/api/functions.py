@@ -574,3 +574,11 @@ def get_json_object(c, path) -> Column:
     from spark_rapids_tpu.expr.jsonexpr import GetJsonObject
 
     return Column(GetJsonObject(expr_of(c), path), "get_json_object")
+
+
+def parse_url(c, part: str, key=None) -> Column:
+    """parse_url(url, 'HOST'|'PATH'|'QUERY'[, query_key]) — host path
+    in v1 (GpuParseUrl role)."""
+    from spark_rapids_tpu.expr.jsonexpr import ParseUrl
+
+    return Column(ParseUrl(expr_of(c), part, key), "parse_url")

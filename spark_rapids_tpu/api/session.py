@@ -65,6 +65,24 @@ class DataFrameReader:
 
         return read_delta(self.session, path)
 
+    def hivetext(self, *paths: str):
+        """Hive LazySimpleSerDe text table ('\\x01' fields, '\\N'
+        nulls); requires .schema(...) since the format has no header."""
+        from spark_rapids_tpu.api.dataframe import DataFrame
+        from spark_rapids_tpu.plan.logical import FileScan
+
+        if self._schema is None:
+            raise ValueError("hivetext requires an explicit schema")
+        schema = self._schema
+        if not hasattr(schema, "fields"):
+            from spark_rapids_tpu.columnar.arrow_bridge import (
+                schema_from_arrow,
+            )
+
+            schema = schema_from_arrow(schema)
+        return DataFrame(FileScan("hivetext", list(paths), schema,
+                                  self._options), self.session)
+
     def parquet(self, *paths: str):
         from spark_rapids_tpu.api.dataframe import DataFrame
         from spark_rapids_tpu.columnar.arrow_bridge import schema_from_arrow
@@ -217,6 +235,15 @@ class TpuSparkSession:
         from spark_rapids_tpu.io.readers import write_parquet
 
         write_parquet(df.collect_arrow(), path)
+
+    def explainPotentialTpuPlan(self, df) -> str:
+        """Execute-free placement report: tag the plan and return the
+        would-be device placement with fallback reasons (the ExplainPlan
+        public API, reference GpuOverrides.scala:4500
+        explainPotentialGpuPlan)."""
+        _phys, meta = df._physical()
+        txt = meta.explain(only_not_on_device=False)
+        return txt or "(all operators place on device)"
 
     # --- profiling (NvtxWithMetrics / nvtx_profiling.md analog) ---
 

@@ -314,6 +314,13 @@ def _ev_collections(e: Expression, t: pa.Table):
         docs = _ev(e.children[0], t).to_pylist()
         return pa.array([None if d is None else extract_json(d, e.steps)
                          for d in docs], type=pa.string())
+    from spark_rapids_tpu.expr.jsonexpr import ParseUrl, extract_url
+
+    if isinstance(e, ParseUrl):
+        urls = eval_expr(e.children[0], t).to_pylist()
+        return pa.array(
+            [None if u is None else extract_url(u, e.part, e.query_key)
+             for u in urls], type=pa.string())
     if isinstance(e, (ArrayTransform, ArrayFilter)):
         a = eval_expr(e.children[0], t).combine_chunks()
         flat = pc.list_flatten(a)

@@ -147,6 +147,9 @@ class TpuFileScanExec(PhysicalPlan):
         elif fmt in ("orc", "avro"):
             self._tasks = readers.split_file_tasks(paths, "." + fmt,
                                                    coalesce_bytes)
+        elif fmt == "hivetext":
+            self._tasks = readers.split_file_tasks(paths, ".txt",
+                                                   coalesce_bytes)
         else:
             self._tasks = [[p] for p in readers.expand_paths(
                 paths, "." + fmt)]
@@ -177,6 +180,15 @@ class TpuFileScanExec(PhysicalPlan):
 
             return iter([read_avro(f).select(cols) if cols
                          else read_avro(f) for f in files])
+        if self.fmt == "hivetext":
+            from spark_rapids_tpu.io.hivetext import read_hive_text
+            from spark_rapids_tpu.sqltypes.datatypes import to_arrow_type
+
+            at = pa.schema([pa.field(f.name, to_arrow_type(f.dataType),
+                                     f.nullable)
+                            for f in self.schema.fields])
+            tabs = [read_hive_text(f, at) for f in files]
+            return iter([t.select(cols) if cols else t for t in tabs])
         raise ValueError(f"format {self.fmt}")
 
     def execute_partition(self, pid, ctx):
@@ -1103,10 +1115,35 @@ class TpuGenerateExec(PhysicalPlan):
         self.gen_alias = gen_alias
         self.position = position
 
-    def _explode_batch(self, batch: ColumnBatch) -> ColumnBatch:
+    def _explode_to_cap(self, batch: ColumnBatch, out_cap: int):
+        """Trace-safe explode into a static capacity; returns
+        (batch, overflow) — shared by the eager path (exact capacity)
+        and the mesh SPMD lowering (static + recompile-on-overflow)."""
         from spark_rapids_tpu.ops import joinops
-        from spark_rapids_tpu.runtime.memory import get_catalog
         from spark_rapids_tpu.sqltypes.datatypes import integer
+
+        ectx = EvalContext(batch)
+        arr = self.gen_alias.children[0].children[0].eval(ectx)
+        counts = jnp.where(batch.live_mask() & arr.validity,
+                           arr.lengths, 0).astype(jnp.int32)
+        lo = jnp.zeros((batch.capacity,), jnp.int32)
+        pi, ei, total = joinops.expand_gather_maps(lo, counts, out_cap)
+        overflow = total > out_cap
+        cols = [a.eval(ectx).gather(pi) for a in self.pass_through]
+        if self.position:
+            cols.append(DeviceColumn(
+                integer, ei.astype(jnp.int32),
+                jnp.ones((out_cap,), bool)))
+        safe_e = jnp.clip(ei, 0, arr.data.shape[1] - 1)
+        vals = arr.data[pi, safe_e]
+        ev = arr.elem_validity[pi, safe_e]
+        cols.append(DeviceColumn(self.gen_alias.dtype, vals, ev))
+        out = ColumnBatch(self.schema, cols,
+                          jnp.minimum(total, out_cap))
+        return out, overflow
+
+    def _explode_batch(self, batch: ColumnBatch) -> ColumnBatch:
+        from spark_rapids_tpu.runtime.memory import get_catalog
 
         ectx = EvalContext(batch)
         arr = self.gen_alias.children[0].children[0].eval(ectx)
@@ -1117,18 +1154,8 @@ class TpuGenerateExec(PhysicalPlan):
         row_bytes = batch.device_size_bytes() // max(1, batch.capacity)
         with get_catalog().reserved(cap_out * (row_bytes + 16),
                                     "generate"):
-            lo = jnp.zeros((batch.capacity,), jnp.int32)
-            pi, ei, _ = joinops.expand_gather_maps(lo, counts, cap_out)
-            cols = [a.eval(ectx).gather(pi) for a in self.pass_through]
-            if self.position:
-                cols.append(DeviceColumn(
-                    integer, ei.astype(jnp.int32),
-                    jnp.ones((cap_out,), bool)))
-            safe_e = jnp.clip(ei, 0, arr.data.shape[1] - 1)
-            vals = arr.data[pi, safe_e]
-            ev = arr.elem_validity[pi, safe_e]
-            cols.append(DeviceColumn(self.gen_alias.dtype, vals, ev))
-            return ColumnBatch(self.schema, cols, total)
+            out, _ovf = self._explode_to_cap(batch, cap_out)
+            return out
 
     def execute_partition(self, pid, ctx):
         from spark_rapids_tpu.runtime.retry import retry_on_oom

@@ -46,9 +46,16 @@ from spark_rapids_tpu.expr.regexexpr import (  # noqa: F401
 )
 from spark_rapids_tpu.expr.collections import (  # noqa: F401
     ArrayContains,
+    ArrayFilter,
+    ArrayMax,
+    ArrayMin,
+    ArrayTransform,
     CreateArray,
     ElementAt,
     GetArrayItem,
     Size,
+    SortArray,
 )
+from spark_rapids_tpu.expr.jsonexpr import GetJsonObject  # noqa: F401
+from spark_rapids_tpu.expr.deviceudf import DeviceUDF  # noqa: F401
 from spark_rapids_tpu.expr.generators import Explode, PosExplode  # noqa: F401

@@ -72,3 +72,68 @@ class GetJsonObject(Expression):
 
     def key(self):
         return ("get_json_object", self.path, self.children[0].key())
+
+
+class ParseUrl(Expression):
+    """parse_url(url, part[, key]) — GpuParseUrl / ParseURI JNI role;
+    host-evaluated in v1 with Spark's part names (PROTOCOL, HOST, PATH,
+    QUERY, REF, FILE, AUTHORITY, USERINFO)."""
+
+    PARTS = ("PROTOCOL", "HOST", "PATH", "QUERY", "REF", "FILE",
+             "AUTHORITY", "USERINFO")
+
+    def __init__(self, child: Expression, part: str, key=None):
+        super().__init__([child])
+        if part not in self.PARTS:
+            raise ValueError(f"parse_url part {part!r}")
+        self.part = part
+        self.query_key = key
+
+    @property
+    def dtype(self):
+        return string_t
+
+    @property
+    def nullable(self):
+        return True
+
+    def key(self):
+        return ("parse_url", self.part, self.query_key,
+                self.children[0].key())
+
+
+def extract_url(url: str, part: str, key=None):
+    from urllib.parse import urlsplit
+
+    try:
+        u = urlsplit(url)
+    except ValueError:
+        return None
+    if not u.scheme or "://" not in url:
+        return None
+    if part == "PROTOCOL":
+        return u.scheme or None
+    if part == "HOST":
+        return u.hostname
+    if part == "PATH":
+        return u.path or None
+    if part == "QUERY":
+        if key is not None:
+            # Spark extracts the RAW substring (no URL decoding, blank
+            # values preserved)
+            m = re.search(r"(?:^|&)" + re.escape(key) + r"=([^&]*)",
+                          u.query)
+            return m.group(1) if m else None
+        return u.query or None
+    if part == "REF":
+        return u.fragment or None
+    if part == "FILE":
+        return (u.path + ("?" + u.query if u.query else "")) or None
+    if part == "AUTHORITY":
+        return u.netloc or None
+    if part == "USERINFO":
+        if u.username is None and u.password is None:
+            return None
+        return (u.username or "") + (
+            ":" + u.password if u.password is not None else "")
+    return None

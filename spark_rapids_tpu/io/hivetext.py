@@ -1,0 +1,110 @@
+"""Hive text serde — the GpuHiveTextFileFormat / GpuHiveTableScanExec
+analog (reference org/apache/spark/sql/hive/rapids/, 2.7k LoC): the
+LazySimpleSerDe default layout — '\\x01'-delimited fields, '\\N' nulls,
+backslash-escaped delimiter/newline/backslash, no header.
+
+The reader is an escape-aware scanner (Hive's null sentinel must be
+recognized BEFORE unescaping, which rules out generic csv parsers);
+values then batch-cast through arrow. Write formats Hive-compatibly
+with the same escaping."""
+
+from __future__ import annotations
+
+from typing import List
+
+import pyarrow as pa
+
+DELIM = "\x01"
+NULL = "\\N"
+
+_ESCAPES = {"\\": "\\\\", DELIM: "\\" + DELIM, "\n": "\\n",
+            "\r": "\\r"}
+
+
+def _escape(s: str) -> str:
+    out = []
+    for ch in s:
+        out.append(_ESCAPES.get(ch, ch))
+    return "".join(out)
+
+
+def _parse_records(text: str) -> List[List[str]]:
+    """Split on unescaped newlines/delimiters; keep fields RAW (null
+    detection needs the pre-unescape bytes)."""
+    rows: List[List[str]] = []
+    field: List[str] = []
+    row: List[str] = []
+    i = 0
+    n = len(text)
+    while i < n:
+        ch = text[i]
+        if ch == "\\" and i + 1 < n:
+            field.append(ch)
+            field.append(text[i + 1])
+            i += 2
+            continue
+        if ch == DELIM:
+            row.append("".join(field))
+            field = []
+        elif ch == "\n":
+            row.append("".join(field))
+            field = []
+            rows.append(row)
+            row = []
+        else:
+            field.append(ch)
+        i += 1
+    if field or row:
+        row.append("".join(field))
+        rows.append(row)
+    return rows
+
+
+def _unescape(raw: str) -> str:
+    out = []
+    i = 0
+    n = len(raw)
+    while i < n:
+        ch = raw[i]
+        if ch == "\\" and i + 1 < n:
+            nxt = raw[i + 1]
+            out.append({"n": "\n", "r": "\r"}.get(nxt, nxt))
+            i += 2
+            continue
+        out.append(ch)
+        i += 1
+    return "".join(out)
+
+
+def read_hive_text(path: str, schema: pa.Schema) -> pa.Table:
+    with open(path, "r") as f:
+        rows = _parse_records(f.read())
+    ncols = len(schema.names)
+    cols: List[List] = [[] for _ in range(ncols)]
+    for row in rows:
+        for c in range(ncols):
+            raw = row[c] if c < len(row) else NULL
+            cols[c].append(None if raw == NULL else _unescape(raw))
+    arrays = []
+    for c, field in enumerate(schema):
+        arr = pa.array(cols[c], type=pa.string())
+        if not pa.types.is_string(field.type):
+            arr = arr.cast(field.type)
+        arrays.append(arr)
+    return pa.Table.from_arrays(arrays, schema=schema)
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return NULL
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return _escape(str(v))
+
+
+def write_hive_text(table: pa.Table, path: str):
+    cols = [c.to_pylist() for c in table.columns]
+    with open(path, "w") as f:
+        for row in zip(*cols):
+            f.write(DELIM.join(_fmt(v) for v in row))
+            f.write("\n")

@@ -44,6 +44,7 @@ _KNOWN_OPTIONS = {
     "csv": {"header"},
     "json": set(),
     "avro": set(),
+    "hivetext": set(),
 }
 
 
@@ -81,12 +82,16 @@ def _write_one(fmt: str, table: pa.Table, path: str,
         from spark_rapids_tpu.io.avro import write_avro
 
         write_avro(table, path)
+    elif fmt == "hivetext":
+        from spark_rapids_tpu.io.hivetext import write_hive_text
+
+        write_hive_text(table, path)
     else:
         raise ValueError(f"write format {fmt!r}")
 
 
 _EXT = {"parquet": ".parquet", "orc": ".orc", "csv": ".csv",
-        "json": ".json", "avro": ".avro"}
+        "json": ".json", "avro": ".avro", "hivetext": ".txt"}
 
 
 def prepare_dir(path: str, mode: str):

@@ -369,6 +369,9 @@ class DeltaTable:
                 cols.append(F.col(name))
         self._rewrite(target.select(*cols).collect_arrow(), "UPDATE")
 
+    def optimize(self) -> "DeltaOptimizeBuilder":
+        return DeltaOptimizeBuilder(self)
+
     def _rewrite(self, table: pa.Table, op: str):
         snap = load_snapshot(self.path)
         ts = int(time.time() * 1000)
@@ -381,6 +384,32 @@ class DeltaTable:
                                        "operation": op,
                                        "operationParameters": {}}})
         _commit(self.path, snap.version + 1, actions)
+
+
+class DeltaOptimizeBuilder:
+    """OPTIMIZE [ZORDER BY cols] — compaction + Morton-curve clustering
+    (reference delta-lake zorder/ZOrderRules.scala + GpuInterleaveBits;
+    device kernel in ops/zorder.py)."""
+
+    def __init__(self, table: DeltaTable):
+        self.table = table
+
+    def executeCompaction(self):
+        t = self.table.toDF().collect_arrow()
+        self.table._rewrite(t, "OPTIMIZE")
+
+    def executeZOrderBy(self, *cols: str):
+        from spark_rapids_tpu.columnar.arrow_bridge import (
+            arrow_to_device,
+            device_to_arrow,
+        )
+        from spark_rapids_tpu.ops.zorder import zorder_sort
+
+        t = self.table.toDF().collect_arrow()
+        batch = arrow_to_device(t)
+        ordinals = [t.column_names.index(c) for c in cols]
+        out = device_to_arrow(zorder_sort(batch, ordinals))
+        self.table._rewrite(out, "OPTIMIZE")
 
 
 class DeltaMergeBuilder:

@@ -232,7 +232,16 @@ _SOURCE_TYPES = (ops.LocalRelationExec, ops.RangeExec, ops.TpuFileScanExec,
 _SUPPORTED = (ops.TpuProjectExec, ops.TpuFilterExec,
               ops.TpuHashAggregateExec, ops.TpuShuffleExchangeExec,
               ops.TpuSortExec, ops.TpuLocalLimitExec, ops.UnionExec,
+              ops.TpuWindowExec, ops.TpuGenerateExec,
               J.TpuShuffledHashJoinExec, J.TpuBroadcastHashJoinExec)
+
+
+def shard_generate(node: ops.TpuGenerateExec, batch: ColumnBatch,
+                   out_cap: int):
+    """Trace-safe per-shard explode with a static output capacity
+    (overflow -> recompile bigger); shares the operator's explode
+    program."""
+    return node._explode_to_cap(batch, out_cap)
 
 
 def _plan_key(node: PhysicalPlan) -> tuple:
@@ -262,6 +271,12 @@ def _plan_key(node: PhysicalPlan) -> tuple:
                if node.key_exprs else None, node.num_partitions)
     elif isinstance(node, ops.TpuLocalLimitExec):
         own = (node.n,)
+    elif isinstance(node, ops.TpuWindowExec):
+        own = (aliases_key(node.window_exprs), node.presorted,
+               node.halo)
+    elif isinstance(node, ops.TpuGenerateExec):
+        own = (node.gen_alias.name, node.gen_alias.key(),
+               aliases_key(node.pass_through), node.position)
     elif isinstance(node, (J.TpuShuffledHashJoinExec,
                            J.TpuBroadcastHashJoinExec)):
         own = (node.join_type,
@@ -365,6 +380,39 @@ class MeshQueryExecutor:
                         [emit(c) for c in node.children])
                 if isinstance(node, ops.TpuHashAggregateExec):
                     return self._emit_agg(node, emit, track, expansion)
+                if isinstance(node, ops.TpuGenerateExec):
+                    cb = emit(node.children[0])
+                    out_cap = next_capacity(expansion * cb.capacity)
+                    return track(shard_generate(node, cb, out_cap))
+                if isinstance(node, ops.TpuWindowExec):
+                    # rows of one window partition must share a shard:
+                    # hash-exchange by partition keys (or gather-to-one
+                    # for unpartitioned specs), then the per-shard
+                    # window program runs whole (it is trace-safe)
+                    child = node.children[0]
+                    if (isinstance(child, ops.TpuSortExec) and
+                            node.presorted):
+                        # the single-chip batched-window pipeline sorts
+                        # + chunks; the shard program windows in one
+                        # pass (its _run sorts internally), so bypass
+                        child = child.children[0]
+                    spec = node.spec0
+                    if spec.partitions:
+                        # own the partition-key exchange; bypass a
+                        # planner-inserted one carrying the same keys
+                        # (as the join lowering does)
+                        child = self._skip_keyed_exchange(
+                            child, spec.partitions)
+                        cb = self._key_exchange(
+                            emit(child), spec.partitions, track,
+                            expansion)
+                    else:
+                        if (isinstance(child, ops.TpuShuffleExchangeExec)
+                                and child.key_exprs is None
+                                and child.num_partitions == 1):
+                            child = child.children[0]
+                        cb = gather_to_one(emit(child), AXIS, n)
+                    return node._run(cb)
                 if isinstance(node, ops.TpuShuffleExchangeExec):
                     return self._emit_exchange(
                         node, emit(node.children[0]), track, expansion)

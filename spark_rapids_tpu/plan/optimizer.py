@@ -109,6 +109,10 @@ def _remap(e: Expression, mapping) -> Expression:
 
 def _prune(scan: L.FileScan, needed: List[int]):
     """-> (new_scan, old_ordinal -> new_ordinal) or None if no gain."""
+    if scan.fmt == "hivetext":
+        # positional headerless format: the parser needs the full file
+        # schema (every line carries every field anyway)
+        return None
     if len(needed) >= len(scan.schema.fields) or not needed:
         return None
     fields = [scan.schema.fields[i] for i in sorted(needed)]

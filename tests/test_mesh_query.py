@@ -188,15 +188,56 @@ def test_mesh_union():
 # -------------------------------------------------------- fallback path
 
 def test_mesh_fallback_for_unsupported():
-    """Operators without a mesh lowering (window) fall back to the
-    thread-pool engine and still produce oracle results."""
+    """Operators without a mesh lowering (nested-loop/cross join) fall
+    back to the thread-pool engine and still produce oracle results."""
+
+    def q(s):
+        a = s.createDataFrame(pa.table({"x": pa.array(range(40),
+                                                      type=pa.int64())}))
+        b = s.createDataFrame(pa.table({"y": pa.array(range(25),
+                                                      type=pa.int64())}))
+        return a.crossJoin(b).groupBy("x").agg(F.count("*").alias("n"))
+
+    _mesh_vs_oracle(q)
+
+
+def test_mesh_window():
+    """Windows lower to a partition-key all_to_all + per-shard window
+    program inside the SPMD plan."""
     from spark_rapids_tpu.api.window import Window
 
     def q(s):
-        fact, _ = _tables(s, n=800)
+        fact, _ = _tables(s, n=2000)
         w = Window.partitionBy("store").orderBy("amount")
         return fact.select("store", "amount",
                            F.row_number().over(w).alias("rn"))
+
+    _mesh_vs_oracle(q)
+
+
+def test_mesh_window_bounded_frame():
+    from spark_rapids_tpu.api.window import Window
+
+    def q(s):
+        fact, _ = _tables(s, n=1500)
+        w = (Window.partitionBy("store").orderBy("amount")
+             .rowsBetween(-2, 2))
+        return fact.select("store", "amount",
+                           F.sum("qty").over(w).alias("s5"))
+
+    _mesh_vs_oracle(q)
+
+
+def test_mesh_explode():
+    def q(s):
+        rng = np.random.default_rng(9)
+        t = s.createDataFrame(pa.table({
+            "k": pa.array(rng.integers(0, 10, 600), type=pa.int64()),
+            "arr": pa.array(
+                [[int(v) for v in rng.integers(0, 50, rng.integers(0, 4))]
+                 for _ in range(600)], type=pa.list_(pa.int64()))}))
+        return (t.select("k", F.explode(F.col("arr")).alias("v"))
+                .groupBy("v").agg(F.count("*").alias("n")))
 
     _mesh_vs_oracle(q)
 

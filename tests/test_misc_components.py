@@ -1,0 +1,196 @@
+"""Columnar cache (df.cache), z-order OPTIMIZE, Hive text serde, and
+generated docs — the remaining small inventory components."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSparkSession
+
+_CONF = {"spark.sql.shuffle.partitions": 2}
+
+
+@pytest.fixture()
+def spark():
+    s = TpuSparkSession(dict(_CONF))
+    yield s
+    s.stop()
+
+
+def _df(spark, n=800, seed=3):
+    rng = np.random.default_rng(seed)
+    return spark.createDataFrame(pa.table({
+        "a": pa.array(rng.integers(0, 50, n), type=pa.int64()),
+        "b": pa.array(rng.random(n), type=pa.float64()),
+        "s": pa.array([f"r{i % 9}" for i in range(n)],
+                      type=pa.string()),
+    }))
+
+
+# --------------------------------------------------------------- cache
+
+def test_cache_serves_second_action(spark, monkeypatch):
+    df = _df(spark).groupBy("a").agg(F.sum("b").alias("t")).cache()
+    first = df.collect_arrow()
+    assert df._cache_blob is not None
+    # second action must not re-plan: poison the planner
+    import spark_rapids_tpu.plan.overrides as ov
+
+    def boom(*a, **k):
+        raise AssertionError("replanned a cached DataFrame")
+
+    monkeypatch.setattr(ov, "plan_query", boom)
+    second = df.collect_arrow()
+    assert second.equals(first)
+    df.unpersist()
+    assert df._cache_blob is None
+
+
+def test_cache_blob_is_compressed_parquet(spark):
+    df = _df(spark, n=5000).cache()
+    raw = df.collect_arrow()
+    assert len(df._cache_blob) < raw.nbytes  # parquet-compressed
+
+
+# -------------------------------------------------------------- z-order
+
+def test_zorder_kernel_locality():
+    """Morton-sorted data clusters both dimensions: the first half of
+    rows covers about half the range of EACH key, unlike a plain sort
+    (which only clusters the primary key)."""
+    import jax
+
+    from spark_rapids_tpu.columnar.arrow_bridge import (
+        arrow_to_device,
+        device_to_arrow,
+    )
+    from spark_rapids_tpu.ops.zorder import zorder_sort
+
+    rng = np.random.default_rng(5)
+    n = 4096
+    t = pa.table({
+        "x": pa.array(rng.integers(0, 1 << 20, n), type=pa.int64()),
+        "y": pa.array(rng.integers(0, 1 << 20, n), type=pa.int64()),
+    })
+    out = device_to_arrow(zorder_sort(arrow_to_device(t), [0, 1]))
+    # an aligned quarter of the Morton curve is a quadrant of key
+    # space: BOTH dimensions roughly halve (a plain sort would only
+    # constrain the primary key)
+    quarter = out.slice(0, n // 4)
+    for col in ("x", "y"):
+        spread = (max(quarter.column(col).to_pylist()) -
+                  min(quarter.column(col).to_pylist()))
+        full = (max(out.column(col).to_pylist()) -
+                min(out.column(col).to_pylist()))
+        assert spread < 0.7 * full, (col, spread, full)
+    # row set preserved
+    assert sorted(out.column("x").to_pylist()) == \
+        sorted(t.column("x").to_pylist())
+
+
+def test_delta_optimize_zorder(spark, tmp_path):
+    from spark_rapids_tpu.lakehouse.delta import DeltaTable, load_snapshot
+
+    p = str(tmp_path / "zt")
+    _df(spark, n=500).write.format("delta").save(p)
+    DeltaTable.forPath(spark, p).optimize().executeZOrderBy("a", "b")
+    snap = load_snapshot(p)
+    assert snap.version == 1
+    out = spark.read.delta(p).collect_arrow()
+    assert out.num_rows == 500
+
+
+# ------------------------------------------------------------ hive text
+
+def test_hive_text_roundtrip(spark, tmp_path):
+    df = _df(spark, n=300)
+    p = str(tmp_path / "ht")
+    df.write.format("hivetext").save(p)
+    raw = open(os.path.join(p, "part-00000.txt")).readline()
+    assert "\x01" in raw  # LazySimpleSerDe delimiter
+    import pyarrow as _pa
+
+    schema = _pa.schema([("a", _pa.int64()), ("b", _pa.float64()),
+                         ("s", _pa.string())])
+    back = (spark.read.schema(schema).hivetext(p)
+            .groupBy("s").agg(F.count("*").alias("n")).collect_arrow())
+    want = df.groupBy("s").agg(F.count("*").alias("n")).collect_arrow()
+    assert sorted(back.column("n").to_pylist()) == \
+        sorted(want.column("n").to_pylist())
+
+
+def test_hive_text_nulls(spark, tmp_path):
+    t = pa.table({"a": pa.array([1, None, 3], type=pa.int64()),
+                  "s": pa.array(["x", None, "z"], type=pa.string())})
+    df = spark.createDataFrame(t)
+    p = str(tmp_path / "htn")
+    df.write.format("hivetext").save(p)
+    content = open(os.path.join(p, "part-00000.txt")).read()
+    assert "\\N" in content
+    import pyarrow as _pa
+
+    schema = _pa.schema([("a", _pa.int64()), ("s", _pa.string())])
+    back = spark.read.schema(schema).hivetext(p).collect_arrow()
+    assert back.column("a").to_pylist() == [1, None, 3]
+    assert back.column("s").to_pylist() == ["x", None, "z"]
+
+
+# ----------------------------------------------------------------- docs
+
+def test_generated_docs_current(tmp_path):
+    """docs/ artifacts match the generators (the reference keeps
+    supported_ops.md generated and checked in)."""
+    from spark_rapids_tpu.tools.gendocs import configs_md, supported_ops_md
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert open(os.path.join(repo, "docs", "configs.md")).read() == \
+        configs_md()
+    assert open(os.path.join(repo, "docs",
+                             "supported_ops.md")).read() == \
+        supported_ops_md()
+
+
+def test_docs_mention_core_surface():
+    from spark_rapids_tpu.tools.gendocs import configs_md, supported_ops_md
+
+    cfg = configs_md()
+    assert "spark.rapids.tpu.mesh" in cfg
+    assert "spark.rapids.shuffle.compression.codec" in cfg
+    ops = supported_ops_md()
+    assert "TpuShuffledHashJoinExec" in ops
+    assert "ArrayTransform" in ops
+
+
+# ------------------------------------------------------ parse_url / explain
+
+def test_parse_url(spark):
+    urls = ["https://user:pw@example.com:8080/a/b?x=1&y=2#frag",
+            "http://spark.apache.org/path", "not a url", None]
+    df = spark.createDataFrame(pa.table({"u": pa.array(
+        urls, type=pa.string())}))
+    out = df.select(
+        F.parse_url(F.col("u"), "HOST").alias("host"),
+        F.parse_url(F.col("u"), "PROTOCOL").alias("proto"),
+        F.parse_url(F.col("u"), "PATH").alias("path"),
+        F.parse_url(F.col("u"), "QUERY", "y").alias("qy"),
+    ).collect_arrow()
+    assert out.column("host").to_pylist() == [
+        "example.com", "spark.apache.org", None, None]
+    assert out.column("proto").to_pylist() == ["https", "http", None,
+                                               None]
+    assert out.column("qy").to_pylist() == ["2", None, None, None]
+
+
+def test_explain_potential_plan_api(spark):
+    @F.pandas_udf(returnType="long")
+    def slow(a):
+        return a
+
+    df = _df(spark).select(slow(F.col("a")).alias("x"))
+    txt = spark.explainPotentialTpuPlan(df)
+    assert "NOT_ON_TPU" in txt and "Arrow worker-process" in txt
+    ok = spark.explainPotentialTpuPlan(_df(spark).select("a"))
+    assert "NOT_ON_TPU" not in ok or "device" in ok
