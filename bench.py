@@ -108,6 +108,8 @@ def main():
         "spark.sql.shuffle.partitions": 8,
         "spark.rapids.sql.reader.batchSizeRows": 1 << 22,
         "spark.rapids.sql.batchSizeRows": 1 << 22,
+        # HBM-resident shuffle blocks: no host round trip per exchange
+        "spark.rapids.shuffle.mode": "DEVICE",
     })
 
     # ---- CPU baseline (pyarrow, the vectorized CPU engine) ----

@@ -130,9 +130,13 @@ STRING_MAX_BYTES = conf(
 SHUFFLE_MODE = conf(
     "spark.rapids.shuffle.mode", "MULTITHREADED",
     "MULTITHREADED (host-serialized, thread-pooled — reference "
-    "RapidsShuffleInternalManagerBase.scala:238) or ICI (device-resident "
-    "all-to-all collectives over the mesh, the UCX transport analog).", str,
-    checker=lambda v: v in ("MULTITHREADED", "ICI", "CACHE_ONLY"))
+    "RapidsShuffleInternalManagerBase.scala:238), DEVICE (blocks stay "
+    "HBM-resident in the spill catalog, no host round trip — the "
+    "RapidsCachingWriter/ShuffleBufferCatalog role), CACHE_ONLY (host "
+    "arrow blocks), or ICI (all-to-all collectives over the mesh, the "
+    "UCX transport analog).", str,
+    checker=lambda v: v in ("MULTITHREADED", "ICI", "CACHE_ONLY",
+                            "DEVICE"))
 SHUFFLE_COMPRESSION_CODEC = conf(
     "spark.rapids.shuffle.compression.codec", "zstd",
     "Codec for serialized shuffle blocks: none|zstd|zlib (the reference "

@@ -654,6 +654,18 @@ class TpuShuffleExchangeExec(PhysicalPlan):
         import threading
 
         self._lock = threading.Lock()
+        from spark_rapids_tpu.config import rapids_conf as rc
+
+        # DEVICE mode: blocks stay HBM-resident as spillables in the
+        # catalog — no device->host->device round trip per exchange
+        # (RapidsCachingWriter + ShuffleBufferCatalog role)
+        self._device_mode = bool(
+            conf is not None and conf.get(rc.SHUFFLE_MODE) == "DEVICE")
+        self._dev_blocks: List = []  # [(SpillableBatch, np offsets)]
+        self._fetches_left = self._nparts
+        # separate from _lock: map tasks park blocks WHILE the map-stage
+        # coordinator holds _lock
+        self._blocks_lock = threading.Lock()
         from spark_rapids_tpu.runtime.jit_cache import cached_jit
 
         kkey = (tuple(k.key() for k in key_exprs)
@@ -686,6 +698,16 @@ class TpuShuffleExchangeExec(PhysicalPlan):
         pb = partition.round_robin_partition(batch, self._nparts)
         return pb.batch, pb.counts
 
+    def _park_device_block(self, batch: ColumnBatch, offs: np.ndarray):
+        from spark_rapids_tpu.runtime.memory import SpillPriority, \
+            get_catalog
+        from spark_rapids_tpu.runtime.retry import retry_on_oom
+
+        sb = retry_on_oom(lambda: get_catalog().add_batch(
+            batch, SpillPriority.INPUT_FROM_SHUFFLE))
+        with self._blocks_lock:
+            self._dev_blocks.append((sb, offs))
+
     def _map_one(self, mgr, cpid: int):
         """One map task: execute a child partition, device-partition its
         batches, store contiguous slices (per-map-task parallel, the
@@ -697,12 +719,21 @@ class TpuShuffleExchangeExec(PhysicalPlan):
         try:
             for batch in self.children[0].execute_partition(cpid, tctx):
                 if self._nparts == 1:
-                    mgr.put(self._shuffle_id, 0, device_to_arrow(batch))
+                    if self._device_mode:
+                        self._park_device_block(
+                            batch,
+                            np.array([0, batch.row_count()], np.int64))
+                    else:
+                        mgr.put(self._shuffle_id, 0,
+                                device_to_arrow(batch))
                     continue
                 sorted_batch, counts = self._jit_partition(batch)
-                host = device_to_arrow(sorted_batch)
                 offs = np.concatenate(
                     [[0], np.cumsum(np.asarray(counts))])
+                if self._device_mode:
+                    self._park_device_block(sorted_batch, offs)
+                    continue
+                host = device_to_arrow(sorted_batch)
                 for rp in range(self._nparts):
                     lo, hi = int(offs[rp]), int(offs[rp + 1])
                     if hi > lo:
@@ -730,8 +761,65 @@ class TpuShuffleExchangeExec(PhysicalPlan):
                                   range(nchild)))
             self._map_done = True
 
+    def _fetch_device(self, pid) -> Iterator[ColumnBatch]:
+        """Reduce-side device fetch: gather this partition's row range
+        out of every HBM-resident block, coalesce on device."""
+        from spark_rapids_tpu.runtime.retry import retry_on_oom
+
+        with self._blocks_lock:
+            blocks = list(self._dev_blocks)
+        pieces = []
+        for sb, offs in blocks:
+            lo, hi = int(offs[pid]), int(offs[pid + 1])
+            if hi <= lo:
+                continue
+
+            def slice_step(s=sb, lo=lo, hi=hi):
+                b = s.get_batch()
+                cap = next_capacity(hi - lo)
+                idx = jnp.clip(jnp.arange(cap, dtype=jnp.int32) + lo,
+                               0, b.capacity - 1)
+                return b.gather(idx, hi - lo)
+
+            pieces.append(retry_on_oom(slice_step))
+        done = False
+        with self._blocks_lock:
+            self._fetches_left -= 1
+            done = self._fetches_left <= 0
+        if done:
+            for sb, _ in blocks:
+                sb.close()
+        if not pieces:
+            return
+        merged = (concat_batches(pieces) if len(pieces) > 1
+                  else pieces[0])
+        # ShuffleCoalesce batch-size discipline, same as the host path
+        from spark_rapids_tpu.config import rapids_conf as rc
+
+        max_rows = (self.conf.get(rc.BATCH_SIZE_ROWS) if self.conf
+                    else 1 << 20)
+        total = merged.row_count()
+        if total <= max_rows:
+            yield merged
+            return
+        for off in range(0, total, max_rows):
+            count = min(max_rows, total - off)
+            cap = next_capacity(count)
+            idx = jnp.clip(jnp.arange(cap, dtype=jnp.int32) + off, 0,
+                           merged.capacity - 1)
+            yield merged.gather(idx, count)
+
     def execute_partition(self, pid, ctx):
+        # Exchanges are stage barriers: release this task's device
+        # permits before blocking on the map stage, or reduce tasks
+        # starve the map tasks (GpuSemaphore releaseIfNecessary-before-
+        # blocking discipline, GpuShuffleExchangeExecBase)
+        sem.get().release_if_necessary(ctx.task_id)
         self._run_map_stage(ctx)
+        if self._device_mode:
+            _acquire(ctx)
+            yield from self._fetch_device(pid)
+            return
         mgr = get_shuffle_manager()
         tables = mgr.fetch(self._shuffle_id, pid)
         if not tables:
@@ -813,9 +901,13 @@ class TpuRangeShuffleExchangeExec(TpuShuffleExchangeExec):
                 dest = _binary_search(bounds, keys, jnp.int32(npt - 1),
                                       max(npt - 1, 1), upper=True)
                 pb = partition.partition_by_ids(b, dest, npt)
-                host = device_to_arrow(pb.batch)
                 offs = np.concatenate([[0],
                                        np.cumsum(np.asarray(pb.counts))])
+                if self._device_mode:
+                    self._park_device_block(pb.batch, offs)
+                    sb.close()
+                    continue
+                host = device_to_arrow(pb.batch)
                 for rp in range(npt):
                     lo, hi = int(offs[rp]), int(offs[rp + 1])
                     if hi > lo:
@@ -1115,17 +1207,23 @@ class TpuGenerateExec(PhysicalPlan):
         self.gen_alias = gen_alias
         self.position = position
 
-    def _explode_to_cap(self, batch: ColumnBatch, out_cap: int):
+    def _explode_to_cap(self, batch: ColumnBatch, out_cap: int,
+                        _pre=None):
         """Trace-safe explode into a static capacity; returns
-        (batch, overflow) — shared by the eager path (exact capacity)
-        and the mesh SPMD lowering (static + recompile-on-overflow)."""
+        (batch, overflow) — shared by the eager path (exact capacity,
+        which passes its sizing-pass results via _pre to avoid a second
+        evaluation of the array expression) and the mesh SPMD lowering
+        (static + recompile-on-overflow)."""
         from spark_rapids_tpu.ops import joinops
         from spark_rapids_tpu.sqltypes.datatypes import integer
 
-        ectx = EvalContext(batch)
-        arr = self.gen_alias.children[0].children[0].eval(ectx)
-        counts = jnp.where(batch.live_mask() & arr.validity,
-                           arr.lengths, 0).astype(jnp.int32)
+        if _pre is None:
+            ectx = EvalContext(batch)
+            arr = self.gen_alias.children[0].children[0].eval(ectx)
+            counts = jnp.where(batch.live_mask() & arr.validity,
+                               arr.lengths, 0).astype(jnp.int32)
+        else:
+            ectx, arr, counts = _pre
         lo = jnp.zeros((batch.capacity,), jnp.int32)
         pi, ei, total = joinops.expand_gather_maps(lo, counts, out_cap)
         overflow = total > out_cap
@@ -1154,7 +1252,8 @@ class TpuGenerateExec(PhysicalPlan):
         row_bytes = batch.device_size_bytes() // max(1, batch.capacity)
         with get_catalog().reserved(cap_out * (row_bytes + 16),
                                     "generate"):
-            out, _ovf = self._explode_to_cap(batch, cap_out)
+            out, _ovf = self._explode_to_cap(batch, cap_out,
+                                             _pre=(ectx, arr, counts))
             return out
 
     def execute_partition(self, pid, ctx):

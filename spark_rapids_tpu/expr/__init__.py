@@ -56,6 +56,9 @@ from spark_rapids_tpu.expr.collections import (  # noqa: F401
     Size,
     SortArray,
 )
-from spark_rapids_tpu.expr.jsonexpr import GetJsonObject  # noqa: F401
+from spark_rapids_tpu.expr.jsonexpr import (  # noqa: F401
+    GetJsonObject,
+    ParseUrl,
+)
 from spark_rapids_tpu.expr.deviceudf import DeviceUDF  # noqa: F401
 from spark_rapids_tpu.expr.generators import Explode, PosExplode  # noqa: F401

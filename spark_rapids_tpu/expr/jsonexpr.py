@@ -114,7 +114,15 @@ def extract_url(url: str, part: str, key=None):
     if part == "PROTOCOL":
         return u.scheme or None
     if part == "HOST":
-        return u.hostname
+        # java.net.URI preserves host case and IPv6 brackets (urllib's
+        # .hostname lowercases): extract raw from the netloc
+        host = u.netloc.rsplit("@", 1)[-1]
+        if host.startswith("["):
+            end = host.find("]")
+            host = host[:end + 1] if end >= 0 else host
+        else:
+            host = host.split(":", 1)[0]
+        return host or None
     if part == "PATH":
         return u.path or None
     if part == "QUERY":

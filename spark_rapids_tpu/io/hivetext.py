@@ -89,9 +89,27 @@ def read_hive_text(path: str, schema: pa.Schema) -> pa.Table:
     for c, field in enumerate(schema):
         arr = pa.array(cols[c], type=pa.string())
         if not pa.types.is_string(field.type):
-            arr = arr.cast(field.type)
+            arr = _cast_null_on_error(arr, field.type)
         arrays.append(arr)
     return pa.Table.from_arrays(arrays, schema=schema)
+
+
+def _cast_null_on_error(arr: pa.Array, t: pa.DataType) -> pa.Array:
+    """Hive semantics: unparseable fields become NULL, never errors."""
+    try:
+        return arr.cast(t)
+    except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+        pass
+    out = []
+    for v in arr.to_pylist():
+        if v is None:
+            out.append(None)
+            continue
+        try:
+            out.append(pa.scalar(v, type=pa.string()).cast(t).as_py())
+        except (pa.ArrowInvalid, ValueError):
+            out.append(None)
+    return pa.array(out, type=t)
 
 
 def _fmt(v) -> str:
