@@ -582,3 +582,9 @@ def parse_url(c, part: str, key=None) -> Column:
     from spark_rapids_tpu.expr.jsonexpr import ParseUrl
 
     return Column(ParseUrl(expr_of(c), part, key), "parse_url")
+
+
+def last(c, ignorenulls: bool = False) -> Column:
+    from spark_rapids_tpu.expr.aggregates import Last
+
+    return Column(Last(expr_of(c), ignore_nulls=ignorenulls), "last")

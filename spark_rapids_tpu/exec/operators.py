@@ -572,6 +572,7 @@ class CpuHashAggregateExec(PhysicalPlan):
     is_tpu = False
 
     _ARROW_FN = {"sum": "sum", "count": "count", "min": "min", "max": "max",
+                 "last": "last",
                  "avg": "mean", "first": "first"}
 
     def __init__(self, grouping, aggs, child, schema, conf):
@@ -611,6 +612,12 @@ class CpuHashAggregateExec(PhysicalPlan):
             arrow_fn = self._ARROW_FN[fn.name]
             if fn.name == "count" and fn.input is None:
                 agg_specs.append((in_names[i], "sum"))
+            elif fn.name in ("first", "last"):
+                # pyarrow defaults skip_nulls=True; Spark's ignore_nulls
+                # must be honored on the oracle path too
+                agg_specs.append((in_names[i], arrow_fn,
+                                  pc.ScalarAggregateOptions(
+                                      skip_nulls=fn.ignore_nulls)))
             else:
                 agg_specs.append((in_names[i], arrow_fn))
         if key_names:
@@ -618,8 +625,13 @@ class CpuHashAggregateExec(PhysicalPlan):
                 agg_specs)
         else:
             flat = {}
-            for (nm, fnname), a in zip(agg_specs, self.aggs):
-                val = getattr(pc, fnname)(work.column(nm))
+            for spec, a in zip(agg_specs, self.aggs):
+                nm, fnname = spec[0], spec[1]
+                if len(spec) > 2:  # first/last carry null options
+                    val = getattr(pc, fnname)(work.column(nm),
+                                              options=spec[2])
+                else:
+                    val = getattr(pc, fnname)(work.column(nm))
                 flat[a.name] = pa.array([val.as_py()],
                                         type=to_arrow_type(a.dtype))
             yield pa.table(flat)
@@ -628,7 +640,8 @@ class CpuHashAggregateExec(PhysicalPlan):
         out = {}
         for k in key_names:
             out[k] = res.column(k)
-        for (nm, fnname), a in zip(agg_specs, self.aggs):
+        for spec, a in zip(agg_specs, self.aggs):
+            nm, fnname = spec[0], spec[1]
             col = res.column(f"{nm}_{fnname}")
             out[a.name] = pc.cast(col, to_arrow_type(a.dtype))
         yield pa.table(out)
@@ -749,16 +762,25 @@ class TpuShuffleExchangeExec(PhysicalPlan):
             mgr = get_shuffle_manager()
             self._shuffle_id = mgr.new_shuffle_id()
             nchild = self.children[0].num_partitions
-            if nchild == 1:
-                self._map_one(mgr, 0)
-            else:
-                from concurrent.futures import ThreadPoolExecutor
+            try:
+                if nchild == 1:
+                    self._map_one(mgr, 0)
+                else:
+                    from concurrent.futures import ThreadPoolExecutor
 
-                with ThreadPoolExecutor(
-                        max_workers=min(8, nchild),
-                        thread_name_prefix="shuffle-map") as pool:
-                    list(pool.map(lambda c: self._map_one(mgr, c),
-                                  range(nchild)))
+                    with ThreadPoolExecutor(
+                            max_workers=min(8, nchild),
+                            thread_name_prefix="shuffle-map") as pool:
+                        list(pool.map(lambda c: self._map_one(mgr, c),
+                                      range(nchild)))
+            except BaseException:
+                # close partially-parked device blocks so a failed map
+                # stage leaks nothing and a retry starts clean
+                with self._blocks_lock:
+                    blocks, self._dev_blocks = self._dev_blocks, []
+                for sb, _ in blocks:
+                    sb.close()
+                raise
             self._map_done = True
 
     def _fetch_device(self, pid) -> Iterator[ColumnBatch]:
@@ -895,6 +917,21 @@ class TpuRangeShuffleExchangeExec(TpuShuffleExchangeExec):
             j = jnp.clip((jnp.arange(npt - 1, dtype=jnp.int32) + 1) *
                          live_ct // npt, 0, total_s - 1)
             bounds = [jnp.take(k, j) for k in skeys]
+            try:
+                self._range_partition_parked(parked, bounds, npt, mgr,
+                                             sortops, _binary_search)
+            except BaseException:
+                with self._blocks_lock:
+                    blocks, self._dev_blocks = self._dev_blocks, []
+                for bsb, _ in blocks:
+                    bsb.close()
+                for sb in parked:
+                    sb.close()
+                raise
+            self._map_done = True
+
+    def _range_partition_parked(self, parked, bounds, npt, mgr, sortops,
+                                _binary_search):
             for sb in parked:
                 b = sb.get_batch()
                 keys = sortops.order_keys(b, self.orders)
@@ -914,7 +951,6 @@ class TpuRangeShuffleExchangeExec(TpuShuffleExchangeExec):
                         mgr.put(self._shuffle_id, rp,
                                 host.slice(lo, hi - lo))
                 sb.close()
-            self._map_done = True
 
 
 class CpuShuffleExchangeExec(PhysicalPlan):
@@ -1467,14 +1503,17 @@ class TpuWindowExec(PhysicalPlan):
                                        start, end, isinstance(fn, Max))
                     d = d.astype(inp_s.data.dtype)
                     v = cnt > 0
-                elif isinstance(fn, First):
+                elif isinstance(fn, First):  # Last subclasses First
+                    from spark_rapids_tpu.expr.aggregates import Last
+
+                    is_last = isinstance(fn, Last)
                     d, v = W.frame_first_last(
                         inp_s.data, inp_s.validity, sw, start, end,
-                        last=False, ignore_nulls=fn.ignore_nulls)
+                        last=is_last, ignore_nulls=fn.ignore_nulls)
                     if isinstance(dt, StringType):
                         lens, _ = W.frame_first_last(
                             inp_s.lengths, inp_s.validity, sw, start, end,
-                            last=False, ignore_nulls=fn.ignore_nulls)
+                            last=is_last, ignore_nulls=fn.ignore_nulls)
                         d_o, v_o = to_original(d, v)
                         new_cols.append(DeviceColumn(
                             dt, d_o, v_o, jnp.take(lens, sw.inv)))

@@ -168,12 +168,17 @@ def compute_windows(table: pa.Table, window_exprs: List[Alias]) -> pa.Table:
                         result[i] = _pymin(vals) if vals else None
                     elif isinstance(fn, Max):
                         result[i] = _pymax(vals) if vals else None
-                    elif isinstance(fn, First):
+                    elif isinstance(fn, First):  # Last subclasses it
+                        from spark_rapids_tpu.expr.aggregates import Last
+
+                        is_last = isinstance(fn, Last)
                         if fn.ignore_nulls:
-                            result[i] = vals[0] if vals else None
+                            result[i] = ((vals[-1] if is_last else
+                                          vals[0]) if vals else None)
                         else:
-                            result[i] = (inp_vals[idxs[lo]] if hi >= lo
-                                         else None)
+                            pos = hi if is_last else lo
+                            result[i] = (inp_vals[idxs[pos]]
+                                         if hi >= lo else None)
                     else:
                         raise NotImplementedError(type(fn).__name__)
         out_arrays.append(pa.array(result,

@@ -34,7 +34,7 @@ from spark_rapids_tpu.expr.datetimes import (  # noqa: F401
     Year, Month, DayOfMonth, Hour, Minute, Second,
 )
 from spark_rapids_tpu.expr.aggregates import (  # noqa: F401
-    AggregateFunction, Sum, Count, Min, Max, Average, First,
+    AggregateFunction, Sum, Count, Min, Max, Average, First, Last,
 )
 from spark_rapids_tpu.expr.hashexpr import Murmur3Hash, XxHash64  # noqa: F401
 from spark_rapids_tpu.expr.windows import (  # noqa: F401

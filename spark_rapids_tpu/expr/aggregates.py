@@ -235,15 +235,22 @@ class First(AggregateFunction):
     def buffer_types(self):
         return [self.dtype]
 
+    _take_last = False  # Last flips to a segment_max over positions
+
     def _first(self, values: DeviceColumn, valid, gid, cap):
-        pos = jnp.arange(values.data.shape[0], dtype=jnp.int32)
-        big = jnp.int32(values.data.shape[0])
         import jax
 
-        fp = jax.ops.segment_min(jnp.where(valid, pos, big), gid,
-                                 num_segments=cap)
-        found = fp < big
-        safe = jnp.clip(fp, 0, values.data.shape[0] - 1)
+        n = values.data.shape[0]
+        pos = jnp.arange(n, dtype=jnp.int32)
+        if self._take_last:
+            fp = jax.ops.segment_max(jnp.where(valid, pos, -1), gid,
+                                     num_segments=cap)
+            found = fp >= 0
+        else:
+            fp = jax.ops.segment_min(jnp.where(valid, pos, n), gid,
+                                     num_segments=cap)
+            found = fp < n
+        safe = jnp.clip(fp, 0, n - 1)
         data = jnp.take(values.data, safe, axis=0)
         lengths = None if values.lengths is None else jnp.take(
             values.lengths, safe)
@@ -262,3 +269,14 @@ class First(AggregateFunction):
 
     def evaluate(self, buffers):
         return buffers[0]
+
+
+class Last(First):
+    """last(col): final (by sorted position) value per group — First
+    with segment_max over positions."""
+
+    name = "last"
+    _take_last = True
+
+    def key(self):
+        return ("last", self.ignore_nulls, self.children[0].key())

@@ -18,8 +18,11 @@ Covered (reference files in delta-lake/common + delta-24x):
   rewrites through the engine, committed as remove+add.
 
 v1 rewrites the full table on merge/delete/update (no file-level
-pruning yet) and does not write checkpoints; both are compatible with
-other Delta readers (the log stays correct).
+pruning yet). Parquet checkpoints are written every CHECKPOINT_INTERVAL
+commits (and via write_checkpoint); map-typed protocol fields are
+JSON-string-encoded in the checkpoint (parquet cannot hold empty
+structs), which this reader decodes — external Delta readers should
+replay the JSON log, which stays fully protocol-correct.
 """
 
 from __future__ import annotations
@@ -91,14 +94,15 @@ def _read_checkpoint(table_path: str) -> Tuple[int, Dict[str, dict],
     t = pq.read_table(cp)
     for row in t.to_pylist():
         if row.get("add"):
-            add = row["add"]
+            add = dict(row["add"])
+            pv = add.get("partitionValues")
+            if isinstance(pv, str):  # JSON-encoded map field
+                add["partitionValues"] = json.loads(pv)
             files[add["path"]] = add
         if row.get("metaData"):
             meta = row["metaData"]
-            fmt = meta.get("schemaString")
-            if isinstance(fmt, str):
-                meta["schemaString"] = fmt
-            parts = list(meta.get("partitionColumns") or [])
+            parts = [c for c in (meta.get("partitionColumns") or [])
+                     if c]
     return v, files, meta, parts
 
 
@@ -226,8 +230,13 @@ def read_delta(session, path: str):
 
 # ----------------------------------------------------------------- write
 
+CHECKPOINT_INTERVAL = 10
+
+
 def _commit(table_path: str, version: int, actions: List[dict]):
-    """Write one atomic commit file (OptimisticTransaction.commit)."""
+    """Write one atomic commit file (OptimisticTransaction.commit);
+    every CHECKPOINT_INTERVAL versions also writes a parquet checkpoint
+    + _last_checkpoint pointer so log replay stays O(interval)."""
     os.makedirs(_log_path(table_path), exist_ok=True)
     target = _commit_file(table_path, version)
     tmp = target + f".tmp-{uuid.uuid4().hex[:8]}"
@@ -241,6 +250,38 @@ def _commit(table_path: str, version: int, actions: List[dict]):
         raise RuntimeError(
             f"concurrent commit conflict at version {version}")
     os.unlink(tmp)
+    if version > 0 and version % CHECKPOINT_INTERVAL == 0:
+        write_checkpoint(table_path)
+
+
+def write_checkpoint(table_path: str):
+    """Materialize the current snapshot as a parquet checkpoint
+    (Checkpoints.writeCheckpoint role)."""
+    snap = load_snapshot(table_path)
+    meta = {"id": str(uuid.uuid4()),
+            "schemaString": json.dumps(snap.schema_json)
+            if snap.schema_json else "{}",
+            "partitionColumns": list(snap.partition_cols) or [""],
+            "createdTime": int(time.time() * 1000)}
+    rows = [{"add": None, "metaData": meta}]
+    for add in snap.files.values():
+        a = dict(add)
+        # map-typed protocol fields encode as JSON strings (parquet
+        # cannot hold empty structs; load_snapshot decodes)
+        a["partitionValues"] = json.dumps(
+            a.get("partitionValues") or {})
+        rows.append({"add": a, "metaData": None})
+    t = pa.Table.from_pylist(rows)
+    cp = os.path.join(_log_path(table_path),
+                      f"{snap.version:020d}.checkpoint.parquet")
+    pq.write_table(t, cp)
+    # atomic pointer update: a reader between truncate and write (or a
+    # crash mid-write) must never see a partial _last_checkpoint
+    lc = os.path.join(_log_path(table_path), "_last_checkpoint")
+    tmp = lc + f".tmp-{uuid.uuid4().hex[:8]}"
+    with open(tmp, "w") as f:
+        json.dump({"version": snap.version, "size": len(rows)}, f)
+    os.replace(tmp, lc)
 
 
 def _meta_action(schema: pa.Schema, partition_cols: List[str]) -> dict:

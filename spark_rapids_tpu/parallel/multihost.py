@@ -1,0 +1,63 @@
+"""Multi-host initialization — the heartbeat/topology control plane
+analog (reference RapidsShuffleHeartbeatManager.scala + Plugin.scala
+driver RPC: executors learn peer topology so UCX endpoints connect).
+
+On TPU pods the runtime already knows the topology: each host runs one
+process, `jax.distributed.initialize` wires the coordination service,
+and `jax.devices()` then spans EVERY host's chips — the mesh compiler
+(parallel/plan_compiler.py) and collectives work unchanged, with XLA
+routing intra-slice traffic over ICI and cross-slice traffic over DCN.
+No heartbeats, endpoint tables, or bounce buffers to manage.
+
+Single-host sessions skip initialization (the default path everywhere
+else in the engine)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the multi-host coordination service. On Cloud TPU pods all
+    arguments are auto-detected from the metadata server; elsewhere pass
+    them explicitly (reference: executors registering with the driver
+    plugin, Plugin.scala:417-437)."""
+    global _initialized
+    if _initialized:
+        return
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+
+
+def global_device_count() -> int:
+    return len(jax.devices())
+
+
+def local_device_count() -> int:
+    return len(jax.local_devices())
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def make_global_executor(conf=None):
+    """MeshQueryExecutor over EVERY device across all hosts — the
+    multi-host distributed engine entry point. Within one host this is
+    identical to spark.rapids.tpu.mesh=len(jax.devices())."""
+    from spark_rapids_tpu.parallel.plan_compiler import MeshQueryExecutor
+
+    return MeshQueryExecutor.for_devices(global_device_count(), conf)

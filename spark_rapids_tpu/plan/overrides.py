@@ -132,11 +132,11 @@ class TpuOverrides:
     def _tag_window(self, node: "L.Window", meta: PlanMeta):
         from spark_rapids_tpu.expr import windows as we
         from spark_rapids_tpu.expr.aggregates import (
-            Average, Count, First, Max, Min, Sum,
+            Average, Count, First, Last, Max, Min, Sum,
         )
         from spark_rapids_tpu.sqltypes import NumericType, StringType
 
-        supported_aggs = (Sum, Count, Min, Max, Average, First)
+        supported_aggs = (Sum, Count, Min, Max, Average, First, Last)
         for a in node.window_exprs:
             wexpr = a.children[0]
             for e in wexpr.spec.partitions:

@@ -137,3 +137,38 @@ def test_read_runs_on_engine_scan(spark, tmp_path):
     assert "TpuFileScanExec" in names, names
     total = sum(df.collect_arrow().column("n").to_pylist())
     assert total == 49
+
+
+def test_checkpoint_roundtrip(spark, tmp_path):
+    """Parquet checkpoints: written explicitly (or every 10th commit)
+    and replayed through _last_checkpoint, with newer JSON commits
+    layered on top."""
+    from spark_rapids_tpu.lakehouse.delta import (
+        load_snapshot,
+        write_checkpoint,
+    )
+
+    p = str(tmp_path / "cp")
+    _df(spark, n=60).write.format("delta").save(p)
+    _df(spark, n=40, key_start=60).write.format("delta") \
+        .mode("append").save(p)
+    write_checkpoint(p)
+    assert os.path.exists(os.path.join(p, "_delta_log",
+                                       "_last_checkpoint"))
+    # a commit after the checkpoint must layer on top of it
+    _df(spark, n=10, key_start=100).write.format("delta") \
+        .mode("append").save(p)
+    snap = load_snapshot(p)
+    assert snap.version == 2
+    assert spark.read.delta(p).count() == 110
+
+
+def test_auto_checkpoint_every_10_commits(spark, tmp_path):
+    p = str(tmp_path / "cp10")
+    _df(spark, n=10).write.format("delta").save(p)
+    for i in range(10):
+        _df(spark, n=5, key_start=10 + i * 5).write.format("delta") \
+            .mode("append").save(p)
+    assert os.path.exists(os.path.join(
+        p, "_delta_log", f"{10:020d}.checkpoint.parquet"))
+    assert spark.read.delta(p).count() == 60

@@ -258,3 +258,28 @@ def test_mesh_skew_overflow_retry():
                                   F.count("*").alias("n"))
 
     _mesh_vs_oracle(q)
+
+
+def test_multihost_helper_single_process():
+    """Multi-host helper: device counts + global-mesh executor on one
+    process (the virtual 8-device mesh)."""
+    import pyarrow as pa
+
+    from spark_rapids_tpu.parallel import multihost as mh
+
+    assert mh.global_device_count() == 8
+    assert mh.local_device_count() == 8
+    assert mh.process_index() == 0
+    from spark_rapids_tpu.api.session import TpuSparkSession
+
+    spark = TpuSparkSession({"spark.sql.shuffle.partitions": 2})
+    try:
+        df = (spark.createDataFrame(pa.table({
+            "k": pa.array(list(range(100)) * 4),
+            "v": pa.array([float(i) for i in range(400)])}))
+            .groupBy("k").agg(F.sum("v").alias("s")))
+        phys, _ = df._physical()
+        out = mh.make_global_executor(spark.rapids_conf).execute(phys)
+        assert out.num_rows == 100
+    finally:
+        spark.stop()

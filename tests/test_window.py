@@ -324,3 +324,45 @@ def test_window_in_orderby_rejected():
         return None
 
     assert "not allowed in orderBy" in with_tpu_session(q)
+
+
+def test_last_aggregate_and_window():
+    """last() as a group aggregate and over window frames (device vs
+    oracle)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.window import Window
+    from spark_rapids_tpu.testing.asserts import (
+        assert_tpu_and_cpu_are_equal_collect,
+    )
+
+    rng = np.random.default_rng(31)
+    n = 1500
+    vals = [float(v) if v % 7 else None
+            for v in rng.integers(0, 100, n)]
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 12, n), type=pa.int64()),
+        "o": pa.array(np.arange(n), type=pa.int64()),
+        "v": pa.array(vals, type=pa.float64())})
+    mk = lambda s: s.createDataFrame(t)
+
+    # group-agg last is order-sensitive (Spark calls it
+    # non-deterministic): check the well-defined identity
+    # last(o) == max(o) when input arrives in o-order, on the device
+    from spark_rapids_tpu.testing.asserts import with_tpu_session
+
+    out = with_tpu_session(
+        lambda s: mk(s).groupBy("k")
+        .agg(F.last("o", ignorenulls=True).alias("lo"),
+             F.max("o").alias("mo")).collect_arrow(),
+        {"spark.sql.shuffle.partitions": 1})
+    assert out.column("lo").to_pylist() == out.column("mo").to_pylist()
+
+    w = Window.partitionBy("k").orderBy("o").rowsBetween(-3, 0)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: mk(s).select(
+            "k", "o", F.last("v", ignorenulls=True).over(w).alias("lw"),
+            F.first("v", ignorenulls=True).over(w).alias("fw")),
+        conf={"spark.sql.shuffle.partitions": 2})
