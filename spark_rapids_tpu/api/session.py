@@ -56,6 +56,16 @@ class DataFrameReader:
         return self
 
     def load(self, path: str):
+        from spark_rapids_tpu.io.datasource import lookup_format
+
+        ext = lookup_format(self._format)
+        if ext is None:
+            # built-in providers (iceberg, ...) register on first use
+            import spark_rapids_tpu.lakehouse  # noqa: F401
+
+            ext = lookup_format(self._format)
+        if ext is not None:
+            return ext(self.session, path, self._schema, self._options)
         if self._format == "delta":
             return self.delta(path)
         return getattr(self, self._format)(path)
@@ -259,8 +269,18 @@ class TpuSparkSession:
 
     def stop(self):
         global _active
-        with _active_lock:
-            _active = None
+        try:
+            from spark_rapids_tpu.runtime.memory import _catalog
+
+            if _catalog is not None:
+                _catalog.check_leaks(
+                    raise_on_leak=bool(self.rapids_conf.get(
+                        rc.LEAK_DETECTION)))
+        finally:
+            # the session must deregister even when the leak check
+            # raises, or active() keeps returning a dead session
+            with _active_lock:
+                _active = None
 
     @staticmethod
     def active() -> Optional["TpuSparkSession"]:

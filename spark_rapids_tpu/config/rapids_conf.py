@@ -163,6 +163,10 @@ PARQUET_READER_TYPE = conf(
     "PERFILE, COALESCING, MULTITHREADED or AUTO "
     "(reference RapidsConf.scala:965-981).", str,
     checker=lambda v: v in ("AUTO", "PERFILE", "COALESCING", "MULTITHREADED"))
+LEAK_DETECTION = conf(
+    "spark.rapids.memory.leakDetection", False,
+    "Raise at session stop when spillable buffers were never closed "
+    "(MemoryCleaner leak-tracking role); off = warn only.", bool)
 CONCURRENT_PYTHON_WORKERS = conf(
     "spark.rapids.python.concurrentPythonWorkers", 4,
     "Worker processes for the pandas-UDF Arrow exchange (reference "

@@ -890,34 +890,38 @@ class TpuRangeShuffleExchangeExec(TpuShuffleExchangeExec):
             self._shuffle_id = mgr.new_shuffle_id()
             catalog = get_catalog()
             parked = []
-            nchild = self.children[0].num_partitions
-            for cpid in range(nchild):
-                for b in self.children[0].execute_partition(cpid, ctx):
-                    parked.append(retry_on_oom(
-                        lambda bb=b: catalog.add_batch(bb)))
-            if not parked:
-                self._map_done = True
-                return
-            npt = self._nparts
-            samples = None
-            for sb in parked:
-                b = sb.get_batch()
-                keys = sortops.order_keys(b, self.orders)
-                s_n = min(self._samples, b.capacity)
-                pos = (jnp.arange(s_n, dtype=jnp.int32) * b.capacity) // s_n
-                samp = [jnp.take(k, pos) for k in keys]
-                samples = (samp if samples is None else
-                           [jnp.concatenate([a, c])
-                            for a, c in zip(samples, samp)])
-            total_s = int(samples[0].shape[0])
-            perm = sort_permutation(samples, total_s)
-            skeys = [jnp.take(g, perm) for g in samples]
-            # garbage/dead sample rows carry leading null-rank 2
-            live_ct = jnp.sum(skeys[0] < 2).astype(jnp.int32)
-            j = jnp.clip((jnp.arange(npt - 1, dtype=jnp.int32) + 1) *
-                         live_ct // npt, 0, total_s - 1)
-            bounds = [jnp.take(k, j) for k in skeys]
+            # the whole map stage (parking, sampling, partitioning) must
+            # clean up parked buffers + device blocks on ANY failure
             try:
+                nchild = self.children[0].num_partitions
+                for cpid in range(nchild):
+                    for b in self.children[0].execute_partition(cpid,
+                                                                ctx):
+                        parked.append(retry_on_oom(
+                            lambda bb=b: catalog.add_batch(bb)))
+                if not parked:
+                    self._map_done = True
+                    return
+                npt = self._nparts
+                samples = None
+                for sb in parked:
+                    b = sb.get_batch()
+                    keys = sortops.order_keys(b, self.orders)
+                    s_n = min(self._samples, b.capacity)
+                    pos = (jnp.arange(s_n, dtype=jnp.int32) *
+                           b.capacity) // s_n
+                    samp = [jnp.take(k, pos) for k in keys]
+                    samples = (samp if samples is None else
+                               [jnp.concatenate([a, c])
+                                for a, c in zip(samples, samp)])
+                total_s = int(samples[0].shape[0])
+                perm = sort_permutation(samples, total_s)
+                skeys = [jnp.take(g, perm) for g in samples]
+                # garbage/dead sample rows carry leading null-rank 2
+                live_ct = jnp.sum(skeys[0] < 2).astype(jnp.int32)
+                j = jnp.clip((jnp.arange(npt - 1, dtype=jnp.int32) + 1) *
+                             live_ct // npt, 0, total_s - 1)
+                bounds = [jnp.take(k, j) for k in skeys]
                 self._range_partition_parked(parked, bounds, npt, mgr,
                                              sortops, _binary_search)
             except BaseException:

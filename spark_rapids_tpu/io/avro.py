@@ -84,6 +84,18 @@ def _arrow_type(schema) -> pa.DataType:
             return pa.timestamp("ms")
         if lt == "decimal":
             raise AvroError("avro decimal unsupported")
+        if t == "record":
+            return pa.struct([
+                pa.field(f["name"], _arrow_type(f["type"]))
+                for f in schema["fields"]])
+        if t == "array":
+            return pa.list_(_arrow_type(schema["items"]))
+        if t == "map":
+            return pa.map_(pa.string(), _arrow_type(schema["values"]))
+        if t == "enum":
+            return pa.string()
+        if t == "fixed":
+            return pa.binary()
         return _arrow_type(t)
     if isinstance(schema, list):  # union
         non_null = [s for s in schema if s != "null"]
@@ -111,9 +123,41 @@ def _read_value(r: _Reader, schema) -> Any:
             return r.string()
         raise AvroError(f"avro type {schema!r} unsupported")
     if isinstance(schema, dict):
-        return _read_value(r, schema["type"]) \
-            if not isinstance(schema["type"], dict) else \
-            _read_value(r, schema["type"])
+        t = schema["type"]
+        if t == "record":
+            return {f["name"]: _read_value(r, f["type"])
+                    for f in schema["fields"]}
+        if t == "array":
+            out = []
+            while True:
+                n = r.zigzag_long()
+                if n == 0:
+                    break
+                if n < 0:  # block with byte-size prefix
+                    r.zigzag_long()
+                    n = -n
+                for _ in range(n):
+                    out.append(_read_value(r, schema["items"]))
+            return out
+        if t == "map":
+            out = {}
+            while True:
+                n = r.zigzag_long()
+                if n == 0:
+                    break
+                if n < 0:
+                    r.zigzag_long()
+                    n = -n
+                for _ in range(n):
+                    k = r.string()
+                    out[k] = _read_value(r, schema["values"])
+            return out
+        if t == "enum":
+            idx = r.zigzag_long()
+            return schema["symbols"][idx]
+        if t == "fixed":
+            return r.read(schema["size"])
+        return _read_value(r, t)
     if isinstance(schema, list):  # union: branch index then value
         idx = r.zigzag_long()
         if idx < 0 or idx >= len(schema):
@@ -122,13 +166,14 @@ def _read_value(r: _Reader, schema) -> Any:
     raise AvroError(f"avro type {schema!r} unsupported")
 
 
-def read_avro(path: str) -> pa.Table:
+def _read_container(path: str):
+    """Container framing shared by every reader: -> (schema, iterator of
+    (record_count, decoded block _Reader))."""
     with open(path, "rb") as f:
         data = f.read()
     r = _Reader(data)
     if r.read(4) != MAGIC:
         raise AvroError(f"{path}: not an avro container file")
-    # file metadata map
     meta: Dict[str, bytes] = {}
     while True:
         n = r.zigzag_long()
@@ -144,25 +189,34 @@ def read_avro(path: str) -> pa.Table:
     sync = r.read(16)
     schema = json.loads(meta["avro.schema"])
     codec = meta.get("avro.codec", b"null").decode()
+
+    def blocks():
+        while not r.at_end():
+            nrecords = r.zigzag_long()
+            nbytes = r.zigzag_long()
+            block = r.read(nbytes)
+            if codec == "deflate":
+                block = zlib.decompress(block, -15)
+            elif codec != "null":
+                raise AvroError(f"avro codec {codec!r} unsupported")
+            yield nrecords, _Reader(block)
+            if r.read(16) != sync:
+                raise AvroError("sync marker mismatch")
+
+    return schema, blocks()
+
+
+def read_avro(path: str) -> pa.Table:
+    schema, blocks = _read_container(path)
     if schema.get("type") != "record":
         raise AvroError("top-level avro schema must be a record")
     fields = schema["fields"]
 
     cols: Dict[str, List] = {f["name"]: [] for f in fields}
-    while not r.at_end():
-        nrecords = r.zigzag_long()
-        nbytes = r.zigzag_long()
-        block = r.read(nbytes)
-        if codec == "deflate":
-            block = zlib.decompress(block, -15)
-        elif codec != "null":
-            raise AvroError(f"avro codec {codec!r} unsupported")
-        br = _Reader(block)
+    for nrecords, br in blocks:
         for _ in range(nrecords):
             for fld in fields:
                 cols[fld["name"]].append(_read_value(br, fld["type"]))
-        if r.read(16) != sync:
-            raise AvroError("sync marker mismatch")
 
     arrays = []
     names = []
@@ -206,7 +260,28 @@ def _write_value(out: bytearray, schema, v):
         _write_value(out, schema[non_null_idx], v)
         return
     if isinstance(schema, dict):
-        _write_value(out, schema["type"], v)
+        t = schema["type"]
+        if t == "record":
+            for f in schema["fields"]:
+                _write_value(out, f["type"], v.get(f["name"]))
+            return
+        if t == "array":
+            if v:
+                out += _zigzag_encode(len(v))
+                for item in v:
+                    _write_value(out, schema["items"], item)
+            out += _zigzag_encode(0)
+            return
+        if t == "map":
+            if v:
+                out += _zigzag_encode(len(v))
+                for k, item in v.items():
+                    kb = k.encode("utf-8")
+                    out += _zigzag_encode(len(kb)) + kb
+                    _write_value(out, schema["values"], item)
+            out += _zigzag_encode(0)
+            return
+        _write_value(out, t, v)
         return
     if schema == "null":
         return
@@ -246,16 +321,6 @@ def write_avro(table: pa.Table, path: str, codec: str = "deflate"):
         fields.append({"name": f.name,
                        "type": ["null", _avro_schema_of(f.type)]})
     schema = {"type": "record", "name": "row", "fields": fields}
-    meta_out = bytearray()
-    meta_out += _zigzag_encode(2)
-    for k, v in (("avro.schema", json.dumps(schema).encode()),
-                 ("avro.codec", codec.encode())):
-        kb = k.encode()
-        meta_out += _zigzag_encode(len(kb)) + kb
-        meta_out += _zigzag_encode(len(v)) + v
-    meta_out += _zigzag_encode(0)
-    sync = b"SPARKTPUAVROSYNC"  # 16 bytes
-    body = bytearray()
     cols = [c.combine_chunks() for c in table.columns]
     # timestamps serialize as micros since epoch
     norm = []
@@ -271,11 +336,52 @@ def write_avro(table: pa.Table, path: str, codec: str = "deflate"):
     for i in range(n):
         for c, fld in zip(norm, fields):
             _write_value(block, fld["type"], c[i].as_py())
-    payload = bytes(block)
+    _write_container(path, schema, n, bytes(block), codec)
+
+
+def _write_container(path: str, schema: dict, nrecords: int,
+                     raw_block: bytes, codec: str):
+    """Container framing shared by every writer."""
+    meta_out = bytearray()
+    meta_out += _zigzag_encode(2)
+    for k, v in (("avro.schema", json.dumps(schema).encode()),
+                 ("avro.codec", codec.encode())):
+        kb = k.encode()
+        meta_out += _zigzag_encode(len(kb)) + kb
+        meta_out += _zigzag_encode(len(v)) + v
+    meta_out += _zigzag_encode(0)
+    sync = b"SPARKTPUAVROSYNC"  # 16 bytes
+    payload = raw_block
     if codec == "deflate":
         co = zlib.compressobj(wbits=-15)
         payload = co.compress(payload) + co.flush()
-    body += _zigzag_encode(n) + _zigzag_encode(len(payload)) + payload
+    body = bytearray()
+    body += _zigzag_encode(nrecords) + \
+        _zigzag_encode(len(payload)) + payload
     body += sync
     with open(path, "wb") as f:
         f.write(MAGIC + bytes(meta_out) + sync + bytes(body))
+
+
+def write_avro_records(path: str, schema: dict, records: List[dict],
+                       codec: str = "deflate"):
+    """Write arbitrary record dicts under an explicit avro schema
+    (nested records/arrays/maps supported) — the fixture/export path
+    for protocol files like Iceberg manifests."""
+    block = bytearray()
+    for rec in records:
+        for fld in schema["fields"]:
+            _write_value(block, fld["type"], rec.get(fld["name"]))
+    _write_container(path, schema, len(records), bytes(block), codec)
+
+
+def read_avro_records(path: str) -> List[dict]:
+    """Read an avro container file as raw record dicts (nested types
+    preserved) — the protocol-file reader for Iceberg manifests."""
+    schema, blocks = _read_container(path)
+    out: List[dict] = []
+    for nrecords, br in blocks:
+        for _ in range(nrecords):
+            out.append({f["name"]: _read_value(br, f["type"])
+                        for f in schema["fields"]})
+    return out

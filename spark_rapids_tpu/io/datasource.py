@@ -1,0 +1,36 @@
+"""External data-source SPI — the ExternalSource / AvroProviderImpl
+analog (reference ExternalSource.scala: pluggable provider rules that
+extend the planner's format coverage without touching the core).
+
+Third-party formats register a reader factory; `spark.read.format(name)
+.load(path)` resolves through this registry before the built-ins.
+
+    from spark_rapids_tpu.io.datasource import register_format
+
+    def my_reader(session, path, schema, options) -> DataFrame: ...
+    register_format("myformat", my_reader)
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+_SOURCES: Dict[str, Callable] = {}
+_lock = threading.Lock()
+
+
+def register_format(name: str, reader: Callable) -> None:
+    """reader(session, path, schema, options) -> DataFrame."""
+    with _lock:
+        _SOURCES[name] = reader
+
+
+def unregister_format(name: str) -> None:
+    with _lock:
+        _SOURCES.pop(name, None)
+
+
+def lookup_format(name: str) -> Optional[Callable]:
+    with _lock:
+        return _SOURCES.get(name)

@@ -3,3 +3,4 @@ from spark_rapids_tpu.lakehouse.delta import (  # noqa: F401
     read_delta,
     write_delta,
 )
+from spark_rapids_tpu.lakehouse.iceberg import read_iceberg  # noqa: F401

@@ -18,11 +18,10 @@ Covered (reference files in delta-lake/common + delta-24x):
   rewrites through the engine, committed as remove+add.
 
 v1 rewrites the full table on merge/delete/update (no file-level
-pruning yet). Parquet checkpoints are written every CHECKPOINT_INTERVAL
-commits (and via write_checkpoint); map-typed protocol fields are
-JSON-string-encoded in the checkpoint (parquet cannot hold empty
-structs), which this reader decodes — external Delta readers should
-replay the JSON log, which stays fully protocol-correct.
+pruning yet). Parquet checkpoints (written every CHECKPOINT_INTERVAL
+commits and via write_checkpoint) carry spec-conformant protocol /
+metaData / add rows with map-typed fields, so readers that start from
+_last_checkpoint — as spec-compliant readers must — stay compatible.
 """
 
 from __future__ import annotations
@@ -96,11 +95,19 @@ def _read_checkpoint(table_path: str) -> Tuple[int, Dict[str, dict],
         if row.get("add"):
             add = dict(row["add"])
             pv = add.get("partitionValues")
-            if isinstance(pv, str):  # JSON-encoded map field
+            if isinstance(pv, str):  # legacy JSON-encoded map field
                 add["partitionValues"] = json.loads(pv)
+            elif isinstance(pv, list):  # arrow map -> [(k, v), ...]
+                add["partitionValues"] = dict(pv)
             files[add["path"]] = add
         if row.get("metaData"):
-            meta = row["metaData"]
+            meta = dict(row["metaData"])
+            fmt = meta.get("format")
+            if isinstance(fmt, dict) and isinstance(
+                    fmt.get("options"), list):
+                fmt["options"] = dict(fmt["options"])
+            if isinstance(meta.get("configuration"), list):
+                meta["configuration"] = dict(meta["configuration"])
             parts = [c for c in (meta.get("partitionColumns") or [])
                      if c]
     return v, files, meta, parts
@@ -254,24 +261,56 @@ def _commit(table_path: str, version: int, actions: List[dict]):
         write_checkpoint(table_path)
 
 
+_CP_MAP = pa.map_(pa.string(), pa.string())
+_CP_SCHEMA = pa.schema([
+    ("protocol", pa.struct([("minReaderVersion", pa.int32()),
+                            ("minWriterVersion", pa.int32())])),
+    ("metaData", pa.struct([
+        ("id", pa.string()),
+        ("format", pa.struct([("provider", pa.string()),
+                              ("options", _CP_MAP)])),
+        ("schemaString", pa.string()),
+        ("partitionColumns", pa.list_(pa.string())),
+        ("configuration", _CP_MAP),
+        ("createdTime", pa.int64())])),
+    ("add", pa.struct([
+        ("path", pa.string()),
+        ("partitionValues", _CP_MAP),
+        ("size", pa.int64()),
+        ("modificationTime", pa.int64()),
+        ("dataChange", pa.bool_())])),
+])
+
+
 def write_checkpoint(table_path: str):
-    """Materialize the current snapshot as a parquet checkpoint
-    (Checkpoints.writeCheckpoint role)."""
+    """Materialize the current snapshot as a spec-conformant parquet
+    checkpoint (Checkpoints.writeCheckpoint role): protocol + metaData +
+    add rows with proper map-typed fields, so external Delta readers
+    starting from _last_checkpoint stay compatible."""
     snap = load_snapshot(table_path)
     meta = {"id": str(uuid.uuid4()),
+            "format": {"provider": "parquet", "options": {}},
             "schemaString": json.dumps(snap.schema_json)
             if snap.schema_json else "{}",
-            "partitionColumns": list(snap.partition_cols) or [""],
+            "partitionColumns": list(snap.partition_cols),
+            "configuration": {},
             "createdTime": int(time.time() * 1000)}
-    rows = [{"add": None, "metaData": meta}]
+    rows = [{"protocol": {"minReaderVersion": 1,
+                          "minWriterVersion": 2},
+             "metaData": None, "add": None},
+            {"protocol": None, "metaData": meta, "add": None}]
     for add in snap.files.values():
-        a = dict(add)
-        # map-typed protocol fields encode as JSON strings (parquet
-        # cannot hold empty structs; load_snapshot decodes)
-        a["partitionValues"] = json.dumps(
-            a.get("partitionValues") or {})
-        rows.append({"add": a, "metaData": None})
-    t = pa.Table.from_pylist(rows)
+        rows.append({"protocol": None, "metaData": None,
+                     "add": {
+                         "path": add["path"],
+                         "partitionValues": dict(
+                             add.get("partitionValues") or {}),
+                         "size": int(add.get("size", 0)),
+                         "modificationTime": int(
+                             add.get("modificationTime", 0)),
+                         "dataChange": bool(
+                             add.get("dataChange", True))}})
+    t = pa.Table.from_pylist(rows, schema=_CP_SCHEMA)
     cp = os.path.join(_log_path(table_path),
                       f"{snap.version:020d}.checkpoint.parquet")
     pq.write_table(t, cp)

@@ -300,6 +300,24 @@ class SpillCatalog:
         with self._lock:
             return len(self._buffers)
 
+    def check_leaks(self, raise_on_leak: bool = False) -> int:
+        """Leak tracking (MemoryCleaner / TaskRegistryTracker analog,
+        reference Plugin.scala:562-577 shutdown-hook accounting): every
+        SpillableBatch must be closed by its owning operator. Returns
+        the number of live buffers; logs (or raises) when nonzero."""
+        with self._lock:
+            leaked = [b for b in self._buffers.values() if not b.closed]
+        if leaked:
+            import logging
+
+            msg = (f"{len(leaked)} spillable buffer(s) leaked "
+                   f"({sum(b.size_bytes for b in leaked)} bytes, tiers: "
+                   f"{sorted({b.tier.name for b in leaked})})")
+            if raise_on_leak:
+                raise AssertionError(msg)
+            logging.getLogger(__name__).warning(msg)
+        return len(leaked)
+
 
 _catalog: Optional[SpillCatalog] = None
 _catalog_lock = threading.Lock()

@@ -1,0 +1,96 @@
+"""Mortgage ETL workload — the MortgageSpark.scala benchmark analog
+(reference integration_tests/.../mortgage/MortgageSpark.scala +
+mortgage_test.py): the classic two-table pipeline — performance records
+joined with acquisitions, per-loan delinquency aggregation, feature
+assembly — used as a perf/regression workload and as the zero-copy ML
+handoff source (ColumnarRdd -> XGBoost in the reference;
+api/columnar_rdd.py here)."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu.api import functions as F
+
+SELLERS = 30
+BASE_LOANS = 10_000
+MONTHS = 24
+
+
+def generate_mortgage_data(out_dir: str, scale_factor: float = 1.0,
+                           seed: int = 7, files_per_table: int = 4
+                           ) -> Dict[str, str]:
+    rng = np.random.default_rng(seed)
+    n_loans = max(200, int(BASE_LOANS * scale_factor))
+    loan_ids = np.arange(n_loans, dtype=np.int64)
+    acq = pa.table({
+        "loan_id": pa.array(loan_ids),
+        "seller": pa.array(rng.integers(0, SELLERS, n_loans),
+                           type=pa.int64()),
+        "orig_rate": pa.array(2.5 + rng.random(n_loans) * 5,
+                              type=pa.float64()),
+        "orig_upb": pa.array(rng.integers(50_000, 800_000, n_loans)
+                             .astype(np.float64)),
+        "dti": pa.array(rng.random(n_loans) * 60, type=pa.float64()),
+        "credit_score": pa.array(rng.integers(450, 850, n_loans),
+                                 type=pa.int64()),
+    })
+    n_perf = n_loans * MONTHS
+    perf_loans = np.repeat(loan_ids, MONTHS)
+    months = np.tile(np.arange(MONTHS, dtype=np.int64), n_loans)
+    delinq = rng.choice([0, 0, 0, 0, 0, 1, 2, 3],
+                        size=n_perf).astype(np.int64)
+    perf = pa.table({
+        "loan_id": pa.array(perf_loans),
+        "month": pa.array(months),
+        "current_upb": pa.array(
+            rng.random(n_perf) * 800_000, type=pa.float64()),
+        "delinq_status": pa.array(delinq),
+        "interest_paid": pa.array(rng.random(n_perf) * 4000,
+                                  type=pa.float64()),
+    })
+    paths = {}
+    for name, t in (("acq", acq), ("perf", perf)):
+        d = os.path.join(out_dir, name)
+        os.makedirs(d, exist_ok=True)
+        per = max(1, t.num_rows // files_per_table)
+        for i in range(0, t.num_rows, per):
+            pq.write_table(t.slice(i, per),
+                           os.path.join(d, f"part-{i // per:04d}.parquet"))
+        paths[name] = d
+    return paths
+
+
+def mortgage_etl(spark, paths: Dict[str, str]):
+    """The ETL: per-loan delinquency features joined onto acquisitions
+    (the XGBoost feature frame of the reference pipeline)."""
+    perf = spark.read.parquet(paths["perf"])
+    acq = spark.read.parquet(paths["acq"])
+    loan_features = (
+        perf.groupBy("loan_id")
+        .agg(F.max("delinq_status").alias("max_delinq"),
+             F.sum("interest_paid").alias("total_interest"),
+             F.avg("current_upb").alias("avg_upb"),
+             F.count("*").alias("n_reports")))
+    joined = acq.join(loan_features, on="loan_id", how="inner")
+    return joined.select(
+        "loan_id", "seller", "orig_rate", "dti", "credit_score",
+        "max_delinq", "total_interest", "avg_upb",
+        (F.col("avg_upb") / F.col("orig_upb")).alias("upb_ratio"),
+        (F.col("max_delinq") >= 1).alias("ever_delinq"))
+
+
+def mortgage_summary(spark, paths: Dict[str, str]):
+    """Seller-level risk rollup (the reporting query of the suite)."""
+    etl = mortgage_etl(spark, paths)
+    return (etl.groupBy("seller")
+            .agg(F.avg("orig_rate").alias("avg_rate"),
+                 F.sum(F.col("ever_delinq").cast("long"))
+                 .alias("delinq_loans"),
+                 F.count("*").alias("loans"))
+            .orderBy("seller"))
