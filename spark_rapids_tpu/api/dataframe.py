@@ -454,6 +454,12 @@ class DataFrame:
         if self.session.rapids_conf.is_explain_only:
             return pa.table({})
         mesh_n = self.session.rapids_conf.get(rc.MESH_SIZE)
+        if not mesh_n and self.session.rapids_conf.get(
+                rc.SHUFFLE_MODE) == "ICI":
+            # ICI shuffle == the SPMD mesh engine over every local chip
+            import jax
+
+            mesh_n = len(jax.devices())
         if mesh_n:
             from spark_rapids_tpu.parallel.plan_compiler import (
                 MeshCompileError,

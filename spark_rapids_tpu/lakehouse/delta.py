@@ -64,11 +64,13 @@ class Snapshot:
 
     def __init__(self, version: int, schema_json: Optional[dict],
                  partition_cols: List[str],
-                 files: Dict[str, dict]):
+                 files: Dict[str, dict],
+                 protocol: Optional[dict] = None):
         self.version = version
         self.schema_json = schema_json
         self.partition_cols = partition_cols
         self.files = files  # relative path -> add action
+        self.protocol = protocol  # last protocol action seen
 
     @property
     def file_paths(self) -> List[str]:
@@ -120,6 +122,7 @@ def load_snapshot(table_path: str) -> Snapshot:
         raise FileNotFoundError(
             f"{table_path} is not a Delta table (no {_LOG_DIR})")
     schema_json = None
+    protocol = None
     if meta is not None and meta.get("schemaString"):
         schema_json = json.loads(meta["schemaString"])
     last = cp_version
@@ -139,7 +142,9 @@ def load_snapshot(table_path: str) -> Snapshot:
                     m = action["metaData"]
                     schema_json = json.loads(m["schemaString"])
                     parts = list(m.get("partitionColumns") or [])
-    return Snapshot(last, schema_json, parts, files)
+                elif "protocol" in action:
+                    protocol = action["protocol"]
+    return Snapshot(last, schema_json, parts, files, protocol)
 
 
 _DELTA_TO_ARROW = {
@@ -278,16 +283,34 @@ _CP_SCHEMA = pa.schema([
         ("partitionValues", _CP_MAP),
         ("size", pa.int64()),
         ("modificationTime", pa.int64()),
-        ("dataChange", pa.bool_())])),
+        ("dataChange", pa.bool_()),
+        ("stats", pa.string())])),
 ])
 
+_CP_ADD_FIELDS = {"path", "partitionValues", "size",
+                  "modificationTime", "dataChange", "stats"}
 
-def write_checkpoint(table_path: str):
+
+def write_checkpoint(table_path: str) -> bool:
     """Materialize the current snapshot as a spec-conformant parquet
     checkpoint (Checkpoints.writeCheckpoint role): protocol + metaData +
-    add rows with proper map-typed fields, so external Delta readers
-    starting from _last_checkpoint stay compatible."""
+    add rows with proper map-typed fields. Tables whose add actions
+    carry fields this writer cannot represent (deletionVector, tags from
+    richer external writers) are left checkpoint-less — dropping those
+    fields would corrupt them for readers that start from
+    _last_checkpoint. Returns False when skipped."""
     snap = load_snapshot(table_path)
+    for add in snap.files.values():
+        extra = set(add) - _CP_ADD_FIELDS
+        if extra:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "skipping checkpoint: add action carries fields this "
+                "writer cannot preserve: %s", sorted(extra))
+            return False
+    protocol = snap.protocol or {"minReaderVersion": 1,
+                                 "minWriterVersion": 2}
     meta = {"id": str(uuid.uuid4()),
             "format": {"provider": "parquet", "options": {}},
             "schemaString": json.dumps(snap.schema_json)
@@ -295,8 +318,11 @@ def write_checkpoint(table_path: str):
             "partitionColumns": list(snap.partition_cols),
             "configuration": {},
             "createdTime": int(time.time() * 1000)}
-    rows = [{"protocol": {"minReaderVersion": 1,
-                          "minWriterVersion": 2},
+    rows = [{"protocol": {
+                "minReaderVersion": int(
+                    protocol.get("minReaderVersion", 1)),
+                "minWriterVersion": int(
+                    protocol.get("minWriterVersion", 2))},
              "metaData": None, "add": None},
             {"protocol": None, "metaData": meta, "add": None}]
     for add in snap.files.values():
@@ -309,7 +335,8 @@ def write_checkpoint(table_path: str):
                          "modificationTime": int(
                              add.get("modificationTime", 0)),
                          "dataChange": bool(
-                             add.get("dataChange", True))}})
+                             add.get("dataChange", True)),
+                         "stats": add.get("stats")}})
     t = pa.Table.from_pylist(rows, schema=_CP_SCHEMA)
     cp = os.path.join(_log_path(table_path),
                       f"{snap.version:020d}.checkpoint.parquet")
@@ -321,6 +348,7 @@ def write_checkpoint(table_path: str):
     with open(tmp, "w") as f:
         json.dump({"version": snap.version, "size": len(rows)}, f)
     os.replace(tmp, lc)
+    return True
 
 
 def _meta_action(schema: pa.Schema, partition_cols: List[str]) -> dict:

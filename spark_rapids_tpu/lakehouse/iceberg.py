@@ -93,7 +93,10 @@ def _current_schema_arrow(meta: dict) -> pa.Schema:
     if schemas:
         sid = meta.get("current-schema-id", 0)
         schema = next((s for s in schemas
-                       if s.get("schema-id") == sid), schemas[-1])
+                       if s.get("schema-id") == sid), None)
+        if schema is None:
+            raise IcebergError(
+                f"current-schema-id {sid} not present in metadata")
     else:
         schema = meta["schema"]  # v1 legacy single schema
     return pa.schema([
@@ -125,6 +128,10 @@ def live_data_files(table_path: str) -> List[str]:
                 raise IcebergError("delete files unsupported")
             if status == 2:  # DELETED
                 continue
+            fmt = str(df.get("file_format", "PARQUET")).upper()
+            if fmt != "PARQUET":
+                raise IcebergError(
+                    f"data file format {fmt} unsupported (parquet only)")
             files.append(_resolve(table_path, df["file_path"]))
     return files
 
@@ -134,8 +141,13 @@ def read_iceberg(session, path: str, schema=None, options=None):
     from spark_rapids_tpu.columnar.arrow_bridge import schema_from_arrow
     from spark_rapids_tpu.plan.logical import FileScan, LocalRelation
 
+    if options:
+        raise IcebergError(
+            f"iceberg reader options unsupported in v1: "
+            f"{sorted(options)}")
     meta = _load_metadata(path)
-    arrow_schema = _current_schema_arrow(meta)
+    arrow_schema = schema if schema is not None else \
+        _current_schema_arrow(meta)
     files = live_data_files(path)
     if not files:
         return DataFrame(LocalRelation(arrow_schema.empty_table()),

@@ -306,7 +306,9 @@ class SpillCatalog:
         SpillableBatch must be closed by its owning operator. Returns
         the number of live buffers; logs (or raises) when nonzero."""
         with self._lock:
-            leaked = [b for b in self._buffers.values() if not b.closed]
+            # close() removes a buffer from the catalog, so anything
+            # still registered is by construction unclosed
+            leaked = list(self._buffers.values())
         if leaked:
             import logging
 
