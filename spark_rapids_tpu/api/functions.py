@@ -67,6 +67,143 @@ def first(c, ignorenulls: bool = False) -> Column:
     return Column(First(expr_of(c), ignore_nulls=ignorenulls))
 
 
+def _agg1(cls, c):
+    return Column(cls(expr_of(c)))
+
+
+def stddev(c) -> Column:
+    from spark_rapids_tpu.expr.aggregates import StddevSamp
+
+    return _agg1(StddevSamp, c)
+
+
+stddev_samp = stddev
+
+
+def stddev_pop(c) -> Column:
+    from spark_rapids_tpu.expr.aggregates import StddevPop
+
+    return _agg1(StddevPop, c)
+
+
+def variance(c) -> Column:
+    from spark_rapids_tpu.expr.aggregates import VarianceSamp
+
+    return _agg1(VarianceSamp, c)
+
+
+var_samp = variance
+
+
+def var_pop(c) -> Column:
+    from spark_rapids_tpu.expr.aggregates import VariancePop
+
+    return _agg1(VariancePop, c)
+
+
+def skewness(c) -> Column:
+    from spark_rapids_tpu.expr.aggregates import Skewness
+
+    return _agg1(Skewness, c)
+
+
+def kurtosis(c) -> Column:
+    from spark_rapids_tpu.expr.aggregates import Kurtosis
+
+    return _agg1(Kurtosis, c)
+
+
+def corr(x, y) -> Column:
+    from spark_rapids_tpu.expr.aggregates import Corr
+
+    return Column(Corr(expr_of(x), expr_of(y)))
+
+
+def covar_pop(x, y) -> Column:
+    from spark_rapids_tpu.expr.aggregates import CovarPop
+
+    return Column(CovarPop(expr_of(x), expr_of(y)))
+
+
+def covar_samp(x, y) -> Column:
+    from spark_rapids_tpu.expr.aggregates import CovarSamp
+
+    return Column(CovarSamp(expr_of(x), expr_of(y)))
+
+
+def collect_list(c) -> Column:
+    from spark_rapids_tpu.expr.aggregates import CollectList
+
+    return _agg1(CollectList, c)
+
+
+array_agg = collect_list
+
+
+def collect_set(c) -> Column:
+    from spark_rapids_tpu.expr.aggregates import CollectSet
+
+    return _agg1(CollectSet, c)
+
+
+def countDistinct(c) -> Column:
+    from spark_rapids_tpu.expr.aggregates import CountDistinct
+
+    return _agg1(CountDistinct, c)
+
+
+count_distinct = countDistinct
+
+
+def sumDistinct(c) -> Column:
+    from spark_rapids_tpu.expr.aggregates import SumDistinct
+
+    return _agg1(SumDistinct, c)
+
+
+sum_distinct = sumDistinct
+
+
+def percentile(c, percentage: float) -> Column:
+    from spark_rapids_tpu.expr.aggregates import Percentile
+
+    return Column(Percentile(expr_of(c), percentage))
+
+
+def percentile_approx(c, percentage: float,
+                      accuracy: int = 10000) -> Column:
+    from spark_rapids_tpu.expr.aggregates import ApproxPercentile
+
+    return Column(ApproxPercentile(expr_of(c), percentage, accuracy))
+
+
+approx_percentile = percentile_approx
+
+
+def bool_and(c) -> Column:
+    from spark_rapids_tpu.expr.aggregates import BoolAnd
+
+    return _agg1(BoolAnd, c)
+
+
+every = bool_and
+
+
+def bool_or(c) -> Column:
+    from spark_rapids_tpu.expr.aggregates import BoolOr
+
+    return _agg1(BoolOr, c)
+
+
+some = bool_or
+
+
+def any_value(c, ignorenulls: bool = True) -> Column:
+    from spark_rapids_tpu.expr.aggregates import AnyValue
+
+    return Column(AnyValue(expr_of(c), ignore_nulls=ignorenulls))
+
+
 # --- scalar functions ---
 
 def abs(c) -> Column:  # noqa: A001

@@ -354,12 +354,20 @@ class TpuHashAggregateExec(PhysicalPlan):
 
         base_key = ("agg", mode, aliases_key(grouping), aliases_key(aggs))
         det = detached(self)
-        self._jit_partial = cached_jit(base_key + ("partial",),
-                                       lambda: det._partial)
-        self._jit_merge = cached_jit(base_key + ("merge_final",),
-                                     lambda: det._merge_final)
-        self._jit_merge_buffers = cached_jit(base_key + ("merge_buffers",),
-                                             lambda: det._merge_buffers)
+        if any(not a.children[0].jittable for a in aggs):
+            # collect_list/percentile family: update/merge output widths
+            # are data-dependent (largest group), so the phases run in
+            # jax eager mode — still on device, just not traced.
+            self._jit_partial = det._partial
+            self._jit_merge = det._merge_final
+            self._jit_merge_buffers = det._merge_buffers
+        else:
+            self._jit_partial = cached_jit(base_key + ("partial",),
+                                           lambda: det._partial)
+            self._jit_merge = cached_jit(base_key + ("merge_final",),
+                                         lambda: det._merge_final)
+            self._jit_merge_buffers = cached_jit(
+                base_key + ("merge_buffers",), lambda: det._merge_buffers)
 
     # --- phases (each a single XLA program) ---
 
@@ -371,13 +379,14 @@ class TpuHashAggregateExec(PhysicalPlan):
         # evaluate grouping + agg inputs into a working batch
         ctx = EvalContext(batch)
         work_cols = [g.eval(ctx) for g in self.grouping]
-        input_cols = []
+        # each aggregate may take 0 (count(*)), 1, or 2+ (corr/covar)
+        # input expressions
+        input_groups = []
         for a in self.aggs:
             fn: AggregateFunction = a.children[0]
-            input_cols.append(fn.input.eval(ctx) if fn.input is not None
-                              else None)
+            input_groups.append([e.eval(ctx) for e in fn.children])
         fields = [StructField(g.name, g.dtype, True) for g in self.grouping]
-        concrete = [c for c in input_cols if c is not None]
+        concrete = [c for grp in input_groups for c in grp]
         for i, c in enumerate(concrete):
             fields.append(StructField(f"in{i}", c.dtype, True))
         work = ColumnBatch(StructType(fields), work_cols + concrete,
@@ -399,13 +408,16 @@ class TpuHashAggregateExec(PhysicalPlan):
                 jnp.take(col.validity, safe),
                 None if col.lengths is None else jnp.take(col.lengths, safe)))
         ci = nkeys
-        for a, inp in zip(self.aggs, input_cols):
+        for a, grp in zip(self.aggs, input_groups):
             fn: AggregateFunction = a.children[0]
-            if inp is None:
+            k = len(grp)
+            if k == 0:
                 vals = None
-            else:
+            elif k == 1:
                 vals = g.sorted_batch.columns[ci]
-                ci += 1
+            else:
+                vals = [g.sorted_batch.columns[ci + j] for j in range(k)]
+            ci += k
             out_cols.extend(fn.update(vals, g.live, g.gid, cap))
         return ColumnBatch(_buffer_schema(self.grouping, self.aggs),
                            out_cols, g.num_groups)
@@ -553,10 +565,22 @@ class TpuHashAggregateExec(PhysicalPlan):
             from spark_rapids_tpu.expr.aggregates import Count
 
             cap = 1024
-            if isinstance(fn, Count):
+            from spark_rapids_tpu.expr.aggregates import CountDistinct
+            from spark_rapids_tpu.sqltypes import ArrayType
+
+            if isinstance(fn, Count) or (isinstance(fn, CountDistinct)
+                                         and fn.name == "count_distinct"):
                 cols.append(DeviceColumn(
                     long, jnp.zeros((cap,), jnp.int64),
                     jnp.ones((cap,), bool)))
+            elif isinstance(a.dtype, ArrayType):
+                # collect_list/set over empty input: empty array, not null
+                et = a.dtype.elementType
+                cols.append(DeviceColumn(
+                    a.dtype, jnp.zeros((cap, 1), et.np_dtype),
+                    jnp.ones((cap,), bool),
+                    jnp.zeros((cap,), jnp.int32),
+                    jnp.zeros((cap, 1), bool)))
             else:
                 dt = a.dtype
                 cols.append(DeviceColumn(
@@ -580,6 +604,131 @@ class CpuHashAggregateExec(PhysicalPlan):
         self.grouping = grouping
         self.aggs = aggs
 
+    def _pandas_groupby(self, work: "pa.Table", key_names, in_groups
+                        ) -> "pa.Table":
+        """Oracle path for aggregates arrow's hash kernels lack
+        (corr/covar/moments/collect/percentile/distinct): per-group
+        numpy evaluation of the Spark formulas."""
+        import pandas as pd
+
+        # arrow-backed dtypes: NULL stays pd.NA (distinct from float NaN,
+        # which Spark treats as a VALUE) and int64-with-nulls keeps its
+        # integer identity instead of round-tripping through float64
+        df = work.to_pandas(types_mapper=pd.ArrowDtype)
+
+        def _nn(s):
+            return s.dropna().to_numpy(dtype=np.float64, na_value=np.nan)
+
+        def _one(fn, sub: "pd.DataFrame", names):
+            x = sub[names[0]]
+            nm = fn.name
+            if nm == "corr":
+                pair = sub[[names[0], names[1]]].dropna()
+                n = len(pair)
+                if n == 0:
+                    return None
+                a = pair[names[0]].to_numpy(np.float64)
+                b = pair[names[1]].to_numpy(np.float64)
+                va = a.var()
+                vb = b.var()
+                if va == 0 or vb == 0:
+                    return None
+                return float(((a - a.mean()) * (b - b.mean())).mean()
+                             / np.sqrt(va * vb))
+            if nm in ("covar_pop", "covar_samp"):
+                pair = sub[[names[0], names[1]]].dropna()
+                n = len(pair)
+                ddof = 0 if nm == "covar_pop" else 1
+                if n < 1 + ddof:
+                    return None
+                a = pair[names[0]].to_numpy(np.float64)
+                b = pair[names[1]].to_numpy(np.float64)
+                return float(((a - a.mean()) * (b - b.mean())).sum()
+                             / (n - ddof))
+            v = _nn(x)
+            n = len(v)
+            if nm in ("var_pop", "var_samp", "stddev_pop", "stddev_samp"):
+                ddof = 0 if nm.endswith("pop") else 1
+                if n < 1 + ddof:
+                    return None
+                r = v.var(ddof=ddof)
+                return float(np.sqrt(r) if nm.startswith("stddev") else r)
+            if nm == "skewness":
+                if n == 0:
+                    return None
+                m2 = ((v - v.mean()) ** 2).sum()
+                m3 = ((v - v.mean()) ** 3).sum()
+                if m2 == 0:
+                    return None
+                return float(np.sqrt(n) * m3 / m2 ** 1.5)
+            if nm == "kurtosis":
+                if n == 0:
+                    return None
+                m2 = ((v - v.mean()) ** 2).sum()
+                m4 = ((v - v.mean()) ** 4).sum()
+                if m2 == 0:
+                    return None
+                return float(n * m4 / (m2 * m2) - 3.0)
+            if nm in ("percentile", "approx_percentile"):
+                if n == 0:
+                    return None
+                return float(np.percentile(v, fn.percentage * 100.0,
+                                           method="linear"))
+            raw = x.dropna()
+            if nm == "collect_list":
+                return list(raw)
+            if nm == "collect_set":
+                return list(pd.unique(raw))
+            if nm == "count_distinct":
+                return int(raw.nunique())
+            if nm == "sum_distinct":
+                u = pd.Series(pd.unique(raw))
+                return None if len(u) == 0 else u.sum()
+            if nm == "bool_and":
+                return None if len(raw) == 0 else bool(raw.all())
+            if nm == "bool_or":
+                return None if len(raw) == 0 else bool(raw.any())
+            if nm == "count":
+                return int(len(raw))
+            if nm == "sum":
+                return None if len(raw) == 0 else raw.sum()
+            if nm == "avg":
+                return None if len(raw) == 0 else float(raw.mean())
+            if nm == "min":
+                return None if len(raw) == 0 else raw.min()
+            if nm == "max":
+                return None if len(raw) == 0 else raw.max()
+            if nm in ("first", "last", "any_value"):
+                src = raw if fn.ignore_nulls else x
+                if len(src) == 0:
+                    return None
+                val = src.iloc[-1 if nm == "last" else 0]
+                return None if pd.isna(val) else val
+            raise NotImplementedError(f"cpu oracle aggregate {nm}")
+
+        if key_names:
+            grouped = df.groupby(key_names, dropna=False, sort=False)
+            groups = list(grouped)
+        else:
+            groups = [((), df)]
+        out_rows = {a.name: [] for a in self.aggs}
+        key_rows = {k: [] for k in key_names}
+        for key_val, sub in groups:
+            if key_names:
+                kv = key_val if isinstance(key_val, tuple) else (key_val,)
+                for k, v in zip(key_names, kv):
+                    key_rows[k].append(None if pd.isna(v) else v)
+            for a, names in zip(self.aggs, in_groups):
+                out_rows[a.name].append(_one(a.children[0], sub, names))
+        out = {}
+        for g_ in self.grouping:
+            out[g_.name] = pa.array(key_rows[g_.name],
+                                    type=to_arrow_type(g_.dtype))
+        for a in self.aggs:
+            out[a.name] = pa.array(out_rows[a.name],
+                                   type=to_arrow_type(a.dtype))
+        return pa.table(out)
+
     def execute_partition(self, pid, ctx):
         import pyarrow.compute as pc
 
@@ -590,22 +739,33 @@ class CpuHashAggregateExec(PhysicalPlan):
                  if tables else None)
         if table is None:
             return
-        # evaluate grouping exprs + agg inputs as columns
+        # evaluate grouping exprs + agg inputs as columns (an aggregate
+        # may take 0, 1, or 2+ inputs — corr/covar are bivariate)
         cols = {}
         for g_ in self.grouping:
             cols[g_.name] = cpu_eval.eval_expr(g_, table)
-        in_names = []
+        in_groups = []
         for i, a in enumerate(self.aggs):
             fn: AggregateFunction = a.children[0]
-            nm = f"__in{i}"
-            if fn.input is None:
+            names = []
+            if not fn.children:
+                nm = f"__in{i}"
                 cols[nm] = pa.chunked_array([
                     pa.array(np.ones(table.num_rows, np.int64))])
+                names.append(nm)
             else:
-                cols[nm] = cpu_eval.eval_expr(fn.input, table)
-            in_names.append(nm)
+                for j, e in enumerate(fn.children):
+                    nm = f"__in{i}_{j}"
+                    cols[nm] = cpu_eval.eval_expr(e, table)
+                    names.append(nm)
+            in_groups.append(names)
         work = pa.table(cols)
         key_names = [g_.name for g_ in self.grouping]
+        if any(a.children[0].name not in self._ARROW_FN
+               for a in self.aggs):
+            yield self._pandas_groupby(work, key_names, in_groups)
+            return
+        in_names = [names[0] for names in in_groups]
         agg_specs = []
         for i, a in enumerate(self.aggs):
             fn = a.children[0]

@@ -28,13 +28,19 @@ from spark_rapids_tpu.sqltypes import (
     DoubleType,
     FloatType,
 )
-from spark_rapids_tpu.sqltypes.datatypes import double, long
+from spark_rapids_tpu.sqltypes.datatypes import boolean, double, long
 
 
 class AggregateFunction(Expression):
-    """Base; children[0] is the input expression (if any)."""
+    """Base; children are the input expressions (if any).
+
+    `jittable=False` marks functions whose update/merge need dynamic
+    output shapes (collect_list and friends); the aggregate exec runs
+    those phases eagerly instead of under jax.jit.
+    """
 
     name: str = "agg"
+    jittable: bool = True
 
     @property
     def input(self):
@@ -280,3 +286,543 @@ class Last(First):
 
     def key(self):
         return ("last", self.ignore_nulls, self.children[0].key())
+
+
+class AnyValue(First):
+    """any_value(col): any value from the group (reference registers it
+    as a First-family aggregate)."""
+
+    name = "any_value"
+
+    def key(self):
+        return ("any_value", self.ignore_nulls, self.children[0].key())
+
+
+# --------------------------------------------------------- moment family
+#
+# Variance/stddev/skewness/kurtosis over raw power sums (n, Σx, Σx²,…)
+# — the declarative-buffer design of the reference's M2-based aggregates
+# (aggregateFunctions.scala GpuStddevPop/GpuVarianceSamp etc.) with
+# power sums instead of streaming M2 so partial/merge are plain
+# segmented additions (one XLA segment_sum per buffer).
+
+
+class _Moments(AggregateFunction):
+    """Buffers: [n (long), Σx, Σx², … Σx^k (double)]."""
+
+    n_powers = 2
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return double
+
+    def buffer_types(self):
+        return [long] + [double] * self.n_powers
+
+    def update(self, values, live, gid, cap):
+        valid = values.validity & live
+        x = values.data.astype(jnp.float64)
+        cnt = segmented.seg_count(valid, gid, cap)
+        ones = jnp.ones(cnt.shape, bool)
+        out = [DeviceColumn(long, cnt, ones)]
+        p = x
+        for k in range(self.n_powers):
+            if k:
+                p = p * x
+            s = segmented.seg_sum(p, valid, gid, cap)
+            out.append(DeviceColumn(double, s, cnt > 0))
+        return out
+
+    def merge(self, buffers, live, gid, cap):
+        cnt = segmented.seg_sum(buffers[0].data, live, gid, cap)
+        ones = jnp.ones(cnt.shape, bool)
+        out = [DeviceColumn(long, cnt, ones)]
+        for b in buffers[1:]:
+            s = segmented.seg_sum(b.data, b.validity & live, gid, cap)
+            out.append(DeviceColumn(double, s, cnt > 0))
+        return out
+
+    @staticmethod
+    def _m2(n, s1, s2):
+        """Central second moment Σ(x-μ)² = Σx² - (Σx)²/n."""
+        safe = jnp.maximum(n, 1.0)
+        return s2 - s1 * s1 / safe
+
+    def evaluate(self, buffers):
+        raise NotImplementedError
+
+
+class VariancePop(_Moments):
+    name = "var_pop"
+
+    def evaluate(self, buffers):
+        n = buffers[0].data.astype(jnp.float64)
+        m2 = self._m2(n, buffers[1].data, buffers[2].data)
+        data = jnp.maximum(m2, 0.0) / jnp.maximum(n, 1.0)
+        return DeviceColumn(double, data, n >= 1)
+
+
+class VarianceSamp(_Moments):
+    """var_samp: NULL for n<2 (Spark 3.x default,
+    spark.sql.legacy.statisticalAggregate=false)."""
+
+    name = "var_samp"
+
+    def evaluate(self, buffers):
+        n = buffers[0].data.astype(jnp.float64)
+        m2 = self._m2(n, buffers[1].data, buffers[2].data)
+        data = jnp.maximum(m2, 0.0) / jnp.maximum(n - 1.0, 1.0)
+        return DeviceColumn(double, data, n >= 2)
+
+
+class StddevPop(VariancePop):
+    name = "stddev_pop"
+
+    def evaluate(self, buffers):
+        v = super().evaluate(buffers)
+        return DeviceColumn(double, jnp.sqrt(v.data), v.validity)
+
+
+class StddevSamp(VarianceSamp):
+    name = "stddev_samp"
+
+    def evaluate(self, buffers):
+        v = super().evaluate(buffers)
+        return DeviceColumn(double, jnp.sqrt(v.data), v.validity)
+
+
+class Skewness(_Moments):
+    """skewness = sqrt(n)·m3 / m2^1.5 (NULL when n=0 or m2=0)."""
+
+    name = "skewness"
+    n_powers = 3
+
+    def evaluate(self, buffers):
+        n = buffers[0].data.astype(jnp.float64)
+        s1, s2, s3 = (b.data for b in buffers[1:])
+        safe = jnp.maximum(n, 1.0)
+        mu = s1 / safe
+        m2 = jnp.maximum(s2 - s1 * mu, 0.0)
+        m3 = s3 - 3.0 * mu * s2 + 2.0 * mu * mu * s1
+        den = jnp.maximum(m2, 1e-300) ** 1.5
+        data = jnp.sqrt(safe) * m3 / den
+        return DeviceColumn(double, data, (n >= 1) & (m2 > 0))
+
+
+class Kurtosis(_Moments):
+    """kurtosis (excess) = n·m4/m2² - 3 (NULL when n=0 or m2=0)."""
+
+    name = "kurtosis"
+    n_powers = 4
+
+    def evaluate(self, buffers):
+        n = buffers[0].data.astype(jnp.float64)
+        s1, s2, s3, s4 = (b.data for b in buffers[1:])
+        safe = jnp.maximum(n, 1.0)
+        mu = s1 / safe
+        m2 = jnp.maximum(s2 - s1 * mu, 0.0)
+        m4 = (s4 - 4.0 * mu * s3 + 6.0 * mu * mu * s2
+              - 3.0 * mu ** 3 * s1)
+        den = jnp.maximum(m2 * m2, 1e-300)
+        data = safe * m4 / den - 3.0
+        return DeviceColumn(double, data, (n >= 1) & (m2 > 0))
+
+
+# ------------------------------------------------------ bivariate family
+
+
+class _Bivariate(AggregateFunction):
+    """Two-input aggregates (corr / covar_*). A row participates only
+    when BOTH inputs are non-null (Spark semantics). Buffers:
+    [n, Σx, Σy, Σxy] (+ Σx², Σy² for corr)."""
+
+    extra_squares = False
+
+    def __init__(self, x: Expression, y: Expression):
+        super().__init__([x, y])
+
+    @property
+    def dtype(self):
+        return double
+
+    def buffer_types(self):
+        return [long] + [double] * (5 if self.extra_squares else 3)
+
+    def update(self, values, live, gid, cap):
+        xc, yc = values
+        valid = xc.validity & yc.validity & live
+        x = xc.data.astype(jnp.float64)
+        y = yc.data.astype(jnp.float64)
+        cnt = segmented.seg_count(valid, gid, cap)
+        ones = jnp.ones(cnt.shape, bool)
+        sums = [x, y, x * y]
+        if self.extra_squares:
+            sums += [x * x, y * y]
+        out = [DeviceColumn(long, cnt, ones)]
+        for s in sums:
+            out.append(DeviceColumn(
+                double, segmented.seg_sum(s, valid, gid, cap), cnt > 0))
+        return out
+
+    def merge(self, buffers, live, gid, cap):
+        cnt = segmented.seg_sum(buffers[0].data, live, gid, cap)
+        ones = jnp.ones(cnt.shape, bool)
+        out = [DeviceColumn(long, cnt, ones)]
+        for b in buffers[1:]:
+            out.append(DeviceColumn(
+                double, segmented.seg_sum(b.data, b.validity & live, gid,
+                                          cap), cnt > 0))
+        return out
+
+
+class CovarPop(_Bivariate):
+    name = "covar_pop"
+
+    def evaluate(self, buffers):
+        n = buffers[0].data.astype(jnp.float64)
+        sx, sy, sxy = (b.data for b in buffers[1:4])
+        safe = jnp.maximum(n, 1.0)
+        data = (sxy - sx * sy / safe) / safe
+        return DeviceColumn(double, data, n >= 1)
+
+
+class CovarSamp(_Bivariate):
+    name = "covar_samp"
+
+    def evaluate(self, buffers):
+        n = buffers[0].data.astype(jnp.float64)
+        sx, sy, sxy = (b.data for b in buffers[1:4])
+        safe = jnp.maximum(n, 1.0)
+        data = (sxy - sx * sy / safe) / jnp.maximum(n - 1.0, 1.0)
+        return DeviceColumn(double, data, n >= 2)
+
+
+class Corr(_Bivariate):
+    """Pearson correlation; NULL when n=0 or either variance is 0."""
+
+    name = "corr"
+    extra_squares = True
+
+    def evaluate(self, buffers):
+        n = buffers[0].data.astype(jnp.float64)
+        sx, sy, sxy, sxx, syy = (b.data for b in buffers[1:6])
+        safe = jnp.maximum(n, 1.0)
+        cov = sxy - sx * sy / safe
+        vx = jnp.maximum(sxx - sx * sx / safe, 0.0)
+        vy = jnp.maximum(syy - sy * sy / safe, 0.0)
+        den = jnp.sqrt(vx) * jnp.sqrt(vy)
+        data = cov / jnp.maximum(den, 1e-300)
+        return DeviceColumn(double, jnp.clip(data, -1.0, 1.0),
+                            (n >= 1) & (den > 0))
+
+
+# ----------------------------------------------------------- bool family
+
+
+class _BoolReduce(AggregateFunction):
+    _use_max = False  # bool_or reduces with max, bool_and with min
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return boolean
+
+    def buffer_types(self):
+        return [boolean]
+
+    def _seg(self, data, valid, gid, cap):
+        x = data.astype(jnp.int32)
+        if self._use_max:
+            r = segmented.seg_max(x, valid, gid, cap)
+        else:
+            r = segmented.seg_min(x, valid, gid, cap)
+        return r > 0
+
+    def update(self, values, live, gid, cap):
+        valid = values.validity & live
+        r = self._seg(values.data, valid, gid, cap)
+        cnt = segmented.seg_count(valid, gid, cap)
+        return [DeviceColumn(boolean, r, cnt > 0)]
+
+    def merge(self, buffers, live, gid, cap):
+        valid = buffers[0].validity & live
+        r = self._seg(buffers[0].data, valid, gid, cap)
+        cnt = segmented.seg_count(valid, gid, cap)
+        return [DeviceColumn(boolean, r, cnt > 0)]
+
+    def evaluate(self, buffers):
+        return buffers[0]
+
+
+class BoolAnd(_BoolReduce):
+    name = "bool_and"
+
+
+class BoolOr(_BoolReduce):
+    name = "bool_or"
+    _use_max = True
+
+
+# ------------------------------------------------- collect / exact sets
+#
+# collect_list/collect_set produce ArrayType results; their buffers are
+# array columns ([cap, max_elems] padded matrices). max_elems is data-
+# dependent (the largest group), so update/merge run EAGERLY
+# (jittable=False) — jax eager mode allows the dynamic output width
+# while keeping the compute on device. Reference: cuDF collect_list /
+# collect_set GroupByAggregations (GpuAggregateExec + cuDF ragged
+# lists); here the ragged result is the padded-matrix array layout of
+# columnar/batch.py.
+
+
+def _eq_nan_aware(a, b):
+    """Element equality where NaN == NaN (Spark set semantics: collect_set
+    and count(DISTINCT) treat NaN as equal to itself)."""
+    eq = a == b
+    if jnp.issubdtype(a.dtype, jnp.floating):
+        eq = eq | (jnp.isnan(a) & jnp.isnan(b))
+    return eq
+
+
+def _seg_exclusive_ranks(valid, gid, cap):
+    """Rank of each valid row within its (contiguous, sorted) segment."""
+    import jax
+
+    csum = jnp.cumsum(valid.astype(jnp.int32)) - valid.astype(jnp.int32)
+    n = valid.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    fp = jax.ops.segment_min(pos, gid, num_segments=cap)
+    base = jnp.take(csum, jnp.clip(fp, 0, n - 1))
+    return csum - jnp.take(base, gid)
+
+
+class CollectList(AggregateFunction):
+    name = "collect_list"
+    jittable = False
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        from spark_rapids_tpu.sqltypes import ArrayType
+
+        return ArrayType(self.children[0].dtype, containsNull=False)
+
+    @property
+    def nullable(self):
+        return False  # empty array, never null (Spark collect_list)
+
+    def buffer_types(self):
+        return [self.dtype]
+
+    def _scatter(self, elem_dt, vals, valid, gid, cap):
+        """Rows -> [cap, me] padded array column (me = largest group)."""
+        cnt = segmented.seg_count(valid, gid, cap)
+        me = max(int(jnp.max(cnt)), 1)
+        rank = _seg_exclusive_ranks(valid, gid, cap)
+        # invalid rows scatter out of range and are dropped
+        col = jnp.where(valid, rank, me)
+        out = jnp.zeros((cap, me), vals.dtype)
+        out = out.at[gid, col].set(vals, mode="drop")
+        ev = (jnp.arange(me, dtype=jnp.int32)[None, :] < cnt[:, None])
+        from spark_rapids_tpu.sqltypes import ArrayType
+
+        # collect_* is never NULL (empty array for all-null groups);
+        # rows past num_groups are sliced away by the batch row count.
+        return DeviceColumn(ArrayType(elem_dt, False), out,
+                            jnp.ones(cap, bool), cnt.astype(jnp.int32), ev)
+
+    def update(self, values, live, gid, cap):
+        valid = values.validity & live
+        return [self._scatter(values.dtype, values.data, valid, gid, cap)]
+
+    def _merge_elements(self, buf, live, gid, cap, dedup: bool):
+        """Flatten each group's row-lists into per-element rows, then
+        re-scatter per group (optionally deduplicating)."""
+        me_in = buf.data.shape[1] if buf.data.ndim == 2 else 1
+        n = buf.data.shape[0]
+        vals = buf.data.reshape(n * me_in)
+        egid = jnp.repeat(gid, me_in)
+        within = jnp.arange(me_in, dtype=jnp.int32)[None, :]
+        evalid = ((within < buf.lengths[:, None])
+                  & live[:, None]).reshape(n * me_in)
+        if buf.elem_validity is not None:
+            evalid = evalid & buf.elem_validity.reshape(n * me_in)
+        if dedup:
+            # sort invalid (padding) elements to each segment's end so
+            # equal valid values are adjacent for the dup test
+            order = jnp.lexsort((vals, ~evalid, egid))
+            vals = jnp.take(vals, order)
+            egid = jnp.take(egid, order)
+            evalid = jnp.take(evalid, order)
+            prev_same = jnp.concatenate([
+                jnp.array([False]),
+                (egid[1:] == egid[:-1])
+                & _eq_nan_aware(vals[1:], vals[:-1]) & evalid[:-1]])
+            evalid = evalid & ~prev_same
+        elem_dt = buf.dtype.elementType
+        return self._scatter(elem_dt, vals, evalid, egid, cap)
+
+    def merge(self, buffers, live, gid, cap):
+        return [self._merge_elements(buffers[0], live, gid, cap,
+                                     dedup=False)]
+
+    def evaluate(self, buffers):
+        return buffers[0]
+
+
+class CollectSet(CollectList):
+    """collect_set: distinct values per group. update deduplicates
+    within the batch segment; merge deduplicates across partials."""
+
+    name = "collect_set"
+
+    def update(self, values, live, gid, cap):
+        valid = values.validity & live
+        vals = values.data
+        order = jnp.lexsort((vals, ~valid, gid))
+        svals = jnp.take(vals, order)
+        sgid = jnp.take(gid, order)
+        svalid = jnp.take(valid, order)
+        prev_same = jnp.concatenate([
+            jnp.array([False]),
+            (sgid[1:] == sgid[:-1])
+            & _eq_nan_aware(svals[1:], svals[:-1]) & svalid[:-1]])
+        keep = svalid & ~prev_same
+        return [self._scatter(values.dtype, svals, keep, sgid, cap)]
+
+    def merge(self, buffers, live, gid, cap):
+        return [self._merge_elements(buffers[0], live, gid, cap,
+                                     dedup=True)]
+
+
+class CountDistinct(AggregateFunction):
+    """count(DISTINCT col) — CollectSet buffers, cardinality at
+    evaluate (the planner's Expand-based distinct rewrite in Spark,
+    collapsed into one set-buffer aggregate here)."""
+
+    name = "count_distinct"
+    jittable = False
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def _set(self):
+        # derived lazily: children are rebound during plan analysis
+        return CollectSet(self.children[0])
+
+    @property
+    def dtype(self):
+        return long
+
+    @property
+    def nullable(self):
+        return False
+
+    def buffer_types(self):
+        return self._set.buffer_types()
+
+    def update(self, values, live, gid, cap):
+        return self._set.update(values, live, gid, cap)
+
+    def merge(self, buffers, live, gid, cap):
+        return self._set.merge(buffers, live, gid, cap)
+
+    def evaluate(self, buffers):
+        buf = buffers[0]
+        cnt = buf.lengths.astype(jnp.int64)
+        return DeviceColumn(long, cnt, jnp.ones(cnt.shape, bool))
+
+
+class SumDistinct(CountDistinct):
+    name = "sum_distinct"
+
+    @property
+    def dtype(self):
+        return _sum_result_type(self.children[0].dtype)
+
+    @property
+    def nullable(self):
+        return True
+
+    def evaluate(self, buffers):
+        buf = buffers[0]
+        me = buf.data.shape[1]
+        mask = (jnp.arange(me, dtype=jnp.int32)[None, :]
+                < buf.lengths[:, None])
+        out_t = self.dtype
+        data = jnp.where(mask, buf.data.astype(out_t.np_dtype), 0).sum(
+            axis=1)
+        return DeviceColumn(out_t, data, buf.lengths > 0)
+
+
+class Percentile(AggregateFunction):
+    """Exact percentile with linear interpolation (Spark `percentile`).
+    Buffers collect the group's raw values (the reference's exact
+    GpuPercentile accumulates a value->count histogram via JNI
+    Histogram; the padded-array buffer plays that role here), so this
+    is for group sizes that fit a device row — the same practical
+    envelope as the reference's exact path."""
+
+    name = "percentile"
+    jittable = False
+
+    def __init__(self, child: Expression, percentage: float,
+                 accuracy: int = 10000):
+        super().__init__([child])
+        self.percentage = float(percentage)
+        self.accuracy = int(accuracy)
+
+    @property
+    def _list(self):
+        # derived lazily: children are rebound during plan analysis
+        return CollectList(self.children[0])
+
+    @property
+    def dtype(self):
+        return double
+
+    def key(self):
+        return (self.name, self.percentage, self.children[0].key())
+
+    def buffer_types(self):
+        return self._list.buffer_types()
+
+    def update(self, values, live, gid, cap):
+        return self._list.update(values, live, gid, cap)
+
+    def merge(self, buffers, live, gid, cap):
+        return self._list.merge(buffers, live, gid, cap)
+
+    def evaluate(self, buffers):
+        buf = buffers[0]
+        me = buf.data.shape[1]
+        cnt = buf.lengths
+        mask = (jnp.arange(me, dtype=jnp.int32)[None, :] < cnt[:, None])
+        vals = jnp.where(mask, buf.data.astype(jnp.float64), jnp.inf)
+        svals = jnp.sort(vals, axis=1)
+        rk = self.percentage * jnp.maximum(cnt - 1, 0).astype(jnp.float64)
+        lo = jnp.floor(rk).astype(jnp.int32)
+        hi = jnp.ceil(rk).astype(jnp.int32)
+        frac = rk - lo
+        safe_lo = jnp.clip(lo, 0, me - 1)
+        safe_hi = jnp.clip(hi, 0, me - 1)
+        vlo = jnp.take_along_axis(svals, safe_lo[:, None], axis=1)[:, 0]
+        vhi = jnp.take_along_axis(svals, safe_hi[:, None], axis=1)[:, 0]
+        data = vlo + (vhi - vlo) * frac
+        return DeviceColumn(double, data, cnt > 0)
+
+
+class ApproxPercentile(Percentile):
+    """approx_percentile: same buffers/evaluation as the exact path —
+    exact answers satisfy the approximation contract; `accuracy` is
+    accepted for API parity (reference: t-digest via JNI)."""
+
+    name = "approx_percentile"

@@ -146,8 +146,21 @@ def read_iceberg(session, path: str, schema=None, options=None):
             f"iceberg reader options unsupported in v1: "
             f"{sorted(options)}")
     meta = _load_metadata(path)
-    arrow_schema = schema if schema is not None else \
-        _current_schema_arrow(meta)
+    if schema is not None:
+        # the reader convention passes the engine StructType
+        # (api/session.py DataFrameReader.schema); accept a raw
+        # pa.Schema too
+        from spark_rapids_tpu.sqltypes import StructType
+        from spark_rapids_tpu.sqltypes.datatypes import to_arrow_type
+
+        if isinstance(schema, StructType):
+            arrow_schema = pa.schema([
+                pa.field(f.name, to_arrow_type(f.dataType), f.nullable)
+                for f in schema.fields])
+        else:
+            arrow_schema = schema
+    else:
+        arrow_schema = _current_schema_arrow(meta)
     files = live_data_files(path)
     if not files:
         return DataFrame(LocalRelation(arrow_schema.empty_table()),

@@ -519,6 +519,11 @@ class MeshQueryExecutor:
     def _emit_agg(self, node: ops.TpuHashAggregateExec, emit, track,
                   expansion: int) -> ColumnBatch:
         n = self.n
+        if any(not a.children[0].jittable for a in node.aggs):
+            # collect_list/percentile family needs data-dependent output
+            # widths — no static shard_map lowering; thread-pool path
+            raise MeshCompileError("non-jittable aggregate (collect/"
+                                   "percentile family)")
         if node.mode == "partial":
             return node._partial(emit(node.children[0]))
         if node.mode == "final":

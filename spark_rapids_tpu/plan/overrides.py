@@ -90,12 +90,32 @@ class TpuOverrides:
                 r = key_type_supported(g.dtype)
                 if r:
                     meta.cannot_run(r)
+            from spark_rapids_tpu.expr.aggregates import (
+                CollectList, CountDistinct, Percentile, _Bivariate,
+                _Moments,
+            )
+            from spark_rapids_tpu.sqltypes import (
+                ArrayType as _AT,
+                NumericType as _NT,
+            )
+
             for a in node.aggregates:
                 fn = a.children[0]
                 if (isinstance(fn, (Min, Max)) and fn.input is not None
                         and isinstance(fn.input.dtype, StringType)):
                     meta.cannot_run(
                         "string min/max aggregation runs on CPU in v1")
+                if (isinstance(fn, (CollectList, CountDistinct))
+                        and fn.input is not None
+                        and isinstance(fn.input.dtype, (StringType, _AT))):
+                    meta.cannot_run(
+                        "collect/distinct over string/array input runs "
+                        "on CPU in v1")
+                if isinstance(fn, (_Moments, _Bivariate, Percentile)):
+                    for e in fn.children:
+                        if not isinstance(e.dtype, _NT):
+                            meta.cannot_run(
+                                f"{fn.name} requires numeric input")
         elif isinstance(node, L.Join):
             for e in node.left_keys + node.right_keys:
                 for r in expr_unsupported_reasons(e):

@@ -1,0 +1,220 @@
+"""Aggregate-function breadth: moments/bivariate/collect/distinct/
+percentile families (reference:
+sql-plugin/src/main/scala/org/apache/spark/sql/rapids/aggregate/
+aggregateFunctions.scala, GpuApproximatePercentile.scala) — differential
+tests against the CPU oracle plus numpy spot checks of the Spark
+formulas."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.testing.asserts import (
+    assert_tpu_and_cpu_are_equal_collect,
+)
+
+
+@pytest.fixture(scope="module")
+def stats_path(tmp_path_factory):
+    d = tmp_path_factory.mktemp("aggdata")
+    rng = np.random.default_rng(7)
+    n = 4000
+    x = rng.random(n) * 10
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 6, n)),
+        "x": pa.array(x, mask=rng.random(n) < 0.15),
+        "y": pa.array(rng.random(n) * 3,
+                      mask=rng.random(n) < 0.1),
+        "b": pa.array(rng.random(n) < 0.5,
+                      mask=rng.random(n) < 0.2),
+        "i": pa.array(rng.integers(0, 9, n),
+                      mask=rng.random(n) < 0.1),
+    })
+    p = str(d / "stats.parquet")
+    pq.write_table(t, p)
+    return p
+
+
+def _agg_diff(path, *cols, conf=None):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.read.parquet(path).groupBy("k").agg(*cols),
+        conf=conf)
+
+
+def test_variance_family(stats_path):
+    _agg_diff(stats_path,
+              F.var_pop("x").alias("vp"),
+              F.var_samp("x").alias("vs"),
+              F.stddev_pop("x").alias("sp"),
+              F.stddev("x").alias("ss"))
+
+
+def test_skew_kurtosis(stats_path):
+    _agg_diff(stats_path,
+              F.skewness("x").alias("sk"),
+              F.kurtosis("x").alias("ku"))
+
+
+def test_corr_covar(stats_path):
+    _agg_diff(stats_path,
+              F.corr("x", "y").alias("c"),
+              F.covar_pop("x", "y").alias("cp"),
+              F.covar_samp("x", "y").alias("cs"))
+
+
+def test_bool_and_or(stats_path):
+    _agg_diff(stats_path,
+              F.bool_and("b").alias("ba"),
+              F.bool_or("b").alias("bo"))
+
+
+def test_collect_list_set(stats_path):
+    # list order is engine-defined: compare as sorted lists
+    from spark_rapids_tpu.testing.asserts import (
+        with_cpu_session,
+        with_tpu_session,
+    )
+
+    def q(spark):
+        out = (spark.read.parquet(stats_path).groupBy("k")
+               .agg(F.collect_list("i").alias("cl"),
+                    F.collect_set("i").alias("cs"))
+               .collect_arrow())
+        df = out.to_pandas().sort_values("k").reset_index(drop=True)
+        df["cl"] = df["cl"].apply(lambda v: sorted(v))
+        df["cs"] = df["cs"].apply(lambda v: sorted(v))
+        return df
+
+    tpu = with_tpu_session(q)
+    cpu = with_cpu_session(q)
+    assert tpu["k"].tolist() == cpu["k"].tolist()
+    for c in ("cl", "cs"):
+        for a, b in zip(tpu[c], cpu[c]):
+            assert list(a) == list(b), c
+
+
+def test_count_sum_distinct(stats_path):
+    _agg_diff(stats_path,
+              F.countDistinct("i").alias("cd"),
+              F.sum_distinct("i").alias("sd"))
+
+
+def test_percentile(stats_path):
+    _agg_diff(stats_path,
+              F.percentile("x", 0.5).alias("p50"),
+              F.percentile("x", 0.25).alias("p25"),
+              F.percentile_approx("x", 0.9).alias("p90"))
+
+
+def test_any_value(stats_path):
+    # any value from the group is legal; assert it is a member
+    from spark_rapids_tpu.testing.asserts import with_tpu_session
+
+    def q(spark):
+        return (spark.read.parquet(stats_path).groupBy("k")
+                .agg(F.any_value("i").alias("av"),
+                     F.collect_set("i").alias("members"))
+                .collect_arrow().to_pandas())
+
+    df = with_tpu_session(q)
+    for _, row in df.iterrows():
+        if row["av"] is not None and not (
+                isinstance(row["av"], float) and np.isnan(row["av"])):
+            assert row["av"] in set(row["members"])
+
+
+def test_global_stats_agg(stats_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.read.parquet(stats_path).agg(
+            F.stddev("x").alias("sd"),
+            F.corr("x", "y").alias("c"),
+            F.countDistinct("i").alias("cd"),
+            F.percentile("x", 0.75).alias("p75")))
+
+
+def test_variance_edge_singleton():
+    """n=1 groups: var_samp/stddev_samp NULL (Spark 3.x default);
+    var_pop 0."""
+    from spark_rapids_tpu.testing.asserts import with_tpu_session
+
+    def q(spark):
+        df = spark.createDataFrame(
+            pa.table({"k": pa.array([1, 2, 2]),
+                      "x": pa.array([5.0, 1.0, 3.0])}))
+        return (df.groupBy("k")
+                .agg(F.var_samp("x").alias("vs"),
+                     F.var_pop("x").alias("vp"),
+                     F.corr("x", "x").alias("c"))
+                .collect_arrow().to_pandas().sort_values("k")
+                .reset_index(drop=True))
+
+    out = with_tpu_session(q)
+    assert out["vs"][0] is None or np.isnan(out["vs"][0])
+    assert out["vp"][0] == 0.0
+    assert abs(out["vs"][1] - 2.0) < 1e-12
+    # corr(x, x) of a singleton has zero variance -> NULL
+    assert out["c"][0] is None or np.isnan(out["c"][0])
+
+
+def test_collect_through_multiple_partitions(stats_path):
+    """Partial/merge across a multi-partition shuffle must union the
+    per-batch lists correctly."""
+    from spark_rapids_tpu.testing.asserts import with_tpu_session
+
+    def q(nparts):
+        def run(spark):
+            out = (spark.read.parquet(stats_path).groupBy("k")
+                   .agg(F.collect_set("i").alias("cs"),
+                        F.countDistinct("i").alias("cd"))
+                   .collect_arrow())
+            df = out.to_pandas().sort_values("k").reset_index(drop=True)
+            df["cs"] = df["cs"].apply(sorted)
+            return df
+        return with_tpu_session(
+            run, conf={"spark.sql.shuffle.partitions": nparts})
+
+    one = q(1)
+    many = q(5)
+    assert one["cs"].tolist() == many["cs"].tolist()
+    assert one["cd"].tolist() == many["cd"].tolist()
+    for _, row in one.iterrows():
+        assert row["cd"] == len(row["cs"])
+
+
+def test_collect_set_nan_dedup():
+    """NaN == NaN for set semantics (Spark collect_set/count distinct
+    keep a single NaN)."""
+    from spark_rapids_tpu.testing.asserts import with_tpu_session
+
+    def q(spark):
+        df = spark.createDataFrame(
+            pa.table({"k": pa.array([1, 1, 1, 1]),
+                      "x": pa.array([float("nan"), float("nan"),
+                                     2.0, 2.0])}))
+        return (df.groupBy("k")
+                .agg(F.collect_set("x").alias("cs"),
+                     F.countDistinct("x").alias("cd"))
+                .collect_arrow().to_pandas())
+
+    out = with_tpu_session(q)
+    assert out["cd"][0] == 2
+    vals = list(out["cs"][0])
+    assert len(vals) == 2
+    assert sum(1 for v in vals if np.isnan(v)) == 1
+
+
+def test_mesh_falls_back_for_collect(stats_path):
+    """The SPMD mesh path has no static lowering for collect_*; the
+    session must fall back to the thread-pool path, not crash."""
+    from spark_rapids_tpu.testing.asserts import with_tpu_session
+
+    def run(spark):
+        out = (spark.read.parquet(stats_path).groupBy("k")
+               .agg(F.collect_set("i").alias("cs"))
+               .collect_arrow())
+        return out.num_rows
+
+    n = with_tpu_session(run, conf={"spark.rapids.tpu.mesh": 4})
+    assert n == 6
