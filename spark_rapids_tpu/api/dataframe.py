@@ -210,8 +210,45 @@ class DataFrame:
     def groupBy(self, *cols) -> "GroupedData":
         return GroupedData(self, list(cols))
 
+    def rollup(self, *cols) -> "GroupedData":
+        """GROUP BY ROLLUP — hierarchical subtotal grouping sets
+        (lowered through Expand, like Spark's rollup plan)."""
+        return GroupedData(self, list(cols), mode="rollup")
+
+    def cube(self, *cols) -> "GroupedData":
+        """GROUP BY CUBE — all 2^n grouping-set combinations."""
+        return GroupedData(self, list(cols), mode="cube")
+
+    def groupingSets(self, sets, *cols) -> "GroupedData":
+        """Explicit grouping sets: `sets` is a list of lists of column
+        names drawn from `cols`."""
+        return GroupedData(self, list(cols), mode="grouping_sets",
+                           sets=sets)
+
     def agg(self, *cols) -> "DataFrame":
         return GroupedData(self, []).agg(*cols)
+
+    def sample(self, withReplacement=None, fraction=None,
+               seed=None) -> "DataFrame":
+        """Bernoulli row sample (pyspark-compatible overloads:
+        sample(fraction), sample(fraction, seed),
+        sample(withReplacement, fraction, seed))."""
+        if isinstance(withReplacement, float):
+            # sample(fraction[, seed]) form
+            withReplacement, fraction, seed = False, withReplacement, \
+                fraction
+        if fraction is None:
+            raise ValueError("sample() requires a fraction")
+        if not 0.0 <= float(fraction) <= 1.0 and not withReplacement:
+            raise ValueError(f"fraction must be in [0, 1]: {fraction}")
+        if seed is None:
+            import random
+
+            seed = random.randint(0, 2 ** 31 - 1)
+        return DataFrame(
+            L.Sample(float(fraction), int(seed), bool(withReplacement),
+                     self._plan),
+            self.session)
 
     def crossJoin(self, other: "DataFrame") -> "DataFrame":
         return DataFrame(
@@ -597,10 +634,13 @@ class Row(dict):
 
 
 class GroupedData:
-    def __init__(self, df: DataFrame, cols):
+    def __init__(self, df: DataFrame, cols, mode: str = "groupby",
+                 sets=None):
         from spark_rapids_tpu.expr.windows import contains_window
 
         self.df = df
+        self.mode = mode
+        self._user_sets = sets
         self.grouping = [
             _named(df._col_expr(c), c if isinstance(c, str) else c.name)
             for c in cols]
@@ -611,9 +651,10 @@ class GroupedData:
                     "materialize with select/withColumn first")
 
     def agg(self, *cols) -> DataFrame:
+        from spark_rapids_tpu.expr.aggregates import GroupingBit, GroupingID
         from spark_rapids_tpu.expr.windows import contains_window
 
-        aggs = []
+        entries = []  # (base_expr, name); base is agg fn or marker
         for i, c in enumerate(cols):
             e = self.df._col_expr(c)
             if contains_window(e):
@@ -621,13 +662,137 @@ class GroupedData:
                     "window functions are not allowed in groupBy.agg(); "
                     "use select/withColumn")
             base = e.children[0] if isinstance(e, Alias) else e
-            assert isinstance(base, AggregateFunction), \
-                f"agg() requires aggregate expressions, got {base!r}"
+            if isinstance(base, (GroupingID, GroupingBit)):
+                if self.mode == "groupby":
+                    raise ValueError(
+                        "grouping()/grouping_id() are only valid with "
+                        "rollup/cube/groupingSets")
+                if isinstance(e, Alias):
+                    name = e.name
+                elif isinstance(base, GroupingID):
+                    name = "spark_grouping_id()"
+                else:
+                    name = f"grouping({base.children[0]!r})"
+                entries.append((base, name))
+                continue
             name = (e.name if isinstance(e, Alias)
                     else f"{base.name}({_input_name(base)})")
-            aggs.append(Alias(base, name) if not isinstance(e, Alias) else e)
+            assert isinstance(base, AggregateFunction), \
+                f"agg() requires aggregate expressions, got {base!r}"
+            entries.append((base, name))
+        if self.mode != "groupby":
+            return self._expand_agg(entries)
+        aggs = [Alias(b, n) for b, n in entries]
         plan = L.Aggregate(self.grouping, aggs, self.df._plan)
         return DataFrame(plan, self.df.session)
+
+    def _grouping_sets(self):
+        """Index sets (into self.grouping) included per grouping set."""
+        n = len(self.grouping)
+        if self.mode == "rollup":
+            return [frozenset(range(k)) for k in range(n, -1, -1)]
+        if self.mode == "cube":
+            from itertools import combinations
+
+            out = []
+            for k in range(n, -1, -1):
+                out.extend(frozenset(s)
+                           for s in combinations(range(n), k))
+            return out
+        # grouping_sets: user lists of column names
+        by_name = {g.name: i for i, g in enumerate(self.grouping)}
+        out = []
+        for s in self._user_sets:
+            try:
+                out.append(frozenset(by_name[c] for c in s))
+            except KeyError as e:
+                raise ValueError(
+                    f"grouping set column {e} not in groupingSets "
+                    f"columns {sorted(by_name)}")
+        return out
+
+    def _expand_agg(self, entries) -> DataFrame:
+        """rollup/cube/groupingSets: Expand (one projection per
+        grouping set, null-masked keys + grouping-id) -> Aggregate over
+        (keys + gid) -> Project dropping the internal gid key. The
+        Spark lowering (ExpandExec), device-planned like everything
+        else (reference GpuExpandExec.scala)."""
+        from spark_rapids_tpu.expr.aggregates import (
+            GroupingBit,
+            GroupingID,
+            Max,
+        )
+        from spark_rapids_tpu.expr.core import BoundReference, Literal
+        from spark_rapids_tpu.expr.mathexpr import BitwiseAnd, ShiftRight
+        from spark_rapids_tpu.sqltypes.datatypes import long as long_t
+
+        child = self.df._plan
+        cs = child.schema
+        ncols = len(cs.fields)
+        n = len(self.grouping)
+        gid_ord = ncols + n
+        sets = self._grouping_sets()
+        # duplicate grouping sets must produce duplicate result rows
+        # (Spark adds a grouping-set position to disambiguate)
+        need_pos = len(set(sets)) < len(sets)
+        projections = []
+        for pos_i, s in enumerate(sets):
+            gid_val = sum(1 << (n - 1 - i) for i in range(n)
+                          if i not in s)
+            proj = [Alias(BoundReference(j, f.dataType, f.nullable),
+                          f.name)
+                    for j, f in enumerate(cs.fields)]
+            proj += [
+                Alias(g.children[0] if i in s
+                      else Literal(None, g.dtype), f"__g{i}")
+                for i, g in enumerate(self.grouping)]
+            proj.append(Alias(Literal(gid_val, long_t),
+                              "spark_grouping_id"))
+            if need_pos:
+                proj.append(Alias(Literal(pos_i, long_t),
+                                  "__grouping_pos"))
+            projections.append(proj)
+        expand = L.Expand(projections, child)
+        new_grouping = [
+            Alias(BoundReference(ncols + i, g.dtype, True), g.name)
+            for i, g in enumerate(self.grouping)]
+        new_grouping.append(
+            Alias(BoundReference(gid_ord, long_t, False),
+                  "spark_grouping_id"))
+        if need_pos:
+            new_grouping.append(
+                Alias(BoundReference(gid_ord + 1, long_t, False),
+                      "__grouping_pos"))
+        gid_ref = BoundReference(gid_ord, long_t, False)
+        agg_aliases = []
+        for base, name in entries:
+            if isinstance(base, GroupingID):
+                agg_aliases.append(Alias(Max(gid_ref), name))
+            elif isinstance(base, GroupingBit):
+                i = self._grouping_index(base.children[0])
+                bit = BitwiseAnd(
+                    ShiftRight(gid_ref, Literal(n - 1 - i, long_t)),
+                    Literal(1, long_t))
+                agg_aliases.append(Alias(Max(bit), name))
+            else:
+                agg_aliases.append(Alias(base, name))
+        agg_plan = L.Aggregate(new_grouping, agg_aliases, expand)
+        nkeys = len(new_grouping)
+        out = [Alias(BoundReference(i, g.dtype, True), g.name)
+               for i, g in enumerate(self.grouping)]
+        out += [
+            Alias(BoundReference(nkeys + j, a.dtype,
+                                 a.children[0].nullable), a.name)
+            for j, a in enumerate(agg_aliases)]
+        return DataFrame(L.Project(out, agg_plan), self.df.session)
+
+    def _grouping_index(self, expr) -> int:
+        key = expr.key()
+        for i, g in enumerate(self.grouping):
+            if g.children[0].key() == key:
+                return i
+        raise ValueError(
+            f"grouping() argument {expr!r} is not a grouping column")
 
     def count(self) -> DataFrame:
         from spark_rapids_tpu.api import functions as F

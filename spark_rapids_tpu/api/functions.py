@@ -204,6 +204,20 @@ def any_value(c, ignorenulls: bool = True) -> Column:
     return Column(AnyValue(expr_of(c), ignore_nulls=ignorenulls))
 
 
+def grouping_id() -> Column:
+    """Grouping-set id inside rollup/cube/groupingSets agg()."""
+    from spark_rapids_tpu.expr.aggregates import GroupingID
+
+    return Column(GroupingID(), "spark_grouping_id()")
+
+
+def grouping(c) -> Column:
+    """1 when the column is aggregated in the current grouping set."""
+    from spark_rapids_tpu.expr.aggregates import GroupingBit
+
+    return Column(GroupingBit(expr_of(c)))
+
+
 # --- scalar functions ---
 
 def abs(c) -> Column:  # noqa: A001

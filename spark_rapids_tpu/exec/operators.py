@@ -278,6 +278,141 @@ class CpuProjectExec(PhysicalPlan):
                                        names=[e.name for e in self.exprs])
 
 
+class TpuExpandExec(PhysicalPlan):
+    """One output batch per projection per input batch (reference
+    GpuExpandExec.scala iterates projections per batch to bound peak
+    memory the same way)."""
+
+    def __init__(self, projections, child, schema, conf):
+        from spark_rapids_tpu.runtime.jit_cache import aliases_key, cached_jit
+        from spark_rapids_tpu.runtime.jit_cache import detached
+
+        super().__init__([child], schema, conf)
+        self.projections = projections
+        det = detached(self)
+        self._jitted = [
+            cached_jit(("expand", i, aliases_key(p)),
+                       lambda i=i: lambda b: det._run(b, i))
+            for i, p in enumerate(projections)]
+
+    def _run(self, batch: ColumnBatch, i: int) -> ColumnBatch:
+        ctx = EvalContext(batch)
+        cols = [e.eval(ctx) for e in self.projections[i]]
+        return ColumnBatch(self.schema, cols, batch.num_rows)
+
+    def execute_partition(self, pid, ctx):
+        with self.metrics[M.OP_TIME].ns():
+            for batch in self.children[0].execute_partition(pid, ctx):
+                for fn in self._jitted:
+                    out = fn(batch)
+                    self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
+                    yield out
+
+
+class CpuExpandExec(PhysicalPlan):
+    is_tpu = False
+
+    def __init__(self, projections, child, schema, conf):
+        super().__init__([child], schema, conf)
+        self.projections = projections
+
+    def execute_partition(self, pid, ctx):
+        from spark_rapids_tpu.sqltypes.datatypes import to_arrow_type
+
+        names = [e.name for e in self.projections[0]]
+        types = [to_arrow_type(f.dataType) for f in self.schema.fields]
+        for table in self.children[0].execute_partition(pid, ctx):
+            for proj in self.projections:
+                arrays = []
+                for e, at in zip(proj, types):
+                    arr = cpu_eval.eval_expr(e, table).combine_chunks()
+                    if arr.type != at:
+                        arr = arr.cast(at)
+                    arrays.append(arr)
+                yield pa.Table.from_arrays(arrays, names=names)
+
+
+def _sample_uniform01(pos, seed: int, xp):
+    """Deterministic per-row uniform in [0,1) from (seed, global row
+    position) — two rounds of 32-bit avalanche mixing; identical
+    numpy/jnp implementations keep the device engine and the CPU oracle
+    selecting the same rows."""
+    x = pos.astype(xp.uint32)
+    x = x ^ xp.uint32(seed & 0xFFFFFFFF)
+    for _ in range(2):
+        x = (x ^ (x >> xp.uint32(16))) * xp.uint32(0x7FEB352D)
+        x = (x ^ (x >> xp.uint32(15))) * xp.uint32(0x846CA68B)
+        x = x ^ (x >> xp.uint32(16))
+    return x.astype(xp.float64) / 4294967296.0
+
+
+class TpuSampleExec(PhysicalPlan):
+    """Bernoulli sample without replacement, on device."""
+
+    def __init__(self, fraction, seed, child, conf):
+        from spark_rapids_tpu.runtime.jit_cache import cached_jit, detached
+
+        super().__init__([child], child.schema, conf)
+        self.fraction = fraction
+        self.seed = seed
+        det = detached(self)
+        self._jitted = cached_jit(("sample", fraction, seed),
+                                  lambda: det._run)
+
+    def _run(self, batch: ColumnBatch, offset, pid) -> ColumnBatch:
+        cap = batch.capacity
+        # partition id folds into the position stream (traced scalar, so
+        # one compiled program serves every partition)
+        pos = offset + jnp.arange(cap, dtype=jnp.int64) \
+            + pid * jnp.int64(0x5DEECE66D)
+        u = _sample_uniform01(pos, self.seed, jnp)
+        keep = batch.live_mask() & (u < self.fraction)
+        return filterops.compact(batch, keep)
+
+    def execute_partition(self, pid, ctx):
+        with self.metrics[M.OP_TIME].ns():
+            offset = 0
+            pid_arr = jnp.int64(pid)
+            for batch in self.children[0].execute_partition(pid, ctx):
+                out = self._jitted(batch, jnp.int64(offset), pid_arr)
+                offset += batch.row_count()
+                yield out
+
+
+class CpuSampleExec(PhysicalPlan):
+    """Arrow-side sample; also handles with-replacement (Poisson row
+    repetition), which has no fixed-shape device lowering."""
+
+    is_tpu = False
+
+    def __init__(self, fraction, seed, with_replacement, child, conf):
+        super().__init__([child], child.schema, conf)
+        self.fraction = fraction
+        self.seed = seed
+        self.with_replacement = with_replacement
+        self._off = {}
+
+    def execute_partition(self, pid, ctx):
+        self._off[pid] = 0
+        # one RNG stream per partition (not per batch) so successive
+        # batches draw fresh Poisson counts
+        rng = np.random.default_rng(
+            (self.seed * 1_000_003 + pid) & 0xFFFFFFFF)
+        for table in self.children[0].execute_partition(pid, ctx):
+            n = table.num_rows
+            offset = self._off[pid]
+            self._off[pid] = offset + n
+            if self.with_replacement:
+                counts = rng.poisson(self.fraction, n)
+                idx = np.repeat(np.arange(n), counts)
+                yield table.take(pa.array(idx))
+            else:
+                pos = (np.arange(offset, offset + n, dtype=np.int64)
+                       + pid * 0x5DEECE66D)
+                u = _sample_uniform01(pos, self.seed, np)
+                yield table.filter(pa.array(u < self.fraction))
+
+
 class TpuFilterExec(PhysicalPlan):
     def __init__(self, condition, child, conf):
         from spark_rapids_tpu.runtime.jit_cache import cached_jit

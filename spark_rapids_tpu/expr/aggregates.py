@@ -298,6 +298,36 @@ class AnyValue(First):
         return ("any_value", self.ignore_nulls, self.children[0].key())
 
 
+class GroupingID(Expression):
+    """Marker for F.grouping_id(); rewritten by rollup/cube/grouping-
+    sets agg() into a reference to the synthesized grouping-id column.
+    Invalid outside those contexts (as in Spark)."""
+
+    @property
+    def dtype(self):
+        return long
+
+    @property
+    def nullable(self):
+        return False
+
+
+class GroupingBit(Expression):
+    """Marker for F.grouping(col): 1 when the column is aggregated
+    (masked) in the grouping set, else 0."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return long
+
+    @property
+    def nullable(self):
+        return False
+
+
 # --------------------------------------------------------- moment family
 #
 # Variance/stddev/skewness/kurtosis over raw power sums (n, Σx, Σx²,…)

@@ -263,6 +263,57 @@ class Generate(LogicalPlan):
         return f"Generate [{self.gen_alias.name}]"
 
 
+class Expand(LogicalPlan):
+    """Each input row emits one output row per projection list — the
+    lowering for rollup/cube/grouping sets and distinct-aggregate
+    rewrites (Spark ExpandExec; reference GpuExpandExec.scala).
+
+    All projection lists share arity/names/types; a slot is nullable if
+    it is nullable under ANY projection."""
+
+    def __init__(self, projections: List[List[Alias]], child: LogicalPlan):
+        super().__init__([child])
+        assert projections
+        arity = len(projections[0])
+        assert all(len(p) == arity for p in projections)
+        self.projections = projections
+
+    @property
+    def schema(self):
+        first = self.projections[0]
+        fields = []
+        for i, e in enumerate(first):
+            nullable = any(p[i].nullable for p in self.projections)
+            fields.append(StructField(e.name, e.dtype, nullable))
+        return StructType(fields)
+
+    def _node_string(self):
+        return (f"Expand x{len(self.projections)} ["
+                + ", ".join(e.name for e in self.projections[0]) + "]")
+
+
+class Sample(LogicalPlan):
+    """Bernoulli row sample. Deterministic in (seed, partition, row
+    position) so the device and CPU-oracle engines select identical
+    rows (Spark SampleExec; reference GpuSampleExec in
+    basicPhysicalOperators.scala)."""
+
+    def __init__(self, fraction: float, seed: int, with_replacement: bool,
+                 child: LogicalPlan):
+        super().__init__([child])
+        assert with_replacement or 0.0 <= fraction <= 1.0, fraction
+        self.fraction = fraction
+        self.seed = seed
+        self.with_replacement = with_replacement
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def _node_string(self):
+        return f"Sample fraction={self.fraction} seed={self.seed}"
+
+
 class Limit(LogicalPlan):
     def __init__(self, n: int, child: LogicalPlan):
         super().__init__([child])

@@ -140,6 +140,15 @@ class TpuOverrides:
             gen_input = node.gen_alias.children[0].children[0]
             for r in expr_unsupported_reasons(gen_input):
                 meta.cannot_run(r)
+        elif isinstance(node, L.Expand):
+            for p in node.projections:
+                for e in p:
+                    for r in expr_unsupported_reasons(e):
+                        meta.cannot_run(r)
+        elif isinstance(node, L.Sample):
+            if node.with_replacement:
+                meta.cannot_run("with-replacement sampling has no "
+                                "fixed-shape device lowering (CPU)")
         elif isinstance(node, L.Window):
             self._tag_window(node, meta)
         elif isinstance(node, L.LocalRelation):
@@ -267,6 +276,21 @@ class TpuOverrides:
                 return ops.TpuFilterExec(node.condition,
                                          self._to_device(children[0]), conf)
             return ops.CpuFilterExec(node.condition,
+                                     self._to_host(children[0]), conf)
+        if isinstance(node, L.Expand):
+            if on_device:
+                return ops.TpuExpandExec(node.projections,
+                                         self._to_device(children[0]),
+                                         node.schema, conf)
+            return ops.CpuExpandExec(node.projections,
+                                     self._to_host(children[0]),
+                                     node.schema, conf)
+        if isinstance(node, L.Sample):
+            if on_device:
+                return ops.TpuSampleExec(node.fraction, node.seed,
+                                         self._to_device(children[0]), conf)
+            return ops.CpuSampleExec(node.fraction, node.seed,
+                                     node.with_replacement,
                                      self._to_host(children[0]), conf)
         if isinstance(node, L.Aggregate):
             return self._convert_aggregate(node, children[0], on_device)

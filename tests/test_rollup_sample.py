@@ -1,0 +1,161 @@
+"""Rollup/cube/grouping-sets (Expand lowering; reference
+GpuExpandExec.scala + GpuOverrides expand rules) and Bernoulli sampling
+(reference GpuSampleExec, basicPhysicalOperators.scala)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.testing.asserts import (
+    assert_tpu_and_cpu_are_equal_collect,
+    with_tpu_session,
+)
+
+
+@pytest.fixture(scope="module")
+def cube_path(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cubedata")
+    rng = np.random.default_rng(11)
+    n = 3000
+    t = pa.table({
+        "a": pa.array(rng.integers(0, 4, n),
+                      mask=rng.random(n) < 0.05),
+        "b": pa.array([["x", "y", "z"][i]
+                       for i in rng.integers(0, 3, n)]),
+        "v": pa.array(rng.random(n) * 10,
+                      mask=rng.random(n) < 0.1),
+    })
+    p = str(d / "cube.parquet")
+    pq.write_table(t, p)
+    return p
+
+
+def test_rollup_diff(cube_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.read.parquet(cube_path).rollup("a", "b")
+        .agg(F.sum("v").alias("s"), F.count("*").alias("c"),
+             F.grouping_id().alias("gid")))
+
+
+def test_cube_diff(cube_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.read.parquet(cube_path).cube("a", "b")
+        .agg(F.avg("v").alias("m"), F.grouping("a").alias("ga"),
+             F.grouping("b").alias("gb")))
+
+
+def test_grouping_sets_diff(cube_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.read.parquet(cube_path)
+        .groupingSets([["a"], ["b"], []], "a", "b")
+        .agg(F.count("*").alias("c"), F.max("v").alias("mx")))
+
+
+def test_rollup_row_count_and_total(cube_path):
+    def q(spark):
+        return (spark.read.parquet(cube_path).rollup("a", "b")
+                .agg(F.count("*").alias("c"),
+                     F.grouping_id().alias("gid"))
+                .collect_arrow().to_pandas())
+
+    df = with_tpu_session(q)
+    n_total = pq.read_table(cube_path).num_rows
+    grand = df[df.gid == 3]
+    assert len(grand) == 1
+    assert int(grand.c.iloc[0]) == n_total
+    # per-a subtotals sum back to the grand total
+    assert int(df[df.gid == 1].c.sum()) == n_total
+
+
+def test_grouping_id_requires_multi_set(cube_path):
+    with pytest.raises(ValueError, match="rollup/cube"):
+        with_tpu_session(
+            lambda spark: spark.read.parquet(cube_path).groupBy("a")
+            .agg(F.grouping_id().alias("g")).collect_arrow())
+
+
+def test_sample_deterministic_and_fraction(cube_path):
+    def q(spark):
+        return spark.read.parquet(cube_path).sample(0.4, 7) \
+            .collect_arrow()
+
+    a = with_tpu_session(q)
+    b = with_tpu_session(q)
+    assert a.equals(b)
+    n = pq.read_table(cube_path).num_rows
+    assert 0.3 * n < a.num_rows < 0.5 * n
+
+
+def test_sample_diff(cube_path):
+    # identical hash stream on device and CPU oracle -> identical rows
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.read.parquet(cube_path).sample(0.25, 123))
+
+
+def test_sample_with_replacement_cpu_fallback(cube_path):
+    from spark_rapids_tpu.testing.asserts import assert_tpu_fallback_collect
+
+    def q(spark):
+        return spark.read.parquet(cube_path).sample(True, 1.5, 3)
+
+    out = with_tpu_session(lambda spark: q(spark).collect_arrow())
+    n = pq.read_table(cube_path).num_rows
+    # poisson(1.5) mean: expect ~1.5x rows
+    assert n < out.num_rows < 2.2 * n
+
+
+def test_unaliased_grouping_id(cube_path):
+    def q(spark):
+        return (spark.read.parquet(cube_path).rollup("a")
+                .agg(F.sum("v"), F.grouping_id(), F.grouping("a"))
+                .collect_arrow())
+
+    out = with_tpu_session(q)
+    assert "spark_grouping_id()" in out.column_names
+
+
+def test_duplicate_grouping_sets(cube_path):
+    """GROUPING SETS ((b),(b)) emits each group twice (Spark
+    disambiguates duplicate sets by position)."""
+    def q(spark):
+        return (spark.read.parquet(cube_path)
+                .groupingSets([["b"], ["b"]], "b")
+                .agg(F.sum("v").alias("s"), F.count("*").alias("c"))
+                .collect_arrow().to_pandas()
+                .sort_values(["b", "s"]).reset_index(drop=True))
+
+    dup = with_tpu_session(q)
+
+    def single(spark):
+        return (spark.read.parquet(cube_path).groupBy("b")
+                .agg(F.sum("v").alias("s"), F.count("*").alias("c"))
+                .collect_arrow().to_pandas()
+                .sort_values("b").reset_index(drop=True))
+
+    base = with_tpu_session(single)
+    assert len(dup) == 2 * len(base)
+    # values are NOT doubled — each copy equals the plain groupBy row
+    merged = dup.drop_duplicates().reset_index(drop=True)
+    assert np.allclose(merged.s.to_numpy(), base.s.to_numpy())
+    assert (merged.c.to_numpy() == base.c.to_numpy()).all()
+
+
+def test_sample_with_replacement_multibatch_varies(cube_path):
+    """Poisson draws must differ across batches (per-partition RNG
+    stream, not per-batch)."""
+    def q(spark):
+        return (spark.read.parquet(cube_path)
+                .sample(True, 1.0, 5).collect_arrow())
+
+    out = with_tpu_session(
+        q, conf={"spark.rapids.sql.reader.batchSizeRows": 512})
+    n = pq.read_table(cube_path).num_rows
+    assert 0.7 * n < out.num_rows < 1.4 * n
+
+
+def test_sample_then_agg(cube_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.read.parquet(cube_path).sample(0.5, 99)
+        .groupBy("b").agg(F.sum("v").alias("s")))
