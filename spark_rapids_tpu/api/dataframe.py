@@ -42,6 +42,64 @@ def _resolve(expr, schema) -> Expression:
     raise TypeError(f"cannot resolve {expr!r}")
 
 
+def _stamp_session(expr: Expression, session) -> Expression:
+    """Post-resolution session pass: stamp the session timezone on
+    tz-aware nodes (GpuTimeZoneDB role — the zone becomes part of every
+    jit key) and pin current_date/current_timestamp to one literal per
+    query (Spark's QueryExecution does the same)."""
+    from spark_rapids_tpu.config import rapids_conf as rc
+    from spark_rapids_tpu.expr.cast import Cast
+    from spark_rapids_tpu.expr.datetimes import (
+        CurrentDate,
+        CurrentTimestamp,
+        TzAware,
+    )
+
+    tz = session.rapids_conf.get(rc.SESSION_TZ) if session else "UTC"
+
+    def fn(node):
+        if isinstance(node, (TzAware, Cast, CurrentDate,
+                             CurrentTimestamp)):
+            node.tz = tz  # node is a fresh copy from transform()
+        return node
+
+    return expr.transform(fn)
+
+
+def _pin_query_time(plan):
+    """Replace current_date/current_timestamp markers with ONE literal
+    per query (Spark pins both at query start), applied at physical
+    planning time."""
+    import time
+
+    import numpy as np
+
+    from spark_rapids_tpu.expr.core import Literal
+    from spark_rapids_tpu.expr.datetimes import (
+        CurrentDate,
+        CurrentTimestamp,
+    )
+    from spark_rapids_tpu.ops import tzdb
+    from spark_rapids_tpu.sqltypes.datatypes import (
+        date as date_t,
+        timestamp as timestamp_t,
+    )
+
+    now_us = int(time.time() * 1_000_000)
+
+    def efn(node):
+        if isinstance(node, CurrentTimestamp):
+            return Literal(now_us, timestamp_t)
+        if isinstance(node, CurrentDate):
+            local = int(tzdb.utc_to_local_np(
+                np.array([now_us], np.int64),
+                getattr(node, "tz", "UTC"))[0])
+            return Literal(local // 86_400_000_000, date_t)
+        return node
+
+    return L.transform_expressions(plan, lambda e: e.transform(efn))
+
+
 def _field_index(schema, name: str) -> int:
     lowered = [n.lower() for n in schema.names]
     if name in schema.names:
@@ -84,9 +142,10 @@ class DataFrame:
 
     def _col_expr(self, c) -> Expression:
         if isinstance(c, str):
-            return self[c].expr
+            return _stamp_session(self[c].expr, self.session)
         if isinstance(c, Column):
-            return _resolve(c.expr, self.schema)
+            return _stamp_session(_resolve(c.expr, self.schema),
+                                  self.session)
         raise TypeError(repr(c))
 
     def select(self, *cols) -> "DataFrame":
@@ -286,7 +345,7 @@ class DataFrame:
                 return node.with_children([go(c) for c in node.children])
             raise TypeError(f"cannot resolve {node!r}")
 
-        return go(e)
+        return _stamp_session(go(e), self.session)
 
     @staticmethod
     def _promote_keys(lk, rk):
@@ -413,8 +472,10 @@ class DataFrame:
                     else [ascending] * len(cols))
         for c, asc in zip(cols, asc_list):
             if isinstance(c, SortColumn):
-                orders.append(L.SortOrder(_resolve(c.expr, self.schema),
-                                          c.ascending, c.nulls_first))
+                orders.append(L.SortOrder(
+                    _stamp_session(_resolve(c.expr, self.schema),
+                                   self.session),
+                    c.ascending, c.nulls_first))
                 continue
             a = True if asc is None else bool(asc)
             orders.append(L.SortOrder(self._col_expr(c), a))
@@ -444,7 +505,8 @@ class DataFrame:
         from spark_rapids_tpu.plan.optimizer import optimize
         from spark_rapids_tpu.plan.overrides import plan_query
 
-        return plan_query(optimize(self._plan), self.session.rapids_conf)
+        plan = _pin_query_time(self._plan)
+        return plan_query(optimize(plan), self.session.rapids_conf)
 
     # --- caching (ParquetCachedBatchSerializer analog: df.cache() data
     # --- lives as compressed parquet blobs, decoded on reuse) ---

@@ -248,8 +248,141 @@ def length(c) -> Column:
     return Column(Length(expr_of(c)))
 
 
+def _dt(cls, *args):
+    from spark_rapids_tpu.expr import datetimes as DT
+
+    return Column(getattr(DT, cls)(*args))
+
+
 def year(c) -> Column:
     return Column(Year(expr_of(c)))
+
+
+def dayofweek(c) -> Column:
+    return _dt("DayOfWeek", expr_of(c))
+
+
+def weekday(c) -> Column:
+    return _dt("WeekDay", expr_of(c))
+
+
+def dayofyear(c) -> Column:
+    return _dt("DayOfYear", expr_of(c))
+
+
+def weekofyear(c) -> Column:
+    return _dt("WeekOfYear", expr_of(c))
+
+
+def quarter(c) -> Column:
+    return _dt("Quarter", expr_of(c))
+
+
+def last_day(c) -> Column:
+    return _dt("LastDay", expr_of(c))
+
+
+def date_add(c, days) -> Column:
+    return _dt("DateAdd", expr_of(c), expr_of(lit_or(days)))
+
+
+def date_sub(c, days) -> Column:
+    return _dt("DateSub", expr_of(c), expr_of(lit_or(days)))
+
+
+def datediff(end, start) -> Column:
+    return _dt("DateDiff", expr_of(end), expr_of(start))
+
+
+def add_months(c, months) -> Column:
+    return _dt("AddMonths", expr_of(c), expr_of(lit_or(months)))
+
+
+def months_between(end, start, roundOff: bool = True) -> Column:
+    from spark_rapids_tpu.expr.datetimes import MonthsBetween
+
+    return Column(MonthsBetween(expr_of(end), expr_of(start), roundOff))
+
+
+def next_day(c, dayOfWeek: str) -> Column:
+    return _dt("NextDay", expr_of(c), dayOfWeek)
+
+
+def trunc(c, fmt: str) -> Column:
+    return _dt("TruncDate", expr_of(c), fmt)
+
+
+def date_trunc(fmt: str, c) -> Column:
+    return _dt("DateTrunc", fmt, expr_of(c))
+
+
+def unix_timestamp(c) -> Column:
+    from spark_rapids_tpu.expr.datetimes import UnixTimestamp
+
+    # string/date input routes through the cast machinery first
+    # (no-op for timestamps)
+    return Column(UnixTimestamp(_StringToTs(expr_of(c))))
+
+
+def _StringToTs(e):
+    from spark_rapids_tpu.expr import Cast
+    from spark_rapids_tpu.sqltypes.datatypes import timestamp as _ts_t
+
+    return Cast(e, _ts_t)
+
+
+def from_unixtime(c, fmt: str = "yyyy-MM-dd HH:mm:ss") -> Column:
+    return _dt("FromUnixtime", expr_of(c), fmt)
+
+
+def timestamp_seconds(c) -> Column:
+    return _dt("SecondsToTimestamp", expr_of(c))
+
+
+def make_date(y, m, d) -> Column:
+    return _dt("MakeDate", expr_of(y), expr_of(m), expr_of(d))
+
+
+def from_utc_timestamp(c, tz: str) -> Column:
+    return _dt("FromUtcTimestamp", expr_of(c), tz)
+
+
+def to_utc_timestamp(c, tz: str) -> Column:
+    return _dt("ToUtcTimestamp", expr_of(c), tz)
+
+
+def date_format(c, fmt: str) -> Column:
+    return _dt("DateFormat", expr_of(c), fmt)
+
+
+def to_date(c, fmt: str = None) -> Column:
+    from spark_rapids_tpu.expr import Cast
+    from spark_rapids_tpu.sqltypes.datatypes import date as _date_t
+
+    if fmt is not None and fmt not in ("yyyy-MM-dd",):
+        raise ValueError(
+            f"to_date format {fmt!r} unsupported in v1 (default "
+            "'yyyy-MM-dd' only)")
+    return Column(Cast(expr_of(c), _date_t))
+
+
+def to_timestamp(c, fmt: str = None) -> Column:
+    from spark_rapids_tpu.expr import Cast
+    from spark_rapids_tpu.sqltypes.datatypes import timestamp as _ts_t
+
+    if fmt is not None and fmt not in ("yyyy-MM-dd HH:mm:ss",
+                                       "yyyy-MM-dd"):
+        raise ValueError(
+            f"to_timestamp format {fmt!r} unsupported in v1")
+    return Column(Cast(expr_of(c), _ts_t))
+
+
+def current_date() -> Column:
+    return _dt("CurrentDate")
+
+
+def current_timestamp() -> Column:
+    return _dt("CurrentTimestamp")
 
 
 def month(c) -> Column:

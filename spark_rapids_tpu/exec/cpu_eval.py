@@ -203,17 +203,17 @@ def _ev(e: Expression, t: pa.Table):
         return pc.match_substring(_ev(e.children[0], t),
                                   e.needle.decode("utf-8"))
     if isinstance(e, Year):
-        return pc.cast(pc.year(_ev(e.children[0], t)), pa.int32())
+        return pc.cast(pc.year(_loc(e, t)), pa.int32())
     if isinstance(e, Month):
-        return pc.cast(pc.month(_ev(e.children[0], t)), pa.int32())
+        return pc.cast(pc.month(_loc(e, t)), pa.int32())
     if isinstance(e, DayOfMonth):
-        return pc.cast(pc.day(_ev(e.children[0], t)), pa.int32())
+        return pc.cast(pc.day(_loc(e, t)), pa.int32())
     if isinstance(e, Hour):
-        return pc.cast(pc.hour(_ev(e.children[0], t)), pa.int32())
+        return pc.cast(pc.hour(_loc(e, t)), pa.int32())
     if isinstance(e, Minute):
-        return pc.cast(pc.minute(_ev(e.children[0], t)), pa.int32())
+        return pc.cast(pc.minute(_loc(e, t)), pa.int32())
     if isinstance(e, Second):
-        return pc.cast(pc.second(_ev(e.children[0], t)), pa.int32())
+        return pc.cast(pc.second(_loc(e, t)), pa.int32())
     if isinstance(e, Murmur3Hash):
         return _murmur3_cpu(e, t)
     from spark_rapids_tpu.udf.pandas_udf import PandasUDF
@@ -231,10 +231,274 @@ def _ev(e: Expression, t: pa.Table):
     r = _ev_collections(e, t)
     if r is not None:
         return r
+    r = _ev_datetime(e, t)
+    if r is not None:
+        return r
     r = _ev_ext(e, t)
     if r is not None:
         return r
     raise NotImplementedError(f"CPU eval for {type(e).__name__}")
+
+
+def _tz_utc(tz: str) -> bool:
+    from spark_rapids_tpu.ops import tzdb
+
+    return tzdb.is_utc(tz)
+
+
+def _localize(arr, tz: str):
+    """Localize a tz-aware arrow timestamp array so pc temporal kernels
+    extract wall-clock parts in the session zone."""
+    if not _tz_utc(tz) and pa.types.is_timestamp(arr.type):
+        return arr.cast(pa.timestamp("us", tz))
+    return arr
+
+
+def _loc(e: Expression, t: pa.Table):
+    return _localize(_ev(e.children[0], t), getattr(e, "tz", "UTC"))
+
+
+def _ev_datetime(e: Expression, t: pa.Table):
+    """Datetime-family oracle (independent pandas/arrow
+    implementations of the Spark semantics)."""
+    import pandas as pd
+
+    from spark_rapids_tpu.expr import datetimes as DT
+
+    if isinstance(e, DT.DayOfWeek):
+        mon0 = pc.day_of_week(_loc(e, t))  # Monday=0
+        # Spark: Sunday=1..Saturday=7
+        return pc.cast(pc.if_else(pc.equal(mon0, 6), 1,
+                                  pc.add(mon0, 2)), pa.int32())
+    if isinstance(e, DT.WeekDay):
+        return pc.cast(pc.day_of_week(_loc(e, t)), pa.int32())
+    if isinstance(e, DT.DayOfYear):
+        return pc.cast(pc.day_of_year(_loc(e, t)), pa.int32())
+    if isinstance(e, DT.WeekOfYear):
+        return pc.cast(pc.iso_week(_loc(e, t)), pa.int32())
+    if isinstance(e, DT.Quarter):
+        return pc.cast(pc.quarter(_loc(e, t)), pa.int32())
+    if isinstance(e, DT.LastDay):
+        s = pd.Series(_loc(e, t).to_pandas())
+        dt = pd.to_datetime(s)
+        out = (dt + pd.offsets.MonthEnd(0)).where(dt.notna())
+        # MonthEnd(0) leaves month-ends alone but rolls others forward
+        return pa.array(out.dt.date, type=pa.date32())
+    if isinstance(e, (DT.DateAdd, DT.DateSub)):
+        d = _ev(e.children[0], t)
+        n = pc.cast(_ev(e.children[1], t), pa.int32())
+        days = pc.cast(d, pa.int32())
+        sgn = 1 if not isinstance(e, DT.DateSub) else -1
+        return _days_to_date(pc.add(days, pc.multiply(n, sgn)))
+    if isinstance(e, DT.DateDiff):
+        a = pc.cast(_ev(e.children[0], t), pa.int32())
+        b = pc.cast(_ev(e.children[1], t), pa.int32())
+        return pc.subtract(a, b)
+    if isinstance(e, DT.AddMonths):
+        d = pd.Series(_ev(e.children[0], t).to_pandas())
+        n = pd.Series(_ev(e.children[1], t).to_pandas())
+        dt = pd.to_datetime(d)
+        ok = dt.notna() & n.notna()
+        nz = n.fillna(0).astype(np.int64)
+        m0 = (dt.dt.year.fillna(1970).astype(np.int64) * 12
+              + dt.dt.month.fillna(1).astype(np.int64) - 1 + nz)
+        ny = m0 // 12
+        nm = (m0 % 12 + 1).astype(np.int64)
+        first = pd.to_datetime(dict(year=ny, month=nm,
+                                    day=np.ones(len(ny), np.int64)))
+        dim = (first + pd.offsets.MonthEnd(0)).dt.day
+        day = np.minimum(dt.dt.day.fillna(1).astype(np.int64), dim)
+        res = first + pd.to_timedelta(day - 1, unit="D")
+        return pa.array(res.where(ok).dt.date, type=pa.date32())
+    if isinstance(e, DT.MonthsBetween):
+        tz = getattr(e, "tz", "UTC")
+
+        def fields(x):
+            arr = _localize(_ev(x, t), tz)
+            if pa.types.is_timestamp(arr.type):
+                s = pd.Series(arr.to_pandas()).dt.tz_localize(None)
+            else:
+                s = pd.to_datetime(pd.Series(arr.to_pandas()))
+            return s
+
+        s1, s2 = fields(e.children[0]), fields(e.children[1])
+        ok = s1.notna() & s2.notna()
+        months = ((s1.dt.year - s2.dt.year) * 12
+                  + (s1.dt.month - s2.dt.month)).astype(float)
+        last1 = s1.dt.day == s1.dt.days_in_month
+        last2 = s2.dt.day == s2.dt.days_in_month
+        integral = (s1.dt.day == s2.dt.day) | (last1 & last2)
+        sec1 = (s1.dt.day * 86400.0 + s1.dt.hour * 3600.0
+                + s1.dt.minute * 60.0 + s1.dt.second
+                + s1.dt.microsecond / 1e6)
+        sec2 = (s2.dt.day * 86400.0 + s2.dt.hour * 3600.0
+                + s2.dt.minute * 60.0 + s2.dt.second
+                + s2.dt.microsecond / 1e6)
+        out = months.where(integral,
+                           months + (sec1 - sec2) / (31.0 * 86400.0))
+        if e.round_off:
+            out = (out * 1e8).round() / 1e8
+        return pa.array(out.where(ok), type=pa.float64())
+    if isinstance(e, DT.NextDay):
+        arr = pc.cast(_ev(e.children[0], t), pa.int32())
+        if e.target is None:
+            return pa.nulls(len(arr), pa.date32())
+        mask = np.asarray(pc.is_null(arr).to_numpy(zero_copy_only=False),
+                          dtype=bool)
+        d = np.where(mask, 0, arr.to_numpy(zero_copy_only=False)
+                     ).astype(np.int64)
+        dow = (d + 3) % 7 + 1  # ISO Mon=1..Sun=7
+        delta = (e.target - dow + 7) % 7
+        delta = np.where(delta == 0, 7, delta)
+        return pa.array((d + delta).astype(np.int32), type=pa.int32(),
+                        mask=mask).view(pa.date32())
+    if isinstance(e, DT.TruncDate):
+        if e.unit is None:
+            d = _ev(e.children[0], t)
+            return pa.nulls(len(d), pa.date32())
+        s = pd.to_datetime(pd.Series(_ev(e.children[0], t).to_pandas()))
+        return pa.array(_pd_trunc(s, e.unit).dt.date, type=pa.date32())
+    if isinstance(e, DT.DateTrunc):
+        arr = _ev(e.children[0], t)
+        if e.unit is None:
+            return pa.nulls(len(arr), arr.type)
+        tz = getattr(e, "tz", "UTC")
+        s = pd.Series(_localize(arr, tz).to_pandas())
+        wall = s.dt.tz_localize(None)
+        tr = _pd_trunc(wall, e.unit)
+        zone = tz if not _tz_utc(tz) else "UTC"
+        back = tr.dt.tz_localize(zone, ambiguous=True,
+                                 nonexistent="shift_forward")
+        return pa.array(back.dt.tz_convert("UTC"),
+                        type=pa.timestamp("us", tz="UTC"))
+    if isinstance(e, DT.UnixTimestamp):
+        a = _ev(e.children[0], t)
+        us = pc.cast(a.cast(pa.timestamp("us")), pa.int64())
+        return _floor_div_i64(us, 1_000_000)
+    if isinstance(e, DT.SecondsToTimestamp):
+        a = _ev(e.children[0], t)
+        if pa.types.is_floating(a.type):
+            us = pc.cast(pc.round(pc.multiply(
+                pc.cast(a, pa.float64()), 1e6)), pa.int64())
+        else:
+            us = pc.multiply(pc.cast(a, pa.int64()), 1_000_000)
+        return us.cast(pa.timestamp("us")).cast(
+            pa.timestamp("us", tz="UTC"))
+    if isinstance(e, DT.MakeDate):
+        def mat(x):
+            r = _ev(x, t)
+            if isinstance(r, pa.Scalar):
+                r = pa.array([r.as_py()] * t.num_rows, type=r.type)
+            return pd.Series(r.to_pandas())
+
+        y, m, d = (mat(c) for c in e.children)
+        res = pd.to_datetime(
+            dict(year=y, month=m, day=d), errors="coerce")
+        return pa.array(res.dt.date, type=pa.date32())
+    if isinstance(e, DT.FromUtcTimestamp):
+        from spark_rapids_tpu.ops import tzdb
+
+        a = _ev(e.children[0], t)
+        us, mask = _ts_us_numpy(a)
+        fn = (tzdb.local_to_utc_np if e._to_utc
+              else tzdb.utc_to_local_np)
+        out = fn(us, e.zone)
+        return pa.array(out, type=pa.int64(), mask=mask).cast(
+            pa.timestamp("us")).cast(pa.timestamp("us", tz="UTC"))
+    if isinstance(e, DT.DateFormat):  # incl. FromUnixtime
+        arr = _ev(e.children[0], t)
+        tz = getattr(e, "tz", "UTC")
+        fmt = _java_fmt_to_strftime(e.fmt)  # raises on unknown letters
+        if pa.types.is_timestamp(arr.type):
+            # floor to seconds precision: arrow's %S would append the
+            # fraction and its us->s cast truncates toward zero
+            us, mask = _ts_us_numpy(arr)
+            arr = _epoch_secs_localized(us, mask, tz)
+        elif pa.types.is_date(arr.type):
+            arr = pc.cast(arr, pa.timestamp("s"))
+        return pc.strftime(arr, format=fmt)
+    return None
+
+
+_JAVA_FMT_TOKENS = (
+    ("yyyy", "%Y"), ("EEEE", "%A"), ("EEE", "%a"), ("MM", "%m"),
+    ("dd", "%d"), ("HH", "%H"), ("mm", "%M"), ("ss", "%S"), ("a", "%p"),
+)
+
+
+def _java_fmt_to_strftime(fmt: str) -> str:
+    """Java SimpleDateFormat subset -> strftime; raises on pattern
+    letters with no mapping instead of emitting them as literal text."""
+    out = []
+    i = 0
+    while i < len(fmt):
+        for tok, rep in _JAVA_FMT_TOKENS:
+            if fmt.startswith(tok, i):
+                out.append(rep)
+                i += len(tok)
+                break
+        else:
+            ch = fmt[i]
+            if ch.isalpha():
+                raise NotImplementedError(
+                    f"date_format pattern letter {ch!r} in {fmt!r} has "
+                    "no CPU oracle mapping")
+            out.append("%%" if ch == "%" else ch)
+            i += 1
+    return "".join(out)
+
+
+def _epoch_secs_localized(us: np.ndarray, mask, tz: str):
+    """Floored epoch seconds -> arrow timestamp('s') in the session
+    zone (or UTC)."""
+    secs = pa.array(us // 1_000_000, type=pa.int64(), mask=mask).cast(
+        pa.timestamp("s")).cast(pa.timestamp("s", tz="UTC"))
+    if not _tz_utc(tz):
+        secs = secs.cast(pa.timestamp("s", tz))
+    return secs
+
+
+def _days_to_date(x):
+    """int days-since-epoch -> date32 (arrow has no numeric->date cast;
+    reinterpret the int32 buffer)."""
+    a = pc.cast(x, pa.int32())
+    if isinstance(a, pa.ChunkedArray):
+        a = a.combine_chunks()
+    return a.view(pa.date32())
+
+
+def _pd_trunc(s, unit):
+    import pandas as pd
+
+    if unit == "year":
+        return s.dt.to_period("Y").dt.to_timestamp()
+    if unit == "quarter":
+        return s.dt.to_period("Q").dt.to_timestamp()
+    if unit == "month":
+        return s.dt.to_period("M").dt.to_timestamp()
+    if unit == "week":
+        return (s - pd.to_timedelta(s.dt.weekday, unit="D")).dt.floor("D")
+    return s.dt.floor({"day": "D", "hour": "h", "minute": "min",
+                       "second": "s"}[unit])
+
+
+def _floor_div_i64(arr, k: int):
+    an = pc.cast(arr, pa.int64()).to_numpy(zero_copy_only=False)
+    mask = np.asarray(pc.is_null(arr).to_numpy(zero_copy_only=False),
+                      dtype=bool)
+    safe = np.where(mask, 0, an).astype(np.int64)
+    return pa.array(safe // k, type=pa.int64(), mask=mask)
+
+
+def _ts_us_numpy(arr):
+    mask = (np.asarray(pc.is_null(arr).to_numpy(zero_copy_only=False),
+                       dtype=bool)
+            if arr.null_count else None)
+    us = pc.cast(arr.cast(pa.timestamp("us")), pa.int64()) \
+        .to_numpy(zero_copy_only=False)
+    if mask is not None:
+        us = np.where(mask, 0, us)
+    return us.astype(np.int64), mask
 
 
 def _ev_collections(e: Expression, t: pa.Table):
@@ -521,15 +785,26 @@ def _host_parse_string(values, to, ansi: bool):
 def _cast(e: Cast, t: pa.Table):
     from spark_rapids_tpu.config.rapids_conf import ansi_enabled
 
+    from spark_rapids_tpu.sqltypes import DateType
+
     a = _ev(e.children[0], t)
     frm, to = e.children[0].dtype, e.to
     at = to_arrow_type(to)
     ansi = ansi_enabled()
+    tz = getattr(e, "tz", "UTC")
     if isinstance(frm, StringType) and not isinstance(to, StringType):
         vals = _host_parse_string(
             a.to_pylist() if hasattr(a, "to_pylist") else list(a), to,
             ansi)
-        return pa.array(vals, type=at)
+        out = pa.array(vals, type=at)
+        if isinstance(to, TimestampType) and not _tz_utc(tz):
+            from spark_rapids_tpu.ops import tzdb
+
+            us, mask = _ts_us_numpy(out)
+            shifted = tzdb.local_to_utc_np(us, tz)
+            out = pa.array(shifted, type=pa.int64(), mask=mask).cast(
+                pa.timestamp("us")).cast(at)
+        return out
     if isinstance(to, StringType):
         from spark_rapids_tpu.sqltypes import BooleanType, DateType
 
@@ -539,7 +814,32 @@ def _cast(e: Cast, t: pa.Table):
             return pc.strftime(a, format="%Y-%m-%d")
         if isinstance(frm, BooleanType):
             return pc.if_else(a, "true", "false")
+        if isinstance(frm, TimestampType):
+            # Spark format: fraction present only when nonzero,
+            # trailing zeros trimmed. arrow's %S always appends the
+            # fraction, so format a seconds-precision copy and build
+            # the fraction suffix separately. Seconds are FLOOR of the
+            # epoch micros (numpy //; arrow's us->s cast truncates
+            # toward zero and would misformat pre-epoch fractions).
+            us, mask = _ts_us_numpy(a)
+            secs = _epoch_secs_localized(us, mask, tz)
+            base = pc.strftime(secs, format="%Y-%m-%d %H:%M:%S")
+            frac = us % 1_000_000
+            suffix = pa.array(
+                ["" if f == 0 else (".%06d" % f).rstrip("0")
+                 for f in frac], type=pa.string())
+            return pc.binary_join_element_wise(base, suffix, "")
         return pc.cast(a, pa.string())
+    if isinstance(frm, TimestampType) and isinstance(to, DateType):
+        return pc.cast(_localize(a, tz), pa.date32())
+    if isinstance(frm, DateType) and isinstance(to, TimestampType):
+        naive = pc.cast(a, pa.timestamp("us"))
+        if _tz_utc(tz):
+            return naive.cast(at)
+        loc = pc.assume_timezone(naive, timezone=tz,
+                                 ambiguous="earliest",
+                                 nonexistent="latest")
+        return loc.cast(at)
     if isinstance(frm, (FloatType, DoubleType)) and isinstance(
             to, IntegralType):
         an = pc.cast(a, pa.float64()).to_numpy(zero_copy_only=False)

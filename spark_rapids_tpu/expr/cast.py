@@ -38,6 +38,12 @@ _US_PER_DAY = 86_400_000_000
 
 
 class Cast(Expression):
+    """tz: session timezone stamped at resolution time — governs
+    timestamp<->date/string conversions (GpuCast + GpuTimeZoneDB role)
+    and participates in the jit key."""
+
+    tz: str = "UTC"
+
     def __init__(self, child: Expression, to: DataType):
         super().__init__([child])
         self.to = to
@@ -47,13 +53,9 @@ class Cast(Expression):
         return self.to
 
     def key(self):
-        return ("cast", repr(self.to), self.children[0].key())
+        return ("cast", repr(self.to), self.tz, self.children[0].key())
 
     def device_supported(self) -> bool:
-        frm = self.children[0].dtype
-        if isinstance(self.to, StringType) and isinstance(
-                frm, (TimestampType,)):
-            return False  # timestamp formatting: host fallback in v1
         return True
 
     def can_fail(self) -> bool:
@@ -81,19 +83,29 @@ class Cast(Expression):
         if frm == to:
             return c
         if isinstance(frm, StringType):
-            return _cast_from_string(c, to)
+            return _cast_from_string(c, to, self.tz)
         if isinstance(to, StringType):
-            return _cast_to_string(c)
+            return _cast_to_string(c, self.tz)
         if isinstance(frm, BooleanType):
             data = c.data.astype(to.np_dtype)
             return DeviceColumn(to, data, c.validity)
         if isinstance(to, BooleanType):
             return DeviceColumn(to, c.data != 0, c.validity)
         if isinstance(frm, DateType) and isinstance(to, TimestampType):
-            return DeviceColumn(
-                to, c.data.astype(jnp.int64) * _US_PER_DAY, c.validity)
+            # local midnight in the session zone -> UTC instant
+            local = c.data.astype(jnp.int64) * _US_PER_DAY
+            if not _is_utc(self.tz):
+                from spark_rapids_tpu.ops import tzdb
+
+                local = tzdb.local_to_utc(local, self.tz)
+            return DeviceColumn(to, local, c.validity)
         if isinstance(frm, TimestampType) and isinstance(to, DateType):
-            d = jnp.floor_divide(c.data, _US_PER_DAY).astype(jnp.int32)
+            us = c.data
+            if not _is_utc(self.tz):
+                from spark_rapids_tpu.ops import tzdb
+
+                us = tzdb.utc_to_local(us, self.tz)
+            d = jnp.floor_divide(us, _US_PER_DAY).astype(jnp.int32)
             return DeviceColumn(to, d, c.validity)
         if isinstance(frm, DecimalType) or isinstance(to, DecimalType):
             return _cast_decimal(c, frm, to)
@@ -117,7 +129,14 @@ def _int_width(dt: DataType) -> int:
     return np.dtype(dt.np_dtype).itemsize
 
 
-def _cast_from_string(c: DeviceColumn, to: DataType) -> DeviceColumn:
+def _is_utc(tz: str) -> bool:
+    from spark_rapids_tpu.ops import tzdb
+
+    return tzdb.is_utc(tz)
+
+
+def _cast_from_string(c: DeviceColumn, to: DataType,
+                      tz: str = "UTC") -> DeviceColumn:
     """Device string parsing (ops/stringcast.py; the CastStrings JNI
     kernel role). Invalid input -> null (non-ANSI)."""
     from spark_rapids_tpu.ops import stringcast as SC
@@ -133,7 +152,15 @@ def _cast_from_string(c: DeviceColumn, to: DataType) -> DeviceColumn:
     if isinstance(to, DateType):
         return SC.parse_date(c, to)
     if isinstance(to, TimestampType):
-        return SC.parse_timestamp(c, to)
+        out = SC.parse_timestamp(c, to)
+        if not _is_utc(tz):
+            # the parsed wall-clock is in the session zone
+            from spark_rapids_tpu.ops import tzdb
+
+            out = DeviceColumn(out.dtype,
+                               tzdb.local_to_utc(out.data, tz),
+                               out.validity)
+        return out
     raise TypeError(f"cast string -> {to} not supported on device")
 
 
@@ -175,10 +202,13 @@ def _cast_decimal(c: DeviceColumn, frm: DataType, to: DataType
 _MAX_DIGITS = 20
 
 
-def _cast_to_string(c: DeviceColumn) -> DeviceColumn:
-    """Integral/boolean/date -> UTF-8 padded byte matrix, fully on device."""
+def _cast_to_string(c: DeviceColumn, tz: str = "UTC") -> DeviceColumn:
+    """Integral/boolean/date/timestamp -> UTF-8 padded byte matrix,
+    fully on device."""
     from spark_rapids_tpu.sqltypes.datatypes import string as string_t
 
+    if isinstance(c.dtype, TimestampType):
+        return _timestamp_to_string(c, tz)
     if isinstance(c.dtype, BooleanType):
         mb = 8
         tmat = jnp.zeros((2, mb), jnp.uint8)
@@ -259,6 +289,64 @@ def _date_to_string(c: DeviceColumn) -> DeviceColumn:
     for i, col in enumerate(cols):
         out = out.at[:, i].set(col.astype(jnp.uint8))
     lengths = jnp.full((n,), 10, jnp.int32)
+    return DeviceColumn(string_t, out, c.validity, lengths)
+
+
+def _timestamp_to_string(c: DeviceColumn, tz: str = "UTC") -> DeviceColumn:
+    """epoch-us -> 'YYYY-MM-DD HH:MM:SS[.ffffff]' in the session zone,
+    trailing fraction zeros trimmed (Spark cast-to-string format;
+    GpuCast.scala castTimestampToString)."""
+    from spark_rapids_tpu.expr.datetimes import civil_from_days
+    from spark_rapids_tpu.sqltypes.datatypes import string as string_t
+
+    us = c.data
+    if not _is_utc(tz):
+        from spark_rapids_tpu.ops import tzdb
+
+        us = tzdb.utc_to_local(us, tz)
+    days = jnp.floor_divide(us, 86_400_000_000)
+    in_day = us - days * 86_400_000_000
+    y, m, d = civil_from_days(days)
+    hh = in_day // 3_600_000_000
+    mi = (in_day // 60_000_000) % 60
+    ss = (in_day // 1_000_000) % 60
+    frac = in_day % 1_000_000
+
+    def digit(x, p):
+        return ((x // (10 ** p)) % 10 + ord("0")).astype(jnp.uint8)
+
+    n = c.data.shape[0]
+    mb = 32
+    out = jnp.zeros((n, mb), jnp.uint8)
+    fixed = [
+        digit(y, 3), digit(y, 2), digit(y, 1), digit(y, 0),
+        jnp.full((n,), ord("-"), jnp.uint8),
+        digit(m, 1), digit(m, 0),
+        jnp.full((n,), ord("-"), jnp.uint8),
+        digit(d, 1), digit(d, 0),
+        jnp.full((n,), ord(" "), jnp.uint8),
+        digit(hh, 1), digit(hh, 0),
+        jnp.full((n,), ord(":"), jnp.uint8),
+        digit(mi, 1), digit(mi, 0),
+        jnp.full((n,), ord(":"), jnp.uint8),
+        digit(ss, 1), digit(ss, 0),
+    ]
+    for i, col in enumerate(fixed):
+        out = out.at[:, i].set(col)
+    # fraction: 6 digits with trailing zeros trimmed; none when frac==0
+    trailing = jnp.zeros((n,), jnp.int32)
+    for z in range(1, 7):
+        trailing = jnp.where(frac % (10 ** z) == 0, z, trailing)
+    has_frac = frac > 0
+    ndig = jnp.where(has_frac, 6 - trailing, 0)
+    out = out.at[:, 19].set(jnp.where(has_frac, ord("."), 0
+                                      ).astype(jnp.uint8))
+    for j in range(6):
+        dj = digit(frac, 5 - j)
+        keep = j < ndig
+        out = out.at[:, 20 + j].set(jnp.where(keep, dj, 0
+                                              ).astype(jnp.uint8))
+    lengths = (19 + jnp.where(has_frac, ndig + 1, 0)).astype(jnp.int32)
     return DeviceColumn(string_t, out, c.validity, lengths)
 
 

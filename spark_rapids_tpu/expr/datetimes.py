@@ -1,6 +1,10 @@
-"""Date/time expressions (UTC session timezone, like the reference's
-default device path; non-UTC zones there require GpuTimeZoneDB, here a
-planned extension via a device transition table).
+"""Date/time expressions.
+
+Non-UTC session timezones rebase through the device transition table in
+ops/tzdb.py (the GpuTimeZoneDB role; reference GpuTimeZoneDB usage in
+GpuCast.scala and datetime expression rules in GpuOverrides.scala) —
+tz-sensitive expressions carry a `tz` zone id that the session stamps
+at resolution time and that participates in every jit cache key.
 
 Date math uses Howard Hinnant's civil-from-days algorithm — pure integer
 ops, fully vectorized on the VPU.
@@ -13,11 +17,31 @@ from typing import Tuple
 import jax.numpy as jnp
 
 from spark_rapids_tpu.columnar.batch import DeviceColumn
-from spark_rapids_tpu.expr.core import Expression
-from spark_rapids_tpu.sqltypes import DateType, TimestampType
-from spark_rapids_tpu.sqltypes.datatypes import integer
+from spark_rapids_tpu.expr.core import Expression, Literal
+from spark_rapids_tpu.ops import tzdb
+from spark_rapids_tpu.sqltypes import DateType, StringType, TimestampType
+from spark_rapids_tpu.sqltypes.datatypes import (
+    date as date_t,
+    double,
+    integer,
+    long,
+    timestamp as timestamp_t,
+)
 
 _US_PER_DAY = 86_400_000_000
+_US_PER_SEC = 1_000_000
+
+
+class TzAware:
+    """Mixin: expression whose semantics depend on the session timezone.
+    `tz` is stamped by the session at resolution time and is part of the
+    jit key so each (program, zone) compiles once."""
+
+    tz: str = "UTC"
+
+    def key(self):
+        return (type(self).__name__, self.tz,
+                tuple(c.key() for c in self.children))
 
 
 def civil_from_days(z: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray,
@@ -46,13 +70,20 @@ def days_from_civil(y, m, d):
     return (era * 146097 + doe - 719468).astype(jnp.int32)
 
 
-def _days_of(col: DeviceColumn) -> jnp.ndarray:
+def _local_us(col: DeviceColumn, tz: str) -> jnp.ndarray:
+    """Timestamp column -> local wall-clock epoch-us."""
+    if tzdb.is_utc(tz):
+        return col.data
+    return tzdb.utc_to_local(col.data, tz)
+
+
+def _days_of(col: DeviceColumn, tz: str = "UTC") -> jnp.ndarray:
     if isinstance(col.dtype, TimestampType):
-        return jnp.floor_divide(col.data, _US_PER_DAY)
+        return jnp.floor_divide(_local_us(col, tz), _US_PER_DAY)
     return col.data.astype(jnp.int64)
 
 
-class _DatePart(Expression):
+class _DatePart(TzAware, Expression):
     def __init__(self, child):
         super().__init__([child])
 
@@ -65,7 +96,7 @@ class _DatePart(Expression):
 
     def eval(self, ctx):
         c = self.children[0].eval(ctx)
-        y, m, d = civil_from_days(_days_of(c))
+        y, m, d = civil_from_days(_days_of(c, self.tz))
         return DeviceColumn(integer, self._part(y, m, d), c.validity)
 
 
@@ -84,7 +115,7 @@ class DayOfMonth(_DatePart):
         return d
 
 
-class _TimePart(Expression):
+class _TimePart(TzAware, Expression):
     def __init__(self, child):
         super().__init__([child])
 
@@ -97,8 +128,8 @@ class _TimePart(Expression):
 
     def eval(self, ctx):
         c = self.children[0].eval(ctx)
-        us_in_day = c.data - jnp.floor_divide(c.data, _US_PER_DAY) * \
-            _US_PER_DAY
+        us = _local_us(c, self.tz)
+        us_in_day = us - jnp.floor_divide(us, _US_PER_DAY) * _US_PER_DAY
         val = (us_in_day // self.divisor) % self.modulus
         return DeviceColumn(integer, val.astype(jnp.int32), c.validity)
 
@@ -116,3 +147,564 @@ class Minute(_TimePart):
 class Second(_TimePart):
     divisor = 1_000_000
     modulus = 60
+
+
+# ------------------------------------------------------- calendar parts
+
+
+class DayOfWeek(_DatePart):
+    """Spark dayofweek: 1 = Sunday .. 7 = Saturday."""
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        d = _days_of(c, self.tz)
+        return DeviceColumn(integer,
+                            ((d + 4) % 7 + 1).astype(jnp.int32),
+                            c.validity)
+
+
+class WeekDay(_DatePart):
+    """Spark weekday: 0 = Monday .. 6 = Sunday."""
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        d = _days_of(c, self.tz)
+        return DeviceColumn(integer, ((d + 3) % 7).astype(jnp.int32),
+                            c.validity)
+
+
+class DayOfYear(_DatePart):
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        d = _days_of(c, self.tz)
+        y, _, _ = civil_from_days(d)
+        jan1 = days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+        return DeviceColumn(integer, (d - jan1 + 1).astype(jnp.int32),
+                            c.validity)
+
+
+class WeekOfYear(_DatePart):
+    """ISO-8601 week number (the week containing Thursday)."""
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        d = _days_of(c, self.tz)
+        thu = d - (d + 3) % 7 + 3
+        y, _, _ = civil_from_days(thu)
+        jan1 = days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+        week = (thu - jan1) // 7 + 1
+        return DeviceColumn(integer, week.astype(jnp.int32), c.validity)
+
+
+class Quarter(_DatePart):
+    def _part(self, y, m, d):
+        return (m - 1) // 3 + 1
+
+
+def _month_len(y, m):
+    nxt_m = jnp.where(m == 12, 1, m + 1)
+    nxt_y = jnp.where(m == 12, y + 1, y)
+    one = jnp.ones_like(m)
+    return (days_from_civil(nxt_y, nxt_m, one)
+            - days_from_civil(y, m, one))
+
+
+class LastDay(_DatePart):
+    @property
+    def dtype(self):
+        return date_t
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        y, m, _ = civil_from_days(_days_of(c, self.tz))
+        one = jnp.ones_like(m)
+        nd = days_from_civil(y, m, one) + _month_len(y, m) - 1
+        return DeviceColumn(date_t, nd.astype(jnp.int32), c.validity)
+
+
+# ------------------------------------------------------- date arithmetic
+
+
+class DateAdd(Expression):
+    """date_add(date, n). DateSub flips the sign."""
+
+    _sign = 1
+
+    def __init__(self, date: Expression, n: Expression):
+        super().__init__([date, n])
+
+    @property
+    def dtype(self):
+        return date_t
+
+    def eval(self, ctx):
+        d = self.children[0].eval(ctx)
+        n = self.children[1].eval(ctx)
+        days = d.data.astype(jnp.int32) \
+            + self._sign * n.data.astype(jnp.int32)
+        from spark_rapids_tpu.expr.core import binary_validity
+
+        return DeviceColumn(date_t, days, binary_validity(d, n))
+
+
+class DateSub(DateAdd):
+    _sign = -1
+
+
+class DateDiff(Expression):
+    """datediff(end, start) in days."""
+
+    def __init__(self, end: Expression, start: Expression):
+        super().__init__([end, start])
+
+    @property
+    def dtype(self):
+        return integer
+
+    def eval(self, ctx):
+        e = self.children[0].eval(ctx)
+        s = self.children[1].eval(ctx)
+        from spark_rapids_tpu.expr.core import binary_validity
+
+        return DeviceColumn(
+            integer,
+            (e.data.astype(jnp.int32) - s.data.astype(jnp.int32)),
+            binary_validity(e, s))
+
+
+class AddMonths(Expression):
+    def __init__(self, date: Expression, n: Expression):
+        super().__init__([date, n])
+
+    @property
+    def dtype(self):
+        return date_t
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        n = self.children[1].eval(ctx)
+        y, m, d = civil_from_days(c.data.astype(jnp.int64))
+        m0 = y * 12 + (m - 1) + n.data.astype(jnp.int32)
+        ny = jnp.floor_divide(m0, 12)
+        nm = m0 - ny * 12 + 1
+        nd = jnp.minimum(d, _month_len(ny, nm))
+        from spark_rapids_tpu.expr.core import binary_validity
+
+        return DeviceColumn(date_t, days_from_civil(ny, nm, nd),
+                            binary_validity(c, n))
+
+
+class MonthsBetween(TzAware, Expression):
+    """months_between(end, start[, roundOff]) — Spark's 31-day-month
+    fractional rule: integral when both are the same day-of-month or
+    both are month-ends, else day+time difference / 31."""
+
+    def __init__(self, end: Expression, start: Expression,
+                 round_off: bool = True):
+        super().__init__([end, start])
+        self.round_off = round_off
+
+    @property
+    def dtype(self):
+        return double
+
+    def key(self):
+        return ("months_between", self.tz, self.round_off,
+                tuple(c.key() for c in self.children))
+
+    def _fields(self, col):
+        if isinstance(col.dtype, TimestampType):
+            us = _local_us(col, self.tz)
+        else:
+            us = col.data.astype(jnp.int64) * _US_PER_DAY
+        days = jnp.floor_divide(us, _US_PER_DAY)
+        tod = (us - days * _US_PER_DAY).astype(jnp.float64) / _US_PER_SEC
+        y, m, d = civil_from_days(days)
+        return y, m, d, tod
+
+    def eval(self, ctx):
+        e = self.children[0].eval(ctx)
+        s = self.children[1].eval(ctx)
+        y1, m1, d1, t1 = self._fields(e)
+        y2, m2, d2, t2 = self._fields(s)
+        months = ((y1 - y2) * 12 + (m1 - m2)).astype(jnp.float64)
+        last1 = d1 == _month_len(y1, m1)
+        last2 = d2 == _month_len(y2, m2)
+        integral = (d1 == d2) | (last1 & last2)
+        sec1 = d1.astype(jnp.float64) * 86400.0 + t1
+        sec2 = d2.astype(jnp.float64) * 86400.0 + t2
+        frac = (sec1 - sec2) / (31.0 * 86400.0)
+        out = jnp.where(integral, months, months + frac)
+        if self.round_off:
+            out = jnp.round(out * 1e8) / 1e8
+        from spark_rapids_tpu.expr.core import binary_validity
+
+        return DeviceColumn(double, out, binary_validity(e, s))
+
+
+_DAY_NAMES = {
+    "MO": 1, "MON": 1, "MONDAY": 1, "TU": 2, "TUE": 2, "TUESDAY": 2,
+    "WE": 3, "WED": 3, "WEDNESDAY": 3, "TH": 4, "THU": 4, "THURSDAY": 4,
+    "FR": 5, "FRI": 5, "FRIDAY": 5, "SA": 6, "SAT": 6, "SATURDAY": 6,
+    "SU": 7, "SUN": 7, "SUNDAY": 7,
+}
+
+
+class NextDay(Expression):
+    """next_day(date, 'Mon'): first date strictly after `date` that
+    falls on the given weekday; invalid day name -> null."""
+
+    def __init__(self, date: Expression, day_name: str):
+        super().__init__([date])
+        self.target = _DAY_NAMES.get(str(day_name).strip().upper())
+
+    @property
+    def dtype(self):
+        return date_t
+
+    @property
+    def nullable(self):
+        return True
+
+    def key(self):
+        return ("next_day", self.target, self.children[0].key())
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        if self.target is None:
+            return DeviceColumn(date_t, jnp.zeros_like(c.data),
+                                jnp.zeros(c.data.shape, bool))
+        d = c.data.astype(jnp.int32)
+        # ISO dow: Monday=1..Sunday=7; 1970-01-01 is Thursday(4)
+        dow = (d + 3) % 7 + 1
+        delta = (self.target - dow + 7) % 7
+        delta = jnp.where(delta == 0, 7, delta)
+        return DeviceColumn(date_t, d + delta, c.validity)
+
+
+# ------------------------------------------------------------ truncation
+
+_TRUNC_DATE_FMTS = {
+    "YEAR": "year", "YYYY": "year", "YY": "year",
+    "QUARTER": "quarter", "MONTH": "month", "MON": "month",
+    "MM": "month", "WEEK": "week",
+}
+_TRUNC_TS_FMTS = dict(_TRUNC_DATE_FMTS, **{
+    "DAY": "day", "DD": "day", "HOUR": "hour", "MINUTE": "minute",
+    "SECOND": "second",
+})
+
+
+def _trunc_days(days, unit):
+    y, m, d = civil_from_days(days)
+    one = jnp.ones_like(m)
+    if unit == "year":
+        return days_from_civil(y, one, one)
+    if unit == "quarter":
+        qm = ((m - 1) // 3) * 3 + 1
+        return days_from_civil(y, qm, one)
+    if unit == "month":
+        return days_from_civil(y, m, one)
+    if unit == "week":  # Monday start
+        return (days - (days + 3) % 7).astype(jnp.int32)
+    raise ValueError(unit)
+
+
+class TruncDate(Expression):
+    """trunc(date, fmt) -> date; unknown fmt -> null (Spark)."""
+
+    def __init__(self, date: Expression, fmt: str):
+        super().__init__([date])
+        self.unit = _TRUNC_DATE_FMTS.get(str(fmt).strip().upper())
+
+    @property
+    def dtype(self):
+        return date_t
+
+    @property
+    def nullable(self):
+        return True
+
+    def key(self):
+        return ("trunc_date", self.unit, self.children[0].key())
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        if self.unit is None:
+            return DeviceColumn(date_t, jnp.zeros_like(c.data),
+                                jnp.zeros(c.data.shape, bool))
+        days = _trunc_days(c.data.astype(jnp.int64), self.unit)
+        return DeviceColumn(date_t, days.astype(jnp.int32), c.validity)
+
+
+class DateTrunc(TzAware, Expression):
+    """date_trunc(fmt, timestamp) -> timestamp, truncated in the
+    session zone's wall-clock then rebased to UTC."""
+
+    def __init__(self, fmt: str, ts: Expression):
+        super().__init__([ts])
+        self.unit = _TRUNC_TS_FMTS.get(str(fmt).strip().upper())
+
+    @property
+    def dtype(self):
+        return timestamp_t
+
+    @property
+    def nullable(self):
+        return True
+
+    def key(self):
+        return ("date_trunc", self.unit, self.tz,
+                self.children[0].key())
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        if self.unit is None:
+            return DeviceColumn(timestamp_t, jnp.zeros_like(c.data),
+                                jnp.zeros(c.data.shape, bool))
+        us = _local_us(c, self.tz)
+        if self.unit == "second":
+            out = jnp.floor_divide(us, _US_PER_SEC) * _US_PER_SEC
+        elif self.unit == "minute":
+            out = jnp.floor_divide(us, 60 * _US_PER_SEC) \
+                * (60 * _US_PER_SEC)
+        elif self.unit == "hour":
+            out = jnp.floor_divide(us, 3600 * _US_PER_SEC) \
+                * (3600 * _US_PER_SEC)
+        elif self.unit == "day":
+            out = jnp.floor_divide(us, _US_PER_DAY) * _US_PER_DAY
+        else:
+            days = _trunc_days(jnp.floor_divide(us, _US_PER_DAY),
+                               self.unit)
+            out = days.astype(jnp.int64) * _US_PER_DAY
+        if not tzdb.is_utc(self.tz):
+            out = tzdb.local_to_utc(out, self.tz)
+        return DeviceColumn(timestamp_t, out, c.validity)
+
+
+# ------------------------------------------------------ epoch conversion
+
+
+class UnixTimestamp(Expression):
+    """unix_timestamp(ts) -> seconds since epoch (long)."""
+
+    def __init__(self, ts: Expression):
+        super().__init__([ts])
+
+    @property
+    def dtype(self):
+        return long
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        return DeviceColumn(
+            long, jnp.floor_divide(c.data, _US_PER_SEC), c.validity)
+
+
+class SecondsToTimestamp(Expression):
+    """timestamp_seconds(col) — numeric seconds -> timestamp."""
+
+    def __init__(self, secs: Expression):
+        super().__init__([secs])
+
+    @property
+    def dtype(self):
+        return timestamp_t
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        if jnp.issubdtype(c.data.dtype, jnp.floating):
+            us = jnp.round(c.data * _US_PER_SEC).astype(jnp.int64)
+        else:
+            us = c.data.astype(jnp.int64) * _US_PER_SEC
+        return DeviceColumn(timestamp_t, us, c.validity)
+
+
+class MakeDate(Expression):
+    def __init__(self, y: Expression, m: Expression, d: Expression):
+        super().__init__([y, m, d])
+
+    @property
+    def dtype(self):
+        return date_t
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval(self, ctx):
+        y = self.children[0].eval(ctx)
+        m = self.children[1].eval(ctx)
+        d = self.children[2].eval(ctx)
+        yy = y.data.astype(jnp.int32)
+        mm = m.data.astype(jnp.int32)
+        dd = d.data.astype(jnp.int32)
+        ok = ((mm >= 1) & (mm <= 12) & (dd >= 1)
+              & (dd <= _month_len(yy, jnp.clip(mm, 1, 12))))
+        days = days_from_civil(yy, jnp.clip(mm, 1, 12),
+                               jnp.clip(dd, 1, 31))
+        return DeviceColumn(
+            date_t, days,
+            y.validity & m.validity & d.validity & ok)
+
+
+class FromUtcTimestamp(Expression):
+    """from_utc_timestamp(ts, zone): reinterpret a UTC instant as the
+    given zone's wall clock (explicit zone, not the session zone)."""
+
+    _to_utc = False
+
+    def __init__(self, ts: Expression, zone: str):
+        super().__init__([ts])
+        self.zone = str(zone)
+
+    @property
+    def dtype(self):
+        return timestamp_t
+
+    def key(self):
+        return (type(self).__name__, self.zone,
+                self.children[0].key())
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        if self._to_utc:
+            out = tzdb.local_to_utc(c.data, self.zone)
+        else:
+            out = tzdb.utc_to_local(c.data, self.zone)
+        return DeviceColumn(timestamp_t, out, c.validity)
+
+
+class ToUtcTimestamp(FromUtcTimestamp):
+    _to_utc = True
+
+
+# ------------------------------------------------------------ formatting
+
+_FMT_TOKENS = ("yyyy", "MM", "dd", "HH", "mm", "ss", "SSS")
+
+
+def _tokenize_format(fmt: str):
+    """Java SimpleDateFormat subset -> [(kind, text)] or None if the
+    pattern uses tokens outside the supported set."""
+    out = []
+    i = 0
+    while i < len(fmt):
+        for tok in _FMT_TOKENS:
+            if fmt.startswith(tok, i):
+                out.append(("tok", tok))
+                i += len(tok)
+                break
+        else:
+            ch = fmt[i]
+            if ch.isalpha():
+                return None  # unsupported pattern letter
+            out.append(("lit", ch))
+            i += 1
+    return out
+
+
+class DateFormat(TzAware, Expression):
+    """date_format(ts, fmt) for the fixed-width token subset
+    yyyy/MM/dd/HH/mm/ss/SSS (+ literal separators); other patterns are
+    tagged for CPU by the planner check below."""
+
+    def __init__(self, ts: Expression, fmt: str):
+        super().__init__([ts])
+        self.fmt = str(fmt)
+        self.tokens = _tokenize_format(self.fmt)
+
+    @property
+    def dtype(self):
+        from spark_rapids_tpu.sqltypes.datatypes import string as string_t
+
+        return string_t
+
+    def key(self):
+        return ("date_format", self.fmt, self.tz,
+                self.children[0].key())
+
+    def device_supported(self):
+        if self.tokens is None:
+            return (f"date_format pattern {self.fmt!r} outside the "
+                    "device token subset (yyyy MM dd HH mm ss SSS)")
+        return None
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.sqltypes.datatypes import string as string_t
+
+        c = self.children[0].eval(ctx)
+        if isinstance(c.dtype, TimestampType):
+            us = _local_us(c, self.tz)
+        else:
+            us = c.data.astype(jnp.int64) * _US_PER_DAY
+        days = jnp.floor_divide(us, _US_PER_DAY)
+        in_day = us - days * _US_PER_DAY
+        y, m, d = civil_from_days(days)
+        vals = {
+            "yyyy": (y.astype(jnp.int64), 4),
+            "MM": (m.astype(jnp.int64), 2),
+            "dd": (d.astype(jnp.int64), 2),
+            "HH": (in_day // 3_600_000_000, 2),
+            "mm": ((in_day // 60_000_000) % 60, 2),
+            "ss": ((in_day // _US_PER_SEC) % 60, 2),
+            "SSS": ((in_day // 1000) % 1000, 3),
+        }
+        width = sum(vals[t][1] if k == "tok" else 1
+                    for k, t in self.tokens)
+        mb = max(8, 1 << (width - 1).bit_length())
+        n = c.data.shape[0]
+        mat = jnp.zeros((n, mb), jnp.uint8)
+        pos = 0
+        for kind, t in self.tokens:
+            if kind == "lit":
+                mat = mat.at[:, pos].set(jnp.uint8(ord(t)))
+                pos += 1
+            else:
+                v, w = vals[t]
+                for j in range(w):
+                    digit = (v // (10 ** (w - 1 - j))) % 10
+                    mat = mat.at[:, pos].set(
+                        (digit + ord("0")).astype(jnp.uint8))
+                    pos += 1
+        lengths = jnp.full((n,), jnp.int32(width))
+        return DeviceColumn(string_t, mat, c.validity, lengths)
+
+
+class FromUnixtime(DateFormat):
+    """from_unixtime(secs[, fmt]) -> formatted string in the session
+    zone (default 'yyyy-MM-dd HH:mm:ss')."""
+
+    def __init__(self, secs: Expression,
+                 fmt: str = "yyyy-MM-dd HH:mm:ss"):
+        super().__init__(SecondsToTimestamp(secs), fmt)
+
+    def key(self):
+        return ("from_unixtime", self.fmt, self.tz,
+                self.children[0].key())
+
+
+class CurrentDate(TzAware, Expression):
+    """Marker; physical planning pins it to ONE literal date per query
+    (api/dataframe._pin_query_time, like Spark's QueryExecution)."""
+
+    @property
+    def dtype(self):
+        return date_t
+
+    @property
+    def nullable(self):
+        return False
+
+
+class CurrentTimestamp(Expression):
+    """Marker; pinned to one literal timestamp per query at physical
+    planning time."""
+
+    @property
+    def dtype(self):
+        return timestamp_t
+
+    @property
+    def nullable(self):
+        return False

@@ -1,0 +1,177 @@
+"""Device timezone database — the GpuTimeZoneDB analog.
+
+The reference loads JVM zone rules into a device-resident transition
+table and rebases timestamps with a binary search per row
+(spark-rapids-jni GpuTimeZoneDB, used by GpuCast/datetime expressions
+for non-UTC session zones; see SURVEY.md §2.12). Here the table is
+parsed straight from the system TZif files (/usr/share/zoneinfo) and
+baked into the XLA program as two small constant arrays per zone:
+
+- UTC->local: transitions[i] = UTC instant (us) where the offset
+  changes, offsets[i] = offset (us) in effect from that instant.
+- local->UTC: wall[i] = local wall-clock instant of the same
+  transition (computed with the PRE-transition offset so ambiguous
+  times resolve to the earlier offset, matching
+  java.time.ZoneRules.getOffset's documented choice).
+
+searchsorted over ~a few hundred entries vectorizes on the VPU; tables
+are cached per zone id and the zone id is part of every expression jit
+key, so each (program, zone) pair compiles once.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import Dict, Tuple
+
+import numpy as np
+
+_US = 1_000_000
+_ZONEINFO_DIRS = ("/usr/share/zoneinfo", "/usr/lib/zoneinfo")
+
+_lock = threading.Lock()
+_cache: Dict[str, Tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
+
+
+class TimeZoneError(ValueError):
+    pass
+
+
+def _parse_tzif(data: bytes):
+    """TZif v2/v3 parser -> (transition_secs[int64], offset_secs[int64]).
+
+    offset_secs has len(transitions)+1 entries: offset_secs[0] applies
+    before the first transition."""
+    if data[:4] != b"TZif":
+        raise TimeZoneError("not a TZif file")
+
+    def read_block(off, long_times):
+        (isutcnt, isstdcnt, leapcnt, timecnt, typecnt,
+         charcnt) = struct.unpack(">6I", data[off + 20:off + 44])
+        p = off + 44
+        tfmt = ">%dq" % timecnt if long_times else ">%dl" % timecnt
+        tsize = 8 if long_times else 4
+        trans = np.array(struct.unpack(tfmt, data[p:p + timecnt * tsize]),
+                         dtype=np.int64)
+        p += timecnt * tsize
+        idx = np.frombuffer(data[p:p + timecnt], dtype=np.uint8)
+        p += timecnt
+        ttinfo = []
+        for i in range(typecnt):
+            gmtoff, isdst, abbrind = struct.unpack(
+                ">lBB", data[p + i * 6:p + i * 6 + 6])
+            ttinfo.append(gmtoff)
+        p += typecnt * 6 + charcnt + leapcnt * (tsize + 4) \
+            + isstdcnt + isutcnt
+        offs = np.array(ttinfo, dtype=np.int64)
+        # offset before the first transition: first non-dst type, else 0
+        first = offs[0] if typecnt else 0
+        offsets = np.concatenate([[first],
+                                  offs[idx] if timecnt else offs[:0]])
+        return trans, offsets, p
+
+    version = data[4:5]
+    trans, offsets, end = read_block(0, long_times=False)
+    if version in (b"2", b"3"):
+        # v2+: a second block with 64-bit transition times follows
+        trans, offsets, _ = read_block(end, long_times=True)
+    return trans, offsets
+
+
+def _load_zone(zone: str):
+    if zone in ("UTC", "GMT", "Z", "Etc/UTC", "Etc/GMT"):
+        return (np.zeros(0, np.int64), np.zeros(1, np.int64),
+                np.zeros(0, np.int64))
+    path = None
+    for base in _ZONEINFO_DIRS:
+        cand = os.path.join(base, zone)
+        if os.path.isfile(cand):
+            path = cand
+            break
+    if path is None:
+        raise TimeZoneError(f"unknown timezone {zone!r}")
+    with open(path, "rb") as f:
+        trans_s, offs_s = _parse_tzif(f.read())
+    trans = trans_s * _US
+    offsets = offs_s * _US
+    # wall-clock instants of each transition under the PRE-transition
+    # offset (earlier-offset rule for ambiguous local times)
+    wall = trans + offsets[:-1]
+    return trans, offsets, wall
+
+
+def tables(zone: str):
+    """(transitions_us, offsets_us[len+1], wall_us) numpy arrays."""
+    with _lock:
+        t = _cache.get(zone)
+        if t is None:
+            t = _load_zone(zone)
+            _cache[zone] = t
+        return t
+
+
+def is_utc(zone: str) -> bool:
+    """Single UTC-alias predicate (shared by cast/datetime/cpu_eval so
+    the alias list cannot drift)."""
+    return zone in ("UTC", "GMT", "Z", "Etc/UTC", "Etc/GMT", "GMT0")
+
+
+def is_fixed_offset(zone: str) -> bool:
+    trans, offsets, _ = tables(zone)
+    return trans.size == 0 or bool((offsets == offsets[0]).all())
+
+
+def utc_to_local(ts_us, zone: str):
+    """UTC epoch-us -> local wall-clock epoch-us (device)."""
+    import jax.numpy as jnp
+
+    trans, offsets, _ = tables(zone)
+    if trans.size == 0:
+        return ts_us + int(offsets[0])
+    i = jnp.searchsorted(jnp.asarray(trans), ts_us, side="right")
+    return ts_us + jnp.take(jnp.asarray(offsets), i)
+
+
+def local_to_utc(local_us, zone: str):
+    """Local wall-clock epoch-us -> UTC epoch-us (device); ambiguous
+    local times resolve to the earlier offset, and nonexistent (gap)
+    local times keep the PRE-gap offset — i.e. they are pushed later by
+    the gap width, the java.time.ZoneRules behavior Spark inherits."""
+    import jax.numpy as jnp
+
+    trans, offsets, wall = tables(zone)
+    if trans.size == 0:
+        return local_us - int(offsets[0])
+    tr = jnp.asarray(trans)
+    offs = jnp.asarray(offsets)
+    i = jnp.searchsorted(jnp.asarray(wall), local_us, side="right")
+    cand = local_us - jnp.take(offs, i)
+    # gap detection: the chosen regime starts at trans[i-1]; if the
+    # candidate instant lands BEFORE that start, the local time never
+    # existed — fall back to the previous (pre-gap) offset
+    prev_start = jnp.take(tr, jnp.maximum(i - 1, 0))
+    in_gap = (i > 0) & (cand < prev_start)
+    prev_off = jnp.take(offs, jnp.maximum(i - 1, 0))
+    return jnp.where(in_gap, local_us - prev_off, cand)
+
+
+def utc_to_local_np(ts_us: np.ndarray, zone: str) -> np.ndarray:
+    trans, offsets, _ = tables(zone)
+    if trans.size == 0:
+        return ts_us + int(offsets[0])
+    i = np.searchsorted(trans, ts_us, side="right")
+    return ts_us + offsets[i]
+
+
+def local_to_utc_np(local_us: np.ndarray, zone: str) -> np.ndarray:
+    trans, offsets, wall = tables(zone)
+    if trans.size == 0:
+        return local_us - int(offsets[0])
+    i = np.searchsorted(wall, local_us, side="right")
+    cand = local_us - offsets[i]
+    prev_start = trans[np.maximum(i - 1, 0)]
+    in_gap = (i > 0) & (cand < prev_start)
+    prev_off = offsets[np.maximum(i - 1, 0)]
+    return np.where(in_gap, local_us - prev_off, cand)

@@ -263,6 +263,34 @@ class Generate(LogicalPlan):
         return f"Generate [{self.gen_alias.name}]"
 
 
+def transform_expressions(plan: LogicalPlan, fn) -> LogicalPlan:
+    """Rebuild a logical tree with `fn` applied to every expression
+    (introspects node fields generically: Expression, SortOrder, and
+    (nested) lists thereof)."""
+    import copy
+
+    def map_val(v):
+        from spark_rapids_tpu.expr.core import Expression
+
+        if isinstance(v, Expression):
+            return fn(v)
+        if isinstance(v, SortOrder):
+            return SortOrder(fn(v.expr), v.ascending, v.nulls_first)
+        if isinstance(v, list):
+            return [map_val(x) for x in v]
+        if isinstance(v, tuple):
+            return tuple(map_val(x) for x in v)
+        return v
+
+    node = copy.copy(plan)
+    node.children = [transform_expressions(c, fn) for c in plan.children]
+    for k, v in list(vars(node).items()):
+        if k == "children":
+            continue
+        node.__dict__[k] = map_val(v)
+    return node
+
+
 class Expand(LogicalPlan):
     """Each input row emits one output row per projection list — the
     lowering for rollup/cube/grouping sets and distinct-aggregate

@@ -132,3 +132,11 @@ from spark_rapids_tpu.udf.pandas_udf import PandasUDF  # noqa: E402
 def _pandas_udf_check(e: "PandasUDF") -> Optional[str]:
     return ("pandas UDF runs via the Arrow worker-process exchange "
             "(GpuArrowEvalPythonExec role, host-side)")
+
+
+from spark_rapids_tpu.expr.datetimes import DateFormat  # noqa: E402
+
+
+@register_check(DateFormat)
+def _date_format_check(e: "DateFormat") -> Optional[str]:
+    return e.device_supported()

@@ -1,0 +1,241 @@
+"""Datetime expression family + timezone DB (reference: datetime
+expression rules in GpuOverrides.scala, GpuTimeZoneDB JNI, GpuCast
+timestamp conversions). Differential tests against the CPU oracle in
+UTC and non-UTC session zones, plus DST-boundary spot checks against
+zoneinfo."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.testing.asserts import (
+    assert_tpu_and_cpu_are_equal_collect,
+    with_tpu_session,
+)
+
+LA = "America/Los_Angeles"
+KOLKATA = "Asia/Kolkata"
+
+
+@pytest.fixture(scope="module")
+def dt_path(tmp_path_factory):
+    d = tmp_path_factory.mktemp("dtdata")
+    rng = np.random.default_rng(23)
+    n = 4000
+    secs = rng.integers(0, 1_800_000_000, n)
+    # concentrate some instants near US DST transitions
+    for base in (1710053100, 1730627100, 952041600):
+        secs[:200] = base + rng.integers(-86400, 86400, 200)
+        rng.shuffle(secs)
+    t = pa.table({
+        "ts": pa.array(secs * 1_000_000,
+                       type=pa.timestamp("us", tz="UTC")),
+        "d": pa.array((secs // 86400).astype("int32"),
+                      type=pa.date32()),
+        "n": pa.array(rng.integers(-40, 40, n).astype("int32")),
+        "s": pa.array([f"20{i % 23 + 10}-0{i % 9 + 1}-1{i % 9} "
+                       f"0{i % 9}:1{i % 5}:2{i % 7}"
+                       for i in range(n)]),
+    })
+    p = str(d / "dt.parquet")
+    pq.write_table(t, p)
+    return p
+
+
+def _diff(path, cols, conf=None):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.read.parquet(path).select(*cols), conf=conf)
+
+
+def test_calendar_parts(dt_path):
+    _diff(dt_path, [
+        F.dayofweek("d").alias("dw"), F.weekday("d").alias("wd"),
+        F.dayofyear("d").alias("dy"), F.weekofyear("d").alias("wy"),
+        F.quarter("d").alias("q"), F.last_day("d").alias("ld")])
+
+
+def test_date_arithmetic(dt_path):
+    _diff(dt_path, [
+        F.date_add("d", 31).alias("da"),
+        F.date_sub("d", F.col("n")).alias("ds"),
+        F.datediff(F.date_add("d", 5), "d").alias("dd"),
+        F.add_months("d", F.col("n")).alias("am"),
+        F.months_between(F.col("ts"), F.col("d")).alias("mb"),
+        F.next_day("d", "Friday").alias("nd")])
+
+
+def test_truncation(dt_path):
+    _diff(dt_path, [
+        F.trunc("d", "year").alias("ty"),
+        F.trunc("d", "month").alias("tm"),
+        F.trunc("d", "week").alias("tw"),
+        F.date_trunc("hour", "ts").alias("th"),
+        F.date_trunc("day", "ts").alias("td"),
+        F.date_trunc("quarter", "ts").alias("tq")])
+
+
+def test_epoch_and_format(dt_path):
+    _diff(dt_path, [
+        F.unix_timestamp("ts").alias("ut"),
+        F.from_unixtime(F.unix_timestamp("ts")).alias("fu"),
+        F.timestamp_seconds(F.unix_timestamp("ts")).alias("tsec"),
+        F.date_format("ts", "yyyy-MM-dd HH:mm").alias("dfmt"),
+        F.col("ts").cast("string").alias("tss")])
+
+
+@pytest.mark.parametrize("zone", [LA, KOLKATA])
+def test_parts_in_session_zone(dt_path, zone):
+    _diff(dt_path, [
+        F.hour("ts").alias("h"), F.minute("ts").alias("mi"),
+        F.year("ts").alias("y"), F.dayofmonth("ts").alias("dom"),
+        F.col("ts").cast("date").alias("tsd"),
+        F.col("d").cast("timestamp").alias("dts"),
+        F.date_trunc("day", "ts").alias("td"),
+        F.col("ts").cast("string").alias("tss")],
+        conf={"spark.sql.session.timeZone": zone})
+
+
+@pytest.mark.parametrize("zone", [LA, KOLKATA])
+def test_string_parse_in_session_zone(dt_path, zone):
+    _diff(dt_path, [
+        F.col("s").cast("timestamp").alias("parsed"),
+        F.unix_timestamp(F.col("s")).alias("ut")],
+        conf={"spark.sql.session.timeZone": zone})
+
+
+def test_from_to_utc_timestamp(dt_path):
+    _diff(dt_path, [
+        F.from_utc_timestamp("ts", LA).alias("f"),
+        F.to_utc_timestamp("ts", KOLKATA).alias("t")])
+
+
+def test_tz_against_zoneinfo(dt_path):
+    """Device hour() in LA must agree with python zoneinfo across DST
+    boundaries (independent oracle, not the CPU engine)."""
+    from datetime import datetime, timezone
+    from zoneinfo import ZoneInfo
+
+    def q(spark):
+        return (spark.read.parquet(dt_path)
+                .select("ts", F.hour("ts").alias("h"))
+                .collect_arrow())
+
+    out = with_tpu_session(
+        q, conf={"spark.sql.session.timeZone": LA})
+    zi = ZoneInfo(LA)
+    ts = out.column("ts").to_pylist()
+    hs = out.column("h").to_pylist()
+    for i in range(0, len(ts), 37):
+        want = ts[i].astimezone(zi).hour
+        assert hs[i] == want, (ts[i], hs[i], want)
+
+
+def test_date_format_fallback_pattern(dt_path):
+    """Patterns outside the device token subset run on CPU (planner
+    tag), still correct."""
+    def q(spark):
+        return (spark.read.parquet(dt_path)
+                .select(F.date_format("ts", "yyyy/MM/dd").alias("a"))
+                .collect_arrow())
+
+    out = with_tpu_session(q)
+    assert out.column("a")[0].as_py().count("/") == 2
+
+
+def test_current_date_timestamp(dt_path):
+    import datetime as dtm
+
+    def q(spark):
+        return (spark.read.parquet(dt_path).limit(3)
+                .select(F.current_date().alias("cd"),
+                        F.current_timestamp().alias("ct"))
+                .collect_arrow())
+
+    out = with_tpu_session(q)
+    today = dtm.datetime.now(dtm.timezone.utc).date()
+    cd = out.column("cd")[0].as_py()
+    assert abs((cd - today).days) <= 1
+    ct = out.column("ct")[0].as_py()
+    assert abs((ct - dtm.datetime.now(dtm.timezone.utc))
+               .total_seconds()) < 3600
+
+
+def test_dst_gap_and_overlap_rules():
+    """Nonexistent local times (spring-forward gap) keep the pre-gap
+    offset (pushed later by the gap width), ambiguous times take the
+    earlier offset — java.time.ZoneRules/Spark behavior."""
+    import datetime as dtm
+
+    from spark_rapids_tpu.ops import tzdb
+
+    la = "America/Los_Angeles"
+
+    def us(*args):
+        return int((dtm.datetime(*args)
+                    - dtm.datetime(1970, 1, 1)).total_seconds() * 1e6)
+
+    gap = np.array([us(2021, 3, 14, 2, 30)], np.int64)
+    out = tzdb.local_to_utc_np(gap, la)
+    assert out[0] == us(2021, 3, 14, 10, 30)  # = 03:30 PDT
+    amb = np.array([us(2021, 11, 7, 1, 30)], np.int64)
+    out = tzdb.local_to_utc_np(amb, la)
+    assert out[0] == us(2021, 11, 7, 8, 30)  # earlier (PDT) offset
+
+    # device path agrees with the numpy path
+    import jax.numpy as jnp
+
+    dev = np.asarray(tzdb.local_to_utc(jnp.asarray(
+        np.concatenate([gap, amb])), la))
+    assert dev[0] == us(2021, 3, 14, 10, 30)
+    assert dev[1] == us(2021, 11, 7, 8, 30)
+
+
+def test_pre_epoch_timestamp_to_string():
+    """Pre-1970 fractional timestamps format with floored seconds."""
+    import datetime as dtm
+
+    def q(spark):
+        df = spark.createDataFrame(pa.table({
+            "t": pa.array([-500000, -1, 500000],
+                          type=pa.timestamp("us", tz="UTC"))}))
+        return df.select(F.col("t").cast("string").alias("s")) \
+            .collect_arrow()
+
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.createDataFrame(pa.table({
+            "t": pa.array([-500000, -1, 500000],
+                          type=pa.timestamp("us", tz="UTC"))}))
+        .select(F.col("t").cast("string").alias("s")))
+    out = with_tpu_session(q)
+    assert out.column("s").to_pylist() == [
+        "1969-12-31 23:59:59.5", "1969-12-31 23:59:59.999999",
+        "1970-01-01 00:00:00.5"]
+
+
+def test_current_timestamp_pinned_per_query():
+    def q(spark):
+        return (spark.range(3)
+                .select(F.current_timestamp().alias("a"),
+                        F.current_timestamp().alias("b"))
+                .collect_arrow())
+
+    out = with_tpu_session(q)
+    assert out.column("a").to_pylist() == out.column("b").to_pylist()
+
+
+def test_make_date_invalid_is_null():
+    def q(spark):
+        df = spark.createDataFrame(pa.table({
+            "y": pa.array([2024, 2023, 2024]),
+            "m": pa.array([2, 2, 13]),
+            "dd": pa.array([29, 29, 1])}))
+        return (df.select(F.make_date("y", "m", "dd").alias("md"))
+                .collect_arrow())
+
+    out = with_tpu_session(q)
+    vals = out.column("md").to_pylist()
+    assert vals[0] is not None       # 2024-02-29 valid (leap)
+    assert vals[1] is None           # 2023-02-29 invalid
+    assert vals[2] is None           # month 13

@@ -189,18 +189,31 @@ def test_string_cast_on_device(sales_path):
         conf=_CONF)
 
 
-def test_fallback_timestamp_to_string_cast(sales_path):
-    """Cast(timestamp -> string) stays CPU-only: assert fallback
-    placement and result parity (assert_gpu_fallback_collect analog)."""
+def test_timestamp_to_string_cast_on_device(sales_path):
+    """Cast(timestamp -> string) runs on device since the
+    _timestamp_to_string kernel landed; diff it against the oracle."""
     import datetime
 
     from spark_rapids_tpu.sqltypes.datatypes import string as string_t
+
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame({"t": [
+            datetime.datetime(2020, 1, 1, 12, 0, 0),
+            datetime.datetime(2021, 6, 15, 23, 59, 59, 120000)]})
+        .select(F.col("t").cast(string_t).alias("s")),
+        conf=_CONF)
+
+
+def test_fallback_date_format_pattern(sales_path):
+    """date_format with a pattern outside the device token subset is
+    tagged NOT_ON_TPU (assert_gpu_fallback_collect analog)."""
+    import datetime
 
     assert_tpu_fallback_collect(
         lambda s: s.createDataFrame({"t": [
             datetime.datetime(2020, 1, 1, 12, 0, 0),
             datetime.datetime(2021, 6, 15, 23, 59, 59)]})
-        .select(F.col("t").cast(string_t).alias("s")),
+        .select(F.date_format("t", "EEE yyyy").alias("s")),
         fallback_class="CpuProjectExec",
         conf=_CONF)
 

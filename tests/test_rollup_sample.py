@@ -95,12 +95,16 @@ def test_sample_diff(cube_path):
 
 
 def test_sample_with_replacement_cpu_fallback(cube_path):
-    from spark_rapids_tpu.testing.asserts import assert_tpu_fallback_collect
+    """With-replacement sampling must be PLANNED on CPU (fallback
+    placement assertion), and produce ~fraction x rows."""
+    from spark_rapids_tpu.testing.asserts import (
+        assert_tpu_fallback_collect,
+    )
 
     def q(spark):
         return spark.read.parquet(cube_path).sample(True, 1.5, 3)
 
-    out = with_tpu_session(lambda spark: q(spark).collect_arrow())
+    out = assert_tpu_fallback_collect(q, "CpuSampleExec")
     n = pq.read_table(cube_path).num_rows
     # poisson(1.5) mean: expect ~1.5x rows
     assert n < out.num_rows < 2.2 * n
