@@ -140,9 +140,11 @@ def _matrix_to_list(data: np.ndarray, lengths: np.ndarray,
         import decimal as _dec
 
         s = elem_dtype.scale
-        child = pa.array(
-            [_dec.Decimal(int(v)).scaleb(-s) if ok else None
-             for v, ok in zip(flat, flat_valid)], type=at)
+        with _dec.localcontext() as _ctx:
+            _ctx.prec = 50  # scaleb rounds at context precision
+            child = pa.array(
+                [_dec.Decimal(int(v)).scaleb(-s) if ok else None
+                 for v, ok in zip(flat, flat_valid)], type=at)
     else:
         child = pa.array(flat, type=at,
                          mask=None if flat_valid.all() else ~flat_valid)
@@ -156,16 +158,24 @@ def _primitive_np(arr: pa.Array, dtype: DataType):
     validity = np.asarray(arr.is_valid())
     at = arr.type
     if pa.types.is_decimal(at):
-        # Scaled int64 from the decimal128 buffer directly (vectorized):
-        # 16-byte little-endian two's complement; for precision<=18 the
-        # value fits int64, so the low word IS the value.
+        # 16-byte little-endian two's complement words from the
+        # decimal128 buffer directly (vectorized). precision<=18: the
+        # low word IS the value (DECIMAL64); wider: [n, 2] (hi, lo)
+        # limb matrix (the device DECIMAL128 layout, ops/decimal128.py).
         arr128 = arr.cast(pa.decimal128(38, at.scale))
         buf = arr128.buffers()[1]
         words = np.frombuffer(buf, dtype=np.int64,
                               count=(arr128.offset + len(arr128)) * 2)
-        ints = words[arr128.offset * 2::2][:len(arr128)].copy()
-        ints[~validity] = 0
-        return ints, validity
+        words = words[arr128.offset * 2:(arr128.offset + len(arr128)) * 2]
+        lo = words[0::2].copy()
+        if isinstance(dtype, DecimalType) and \
+                dtype.precision > DecimalType.MAX_LONG_DIGITS:
+            hi = words[1::2].copy()
+            lo[~validity] = 0
+            hi[~validity] = 0
+            return np.stack([hi, lo], axis=1), validity
+        lo[~validity] = 0
+        return lo, validity
     if pa.types.is_timestamp(at):
         arr = arr.cast(pa.timestamp("us", tz=getattr(at, "tz", None) or "UTC"))
         vals = np.asarray(arr.cast(pa.int64()).fill_null(0))
@@ -240,10 +250,26 @@ def device_to_arrow(batch: ColumnBatch) -> pa.Table:
         if isinstance(field.dataType, DecimalType):
             import decimal as _dec
             s = field.dataType.scale
-            py = [
-                _dec.Decimal(int(v)).scaleb(-s) if ok else None
-                for v, ok in zip(vals, validity)
-            ]
+            # scaleb rounds at context precision (default 28 digits —
+            # it would corrupt 29+ digit DECIMAL128 values)
+            with _dec.localcontext() as _ctx:
+                _ctx.prec = 50
+                if vals.ndim == 2:  # DECIMAL128 limb matrix (hi, lo)
+                    py = []
+                    for (h, lo_), ok in zip(vals, validity):
+                        if not ok:
+                            py.append(None)
+                            continue
+                        v = (int(h) << 64) | (int(lo_) & ((1 << 64) - 1))
+                        v &= (1 << 128) - 1
+                        if v >= 1 << 127:
+                            v -= 1 << 128
+                        py.append(_dec.Decimal(v).scaleb(-s))
+                else:
+                    py = [
+                        _dec.Decimal(int(v)).scaleb(-s) if ok else None
+                        for v, ok in zip(vals, validity)
+                    ]
             arrays.append(pa.array(py, type=at))
             continue
         mask = None if validity.all() else ~validity

@@ -264,6 +264,10 @@ def make_column(dtype: DataType, values: np.ndarray,
             ev[:n, :] = elem_validity
         return DeviceColumn(dtype, jnp.asarray(data), jnp.asarray(vpad),
                             jnp.asarray(lpad), jnp.asarray(ev))
+    if values.ndim == 2:  # DECIMAL128 limb matrix [n, 2]
+        data = np.zeros((capacity, 2), dtype=np.int64)
+        data[:n, :] = values
+        return DeviceColumn(dtype, jnp.asarray(data), jnp.asarray(vpad))
     data = np.zeros(capacity, dtype=dtype.np_dtype)
     data[:n] = values
     return DeviceColumn(dtype, jnp.asarray(data), jnp.asarray(vpad))
@@ -280,9 +284,13 @@ def empty_like_schema(schema: StructType, capacity: int,
                 jnp.zeros(capacity, jnp.bool_),
                 jnp.zeros(capacity, jnp.int32)))
         else:
+            from spark_rapids_tpu.ops import decimal128 as _d128
+
+            shape = ((capacity, 2) if _d128.is_wide(f.dataType)
+                     else (capacity,))
             cols.append(DeviceColumn(
                 f.dataType,
-                jnp.zeros(capacity, f.dataType.np_dtype),
+                jnp.zeros(shape, f.dataType.np_dtype),
                 jnp.zeros(capacity, jnp.bool_)))
     return ColumnBatch(schema, cols, 0)
 

@@ -366,11 +366,22 @@ def _ev_datetime(e: Expression, t: pa.Table):
         s = pd.Series(_localize(arr, tz).to_pandas())
         wall = s.dt.tz_localize(None)
         tr = _pd_trunc(wall, e.unit)
-        zone = tz if not _tz_utc(tz) else "UTC"
-        back = tr.dt.tz_localize(zone, ambiguous=True,
-                                 nonexistent="shift_forward")
-        return pa.array(back.dt.tz_convert("UTC"),
-                        type=pa.timestamp("us", tz="UTC"))
+        if _tz_utc(tz):
+            return pa.array(tr.dt.tz_localize("UTC"),
+                            type=pa.timestamp("us", tz="UTC"))
+        # rebase with the java.time gap/overlap rules via tzdb
+        from spark_rapids_tpu.ops import tzdb as _tzdb
+
+        nat = tr.isna().to_numpy()
+        # explicit unit: pandas keeps arrow's us resolution, but a ns
+        # series would be off by 1000x with a blind astype(int64)
+        local_us = tr.to_numpy().astype("datetime64[us]").astype(
+            np.int64)
+        local_us = np.where(nat, 0, local_us)
+        shifted = _tzdb.local_to_utc_np(local_us, tz)
+        return pa.array(shifted, type=pa.int64(),
+                        mask=nat).cast(pa.timestamp("us")).cast(
+                            pa.timestamp("us", tz="UTC"))
     if isinstance(e, DT.UnixTimestamp):
         a = _ev(e.children[0], t)
         us = pc.cast(a.cast(pa.timestamp("us")), pa.int64())
@@ -408,7 +419,9 @@ def _ev_datetime(e: Expression, t: pa.Table):
     if isinstance(e, DT.DateFormat):  # incl. FromUnixtime
         arr = _ev(e.children[0], t)
         tz = getattr(e, "tz", "UTC")
-        fmt = _java_fmt_to_strftime(e.fmt)  # raises on unknown letters
+        has_ms = "SSS" in e.fmt
+        fmt = _java_fmt_to_strftime(e.fmt.replace("SSS", "\x00"))
+        us = None
         if pa.types.is_timestamp(arr.type):
             # floor to seconds precision: arrow's %S would append the
             # fraction and its us->s cast truncates toward zero
@@ -416,7 +429,15 @@ def _ev_datetime(e: Expression, t: pa.Table):
             arr = _epoch_secs_localized(us, mask, tz)
         elif pa.types.is_date(arr.type):
             arr = pc.cast(arr, pa.timestamp("s"))
-        return pc.strftime(arr, format=fmt)
+        out = pc.strftime(arr, format=fmt)
+        if has_ms:
+            ms = ((us % 1_000_000) // 1000 if us is not None
+                  else np.zeros(len(out), np.int64))
+            out = pa.array(
+                [None if v is None else v.replace("\x00", "%03d" % m)
+                 for v, m in zip(out.to_pylist(), ms)],
+                type=pa.string())
+        return out
     return None
 
 
@@ -836,10 +857,14 @@ def _cast(e: Cast, t: pa.Table):
         naive = pc.cast(a, pa.timestamp("us"))
         if _tz_utc(tz):
             return naive.cast(at)
-        loc = pc.assume_timezone(naive, timezone=tz,
-                                 ambiguous="earliest",
-                                 nonexistent="latest")
-        return loc.cast(at)
+        # java.time gap/overlap rules (earlier offset; gaps shift by
+        # the gap width) — same table the device uses
+        from spark_rapids_tpu.ops import tzdb as _tzdb
+
+        us, mask = _ts_us_numpy(naive)
+        shifted = _tzdb.local_to_utc_np(us, tz)
+        return pa.array(shifted, type=pa.int64(), mask=mask).cast(
+            pa.timestamp("us")).cast(at)
     if isinstance(frm, (FloatType, DoubleType)) and isinstance(
             to, IntegralType):
         an = pc.cast(a, pa.float64()).to_numpy(zero_copy_only=False)
@@ -872,6 +897,25 @@ def _cast(e: Cast, t: pa.Table):
                     f"[CAST_OVERFLOW] {to.simpleString} cast overflow "
                     "(ANSI mode)")
         return pa.array(an.astype(to.np_dtype), type=at, mask=mask)  # wraps
+    if isinstance(to, DecimalType):
+        import decimal as _dm
+
+        r = pc.cast(a, at, safe=False)
+        # arrow does not enforce the target precision; Spark nulls
+        # overflowing values (non-ANSI). Compare in decimal256 — the
+        # limit 10^(p-s) does not fit the target's own 128-bit type.
+        wide = pc.cast(r, pa.decimal256(76, to.scale))
+        lim = _dm.Decimal(10 ** (to.precision - to.scale))
+        lim_t = pa.decimal256(76, to.scale)
+        over = pc.or_kleene(
+            pc.greater_equal(wide, pa.scalar(lim, lim_t)),
+            pc.less_equal(wide, pa.scalar(-lim, lim_t)))
+        if ansi and pc.any(pc.fill_null(over, False)).as_py():
+            raise CastError(
+                f"[CAST_OVERFLOW] {to.simpleString} cast overflow "
+                "(ANSI mode)")
+        return pc.if_else(pc.fill_null(over, False),
+                          pa.scalar(None, at), r)
     return pc.cast(a, at, safe=False)
 
 

@@ -828,7 +828,21 @@ class CpuHashAggregateExec(PhysicalPlan):
             if nm == "sum":
                 return None if len(raw) == 0 else raw.sum()
             if nm == "avg":
-                return None if len(raw) == 0 else float(raw.mean())
+                if len(raw) == 0:
+                    return None
+                from spark_rapids_tpu.sqltypes import DecimalType as _D
+
+                if isinstance(fn.dtype, _D):
+                    # exact decimal mean, HALF_UP at the output scale
+                    import decimal as _dm
+
+                    with _dm.localcontext() as ctx:
+                        ctx.prec = 60
+                        tot = sum(_dm.Decimal(v) for v in raw)
+                        q = _dm.Decimal(1).scaleb(-fn.dtype.scale)
+                        return (tot / len(raw)).quantize(
+                            q, rounding=_dm.ROUND_HALF_UP)
+                return float(raw.mean())
             if nm == "min":
                 return None if len(raw) == 0 else raw.min()
             if nm == "max":
@@ -896,8 +910,18 @@ class CpuHashAggregateExec(PhysicalPlan):
             in_groups.append(names)
         work = pa.table(cols)
         key_names = [g_.name for g_ in self.grouping]
-        if any(a.children[0].name not in self._ARROW_FN
-               for a in self.aggs):
+        from spark_rapids_tpu.sqltypes import DecimalType as _Dec
+
+        def _needs_pandas(a):
+            fn = a.children[0]
+            if fn.name not in self._ARROW_FN:
+                return True
+            # arrow's hash_mean rounds decimals at the INPUT scale;
+            # Spark's avg is exact sum/count at scale+4
+            return (fn.name == "avg" and fn.children
+                    and isinstance(fn.children[0].dtype, _Dec))
+
+        if any(_needs_pandas(a) for a in self.aggs):
             yield self._pandas_groupby(work, key_names, in_groups)
             return
         in_names = [names[0] for names in in_groups]

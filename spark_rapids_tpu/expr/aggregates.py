@@ -67,7 +67,9 @@ def _sum_result_type(t: DataType) -> DataType:
     if isinstance(t, (FloatType, DoubleType)):
         return double
     if isinstance(t, DecimalType):
-        p = min(DecimalType.MAX_LONG_DIGITS, t.precision + 10)
+        # Spark: sum(decimal(p,s)) -> decimal(p+10, s); beyond 18 digits
+        # the buffer/result is DECIMAL128 (limb pairs, ops/decimal128.py)
+        p = min(DecimalType.MAX_PRECISION, t.precision + 10)
         return DecimalType(p, t.scale)
     return long
 
@@ -86,20 +88,37 @@ class Sum(AggregateFunction):
         return [self.dtype, long]  # (sum, count_nonnull)
 
     def update(self, values, live, gid, cap):
+        from spark_rapids_tpu.ops import decimal128 as d128
+
         out_t = self.dtype
         valid = values.validity & live
+        cnt = segmented.seg_count(valid, gid, cap)
+        ones = jnp.ones(cnt.shape, bool)
+        if d128.is_wide(out_t):
+            hi, lo = d128.widen_column(values)
+            sh, sl = d128.seg_sum128(hi, lo, valid, gid, cap)
+            return [DeviceColumn(out_t, d128.join(sh, sl), cnt > 0),
+                    DeviceColumn(long, cnt, ones)]
         data = values.data.astype(out_t.np_dtype)
         s = segmented.seg_sum(data, valid, gid, cap)
-        cnt = segmented.seg_count(valid, gid, cap)
         return [DeviceColumn(out_t, s, cnt > 0),
-                DeviceColumn(long, cnt, jnp.ones(cnt.shape, bool))]
+                DeviceColumn(long, cnt, ones)]
 
     def merge(self, buffers, live, gid, cap):
-        s = segmented.seg_sum(buffers[0].data,
-                              buffers[0].validity & live, gid, cap)
+        from spark_rapids_tpu.ops import decimal128 as d128
+
         cnt = segmented.seg_sum(buffers[1].data, live, gid, cap)
-        return [DeviceColumn(buffers[0].dtype, s, cnt > 0),
-                DeviceColumn(long, cnt, jnp.ones(cnt.shape, bool))]
+        ones = jnp.ones(cnt.shape, bool)
+        buf = buffers[0]
+        if buf.data.ndim == 2:
+            hi, lo = d128.split(buf.data)
+            sh, sl = d128.seg_sum128(hi, lo, buf.validity & live, gid,
+                                     cap)
+            return [DeviceColumn(buf.dtype, d128.join(sh, sl), cnt > 0),
+                    DeviceColumn(long, cnt, ones)]
+        s = segmented.seg_sum(buf.data, buf.validity & live, gid, cap)
+        return [DeviceColumn(buf.dtype, s, cnt > 0),
+                DeviceColumn(long, cnt, ones)]
 
     def evaluate(self, buffers):
         return buffers[0]
@@ -154,15 +173,28 @@ class _MinMax(AggregateFunction):
     def _seg(self, data, valid, gid, cap):
         raise NotImplementedError
 
+    def _seg_any(self, data, valid, gid, cap):
+        if data.ndim != 2:
+            return self._seg(data, valid, gid, cap)
+        # DECIMAL128: two-pass segmented extremum over (hi, lo') limbs
+        from spark_rapids_tpu.ops import decimal128 as d128
+
+        hi, lo = d128.split(data)
+        lo_o = lo ^ jnp.int64(d128._SIGN64)  # unsigned-orderable
+        h = self._seg(hi, valid, gid, cap)
+        tie = valid & (hi == jnp.take(h, gid))
+        l_o = self._seg(lo_o, tie, gid, cap)
+        return d128.join(h, l_o ^ jnp.int64(d128._SIGN64))
+
     def update(self, values, live, gid, cap):
         valid = values.validity & live
-        r = self._seg(values.data, valid, gid, cap)
+        r = self._seg_any(values.data, valid, gid, cap)
         cnt = segmented.seg_count(valid, gid, cap)
         return [DeviceColumn(self.dtype, r, cnt > 0)]
 
     def merge(self, buffers, live, gid, cap):
         valid = buffers[0].validity & live
-        r = self._seg(buffers[0].data, valid, gid, cap)
+        r = self._seg_any(buffers[0].data, valid, gid, cap)
         cnt = segmented.seg_count(valid, gid, cap)
         return [DeviceColumn(buffers[0].dtype, r, cnt > 0)]
 
@@ -195,7 +227,9 @@ class Average(AggregateFunction):
         # Spark: avg(decimal) -> decimal(p+4, s+4); others -> double.
         t = self.children[0].dtype
         if isinstance(t, DecimalType):
-            return DecimalType(min(18, t.precision + 4), min(18, t.scale + 4))
+            m = DecimalType.MAX_PRECISION
+            return DecimalType(min(m, t.precision + 4),
+                               min(m, t.scale + 4))
         return double
 
     def buffer_types(self):
@@ -208,9 +242,22 @@ class Average(AggregateFunction):
         return Sum(self.children[0]).merge(buffers, live, gid, cap)
 
     def evaluate(self, buffers):
+        from spark_rapids_tpu.ops import decimal128 as d128
+
         s, cnt = buffers
         out_t = self.dtype
         safe = jnp.maximum(cnt.data, 1)
+        if isinstance(out_t, DecimalType) and s.data.ndim == 2:
+            in_t = self.children[0].dtype
+            up = out_t.scale - in_t.scale
+            hi, lo = d128.rescale(*d128.split(s.data), up)
+            qh, ql = d128.div128_round_half_up(hi, lo, safe)
+            valid = (cnt.data > 0) & d128.fits_precision(
+                qh, ql, out_t.precision)
+            if d128.is_wide(out_t):
+                return DeviceColumn(out_t, d128.join(qh, ql), valid)
+            return DeviceColumn(out_t, ql,
+                                valid & d128.fits_i64(qh, ql))
         if isinstance(out_t, DecimalType):
             in_t = self.children[0].dtype
             up = out_t.scale - in_t.scale

@@ -90,40 +90,77 @@ def _to_scaled_i64(col: DeviceColumn, scale: int) -> jnp.ndarray:
     return col.data.astype(jnp.int64)
 
 
+def _any_wide(*dts) -> bool:
+    from spark_rapids_tpu.ops import decimal128 as d128
+
+    return any(d128.is_wide(dt) for dt in dts)
+
+
 class Add(BinaryArithmetic):
+    _negate_right = False
+
     def _dec_type(self, lp, ls, rp, rs):
         s = max(ls, rs)
-        p = min(DecimalType.MAX_LONG_DIGITS, max(lp - ls, rp - rs) + s + 1)
+        p = min(DecimalType.MAX_PRECISION, max(lp - ls, rp - rs) + s + 1)
         return DecimalType(p, s)
 
+    def _wide_eval(self, ctx, out_t):
+        """DECIMAL128 add/subtract via limb arithmetic
+        (ops/decimal128.py; reference spark-rapids-jni DecimalUtils)."""
+        from spark_rapids_tpu.ops import decimal128 as d128
+
+        lc = self.left.eval(ctx)
+        rc = self.right.eval(ctx)
+        s = out_t.scale
+        ls = _dec_prec_scale(self.left.dtype)[1]
+        rs = _dec_prec_scale(self.right.dtype)[1]
+        lh, ll = d128.widen_column(lc, s - ls)
+        rh, rl = d128.widen_column(rc, s - rs)
+        if self._negate_right:
+            rh, rl = d128.neg128(rh, rl)
+        hi, lo = d128.add128(lh, ll, rh, rl)
+        valid = binary_validity(lc, rc) & d128.fits_precision(
+            hi, lo, out_t.precision)
+        return DeviceColumn(out_t, d128.join(hi, lo), valid)
+
     def eval(self, ctx):
+        out_t = self._result_type()
+        if _any_wide(out_t, self.left.dtype, self.right.dtype):
+            return self._wide_eval(ctx, out_t)
         ld, rd, lc, rc, out_t, ls, rs = self._promote(ctx)
         if isinstance(out_t, DecimalType):
             s = out_t.scale
             ld = ld * (10 ** (s - ls))
             rd = rd * (10 ** (s - rs))
+        if self._negate_right:
+            rd = -rd
         return DeviceColumn(out_t, ld + rd, binary_validity(lc, rc))
 
 
-class Subtract(BinaryArithmetic):
-    _dec_type = Add._dec_type
-
-    def eval(self, ctx):
-        ld, rd, lc, rc, out_t, ls, rs = self._promote(ctx)
-        if isinstance(out_t, DecimalType):
-            s = out_t.scale
-            ld = ld * (10 ** (s - ls))
-            rd = rd * (10 ** (s - rs))
-        return DeviceColumn(out_t, ld - rd, binary_validity(lc, rc))
+class Subtract(Add):
+    _negate_right = True
 
 
 class Multiply(BinaryArithmetic):
     def _dec_type(self, lp, ls, rp, rs):
-        s = min(DecimalType.MAX_LONG_DIGITS, ls + rs)
-        p = min(DecimalType.MAX_LONG_DIGITS, lp + rp + 1)
+        s = min(DecimalType.MAX_PRECISION, ls + rs)
+        p = min(DecimalType.MAX_PRECISION, lp + rp + 1)
         return DecimalType(p, s)
 
     def eval(self, ctx):
+        out_t = self._result_type()
+        if _any_wide(out_t, self.left.dtype, self.right.dtype):
+            # only narrow x narrow -> wide has a device lowering; wide
+            # OPERANDS are planner-tagged for CPU (typesig check)
+            from spark_rapids_tpu.ops import decimal128 as d128
+
+            lc = self.left.eval(ctx)
+            rc = self.right.eval(ctx)
+            hi, lo = d128.mul_i64_i64(lc.data.astype(jnp.int64),
+                                      rc.data.astype(jnp.int64))
+            valid = binary_validity(lc, rc) & d128.fits_precision(
+                hi, lo, out_t.precision)
+            return DeviceColumn(out_t, d128.join(hi, lo), valid)
         ld, rd, lc, rc, out_t, ls, rs = self._promote(ctx)
         return DeviceColumn(out_t, ld * rd, binary_validity(lc, rc))
 
@@ -228,6 +265,12 @@ class UnaryMinus(Expression):
 
     def eval(self, ctx):
         c = self.children[0].eval(ctx)
+        if c.data.ndim == 2 and isinstance(c.dtype, DecimalType):
+            from spark_rapids_tpu.ops import decimal128 as d128
+
+            hi, lo = d128.neg128(*d128.split(c.data))
+            return DeviceColumn(self.dtype, d128.join(hi, lo),
+                                c.validity)
         return DeviceColumn(self.dtype, -c.data, c.validity, c.lengths)
 
 
@@ -241,5 +284,11 @@ class Abs(Expression):
 
     def eval(self, ctx):
         c = self.children[0].eval(ctx)
+        if c.data.ndim == 2 and isinstance(c.dtype, DecimalType):
+            from spark_rapids_tpu.ops import decimal128 as d128
+
+            hi, lo, _ = d128.abs128(*d128.split(c.data))
+            return DeviceColumn(self.dtype, d128.join(hi, lo),
+                                c.validity)
         return DeviceColumn(self.dtype, jnp.abs(c.data), c.validity,
                             c.lengths)

@@ -56,6 +56,15 @@ class Cast(Expression):
         return ("cast", repr(self.to), self.tz, self.children[0].key())
 
     def device_supported(self) -> bool:
+        from spark_rapids_tpu.ops import decimal128 as d128
+
+        frm = self.children[0].dtype
+        if d128.is_wide(self.to) and isinstance(
+                frm, (FloatType, DoubleType, StringType)):
+            return False  # needs exact big-int parse/scale: CPU
+        if (isinstance(self.to, StringType) and d128.is_wide(frm)
+                and frm.scale > 18):
+            return False  # fraction chunk exceeds one 64-bit divisor
         return True
 
     def can_fail(self) -> bool:
@@ -166,7 +175,13 @@ def _cast_from_string(c: DeviceColumn, to: DataType,
 
 def _cast_decimal(c: DeviceColumn, frm: DataType, to: DataType
                   ) -> DeviceColumn:
+    from spark_rapids_tpu.ops import decimal128 as d128
+
     fs = frm.scale if isinstance(frm, DecimalType) else 0
+    frm_wide = c.data.ndim == 2
+    to_wide = d128.is_wide(to) if isinstance(to, DecimalType) else False
+    if frm_wide or to_wide:
+        return _cast_decimal_wide(c, frm, to, fs, frm_wide, to_wide)
     if isinstance(to, DecimalType):
         ts = to.scale
         if isinstance(frm, (FloatType, DoubleType)):
@@ -197,6 +212,41 @@ def _cast_decimal(c: DeviceColumn, frm: DataType, to: DataType
     f = 10 ** fs
     q = jnp.sign(c.data) * (jnp.abs(c.data.astype(jnp.int64)) // f)
     return DeviceColumn(to, q.astype(to.np_dtype), c.validity)
+
+
+def _cast_decimal_wide(c: DeviceColumn, frm: DataType, to: DataType,
+                       fs: int, frm_wide: bool, to_wide: bool
+                       ) -> DeviceColumn:
+    """DECIMAL128 conversions via limb arithmetic (ops/decimal128.py;
+    the DecimalUtils role). float->wide and string parsing are planner-
+    tagged for CPU (typesig)."""
+    from spark_rapids_tpu.ops import decimal128 as d128
+
+    if isinstance(to, DecimalType):
+        if isinstance(frm, (FloatType, DoubleType)):
+            raise TypeError(
+                "float -> decimal128 has no device lowering (CPU)")
+        hi, lo = d128.widen_column(c, to.scale - fs)
+        valid = c.validity & d128.fits_precision(hi, lo, to.precision)
+        if to_wide:
+            return DeviceColumn(to, d128.join(hi, lo), valid)
+        valid = valid & d128.fits_i64(hi, lo)
+        return DeviceColumn(to, lo, valid)
+    # wide decimal -> numeric
+    hi, lo = d128.split(c.data)
+    if isinstance(to, (FloatType, DoubleType)):
+        data = d128.to_f64(hi, lo) / (10.0 ** fs)
+        return DeviceColumn(to, data.astype(to.np_dtype), c.validity)
+    # integral: truncate the fraction (Spark cast), then wrap like Java
+    if fs:
+        ah, al, neg = d128.abs128(hi, lo)
+        qh, ql, _ = d128.divmod_u128_u64(ah, al, 10 ** min(fs, 18))
+        if fs > 18:
+            qh, ql, _ = d128.divmod_u128_u64(qh, ql, 10 ** (fs - 18))
+        nh, nl = d128.neg128(qh, ql)
+        hi = jnp.where(neg, nh, qh)
+        lo = jnp.where(neg, nl, ql)
+    return DeviceColumn(to, lo.astype(to.np_dtype), c.validity)
 
 
 _MAX_DIGITS = 20
@@ -352,6 +402,13 @@ def _timestamp_to_string(c: DeviceColumn, tz: str = "UTC") -> DeviceColumn:
 
 def _decimal_to_string(c: DeviceColumn) -> DeviceColumn:
     from spark_rapids_tpu.sqltypes.datatypes import string as string_t
+
+    if c.data.ndim == 2:  # DECIMAL128 limb matrix
+        from spark_rapids_tpu.ops import decimal128 as d128
+
+        mat, lengths = d128.decimal_string(*d128.split(c.data),
+                                           c.dtype.scale)
+        return DeviceColumn(string_t, mat, c.validity, lengths)
 
     s = c.dtype.scale
     if s == 0:

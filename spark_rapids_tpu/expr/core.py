@@ -153,8 +153,11 @@ class Literal(Expression):
             lengths = jnp.full((cap,), np.int32(len(raw)))
             valid = jnp.full((cap,), self.value is not None)
             return DeviceColumn(dt, data, valid, lengths)
+        from spark_rapids_tpu.ops import decimal128 as _d128
+
+        wide = _d128.is_wide(dt)
         if self.value is None:
-            data = jnp.zeros((cap,), dt.np_dtype)
+            data = jnp.zeros((cap, 2) if wide else (cap,), dt.np_dtype)
             return DeviceColumn(dt, data, jnp.zeros((cap,), bool))
         v = self.value
         if isinstance(dt, DecimalType):
@@ -162,6 +165,12 @@ class Literal(Expression):
 
             v = int(decimal.Decimal(str(v)).scaleb(dt.scale)
                     .to_integral_value())
+            if wide:
+                hi = (v >> 64)
+                lo = _d128._i64_bits(v)
+                data = jnp.broadcast_to(
+                    jnp.asarray([hi, lo], jnp.int64), (cap, 2))
+                return DeviceColumn(dt, data, jnp.ones((cap,), bool))
         data = jnp.full((cap,), v, dtype=dt.np_dtype)
         return DeviceColumn(dt, data, jnp.ones((cap,), bool))
 

@@ -34,6 +34,10 @@ def _comparable(col: DeviceColumn) -> List[jnp.ndarray]:
         return _string_orderable(col)
     if isinstance(col.dtype, (FloatType, DoubleType)):
         return [_float_orderable(col.data)]
+    if col.data.ndim == 2:  # DECIMAL128 limb matrix
+        from spark_rapids_tpu.ops import decimal128 as _d128
+
+        return _d128.orderable_limbs(col.data)
     return [col.data.astype(jnp.int64)]
 
 

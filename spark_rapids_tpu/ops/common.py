@@ -114,6 +114,10 @@ def orderable_keys(col: DeviceColumn, ascending: bool, nulls_first: bool,
         vals = [_float_orderable(col.data)]
     elif isinstance(dt, BooleanType):
         vals = [col.data.astype(jnp.int64)]
+    elif col.data.ndim == 2:  # DECIMAL128 limb matrix
+        from spark_rapids_tpu.ops import decimal128 as _d128
+
+        vals = _d128.orderable_limbs(col.data)
     else:
         vals = [col.data.astype(jnp.int64)]
     # Null/dead rows: zero the value keys so ordering within them is stable.

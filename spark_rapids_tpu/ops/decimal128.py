@@ -1,0 +1,299 @@
+"""128-bit decimal limb arithmetic on device — the DecimalUtils /
+Aggregation128Utils role (reference: spark-rapids-jni DecimalUtils,
+Aggregation128Utils; SURVEY.md §2.12).
+
+A wide decimal column (precision > 18) stores its unscaled value as a
+[cap, 2] int64 matrix: column 0 = high limb (signed), column 1 = low
+limb (the low 64 bits of the two's-complement value, stored as an int64
+bit pattern). All helpers below are shape-preserving jnp ops so every
+call vectorizes on the VPU; uint64 intermediates are well-defined
+mod-2^64 wraps (XLA emulates 64-bit integers on TPU v5e exactly).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.sqltypes import DecimalType
+
+_M32 = jnp.uint64(0xFFFFFFFF)
+_SIGN64 = -0x8000000000000000  # int64 min: flips to unsigned order
+
+
+def is_wide(dt) -> bool:
+    return isinstance(dt, DecimalType) and \
+        dt.precision > DecimalType.MAX_LONG_DIGITS
+
+
+def split(data: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[n, 2] limb matrix -> (hi, lo) int64 vectors."""
+    return data[:, 0], data[:, 1]
+
+
+def join(hi: jnp.ndarray, lo: jnp.ndarray) -> jnp.ndarray:
+    return jnp.stack([hi, lo], axis=1)
+
+
+def from_i64(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Sign-extend an int64 unscaled value to 128 bits."""
+    return x >> 63, x
+
+
+def _u(x):
+    return x.astype(jnp.uint64)
+
+
+def _s(x):
+    return x.astype(jnp.int64)
+
+
+def add128(h1, l1, h2, l2):
+    """(h1,l1) + (h2,l2) mod 2^128."""
+    lo = _s(_u(l1) + _u(l2))
+    carry = _s(_u(lo) < _u(l1)).astype(jnp.int64)
+    return _s(_u(h1) + _u(h2) + _u(carry)), lo
+
+
+def neg128(hi, lo):
+    nh, nl = ~hi, ~lo
+    lo2 = _s(_u(nl) + jnp.uint64(1))
+    carry = (nl == -1).astype(jnp.int64)  # +1 wrapped: all-ones low limb
+    return _s(_u(nh) + _u(carry)), lo2
+
+
+def abs128(hi, lo):
+    neg = hi < 0
+    nh, nl = neg128(hi, lo)
+    return jnp.where(neg, nh, hi), jnp.where(neg, nl, lo), neg
+
+
+def mul_i64_i64(a: jnp.ndarray, b: jnp.ndarray):
+    """Full signed 64x64 -> 128-bit product (hi, lo int64)."""
+    au, bu = _u(a), _u(b)
+    a0, a1 = au & _M32, au >> jnp.uint64(32)
+    b0, b1 = bu & _M32, bu >> jnp.uint64(32)
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    p11 = a1 * b1
+    mid = (p00 >> jnp.uint64(32)) + (p01 & _M32) + (p10 & _M32)
+    lo = (p00 & _M32) | ((mid & _M32) << jnp.uint64(32))
+    hi = p11 + (p01 >> jnp.uint64(32)) + (p10 >> jnp.uint64(32)) \
+        + (mid >> jnp.uint64(32))
+    # unsigned -> signed product adjustment
+    hi = hi - jnp.where(a < 0, bu, jnp.uint64(0)) \
+        - jnp.where(b < 0, au, jnp.uint64(0))
+    return _s(hi), _s(lo)
+
+
+def mul128_small(hi, lo, k: int):
+    """(hi, lo) * k for a small positive python int k (< 2^62)."""
+    ph, pl = mul_i64_i64(lo, jnp.full_like(lo, k))
+    # correction: mul_i64_i64 treated lo as signed; we need lo unsigned.
+    # signed(lo)*k = unsigned(lo)*k - (lo<0)*2^64*k  => add back k to hi
+    ph = ph + jnp.where(lo < 0, jnp.int64(k), jnp.int64(0))
+    return _s(_u(ph) + _u(hi * jnp.int64(k))), pl
+
+
+def cmp_unsigned(h1, l1, h2, l2):
+    """-1/0/1 comparison of two unsigned 128-bit values."""
+    hgt = _u(h1) > _u(h2)
+    hlt = _u(h1) < _u(h2)
+    lgt = _u(l1) > _u(l2)
+    llt = _u(l1) < _u(l2)
+    gt = hgt | ((h1 == h2) & lgt)
+    lt = hlt | ((h1 == h2) & llt)
+    return jnp.where(gt, 1, jnp.where(lt, -1, 0))
+
+
+def shl1(hi, lo, bit):
+    """(hi,lo) << 1 | bit."""
+    nh = _s((_u(hi) << jnp.uint64(1)) | (_u(lo) >> jnp.uint64(63)))
+    nl = _s((_u(lo) << jnp.uint64(1)) | _u(bit))
+    return nh, nl
+
+
+def divmod_u128_u64(hi, lo, d):
+    """Unsigned (hi,lo) // d and remainder, divisor d in (0, 2^63):
+    128-step restoring division; the remainder always fits one int64
+    since d does. d may be a per-row vector (e.g. group counts)."""
+    d = jnp.broadcast_to(jnp.asarray(d, jnp.int64), hi.shape)
+
+    def step(i, carry):
+        qh, ql, rem = carry
+        # numerator bit (127 - i), from hi for i < 64 else from lo
+        idx_hi = jnp.uint64(63) - jnp.minimum(i, 63).astype(jnp.uint64)
+        idx_lo = jnp.uint64(63) - jnp.clip(i - 64, 0, 63).astype(
+            jnp.uint64)
+        b_hi = (_u(hi) >> idx_hi) & jnp.uint64(1)
+        b_lo = (_u(lo) >> idx_lo) & jnp.uint64(1)
+        bit = jnp.where(i < 64, _s(b_hi), _s(b_lo))
+        rem = _s((_u(rem) << jnp.uint64(1)) | _u(bit))
+        ge = _u(rem) >= _u(d)
+        rem = jnp.where(ge, _s(_u(rem) - _u(d)), rem)
+        qh, ql = shl1(qh, ql, ge.astype(jnp.int64))
+        return qh, ql, rem
+
+    zero = jnp.zeros_like(hi)
+    qh, ql, rem = jax.lax.fori_loop(0, 128, step, (zero, zero, zero))
+    return qh, ql, rem
+
+
+def div128_round_half_up(hi, lo, d):
+    """Signed (hi,lo) / d with HALF_UP rounding (Spark BigDecimal);
+    d is a positive int64 vector or scalar."""
+    ah, al, neg = abs128(hi, lo)
+    qh, ql, rem = divmod_u128_u64(ah, al, d)
+    d = jnp.broadcast_to(jnp.asarray(d, jnp.int64), hi.shape)
+    up = (2 * rem >= d).astype(jnp.int64)
+    qh2, ql2 = add128(qh, ql, jnp.zeros_like(qh), up)
+    nh, nl = neg128(qh2, ql2)
+    return jnp.where(neg, nh, qh2), jnp.where(neg, nl, ql2)
+
+
+_POW10 = [10 ** i for i in range(39)]
+
+
+def rescale(hi, lo, delta: int):
+    """Multiply (delta>0) or divide-HALF_UP (delta<0) by 10^|delta|."""
+    if delta == 0:
+        return hi, lo
+    if delta > 0:
+        while delta > 0:
+            step = min(delta, 18)
+            hi, lo = mul128_small(hi, lo, _POW10[step])
+            delta -= step
+        return hi, lo
+    delta = -delta
+    # divide by up to 10^18 per step (fits < 2^63); HALF_UP only on the
+    # LAST step (BigDecimal.setScale semantics)
+    ah, al, neg = abs128(hi, lo)
+    while delta > 18:
+        qh, ql, _ = divmod_u128_u64(ah, al, _POW10[18])
+        ah, al = qh, ql
+        delta -= 18
+    d = _POW10[delta]
+    qh, ql, rem = divmod_u128_u64(ah, al, d)
+    up = (2 * rem >= jnp.int64(d)).astype(jnp.int64)
+    qh, ql = add128(qh, ql, jnp.zeros_like(qh), up)
+    nh, nl = neg128(qh, ql)
+    return jnp.where(neg, nh, qh), jnp.where(neg, nl, ql)
+
+
+def _i64_bits(v: int) -> int:
+    """Python int's low 64 bits as an int64 bit pattern."""
+    v &= (1 << 64) - 1
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def fits_precision(hi, lo, precision: int):
+    """validity mask: |value| < 10^precision (precision <= 38)."""
+    ah, al, _ = abs128(hi, lo)
+    limit = _POW10[precision]
+    lh = jnp.full_like(hi, limit >> 64)
+    ll = jnp.full_like(lo, _i64_bits(limit))
+    return cmp_unsigned(ah, al, lh, ll) < 0
+
+
+def fits_i64(hi, lo):
+    """True where the 128-bit value fits a signed int64."""
+    return hi == (lo >> 63)
+
+
+def to_f64(hi, lo):
+    """Approximate float64 value of the signed 128-bit integer."""
+    return hi.astype(jnp.float64) * 18446744073709551616.0 \
+        + _u(lo).astype(jnp.float64)
+
+
+def seg_sum128(hi, lo, valid, gid, cap: int):
+    """Segmented sum of 128-bit values, exact mod 2^128: decompose into
+    four 32-bit limbs (no intra-sum overflow for < 2^31 rows), segment-
+    sum each, then carry-normalize (the Aggregation128Utils role)."""
+    u_lo, u_hi = _u(lo), _u(hi)
+    limbs = [
+        _s(u_lo & _M32), _s(u_lo >> jnp.uint64(32)),
+        _s(u_hi & _M32), _s(u_hi >> jnp.uint64(32)),
+    ]
+    sums = []
+    for limb in limbs:
+        masked = jnp.where(valid, limb, 0)
+        sums.append(jax.ops.segment_sum(masked, gid, num_segments=cap))
+    c = jnp.zeros_like(sums[0])
+    out = []
+    for s_ in sums:
+        tot = _u(s_) + _u(c)
+        out.append(tot & _M32)
+        c = _s(tot >> jnp.uint64(32))
+    lo_out = _s(out[0] | (out[1] << jnp.uint64(32)))
+    hi_out = _s(out[2] | (out[3] << jnp.uint64(32)))
+    return hi_out, lo_out
+
+
+def orderable_limbs(data: jnp.ndarray):
+    """[hi, lo'] key pair whose lexicographic signed order equals the
+    128-bit signed order (lo gets its sign bit flipped to unsigned)."""
+    hi, lo = split(data)
+    return [hi, lo ^ jnp.int64(_SIGN64)]
+
+
+def widen_column(col, target_scale_delta: int = 0):
+    """DeviceColumn (narrow or wide decimal) -> (hi, lo), optionally
+    rescaled up by target_scale_delta digits."""
+    if col.data.ndim == 2:
+        hi, lo = split(col.data)
+    else:
+        hi, lo = from_i64(col.data.astype(jnp.int64))
+    if target_scale_delta:
+        hi, lo = rescale(hi, lo, target_scale_delta)
+    return hi, lo
+
+
+def decimal_string(hi, lo, scale: int):
+    """(hi, lo, scale) -> (byte_matrix [n, 48], lengths): the Spark
+    decimal string '-123.45' with exactly `scale` fraction digits
+    (scale <= 18 handled by the device path; wider scales are planner-
+    tagged for CPU)."""
+    ah, al, neg = abs128(hi, lo)
+    chunks = []
+    ch, cl = ah, al
+    for _ in range(5):
+        qh, ql, rem = divmod_u128_u64(ch, cl, 10 ** 9)
+        chunks.append(rem)
+        ch, cl = qh, ql
+    n = hi.shape[0]
+    # significant digit count of |value|
+    ndig = jnp.ones((n,), jnp.int32)
+    for ci in range(5):
+        for k in range(9):
+            dr = ci * 9 + k
+            nz = chunks[ci] >= 10 ** k
+            ndig = jnp.where(nz, jnp.maximum(ndig, dr + 1), ndig)
+    ndig = jnp.maximum(ndig, scale + 1)  # "0.xx" needs a leading 0
+    whole_len = ndig - scale
+    chars = ndig + (1 if scale else 0)
+    sign_len = neg.astype(jnp.int32)
+    lengths = sign_len + chars
+    mb = 48
+    pos = jnp.arange(mb, dtype=jnp.int32)[None, :]
+    from_left = pos - sign_len[:, None]
+    is_dot = (scale > 0) & (from_left == whole_len[:, None])
+    after_dot = (scale > 0) & (from_left > whole_len[:, None])
+    digit_fr = jnp.where(
+        after_dot,
+        ndig[:, None] - from_left,  # skip the dot char
+        ndig[:, None] - 1 - from_left)
+    digit = jnp.zeros((n, mb), jnp.int32)
+    for ci in range(5):
+        for k in range(9):
+            dr = ci * 9 + k
+            dv = ((chunks[ci] // (10 ** k)) % 10).astype(jnp.int32)
+            digit = jnp.where(digit_fr == dr, dv[:, None], digit)
+    in_chars = (from_left >= 0) & (from_left < chars[:, None])
+    out = jnp.where(in_chars, (digit + ord("0")).astype(jnp.uint8), 0)
+    out = jnp.where(is_dot & in_chars, jnp.uint8(ord(".")), out)
+    out = jnp.where((pos == 0) & neg[:, None], jnp.uint8(ord("-")), out)
+    return out, lengths

@@ -24,7 +24,7 @@ from __future__ import annotations
 import os
 import struct
 import threading
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -74,10 +74,99 @@ def _parse_tzif(data: bytes):
 
     version = data[4:5]
     trans, offsets, end = read_block(0, long_times=False)
-    if version in (b"2", b"3"):
-        # v2+: a second block with 64-bit transition times follows
-        trans, offsets, _ = read_block(end, long_times=True)
+    footer = b""
+    if version in (b"2", b"3", b"4"):
+        # v2+: a second block with 64-bit transition times follows,
+        # then a newline-wrapped POSIX TZ footer with the recurring
+        # rule for instants past the last explicit transition
+        trans, offsets, p = read_block(end, long_times=True)
+        footer = data[p:].strip(b"\n \t")
+    ext = _extend_with_posix_rule(trans, offsets,
+                                  footer.decode("ascii", "ignore"))
+    if ext is not None:
+        trans, offsets = ext
     return trans, offsets
+
+
+_POSIX_OFF = r"[+-]?\d{1,2}(?::\d{2}(?::\d{2})?)?"
+_POSIX_NAME = r"(?:[A-Za-z]{3,}|<[^>]+>)"
+
+
+def _posix_seconds(s: str) -> int:
+    sign = -1 if s.startswith("-") else 1
+    s = s.lstrip("+-")
+    parts = [int(x) for x in s.split(":")]
+    while len(parts) < 3:
+        parts.append(0)
+    return sign * (parts[0] * 3600 + parts[1] * 60 + parts[2])
+
+
+def _rule_instant(year: int, rule: str, default_time: int,
+                  offset: int) -> Optional[int]:
+    """Mm.w.d[/time] -> UTC epoch seconds of the transition in `year`
+    under the prevailing `offset`; None for unsupported J/n forms."""
+    import calendar
+    import datetime as dtm
+
+    if "/" in rule:
+        rule, timestr = rule.split("/", 1)
+        t = _posix_seconds(timestr)
+    else:
+        t = default_time
+    if not rule.startswith("M"):
+        return None
+    m, w, d = (int(x) for x in rule[1:].split("."))
+    # day-of-week d (0=Sunday); week w (5 = last)
+    first_dow = dtm.date(year, m, 1).weekday()  # Mon=0
+    first_sun0 = (first_dow + 1) % 7  # dow (Sun=0) of day 1
+    day1 = 1 + (d - first_sun0) % 7
+    day = day1 + (w - 1) * 7
+    ndays = calendar.monthrange(year, m)[1]
+    while day > ndays:
+        day -= 7
+    wall = int(dtm.datetime(year, m, day, tzinfo=dtm.timezone.utc)
+               .timestamp()) + t
+    return wall - offset
+
+
+def _extend_with_posix_rule(trans, offsets, footer: str):
+    """Append yearly DST transitions (through 2100) from the TZ footer
+    so post-2037 instants keep the recurring rule, as java.time does.
+    Returns None when the footer has no DST rule (fixed offset) or uses
+    an unsupported form."""
+    import re
+
+    if not footer or "," not in footer:
+        return None
+    m = re.match(
+        rf"^({_POSIX_NAME})({_POSIX_OFF})({_POSIX_NAME})({_POSIX_OFF})?"
+        rf",([^,]+),(.+)$", footer)
+    if not m:
+        return None
+    std_off = -_posix_seconds(m.group(2))  # POSIX: west positive
+    dst_off = (-_posix_seconds(m.group(4)) if m.group(4)
+               else std_off + 3600)
+    start_rule, end_rule = m.group(5), m.group(6)
+    last = int(trans[-1]) if trans.size else 0
+    import datetime as dtm
+
+    y0 = max(dtm.datetime.fromtimestamp(
+        max(last, 0), dtm.timezone.utc).year, 1970)
+    new_t, new_o = [], []
+    for year in range(y0, 2101):
+        a = _rule_instant(year, start_rule, 7200, std_off)
+        b = _rule_instant(year, end_rule, 7200, dst_off)
+        if a is None or b is None:
+            return None
+        for instant, off in sorted([(a, dst_off), (b, std_off)]):
+            if instant > last:
+                new_t.append(instant)
+                new_o.append(off)
+    if not new_t:
+        return None
+    trans2 = np.concatenate([trans, np.array(new_t, np.int64)])
+    offsets2 = np.concatenate([offsets, np.array(new_o, np.int64)])
+    return trans2, offsets2
 
 
 def _load_zone(zone: str):
@@ -116,11 +205,6 @@ def is_utc(zone: str) -> bool:
     """Single UTC-alias predicate (shared by cast/datetime/cpu_eval so
     the alias list cannot drift)."""
     return zone in ("UTC", "GMT", "Z", "Etc/UTC", "Etc/GMT", "GMT0")
-
-
-def is_fixed_offset(zone: str) -> bool:
-    trans, offsets, _ = tables(zone)
-    return trans.size == 0 or bool((offsets == offsets[0]).all())
 
 
 def utc_to_local(ts_us, zone: str):

@@ -105,12 +105,15 @@ class TpuOverrides:
                         and isinstance(fn.input.dtype, StringType)):
                     meta.cannot_run(
                         "string min/max aggregation runs on CPU in v1")
+                from spark_rapids_tpu.plan.typesig import _wide_dec
+
                 if (isinstance(fn, (CollectList, CountDistinct))
                         and fn.input is not None
-                        and isinstance(fn.input.dtype, (StringType, _AT))):
+                        and (isinstance(fn.input.dtype, (StringType, _AT))
+                             or _wide_dec(fn.input.dtype))):
                     meta.cannot_run(
-                        "collect/distinct over string/array input runs "
-                        "on CPU in v1")
+                        "collect/distinct over string/array/decimal128 "
+                        "input runs on CPU in v1")
                 if isinstance(fn, (_Moments, _Bivariate, Percentile)):
                     for e in fn.children:
                         if not isinstance(e.dtype, _NT):
@@ -186,6 +189,12 @@ class TpuOverrides:
                         for r in expr_unsupported_reasons(fn.default):
                             meta.cannot_run(r)
             elif isinstance(fn, supported_aggs):
+                from spark_rapids_tpu.plan.typesig import _wide_dec as _wd
+
+                if fn.input is not None and _wd(fn.input.dtype):
+                    meta.cannot_run(
+                        "decimal(>18) window aggregation runs on CPU "
+                        "in v1")
                 if fn.input is not None:
                     for r in expr_unsupported_reasons(fn.input):
                         meta.cannot_run(r)

@@ -30,18 +30,21 @@ DEVICE_TYPES = (BooleanType, IntegralType, FloatType, DoubleType,
                 StringType, DateType, TimestampType, DecimalType)
 
 
+def _wide_dec(dt: DataType) -> bool:
+    return isinstance(dt, DecimalType) and \
+        dt.precision > DecimalType.MAX_LONG_DIGITS
+
+
 def type_supported(dt: DataType) -> Optional[str]:
     from spark_rapids_tpu.sqltypes import ArrayType
 
-    if isinstance(dt, DecimalType) and dt.precision > 18:
-        return f"decimal precision {dt.precision} > 18 (DECIMAL64 only)"
     if isinstance(dt, NullType):
         return None
     if isinstance(dt, ArrayType):
         et = dt.elementType
-        if isinstance(et, (StringType, ArrayType)):
+        if isinstance(et, (StringType, ArrayType)) or _wide_dec(et):
             return (f"array element type {et.simpleString} runs on CPU "
-                    "(device arrays hold primitive elements in v1)")
+                    "(device arrays hold primitive/64-bit elements in v1)")
         return type_supported(et)
     if not isinstance(dt, DEVICE_TYPES):
         return f"type {dt} not supported on device"
@@ -55,6 +58,11 @@ def key_type_supported(dt: DataType) -> Optional[str]:
 
     if isinstance(dt, ArrayType):
         return "array-typed keys run on CPU (no orderable device keys)"
+    if _wide_dec(dt):
+        # the SHUFFLE hash of a >18-digit decimal needs Spark's
+        # minimal-two's-complement-byte murmur3, not lowered yet
+        return ("decimal(>18) grouping/join keys run on CPU "
+                "(no device hash for 128-bit keys in v1)")
     return type_supported(dt)
 
 
@@ -140,3 +148,22 @@ from spark_rapids_tpu.expr.datetimes import DateFormat  # noqa: E402
 @register_check(DateFormat)
 def _date_format_check(e: "DateFormat") -> Optional[str]:
     return e.device_supported()
+
+
+from spark_rapids_tpu.expr.arith import Divide, Multiply  # noqa: E402
+
+
+@register_check(Divide)
+def _divide_check(e) -> Optional[str]:
+    if _wide_dec(e.children[0].dtype) or _wide_dec(e.children[1].dtype):
+        return ("decimal(>18) division runs on CPU "
+                "(128/128 device division not lowered)")
+    return None
+
+
+@register_check(Multiply)
+def _multiply_check(e) -> Optional[str]:
+    if _wide_dec(e.children[0].dtype) or _wide_dec(e.children[1].dtype):
+        return ("decimal(>18) operand multiplication runs on CPU "
+                "(only 64x64 -> 128 is lowered)")
+    return None

@@ -313,10 +313,8 @@ def from_arrow_type(at) -> DataType:
     if pa.types.is_timestamp(at):
         return timestamp
     if pa.types.is_decimal(at):
-        if at.precision > DecimalType.MAX_LONG_DIGITS:
-            raise TypeError(
-                f"decimal precision {at.precision} > 18 is not supported "
-                "(DECIMAL64 representation, v1 — see DecimalType docstring)")
+        # precision <= 18: scaled int64 (DECIMAL64); wider: [cap, 2]
+        # int64 limb pairs (DECIMAL128, ops/decimal128.py)
         return DecimalType(at.precision, at.scale)
     if pa.types.is_list(at) or pa.types.is_large_list(at):
         return ArrayType(from_arrow_type(at.value_type))

@@ -1,0 +1,168 @@
+"""DECIMAL128 (precision > 18) — [cap, 2] int64 limb columns with
+device limb arithmetic (ops/decimal128.py; reference: cuDF DECIMAL128 +
+spark-rapids-jni DecimalUtils/Aggregation128Utils, SURVEY.md §2.12)."""
+
+import decimal
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.testing.asserts import (
+    assert_tpu_and_cpu_are_equal_collect,
+    with_tpu_session,
+)
+
+
+@pytest.fixture(scope="module")
+def dec_path(tmp_path_factory):
+    d = tmp_path_factory.mktemp("dec128")
+    rng = np.random.default_rng(41)
+    n = 3000
+
+    def gen(lo, hi, scale, null_p=0.1):
+        return [decimal.Decimal(int(rng.integers(lo, hi))).scaleb(-scale)
+                if rng.random() > null_p else None for _ in range(n)]
+
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 6, n)),
+        "price": pa.array(gen(-10 ** 11, 10 ** 11, 2),
+                          type=pa.decimal128(12, 2)),
+        "wide": pa.array(
+            [decimal.Decimal(int(rng.integers(-10 ** 17, 10 ** 17))
+                             * 10 ** 9).scaleb(-4)
+             if rng.random() > 0.1 else None for _ in range(n)],
+            type=pa.decimal128(30, 4)),
+    })
+    p = str(d / "dec.parquet")
+    pq.write_table(t, p)
+    return p
+
+
+def test_limb_arithmetic_vs_python():
+    """Limb kernels against Python big-int arithmetic."""
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.ops import decimal128 as D
+
+    rng = np.random.default_rng(0)
+    a = [int(rng.integers(-10 ** 18, 10 ** 18))
+         * int(rng.integers(1, 9 * 10 ** 18)) for _ in range(300)]
+    b = [int(rng.integers(-10 ** 18, 10 ** 18))
+         * int(rng.integers(1, 9 * 10 ** 18)) for _ in range(300)]
+
+    def to_limbs(vals):
+        hi, lo = [], []
+        for x in vals:
+            v = x & ((1 << 128) - 1)
+            h = v >> 64
+            hi.append(h - (1 << 64) if h >= (1 << 63) else h)
+            lo.append(D._i64_bits(v))
+        return (jnp.asarray(np.array(hi, np.int64)),
+                jnp.asarray(np.array(lo, np.int64)))
+
+    def from_limbs(hi, lo):
+        out = []
+        for h, lo_ in zip(np.asarray(hi), np.asarray(lo)):
+            v = (((int(h) << 64) | (int(lo_) & ((1 << 64) - 1)))
+                 & ((1 << 128) - 1))
+            out.append(v - (1 << 128) if v >= (1 << 127) else v)
+        return out
+
+    ah, al = to_limbs(a)
+    bh, bl = to_limbs(b)
+    rh, rl = D.add128(ah, al, bh, bl)
+    wrap = lambda x: (x + (1 << 127)) % (1 << 128) - (1 << 127)  # noqa
+    assert from_limbs(rh, rl) == [wrap(x + y) for x, y in zip(a, b)]
+
+    x = rng.integers(-2 ** 62, 2 ** 62, 300)
+    y = rng.integers(-2 ** 62, 2 ** 62, 300)
+    mh, ml = D.mul_i64_i64(jnp.asarray(x), jnp.asarray(y))
+    assert from_limbs(mh, ml) == [int(p) * int(q) for p, q in zip(x, y)]
+
+    d = rng.integers(1, 10 ** 18, 300)
+    qh, ql = D.div128_round_half_up(ah, al, jnp.asarray(d))
+    with decimal.localcontext() as ctx:
+        ctx.prec = 60
+        want = [int((decimal.Decimal(v) / int(dd)).to_integral_value(
+            decimal.ROUND_HALF_UP)) for v, dd in zip(a, d)]
+    assert from_limbs(qh, ql) == want
+
+    gid = jnp.asarray(rng.integers(0, 5, 300).astype(np.int32))
+    valid = jnp.asarray(rng.random(300) < 0.9)
+    sh, sl = D.seg_sum128(ah, al, valid, gid, 8)
+    got = from_limbs(sh, sl)[:5]
+    want = [sum(v for v, g, ok in zip(a, np.asarray(gid),
+                                      np.asarray(valid))
+                if g == i and ok) for i in range(5)]
+    assert got == want
+
+
+def test_sum_avg_needs_128(dec_path):
+    """sum(decimal(12,2)) -> decimal(22,2): the buffer is DECIMAL128
+    through the shuffle."""
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.read.parquet(dec_path).groupBy("k")
+        .agg(F.sum("price").alias("s"), F.avg("price").alias("a")),
+        conf={"spark.sql.shuffle.partitions": 4})
+
+
+def test_wide_input_aggregates(dec_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.read.parquet(dec_path).groupBy("k")
+        .agg(F.sum("wide").alias("s"), F.min("wide").alias("mn"),
+             F.max("wide").alias("mx"), F.avg("wide").alias("a"),
+             F.count("wide").alias("c")))
+
+
+def test_wide_arithmetic_and_casts(dec_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.read.parquet(dec_path)
+        .select("k",
+                (F.col("wide") + F.col("wide")).alias("add"),
+                (F.col("wide") - F.lit(1)).alias("sub"),
+                (F.col("price") * F.col("price")).alias("mul128"),
+                F.col("wide").cast("string").alias("s"),
+                F.col("wide").cast("decimal(12,1)").alias("narrow"),
+                F.col("wide").cast("long").alias("l"),
+                F.abs(F.col("wide")).alias("ab"),
+                (-F.col("wide")).alias("neg")))
+
+
+def test_wide_sort(dec_path):
+    def q(spark):
+        return (spark.read.parquet(dec_path)
+                .orderBy(F.col("wide").desc()).limit(20)
+                .collect_arrow())
+
+    tpu = with_tpu_session(q)
+    vals = [v for v in tpu.column("wide").to_pylist() if v is not None]
+    assert vals == sorted(vals, reverse=True)
+
+
+def test_global_wide_sum(dec_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.read.parquet(dec_path)
+        .agg(F.sum("wide").alias("s"), F.avg("price").alias("a")))
+
+
+def test_wide_key_falls_back(dec_path):
+    """decimal(>18) grouping keys have no device hash: CPU placement,
+    same result."""
+    from spark_rapids_tpu.testing.asserts import (
+        assert_tpu_fallback_collect,
+    )
+
+    assert_tpu_fallback_collect(
+        lambda spark: spark.read.parquet(dec_path).groupBy("wide")
+        .agg(F.count("*").alias("c")),
+        fallback_class="CpuHashAggregateExec")
+
+
+def test_filter_on_wide_comparison(dec_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.read.parquet(dec_path)
+        .filter(F.col("wide") > 0).groupBy("k")
+        .agg(F.count("*").alias("c")))
