@@ -34,3 +34,6 @@ _jax.config.update("jax_enable_x64", True)
 __version__ = "0.1.0"
 
 from spark_rapids_tpu.api.session import TpuSparkSession  # noqa: E402,F401
+from spark_rapids_tpu.explain import (  # noqa: E402,F401
+    explain_potential_tpu_plan,
+)

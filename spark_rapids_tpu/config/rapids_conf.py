@@ -69,6 +69,28 @@ def conf(key, default, doc, conf_type=str, **kw) -> ConfEntry:
 # --- Core entries (names follow the reference's spark.rapids.* namespace,
 # --- re-rooted at spark.rapids.tpu where TPU-specific). ---
 
+OPTIMIZER_ENABLED = conf(
+    "spark.rapids.sql.optimizer.enabled", False,
+    "Enable the cost-based optimizer: revert device subtrees whose "
+    "estimated compute benefit does not cover the host<->device "
+    "transfer cost (reference CostBasedOptimizer).", bool)
+OPTIMIZER_CPU_ROW_COST = conf(
+    "spark.rapids.sql.optimizer.cpuRowCost", 1.0,
+    "Relative per-row cost of evaluating one operator on the CPU "
+    "backend (cost-based optimizer).", float)
+OPTIMIZER_TPU_ROW_COST = conf(
+    "spark.rapids.sql.optimizer.tpuRowCost", 0.02,
+    "Relative per-row cost of evaluating one operator on the device "
+    "(cost-based optimizer).", float)
+OPTIMIZER_TRANSFER_ROW_COST = conf(
+    "spark.rapids.sql.optimizer.transferRowCost", 1.0,
+    "Relative cost of moving one row across the host<->device "
+    "boundary (covers Arrow conversion + H2D/D2H copy).", float)
+OPTIMIZER_OP_OVERHEAD = conf(
+    "spark.rapids.sql.optimizer.deviceOpOverhead", 1000.0,
+    "Fixed row-equivalent cost per device operator (kernel dispatch + "
+    "compile-cache pressure) — makes tiny inputs stay on CPU.", float)
+
 SQL_ENABLED = conf(
     "spark.rapids.sql.enabled", True,
     "Enable plan rewriting onto the TPU columnar engine.", bool)

@@ -1,0 +1,30 @@
+"""Public explain API — the ExplainPlan analog.
+
+Reference: `explainPotentialGpuPlan` (GpuOverrides.scala:4500-4525) and
+the `com.nvidia.spark.rapids.ExplainPlan` entry point let users ask,
+WITHOUT device hardware or execution, how a plan would be placed. Same
+surface here: pass any DataFrame, get the placement report string.
+"""
+
+from __future__ import annotations
+
+
+def explain_potential_tpu_plan(df, mode: str = "ALL") -> str:
+    """Tag `df`'s plan and report would-be device placement without
+    executing it.
+
+    mode="ALL" reports every operator with its placement;
+    mode="NOT_ON_TPU" reports only operators kept on CPU and why.
+    """
+    assert mode in ("ALL", "NOT_ON_TPU"), mode
+    from spark_rapids_tpu.plan.optimizer import optimize
+    from spark_rapids_tpu.plan.overrides import TpuOverrides
+
+    ov = TpuOverrides(df.session.rapids_conf)
+    meta = ov.tag(optimize(df._plan))
+    from spark_rapids_tpu.plan import cbo
+
+    if df.session.rapids_conf.get(cbo.OPTIMIZER_ENABLED):
+        cbo.apply_cbo(meta, df.session.rapids_conf)
+    txt = meta.explain(only_not_on_device=(mode == "NOT_ON_TPU"))
+    return txt or "(every operator runs on device)"

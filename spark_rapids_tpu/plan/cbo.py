@@ -1,0 +1,177 @@
+"""Cost-based optimizer — decides whether device placement is worth the
+host<->device transfers (reference CostBasedOptimizer.scala:54 +
+MemoryCostHelper :240-249; off by default there and here,
+spark.rapids.sql.optimizer.enabled).
+
+Model (own design, sized for this engine):
+1. Estimate output rows per logical node bottom-up (parquet footers
+   give exact scan counts; standard selectivity heuristics elsewhere).
+2. For every maximal device-placed subtree, compare
+     benefit = sum(rows_i * (cpu_row_cost - tpu_row_cost))
+   against
+     cost = boundary_rows * transfer_row_cost
+   (both boundaries: upload at the leaves of the subtree that consume
+   host data, download where a CPU parent consumes its output).
+3. When cost >= benefit the whole subtree is tagged back to CPU with a
+   cost-model reason — small inputs never pay for the PCIe/ICI hop.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from spark_rapids_tpu.config import rapids_conf as rc
+from spark_rapids_tpu.config.rapids_conf import (
+    OPTIMIZER_CPU_ROW_COST as CPU_ROW_COST,
+    OPTIMIZER_ENABLED as OPTIMIZER_ENABLED,
+    OPTIMIZER_OP_OVERHEAD as OP_OVERHEAD,
+    OPTIMIZER_TPU_ROW_COST as TPU_ROW_COST,
+    OPTIMIZER_TRANSFER_ROW_COST as TRANSFER_ROW_COST,
+)
+from spark_rapids_tpu.plan import logical as L
+
+
+def estimate_rows(node: L.LogicalPlan,
+                  cache: Optional[Dict[int, float]] = None) -> float:
+    """Bottom-up cardinality estimate (CostBasedOptimizer's
+    RowCountPlanVisitor role)."""
+    if cache is None:
+        cache = {}
+    key = id(node)
+    if key in cache:
+        return cache[key]
+    kids = [estimate_rows(c, cache) for c in node.children]
+    n = _estimate(node, kids)
+    cache[key] = n
+    return n
+
+
+def _scan_rows(node: L.FileScan) -> float:
+    from spark_rapids_tpu.io.readers import expand_paths
+
+    try:
+        files = expand_paths(node.paths, "." + node.fmt)
+    except Exception:
+        files = list(node.paths)
+    if node.fmt == "parquet":
+        try:
+            import pyarrow.parquet as pq
+
+            return float(sum(pq.ParquetFile(f).metadata.num_rows
+                             for f in files))
+        except Exception:
+            pass
+    # non-parquet: rough 1 row / 64 bytes of file
+    try:
+        import os
+
+        return sum(os.path.getsize(f) for f in files
+                   if os.path.isfile(f)) / 64.0
+    except Exception:
+        return 1e6
+
+
+def _estimate(node: L.LogicalPlan, kids) -> float:
+    child = kids[0] if kids else 0.0
+    if isinstance(node, L.FileScan):
+        return _scan_rows(node)
+    if isinstance(node, L.LocalRelation):
+        return float(getattr(node.table, "num_rows", 1000))
+    if isinstance(node, L.Range):
+        step = node.step or 1
+        return max(1.0, (node.end - node.start) / step)
+    if isinstance(node, L.Filter):
+        return child * 0.5
+    if isinstance(node, L.Sample):
+        return child * min(node.fraction, 1.0)
+    if isinstance(node, L.Limit):
+        return min(float(node.n), child)
+    if isinstance(node, L.Aggregate):
+        if not node.grouping:
+            return 1.0
+        return max(1.0, child / 2.0)
+    if isinstance(node, L.Join):
+        left, right = kids
+        how = node.join_type
+        if how in ("left_semi", "left_anti"):
+            return left * 0.5
+        if how == "cross":
+            return left * right
+        return max(left, right)
+    if isinstance(node, L.Expand):
+        return child * len(node.projections)
+    if isinstance(node, L.Generate):
+        return child * 4.0  # average explode fan-out guess
+    if isinstance(node, L.Union):
+        return float(sum(kids))
+    return child  # Project/Sort/Window/Repartition keep cardinality
+
+
+def apply_cbo(root_meta, conf: rc.RapidsConf) -> int:
+    """Walk the tagged meta tree; revert device subtrees that do not
+    pay for their transfers. Returns the number of nodes reverted."""
+    cpu_c = conf.get(CPU_ROW_COST)
+    tpu_c = conf.get(TPU_ROW_COST)
+    xfer_c = conf.get(TRANSFER_ROW_COST)
+    op_c = conf.get(OP_OVERHEAD)
+    rows_cache: Dict[int, float] = {}
+    reverted = 0
+
+    def subtree_stats(meta, parent_on_device: bool):
+        """(benefit, transfer_rows, n_ops) for the maximal device
+        subtree rooted at meta; recurses independently into CPU
+        children."""
+        rows = estimate_rows(meta.node, rows_cache)
+        benefit = rows * (cpu_c - tpu_c)
+        transfer = 0.0 if parent_on_device else rows  # download edge
+        n_ops = 1
+        if not meta.children:
+            # device leaf (scan/local data): host bytes must be
+            # uploaded for it to run on device
+            transfer += rows
+        for c in meta.children:
+            if c.can_run_on_device:
+                b, t, k = subtree_stats(c, True)
+                benefit += b
+                transfer += t
+                n_ops += k
+            else:
+                # upload edge from a host child
+                transfer += estimate_rows(c.node, rows_cache)
+                walk(c)  # evaluate device subtrees further down
+        return benefit, transfer, n_ops
+
+    def revert(meta, reason):
+        # CPU children were already walked by subtree_stats; only the
+        # device subtree flips
+        nonlocal reverted
+        if meta.can_run_on_device:
+            meta.cannot_run(reason)
+            reverted += 1
+        for c in meta.children:
+            if c.can_run_on_device:
+                revert(c, reason)
+
+    def walk(meta):
+        """Find maximal device subtrees under a CPU node."""
+        for c in meta.children:
+            if c.can_run_on_device:
+                decide(c)
+            else:
+                walk(c)
+
+    def decide(meta):
+        benefit, transfer, n_ops = subtree_stats(
+            meta, parent_on_device=False)
+        cost = transfer * xfer_c + n_ops * op_c
+        if cost >= benefit:
+            revert(meta, (
+                f"cost-based optimizer: transfer+dispatch cost "
+                f"{cost:.0f} >= device benefit {benefit:.0f} "
+                f"(~{transfer:.0f} boundary rows, {n_ops} ops)"))
+
+    if root_meta.can_run_on_device:
+        decide(root_meta)
+    else:
+        walk(root_meta)
+    return reverted

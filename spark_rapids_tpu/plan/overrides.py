@@ -220,6 +220,10 @@ class TpuOverrides:
 
     def apply(self, plan: L.LogicalPlan) -> Tuple[PhysicalPlan, PlanMeta]:
         meta = self.tag(plan)
+        from spark_rapids_tpu.plan import cbo
+
+        if self.conf.get(cbo.OPTIMIZER_ENABLED):
+            cbo.apply_cbo(meta, self.conf)
         phys = self._convert(meta)
         explain_mode = self.conf.get(rc.EXPLAIN)
         if explain_mode != "NONE":
@@ -327,10 +331,10 @@ class TpuOverrides:
         if isinstance(node, L.Repartition):
             child = children[0]
             keys = node.keys
-            if child.is_tpu or keys is not None:
+            if on_device and (child.is_tpu or keys is not None):
                 return ops.TpuShuffleExchangeExec(
                     self._to_device(child), keys, node.num_partitions, conf)
-            return ops.CpuShuffleExchangeExec(child, keys,
+            return ops.CpuShuffleExchangeExec(self._to_host(child), keys,
                                               node.num_partitions, conf)
         raise NotImplementedError(f"logical node {type(node).__name__}")
 

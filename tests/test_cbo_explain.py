@@ -1,0 +1,109 @@
+"""Cost-based optimizer (reference CostBasedOptimizer.scala) and the
+public explain API (explainPotentialGpuPlan, GpuOverrides.scala:4500)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu import explain_potential_tpu_plan
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.testing.asserts import with_tpu_session
+
+
+@pytest.fixture(scope="module")
+def small_big(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cbo")
+    rng = np.random.default_rng(3)
+    small = pa.table({"k": pa.array(rng.integers(0, 5, 50)),
+                      "v": pa.array(rng.random(50))})
+    big = pa.table({"k": pa.array(rng.integers(0, 5, 200_000)),
+                    "v": pa.array(rng.random(200_000))})
+    ps, pb = str(d / "small.parquet"), str(d / "big.parquet")
+    pq.write_table(small, ps)
+    pq.write_table(big, pb)
+    return ps, pb
+
+
+def _placement(spark, df):
+    phys, meta = df._physical()
+    names = []
+
+    def walk(p):
+        names.append(type(p).__name__)
+        for c in p.children:
+            walk(c)
+
+    walk(phys)
+    return names
+
+
+def test_cbo_reverts_tiny_input(small_big):
+    ps, _ = small_big
+
+    def q(spark):
+        df = (spark.read.parquet(ps).filter(F.col("v") > 0.1)
+              .groupBy("k").agg(F.sum("v").alias("s")))
+        return _placement(spark, df)
+
+    on = with_tpu_session(
+        q, conf={"spark.rapids.sql.optimizer.enabled": True})
+    off = with_tpu_session(q)
+    # 50 rows never pay for the transfer: everything reverts to CPU
+    assert any(n.startswith("Cpu") for n in on)
+    assert not any(n.startswith("Tpu") for n in on), on
+    assert any(n.startswith("Tpu") for n in off)
+
+
+def test_cbo_keeps_large_input(small_big):
+    _, pb = small_big
+
+    def q(spark):
+        df = (spark.read.parquet(pb).filter(F.col("v") > 0.1)
+              .groupBy("k").agg(F.sum("v").alias("s")))
+        return _placement(spark, df)
+
+    on = with_tpu_session(
+        q, conf={"spark.rapids.sql.optimizer.enabled": True})
+    assert any(n.startswith("Tpu") for n in on), on
+
+
+def test_cbo_results_still_correct(small_big):
+    ps, _ = small_big
+    from spark_rapids_tpu.testing.asserts import (
+        assert_tpu_and_cpu_are_equal_collect,
+    )
+
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.read.parquet(ps).groupBy("k")
+        .agg(F.sum("v").alias("s")),
+        conf={"spark.rapids.sql.optimizer.enabled": True})
+
+
+def test_explain_potential_plan(small_big):
+    _, pb = small_big
+
+    def q(spark):
+        df = (spark.read.parquet(pb)
+              .select(F.col("v").cast("string").alias("s"),
+                      F.date_format(F.current_timestamp(),
+                                    "EEE yyyy").alias("bad"))
+              .limit(5))
+        return explain_potential_tpu_plan(df, "NOT_ON_TPU"), \
+            explain_potential_tpu_plan(df, "ALL")
+
+    not_on, full = with_tpu_session(q)
+    assert "NOT_ON_TPU" in not_on
+    assert "date_format" in not_on
+    assert "Limit" in full
+
+
+def test_explain_all_device(small_big):
+    _, pb = small_big
+
+    def q(spark):
+        return explain_potential_tpu_plan(
+            spark.read.parquet(pb).filter(F.col("v") > 0.5), "NOT_ON_TPU")
+
+    out = with_tpu_session(q)
+    assert out == "(every operator runs on device)"
