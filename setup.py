@@ -1,0 +1,56 @@
+"""Builds the native host runtime into the wheel.
+
+The reference ships its native code as a prebuilt Maven artifact
+(spark-rapids-jni bundling libcudf, pom.xml:904-911); here the C++
+host runtime (wire-format pack, spark-exact hashing, row transpose,
+host buffer pool) compiles at package build time and lands next to the
+python package so `spark_rapids_tpu.native` loads it without a
+toolchain at runtime. A missing/failed toolchain is NOT an install
+error: the runtime falls back to building from source at first use,
+and then to pure-python (native/__init__.py)."""
+
+import os
+import shutil
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNative(build_py):
+    def run(self):
+        here = os.path.dirname(os.path.abspath(__file__))
+        # load the native module FILE directly: importing the package
+        # would pull in jax, which need not exist in the build env
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "_srtpu_native_build",
+            os.path.join(here, "spark_rapids_tpu", "native",
+                         "__init__.py"))
+        native_mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(native_mod)
+        compile_runtime = native_mod.compile_runtime
+
+        src = os.path.join(here, "native", "sparktpu_runtime.cpp")
+        out_dir = os.path.join(here, "native", "build")
+        so = os.path.join(out_dir, "libsparktpu.so")
+        built = None
+        if os.path.exists(src):
+            os.makedirs(out_dir, exist_ok=True)
+            # portable flags for a distributable wheel
+            built = compile_runtime(src, so, timeout=300,
+                                    native_arch=False)
+            if built is None:
+                print("warning: native runtime not built "
+                      "(toolchain missing?); wheel ships pure-python "
+                      "with on-demand build fallback")
+        super().run()
+        if built:
+            pkg_native = os.path.join(self.build_lib,
+                                      "spark_rapids_tpu", "native")
+            os.makedirs(pkg_native, exist_ok=True)
+            shutil.copy2(built, os.path.join(pkg_native,
+                                             "libsparktpu.so"))
+
+
+setup(cmdclass={"build_py": BuildWithNative})

@@ -167,22 +167,17 @@ class TpuSparkSession:
             _active = self
 
     def _init_runtime(self):
-        """Executor-plugin init path (Plugin.scala:484-545 analog)."""
-        from spark_rapids_tpu.runtime import memory, semaphore
+        """Plugin lifecycle (Plugin.scala:412-545): driver init fixes
+        up/broadcasts the conf, executor init brings up the device
+        runtime. Standalone, both run here."""
+        from spark_rapids_tpu.plugin import (
+            TpuDriverPlugin,
+            executor_plugin,
+        )
 
-        memory.initialize_memory(self.rapids_conf, force=True)
-        semaphore.initialize(
-            self.rapids_conf.get(rc.CONCURRENT_TPU_TASKS))
-        from spark_rapids_tpu.shuffle.manager import configure_shuffle
-
-        configure_shuffle(
-            self.rapids_conf.get(rc.SHUFFLE_MODE),
-            shuffle_dir=self.rapids_conf.get(rc.SPILL_DIR) or None,
-            num_threads=self.rapids_conf.get(
-                rc.MULTITHREADED_READ_NUM_THREADS),
-            codec=self.rapids_conf.get(rc.SHUFFLE_COMPRESSION_CODEC),
-            spill_threshold=self.rapids_conf.get(
-                rc.SHUFFLE_SPILL_THRESHOLD))
+        self._conf_map = TpuDriverPlugin().init(self.rapids_conf)
+        self._executor_plugin = executor_plugin()
+        self._executor_plugin.init(self.rapids_conf)
 
     # --- conf ---
 

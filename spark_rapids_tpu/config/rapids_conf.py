@@ -69,6 +69,14 @@ def conf(key, default, doc, conf_type=str, **kw) -> ConfEntry:
 # --- Core entries (names follow the reference's spark.rapids.* namespace,
 # --- re-rooted at spark.rapids.tpu where TPU-specific). ---
 
+FATAL_ERROR_EXIT = conf(
+    "spark.rapids.tpu.fatalErrorExitCode", 0,
+    "When > 0, a fatal device error (unrecoverable XLA runtime failure) "
+    "terminates the process with this exit code so an external "
+    "scheduler reschedules the executor elsewhere (the reference's "
+    "CudaFatalException exit-20 policy, Plugin.scala:651-675). 0 "
+    "propagates the exception instead.", int)
+
 OPTIMIZER_ENABLED = conf(
     "spark.rapids.sql.optimizer.enabled", False,
     "Enable the cost-based optimizer: revert device subtrees whose "

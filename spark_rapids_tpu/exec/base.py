@@ -83,6 +83,14 @@ class PhysicalPlan:
                             parts.append(device_to_arrow(payload))
                         else:
                             parts.append(payload)
+            except BaseException as exc:
+                # fatal-error policy (Plugin.scala:651-675 onTaskFailed):
+                # unrecoverable device failures may exit the process so
+                # the cluster manager reschedules this executor
+                from spark_rapids_tpu.plugin import executor_plugin
+
+                executor_plugin().on_task_failed(exc)
+                raise
             finally:
                 sem.get().release_if_necessary(task_id)
             if parts:

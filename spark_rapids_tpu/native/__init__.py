@@ -31,7 +31,34 @@ i64p = ctypes.POINTER(ctypes.c_int64)
 u64p = ctypes.POINTER(ctypes.c_uint64)
 
 
+def compile_runtime(src: str, out_so: str, timeout: int = 120,
+                    native_arch: bool = True) -> Optional[str]:
+    """THE compile command for the native runtime — shared by the
+    import-time builder, setup.py, and tools/package_dist so flags
+    cannot drift. Returns the .so path or None (toolchain missing /
+    compile failure); never raises."""
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC"]
+    if native_arch:
+        cmd.append("-march=native")
+    cmd += [src, "-o", out_so]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True,
+                       timeout=timeout)
+        return out_so
+    except (subprocess.SubprocessError, OSError):
+        if native_arch:
+            # retry without -march=native (portability)
+            return compile_runtime(src, out_so, timeout,
+                                   native_arch=False)
+        return None
+
+
 def _build() -> Optional[str]:
+    # prebuilt library shipped inside the wheel (setup.py build_py)
+    packaged = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "libsparktpu.so")
+    if os.path.exists(packaged):
+        return packaged
     try:
         os.makedirs(_OUT_DIR, exist_ok=True)
         if os.path.exists(_SO) and (
@@ -42,20 +69,7 @@ def _build() -> Optional[str]:
             return None
     except OSError:
         return None
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-           "-march=native", _SRC, "-o", _SO]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        return _SO
-    except (subprocess.SubprocessError, OSError):
-        # retry without -march=native (portability)
-        try:
-            cmd.remove("-march=native")
-            subprocess.run(cmd, check=True, capture_output=True,
-                           timeout=120)
-            return _SO
-        except (subprocess.SubprocessError, OSError):
-            return None
+    return compile_runtime(_SRC, _SO)
 
 
 def _declare(lib):

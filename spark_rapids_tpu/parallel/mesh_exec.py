@@ -33,11 +33,13 @@ AXIS = "data"
 
 
 def make_mesh(n_devices: int) -> Mesh:
+    from spark_rapids_tpu.shims import get_shim
+
     devs = jax.devices()[:n_devices]
     if len(devs) < n_devices:
         raise RuntimeError(
             f"need {n_devices} devices, have {len(jax.devices())}")
-    return Mesh(np.array(devs), (AXIS,))
+    return get_shim().make_mesh(devs, AXIS)
 
 
 def shard_batch(mesh: Mesh, batch: ColumnBatch) -> ColumnBatch:
@@ -110,7 +112,7 @@ def make_distributed_agg(mesh: Mesh, template: ColumnBatch,
                           jnp.asarray(out.num_rows, jnp.int32).reshape(1))
         return out, overflow.reshape(1)
 
-    from jax import shard_map
+    from spark_rapids_tpu.shims import get_shim
 
     local_template = _local_view(template, n)
     out_shape = jax.eval_shape(
@@ -118,8 +120,7 @@ def make_distributed_agg(mesh: Mesh, template: ColumnBatch,
         local_template)
     in_specs = input_batch_specs(template, P(AXIS))
     out_specs = (batch_specs(out_shape, P(AXIS)), P(AXIS))
-    smapped = shard_map(step, mesh=mesh, in_specs=(in_specs,),
-                        out_specs=out_specs, check_vma=False)
+    smapped = get_shim().shard_map(step, mesh, (in_specs,), out_specs)
     jitted = jax.jit(smapped)
 
     def run(sharded_batch: ColumnBatch) -> ColumnBatch:

@@ -459,9 +459,8 @@ class MeshQueryExecutor:
                 jnp.asarray(out.num_rows, jnp.int32).reshape(1))
             return out, overflow.reshape(1)
 
-        from jax import shard_map
-
         from spark_rapids_tpu.runtime.jit_cache import cached_jit
+        from spark_rapids_tpu.shims import get_shim
 
         shape_key = tuple(
             tuple((tuple(c.data.shape), str(c.data.dtype))
@@ -470,10 +469,10 @@ class MeshQueryExecutor:
         key = ("mesh_plan", _plan_key(phys), n, expansion, shape_key)
         jitted = cached_jit(
             key,
-            lambda: shard_map(step, mesh=self.mesh,
-                              in_specs=tuple(P(AXIS) for _ in sharded),
-                              out_specs=(P(AXIS), P(AXIS)),
-                              check_vma=False))
+            lambda: get_shim().shard_map(
+                step, self.mesh,
+                tuple(P(AXIS) for _ in sharded),
+                (P(AXIS), P(AXIS))))
         out, ovf = jitted(*sharded)
         jax.block_until_ready(jax.tree_util.tree_leaves(out))
         if bool(np.asarray(jax.device_get(ovf)).any()):

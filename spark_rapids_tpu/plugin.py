@@ -1,0 +1,132 @@
+"""Plugin lifecycle — the SQLPlugin / RapidsDriverPlugin /
+RapidsExecutorPlugin surface (reference: sql-plugin-api SQLPlugin.scala,
+Plugin.scala:412-684, ColumnarOverrideRules Plugin.scala:49-56).
+
+Standalone, the session owns the process, so the "driver" and
+"executor" hooks both run inside TpuSparkSession construction — but the
+lifecycle is factored exactly like the reference so an embedding
+framework (or a future multi-process deployment) can drive the hooks
+itself:
+
+- TpuDriverPlugin.init: validate/fix up the conf, produce the conf map
+  to broadcast to executors (Plugin.scala:439-464).
+- TpuExecutorPlugin.init: validate the device, initialize the memory
+  pool + spill catalog, shuffle env, and semaphore
+  (Plugin.scala:484-545), and install the fatal-error policy.
+- ColumnarOverrideRules: the rule objects a planner integration would
+  inject (pre = TpuOverrides, post = transition insertion — both are
+  applied by plan_query here).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, Optional
+
+from spark_rapids_tpu.config import rapids_conf as rc
+from spark_rapids_tpu.config.rapids_conf import FATAL_ERROR_EXIT
+
+
+class TpuDriverPlugin:
+    """Driver-side init: conf validation + broadcastable conf map."""
+
+    def init(self, conf: rc.RapidsConf) -> Dict[str, object]:
+        unknown = getattr(conf, "unknown_keys", [])
+        bad = [k for k in unknown if k.startswith("spark.rapids")]
+        if bad:
+            import warnings
+
+            warnings.warn(
+                f"unknown spark.rapids.* conf keys ignored: {sorted(bad)}")
+        # the executor-broadcast conf map (RapidsConf.rapidsConfMap role)
+        return {k: v for k, v in conf._values.items()}
+
+
+class TpuExecutorPlugin:
+    """Executor-side init (Plugin.scala:484-545 analog)."""
+
+    def __init__(self):
+        self.initialized = False
+
+    def init(self, conf: rc.RapidsConf):
+        from spark_rapids_tpu.runtime import memory, semaphore
+        from spark_rapids_tpu.shuffle.manager import configure_shuffle
+
+        self._validate_device()
+        memory.initialize_memory(conf, force=True)
+        semaphore.initialize(conf.get(rc.CONCURRENT_TPU_TASKS))
+        configure_shuffle(
+            conf.get(rc.SHUFFLE_MODE),
+            shuffle_dir=conf.get(rc.SPILL_DIR) or None,
+            num_threads=conf.get(rc.MULTITHREADED_READ_NUM_THREADS),
+            codec=conf.get(rc.SHUFFLE_COMPRESSION_CODEC),
+            spill_threshold=conf.get(rc.SHUFFLE_SPILL_THRESHOLD))
+        self._fatal_exit_code = conf.get(FATAL_ERROR_EXIT)
+        self.initialized = True
+
+    def _validate_device(self):
+        """Device/arch validation (validateGpuArchitecture role): jax
+        must initialize and expose at least one device."""
+        import jax
+
+        devs = jax.devices()
+        if not devs:
+            raise RuntimeError("no jax devices available")
+
+    def on_task_failed(self, exc: BaseException) -> bool:
+        """Fatal-error policy (Plugin.scala:651-675): unrecoverable
+        device/runtime failures optionally kill the process so the
+        cluster manager reschedules. Returns True when the error is
+        classified fatal."""
+        fatal = _is_fatal_device_error(exc)
+        if fatal and getattr(self, "_fatal_exit_code", 0):
+            sys.stderr.write(
+                f"fatal device error, exiting "
+                f"{self._fatal_exit_code}: {exc}\n")
+            sys.stderr.flush()
+            sys.exit(self._fatal_exit_code)
+        return fatal
+
+    def shutdown(self):
+        from spark_rapids_tpu.runtime import memory
+
+        memory.shutdown_memory()
+
+
+def _is_fatal_device_error(exc: BaseException) -> bool:
+    """Classify unrecoverable device failures (the CudaFatalException
+    analog): XLA runtime INTERNAL/device-lost errors, not OOM/compile
+    issues the retry framework handles."""
+    name = type(exc).__name__
+    msg = str(exc)
+    if name == "XlaRuntimeError":
+        return any(tag in msg for tag in
+                   ("INTERNAL:", "device lost", "DEVICE_LOST",
+                    "hardware", "halted"))
+    return False
+
+
+class ColumnarOverrideRules:
+    """The rule pair a planner integration injects (ColumnarOverrideRules
+    Plugin.scala:49-56). `pre` tags + converts, `post` is the transition
+    insertion — both run inside plan_query for the standalone engine."""
+
+    def pre_columnar_transitions(self, conf: rc.RapidsConf):
+        from spark_rapids_tpu.plan.overrides import TpuOverrides
+
+        return TpuOverrides(conf)
+
+    def post_columnar_transitions(self, conf: rc.RapidsConf):
+        # transition insertion lives inside TpuOverrides._convert
+        # (_to_device/_to_host); exposed for API parity
+        return None
+
+
+_executor_plugin: Optional[TpuExecutorPlugin] = None
+
+
+def executor_plugin() -> TpuExecutorPlugin:
+    global _executor_plugin
+    if _executor_plugin is None:
+        _executor_plugin = TpuExecutorPlugin()
+    return _executor_plugin

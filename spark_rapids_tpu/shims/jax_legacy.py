@@ -1,0 +1,28 @@
+"""Shim world for jax 0.4.x / 0.5.x: `jax.experimental.shard_map`
+with the pre-rename `check_rep` flag."""
+
+from __future__ import annotations
+
+VERSIONS = ("0.4", "0.5")
+
+
+def matches(version: str) -> bool:
+    return version.startswith(VERSIONS)
+
+
+def description() -> str:
+    return "jax.experimental.shard_map world (jax 0.4-0.5)"
+
+
+def shard_map(fn, mesh, in_specs, out_specs, check: bool = False):
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check)
+
+
+def make_mesh(devices, axis_name: str):
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(devices), (axis_name,))

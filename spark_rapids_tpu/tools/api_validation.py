@@ -1,0 +1,83 @@
+"""API validation — the api_validation/ApiValidation.scala analog.
+
+The reference audits constructor-signature drift between CPU execs and
+their Gpu counterparts across every shim. Here two drift surfaces
+matter:
+1. shim worlds: every provider in spark_rapids_tpu.shims must export
+   the identical API (names + call signatures), or a jax upgrade would
+   silently change engine behavior per environment;
+2. device/CPU operator pairs: every Tpu*Exec with a Cpu* sibling must
+   agree on the leading constructor parameters the planner passes.
+
+Run: python -m spark_rapids_tpu.tools.api_validation  (exit 1 on drift)
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+from typing import List
+
+
+def validate_shims() -> List[str]:
+    from spark_rapids_tpu import shims
+
+    problems = []
+    mods = [importlib.import_module(n) for n in shims._PROVIDERS]
+    for mod in mods:
+        for name in shims.SHIM_API:
+            if not hasattr(mod, name):
+                problems.append(f"{mod.__name__} missing {name}")
+    # signatures must agree across worlds
+    for name in shims.SHIM_API:
+        sigs = {}
+        for mod in mods:
+            obj = getattr(mod, name, None)
+            if callable(obj):
+                sigs[mod.__name__] = str(inspect.signature(obj))
+        if len(set(sigs.values())) > 1:
+            problems.append(f"shim API {name} signature drift: {sigs}")
+    return problems
+
+
+def validate_operator_pairs() -> List[str]:
+    """Tpu*Exec vs Cpu*Exec constructor-prefix agreement (CpuSampleExec
+    legitimately adds with_replacement; extra trailing params are
+    allowed, renamed/reordered shared ones are not)."""
+    from spark_rapids_tpu.exec import operators as ops
+
+    problems = []
+    names = dir(ops)
+    for n in names:
+        if not n.startswith("Tpu") or not n.endswith("Exec"):
+            continue
+        sibling = "Cpu" + n[3:]
+        if sibling not in names:
+            continue
+        tsig = list(inspect.signature(
+            getattr(ops, n).__init__).parameters)[1:]
+        csig = list(inspect.signature(
+            getattr(ops, sibling).__init__).parameters)[1:]
+        shared = [p for p in tsig if p in csig]
+        t_order = [p for p in tsig if p in shared]
+        c_order = [p for p in csig if p in shared]
+        if t_order != c_order:
+            problems.append(
+                f"{n}/{sibling}: shared ctor params ordered "
+                f"{t_order} vs {c_order}")
+        if not shared:
+            problems.append(f"{n}/{sibling}: no shared ctor params")
+    return problems
+
+
+def main() -> int:
+    problems = validate_shims() + validate_operator_pairs()
+    for p in problems:
+        print("DRIFT:", p)
+    if not problems:
+        print("api validation: OK")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
