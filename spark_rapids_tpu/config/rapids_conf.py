@@ -69,6 +69,37 @@ def conf(key, default, doc, conf_type=str, **kw) -> ConfEntry:
 # --- Core entries (names follow the reference's spark.rapids.* namespace,
 # --- re-rooted at spark.rapids.tpu where TPU-specific). ---
 
+FILECACHE_ENABLED = conf(
+    "spark.rapids.filecache.enabled", False,
+    "Cache remote input files on local disk (FileCache role). Local "
+    "paths are unaffected.", bool)
+FILECACHE_PATH = conf(
+    "spark.rapids.filecache.path", "",
+    "Cache directory (default: <tmp>/srtpu_filecache).", str)
+FILECACHE_MAX_BYTES = conf(
+    "spark.rapids.filecache.maxBytes", 10 << 30,
+    "Byte budget for the local file cache; least-recently-used entries "
+    "evict past it.", int)
+ALLUXIO_REPLACE = conf(
+    "spark.rapids.alluxio.pathsToReplace", "",
+    "Semicolon-separated 'srcPrefix->dstPrefix' scan-path rewrite "
+    "rules (AlluxioUtils role).", str)
+ALLUXIO_AUTOMOUNT_REGEX = conf(
+    "spark.rapids.alluxio.automount.regex", "",
+    "Regex over 'scheme://bucket'; matching scan paths rewrite to "
+    "alluxio://<master>/<bucket>/<rest>.", str)
+ALLUXIO_MASTER = conf(
+    "spark.rapids.alluxio.master", "",
+    "alluxio master host:port for automount rewriting.", str)
+HEARTBEAT_INTERVAL_MS = conf(
+    "spark.rapids.shuffle.heartbeat.intervalMs", 5000,
+    "Executor->driver heartbeat interval (RapidsShuffleHeartbeatManager "
+    "role).", int)
+HEARTBEAT_TIMEOUT_MS = conf(
+    "spark.rapids.shuffle.heartbeat.timeoutMs", 30000,
+    "Driver prunes executors whose last heartbeat is older than this.",
+    int)
+
 FATAL_ERROR_EXIT = conf(
     "spark.rapids.tpu.fatalErrorExitCode", 0,
     "When > 0, a fatal device error (unrecoverable XLA runtime failure) "

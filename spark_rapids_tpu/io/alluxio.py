@@ -1,0 +1,59 @@
+"""Alluxio-style path rewriting (reference AlluxioUtils.scala:74-397).
+
+The reference optionally rewrites `s3://bucket/...` scan paths to
+`alluxio://master:port/bucket/...` so reads hit the co-located cache
+cluster, with either an explicit replacement list or an auto-mount
+pattern. Same two modes here:
+
+- spark.rapids.alluxio.pathsToReplace: "src1->dst1;src2->dst2" exact
+  prefix replacement.
+- spark.rapids.alluxio.automount.regex + spark.rapids.alluxio.master:
+  any path whose scheme+bucket matches the regex rewrites to
+  alluxio://<master>/<bucket>/<rest>.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from spark_rapids_tpu.config import rapids_conf as rc
+from spark_rapids_tpu.config.rapids_conf import (  # noqa: F401
+    ALLUXIO_REPLACE,
+    ALLUXIO_AUTOMOUNT_REGEX,
+    ALLUXIO_MASTER,
+)
+
+
+
+def rewrite_paths(paths: List[str], conf: rc.RapidsConf) -> List[str]:
+    rules = []
+    raw = conf.get(ALLUXIO_REPLACE)
+    if raw:
+        for pair in raw.split(";"):
+            pair = pair.strip()
+            if not pair:
+                continue
+            if "->" not in pair:
+                raise ValueError(
+                    f"bad spark.rapids.alluxio.pathsToReplace rule "
+                    f"{pair!r} (want 'src->dst')")
+            src, dst = pair.split("->", 1)
+            rules.append((src.strip(), dst.strip()))
+    pattern = conf.get(ALLUXIO_AUTOMOUNT_REGEX)
+    master = conf.get(ALLUXIO_MASTER)
+    out = []
+    for p in paths:
+        replaced = p
+        for src, dst in rules:
+            if p.startswith(src):
+                replaced = dst + p[len(src):]
+                break
+        else:
+            if pattern and master:
+                m = re.match(r"^([a-z0-9]+)://([^/]+)/(.*)$", p)
+                if m and re.match(pattern, f"{m.group(1)}://{m.group(2)}"):
+                    replaced = (f"alluxio://{master}/{m.group(2)}/"
+                                f"{m.group(3)}")
+        out.append(replaced)
+    return out

@@ -1,0 +1,167 @@
+"""Local-disk file cache for remote inputs — the FileCache role.
+
+The reference caches remote parquet footers/data on executor-local
+disk (hooks Plugin.scala:419,458,545; usage GpuParquetScan.scala:
+523-539; core impl in the closed-source rapids-4-spark-private jar —
+this is an open implementation of the same idea).
+
+Remote paths (scheme://...) resolve through a pluggable filesystem SPI
+(`register_filesystem`) and land in a bounded local cache directory,
+keyed by (path, etag/mtime) with LRU byte-budget eviction. Local paths
+pass through untouched, so the readers call `localize_paths` on every
+scan unconditionally.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from typing import Callable, Dict, List, NamedTuple, Optional
+
+from spark_rapids_tpu.config import rapids_conf as rc
+from spark_rapids_tpu.config.rapids_conf import (  # noqa: F401
+    FILECACHE_ENABLED,
+    FILECACHE_PATH,
+    FILECACHE_MAX_BYTES,
+)
+
+
+
+class RemoteFile(NamedTuple):
+    """What a filesystem provider returns for stat()."""
+
+    size: int
+    etag: str  # version discriminator (mtime, hash, ...)
+
+
+class FileSystemProvider(NamedTuple):
+    stat: Callable[[str], RemoteFile]
+    read: Callable[[str], bytes]
+
+
+_filesystems: Dict[str, FileSystemProvider] = {}
+_lock = threading.Lock()
+
+
+def register_filesystem(scheme: str, stat: Callable[[str], RemoteFile],
+                        read: Callable[[str], bytes]):
+    """Plug a remote filesystem (the ExternalSource/FileCache provider
+    SPI analog). `scheme` without '://'."""
+    _filesystems[scheme] = FileSystemProvider(stat, read)
+
+
+def _scheme_of(path: str) -> Optional[str]:
+    i = path.find("://")
+    return path[:i] if i > 0 else None
+
+
+class FileCache:
+    def __init__(self, conf: rc.RapidsConf):
+        self.enabled = conf.get(FILECACHE_ENABLED)
+        base = conf.get(FILECACHE_PATH)
+        if not base:
+            import tempfile
+
+            base = os.path.join(tempfile.gettempdir(), "srtpu_filecache")
+        self.base = base
+        self.max_bytes = conf.get(FILECACHE_MAX_BYTES)
+        self.hits = 0
+        self.misses = 0
+
+    def _entry_path(self, path: str, etag: str) -> str:
+        h = hashlib.sha1(f"{path}#{etag}".encode()).hexdigest()
+        base = os.path.basename(path.rstrip("/")) or "file"
+        return os.path.join(self.base, f"{h}-{base}")
+
+    def localize(self, path: str) -> str:
+        """Remote path -> local cached copy; local paths pass through."""
+        scheme = _scheme_of(path)
+        if scheme is None:
+            return path
+        fs = _filesystems.get(scheme)
+        if fs is None:
+            raise FileNotFoundError(
+                f"no filesystem registered for scheme {scheme!r} "
+                f"({path}); register_filesystem() or rewrite the path")
+        st = fs.stat(path)
+        local = self._entry_path(path, st.etag)
+        with _lock:
+            if os.path.exists(local):
+                os.utime(local)  # LRU touch
+                self.hits += 1
+                return local
+            self.misses += 1
+        data = fs.read(path)
+        os.makedirs(self.base, exist_ok=True)
+        tmp = f"{local}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, local)
+        self._evict(protect=local)
+        return local
+
+    def _evict(self, protect: Optional[str] = None):
+        """Drop least-recently-used entries past the byte budget; never
+        the entry being handed back to a reader (the budget is advisory
+        when one file alone exceeds it)."""
+        with _lock:
+            try:
+                entries = [
+                    (os.path.getatime(p), os.path.getsize(p), p)
+                    for p in (os.path.join(self.base, f)
+                              for f in os.listdir(self.base))
+                    if os.path.isfile(p) and ".tmp." not in p]
+            except OSError:
+                return
+            total = sum(s for _, s, _ in entries)
+            for _, size, p in sorted(entries):
+                if total <= self.max_bytes:
+                    break
+                if p == protect:
+                    continue
+                try:
+                    os.remove(p)
+                    total -= size
+                except OSError:
+                    pass
+
+
+_active: Optional[FileCache] = None
+
+
+def configure(conf: rc.RapidsConf):
+    global _active
+    _active = FileCache(conf)
+
+
+def get_cache() -> Optional[FileCache]:
+    return _active
+
+
+def localize_paths(paths: List[str]) -> List[str]:
+    """Reader chokepoint: rewrite remote paths to cached local copies.
+    Local paths pass through. A registered provider always localizes
+    (readers need local files); spark.rapids.filecache.enabled governs
+    RETENTION — disabled drops everything except the entry currently
+    being handed out (budget 0)."""
+    if not any(_scheme_of(p) for p in paths):
+        return list(paths)
+    cache = _active
+    if cache is None:
+        from spark_rapids_tpu.config import rapids_conf as rc
+
+        cache = FileCache(rc.RapidsConf({}))
+    if not cache.enabled:
+        import copy
+
+        cache = copy.copy(cache)
+        cache.max_bytes = 0
+    return [cache.localize(p) for p in paths]
+
+
+def stamp_mtime_etag(path: str) -> RemoteFile:
+    """Helper for providers backed by real files."""
+    st = os.stat(path)
+    return RemoteFile(st.st_size, f"{st.st_mtime_ns}")
+

@@ -43,9 +43,22 @@ def reader_thread_pool(num_threads: int = 8) -> ThreadPoolExecutor:
         return _pool
 
 
+def resolve_input_paths(paths: List[str]) -> List[str]:
+    """Scan-path resolution chokepoint: Alluxio-style prefix rewriting
+    (io/alluxio.py, AlluxioUtils role) then remote-file localization
+    through the local disk cache (io/filecache.py, FileCache role)."""
+    from spark_rapids_tpu.api.session import TpuSparkSession
+    from spark_rapids_tpu.io import alluxio, filecache
+
+    s = TpuSparkSession.active()
+    if s is not None:
+        paths = alluxio.rewrite_paths(list(paths), s.rapids_conf)
+    return filecache.localize_paths(paths)
+
+
 def expand_paths(paths: List[str], suffix: str) -> List[str]:
     out: List[str] = []
-    for p in paths:
+    for p in resolve_input_paths(paths):
         if os.path.isdir(p):
             out.extend(sorted(
                 f for f in globlib.glob(os.path.join(p, "**", "*"),

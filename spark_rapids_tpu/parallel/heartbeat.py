@@ -1,0 +1,212 @@
+"""Heartbeat control plane — the RapidsShuffleHeartbeatManager analog.
+
+Reference behavior (RapidsShuffleHeartbeatManager.scala + the driver
+plugin RPC, Plugin.scala:417-437): executors register with the driver
+on startup and heartbeat periodically; each heartbeat response carries
+the peers registered since the executor's last call, so every executor
+converges on the full topology for early shuffle-endpoint setup; the
+driver prunes executors whose heartbeats stop.
+
+Here the driver side is a tiny JSON-lines TCP server (stdlib only) and
+the executor side a daemon thread. On TPU pods the COLLECTIVE wiring is
+jax.distributed (parallel/multihost.py); this plane carries the
+host-side metadata the collectives do not: peer liveness for the
+shuffle/file-transfer services and early failure detection.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from spark_rapids_tpu.config import rapids_conf as rc
+from spark_rapids_tpu.config.rapids_conf import (  # noqa: F401
+    HEARTBEAT_INTERVAL_MS,
+    HEARTBEAT_TIMEOUT_MS,
+)
+
+
+
+class PeerInfo(dict):
+    """{executor_id, host, port, seq} — a dict so it moves through
+    JSON unchanged. `seq` is the monotone registration sequence the
+    incremental-discovery protocol keys on (prune-safe, unlike a
+    positional index)."""
+
+
+class HeartbeatManager:
+    """Driver-side registry + liveness pruning. Discovery protocol:
+    every registration gets a monotonically increasing `seq`; clients
+    track the highest seq they have seen and each heartbeat returns the
+    live peers with a higher seq. Prunes never move sequence numbers,
+    so discovery survives arbitrary death/registration interleavings;
+    a heartbeat from a pruned executor gets `reregister` back."""
+
+    def __init__(self, timeout_ms: int = 30000):
+        self._peers: Dict[str, PeerInfo] = {}
+        self._last_seen: Dict[str, float] = {}
+        self._seq = 0
+        self._lock = threading.Lock()
+        self.timeout_ms = timeout_ms
+
+    def register(self, executor_id: str, host: str, port: int):
+        with self._lock:
+            self._seq += 1
+            self._peers[executor_id] = PeerInfo(
+                executor_id=executor_id, host=host, port=port,
+                seq=self._seq)
+            self._last_seen[executor_id] = time.monotonic()
+            others = [p for e, p in self._peers.items()
+                      if e != executor_id]
+            return others, self._seq
+
+    def heartbeat(self, executor_id: str, last_seq: int):
+        """Record liveness; return (new live peers with seq > last_seq,
+        current max seq), or (None, _) when the executor was pruned and
+        must re-register."""
+        with self._lock:
+            if executor_id not in self._peers:
+                return None, self._seq
+            self._last_seen[executor_id] = time.monotonic()
+            self._prune_locked()
+            fresh = [p for e, p in self._peers.items()
+                     if e != executor_id and p["seq"] > last_seq]
+            return fresh, self._seq
+
+    def live_peers(self) -> List[PeerInfo]:
+        with self._lock:
+            self._prune_locked()
+            return list(self._peers.values())
+
+    def _prune_locked(self):
+        deadline = time.monotonic() - self.timeout_ms / 1000.0
+        dead = [e for e, ts in self._last_seen.items() if ts < deadline]
+        for e in dead:
+            self._peers.pop(e, None)
+            self._last_seen.pop(e, None)
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        mgr: HeartbeatManager = self.server.manager  # type: ignore
+        for line in self.rfile:
+            try:
+                msg = json.loads(line)
+                op = msg.get("op")
+                if op == "register":
+                    peers, seq = mgr.register(msg["executor_id"],
+                                              msg["host"], msg["port"])
+                    resp = {"peers": peers, "seq": seq}
+                elif op == "heartbeat":
+                    peers, seq = mgr.heartbeat(msg["executor_id"],
+                                               msg.get("seen", 0))
+                    if peers is None:
+                        resp = {"reregister": True, "seq": seq}
+                    else:
+                        resp = {"peers": peers, "seq": seq}
+                elif op == "peers":
+                    resp = {"peers": mgr.live_peers(),
+                            "seq": mgr._seq}
+                else:
+                    resp = {"peers": [], "seq": mgr._seq}
+            except Exception as e:  # malformed line: report, keep serving
+                resp = {"error": str(e)}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+class HeartbeatServer:
+    """Driver endpoint (Plugin.scala driver-plugin RPC receive)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 timeout_ms: int = 30000):
+        self.manager = HeartbeatManager(timeout_ms)
+        self._srv = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=True)
+        self._srv.daemon_threads = True
+        self._srv.manager = self.manager  # type: ignore
+        self.address = self._srv.server_address
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True,
+            name="srtpu-heartbeat-server")
+        self._thread.start()
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class HeartbeatClient:
+    """Executor side: register once, then heartbeat on a daemon thread;
+    `on_new_peers` fires with peers discovered since the last call
+    (the trigger for early shuffle endpoint setup)."""
+
+    def __init__(self, driver_addr, executor_id: str, host: str,
+                 port: int, interval_ms: int = 5000,
+                 on_new_peers: Optional[Callable] = None):
+        self.driver_addr = tuple(driver_addr)
+        self.executor_id = executor_id
+        self.host, self.port = host, port
+        self.interval_ms = interval_ms
+        self.on_new_peers = on_new_peers
+        self._peers_by_id: Dict[str, PeerInfo] = {}
+        self._seen = 0
+        self._stop = threading.Event()
+        self._sock = socket.create_connection(self.driver_addr,
+                                              timeout=10)
+        self._rfile = self._sock.makefile("r")
+        initial = self._call({"op": "register",
+                              "executor_id": executor_id,
+                              "host": host, "port": port})
+        self._absorb(initial)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"srtpu-hb-{executor_id}")
+        self._thread.start()
+
+    @property
+    def peers(self) -> List[PeerInfo]:
+        return list(self._peers_by_id.values())
+
+    def _call(self, msg) -> dict:
+        self._sock.sendall((json.dumps(msg) + "\n").encode())
+        return json.loads(self._rfile.readline())
+
+    def _absorb(self, resp: dict):
+        new = [p for p in resp.get("peers", [])
+               if self._peers_by_id.get(p["executor_id"], {}
+                                        ).get("seq") != p["seq"]]
+        for p in new:
+            self._peers_by_id[p["executor_id"]] = PeerInfo(p)
+        if new and self.on_new_peers:
+            self.on_new_peers(new)
+        self._seen = max(self._seen, resp.get("seq", self._seen))
+
+    def poke(self):
+        """One synchronous heartbeat (tests / forced refresh)."""
+        resp = self._call({"op": "heartbeat",
+                           "executor_id": self.executor_id,
+                           "seen": self._seen})
+        if resp.get("reregister"):
+            # pruned (e.g. long GC pause): rejoin with full state
+            resp = self._call({"op": "register",
+                               "executor_id": self.executor_id,
+                               "host": self.host, "port": self.port})
+        self._absorb(resp)
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_ms / 1000.0):
+            try:
+                self.poke()
+            except OSError:
+                return  # driver gone; executor keeps running
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass

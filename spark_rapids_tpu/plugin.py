@@ -49,10 +49,12 @@ class TpuExecutorPlugin:
         self.initialized = False
 
     def init(self, conf: rc.RapidsConf):
+        from spark_rapids_tpu.io import filecache
         from spark_rapids_tpu.runtime import memory, semaphore
         from spark_rapids_tpu.shuffle.manager import configure_shuffle
 
         self._validate_device()
+        filecache.configure(conf)  # FileCache.init (Plugin.scala:545)
         memory.initialize_memory(conf, force=True)
         semaphore.initialize(conf.get(rc.CONCURRENT_TPU_TASKS))
         configure_shuffle(

@@ -1,0 +1,214 @@
+"""File cache (FileCache role), Alluxio path rewriting
+(AlluxioUtils.scala), and the heartbeat control plane
+(RapidsShuffleHeartbeatManager.scala)."""
+
+import os
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.testing.asserts import with_tpu_session
+
+
+@pytest.fixture()
+def mem_fs(tmp_path):
+    """A fake remote filesystem: mem://<name> backed by a dict."""
+    from spark_rapids_tpu.io import filecache
+
+    store = {}
+    reads = {"n": 0}
+
+    def stat(path):
+        data, ver = store[path]
+        return filecache.RemoteFile(len(data), ver)
+
+    def read(path):
+        reads["n"] += 1
+        return store[path][0]
+
+    filecache.register_filesystem("mem", stat, read)
+    return store, reads
+
+
+def _parquet_bytes(t: pa.Table) -> bytes:
+    import io
+
+    buf = io.BytesIO()
+    pq.write_table(t, buf)
+    return buf.getvalue()
+
+
+def test_remote_scan_through_filecache(tmp_path, mem_fs):
+    store, reads = mem_fs
+    rng = np.random.default_rng(1)
+    t = pa.table({"k": pa.array(rng.integers(0, 3, 2000)),
+                  "v": pa.array(rng.random(2000))})
+    store["mem://bucket/data.parquet"] = (_parquet_bytes(t), "v1")
+
+    conf = {"spark.rapids.filecache.enabled": True,
+            "spark.rapids.filecache.path": str(tmp_path / "fc")}
+
+    def q(spark):
+        return (spark.read.parquet("mem://bucket/data.parquet")
+                .groupBy("k").agg(F.sum("v").alias("s"))
+                .collect_arrow().sort_by("k"))
+
+    out1 = with_tpu_session(q, conf=conf)
+    n_reads_first = reads["n"]
+    out2 = with_tpu_session(q, conf=conf)
+    assert out1.equals(out2)
+    # second query served from the cache: no extra remote reads
+    assert reads["n"] == n_reads_first
+    want = t.to_pandas().groupby("k").v.sum()
+    got = out1.to_pandas().set_index("k").s
+    assert np.allclose(got.to_numpy(), want.to_numpy())
+
+
+def test_filecache_version_invalidation(tmp_path, mem_fs):
+    store, reads = mem_fs
+    t1 = pa.table({"v": pa.array([1.0, 2.0])})
+    t2 = pa.table({"v": pa.array([5.0, 6.0, 7.0])})
+    store["mem://b/t.parquet"] = (_parquet_bytes(t1), "v1")
+    conf = {"spark.rapids.filecache.enabled": True,
+            "spark.rapids.filecache.path": str(tmp_path / "fc")}
+
+    def q(spark):
+        return spark.read.parquet("mem://b/t.parquet").collect_arrow()
+
+    assert with_tpu_session(q, conf=conf).num_rows == 2
+    store["mem://b/t.parquet"] = (_parquet_bytes(t2), "v2")
+    # changed etag -> refetch, not a stale hit
+    assert with_tpu_session(q, conf=conf).num_rows == 3
+
+
+def test_filecache_eviction(tmp_path, mem_fs):
+    from spark_rapids_tpu.config import rapids_conf as rc
+    from spark_rapids_tpu.io import filecache
+
+    store, _ = mem_fs
+    conf = rc.RapidsConf({
+        "spark.rapids.filecache.enabled": True,
+        "spark.rapids.filecache.path": str(tmp_path / "fc"),
+        "spark.rapids.filecache.maxBytes": 4096})
+    cache = filecache.FileCache(conf)
+    for i in range(8):
+        store[f"mem://b/f{i}"] = (os.urandom(1024), "v")
+        cache.localize(f"mem://b/f{i}")
+        time.sleep(0.01)
+    files = os.listdir(cache.base)
+    total = sum(os.path.getsize(os.path.join(cache.base, f))
+                for f in files)
+    assert total <= 4096, (total, files)
+
+
+def test_alluxio_rewrite_rules():
+    from spark_rapids_tpu.config import rapids_conf as rc
+    from spark_rapids_tpu.io.alluxio import rewrite_paths
+
+    conf = rc.RapidsConf({
+        "spark.rapids.alluxio.pathsToReplace":
+            "s3://bucket1->alluxio://m:19998/bucket1;"
+            "s3://b2->/local/b2"})
+    out = rewrite_paths(
+        ["s3://bucket1/x/y.parquet", "s3://b2/z.parquet",
+         "/plain/path.parquet"], conf)
+    assert out == ["alluxio://m:19998/bucket1/x/y.parquet",
+                   "/local/b2/z.parquet", "/plain/path.parquet"]
+
+
+def test_alluxio_automount_regex():
+    from spark_rapids_tpu.config import rapids_conf as rc
+    from spark_rapids_tpu.io.alluxio import rewrite_paths
+
+    conf = rc.RapidsConf({
+        "spark.rapids.alluxio.automount.regex": r"^s3://data-.*",
+        "spark.rapids.alluxio.master": "am:19998"})
+    out = rewrite_paths(
+        ["s3://data-prod/t/p.parquet", "s3://other/x.parquet"], conf)
+    assert out == ["alluxio://am:19998/data-prod/t/p.parquet",
+                   "s3://other/x.parquet"]
+
+
+def test_alluxio_rewrite_to_local_dir_end_to_end(tmp_path):
+    """Rule targets a plain local dir: the scan reads the co-located
+    copy without any remote fetch."""
+    rng = np.random.default_rng(2)
+    t = pa.table({"v": pa.array(rng.random(100))})
+    local = tmp_path / "mirror" / "tbl"
+    local.mkdir(parents=True)
+    pq.write_table(t, str(local / "part-0.parquet"))
+
+    conf = {"spark.rapids.alluxio.pathsToReplace":
+            f"s3://warehouse->{tmp_path / 'mirror'}"}
+
+    def q(spark):
+        return (spark.read.parquet("s3://warehouse/tbl")
+                .agg(F.sum("v").alias("s")).collect_arrow())
+
+    out = with_tpu_session(q, conf=conf)
+    assert abs(out.column("s")[0].as_py()
+               - float(np.asarray(t.column("v")).sum())) < 1e-9
+
+
+# ------------------------------------------------------------- heartbeat
+
+
+def test_heartbeat_topology_convergence():
+    from spark_rapids_tpu.parallel.heartbeat import (
+        HeartbeatClient,
+        HeartbeatServer,
+    )
+
+    srv = HeartbeatServer(timeout_ms=60000)
+    try:
+        seen_a = []
+        a = HeartbeatClient(srv.address, "exec-a", "hostA", 7001,
+                            interval_ms=60000,
+                            on_new_peers=seen_a.extend)
+        b = HeartbeatClient(srv.address, "exec-b", "hostB", 7002,
+                            interval_ms=60000)
+        # b registered after a: a learns about b on its next heartbeat
+        a.poke()
+        assert [p["executor_id"] for p in seen_a] == ["exec-b"]
+        assert [p["executor_id"] for p in b.peers] == ["exec-a"]
+        c = HeartbeatClient(srv.address, "exec-c", "hostC", 7003,
+                            interval_ms=60000)
+        a.poke()
+        b.poke()
+        assert {p["executor_id"] for p in a.peers} == {"exec-b",
+                                                       "exec-c"}
+        assert {p["executor_id"] for p in b.peers} == {"exec-a",
+                                                       "exec-c"}
+        assert {p["executor_id"] for p in c.peers} == {"exec-a",
+                                                       "exec-b"}
+        a.close()
+        b.close()
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_heartbeat_prunes_dead_executors():
+    from spark_rapids_tpu.parallel.heartbeat import HeartbeatManager
+
+    mgr = HeartbeatManager(timeout_ms=50)
+    mgr.register("e1", "h1", 1)
+    _, seq = mgr.register("e2", "h2", 2)
+    assert len(mgr.live_peers()) == 2
+    time.sleep(0.08)
+    mgr.heartbeat("e2", last_seq=seq)  # only e2 stays alive
+    live = [p["executor_id"] for p in mgr.live_peers()]
+    assert live == ["e2"]
+    # pruned executor heartbeats again -> told to re-register; the
+    # registry must keep serving (no poisoned state)
+    fresh, _ = mgr.heartbeat("e1", last_seq=0)
+    assert fresh is None
+    others, seq2 = mgr.register("e1", "h1", 1)
+    assert [p["executor_id"] for p in others] == ["e2"]
+    # e2 discovers the re-registered e1 via seq (prune-safe protocol)
+    fresh2, _ = mgr.heartbeat("e2", last_seq=seq)
+    assert [p["executor_id"] for p in fresh2] == ["e1"]
