@@ -287,6 +287,16 @@ class DataFrame:
     def agg(self, *cols) -> "DataFrame":
         return GroupedData(self, []).agg(*cols)
 
+    def mapInPandas(self, fn, schema) -> "DataFrame":
+        """Iterator-of-pandas-frames transform through the Arrow worker
+        pool (GpuMapInPandasExec role). `schema` is a DDL string
+        ('a long, b double') or StructType."""
+        from spark_rapids_tpu.sqltypes.datatypes import parse_ddl_schema
+
+        return DataFrame(
+            L.MapInPandas(fn, parse_ddl_schema(schema), self._plan),
+            self.session)
+
     def sample(self, withReplacement=None, fraction=None,
                seed=None) -> "DataFrame":
         """Bernoulli row sample (pyspark-compatible overloads:
@@ -861,6 +871,26 @@ class GroupedData:
 
         return self.agg(F.count("*").alias("count"))
 
+    def applyInPandas(self, fn, schema) -> DataFrame:
+        """Grouped-map pandas exchange: fn(pandas.DataFrame) ->
+        pandas.DataFrame per key group
+        (GpuFlatMapGroupsInPandasExec role)."""
+        from spark_rapids_tpu.sqltypes.datatypes import parse_ddl_schema
+
+        key_names = [g.name for g in self.grouping]
+        if self.mode != "groupby":
+            raise ValueError("applyInPandas requires plain groupBy()")
+        return DataFrame(
+            L.GroupedMapInPandas(key_names, fn,
+                                 parse_ddl_schema(schema),
+                                 self.df._plan),
+            self.df.session)
+
+    def cogroup(self, other: "GroupedData") -> "CoGroupedData":
+        """Pair two grouped frames for cogrouped applyInPandas
+        (GpuFlatMapCoGroupsInPandasExec role)."""
+        return CoGroupedData(self, other)
+
     def _simple(self, fn, *cols) -> DataFrame:
         from spark_rapids_tpu.api import functions as F
 
@@ -887,3 +917,24 @@ def _input_name(fn: AggregateFunction) -> str:
     if isinstance(c, BoundReference):
         return f"#{c.ordinal}"
     return repr(c)
+
+
+class CoGroupedData:
+    def __init__(self, left: GroupedData, right: GroupedData):
+        if [g.name for g in left.grouping] != \
+                [g.name for g in right.grouping]:
+            raise ValueError(
+                "cogroup requires identical grouping column names")
+        self.left = left
+        self.right = right
+
+    def applyInPandas(self, fn, schema) -> DataFrame:
+        from spark_rapids_tpu.sqltypes.datatypes import parse_ddl_schema
+
+        key_names = [g.name for g in self.left.grouping]
+        return DataFrame(
+            L.CoGroupedMapInPandas(key_names, fn,
+                                   parse_ddl_schema(schema),
+                                   self.left.df._plan,
+                                   self.right.df._plan),
+            self.left.df.session)

@@ -413,6 +413,99 @@ class CpuSampleExec(PhysicalPlan):
                 yield table.filter(pa.array(u < self.fraction))
 
 
+class _PandasExecBase(PhysicalPlan):
+    """Shared plumbing for the pandas-exchange execs (the
+    GpuArrowEvalPythonExec family roles): gather the host child into one
+    table per partition, apply through the worker pool."""
+
+    is_tpu = False
+
+    def _workers(self):
+        from spark_rapids_tpu.config import rapids_conf as rcm
+
+        return (self.conf.get(rcm.CONCURRENT_PYTHON_WORKERS)
+                if self.conf else 4)
+
+    def _out_arrow_schema(self):
+        from spark_rapids_tpu.sqltypes.datatypes import to_arrow_type
+
+        return pa.schema([
+            pa.field(f.name, to_arrow_type(f.dataType), f.nullable)
+            for f in self.schema.fields])
+
+    @staticmethod
+    def _gather(child, pid, ctx):
+        tables = list(child.execute_partition(pid, ctx))
+        if not tables:
+            return None
+        return pa.concat_tables(tables, promote_options="none")
+
+
+class CpuMapInPandasExec(_PandasExecBase):
+    def __init__(self, fn, schema, child, conf):
+        super().__init__([child], schema, conf)
+        self.fn = fn
+
+    def execute_partition(self, pid, ctx):
+        from spark_rapids_tpu.udf.pandas_udf import map_in_pandas
+
+        table = self._gather(self.children[0], pid, ctx)
+        if table is None:
+            return
+        yield map_in_pandas(self.fn, table, self._out_arrow_schema(),
+                            num_workers=self._workers())
+
+
+class CpuGroupedMapInPandasExec(_PandasExecBase):
+    def __init__(self, key_names, fn, schema, child, conf):
+        super().__init__([child], schema, conf)
+        self.key_names = key_names
+        self.fn = fn
+
+    def execute_partition(self, pid, ctx):
+        from spark_rapids_tpu.udf.pandas_udf import (
+            apply_in_pandas_grouped,
+        )
+
+        table = self._gather(self.children[0], pid, ctx)
+        if table is None:
+            return
+        yield apply_in_pandas_grouped(self.fn, self.key_names, table,
+                                      self._out_arrow_schema(),
+                                      num_workers=self._workers())
+
+
+class CpuCoGroupedMapInPandasExec(_PandasExecBase):
+    def __init__(self, key_names, fn, schema, left, right, conf):
+        super().__init__([left, right], schema, conf)
+        self.key_names = key_names
+        self.fn = fn
+
+    def execute_partition(self, pid, ctx):
+        from spark_rapids_tpu.udf.pandas_udf import (
+            apply_in_pandas_cogrouped,
+        )
+
+        left = self._gather(self.children[0], pid, ctx)
+        right = self._gather(self.children[1], pid, ctx)
+        if left is None and right is None:
+            return
+        lsch = self.children[0].schema
+        rsch = self.children[1].schema
+        from spark_rapids_tpu.sqltypes.datatypes import to_arrow_type
+
+        def empty(sch):
+            return pa.schema([
+                pa.field(f.name, to_arrow_type(f.dataType), f.nullable)
+                for f in sch.fields]).empty_table()
+
+        yield apply_in_pandas_cogrouped(
+            self.fn, self.key_names,
+            left if left is not None else empty(lsch),
+            right if right is not None else empty(rsch),
+            self._out_arrow_schema(), num_workers=self._workers())
+
+
 class TpuFilterExec(PhysicalPlan):
     def __init__(self, condition, child, conf):
         from spark_rapids_tpu.runtime.jit_cache import cached_jit

@@ -73,7 +73,81 @@ class BinaryComparison(Expression):
             mb = max(lc.max_bytes, rc.max_bytes)
             lc = _pad_string(lc, mb)
             rc = _pad_string(rc, mb)
+        if lc.dtype != rc.dtype:
+            lc, rc = _coerce_numeric(lc, rc)
         return lc, rc
+
+
+def _coerce_numeric(lc: DeviceColumn, rc: DeviceColumn):
+    """Promote mismatched numeric comparison operands to a common type
+    (Spark's ImplicitTypeCasts): int-vs-float comparisons must not key
+    a raw integer against the float total-order transform, and decimals
+    of different scales must align before unscaled-int keying."""
+    from spark_rapids_tpu.sqltypes import (
+        DecimalType,
+        IntegralType,
+        NumericType,
+    )
+    from spark_rapids_tpu.sqltypes.datatypes import double as _double
+
+    lt, rt = lc.dtype, rc.dtype
+    if not (isinstance(lt, NumericType) and isinstance(rt, NumericType)):
+        return lc, rc
+    ld, rd = isinstance(lt, DecimalType), isinstance(rt, DecimalType)
+    if ld or rd:
+        if isinstance(lt, (FloatType, DoubleType)) or \
+                isinstance(rt, (FloatType, DoubleType)):
+            # decimal vs float: compare as doubles
+            return (_as_double(lc), _as_double(rc))
+        ls = lt.scale if ld else 0
+        rs = rt.scale if rd else 0
+        s = max(ls, rs)
+        if lc.data.ndim == 2 or rc.data.ndim == 2:
+            # DECIMAL128 on either side: widen BOTH to limb pairs at
+            # the common scale so the limb keys align
+            from spark_rapids_tpu.ops import decimal128 as _d128
+
+            out_t = DecimalType(DecimalType.MAX_PRECISION, s)
+
+            def widen(col, delta):
+                hi, lo = _d128.widen_column(col, delta)
+                return DeviceColumn(out_t, _d128.join(hi, lo),
+                                    col.validity)
+
+            return widen(lc, s - ls), widen(rc, s - rs)
+        out_t = DecimalType(DecimalType.MAX_LONG_DIGITS, s)
+        return (
+            DeviceColumn(out_t,
+                         lc.data.astype(jnp.int64) * (10 ** (s - ls)),
+                         lc.validity, lc.lengths),
+            DeviceColumn(out_t,
+                         rc.data.astype(jnp.int64) * (10 ** (s - rs)),
+                         rc.validity, rc.lengths))
+    l_float = isinstance(lt, (FloatType, DoubleType))
+    r_float = isinstance(rt, (FloatType, DoubleType))
+    if l_float != r_float:
+        return _as_double(lc), _as_double(rc)
+    if l_float and r_float and lt != rt:
+        return _as_double(lc), _as_double(rc)
+    # both integral (possibly different widths): int64 keying is exact
+    return lc, rc
+
+
+def _as_double(col: DeviceColumn) -> DeviceColumn:
+    from spark_rapids_tpu.sqltypes import DecimalType
+    from spark_rapids_tpu.sqltypes.datatypes import double as _double
+
+    if col.data.ndim == 2 and isinstance(col.dtype, DecimalType):
+        # DECIMAL128 limb matrix -> approximate double value
+        from spark_rapids_tpu.ops import decimal128 as _d128
+
+        data = _d128.to_f64(*_d128.split(col.data)) \
+            / (10.0 ** col.dtype.scale)
+        return DeviceColumn(_double, data, col.validity)
+    data = col.data.astype(jnp.float64)
+    if isinstance(col.dtype, DecimalType):
+        data = data / (10.0 ** col.dtype.scale)
+    return DeviceColumn(_double, data, col.validity)
 
 
 def _pad_string(col: DeviceColumn, mb: int) -> DeviceColumn:

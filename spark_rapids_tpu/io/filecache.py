@@ -98,13 +98,16 @@ class FileCache:
         with open(tmp, "wb") as f:
             f.write(data)
         os.replace(tmp, local)
-        self._evict(protect=local)
+        if self.max_bytes > 0:
+            self._evict(protect={local})
         return local
 
-    def _evict(self, protect: Optional[str] = None):
+    def _evict(self, protect=None):
         """Drop least-recently-used entries past the byte budget; never
-        the entry being handed back to a reader (the budget is advisory
-        when one file alone exceeds it)."""
+        an entry being handed back to a reader (the budget is advisory
+        when protected files alone exceed it). `protect` is a set of
+        local paths."""
+        protect = protect or set()
         with _lock:
             try:
                 entries = [
@@ -118,7 +121,7 @@ class FileCache:
             for _, size, p in sorted(entries):
                 if total <= self.max_bytes:
                     break
-                if p == protect:
+                if p in protect:
                     continue
                 try:
                     os.remove(p)
@@ -153,10 +156,16 @@ def localize_paths(paths: List[str]) -> List[str]:
 
         cache = FileCache(rc.RapidsConf({}))
     if not cache.enabled:
+        # retention off: keep ONLY this scan's files (evict the rest
+        # AFTER all of them are localized — evicting between files
+        # would delete earlier paths of the same scan)
         import copy
 
         cache = copy.copy(cache)
         cache.max_bytes = 0
+        out = [cache.localize(p) for p in paths]
+        cache._evict(protect=set(out))
+        return out
     return [cache.localize(p) for p in paths]
 
 

@@ -342,6 +342,62 @@ class Sample(LogicalPlan):
         return f"Sample fraction={self.fraction} seed={self.seed}"
 
 
+class MapInPandas(LogicalPlan):
+    """df.mapInPandas(fn, schema): iterator-of-frames exchange through
+    the Arrow worker pool (GpuMapInPandasExec role)."""
+
+    def __init__(self, fn, out_schema: StructType, child: LogicalPlan):
+        super().__init__([child])
+        self.fn = fn
+        self._schema = out_schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def _node_string(self):
+        return "MapInPandas"
+
+
+class GroupedMapInPandas(LogicalPlan):
+    """groupBy(keys).applyInPandas(fn, schema)
+    (GpuFlatMapGroupsInPandasExec role)."""
+
+    def __init__(self, key_names: List[str], fn,
+                 out_schema: StructType, child: LogicalPlan):
+        super().__init__([child])
+        self.key_names = key_names
+        self.fn = fn
+        self._schema = out_schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def _node_string(self):
+        return f"GroupedMapInPandas {self.key_names}"
+
+
+class CoGroupedMapInPandas(LogicalPlan):
+    """cogroup(...).applyInPandas(fn, schema)
+    (GpuFlatMapCoGroupsInPandasExec role)."""
+
+    def __init__(self, key_names: List[str], fn,
+                 out_schema: StructType, left: LogicalPlan,
+                 right: LogicalPlan):
+        super().__init__([left, right])
+        self.key_names = key_names
+        self.fn = fn
+        self._schema = out_schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def _node_string(self):
+        return f"CoGroupedMapInPandas {self.key_names}"
+
+
 class Limit(LogicalPlan):
     def __init__(self, n: int, child: LogicalPlan):
         super().__init__([child])

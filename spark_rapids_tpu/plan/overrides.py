@@ -152,6 +152,12 @@ class TpuOverrides:
             if node.with_replacement:
                 meta.cannot_run("with-replacement sampling has no "
                                 "fixed-shape device lowering (CPU)")
+        elif isinstance(node, (L.MapInPandas, L.GroupedMapInPandas,
+                               L.CoGroupedMapInPandas)):
+            meta.cannot_run(
+                "pandas exchange runs via the Arrow worker pool "
+                "(GpuArrowEvalPythonExec family is host-side in the "
+                "reference too)")
         elif isinstance(node, L.Window):
             self._tag_window(node, meta)
         elif isinstance(node, L.LocalRelation):
@@ -238,6 +244,13 @@ class TpuOverrides:
             return child
         return ops.ArrowToDeviceExec(child, self.conf)
 
+    def _gather_host(self, child: PhysicalPlan) -> PhysicalPlan:
+        """Host child funneled to ONE partition (global grouping)."""
+        host = self._to_host(child)
+        if host.num_partitions > 1:
+            return ops.CpuShuffleExchangeExec(host, None, 1, self.conf)
+        return host
+
     def _to_host(self, child: PhysicalPlan) -> PhysicalPlan:
         if not child.is_tpu:
             return child
@@ -305,6 +318,21 @@ class TpuOverrides:
             return ops.CpuSampleExec(node.fraction, node.seed,
                                      node.with_replacement,
                                      self._to_host(children[0]), conf)
+        if isinstance(node, L.MapInPandas):
+            # map is per-row: partition layout is irrelevant
+            return ops.CpuMapInPandasExec(
+                node.fn, node.schema, self._to_host(children[0]), conf)
+        if isinstance(node, L.GroupedMapInPandas):
+            # grouping must be GLOBAL: gather multi-partition children
+            # (the aggregate path inserts the same exchange)
+            return ops.CpuGroupedMapInPandasExec(
+                node.key_names, node.fn, node.schema,
+                self._gather_host(children[0]), conf)
+        if isinstance(node, L.CoGroupedMapInPandas):
+            return ops.CpuCoGroupedMapInPandasExec(
+                node.key_names, node.fn, node.schema,
+                self._gather_host(children[0]),
+                self._gather_host(children[1]), conf)
         if isinstance(node, L.Aggregate):
             return self._convert_aggregate(node, children[0], on_device)
         if isinstance(node, L.Join):

@@ -375,3 +375,35 @@ def parse_type_name(name: str) -> DataType:
         p, _, s = inner.partition(",")
         return DecimalType(int(p), int(s or 0))
     raise ValueError(f"cannot parse type name {name!r}")
+
+
+def parse_ddl_schema(ddl) -> "StructType":
+    """'a long, b double' DDL string (or a StructType passthrough) ->
+    StructType — the schema argument convention of applyInPandas /
+    mapInPandas."""
+    if isinstance(ddl, StructType):
+        return ddl
+    # split on commas not inside parens (decimal(10,2) stays whole)
+    parts, depth, cur = [], 0, []
+    for ch in str(ddl):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    fields = []
+    for part in parts:
+        part = part.strip()
+        if not part:
+            continue
+        name, _, tname = part.partition(" ")
+        if not tname:
+            raise ValueError(f"bad DDL field {part!r} (want 'name type')")
+        fields.append(StructField(name.strip(), parse_type_name(tname),
+                                  True))
+    return StructType(fields)

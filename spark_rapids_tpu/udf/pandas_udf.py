@@ -19,7 +19,7 @@ grouped-agg variants are follow-ups.
 from __future__ import annotations
 
 import threading
-from concurrent.futures import ProcessPoolExecutor
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, List, Optional
 
 import pyarrow as pa
@@ -27,26 +27,109 @@ import pyarrow as pa
 from spark_rapids_tpu.expr.core import Expression
 from spark_rapids_tpu.sqltypes import DataType
 
-_pool: Optional[ProcessPoolExecutor] = None
+
+class PandasWorkerError(RuntimeError):
+    pass
+
+
+class _WorkerProc:
+    """One `python srtpu_pandas_worker.py serve` subprocess speaking
+    length-prefixed pickle over its pipes."""
+
+    def __init__(self):
+        import os
+        import subprocess
+        import sys
+
+        import srtpu_pandas_worker as w
+
+        env = dict(os.environ)
+        # workers never touch a device; keep jax inert if anything in
+        # their (pyarrow/pandas-only) imports ever pulls it in
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        self.proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(w.__file__), "serve"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env)
+
+    def call(self, name: str, args: tuple):
+        import pickle
+
+        from srtpu_pandas_worker import _read_frame, _write_frame
+
+        _write_frame(self.proc.stdin, pickle.dumps((name, args)))
+        frame = _read_frame(self.proc.stdout)
+        if frame is None:
+            raise PandasWorkerError(
+                f"pandas worker died (exit {self.proc.poll()})")
+        status, payload = pickle.loads(frame)
+        if status != "ok":
+            raise PandasWorkerError(
+                f"pandas UDF worker failed:\n{payload}")
+        return payload
+
+    def close(self):
+        try:
+            self.proc.stdin.close()
+            self.proc.terminate()
+        except OSError:
+            pass
+
+
+class SubprocessPool:
+    """ProcessPoolExecutor-shaped facade over the worker daemons (the
+    reference's python daemon/worker pool, python/rapids/daemon.py +
+    PythonWorkerSemaphore): one dispatcher thread per worker, tasks
+    queue through a shared executor."""
+
+    def __init__(self, num_workers: int):
+        import queue
+
+        self._threads = ThreadPoolExecutor(
+            max_workers=num_workers,
+            thread_name_prefix="srtpu-pandas-dispatch")
+        self._workers = queue.SimpleQueue()
+        for _ in range(num_workers):
+            self._workers.put(_WorkerProc())
+
+    def submit(self, fn, *args):
+        name = fn.__name__
+
+        def run():
+            w = self._workers.get()
+            try:
+                out = w.call(name, args)
+            except BaseException:
+                # ANY failure retires the worker (a BrokenPipeError
+                # would otherwise leak it and starve the pool)
+                w.close()
+                self._workers.put(_WorkerProc())
+                raise
+            self._workers.put(w)
+            return out
+
+        return self._threads.submit(run)
+
+    def shutdown(self, wait=True):
+        self._threads.shutdown(wait=wait)
+        try:
+            while True:
+                self._workers.get_nowait().close()
+        except Exception:
+            pass
+
+
+_pool: Optional[SubprocessPool] = None
 _pool_workers = 0
 _pool_lock = threading.Lock()
 
 
-def get_worker_pool(num_workers: int = 4) -> ProcessPoolExecutor:
+def get_worker_pool(num_workers: int = 4) -> SubprocessPool:
     global _pool, _pool_workers
-    import multiprocessing
-
     with _pool_lock:
         if _pool is None or _pool_workers != num_workers:
             if _pool is not None:
                 _pool.shutdown(wait=False)
-            # forkserver, not fork: the parent runs JAX's thread pools
-            # and a direct fork can deadlock on their held locks; the
-            # forkserver is exec'd fresh and forks clean children (and
-            # unlike spawn it does not re-run __main__)
-            _pool = ProcessPoolExecutor(
-                max_workers=num_workers,
-                mp_context=multiprocessing.get_context("forkserver"))
+            _pool = SubprocessPool(num_workers)
             _pool_workers = num_workers
         return _pool
 
@@ -107,25 +190,7 @@ def eval_pandas_udf(e: PandasUDF, table: pa.Table,
     out_type = to_arrow_type(e.dtype)
     type_blob = pa.schema([pa.field("r", out_type)]).serialize() \
         .to_pybytes()
-    # pickle the UDF by value: a by-reference pickle would make workers
-    # import the user's module (and transitively this package, whose
-    # import initializes the JAX backend)
-    import inspect
-
-    mod = inspect.getmodule(e.fn)
-    registered = False
-    if mod is not None and getattr(mod, "__name__", "__main__") not in (
-            "builtins",):
-        try:
-            cloudpickle.register_pickle_by_value(mod)
-            registered = True
-        except Exception:
-            pass
-    try:
-        fn_bytes = cloudpickle.dumps(e.fn)
-    finally:
-        if registered:
-            cloudpickle.unregister_pickle_by_value(mod)
+    fn_bytes = pickle_fn(e.fn)
     pool = get_worker_pool(num_workers)
     futures = []
     for off in range(0, max(work.num_rows, 1), chunk_rows):
@@ -139,3 +204,141 @@ def eval_pandas_udf(e: PandasUDF, table: pa.Table,
         return pa.chunked_array([pa.array([], type=out_type)])
     return pa.chunked_array(
         [c for ch in chunks for c in ch.chunks])
+
+
+def pickle_fn(fn) -> bytes:
+    """Pickle a user function BY VALUE (workers must not import the
+    user's module — it would transitively initialize jax)."""
+    import inspect
+
+    import cloudpickle
+
+    mod = inspect.getmodule(fn)
+    registered = False
+    if mod is not None and getattr(mod, "__name__", "__main__") not in (
+            "builtins",):
+        try:
+            cloudpickle.register_pickle_by_value(mod)
+            registered = True
+        except Exception:
+            pass
+    try:
+        return cloudpickle.dumps(fn)
+    finally:
+        if registered:
+            cloudpickle.unregister_pickle_by_value(mod)
+
+
+def _schema_blob(schema: pa.Schema) -> bytes:
+    return schema.serialize().to_pybytes()
+
+
+def _group_slices(table: pa.Table, key_names):
+    """Contiguous per-group slices (sorted by keys, null keys grouped)."""
+    import pyarrow.compute as pc
+
+    if table.num_rows == 0:
+        return
+    sort_keys = [(k, "ascending") for k in key_names]
+    idx = pc.sort_indices(table, sort_keys=sort_keys,
+                          null_placement="at_end")
+    s = table.take(idx)
+    import numpy as np
+
+    keys = [s.column(k) for k in key_names]
+    n = s.num_rows
+    boundary = np.zeros(n, dtype=bool)
+    boundary[0] = True
+    for k in keys:
+        vals = k.to_pandas()
+        neq = vals.ne(vals.shift()) & ~(vals.isna() & vals.isna().shift(
+            fill_value=False))
+        boundary |= neq.to_numpy(dtype=bool, na_value=True)
+    starts = np.flatnonzero(boundary)
+    ends = np.append(starts[1:], n)
+    for a, b in zip(starts, ends):
+        yield s.slice(a, b - a)
+
+
+def apply_in_pandas_grouped(fn, key_names, table: pa.Table,
+                            out_schema: pa.Schema,
+                            num_workers: int = 4) -> pa.Table:
+    """groupBy(...).applyInPandas driver side: each key group ships to
+    the worker pool as one Arrow chunk (GpuArrowEvalPythonExec grouped-
+    map role)."""
+    from srtpu_pandas_worker import worker_apply_df
+
+    fn_bytes = pickle_fn(fn)
+    blob = _schema_blob(out_schema)
+    pool = get_worker_pool(num_workers)
+    futures = [pool.submit(worker_apply_df, fn_bytes, _ipc_bytes(g),
+                           blob)
+               for g in _group_slices(table, key_names)]
+    parts = [_ipc_table(f.result()) for f in futures]
+    if not parts:
+        return out_schema.empty_table()
+    return pa.concat_tables(parts, promote_options="none")
+
+
+def map_in_pandas(fn, table: pa.Table, out_schema: pa.Schema,
+                  chunk_rows: int = 65536,
+                  num_workers: int = 4) -> pa.Table:
+    """df.mapInPandas driver side: the iterator-of-frames contract is
+    delivered chunk-by-chunk through the worker pool."""
+    from srtpu_pandas_worker import worker_apply_df
+
+    def once(df):
+        # user fn takes an iterator of frames and yields frames
+        import pandas as pd
+
+        outs = list(fn(iter([df])))
+        if not outs:
+            import pandas as pd
+
+            return pd.DataFrame()
+        return pd.concat(outs, ignore_index=True)
+
+    fn_bytes = pickle_fn(once)
+    blob = _schema_blob(out_schema)
+    pool = get_worker_pool(num_workers)
+    futures = []
+    for off in range(0, max(table.num_rows, 1), chunk_rows):
+        piece = table.slice(off, min(chunk_rows,
+                                     table.num_rows - off))
+        if piece.num_rows == 0 and table.num_rows > 0:
+            break
+        futures.append(pool.submit(worker_apply_df, fn_bytes,
+                                   _ipc_bytes(piece), blob))
+    parts = [_ipc_table(f.result()) for f in futures]
+    if not parts:
+        return out_schema.empty_table()
+    return pa.concat_tables(parts, promote_options="none")
+
+
+def apply_in_pandas_cogrouped(fn, key_names, left: pa.Table,
+                              right: pa.Table, out_schema: pa.Schema,
+                              num_workers: int = 4) -> pa.Table:
+    """cogroup(...).applyInPandas driver side: align per-key groups
+    from both sides (missing side = empty frame, Spark semantics)."""
+    from srtpu_pandas_worker import worker_apply_cogroup
+
+    def key_of(g):
+        return tuple(g.column(k)[0].as_py() for k in key_names)
+
+    lmap = {key_of(g): g for g in _group_slices(left, key_names)}
+    rmap = {key_of(g): g for g in _group_slices(right, key_names)}
+    fn_bytes = pickle_fn(fn)
+    blob = _schema_blob(out_schema)
+    pool = get_worker_pool(num_workers)
+    futures = []
+    for k in sorted(set(lmap) | set(rmap),
+                    key=lambda t: tuple((v is None, v) for v in t)):
+        lg = lmap.get(k, left.schema.empty_table())
+        rg = rmap.get(k, right.schema.empty_table())
+        futures.append(pool.submit(worker_apply_cogroup, fn_bytes,
+                                   _ipc_bytes(lg), _ipc_bytes(rg),
+                                   blob))
+    parts = [_ipc_table(f.result()) for f in futures]
+    if not parts:
+        return out_schema.empty_table()
+    return pa.concat_tables(parts, promote_options="none")

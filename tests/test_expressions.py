@@ -216,3 +216,60 @@ def test_starts_ends_contains():
 def test_murmur3_expression():
     t = pa.table({"a": pa.array([1], pa.int64())})
     assert _eval(t, Murmur3Hash(ref(0))) == [-1712319331]
+
+
+def test_mixed_type_comparison_coercion():
+    """Regression: comparing a double column with an INT literal keyed
+    a raw integer against the float total-order transform, passing
+    every row (predicates._coerce_numeric)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.testing.asserts import (
+        assert_tpu_and_cpu_are_equal_collect,
+        with_tpu_session,
+    )
+
+    rng = np.random.default_rng(3)
+    t = pa.table({
+        "d": pa.array(rng.random(1000) * 100),
+        "f": pa.array((rng.random(1000) * 10).astype("float32")),
+        "i": pa.array(rng.integers(0, 100, 1000).astype("int32")),
+    })
+
+    def q(spark):
+        df = spark.createDataFrame(t)
+        return df.select(
+            (F.col("d") > 5).alias("a"),        # double vs int lit
+            (F.col("i") > F.lit(4.5)).alias("b"),  # int vs double lit
+            (F.col("f") <= 3).alias("c"),       # float vs int lit
+            (F.col("d") == F.col("i")).alias("e"),
+            (F.col("f") < F.col("d")).alias("g"),  # float vs double
+        )
+
+    assert_tpu_and_cpu_are_equal_collect(q)
+    out = with_tpu_session(lambda s: q(s).collect_arrow())
+    want = (np.asarray(t.column("d")) > 5)
+    assert (np.asarray(out.column("a")) == want).all()
+
+
+def test_decimal_int_comparison_coercion():
+    import decimal
+
+    import pyarrow as pa
+
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.testing.asserts import (
+        assert_tpu_and_cpu_are_equal_collect,
+    )
+
+    t = pa.table({"p": pa.array([decimal.Decimal("4.99"),
+                                 decimal.Decimal("5.00"),
+                                 decimal.Decimal("5.01")],
+                                type=pa.decimal128(10, 2))})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.createDataFrame(t).select(
+            (F.col("p") > 5).alias("gt"),
+            (F.col("p") >= F.lit(5)).alias("ge"),
+            (F.col("p") < F.lit(5.005)).alias("ltf")))

@@ -1,0 +1,161 @@
+"""Grouped/map/cogrouped pandas exchange (reference
+GpuArrowEvalPythonExec family: GpuFlatMapGroupsInPandasExec,
+GpuMapInPandasExec, GpuFlatMapCoGroupsInPandasExec — host-side execs
+over the Arrow worker-process pool)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.testing.asserts import with_tpu_session
+
+
+def _data(n=500, seed=0):
+    rng = np.random.default_rng(seed)
+    return pa.table({"k": pa.array(rng.integers(0, 4, n)),
+                     "v": pa.array(rng.random(n))})
+
+
+def test_apply_in_pandas_grouped():
+    t = _data()
+
+    def center(pdf):
+        pdf = pdf.copy()
+        pdf["v"] = pdf["v"] - pdf["v"].mean()
+        return pdf
+
+    def q(spark):
+        return (spark.createDataFrame(t).groupBy("k")
+                .applyInPandas(center, "k bigint, v double")
+                .collect_arrow())
+
+    out = with_tpu_session(q)
+    assert out.num_rows == t.num_rows
+    assert abs(out.to_pandas().groupby("k").v.mean()).max() < 1e-12
+
+
+def test_apply_in_pandas_changes_shape():
+    """Result cardinality may differ per group (Spark contract)."""
+    t = _data()
+
+    def summarize(pdf):
+        import pandas as pd
+
+        return pd.DataFrame({"k": [pdf.k.iloc[0]],
+                             "mean_v": [pdf.v.mean()],
+                             "n": [len(pdf)]})
+
+    def q(spark):
+        return (spark.createDataFrame(t).groupBy("k")
+                .applyInPandas(summarize, "k bigint, mean_v double, "
+                                          "n bigint")
+                .collect_arrow().sort_by("k").to_pandas())
+
+    out = with_tpu_session(q)
+    want = t.to_pandas().groupby("k").v.agg(["mean", "size"])
+    assert np.allclose(out.mean_v.to_numpy(),
+                       want["mean"].to_numpy())
+    assert (out.n.to_numpy() == want["size"].to_numpy()).all()
+
+
+def test_map_in_pandas():
+    t = _data()
+
+    def doubler(it):
+        for pdf in it:
+            pdf = pdf.copy()
+            pdf["v"] = pdf["v"] * 2
+            yield pdf[["v"]]
+
+    def q(spark):
+        return (spark.createDataFrame(t)
+                .mapInPandas(doubler, "v double").collect_arrow())
+
+    out = with_tpu_session(q)
+    assert np.allclose(sorted(out.column("v").to_pylist()),
+                       sorted((t.to_pandas().v * 2).tolist()))
+
+
+def test_map_in_pandas_filtering_iterator():
+    """The fn may drop rows / yield multiple frames per chunk."""
+    t = _data()
+
+    def keep_big(it):
+        for pdf in it:
+            yield pdf[pdf.v > 0.5][["k", "v"]]
+
+    def q(spark):
+        return (spark.createDataFrame(t)
+                .mapInPandas(keep_big, "k bigint, v double")
+                .collect_arrow())
+
+    out = with_tpu_session(q)
+    want = t.to_pandas().query("v > 0.5")
+    assert out.num_rows == len(want)
+
+
+def test_cogroup_apply_in_pandas():
+    t1 = _data(500, 0)
+    t2 = pa.table({"k": pa.array([0, 0, 1, 9]),
+                   "w": pa.array([1.0, 2.0, 3.0, 4.0])})
+
+    def merge_counts(lf, rf):
+        import pandas as pd
+
+        k = lf.k.iloc[0] if len(lf) else rf.k.iloc[0]
+        return pd.DataFrame({"k": [k], "nl": [len(lf)],
+                             "nr": [len(rf)]})
+
+    def q(spark):
+        a = spark.createDataFrame(t1).groupBy("k")
+        b = spark.createDataFrame(t2).groupBy("k")
+        return (a.cogroup(b)
+                .applyInPandas(merge_counts,
+                               "k bigint, nl bigint, nr bigint")
+                .collect_arrow().sort_by("k").to_pandas())
+
+    out = with_tpu_session(q)
+    # key 9 exists only on the right: left side is an empty frame
+    row9 = out[out.k == 9]
+    assert len(row9) == 1 and int(row9.nl.iloc[0]) == 0 \
+        and int(row9.nr.iloc[0]) == 1
+    nl = t1.to_pandas().groupby("k").size()
+    for k in (0, 1, 2, 3):
+        assert int(out[out.k == k].nl.iloc[0]) == int(nl[k])
+
+
+def test_cogroup_key_name_mismatch():
+    t = _data(20)
+
+    def q(spark):
+        a = spark.createDataFrame(t).groupBy("k")
+        b = spark.createDataFrame(
+            pa.table({"j": pa.array([1])})).groupBy("j")
+        with pytest.raises(ValueError, match="identical grouping"):
+            a.cogroup(b)
+        return True
+
+    assert with_tpu_session(q)
+
+
+def test_apply_in_pandas_after_device_ops():
+    """The pandas exec consumes device-operator output through the
+    host transition."""
+    t = _data()
+
+    def tag(pdf):
+        pdf = pdf.copy()
+        pdf["r"] = pdf["v"].rank()
+        return pdf[["k", "r"]]
+
+    def q(spark):
+        return (spark.createDataFrame(t)
+                .filter(F.col("v") > 0.2)
+                .withColumn("v", F.col("v") * 10)
+                .groupBy("k").applyInPandas(tag, "k bigint, r double")
+                .collect_arrow())
+
+    out = with_tpu_session(q)
+    want_n = (t.to_pandas().v > 0.2).sum()
+    assert out.num_rows == want_n
