@@ -716,11 +716,18 @@ class GroupedData:
         self.grouping = [
             _named(df._col_expr(c), c if isinstance(c, str) else c.name)
             for c in cols]
+        from spark_rapids_tpu.sqltypes import MapType
+
         for g in self.grouping:
             if contains_window(g):
                 raise ValueError(
                     "window functions are not allowed as grouping keys; "
                     "materialize with select/withColumn first")
+            if isinstance(g.dtype, MapType):
+                raise ValueError(
+                    "expression cannot be used as a grouping expression "
+                    "because its data type is a map (Spark "
+                    "EXPRESSION_TYPE_IS_NOT_ORDERABLE)")
 
     def agg(self, *cols) -> DataFrame:
         from spark_rapids_tpu.expr.aggregates import GroupingBit, GroupingID

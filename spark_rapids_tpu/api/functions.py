@@ -845,6 +845,36 @@ def array_min(c) -> Column:
     return Column(ArrayMin(expr_of(c)), "array_min")
 
 
+def map_keys(c) -> Column:
+    from spark_rapids_tpu.expr.collections import MapKeys
+
+    return Column(MapKeys(expr_of(c)))
+
+
+def map_values(c) -> Column:
+    from spark_rapids_tpu.expr.collections import MapValues
+
+    return Column(MapValues(expr_of(c)))
+
+
+def map_contains_key(c, key) -> Column:
+    from spark_rapids_tpu.expr.collections import MapContainsKey
+
+    return Column(MapContainsKey(expr_of(c), expr_of(lit_or(key))))
+
+
+def create_map(*cols) -> Column:
+    from spark_rapids_tpu.expr.collections import CreateMap
+
+    return Column(CreateMap(*[expr_of(lit_or(c)) for c in cols]))
+
+
+def map_from_arrays(keys, values) -> Column:
+    from spark_rapids_tpu.expr.collections import MapFromArrays
+
+    return Column(MapFromArrays(expr_of(keys), expr_of(values)))
+
+
 def sort_array(c, asc: bool = True) -> Column:
     from spark_rapids_tpu.expr.collections import SortArray
 

@@ -63,15 +63,17 @@ class DeviceColumn:
     elem_validity: [cap, max_elems] bool (arrays only): per-element nulls
     """
 
-    __slots__ = ("dtype", "data", "validity", "lengths", "elem_validity")
+    __slots__ = ("dtype", "data", "validity", "lengths",
+                 "elem_validity", "map_values")
 
     def __init__(self, dtype: DataType, data, validity, lengths=None,
-                 elem_validity=None):
+                 elem_validity=None, map_values=None):
         self.dtype = dtype
-        self.data = data
+        self.data = data          # maps: the KEY matrix
         self.validity = validity
         self.lengths = lengths
-        self.elem_validity = elem_validity
+        self.elem_validity = elem_validity  # maps: VALUE validity
+        self.map_values = map_values        # maps only: value matrix
 
     @property
     def is_string(self) -> bool:
@@ -102,11 +104,13 @@ class DeviceColumn:
             n += self.lengths.size * 4
         if self.elem_validity is not None:
             n += self.elem_validity.size
+        if self.map_values is not None:
+            n += self.map_values.size * self.map_values.dtype.itemsize
         return n
 
     def with_validity(self, validity) -> "DeviceColumn":
         return DeviceColumn(self.dtype, self.data, validity, self.lengths,
-                            self.elem_validity)
+                            self.elem_validity, self.map_values)
 
     def gather(self, indices) -> "DeviceColumn":
         """Row gather; indices must be in [0, capacity)."""
@@ -118,6 +122,8 @@ class DeviceColumn:
                                                        axis=0),
             None if self.elem_validity is None else jnp.take(
                 self.elem_validity, indices, axis=0),
+            None if self.map_values is None else jnp.take(
+                self.map_values, indices, axis=0),
         )
 
     def _tree_flatten(self):
@@ -126,18 +132,22 @@ class DeviceColumn:
             leaves.append(self.lengths)
         if self.elem_validity is not None:
             leaves.append(self.elem_validity)
+        if self.map_values is not None:
+            leaves.append(self.map_values)
         return tuple(leaves), (self.dtype, self.lengths is not None,
-                               self.elem_validity is not None)
+                               self.elem_validity is not None,
+                               self.map_values is not None)
 
     @classmethod
     def _tree_unflatten(cls, aux, children):
-        dtype, has_len, has_ev = aux
+        dtype, has_len, has_ev, has_mv = aux
         it = iter(children)
         data = next(it)
         validity = next(it)
         lengths = next(it) if has_len else None
         ev = next(it) if has_ev else None
-        return cls(dtype, data, validity, lengths, ev)
+        mv = next(it) if has_mv else None
+        return cls(dtype, data, validity, lengths, ev, mv)
 
 
 jax.tree_util.register_pytree_node(
@@ -228,7 +238,10 @@ def make_column(dtype: DataType, values: np.ndarray,
                 validity: Optional[np.ndarray], capacity: int,
                 lengths: Optional[np.ndarray] = None,
                 elem_validity: Optional[np.ndarray] = None) -> DeviceColumn:
-    """Build a device column from host numpy data, padding to capacity.
+    """Build a column from host numpy data, padding to capacity. The
+    returned column holds NUMPY leaves — the caller uploads the whole
+    batch with ONE jax.device_put (per-array jnp.asarray costs ~6x in
+    transfer setup, and far more over tunneled devices).
 
     For strings, `values` is a [n, max_bytes] uint8 matrix and `lengths`
     the per-row byte counts. For arrays, `values` is [n, max_elems] of
@@ -237,7 +250,8 @@ def make_column(dtype: DataType, values: np.ndarray,
     """
     from spark_rapids_tpu.sqltypes import ArrayType
 
-    n = len(values)
+    # maps pass (key_matrix, value_matrix)
+    n = len(values[0]) if isinstance(values, tuple) else len(values)
     if validity is None:
         validity = np.ones(n, dtype=np.bool_)
     vpad = np.zeros(capacity, dtype=np.bool_)
@@ -249,8 +263,7 @@ def make_column(dtype: DataType, values: np.ndarray,
         lpad = np.zeros(capacity, dtype=np.int32)
         if lengths is not None:
             lpad[:n] = lengths
-        return DeviceColumn(dtype, jnp.asarray(data), jnp.asarray(vpad),
-                            jnp.asarray(lpad))
+        return DeviceColumn(dtype, data, vpad, lpad)
     if isinstance(dtype, ArrayType):
         assert values.ndim == 2
         data = np.zeros((capacity, values.shape[1]),
@@ -262,15 +275,32 @@ def make_column(dtype: DataType, values: np.ndarray,
         ev = np.zeros((capacity, values.shape[1]), dtype=np.bool_)
         if elem_validity is not None:
             ev[:n, :] = elem_validity
-        return DeviceColumn(dtype, jnp.asarray(data), jnp.asarray(vpad),
-                            jnp.asarray(lpad), jnp.asarray(ev))
+        return DeviceColumn(dtype, data, vpad, lpad, ev)
+    from spark_rapids_tpu.sqltypes import MapType
+
+    if isinstance(dtype, MapType):
+        # values is (key_matrix, value_matrix); elem_validity covers
+        # VALUES (map keys are never null)
+        kmat, vmat = values
+        me = kmat.shape[1]
+        kd = np.zeros((capacity, me), dtype=dtype.keyType.np_dtype)
+        kd[:n, :] = kmat
+        vd = np.zeros((capacity, me), dtype=dtype.valueType.np_dtype)
+        vd[:n, :] = vmat
+        lpad = np.zeros(capacity, dtype=np.int32)
+        if lengths is not None:
+            lpad[:n] = lengths
+        ev = np.zeros((capacity, me), dtype=np.bool_)
+        if elem_validity is not None:
+            ev[:n, :] = elem_validity
+        return DeviceColumn(dtype, kd, vpad, lpad, ev, vd)
     if values.ndim == 2:  # DECIMAL128 limb matrix [n, 2]
         data = np.zeros((capacity, 2), dtype=np.int64)
         data[:n, :] = values
-        return DeviceColumn(dtype, jnp.asarray(data), jnp.asarray(vpad))
+        return DeviceColumn(dtype, data, vpad)
     data = np.zeros(capacity, dtype=dtype.np_dtype)
     data[:n] = values
-    return DeviceColumn(dtype, jnp.asarray(data), jnp.asarray(vpad))
+    return DeviceColumn(dtype, data, vpad)
 
 
 def empty_like_schema(schema: StructType, capacity: int,
@@ -306,7 +336,8 @@ def concat_batches(batches: List[ColumnBatch]) -> ColumnBatch:
     cap = next_capacity(total)
     cols: List[DeviceColumn] = []
     for ci, field in enumerate(schema.fields):
-        parts_data, parts_val, parts_len, parts_ev = [], [], [], []
+        parts_data, parts_val, parts_len = [], [], []
+        parts_ev, parts_mv = [], []
         for b in batches:
             n = b.row_count()
             c = b.columns[ci]
@@ -316,13 +347,18 @@ def concat_batches(batches: List[ColumnBatch]) -> ColumnBatch:
                 parts_len.append(c.lengths[:n])
             if c.elem_validity is not None:
                 parts_ev.append(c.elem_validity[:n])
-        if parts_data[0].ndim == 2:  # strings / arrays: align widths
+            if c.map_values is not None:
+                parts_mv.append(c.map_values[:n])
+        if parts_data[0].ndim == 2:  # strings / arrays / maps: align
             mb = max(int(p.shape[1]) for p in parts_data)
             parts_data = [
                 jnp.pad(p, ((0, 0), (0, mb - p.shape[1]))) for p in parts_data
             ]
             parts_ev = [
                 jnp.pad(p, ((0, 0), (0, mb - p.shape[1]))) for p in parts_ev
+            ]
+            parts_mv = [
+                jnp.pad(p, ((0, 0), (0, mb - p.shape[1]))) for p in parts_mv
             ]
         data = jnp.concatenate(parts_data, axis=0)
         pad = cap - total
@@ -338,5 +374,11 @@ def concat_batches(batches: List[ColumnBatch]) -> ColumnBatch:
             ev = jnp.concatenate(parts_ev, axis=0)
             if pad:
                 ev = jnp.pad(ev, ((0, pad), (0, 0)))
-        cols.append(DeviceColumn(field.dataType, data, val, lens, ev))
+        mv = None
+        if parts_mv:
+            mv = jnp.concatenate(parts_mv, axis=0)
+            if pad:
+                mv = jnp.pad(mv, ((0, pad), (0, 0)))
+        cols.append(DeviceColumn(field.dataType, data, val, lens, ev,
+                                 mv))
     return ColumnBatch(schema, cols, total)

@@ -228,6 +228,9 @@ def _ev(e: Expression, t: pa.Table):
         workers = (s.rapids_conf.get(_rc.CONCURRENT_PYTHON_WORKERS)
                    if s else 4)
         return eval_pandas_udf(e, t, num_workers=workers)
+    r = _ev_maps(e, t)
+    if r is not None:
+        return r
     r = _ev_collections(e, t)
     if r is not None:
         return r
@@ -534,6 +537,11 @@ def _ev_collections(e: Expression, t: pa.Table):
 
     if isinstance(e, Size):
         a = _ev(e.children[0], t)
+        if pa.types.is_map(a.type):
+            # arrow's list_value_length has no map kernel
+            vals = [(-1 if m is None else len(m))
+                    for m in a.to_pylist()]
+            return pa.array(vals, type=pa.int32())
         n = pc.list_value_length(a)
         return pc.fill_null(pc.cast(n, pa.int32()), pa.scalar(-1,
                                                               pa.int32()))
@@ -1411,3 +1419,78 @@ def _xxhash64_cpu(e: XxHash64, t: pa.Table):
 
     vals = np.asarray(jax.device_get(col.data))[:t.num_rows]
     return pa.array(vals, type=pa.int64())
+
+
+def _ev_maps(e: Expression, t: pa.Table):
+    """Map-expression oracle (python map semantics over arrow maps)."""
+    from spark_rapids_tpu.expr.collections import (
+        CreateMap,
+        ElementAt,
+        GetMapValue,
+        MapContainsKey,
+        MapFromArrays,
+        MapKeys,
+        MapValues,
+    )
+    from spark_rapids_tpu.sqltypes import MapType
+
+    if isinstance(e, MapKeys):
+        arr = _ev(e.children[0], t)
+        return pa.array(
+            [None if m is None else [k for k, _ in m]
+             for m in arr.to_pylist()],
+            type=to_arrow_type(e.dtype))
+    if isinstance(e, MapValues):
+        arr = _ev(e.children[0], t)
+        return pa.array(
+            [None if m is None else [v for _, v in m]
+             for m in arr.to_pylist()],
+            type=to_arrow_type(e.dtype))
+    if isinstance(e, MapContainsKey):
+        arr = _ev(e.children[0], t)
+        key = _ev(e.children[1], t)
+        keys = (key.to_pylist() if not isinstance(key, pa.Scalar)
+                else [key.as_py()] * t.num_rows)
+        return pa.array(
+            [None if m is None or k is None
+             else any(mk == k for mk, _ in m)
+             for m, k in zip(arr.to_pylist(), keys)], type=pa.bool_())
+    if isinstance(e, GetMapValue) or (
+            isinstance(e, ElementAt)
+            and isinstance(e.children[0].dtype, MapType)):
+        arr = _ev(e.children[0], t)
+        key = _ev(e.children[1], t)
+        keys = (key.to_pylist() if not isinstance(key, pa.Scalar)
+                else [key.as_py()] * t.num_rows)
+        out = []
+        for m, k in zip(arr.to_pylist(), keys):
+            v = None
+            if m is not None and k is not None:
+                for mk, mv in m:
+                    if mk == k:
+                        v = mv
+                        break
+            out.append(v)
+        return pa.array(out, type=to_arrow_type(e.dtype))
+    if isinstance(e, MapFromArrays):
+        ka = _ev(e.children[0], t).to_pylist()
+        va = _ev(e.children[1], t).to_pylist()
+        out = []
+        for ks, vs in zip(ka, va):
+            if ks is None or vs is None or len(ks) != len(vs):
+                out.append(None)
+            else:
+                out.append(list(zip(ks, vs)))
+        return pa.array(out, type=to_arrow_type(e.dtype))
+    if isinstance(e, CreateMap):
+        cols = [eval_expr(c, t).to_pylist() for c in e.children]
+        out = []
+        for i in range(t.num_rows):
+            ks = [cols[j][i] for j in range(0, len(cols), 2)]
+            vs = [cols[j][i] for j in range(1, len(cols), 2)]
+            if any(k is None for k in ks):
+                out.append(None)
+            else:
+                out.append(list(zip(ks, vs)))
+        return pa.array(out, type=to_arrow_type(e.dtype))
+    return None

@@ -291,17 +291,16 @@ class First(AggregateFunction):
     _take_last = False  # Last flips to a segment_max over positions
 
     def _first(self, values: DeviceColumn, valid, gid, cap):
-        import jax
-
         n = values.data.shape[0]
         pos = jnp.arange(n, dtype=jnp.int32)
+        ones = jnp.ones((n,), bool)
         if self._take_last:
-            fp = jax.ops.segment_max(jnp.where(valid, pos, -1), gid,
-                                     num_segments=cap)
+            fp = segmented.seg_max(jnp.where(valid, pos, -1), ones,
+                                   gid, cap)
             found = fp >= 0
         else:
-            fp = jax.ops.segment_min(jnp.where(valid, pos, n), gid,
-                                     num_segments=cap)
+            fp = segmented.seg_min(jnp.where(valid, pos, n), ones,
+                                   gid, cap)
             found = fp < n
         safe = jnp.clip(fp, 0, n - 1)
         data = jnp.take(values.data, safe, axis=0)
@@ -668,12 +667,11 @@ def _eq_nan_aware(a, b):
 
 def _seg_exclusive_ranks(valid, gid, cap):
     """Rank of each valid row within its (contiguous, sorted) segment."""
-    import jax
-
     csum = jnp.cumsum(valid.astype(jnp.int32)) - valid.astype(jnp.int32)
     n = valid.shape[0]
-    pos = jnp.arange(n, dtype=jnp.int32)
-    fp = jax.ops.segment_min(pos, gid, num_segments=cap)
+    # contiguous gid: first position of segment g by binary search
+    fp = jnp.searchsorted(gid, jnp.arange(cap, dtype=gid.dtype),
+                          side="left")
     base = jnp.take(csum, jnp.clip(fp, 0, n - 1))
     return csum - jnp.take(base, gid)
 

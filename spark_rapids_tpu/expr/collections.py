@@ -94,13 +94,22 @@ class GetArrayItem(Expression):
 
 
 class ElementAt(Expression):
-    """element_at(array, i): 1-based, negative counts from the end."""
+    """element_at(array, i): 1-based, negative counts from the end;
+    element_at(map, key): value lookup (GetMapValue semantics)."""
 
     def __init__(self, arr: Expression, index: Expression):
         super().__init__([arr, index])
 
     @property
+    def _is_map(self):
+        from spark_rapids_tpu.sqltypes import MapType
+
+        return isinstance(self.children[0].dtype, MapType)
+
+    @property
     def dtype(self):
+        if self._is_map:
+            return self.children[0].dtype.valueType
         return self.children[0].dtype.elementType
 
     @property
@@ -108,6 +117,8 @@ class ElementAt(Expression):
         return True
 
     def eval(self, ctx):
+        if self._is_map:
+            return GetMapValue(*self.children).eval(ctx)
         c = self.children[0].eval(ctx)
         i = self.children[1].eval(ctx)
         raw = i.data.astype(jnp.int32)
@@ -404,3 +415,179 @@ class SortArray(Expression):
         data = jnp.take_along_axis(c.data, order, axis=1)
         ev = jnp.take_along_axis(c.elem_validity, order, axis=1)
         return DeviceColumn(self.dtype, data, c.validity, c.lengths, ev)
+
+
+# -------------------------------------------------------------- maps
+#
+# Map functions (reference collectionOperations.scala map rules +
+# complexTypeExtractors GetMapValue): device layout keeps keys in the
+# column's data matrix and values in map_values (sqltypes MapType).
+
+
+class MapKeys(Expression):
+    """map_keys(m) -> array<k>."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        from spark_rapids_tpu.sqltypes import ArrayType
+
+        return ArrayType(self.children[0].dtype.keyType, False)
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        me = c.data.shape[1]
+        in_row = (jnp.arange(me, dtype=jnp.int32)[None, :]
+                  < c.lengths[:, None])
+        return DeviceColumn(self.dtype, c.data, c.validity, c.lengths,
+                            in_row)
+
+
+class MapValues(Expression):
+    """map_values(m) -> array<v>."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        from spark_rapids_tpu.sqltypes import ArrayType
+
+        mt = self.children[0].dtype
+        return ArrayType(mt.valueType, mt.valueContainsNull)
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        return DeviceColumn(self.dtype, c.map_values, c.validity,
+                            c.lengths, c.elem_validity)
+
+
+class MapContainsKey(Expression):
+    def __init__(self, m: Expression, key: Expression):
+        super().__init__([m, key])
+
+    @property
+    def dtype(self):
+        from spark_rapids_tpu.sqltypes.datatypes import boolean
+
+        return boolean
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.expr.core import binary_validity
+        from spark_rapids_tpu.sqltypes.datatypes import boolean
+
+        c = self.children[0].eval(ctx)
+        k = self.children[1].eval(ctx)
+        me = c.data.shape[1]
+        in_row = (jnp.arange(me, dtype=jnp.int32)[None, :]
+                  < c.lengths[:, None])
+        hit = in_row & (c.data == k.data[:, None])
+        return DeviceColumn(boolean, hit.any(axis=1),
+                            binary_validity(c, k))
+
+
+class GetMapValue(Expression):
+    """m[key] / element_at(m, key): first matching key's value, null
+    when absent (GetMapValue non-ANSI semantics)."""
+
+    def __init__(self, m: Expression, key: Expression):
+        super().__init__([m, key])
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype.valueType
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        k = self.children[1].eval(ctx)
+        me = c.data.shape[1]
+        in_row = (jnp.arange(me, dtype=jnp.int32)[None, :]
+                  < c.lengths[:, None])
+        hit = in_row & (c.data == k.data[:, None])
+        # first match position (me when absent)
+        pos = jnp.where(hit, jnp.arange(me, dtype=jnp.int32)[None, :],
+                        me).min(axis=1)
+        found = pos < me
+        safe = jnp.clip(pos, 0, me - 1).astype(jnp.int64)
+        vals = jnp.take_along_axis(c.map_values, safe[:, None],
+                                   axis=1)[:, 0]
+        vv = jnp.take_along_axis(c.elem_validity, safe[:, None],
+                                 axis=1)[:, 0]
+        valid = c.validity & k.validity & found & vv
+        return DeviceColumn(self.dtype, vals, valid)
+
+
+class MapFromArrays(Expression):
+    """map_from_arrays(keys_array, values_array)."""
+
+    def __init__(self, keys: Expression, values: Expression):
+        super().__init__([keys, values])
+
+    @property
+    def dtype(self):
+        from spark_rapids_tpu.sqltypes import MapType
+
+        ka = self.children[0].dtype
+        va = self.children[1].dtype
+        return MapType(ka.elementType, va.elementType)
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.expr.core import binary_validity
+
+        ka = self.children[0].eval(ctx)
+        va = self.children[1].eval(ctx)
+        me = max(ka.data.shape[1], va.data.shape[1])
+
+        def pad(m):
+            return jnp.pad(m, ((0, 0), (0, me - m.shape[1])))
+
+        kd, vd = pad(ka.data), pad(va.data)
+        vv = pad(va.elem_validity)
+        # Spark errors on length mismatch / null keys (NULL_MAP_KEY);
+        # the non-ANSI engine nulls the row instead
+        same = ka.lengths == va.lengths
+        me_k = ka.data.shape[1]
+        in_row = (jnp.arange(me_k, dtype=jnp.int32)[None, :]
+                  < ka.lengths[:, None])
+        keys_ok = (~in_row | ka.elem_validity).all(axis=1)
+        return DeviceColumn(self.dtype, kd,
+                            binary_validity(ka, va) & same & keys_ok,
+                            ka.lengths, vv, vd)
+
+
+class CreateMap(Expression):
+    """map(k1, v1, k2, v2, ...) from scalar columns."""
+
+    def __init__(self, *kv: Expression):
+        assert kv and len(kv) % 2 == 0, "map() needs key/value pairs"
+        super().__init__(list(kv))
+
+    @property
+    def dtype(self):
+        from spark_rapids_tpu.sqltypes import MapType
+
+        return MapType(self.children[0].dtype, self.children[1].dtype)
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx):
+        cols = [c.eval(ctx) for c in self.children]
+        keys = cols[0::2]
+        vals = cols[1::2]
+        me = len(keys)
+        kd = jnp.stack([k.data for k in keys], axis=1)
+        vd = jnp.stack([v.data for v in vals], axis=1)
+        vv = jnp.stack([v.validity for v in vals], axis=1)
+        n = kd.shape[0]
+        lengths = jnp.full((n,), jnp.int32(me))
+        # a null KEY is illegal in Spark; non-ANSI: null out the row
+        kvalid = jnp.stack([k.validity for k in keys], axis=1).all(axis=1)
+        return DeviceColumn(self.dtype, kd, kvalid, lengths, vv, vd)

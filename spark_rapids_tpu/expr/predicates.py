@@ -102,7 +102,10 @@ def _coerce_numeric(lc: DeviceColumn, rc: DeviceColumn):
         ls = lt.scale if ld else 0
         rs = rt.scale if rd else 0
         s = max(ls, rs)
-        if lc.data.ndim == 2 or rc.data.ndim == 2:
+        lp = lt.precision if ld else 19
+        rp = rt.precision if rd else 19
+        need = max(lp - ls, rp - rs) + s  # digits at the common scale
+        if lc.data.ndim == 2 or rc.data.ndim == 2 or need > 18:
             # DECIMAL128 on either side: widen BOTH to limb pairs at
             # the common scale so the limb keys align
             from spark_rapids_tpu.ops import decimal128 as _d128

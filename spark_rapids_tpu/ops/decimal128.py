@@ -218,10 +218,11 @@ def seg_sum128(hi, lo, valid, gid, cap: int):
         _s(u_lo & _M32), _s(u_lo >> jnp.uint64(32)),
         _s(u_hi & _M32), _s(u_hi >> jnp.uint64(32)),
     ]
+    from spark_rapids_tpu.ops import segmented as _seg
+
     sums = []
     for limb in limbs:
-        masked = jnp.where(valid, limb, 0)
-        sums.append(jax.ops.segment_sum(masked, gid, num_segments=cap))
+        sums.append(_seg.seg_sum(limb, valid, gid, cap))
     c = jnp.zeros_like(sums[0])
     out = []
     for s_ in sums:

@@ -71,14 +71,16 @@ def group_by(batch: ColumnBatch, key_idxs: Sequence[int]) -> GroupedBatch:
 
 def seg_count(valid: jnp.ndarray, gid: jnp.ndarray, cap: int) -> jnp.ndarray:
     return jax.ops.segment_sum(valid.astype(jnp.int64), gid,
-                               num_segments=cap)
+                               num_segments=cap,
+                               indices_are_sorted=True)
 
 
 def seg_sum(values: jnp.ndarray, valid: jnp.ndarray, gid: jnp.ndarray,
             cap: int) -> jnp.ndarray:
     zero = jnp.zeros((), dtype=values.dtype)
     return jax.ops.segment_sum(jnp.where(valid, values, zero), gid,
-                               num_segments=cap)
+                               num_segments=cap,
+                               indices_are_sorted=True)
 
 
 def seg_min(values: jnp.ndarray, valid: jnp.ndarray, gid: jnp.ndarray,
@@ -88,7 +90,8 @@ def seg_min(values: jnp.ndarray, valid: jnp.ndarray, gid: jnp.ndarray,
     else:
         ident = jnp.array(jnp.iinfo(values.dtype).max, dtype=values.dtype)
     return jax.ops.segment_min(jnp.where(valid, values, ident), gid,
-                               num_segments=cap)
+                               num_segments=cap,
+                               indices_are_sorted=True)
 
 
 def seg_max(values: jnp.ndarray, valid: jnp.ndarray, gid: jnp.ndarray,
@@ -98,7 +101,8 @@ def seg_max(values: jnp.ndarray, valid: jnp.ndarray, gid: jnp.ndarray,
     else:
         ident = jnp.array(jnp.iinfo(values.dtype).min, dtype=values.dtype)
     return jax.ops.segment_max(jnp.where(valid, values, ident), gid,
-                               num_segments=cap)
+                               num_segments=cap,
+                               indices_are_sorted=True)
 
 
 def seg_first(values: jnp.ndarray, first_pos_valid: jnp.ndarray

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from spark_rapids_tpu.columnar.batch import ColumnBatch, DeviceColumn
+from spark_rapids_tpu.ops import segmented
 from spark_rapids_tpu.ops.common import (
     normalize_floating,
     orderable_keys,
@@ -49,6 +50,10 @@ class SortedWindow(NamedTuple):
     seg_len: jnp.ndarray     # [cap]
     peer_start: jnp.ndarray  # [cap] first position of the ORDER BY peer run
     peer_end: jnp.ndarray    # [cap] last position of the peer run
+
+
+def _ones(x):
+    return jnp.ones(x.shape[:1], bool)
 
 
 def sort_for_window(batch: ColumnBatch,
@@ -85,10 +90,10 @@ def sort_for_window(batch: ColumnBatch,
     big = jnp.int32(cap)
     live_pos = jnp.where(live_s, pos, big)
     seg_start = jnp.take(
-        jax.ops.segment_min(live_pos, gid, num_segments=cap), gid)
+        segmented.seg_min(live_pos, _ones(live_pos), gid, cap), gid)
     seg_end = jnp.take(
-        jax.ops.segment_max(jnp.where(live_s, pos, -1), gid,
-                            num_segments=cap), gid)
+        segmented.seg_max(jnp.where(live_s, pos, -1), _ones(pos), gid,
+                          cap), gid)
     seg_len = seg_end - seg_start + 1
 
     if order_keys:
@@ -97,10 +102,10 @@ def sort_for_window(batch: ColumnBatch,
         pid = (jnp.cumsum(pboundary.astype(jnp.int32)) - 1).astype(jnp.int32)
         pid = jnp.clip(pid, 0, cap - 1)
         peer_start = jnp.take(
-            jax.ops.segment_min(live_pos, pid, num_segments=cap), pid)
+            segmented.seg_min(live_pos, _ones(live_pos), pid, cap), pid)
         peer_end = jnp.take(
-            jax.ops.segment_max(jnp.where(live_s, pos, -1), pid,
-                                num_segments=cap), pid)
+            segmented.seg_max(jnp.where(live_s, pos, -1), _ones(pos),
+                              pid, cap), pid)
     else:
         # no ORDER BY: every row in the partition is a peer
         peer_start, peer_end = seg_start, seg_end

@@ -160,6 +160,13 @@ class TpuOverrides:
                 "reference too)")
         elif isinstance(node, L.Window):
             self._tag_window(node, meta)
+        elif isinstance(node, L.FileScan):
+            from spark_rapids_tpu.plan.typesig import type_supported
+
+            for f in node.schema.fields:
+                r = type_supported(f.dataType)
+                if r:
+                    meta.cannot_run(f"column {f.name!r}: {r}")
         elif isinstance(node, L.LocalRelation):
             meta.cannot_run("in-memory relation stays host-side until "
                             "first device operator")

@@ -46,6 +46,19 @@ def type_supported(dt: DataType) -> Optional[str]:
             return (f"array element type {et.simpleString} runs on CPU "
                     "(device arrays hold primitive/64-bit elements in v1)")
         return type_supported(et)
+    from spark_rapids_tpu.sqltypes import MapType as _MT
+
+    if isinstance(dt, _MT):
+        for part, t in (("key", dt.keyType), ("value", dt.valueType)):
+            if isinstance(t, (StringType, ArrayType, _MT)) \
+                    or _wide_dec(t):
+                return (f"map {part} type {t.simpleString} runs on CPU "
+                        "(device maps hold primitive/64-bit entries "
+                        "in v1)")
+            r = type_supported(t)
+            if r:
+                return r
+        return None
     if not isinstance(dt, DEVICE_TYPES):
         return f"type {dt} not supported on device"
     return None
@@ -58,6 +71,10 @@ def key_type_supported(dt: DataType) -> Optional[str]:
 
     if isinstance(dt, ArrayType):
         return "array-typed keys run on CPU (no orderable device keys)"
+    from spark_rapids_tpu.sqltypes import MapType as _MT2
+
+    if isinstance(dt, _MT2):
+        return "map-typed keys run on CPU (maps are not orderable)"
     if _wide_dec(dt):
         # the SHUFFLE hash of a >18-digit decimal needs Spark's
         # minimal-two's-complement-byte murmur3, not lowered yet

@@ -196,6 +196,40 @@ class ArrayType(DataType):
         return hash(("array", self.elementType, self.containsNull))
 
 
+class MapType(DataType):
+    """map<key, value> with primitive key/value types. Device layout
+    (columnar.batch): keys in the column's [cap, max_elems] data
+    matrix, values in a parallel map_values matrix, plus per-row entry
+    counts and per-entry value validity (keys are never null in Spark
+    maps) — the cuDF LIST<STRUCT<K,V>> layout re-thought as two padded
+    matrices for XLA static shapes."""
+
+    def __init__(self, keyType: DataType, valueType: DataType,
+                 valueContainsNull: bool = True):
+        self.keyType = keyType
+        self.valueType = valueType
+        self.valueContainsNull = valueContainsNull
+
+    @property
+    def simpleString(self):
+        return (f"map<{self.keyType.simpleString},"
+                f"{self.valueType.simpleString}>")
+
+    def __repr__(self):
+        return (f"MapType({self.keyType!r}, {self.valueType!r}, "
+                f"{self.valueContainsNull})")
+
+    def __eq__(self, other):
+        return (isinstance(other, MapType)
+                and other.keyType == self.keyType
+                and other.valueType == self.valueType
+                and other.valueContainsNull == self.valueContainsNull)
+
+    def __hash__(self):
+        return hash(("map", self.keyType, self.valueType,
+                     self.valueContainsNull))
+
+
 class StructField:
     def __init__(self, name: str, dataType: DataType, nullable: bool = True):
         self.name = name
@@ -318,6 +352,9 @@ def from_arrow_type(at) -> DataType:
         return DecimalType(at.precision, at.scale)
     if pa.types.is_list(at) or pa.types.is_large_list(at):
         return ArrayType(from_arrow_type(at.value_type))
+    if pa.types.is_map(at):
+        return MapType(from_arrow_type(at.key_type),
+                       from_arrow_type(at.item_type))
     if pa.types.is_dictionary(at):
         return from_arrow_type(at.value_type)
     raise TypeError(f"unsupported arrow type {at}")
@@ -343,6 +380,9 @@ def to_arrow_type(dt: DataType):
         return pa.decimal128(dt.precision, dt.scale)
     if isinstance(dt, ArrayType):
         return pa.list_(to_arrow_type(dt.elementType))
+    if isinstance(dt, MapType):
+        return pa.map_(to_arrow_type(dt.keyType),
+                       to_arrow_type(dt.valueType))
     try:
         return mapping[type(dt)]
     except KeyError:

@@ -71,7 +71,8 @@ class _WorkerProc:
         try:
             self.proc.stdin.close()
             self.proc.terminate()
-        except OSError:
+            self.proc.wait(timeout=5)  # reap; no zombies
+        except (OSError, Exception):
             pass
 
 
@@ -89,6 +90,11 @@ class SubprocessPool:
             thread_name_prefix="srtpu-pandas-dispatch")
         self._workers = queue.SimpleQueue()
         for _ in range(num_workers):
+            self._workers.put(_WorkerProc())
+
+    def grow(self, extra: int):
+        self._threads._max_workers += extra  # ThreadPoolExecutor grows
+        for _ in range(extra):
             self._workers.put(_WorkerProc())
 
     def submit(self, fn, *args):
@@ -124,12 +130,16 @@ _pool_lock = threading.Lock()
 
 
 def get_worker_pool(num_workers: int = 4) -> SubprocessPool:
+    """Grow-only: resizing up adds workers; shrinking keeps the larger
+    pool (rebuilding under in-flight dispatches would strand busy
+    workers in an abandoned queue)."""
     global _pool, _pool_workers
     with _pool_lock:
-        if _pool is None or _pool_workers != num_workers:
-            if _pool is not None:
-                _pool.shutdown(wait=False)
+        if _pool is None:
             _pool = SubprocessPool(num_workers)
+            _pool_workers = num_workers
+        elif num_workers > _pool_workers:
+            _pool.grow(num_workers - _pool_workers)
             _pool_workers = num_workers
         return _pool
 
@@ -283,36 +293,31 @@ def apply_in_pandas_grouped(fn, key_names, table: pa.Table,
 def map_in_pandas(fn, table: pa.Table, out_schema: pa.Schema,
                   chunk_rows: int = 65536,
                   num_workers: int = 4) -> pa.Table:
-    """df.mapInPandas driver side: the iterator-of-frames contract is
-    delivered chunk-by-chunk through the worker pool."""
+    """df.mapInPandas driver side. Spark contract: the function runs
+    ONCE per partition over an iterator of batches (state may carry
+    across the iterator), so the whole partition ships to one worker,
+    which feeds the function chunk-sized frames."""
     from srtpu_pandas_worker import worker_apply_df
 
+    names = out_schema.names
+
     def once(df):
-        # user fn takes an iterator of frames and yields frames
         import pandas as pd
 
-        outs = list(fn(iter([df])))
+        # re-chunk inside the worker so fn sees the iterator contract
+        chunks = [df.iloc[i:i + chunk_rows]
+                  for i in range(0, max(len(df), 1), chunk_rows)]
+        outs = [o for o in fn(iter(chunks)) if len(o)]
         if not outs:
-            import pandas as pd
-
-            return pd.DataFrame()
+            return pd.DataFrame({c: [] for c in names})
         return pd.concat(outs, ignore_index=True)
 
     fn_bytes = pickle_fn(once)
     blob = _schema_blob(out_schema)
     pool = get_worker_pool(num_workers)
-    futures = []
-    for off in range(0, max(table.num_rows, 1), chunk_rows):
-        piece = table.slice(off, min(chunk_rows,
-                                     table.num_rows - off))
-        if piece.num_rows == 0 and table.num_rows > 0:
-            break
-        futures.append(pool.submit(worker_apply_df, fn_bytes,
-                                   _ipc_bytes(piece), blob))
-    parts = [_ipc_table(f.result()) for f in futures]
-    if not parts:
-        return out_schema.empty_table()
-    return pa.concat_tables(parts, promote_options="none")
+    fut = pool.submit(worker_apply_df, fn_bytes, _ipc_bytes(table),
+                      blob)
+    return _ipc_table(fut.result())
 
 
 def apply_in_pandas_cogrouped(fn, key_names, left: pa.Table,

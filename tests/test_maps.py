@@ -1,0 +1,117 @@
+"""MapType + map functions (reference: map rules in
+collectionOperations.scala, GetMapValue in complexTypeExtractors,
+GpuCreateMap) — device layout is parallel key/value padded matrices."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.testing.asserts import (
+    assert_tpu_and_cpu_are_equal_collect,
+    with_tpu_session,
+)
+
+
+@pytest.fixture(scope="module")
+def map_path(tmp_path_factory):
+    d = tmp_path_factory.mktemp("mapdata")
+    rng = np.random.default_rng(13)
+    rows = []
+    for i in range(2000):
+        if rng.random() < 0.05:
+            rows.append(None)
+        else:
+            n = int(rng.integers(0, 5))
+            keys = rng.choice(20, size=n, replace=False)
+            rows.append([(int(k), float(rng.random()) if
+                          rng.random() > 0.1 else None)
+                         for k in keys])
+    t = pa.table({
+        "id": pa.array(range(2000)),
+        "m": pa.array(rows, type=pa.map_(pa.int64(), pa.float64())),
+    })
+    p = str(d / "maps.parquet")
+    pq.write_table(t, p)
+    return p
+
+
+def test_map_scan_roundtrip(map_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.read.parquet(map_path))
+
+
+def test_map_keys_values_size(map_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.read.parquet(map_path).select(
+            "id",
+            F.map_keys("m").alias("ks"),
+            F.map_values("m").alias("vs"),
+            F.size("m").alias("n")))
+
+
+def test_get_map_value(map_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.read.parquet(map_path).select(
+            "id",
+            F.element_at("m", F.lit(3)).alias("v3"),
+            F.map_contains_key("m", 3).alias("has3"),
+            F.map_contains_key("m", 99).alias("has99")))
+
+
+def test_create_map_and_from_arrays(map_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.read.parquet(map_path).select(
+            "id",
+            F.create_map(F.lit(1), F.col("id"),
+                         F.lit(2), F.col("id") * 2).alias("cm"),
+            F.map_from_arrays(F.map_keys("m"),
+                              F.map_values("m")).alias("rt")))
+
+
+def test_map_filter_on_lookup(map_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.read.parquet(map_path)
+        .filter(F.element_at("m", F.lit(5)) > 0.5)
+        .select("id"))
+
+
+def test_map_through_shuffle(map_path):
+    """Map columns survive the exchange (first/any aggregation keeps
+    the map payload)."""
+    def q(spark):
+        return (spark.read.parquet(map_path)
+                .withColumn("b", F.col("id") % 7)
+                .groupBy("b").agg(F.count("*").alias("c"))
+                .collect_arrow())
+
+    out = with_tpu_session(
+        q, conf={"spark.sql.shuffle.partitions": 3})
+    assert out.num_rows == 7
+
+
+def test_map_grouping_key_rejected(map_path):
+    """Spark disallows map grouping keys (maps are not orderable)."""
+    def q(spark):
+        with pytest.raises(ValueError, match="not.*orderable|map"):
+            spark.read.parquet(map_path).groupBy("m").agg(
+                F.count("*").alias("c"))
+        return True
+
+    assert with_tpu_session(q)
+
+
+def test_string_valued_map_falls_back(tmp_path):
+    t = pa.table({"m": pa.array([[(1, "a")], [(2, "b")]],
+                                type=pa.map_(pa.int64(), pa.string()))})
+    p = str(tmp_path / "sm.parquet")
+    pq.write_table(t, p)
+    from spark_rapids_tpu.testing.asserts import (
+        assert_tpu_fallback_collect,
+    )
+
+    assert_tpu_fallback_collect(
+        lambda spark: spark.read.parquet(p).select(
+            F.map_values("m").alias("v")),
+        fallback_class="CpuProjectExec")

@@ -159,3 +159,40 @@ def test_apply_in_pandas_after_device_ops():
     out = with_tpu_session(q)
     want_n = (t.to_pandas().v > 0.2).sum()
     assert out.num_rows == want_n
+
+
+def test_map_in_pandas_partition_iterator_contract():
+    """Spark contract: ONE invocation per partition over an iterator of
+    all batches (state carries across chunks)."""
+    t = _data(2000)
+
+    def summarize(it):
+        import pandas as pd
+
+        total = sum(len(pdf) for pdf in it)
+        yield pd.DataFrame({"n": [total]})
+
+    def q(spark):
+        return (spark.createDataFrame(t)
+                .mapInPandas(summarize, "n bigint").collect_arrow())
+
+    out = with_tpu_session(q)
+    assert out.num_rows == 1
+    assert out.column("n")[0].as_py() == 2000
+
+
+def test_map_in_pandas_empty_yield():
+    t = _data(100)
+
+    def nothing(it):
+        for pdf in it:
+            if False:
+                yield pdf
+
+    def q(spark):
+        return (spark.createDataFrame(t)
+                .mapInPandas(nothing, "k bigint, v double")
+                .collect_arrow())
+
+    out = with_tpu_session(q)
+    assert out.num_rows == 0
