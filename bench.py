@@ -144,6 +144,20 @@ def main():
                 _PEAK_BW["cpu"])
     roofline = dev_gbps * 1e9 / peak
 
+    # characterize the host<->device link so absolute numbers are
+    # interpretable: tunneled/relayed devices add a fixed per-dispatch
+    # roundtrip that dominates multi-operator pipelines
+    probe = jax.device_put(np.zeros(1 << 20))
+    jax.block_until_ready(probe)
+    t0 = time.perf_counter()
+    for _ in range(5):
+        jax.device_get(probe[:8])
+    rt_ms = (time.perf_counter() - t0) / 5 * 1000
+    big = np.zeros(1 << 25)
+    t0 = time.perf_counter()
+    jax.block_until_ready(jax.device_put(big))
+    h2d = big.nbytes / (time.perf_counter() - t0) / 1e9
+
     print(json.dumps({
         "metric": f"q5-slice engine end-to-end throughput ({dev.platform},"
                   f" {ROWS} rows, {input_bytes >> 20} MiB)",
@@ -155,6 +169,8 @@ def main():
         "cpu_baseline_gbps": round(cpu_gbps, 3),
         "roofline_frac": round(roofline, 4),
         "device_kind": str(kind),
+        "link_roundtrip_ms": round(rt_ms, 1),
+        "link_h2d_gbps": round(h2d, 2),
     }))
 
 

@@ -195,9 +195,25 @@ def _matrices_to_map(kmat: np.ndarray, vmat: np.ndarray,
     flat_vv = vvalid[in_row]
     offsets = np.zeros(n + 1, dtype=np.int32)
     np.cumsum(lengths, out=offsets[1:])
-    keys = pa.array(flat_k, type=at.key_type)
-    items = pa.array(flat_v, type=at.item_type,
-                     mask=None if flat_vv.all() else ~flat_vv)
+
+    def child_array(flat, t, target_type, mask):
+        if isinstance(t, DecimalType):
+            import decimal as _dec
+
+            with _dec.localcontext() as _ctx:
+                _ctx.prec = 50
+                return pa.array(
+                    [_dec.Decimal(int(v)).scaleb(-t.scale)
+                     if ok else None
+                     for v, ok in zip(flat, (np.ones(len(flat), bool)
+                                             if mask is None else mask))],
+                    type=target_type)
+        return pa.array(flat, type=target_type,
+                        mask=None if mask is None or mask.all()
+                        else ~mask)
+
+    keys = child_array(flat_k, dt.keyType, at.key_type, None)
+    items = child_array(flat_v, dt.valueType, at.item_type, flat_vv)
     mask = None if validity.all() else pa.array(~validity)
     if mask is not None:
         # MapArray.from_arrays has no mask param in older pyarrow;
@@ -289,7 +305,10 @@ def arrow_to_device(table, capacity: Optional[int] = None,
     # ONE transfer for the whole batch: batched device_put is ~6x
     # faster than per-array jnp.asarray, and hugely so on tunneled
     # devices (make_column returns numpy-backed columns)
-    return jax.device_put(ColumnBatch(schema, cols, n))
+    out = jax.device_put(ColumnBatch(schema, cols, n))
+    out._host_rows = n  # pytree flatten devicified num_rows; keep the
+    # known count so the first row_count() is not a device roundtrip
+    return out
 
 
 def device_to_arrow(batch: ColumnBatch) -> pa.Table:

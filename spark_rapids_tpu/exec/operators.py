@@ -729,12 +729,14 @@ class TpuHashAggregateExec(PhysicalPlan):
                 for sb in pending:
                     sb.close()
                 pending = [park(compacted)]
-                pending_rows = compacted.row_count()
+                # capacity-based accounting: exact row_count() costs a
+                # device roundtrip per batch (64ms+ over device tunnels)
+                pending_rows = compacted.capacity
 
             for batch in self.children[0].execute_partition(pid, ctx):
                 if self.mode == "final":
                     pending.append(park(batch))
-                    pending_rows += batch.row_count()
+                    pending_rows += batch.capacity
                 else:
                     sb = park(batch)
 
@@ -746,7 +748,7 @@ class TpuHashAggregateExec(PhysicalPlan):
 
                     for part in with_retry(sb, part_fn):
                         pending.append(park(part))
-                        pending_rows += part.row_count()
+                        pending_rows += part.capacity
                 if len(pending) > 1 and pending_rows > 2 * target_rows:
                     reduce_pending()
 

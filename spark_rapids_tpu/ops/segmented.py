@@ -68,6 +68,11 @@ def group_by(batch: ColumnBatch, key_idxs: Sequence[int]) -> GroupedBatch:
 
 
 # --- segmented reduction primitives (masked; num_segments = capacity) ---
+#
+# PRECONDITION: gid must be SORTED ascending (group_by sorts rows
+# before every reduction). indices_are_sorted=True below is an XLA
+# correctness contract, not a hint — unsorted gids produce silently
+# wrong results on TPU.
 
 def seg_count(valid: jnp.ndarray, gid: jnp.ndarray, cap: int) -> jnp.ndarray:
     return jax.ops.segment_sum(valid.astype(jnp.int64), gid,

@@ -66,7 +66,8 @@ class SpillableBatch:
         self._disk_path: Optional[str] = None
         self._treedef = None
         self.size_bytes = batch.device_size_bytes()
-        self._rows = batch.row_count()
+        self._rows = None  # lazy: row_count() syncs the device (64ms+
+        # per roundtrip on tunneled devices; hundreds of parks per query)
         self.id = uuid.uuid4().hex[:12]
         self.closed = False
 
@@ -75,12 +76,26 @@ class SpillableBatch:
         return self._tier
 
     def row_count(self) -> int:
+        if self._rows is None:
+            # under the catalog lock: a concurrent spill (_to_host)
+            # pins _rows then clears _device_batch; racing it lock-free
+            # could cache a bogus 0
+            with self._catalog._lock:
+                if self._rows is None:
+                    b = self._device_batch
+                    if b is not None:
+                        self._rows = b.row_count()
+            if self._rows is None:
+                # spilled before first use: the host copy knows
+                self._catalog.unspill(self)
+                self._rows = self._device_batch.row_count()
         return self._rows
 
     # --- tier transitions (called under catalog lock) ---
 
     def _to_host(self):
         assert self._tier == SpillTier.DEVICE
+        self.row_count()  # pin before the device batch goes away
         leaves, treedef = jax.tree_util.tree_flatten(self._device_batch)
         self._host_data = [np.asarray(jax.device_get(x)) for x in leaves]
         self._treedef = treedef

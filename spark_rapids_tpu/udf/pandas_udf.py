@@ -71,8 +71,8 @@ class _WorkerProc:
         try:
             self.proc.stdin.close()
             self.proc.terminate()
-            self.proc.wait(timeout=5)  # reap; no zombies
-        except (OSError, Exception):
+            self.proc.wait(timeout=5)  # reap
+        except Exception:  # incl. TimeoutExpired: best-effort teardown
             pass
 
 
@@ -82,18 +82,23 @@ class SubprocessPool:
     PythonWorkerSemaphore): one dispatcher thread per worker, tasks
     queue through a shared executor."""
 
+    _MAX_DISPATCHERS = 64
+
     def __init__(self, num_workers: int):
         import queue
 
+        # dispatcher threads are cheap and idle-block on the worker
+        # queue; a fixed generous cap avoids resizing executor
+        # internals when the pool grows (true concurrency is bounded
+        # by the number of _WorkerProc entries in the queue)
         self._threads = ThreadPoolExecutor(
-            max_workers=num_workers,
+            max_workers=self._MAX_DISPATCHERS,
             thread_name_prefix="srtpu-pandas-dispatch")
         self._workers = queue.SimpleQueue()
         for _ in range(num_workers):
             self._workers.put(_WorkerProc())
 
     def grow(self, extra: int):
-        self._threads._max_workers += extra  # ThreadPoolExecutor grows
         for _ in range(extra):
             self._workers.put(_WorkerProc())
 
