@@ -845,6 +845,73 @@ def array_min(c) -> Column:
     return Column(ArrayMin(expr_of(c)), "array_min")
 
 
+def _coll(cls_name, *args):
+    from spark_rapids_tpu.expr import collections as CX
+
+    return Column(getattr(CX, cls_name)(*args))
+
+
+def slice(c, start, length) -> Column:  # noqa: A001
+    return _coll("Slice", expr_of(c), expr_of(lit_or(start)),
+                 expr_of(lit_or(length)))
+
+
+def array_position(c, v) -> Column:
+    return _coll("ArrayPosition", expr_of(c), expr_of(lit_or(v)))
+
+
+def array_remove(c, v) -> Column:
+    return _coll("ArrayRemove", expr_of(c), expr_of(lit_or(v)))
+
+
+def array_distinct(c) -> Column:
+    return _coll("ArrayDistinct", expr_of(c))
+
+
+def reverse(c) -> Column:
+    return _coll("Reverse", expr_of(c))
+
+
+def exists(c, fn) -> Column:
+    from spark_rapids_tpu.expr.collections import ArrayExists
+
+    return Column(ArrayExists(expr_of(c), fn=fn))
+
+
+def forall(c, fn) -> Column:
+    from spark_rapids_tpu.expr.collections import ArrayForall
+
+    return Column(ArrayForall(expr_of(c), fn=fn))
+
+
+def array_union(a, b) -> Column:
+    return _coll("ArrayUnion", expr_of(a), expr_of(b))
+
+
+def array_intersect(a, b) -> Column:
+    return _coll("ArrayIntersect", expr_of(a), expr_of(b))
+
+
+def array_except(a, b) -> Column:
+    return _coll("ArrayExcept", expr_of(a), expr_of(b))
+
+
+def arrays_overlap(a, b) -> Column:
+    return _coll("ArraysOverlap", expr_of(a), expr_of(b))
+
+
+def concat_arrays(*cs) -> Column:
+    return _coll("ConcatArrays", *[expr_of(c) for c in cs])
+
+
+def approx_count_distinct(c, rsd: float = 0.05) -> Column:
+    """Exact distinct count (satisfies the approximation contract;
+    reference: HLL++ sketches. `rsd` accepted for API parity)."""
+    from spark_rapids_tpu.expr.aggregates import CountDistinct
+
+    return Column(CountDistinct(expr_of(c)))
+
+
 def map_keys(c) -> Column:
     from spark_rapids_tpu.expr.collections import MapKeys
 

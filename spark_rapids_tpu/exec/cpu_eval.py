@@ -90,8 +90,14 @@ def _ev(e: Expression, t: pa.Table):
         return pc.divide(a, b)  # arrow int division truncates toward zero
     if isinstance(e, (Remainder, Pmod)):
         out_t = to_arrow_type(e.dtype)
-        a = pc.cast(_ev(e.children[0], t), out_t)
-        b = pc.cast(_ev(e.children[1], t), out_t)
+
+        def _mat(x):
+            r = _ev(x, t)
+            if isinstance(r, pa.Scalar):
+                r = pa.array([r.as_py()] * t.num_rows, type=r.type)
+            return pc.cast(r, out_t)
+
+        a, b = _mat(e.children[0]), _mat(e.children[1])
         an, bn = a.to_numpy(zero_copy_only=False), b.to_numpy(
             zero_copy_only=False)
         mask = pc.or_kleene(pc.is_null(a), pc.or_kleene(
@@ -229,6 +235,9 @@ def _ev(e: Expression, t: pa.Table):
                    if s else 4)
         return eval_pandas_udf(e, t, num_workers=workers)
     r = _ev_maps(e, t)
+    if r is not None:
+        return r
+    r = _ev_array_breadth(e, t)
     if r is not None:
         return r
     r = _ev_collections(e, t)
@@ -1493,4 +1502,138 @@ def _ev_maps(e: Expression, t: pa.Table):
             else:
                 out.append(list(zip(ks, vs)))
         return pa.array(out, type=to_arrow_type(e.dtype))
+    return None
+
+
+def _ev_array_breadth(e: Expression, t: pa.Table):
+    """Oracle for the v2 array expressions (python list semantics)."""
+    from spark_rapids_tpu.expr.collections import (
+        ArrayDistinct,
+        ArrayExcept,
+        ArrayExists,
+        ArrayForall,
+        ArrayIntersect,
+        ArrayPosition,
+        ArrayRemove,
+        ArraysOverlap,
+        ArrayUnion,
+        ConcatArrays,
+        Reverse,
+        Slice,
+    )
+    from spark_rapids_tpu.sqltypes import StringType
+
+    def lists(x):
+        r = _ev(x, t)
+        if isinstance(r, pa.Scalar):
+            return [r.as_py()] * t.num_rows
+        return r.to_pylist()
+
+    def nan_eq(x, y):
+        if x is None or y is None:
+            return x is None and y is None
+        try:
+            import math
+
+            if math.isnan(x) and math.isnan(y):
+                return True
+        except TypeError:
+            pass
+        return x == y
+
+    def dedup(vals):
+        out = []
+        for v in vals:
+            if not any(nan_eq(v, o) for o in out):
+                out.append(v)
+        return out
+
+    if isinstance(e, Slice):
+        arrs, sts, lns = (lists(c) for c in e.children)
+        out = []
+        for a, st, ln in zip(arrs, sts, lns):
+            if a is None or st is None or ln is None or st == 0 \
+                    or ln < 0:
+                out.append(None)
+                continue
+            b = st - 1 if st > 0 else len(a) + st
+            out.append([] if b < 0 else a[b:b + ln])
+        return pa.array(out, type=to_arrow_type(e.dtype))
+    if isinstance(e, ArrayPosition):
+        arrs, vals = (lists(c) for c in e.children)
+        out = []
+        for a, v in zip(arrs, vals):
+            if a is None or v is None:
+                out.append(None)
+                continue
+            idx = next((i + 1 for i, x in enumerate(a)
+                        if x is not None and nan_eq(x, v)), 0)
+            out.append(idx)
+        return pa.array(out, type=pa.int64())
+    if isinstance(e, ArrayRemove):
+        arrs, vals = (lists(c) for c in e.children)
+        out = [None if a is None or v is None
+               else [x for x in a
+                     if x is None or not nan_eq(x, v)]
+               for a, v in zip(arrs, vals)]
+        return pa.array(out, type=to_arrow_type(e.dtype))
+    if isinstance(e, ArrayDistinct):
+        arrs = lists(e.children[0])
+        out = [None if a is None else dedup(a) for a in arrs]
+        return pa.array(out, type=to_arrow_type(e.dtype))
+    if isinstance(e, Reverse):
+        arrs = lists(e.children[0])
+        if isinstance(e.dtype, StringType):
+            return pa.array([None if a is None else a[::-1]
+                             for a in arrs], type=pa.string())
+        return pa.array([None if a is None else a[::-1]
+                         for a in arrs],
+                        type=to_arrow_type(e.dtype))
+    if isinstance(e, (ArrayUnion, ArrayIntersect, ArrayExcept)):
+        la, lb = (lists(c) for c in e.children)
+        out = []
+        for a, b in zip(la, lb):
+            if a is None or b is None:
+                out.append(None)
+                continue
+            if isinstance(e, ArrayUnion):
+                out.append(dedup(a + b))
+            elif isinstance(e, ArrayIntersect):
+                out.append(dedup([x for x in a
+                                  if any(nan_eq(x, y) for y in b)]))
+            else:
+                out.append(dedup([x for x in a
+                                  if not any(nan_eq(x, y)
+                                             for y in b)]))
+        return pa.array(out, type=to_arrow_type(e.dtype))
+    if isinstance(e, ArraysOverlap):
+        la, lb = (lists(c) for c in e.children)
+        out = []
+        for a, b in zip(la, lb):
+            if a is None or b is None:
+                out.append(None)
+                continue
+            common = any(x is not None and any(
+                nan_eq(x, y) for y in b if y is not None) for x in a)
+            if common:
+                out.append(True)
+            elif a and b and (None in a or None in b):
+                out.append(None)
+            else:
+                out.append(False)
+        return pa.array(out, type=pa.bool_())
+    if isinstance(e, ConcatArrays):
+        cols = [lists(c) for c in e.children]
+        out = []
+        for parts in zip(*cols):
+            if any(p is None for p in parts):
+                out.append(None)
+            else:
+                acc = []
+                for p in parts:
+                    acc.extend(p)
+                out.append(acc)
+        return pa.array(out, type=to_arrow_type(e.dtype))
+    if isinstance(e, (ArrayExists, ArrayForall)):
+        return None  # lambda: evaluated via the device path only
     return None

@@ -729,9 +729,10 @@ class TpuHashAggregateExec(PhysicalPlan):
                 for sb in pending:
                     sb.close()
                 pending = [park(compacted)]
-                # capacity-based accounting: exact row_count() costs a
-                # device roundtrip per batch (64ms+ over device tunnels)
-                pending_rows = compacted.capacity
+                # one exact sync per COMPACTION (rare) — a capacity
+                # estimate here could exceed the threshold permanently
+                # and re-trigger full merges on every input batch
+                pending_rows = compacted.row_count()
 
             for batch in self.children[0].execute_partition(pid, ctx):
                 if self.mode == "final":
@@ -875,8 +876,13 @@ class CpuHashAggregateExec(PhysicalPlan):
                 b = pair[names[1]].to_numpy(np.float64)
                 return float(((a - a.mean()) * (b - b.mean())).sum()
                              / (n - ddof))
-            v = _nn(x)
-            n = len(v)
+            if nm in ("var_pop", "var_samp", "stddev_pop",
+                      "stddev_samp", "skewness", "kurtosis",
+                      "percentile", "approx_percentile"):
+                # float conversion only for the numeric moments family
+                # (string inputs reach other branches, e.g. distinct)
+                v = _nn(x)
+                n = len(v)
             if nm in ("var_pop", "var_samp", "stddev_pop", "stddev_samp"):
                 ddof = 0 if nm.endswith("pop") else 1
                 if n < 1 + ddof:

@@ -591,3 +591,352 @@ class CreateMap(Expression):
         # a null KEY is illegal in Spark; non-ANSI: null out the row
         kvalid = jnp.stack([k.validity for k in keys], axis=1).all(axis=1)
         return DeviceColumn(self.dtype, kd, kvalid, lengths, vv, vd)
+
+
+# ------------------------------------------------- array breadth (v2)
+#
+# Reference: collectionOperations.scala rules (slice, array_position,
+# array_remove, array_distinct, reverse, exists/forall, set ops,
+# concat-of-arrays, arrays_overlap). Device idiom throughout: padded
+# [cap, max_elems] matrices, per-row compaction via stable argsort.
+
+
+def _in_row_mask(c: DeviceColumn):
+    me = c.data.shape[1]
+    return (jnp.arange(me, dtype=jnp.int32)[None, :]
+            < c.lengths[:, None])
+
+
+def _row_compact(c_dtype, data, ev, keep, validity):
+    """Keep flagged elements, left-compacted, preserving order."""
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    out = jnp.take_along_axis(data, order, axis=1)
+    oev = jnp.take_along_axis(ev & keep, order, axis=1)
+    lengths = jnp.sum(keep, axis=1).astype(jnp.int32)
+    return DeviceColumn(c_dtype, out, validity, lengths, oev)
+
+
+def _elem_eq(a, b, a_ok=None, b_ok=None):
+    """Pairwise element equality with NULL==NULL set semantics and
+    NaN==NaN."""
+    eq = a == b
+    if jnp.issubdtype(a.dtype, jnp.floating):
+        eq = eq | (jnp.isnan(a) & jnp.isnan(b))
+    if a_ok is not None:
+        eq = (eq & a_ok & b_ok) | (~a_ok & ~b_ok)
+    return eq
+
+
+class Slice(Expression):
+    """slice(arr, start, length): 1-based; negative start counts from
+    the end; start=0 -> null row (non-ANSI)."""
+
+    def __init__(self, arr: Expression, start: Expression,
+                 length: Expression):
+        super().__init__([arr, start, length])
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        st = self.children[1].eval(ctx)
+        ln = self.children[2].eval(ctx)
+        me = c.data.shape[1]
+        raw = st.data.astype(jnp.int32)
+        begin = jnp.where(raw > 0, raw - 1, c.lengths + raw)
+        want = jnp.clip(ln.data.astype(jnp.int32), 0, me)
+        j = jnp.arange(me, dtype=jnp.int32)[None, :]
+        src = begin[:, None] + j
+        # begin < 0 (|start| > length) -> empty result, NOT a partial
+        # window: a plain src >= 0 test would leave holes mid-row
+        inside = ((j < want[:, None]) & (begin >= 0)[:, None]
+                  & (src < c.lengths[:, None]))
+        safe = jnp.clip(src, 0, me - 1).astype(jnp.int64)
+        data = jnp.take_along_axis(c.data, safe, axis=1)
+        ev = jnp.take_along_axis(c.elem_validity, safe, axis=1) & inside
+        lengths = jnp.sum(inside, axis=1).astype(jnp.int32)
+        bad = (raw == 0) | (ln.data < 0)
+        valid = c.validity & st.validity & ln.validity & ~bad
+        return DeviceColumn(self.dtype, data, valid, lengths, ev)
+
+
+class ArrayPosition(Expression):
+    """array_position(arr, v): 1-based first index, 0 when absent."""
+
+    def __init__(self, arr: Expression, value: Expression):
+        super().__init__([arr, value])
+
+    @property
+    def dtype(self):
+        from spark_rapids_tpu.sqltypes.datatypes import long
+
+        return long
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.expr.core import binary_validity
+        from spark_rapids_tpu.sqltypes.datatypes import long
+
+        c = self.children[0].eval(ctx)
+        v = self.children[1].eval(ctx)
+        me = c.data.shape[1]
+        hit = (_in_row_mask(c) & c.elem_validity
+               & _elem_eq(c.data, v.data[:, None]))
+        pos = jnp.where(hit, jnp.arange(me, dtype=jnp.int64)[None, :],
+                        me).min(axis=1)
+        out = jnp.where(pos < me, pos + 1, 0)
+        return DeviceColumn(long, out, binary_validity(c, v))
+
+
+class ArrayRemove(Expression):
+    """array_remove(arr, v); v null -> null result (Spark)."""
+
+    def __init__(self, arr: Expression, value: Expression):
+        super().__init__([arr, value])
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval(self, ctx):
+        c = self.children[0].eval(ctx)
+        v = self.children[1].eval(ctx)
+        keep = _in_row_mask(c) & ~(
+            c.elem_validity & _elem_eq(c.data, v.data[:, None]))
+        out = _row_compact(self.dtype, c.data, c.elem_validity, keep,
+                           c.validity & v.validity)
+        return out
+
+
+class ArrayDistinct(Expression):
+    """array_distinct(arr): first occurrences, original order;
+    NULL==NULL and NaN==NaN dedupe."""
+
+    def __init__(self, arr: Expression):
+        super().__init__([arr])
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def eval(self, ctx):
+        return _distinct_of(self.children[0].eval(ctx))
+
+
+class Reverse(Expression):
+    """reverse(array) / reverse(string)."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.sqltypes import StringType
+
+        if isinstance(self.children[0].dtype, StringType):
+            # character-aware (UTF-8) reverse, NOT byte reverse
+            from spark_rapids_tpu.expr.strings import StringReverse
+
+            return StringReverse(self.children[0]).eval(ctx)
+        c = self.children[0].eval(ctx)
+        me = c.data.shape[1]
+        j = jnp.arange(me, dtype=jnp.int32)[None, :]
+        src = jnp.clip(c.lengths[:, None] - 1 - j, 0, me - 1) \
+            .astype(jnp.int64)
+        in_row = j < c.lengths[:, None]
+        data = jnp.where(in_row,
+                         jnp.take_along_axis(c.data, src, axis=1), 0)
+        ev = jnp.where(in_row, jnp.take_along_axis(
+            c.elem_validity, src, axis=1), False)
+        return DeviceColumn(self.dtype, data, c.validity, c.lengths, ev)
+
+
+class ArrayExists(_HigherOrder):
+    """exists(arr, x -> pred): 3-valued (any true -> true; else any
+    null -> null; else false)."""
+
+    @property
+    def dtype(self):
+        from spark_rapids_tpu.sqltypes.datatypes import boolean
+
+        return boolean
+
+    @property
+    def nullable(self):
+        return True
+
+    _forall = False
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.sqltypes.datatypes import boolean
+
+        c = self.children[0].eval(ctx)
+        cap, me = c.data.shape
+        pred = _eval_lambda(self._lam, c)
+        in_row = _in_row_mask(c)
+        # the lambda sees NULL elements (Spark evaluates it over them:
+        # exists(a, x -> isnull(x)) can decide on a null entry); only
+        # the PREDICATE's own null-ness makes a slot undecided
+        pv = pred.data.reshape(cap, me)
+        pok = pred.validity.reshape(cap, me) & in_row
+        if self._forall:
+            decided = (pok & ~pv).any(axis=1)   # a definite false
+            result = ~decided
+        else:
+            decided = (pok & pv).any(axis=1)    # a definite true
+            result = decided
+        has_null_verdict = (in_row & ~pred.validity.reshape(cap, me)
+                            ).any(axis=1)
+        valid = c.validity & (decided | ~has_null_verdict)
+        return DeviceColumn(boolean, result, valid)
+
+
+class ArrayForall(ArrayExists):
+    _forall = True
+
+
+class ConcatArrays(Expression):
+    """concat(arr1, arr2, ...) for array inputs."""
+
+    def __init__(self, *arrs: Expression):
+        super().__init__(list(arrs))
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def eval(self, ctx):
+        cols = [c.eval(ctx) for c in self.children]
+        me_out = sum(c.data.shape[1] for c in cols)
+        cap = cols[0].data.shape[0]
+        data = jnp.zeros((cap, me_out), cols[0].data.dtype)
+        ev = jnp.zeros((cap, me_out), bool)
+        offset = jnp.zeros((cap,), jnp.int32)
+        for c in cols:
+            me = c.data.shape[1]
+            j = jnp.arange(me, dtype=jnp.int32)[None, :]
+            dest = offset[:, None] + j
+            inside = j < c.lengths[:, None]
+            dest_safe = jnp.where(inside, dest, me_out)
+            rows = jnp.broadcast_to(
+                jnp.arange(cap)[:, None], (cap, me))
+            data = data.at[rows, dest_safe].set(
+                c.data, mode="drop")
+            ev = ev.at[rows, dest_safe].set(
+                c.elem_validity & inside, mode="drop")
+            offset = offset + c.lengths
+        valid = cols[0].validity
+        for c in cols[1:]:
+            valid = valid & c.validity
+        return DeviceColumn(self.dtype, data, valid,
+                            offset.astype(jnp.int32), ev)
+
+
+class _ArraySetOp(Expression):
+    """Pairwise-membership set ops (array_union/intersect/except,
+    arrays_overlap) with NULL==NULL semantics."""
+
+    def __init__(self, left: Expression, right: Expression):
+        super().__init__([left, right])
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype
+
+    def _membership(self, a: DeviceColumn, b: DeviceColumn):
+        """[cap, me_a] mask: element of a present in b."""
+        eq = _elem_eq(a.data[:, :, None], b.data[:, None, :],
+                      a.elem_validity[:, :, None],
+                      b.elem_validity[:, None, :])
+        both = (_in_row_mask(a)[:, :, None]
+                & _in_row_mask(b)[:, None, :])
+        return (eq & both).any(axis=2)
+
+
+class ArraysOverlap(_ArraySetOp):
+    @property
+    def dtype(self):
+        from spark_rapids_tpu.sqltypes.datatypes import boolean
+
+        return boolean
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.expr.core import binary_validity
+        from spark_rapids_tpu.sqltypes.datatypes import boolean
+
+        a = self.children[0].eval(ctx)
+        b = self.children[1].eval(ctx)
+        # Spark: true if any NON-NULL common element; null result when
+        # no common element but either side has a null element
+        eq = _elem_eq(a.data[:, :, None], b.data[:, None, :])
+        both_ok = (a.elem_validity[:, :, None]
+                   & b.elem_validity[:, None, :])
+        both = (_in_row_mask(a)[:, :, None]
+                & _in_row_mask(b)[:, None, :])
+        overlap = (eq & both_ok & both).any(axis=(1, 2))
+        has_null = ((_in_row_mask(a) & ~a.elem_validity).any(axis=1)
+                    | (_in_row_mask(b) & ~b.elem_validity).any(axis=1))
+        nonempty = (a.lengths > 0) & (b.lengths > 0)
+        valid = binary_validity(a, b) & (
+            overlap | ~(has_null & nonempty))
+        return DeviceColumn(self.dtype, overlap, valid)
+
+
+class ArrayIntersect(_ArraySetOp):
+    def eval(self, ctx):
+        from spark_rapids_tpu.expr.core import binary_validity
+
+        a = self.children[0].eval(ctx)
+        b = self.children[1].eval(ctx)
+        keep = _in_row_mask(a) & self._membership(a, b)
+        interim = _row_compact(self.dtype, a.data, a.elem_validity,
+                               keep, binary_validity(a, b))
+        return _distinct_of(interim)
+
+
+class ArrayExcept(_ArraySetOp):
+    def eval(self, ctx):
+        from spark_rapids_tpu.expr.core import binary_validity
+
+        a = self.children[0].eval(ctx)
+        b = self.children[1].eval(ctx)
+        keep = _in_row_mask(a) & ~self._membership(a, b)
+        interim = _row_compact(self.dtype, a.data, a.elem_validity,
+                               keep, binary_validity(a, b))
+        return _distinct_of(interim)
+
+
+class ArrayUnion(_ArraySetOp):
+    def eval(self, ctx):
+        # ConcatArrays already ANDs the input validities; evaluating
+        # the children again here would run their subtrees twice
+        cat = ConcatArrays(*self.children).eval(ctx)
+        return _distinct_of(cat)
+
+
+def _distinct_of(c: DeviceColumn) -> DeviceColumn:
+    """array_distinct over an already-evaluated column."""
+    in_row = _in_row_mask(c)
+    eq = _elem_eq(c.data[:, :, None], c.data[:, None, :],
+                  c.elem_validity[:, :, None],
+                  c.elem_validity[:, None, :])
+    me = c.data.shape[1]
+    earlier = (jnp.arange(me)[None, :, None]
+               > jnp.arange(me)[None, None, :])
+    both = in_row[:, :, None] & in_row[:, None, :]
+    dup = (eq & earlier & both).any(axis=2)
+    keep = in_row & ~dup
+    return _row_compact(c.dtype, c.data, c.elem_validity, keep,
+                        c.validity)

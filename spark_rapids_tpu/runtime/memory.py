@@ -82,13 +82,17 @@ class SpillableBatch:
             # could cache a bogus 0
             with self._catalog._lock:
                 if self._rows is None:
-                    b = self._device_batch
-                    if b is not None:
-                        self._rows = b.row_count()
-            if self._rows is None:
-                # spilled before first use: the host copy knows
-                self._catalog.unspill(self)
-                self._rows = self._device_batch.row_count()
+                    if self._device_batch is not None:
+                        self._rows = self._device_batch.row_count()
+                    elif self._host_data is not None:
+                        # num_rows is the LAST pytree leaf
+                        self._rows = int(self._host_data[-1])
+                    elif self._disk_path is not None:
+                        with np.load(self._disk_path) as z:
+                            self._rows = int(z[z.files[-1]])
+                    else:
+                        raise RuntimeError(
+                            "row_count() on a closed SpillableBatch")
         return self._rows
 
     # --- tier transitions (called under catalog lock) ---

@@ -82,25 +82,36 @@ class SubprocessPool:
     PythonWorkerSemaphore): one dispatcher thread per worker, tasks
     queue through a shared executor."""
 
-    _MAX_DISPATCHERS = 64
+    _DISPATCH_HEADROOM = 64
 
     def __init__(self, num_workers: int):
         import queue
 
         # dispatcher threads are cheap and idle-block on the worker
-        # queue; a fixed generous cap avoids resizing executor
-        # internals when the pool grows (true concurrency is bounded
-        # by the number of _WorkerProc entries in the queue)
+        # queue; size the executor with headroom so grow() never needs
+        # to resize executor internals (concurrency is bounded by the
+        # number of _WorkerProc entries in the queue)
         self._threads = ThreadPoolExecutor(
-            max_workers=self._MAX_DISPATCHERS,
+            max_workers=max(num_workers * 2, self._DISPATCH_HEADROOM),
             thread_name_prefix="srtpu-pandas-dispatch")
+        self._dispatch_cap = max(num_workers * 2,
+                                 self._DISPATCH_HEADROOM)
         self._workers = queue.SimpleQueue()
         for _ in range(num_workers):
             self._workers.put(_WorkerProc())
 
     def grow(self, extra: int):
+        import warnings
+
         for _ in range(extra):
             self._workers.put(_WorkerProc())
+        total = self._workers.qsize()
+        if total > self._dispatch_cap:
+            warnings.warn(
+                f"pandas worker pool grew to {total} workers but only "
+                f"{self._dispatch_cap} dispatcher threads exist; "
+                "concurrency is capped — create the session with the "
+                "larger worker count instead")
 
     def submit(self, fn, *args):
         name = fn.__name__

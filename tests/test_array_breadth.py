@@ -1,0 +1,132 @@
+"""Array-function breadth v2 (reference collectionOperations.scala:
+slice, array_position/remove/distinct, reverse, exists/forall, set
+operations, concat, arrays_overlap) + approx_count_distinct."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.testing.asserts import (
+    assert_tpu_and_cpu_are_equal_collect,
+    with_tpu_session,
+)
+
+
+@pytest.fixture(scope="module")
+def arr_path(tmp_path_factory):
+    d = tmp_path_factory.mktemp("arrdata")
+    rng = np.random.default_rng(17)
+    rows_a, rows_b = [], []
+    for i in range(1500):
+        if rng.random() < 0.05:
+            rows_a.append(None)
+        else:
+            n = int(rng.integers(0, 6))
+            rows_a.append([int(x) if rng.random() > 0.1 else None
+                           for x in rng.integers(0, 8, n)])
+        rows_b.append([int(x) for x in
+                       rng.integers(0, 8, rng.integers(0, 4))])
+    t = pa.table({
+        "id": pa.array(range(1500)),
+        "a": pa.array(rows_a, type=pa.list_(pa.int64())),
+        "b": pa.array(rows_b, type=pa.list_(pa.int64())),
+        "s": pa.array([f"str{i % 37}" for i in range(1500)]),
+    })
+    p = str(d / "arr.parquet")
+    pq.write_table(t, p)
+    return p
+
+
+def test_slice_and_position(arr_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.read.parquet(arr_path).select(
+            "id",
+            F.slice("a", 2, 2).alias("sl"),
+            F.slice("a", -2, 3).alias("slneg"),
+            F.array_position("a", 3).alias("p3")))
+
+
+def test_remove_distinct_reverse(arr_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.read.parquet(arr_path).select(
+            "id",
+            F.array_remove("a", 2).alias("rm"),
+            F.array_distinct("a").alias("dd"),
+            F.reverse("a").alias("rv"),
+            F.reverse("s").alias("rs")))
+
+
+def test_set_operations(arr_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.read.parquet(arr_path).select(
+            "id",
+            F.array_union("a", "b").alias("u"),
+            F.array_intersect("a", "b").alias("i"),
+            F.array_except("a", "b").alias("x"),
+            F.arrays_overlap("a", "b").alias("o"),
+            F.concat_arrays("a", "b").alias("c")))
+
+
+def test_exists_forall(arr_path):
+    """Higher-order predicates are device-evaluated (no CPU lambda
+    oracle); verify against python semantics."""
+    def q(spark):
+        return (spark.read.parquet(arr_path).select(
+            "id",
+            F.exists("a", lambda x: x > 5).alias("ex"),
+            F.forall("a", lambda x: x >= 0).alias("fa"))
+            .collect_arrow().to_pandas())
+
+    out = with_tpu_session(q)
+    src = pq.read_table(arr_path).column("a").to_pylist()
+    for i, a in enumerate(src[:400]):
+        if a is None:
+            assert out.ex[i] is None or np.isnan(out.ex[i]) \
+                if not isinstance(out.ex[i], (bool, np.bool_)) else True
+            continue
+        vals = [x for x in a if x is not None]
+        has_null = any(x is None for x in a)
+        want_ex = (True if any(x > 5 for x in vals)
+                   else (None if has_null else False))
+        got = out.ex[i]
+        if want_ex is None:
+            assert got is None or (not isinstance(
+                got, (bool, np.bool_)) and np.isnan(got))
+        else:
+            assert bool(got) == want_ex, (i, a, got, want_ex)
+
+
+def test_approx_count_distinct(arr_path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda spark: spark.read.parquet(arr_path)
+        .withColumn("g", F.col("id") % 4)
+        .groupBy("g").agg(F.approx_count_distinct("s").alias("d")))
+
+
+def test_reverse_is_character_aware():
+    """F.reverse on strings must reverse CODEPOINTS, not UTF-8 bytes
+    (regression: collections.Reverse shadowed StringReverse)."""
+    t = pa.table({"s": pa.array(["café", "日本語", "ab"])})
+
+    def q(spark):
+        return (spark.createDataFrame(t)
+                .select(F.reverse("s").alias("r")).collect_arrow())
+
+    out = with_tpu_session(q)
+    assert out.column("r").to_pylist() == ["éfac", "語本日", "ba"]
+
+
+def test_exists_decides_on_null_element():
+    """exists(a, x -> isnull(x)) decides TRUE on a null entry."""
+    t = pa.table({"a": pa.array([[1, None], [1, 2], []],
+                                type=pa.list_(pa.int64()))})
+
+    def q(spark):
+        return (spark.createDataFrame(t)
+                .select(F.exists("a", lambda x: x.isNull()).alias("e"))
+                .collect_arrow())
+
+    out = with_tpu_session(q)
+    assert out.column("e").to_pylist() == [True, False, False]
