@@ -179,6 +179,39 @@ def compute_windows(table: pa.Table, window_exprs: List[Alias]) -> pa.Table:
                             pos = hi if is_last else lo
                             result[i] = (inp_vals[idxs[pos]]
                                          if hi >= lo else None)
+                    elif fn.name in ("var_pop", "var_samp",
+                                     "stddev_pop", "stddev_samp"):
+                        import math
+
+                        ddof = 0 if fn.name.endswith("pop") else 1
+                        if len(vals) < 1 + ddof:
+                            result[i] = None
+                        else:
+                            mu = float(_pysum(vals)) / len(vals)
+                            m2 = sum((float(v) - mu) ** 2
+                                     for v in vals)
+                            var = m2 / (len(vals) - ddof)
+                            result[i] = (math.sqrt(var)
+                                         if fn.name.startswith("stddev")
+                                         else var)
+                    elif fn.name == "collect_list":
+                        result[i] = list(vals)
+                    elif fn.name == "collect_set":
+                        import math
+
+                        def _same(a, b):
+                            try:
+                                if math.isnan(a) and math.isnan(b):
+                                    return True
+                            except TypeError:
+                                pass
+                            return a == b
+
+                        seen = []
+                        for v in vals:
+                            if not any(_same(v, o) for o in seen):
+                                seen.append(v)
+                        result[i] = seen
                     else:
                         raise NotImplementedError(type(fn).__name__)
         out_arrays.append(pa.array(result,

@@ -315,11 +315,8 @@ class ArrayFilter(_HigherOrder):
                   c.lengths[:, None])
         keep = pred.data.reshape(cap, me) & pred.validity.reshape(
             cap, me) & in_row
-        order = jnp.argsort(~keep, axis=1, stable=True)
-        data = jnp.take_along_axis(c.data, order, axis=1)
-        ev = jnp.take_along_axis(c.elem_validity & keep, order, axis=1)
-        lengths = jnp.sum(keep, axis=1).astype(jnp.int32)
-        return DeviceColumn(self.dtype, data, c.validity, lengths, ev)
+        return _row_compact(self.dtype, c.data, c.elem_validity, keep,
+                            c.validity)
 
 
 class _ArrayReduce(Expression):

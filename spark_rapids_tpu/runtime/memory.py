@@ -99,7 +99,6 @@ class SpillableBatch:
 
     def _to_host(self):
         assert self._tier == SpillTier.DEVICE
-        self.row_count()  # pin before the device batch goes away
         leaves, treedef = jax.tree_util.tree_flatten(self._device_batch)
         self._host_data = [np.asarray(jax.device_get(x)) for x in leaves]
         self._treedef = treedef

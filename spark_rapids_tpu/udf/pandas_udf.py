@@ -91,11 +91,12 @@ class SubprocessPool:
         # queue; size the executor with headroom so grow() never needs
         # to resize executor internals (concurrency is bounded by the
         # number of _WorkerProc entries in the queue)
-        self._threads = ThreadPoolExecutor(
-            max_workers=max(num_workers * 2, self._DISPATCH_HEADROOM),
-            thread_name_prefix="srtpu-pandas-dispatch")
         self._dispatch_cap = max(num_workers * 2,
                                  self._DISPATCH_HEADROOM)
+        self._threads = ThreadPoolExecutor(
+            max_workers=self._dispatch_cap,
+            thread_name_prefix="srtpu-pandas-dispatch")
+        self._total_workers = num_workers
         self._workers = queue.SimpleQueue()
         for _ in range(num_workers):
             self._workers.put(_WorkerProc())
@@ -105,7 +106,8 @@ class SubprocessPool:
 
         for _ in range(extra):
             self._workers.put(_WorkerProc())
-        total = self._workers.qsize()
+        self._total_workers += extra
+        total = self._total_workers  # qsize() misses checked-out workers
         if total > self._dispatch_cap:
             warnings.warn(
                 f"pandas worker pool grew to {total} workers but only "

@@ -83,8 +83,10 @@ def test_exists_forall(arr_path):
     src = pq.read_table(arr_path).column("a").to_pylist()
     for i, a in enumerate(src[:400]):
         if a is None:
-            assert out.ex[i] is None or np.isnan(out.ex[i]) \
-                if not isinstance(out.ex[i], (bool, np.bool_)) else True
+            got0 = out.ex[i]
+            assert got0 is None or (
+                not isinstance(got0, (bool, np.bool_))
+                and np.isnan(got0)), (i, got0)
             continue
         vals = [x for x in a if x is not None]
         has_null = any(x is None for x in a)

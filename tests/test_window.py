@@ -366,3 +366,35 @@ def test_last_aggregate_and_window():
             "k", "o", F.last("v", ignorenulls=True).over(w).alias("lw"),
             F.first("v", ignorenulls=True).over(w).alias("fw")),
         conf={"spark.sql.shuffle.partitions": 2})
+
+
+def test_window_stddev_variance_cpu_fallback():
+    """Moment aggregates over windows run via the CPU window path
+    (planner-tagged: no framed device lowering in v1)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.window import Window
+    from spark_rapids_tpu.testing.asserts import with_tpu_session
+
+    rng = np.random.default_rng(5)
+    t = pa.table({"k": pa.array(rng.integers(0, 4, 400)),
+                  "v": pa.array(rng.random(400))})
+
+    def q(spark):
+        w = Window.partitionBy("k")
+        return (spark.createDataFrame(t)
+                .select("k", "v",
+                        F.stddev("v").over(w).alias("sd"),
+                        F.var_pop("v").over(w).alias("vp"))
+                .collect_arrow().to_pandas())
+
+    out = with_tpu_session(q)
+    import pandas as pd
+
+    pdf = t.to_pandas()
+    want_sd = pdf.groupby("k").v.transform("std")
+    want_vp = pdf.groupby("k").v.transform(lambda s: s.var(ddof=0))
+    assert np.allclose(out.sd.to_numpy(), want_sd.to_numpy())
+    assert np.allclose(out.vp.to_numpy(), want_vp.to_numpy())
