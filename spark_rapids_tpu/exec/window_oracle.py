@@ -197,21 +197,16 @@ def compute_windows(table: pa.Table, window_exprs: List[Alias]) -> pa.Table:
                     elif fn.name == "collect_list":
                         result[i] = list(vals)
                     elif fn.name == "collect_set":
-                        import math
-
-                        def _same(a, b):
-                            try:
-                                if math.isnan(a) and math.isnan(b):
-                                    return True
-                            except TypeError:
-                                pass
-                            return a == b
-
-                        seen = []
+                        # _hashable canonicalizes NaN/-0.0, so a set
+                        # gives NaN==NaN dedup in O(frame) per row
+                        seen = set()
+                        uniq = []
                         for v in vals:
-                            if not any(_same(v, o) for o in seen):
-                                seen.append(v)
-                        result[i] = seen
+                            h = _hashable(v)
+                            if h not in seen:
+                                seen.add(h)
+                                uniq.append(v)
+                        result[i] = uniq
                     else:
                         raise NotImplementedError(type(fn).__name__)
         out_arrays.append(pa.array(result,

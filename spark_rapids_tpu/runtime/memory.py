@@ -77,9 +77,9 @@ class SpillableBatch:
 
     def row_count(self) -> int:
         if self._rows is None:
-            # under the catalog lock: a concurrent spill (_to_host)
-            # pins _rows then clears _device_batch; racing it lock-free
-            # could cache a bogus 0
+            # the catalog RLock serializes against tier moves
+            # (_to_host/_to_disk also run under it); whichever tier the
+            # batch is on, its copy carries the count
             with self._catalog._lock:
                 if self._rows is None:
                     if self._device_batch is not None:
