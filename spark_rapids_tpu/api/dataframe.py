@@ -583,6 +583,20 @@ class DataFrame:
                 return out
             except MeshCompileError:
                 pass  # operator without a mesh lowering: thread-pool path
+        if self.session.rapids_conf.get(rc.FUSED_EXEC):
+            from spark_rapids_tpu.exec.fused import (
+                FusedCompileError,
+                FusedSingleChipExecutor,
+            )
+
+            try:
+                out = FusedSingleChipExecutor(
+                    self.session.rapids_conf).execute(phys)
+                if getattr(self, "_cached", False):
+                    self._cache_store(out)
+                return out
+            except FusedCompileError:
+                pass  # no fused lowering / too big: per-operator engine
         out = phys.collect()
         if getattr(self, "_cached", False):
             self._cache_store(out)

@@ -54,6 +54,28 @@ def schema_from_arrow(schema: pa.Schema) -> StructType:
     ])
 
 
+def _check_string_ceiling(max_len: int) -> None:
+    """Enforce spark.rapids.tpu.string.maxBytes: the padded-matrix
+    width adapts per column, but a pathological value (a megabyte blob)
+    would multiply the whole column's footprint — fail loudly with the
+    conf escape hatch instead."""
+    from spark_rapids_tpu.config import rapids_conf as rc
+
+    ceiling = rc.STRING_MAX_BYTES.default
+    try:
+        from spark_rapids_tpu.api.session import TpuSparkSession
+
+        s = TpuSparkSession.active()
+        if s is not None:
+            ceiling = s.rapids_conf.get(rc.STRING_MAX_BYTES)
+    except Exception:
+        pass
+    if max_len > ceiling:
+        raise ValueError(
+            f"string of {max_len} bytes exceeds the device padded-width "
+            f"ceiling {ceiling}; raise spark.rapids.tpu.string.maxBytes")
+
+
 def _string_to_matrix(arr: pa.Array, pad_to: Optional[int] = None):
     """Arrow utf8 array -> ([n, max_bytes] uint8, lengths int32) vectorized."""
     arr = arr.cast(pa.large_string()) if pa.types.is_string(arr.type) else arr
@@ -70,6 +92,7 @@ def _string_to_matrix(arr: pa.Array, pad_to: Optional[int] = None):
             np.zeros(1, dtype=np.uint8))
     lengths = (offsets[1:] - offsets[:-1]).astype(np.int32)
     max_len = int(lengths.max()) if len(lengths) else 0
+    _check_string_ceiling(max_len)
     mb = _round_up_pow2(max(max_len, 1), minimum=pad_to or 8)
     n = len(arr)
     idx = offsets[:-1, None] + np.arange(mb, dtype=np.int64)[None, :]
@@ -265,6 +288,35 @@ def _primitive_np(arr: pa.Array, dtype: DataType):
     return vals.astype(dtype.np_dtype), validity
 
 
+def column_from_arrow(arr, field, cap: int,
+                      string_pad_min: int = 8) -> DeviceColumn:
+    """One pyarrow array -> one capacity-padded host-numpy DeviceColumn
+    (shared by arrow_to_device and the fused executor's narrowed
+    upload)."""
+    if pa.types.is_dictionary(arr.type):
+        arr = arr.dictionary_decode()
+    if isinstance(field.dataType, StringType):
+        mat, lengths = _string_to_matrix(arr, pad_to=string_pad_min)
+        validity = np.asarray(arr.is_valid())
+        return make_column(field.dataType, mat, validity, cap,
+                           lengths=lengths)
+    if isinstance(field.dataType, ArrayType):
+        mat, lengths, ev = _list_to_matrix(
+            arr, field.dataType.elementType)
+        validity = np.asarray(arr.is_valid())
+        return make_column(field.dataType, mat, validity, cap,
+                           lengths=lengths, elem_validity=ev)
+    if isinstance(field.dataType, MapType):
+        kmat, vmat, lengths, vvalid = _map_to_matrices(
+            arr, field.dataType)
+        validity = np.asarray(arr.is_valid())
+        return make_column(field.dataType, (kmat, vmat),
+                           validity, cap, lengths=lengths,
+                           elem_validity=vvalid)
+    vals, validity = _primitive_np(arr, field.dataType)
+    return make_column(field.dataType, vals, validity, cap)
+
+
 def arrow_to_device(table, capacity: Optional[int] = None,
                     string_pad_min: int = 8) -> ColumnBatch:
     """pyarrow Table/RecordBatch -> device ColumnBatch."""
@@ -279,29 +331,7 @@ def arrow_to_device(table, capacity: Optional[int] = None,
         col = table.column(i)
         arr = (col.chunk(0) if col.num_chunks else
                pa.array([], type=table.schema.field(i).type))
-        if pa.types.is_dictionary(arr.type):
-            arr = arr.dictionary_decode()
-        if isinstance(field.dataType, StringType):
-            mat, lengths = _string_to_matrix(arr, pad_to=string_pad_min)
-            validity = np.asarray(arr.is_valid())
-            cols.append(make_column(field.dataType, mat, validity, cap,
-                                    lengths=lengths))
-        elif isinstance(field.dataType, ArrayType):
-            mat, lengths, ev = _list_to_matrix(
-                arr, field.dataType.elementType)
-            validity = np.asarray(arr.is_valid())
-            cols.append(make_column(field.dataType, mat, validity, cap,
-                                    lengths=lengths, elem_validity=ev))
-        elif isinstance(field.dataType, MapType):
-            kmat, vmat, lengths, vvalid = _map_to_matrices(
-                arr, field.dataType)
-            validity = np.asarray(arr.is_valid())
-            cols.append(make_column(field.dataType, (kmat, vmat),
-                                    validity, cap, lengths=lengths,
-                                    elem_validity=vvalid))
-        else:
-            vals, validity = _primitive_np(arr, field.dataType)
-            cols.append(make_column(field.dataType, vals, validity, cap))
+        cols.append(column_from_arrow(arr, field, cap, string_pad_min))
     # ONE transfer for the whole batch: batched device_put is ~6x
     # faster than per-array jnp.asarray, and hugely so on tunneled
     # devices (make_column returns numpy-backed columns)

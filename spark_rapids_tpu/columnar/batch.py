@@ -64,16 +64,21 @@ class DeviceColumn:
     """
 
     __slots__ = ("dtype", "data", "validity", "lengths",
-                 "elem_validity", "map_values")
+                 "elem_validity", "map_values", "vrange")
 
     def __init__(self, dtype: DataType, data, validity, lengths=None,
-                 elem_validity=None, map_values=None):
+                 elem_validity=None, map_values=None, vrange=None):
         self.dtype = dtype
         self.data = data          # maps: the KEY matrix
         self.validity = validity
         self.lengths = lengths
         self.elem_validity = elem_validity  # maps: VALUE validity
         self.map_values = map_values        # maps only: value matrix
+        # STATIC (lo, hi) bound on the column's integer values, stamped
+        # at upload time (quantized so refills retrace rarely). Enables
+        # the sort-free direct-binned group-by; ops that change values
+        # drop it (None).
+        self.vrange = vrange
 
     @property
     def is_string(self) -> bool:
@@ -136,18 +141,18 @@ class DeviceColumn:
             leaves.append(self.map_values)
         return tuple(leaves), (self.dtype, self.lengths is not None,
                                self.elem_validity is not None,
-                               self.map_values is not None)
+                               self.map_values is not None, self.vrange)
 
     @classmethod
     def _tree_unflatten(cls, aux, children):
-        dtype, has_len, has_ev, has_mv = aux
+        dtype, has_len, has_ev, has_mv, vrange = aux
         it = iter(children)
         data = next(it)
         validity = next(it)
         lengths = next(it) if has_len else None
         ev = next(it) if has_ev else None
         mv = next(it) if has_mv else None
-        return cls(dtype, data, validity, lengths, ev, mv)
+        return cls(dtype, data, validity, lengths, ev, mv, vrange)
 
 
 jax.tree_util.register_pytree_node(

@@ -185,9 +185,13 @@ RETRY_SPLIT_LIMIT = conf(
     "Maximum times a batch may be halved by split-and-retry before the "
     "query fails (reference GpuSplitAndRetryOOM taxonomy).", int)
 STRING_MAX_BYTES = conf(
-    "spark.rapids.tpu.string.maxBytes", 64,
-    "Default padded byte width of device string columns; longer strings "
-    "keep correctness via host fallback tagging.", int)
+    "spark.rapids.tpu.string.maxBytes", 8192,
+    "Hard ceiling on the ADAPTIVE padded byte width of device string "
+    "columns (each column pads to the power-of-two envelope of its "
+    "longest value; filter/sort/join/group-by on >=512B strings run on "
+    "device). Columns whose longest string exceeds the ceiling raise "
+    "rather than silently truncate — raise the conf for pathological "
+    "data.", int)
 SHUFFLE_MODE = conf(
     "spark.rapids.shuffle.mode", "MULTITHREADED",
     "MULTITHREADED (host-serialized, thread-pooled — reference "
@@ -239,6 +243,15 @@ MESH_SIZE = conf(
     "transport (the UCX P2P transport role, SURVEY.md 5.8); 0 = "
     "single-chip thread-pool engine. Plans with no mesh lowering fall "
     "back to the single-chip engine automatically.", int)
+FUSED_EXEC = conf(
+    "spark.rapids.sql.fusedExec.enabled", True,
+    "Compile whole query stages into a few fused XLA programs for "
+    "single-chip execution (per-partition scan chains + on-device "
+    "reduce; the one-device analog of the mesh compiler). The "
+    "per-operator eager engine pays one host<->device roundtrip per "
+    "kernel dispatch, which dominates on tunneled devices. Plans or "
+    "working sets the fused path cannot handle fall back to the "
+    "per-operator out-of-core engine automatically.", bool)
 CPU_ORACLE_ENABLED = conf(
     "spark.rapids.tpu.test.cpuOracle", False,
     "Internal: route this session through the CPU (pyarrow) backend; used "

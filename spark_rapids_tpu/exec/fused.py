@@ -1,0 +1,545 @@
+"""Whole-stage fusion for the single-chip engine.
+
+The eager engine executes planner output one operator dispatch at a
+time (the reference's hot loop: `GpuExec.internalDoExecuteColumnar`
+chaining one cuDF kernel per expression node, SURVEY.md section 3.3).
+On a tunneled TPU every dispatch pays a fixed host<->device roundtrip
+(~6 ms measured), so a multi-operator pipeline is dispatch-bound long
+before it is bandwidth-bound. This module compiles a whole query into
+a handful of XLA programs instead:
+
+- one fused PER-PARTITION program per scan task — the scan-side
+  operator chain (filter/project/partial-aggregate) plus a static
+  "shrink" that slices aggregate output down to a small capacity
+  bucket so concatenation stays cheap;
+- one fused REDUCE program per blocking operator (final aggregate,
+  sort, window, join, limit) that concatenates the per-partition
+  results ON DEVICE and applies the operator in the same program, so
+  a single-chip exchange costs zero host traffic (the one-device
+  analog of the mesh compiler's all_to_all lowering,
+  parallel/plan_compiler.py).
+
+Data-dependent sizes use the engine's standard static-capacity +
+overflow-flag discipline: join expansions and aggregate shrink caps
+are static; overflow raises TpuSplitAndRetryOOM on the host and the
+query re-runs with doubled factors (leaf batches stay device-resident
+across retries, so only the programs recompile).
+
+Host->device transfer is the other tunneled-link tax, so scan uploads
+are NARROWED: integer columns whose observed min/max fit a smaller
+width ship at that width and widen back to their logical dtype inside
+the fused program (the role nvcomp-compressed shuffle payloads play
+for the reference's PCIe transfers, TableCompressionCodec.scala).
+
+Plans containing operators without a fused lowering raise
+FusedCompileError; the session falls back to the per-operator
+out-of-core engine, which remains the path for HBM-exceeding inputs.
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.columnar.arrow_bridge import (
+    _primitive_np,
+    device_to_arrow,
+    schema_from_arrow,
+)
+from spark_rapids_tpu.columnar.batch import (
+    ColumnBatch,
+    DeviceColumn,
+    empty_like_schema,
+    next_capacity,
+)
+from spark_rapids_tpu.exec import joins as J
+from spark_rapids_tpu.exec import operators as ops
+from spark_rapids_tpu.exec.base import PhysicalPlan
+from spark_rapids_tpu.ops import filterops
+from spark_rapids_tpu.runtime.errors import TpuSplitAndRetryOOM
+from spark_rapids_tpu.sqltypes import StringType
+
+# capacity granularity for scan uploads: fine-grained (vs power-of-two
+# buckets) because padding bytes cross the tunneled link
+_UPLOAD_ALIGN = 1 << 16
+
+
+class FusedCompileError(NotImplementedError):
+    """Plan has no fused single-chip lowering (caller falls back to the
+    per-operator out-of-core engine)."""
+
+
+# ----------------------------------------------------- narrowed upload
+
+_NARROW_STEPS = {
+    np.dtype(np.int64): (np.int32, np.int16),
+    np.dtype(np.int32): (np.int16,),
+}
+
+
+def _quantize_range(lo: int, hi: int):
+    """Power-of-two envelope of an observed [lo, hi] so refills of the
+    same column land on the same static vrange (one trace, not one per
+    file)."""
+    hi_q = (1 << int(max(hi, 0)).bit_length()) - 1
+    lo_q = 0 if lo >= 0 else -(1 << int(-lo).bit_length())
+    return lo_q, hi_q
+
+
+def _narrow(vals: np.ndarray):
+    """-> (vals possibly narrowed, quantized (lo, hi) or None)."""
+    if vals.size == 0 or not np.issubdtype(vals.dtype, np.integer):
+        return vals, None
+    lo, hi = int(vals.min()), int(vals.max())
+    vrange = _quantize_range(lo, hi)
+    for cand in reversed(_NARROW_STEPS.get(vals.dtype, ())):
+        info = np.iinfo(cand)
+        if info.min <= lo and hi <= info.max:
+            return vals.astype(cand), vrange
+    return vals, vrange
+
+
+def upload_narrowed(table: pa.Table, capacity: Optional[int] = None,
+                    narrow: bool = True) -> ColumnBatch:
+    """pyarrow Table -> device ColumnBatch with integer columns shipped
+    at their observed width (widened back in-trace by `widen_traced`).
+    One device_put for the whole batch, like arrow_to_device."""
+    table = table.combine_chunks()
+    n = table.num_rows
+    cap = capacity or max(_UPLOAD_ALIGN,
+                          -(-max(n, 1) // _UPLOAD_ALIGN) * _UPLOAD_ALIGN)
+    schema = schema_from_arrow(table.schema)
+    cols: List[DeviceColumn] = []
+    for i, field in enumerate(schema.fields):
+        col = table.column(i)
+        arr = (col.chunk(0) if col.num_chunks else
+               pa.array([], type=table.schema.field(i).type))
+        if pa.types.is_dictionary(arr.type):
+            arr = arr.dictionary_decode()
+        dt = field.dataType
+        np_dt = getattr(dt, "np_dtype", None)
+        if (narrow and np_dt is not None
+                and np.issubdtype(np.dtype(np_dt), np.integer)
+                and not isinstance(dt, StringType)):
+            vals, validity = _primitive_np(arr, dt)
+            if getattr(vals, "ndim", 1) == 1:
+                vals, vrange = _narrow(np.ascontiguousarray(vals))
+                if validity is None:
+                    validity = np.ones(n, dtype=np.bool_)
+                data = np.zeros(cap, dtype=vals.dtype)
+                data[:n] = vals
+                vpad = np.zeros(cap, dtype=np.bool_)
+                vpad[:n] = validity
+                cols.append(DeviceColumn(dt, data, vpad, vrange=vrange))
+                continue
+        from spark_rapids_tpu.columnar.arrow_bridge import (
+            column_from_arrow,
+        )
+
+        cols.append(column_from_arrow(arr, field, cap))
+    return jax.device_put(ColumnBatch(schema, cols, n))
+
+
+def widen_traced(batch: ColumnBatch) -> ColumnBatch:
+    """In-trace inverse of the narrowed upload: restore each column's
+    logical dtype (free relative to HBM bandwidth; fused with the first
+    consumer by XLA)."""
+    cols = []
+    for c, f in zip(batch.columns, batch.schema.fields):
+        np_dt = getattr(f.dataType, "np_dtype", None)
+        if (np_dt is not None and c.data.ndim == 1
+                and c.data.dtype != np.dtype(np_dt)
+                and np.issubdtype(c.data.dtype, np.integer)):
+            c = DeviceColumn(c.dtype, c.data.astype(np_dt), c.validity,
+                             c.lengths, c.elem_validity, c.map_values,
+                             vrange=c.vrange)
+        cols.append(c)
+    return ColumnBatch(batch.schema, cols, batch.num_rows)
+
+
+def shrink_traced(batch: ColumnBatch, cap2: int):
+    """Slice a front-compacted batch to a smaller static capacity.
+    Aggregate outputs land compacted at segment-id positions
+    (ops/segmented.py), so the slice is exact unless the true row count
+    exceeds cap2 — reported via the overflow flag."""
+    if cap2 >= batch.capacity:
+        return batch, jnp.zeros((), bool)
+    nr = jnp.asarray(batch.num_rows, jnp.int32)
+    ovf = nr > cap2
+    cols = [DeviceColumn(
+        c.dtype, c.data[:cap2], c.validity[:cap2],
+        None if c.lengths is None else c.lengths[:cap2],
+        None if c.elem_validity is None else c.elem_validity[:cap2],
+        None if c.map_values is None else c.map_values[:cap2])
+        for c in batch.columns]
+    return ColumnBatch(batch.schema, cols, jnp.minimum(nr, cap2)), ovf
+
+
+# --------------------------------------------------------- the executor
+
+_SOURCE_TYPES = (ops.LocalRelationExec, ops.RangeExec, ops.TpuFileScanExec,
+                 ops.ArrowToDeviceExec)
+
+
+def _agg_jittable(node: ops.TpuHashAggregateExec) -> bool:
+    return all(a.children[0].jittable for a in node.aggs)
+
+
+class FusedSingleChipExecutor:
+    """Compile + run one physical plan as a few fused XLA programs on
+    the default (single) device."""
+
+    def __init__(self, conf=None, expansion: int = 4,
+                 group_cap: int = 1 << 16):
+        self.conf = conf
+        self._expansion = expansion
+        self._group_cap = group_cap
+
+    # --- source preparation (once; survives expansion retries) ---
+
+    def _collect_sources(self, node: PhysicalPlan,
+                         out: List[PhysicalPlan]) -> None:
+        if isinstance(node, _SOURCE_TYPES) or not node.is_tpu:
+            out.append(node)
+            return
+        for c in node.children:
+            self._collect_sources(c, out)
+
+    def _hbm_budget(self) -> int:
+        from spark_rapids_tpu.runtime.memory import get_catalog
+
+        return get_catalog().pool.limit
+
+    def _plain_file_batch(self, scan: ops.TpuFileScanExec,
+                          path: str) -> Optional[ColumnBatch]:
+        """Device-direct scan of one PLAIN parquet file
+        (io/parquet_plain.py): page payloads become zero-copy typed
+        views, integers narrow for the link, capacity == rows so no pad
+        copy touches the big float columns. None -> general reader."""
+        from spark_rapids_tpu.io.parquet_plain import read_plain_columns
+
+        if scan.fmt != "parquet" or scan.pushed_filters:
+            return None
+        names = [f.name for f in scan.schema.fields]
+        cols_np = read_plain_columns(path, names)
+        if cols_np is None:
+            return None
+        n = len(cols_np[names[0]])
+        cols: List[DeviceColumn] = []
+        for f in scan.schema.fields:
+            vals, vrange = _narrow(cols_np[f.name])
+            cols.append(DeviceColumn(
+                f.dataType, vals, np.ones(n, dtype=np.bool_),
+                vrange=vrange))
+        return jax.device_put(ColumnBatch(scan.schema, list(cols), n))
+
+    def _scan_parts(self, scan: ops.TpuFileScanExec) -> List[ColumnBatch]:
+        tasks = [t for t in scan._tasks if t]
+        if not tasks:
+            return [empty_like_schema(scan.schema, 1024)]
+        # pre-decode gate: decompressed+padded working set must fit HBM
+        # comfortably, else the out-of-core engine is the right path
+        fsz = sum(os.path.getsize(f) for t in tasks for f in t
+                  if os.path.exists(f))
+        if fsz * 6 > self._hbm_budget():
+            raise FusedCompileError("scan working set exceeds HBM budget")
+
+        def one(task):
+            out, rest = [], []
+            for path in task:
+                b = (self._plain_file_batch(scan, path)
+                     if scan.fmt == "parquet" else None)
+                if b is not None:
+                    out.append(b)
+                else:
+                    rest.append(path)
+            if rest or scan.fmt != "parquet":
+                files = rest if scan.fmt == "parquet" else task
+                out.extend(upload_narrowed(t)
+                           for t in scan._host_tables(files))
+            return out
+
+        if len(tasks) == 1:
+            groups = [one(tasks[0])]
+        else:
+            with ThreadPoolExecutor(
+                    max_workers=min(8, len(tasks))) as pool:
+                groups = list(pool.map(one, tasks))
+        return [b for g in groups for b in g]
+
+    def _prepare(self, phys: PhysicalPlan) -> Dict[int, List[ColumnBatch]]:
+        sources: List[PhysicalPlan] = []
+        self._collect_sources(phys, sources)
+        if any(s is phys for s in sources):
+            raise FusedCompileError("plan root is a host operator")
+        parts: Dict[int, List[ColumnBatch]] = {}
+        total = 0
+        for s in sources:
+            if isinstance(s, ops.TpuFileScanExec) and s.is_tpu:
+                ps = self._scan_parts(s)
+            else:
+                table = s.collect()
+                if table.nbytes * 4 > self._hbm_budget():
+                    raise FusedCompileError("source exceeds HBM budget")
+                ps = [upload_narrowed(table)]
+            total += sum(b.device_size_bytes() for b in ps)
+            parts[id(s)] = ps
+        if total * 4 > self._hbm_budget():
+            raise FusedCompileError("working set exceeds HBM budget")
+        self._src_parts = parts
+        self._sources = sources
+        return parts
+
+    # --- per-run state ---
+
+    def execute(self, phys: PhysicalPlan) -> pa.Table:
+        from spark_rapids_tpu.exec.base import new_task_context
+        from spark_rapids_tpu.runtime import semaphore as sem
+
+        from spark_rapids_tpu.config import rapids_conf as rc
+
+        if self.conf is not None and self.conf.get(rc.ANSI_ENABLED):
+            # ANSI error checks hook the per-operator engine
+            # (exec/operators.py _build_ansi_check); the fused programs
+            # have no raise points yet
+            raise FusedCompileError("ANSI mode uses the eager engine")
+        # validate the plan BEFORE decoding/uploading anything
+        self._validate(phys)
+        ctx = new_task_context(self.conf)
+        sem.get().acquire_if_necessary(ctx.task_id)
+        try:
+            self._prepare(phys)
+            expansion, group_cap = self._expansion, self._group_cap
+            while True:
+                try:
+                    return self._run(phys, expansion, group_cap)
+                except TpuSplitAndRetryOOM:
+                    if expansion >= 256:
+                        raise
+                    expansion *= 2
+                    group_cap *= 4
+        finally:
+            sem.get().release_if_necessary(ctx.task_id)
+            self._src_parts = None
+            self._sources = None
+
+    # --- validation walk (no device work) ---
+
+    def _validate(self, node: PhysicalPlan) -> None:
+        if isinstance(node, _SOURCE_TYPES) or not node.is_tpu:
+            return
+        ok = isinstance(node, (
+            ops.TpuProjectExec, ops.TpuFilterExec, ops.TpuExpandExec,
+            ops.TpuGenerateExec, ops.TpuLocalLimitExec, ops.UnionExec,
+            ops.TpuSortExec, ops.TpuWindowExec,
+            ops.TpuShuffleExchangeExec,
+            J.TpuShuffledHashJoinExec, J.TpuBroadcastHashJoinExec))
+        if isinstance(node, ops.TpuHashAggregateExec):
+            ok = _agg_jittable(node)
+        if not ok:
+            raise FusedCompileError(
+                f"{type(node).__name__} has no fused lowering")
+        for c in node.children:
+            self._validate(c)
+
+    # --- plan walking / program construction ---
+
+    def _is_per_partition(self, node: PhysicalPlan) -> bool:
+        if isinstance(node, (ops.TpuProjectExec, ops.TpuFilterExec,
+                             ops.TpuExpandExec, ops.TpuGenerateExec)):
+            return True
+        return (isinstance(node, ops.TpuHashAggregateExec)
+                and node.mode == "partial")
+
+    def _run(self, phys: PhysicalPlan, expansion: int,
+             group_cap: int) -> pa.Table:
+        from spark_rapids_tpu.parallel.plan_compiler import (
+            _plan_key,
+            concat_traced,
+            shard_equi_join,
+        )
+        from spark_rapids_tpu.runtime.jit_cache import cached_jit
+
+        flags: List[jnp.ndarray] = []
+        src_parts = self._src_parts
+
+        def shapes_key(batches):
+            return tuple(
+                tuple((tuple(leaf.shape), str(leaf.dtype))
+                      for leaf in jax.tree_util.tree_leaves(b))
+                for b in batches)
+
+        def run_program(key_tag, nodes_key, fn, inputs):
+            key = ("fused", key_tag, nodes_key, expansion, group_cap,
+                   shapes_key(inputs))
+            jitted = cached_jit(key, lambda: fn)
+            out, ovf = jitted(*inputs)
+            flags.append(ovf)
+            return out
+
+        def chain_traced(nodes, batch):
+            """Apply a bottom-up list of per-partition operators inside
+            one trace; returns (batch, overflow).
+
+            Filters are carried as a PENDING MASK rather than a physical
+            compaction: an aggregation consumes the mask directly (its
+            segment reductions already mask per row), so the canonical
+            scan -> filter -> project -> partial-agg stage runs with no
+            row movement at all — pure elementwise + scatter work."""
+            from spark_rapids_tpu.expr import EvalContext
+
+            ovf = jnp.zeros((), bool)
+            b = widen_traced(batch)
+            mask = None  # pending filter predicate over b's rows
+
+            def materialized(b, mask):
+                return b if mask is None else filterops.compact(b, mask)
+
+            for nd in nodes:
+                if isinstance(nd, ops.TpuFilterExec):
+                    pred = nd.condition.eval(EvalContext(b))
+                    m = pred.data & pred.validity
+                    mask = m if mask is None else mask & m
+                elif isinstance(nd, ops.TpuProjectExec):
+                    b = nd._run(b)  # row-preserving; mask stays aligned
+                elif isinstance(nd, ops.TpuExpandExec):
+                    b, mask = materialized(b, mask), None
+                    b = concat_traced(
+                        [nd._run(b, i)
+                         for i in range(len(nd.projections))])
+                elif isinstance(nd, ops.TpuGenerateExec):
+                    b, mask = materialized(b, mask), None
+                    out_cap = next_capacity(expansion * b.capacity)
+                    b, o = nd._explode_to_cap(b, out_cap)
+                    ovf = ovf | o
+                else:  # partial aggregate: consumes the mask as `live`
+                    live = b.live_mask() if mask is None \
+                        else mask & b.live_mask()
+                    b, mask = nd._partial(b, live=live), None
+                    b, o = shrink_traced(b, group_cap)
+                    ovf = ovf | o
+            return materialized(b, mask), ovf
+
+        def emit_parts(node: PhysicalPlan) -> List[ColumnBatch]:
+            if id(node) in src_parts:
+                return src_parts[id(node)]
+            if isinstance(node, ops.TpuShuffleExchangeExec):
+                # single chip: every partition is already co-resident
+                return emit_parts(node.children[0])
+            if isinstance(node, ops.UnionExec):
+                return [b for c in node.children for b in emit_parts(c)]
+            if self._is_per_partition(node):
+                chain = [node]
+                cur = node.children[0]
+                while (self._is_per_partition(cur)
+                       and id(cur) not in src_parts):
+                    chain.append(cur)
+                    cur = cur.children[0]
+                base = emit_parts(cur)
+                nodes = list(reversed(chain))
+                nodes_key = tuple(_plan_key(n)[:2] for n in nodes)
+
+                def stage_fn(b, _nodes=nodes):
+                    return chain_traced(_nodes, b)
+
+                return [run_program("chain", nodes_key, stage_fn, [b])
+                        for b in base]
+            return [emit_blocking(node)]
+
+        def concat_inputs(parts):
+            return [widen_traced(p) for p in parts]
+
+        def emit_blocking(node: PhysicalPlan) -> ColumnBatch:
+            if isinstance(node, ops.TpuHashAggregateExec):
+                parts = emit_parts(node.children[0])
+                mode = node.mode
+
+                def agg_fn(*ps):
+                    cb = concat_traced(concat_inputs(list(ps)))
+                    if mode in ("complete",):
+                        cb = node._partial(cb)
+                    out = node._merge_final(cb)
+                    return shrink_traced(out, group_cap)
+
+                return run_program("agg", _plan_key(node)[:2], agg_fn,
+                                   parts)
+            if isinstance(node, ops.TpuSortExec):
+                child = node.children[0]
+                if isinstance(child, ops.TpuShuffleExchangeExec):
+                    child = child.children[0]
+                parts = emit_parts(child)
+
+                def sort_fn(*ps):
+                    cb = concat_traced(concat_inputs(list(ps)))
+                    return node._run(cb), jnp.zeros((), bool)
+
+                return run_program("sort", _plan_key(node)[:2], sort_fn,
+                                   parts)
+            if isinstance(node, ops.TpuWindowExec):
+                child = node.children[0]
+                if (isinstance(child, ops.TpuSortExec)
+                        and node.presorted):
+                    # the window program sorts internally
+                    child = child.children[0]
+                if isinstance(child, ops.TpuShuffleExchangeExec):
+                    child = child.children[0]
+                parts = emit_parts(child)
+
+                def win_fn(*ps):
+                    cb = concat_traced(concat_inputs(list(ps)))
+                    return node._run(cb), jnp.zeros((), bool)
+
+                return run_program("window", _plan_key(node)[:2], win_fn,
+                                   parts)
+            if isinstance(node, ops.TpuLocalLimitExec):
+                parts = emit_parts(node.children[0])
+                k = node.n
+
+                def limit_fn(*ps):
+                    cb = concat_traced(concat_inputs(list(ps)))
+                    return filterops.slice_head(cb, k), jnp.zeros((), bool)
+
+                return run_program("limit", (_plan_key(node)[:2],), limit_fn,
+                                   parts)
+            if isinstance(node, (J.TpuShuffledHashJoinExec,
+                                 J.TpuBroadcastHashJoinExec)):
+                lparts = emit_parts(node.children[0])
+                rparts = emit_parts(node.children[1])
+                nl = len(lparts)
+
+                def join_fn(*ps):
+                    lb = concat_traced(concat_inputs(list(ps[:nl])))
+                    rb = concat_traced(concat_inputs(list(ps[nl:])))
+                    out_cap = next_capacity(
+                        expansion * max(lb.capacity, rb.capacity))
+                    return shard_equi_join(node, lb, rb, out_cap)
+
+                return run_program("join", _plan_key(node)[:2], join_fn,
+                                   lparts + rparts)
+            raise FusedCompileError(type(node).__name__)
+
+        parts = emit_parts(phys)
+        if len(parts) > 1:
+            def collect_fn(*ps):
+                return (concat_traced(concat_inputs(list(ps))),
+                        jnp.zeros((), bool))
+
+            result = run_program("collect", ("collect",), collect_fn,
+                                 parts)
+        else:
+            def one_fn(b):
+                return widen_traced(b), jnp.zeros((), bool)
+
+            result = run_program("collect1", ("collect1",), one_fn, parts)
+        # one host sync for all overflow flags before fetching results
+        if flags and bool(np.any(jax.device_get(
+                jnp.stack([f.reshape(()) for f in flags])))):
+            raise TpuSplitAndRetryOOM(
+                "fused program capacity overflow; recompiling larger")
+        return device_to_arrow(result)

@@ -164,10 +164,7 @@ class _DeviceJoinBase(PhysicalPlan):
             pair_batch = ColumnBatch(pair_batch.schema, pair_batch.columns,
                                      n_pairs)
         # compact survivors to the front (ok is not necessarily prefix)
-        key = jnp.where(ok, 0, 1).astype(jnp.int32)
-        from spark_rapids_tpu.ops.common import sort_permutation
-
-        perm = sort_permutation([key], total_cap)
+        perm, _ = filterops.compact_perm(ok, total_cap)
         pair_batch = pair_batch.gather(perm, n_pairs)
         if jt in ("inner", "cross"):
             return pair_batch

@@ -61,6 +61,24 @@ def _acquire(ctx: TaskContext):
     sem.get().acquire_if_necessary(ctx.task_id)
 
 
+def _build_ansi_check(conf, exprs, key_base):
+    """Compiled ANSI overflow-mask reduction for an operator's
+    expressions (expr/ansicheck.py), or None when ANSI mode is off or
+    nothing in the tree can raise. One extra tiny program per batch —
+    ANSI trades throughput for eager errors, like the reference's ANSI
+    kernels."""
+    from spark_rapids_tpu.config import rapids_conf as rc
+    from spark_rapids_tpu.expr import ansicheck
+    from spark_rapids_tpu.runtime.jit_cache import cached_jit
+
+    if conf is None or not conf.get(rc.ANSI_ENABLED):
+        return None
+    if not any(ansicheck.has_ansi_checks(e) for e in exprs):
+        return None
+    return cached_jit(("ansi_check",) + tuple(key_base),
+                      lambda: ansicheck.check_fn(list(exprs)))
+
+
 # ---------------------------------------------------------------- sources
 
 class LocalRelationExec(PhysicalPlan):
@@ -125,19 +143,24 @@ class TpuFileScanExec(PhysicalPlan):
 
     def __init__(self, fmt: str, paths: List[str], schema, conf,
                  pushed_columns: Optional[List[str]] = None,
-                 pushed_filters=None):
+                 pushed_filters=None, options: Optional[dict] = None):
         super().__init__([], schema, conf)
         self.fmt = fmt
         self.paths = paths
         self.pushed_columns = pushed_columns
         self.pushed_filters = pushed_filters or None
+        self.options = options or {}
         from spark_rapids_tpu.config import rapids_conf as rc
 
         self._batch_rows = conf.get(rc.MAX_READER_BATCH_SIZE_ROWS)
         self._nthreads = conf.get(rc.MULTITHREADED_READ_NUM_THREADS)
         self._strategy = conf.get(rc.PARQUET_READER_TYPE)
         coalesce_bytes = 128 << 20
-        if fmt == "parquet":
+        if fmt == "iceberg":
+            # per-file tasks: each data file carries its own delete set
+            # and field-id projection (lakehouse/iceberg.py)
+            self._tasks = [[p] for p in paths] or [[]]
+        elif fmt == "parquet":
             if self._strategy == "PERFILE":
                 self._tasks = [[f] for f in readers.expand_paths(
                     paths, ".parquet")] or [[]]
@@ -160,6 +183,11 @@ class TpuFileScanExec(PhysicalPlan):
 
     def _host_tables(self, files) -> Iterator[pa.Table]:
         cols = self.pushed_columns
+        if self.fmt == "iceberg":
+            from spark_rapids_tpu.lakehouse.iceberg import read_data_file
+
+            ctx = self.options["iceberg_ctx"]
+            return iter([read_data_file(ctx, f, cols) for f in files])
         if self.fmt == "parquet":
             if self._strategy == "MULTITHREADED":
                 return readers.read_parquet_multithreaded(
@@ -248,6 +276,8 @@ class TpuProjectExec(PhysicalPlan):
 
         self._jitted = cached_jit(("project", aliases_key(exprs)),
                                   lambda: detached(self)._run)
+        self._ansi_jit = _build_ansi_check(
+            conf, [a for a in exprs], ("project", aliases_key(exprs)))
 
     def _run(self, batch: ColumnBatch) -> ColumnBatch:
         ctx = EvalContext(batch)
@@ -257,6 +287,10 @@ class TpuProjectExec(PhysicalPlan):
     def execute_partition(self, pid, ctx):
         with self.metrics[M.OP_TIME].ns():
             for batch in self.children[0].execute_partition(pid, ctx):
+                if self._ansi_jit is not None:
+                    from spark_rapids_tpu.expr.ansicheck import raise_if_set
+
+                    raise_if_set(self._ansi_jit(batch))
                 out = self._jitted(batch)
                 self.metrics[M.NUM_OUTPUT_BATCHES].add(1)
                 yield out
@@ -516,6 +550,8 @@ class TpuFilterExec(PhysicalPlan):
 
         self._jitted = cached_jit(("filter", condition.key()),
                                   lambda: detached(self)._run)
+        self._ansi_jit = _build_ansi_check(
+            conf, [condition], ("filter", condition.key()))
 
     def _run(self, batch: ColumnBatch) -> ColumnBatch:
         ctx = EvalContext(batch)
@@ -526,6 +562,10 @@ class TpuFilterExec(PhysicalPlan):
     def execute_partition(self, pid, ctx):
         with self.metrics[M.FILTER_TIME].ns():
             for batch in self.children[0].execute_partition(pid, ctx):
+                if self._ansi_jit is not None:
+                    from spark_rapids_tpu.expr.ansicheck import raise_if_set
+
+                    raise_if_set(self._ansi_jit(batch))
                 yield self._run_jit(batch)
 
     def _run_jit(self, batch):
@@ -596,13 +636,38 @@ class TpuHashAggregateExec(PhysicalPlan):
                                          lambda: det._merge_final)
             self._jit_merge_buffers = cached_jit(
                 base_key + ("merge_buffers",), lambda: det._merge_buffers)
+        # ANSI checks evaluate the grouping/agg INPUT expressions, which
+        # only exist against the source batch (partial/complete input)
+        self._ansi_jit = None if mode == "final" else _build_ansi_check(
+            conf, list(grouping) + list(aggs), base_key)
 
     # --- phases (each a single XLA program) ---
 
-    def _grouped(self, batch: ColumnBatch, key_idx):
-        return segmented.group_by(batch, key_idx)
+    def _grouped(self, batch: ColumnBatch, key_idx, live=None):
+        return segmented.group_by(batch, key_idx, live)
 
-    def _partial(self, batch: ColumnBatch) -> ColumnBatch:
+    @staticmethod
+    def _bin_ranges(work: ColumnBatch, nkeys: int):
+        """Static per-key (lo, hi) value bounds when EVERY group key is
+        an integer column carrying upload-time vrange metadata and the
+        total bin count fits the capacity — enables the sort-free
+        direct-binned grouping (segmented.binned_group_by)."""
+        if nkeys == 0:
+            return None
+        ranges, total = [], 1
+        for i in range(nkeys):
+            c = work.columns[i]
+            vr = getattr(c, "vrange", None)
+            if (vr is None or c.data.ndim != 1
+                    or not jnp.issubdtype(c.data.dtype, jnp.integer)):
+                return None
+            total *= vr[1] - vr[0] + 2
+            if total > min(work.capacity, 1 << 20):
+                return None
+            ranges.append(vr)
+        return ranges
+
+    def _partial(self, batch: ColumnBatch, live=None) -> ColumnBatch:
         nkeys = len(self.grouping)
         # evaluate grouping + agg inputs into a working batch
         ctx = EvalContext(batch)
@@ -624,29 +689,46 @@ class TpuHashAggregateExec(PhysicalPlan):
             # batch so capacity/live-mask come from the real data (a
             # zero-column batch reports the minimum capacity bucket)
             work = ColumnBatch(batch.schema, batch.columns, batch.num_rows)
-        g = self._grouped(work, list(range(nkeys)))
+        from contextlib import nullcontext
+
+        ranges = self._bin_ranges(work, nkeys)
+        if ranges is not None:
+            g, occupied = segmented.binned_group_by(
+                work, list(range(nkeys)), ranges, live)
+            seg_mode = segmented.unsorted_gids()
+        else:
+            g = self._grouped(work, list(range(nkeys)), live)
+            occupied = None
+            seg_mode = nullcontext()
         cap = work.capacity
         out_cols: List[DeviceColumn] = []
-        # group key columns: first row of each segment
-        for ki in range(nkeys):
-            col = g.sorted_batch.columns[ki]
-            safe = jnp.clip(g.first_pos, 0, cap - 1)
-            out_cols.append(DeviceColumn(
-                col.dtype, jnp.take(col.data, safe, axis=0),
-                jnp.take(col.validity, safe),
-                None if col.lengths is None else jnp.take(col.lengths, safe)))
-        ci = nkeys
-        for a, grp in zip(self.aggs, input_groups):
-            fn: AggregateFunction = a.children[0]
-            k = len(grp)
-            if k == 0:
-                vals = None
-            elif k == 1:
-                vals = g.sorted_batch.columns[ci]
-            else:
-                vals = [g.sorted_batch.columns[ci + j] for j in range(k)]
-            ci += k
-            out_cols.extend(fn.update(vals, g.live, g.gid, cap))
+        with seg_mode:
+            # group key columns: first row of each segment
+            for ki in range(nkeys):
+                col = g.sorted_batch.columns[ki]
+                safe = jnp.clip(g.first_pos, 0, cap - 1)
+                out_cols.append(DeviceColumn(
+                    col.dtype, jnp.take(col.data, safe, axis=0),
+                    jnp.take(col.validity, safe),
+                    None if col.lengths is None
+                    else jnp.take(col.lengths, safe)))
+            ci = nkeys
+            for a, grp in zip(self.aggs, input_groups):
+                fn: AggregateFunction = a.children[0]
+                k = len(grp)
+                if k == 0:
+                    vals = None
+                elif k == 1:
+                    vals = g.sorted_batch.columns[ci]
+                else:
+                    vals = [g.sorted_batch.columns[ci + j] for j in range(k)]
+                ci += k
+                out_cols.extend(fn.update(vals, g.live, g.gid, cap))
+        if occupied is not None:
+            # bins -> dense group positions (front-compacted like the
+            # sorted path's segment-id outputs)
+            perm = segmented.dense_bin_perm(occupied, cap)
+            out_cols = [c.gather(perm) for c in out_cols]
         return ColumnBatch(_buffer_schema(self.grouping, self.aggs),
                            out_cols, g.num_groups)
 
@@ -735,6 +817,10 @@ class TpuHashAggregateExec(PhysicalPlan):
                 pending_rows = compacted.row_count()
 
             for batch in self.children[0].execute_partition(pid, ctx):
+                if self._ansi_jit is not None:
+                    from spark_rapids_tpu.expr.ansicheck import raise_if_set
+
+                    raise_if_set(self._ansi_jit(batch))
                 if self.mode == "final":
                     pending.append(park(batch))
                     pending_rows += batch.capacity

@@ -1,0 +1,267 @@
+"""Device-direct parquet scan for PLAIN-encoded column chunks.
+
+The reference ships raw parquet bytes to the device and decodes there
+(`Table.readParquet`, GpuParquetScan.scala:2619; the COALESCING reader
+stitches row-group bytes into one host buffer first,
+GpuParquetScan.scala:1860). The TPU has no snappy/bit-unpack kernels,
+but for UNCOMPRESSED PLAIN column chunks the page payloads ARE the
+little-endian values — so the host's whole job is to parse the (tiny)
+thrift page headers, stitch payload byte ranges into one contiguous
+buffer per column (a single memcpy), and hand zero-copy typed views to
+the uploader. No pyarrow decode pass, which matters: scan hosts can be
+a single core while the device does the real work.
+
+Column chunks that are compressed, dictionary-encoded, nested, or
+contain nulls fall back to the normal pyarrow reader per chunk — the
+same per-file fallback discipline the reference applies when its native
+footer parser cannot handle a file (GpuParquetScan.scala:221-240).
+
+The page-header parser below implements the minimal thrift compact
+protocol subset PageHeader needs; it is written against the parquet
+format spec, not any particular implementation.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+# thrift compact type ids
+_CT_BOOL_TRUE = 1
+_CT_BOOL_FALSE = 2
+_CT_BYTE = 3
+_CT_I16 = 4
+_CT_I32 = 5
+_CT_I64 = 6
+_CT_DOUBLE = 7
+_CT_BINARY = 8
+_CT_LIST = 9
+_CT_SET = 10
+_CT_MAP = 11
+_CT_STRUCT = 12
+
+_PHYS_DTYPE = {
+    "INT32": np.dtype("<i4"),
+    "INT64": np.dtype("<i8"),
+    "FLOAT": np.dtype("<f4"),
+    "DOUBLE": np.dtype("<f8"),
+}
+
+
+class _Reader:
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: memoryview, pos: int = 0):
+        self.buf = buf
+        self.pos = pos
+
+    def byte(self) -> int:
+        b = self.buf[self.pos]
+        self.pos += 1
+        return b
+
+    def varint(self) -> int:
+        result = shift = 0
+        while True:
+            b = self.byte()
+            result |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return result
+            shift += 7
+
+    def zigzag(self) -> int:
+        n = self.varint()
+        return (n >> 1) ^ -(n & 1)
+
+    def skip(self, ctype: int) -> None:
+        if ctype in (_CT_BOOL_TRUE, _CT_BOOL_FALSE):
+            return
+        if ctype in (_CT_BYTE,):
+            self.pos += 1
+            return
+        if ctype in (_CT_I16, _CT_I32, _CT_I64):
+            self.varint()
+            return
+        if ctype == _CT_DOUBLE:
+            self.pos += 8
+            return
+        if ctype == _CT_BINARY:
+            n = self.varint()  # two steps: += would read pos pre-varint
+            self.pos += n
+            return
+        if ctype in (_CT_LIST, _CT_SET):
+            head = self.byte()
+            n = head >> 4
+            et = head & 0x0F
+            if n == 15:
+                n = self.varint()
+            for _ in range(n):
+                self.skip(et)
+            return
+        if ctype == _CT_MAP:
+            n = self.varint()
+            if n:
+                kv = self.byte()
+                for _ in range(n):
+                    self.skip(kv >> 4)
+                    self.skip(kv & 0x0F)
+            return
+        if ctype == _CT_STRUCT:
+            self.skip_struct()
+            return
+        raise ValueError(f"thrift compact type {ctype}")
+
+    def skip_struct(self) -> None:
+        fid = 0
+        while True:
+            head = self.byte()
+            if head == 0:
+                return
+            delta = head >> 4
+            ctype = head & 0x0F
+            fid = fid + delta if delta else self.zigzag()
+            self.skip(ctype)
+
+    def read_struct_i32s(self):
+        """Read a struct keeping i32/i64/bool fields and one level of
+        nested structs (PageHeader's data_page_header); everything else
+        (statistics, ...) is skipped. Returns (fields, nested)."""
+        out: Dict[int, int] = {}
+        nested: Dict[int, Dict[int, int]] = {}
+        fid = 0
+        while True:
+            head = self.byte()
+            if head == 0:
+                return out, nested
+            delta = head >> 4
+            ctype = head & 0x0F
+            fid = fid + delta if delta else self.zigzag()
+            if ctype in (_CT_I16, _CT_I32, _CT_I64):
+                out[fid] = self.zigzag()
+            elif ctype == _CT_BOOL_TRUE:
+                out[fid] = 1
+            elif ctype == _CT_BOOL_FALSE:
+                out[fid] = 0
+            elif ctype == _CT_STRUCT:
+                nested[fid], _ = self.read_struct_i32s()
+            else:
+                self.skip(ctype)
+
+
+def _all_valid_def_levels(buf: memoryview, num_values: int
+                          ) -> Optional[int]:
+    """For an optional column (max def level 1), check the v1 def-level
+    block is a single all-ones RLE run; return its total byte size
+    (4-byte length prefix included), or None when nulls/bitpack runs
+    are present."""
+    ln = int.from_bytes(buf[:4], "little")
+    r = _Reader(buf, 4)
+    header = r.varint()
+    if header & 1:
+        return None  # bit-packed run: nulls possible
+    count = header >> 1
+    if count != num_values:
+        return None
+    value = r.byte()
+    if value != 1:
+        return None  # a run of zeros = all null
+    if r.pos - 4 != ln:
+        return None  # trailing runs
+    return 4 + ln
+
+
+def plain_chunk_slices(buf: memoryview, start: int, size: int,
+                       num_values: int, has_def_levels: bool
+                       ) -> Optional[List[Tuple[int, int, int]]]:
+    """Walk the pages of one PLAIN uncompressed column chunk; return
+    [(payload_offset, payload_len, n_values)] or None when any page is
+    not the simple shape (v2 pages, dict pages, nulls)."""
+    pos = start
+    end = start + size
+    seen = 0
+    out: List[Tuple[int, int, int]] = []
+    while pos < end and seen < num_values:
+        r = _Reader(buf, pos)
+        hdr, nested = r.read_struct_i32s()
+        page_type = hdr.get(1)
+        comp_size = hdr.get(3)
+        if page_type != 0 or comp_size is None:  # 0 = DATA_PAGE (v1)
+            return None
+        dph = nested.get(5)
+        if not dph:
+            return None
+        n_vals = dph.get(1)
+        encoding = dph.get(2)
+        if n_vals is None or encoding != 0:  # 0 = PLAIN
+            return None
+        payload_start = r.pos
+        payload_len = comp_size
+        if has_def_levels:
+            skip = _all_valid_def_levels(
+                buf[payload_start:payload_start + payload_len], n_vals)
+            if skip is None:
+                return None
+            payload_start += skip
+            payload_len -= skip
+        out.append((payload_start, payload_len, n_vals))
+        seen += n_vals
+        pos = r.pos + comp_size
+    if seen != num_values:
+        return None
+    return out
+
+
+def read_plain_columns(path: str, columns: List[str]
+                       ) -> Optional[Dict[str, np.ndarray]]:
+    """Read the requested columns of a parquet file as zero-copy-ish
+    numpy arrays (one payload-stitch memcpy per column) when every
+    requested column chunk is UNCOMPRESSED + PLAIN + null-free flat
+    primitives. Returns None when the file needs the general reader."""
+    import pyarrow.parquet as pq
+
+    pf = pq.ParquetFile(path)
+    md = pf.metadata
+    schema = pf.schema_arrow
+    name_to_idx = {md.row_group(0).column(i).path_in_schema: i
+                   for i in range(md.num_columns)} if md.num_row_groups \
+        else {}
+    for c in columns:
+        if c not in name_to_idx:
+            return None
+    import mmap
+
+    f = open(path, "rb")
+    try:
+        raw = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+    except (ValueError, OSError):
+        raw = f.read()
+    finally:
+        f.close()
+    buf = memoryview(raw)
+    out: Dict[str, List[np.ndarray]] = {c: [] for c in columns}
+    for g in range(md.num_row_groups):
+        rg = md.row_group(g)
+        for c in columns:
+            cc = rg.column(name_to_idx[c])
+            dt = _PHYS_DTYPE.get(cc.physical_type)
+            if (dt is None or cc.compression != "UNCOMPRESSED"
+                    or "PLAIN_DICTIONARY" in cc.encodings
+                    or "RLE_DICTIONARY" in cc.encodings):
+                return None
+            stats = cc.statistics
+            if stats is not None and stats.null_count not in (0, None):
+                return None
+            field = schema.field(c)
+            slices = plain_chunk_slices(
+                buf, cc.data_page_offset, cc.total_compressed_size,
+                cc.num_values, has_def_levels=field.nullable)
+            if slices is None:
+                return None
+            for off, ln, n in slices:
+                if ln != n * dt.itemsize:
+                    return None
+                out[c].append(np.frombuffer(buf, dtype=dt, count=n,
+                                            offset=off))
+    return {c: (arrs[0] if len(arrs) == 1 else np.concatenate(arrs))
+            for c, arrs in out.items()}

@@ -13,13 +13,27 @@ from spark_rapids_tpu.columnar.batch import ColumnBatch
 from spark_rapids_tpu.ops.common import sort_permutation
 
 
+def compact_perm(keep: jnp.ndarray, cap: int):
+    """Stable-partition gather permutation: rows with keep land first in
+    original order, dropped rows after. O(n) cumsum+scatter — a full
+    sort here would be the single most expensive op in every filter
+    (lax.sort is log^2-pass on TPU; this is one bandwidth pass).
+    Returns (perm, n_keep); out = batch.gather(perm, n_keep)."""
+    k32 = keep.astype(jnp.int32)
+    n_keep = jnp.sum(k32).astype(jnp.int32)
+    pos_keep = jnp.cumsum(k32) - 1
+    pos_drop = n_keep + jnp.cumsum(1 - k32) - 1
+    positions = jnp.where(keep, pos_keep, pos_drop).astype(jnp.int32)
+    # positions is a bijection on [0, cap): invert it by scatter
+    perm = jnp.zeros((cap,), jnp.int32).at[positions].set(
+        jnp.arange(cap, dtype=jnp.int32), unique_indices=True)
+    return perm, n_keep
+
+
 def compact(batch: ColumnBatch, keep: jnp.ndarray) -> ColumnBatch:
     """Keep rows where `keep` (and logically live); preserves order."""
-    live = batch.live_mask()
-    keep = keep & live
-    key = jnp.where(keep, 0, 1).astype(jnp.int32)
-    perm = sort_permutation([key], batch.capacity)
-    new_rows = jnp.sum(keep).astype(jnp.int32)
+    keep = keep & batch.live_mask()
+    perm, new_rows = compact_perm(keep, batch.capacity)
     return batch.gather(perm, new_rows)
 
 

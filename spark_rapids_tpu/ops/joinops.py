@@ -88,17 +88,28 @@ def _binary_search(build_keys: List[jnp.ndarray],
                    build_cap: int, upper: bool) -> jnp.ndarray:
     """First index in [0, bound) where build[idx] >= probe (lower) or
     > probe (upper); vectorized over probe rows."""
+    from jax import lax
+
     n = probe_keys[0].shape[0]
     lo = jnp.zeros(n, dtype=jnp.int32)
     hi = jnp.broadcast_to(bound.astype(jnp.int32), (n,))
     iters = max(1, build_cap.bit_length())
-    for _ in range(iters):
+
+    # fori_loop, NOT an unrolled Python loop: with W key words (long
+    # strings pack to max_bytes/8 words) an unrolled search emits
+    # W * iters * 2 gather/compare chains and XLA compile time explodes
+    # (64-byte string join: 150 s on CPU); the loop body compiles once.
+    def step(_, carry):
+        lo, hi = carry
         active = lo < hi
         mid = (lo + hi) >> 1
-        go_right = _tuple_cmp_at(build_keys, mid, probe_keys, strict=not upper)
+        go_right = _tuple_cmp_at(build_keys, mid, probe_keys,
+                                 strict=not upper)
         new_lo = jnp.where(active & go_right, mid + 1, lo)
         new_hi = jnp.where(active & ~go_right, mid, hi)
-        lo, hi = new_lo, new_hi
+        return new_lo, new_hi
+
+    lo, hi = lax.fori_loop(0, iters, step, (lo, hi))
     return lo
 
 

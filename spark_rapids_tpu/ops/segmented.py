@@ -13,7 +13,9 @@ groupby -> merge).
 
 from __future__ import annotations
 
-from typing import List, NamedTuple, Sequence
+import contextvars
+from contextlib import contextmanager
+from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,9 +40,74 @@ class GroupedBatch(NamedTuple):
     #                                group's first row (by gid)
 
 
-def group_by(batch: ColumnBatch, key_idxs: Sequence[int]) -> GroupedBatch:
+# Trace-time flag: the binned (sort-free) grouping path produces gids
+# in original row order, so segment ops must not claim sortedness.
+# ContextVar (not a module global) because program construction runs
+# concurrently from reader/compile thread pools.
+_SORTED_GIDS = contextvars.ContextVar("srtpu_sorted_gids", default=True)
+
+
+@contextmanager
+def unsorted_gids():
+    tok = _SORTED_GIDS.set(False)
+    try:
+        yield
+    finally:
+        _SORTED_GIDS.reset(tok)
+
+
+def binned_group_by(batch: ColumnBatch, key_idxs: Sequence[int],
+                    ranges: Sequence[Tuple[int, int]],
+                    live: Optional[jnp.ndarray] = None
+                    ) -> Tuple[GroupedBatch, jnp.ndarray]:
+    """Sort-free grouping for integer keys with small static value
+    ranges (DeviceColumn.vrange upload metadata): each row maps
+    directly to a bin (per-key code 0 = null, 1.. = value - lo), and
+    aggregation runs as scatter-adds over bins — one bandwidth pass
+    instead of a multi-pass device sort. This is the TPU answer to
+    cuDF's hash group-by for the common low-cardinality OLAP keys.
+
+    Returns (GroupedBatch, occupied) where gid is the UNSORTED bin id
+    per original row (use within `unsorted_gids()`), `sorted_batch` is
+    the batch itself, and `occupied` marks live bins; callers compact
+    bins to dense group positions with `dense_bin_perm`.
+    """
     cap = batch.capacity
-    live = batch.live_mask()
+    if live is None:
+        live = batch.live_mask()
+    gid64 = jnp.zeros((cap,), jnp.int64)
+    stride = 1
+    for i, (lo, hi) in zip(key_idxs, ranges):
+        c = batch.columns[i]
+        code = jnp.where(c.validity, c.data.astype(jnp.int64) - lo + 1, 0)
+        gid64 = gid64 + code * stride
+        stride *= hi - lo + 2
+    assert stride <= cap, "bin count must fit the batch capacity"
+    gid = jnp.clip(gid64, 0, cap - 1).astype(jnp.int32)
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    big = jnp.int32(cap)
+    first_pos = jax.ops.segment_min(jnp.where(live, pos, big), gid,
+                                    num_segments=cap)
+    occupied = first_pos < big
+    num_groups = jnp.sum(occupied).astype(jnp.int32)
+    return (GroupedBatch(batch, gid, live, num_groups, first_pos),
+            occupied)
+
+
+def dense_bin_perm(occupied: jnp.ndarray, cap: int) -> jnp.ndarray:
+    """Gather permutation mapping dense group position j -> the j-th
+    occupied bin (rows past num_groups are garbage)."""
+    dense = jnp.cumsum(occupied.astype(jnp.int32)) - 1
+    return jnp.zeros((cap,), jnp.int32).at[
+        jnp.where(occupied, dense, cap)].set(
+        jnp.arange(cap, dtype=jnp.int32), mode="drop")
+
+
+def group_by(batch: ColumnBatch, key_idxs: Sequence[int],
+             live: Optional[jnp.ndarray] = None) -> GroupedBatch:
+    cap = batch.capacity
+    if live is None:
+        live = batch.live_mask()
     if not key_idxs:
         # global aggregation: every live row in segment 0; one group
         # always exists (Spark's global agg emits one row on empty input)
@@ -70,14 +137,15 @@ def group_by(batch: ColumnBatch, key_idxs: Sequence[int]) -> GroupedBatch:
 # --- segmented reduction primitives (masked; num_segments = capacity) ---
 #
 # PRECONDITION: gid must be SORTED ascending (group_by sorts rows
-# before every reduction). indices_are_sorted=True below is an XLA
-# correctness contract, not a hint — unsorted gids produce silently
-# wrong results on TPU.
+# before every reduction) UNLESS the caller is inside `unsorted_gids()`
+# (the binned grouping path). The indices_are_sorted flag is an XLA
+# correctness contract, not a hint — claiming sortedness over unsorted
+# gids produces silently wrong results on TPU.
 
 def seg_count(valid: jnp.ndarray, gid: jnp.ndarray, cap: int) -> jnp.ndarray:
     return jax.ops.segment_sum(valid.astype(jnp.int64), gid,
                                num_segments=cap,
-                               indices_are_sorted=True)
+                               indices_are_sorted=_SORTED_GIDS.get())
 
 
 def seg_sum(values: jnp.ndarray, valid: jnp.ndarray, gid: jnp.ndarray,
@@ -85,7 +153,7 @@ def seg_sum(values: jnp.ndarray, valid: jnp.ndarray, gid: jnp.ndarray,
     zero = jnp.zeros((), dtype=values.dtype)
     return jax.ops.segment_sum(jnp.where(valid, values, zero), gid,
                                num_segments=cap,
-                               indices_are_sorted=True)
+                               indices_are_sorted=_SORTED_GIDS.get())
 
 
 def seg_min(values: jnp.ndarray, valid: jnp.ndarray, gid: jnp.ndarray,
@@ -96,7 +164,7 @@ def seg_min(values: jnp.ndarray, valid: jnp.ndarray, gid: jnp.ndarray,
         ident = jnp.array(jnp.iinfo(values.dtype).max, dtype=values.dtype)
     return jax.ops.segment_min(jnp.where(valid, values, ident), gid,
                                num_segments=cap,
-                               indices_are_sorted=True)
+                               indices_are_sorted=_SORTED_GIDS.get())
 
 
 def seg_max(values: jnp.ndarray, valid: jnp.ndarray, gid: jnp.ndarray,
@@ -107,7 +175,7 @@ def seg_max(values: jnp.ndarray, valid: jnp.ndarray, gid: jnp.ndarray,
         ident = jnp.array(jnp.iinfo(values.dtype).min, dtype=values.dtype)
     return jax.ops.segment_max(jnp.where(valid, values, ident), gid,
                                num_segments=cap,
-                               indices_are_sorted=True)
+                               indices_are_sorted=_SORTED_GIDS.get())
 
 
 def seg_first(values: jnp.ndarray, first_pos_valid: jnp.ndarray

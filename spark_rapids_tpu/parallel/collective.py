@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from spark_rapids_tpu.columnar.batch import ColumnBatch, DeviceColumn
+from spark_rapids_tpu.ops import filterops
 from spark_rapids_tpu.ops.common import sort_permutation
 
 
@@ -122,8 +123,7 @@ def all_to_all_batch(batch: ColumnBatch, pid: jnp.ndarray, n_dest: int,
     total = jnp.sum(live_recv).astype(jnp.int32)
     interim = ColumnBatch(batch.schema, new_cols, recv_cap)
     # compact live rows to the front
-    ckey = jnp.where(live_recv, 0, 1).astype(jnp.int64)
-    cperm = sort_permutation([ckey], recv_cap)
+    cperm, _ = filterops.compact_perm(live_recv, recv_cap)
     out = interim.gather(cperm, total)
     return out, overflow
 
@@ -151,8 +151,7 @@ def all_gather_batch(batch: ColumnBatch, axis_name: str, n: int
     live = pos < jnp.take(counts, blk)
     total = jnp.sum(live).astype(jnp.int32)
     interim = ColumnBatch(batch.schema, new_cols, n * cap)
-    key = jnp.where(live, 0, 1).astype(jnp.int64)
-    perm = sort_permutation([key], n * cap)
+    perm, _ = filterops.compact_perm(live, n * cap)
     return interim.gather(perm, total)
 
 

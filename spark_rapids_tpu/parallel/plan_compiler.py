@@ -100,11 +100,7 @@ def concat_traced(batches: List[ColumnBatch]) -> ColumnBatch:
             lens = jnp.concatenate([p.lengths for p in parts])
         cols.append(DeviceColumn(field.dataType, data, val, lens))
     interim = ColumnBatch(schema, cols, total_cap)
-    from spark_rapids_tpu.ops.common import sort_permutation
-
-    key = jnp.where(live, 0, 1).astype(jnp.int64)
-    perm = sort_permutation([key], total_cap)
-    total = jnp.sum(live).astype(jnp.int32)
+    perm, total = filterops.compact_perm(live, total_cap)
     return interim.gather(perm, total)
 
 
@@ -173,10 +169,7 @@ def shard_equi_join(node: J._DeviceJoinBase, left: ColumnBatch,
     if jt == "existence":
         return node._exists_batch(left, matched_l), overflow
     n_pairs = jnp.sum(jnp.where(ok, 1, 0)).astype(jnp.int32)
-    from spark_rapids_tpu.ops.common import sort_permutation
-
-    key = jnp.where(ok, 0, 1).astype(jnp.int32)
-    perm = sort_permutation([key], out_cap)
+    perm, _ = filterops.compact_perm(ok, out_cap)
     survivors = pair_batch.gather(perm, n_pairs)
     if jt in ("inner", "cross"):
         return survivors, overflow
@@ -326,33 +319,141 @@ class MeshQueryExecutor:
 
     def _materialize(self, source: PhysicalPlan) -> ColumnBatch:
         """Run a source subtree on the host engine and build one padded
-        device batch whose capacity divides the mesh size."""
+        device batch whose capacity divides the mesh size. Only used for
+        sources that are inherently single-host (local relations, CPU
+        fallback subtrees); file scans ingest per shard
+        (_ingest_scan_sharded)."""
         table = source.collect()
         cap = next_capacity(max(table.num_rows, 1))
         if cap % self.n:
             cap = -(-cap // self.n) * self.n
         return arrow_to_device(table, capacity=cap)
 
+    def _ingest_scan_sharded(self, scan: ops.TpuFileScanExec
+                             ) -> ColumnBatch:
+        """Partitioned mesh ingestion: split the scan's file-task list
+        across shards; each shard decodes ONLY its own files into its
+        own device buffer (reader pool in parallel), assembled into one
+        globally-sharded array per leaf — no whole-table host batch
+        ever exists (the MultiFileCloudPartitionReader role,
+        GpuParquetScan.scala:2051, mapped onto mesh ingestion)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from jax.sharding import NamedSharding
+
+        from spark_rapids_tpu.columnar.arrow_bridge import column_from_arrow
+        from spark_rapids_tpu.columnar.batch import concat_batches  # noqa: F401
+        from spark_rapids_tpu.sqltypes.datatypes import to_arrow_type
+
+        n = self.n
+        files = [f for t in scan._tasks for f in t]
+        shard_files = [files[s::n] for s in range(n)]
+
+        def decode(fs) -> pa.Table:
+            if not fs:
+                arrow_schema = pa.schema([
+                    pa.field(f.name, to_arrow_type(f.dataType), f.nullable)
+                    for f in scan.schema.fields])
+                return pa.table(
+                    {f.name: pa.array([], f.type) for f in arrow_schema},
+                    schema=arrow_schema)
+            tabs = []
+            for t in scan._host_tables(fs):
+                tabs.append(t)
+            return pa.concat_tables(tabs, promote_options="none")
+
+        with ThreadPoolExecutor(max_workers=min(8, n)) as pool:
+            tables = list(pool.map(decode, shard_files))
+        shard_cap = next_capacity(max(max(t.num_rows for t in tables), 1))
+        shard_cols = []
+        for t in tables:
+            t = t.combine_chunks()
+            cols = []
+            for i, field in enumerate(scan.schema.fields):
+                col = t.column(i)
+                arr = (col.chunk(0) if col.num_chunks else
+                       pa.array([], type=t.schema.field(i).type))
+                cols.append(column_from_arrow(arr, field, shard_cap))
+            shard_cols.append(cols)
+        # align string/array/map matrices to the global max width —
+        # EVERY 2-D leaf (data, elem_validity, map_values) must reach
+        # the same width or the global-array assembly rejects the shards
+        def pad2d(a, mb):
+            if a is None or a.shape[1] >= mb:
+                return a
+            fill = np.zeros((shard_cap, mb - a.shape[1]), dtype=a.dtype)
+            return np.concatenate([a, fill], axis=1)
+
+        for ci in range(len(scan.schema.fields)):
+            datas = [sc[ci].data for sc in shard_cols]
+            if datas[0].ndim == 2:
+                mb = max(int(d.shape[1]) for d in datas)
+                for sc in shard_cols:
+                    c = sc[ci]
+                    sc[ci] = DeviceColumn(
+                        c.dtype, pad2d(c.data, mb), c.validity,
+                        c.lengths, pad2d(c.elem_validity, mb),
+                        pad2d(c.map_values, mb))
+        devs = list(self.mesh.devices.reshape(-1))
+        sharding = NamedSharding(self.mesh, P(AXIS))
+
+        def assemble(leaves_per_shard, global_shape):
+            singles = [jax.device_put(leaf, d)
+                       for leaf, d in zip(leaves_per_shard, devs)]
+            return jax.make_array_from_single_device_arrays(
+                global_shape, sharding, singles)
+
+        out_cols = []
+        for ci, field in enumerate(scan.schema.fields):
+            per = [sc[ci] for sc in shard_cols]
+            c0 = per[0]
+            gshape = (n * shard_cap,) + tuple(c0.data.shape[1:])
+            data = assemble([c.data for c in per], gshape)
+            validity = assemble([c.validity for c in per],
+                                (n * shard_cap,))
+            lengths = None if c0.lengths is None else assemble(
+                [c.lengths for c in per], (n * shard_cap,))
+            ev = None if c0.elem_validity is None else assemble(
+                [c.elem_validity for c in per], gshape)
+            mv = None if c0.map_values is None else assemble(
+                [c.map_values for c in per], gshape)
+            out_cols.append(DeviceColumn(field.dataType, data, validity,
+                                         lengths, ev, mv))
+        counts = assemble(
+            [np.asarray([t.num_rows], dtype=np.int32) for t in tables],
+            (n,))
+        return ColumnBatch(scan.schema, out_cols, counts)
+
     # --- execution ---
 
     def execute(self, phys: PhysicalPlan) -> pa.Table:
+        from spark_rapids_tpu.config import rapids_conf as rc
+
+        if self.conf is not None and self.conf.get(rc.ANSI_ENABLED):
+            # ANSI checks live in the eager engine's per-batch check
+            # programs; the SPMD program has no raise points
+            raise MeshCompileError("ANSI mode uses the eager engine")
         sources: List[PhysicalPlan] = []
         self._collect_sources(phys, sources)
-        host_batches = [self._materialize(s) for s in sources]
+        sharded = []
+        for s in sources:
+            if isinstance(s, ops.TpuFileScanExec) and s.is_tpu:
+                sharded.append(self._ingest_scan_sharded(s))
+            else:
+                sharded.append(mesh_exec.shard_batch(
+                    self.mesh, self._materialize(s)))
         expansion = self._expansion
         while True:
             try:
-                return self._run(phys, sources, host_batches, expansion)
+                return self._run(phys, sources, sharded, expansion)
             except TpuSplitAndRetryOOM:
                 if expansion >= 256:
                     raise
                 expansion *= 2
 
     def _run(self, phys: PhysicalPlan, sources: List[PhysicalPlan],
-             host_batches: List[ColumnBatch], expansion: int) -> pa.Table:
+             sharded: List[ColumnBatch], expansion: int) -> pa.Table:
         n = self.n
-        sharded = [mesh_exec.shard_batch(self.mesh, hb)
-                   for hb in host_batches]
         src_index: Dict[int, int] = {id(s): i for i, s in
                                      enumerate(sources)}
 
@@ -464,8 +565,8 @@ class MeshQueryExecutor:
 
         shape_key = tuple(
             tuple((tuple(c.data.shape), str(c.data.dtype))
-                  for c in hb.columns) + ((hb.capacity,),)
-            for hb in host_batches)
+                  for c in sb.columns) + ((sb.capacity,),)
+            for sb in sharded)
         key = ("mesh_plan", _plan_key(phys), n, expansion, shape_key)
         jitted = cached_jit(
             key,

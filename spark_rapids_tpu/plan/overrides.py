@@ -279,10 +279,12 @@ class TpuOverrides:
             if on_device:
                 return ops.TpuFileScanExec(node.fmt, node.paths, node.schema,
                                            conf, pushed_columns=cols,
-                                           pushed_filters=filters)
+                                           pushed_filters=filters,
+                                           options=node.options)
             return ops.CpuFileScanExec(node.fmt, node.paths, node.schema,
                                        conf, pushed_columns=cols,
-                                       pushed_filters=filters)
+                                       pushed_filters=filters,
+                                       options=node.options)
 
         if isinstance(node, L.Limit):
             smeta = meta.children[0]

@@ -1,0 +1,263 @@
+"""Whole-stage fused single-chip execution (exec/fused.py) and its
+supporting kernels: O(n) compaction, binned group-by, PLAIN-parquet
+device-direct scan. Oracle is pyarrow throughout (the reference's
+CPU-vs-device differential discipline, SURVEY.md section 4)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSparkSession
+
+
+@pytest.fixture()
+def spark():
+    s = TpuSparkSession({"spark.sql.shuffle.partitions": 4})
+    yield s
+    s.stop()
+
+
+# ------------------------------------------------------- compact_perm
+
+def test_compact_perm_stable_order():
+    from spark_rapids_tpu.ops.filterops import compact_perm
+
+    rng = np.random.default_rng(0)
+    keep = jnp.asarray(rng.random(257) < 0.3)
+    perm, n = compact_perm(keep, 257)
+    vals = jnp.arange(257)
+    out = np.asarray(jnp.take(vals, perm))[: int(n)]
+    want = np.arange(257)[np.asarray(keep)]
+    assert np.array_equal(out, want)
+    # all-keep and none-keep edges
+    for k in (jnp.ones(64, bool), jnp.zeros(64, bool)):
+        perm, n = compact_perm(k, 64)
+        assert int(n) == (64 if bool(k[0]) else 0)
+        assert sorted(np.asarray(perm).tolist()) == list(range(64))
+
+
+# --------------------------------------------------- binned group-by
+
+def test_binned_groupby_matches_sorted_path():
+    from spark_rapids_tpu.columnar.arrow_bridge import arrow_to_device
+    from spark_rapids_tpu.columnar.batch import DeviceColumn
+    from spark_rapids_tpu.exec.operators import TpuHashAggregateExec
+    from spark_rapids_tpu.expr import Alias, Average, BoundReference, Count, Sum
+    from spark_rapids_tpu.sqltypes.datatypes import double, long
+
+    rng = np.random.default_rng(3)
+    n = 4000
+    keys = rng.integers(0, 37, n)
+    vals = rng.random(n) * 10
+    null_mask = rng.random(n) < 0.1
+    key_arr = pa.array(keys, type=pa.int64())
+    t = pa.table({
+        "k": pa.array(np.where(null_mask, None, keys), type=pa.int64()),
+        "v": pa.array(vals, type=pa.float64()),
+    })
+    batch = arrow_to_device(t)
+    agg = TpuHashAggregateExec(
+        "complete",
+        [Alias(BoundReference(0, long, True), "k")],
+        [Alias(Sum(BoundReference(1, double, True)), "s"),
+         Alias(Count(None), "c"),
+         Alias(Average(BoundReference(1, double, True)), "a")],
+        None, None)
+
+    part_sorted = agg._partial(batch)
+
+    # stamp vrange on the key column -> binned path
+    kcol = batch.columns[0]
+    batch.columns[0] = DeviceColumn(kcol.dtype, kcol.data, kcol.validity,
+                                    vrange=(0, 63))
+    assert agg._bin_ranges(batch, 1) is not None
+    part_binned = agg._partial(batch)
+
+    def as_map(part):
+        out = agg._merge_final(part)
+        from spark_rapids_tpu.columnar.arrow_bridge import device_to_arrow
+
+        tt = device_to_arrow(out)
+        return {r["k"]: (r["s"], r["c"], r["a"]) for r in tt.to_pylist()}
+
+    a, b = as_map(part_sorted), as_map(part_binned)
+    assert set(a) == set(b)
+    for k in a:
+        assert a[k][1] == b[k][1], k
+        assert abs((a[k][0] or 0) - (b[k][0] or 0)) < 1e-9, k
+
+
+# ---------------------------------------------- PLAIN parquet scanner
+
+def _write_plain(path, table):
+    pq.write_table(table, path, compression="NONE", use_dictionary=False,
+                   row_group_size=1 << 20, data_page_size=16 << 20)
+
+
+def test_read_plain_columns_exact(tmp_path):
+    from spark_rapids_tpu.io.parquet_plain import read_plain_columns
+
+    rng = np.random.default_rng(5)
+    t = pa.table({
+        "a": pa.array(rng.integers(-50, 3000, 10_000), type=pa.int64()),
+        "b": pa.array(rng.random(10_000), type=pa.float64()),
+        "c": pa.array(rng.integers(0, 100, 10_000), type=pa.int32()),
+    })
+    p = str(tmp_path / "plain.parquet")
+    _write_plain(p, t)
+    cols = read_plain_columns(p, ["a", "b", "c"])
+    assert cols is not None
+    for name in ("a", "b", "c"):
+        assert np.array_equal(cols[name], np.asarray(t.column(name)))
+
+
+def test_read_plain_columns_fallbacks(tmp_path):
+    from spark_rapids_tpu.io.parquet_plain import read_plain_columns
+
+    t = pa.table({"a": pa.array([1, 2, None, 4], type=pa.int64())})
+    p1 = str(tmp_path / "nulls.parquet")
+    _write_plain(p1, t)
+    assert read_plain_columns(p1, ["a"]) is None  # nulls -> fallback
+
+    t2 = pa.table({"a": pa.array(np.arange(1000), type=pa.int64())})
+    p2 = str(tmp_path / "snappy.parquet")
+    pq.write_table(t2, p2, compression="snappy")
+    assert read_plain_columns(p2, ["a"]) is None  # compressed -> fallback
+
+    t3 = pa.table({"s": pa.array(["x", "y"] * 50)})
+    p3 = str(tmp_path / "strs.parquet")
+    _write_plain(p3, t3)
+    assert read_plain_columns(p3, ["s"]) is None  # byte-array physical
+
+
+def test_plain_multi_row_group_and_pages(tmp_path):
+    from spark_rapids_tpu.io.parquet_plain import read_plain_columns
+
+    rng = np.random.default_rng(6)
+    t = pa.table({"a": pa.array(rng.integers(0, 9, 50_000),
+                                type=pa.int64()),
+                  "b": pa.array(rng.random(50_000), type=pa.float64())})
+    p = str(tmp_path / "multi.parquet")
+    pq.write_table(t, p, compression="NONE", use_dictionary=False,
+                   row_group_size=7_000, data_page_size=8 << 10)
+    cols = read_plain_columns(p, ["a", "b"])
+    assert cols is not None
+    assert np.array_equal(cols["a"], np.asarray(t.column("a")))
+    assert np.array_equal(cols["b"], np.asarray(t.column("b")))
+
+
+# ----------------------------------------------- fused executor e2e
+
+def _q5_files(tmp_path, nfiles=3, rows=20_000, plain=True):
+    rng = np.random.default_rng(11)
+    d = tmp_path / "data"
+    os.makedirs(d, exist_ok=True)
+    tabs = []
+    for i in range(nfiles):
+        t = pa.table({
+            "store": pa.array(rng.integers(0, 100, rows), type=pa.int64()),
+            "amount": pa.array(rng.random(rows) * 100, type=pa.float64()),
+            "qty": pa.array(rng.integers(1, 50, rows), type=pa.int64()),
+        })
+        tabs.append(t)
+        if plain:
+            _write_plain(str(d / f"p{i}.parquet"), t)
+        else:
+            pq.write_table(t, str(d / f"p{i}.parquet"))
+    return str(d), pa.concat_tables(tabs)
+
+
+def _q5_oracle(t):
+    f = t.filter(pc.greater(t.column("amount"), 10.0))
+    rev = pc.multiply(f.column("amount"),
+                      pc.cast(f.column("qty"), pa.float64()))
+    w = pa.table({"store": f.column("store"), "revenue": rev})
+    return {r["store"]: r["revenue_sum"] for r in
+            w.group_by("store").aggregate(
+                [("revenue", "sum")]).to_pylist()}
+
+
+@pytest.mark.parametrize("plain", [True, False])
+def test_fused_q5_vs_oracle(spark, tmp_path, plain):
+    from spark_rapids_tpu.exec.fused import FusedSingleChipExecutor
+
+    d, all_t = _q5_files(tmp_path, plain=plain)
+    df = (spark.read.parquet(d)
+          .filter(F.col("amount") > 10.0)
+          .select("store",
+                  (F.col("amount") * F.col("qty")).alias("revenue"))
+          .groupBy("store").agg(F.sum("revenue").alias("rev")))
+    phys, _ = df._physical()
+    out = FusedSingleChipExecutor(spark.rapids_conf).execute(phys)
+    got = {r["store"]: r["rev"] for r in out.to_pylist()}
+    exp = _q5_oracle(all_t)
+    assert set(got) == set(exp)
+    for k in exp:
+        assert abs(got[k] - exp[k]) < 1e-6 * max(1.0, abs(exp[k])), k
+
+
+def test_fused_retry_on_group_overflow(spark, tmp_path):
+    """A tiny initial group cap must transparently recompile larger."""
+    from spark_rapids_tpu.exec.fused import FusedSingleChipExecutor
+
+    d, all_t = _q5_files(tmp_path, nfiles=1, rows=9_000)
+    df = spark.read.parquet(d).groupBy("store").agg(
+        F.count("*").alias("n"))
+    phys, _ = df._physical()
+    ex = FusedSingleChipExecutor(spark.rapids_conf, group_cap=16)
+    out = ex.execute(phys)
+    assert out.num_rows == len(set(all_t.column("store").to_pylist()))
+
+
+def test_fused_fallback_collect_arrow(spark, tmp_path):
+    """collect_arrow uses the fused path by default and falls back to
+    the per-operator engine for plans without a fused lowering."""
+    d, all_t = _q5_files(tmp_path, nfiles=2)
+    df = spark.read.parquet(d).groupBy("store").agg(
+        F.collect_list("qty").alias("qs"))  # non-jittable aggregate
+    out = df.collect_arrow()  # must not raise: eager fallback
+    assert out.num_rows == len(set(all_t.column("store").to_pylist()))
+
+
+def test_fused_join_sort_limit(spark):
+    rng = np.random.default_rng(2)
+    fact = pa.table({
+        "k": pa.array(rng.integers(0, 40, 3000), type=pa.int64()),
+        "v": pa.array(rng.random(3000) * 10, type=pa.float64())})
+    dim = pa.table({"k": pa.array(np.arange(50), type=pa.int64()),
+                    "g": pa.array(np.arange(50) % 4, type=pa.int64())})
+    out = (spark.createDataFrame(fact)
+           .join(spark.createDataFrame(dim), on="k", how="inner")
+           .groupBy("g").agg(F.sum("v").alias("s"))
+           .orderBy(F.col("s").desc()).limit(2)).collect_arrow()
+    j = fact.join(dim, keys="k", join_type="inner")
+    w = j.group_by("g").aggregate([("v", "sum")]).to_pylist()
+    top = sorted((r["v_sum"] for r in w), reverse=True)[:2]
+    assert [round(v, 6) for v in out.column("s").to_pylist()] == \
+        [round(v, 6) for v in top]
+
+
+def test_narrowed_upload_roundtrip():
+    from spark_rapids_tpu.columnar.arrow_bridge import device_to_arrow
+    from spark_rapids_tpu.exec.fused import upload_narrowed, widen_traced
+
+    t = pa.table({
+        "i": pa.array([-300, 5, None, 120], type=pa.int64()),
+        "f": pa.array([1.5, None, 3.0, 4.0], type=pa.float64()),
+        "s": pa.array(["a", "bb", None, "dddd"]),
+    })
+    b = upload_narrowed(t)
+    assert b.columns[0].data.dtype == np.int16  # narrowed
+    assert b.columns[0].vrange is not None
+    wide = jax.jit(widen_traced)(b)
+    back = device_to_arrow(wide)
+    assert back.column("i").to_pylist() == [-300, 5, None, 120]
+    assert back.column("f").to_pylist() == [1.5, None, 3.0, 4.0]
+    assert back.column("s").to_pylist() == ["a", "bb", None, "dddd"]
