@@ -50,6 +50,26 @@ def eval_expr(expr: Expression, table: pa.Table) -> pa.ChunkedArray:
     return r
 
 
+def _ansi_div_zero_check(a, b) -> None:
+    """ANSI: raise when any row divides by zero with BOTH operands
+    non-null (called with the operator's already-evaluated operands —
+    no re-evaluation of subtrees)."""
+    from spark_rapids_tpu.config.rapids_conf import ansi_enabled
+
+    if not ansi_enabled():
+        return
+    zero = pc.fill_null(pc.equal(pc.cast(b, pa.float64()), 0.0), False)
+    both = pc.and_(pc.is_valid(a), pc.is_valid(b))
+    hit = pc.and_(both, zero)
+    hit_any = (hit.as_py() if isinstance(hit, pa.Scalar)
+               else pc.any(hit, min_count=0).as_py())
+    if hit_any:
+        from spark_rapids_tpu.runtime.errors import TpuDivideByZero
+
+        raise TpuDivideByZero(
+            "[DIVIDE_BY_ZERO] division by zero in ANSI mode")
+
+
 def _ev(e: Expression, t: pa.Table):
     if isinstance(e, Alias):
         return _ev(e.children[0], t)
@@ -66,12 +86,26 @@ def _ev(e: Expression, t: pa.Table):
               Multiply: pc.multiply_checked}[type(e)]
         if pa.types.is_decimal(out_t):
             return pc.cast(fn(a, b), out_t)
+        from spark_rapids_tpu.config.rapids_conf import ansi_enabled
+
+        if ansi_enabled() and pa.types.is_integer(out_t):
+            from spark_rapids_tpu.runtime.errors import (
+                TpuArithmeticOverflow,
+            )
+
+            try:
+                return pc.cast(fn(pc.cast(a, out_t), pc.cast(b, out_t)),
+                               out_t)
+            except pa.ArrowInvalid as exc:
+                raise TpuArithmeticOverflow(
+                    f"[ARITHMETIC_OVERFLOW] {exc}") from exc
         # use unchecked wraparound for integrals like Java
         fn2 = {Add: pc.add, Subtract: pc.subtract,
                Multiply: pc.multiply}[type(e)]
         return pc.cast(fn2(pc.cast(a, out_t), pc.cast(b, out_t)), out_t)
     if isinstance(e, Divide):
         a, b = _ev(e.children[0], t), _ev(e.children[1], t)
+        _ansi_div_zero_check(a, b)
         out_t = to_arrow_type(e.dtype)
         if pa.types.is_decimal(out_t):
             zero = pc.equal(pc.cast(b, pa.float64()), 0.0)
@@ -85,6 +119,7 @@ def _ev(e: Expression, t: pa.Table):
     if isinstance(e, IntegralDivide):
         a = pc.cast(_ev(e.children[0], t), pa.int64())
         b = pc.cast(_ev(e.children[1], t), pa.int64())
+        _ansi_div_zero_check(a, b)
         zero = pc.equal(b, 0)
         b = pc.if_else(zero, pa.scalar(None, pa.int64()), b)
         return pc.divide(a, b)  # arrow int division truncates toward zero
@@ -98,6 +133,7 @@ def _ev(e: Expression, t: pa.Table):
             return pc.cast(r, out_t)
 
         a, b = _mat(e.children[0]), _mat(e.children[1])
+        _ansi_div_zero_check(a, b)
         an, bn = a.to_numpy(zero_copy_only=False), b.to_numpy(
             zero_copy_only=False)
         mask = pc.or_kleene(pc.is_null(a), pc.or_kleene(
@@ -112,10 +148,24 @@ def _ev(e: Expression, t: pa.Table):
         return pa.array(r, type=out_t,
                         mask=np.asarray(mask.to_numpy(zero_copy_only=False),
                                         dtype=bool))
-    if isinstance(e, UnaryMinus):
-        return pc.negate(_ev(e.children[0], t))
-    if isinstance(e, Abs):
-        return pc.abs(_ev(e.children[0], t))
+    if isinstance(e, (UnaryMinus, Abs)):
+        from spark_rapids_tpu.config.rapids_conf import ansi_enabled
+
+        v = _ev(e.children[0], t)
+        fn = pc.negate if isinstance(e, UnaryMinus) else pc.abs
+        if ansi_enabled() and pa.types.is_integer(_type_of(v)):
+            fnc = (pc.negate_checked if isinstance(e, UnaryMinus)
+                   else pc.abs_checked)
+            try:
+                return fnc(v)
+            except pa.ArrowInvalid as exc:
+                from spark_rapids_tpu.runtime.errors import (
+                    TpuArithmeticOverflow,
+                )
+
+                raise TpuArithmeticOverflow(
+                    f"[ARITHMETIC_OVERFLOW] {exc}") from exc
+        return fn(v)
     if isinstance(e, EqualTo):
         a, b = _ev(e.children[0], t), _ev(e.children[1], t)
         r = pc.equal(a, b)
@@ -708,7 +758,10 @@ def _compare(e, t):
     return r
 
 
-class CastError(ValueError):
+from spark_rapids_tpu.runtime.errors import TpuCastError
+
+
+class CastError(TpuCastError):
     """ANSI-mode cast failure ([CAST_INVALID_INPUT] /
     [CAST_OVERFLOW] role, Spark SparkArithmeticException)."""
 

@@ -7,11 +7,11 @@ propagation, integral wraparound in non-ANSI mode, divide-by-zero -> null,
 Spark's `/` returning double for integral inputs, `div` as integral
 divide, and decimal scale arithmetic for the DECIMAL64 range.
 
-ANSI overflow checking is a planner-level fallback in v1 (queries with
-spark.sql.ansi.enabled run the affected expressions on the CPU oracle
-backend) because data-dependent raises cannot happen inside a traced XLA
-program; a later version can return error flags checked at batch
-boundaries.
+ANSI overflow checking runs ON DEVICE: data-dependent raises cannot
+happen inside a traced XLA program, so expr/ansicheck.py compiles the
+overflow conditions to per-row masks reduced to scalars, and the
+operators raise host-side at batch boundaries (the error-flag design
+this docstring used to promise).
 """
 
 from __future__ import annotations

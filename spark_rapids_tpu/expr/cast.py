@@ -12,7 +12,11 @@ v1 device coverage (non-ANSI semantics):
   Cast(string -> x) for CPU fallback (the reference spent `CastStrings`
   JNI kernels + 1,900 Scala lines here; a pallas parser is future work).
 
-Cast never raises in non-ANSI mode; invalid casts produce null.
+Cast never raises in non-ANSI mode; invalid casts produce null. Under
+spark.sql.ansi.enabled, numeric narrowing and float->integral casts
+raise ON DEVICE via the compiled overflow-mask check
+(expr/ansicheck.py); string/decimal ANSI casts keep the CPU path where
+errors raise eagerly.
 """
 
 from __future__ import annotations

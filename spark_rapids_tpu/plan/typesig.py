@@ -101,10 +101,16 @@ def _cast_check(e: Cast) -> Optional[str]:
     from spark_rapids_tpu.config.rapids_conf import ansi_enabled
 
     if ansi_enabled() and e.can_fail():
-        return (f"ANSI mode: failable cast "
-                f"{e.children[0].dtype.simpleString} -> "
-                f"{e.to.simpleString} runs on CPU so errors raise "
-                "eagerly")
+        # numeric narrowing / float->int casts raise on DEVICE via the
+        # compiled overflow-mask check (expr/ansicheck.py); only casts
+        # without a device check (string parses, decimal) fall back
+        from spark_rapids_tpu.expr.ansicheck import _node_checked
+
+        if not _node_checked(e):
+            return (f"ANSI mode: failable cast "
+                    f"{e.children[0].dtype.simpleString} -> "
+                    f"{e.to.simpleString} runs on CPU so errors raise "
+                    "eagerly")
     return None
 
 

@@ -21,3 +21,25 @@ class TpuRetryOOM(TpuOOMError):
 class TpuSplitAndRetryOOM(TpuOOMError):
     """The work unit cannot fit even after spilling: split the input
     (usually in half by rows) and retry the pieces."""
+
+
+class TpuAnsiError(ValueError):
+    """ANSI-mode runtime error (the SparkArithmeticException /
+    SparkDateTimeException role): raised when spark.sql.ansi.enabled
+    turns wrap/null semantics into errors. Device operators detect the
+    condition with a compiled overflow-mask reduction
+    (expr/ansicheck.py) and raise host-side; the CPU oracle raises the
+    same classes so differential tests compare error classes."""
+
+
+class TpuArithmeticOverflow(TpuAnsiError):
+    """[ARITHMETIC_OVERFLOW] add/subtract/multiply/negate/abs overflow."""
+
+
+class TpuDivideByZero(TpuAnsiError):
+    """[DIVIDE_BY_ZERO] division or remainder by zero."""
+
+
+class TpuCastError(TpuAnsiError):
+    """[CAST_OVERFLOW] / [CAST_INVALID_INPUT] ANSI cast failure (device
+    numeric casts and the CPU oracle's CastError share this base)."""
