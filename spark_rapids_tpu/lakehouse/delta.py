@@ -17,11 +17,16 @@ Covered (reference files in delta-lake/common + delta-24x):
   (GpuDeleteCommand / GpuUpdateCommand) — implemented as join/filter
   rewrites through the engine, committed as remove+add.
 
-v1 rewrites the full table on merge/delete/update (no file-level
-pruning yet). Parquet checkpoints (written every CHECKPOINT_INTERVAL
-commits and via write_checkpoint) carry spec-conformant protocol /
-metaData / add rows with map-typed fields, so readers that start from
-_last_checkpoint — as spec-compliant readers must — stay compatible.
+DML is FILE-LEVEL PRUNED: writes record per-file min/max/null stats in
+the add actions' `stats` JSON, and merge/delete/update rewrite only
+candidate files — DELETE/UPDATE via conservative interval analysis of
+the condition against file stats (_file_might_match), MERGE via
+source-key-range overlap — while untouched files keep their add
+actions (GpuDeleteCommand / GpuMergeIntoCommand candidate selection).
+Parquet checkpoints (written every CHECKPOINT_INTERVAL commits and via
+write_checkpoint) carry spec-conformant protocol / metaData / add rows
+with map-typed fields, so readers that start from _last_checkpoint —
+as spec-compliant readers must — stay compatible.
 """
 
 from __future__ import annotations
@@ -362,6 +367,33 @@ def _meta_action(schema: pa.Schema, partition_cols: List[str]) -> dict:
     }}
 
 
+def _file_stats(piece: pa.Table) -> str:
+    """Per-file column statistics in Delta's `stats` JSON shape
+    ({numRecords, minValues, maxValues, nullCount}) — the input DML
+    file pruning needs (GpuDeltaTaskStatisticsTracker role)."""
+    import pyarrow.compute as pc
+
+    mins, maxs, nulls = {}, {}, {}
+    for name in piece.column_names:
+        col = piece.column(name)
+        nulls[name] = col.null_count
+        t = col.type
+        if not (pa.types.is_integer(t) or pa.types.is_floating(t)
+                or pa.types.is_string(t) or pa.types.is_date(t)
+                or pa.types.is_timestamp(t)):
+            continue
+        if col.null_count == len(col):
+            continue
+        mn, mx = pc.min(col).as_py(), pc.max(col).as_py()
+        if mn is not None:
+            mins[name] = mn if not hasattr(mn, "isoformat") \
+                else mn.isoformat()
+            maxs[name] = mx if not hasattr(mx, "isoformat") \
+                else mx.isoformat()
+    return json.dumps({"numRecords": piece.num_rows, "minValues": mins,
+                       "maxValues": maxs, "nullCount": nulls})
+
+
 def _write_data_files(table: pa.Table, table_path: str,
                       rows_per_file: int = 1 << 20) -> List[dict]:
     adds = []
@@ -378,6 +410,7 @@ def _write_data_files(table: pa.Table, table_path: str,
             "size": os.path.getsize(full),
             "modificationTime": int(time.time() * 1000),
             "dataChange": True,
+            "stats": _file_stats(piece),
         }})
         if table.num_rows == 0:
             break
@@ -422,6 +455,140 @@ def write_delta(df, path: str, mode: str = "error",
 
 # ------------------------------------------------- merge / delete / update
 
+def _add_stats(add: dict) -> Optional[dict]:
+    s = add.get("stats")
+    if not s:
+        return None
+    try:
+        return json.loads(s) if isinstance(s, str) else dict(s)
+    except (ValueError, TypeError):
+        return None
+
+
+def _file_might_match(e, stats: Optional[dict]) -> bool:
+    """Conservative interval analysis of a DML condition against one
+    file's min/max stats (the reference's candidate-file selection in
+    GpuDeleteCommand/GpuMergeIntoCommand: only files that COULD contain
+    matching rows are rewritten). True = cannot prove empty."""
+    from spark_rapids_tpu.api.functions import UnresolvedColumn
+    from spark_rapids_tpu.expr.core import Literal
+    from spark_rapids_tpu.expr.predicates import (
+        And,
+        EqualTo,
+        GreaterThan,
+        GreaterThanOrEqual,
+        In,
+        IsNotNull,
+        IsNull,
+        LessThan,
+        LessThanOrEqual,
+        Not,
+        Or,
+    )
+
+    if stats is None:
+        return True
+    mins = stats.get("minValues") or {}
+    maxs = stats.get("maxValues") or {}
+    nulls = stats.get("nullCount") or {}
+
+    def col_lit(a, b):
+        """-> (name, literal, flipped) for col-vs-literal shapes."""
+        if isinstance(a, UnresolvedColumn) and isinstance(b, Literal):
+            return a.name, b.value, False
+        if isinstance(b, UnresolvedColumn) and isinstance(a, Literal):
+            return b.name, a.value, True
+        return None
+
+    def rng(name):
+        if name in mins and name in maxs:
+            return mins[name], maxs[name]
+        return None
+
+    if isinstance(e, And):
+        return (_file_might_match(e.children[0], stats)
+                and _file_might_match(e.children[1], stats))
+    if isinstance(e, Or):
+        return (_file_might_match(e.children[0], stats)
+                or _file_might_match(e.children[1], stats))
+    if isinstance(e, Not):
+        c = e.children[0]
+        flip = {GreaterThan: LessThanOrEqual,
+                GreaterThanOrEqual: LessThan,
+                LessThan: GreaterThanOrEqual,
+                LessThanOrEqual: GreaterThan}
+        if type(c) in flip:
+            return _file_might_match(
+                flip[type(c)](c.children[0], c.children[1]), stats)
+        return True
+    if isinstance(e, IsNull):
+        c = e.children[0]
+        if isinstance(c, UnresolvedColumn) and c.name in nulls:
+            return nulls[c.name] > 0
+        return True
+    if isinstance(e, IsNotNull):
+        c = e.children[0]
+        if isinstance(c, UnresolvedColumn) and c.name in nulls:
+            return stats.get("numRecords", 1) > nulls[c.name]
+        return True
+    if isinstance(e, In):
+        c = e.children[0]
+        if isinstance(c, UnresolvedColumn) and rng(c.name):
+            lo, hi = rng(c.name)
+            vals = [x.value if isinstance(x, Literal) else x
+                    for x in e.values]
+            try:
+                return any(lo <= v <= hi for v in vals
+                           if v is not None)
+            except TypeError:
+                return True
+        return True
+    if isinstance(e, (EqualTo, GreaterThan, GreaterThanOrEqual,
+                      LessThan, LessThanOrEqual)):
+        cl = col_lit(e.children[0], e.children[1])
+        if cl is None:
+            return True
+        name, v, flipped = cl
+        if v is None or rng(name) is None:
+            return True
+        lo, hi = rng(name)
+        op = type(e)
+        if flipped:  # lit OP col  ->  col FLIP(OP) lit
+            op = {GreaterThan: LessThan, LessThan: GreaterThan,
+                  GreaterThanOrEqual: LessThanOrEqual,
+                  LessThanOrEqual: GreaterThanOrEqual,
+                  EqualTo: EqualTo}[op]
+        try:
+            if op is EqualTo:
+                return lo <= v <= hi
+            if op is GreaterThan:
+                return hi > v
+            if op is GreaterThanOrEqual:
+                return hi >= v
+            if op is LessThan:
+                return lo < v
+            return lo <= v
+        except TypeError:
+            return True
+    return True
+
+
+def _read_files(session, path: str, snap: Snapshot,
+                rel_paths: List[str]):
+    """DataFrame over a SUBSET of a snapshot's files (candidate-only
+    DML rewrites)."""
+    from spark_rapids_tpu.api.dataframe import DataFrame
+    from spark_rapids_tpu.columnar.arrow_bridge import schema_from_arrow
+    from spark_rapids_tpu.plan.logical import FileScan, LocalRelation
+
+    at = _delta_schema_to_arrow(snap.schema_json)
+    if not rel_paths:
+        return DataFrame(LocalRelation(at.empty_table()), session)
+    files = [os.path.join(path, p) for p in rel_paths]
+    return DataFrame(FileScan("parquet", files, schema_from_arrow(at),
+                              {}), session)
+
+
 class DeltaTable:
     """DeltaTable.forPath(spark, path).merge(source, cond)... — the
     GpuMergeIntoCommand / GpuDeleteCommand / GpuUpdateCommand surface.
@@ -449,22 +616,42 @@ class DeltaTable:
         keys = [on] if isinstance(on, str) else list(on)
         return DeltaMergeBuilder(self, source, keys)
 
+    def _candidates(self, snap: Snapshot, cond_expr) -> List[str]:
+        """Files whose stats say they COULD hold matching rows; the
+        rest keep their add actions untouched."""
+        return [p for p in snap.file_paths
+                if _file_might_match(cond_expr,
+                                     _add_stats(snap.files[p]))]
+
     def delete(self, condition=None):
-        """DELETE FROM target WHERE condition."""
+        """DELETE FROM target WHERE condition — rewrites only candidate
+        files (GpuDeleteCommand's candidate-file selection)."""
         from spark_rapids_tpu.api import functions as F
 
-        target = self.toDF()
+        snap = load_snapshot(self.path)
         if condition is None:
-            kept = target.filter(F.lit(False))
-        else:
-            kept = target.filter(~condition)
-        self._rewrite(kept.collect_arrow(), "DELETE")
+            self._rewrite(self.toDF().filter(
+                F.lit(False)).collect_arrow(), "DELETE")
+            return
+        cands = self._candidates(snap, condition.expr)
+        if not cands:
+            return  # provably no matching rows: no-op, no commit
+        kept = _read_files(self.session, self.path, snap,
+                           cands).filter(~condition)
+        self._rewrite(kept.collect_arrow(), "DELETE", snap=snap,
+                      only_files=cands)
 
     def update(self, condition, set_exprs: Dict[str, object]):
-        """UPDATE target SET col = expr WHERE condition."""
+        """UPDATE target SET col = expr WHERE condition — candidate
+        files only (GpuUpdateCommand)."""
         from spark_rapids_tpu.api import functions as F
 
-        target = self.toDF()
+        snap = load_snapshot(self.path)
+        cands = (self._candidates(snap, condition.expr)
+                 if condition is not None else list(snap.file_paths))
+        if not cands:
+            return
+        target = _read_files(self.session, self.path, snap, cands)
         cols = []
         for name in target.columns:
             if name in set_exprs:
@@ -475,22 +662,32 @@ class DeltaTable:
                     .otherwise(F.col(name)).alias(name))
             else:
                 cols.append(F.col(name))
-        self._rewrite(target.select(*cols).collect_arrow(), "UPDATE")
+        self._rewrite(target.select(*cols).collect_arrow(), "UPDATE",
+                      snap=snap, only_files=cands)
 
     def optimize(self) -> "DeltaOptimizeBuilder":
         return DeltaOptimizeBuilder(self)
 
-    def _rewrite(self, table: pa.Table, op: str):
-        snap = load_snapshot(self.path)
+    def _rewrite(self, table: pa.Table, op: str,
+                 snap: Optional[Snapshot] = None,
+                 only_files: Optional[List[str]] = None):
+        """Commit remove(only_files or all) + add(new files). Files not
+        in only_files keep their add actions (file-level pruning)."""
+        if snap is None:
+            snap = load_snapshot(self.path)
         ts = int(time.time() * 1000)
         actions: List[dict] = []
-        for p in snap.file_paths:
+        for p in (only_files if only_files is not None
+                  else snap.file_paths):
             actions.append({"remove": {
                 "path": p, "deletionTimestamp": ts, "dataChange": True}})
         actions.extend(_write_data_files(table, self.path))
-        actions.append({"commitInfo": {"timestamp": ts,
-                                       "operation": op,
-                                       "operationParameters": {}}})
+        actions.append({"commitInfo": {
+            "timestamp": ts, "operation": op,
+            "operationParameters": {},
+            "readVersion": snap.version,
+            "prunedFiles": (len(snap.file_paths) - len(only_files))
+            if only_files is not None else 0}})
         _commit(self.path, snap.version + 1, actions)
 
 
@@ -542,14 +739,45 @@ class DeltaMergeBuilder:
         return self
 
     def execute(self):
-        """MERGE rewrite through the engine: target LEFT-ANTI source
-        (untouched rows) UNION matched source rows (updateAll) UNION
-        not-matched source rows (insertAll) — the GpuMergeIntoCommand
-        join strategy without file-level pruning."""
+        """MERGE rewrite through the engine: candidate target files are
+        those whose key-column stats overlap the SOURCE's key ranges —
+        a source key matching any target row implies range overlap, so
+        joins against candidates alone are exact
+        (GpuMergeIntoCommand's candidate-file selection). Then:
+        candidates LEFT-ANTI source (untouched rows) UNION matched
+        source rows (updateAll) UNION not-matched source rows
+        (insertAll); non-candidate files keep their add actions."""
+        import pyarrow.compute as pc
+
         t = self.table
-        target = t.toDF()
-        source = self.source
         keys = self.keys
+        snap = load_snapshot(t.path)
+        src_tbl = self.source.collect_arrow()
+        source = t.session.createDataFrame(src_tbl)
+
+        def overlaps(add) -> bool:
+            stats = _add_stats(add)
+            if stats is None or src_tbl.num_rows == 0:
+                return True
+            mins = stats.get("minValues") or {}
+            maxs = stats.get("maxValues") or {}
+            for k in keys:
+                if k not in mins or k not in maxs:
+                    continue
+                col = src_tbl.column(k)
+                if col.null_count == len(col):
+                    continue
+                smin, smax = pc.min(col).as_py(), pc.max(col).as_py()
+                try:
+                    if smax < mins[k] or smin > maxs[k]:
+                        return False
+                except TypeError:
+                    continue
+            return True
+
+        cands = [p for p in snap.file_paths
+                 if overlaps(snap.files[p])]
+        target = _read_files(t.session, t.path, snap, cands)
         parts = []
         if self._delete_matched or self._update_all:
             untouched = target.join(source, on=keys, how="left_anti")
@@ -567,4 +795,4 @@ class DeltaMergeBuilder:
         merged = pa.concat_tables(
             [p.select(cols).cast(parts[0].schema) for p in parts],
             promote_options="none")
-        t._rewrite(merged, "MERGE")
+        t._rewrite(merged, "MERGE", snap=snap, only_files=cands)

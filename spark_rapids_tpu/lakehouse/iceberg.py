@@ -1,18 +1,34 @@
 """Iceberg v1/v2 table reads — the sql-plugin iceberg/ analog
-(reference: 29 Java files, GpuSparkBatchQueryScan / IcebergProvider;
-here a direct implementation of the open table spec).
+(reference: 29 Java files, GpuSparkBatchQueryScan / IcebergProvider /
+GpuDeleteFilter; here a direct implementation of the open table spec).
 
 Snapshot resolution: metadata/version-hint.text (or the highest
 vN.metadata.json) -> current-snapshot-id -> snapshot's manifest-list
 avro -> manifest avros -> live data-file set (status 2 = DELETED entries
 drop out). Schemas come from the metadata JSON (current-schema-id).
-Scans ride the engine's parquet FileScan, so pruning/pushdown and device
-decode apply unchanged.
 
-Registered through the external-source SPI:
-spark.read.format("iceberg").load(path). Row-level delete files
-(v2 merge-on-read) are not applied yet — tables carrying delete files
-are rejected rather than silently misread.
+v2 merge-on-read deletes ARE applied (the GpuDeleteFilter role,
+iceberg/data/GpuDeleteFilter.java):
+- POSITION deletes (content=1): (file_path, pos) rows mask positions of
+  a data file; applies when delete data_sequence_number >= the data
+  file's.
+- EQUALITY deletes (content=2): rows matching the delete file's rows on
+  its equality_ids columns drop; applies when delete sequence number is
+  STRICTLY greater than the data file's (spec section "Delete file
+  application").
+
+Schema evolution resolves columns BY FIELD ID
+(GpuSparkBatchQueryScan.java's id-based projection): each data file's
+parquet schema carries PARQUET:field_id metadata; the current schema
+maps ids -> (name, type), so renamed columns read correctly and added
+columns materialize as nulls. Files without ids (non-iceberg writers)
+fall back to by-name resolution.
+
+Scans run as per-file tasks of the engine's FileScan (fmt="iceberg"),
+so the thread pool, device upload, and downstream operators apply
+unchanged; delete masks are applied host-side before upload in v1
+(the reference filters on device — a future device pass can move the
+positional mask into the fused scan program).
 """
 
 from __future__ import annotations
@@ -20,8 +36,9 @@ from __future__ import annotations
 import json
 import os
 import re
-from typing import List, Optional
+from typing import Dict, List, Optional
 
+import numpy as np
 import pyarrow as pa
 
 from spark_rapids_tpu.io.avro import read_avro_records
@@ -88,7 +105,8 @@ def _resolve(table_path: str, location: str) -> str:
     return location
 
 
-def _current_schema_arrow(meta: dict) -> pa.Schema:
+def _current_schema_arrow(meta: dict):
+    """-> (pa.Schema, {field_id: name}) of the current schema."""
     schemas = meta.get("schemas")
     if schemas:
         sid = meta.get("current-schema-id", 0)
@@ -99,40 +117,233 @@ def _current_schema_arrow(meta: dict) -> pa.Schema:
                 f"current-schema-id {sid} not present in metadata")
     else:
         schema = meta["schema"]  # v1 legacy single schema
-    return pa.schema([
+    arrow = pa.schema([
         pa.field(f["name"], _ice_type_to_arrow(f["type"]),
                  not f.get("required", False))
         for f in schema["fields"]])
+    ids = {f["id"]: f["name"] for f in schema["fields"]}
+    return arrow, ids
 
 
-def live_data_files(table_path: str) -> List[str]:
-    meta = _load_metadata(table_path)
+class IcebergReadContext:
+    """Everything a per-file read task needs: the projected (current)
+    schema with field ids, per-data-file sequence numbers, and the
+    table's delete files."""
+
+    def __init__(self, arrow_schema: pa.Schema,
+                 field_ids: Dict[int, str]):
+        self.schema = arrow_schema
+        self.field_ids = field_ids  # field id -> current column name
+        self.data_seq: Dict[str, int] = {}
+        # position deletes: data file path -> sorted np.ndarray positions
+        self.pos_deletes: Dict[str, List] = {}
+        # equality deletes: [(seq, [field ids], pa.Table rows renamed to
+        # CURRENT column names)]
+        self.eq_deletes: List = []
+
+    def eq_delete_names(self) -> List[str]:
+        """Current-schema names every equality delete needs — these
+        columns must be READ even when the projection drops them."""
+        out = []
+        for _seq, fids, _rows in self.eq_deletes:
+            for fid in fids:
+                n = self.field_ids.get(fid)
+                if n is not None and n not in out:
+                    out.append(n)
+        return out
+
+    def pos_for(self, path: str) -> Optional[np.ndarray]:
+        chunks = self.pos_deletes.get(path)
+        if not chunks:
+            return None
+        return np.unique(np.concatenate(chunks))
+
+
+def _scan_manifests(table_path: str, meta: dict):
+    """Yield (manifest_seq, entry_record) for every manifest entry of
+    the current snapshot."""
     snap_id = meta.get("current-snapshot-id")
     if snap_id is None or snap_id == -1:
-        return []
+        return
     snap = next((s for s in meta.get("snapshots", [])
                  if s.get("snapshot-id") == snap_id), None)
     if snap is None:
         raise IcebergError(f"snapshot {snap_id} missing")
     mlist = _resolve(table_path, snap["manifest-list"])
-    files: List[str] = []
     for entry in read_avro_records(mlist):
         mpath = _resolve(table_path, entry["manifest_path"])
-        if entry.get("content", 0) == 1:
-            raise IcebergError(
-                "delete manifests (v2 merge-on-read) unsupported")
+        mseq = entry.get("sequence_number") or 0
         for rec in read_avro_records(mpath):
-            status = rec.get("status", 1)
-            df = rec.get("data_file") or {}
-            if df.get("content", 0) != 0:
-                raise IcebergError("delete files unsupported")
-            if status == 2:  # DELETED
-                continue
-            fmt = str(df.get("file_format", "PARQUET")).upper()
-            if fmt != "PARQUET":
+            yield mseq, rec
+
+
+def build_read_context(table_path: str, meta: dict,
+                       arrow_schema: pa.Schema,
+                       field_ids: Dict[int, str]) -> IcebergReadContext:
+    """Walk the current snapshot's manifests into data files + applied
+    delete files (GpuDeleteFilter inputs)."""
+    import pyarrow.parquet as pq
+
+    ctx = IcebergReadContext(arrow_schema, field_ids)
+    deletes = []  # (kind, seq, data_file record)
+    for mseq, rec in _scan_manifests(table_path, meta):
+        status = rec.get("status", 1)
+        if status == 2:  # DELETED entry
+            continue
+        df = rec.get("data_file") or {}
+        seq = rec.get("sequence_number")
+        if seq is None:
+            seq = mseq
+        content = df.get("content", 0)
+        path = _resolve(table_path, df["file_path"])
+        fmt = str(df.get("file_format", "PARQUET")).upper()
+        if fmt != "PARQUET":
+            raise IcebergError(
+                f"file format {fmt} unsupported (parquet only)")
+        if content == 0:
+            ctx.data_seq[path] = seq
+        elif content == 1:  # position deletes
+            deletes.append(("pos", seq, path, df))
+        elif content == 2:  # equality deletes
+            deletes.append(("eq", seq, path, df))
+        else:
+            raise IcebergError(f"manifest content {content}")
+    for kind, seq, path, df in deletes:
+        t = pq.read_table(path)
+        if kind == "pos":
+            fp = t.column("file_path").to_pylist()
+            pos = np.asarray(t.column("pos").to_pylist(), dtype=np.int64)
+            for target in set(fp):
+                rt = _resolve(table_path, target)
+                if rt in ctx.data_seq and seq < ctx.data_seq[rt]:
+                    continue  # older than the data file: not applicable
+                mask = np.asarray([f == target for f in fp])
+                ctx.pos_deletes.setdefault(rt, []).append(pos[mask])
+        else:
+            eq_ids = df.get("equality_ids") or []
+            names = [field_ids.get(i) for i in eq_ids]
+            if any(n is None for n in names):
                 raise IcebergError(
-                    f"data file format {fmt} unsupported (parquet only)")
-            files.append(_resolve(table_path, df["file_path"]))
+                    f"equality delete ids {eq_ids} not in schema")
+            # resolve the delete file's columns BY FIELD ID (its
+            # write-time names may predate renames), falling back to
+            # current names; missing keys are an error, not a silent
+            # partial-key join
+            dfile = pq.ParquetFile(path)
+            del_ids = _file_field_id_map(dfile)
+            file_names = dfile.schema_arrow.names
+            sel, out_names = [], []
+            for fid, cur in zip(eq_ids, names):
+                if del_ids is not None and fid in del_ids:
+                    sel.append(file_names[del_ids[fid]])
+                elif cur in t.column_names:
+                    sel.append(cur)
+                else:
+                    raise IcebergError(
+                        f"equality delete file {path} lacks field "
+                        f"{fid} ({cur})")
+                out_names.append(cur)
+            ctx.eq_deletes.append((seq, list(eq_ids),
+                                   t.select(sel).rename_columns(
+                                       out_names)))
+    return ctx
+
+
+def _file_field_id_map(pf) -> Optional[Dict[int, int]]:
+    """field id -> column index of a parquet file, from the
+    PARQUET:field_id metadata iceberg writers stamp; None when the file
+    carries no ids (fall back to by-name)."""
+    sch = pf.schema_arrow
+    out = {}
+    for i, f in enumerate(sch):
+        md = f.metadata or {}
+        fid = md.get(b"PARQUET:field_id")
+        if fid is None:
+            return None
+        out[int(fid)] = i
+    return out
+
+
+def read_data_file(ctx: IcebergReadContext, path: str,
+                   columns: Optional[List[str]] = None) -> pa.Table:
+    """One data file -> current-schema arrow table with deletes applied
+    (the per-task body of the reference's GpuMultiFileBatchReader +
+    GpuDeleteFilter pipeline). Only the projected columns PLUS any
+    equality-delete key columns are decoded; the extra keys drop after
+    the delete joins."""
+    import pyarrow.compute as pc
+    import pyarrow.parquet as pq
+
+    schema_names = set(ctx.schema.names)
+    proj = [n for n in ctx.schema.names
+            if columns is None or n in columns]
+    needed = list(proj)
+    for n in ctx.eq_delete_names():
+        if n in schema_names and n not in needed:
+            needed.append(n)
+
+    pf = pq.ParquetFile(path)
+    id_map = _file_field_id_map(pf)
+    file_names = pf.schema_arrow.names
+    # current field id -> this file's column name
+    read_cols, sources = [], {}
+    for fid, name in ctx.field_ids.items():
+        if name not in needed:
+            continue
+        if id_map is not None and fid in id_map:
+            src = file_names[id_map[fid]]
+        elif id_map is None and name in file_names:
+            src = name
+        else:
+            sources[name] = None  # added column -> nulls
+            continue
+        sources[name] = src
+        read_cols.append(src)
+    t = pq.read_table(path, columns=read_cols) if read_cols else \
+        pq.read_table(path, columns=[])
+    n = pf.metadata.num_rows
+    arrays, names = [], []
+    for name in needed:
+        field = ctx.schema.field(name)
+        src = sources.get(name)
+        arr = pa.nulls(n, field.type) if src is None else t.column(src)
+        if arr.type != field.type:
+            arr = arr.cast(field.type)  # type promotion (int -> long)
+        arrays.append(arr)
+        names.append(name)
+    out = pa.table(dict(zip(names, arrays)))
+    # position deletes
+    pos = ctx.pos_for(path)
+    if pos is not None and len(pos):
+        keep = np.ones(n, dtype=bool)
+        keep[pos[pos < n]] = False
+        out = out.filter(pa.array(keep))
+    # equality deletes (strictly newer than the data file)
+    my_seq = ctx.data_seq.get(path, 0)
+    for seq, fids, rows in ctx.eq_deletes:
+        if seq <= my_seq or rows.num_rows == 0:
+            continue
+        cols = [ctx.field_ids[fid] for fid in fids]
+        # anti-join on the full equality key (cols are all in `needed`)
+        distinct = rows.select(cols).group_by(cols).aggregate([])
+        marked = distinct.append_column(
+            "__del__", pa.array([True] * distinct.num_rows))
+        joined = out.join(marked, keys=cols, join_type="left outer")
+        keep = pc.fill_null(pc.is_null(joined.column("__del__")), True)
+        out = joined.filter(keep).drop_columns(["__del__"])
+        out = out.select(names)  # joins may reorder columns
+    return out.select(proj)
+
+
+def live_data_files(table_path: str) -> List[str]:
+    meta = _load_metadata(table_path)
+    files: List[str] = []
+    for _mseq, rec in _scan_manifests(table_path, meta):
+        status = rec.get("status", 1)
+        df = rec.get("data_file") or {}
+        if status == 2 or df.get("content", 0) != 0:
+            continue
+        files.append(_resolve(table_path, df["file_path"]))
     return files
 
 
@@ -146,6 +357,7 @@ def read_iceberg(session, path: str, schema=None, options=None):
             f"iceberg reader options unsupported in v1: "
             f"{sorted(options)}")
     meta = _load_metadata(path)
+    cur_schema, field_ids = _current_schema_arrow(meta)
     if schema is not None:
         # the reader convention passes the engine StructType
         # (api/session.py DataFrameReader.schema); accept a raw
@@ -160,13 +372,15 @@ def read_iceberg(session, path: str, schema=None, options=None):
         else:
             arrow_schema = schema
     else:
-        arrow_schema = _current_schema_arrow(meta)
-    files = live_data_files(path)
+        arrow_schema = cur_schema
+    ctx = build_read_context(path, meta, arrow_schema, field_ids)
+    files = sorted(ctx.data_seq)
     if not files:
         return DataFrame(LocalRelation(arrow_schema.empty_table()),
                          session)
-    return DataFrame(FileScan("parquet", files,
-                              schema_from_arrow(arrow_schema), {}),
+    return DataFrame(FileScan("iceberg", files,
+                              schema_from_arrow(arrow_schema),
+                              {"iceberg_ctx": ctx}),
                      session)
 
 

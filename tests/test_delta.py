@@ -172,3 +172,94 @@ def test_auto_checkpoint_every_10_commits(spark, tmp_path):
     assert os.path.exists(os.path.join(
         p, "_delta_log", f"{10:020d}.checkpoint.parquet"))
     assert spark.read.delta(p).count() == 60
+
+
+# ---------------- file-level DML pruning (round-4 verdict item #7) ----
+
+
+def _ranged_df(spark, lo, n=500, seed=None):
+    rng = np.random.default_rng(seed if seed is not None else lo)
+    return spark.createDataFrame(pa.table({
+        "id": pa.array(np.arange(lo, lo + n), type=pa.int64()),
+        "v": pa.array(rng.random(n), type=pa.float64()),
+    }))
+
+
+def _three_file_table(spark, p):
+    """Three data files with disjoint id ranges [0,500) [1000,1500)
+    [2000,2500) — one per append commit."""
+    for i, lo in enumerate((0, 1000, 2000)):
+        _ranged_df(spark, lo).write.format("delta").mode(
+            "error" if i == 0 else "append").save(p)
+    return load_snapshot(p)
+
+
+def test_delete_prunes_untouched_files(spark, tmp_path):
+    p = str(tmp_path / "prune1")
+    snap0 = _three_file_table(spark, p)
+    assert len(snap0.files) == 3
+    by_range = {json.loads(a["stats"])["minValues"]["id"]: path
+                for path, a in snap0.files.items()}
+    DeltaTable.forPath(spark, p).delete(F.col("id") < 500)
+    snap1 = load_snapshot(p)
+    # files [1000,1500) and [2000,2500) kept their ORIGINAL add actions
+    assert by_range[1000] in snap1.files
+    assert by_range[2000] in snap1.files
+    assert by_range[0] not in snap1.files
+    out = spark.read.format("delta").load(p).collect_arrow()
+    ids = sorted(out.column("id").to_pylist())
+    assert len(ids) == 1000 and ids[0] == 1000 and ids[-1] == 2499
+    # the commit records how many files pruning skipped
+    with open(os.path.join(p, "_delta_log",
+                           f"{snap1.version:020d}.json")) as f:
+        infos = [json.loads(ln) for ln in f if ln.strip()]
+    ci = next(a["commitInfo"] for a in infos if "commitInfo" in a)
+    assert ci["prunedFiles"] == 2
+
+
+def test_delete_provably_empty_is_noop(spark, tmp_path):
+    p = str(tmp_path / "prune2")
+    snap0 = _three_file_table(spark, p)
+    DeltaTable.forPath(spark, p).delete(F.col("id") > 99_999)
+    snap1 = load_snapshot(p)
+    assert snap1.version == snap0.version  # no commit at all
+    assert set(snap1.files) == set(snap0.files)
+
+
+def test_update_prunes_untouched_files(spark, tmp_path):
+    p = str(tmp_path / "prune3")
+    snap0 = _three_file_table(spark, p)
+    by_range = {json.loads(a["stats"])["minValues"]["id"]: path
+                for path, a in snap0.files.items()}
+    DeltaTable.forPath(spark, p).update(
+        F.col("id") >= 2000, {"v": F.lit(-1.0)})
+    snap1 = load_snapshot(p)
+    assert by_range[0] in snap1.files
+    assert by_range[1000] in snap1.files
+    assert by_range[2000] not in snap1.files
+    out = spark.read.format("delta").load(p).collect_arrow()
+    got = {r["id"]: r["v"] for r in out.to_pylist()}
+    assert all(got[i] == -1.0 for i in range(2000, 2500))
+    assert all(got[i] != -1.0 for i in range(0, 500))
+
+
+def test_merge_prunes_by_source_key_range(spark, tmp_path):
+    p = str(tmp_path / "prune4")
+    snap0 = _three_file_table(spark, p)
+    by_range = {json.loads(a["stats"])["minValues"]["id"]: path
+                for path, a in snap0.files.items()}
+    src = spark.createDataFrame(pa.table({
+        "id": pa.array([10, 20, 600], type=pa.int64()),
+        "v": pa.array([9.0, 9.0, 9.0], type=pa.float64()),
+    }))
+    (DeltaTable.forPath(spark, p).merge(src, "id")
+     .whenMatchedUpdateAll().whenNotMatchedInsertAll().execute())
+    snap1 = load_snapshot(p)
+    # source ids [10, 600] overlap only file [0,500): others untouched
+    assert by_range[1000] in snap1.files
+    assert by_range[2000] in snap1.files
+    assert by_range[0] not in snap1.files
+    out = spark.read.format("delta").load(p).collect_arrow()
+    got = {r["id"]: r["v"] for r in out.to_pylist()}
+    assert got[10] == 9.0 and got[20] == 9.0 and got[600] == 9.0
+    assert len(got) == 1501  # 1500 original + inserted id 600

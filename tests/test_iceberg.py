@@ -142,3 +142,162 @@ def test_iceberg_schema_from_metadata(spark, tmp_path):
     assert df.columns == ["k", "v", "id"]
     out = df.filter(F.col("id") < 100).collect_arrow()
     assert out.num_rows == 100
+
+
+# ---- v2 merge-on-read deletes + schema evolution (round-4 item #6) ----
+
+_ENTRY_SCHEMA_V2 = {
+    "type": "record", "name": "manifest_entry", "fields": [
+        {"name": "status", "type": "int"},
+        {"name": "snapshot_id", "type": ["null", "long"]},
+        {"name": "sequence_number", "type": ["null", "long"]},
+        {"name": "data_file", "type": {
+            "type": "record", "name": "data_file", "fields": [
+                {"name": "content", "type": "int"},
+                {"name": "file_path", "type": "string"},
+                {"name": "file_format", "type": "string"},
+                {"name": "record_count", "type": "long"},
+                {"name": "file_size_in_bytes", "type": "long"},
+                {"name": "equality_ids", "type": ["null", {
+                    "type": "array", "items": "int"}]},
+            ]}},
+    ]}
+
+
+def _ice_field(name, typ, fid):
+    return pa.field(name, typ,
+                    metadata={b"PARQUET:field_id": str(fid).encode()})
+
+
+def build_v2_table(root, schema_fields, files, version=1):
+    """files: [(path_rel, content, seq, pa.Table, equality_ids)]"""
+    os.makedirs(os.path.join(root, "data"), exist_ok=True)
+    os.makedirs(os.path.join(root, "metadata"), exist_ok=True)
+    entries = []
+    for rel, content, seq, t, eq_ids in files:
+        p = os.path.join(root, "data", rel)
+        pq.write_table(t, p)
+        entries.append({
+            "status": 1, "snapshot_id": 99, "sequence_number": seq,
+            "data_file": {
+                "content": content, "file_path": p,
+                "file_format": "PARQUET", "record_count": t.num_rows,
+                "file_size_in_bytes": os.path.getsize(p),
+                "equality_ids": eq_ids}})
+    mpath = os.path.join(root, "metadata", "manifest-1.avro")
+    write_avro_records(mpath, _ENTRY_SCHEMA_V2, entries)
+    mlist = os.path.join(root, "metadata", "snap-99.avro")
+    write_avro_records(mlist, _MANIFEST_LIST_SCHEMA, [{
+        "manifest_path": mpath,
+        "manifest_length": os.path.getsize(mpath),
+        "partition_spec_id": 0, "content": 0,
+        "added_snapshot_id": 99}])
+    meta = {
+        "format-version": 2, "table-uuid": "0000-t", "location": root,
+        "current-snapshot-id": 99,
+        "schemas": [{"schema-id": 0, "type": "struct",
+                     "fields": schema_fields}],
+        "current-schema-id": 0,
+        "snapshots": [{"snapshot-id": 99, "manifest-list": mlist,
+                       "timestamp-ms": 0}],
+    }
+    with open(os.path.join(root, "metadata",
+                           f"v{version}.metadata.json"), "w") as f:
+        json.dump(meta, f)
+    with open(os.path.join(root, "metadata", "version-hint.text"),
+              "w") as f:
+        f.write(str(version))
+    return root
+
+
+_SCHEMA_KV = [
+    {"id": 1, "name": "k", "required": False, "type": "long"},
+    {"id": 2, "name": "v", "required": False, "type": "double"},
+]
+
+
+def _kv_table(ids):
+    return pa.table({"k": pa.array(ids, type=pa.int64()),
+                     "v": pa.array([float(i) for i in ids],
+                                   type=pa.float64())})
+
+
+def test_positional_deletes(spark, tmp_path):
+    root = str(tmp_path / "posdel")
+    data = _kv_table(range(100))
+    data_path = os.path.join(root, "data", "d0.parquet")
+    pos_del = pa.table({
+        "file_path": pa.array([data_path] * 3),
+        "pos": pa.array([0, 7, 99], type=pa.int64())})
+    build_v2_table(root, _SCHEMA_KV, [
+        ("d0.parquet", 0, 1, data, None),
+        ("del0.parquet", 1, 2, pos_del, None)])
+    out = spark.read.format("iceberg").load(root).collect_arrow()
+    ks = sorted(out.column("k").to_pylist())
+    assert len(ks) == 97 and 0 not in ks and 7 not in ks and 99 not in ks
+
+
+def test_positional_delete_older_than_data_ignored(spark, tmp_path):
+    root = str(tmp_path / "posdel_old")
+    data = _kv_table(range(10))
+    data_path = os.path.join(root, "data", "d0.parquet")
+    pos_del = pa.table({"file_path": pa.array([data_path]),
+                        "pos": pa.array([1], type=pa.int64())})
+    build_v2_table(root, _SCHEMA_KV, [
+        ("d0.parquet", 0, 5, data, None),
+        ("del0.parquet", 1, 2, pos_del, None)])  # seq 2 < data seq 5
+    out = spark.read.format("iceberg").load(root).collect_arrow()
+    assert out.num_rows == 10
+
+
+def test_equality_deletes_sequence_scoped(spark, tmp_path):
+    """Equality deletes apply only to data files with STRICTLY smaller
+    sequence numbers (a re-inserted key in a newer file survives)."""
+    root = str(tmp_path / "eqdel")
+    old = _kv_table([1, 2, 3, 4])       # seq 1
+    newer = _kv_table([3, 5])           # seq 3: re-inserts k=3
+    eq_del = pa.table({"k": pa.array([2, 3], type=pa.int64())})  # seq 2
+    build_v2_table(root, _SCHEMA_KV, [
+        ("old.parquet", 0, 1, old, None),
+        ("new.parquet", 0, 3, newer, None),
+        ("eqdel.parquet", 2, 2, eq_del, [1])])
+    out = spark.read.format("iceberg").load(root).collect_arrow()
+    assert sorted(out.column("k").to_pylist()) == [1, 3, 4, 5]
+
+
+def test_schema_evolution_rename_and_add(spark, tmp_path):
+    """Field-id resolution: the file was written when column 2 was
+    named 'val'; the current schema renames it to 'v' and adds id 3."""
+    root = str(tmp_path / "evolve")
+    file_schema = pa.schema([
+        _ice_field("k", pa.int64(), 1),
+        _ice_field("val", pa.float64(), 2)])
+    t = pa.table({"k": pa.array([1, 2], type=pa.int64()),
+                  "val": pa.array([1.5, 2.5], type=pa.float64())})
+    t = t.cast(file_schema)
+    schema_fields = [
+        {"id": 1, "name": "k", "required": False, "type": "long"},
+        {"id": 2, "name": "v", "required": False, "type": "double"},
+        {"id": 3, "name": "extra", "required": False, "type": "long"},
+    ]
+    build_v2_table(root, schema_fields, [("d0.parquet", 0, 1, t, None)])
+    df = spark.read.format("iceberg").load(root)
+    assert df.columns == ["k", "v", "extra"]
+    out = df.collect_arrow()
+    assert out.column("v").to_pylist() == [1.5, 2.5]   # renamed col read
+    assert out.column("extra").to_pylist() == [None, None]  # added col
+
+
+def test_equality_delete_with_pruned_projection(spark, tmp_path):
+    """Column pruning must not resurrect equality-deleted rows: the
+    delete key column is read for the join even when the query projects
+    it away (review finding, round 4)."""
+    root = str(tmp_path / "eqprune")
+    data = _kv_table([1, 2, 3, 4])
+    eq_del = pa.table({"k": pa.array([2, 4], type=pa.int64())})
+    build_v2_table(root, _SCHEMA_KV, [
+        ("d0.parquet", 0, 1, data, None),
+        ("eqdel.parquet", 2, 2, eq_del, [1])])
+    out = (spark.read.format("iceberg").load(root)
+           .select("v").collect_arrow())
+    assert sorted(out.column("v").to_pylist()) == [1.0, 3.0]
