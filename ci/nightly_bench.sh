@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Nightly perf job — the jenkins/spark-nightly-build.sh role: run the
+# engine benchmark on real hardware and archive the JSON line.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+out="bench-$(date +%Y%m%d).json"
+timeout 900 python bench.py | tee "$out"

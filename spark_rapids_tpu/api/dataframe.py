@@ -597,6 +597,22 @@ class DataFrame:
                 return out
             except FusedCompileError:
                 pass  # no fused lowering / too big: per-operator engine
+        if self.session.rapids_conf.get(rc.ADAPTIVE_ENABLED):
+            from spark_rapids_tpu.exec.operators import (
+                TpuShuffleExchangeExec,
+            )
+            from spark_rapids_tpu.plan.aqe import AdaptiveQueryExecutor
+
+            def has_exchange(n):
+                return isinstance(n, TpuShuffleExchangeExec) or any(
+                    has_exchange(c) for c in n.children)
+
+            if has_exchange(phys):
+                out = AdaptiveQueryExecutor(
+                    self.session.rapids_conf).execute(phys)
+                if getattr(self, "_cached", False):
+                    self._cache_store(out)
+                return out
         out = phys.collect()
         if getattr(self, "_cached", False):
             self._cache_store(out)

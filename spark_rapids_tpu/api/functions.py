@@ -703,6 +703,18 @@ def udf(f=None, returnType=None):
     return wrap
 
 
+def call_udf(name: str, *cols) -> Column:
+    """Invoke a UDF registered on the active session
+    (spark.udf.register / registerHive / registerDevice)."""
+    from spark_rapids_tpu.api.session import TpuSparkSession
+    from spark_rapids_tpu.udf.hive_udf import call_registered
+
+    session = TpuSparkSession.active()
+    if session is None:
+        raise RuntimeError("no active session for call_udf")
+    return call_registered(session, name, cols)
+
+
 def pandas_udf(f=None, returnType=None):
     """Scalar pandas UDF: runs over pandas Series in a worker-process
     pool via Arrow IPC (the GpuArrowEvalPythonExec exchange analog,

@@ -94,15 +94,40 @@ class DataFrameReader:
                                   self._options), self.session)
 
     def parquet(self, *paths: str):
+        import pyarrow as _pa
+
         from spark_rapids_tpu.api.dataframe import DataFrame
         from spark_rapids_tpu.columnar.arrow_bridge import schema_from_arrow
-        from spark_rapids_tpu.io.readers import infer_parquet_schema
+        from spark_rapids_tpu.io.readers import (
+            discover_partitions,
+            expand_paths,
+            infer_parquet_schema,
+        )
         from spark_rapids_tpu.plan.logical import FileScan
 
-        schema = self._schema or schema_from_arrow(
-            infer_parquet_schema(list(paths)))
+        files = expand_paths(list(paths), ".parquet")
+        from spark_rapids_tpu.io.readers import resolve_input_paths
+
+        part_cols, file_values = discover_partitions(
+            files, resolve_input_paths(list(paths)))
+        opts = dict(self._options)
+        arrow_schema = (None if self._schema is not None
+                        else infer_parquet_schema(list(paths)))
+        if part_cols:
+            # partition columns materialize from the directory layout
+            # (PartitioningAwareFileIndex role); they are appended
+            # after the file columns, Spark-style. With an explicit
+            # user schema the spec still attaches — the values come
+            # from the directories, typed per the declared field.
+            if self._schema is None:
+                for name, is_int in part_cols:
+                    if name not in arrow_schema.names:
+                        arrow_schema = arrow_schema.append(_pa.field(
+                            name, _pa.int64() if is_int else _pa.string()))
+            opts["partition_spec"] = (part_cols, file_values)
+        schema = self._schema or schema_from_arrow(arrow_schema)
         return DataFrame(FileScan("parquet", list(paths), schema,
-                                  self._options), self.session)
+                                  opts), self.session)
 
     def csv(self, path: str, header: bool = True, **kw):
         from spark_rapids_tpu.api.dataframe import DataFrame
@@ -198,6 +223,16 @@ class TpuSparkSession:
     @property
     def conf(self):
         return TpuSparkSession._ConfView(self)
+
+    # --- UDF registry (UDFRegistration / hiveUDFs.scala surface) ---
+
+    @property
+    def udf(self):
+        from spark_rapids_tpu.udf.hive_udf import UDFRegistration
+
+        if not hasattr(self, "_udf_reg"):
+            self._udf_reg = UDFRegistration(self)
+        return self._udf_reg
 
     # --- data sources ---
 

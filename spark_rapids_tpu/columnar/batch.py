@@ -102,6 +102,17 @@ class DeviceColumn:
     def max_elems(self) -> Optional[int]:
         return int(self.data.shape[1]) if self.is_array else None
 
+    def truncate(self, cap: int) -> "DeviceColumn":
+        """Row-prefix view [:cap] of every per-row leaf (trace-safe;
+        static slice). Callers guarantee live rows fit in cap."""
+        return DeviceColumn(
+            self.dtype, self.data[:cap], self.validity[:cap],
+            None if self.lengths is None else self.lengths[:cap],
+            None if self.elem_validity is None
+            else self.elem_validity[:cap],
+            None if self.map_values is None else self.map_values[:cap],
+            self.vrange)
+
     def device_size_bytes(self) -> int:
         n = self.data.size * self.data.dtype.itemsize
         n += self.validity.size  # bool = 1 byte

@@ -216,6 +216,20 @@ SHUFFLE_SPILL_THRESHOLD = conf(
 SHUFFLE_PARTITIONS = conf(
     "spark.sql.shuffle.partitions", 8,
     "Number of shuffle output partitions.", int)
+ADAPTIVE_ENABLED = conf(
+    "spark.sql.adaptive.enabled", True,
+    "Adaptive query execution for the per-operator engine: exchanges "
+    "materialize stage by stage and the remainder re-plans with the "
+    "observed output statistics — broadcast-join promotion (cancelling "
+    "unrun probe-side shuffles) and tiny-partition coalescing "
+    "(reference: GpuOverrides per AQE query stage, "
+    "GpuOverrides.scala:517-580).", bool)
+JOIN_BLOOM_FILTER = conf(
+    "spark.rapids.sql.join.bloomFilter.enabled", True,
+    "Build-side bloom runtime filter applied to the probe side of "
+    "inner/semi hash joins before the probe (spark-rapids-jni "
+    "BloomFilter / GpuBloomFilterMightContain role): provably-absent "
+    "probe rows drop and the batch re-buckets smaller.", bool)
 BROADCAST_THRESHOLD = conf(
     "spark.sql.autoBroadcastJoinThreshold", 10 << 20,
     "Max estimated build-side bytes for broadcast joins; -1 disables "

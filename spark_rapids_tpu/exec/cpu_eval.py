@@ -966,7 +966,11 @@ def _cast(e: Cast, t: pa.Table):
                 raise CastError(
                     f"[CAST_OVERFLOW] {to.simpleString} cast overflow "
                     "(ANSI mode)")
-        return pa.array(an.astype(to.np_dtype), type=at, mask=mask)  # wraps
+        with np.errstate(invalid="ignore"):
+            # non-ANSI integral narrowing WRAPS by design (Java
+            # semantics); numpy's out-of-range warning is expected noise
+            out = an.astype(to.np_dtype)
+        return pa.array(out, type=at, mask=mask)
     if isinstance(to, DecimalType):
         import decimal as _dm
 

@@ -172,12 +172,7 @@ def shrink_traced(batch: ColumnBatch, cap2: int):
         return batch, jnp.zeros((), bool)
     nr = jnp.asarray(batch.num_rows, jnp.int32)
     ovf = nr > cap2
-    cols = [DeviceColumn(
-        c.dtype, c.data[:cap2], c.validity[:cap2],
-        None if c.lengths is None else c.lengths[:cap2],
-        None if c.elem_validity is None else c.elem_validity[:cap2],
-        None if c.map_values is None else c.map_values[:cap2])
-        for c in batch.columns]
+    cols = [c.truncate(cap2) for c in batch.columns]
     return ColumnBatch(batch.schema, cols, jnp.minimum(nr, cap2)), ovf
 
 

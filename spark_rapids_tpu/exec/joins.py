@@ -285,11 +285,60 @@ class _DeviceJoinBase(PhysicalPlan):
             return None
         bt = prepared_bt if prepared_bt is not None \
             else self._build_table(right)
+        left = self._bloom_prefilter(left, right, jt)
         work_l, lk = self._prepare_keys(left, self.left_keys)
         lo, counts = joinops.probe_ranges(bt, work_l, lk)
         if self.condition is None:
             return self._fast_equi_join(left, bt, lo, counts)
         return self._conditional_equi_join(left, bt, lo, counts)
+
+    def _bloom_prefilter(self, left: ColumnBatch, right: ColumnBatch,
+                         jt: str) -> ColumnBatch:
+        """Build-side bloom filter applied to the probe side BEFORE the
+        hash probe (the runtime-filter role of spark-rapids-jni
+        BloomFilter + GpuBloomFilterMightContain): provably-absent keys
+        drop and the probe batch re-buckets to a smaller capacity, so
+        every downstream gather/expand shrinks. Only for joins where a
+        non-matching probe row produces nothing (inner/left_semi)."""
+        from spark_rapids_tpu.config import rapids_conf as rc
+        from spark_rapids_tpu.ops import bloom
+
+        if jt not in ("inner", "left_semi"):
+            return left
+        if self.conf is not None and not self.conf.get(
+                rc.JOIN_BLOOM_FILTER):
+            return left
+        build_rows = right.row_count()
+        # pay the filter only when the probe side is meaningfully larger
+        if build_rows == 0 or left.capacity < 4 * build_rows:
+            return left
+        # build once per build batch: broadcast joins probe the SAME
+        # right batch from every partition (benign race: concurrent
+        # probes compute identical bits)
+        cached = getattr(self, "_bloom_cache", None)
+        if cached is not None and cached[0] is right:
+            bits = cached[1]
+        else:
+            work_r, rk = self._prepare_keys(right, self.right_keys)
+            rkeys = [work_r.columns[i] for i in rk]
+            bits = bloom.build(rkeys, right.live_mask(),
+                               bloom.size_for(build_rows))
+            self._bloom_cache = (right, bits)
+        work_l, lk = self._prepare_keys(left, self.left_keys)
+        lkeys = [work_l.columns[i] for i in lk]
+        keep = bloom.might_contain(bits, lkeys)
+        rows = left.row_count()
+        n = int(jnp.sum(keep & left.live_mask()))
+        if n == rows:
+            return left  # nothing provably absent: skip the compaction
+        self.metrics[M.BLOOM_FILTERED_ROWS].add(rows - n)
+        reduced = filterops.compact(left, keep)
+        cap2 = next_capacity(n)
+        if cap2 >= left.capacity:
+            return reduced
+        return ColumnBatch(reduced.schema,
+                           [c.truncate(cap2) for c in reduced.columns],
+                           n)
 
     def _build_table(self, right: ColumnBatch) -> joinops.BuildTable:
         rsch = self.children[1].schema

@@ -156,12 +156,21 @@ class TpuFileScanExec(PhysicalPlan):
         self._nthreads = conf.get(rc.MULTITHREADED_READ_NUM_THREADS)
         self._strategy = conf.get(rc.PARQUET_READER_TYPE)
         coalesce_bytes = 128 << 20
+        self._part_spec = self.options.get("partition_spec")
         if fmt == "iceberg":
             # per-file tasks: each data file carries its own delete set
             # and field-id projection (lakehouse/iceberg.py)
             self._tasks = [[p] for p in paths] or [[]]
         elif fmt == "parquet":
-            if self._strategy == "PERFILE":
+            if self._part_spec is not None:
+                # hive-partitioned layout: per-file tasks (each file
+                # carries its own partition values), statically pruned
+                # by pushed filters on partition columns
+                # (GpuFileSourceScanExec partition pruning role)
+                files = readers.expand_paths(paths, ".parquet")
+                files = self._prune_partition_files(files)
+                self._tasks = [[f] for f in files] or [[]]
+            elif self._strategy == "PERFILE":
                 self._tasks = [[f] for f in readers.expand_paths(
                     paths, ".parquet")] or [[]]
             else:
@@ -181,8 +190,109 @@ class TpuFileScanExec(PhysicalPlan):
     def num_partitions(self):
         return max(1, len(self._tasks))
 
+    def _prune_partition_files(self, files: List[str]) -> List[str]:
+        """Drop files whose partition values contradict pushed filters
+        (static partition pruning; dynamic pruning calls
+        prune_partitions with runtime key sets)."""
+        part_cols, file_values = self._part_spec
+        kinds = dict(part_cols)
+        ops_fn = {"=": lambda a, b: a == b, "!=": lambda a, b: a != b,
+                  "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
+                  ">": lambda a, b: a > b, ">=": lambda a, b: a >= b}
+        out = []
+        for f in files:
+            vals = file_values.get(f, {})
+            keep = True
+            for name, op, value in (self.pushed_filters or []):
+                if name not in vals or op not in ops_fn:
+                    continue
+                pv = readers.partition_value(vals[name], kinds[name])
+                if pv is None or not ops_fn[op](pv, value):
+                    keep = False
+                    break
+            if keep:
+                out.append(f)
+        return out
+
+    def prune_partitions(self, col: str, allowed) -> int:
+        """DYNAMIC partition pruning (GpuFileSourceScanExec.scala DPP
+        role): keep only files whose `col` partition value is in
+        `allowed` (runtime build-side key set). Returns files dropped.
+        Only valid before execution starts."""
+        if self._part_spec is None:
+            return 0
+        part_cols, file_values = self._part_spec
+        kinds = dict(part_cols)
+        if col not in kinds:
+            return 0
+        before = sum(len(t) for t in self._tasks)
+        kept = []
+        for t in self._tasks:
+            fs = [f for f in t
+                  if readers.partition_value(
+                      file_values.get(f, {}).get(col, ""),
+                      kinds[col]) in allowed]
+            if fs:
+                kept.append(fs)
+        self._tasks = kept or [[]]
+        return before - sum(len(t) for t in self._tasks)
+
+    def _append_partition_columns(self, table: pa.Table,
+                                  path: str) -> pa.Table:
+        from spark_rapids_tpu.sqltypes.datatypes import to_arrow_type
+
+        part_cols, file_values = self._part_spec
+        kinds = dict(part_cols)
+        declared = {f.name: to_arrow_type(f.dataType)
+                    for f in self.schema.fields}
+        vals = file_values.get(path, {})
+        want = self.pushed_columns or [f.name for f in self.schema.fields]
+        arrays, names = [], []
+        for name in want:
+            if name in kinds:
+                # the scan schema (user-declared or inferred) wins over
+                # the directory inference for the column's type
+                typ = declared.get(
+                    name, pa.int64() if kinds[name] else pa.string())
+                raw = vals.get(name, "")
+                if raw == "__HIVE_DEFAULT_PARTITION__":
+                    pv = None
+                elif pa.types.is_string(typ):
+                    pv = raw
+                elif pa.types.is_floating(typ):
+                    pv = float(raw)
+                else:
+                    pv = int(raw)
+                arrays.append(pa.array([pv] * table.num_rows, type=typ))
+            else:
+                arrays.append(table.column(name))
+            names.append(name)
+        return pa.table(dict(zip(names, arrays)))
+
     def _host_tables(self, files) -> Iterator[pa.Table]:
         cols = self.pushed_columns
+        if self.fmt == "parquet" and self._part_spec is not None:
+            part_names = {n for n, _ in self._part_spec[0]}
+            data_cols = None if cols is None else [
+                c for c in cols if c not in part_names]
+
+            def gen():
+                for f in files:
+                    # row-group stats pruning applies to data columns
+                    # exactly as on the unpartitioned path (partition-
+                    # column predicates are skipped: the data file has
+                    # no such column, _row_group_may_match keeps it)
+                    if self.pushed_filters:
+                        it = readers.read_parquet_task_filtered(
+                            [f], data_cols, self._batch_rows,
+                            self.pushed_filters)
+                    else:
+                        it = readers.read_parquet_task(
+                            [f], data_cols, self._batch_rows)
+                    for t in it:
+                        yield self._append_partition_columns(t, f)
+
+            return gen()
         if self.fmt == "iceberg":
             from spark_rapids_tpu.lakehouse.iceberg import read_data_file
 
@@ -1915,6 +2025,7 @@ class TpuWindowExec(PhysicalPlan):
                                fromlist=["detached"]).detached(self)._run)
 
     def _run(self, batch: ColumnBatch) -> ColumnBatch:
+        from spark_rapids_tpu.expr import aggregates as AGG
         from spark_rapids_tpu.expr import windows as we
         from spark_rapids_tpu.expr.aggregates import (
             Average, Count, First, Max, Min, Sum,
@@ -2028,6 +2139,36 @@ class TpuWindowExec(PhysicalPlan):
                         new_cols.append(DeviceColumn(
                             dt, d_o, v_o, jnp.take(lens, sw.inv)))
                         continue
+                elif isinstance(fn, (AGG.VariancePop, AGG.VarianceSamp)):
+                    # moments over frames from prefix sums: the device
+                    # RollingAggregation analog (GpuWindowExpression
+                    # moment family); StddevPop/Samp subclass these
+                    f64 = inp_s.data.astype(jnp.float64)
+                    cnt = W.frame_count(inp_s.validity, sw, start, end)
+                    n = cnt.astype(jnp.float64)
+                    s1 = W.frame_sum(f64, inp_s.validity, sw, start,
+                                     end, jnp.float64)
+                    s2 = W.frame_sum(f64 * f64, inp_s.validity, sw,
+                                     start, end, jnp.float64)
+                    m2 = jnp.maximum(s2 - s1 * (s1 / jnp.maximum(n, 1.0)),
+                                     0.0)
+                    if isinstance(fn, AGG.VarianceSamp):
+                        d = m2 / jnp.maximum(n - 1.0, 1.0)
+                        v = cnt >= 2
+                    else:
+                        d = m2 / jnp.maximum(n, 1.0)
+                        v = cnt >= 1
+                    if isinstance(fn, (AGG.StddevPop, AGG.StddevSamp)):
+                        d = jnp.sqrt(d)
+                elif isinstance(fn, AGG.CollectList):  # CollectSet too
+                    d, v, lens, ev = W.frame_collect(
+                        inp_s.data, inp_s.validity, sw, start, end,
+                        frame, distinct=isinstance(fn, AGG.CollectSet))
+                    d_o, v_o = to_original(d, v)
+                    new_cols.append(DeviceColumn(
+                        dt, d_o, v_o, jnp.take(lens, sw.inv),
+                        jnp.take(ev, sw.inv, axis=0)))
+                    continue
                 else:
                     raise NotImplementedError(
                         f"window function {type(fn).__name__}")

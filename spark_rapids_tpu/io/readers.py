@@ -284,3 +284,66 @@ def read_parquet_task_filtered(files: List[str],
         for rb in pf.iter_batches(batch_size=batch_rows, row_groups=keep,
                                   columns=columns):
             yield pa.Table.from_batches([rb])
+
+
+# ------------------------- hive-style partition directories (col=val/)
+
+def discover_partitions(files: List[str],
+                        base_paths: Optional[List[str]] = None):
+    """Detect hive-layout partition columns from `name=value` directory
+    segments (the PartitioningAwareFileIndex role). Returns
+    (part_cols, file_values) where part_cols = [(name, is_int)] in
+    path order and file_values maps file -> {name: str_value}, or
+    ([], {}) when the layout is absent/inconsistent.
+
+    Only segments BELOW one of `base_paths` (the user's input paths)
+    count — a `run=3` directory in a parent of the input path is part
+    of the location, not a partition column (Spark derives partitions
+    relative to the scanned root only)."""
+    import urllib.parse
+
+    bases = [os.path.abspath(b).rstrip(os.sep)
+             for b in (base_paths or [])]
+
+    def below_base(f: str) -> str:
+        af = os.path.abspath(f)
+        for b in bases:
+            if af.startswith(b + os.sep):
+                return af[len(b) + 1:]
+        return af if not bases else ""
+
+    file_values = {}
+    col_order: List[str] = []
+    for f in files:
+        vals = {}
+        for seg in below_base(f).split(os.sep)[:-1]:
+            if "=" in seg and not seg.startswith("="):
+                k, _, v = seg.partition("=")
+                vals[k] = urllib.parse.unquote(v)
+                if k not in col_order:
+                    col_order.append(k)
+        file_values[f] = vals
+    if not col_order:
+        return [], {}
+    for f, vals in file_values.items():
+        if set(vals) != set(col_order):
+            return [], {}  # inconsistent layout: not partitioned
+    part_cols = []
+    for name in col_order:
+        is_int = all(_is_int(file_values[f][name]) for f in files)
+        part_cols.append((name, is_int))
+    return part_cols, file_values
+
+
+def _is_int(s: str) -> bool:
+    try:
+        int(s)
+        return True
+    except ValueError:
+        return False
+
+
+def partition_value(raw: str, is_int: bool):
+    if raw == "__HIVE_DEFAULT_PARTITION__":
+        return None
+    return int(raw) if is_int else raw

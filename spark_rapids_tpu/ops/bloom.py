@@ -1,0 +1,72 @@
+"""Device bloom filter — the spark-rapids-jni `BloomFilter` role
+(reference: build-side runtime filters for joins, wired through
+`GpuBloomFilterMightContain`; SURVEY.md section 2.12).
+
+The filter is a flat boolean bit array in HBM (simplest XLA-native
+form: scatter-set on build, gather-and on probe). k probe positions
+come from double hashing over the engine's Spark-exact murmur3
+(h_i = h1 + i*h2), so build and probe agree across operators by
+construction. Null keys never set or pass the filter — appropriate for
+the inner/semi joins runtime filters apply to, where null keys cannot
+match."""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import DeviceColumn
+from spark_rapids_tpu.ops.hashing import murmur3_columns, pmod
+
+# int32-signed views of the classic murmur constants (the hash chain
+# seeds are jnp.int32)
+_SEED_A = 0x9747b28c - (1 << 32)
+_SEED_B = 0x85ebca6b - (1 << 32)
+DEFAULT_K = 4
+
+
+def _positions(key_cols: List[DeviceColumn], m_bits: int, k: int):
+    h1 = murmur3_columns(key_cols, seed=_SEED_A).astype(jnp.int64)
+    h2 = murmur3_columns(key_cols, seed=_SEED_B).astype(jnp.int64)
+    # odd step avoids degenerate cycles on power-of-two m
+    h2 = h2 | 1
+    return [pmod((h1 + i * h2).astype(jnp.int32), m_bits)
+            for i in range(k)]
+
+
+def all_keys_valid(key_cols: List[DeviceColumn]) -> jnp.ndarray:
+    ok = key_cols[0].validity
+    for c in key_cols[1:]:
+        ok = ok & c.validity
+    return ok
+
+
+def build(key_cols: List[DeviceColumn], live: jnp.ndarray,
+          m_bits: int, k: int = DEFAULT_K) -> jnp.ndarray:
+    """-> bool[m_bits] with k bits set per live, fully-non-null key."""
+    ok = live & all_keys_valid(key_cols)
+    bits = jnp.zeros((m_bits,), bool)
+    for idx in _positions(key_cols, m_bits, k):
+        bits = bits.at[jnp.where(ok, idx, m_bits)].set(True, mode="drop")
+    return bits
+
+
+def might_contain(bits: jnp.ndarray, key_cols: List[DeviceColumn],
+                  k: int = DEFAULT_K) -> jnp.ndarray:
+    """bool[cap]: False only when the key is PROVABLY absent (or any
+    key column is null)."""
+    m_bits = int(bits.shape[0])
+    ok = all_keys_valid(key_cols)
+    for idx in _positions(key_cols, m_bits, k):
+        ok = ok & jnp.take(bits, idx)
+    return ok
+
+
+def size_for(build_rows: int, bits_per_key: int = 10,
+             lo: int = 1 << 13, hi: int = 1 << 23) -> int:
+    """Power-of-two bit count targeting ~1% false positives."""
+    m = 1
+    while m < build_rows * bits_per_key:
+        m <<= 1
+    return max(lo, min(m, hi))

@@ -352,6 +352,46 @@ def frame_first_last(vals: jnp.ndarray, valid: jnp.ndarray,
     return v, ok
 
 
+def frame_collect(vals: jnp.ndarray, valid: jnp.ndarray,
+                  sw: SortedWindow, start, end, frame,
+                  distinct: bool):
+    """collect_list/collect_set over BOUNDED ROWS frames — the device
+    RollingAggregation COLLECT_LIST/COLLECT_SET role. The output width
+    is the frame's static span (lower+upper+1), so the padded array
+    column has a compile-time shape; unbounded frames take the CPU
+    path via planner tagging.
+
+    Returns (data [cap, W], row_validity, lengths, elem_validity) with
+    elements left-packed in frame order (nulls skipped, like Spark);
+    collect_set additionally drops duplicates keeping first occurrence.
+    """
+    assert frame is not None and frame.frame_type == "rows"
+    width = int(frame.upper) - int(frame.lower) + 1
+    cap = vals.shape[0]
+    offs = jnp.arange(width, dtype=jnp.int32)[None, :]
+    idx = start[:, None] + offs                      # [cap, W]
+    inside = idx <= end[:, None]
+    safe = jnp.clip(idx, 0, cap - 1)
+    elem = jnp.take(vals, safe, axis=0)              # [cap, W]
+    ok = inside & jnp.take(valid, safe) & jnp.take(sw.live, safe)
+    if distinct:
+        # keep the first occurrence of each value within the row
+        dup = jnp.zeros_like(ok)
+        for j in range(1, width):
+            prev_eq = (elem[:, :j] == elem[:, j:j + 1]) & ok[:, :j]
+            dup = dup.at[:, j].set(jnp.any(prev_eq, axis=1))
+        ok = ok & ~dup
+    # left-pack kept elements preserving frame order: stable argsort on
+    # the drop flag
+    order = jnp.argsort(jnp.where(ok, 0, 1).astype(jnp.int8), axis=1,
+                        stable=True)
+    packed = jnp.take_along_axis(elem, order, axis=1)
+    kept = jnp.take_along_axis(ok, order, axis=1)
+    lengths = jnp.sum(ok, axis=1).astype(jnp.int32)
+    row_valid = jnp.ones((cap,), bool)  # empty array, never null
+    return packed, row_valid, lengths, kept
+
+
 # --------------------------------------------------------- ranking family
 
 def row_number(sw: SortedWindow) -> jnp.ndarray:

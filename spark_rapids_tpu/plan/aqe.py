@@ -1,0 +1,347 @@
+"""Adaptive query execution — the AQE re-planning role
+(reference: GpuOverrides applied per AQE query stage,
+GpuOverrides.scala:517-580 + 4652-4670, over Spark's
+AdaptiveSparkPlanExec machinery).
+
+The engine's exchanges are stage barriers that materialize their map
+output into the in-process shuffle manager, so the classic AQE loop
+maps directly:
+
+1. find READY exchanges (no unmaterialized exchange beneath them),
+2. materialize their map stages — build (right) sides of joins first,
+3. re-plan the remainder with the OBSERVED output statistics:
+   - broadcast promotion: a shuffled hash join whose build side
+     materialized under spark.sql.autoBroadcastJoinThreshold becomes a
+     broadcast hash join, and the probe side's own exchange — if it
+     has not run yet — is CANCELLED (its child feeds the join
+     directly): the probe-side shuffle never happens,
+   - partition coalescing: a materialized exchange whose reduce
+     partitions are tiny collapses adjacent partitions into fewer
+     reduce tasks (spark.sql.adaptive.coalescePartitions analog);
+     contiguous grouping preserves both hash-bucket disjointness and
+     range order,
+4. repeat until no exchanges remain, then run the final stage.
+
+Decisions are recorded on the executor (`decisions`) and surfaced in
+explain diagnostics, mirroring the reference's AQE plan annotations.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.config import rapids_conf as rc
+from spark_rapids_tpu.exec import joins as J
+from spark_rapids_tpu.exec import operators as ops
+from spark_rapids_tpu.exec.base import PhysicalPlan, new_task_context
+
+
+def _exchange_stats(ex: ops.TpuShuffleExchangeExec) -> List[int]:
+    """Per-reduce-partition bytes of a MATERIALIZED exchange."""
+    n = ex.num_partitions
+    if ex._device_mode:
+        out = [0] * n
+        with ex._blocks_lock:
+            blocks = list(ex._dev_blocks)
+        for sb, offs in blocks:
+            rows = max(int(offs[-1]), 1)
+            bpr = sb.size_bytes / rows if hasattr(sb, "size_bytes") \
+                else 8 * rows
+            for rp in range(n):
+                out[rp] += int((int(offs[rp + 1]) - int(offs[rp])) * bpr)
+        return out
+    from spark_rapids_tpu.shuffle.manager import get_shuffle_manager
+
+    return get_shuffle_manager().partition_sizes(ex._shuffle_id, n)
+
+
+class CoalescedShuffleReadExec(PhysicalPlan):
+    """AQE coalesced read over a materialized exchange: reduce task i
+    drains the exchange's partitions in groups[i] (the
+    AQEShuffleReadExec / CoalescedPartitionSpec role)."""
+
+    def __init__(self, ex: ops.TpuShuffleExchangeExec,
+                 groups: List[List[int]], conf):
+        super().__init__([ex], ex.schema, conf)
+        self.groups = groups
+
+    @property
+    def num_partitions(self):
+        return max(1, len(self.groups))
+
+    def execute_partition(self, pid, ctx):
+        if pid >= len(self.groups):
+            return
+        for sub in self.groups[pid]:
+            yield from self.children[0].execute_partition(sub, ctx)
+
+    def _node_string(self):
+        return (f"CoalescedShuffleReadExec {len(self.groups)} <- "
+                f"{self.children[0].num_partitions}")
+
+
+class AdaptiveQueryExecutor:
+    """Stage-by-stage execution with stats-driven re-planning."""
+
+    def __init__(self, conf):
+        self.conf = conf
+        self.decisions: List[str] = []
+        self._stats: Dict[int, List[int]] = {}  # id(ex) -> bytes/part
+        self._join_fed: set = set()
+        self._target = (conf.get(rc.BATCH_SIZE_BYTES)
+                        if conf is not None else 1 << 30)
+        thr = (conf.get(rc.BROADCAST_THRESHOLD)
+               if conf is not None else 10 << 20)
+        self._bcast_threshold = thr if thr is not None else -1
+
+    # --- plan walking ---
+
+    def _walk(self, node: PhysicalPlan, fn) -> None:
+        fn(node)
+        for c in node.children:
+            self._walk(c, fn)
+
+    def _exchanges(self, plan) -> List[ops.TpuShuffleExchangeExec]:
+        found: List[ops.TpuShuffleExchangeExec] = []
+
+        def fn(n):
+            if isinstance(n, ops.TpuShuffleExchangeExec):
+                found.append(n)
+
+        self._walk(plan, fn)
+        return found
+
+    def _ready(self, plan) -> List[ops.TpuShuffleExchangeExec]:
+        """Unmaterialized exchanges with no unmaterialized exchange in
+        their subtrees; build (join right) sides first so a small build
+        can cancel the probe-side shuffle before it runs."""
+        exchanges = self._exchanges(plan)
+        unmat = [e for e in exchanges if not e._map_done]
+
+        def has_unmat_below(e):
+            return any(x is not e and not x._map_done
+                       for x in self._exchanges(e))
+
+        ready = [e for e in unmat if not has_unmat_below(e)]
+        build_sides = set()
+
+        def mark(n):
+            if isinstance(n, (J.TpuShuffledHashJoinExec,
+                              J.TpuBroadcastHashJoinExec)):
+                # every exchange in the BUILD subtree runs before probe
+                # exchanges, so build stats can cancel/prune the probe
+                for e in self._exchanges(n.children[1]):
+                    build_sides.add(id(e))
+
+        self._walk(plan, mark)
+        return sorted(ready,
+                      key=lambda e: 0 if id(e) in build_sides else 1)
+
+    # --- rewrites ---
+
+    def _mark_join_fed(self, plan: PhysicalPlan) -> None:
+        """Exchanges feeding a shuffled hash join must not coalesce
+        independently: both sides share one partitioning and
+        execute_partition pairs them by pid. They may only coalesce
+        TOGETHER with one shared grouping (Spark coordinates coalescing
+        across a join's sides the same way)."""
+        self._join_fed = set()
+
+        def mark(n):
+            if isinstance(n, J.TpuShuffledHashJoinExec):
+                for c in n.children:
+                    cur = c
+                    while (cur is not None
+                           and not isinstance(
+                               cur, ops.TpuShuffleExchangeExec)):
+                        cur = (cur.children[0]
+                               if len(cur.children) == 1 else None)
+                    if cur is not None:
+                        self._join_fed.add(id(cur))
+
+        self._walk(plan, mark)
+
+    def _grouping(self, sizes: List[int]) -> Optional[List[List[int]]]:
+        """Contiguous partition groups targeting batchSizeBytes, or
+        None when coalescing would not reduce the partition count."""
+        total = sum(sizes)
+        if not total or total / len(sizes) >= self._target // 8:
+            return None
+        groups: List[List[int]] = []
+        cur: List[int] = []
+        acc = 0
+        for rp, s in enumerate(sizes):
+            cur.append(rp)
+            acc += s
+            if acc >= self._target:
+                groups.append(cur)
+                cur, acc = [], 0
+        if cur:
+            groups.append(cur)
+        return groups if len(groups) < len(sizes) else None
+
+    def _rewrite(self, node: PhysicalPlan) -> PhysicalPlan:
+        if isinstance(node, CoalescedShuffleReadExec):
+            return node  # already adapted; never double-wrap
+        node.children = [self._rewrite(c) for c in node.children]
+        if isinstance(node, J.TpuShuffledHashJoinExec):
+            right = node.children[1]
+            # the build exchange may already be coalesce-wrapped
+            right_ex = (right.children[0]
+                        if isinstance(right, CoalescedShuffleReadExec)
+                        else right)
+            if (isinstance(right_ex, ops.TpuShuffleExchangeExec)
+                    and right_ex._map_done):
+                self._try_dpp(node, right_ex)
+            if (self._bcast_threshold >= 0
+                    and isinstance(right_ex, ops.TpuShuffleExchangeExec)
+                    and right_ex._map_done
+                    and node.join_type != "full"):
+                total = sum(self._stats.get(id(right_ex), [1 << 62]))
+                if total <= self._bcast_threshold:
+                    left = node.children[0]
+                    cancelled = ""
+                    if (isinstance(left, ops.TpuShuffleExchangeExec)
+                            and not left._map_done):
+                        left = left.children[0]
+                        cancelled = " (probe-side exchange cancelled)"
+                    self.decisions.append(
+                        f"broadcast promotion: build side "
+                        f"{total >> 10} KiB <= threshold{cancelled}")
+                    return J.TpuBroadcastHashJoinExec(
+                        left, right, node.join_type, node.left_keys,
+                        node.right_keys, node.schema, node.conf,
+                        node.condition)
+            self._coalesce_join_sides(node)
+        if (isinstance(node, ops.TpuShuffleExchangeExec)
+                and not isinstance(node, ops.TpuRangeShuffleExchangeExec)
+                and node._map_done and node.num_partitions > 1
+                and id(node) not in self._join_fed
+                and id(node) in self._stats):
+            groups = self._grouping(self._stats[id(node)])
+            if groups is not None:
+                self.decisions.append(
+                    f"coalesced {node.num_partitions} shuffle "
+                    f"partitions -> {len(groups)}")
+                return CoalescedShuffleReadExec(node, groups, self.conf)
+        return node
+
+    def _coalesce_join_sides(self, node: "J.TpuShuffledHashJoinExec"
+                             ) -> None:
+        """Coalesce BOTH sides of a shuffled join with one shared
+        grouping (sizes summed pairwise), preserving pid-paired
+        co-partitioning. Only fires when both sides are directly
+        materialized exchanges of equal width."""
+        lc, rc2 = node.children
+        if not (isinstance(lc, ops.TpuShuffleExchangeExec)
+                and isinstance(rc2, ops.TpuShuffleExchangeExec)
+                and not isinstance(lc, ops.TpuRangeShuffleExchangeExec)
+                and not isinstance(rc2, ops.TpuRangeShuffleExchangeExec)
+                and lc._map_done and rc2._map_done
+                and lc.num_partitions == rc2.num_partitions
+                and lc.num_partitions > 1
+                and id(lc) in self._stats and id(rc2) in self._stats):
+            return
+        sizes = [a + b for a, b in zip(self._stats[id(lc)],
+                                       self._stats[id(rc2)])]
+        groups = self._grouping(sizes)
+        if groups is None:
+            return
+        self.decisions.append(
+            f"coalesced both join sides {lc.num_partitions} shuffle "
+            f"partitions -> {len(groups)} (shared grouping)")
+        node.children = [
+            CoalescedShuffleReadExec(lc, groups, self.conf),
+            CoalescedShuffleReadExec(rc2, groups, self.conf)]
+
+    # --- dynamic partition pruning ---
+
+    _DPP_MAX_BUILD = 64 << 20
+
+    def _try_dpp(self, node: "J.TpuShuffledHashJoinExec",
+                 right_ex: ops.TpuShuffleExchangeExec) -> None:
+        """Prune the probe side's partitioned scan with the
+        MATERIALIZED build side's distinct join-key values
+        (GpuFileSourceScanExec dynamic partition pruning,
+        GpuFileSourceScanExec.scala:360-420). Applies only when the
+        probe path from join to scan is filters/exchanges (schema
+        order preserved, so key ordinals resolve to scan columns
+        exactly), the scan is hive-partitioned on the key, and the
+        build output is small enough to inspect."""
+        from spark_rapids_tpu.expr import BoundReference
+
+        if right_ex._device_mode:
+            return  # device-resident blocks: reads are consuming
+        total = sum(self._stats.get(id(right_ex), [1 << 62]))
+        if total > self._DPP_MAX_BUILD:
+            return
+        child = node.children[0]
+        cur = child
+        while isinstance(cur, (ops.TpuShuffleExchangeExec,
+                               ops.TpuFilterExec)):
+            cur = cur.children[0]
+        if not (isinstance(cur, ops.TpuFileScanExec)
+                and getattr(cur, "_part_spec", None)):
+            return
+        scan = cur
+        if id(scan) in getattr(self, "_dpp_done", set()):
+            return
+        part_names = {n for n, _ in scan._part_spec[0]}
+        for i, lk in enumerate(node.left_keys):
+            if not isinstance(lk, BoundReference):
+                continue
+            if lk.ordinal >= len(child.schema.names):
+                continue
+            name = child.schema.names[lk.ordinal]
+            if name not in part_names:
+                continue
+            vals = self._collect_build_keys(right_ex,
+                                            node.right_keys[i])
+            if vals is None:
+                continue
+            dropped = scan.prune_partitions(name, vals)
+            self._dpp_done = getattr(self, "_dpp_done", set())
+            self._dpp_done.add(id(scan))
+            if dropped:
+                self.decisions.append(
+                    f"dynamic partition pruning on {name}: "
+                    f"{dropped} files skipped")
+
+    def _collect_build_keys(self, ex: ops.TpuShuffleExchangeExec,
+                            key_expr):
+        from spark_rapids_tpu.exec import cpu_eval
+        from spark_rapids_tpu.shuffle.manager import get_shuffle_manager
+
+        mgr = get_shuffle_manager()
+        out = set()
+        for rp in range(ex.num_partitions):
+            for t in mgr.fetch(ex._shuffle_id, rp):
+                try:
+                    arr = cpu_eval.eval_expr(key_expr, t)
+                except Exception:
+                    return None
+                out.update(arr.to_pylist())
+        out.discard(None)
+        return out
+
+    # --- driver ---
+
+    def execute(self, phys: PhysicalPlan) -> pa.Table:
+        plan = phys
+        ctx = new_task_context(self.conf)
+        while True:
+            ready = self._ready(plan)
+            if not ready:
+                break
+            # ONE stage at a time, build sides first: a probe-side
+            # exchange must not run while any build chain is pending,
+            # or its stats can no longer cancel/prune the probe
+            ex = ready[0]
+            ex._run_map_stage(ctx)
+            self._stats[id(ex)] = _exchange_stats(ex)
+            self._mark_join_fed(plan)
+            plan = self._rewrite(plan)
+        return plan.collect()

@@ -177,11 +177,19 @@ class TpuOverrides:
     def _tag_window(self, node: "L.Window", meta: PlanMeta):
         from spark_rapids_tpu.expr import windows as we
         from spark_rapids_tpu.expr.aggregates import (
-            Average, Count, First, Last, Max, Min, Sum,
+            Average, CollectList, Count, First, Last, Max, Min,
+            StddevPop, StddevSamp, Sum, VariancePop, VarianceSamp,
         )
-        from spark_rapids_tpu.sqltypes import NumericType, StringType
+        from spark_rapids_tpu.sqltypes import (
+            ArrayType,
+            MapType,
+            NumericType,
+            StringType,
+        )
 
-        supported_aggs = (Sum, Count, Min, Max, Average, First, Last)
+        supported_aggs = (Sum, Count, Min, Max, Average, First, Last,
+                          VariancePop, VarianceSamp, StddevPop,
+                          StddevSamp, CollectList)
         for a in node.window_exprs:
             wexpr = a.children[0]
             for e in wexpr.spec.partitions:
@@ -215,6 +223,30 @@ class TpuOverrides:
                         isinstance(fn.input.dtype, StringType)):
                     meta.cannot_run(
                         "string min/max over window frames runs on CPU")
+                if isinstance(fn, CollectList):  # CollectSet subclasses
+                    frame = wexpr.spec.frame
+                    bounded = (frame is not None
+                               and frame.frame_type == "rows"
+                               and frame.lower is not None
+                               and frame.upper is not None)
+                    if not bounded:
+                        meta.cannot_run(
+                            "window collect over unbounded frames runs "
+                            "on CPU (device output width is the static "
+                            "frame span)")
+                    elif int(frame.upper) - int(frame.lower) + 1 > 1024:
+                        # the device kernel materializes a [rows, span]
+                        # element matrix — wide frames belong on CPU
+                        meta.cannot_run(
+                            "window collect frame span > 1024 runs on "
+                            "CPU")
+                    elif isinstance(fn.input.dtype,
+                                    (StringType, ArrayType, MapType)):
+                        # frame_collect gathers a [cap, W] element
+                        # matrix — only flat scalar elements fit
+                        meta.cannot_run(
+                            "window collect of string/array/map "
+                            "elements runs on CPU")
             else:
                 meta.cannot_run(f"window function {type(fn).__name__} "
                                 "has no device implementation")

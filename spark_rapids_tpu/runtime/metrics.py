@@ -25,6 +25,7 @@ SEMAPHORE_WAIT_TIME = "semaphoreWaitTime"
 SPILL_TIME = "spillTime"
 BUILD_TIME = "buildTime"
 JOIN_TIME = "joinTime"
+BLOOM_FILTERED_ROWS = "bloomFilteredRows"
 SORT_TIME = "sortTime"
 AGG_TIME = "aggTime"
 FILTER_TIME = "filterTime"

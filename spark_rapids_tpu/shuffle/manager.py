@@ -134,6 +134,27 @@ class ShuffleManager:
         with self._lock:
             self._files[(shuffle_id, reduce_pid)].append(fut)
 
+    def partition_sizes(self, shuffle_id: int, nparts: int) -> List[int]:
+        """Per-reduce-partition byte sizes of a materialized shuffle —
+        the MapOutputStatistics role AQE re-planning consumes."""
+        out = [0] * nparts
+        with self._lock:
+            for (sid, rp), blks in self._blocks.items():
+                if sid == shuffle_id and rp < nparts:
+                    out[rp] += sum(b.nbytes for b in blks)
+            futs = [((sid, rp), list(fs))
+                    for (sid, rp), fs in self._files.items()
+                    if sid == shuffle_id and rp < nparts]
+        import os as _os
+
+        for (sid, rp), fs in futs:
+            for f in fs:
+                try:
+                    out[rp] += _os.path.getsize(f.result())
+                except OSError:
+                    pass
+        return out
+
     def fetch(self, shuffle_id: int, reduce_pid: int) -> List[pa.Table]:
         from spark_rapids_tpu.shuffle import serde
 

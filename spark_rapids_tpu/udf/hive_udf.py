@@ -1,0 +1,116 @@
+"""Hive UDF surface + session UDF registry — the hiveUDFs.scala /
+UDFRegistration analog.
+
+The reference runs Hive `GenericUDF`s as black-box row functions on the
+CPU plan UNLESS the UDF also implements `RapidsUDF.evaluateColumnar`,
+in which case it runs on device inside the columnar pipeline
+(org/apache/spark/sql/hive/rapids/hiveUDFs.scala;
+sql-plugin-api/.../RapidsUDF.java:22-68). The same dual contract here:
+
+    class MyUdf(HiveGenericUDF):
+        def initialize(self, arg_types):    # -> result DataType
+            return double
+        def evaluate(self, x, y):           # per-row python values
+            return x * y
+        # OPTIONAL device path (RapidsUDF role): jnp arrays in/out,
+        # traced into the enclosing XLA program; arguments arrive as
+        # all value arrays then all validity arrays (DeviceUDF order)
+        def evaluate_columnar(self, x, y, xv, yv):
+            return x * y, xv & yv
+
+    spark.udf.registerHive("my_udf", MyUdf())
+    df.select(F.call_udf("my_udf", df.a, df.b))
+
+`spark.udf.register(name, fn, returnType)` covers plain Python
+functions (attempted through the bytecode compiler first, like
+F.udf)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from spark_rapids_tpu.sqltypes import DataType
+from spark_rapids_tpu.sqltypes.datatypes import double
+
+
+class HiveSimpleUDF:
+    """evaluate(*row_values) -> value; fixed returnType attribute."""
+
+    returnType: DataType = double
+
+    def evaluate(self, *args):
+        raise NotImplementedError
+
+
+class HiveGenericUDF(HiveSimpleUDF):
+    """Adds Hive's initialize(arg_types) -> result type negotiation."""
+
+    def initialize(self, arg_types) -> DataType:
+        return self.returnType
+
+
+class UDFRegistration:
+    """session.udf — named registration so SQL-ish call sites
+    (F.call_udf) resolve by name."""
+
+    def __init__(self, session):
+        self._session = session
+        self._named: Dict[str, object] = {}
+
+    def register(self, name: str, fn=None, returnType=None):
+        """Plain Python function: compiled to device expressions when
+        the bytecode compiler can, rowwise host fallback otherwise
+        (same pipeline as F.udf)."""
+        from spark_rapids_tpu.api import functions as F
+
+        wrapped = F.udf(fn, returnType=returnType)
+        self._named[name] = wrapped
+        return wrapped
+
+    def registerHive(self, name: str, instance: HiveSimpleUDF):
+        self._named[name] = instance
+        return instance
+
+    def registerDevice(self, name: str, fn, returnType: DataType):
+        """Direct RapidsUDF-style columnar device function:
+        fn(values..., validities...) -> (values, validity)."""
+        self._named[name] = ("device", fn, returnType)
+        return fn
+
+    def lookup(self, name: str):
+        if name not in self._named:
+            raise KeyError(f"UDF {name!r} is not registered")
+        return self._named[name]
+
+
+def call_registered(session, name: str, cols):
+    """Build the Column for a registered UDF (F.call_udf body)."""
+    from spark_rapids_tpu.api.column import Column
+    from spark_rapids_tpu.api.functions import expr_of
+    from spark_rapids_tpu.expr.deviceudf import DeviceUDF
+    from spark_rapids_tpu.udf.pyudf import PythonUDF
+
+    entry = session.udf.lookup(name)
+    exprs = [expr_of(c) for c in cols]
+    if isinstance(entry, tuple) and entry[0] == "device":
+        _, fn, rtype = entry
+        return Column(DeviceUDF(fn, rtype, exprs), name)
+    if isinstance(entry, HiveSimpleUDF):
+        def _dt(e):
+            try:
+                return e.dtype
+            except AttributeError:
+                return None  # unresolved column: type known at binding
+
+        rtype = (entry.initialize([_dt(e) for e in exprs])
+                 if isinstance(entry, HiveGenericUDF)
+                 else entry.returnType)
+        columnar = getattr(entry, "evaluate_columnar", None)
+        if columnar is not None:
+            # the RapidsUDF dual interface: device columnar evaluation
+            # fused into the enclosing program
+            return Column(DeviceUDF(columnar, rtype, exprs), name)
+        return Column(PythonUDF(entry.evaluate, exprs, rtype,
+                                name=name), name)
+    # F.udf-wrapped callable
+    return entry(*cols)

@@ -104,8 +104,69 @@ def test_count_sum_distinct(stats_path):
 def test_percentile(stats_path):
     _agg_diff(stats_path,
               F.percentile("x", 0.5).alias("p50"),
-              F.percentile("x", 0.25).alias("p25"),
-              F.percentile_approx("x", 0.9).alias("p90"))
+              F.percentile("x", 0.25).alias("p25"))
+
+
+def test_approx_percentile_sketch(stats_path):
+    """approx_percentile is a bounded K-point quantile sketch (round-4
+    verdict item #9): per-group answers stay within the sketch's rank
+    tolerance of exact, with O(K) buffers regardless of group size."""
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.testing.asserts import with_tpu_session
+
+    def q(spark):
+        return (spark.read.parquet(stats_path).groupBy("k")
+                .agg(F.percentile_approx("x", 0.9).alias("p90"))
+                .collect_arrow())
+
+    got = {r["k"]: r["p90"] for r in with_tpu_session(q).to_pylist()}
+    t = pq.read_table(stats_path).to_pandas()
+    for k, sub in t.groupby("k"):
+        vals = np.sort(sub["x"].dropna().to_numpy())
+        if not len(vals):
+            continue
+        # rank tolerance: |rank(got) - 0.9*n| <= n/64 + interpolation
+        n = len(vals)
+        r = np.searchsorted(vals, got[k])
+        assert abs(r - 0.9 * n) <= max(2.0, n / 32), (k, got[k], r, n)
+
+
+def test_approx_percentile_bounded_buffers_and_mesh():
+    """The sketch buffer is K+1 columns independent of group size, and
+    (being jittable) lowers into the mesh SPMD program."""
+    from spark_rapids_tpu.expr.aggregates import ApproxPercentile
+    from spark_rapids_tpu.expr.core import BoundReference
+    from spark_rapids_tpu.sqltypes.datatypes import double
+
+    fn = ApproxPercentile(BoundReference(0, double, True), 0.5)
+    assert fn.jittable
+    assert len(fn.buffer_types()) == fn.K + 1  # O(K), not O(rows)
+
+    from spark_rapids_tpu.testing.asserts import with_tpu_session
+
+    rng = np.random.default_rng(3)
+    ks = np.arange(4000) % 3
+    # each group draws from a DISJOINT value range (group g in
+    # [1000g, 1000g+100)) so cross-group contamination in the
+    # partial->merge path is caught, not averaged away
+    vals = rng.random(4000) * 100 + ks * 1000.0
+
+    def q(spark):
+        t = pa.table({"k": pa.array(ks, type=pa.int64()),
+                      "x": pa.array(vals)})
+        return (spark.createDataFrame(t).groupBy("k")
+                .agg(F.percentile_approx("x", 0.5).alias("p"))
+                .collect_arrow())
+
+    got = with_tpu_session(q, {"spark.rapids.tpu.mesh": 8,
+                               "spark.sql.shuffle.partitions": 8})
+    assert len(got) == 3
+    for r in got.to_pylist():
+        sub = np.sort(vals[ks == r["k"]])
+        assert sub[0] <= r["p"] <= sub[-1], (r, sub[0], sub[-1])
+        rk = np.searchsorted(sub, r["p"])
+        assert abs(rk - 0.5 * len(sub)) <= max(2.0, len(sub) / 32)
 
 
 def test_any_value(stats_path):

@@ -1,0 +1,178 @@
+"""Adaptive query execution (plan/aqe.py): stats-driven re-planning at
+exchange boundaries — broadcast-join promotion with probe-side shuffle
+cancellation, and tiny-partition coalescing (reference: GpuOverrides
+applied per AQE query stage, GpuOverrides.scala:517-580)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSparkSession
+from spark_rapids_tpu.plan.aqe import AdaptiveQueryExecutor
+
+_CONF = {
+    "spark.sql.shuffle.partitions": 8,
+    # static planner must not choose broadcast up front: file-scan
+    # estimates are unknown, so equi-joins plan as shuffled hash
+    "spark.sql.autoBroadcastJoinThreshold": 64 << 10,
+    "spark.rapids.sql.fusedExec.enabled": False,
+    "spark.rapids.sql.format.parquet.reader.type": "PERFILE",
+}
+
+
+@pytest.fixture()
+def spark():
+    s = TpuSparkSession(dict(_CONF))
+    yield s
+    s.stop()
+
+
+def _write_parts(d, table, nfiles):
+    import os
+
+    os.makedirs(d, exist_ok=True)
+    per = table.num_rows // nfiles
+    for i in range(nfiles):
+        pq.write_table(table.slice(i * per,
+                                   per if i < nfiles - 1 else None),
+                       f"{d}/p{i}.parquet")
+    return str(d)
+
+
+def _probe_build_tables():
+    rng = np.random.default_rng(3)
+    probe_t = pa.table({
+        "k": pa.array(rng.integers(0, 500, 20_000), type=pa.int64()),
+        "v": pa.array(rng.random(20_000))})
+    build_t = pa.table({
+        "k": pa.array(np.arange(40_000) % 500, type=pa.int64()),
+        "w": pa.array(np.arange(40_000), type=pa.int64())})
+    return probe_t, build_t
+
+
+def _find(n, cls):
+    if isinstance(n, cls):
+        return n
+    for c in n.children:
+        r = _find(c, cls)
+        if r is not None:
+            return r
+
+
+def test_aqe_broadcast_promotion_and_correctness(spark, tmp_path):
+    from spark_rapids_tpu.exec.joins import TpuShuffledHashJoinExec
+
+    probe_t, build_t = _probe_build_tables()
+    pd_ = _write_parts(tmp_path / "probe", probe_t, 4)
+    bd = _write_parts(tmp_path / "build", build_t, 4)
+    probe = spark.read.parquet(pd_)
+    # filters down to ~100 rows at runtime; static planner cannot know
+    build = spark.read.parquet(bd).filter(F.col("w") < 100)
+    df = probe.join(build, on="k", how="inner")
+    phys, _ = df._physical()
+    # static plan chose the shuffled join
+    assert _find(phys, TpuShuffledHashJoinExec) is not None
+    ex = AdaptiveQueryExecutor(spark.rapids_conf)
+    out = ex.execute(phys)
+    assert any("broadcast promotion" in d for d in ex.decisions), \
+        ex.decisions
+    assert any("cancelled" in d for d in ex.decisions), ex.decisions
+    want = probe_t.join(build_t.filter(pc.less(build_t.column("w"),
+                                               100)),
+                        keys="k", join_type="inner")
+    assert out.num_rows == want.num_rows
+
+
+def test_aqe_partition_coalescing(spark, tmp_path):
+    rng = np.random.default_rng(5)
+    t = pa.table({"k": pa.array(rng.integers(0, 30, 5000),
+                                type=pa.int64()),
+                  "v": pa.array(rng.random(5000))})
+    d = _write_parts(tmp_path / "agg", t, 4)
+    df = (spark.read.parquet(d)
+          .groupBy("k").agg(F.sum("v").alias("s"),
+                            F.count("*").alias("n")))
+    phys, _ = df._physical()
+    ex = AdaptiveQueryExecutor(spark.rapids_conf)
+    out = ex.execute(phys)
+    got = {r["k"]: (r["s"], r["n"]) for r in out.to_pylist()}
+    w = t.group_by("k").aggregate([("v", "sum"), ("k", "count")])
+    exp = {r["k"]: (r["v_sum"], r["k_count"]) for r in w.to_pylist()}
+    assert set(got) == set(exp)
+    for k in exp:
+        assert got[k][1] == exp[k][1]
+        assert abs(got[k][0] - exp[k][0]) < 1e-9 * max(
+            1.0, abs(exp[k][0]))
+    assert any("coalesced" in d for d in ex.decisions), ex.decisions
+
+
+def test_aqe_through_public_api_matches_oracle(tmp_path):
+    """collect_arrow routes through AQE by default for exchange-bearing
+    eager plans; results match the CPU oracle."""
+    from spark_rapids_tpu.testing.asserts import (
+        assert_tpu_and_cpu_are_equal_collect,
+    )
+
+    probe_t, build_t = _probe_build_tables()
+    pd_ = _write_parts(tmp_path / "probe", probe_t, 3)
+    bd = _write_parts(tmp_path / "build", build_t, 3)
+
+    def q(s):
+        probe = s.read.parquet(pd_)
+        build = s.read.parquet(bd).filter(F.col("w") < 100)
+        return (probe.join(build, on="k", how="inner")
+                .groupBy("k").agg(F.sum("v").alias("sv"),
+                                  F.count("*").alias("n")))
+
+    assert_tpu_and_cpu_are_equal_collect(q, conf=dict(_CONF))
+
+
+def test_aqe_join_sides_coalesce_together(tmp_path):
+    """Coalescing must never break a shuffled join's co-partitioning:
+    both sides coalesce with ONE shared grouping (or not at all) —
+    independent groupings would pair mismatched pids and silently drop
+    matches (Spark coordinates join-side coalescing the same way)."""
+    rng = np.random.default_rng(5)
+    n = 3000
+    left = pa.table({
+        "k": pa.array(rng.integers(0, 200, n), type=pa.int64()),
+        "x": pa.array(rng.random(n))})
+    right = pa.table({
+        "k": pa.array(rng.integers(0, 200, n), type=pa.int64()),
+        "y": pa.array(rng.random(n))})
+    _write_parts(str(tmp_path / "l"), left, 4)
+    _write_parts(str(tmp_path / "r"), right, 4)
+
+    conf = dict(_CONF)
+    conf["spark.sql.autoBroadcastJoinThreshold"] = -1  # keep shuffled
+    s = TpuSparkSession(conf)
+    try:
+        df = (s.read.parquet(str(tmp_path / "l"))
+              .join(s.read.parquet(str(tmp_path / "r")), on="k",
+                    how="inner")
+              .groupBy().agg(F.count("*").alias("c"),
+                             F.sum(F.col("x") + F.col("y")).alias("sxy")))
+        got = df.collect_arrow()
+    finally:
+        s.stop()
+
+    lk = np.asarray(left.column("k"))
+    rk = np.asarray(right.column("k"))
+    lx = np.asarray(left.column("x"))
+    ry = np.asarray(right.column("y"))
+    # oracle pair count and sum over the inner join
+    import collections
+
+    rcnt = collections.Counter(rk.tolist())
+    rsum = collections.defaultdict(float)
+    for k, y in zip(rk.tolist(), ry.tolist()):
+        rsum[k] += y
+    want_c = sum(rcnt.get(k, 0) for k in lk.tolist())
+    want_s = sum(x * rcnt.get(k, 0) + rsum.get(k, 0.0)
+                 for k, x in zip(lk.tolist(), lx.tolist()))
+    assert got.column("c")[0].as_py() == want_c
+    np.testing.assert_allclose(got.column("sxy")[0].as_py(), want_s,
+                               rtol=1e-9)

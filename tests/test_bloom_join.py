@@ -1,0 +1,149 @@
+"""Build-side bloom runtime filters for hash joins (round-4 verdict
+item #10; reference spark-rapids-jni BloomFilter via
+GpuBloomFilterMightContain): probe rows whose keys are provably absent
+from the build side drop BEFORE the hash probe, with correctness held
+by differential tests."""
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.testing.asserts import (
+    assert_tpu_and_cpu_are_equal_collect,
+    with_tpu_session,
+)
+
+
+def test_bloom_kernel_exact_and_probabilistic():
+    from spark_rapids_tpu.columnar.arrow_bridge import arrow_to_device
+    from spark_rapids_tpu.ops import bloom
+
+    rng = np.random.default_rng(0)
+    build_keys = rng.choice(100_000, size=500, replace=False)
+    b = arrow_to_device(pa.table({"k": pa.array(build_keys,
+                                                type=pa.int64())}))
+    bits = bloom.build([b.columns[0]], b.live_mask(),
+                       bloom.size_for(500))
+    probe = arrow_to_device(pa.table({"k": pa.array(
+        np.arange(100_000), type=pa.int64())}))
+    hit = np.asarray(bloom.might_contain(bits, [probe.columns[0]]))[
+        :100_000]
+    # no false negatives
+    assert hit[build_keys].all()
+    # false positive rate ~1% at 10 bits/key
+    fp = hit.sum() - 500
+    assert fp < 100_000 * 0.05, fp
+
+
+def _tables(spark, n_probe=60_000, n_build=600):
+    rng = np.random.default_rng(7)
+    probe = spark.createDataFrame(pa.table({
+        "k": pa.array(rng.integers(0, 1_000_000, n_probe),
+                      type=pa.int64()),
+        "v": pa.array(rng.random(n_probe)),
+    }))
+    build = spark.createDataFrame(pa.table({
+        "k": pa.array(rng.choice(1_000_000, size=n_build,
+                                 replace=False), type=pa.int64()),
+        "g": pa.array(rng.integers(0, 5, n_build), type=pa.int64()),
+    }))
+    return probe, build
+
+
+def test_bloom_join_correct_and_reduces_probe():
+    """Differential correctness + the filter actually removed rows
+    (metric-backed row reduction on a selective join)."""
+    from spark_rapids_tpu.runtime import metrics as M
+
+    captured = {}
+
+    def q(spark):
+        probe, build = _tables(spark)
+        df = probe.join(build, on="k", how="inner")
+        phys, _ = df._physical()
+        captured["phys"] = phys
+        out = phys.collect()
+        return out
+
+    conf = {"spark.sql.autoBroadcastJoinThreshold": -1,
+            "spark.rapids.sql.fusedExec.enabled": False,
+            "spark.sql.shuffle.partitions": 2}
+    got = with_tpu_session(q, conf)
+
+    def find_join(n):
+        from spark_rapids_tpu.exec.joins import TpuShuffledHashJoinExec
+
+        if isinstance(n, TpuShuffledHashJoinExec):
+            return n
+        for c in n.children:
+            r = find_join(c)
+            if r is not None:
+                return r
+
+    j = find_join(captured["phys"])
+    assert j is not None
+    filtered = j.metrics[M.BLOOM_FILTERED_ROWS].value
+    assert filtered > 40_000, filtered  # most probe rows dropped early
+    # correctness vs pyarrow
+    import pyarrow.compute as pc
+
+    rng = np.random.default_rng(7)
+    probe_t = pa.table({
+        "k": pa.array(rng.integers(0, 1_000_000, 60_000),
+                      type=pa.int64()),
+        "v": pa.array(rng.random(60_000))})
+    build_t = pa.table({
+        "k": pa.array(rng.choice(1_000_000, size=600, replace=False),
+                      type=pa.int64()),
+        "g": pa.array(rng.integers(0, 5, 600), type=pa.int64())})
+    want = probe_t.join(build_t, keys="k", join_type="inner")
+    assert got.num_rows == want.num_rows
+    # raw plan output keeps both sides' key columns; compare (k, v)
+    # multisets by index
+    gk = sorted(zip(got.column(0).to_pylist(),
+                    got.column(1).to_pylist()))
+    wk = sorted(zip(want.column("k").to_pylist(),
+                    want.column("v").to_pylist()))
+    assert gk == wk
+
+
+def test_bloom_join_with_nulls_differential():
+    def q(spark):
+        probe = spark.createDataFrame(pa.table({
+            "k": pa.array([1, None, 3, 4, None, 6] * 2000,
+                          type=pa.int64()),
+            "v": pa.array(list(range(12000)), type=pa.int64())}))
+        build = spark.createDataFrame(pa.table({
+            "k": pa.array([3, 6], type=pa.int64()),
+            "g": pa.array([30, 60], type=pa.int64())}))
+        return probe.join(build, on="k", how="inner")
+
+    assert_tpu_and_cpu_are_equal_collect(
+        q, conf={"spark.sql.autoBroadcastJoinThreshold": -1,
+                 "spark.rapids.sql.fusedExec.enabled": False,
+                 "spark.sql.shuffle.partitions": 2})
+
+
+def test_bloom_semi_join_differential():
+    def q(spark):
+        probe, build = _tables(spark, n_probe=20_000, n_build=300)
+        return probe.join(build, on="k", how="left_semi")
+
+    assert_tpu_and_cpu_are_equal_collect(
+        q, conf={"spark.sql.autoBroadcastJoinThreshold": -1,
+                 "spark.rapids.sql.fusedExec.enabled": False,
+                 "spark.sql.shuffle.partitions": 2})
+
+
+def test_bloom_disabled_conf():
+    def q(spark):
+        probe, build = _tables(spark, n_probe=20_000, n_build=300)
+        return probe.join(build, on="k", how="inner")
+
+    assert_tpu_and_cpu_are_equal_collect(
+        q, conf={"spark.sql.autoBroadcastJoinThreshold": -1,
+                 "spark.rapids.sql.join.bloomFilter.enabled": False,
+                 "spark.rapids.sql.fusedExec.enabled": False,
+                 "spark.sql.shuffle.partitions": 2})
