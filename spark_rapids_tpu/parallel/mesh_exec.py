@@ -178,6 +178,20 @@ def _shape_stub(b: ColumnBatch, partial_fn, final_fn, n: int, slot: int
                        jnp.asarray(out.num_rows, jnp.int32).reshape(1))
 
 
+def fetch_host(x) -> np.ndarray:
+    """Bring a (possibly multi-process-sharded) array to THIS host in
+    full. Single-process arrays are a plain device_get; cross-process
+    shards ride a DCN allgather (multihost_utils), so every process's
+    collect() sees the complete result — the reference's shuffle-fetch
+    of remote blocks (RapidsShuffleClient.scala:174), expressed as an
+    XLA collective instead of a socket protocol."""
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(jax.device_get(x))
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
 def gather_result(out: ColumnBatch, n: int) -> ColumnBatch:
     """Collect a sharded result to one host-side logical batch: shard s
     contributes its first out.num_rows[s] rows (the num_rows leaf of a
@@ -187,8 +201,8 @@ def gather_result(out: ColumnBatch, n: int) -> ColumnBatch:
     counts = out.num_rows
     leaves, treedef = jax.tree_util.tree_flatten(out)
     host = jax.tree_util.tree_unflatten(
-        treedef, [onp.asarray(jax.device_get(x)) for x in leaves])
-    counts = onp.asarray(jax.device_get(counts)).reshape(-1)
+        treedef, [fetch_host(x) for x in leaves])
+    counts = fetch_host(counts).reshape(-1)
     global_cap = host.columns[0].data.shape[0]
     shard_cap = global_cap // n
     keep = onp.zeros(global_cap, dtype=bool)

@@ -72,6 +72,12 @@ class MeshCompileError(NotImplementedError):
     to the single-chip thread-pool engine)."""
 
 
+#: Stats of the most recent sharded scan ingestion in THIS process —
+#: lets multi-process tests assert each process decoded only its own
+#: shard of the file list (never the whole table).
+last_ingest_stats: Dict[str, int] = {}
+
+
 # --------------------------------------------------- trace-safe helpers
 
 def concat_traced(batches: List[ColumnBatch]) -> ColumnBatch:
@@ -348,6 +354,17 @@ class MeshQueryExecutor:
         n = self.n
         files = [f for t in scan._tasks for f in t]
         shard_files = [files[s::n] for s in range(n)]
+        devs = list(self.mesh.devices.reshape(-1))
+        # multi-host: this process decodes ONLY the shards that land on
+        # its own devices — no process ever holds the whole table (the
+        # per-executor task split of the reference's scan RDD)
+        my_proc = jax.process_index()
+        local_ids = [s for s in range(n)
+                     if devs[s].process_index == my_proc]
+        last_ingest_stats.update(
+            files=sum(len(shard_files[s]) for s in local_ids),
+            total_files=len(files), local_shards=len(local_ids),
+            process=my_proc)
 
         def decode(fs) -> pa.Table:
             if not fs:
@@ -362,11 +379,14 @@ class MeshQueryExecutor:
                 tabs.append(t)
             return pa.concat_tables(tabs, promote_options="none")
 
-        with ThreadPoolExecutor(max_workers=min(8, n)) as pool:
-            tables = list(pool.map(decode, shard_files))
-        shard_cap = next_capacity(max(max(t.num_rows for t in tables), 1))
+        with ThreadPoolExecutor(max_workers=min(8, len(local_ids))) as pool:
+            local_tables = list(pool.map(
+                decode, [shard_files[s] for s in local_ids]))
+        shard_cap = next_capacity(
+            max(max(t.num_rows for t in local_tables), 1))
+        shard_cap = self._sync_max(shard_cap)
         shard_cols = []
-        for t in tables:
+        for t in local_tables:
             t = t.combine_chunks()
             cols = []
             for i, field in enumerate(scan.schema.fields):
@@ -387,19 +407,19 @@ class MeshQueryExecutor:
         for ci in range(len(scan.schema.fields)):
             datas = [sc[ci].data for sc in shard_cols]
             if datas[0].ndim == 2:
-                mb = max(int(d.shape[1]) for d in datas)
+                mb = self._sync_max(max(int(d.shape[1]) for d in datas))
                 for sc in shard_cols:
                     c = sc[ci]
                     sc[ci] = DeviceColumn(
                         c.dtype, pad2d(c.data, mb), c.validity,
                         c.lengths, pad2d(c.elem_validity, mb),
                         pad2d(c.map_values, mb))
-        devs = list(self.mesh.devices.reshape(-1))
         sharding = NamedSharding(self.mesh, P(AXIS))
+        local_devs = [devs[s] for s in local_ids]
 
         def assemble(leaves_per_shard, global_shape):
             singles = [jax.device_put(leaf, d)
-                       for leaf, d in zip(leaves_per_shard, devs)]
+                       for leaf, d in zip(leaves_per_shard, local_devs)]
             return jax.make_array_from_single_device_arrays(
                 global_shape, sharding, singles)
 
@@ -420,9 +440,23 @@ class MeshQueryExecutor:
             out_cols.append(DeviceColumn(field.dataType, data, validity,
                                          lengths, ev, mv))
         counts = assemble(
-            [np.asarray([t.num_rows], dtype=np.int32) for t in tables],
+            [np.asarray([t.num_rows], dtype=np.int32)
+             for t in local_tables],
             (n,))
         return ColumnBatch(scan.schema, out_cols, counts)
+
+    @staticmethod
+    def _sync_max(v: int) -> int:
+        """Agree on a global max (shard capacity / padded width) across
+        processes: shapes must be identical on every host or the global
+        arrays don't assemble. One tiny DCN allgather; no-op
+        single-process."""
+        if jax.process_count() == 1:
+            return int(v)
+        from jax.experimental import multihost_utils
+
+        return int(np.max(multihost_utils.process_allgather(
+            np.asarray([v], np.int64))))
 
     # --- execution ---
 
@@ -576,7 +610,7 @@ class MeshQueryExecutor:
                 (P(AXIS), P(AXIS))))
         out, ovf = jitted(*sharded)
         jax.block_until_ready(jax.tree_util.tree_leaves(out))
-        if bool(np.asarray(jax.device_get(ovf)).any()):
+        if bool(mesh_exec.fetch_host(ovf).any()):
             raise TpuSplitAndRetryOOM(
                 "mesh collective slot / join expansion overflowed; "
                 "recompiling with a larger expansion factor")

@@ -1,0 +1,113 @@
+"""Cross-process distributed execution — the reference's multi-executor
+story (RapidsShuffleClient/Server peers + heartbeat topology,
+SURVEY.md §2.7/§5.8) realized TPU-natively: N OS processes join one
+jax.distributed coordination service, the mesh spans every process's
+devices, and ONE compiled SPMD program executes the plan with
+cross-process collectives as the shuffle transport.
+
+This launches two real worker processes (tests/mp_worker.py), each
+owning 4 virtual CPU devices of an 8-device global mesh, and asserts:
+- the planned query (scan → filter → shuffled join → group-by) returns
+  oracle-identical results on BOTH processes,
+- each process decoded only its own half of the scan's files (no
+  whole-table host batch on any single host — the per-executor scan
+  split, GpuParquetScan.scala:2051 role).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.parquet as pq
+import pytest
+
+N_FILES = 8
+N_PROC = 2
+
+
+def _write_data(data_dir: str) -> pa.Table:
+    rng = np.random.default_rng(7)
+    parts = []
+    os.makedirs(data_dir, exist_ok=True)
+    for i in range(N_FILES):
+        t = pa.table({
+            "k": pa.array(rng.integers(0, 50, 600), type=pa.int64()),
+            "v": pa.array(rng.random(600), type=pa.float64()),
+        })
+        pq.write_table(t, os.path.join(data_dir, f"part-{i}.parquet"))
+        parts.append(t)
+    return pa.concat_tables(parts)
+
+
+def _oracle(full: pa.Table) -> pa.Table:
+    filt = full.filter(pc.greater(full.column("v"), 0.2))
+    filt = filt.append_column(
+        "g", pa.array(np.asarray(filt.column("k")) % 5, type=pa.int64()))
+    agg = filt.group_by("g").aggregate([("v", "sum"), ("v", "count")])
+    cols = {n: agg.column(n) for n in agg.column_names}
+    return pa.table({"g": cols["g"], "s": cols["v_sum"],
+                     "c": pc.cast(cols["v_count"], pa.int64())}
+                    ).sort_by([("g", "ascending")])
+
+
+def test_two_process_distributed_query(tmp_path):
+    data_dir = str(tmp_path / "data")
+    out_dir = str(tmp_path / "out")
+    os.makedirs(out_dir)
+    full = _write_data(data_dir)
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["SRTPU_MP_COORD"] = "localhost:29677"
+    env["SRTPU_MP_NPROC"] = str(N_PROC)
+    env.pop("JAX_PLATFORMS", None)  # worker forces cpu itself
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    worker = os.path.join(repo, "tests", "mp_worker.py")
+    procs = []
+    for pid in range(N_PROC):
+        e = dict(env)
+        e["SRTPU_MP_PID"] = str(pid)
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, data_dir, out_dir],
+            env=e, stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=360)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("worker timed out (coordination or collective "
+                        "deadlock)")
+        outs.append(out.decode(errors="replace"))
+
+    for pid, p in enumerate(procs):
+        err_file = os.path.join(out_dir, f"err_{pid}")
+        if p.returncode != 0 or os.path.exists(err_file):
+            err = (open(err_file).read()
+                   if os.path.exists(err_file) else outs[pid][-4000:])
+            pytest.fail(f"worker {pid} failed (rc={p.returncode}):\n{err}")
+
+    want = _oracle(full)
+    stats = []
+    for pid in range(N_PROC):
+        got = pq.read_table(
+            os.path.join(out_dir, f"result_{pid}.parquet")
+        ).select(["g", "s", "c"]).sort_by([("g", "ascending")])
+        assert got.column("g").to_pylist() == want.column("g").to_pylist()
+        assert got.column("c").to_pylist() == want.column("c").to_pylist()
+        np.testing.assert_allclose(
+            np.asarray(got.column("s")), np.asarray(want.column("s")),
+            rtol=1e-9, err_msg=f"worker {pid} sums diverged")
+        stats.append(json.load(open(os.path.join(out_dir, f"ok_{pid}"))))
+
+    # every process decoded exactly its own half of the file list
+    assert [s["files"] for s in stats] == [N_FILES // N_PROC] * N_PROC, stats
+    assert [s["local_shards"] for s in stats] == [4, 4], stats
+    assert sorted(s["process"] for s in stats) == [0, 1], stats
