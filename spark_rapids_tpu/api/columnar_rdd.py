@@ -24,15 +24,22 @@ class ColumnarRdd:
         (no host conversion for device-resident operators)."""
         from spark_rapids_tpu.columnar.arrow_bridge import arrow_to_device
         from spark_rapids_tpu.exec.base import new_task_context
+        from spark_rapids_tpu.runtime import semaphore as sem
 
         phys, _ = df._physical()
         for pid in range(phys.num_partitions):
             ctx = new_task_context(df.session.rapids_conf)
-            for payload in phys.execute_partition(pid, ctx):
-                if isinstance(payload, ColumnBatch):
-                    yield payload
-                else:
-                    yield arrow_to_device(payload)
+            try:
+                for payload in phys.execute_partition(pid, ctx):
+                    if isinstance(payload, ColumnBatch):
+                        yield payload
+                    else:
+                        yield arrow_to_device(payload)
+            finally:
+                # the partition task's admission permits return when
+                # the consumer moves on (or closes the generator) —
+                # GpuSemaphore releases at task completion likewise
+                sem.get().release_if_necessary(ctx.task_id)
 
     @staticmethod
     def to_jax(df) -> Dict[str, Tuple[jnp.ndarray, jnp.ndarray]]:

@@ -200,6 +200,18 @@ class TpuSparkSession:
             executor_plugin,
         )
 
+        coord = self.rapids_conf.get(rc.MULTIHOST_COORDINATOR)
+        if coord:
+            # join the cluster BEFORE any backend touch so
+            # jax.devices() spans every process (multihost.initialize
+            # is idempotent across sessions in one process)
+            from spark_rapids_tpu.parallel import multihost
+
+            nproc = self.rapids_conf.get(rc.MULTIHOST_NUM_PROCESSES)
+            pid = self.rapids_conf.get(rc.MULTIHOST_PROCESS_ID)
+            multihost.initialize(
+                coord, nproc if nproc > 0 else None,
+                pid if pid >= 0 else None)
         self._conf_map = TpuDriverPlugin().init(self.rapids_conf)
         self._executor_plugin = executor_plugin()
         self._executor_plugin.init(self.rapids_conf)
@@ -307,6 +319,13 @@ class TpuSparkSession:
                     raise_on_leak=bool(self.rapids_conf.get(
                         rc.LEAK_DETECTION)))
         finally:
+            # admission permits of tasks the session abandoned (e.g. a
+            # partially-consumed ColumnarRdd iterator) must not starve
+            # the next session — the executor-plugin shutdown resets
+            # GpuSemaphore likewise
+            from spark_rapids_tpu.runtime import semaphore as _sem
+
+            _sem.initialize(self.rapids_conf.get(rc.CONCURRENT_TPU_TASKS))
             # the session must deregister even when the leak check
             # raises, or active() keeps returning a dead session
             with _active_lock:

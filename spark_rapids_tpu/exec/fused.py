@@ -304,6 +304,12 @@ class FusedSingleChipExecutor:
             # (exec/operators.py _build_ansi_check); the fused programs
             # have no raise points yet
             raise FusedCompileError("ANSI mode uses the eager engine")
+        if (self.conf is not None
+                and self.conf.get(rc.OOM_INJECTION_MODE) != "none"):
+            # forced-OOM fault injection targets the eager engine's
+            # allocation points (runtime/retry.py, the RmmSpark-forced
+            # OOM analog) — fused programs have none to inject into
+            raise FusedCompileError("OOM injection uses the eager engine")
         # validate the plan BEFORE decoding/uploading anything
         self._validate(phys)
         ctx = new_task_context(self.conf)

@@ -1775,14 +1775,13 @@ class CpuSortExec(PhysicalPlan):
         table = pa.concat_tables(tables, promote_options="none")
         names = self.children[0].schema.names
         sort_keys = []
-        placement = "at_start"
         for o in self.orders:
             assert isinstance(o.expr, BoundReference)
-            sort_keys.append((names[o.expr.ordinal],
-                              "ascending" if o.ascending else "descending"))
-            placement = "at_start" if o.nulls_first else "at_end"
-        idx = pc.sort_indices(
-            table, sort_keys=sort_keys, null_placement=placement)
+            sort_keys.append((
+                names[o.expr.ordinal],
+                "ascending" if o.ascending else "descending",
+                "at_start" if o.nulls_first else "at_end"))
+        idx = pc.sort_indices(table, sort_keys=sort_keys)
         yield table.take(idx)
 
 

@@ -90,21 +90,33 @@ def concat_traced(batches: List[ColumnBatch]) -> ColumnBatch:
     caps = [b.capacity for b in batches]
     total_cap = sum(caps)
     live = jnp.concatenate([b.live_mask() for b in batches])
+
+    def cat2d(leaves):
+        # width-align 2-D leaves (string bytes / array elements / map
+        # values / element validity) before concatenating rows
+        mb = max(int(x.shape[1]) for x in leaves)
+        return jnp.concatenate(
+            [jnp.pad(x, ((0, 0), (0, mb - x.shape[1]))) for x in leaves],
+            axis=0)
+
     cols: List[DeviceColumn] = []
     for ci, field in enumerate(schema.fields):
         parts = [b.columns[ci] for b in batches]
-        if isinstance(field.dataType, StringType):
-            mb = max(int(p.data.shape[1]) for p in parts)
-            datas = [jnp.pad(p.data, ((0, 0), (0, mb - p.data.shape[1])))
-                     for p in parts]
+        if parts[0].data.ndim == 2:
+            data = cat2d([p.data for p in parts])
         else:
-            datas = [p.data for p in parts]
-        data = jnp.concatenate(datas, axis=0)
+            data = jnp.concatenate([p.data for p in parts], axis=0)
         val = jnp.concatenate([p.validity for p in parts])
         lens = None
         if parts[0].lengths is not None:
             lens = jnp.concatenate([p.lengths for p in parts])
-        cols.append(DeviceColumn(field.dataType, data, val, lens))
+        ev = None
+        if parts[0].elem_validity is not None:
+            ev = cat2d([p.elem_validity for p in parts])
+        mv = None
+        if parts[0].map_values is not None:
+            mv = cat2d([p.map_values for p in parts])
+        cols.append(DeviceColumn(field.dataType, data, val, lens, ev, mv))
     interim = ColumnBatch(schema, cols, total_cap)
     perm, total = filterops.compact_perm(live, total_cap)
     return interim.gather(perm, total)
@@ -276,6 +288,12 @@ def _plan_key(node: PhysicalPlan) -> tuple:
     elif isinstance(node, ops.TpuGenerateExec):
         own = (node.gen_alias.name, node.gen_alias.key(),
                aliases_key(node.pass_through), node.position)
+    elif isinstance(node, ops.TpuExpandExec):
+        # rollup/cube/grouping-sets share one output schema but differ
+        # in their projection lists — the program key must carry them
+        own = tuple(aliases_key(p) for p in node.projections)
+    elif isinstance(node, ops.TpuSampleExec):
+        own = (node.fraction, node.seed)
     elif isinstance(node, (J.TpuShuffledHashJoinExec,
                            J.TpuBroadcastHashJoinExec)):
         own = (node.join_type,
@@ -482,8 +500,32 @@ class MeshQueryExecutor:
                 return self._run(phys, sources, sharded, expansion)
             except TpuSplitAndRetryOOM:
                 if expansion >= 256:
+                    if self._has_static_collect(phys):
+                        # a group wider than the largest static collect
+                        # width (16*256) is better served by the eager
+                        # engine's data-dependent buffers — fall back
+                        # rather than fail the query
+                        raise MeshCompileError(
+                            "collect group exceeds the largest static "
+                            "mesh width; eager engine handles it")
                     raise
                 expansion *= 2
+
+    @staticmethod
+    def _has_static_collect(phys: PhysicalPlan) -> bool:
+        from spark_rapids_tpu.expr.aggregates import (
+            CollectList,
+            CountDistinct,
+        )
+
+        def walk(n) -> bool:
+            if isinstance(n, ops.TpuHashAggregateExec) and any(
+                    isinstance(a.children[0], (CollectList, CountDistinct))
+                    for a in n.aggs):
+                return True
+            return any(walk(c) for c in n.children)
+
+        return walk(phys)
 
     def _run(self, phys: PhysicalPlan, sources: List[PhysicalPlan],
              sharded: List[ColumnBatch], expansion: int) -> pa.Table:
@@ -652,22 +694,52 @@ class MeshQueryExecutor:
 
     def _emit_agg(self, node: ops.TpuHashAggregateExec, emit, track,
                   expansion: int) -> ColumnBatch:
+        from spark_rapids_tpu.expr.aggregates import (
+            CollectList,
+            CountDistinct,
+        )
+
         n = self.n
-        if any(not a.children[0].jittable for a in node.aggs):
-            # collect_list/percentile family needs data-dependent output
-            # widths — no static shard_map lowering; thread-pool path
-            raise MeshCompileError("non-jittable aggregate (collect/"
+        fns = [a.children[0] for a in node.aggs]
+        static_fns = [f for f in fns if not f.jittable
+                      and isinstance(f, (CollectList, CountDistinct))]
+        if any(not f.jittable for f in fns
+               if not isinstance(f, (CollectList, CountDistinct))):
+            # exact percentile keeps its unbounded row-sized buffers —
+            # approx_percentile is the bounded mesh path
+            raise MeshCompileError("non-jittable aggregate (exact "
                                    "percentile family)")
+        # collect/distinct family: static element width under the same
+        # overflow-recompile discipline as the collective slots
+        # (reference: cuDF ragged collect lists; here the padded matrix
+        # width doubles with the expansion factor until the widest
+        # group fits). The bracket wraps ONLY this node's phase calls —
+        # partial and final plan nodes share fn instances, and
+        # emit(child) may reach the sibling phase's _emit_agg.
+        def run_phase(phase_fn, batch):
+            for f in static_fns:
+                f.begin_static(16 * expansion)
+            try:
+                out = phase_fn(batch)
+            except Exception:
+                for f in static_fns:
+                    f.end_static()
+                raise
+            for f in static_fns:
+                out = track((out, f.end_static()))
+            return out
+
         if node.mode == "partial":
-            return node._partial(emit(node.children[0]))
+            return run_phase(node._partial, emit(node.children[0]))
         if node.mode == "final":
             return self._first_shard_only(
-                node._merge_final(emit(node.children[0])), node)
+                run_phase(node._merge_final, emit(node.children[0])),
+                node)
         # complete: the planner saw one partition; distribute it as
         # partial -> key-hash all_to_all -> final (the same shape the
         # planner emits for multi-partition children)
         child = emit(node.children[0])
-        part = node._partial(child)
+        part = run_phase(node._partial, child)
         nk = len(node.grouping)
         if nk:
             key_cols = [part.columns[i] for i in range(nk)]
@@ -676,7 +748,8 @@ class MeshQueryExecutor:
             ex = track(all_to_all_batch(part, dest, n, slot, AXIS))
         else:
             ex = gather_to_one(part, AXIS, n)
-        return self._first_shard_only(node._merge_final(ex), node)
+        return self._first_shard_only(run_phase(node._merge_final, ex),
+                                      node)
 
     @staticmethod
     def _first_shard_only(out: ColumnBatch,

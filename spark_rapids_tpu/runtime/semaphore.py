@@ -54,7 +54,14 @@ _lock = threading.Lock()
 def initialize(concurrent_tasks: int):
     global _instance
     with _lock:
-        _instance = TpuSemaphore(concurrent_tasks)
+        old, _instance = _instance, TpuSemaphore(concurrent_tasks)
+    if old is not None:
+        # wake anyone still blocked on the replaced instance — their
+        # releases would otherwise notify only the new one, stranding
+        # them on a condition variable nobody signals again
+        with old._cv:
+            old._available = MAX_PERMITS
+            old._cv.notify_all()
 
 
 def get() -> TpuSemaphore:

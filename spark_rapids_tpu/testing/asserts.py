@@ -53,13 +53,12 @@ def _sort_table(t: pa.Table) -> pa.Table:
     # sortable in arrow: key on the sortable subset only.
     uniq = [f"c{i}" for i in range(t.num_columns)]
     view = t.rename_columns(uniq)
-    keys = [(n, "ascending") for n, f in zip(uniq, t.schema)
+    keys = [(n, "ascending", "at_start") for n, f in zip(uniq, t.schema)
             if not pa.types.is_nested(f.type)]
     if not keys:
         return t
     try:
-        return t.take(pc.sort_indices(view, sort_keys=keys,
-                                      null_placement="at_start"))
+        return t.take(pc.sort_indices(view, sort_keys=keys))
     except (pa.ArrowNotImplementedError, pa.ArrowTypeError):
         return t
 

@@ -119,7 +119,10 @@ def test_ansi_invalid_string_cast_raises():
 
 
 def test_ansi_overflow_raises():
-    from spark_rapids_tpu.exec.cpu_eval import CastError
+    # the DEVICE ANSI check fires (overflow detected in the jitted
+    # check program, raise_if_set); the public contract is the
+    # TpuCastError base, which the CPU oracle's CastError subclasses
+    from spark_rapids_tpu.runtime.errors import TpuCastError
 
     conf = {**_CONF, "spark.sql.ansi.enabled": True}
 
@@ -128,7 +131,7 @@ def test_ansi_overflow_raises():
             "v": pa.array([1.0, 3.0e10], type=pa.float64())}))
         return df.select(F.col("v").cast("int").alias("i"))
 
-    with pytest.raises(CastError, match="CAST_OVERFLOW"):
+    with pytest.raises(TpuCastError, match="CAST_OVERFLOW"):
         with_tpu_session(lambda s: q(s).collect_arrow(), conf)
 
 
