@@ -257,6 +257,23 @@ MESH_SIZE = conf(
     "transport (the UCX P2P transport role, SURVEY.md 5.8); 0 = "
     "single-chip thread-pool engine. Plans with no mesh lowering fall "
     "back to the single-chip engine automatically.", int)
+MULTIHOST_COORDINATOR = conf(
+    "spark.rapids.tpu.multihost.coordinator", "",
+    "host:port of the jax.distributed coordination service. When set, "
+    "the session joins the multi-host cluster at startup and the mesh "
+    "engine spans every process's devices, with cross-process "
+    "collectives as the shuffle fabric (the executor-registration "
+    "role of the reference heartbeat plane, "
+    "RapidsShuffleHeartbeatManager.scala). Empty = single process.",
+    str, startup_only=True)
+MULTIHOST_NUM_PROCESSES = conf(
+    "spark.rapids.tpu.multihost.numProcesses", 0,
+    "Process count for multihost.coordinator (0 = auto-detect from "
+    "the TPU pod metadata).", int, startup_only=True)
+MULTIHOST_PROCESS_ID = conf(
+    "spark.rapids.tpu.multihost.processId", -1,
+    "This process's id for multihost.coordinator (-1 = auto-detect "
+    "from the TPU pod metadata).", int, startup_only=True)
 FUSED_EXEC = conf(
     "spark.rapids.sql.fusedExec.enabled", True,
     "Compile whole query stages into a few fused XLA programs for "

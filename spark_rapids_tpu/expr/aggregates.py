@@ -682,6 +682,29 @@ class CollectList(AggregateFunction):
     name = "collect_list"
     jittable = False
 
+    #: Traced-mode (mesh SPMD) sizing: when set, the element matrix is
+    #: this static width instead of the eager largest-group host sync;
+    #: groups wider than the width set `_overflow` (a traced bool the
+    #: mesh executor folds into its expansion-retry flag, the same
+    #: static-capacity + recompile-bigger discipline as the
+    #: collectives). None = eager data-dependent sizing.
+    _static_width = None
+    _overflow = None
+
+    def begin_static(self, width: int) -> None:
+        self._static_width = int(width)
+        self._overflow = jnp.zeros((), bool)
+
+    def end_static(self):
+        ovf = self._overflow
+        self._static_width = None
+        self._overflow = None
+        return ovf
+
+    def key(self):
+        return (self.name, self._static_width,
+                self.children[0].key())
+
     def __init__(self, child: Expression):
         super().__init__([child])
 
@@ -699,9 +722,16 @@ class CollectList(AggregateFunction):
         return [self.dtype]
 
     def _scatter(self, elem_dt, vals, valid, gid, cap):
-        """Rows -> [cap, me] padded array column (me = largest group)."""
+        """Rows -> [cap, me] padded array column (me = largest group,
+        or the static traced-mode width)."""
         cnt = segmented.seg_count(valid, gid, cap)
-        me = max(int(jnp.max(cnt)), 1)
+        if self._static_width is not None:
+            me = self._static_width
+            self._overflow = self._overflow | jnp.any(cnt > me)
+            cnt = jnp.minimum(cnt, me)  # ranks >= me scatter out of
+            #                             bounds and drop (mode="drop")
+        else:
+            me = max(int(jnp.max(cnt)), 1)
         rank = _seg_exclusive_ranks(valid, gid, cap)
         # invalid rows scatter out of range and are dropped
         col = jnp.where(valid, rank, me)
@@ -790,10 +820,30 @@ class CountDistinct(AggregateFunction):
     def __init__(self, child: Expression):
         super().__init__([child])
 
+    # traced-mode static sizing delegates to the underlying set buffer
+    _static_width = None
+    _overflow = None
+    begin_static = CollectList.begin_static
+    end_static = CollectList.end_static
+
+    def key(self):
+        return (self.name, self._static_width,
+                self.children[0].key())
+
     @property
     def _set(self):
-        # derived lazily: children are rebound during plan analysis
-        return CollectSet(self.children[0])
+        # derived lazily: children are rebound during plan analysis;
+        # the throwaway delegate carries this instance's traced-mode
+        # state in and out
+        s = CollectSet(self.children[0])
+        s._static_width = self._static_width
+        s._overflow = self._overflow
+        return s
+
+    def _delegated(self, s: "CollectSet", out):
+        if s._static_width is not None:
+            self._overflow = s._overflow
+        return out
 
     @property
     def dtype(self):
@@ -807,10 +857,12 @@ class CountDistinct(AggregateFunction):
         return self._set.buffer_types()
 
     def update(self, values, live, gid, cap):
-        return self._set.update(values, live, gid, cap)
+        s = self._set
+        return self._delegated(s, s.update(values, live, gid, cap))
 
     def merge(self, buffers, live, gid, cap):
-        return self._set.merge(buffers, live, gid, cap)
+        s = self._set
+        return self._delegated(s, s.merge(buffers, live, gid, cap))
 
     def evaluate(self, buffers):
         buf = buffers[0]

@@ -16,10 +16,11 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spark_rapids_tpu.sqltypes import DecimalType
 
-_M32 = jnp.uint64(0xFFFFFFFF)
+_M32 = np.uint64(0xFFFFFFFF)
 _SIGN64 = -0x8000000000000000  # int64 min: flips to unsigned order
 
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 from typing import List
 
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from spark_rapids_tpu.columnar.batch import DeviceColumn
@@ -27,9 +28,9 @@ from spark_rapids_tpu.sqltypes import (
     StringType,
 )
 
-_C1 = jnp.int32(0xCC9E2D51 - (1 << 32))
-_C2 = jnp.int32(0x1B873593)
-_M5 = jnp.int32(0xE6546B64 - (1 << 32))
+_C1 = np.int32(0xCC9E2D51 - (1 << 32))
+_C2 = np.int32(0x1B873593)
+_M5 = np.int32(0xE6546B64 - (1 << 32))
 
 DEFAULT_SEED = 42
 
@@ -166,11 +167,11 @@ def pmod(x: jnp.ndarray, n: int) -> jnp.ndarray:
 # 64-bit integer emulation on TPU.
 # ---------------------------------------------------------------------------
 
-_P1 = jnp.uint64(0x9E3779B185EBCA87)
-_P2 = jnp.uint64(0xC2B2AE3D27D4EB4F)
-_P3 = jnp.uint64(0x165667B19E3779F9)
-_P4 = jnp.uint64(0x85EBCA77C2B2AE63)
-_P5 = jnp.uint64(0x27D4EB2F165667C5)
+_P1 = np.uint64(0x9E3779B185EBCA87)
+_P2 = np.uint64(0xC2B2AE3D27D4EB4F)
+_P3 = np.uint64(0x165667B19E3779F9)
+_P4 = np.uint64(0x85EBCA77C2B2AE63)
+_P5 = np.uint64(0x27D4EB2F165667C5)
 
 XXHASH_DEFAULT_SEED = 42
 

@@ -35,22 +35,23 @@ def main() -> None:
     pid = int(os.environ["SRTPU_MP_PID"])
     data_dir, out_dir = sys.argv[1], sys.argv[2]
 
-    from spark_rapids_tpu.parallel import multihost
-
-    multihost.initialize(coord, nproc, pid)
-    assert jax.process_count() == nproc, jax.process_count()
-
     import pyarrow.parquet as pq
 
     from spark_rapids_tpu.api import functions as F
     from spark_rapids_tpu.api.session import TpuSparkSession
-    from spark_rapids_tpu.parallel import plan_compiler
+    from spark_rapids_tpu.parallel import multihost, plan_compiler
 
+    # the session joins the cluster itself (multihost.* confs)
     spark = TpuSparkSession({
-        "spark.rapids.tpu.mesh": multihost.global_device_count(),
+        "spark.rapids.tpu.multihost.coordinator": coord,
+        "spark.rapids.tpu.multihost.numProcesses": nproc,
+        "spark.rapids.tpu.multihost.processId": pid,
         "spark.sql.shuffle.partitions": 4,
         "spark.sql.autoBroadcastJoinThreshold": -1,
     })
+    assert jax.process_count() == nproc, jax.process_count()
+    spark.conf.set("spark.rapids.tpu.mesh",
+                   multihost.global_device_count())
     try:
         fact = spark.read.parquet(data_dir)
         dim = spark.createDataFrame(_dim_table())
