@@ -416,31 +416,46 @@ class TpuShuffledHashJoinExec(_DeviceJoinBase):
                 yield out
 
 
+_node_lock_guard = threading.Lock()
+
+
+def _node_bcast_lock(node) -> threading.Lock:
+    """Per-node build lock, created lazily (node objects are plan
+    nodes; the lock's lifetime is the plan's)."""
+    with _node_lock_guard:
+        lk = getattr(node, "_srtpu_bcast_lock", None)
+        if lk is None:
+            lk = threading.Lock()
+            node._srtpu_bcast_lock = lk
+        return lk
+
+
 class _BroadcastBuildMixin:
     """Materializes the build (right) side exactly once, shared by every
     probe partition. Subclasses call _init_broadcast() in __init__."""
 
     def _init_broadcast(self):
         self._bcast_lock = threading.Lock()
-        self._built = False
-        self._build_batches: List[ColumnBatch] = []
-        self._build_bt: Optional[joinops.BuildTable] = None
 
     @property
     def num_partitions(self):
         return self.children[0].num_partitions
 
     def _broadcast_build(self, ctx) -> List[ColumnBatch]:
-        with self._bcast_lock:
-            if not self._built:
+        """Materialize the build side ONCE per build NODE: the cache
+        lives on the child, so joins sharing a deduped build subtree
+        (plan/broadcast_reuse.py, the ReusedExchange role) share the
+        device-resident batches too."""
+        rchild = self.children[1]
+        with _node_bcast_lock(rchild):
+            cache = getattr(rchild, "_srtpu_bcast_batches", None)
+            if cache is None:
                 batches: List[ColumnBatch] = []
-                rchild = self.children[1]
                 for rp in range(rchild.num_partitions):
                     batches.extend(rchild.execute_partition(rp, ctx))
-                self._build_batches = (
-                    [concat_batches(batches)] if batches else [])
-                self._built = True
-            return self._build_batches
+                cache = [concat_batches(batches)] if batches else []
+                rchild._srtpu_bcast_batches = cache
+            return cache
 
 
 class TpuBroadcastHashJoinExec(_BroadcastBuildMixin, _DeviceJoinBase):
@@ -459,12 +474,22 @@ class TpuBroadcastHashJoinExec(_BroadcastBuildMixin, _DeviceJoinBase):
 
     def _broadcast_build_table(self, ctx):
         """(build_batches, prepared BuildTable) — the sorted build table
-        is computed once, not per probe partition."""
+        is computed once per (shared build node, join keys): joins that
+        share a deduped build subtree AND sort it by the same keys share
+        the prepared table and its device residency too."""
         batches = self._broadcast_build(ctx)
-        with self._bcast_lock:
-            if batches and self._build_bt is None:
-                self._build_bt = self._build_table(batches[0])
-            return batches, self._build_bt
+        rchild = self.children[1]
+        keys = tuple(k.key() for k in self.right_keys)
+        with _node_bcast_lock(rchild):
+            bts = getattr(rchild, "_srtpu_bcast_bt", None)
+            if bts is None:
+                bts = {}
+                rchild._srtpu_bcast_bt = bts
+            bt = bts.get(keys)
+            if batches and bt is None:
+                bt = self._build_table(batches[0])
+                bts[keys] = bt
+            return batches, bt
 
     def execute_partition(self, pid, ctx):
         with self.metrics[M.JOIN_TIME].ns():

@@ -893,7 +893,12 @@ class TpuHashAggregateExec(PhysicalPlan):
     def execute_partition(self, pid, ctx):
         from spark_rapids_tpu.config import rapids_conf as rc
         from spark_rapids_tpu.runtime.memory import get_catalog
-        from spark_rapids_tpu.runtime.retry import retry_on_oom, with_retry
+        from spark_rapids_tpu.runtime.retry import (
+            PendingBatches,
+            retry_on_oom,
+            with_restore_on_retry,
+            with_retry,
+        )
 
         catalog = get_catalog()
         target_rows = (self.conf.get(rc.BATCH_SIZE_ROWS) if self.conf
@@ -903,14 +908,11 @@ class TpuHashAggregateExec(PhysicalPlan):
             return retry_on_oom(lambda: catalog.add_batch(b))
 
         with self.metrics[M.AGG_TIME].ns():
-            pending = []  # spillable buffer-schema batches
-            pending_rows = 0
+            pending = PendingBatches()  # spillable buffer-schema batches
 
             def reduce_pending():
-                nonlocal pending, pending_rows
-
                 def step():
-                    batches = [sb.get_batch() for sb in pending]
+                    batches = [sb.get_batch() for sb in pending.items]
                     merged = concat_batches(batches) if len(batches) > 1 \
                         else batches[0]
                     with catalog.reserved(merged.device_size_bytes(),
@@ -918,13 +920,13 @@ class TpuHashAggregateExec(PhysicalPlan):
                         return self._jit_merge_buffers(merged)
 
                 compacted = retry_on_oom(step)
-                for sb in pending:
-                    sb.close()
-                pending = [park(compacted)]
-                # one exact sync per COMPACTION (rare) — a capacity
-                # estimate here could exceed the threshold permanently
-                # and re-trigger full merges on every input batch
-                pending_rows = compacted.row_count()
+                pending.close()
+                pending.append(park(compacted),
+                               # one exact sync per COMPACTION (rare) —
+                               # a capacity estimate here could exceed
+                               # the threshold permanently and re-trigger
+                               # full merges on every input batch
+                               compacted.row_count())
 
             for batch in self.children[0].execute_partition(pid, ctx):
                 if self._ansi_jit is not None:
@@ -932,8 +934,7 @@ class TpuHashAggregateExec(PhysicalPlan):
 
                     raise_if_set(self._ansi_jit(batch))
                 if self.mode == "final":
-                    pending.append(park(batch))
-                    pending_rows += batch.capacity
+                    pending.append(park(batch), batch.capacity)
                 else:
                     sb = park(batch)
 
@@ -943,23 +944,29 @@ class TpuHashAggregateExec(PhysicalPlan):
                                               "agg_partial"):
                             return self._jit_partial(b)
 
-                    for part in with_retry(sb, part_fn):
-                        pending.append(park(part))
-                        pending_rows += part.capacity
-                if len(pending) > 1 and pending_rows > 2 * target_rows:
+                    def consume(sb=sb):
+                        for part in with_retry(sb, part_fn):
+                            pending.append(park(part), part.capacity)
+
+                    # a failure mid-batch (e.g. an OOM past its retry
+                    # budget) rolls PENDING back to the last input
+                    # boundary and closes the orphans — the task fails
+                    # leak-free and idempotent for task-level retry
+                    # (withRestoreOnRetry role)
+                    with_restore_on_retry(pending, consume)
+                if len(pending.items) > 1 and pending.rows > 2 * target_rows:
                     reduce_pending()
 
-            if not pending:
+            if not pending.items:
                 if len(self.grouping) == 0 and self.mode in ("final",
                                                              "complete"):
                     # global agg over empty input -> one default row
                     yield self._empty_global_result()
                 return
-            batches = [sb.get_batch() for sb in pending]
+            batches = [sb.get_batch() for sb in pending.items]
             merged = concat_batches(batches) if len(batches) > 1 \
                 else batches[0]
-            for sb in pending:
-                sb.close()
+            pending.close()
             if self.mode == "partial":
                 yield self._jit_merge_buffers(merged)
                 return

@@ -1,0 +1,78 @@
+"""Broadcast-exchange reuse — the GpuBroadcastExchangeExec-reuse /
+ReusedExchangeExec role (reference: broadcast builds are identified and
+re-used across consumers, incl. by AQE, GpuBroadcastExchangeExec.scala;
+SURVEY.md §2.5 Broadcast "re-used by AQE").
+
+Post-planning pass: broadcast joins whose BUILD subtrees are
+structurally identical share ONE child node instance, and the
+materialized build caches on that instance (exec/joins.py
+_BroadcastBuildMixin) — N joins against the same dimension table pay
+one build and one device residency.
+
+Keys are structural (_plan_key for interior operators) with
+source-distinguishing leaves (file list for scans, table identity for
+local relations). Any node without a trusted key contributes a
+unique-identity term, so unknown shapes NEVER dedup — correctness over
+reuse.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from spark_rapids_tpu.exec import joins as J
+from spark_rapids_tpu.exec import operators as ops
+from spark_rapids_tpu.exec.base import PhysicalPlan
+
+#: Interior operators whose _plan_key captures full semantics (their
+#: parameters are part of the key, children keyed recursively here).
+_SAFE_INTERIOR = (
+    ops.TpuProjectExec, ops.TpuFilterExec, ops.TpuHashAggregateExec,
+    ops.TpuSortExec, ops.TpuLocalLimitExec, ops.UnionExec,
+    ops.TpuWindowExec, ops.TpuGenerateExec, ops.TpuExpandExec,
+    ops.TpuSampleExec, ops.TpuShuffleExchangeExec, ops.ArrowToDeviceExec,
+    J.TpuShuffledHashJoinExec, J.TpuBroadcastHashJoinExec,
+)
+
+
+def _subtree_key(n: PhysicalPlan):
+    if isinstance(n, ops.TpuFileScanExec):
+        from spark_rapids_tpu.runtime.jit_cache import schema_key
+
+        own = ("scan", n.fmt,
+               tuple(f for t in n._tasks for f in t),
+               tuple(n.pushed_columns or ()),
+               tuple(map(str, n.pushed_filters or ())),
+               schema_key(n.schema),
+               repr(sorted((k, repr(v))
+                           for k, v in (n.options or {}).items())))
+    elif isinstance(n, ops.LocalRelationExec):
+        # same table OBJECT => same data; different objects never dedup
+        own = ("local", id(n.table))
+    elif isinstance(n, _SAFE_INTERIOR):
+        from spark_rapids_tpu.parallel.plan_compiler import _plan_key
+
+        own = _plan_key(n)[:2]
+    else:
+        # unknown shape: identity term — unequal to every other key
+        own = object()
+    return (own, tuple(_subtree_key(c) for c in n.children))
+
+
+def dedup_broadcast_builds(root: PhysicalPlan) -> PhysicalPlan:
+    seen: Dict[object, PhysicalPlan] = {}
+
+    def walk(n: PhysicalPlan) -> None:
+        for c in n.children:
+            walk(c)
+        if isinstance(n, (J.TpuBroadcastHashJoinExec,
+                          J.TpuBroadcastNestedLoopJoinExec)):
+            key = _subtree_key(n.children[1])
+            prev = seen.get(key)
+            if prev is not None and prev is not n.children[1]:
+                n.children[1] = prev
+            else:
+                seen[key] = n.children[1]
+
+    walk(root)
+    return root

@@ -626,4 +626,10 @@ class TpuOverrides:
 
 def plan_query(logical: L.LogicalPlan, conf: rc.RapidsConf
                ) -> Tuple[PhysicalPlan, PlanMeta]:
-    return TpuOverrides(conf).apply(logical)
+    phys, meta = TpuOverrides(conf).apply(logical)
+    from spark_rapids_tpu.plan.broadcast_reuse import (
+        dedup_broadcast_builds,
+    )
+
+    dedup_broadcast_builds(phys)
+    return phys, meta

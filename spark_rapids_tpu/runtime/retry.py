@@ -110,6 +110,86 @@ def with_retry_no_split(sb: SpillableBatch, fn: Callable[[SpillableBatch], T]
     return out
 
 
+class Retryable:
+    """Checkpoint/restore contract for state mutated inside a retried
+    block — the `com.nvidia.spark.Retryable` role
+    (sql-plugin-api Retryable.java:22; used by withRestoreOnRetry,
+    RmmRapidsRetryIterator.scala:234-261). Implementations snapshot
+    whatever an OOM-triggered re-attempt must not observe half-updated:
+    RNG streams, accumulated buffers, offsets."""
+
+    def checkpoint(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+
+class CheckpointedValue(Retryable):
+    """Single mutable value with snapshot semantics."""
+
+    def __init__(self, value):
+        self.value = value
+        self._mark = value
+
+    def checkpoint(self) -> None:
+        self._mark = self.value
+
+    def restore(self) -> None:
+        self.value = self._mark
+
+
+class PendingBatches(Retryable):
+    """Spillable-batch accumulator whose restore CLOSES anything
+    appended since the checkpoint — partial appends from an aborted
+    attempt neither leak spill-catalog entries nor double-count when
+    the attempt re-runs."""
+
+    def __init__(self):
+        self.items: List[SpillableBatch] = []
+        self.rows = 0
+        self._mark = (0, 0)
+
+    def append(self, sb: SpillableBatch, rows: int) -> None:
+        self.items.append(sb)
+        self.rows += rows
+
+    def checkpoint(self) -> None:
+        self._mark = (len(self.items), self.rows)
+
+    def restore(self) -> None:
+        k, r = self._mark
+        for sb in self.items[k:]:
+            sb.close()
+        del self.items[k:]
+        self.rows = r
+
+    def close(self) -> None:
+        for sb in self.items:
+            sb.close()
+        self.items.clear()
+        self.rows = 0
+
+
+def with_restore_on_retry(retryables, fn: Callable[[], T]) -> T:
+    """Run fn with restore-on-retry semantics
+    (RmmRapidsRetryIterator.scala:234-261 withRestoreOnRetry):
+    checkpoint every retryable first; if a retry-class OOM escapes fn,
+    restore them all before re-raising so the ENCLOSING retry loop
+    re-attempts against clean state. Non-OOM exceptions also restore —
+    a failed attempt must never leave half-applied state behind."""
+    if isinstance(retryables, Retryable):
+        retryables = [retryables]
+    for r in retryables:
+        r.checkpoint()
+    try:
+        return fn()
+    except BaseException:
+        for r in retryables:
+            r.restore()
+        raise
+
+
 def retry_on_oom(fn: Callable[[], T], max_attempts: int = 8) -> T:
     """Re-attempt a non-splittable device step after TpuRetryOOM (the
     spill already freed memory); propagate split OOMs and give up after

@@ -1,0 +1,117 @@
+"""Broadcast-exchange reuse (plan/broadcast_reuse.py): joins against
+the same dimension subtree share one build node and one materialized
+device build (reference GpuBroadcastExchangeExec reuse /
+ReusedExchangeExec, SURVEY.md §2.5 Broadcast)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSparkSession
+from spark_rapids_tpu.exec import joins as J
+
+_CONF = {"spark.sql.shuffle.partitions": 2,
+         "spark.sql.autoBroadcastJoinThreshold": 10 << 20,
+         "spark.rapids.sql.fusedExec.enabled": False}
+
+
+@pytest.fixture()
+def spark():
+    s = TpuSparkSession(dict(_CONF))
+    yield s
+    s.stop()
+
+
+def _find_bcast_joins(phys):
+    out = []
+
+    def walk(n):
+        if isinstance(n, (J.TpuBroadcastHashJoinExec,
+                          J.TpuBroadcastNestedLoopJoinExec)):
+            out.append(n)
+        for c in n.children:
+            walk(c)
+
+    walk(phys)
+    return out
+
+
+def test_same_dim_scan_builds_once(tmp_path, spark):
+    """Two plan branches join the IDENTICAL dim subtree (the classic
+    union-of-joins shape): one shared build node, one materialization."""
+    rng = np.random.default_rng(2)
+    dim = pa.table({"k": pa.array(np.arange(30), type=pa.int64()),
+                    "w": pa.array(np.arange(30) * 1.0)})
+    pq.write_table(dim, str(tmp_path / "dim.parquet"))
+    n = 4000
+    ks = rng.integers(0, 40, n)   # some keys miss the dim
+    k2s = rng.integers(0, 40, n)
+    fact_a = spark.createDataFrame(pa.table({
+        "k": pa.array(ks, type=pa.int64())}))
+    fact_b = spark.createDataFrame(pa.table({
+        "k": pa.array(k2s, type=pa.int64())}))
+
+    d1 = spark.read.parquet(str(tmp_path / "dim.parquet"))
+    d2 = spark.read.parquet(str(tmp_path / "dim.parquet"))
+    df = (fact_a.join(d1, on="k", how="inner")
+          .union(fact_b.join(d2, on="k", how="inner"))
+          .groupBy().agg(F.count("*").alias("c")))
+
+    phys, _ = df._physical()
+    joins = _find_bcast_joins(phys)
+    assert len(joins) == 2, [type(j).__name__ for j in joins]
+    assert joins[0].children[1] is joins[1].children[1], \
+        "identical dim subtrees did not dedup"
+
+    # count build-side executions; a shared (deduped) child is counted
+    # once however many joins consume it
+    calls = {"n": 0}
+    seen = set()
+    for j in joins:
+        child = j.children[1]
+        if id(child) in seen:
+            continue
+        seen.add(id(child))
+        orig = child.execute_partition
+
+        def counted(pid, ctx, _orig=orig):
+            calls["n"] += 1
+            return _orig(pid, ctx)
+
+        child.execute_partition = counted
+
+    got = phys.collect()
+
+    want = int((ks < 30).sum()) + int((k2s < 30).sum())
+    assert got.column("c")[0].as_py() == want
+    assert calls["n"] == 1, (
+        f"dim build executed {calls['n']} times; reuse failed")
+
+
+def test_renamed_projection_still_dedups_or_not_wrong(tmp_path, spark):
+    """d2 projects/renames on top of the same scan — whether or not the
+    differing projections dedup, results must be correct. (The pass
+    dedups the BUILD SUBTREES, which here differ by the rename
+    projection, so they stay separate.)"""
+    rng = np.random.default_rng(3)
+    fact = spark.createDataFrame(pa.table({
+        "k": pa.array(rng.integers(0, 20, 1000), type=pa.int64()),
+        "v": pa.array(rng.random(1000))}))
+    d1 = spark.createDataFrame(pa.table({
+        "k": pa.array(np.arange(20), type=pa.int64()),
+        "a": pa.array(np.arange(20) * 1.0)}))
+    d2 = spark.createDataFrame(pa.table({
+        "k": pa.array(np.arange(10), type=pa.int64()),
+        "b": pa.array(np.arange(10) * 2.0)}))
+    df = (fact.join(d1, on="k").join(d2, on="k")
+          .groupBy().agg(F.count("*").alias("c")))
+    phys, _ = df._physical()
+    joins = _find_bcast_joins(phys)
+    if len(joins) == 2:
+        # different local tables must never collapse to one build
+        assert joins[0].children[1] is not joins[1].children[1]
+    got = df.collect_arrow()
+    kf = np.asarray(fact.collect_arrow().column("k"))
+    assert got.column("c")[0].as_py() == int((kf < 10).sum())

@@ -196,3 +196,60 @@ def test_semaphore_reentrant():
     sem.acquire_if_necessary(7)  # no deadlock
     assert sem.holders() == 1
     sem.release_if_necessary(7)
+
+
+# ------------------- Retryable checkpoint/restore (withRestoreOnRetry)
+
+def test_with_restore_on_retry_restores_on_oom():
+    """State mutated by a failed attempt is rolled back before the OOM
+    propagates to the enclosing retry loop (reference Retryable.java +
+    RmmRapidsRetryIterator.scala:234-261), so the re-attempt runs
+    against clean state."""
+    from spark_rapids_tpu.runtime.errors import TpuRetryOOM
+    from spark_rapids_tpu.runtime.retry import (
+        CheckpointedValue,
+        retry_on_oom,
+        with_restore_on_retry,
+    )
+
+    state = CheckpointedValue(0)
+    attempts = {"n": 0}
+
+    def body():
+        state.value += 10  # mutation an aborted attempt must not keep
+        attempts["n"] += 1
+        if attempts["n"] == 1:
+            raise TpuRetryOOM("injected")
+        return state.value
+
+    out = retry_on_oom(lambda: with_restore_on_retry(state, body))
+    assert out == 10  # not 20: the first attempt's mutation rolled back
+    assert attempts["n"] == 2
+
+
+def test_pending_batches_restore_closes_orphans():
+    """PendingBatches.restore closes spillables appended after the
+    checkpoint — an aborted attempt leaks nothing from the catalog."""
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.columnar.arrow_bridge import arrow_to_device
+    from spark_rapids_tpu.runtime.memory import get_catalog
+    from spark_rapids_tpu.runtime.retry import PendingBatches
+
+    catalog = get_catalog()
+    base = catalog.live_handles() if hasattr(catalog, "live_handles") \
+        else None
+    t = pa.table({"x": pa.array(np.arange(8), type=pa.int64())})
+
+    p = PendingBatches()
+    p.append(catalog.add_batch(arrow_to_device(t)), 8)
+    p.checkpoint()
+    p.append(catalog.add_batch(arrow_to_device(t)), 8)
+    p.append(catalog.add_batch(arrow_to_device(t)), 8)
+    assert len(p.items) == 3 and p.rows == 24
+    p.restore()
+    assert len(p.items) == 1 and p.rows == 8
+    p.close()
+    if base is not None:
+        assert catalog.live_handles() == base
