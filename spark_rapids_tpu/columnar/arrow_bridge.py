@@ -334,8 +334,17 @@ def arrow_to_device(table, capacity: Optional[int] = None,
         cols.append(column_from_arrow(arr, field, cap, string_pad_min))
     # ONE transfer for the whole batch: batched device_put is ~6x
     # faster than per-array jnp.asarray, and hugely so on tunneled
-    # devices (make_column returns numpy-backed columns)
-    out = jax.device_put(ColumnBatch(schema, cols, n))
+    # devices (make_column returns numpy-backed columns). The staging
+    # bytes ride the pinned transfer budget (runtime/host_alloc.py,
+    # PinnedMemoryPool role). device_put dispatches asynchronously, so
+    # the scope bounds concurrent DISPATCHES, not completion — syncing
+    # here would serialize the upload pipeline the engine works hard
+    # to keep full on tunneled devices.
+    from spark_rapids_tpu.runtime import host_alloc
+
+    nbytes = sum(c.device_size_bytes() for c in cols)
+    with host_alloc.get().reserved(nbytes, pinned=True):
+        out = jax.device_put(ColumnBatch(schema, cols, n))
     out._host_rows = n  # pytree flatten devicified num_rows; keep the
     # known count so the first row_count() is not a device roundtrip
     return out
@@ -364,7 +373,11 @@ def device_to_arrow(batch: ColumnBatch) -> pa.Table:
             n)
     arrays = []
     names = []
-    host = jax.device_get(batch)
+    from spark_rapids_tpu.runtime import host_alloc
+
+    with host_alloc.get().reserved(batch.device_size_bytes(),
+                                   pinned=True):
+        host = jax.device_get(batch)
     for field, col in zip(batch.schema.fields, host.columns):
         names.append(field.name)
         validity = np.asarray(col.validity[:n])

@@ -173,6 +173,20 @@ SPILL_DIR = conf(
     "spark.rapids.memory.spillDir", "",
     "Directory for disk-tier spill files; empty = temp dir.", str,
     startup_only=True)
+PINNED_POOL_SIZE = conf(
+    "spark.rapids.memory.pinnedPool.size", 4 << 30,
+    "Bytes of the host transfer-staging pool (the PinnedMemoryPool "
+    "role): host<->device copies account here. Best-effort admission "
+    "(uploads dispatch asynchronously, so the pool bounds concurrent "
+    "dispatches); PJRT stages the actual transfer internally.", int,
+    startup_only=True)
+HOST_MEMORY_LIMIT = conf(
+    "spark.rapids.memory.host.limit", 8 << 30,
+    "Bytes of general (pageable) host working memory shared by the "
+    "spill catalog's HOST tier and shuffle blocks (HostAlloc.scala "
+    "role): allocations past the limit push spilled buffers to disk "
+    "or block briefly, then raise a retryable OOM.", int,
+    startup_only=True)
 OOM_INJECTION_MODE = conf(
     "spark.rapids.memory.gpu.oomInjection.mode", "none",
     "Fault injection for retry tests: none|once|always|split_once — "

@@ -1,0 +1,149 @@
+"""Bounded host-memory arbiter — the HostAlloc role (reference
+HostAlloc.scala:349 + PinnedMemoryPool: every sizable host allocation
+— reader decode buffers, shuffle staging, spilled device buffers —
+draws from bounded pinned/pageable pools with blocking and retry
+semantics instead of growing the heap unboundedly).
+
+TPU mapping: PJRT stages transfers internally, so "pinned" is the
+transfer-staging budget (advisory for placement, exact for
+accounting) and "pageable" is general host working memory. The spill
+catalog's HOST tier draws from the pageable pool, so spill pressure
+and transient staging share ONE global host budget the way the
+reference shares HostAlloc between spill stores and readers.
+
+Semantics (HostAlloc.scala blocking-alloc):
+- try_reserve: non-blocking.
+- reserve(nbytes, timeout): wait for concurrent releases; on timeout,
+  ask the spill catalog to push host-tier buffers to disk; if still
+  over budget raise TpuRetryOOM (the CpuRetryOOM analog) so the
+  caller's retry loop re-attempts smaller/later.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+from spark_rapids_tpu.runtime.errors import TpuRetryOOM
+
+
+class HostPool:
+    def __init__(self, limit: int, name: str,
+                 has_spill_valve: bool = False):
+        self.limit = int(limit)
+        self.name = name
+        self.used = 0
+        self._cv = threading.Condition()
+        # only the pageable pool can free bytes by pushing the spill
+        # catalog's HOST tier to disk; the pinned pool has no valve
+        self._has_spill_valve = has_spill_valve
+
+    def resize(self, limit: int) -> None:
+        """Adjust the limit in place (session re-init) — the pool
+        OBJECT is stable so outstanding reservations release against
+        the same ledger they reserved from."""
+        with self._cv:
+            self.limit = int(limit)
+            self._cv.notify_all()
+
+    def try_reserve(self, nbytes: int) -> bool:
+        with self._cv:
+            if self.used + nbytes <= self.limit:
+                self.used += nbytes
+                return True
+            return False
+
+    def reserve_force(self, nbytes: int) -> None:
+        """Unconditional reservation (may exceed the limit): used by
+        must-proceed paths (device spill relieving HBM pressure) so
+        the ledger stays truthful and later callers see the pressure
+        instead of the pool being silently bypassed."""
+        with self._cv:
+            self.used += nbytes
+
+    def reserve(self, nbytes: int, timeout: float = 10.0) -> None:
+        if nbytes > self.limit:
+            raise TpuRetryOOM(
+                f"host {self.name} pool too small: {nbytes} > "
+                f"{self.limit}")
+        deadline = None
+        with self._cv:
+            while self.used + nbytes > self.limit:
+                import time
+
+                if deadline is None:
+                    deadline = time.monotonic() + timeout
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(remaining)
+            else:
+                self.used += nbytes
+                return
+        if self._has_spill_valve:
+            # timed out: push spilled host buffers to disk, then retry
+            from spark_rapids_tpu.runtime.memory import get_catalog
+
+            get_catalog().spill_host_bytes(nbytes)
+            with self._cv:
+                if self.used + nbytes <= self.limit:
+                    self.used += nbytes
+                    return
+        raise TpuRetryOOM(
+            f"host {self.name} pool exhausted reserving {nbytes} "
+            f"(used={self.used}, limit={self.limit})")
+
+    def release(self, nbytes: int) -> None:
+        with self._cv:
+            self.used -= nbytes
+            self._cv.notify_all()
+
+
+class HostAlloc:
+    def __init__(self, pinned_limit: int, pageable_limit: int):
+        self.pinned = HostPool(pinned_limit, "pinned")
+        self.pageable = HostPool(pageable_limit, "pageable",
+                                 has_spill_valve=True)
+
+    def pool(self, pinned: bool) -> HostPool:
+        return self.pinned if pinned else self.pageable
+
+    @contextlib.contextmanager
+    def reserved(self, nbytes: int, pinned: bool = False,
+                 timeout: float = 10.0):
+        pool = self.pool(pinned)
+        # transfer staging larger than the whole pool serializes at
+        # the full budget instead of failing (the pool bounds
+        # CONCURRENCY; a single oversized transfer is legal)
+        pool.reserve(min(nbytes, pool.limit), timeout)
+        try:
+            yield
+        finally:
+            pool.release(min(nbytes, pool.limit))
+
+
+_instance: Optional[HostAlloc] = None
+_lock = threading.Lock()
+
+
+def initialize(pinned_limit: int, pageable_limit: int) -> None:
+    """Install/resize the global pools. Pool OBJECTS are stable across
+    re-initialization (sessions re-init with their confs) so
+    reservations outstanding from earlier sessions release against the
+    ledger they drew from."""
+    global _instance
+    with _lock:
+        if _instance is None:
+            _instance = HostAlloc(pinned_limit, pageable_limit)
+        else:
+            _instance.pinned.resize(pinned_limit)
+            _instance.pageable.resize(pageable_limit)
+
+
+def get() -> HostAlloc:
+    global _instance
+    with _lock:
+        if _instance is None:
+            _instance = HostAlloc(2 << 30, 8 << 30)
+        return _instance

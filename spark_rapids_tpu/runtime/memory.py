@@ -213,6 +213,9 @@ class SpillCatalog:
                 self.pool.release(sb.size_bytes)
             elif sb.tier == SpillTier.HOST:
                 self.host_used -= sb.size_bytes
+                from spark_rapids_tpu.runtime import host_alloc
+
+                host_alloc.get().pageable.release(sb.size_bytes)
 
     # --- reservation with synchronous spill ---
 
@@ -280,22 +283,52 @@ class SpillCatalog:
         return freed
 
     def _spill_one(self, b: SpillableBatch):
-        b._to_host()
+        from spark_rapids_tpu.runtime import host_alloc
+
+        pageable = host_alloc.get().pageable
+        if (self.host_used + b.size_bytes <= self.host_limit
+                and pageable.try_reserve(b.size_bytes)):
+            b._to_host()
+            self.pool.release(b.size_bytes)
+            self.host_used += b.size_bytes
+            self.metrics["spill_to_host"] += 1
+            return
+        # host tier full (own threshold or the GLOBAL host budget,
+        # runtime/host_alloc.py): go straight through to disk. The
+        # transient host copy is force-accounted — the spill MUST
+        # proceed to relieve HBM pressure, and the ledger staying
+        # truthful makes concurrent callers feel the pressure
+        pageable.reserve_force(b.size_bytes)
+        try:
+            b._to_host()
+            b._to_disk()
+        finally:
+            pageable.release(b.size_bytes)
         self.pool.release(b.size_bytes)
-        self.host_used += b.size_bytes
-        self.metrics["spill_to_host"] += 1
-        if self.host_used > self.host_limit:
-            # overflow host tier to disk, coldest first
-            host_bufs = sorted(
+        self.metrics["spill_to_disk"] += 1
+
+    def spill_host_bytes(self, target: int) -> int:
+        """Push coldest host-tier buffers to disk until `target`
+        pageable bytes are freed — HostAlloc's pressure valve
+        (HostAlloc.scala blocking-alloc spills host store likewise)."""
+        from spark_rapids_tpu.runtime import host_alloc
+
+        pageable = host_alloc.get().pageable
+        freed = 0
+        with self._lock:
+            cands = sorted(
                 (x for x in self._buffers.values()
                  if x.tier == SpillTier.HOST),
                 key=lambda x: (x._priority, -x.size_bytes))
-            for hb in host_bufs:
-                if self.host_used <= self.host_limit:
+            for hb in cands:
+                if freed >= target:
                     break
                 hb._to_disk()
                 self.host_used -= hb.size_bytes
+                pageable.release(hb.size_bytes)
                 self.metrics["spill_to_disk"] += 1
+                freed += hb.size_bytes
+        return freed
 
     def unspill(self, sb: SpillableBatch):
         with self._lock:
@@ -307,6 +340,9 @@ class SpillCatalog:
             sb._to_device()
             if was_host:
                 self.host_used -= sb.size_bytes
+                from spark_rapids_tpu.runtime import host_alloc
+
+                host_alloc.get().pageable.release(sb.size_bytes)
             self.metrics["unspill"] += 1
 
     # --- stats ---
@@ -361,6 +397,10 @@ def initialize_memory(conf=None, force: bool = False) -> SpillCatalog:
         if not limit:
             hbm = _detect_hbm_bytes()
             limit = int(hbm * conf.get(rc.MEMORY_FRACTION))
+        from spark_rapids_tpu.runtime import host_alloc
+
+        host_alloc.initialize(conf.get(rc.PINNED_POOL_SIZE),
+                              conf.get(rc.HOST_MEMORY_LIMIT))
         _catalog = SpillCatalog(
             device_limit=limit,
             host_limit=conf.get(rc.HOST_SPILL_STORAGE_SIZE),

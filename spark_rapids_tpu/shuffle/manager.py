@@ -81,11 +81,26 @@ class ShuffleManager:
             self._dir = tempfile.mkdtemp(prefix="srtpu-shuffle-")
         return self._dir
 
+    def _spill_block(self, b: _MemBlock):
+        """Under lock: move one in-memory block to a compressed disk
+        file (does not touch ledgers — callers own the accounting)."""
+        from spark_rapids_tpu.shuffle import serde
+
+        path = os.path.join(self._spill_dir(),
+                            f"shuffle-spill-{b.seq}.stpu")
+        serde.serialize_table(b.table, codec=self.codec).tofile(path)
+        # path BEFORE table: fetch() snapshots (table, path) and
+        # must never observe both unset
+        b.path = path
+        b.table = None
+        self.blocks_spilled += 1
+
     def _spill_mem_blocks(self):
         """Under lock: move coldest (oldest) in-memory blocks to
         compressed disk files until under the threshold."""
-        from spark_rapids_tpu.shuffle import serde
+        from spark_rapids_tpu.runtime import host_alloc
 
+        pageable = host_alloc.get().pageable
         victims: List[_MemBlock] = []
         for blocks in self._blocks.values():
             victims.extend(b for b in blocks if b.table is not None)
@@ -93,26 +108,38 @@ class ShuffleManager:
         for b in victims:
             if self.bytes_in_memory <= self.spill_threshold:
                 break
-            path = os.path.join(self._spill_dir(),
-                                f"shuffle-spill-{b.seq}.stpu")
-            serde.serialize_table(b.table, codec=self.codec).tofile(path)
-            # path BEFORE table: fetch() snapshots (table, path) and
-            # must never observe both unset
-            b.path = path
-            b.table = None
+            self._spill_block(b)
             self.bytes_in_memory -= b.nbytes
-            self.blocks_spilled += 1
+            pageable.release(b.nbytes)
 
     def put(self, shuffle_id: int, reduce_pid: int, table: pa.Table):
         if self.mode != "MULTITHREADED":
+            from spark_rapids_tpu.runtime import host_alloc
+
+            # in-memory shuffle blocks draw from the GLOBAL pageable
+            # host budget (runtime/host_alloc.py, HostAlloc role); when
+            # the budget is gone this block goes straight to disk
+            in_mem = host_alloc.get().pageable.try_reserve(table.nbytes)
             with self._lock:
                 self._seq += 1
                 blk = _MemBlock(table, table.nbytes, self._seq)
                 self._blocks[(shuffle_id, reduce_pid)].append(blk)
                 self.bytes_written += table.nbytes
-                self.bytes_in_memory += table.nbytes
-                if self.bytes_in_memory > self.spill_threshold:
-                    self._spill_mem_blocks()
+                if in_mem:
+                    self.bytes_in_memory += table.nbytes
+                    if self.bytes_in_memory > self.spill_threshold:
+                        self._spill_mem_blocks()
+                else:
+                    try:
+                        self._spill_block(blk)
+                    except BaseException:
+                        # drop the half-registered block: it holds no
+                        # reservation, and remove_shuffle's
+                        # table-means-reserved accounting must never
+                        # see it
+                        self._blocks[(shuffle_id, reduce_pid)].remove(
+                            blk)
+                        raise
             return
         with self._lock:
             self._seq += 1
@@ -180,12 +207,16 @@ class ShuffleManager:
         return tables
 
     def remove_shuffle(self, shuffle_id: int):
+        from spark_rapids_tpu.runtime import host_alloc
+
+        pageable = host_alloc.get().pageable
         with self._lock:
             spilled_paths = []
             for k in [k for k in self._blocks if k[0] == shuffle_id]:
                 for b in self._blocks.pop(k):
                     if b.table is not None:
                         self.bytes_in_memory -= b.nbytes
+                        pageable.release(b.nbytes)
                     elif b.path:
                         spilled_paths.append(b.path)
             futs = []

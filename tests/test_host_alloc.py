@@ -1,0 +1,105 @@
+"""Bounded host-memory arbiter (runtime/host_alloc.py — the
+HostAlloc.scala + PinnedMemoryPool role): pinned transfer staging and
+pageable working memory shared by the spill catalog's HOST tier and
+shuffle blocks, with blocking + retryable-OOM semantics."""
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.runtime.errors import TpuRetryOOM
+from spark_rapids_tpu.runtime.host_alloc import HostAlloc, HostPool
+
+
+def test_blocking_reserve_wakes_on_release():
+    pool = HostPool(100, "t")
+    assert pool.try_reserve(80)
+    woke = {"t": None}
+
+    def waiter():
+        t0 = time.monotonic()
+        pool.reserve(50, timeout=10.0)
+        woke["t"] = time.monotonic() - t0
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.2)
+    pool.release(80)
+    th.join(timeout=5)
+    assert woke["t"] is not None and woke["t"] >= 0.15
+    assert pool.used == 50
+
+
+def test_overlimit_raises_retryable():
+    pool = HostPool(100, "t")
+    with pytest.raises(TpuRetryOOM):
+        pool.reserve(101)
+
+
+def test_exhausted_raises_retryable_after_timeout():
+    pool = HostPool(100, "t")
+    assert pool.try_reserve(100)
+    with pytest.raises(TpuRetryOOM):
+        pool.reserve(10, timeout=0.1)
+    pool.release(100)
+
+
+def test_pinned_staging_scopes_are_balanced():
+    ha = HostAlloc(1 << 20, 1 << 20)
+    with ha.reserved(1000, pinned=True):
+        assert ha.pinned.used == 1000
+    assert ha.pinned.used == 0
+
+
+def test_shuffle_block_goes_to_disk_when_host_budget_gone(tmp_path):
+    """CACHE_ONLY shuffle blocks draw from the global pageable pool;
+    with no budget left, new blocks degrade straight to disk files and
+    results stay correct."""
+    from spark_rapids_tpu.runtime import host_alloc as ha_mod
+    from spark_rapids_tpu.shuffle.manager import ShuffleManager
+
+    ha_mod.initialize(1 << 20, 1 << 20)
+    pool = ha_mod.get().pageable
+    pool.reserve(1 << 20)  # exhaust the budget
+    try:
+        mgr = ShuffleManager("CACHE_ONLY", shuffle_dir=str(tmp_path))
+        t = pa.table({"x": pa.array(np.arange(100), type=pa.int64())})
+        sid = mgr.new_shuffle_id()
+        mgr.put(sid, 0, t)
+        assert mgr.bytes_in_memory == 0
+        assert mgr.blocks_spilled == 1
+        [got] = mgr.fetch(sid, 0)
+        assert got.column("x").to_pylist() == list(range(100))
+        mgr.remove_shuffle(sid)
+    finally:
+        pool.release(1 << 20)
+        ha_mod.initialize(4 << 30, 8 << 30)
+
+
+def test_catalog_spills_straight_to_disk_without_host_budget():
+    """Device spill with an exhausted pageable pool bypasses the HOST
+    tier (DEVICE -> DISK) instead of blowing the budget."""
+    from spark_rapids_tpu.columnar.arrow_bridge import arrow_to_device
+    from spark_rapids_tpu.runtime import host_alloc as ha_mod
+    from spark_rapids_tpu.runtime.memory import SpillCatalog, SpillTier
+
+    cat = SpillCatalog(device_limit=1 << 20, host_limit=1 << 20)
+    ha_mod.initialize(1 << 20, 1 << 20)
+    pool = ha_mod.get().pageable
+    pool.reserve(1 << 20)
+    try:
+        t = pa.table({"x": pa.array(np.arange(4096), type=pa.int64())})
+        sb = cat.add_batch(arrow_to_device(t))
+        cat.spill_device_bytes(sb.size_bytes)
+        assert sb.tier == SpillTier.DISK
+        assert cat.metrics["spill_to_disk"] == 1
+        assert cat.metrics["spill_to_host"] == 0
+        got = sb.get_batch()  # unspill from disk still round-trips
+        assert int(sb.row_count()) == 4096
+        sb.close()
+    finally:
+        pool.release(1 << 20)
+        ha_mod.initialize(4 << 30, 8 << 30)
