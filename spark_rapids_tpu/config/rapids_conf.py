@@ -345,14 +345,36 @@ class RapidsConf:
             if k.startswith(env_prefix):
                 settings.setdefault(k[len(env_prefix):].replace("__", "."), v)
         self._values: Dict[str, Any] = {}
+        #: Per-operator on/off switches — the reference's
+        #: spark.rapids.sql.{expression,exec}.<Name> dynamic confs
+        #: (GpuOverrides registry isIncompat/disabledMsg surface):
+        #: setting one false tags that operator NOT_ON_TPU, so it
+        #: takes the CPU path with an explain reason (tagging is
+        #: per-operator; children keep their own placement).
+        self._op_switches: Dict[tuple, bool] = {}
         unknown = []
         for key, raw in settings.items():
             entry = _REGISTRY.get(key)
-            if entry is None:
-                unknown.append(key)
-            else:
+            if entry is not None:
                 self._values[key] = entry.convert(raw)
+                continue
+            for kind in ("expression", "exec"):
+                prefix = f"spark.rapids.sql.{kind}."
+                if key.startswith(prefix) and key[len(prefix):]:
+                    # same boolean grammar as registered bool confs
+                    v = raw if isinstance(raw, bool) else \
+                        str(raw).strip().lower() in ("true", "1", "yes")
+                    self._op_switches[(kind, key[len(prefix):])] = v
+                    break
+            else:
+                unknown.append(key)
         self.unknown_keys = unknown
+
+    def expression_enabled(self, name: str) -> bool:
+        return self._op_switches.get(("expression", name), True)
+
+    def exec_enabled(self, name: str) -> bool:
+        return self._op_switches.get(("exec", name), True)
 
     def get(self, entry: ConfEntry):
         return self._values.get(entry.key, entry.default)
@@ -388,6 +410,16 @@ def ansi_enabled() -> bool:
     return bool(s and s.rapids_conf.get(ANSI_ENABLED))
 
 
+def expression_enabled(name: str) -> bool:
+    """Per-expression device switch of the active session
+    (spark.rapids.sql.expression.<Name>; reference GpuOverrides expr
+    registry disable surface)."""
+    from spark_rapids_tpu.api.session import TpuSparkSession
+
+    s = TpuSparkSession.active()
+    return s is None or s.rapids_conf.expression_enabled(name)
+
+
 def generate_docs() -> str:
     """Markdown table of all public confs (reference RapidsConf.scala:2166)."""
     lines = [
@@ -396,10 +428,22 @@ def generate_docs() -> str:
         "| Name | Default | Startup-only | Description |",
         "|---|---|---|---|",
     ]
+    dynamic_note = [
+        "",
+        "## Per-operator switches (dynamic keys)",
+        "",
+        "`spark.rapids.sql.exec.<LogicalOperator>=false` and "
+        "`spark.rapids.sql.expression.<Expression>=false` force the "
+        "named operator/expression to the CPU path "
+        "with an explain reason — the reference GpuOverrides registry "
+        "disable surface. See docs/supported_ops.md for the valid "
+        "names.",
+    ]
     for e in conf_entries():
         if e.internal:
             continue
         lines.append(
             f"| {e.key} | {e.default} | {'yes' if e.startup_only else ''} "
             f"| {e.doc} |")
+    lines.extend(dynamic_note)
     return "\n".join(lines) + "\n"

@@ -70,21 +70,28 @@ class TpuOverrides:
         meta = PlanMeta(node)
         if not self.conf.get(rc.SQL_ENABLED):
             meta.cannot_run("spark.rapids.sql.enabled is false")
+        op_name = type(node).__name__
+        if not self.conf.exec_enabled(op_name):
+            # per-exec switch (spark.rapids.sql.exec.<Name>=false —
+            # the GpuOverrides exec-registry disable surface)
+            meta.cannot_run(
+                f"{op_name} disabled via spark.rapids.sql.exec."
+                f"{op_name}=false")
         if self.conf.get(rc.CPU_ORACLE_ENABLED):
             meta.cannot_run("cpu-oracle session")
         elif isinstance(node, L.Project):
             for e in node.exprs:
-                for r in expr_unsupported_reasons(e):
+                for r in expr_unsupported_reasons(e, self.conf):
                     meta.cannot_run(r)
         elif isinstance(node, L.Filter):
-            for r in expr_unsupported_reasons(node.condition):
+            for r in expr_unsupported_reasons(node.condition, self.conf):
                 meta.cannot_run(r)
         elif isinstance(node, L.Aggregate):
             from spark_rapids_tpu.expr.aggregates import Max, Min
             from spark_rapids_tpu.sqltypes import StringType
 
             for e in node.grouping + node.aggregates:
-                for r in expr_unsupported_reasons(e):
+                for r in expr_unsupported_reasons(e, self.conf):
                     meta.cannot_run(r)
             for g in node.grouping:
                 r = key_type_supported(g.dtype)
@@ -121,32 +128,32 @@ class TpuOverrides:
                                 f"{fn.name} requires numeric input")
         elif isinstance(node, L.Join):
             for e in node.left_keys + node.right_keys:
-                for r in expr_unsupported_reasons(e):
+                for r in expr_unsupported_reasons(e, self.conf):
                     meta.cannot_run(r)
                 r = key_type_supported(e.dtype)
                 if r:
                     meta.cannot_run(r)
             if node.condition is not None:
-                for r in expr_unsupported_reasons(node.condition):
+                for r in expr_unsupported_reasons(node.condition, self.conf):
                     meta.cannot_run(r)
         elif isinstance(node, L.Sort):
             for o in node.orders:
-                for r in expr_unsupported_reasons(o.expr):
+                for r in expr_unsupported_reasons(o.expr, self.conf):
                     meta.cannot_run(r)
                 r = key_type_supported(o.expr.dtype)
                 if r:
                     meta.cannot_run(r)
         elif isinstance(node, L.Generate):
             for e in node.pass_through:
-                for r in expr_unsupported_reasons(e):
+                for r in expr_unsupported_reasons(e, self.conf):
                     meta.cannot_run(r)
             gen_input = node.gen_alias.children[0].children[0]
-            for r in expr_unsupported_reasons(gen_input):
+            for r in expr_unsupported_reasons(gen_input, self.conf):
                 meta.cannot_run(r)
         elif isinstance(node, L.Expand):
             for p in node.projections:
                 for e in p:
-                    for r in expr_unsupported_reasons(e):
+                    for r in expr_unsupported_reasons(e, self.conf):
                         meta.cannot_run(r)
         elif isinstance(node, L.Sample):
             if node.with_replacement:
@@ -193,10 +200,10 @@ class TpuOverrides:
         for a in node.window_exprs:
             wexpr = a.children[0]
             for e in wexpr.spec.partitions:
-                for r in expr_unsupported_reasons(e):
+                for r in expr_unsupported_reasons(e, self.conf):
                     meta.cannot_run(r)
             for o in wexpr.spec.orders:
-                for r in expr_unsupported_reasons(o.expr):
+                for r in expr_unsupported_reasons(o.expr, self.conf):
                     meta.cannot_run(r)
             fn = wexpr.function
             if isinstance(fn, we.WindowFunction):
@@ -204,10 +211,10 @@ class TpuOverrides:
                     meta.cannot_run(
                         f"{type(fn).__name__} requires ORDER BY")
                 if isinstance(fn, we.Lead):
-                    for r in expr_unsupported_reasons(fn.input):
+                    for r in expr_unsupported_reasons(fn.input, self.conf):
                         meta.cannot_run(r)
                     if fn.default is not None:
-                        for r in expr_unsupported_reasons(fn.default):
+                        for r in expr_unsupported_reasons(fn.default, self.conf):
                             meta.cannot_run(r)
             elif isinstance(fn, supported_aggs):
                 from spark_rapids_tpu.plan.typesig import _wide_dec as _wd
@@ -217,7 +224,7 @@ class TpuOverrides:
                         "decimal(>18) window aggregation runs on CPU "
                         "in v1")
                 if fn.input is not None:
-                    for r in expr_unsupported_reasons(fn.input):
+                    for r in expr_unsupported_reasons(fn.input, self.conf):
                         meta.cannot_run(r)
                 if (isinstance(fn, (Min, Max)) and
                         isinstance(fn.input.dtype, StringType)):

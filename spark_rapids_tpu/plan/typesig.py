@@ -114,10 +114,18 @@ def _cast_check(e: Cast) -> Optional[str]:
     return None
 
 
-def expr_unsupported_reasons(expr: Expression) -> List[str]:
+def expr_unsupported_reasons(expr: Expression,
+                             conf=None) -> List[str]:
     """Walk an expression tree; collect every reason it cannot run on
-    device. Empty list == fully supported."""
+    device. Empty list == fully supported. `conf` (the planning
+    session's RapidsConf) carries the per-expression disable switches;
+    None falls back to the active session's conf."""
     reasons: List[str] = []
+    if conf is None:
+        from spark_rapids_tpu.api.session import TpuSparkSession
+
+        s = TpuSparkSession.active()
+        conf = s.rapids_conf if s is not None else None
 
     from spark_rapids_tpu.expr.aggregates import AggregateFunction
     from spark_rapids_tpu.expr.windows import (
@@ -129,6 +137,11 @@ def expr_unsupported_reasons(expr: Expression) -> List[str]:
                           WindowExpression)
 
     def walk(e: Expression):
+        name = type(e).__name__
+        if conf is not None and not conf.expression_enabled(name):
+            reasons.append(
+                f"{name} disabled via spark.rapids.sql.expression."
+                f"{name}=false")
         r = type_supported(e.dtype)
         if r:
             reasons.append(f"{type(e).__name__}: {r}")

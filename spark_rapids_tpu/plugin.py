@@ -33,6 +33,7 @@ class TpuDriverPlugin:
     def init(self, conf: rc.RapidsConf) -> Dict[str, object]:
         unknown = getattr(conf, "unknown_keys", [])
         bad = [k for k in unknown if k.startswith("spark.rapids")]
+        bad += self._unmatched_op_switches(conf)
         if bad:
             import warnings
 
@@ -40,6 +41,39 @@ class TpuDriverPlugin:
                 f"unknown spark.rapids.* conf keys ignored: {sorted(bad)}")
         # the executor-broadcast conf map (RapidsConf.rapidsConfMap role)
         return {k: v for k, v in conf._values.items()}
+
+    @staticmethod
+    def _unmatched_op_switches(conf: rc.RapidsConf) -> list:
+        """Per-operator switch keys naming no known logical operator /
+        expression class — a typo'd switch must warn, not silently
+        no-op (the registered-key diagnostic, extended to the dynamic
+        namespace)."""
+        switches = getattr(conf, "_op_switches", {})
+        if not switches:
+            return []
+        import inspect
+
+        import spark_rapids_tpu.expr as E
+        import spark_rapids_tpu.plan.logical as L
+        from spark_rapids_tpu.expr.core import Expression
+
+        logical = {type_.__name__ for type_ in vars(L).values()
+                   if inspect.isclass(type_)
+                   and issubclass(type_, L.LogicalPlan)}
+        import spark_rapids_tpu.expr.aggregates as _A
+        import spark_rapids_tpu.expr.windows as _W
+        import spark_rapids_tpu.udf.pandas_udf as _P
+
+        exprs = {c.__name__
+                 for mod in (E, _A, _W, _P)
+                 for c in vars(mod).values()
+                 if inspect.isclass(c) and issubclass(c, Expression)}
+        bad = []
+        for (kind, name) in switches:
+            valid = logical if kind == "exec" else exprs
+            if name not in valid:
+                bad.append(f"spark.rapids.sql.{kind}.{name}")
+        return bad
 
 
 class TpuExecutorPlugin:

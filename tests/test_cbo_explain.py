@@ -107,3 +107,64 @@ def test_explain_all_device(small_big):
 
     out = with_tpu_session(q)
     assert out == "(every operator runs on device)"
+
+
+# ---------------- per-operator enable/disable switches (dynamic confs)
+
+def test_expression_disable_switch_falls_back():
+    """spark.rapids.sql.expression.<Name>=false tags the expression
+    NOT_ON_TPU; the query takes the CPU path and stays correct
+    (reference GpuOverrides expr-registry disable surface)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSparkSession
+    from spark_rapids_tpu.explain import explain_potential_tpu_plan
+
+    t = pa.table({"s": pa.array(["a", "Bc", "dE"])})
+    s = TpuSparkSession({"spark.rapids.sql.expression.Upper": False})
+    try:
+        df = s.createDataFrame(t).select(F.upper(F.col("s")).alias("u"))
+        txt = explain_potential_tpu_plan(df, "NOT_ON_TPU")
+        assert "spark.rapids.sql.expression.Upper" in txt, txt
+        assert df.collect_arrow().column("u").to_pylist() == \
+            ["A", "BC", "DE"]
+    finally:
+        s.stop()
+    # and the same query WITH the switch on runs without the reason
+    s = TpuSparkSession({})
+    try:
+        df = s.createDataFrame(t).select(F.upper(F.col("s")).alias("u"))
+        txt = explain_potential_tpu_plan(df, "NOT_ON_TPU")
+        assert "expression.Upper" not in txt
+    finally:
+        s.stop()
+
+
+def test_exec_disable_switch_falls_back():
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSparkSession
+    from spark_rapids_tpu.explain import explain_potential_tpu_plan
+
+    rng = np.random.default_rng(1)
+    t = pa.table({"k": pa.array(rng.integers(0, 5, 100),
+                                type=pa.int64()),
+                  "v": pa.array(rng.random(100))})
+    s = TpuSparkSession({"spark.rapids.sql.exec.Aggregate": "false"})
+    try:
+        df = (s.createDataFrame(t).groupBy("k")
+              .agg(F.sum("v").alias("sv")))
+        txt = explain_potential_tpu_plan(df, "NOT_ON_TPU")
+        assert "spark.rapids.sql.exec.Aggregate" in txt, txt
+        got = {r["k"]: r["sv"] for r in df.collect_arrow().to_pylist()}
+        ks = np.asarray(t.column("k"))
+        vs = np.asarray(t.column("v"))
+        for k in np.unique(ks):
+            np.testing.assert_allclose(got[int(k)], vs[ks == k].sum(),
+                                       rtol=1e-9)
+    finally:
+        s.stop()
