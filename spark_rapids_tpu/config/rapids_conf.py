@@ -187,6 +187,17 @@ HOST_MEMORY_LIMIT = conf(
     "role): allocations past the limit push spilled buffers to disk "
     "or block briefly, then raise a retryable OOM.", int,
     startup_only=True)
+OOM_DUMP_DIR = conf(
+    "spark.rapids.memory.gpu.oomDumpDir", "",
+    "When set, an unrecoverable device OOM writes a device-memory "
+    "profile plus a JSON spill-catalog snapshot here before raising "
+    "(the reference gpuOomDumpDir heap-dump policy, "
+    "RapidsConf.scala:403-414).", str)
+DEBUG_DUMP_PATH = conf(
+    "spark.rapids.sql.debug.dumpBatchesPath", "",
+    "When set, collected stage-output batches dump as parquet files "
+    "under this directory, named by root operator and partition (the "
+    "DumpUtils.dumpToParquetFile debug workflow).", str)
 OOM_INJECTION_MODE = conf(
     "spark.rapids.memory.gpu.oomInjection.mode", "none",
     "Fault injection for retry tests: none|once|always|split_once — "

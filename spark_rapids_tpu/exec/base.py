@@ -61,6 +61,30 @@ class PhysicalPlan:
     def execute_partition(self, pid: int, ctx: TaskContext) -> Iterator:
         raise NotImplementedError
 
+    def _maybe_dump(self, table: pa.Table, pid: int) -> None:
+        """Debug batch dump (DumpUtils.dumpToParquetFile role): when
+        spark.rapids.sql.debug.dumpBatchesPath is set, every operator
+        output partition lands as a parquet file for offline repro."""
+        from spark_rapids_tpu.config import rapids_conf as rc
+
+        path = self.conf.get(rc.DEBUG_DUMP_PATH) if self.conf else ""
+        if not path:
+            return
+        import os
+
+        import pyarrow.parquet as pq
+
+        try:
+            os.makedirs(path, exist_ok=True)
+            name = f"{type(self).__name__}-p{pid}-{next(_task_counter)}"
+            pq.write_table(table, os.path.join(path, name + ".parquet"))
+        except Exception as e:
+            import logging
+
+            # a debug-only dump must never fail the query
+            logging.getLogger(__name__).warning(
+                "batch dump to %s failed: %s", path, e)
+
     # --- driver-side actions ---
 
     def collect(self) -> pa.Table:
@@ -71,13 +95,19 @@ class PhysicalPlan:
         tables: List[Optional[pa.Table]] = [None] * self.num_partitions
 
         def run(pid: int):
-            from spark_rapids_tpu.runtime.profiler import annotate
+            from spark_rapids_tpu.runtime.profiler import (
+                annotate_with_metric,
+            )
 
             task_id = next(_task_counter)
             ctx = TaskContext(task_id, self.conf)
             parts = []
             try:
-                with annotate(f"{type(self).__name__}.p{pid}"):
+                # one scope = timeline range + the task-time metric
+                # (the NvtxWithMetrics coupling)
+                with annotate_with_metric(
+                        f"{type(self).__name__}.p{pid}",
+                        self.metrics[M.TASK_TIME]):
                     for payload in self.execute_partition(pid, ctx):
                         if isinstance(payload, ColumnBatch):
                             parts.append(device_to_arrow(payload))
@@ -95,6 +125,7 @@ class PhysicalPlan:
                 sem.get().release_if_necessary(task_id)
             if parts:
                 tables[pid] = pa.concat_tables(parts, promote_options="none")
+                self._maybe_dump(tables[pid], pid)
 
         n = self.num_partitions
         if n == 1:

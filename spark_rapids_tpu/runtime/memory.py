@@ -99,16 +99,23 @@ class SpillableBatch:
 
     def _to_host(self):
         assert self._tier == SpillTier.DEVICE
+        from spark_rapids_tpu.runtime.profiler import annotate
+
         leaves, treedef = jax.tree_util.tree_flatten(self._device_batch)
-        self._host_data = [np.asarray(jax.device_get(x)) for x in leaves]
+        with annotate(f"spill:D2H:{self.size_bytes}"):
+            self._host_data = [np.asarray(jax.device_get(x))
+                               for x in leaves]
         self._treedef = treedef
         self._device_batch = None
         self._tier = SpillTier.HOST
 
     def _to_disk(self):
         assert self._tier == SpillTier.HOST
+        from spark_rapids_tpu.runtime.profiler import annotate
+
         path = os.path.join(self._catalog.spill_dir, f"spill-{self.id}.npz")
-        np.savez(path, *self._host_data)
+        with annotate(f"spill:HOST2DISK:{self.size_bytes}"):
+            np.savez(path, *self._host_data)
         self._disk_path = path
         self._host_data = None
         self._tier = SpillTier.DISK
@@ -125,7 +132,10 @@ class SpillableBatch:
         if self._tier == SpillTier.DISK:
             self._host_from_disk()
         if self._tier == SpillTier.HOST:
-            leaves = [jax.device_put(x) for x in self._host_data]
+            from spark_rapids_tpu.runtime.profiler import annotate
+
+            with annotate(f"unspill:H2D:{self.size_bytes}"):
+                leaves = [jax.device_put(x) for x in self._host_data]
             self._device_batch = jax.tree_util.tree_unflatten(
                 self._treedef, leaves)
             self._host_data = None
@@ -178,7 +188,8 @@ class SpillCatalog:
     def __init__(self, device_limit: int, host_limit: int,
                  spill_dir: Optional[str] = None,
                  oom_injection_mode: str = "none",
-                 oom_injection_filter: str = ""):
+                 oom_injection_filter: str = "",
+                 oom_dump_dir: str = ""):
         self.pool = DeviceMemoryPool(device_limit)
         self.host_limit = host_limit
         self.host_used = 0
@@ -187,6 +198,7 @@ class SpillCatalog:
         self._lock = threading.RLock()
         self._oom_mode = oom_injection_mode
         self._oom_filter = oom_injection_filter
+        self._oom_dump_dir = oom_dump_dir
         self._oom_armed = oom_injection_mode in ("once", "always",
                                                  "split_once")
         self.metrics = {
@@ -246,6 +258,10 @@ class SpillCatalog:
             raise TpuRetryOOM(
                 f"device pool exhausted reserving {nbytes} (tag={tag}); "
                 f"spilled {freed} bytes, retry")
+        # recoverable by design: with_retry splits the input and
+        # re-attempts. Dumps happen only at TERMINAL failure sites
+        # (runtime/retry.py dump_terminal_oom) so the split-retry hot
+        # path stays free of file I/O under the catalog lock.
         raise TpuSplitAndRetryOOM(
             f"device pool cannot fit {nbytes} (tag={tag}, "
             f"limit={self.pool.limit}, reserved={self.pool.reserved}); "
@@ -407,6 +423,7 @@ def initialize_memory(conf=None, force: bool = False) -> SpillCatalog:
             spill_dir=conf.get(rc.SPILL_DIR) or None,
             oom_injection_mode=conf.get(rc.OOM_INJECTION_MODE),
             oom_injection_filter=conf.get(rc.TEST_RETRY_OOM_INJECTION_FILTER),
+            oom_dump_dir=conf.get(rc.OOM_DUMP_DIR),
         )
         return _catalog
 

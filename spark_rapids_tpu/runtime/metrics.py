@@ -31,6 +31,7 @@ AGG_TIME = "aggTime"
 FILTER_TIME = "filterTime"
 PARTITION_TIME = "partitionTime"
 WINDOW_TIME = "windowTime"
+TASK_TIME = "taskTime"
 
 
 class TpuMetric:

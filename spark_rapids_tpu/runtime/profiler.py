@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import contextlib
 import threading
+from typing import Optional
 
 _active = False
 _lock = threading.Lock()
@@ -51,3 +52,69 @@ def annotate(name: str):
 
     with jax.profiler.TraceAnnotation(name):
         yield
+
+
+@contextlib.contextmanager
+def annotate_with_metric(name: str, metric):
+    """Named range COUPLED with a nanosecond metric — the exact
+    NvtxWithMetrics contract (one scope, both the timeline range and
+    the operator metric accumulate)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name), metric.ns():
+        yield
+
+
+def save_device_memory_profile(path: str) -> Optional[str]:
+    """Write a pprof-format device memory profile (the OOM-dump role,
+    reference RapidsConf.scala:403-414 gpuOomDumpDir + heap dumps).
+    Returns the path, or None when the backend has no profile."""
+    import jax
+
+    try:
+        jax.profiler.save_device_memory_profile(path)
+        return path
+    except Exception:
+        return None
+
+
+def dump_oom_state(dump_dir: str, reason: str,
+                   catalog=None) -> Optional[str]:
+    """On an unrecoverable device OOM: device memory profile + a JSON
+    snapshot of the RAISING spill catalog (per-tier buffer
+    sizes/priorities) so the failure is diagnosable after the fact."""
+    import json
+    import os
+    import time
+
+    try:
+        os.makedirs(dump_dir, exist_ok=True)
+        import uuid
+
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        # uuid keeps same-second dumps (split storms, threads) distinct
+        base = os.path.join(dump_dir,
+                            f"oom-{stamp}-{uuid.uuid4().hex[:8]}")
+        if catalog is None:
+            from spark_rapids_tpu.runtime.memory import get_catalog
+
+            catalog = get_catalog()
+        cat = catalog
+        with cat._lock:
+            bufs = [{"tier": b.tier.name, "bytes": b.size_bytes,
+                     "priority": b._priority}
+                    for b in cat._buffers.values()]
+        state = {
+            "reason": reason,
+            "device_limit": cat.pool.limit,
+            "device_reserved": cat.pool.reserved,
+            "host_used": cat.host_used,
+            "buffers": bufs,
+            "metrics": dict(cat.metrics),
+        }
+        with open(base + ".json", "w") as f:
+            json.dump(state, f, indent=2)
+        save_device_memory_profile(base + ".prof")
+        return base + ".json"
+    except Exception:
+        return None

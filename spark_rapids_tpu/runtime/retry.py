@@ -92,6 +92,8 @@ def with_retry(
                 splits += 1
                 if splits > split_limit:
                     sb.close()
+                    dump_terminal_oom(
+                        f"split limit {split_limit} exceeded")
                     raise TpuOOMError(
                         f"split limit {split_limit} exceeded")
                 pieces = split_policy(sb)
@@ -108,6 +110,22 @@ def with_retry_no_split(sb: SpillableBatch, fn: Callable[[SpillableBatch], T]
     """withRetryNoSplit: retries on TpuRetryOOM, propagates split OOMs."""
     out = next(with_retry([sb], fn, split_policy=None))
     return out
+
+
+def dump_terminal_oom(reason: str) -> None:
+    """Post-mortem dump at a TERMINAL OOM (retry/split budget
+    exhausted): when spark.rapids.memory.gpu.oomDumpDir is set, write
+    the memory-state snapshot (runtime/profiler.py). Recoverable
+    retry-class OOMs never dump — they are normal execution events."""
+    from spark_rapids_tpu.api.session import TpuSparkSession
+    from spark_rapids_tpu.config import rapids_conf as rc
+
+    s = TpuSparkSession.active()
+    dump_dir = s.rapids_conf.get(rc.OOM_DUMP_DIR) if s else ""
+    if dump_dir:
+        from spark_rapids_tpu.runtime import profiler
+
+        profiler.dump_oom_state(dump_dir, reason)
 
 
 class Retryable:
@@ -198,7 +216,9 @@ def retry_on_oom(fn: Callable[[], T], max_attempts: int = 8) -> T:
     while True:
         try:
             return fn()
-        except TpuRetryOOM:
+        except TpuRetryOOM as e:
             attempts += 1
             if attempts >= max_attempts:
+                dump_terminal_oom(
+                    f"retry budget exhausted after {attempts}: {e}")
                 raise

@@ -81,3 +81,57 @@ def test_profiler_trace_produces_output(spark, tmp_path):
     for root, _dirs, files in os.walk(d):
         found.extend(files)
     assert found, "profiler session produced no trace files"
+
+
+# ----------------- observability: OOM dumps + debug batch dumps (5.5)
+
+def test_oom_dump_writes_state_at_terminal_failure(tmp_path):
+    """A TERMINAL OOM (retry budget exhausted) writes a JSON
+    spill-catalog snapshot to the configured dump dir — the reference
+    gpuOomDumpDir post-mortem policy. Recoverable retry-class OOMs do
+    NOT dump (they are normal execution events)."""
+    import json
+
+    from spark_rapids_tpu.runtime.errors import TpuRetryOOM
+    from spark_rapids_tpu.runtime.retry import retry_on_oom
+
+    s = TpuSparkSession({
+        "spark.rapids.memory.gpu.oomDumpDir": str(tmp_path)})
+    try:
+        calls = {"n": 0}
+
+        def always_oom():
+            calls["n"] += 1
+            raise TpuRetryOOM("forced")
+
+        with pytest.raises(TpuRetryOOM):
+            retry_on_oom(always_oom, max_attempts=3)
+        assert calls["n"] == 3
+        dumps = [p for p in tmp_path.iterdir() if p.suffix == ".json"]
+        assert len(dumps) == 1, "exactly one dump at the TERMINAL OOM"
+        state = json.loads(dumps[0].read_text())
+        assert "retry budget exhausted" in state["reason"]
+        assert "buffers" in state and "device_limit" in state
+    finally:
+        s.stop()
+
+
+def test_debug_batch_dump(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    s = TpuSparkSession({
+        "spark.rapids.sql.debug.dumpBatchesPath": str(tmp_path / "dumps"),
+        "spark.rapids.sql.fusedExec.enabled": False,
+        "spark.sql.shuffle.partitions": 2})
+    try:
+        t = pa.table({"x": pa.array(np.arange(100), type=pa.int64())})
+        out = (s.createDataFrame(t)
+               .filter(F.col("x") >= 50).collect_arrow())
+        assert out.num_rows == 50
+        files = list((tmp_path / "dumps").glob("*.parquet"))
+        assert files, "no batch dumps written"
+        # the dumped operator outputs are real, readable batches
+        assert any(pq.read_table(f).num_rows for f in files)
+    finally:
+        s.stop()
