@@ -299,6 +299,13 @@ MULTIHOST_PROCESS_ID = conf(
     "spark.rapids.tpu.multihost.processId", -1,
     "This process's id for multihost.coordinator (-1 = auto-detect "
     "from the TPU pod metadata).", int, startup_only=True)
+COALESCE_AFTER_SCAN = conf(
+    "spark.rapids.sql.coalesceBatches.enabled", True,
+    "Concatenate small device batches toward batchSizeRows after "
+    "chunked scans and repartition exchanges before per-batch "
+    "consumers (the GpuCoalesceBatches / GpuShuffleCoalesceExec "
+    "goal-lattice role) — many tiny batches pay per-dispatch "
+    "roundtrips on tunneled devices.", bool)
 FUSED_EXEC = conf(
     "spark.rapids.sql.fusedExec.enabled", True,
     "Compile whole query stages into a few fused XLA programs for "

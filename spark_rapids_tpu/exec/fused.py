@@ -339,6 +339,7 @@ class FusedSingleChipExecutor:
             ops.TpuProjectExec, ops.TpuFilterExec, ops.TpuExpandExec,
             ops.TpuGenerateExec, ops.TpuLocalLimitExec, ops.UnionExec,
             ops.TpuSortExec, ops.TpuWindowExec,
+            ops.TpuCoalesceBatchesExec,
             ops.TpuShuffleExchangeExec,
             J.TpuShuffledHashJoinExec, J.TpuBroadcastHashJoinExec))
         if isinstance(node, ops.TpuHashAggregateExec):
@@ -352,8 +353,11 @@ class FusedSingleChipExecutor:
     # --- plan walking / program construction ---
 
     def _is_per_partition(self, node: PhysicalPlan) -> bool:
+        # coalesce is identity here: fused stages already run on
+        # whole-partition batches
         if isinstance(node, (ops.TpuProjectExec, ops.TpuFilterExec,
-                             ops.TpuExpandExec, ops.TpuGenerateExec)):
+                             ops.TpuExpandExec, ops.TpuGenerateExec,
+                             ops.TpuCoalesceBatchesExec)):
             return True
         return (isinstance(node, ops.TpuHashAggregateExec)
                 and node.mode == "partial")
@@ -414,6 +418,8 @@ class FusedSingleChipExecutor:
                     b = concat_traced(
                         [nd._run(b, i)
                          for i in range(len(nd.projections))])
+                elif isinstance(nd, ops.TpuCoalesceBatchesExec):
+                    pass  # identity: the stage input is one batch
                 elif isinstance(nd, ops.TpuGenerateExec):
                     b, mask = materialized(b, mask), None
                     out_cap = next_capacity(expansion * b.capacity)

@@ -1794,6 +1794,47 @@ class CpuSortExec(PhysicalPlan):
 
 # ------------------------------------------------------------ limit/union
 
+class TpuCoalesceBatchesExec(PhysicalPlan):
+    """Concatenate small device batches toward a goal before the
+    consumer — the GpuCoalesceBatches role (TargetSize goal of the
+    lattice, GpuCoalesceBatches.scala:170-226). Sized by CAPACITY (no
+    device sync per batch); a lone batch passes through untouched.
+
+    The eager engine inserts this after chunked scans and
+    repartition exchanges, where many small batches would otherwise
+    pay per-batch dispatch on the tunneled link; the fused and mesh
+    engines treat it as identity (their stages already operate on
+    whole-partition data)."""
+
+    def __init__(self, child, conf, target_rows: Optional[int] = None):
+        super().__init__([child], child.schema, conf)
+        from spark_rapids_tpu.config import rapids_conf as rc
+
+        self.target_rows = target_rows or (
+            conf.get(rc.BATCH_SIZE_ROWS) if conf else 1 << 20)
+
+    def _flush(self, pending):
+        if len(pending) == 1:
+            return pending[0]
+        with self.metrics[M.OP_TIME].ns():
+            return concat_batches(pending)
+
+    def execute_partition(self, pid, ctx):
+        pending: List[ColumnBatch] = []
+        rows = 0
+        for b in self.children[0].execute_partition(pid, ctx):
+            pending.append(b)
+            rows += b.capacity
+            if rows >= self.target_rows:
+                yield self._flush(pending)
+                pending, rows = [], 0
+        if pending:
+            yield self._flush(pending)
+
+    def _node_string(self):
+        return f"TpuCoalesceBatchesExec[TargetRows({self.target_rows})]"
+
+
 class TpuLocalLimitExec(PhysicalPlan):
     def __init__(self, n, child, conf):
         super().__init__([child], child.schema, conf)

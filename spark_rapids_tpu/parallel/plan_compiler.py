@@ -244,6 +244,7 @@ _SUPPORTED = (ops.TpuProjectExec, ops.TpuFilterExec,
               ops.TpuHashAggregateExec, ops.TpuShuffleExchangeExec,
               ops.TpuSortExec, ops.TpuLocalLimitExec, ops.UnionExec,
               ops.TpuWindowExec, ops.TpuGenerateExec,
+              ops.TpuCoalesceBatchesExec,
               J.TpuShuffledHashJoinExec, J.TpuBroadcastHashJoinExec)
 
 
@@ -545,6 +546,9 @@ class MeshQueryExecutor:
             def emit(node: PhysicalPlan) -> ColumnBatch:
                 if id(node) in src_index:
                     return shards[src_index[id(node)]]
+                if isinstance(node, ops.TpuCoalesceBatchesExec):
+                    # identity: each shard already holds one batch
+                    return emit(node.children[0])
                 if isinstance(node, ops.TpuProjectExec):
                     return node._run(emit(node.children[0]))
                 if isinstance(node, ops.TpuFilterExec):

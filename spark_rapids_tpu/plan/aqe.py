@@ -281,7 +281,8 @@ class AdaptiveQueryExecutor:
         child = node.children[0]
         cur = child
         while isinstance(cur, (ops.TpuShuffleExchangeExec,
-                               ops.TpuFilterExec)):
+                               ops.TpuFilterExec,
+                               ops.TpuCoalesceBatchesExec)):
             cur = cur.children[0]
         if not (isinstance(cur, ops.TpuFileScanExec)
                 and getattr(cur, "_part_spec", None)):

@@ -31,6 +31,7 @@ _SAFE_INTERIOR = (
     ops.TpuSortExec, ops.TpuLocalLimitExec, ops.UnionExec,
     ops.TpuWindowExec, ops.TpuGenerateExec, ops.TpuExpandExec,
     ops.TpuSampleExec, ops.TpuShuffleExchangeExec, ops.ArrowToDeviceExec,
+    ops.TpuCoalesceBatchesExec,
     J.TpuShuffledHashJoinExec, J.TpuBroadcastHashJoinExec,
 )
 

@@ -316,10 +316,17 @@ class TpuOverrides:
             cols = node.schema.names
             filters = getattr(node, "pushed_filters", None)
             if on_device:
-                return ops.TpuFileScanExec(node.fmt, node.paths, node.schema,
-                                           conf, pushed_columns=cols,
+                scan = ops.TpuFileScanExec(node.fmt, node.paths,
+                                           node.schema, conf,
+                                           pushed_columns=cols,
                                            pushed_filters=filters,
                                            options=node.options)
+                if conf.get(rc.COALESCE_AFTER_SCAN):
+                    # chunked scans feed many small batches; coalesce
+                    # toward batchSizeRows before per-batch consumers
+                    # (GpuCoalesceBatches after-scan insertion)
+                    return ops.TpuCoalesceBatchesExec(scan, conf)
+                return scan
             return ops.CpuFileScanExec(node.fmt, node.paths, node.schema,
                                        conf, pushed_columns=cols,
                                        pushed_filters=filters,
@@ -408,8 +415,13 @@ class TpuOverrides:
             child = children[0]
             keys = node.keys
             if on_device and (child.is_tpu or keys is not None):
+                # no coalesce wrap: the exchange's reduce side already
+                # re-slices fetched blocks at batchSizeRows (the
+                # GpuShuffleCoalesceExec discipline), and downstream
+                # isinstance-based exchange bypasses must keep matching
                 return ops.TpuShuffleExchangeExec(
-                    self._to_device(child), keys, node.num_partitions, conf)
+                    self._to_device(child), keys, node.num_partitions,
+                    conf)
             return ops.CpuShuffleExchangeExec(self._to_host(child), keys,
                                               node.num_partitions, conf)
         raise NotImplementedError(f"logical node {type(node).__name__}")
