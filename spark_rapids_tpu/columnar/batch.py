@@ -129,7 +129,8 @@ class DeviceColumn:
                             self.elem_validity, self.map_values)
 
     def gather(self, indices) -> "DeviceColumn":
-        """Row gather; indices must be in [0, capacity)."""
+        """Row gather; indices must be in [0, capacity). Gathered values
+        are a subset, so the static vrange bound survives."""
         return DeviceColumn(
             self.dtype,
             jnp.take(self.data, indices, axis=0),
@@ -140,6 +141,7 @@ class DeviceColumn:
                 self.elem_validity, indices, axis=0),
             None if self.map_values is None else jnp.take(
                 self.map_values, indices, axis=0),
+            vrange=self.vrange,
         )
 
     def _tree_flatten(self):

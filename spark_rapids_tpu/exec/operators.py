@@ -730,7 +730,13 @@ class TpuHashAggregateExec(PhysicalPlan):
 
         from spark_rapids_tpu.runtime.jit_cache import detached
 
-        base_key = ("agg", mode, aliases_key(grouping), aliases_key(aggs))
+        from spark_rapids_tpu.config import rapids_conf as rc
+
+        # baked at plan time: `detached` strips conf from the cached
+        # bound methods, so trace-time conf reads would always see None
+        self._mm_ok = conf is None or conf.get(rc.AGG_MATMUL_ENABLED)
+        base_key = ("agg", mode, self._mm_ok, aliases_key(grouping),
+                    aliases_key(aggs))
         det = detached(self)
         if any(not a.children[0].jittable for a in aggs):
             # collect_list/percentile family: update/merge output widths
@@ -761,7 +767,8 @@ class TpuHashAggregateExec(PhysicalPlan):
         """Static per-key (lo, hi) value bounds when EVERY group key is
         an integer column carrying upload-time vrange metadata and the
         total bin count fits the capacity — enables the sort-free
-        direct-binned grouping (segmented.binned_group_by)."""
+        bin-space partial aggregation (`_partial_binned`, with MXU
+        matmul reductions on TPU via segmented.binned_bins)."""
         if nkeys == 0:
             return None
         ranges, total = [], 1
@@ -799,29 +806,88 @@ class TpuHashAggregateExec(PhysicalPlan):
             # batch so capacity/live-mask come from the real data (a
             # zero-column batch reports the minimum capacity bucket)
             work = ColumnBatch(batch.schema, batch.columns, batch.num_rows)
-        from contextlib import nullcontext
-
         ranges = self._bin_ranges(work, nkeys)
-        if ranges is not None:
-            g, occupied = segmented.binned_group_by(
-                work, list(range(nkeys)), ranges, live)
-            seg_mode = segmented.unsorted_gids()
-        else:
-            g = self._grouped(work, list(range(nkeys)), live)
-            occupied = None
-            seg_mode = nullcontext()
+        if ranges is not None and all(
+                a.children[0].binned_safe for a in self.aggs):
+            return self._partial_binned(work, ranges, input_groups, live)
+        g = self._grouped(work, list(range(nkeys)), live)
         cap = work.capacity
         out_cols: List[DeviceColumn] = []
-        with seg_mode:
-            # group key columns: first row of each segment
-            for ki in range(nkeys):
-                col = g.sorted_batch.columns[ki]
-                safe = jnp.clip(g.first_pos, 0, cap - 1)
+        # group key columns: first row of each segment
+        for ki in range(nkeys):
+            col = g.sorted_batch.columns[ki]
+            safe = jnp.clip(g.first_pos, 0, cap - 1)
+            out_cols.append(DeviceColumn(
+                col.dtype, jnp.take(col.data, safe, axis=0),
+                jnp.take(col.validity, safe),
+                None if col.lengths is None
+                else jnp.take(col.lengths, safe)))
+        ci = nkeys
+        for a, grp in zip(self.aggs, input_groups):
+            fn: AggregateFunction = a.children[0]
+            k = len(grp)
+            if k == 0:
+                vals = None
+            elif k == 1:
+                vals = g.sorted_batch.columns[ci]
+            else:
+                vals = [g.sorted_batch.columns[ci + j] for j in range(k)]
+            ci += k
+            out_cols.extend(fn.update(vals, g.live, g.gid, cap))
+        return ColumnBatch(_buffer_schema(self.grouping, self.aggs),
+                           out_cols, g.num_groups)
+
+    def _partial_binned(self, work: ColumnBatch, ranges, input_groups,
+                        live) -> ColumnBatch:
+        """Sort-free partial aggregation entirely in BIN space.
+
+        Row work is one elementwise pass (bin id per row) plus the
+        segmented reductions; everything group-shaped lives at the
+        static bin-count capacity, NOT the row capacity — group keys
+        are decoded analytically from the bin index (inverting
+        bin = sum((value - lo + 1) * stride)), so no giant first-pos
+        scatter/gather over the row space exists at all. On TPU the
+        reductions ride the MXU (segmented.binned_bins); elsewhere they
+        stay scatter-adds over the small bin space."""
+        from spark_rapids_tpu.columnar.batch import next_capacity
+
+        nkeys = len(self.grouping)
+        cap = work.capacity
+        if live is None:
+            live = work.live_mask()
+        gid64 = jnp.zeros((cap,), jnp.int64)
+        stride = 1
+        for i, (lo, hi) in enumerate(ranges):
+            c = work.columns[i]
+            code = jnp.where(c.validity,
+                             c.data.astype(jnp.int64) - lo + 1, 0)
+            gid64 = gid64 + code * stride
+            stride *= hi - lo + 2
+        from contextlib import nullcontext
+
+        bcap = next_capacity(stride)
+        gid = jnp.clip(gid64, 0, bcap - 1).astype(jnp.int32)
+        mm_ok = self._mm_ok
+
+        with segmented.unsorted_gids(), (
+                segmented.binned_bins(stride) if mm_ok else nullcontext()):
+            counts = segmented.seg_count(live, gid, bcap)
+            occupied = counts > 0
+            num_groups = jnp.sum(occupied).astype(jnp.int32)
+            out_cols: List[DeviceColumn] = []
+            # analytic key decode: bin index -> key values, in bin space
+            idx = jnp.arange(bcap, dtype=jnp.int64)
+            stride_i = 1
+            for ki, (lo, hi) in enumerate(ranges):
+                base = hi - lo + 2
+                code = (idx // stride_i) % base
+                stride_i *= base
+                col = work.columns[ki]
+                # lo-1 is the null bin's decoded placeholder, so the
+                # stamped bound includes it
                 out_cols.append(DeviceColumn(
-                    col.dtype, jnp.take(col.data, safe, axis=0),
-                    jnp.take(col.validity, safe),
-                    None if col.lengths is None
-                    else jnp.take(col.lengths, safe)))
+                    col.dtype, (code - 1 + lo).astype(col.data.dtype),
+                    code > 0, vrange=(lo - 1, hi)))
             ci = nkeys
             for a, grp in zip(self.aggs, input_groups):
                 fn: AggregateFunction = a.children[0]
@@ -829,18 +895,17 @@ class TpuHashAggregateExec(PhysicalPlan):
                 if k == 0:
                     vals = None
                 elif k == 1:
-                    vals = g.sorted_batch.columns[ci]
+                    vals = work.columns[ci]
                 else:
-                    vals = [g.sorted_batch.columns[ci + j] for j in range(k)]
+                    vals = [work.columns[ci + j] for j in range(k)]
                 ci += k
-                out_cols.extend(fn.update(vals, g.live, g.gid, cap))
-        if occupied is not None:
-            # bins -> dense group positions (front-compacted like the
-            # sorted path's segment-id outputs)
-            perm = segmented.dense_bin_perm(occupied, cap)
-            out_cols = [c.gather(perm) for c in out_cols]
+                out_cols.extend(fn.update(vals, live, gid, bcap))
+        # bins -> dense group positions (front-compacted like the
+        # sorted path's segment-id outputs)
+        perm = segmented.dense_bin_perm(occupied, bcap)
+        out_cols = [c.gather(perm) for c in out_cols]
         return ColumnBatch(_buffer_schema(self.grouping, self.aggs),
-                           out_cols, g.num_groups)
+                           out_cols, num_groups)
 
     def _merge_keys_prefix(self, g, nkeys: int, cap: int
                            ) -> List[DeviceColumn]:

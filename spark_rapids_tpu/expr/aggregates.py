@@ -43,6 +43,10 @@ class AggregateFunction(Expression):
 
     name: str = "agg"
     jittable: bool = True
+    #: False for functions whose update/merge require CONTIGUOUS sorted
+    #: segments (the collect family's rank computation); the aggregate
+    #: exec then keeps the sorted grouping even when keys are binnable.
+    binned_safe: bool = True
 
     @property
     def input(self):
@@ -94,17 +98,26 @@ class Sum(AggregateFunction):
 
         out_t = self.dtype
         valid = values.validity & live
-        cnt = segmented.seg_count(valid, gid, cap)
-        ones = jnp.ones(cnt.shape, bool)
         if d128.is_wide(out_t):
+            cnt = segmented.seg_count(valid, gid, cap)
             hi, lo = d128.widen_column(values)
             sh, sl = d128.seg_sum128(hi, lo, valid, gid, cap)
             return [DeviceColumn(out_t, d128.join(sh, sl), cnt > 0),
-                    DeviceColumn(long, cnt, ones)]
+                    DeviceColumn(long, cnt, jnp.ones(cnt.shape, bool))]
+        vb = getattr(values, "vrange", None)
+        if (vb is None and values.data.ndim == 1
+                and jnp.issubdtype(values.data.dtype, jnp.integer)
+                and values.data.dtype.itemsize == 1):
+            # 8-bit columns without vrange: the width is a tight enough
+            # bound for exact f32 chunks (16-bit widths force the chunk
+            # below _mm_sum_plan's floor, so computing them is wasted);
+            # taken BEFORE the cast to the i64 sum dtype
+            info = jnp.iinfo(values.data.dtype)
+            vb = (int(info.min), int(info.max))
         data = values.data.astype(out_t.np_dtype)
-        s = segmented.seg_sum(data, valid, gid, cap)
+        s, cnt = segmented.seg_sum_count(data, valid, gid, cap, vbound=vb)
         return [DeviceColumn(out_t, s, cnt > 0),
-                DeviceColumn(long, cnt, ones)]
+                DeviceColumn(long, cnt, jnp.ones(cnt.shape, bool))]
 
     def merge(self, buffers, live, gid, cap):
         from spark_rapids_tpu.ops import decimal128 as d128
@@ -403,16 +416,13 @@ class _Moments(AggregateFunction):
     def update(self, values, live, gid, cap):
         valid = values.validity & live
         x = values.data.astype(jnp.float64)
-        cnt = segmented.seg_count(valid, gid, cap)
+        powers = [x]
+        for _ in range(self.n_powers - 1):
+            powers.append(powers[-1] * x)
+        cnt, sums = segmented.seg_multi_sum(powers, valid, gid, cap)
         ones = jnp.ones(cnt.shape, bool)
-        out = [DeviceColumn(long, cnt, ones)]
-        p = x
-        for k in range(self.n_powers):
-            if k:
-                p = p * x
-            s = segmented.seg_sum(p, valid, gid, cap)
-            out.append(DeviceColumn(double, s, cnt > 0))
-        return out
+        return ([DeviceColumn(long, cnt, ones)]
+                + [DeviceColumn(double, s, cnt > 0) for s in sums])
 
     def merge(self, buffers, live, gid, cap):
         cnt = segmented.seg_sum(buffers[0].data, live, gid, cap)
@@ -534,16 +544,13 @@ class _Bivariate(AggregateFunction):
         valid = xc.validity & yc.validity & live
         x = xc.data.astype(jnp.float64)
         y = yc.data.astype(jnp.float64)
-        cnt = segmented.seg_count(valid, gid, cap)
-        ones = jnp.ones(cnt.shape, bool)
-        sums = [x, y, x * y]
+        vecs = [x, y, x * y]
         if self.extra_squares:
-            sums += [x * x, y * y]
-        out = [DeviceColumn(long, cnt, ones)]
-        for s in sums:
-            out.append(DeviceColumn(
-                double, segmented.seg_sum(s, valid, gid, cap), cnt > 0))
-        return out
+            vecs += [x * x, y * y]
+        cnt, sums = segmented.seg_multi_sum(vecs, valid, gid, cap)
+        ones = jnp.ones(cnt.shape, bool)
+        return ([DeviceColumn(long, cnt, ones)]
+                + [DeviceColumn(double, s, cnt > 0) for s in sums])
 
     def merge(self, buffers, live, gid, cap):
         cnt = segmented.seg_sum(buffers[0].data, live, gid, cap)
@@ -681,6 +688,7 @@ def _seg_exclusive_ranks(valid, gid, cap):
 class CollectList(AggregateFunction):
     name = "collect_list"
     jittable = False
+    binned_safe = False  # _seg_exclusive_ranks needs sorted gids
 
     #: Traced-mode (mesh SPMD) sizing: when set, the element matrix is
     #: this static width instead of the eager largest-group host sync;
@@ -816,6 +824,7 @@ class CountDistinct(AggregateFunction):
 
     name = "count_distinct"
     jittable = False
+    binned_safe = False  # delegates to the collect-set buffer
 
     def __init__(self, child: Expression):
         super().__init__([child])
@@ -902,6 +911,7 @@ class Percentile(AggregateFunction):
 
     name = "percentile"
     jittable = False
+    binned_safe = False  # collect-list buffers (sorted-gid ranks)
 
     def __init__(self, child: Expression, percentage: float,
                  accuracy: int = 10000):
@@ -954,6 +964,9 @@ class ApproxPercentile(Percentile):
     t-digest role (reference GpuApproximatePercentile.scala + JNI
     t-digest), re-designed for XLA's static shapes.
 
+    binned_safe again (unlike the exact path): update/merge sort by
+    gid themselves, so unsorted binned gids are fine.
+
     The sketch is K equally-spaced quantile points + a count per group
     (K derives from `accuracy`, capped so the buffer stays K+1 device
     columns regardless of group size — unlike the exact path's
@@ -974,6 +987,7 @@ class ApproxPercentile(Percentile):
 
     name = "approx_percentile"
     jittable = True
+    binned_safe = True
 
     def key(self):
         # K shapes the buffer schema and the jitted partial/merge
@@ -1023,14 +1037,17 @@ class ApproxPercentile(Percentile):
         v = values.data.astype(jnp.float64)
         from spark_rapids_tpu.ops.common import sort_permutation
 
+        # row domain (gid length) and segment domain (cap) differ under
+        # the binned grouping, which keeps groups at bin-count capacity
+        nrow = int(gid.shape[0])
         rank = jnp.where(valid, 0, 1).astype(jnp.int32)
         key_v = jnp.where(valid, v, jnp.inf)
         perm = sort_permutation(
-            [gid.astype(jnp.int64), rank.astype(jnp.int64), key_v], cap)
+            [gid.astype(jnp.int64), rank.astype(jnp.int64), key_v], nrow)
         svals = jnp.take(key_v, perm)
         sgid = jnp.take(gid, perm)
         slive = jnp.take(valid, perm)
-        pos = jnp.arange(cap, dtype=jnp.int32)
+        pos = jnp.arange(nrow, dtype=jnp.int32)
         outs, total = self._extract(svals, sgid, slive, pos, cap)
         n = total.astype(jnp.int64)
         ok = n > 0

@@ -56,42 +56,207 @@ def unsorted_gids():
         _SORTED_GIDS.reset(tok)
 
 
-def binned_group_by(batch: ColumnBatch, key_idxs: Sequence[int],
-                    ranges: Sequence[Tuple[int, int]],
-                    live: Optional[jnp.ndarray] = None
-                    ) -> Tuple[GroupedBatch, jnp.ndarray]:
-    """Sort-free grouping for integer keys with small static value
-    ranges (DeviceColumn.vrange upload metadata): each row maps
-    directly to a bin (per-key code 0 = null, 1.. = value - lo), and
-    aggregation runs as scatter-adds over bins — one bandwidth pass
-    instead of a multi-pass device sort. This is the TPU answer to
-    cuDF's hash group-by for the common low-cardinality OLAP keys.
+# ---- MXU segmented reductions (the binned path's hot kernels) ----
+#
+# XLA:TPU lowers scatter-add (jax.ops.segment_sum) to a serialized
+# update loop — measured ~100 ns/row on v5e, i.e. seconds per 32M-row
+# batch — while one-hot matmuls ride the MXU at >100x that rate. When
+# the bin count B is statically small (the binned group-by), a
+# segmented sum is an outer-product accumulation:
+#
+#   out[h, l] = sum_r value_r * [gid_r // GL == h] * [gid_r % GL == l]
+#             = onehot_hi.T @ (values[:, None] * onehot_lo)
+#
+# with (GH, GL) factoring B, computed chunk-by-chunk under lax.scan so
+# the one-hot tiles never materialize at full length. The MXU has no
+# f64/i64 path (emulated f64 dots measured 16x slower), so every dot
+# runs in f32 with exactness arranged around it:
+#   - counts: chunk counts <= chunk size < 2^24 are exact in f32; the
+#     cross-chunk carry accumulates in i64 -> exact.
+#   - bounded int sums: when |value| <= V (static vrange metadata from
+#     upload narrowing), a chunk of C rows sums to < V*C; choosing C
+#     with V*C <= 2^24 keeps every chunk partial exact in f32, and the
+#     i64 carry is exact. Unbounded i64 sums fall back to scatter.
+#   - float sums: f32 chunk partials with an f64 carry — within the
+#     engine's documented v5e stance (f64 arithmetic at f32 precision,
+#     docs/compatibility.md).
+# min/max have no outer-product form and keep the scatter path (their
+# cost only matters if a plan min/maxes a huge un-sorted batch).
 
-    Returns (GroupedBatch, occupied) where gid is the UNSORTED bin id
-    per original row (use within `unsorted_gids()`), `sorted_batch` is
-    the batch itself, and `occupied` marks live bins; callers compact
-    bins to dense group positions with `dense_bin_perm`.
-    """
-    cap = batch.capacity
-    if live is None:
-        live = batch.live_mask()
-    gid64 = jnp.zeros((cap,), jnp.int64)
-    stride = 1
-    for i, (lo, hi) in zip(key_idxs, ranges):
-        c = batch.columns[i]
-        code = jnp.where(c.validity, c.data.astype(jnp.int64) - lo + 1, 0)
-        gid64 = gid64 + code * stride
-        stride *= hi - lo + 2
-    assert stride <= cap, "bin count must fit the batch capacity"
-    gid = jnp.clip(gid64, 0, cap - 1).astype(jnp.int32)
-    pos = jnp.arange(cap, dtype=jnp.int32)
-    big = jnp.int32(cap)
-    first_pos = jax.ops.segment_min(jnp.where(live, pos, big), gid,
-                                    num_segments=cap)
-    occupied = first_pos < big
-    num_groups = jnp.sum(occupied).astype(jnp.int32)
-    return (GroupedBatch(batch, gid, live, num_groups, first_pos),
-            occupied)
+_MM_BINS = contextvars.ContextVar("srtpu_mm_bins", default=None)
+_MM_FORCE = contextvars.ContextVar("srtpu_mm_force", default=False)
+
+#: trace-time counter of matmul-path sweeps — tests assert the path
+#: actually engaged (a silently regressed gate would otherwise let
+#: scatter-vs-scatter comparisons pass vacuously)
+mm_traced_sweeps = 0
+
+MM_MAX_BINS = 1 << 14
+_MM_CHUNK = 1 << 15
+
+
+@contextmanager
+def binned_bins(b: int):
+    """Declare that gids lie in [0, b) with b static (binned group-by);
+    enables the matmul reductions on TPU backends."""
+    tok = _MM_BINS.set(int(b))
+    try:
+        yield
+    finally:
+        _MM_BINS.reset(tok)
+
+
+@contextmanager
+def force_matmul_path():
+    """Tests: take the matmul path regardless of backend."""
+    tok = _MM_FORCE.set(True)
+    try:
+        yield
+    finally:
+        _MM_FORCE.reset(tok)
+
+
+def _mm_bins() -> Optional[int]:
+    b = _MM_BINS.get()
+    if b is None or b > MM_MAX_BINS:
+        return None
+    if not (_MM_FORCE.get() or jax.default_backend() == "tpu"):
+        return None
+    return b
+
+
+def _mm_factors(b: int) -> Tuple[int, int]:
+    """(GH, GL) with GH*GL >= b. VPU work per row is ~2*GL + GH
+    (two one-hot builds + the masked product), so GL ~ sqrt(b/2)."""
+    gl = 1
+    while gl * gl * 2 < b:
+        gl <<= 1
+    return -(-b // gl), gl
+
+
+def _mm_pass(weights: jnp.ndarray, gid: jnp.ndarray, b: int, chunk: int,
+             acc_dtype, guard_nonfinite: bool = False) -> jnp.ndarray:
+    """sum_r weights_r * onehot(gid_r) -> [b] acc_dtype. weights must be
+    f32 and pre-masked (0 for dead rows).
+
+    Dots run at Precision.HIGHEST: the TPU default lowers f32 matmuls to
+    one-pass bf16 (8-bit mantissa), which would silently break the
+    exact-count/exact-bounded-int contract and degrade float sums far
+    below f32-chunk precision.
+
+    guard_nonfinite (float sums): Inf inputs would poison whole chunks
+    (inf * one-hot-0 = NaN inside both the mask product and the dot), so
+    each chunk checks all-finite and falls back to a scatter-add for
+    that chunk alone — IEEE special values then confine to their own
+    group exactly like the scatter path, at scatter cost only for
+    chunks that actually contain them."""
+    return _mm_pass_multi([weights], gid, b, chunk, [acc_dtype],
+                          guard_nonfinite)[0]
+
+
+def _mm_pass_multi(weights_list, gid: jnp.ndarray, b: int, chunk: int,
+                   acc_dtypes, guard_nonfinite: bool = False):
+    """k segmented sums in ONE row sweep: the one-hot tiles are built
+    once per chunk and all k weight vectors ride a single stacked dot
+    ([GH, C] @ [C, k*GL]) — the one-hot build dominates VPU cost, so
+    fusing k sums costs barely more than one."""
+    global mm_traced_sweeps
+    mm_traced_sweeps += 1
+    n = gid.shape[0]
+    k = len(weights_list)
+    gh, gl = _mm_factors(b)
+    c = min(chunk, n)
+    pad = (-n) % c
+    if pad:
+        weights_list = [
+            jnp.concatenate([w, jnp.zeros(pad, w.dtype)])
+            for w in weights_list]
+        gid = jnp.concatenate([gid, jnp.zeros(pad, gid.dtype)])
+    lo = gid % gl
+    hi = gid // gl
+    il = jnp.arange(gl, dtype=jnp.int32)
+    ih = jnp.arange(gh, dtype=jnp.int32)
+
+    def body(carry, xs):
+        hb, lb = xs[0], xs[1]
+        wbs = xs[2:]
+
+        def mm(_):
+            ohl = (lb[:, None] == il[None, :]).astype(jnp.float32)
+            ohh = (hb[:, None] == ih[None, :]).astype(jnp.float32)
+            stacked = jnp.concatenate(
+                [wb[:, None] * ohl for wb in wbs], axis=1)
+            m = jnp.matmul(ohh.T, stacked,
+                           precision=jax.lax.Precision.HIGHEST)
+            return tuple(m[:, j * gl:(j + 1) * gl] for j in range(k))
+
+        def scatter(_):
+            return tuple(
+                jax.ops.segment_sum(wb, hb * gl + lb,
+                                    num_segments=gh * gl).reshape(gh, gl)
+                for wb in wbs)
+
+        if guard_nonfinite:
+            ms = jax.lax.cond(
+                jnp.all(jnp.stack([jnp.isfinite(wb).all() for wb in wbs])),
+                mm, scatter, 0)
+        else:
+            ms = mm(0)
+        return tuple(cy + m.astype(dt) for cy, m, dt
+                     in zip(carry, ms, acc_dtypes)), None
+
+    init = tuple(jnp.zeros((gh, gl), dt) for dt in acc_dtypes)
+    xs = (hi.reshape(-1, c), lo.reshape(-1, c)) + tuple(
+        w.reshape(-1, c) for w in weights_list)
+    out, _ = jax.lax.scan(body, init, xs)
+    return [o.reshape(-1)[:b] for o in out]
+
+
+def _pad_bins(vals: jnp.ndarray, cap: int) -> jnp.ndarray:
+    if vals.shape[0] >= cap:
+        return vals[:cap]
+    return jnp.concatenate(
+        [vals, jnp.zeros(cap - vals.shape[0], vals.dtype)])
+
+
+def _mm_seg_count(valid: jnp.ndarray, gid: jnp.ndarray,
+                  b: int) -> jnp.ndarray:
+    # chunk counts <= _MM_CHUNK < 2^24: exact in f32; i64 carry exact
+    return _mm_pass(valid.astype(jnp.float32), gid, b, _MM_CHUNK,
+                    jnp.int64)
+
+
+def _mm_sum_plan(values: jnp.ndarray, valid: jnp.ndarray, vbound):
+    """-> (weights_f32, chunk, acc_dtype, guard_nonfinite) for a matmul
+    segmented sum of `values`, or None when exactness cannot be
+    arranged (unbounded/loosely-bounded ints -> scatter)."""
+    dt = values.dtype
+    if jnp.issubdtype(dt, jnp.floating):
+        w = jnp.where(valid, values, 0).astype(jnp.float32)
+        return w, _MM_CHUNK, jnp.float64, True
+    if jnp.issubdtype(dt, jnp.integer):
+        if vbound is None:
+            return None  # unbounded int: scatter keeps exact wrapping
+        v = max(abs(int(vbound[0])), abs(int(vbound[1])), 1)
+        chunk = 1
+        while chunk * 2 * v <= (1 << 24) and chunk < _MM_CHUNK:
+            chunk <<= 1
+        if chunk < 2048:
+            return None  # bound too loose for exact f32 chunks
+        w = jnp.where(valid, values, 0).astype(jnp.float32)
+        return w, chunk, jnp.int64, False
+    return None
+
+
+def _mm_seg_sum(values: jnp.ndarray, valid: jnp.ndarray,
+                gid: jnp.ndarray, b: int,
+                vbound) -> Optional[jnp.ndarray]:
+    plan = _mm_sum_plan(values, valid, vbound)
+    if plan is None:
+        return None
+    w, chunk, acc, guard = plan
+    return _mm_pass(w, gid, b, chunk, acc,
+                    guard_nonfinite=guard).astype(values.dtype)
 
 
 def dense_bin_perm(occupied: jnp.ndarray, cap: int) -> jnp.ndarray:
@@ -143,17 +308,74 @@ def group_by(batch: ColumnBatch, key_idxs: Sequence[int],
 # gids produces silently wrong results on TPU.
 
 def seg_count(valid: jnp.ndarray, gid: jnp.ndarray, cap: int) -> jnp.ndarray:
+    b = _mm_bins()
+    if b is not None and b <= cap:
+        return _pad_bins(_mm_seg_count(valid, gid, b), cap)
     return jax.ops.segment_sum(valid.astype(jnp.int64), gid,
                                num_segments=cap,
                                indices_are_sorted=_SORTED_GIDS.get())
 
 
 def seg_sum(values: jnp.ndarray, valid: jnp.ndarray, gid: jnp.ndarray,
-            cap: int) -> jnp.ndarray:
+            cap: int, vbound=None) -> jnp.ndarray:
+    b = _mm_bins()
+    if b is not None and b <= cap and values.ndim == 1:
+        r = _mm_seg_sum(values, valid, gid, b, vbound)
+        if r is not None:
+            return _pad_bins(r, cap)
     zero = jnp.zeros((), dtype=values.dtype)
     return jax.ops.segment_sum(jnp.where(valid, values, zero), gid,
                                num_segments=cap,
                                indices_are_sorted=_SORTED_GIDS.get())
+
+
+def seg_sum_count(values: jnp.ndarray, valid: jnp.ndarray,
+                  gid: jnp.ndarray, cap: int, vbound=None
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(segmented sum, segmented count) of the same masked rows. On the
+    matmul path both ride ONE row sweep (`_mm_pass_multi`) — the
+    aggregate functions that need sum+count (Sum's null tracking,
+    Average) should call this instead of seg_sum + seg_count."""
+    b = _mm_bins()
+    if b is not None and b <= cap and values.ndim == 1:
+        plan = _mm_sum_plan(values, valid, vbound)
+        if plan is not None:
+            w, chunk, acc, guard = plan
+            s, c = _mm_pass_multi(
+                [w, valid.astype(jnp.float32)], gid, b, chunk,
+                [acc, jnp.int64], guard_nonfinite=guard)
+            return (_pad_bins(s.astype(values.dtype), cap),
+                    _pad_bins(c, cap))
+    return (seg_sum(values, valid, gid, cap, vbound),
+            seg_count(valid, gid, cap))
+
+
+def seg_multi_sum(values_list, valid: jnp.ndarray, gid: jnp.ndarray,
+                  cap: int, with_count: bool = True):
+    """(count, [sums]) over the SAME masked rows, fused into one row
+    sweep on the matmul path (the variance/covariance families need
+    2-5 power/cross sums plus a count — each as its own sweep would
+    rebuild the dominant one-hot tiles k times)."""
+    b = _mm_bins()
+    if (b is not None and b <= cap
+            and all(v.ndim == 1 for v in values_list)):
+        plans = [_mm_sum_plan(v, valid, None) for v in values_list]
+        if all(p is not None for p in plans):
+            ws = [p[0] for p in plans]
+            accs = [p[2] for p in plans]
+            chunk = min(p[1] for p in plans)
+            guard = any(p[3] for p in plans)
+            if with_count:
+                ws.append(valid.astype(jnp.float32))
+                accs.append(jnp.int64)
+            outs = _mm_pass_multi(ws, gid, b, chunk, accs,
+                                  guard_nonfinite=guard)
+            sums = [_pad_bins(o.astype(v.dtype), cap)
+                    for o, v in zip(outs, values_list)]
+            cnt = _pad_bins(outs[-1], cap) if with_count else None
+            return cnt, sums
+    cnt = seg_count(valid, gid, cap) if with_count else None
+    return cnt, [seg_sum(v, valid, gid, cap) for v in values_list]
 
 
 def seg_min(values: jnp.ndarray, valid: jnp.ndarray, gid: jnp.ndarray,

@@ -26,15 +26,43 @@ _cache: Dict[Tuple, Callable] = {}
 _lock = threading.Lock()
 
 
+_segmented_mod = None
+
+
+def _env_token() -> Tuple:
+    """Trace-environment facts that change what a structurally identical
+    program computes: the backend (kernels branch on it, e.g. the MXU
+    segmented reductions) and the test-only forced-matmul flag."""
+    global _segmented_mod
+    if _segmented_mod is None:  # lazy: segmented imports columnar.batch
+        from spark_rapids_tpu.ops import segmented
+
+        _segmented_mod = segmented
+    return (jax.default_backend(), _segmented_mod._MM_FORCE.get())
+
+
 def cached_jit(key: Tuple, build: Callable[[], Callable],
                **jit_kwargs) -> Callable:
-    """Return the jitted callable for `key`, building it on first use."""
-    with _lock:
-        fn = _cache.get(key)
+    """Return a callable dispatching to the jitted program for `key`,
+    building it on first use. The trace-environment part of the key is
+    resolved at CALL time, not construction time — jax.jit traces
+    lazily on first call, so a construction-time snapshot could label a
+    trace with an environment it was not traced under."""
+
+    def dispatch(*args, **kwargs):
+        full = key + _env_token()
+        # lock-free fast path: CPython dict reads are atomic, and every
+        # per-batch dispatch engine-wide funnels through here
+        fn = _cache.get(full)
         if fn is None:
-            fn = jax.jit(build(), **jit_kwargs)
-            _cache[key] = fn
-        return fn
+            with _lock:
+                fn = _cache.get(full)
+                if fn is None:
+                    fn = jax.jit(build(), **jit_kwargs)
+                    _cache[full] = fn
+        return fn(*args, **kwargs)
+
+    return dispatch
 
 
 def detached(op):
