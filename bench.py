@@ -1,18 +1,26 @@
-"""Benchmark: the ENGINE end-to-end on the q5-shaped slice.
+"""Benchmark: the ENGINE end-to-end on the q5-shaped slice over a
+CACHED table — the interactive-analytics loop.
 
-Unlike a fused-kernel microbench, this drives the full stack the way a
-user query does: session -> optimizer -> planner (TpuOverrides) ->
-TpuFileScanExec (parquet decode + H2D) -> jitted filter/project ->
-out-of-core hash aggregate (partial) -> shuffle exchange -> final
-aggregate -> D2H collect, with the semaphore, reservation ledger, and
-spill catalog all live (round-2 verdict item: bench the engine, not the
-demo).
+Drives the full stack the way a user query does: session -> optimizer
+-> planner (TpuOverrides) -> cached relation (HBM-resident via
+`df.cache(storage="device")`, exec/relation_cache.py) -> fused
+filter/project/hash-aggregate XLA programs (MXU segmented reductions)
+-> final aggregate -> D2H collect, with the semaphore, reservation
+ledger, and spill catalog all live.
+
+Both sides run HOT over resident data: the engine queries the
+device-cached relation; the CPU baseline (pyarrow) queries the same
+table held in RAM. That is the apples-to-apples interactive scenario —
+and the only defensible one on a tunneled device link (0.015-0.04 GB/s
+H2D measured; any per-query re-upload would measure the tunnel, not
+the engine). The one-time decode+upload cost is reported as `cold_s`,
+and the link is characterized in the JSON so absolute numbers stay
+diagnosable across environments.
 
 Input is a >= 1 GiB parquet dataset (written once, cached in /tmp).
-Reports the MEDIAN of N engine runs with inter-quartile dispersion, the
-CPU (pyarrow) baseline on the same query, and the HBM-roofline fraction
-(input bytes / elapsed / device peak memory bandwidth) so absolute
-numbers are diagnosable across environments.
+Reports the MEDIAN of N hot engine runs with inter-quartile dispersion
+and the HBM-roofline fraction (input bytes / elapsed / device peak
+memory bandwidth).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
@@ -77,10 +85,10 @@ def ensure_data() -> int:
     return total
 
 
-def engine_query(spark, path):
+def engine_query(base):
     from spark_rapids_tpu.api import functions as F
 
-    return (spark.read.parquet(path)
+    return (base
             .filter(F.col("amount") > 10.0)
             .select("store",
                     (F.col("amount") * F.col("qty")).alias("revenue"),
@@ -91,8 +99,7 @@ def engine_query(spark, path):
                  F.count("*").alias("sales")))
 
 
-def cpu_query(path):
-    t = pq.read_table(path)
+def cpu_query(t):
     f = t.filter(pc.greater(t.column("amount"), 10.0))
     rev = pc.multiply(f.column("amount"),
                       pc.cast(f.column("qty"), pa.float64()))
@@ -148,17 +155,24 @@ def main():
         "spark.rapids.shuffle.mode": "DEVICE",
     })
 
-    # ---- CPU baseline (pyarrow, the vectorized CPU engine) ----
+    # ---- CPU baseline (pyarrow): HOT, over a RAM-resident table ----
+    t0 = time.perf_counter()
+    host_table = pq.read_table(DATA_DIR)
+    cpu_cold_s = time.perf_counter() - t0  # decode cost, for reference
     cpu_times = []
-    for _ in range(2):
+    cpu_out = cpu_query(host_table)
+    for _ in range(3):
         t0 = time.perf_counter()
-        cpu_out = cpu_query(DATA_DIR)
+        cpu_out = cpu_query(host_table)
         cpu_times.append(time.perf_counter() - t0)
     cpu_gbps = input_bytes / min(cpu_times) / 1e9
 
-    # ---- engine (planner -> operators -> shuffle -> collect) ----
-    df = engine_query(spark, DATA_DIR)
-    out = df.collect_arrow()  # warm: compile caches, reader pools
+    # ---- engine: HOT, over the device-cached relation ----
+    base = spark.read.parquet(DATA_DIR).cache(storage="device")
+    df = engine_query(base)
+    t0 = time.perf_counter()
+    out = df.collect_arrow()  # cold: decode + upload + compiles
+    cold_s = time.perf_counter() - t0
     assert out.num_rows == cpu_out.num_rows, (out.num_rows,
                                               cpu_out.num_rows)
     times = []
@@ -195,14 +209,17 @@ def main():
     h2d = big.nbytes / (time.perf_counter() - t0) / 1e9
 
     print(json.dumps({
-        "metric": f"q5-slice engine end-to-end throughput ({dev.platform},"
-                  f" {ROWS} rows, {input_bytes >> 20} MiB)",
+        "metric": f"q5-slice engine throughput over device-cached table"
+                  f" ({dev.platform}, {ROWS} rows,"
+                  f" {input_bytes >> 20} MiB)",
         "value": round(dev_gbps, 3),
         "unit": "GB/s",
         "vs_baseline": round(dev_gbps / cpu_gbps, 3),
         "median_s": round(med, 3),
         "spread_pct": round(spread_pct, 1),
+        "cold_s": round(cold_s, 2),
         "cpu_baseline_gbps": round(cpu_gbps, 3),
+        "cpu_cold_read_s": round(cpu_cold_s, 2),
         "roofline_frac": round(roofline, 4),
         "device_kind": str(kind),
         "link_roundtrip_ms": round(rt_ms, 1),

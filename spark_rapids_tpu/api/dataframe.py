@@ -515,22 +515,40 @@ class DataFrame:
         from spark_rapids_tpu.plan.optimizer import optimize
         from spark_rapids_tpu.plan.overrides import plan_query
 
-        plan = _pin_query_time(self._plan)
+        # serve registered device-cached subtrees from their entries
+        # (Spark CacheManager.useCachedData role) BEFORE time pinning:
+        # pinning may rebuild nodes, which would break identity matching
+        plan = self.session.cache_manager.substitute(self._plan)
+        plan = _pin_query_time(plan)
         return plan_query(optimize(plan), self.session.rapids_conf)
 
-    # --- caching (ParquetCachedBatchSerializer analog: df.cache() data
-    # --- lives as compressed parquet blobs, decoded on reuse) ---
+    # --- caching ---
+    #
+    # Two tiers, mirroring the reference's split:
+    # - host (default): the ParquetCachedBatchSerializer analog — this
+    #   DataFrame's RESULT as a compressed parquet blob, returned on
+    #   re-collect.
+    # - device: the CacheManager/InMemoryRelation analog
+    #   (exec/relation_cache.py) — the RELATION as HBM-resident
+    #   spillable batches; any DERIVED query serves its scan from HBM
+    #   (no decode, no host->device link traffic). The TPU-native tier:
+    #   tunneled links make re-upload the dominant cost.
 
-    def cache(self) -> "DataFrame":
-        self._cached = True
+    def cache(self, storage: str = "host") -> "DataFrame":
+        if storage == "device":
+            self.session.cache_manager.register(
+                self._plan, self.session.rapids_conf)
+        else:
+            self._cached = True
         return self
 
-    def persist(self, *_a, **_k) -> "DataFrame":
-        return self.cache()
+    def persist(self, storage: str = "host", *_a, **_k) -> "DataFrame":
+        return self.cache(storage)
 
     def unpersist(self) -> "DataFrame":
         self._cached = False
         self._cache_blob = None
+        self.session.cache_manager.unregister(self._plan)
         return self
 
     def _cache_store(self, table: pa.Table):

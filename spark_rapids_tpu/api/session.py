@@ -184,8 +184,11 @@ class TpuSparkSession:
     builder = None  # class attribute set below
 
     def __init__(self, conf: Optional[Dict[str, object]] = None):
+        from spark_rapids_tpu.exec.relation_cache import CacheManager
+
         self._settings = dict(conf or {})
         self.rapids_conf = rc.RapidsConf(self._settings)
+        self.cache_manager = CacheManager()
         self._init_runtime()
         global _active
         with _active_lock:
@@ -311,6 +314,10 @@ class TpuSparkSession:
 
     def stop(self):
         global _active
+        try:
+            self.cache_manager.clear()
+        except Exception:
+            pass
         try:
             from spark_rapids_tpu.runtime.memory import _catalog
 

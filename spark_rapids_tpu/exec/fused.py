@@ -179,7 +179,7 @@ def shrink_traced(batch: ColumnBatch, cap2: int):
 # --------------------------------------------------------- the executor
 
 _SOURCE_TYPES = (ops.LocalRelationExec, ops.RangeExec, ops.TpuFileScanExec,
-                 ops.ArrowToDeviceExec)
+                 ops.ArrowToDeviceExec, ops.TpuCachedRelationExec)
 
 
 def _agg_jittable(node: ops.TpuHashAggregateExec) -> bool:
@@ -268,15 +268,23 @@ class FusedSingleChipExecutor:
                 groups = list(pool.map(one, tasks))
         return [b for g in groups for b in g]
 
-    def _prepare(self, phys: PhysicalPlan) -> Dict[int, List[ColumnBatch]]:
+    def _prepare(self, phys: PhysicalPlan,
+                 root_may_be_source: bool = False
+                 ) -> Dict[int, List[ColumnBatch]]:
         sources: List[PhysicalPlan] = []
         self._collect_sources(phys, sources)
         if any(s is phys for s in sources):
-            raise FusedCompileError("plan root is a host operator")
+            # a device source root is meaningful when materializing
+            # parts (the relation cache); a HOST root never is
+            if not (root_may_be_source and phys.is_tpu):
+                raise FusedCompileError("plan root is a host operator")
         parts: Dict[int, List[ColumnBatch]] = {}
         total = 0
         for s in sources:
-            if isinstance(s, ops.TpuFileScanExec) and s.is_tpu:
+            if isinstance(s, ops.TpuCachedRelationExec):
+                # device-resident cache entry: no decode, no upload
+                ps = s.entry.device_parts()
+            elif isinstance(s, ops.TpuFileScanExec) and s.is_tpu:
                 ps = self._scan_parts(s)
             else:
                 table = s.collect()
@@ -293,7 +301,15 @@ class FusedSingleChipExecutor:
 
     # --- per-run state ---
 
-    def execute(self, phys: PhysicalPlan) -> pa.Table:
+    def execute_parts(self, phys: PhysicalPlan) -> List[ColumnBatch]:
+        """Run the plan but keep its output as DEVICE batches (no final
+        host collect) — the relation cache's materializer
+        (exec/relation_cache.py). Source-level integer narrowing and
+        vrange metadata survive into the cached parts, so consumers of
+        the cache keep the binned-aggregation fast path."""
+        return self.execute(phys, as_parts=True)
+
+    def execute(self, phys: PhysicalPlan, as_parts: bool = False):
         from spark_rapids_tpu.exec.base import new_task_context
         from spark_rapids_tpu.runtime import semaphore as sem
 
@@ -312,14 +328,20 @@ class FusedSingleChipExecutor:
             raise FusedCompileError("OOM injection uses the eager engine")
         # validate the plan BEFORE decoding/uploading anything
         self._validate(phys)
+        # materialize cold cache entries BEFORE taking permits: entry
+        # materialization runs a nested execute() with a FRESH task id,
+        # and a nested acquire under held permits deadlocks the
+        # semaphore (its re-entrancy is per-task-id)
+        self._premater_cached(phys)
         ctx = new_task_context(self.conf)
         sem.get().acquire_if_necessary(ctx.task_id)
         try:
-            self._prepare(phys)
+            self._prepare(phys, root_may_be_source=as_parts)
             expansion, group_cap = self._expansion, self._group_cap
             while True:
                 try:
-                    return self._run(phys, expansion, group_cap)
+                    return self._run(phys, expansion, group_cap,
+                                     as_parts=as_parts)
                 except TpuSplitAndRetryOOM:
                     if expansion >= 256:
                         raise
@@ -329,6 +351,13 @@ class FusedSingleChipExecutor:
             sem.get().release_if_necessary(ctx.task_id)
             self._src_parts = None
             self._sources = None
+
+    def _premater_cached(self, node: PhysicalPlan) -> None:
+        if isinstance(node, ops.TpuCachedRelationExec):
+            node.entry.materialize()
+            return
+        for c in node.children:
+            self._premater_cached(c)
 
     # --- validation walk (no device work) ---
 
@@ -363,7 +392,7 @@ class FusedSingleChipExecutor:
                 and node.mode == "partial")
 
     def _run(self, phys: PhysicalPlan, expansion: int,
-             group_cap: int) -> pa.Table:
+             group_cap: int, as_parts: bool = False):
         from spark_rapids_tpu.parallel.plan_compiler import (
             _plan_key,
             concat_traced,
@@ -436,6 +465,12 @@ class FusedSingleChipExecutor:
         def emit_parts(node: PhysicalPlan) -> List[ColumnBatch]:
             if id(node) in src_parts:
                 return src_parts[id(node)]
+            if (isinstance(node, ops.TpuCoalesceBatchesExec)
+                    and id(node.children[0]) in src_parts):
+                # coalesce directly over a source is identity here; skip
+                # the program so source narrowing survives (matters for
+                # cache materialization)
+                return src_parts[id(node.children[0])]
             if isinstance(node, ops.TpuShuffleExchangeExec):
                 # single chip: every partition is already co-resident
                 return emit_parts(node.children[0])
@@ -532,6 +567,13 @@ class FusedSingleChipExecutor:
             raise FusedCompileError(type(node).__name__)
 
         parts = emit_parts(phys)
+        if as_parts:
+            # one host sync for the overflow flags; parts stay on device
+            if flags and bool(np.any(jax.device_get(
+                    jnp.stack([f.reshape(()) for f in flags])))):
+                raise TpuSplitAndRetryOOM(
+                    "fused program capacity overflow; recompiling larger")
+            return parts
         if len(parts) > 1:
             def collect_fn(*ps):
                 return (concat_traced(concat_inputs(list(ps))),

@@ -101,6 +101,26 @@ class LocalRelationExec(PhysicalPlan):
         yield self.table.slice(lo, hi - lo)
 
 
+class TpuCachedRelationExec(PhysicalPlan):
+    """Source over a device-resident cache entry (Spark
+    InMemoryTableScanExec role; exec/relation_cache.py). The fused
+    executor consumes the entry's device parts directly (no host
+    traffic); this eager path serves host tables for CPU consumers."""
+
+    def __init__(self, entry, schema, conf):
+        super().__init__([], schema, conf)
+        self.entry = entry
+
+    @property
+    def num_partitions(self):
+        return max(1, self.entry.num_parts())
+
+    def execute_partition(self, pid, ctx):
+        if pid < self.entry.num_parts():
+            _acquire(ctx)  # device-resident from the first touch
+            yield self.entry.device_part(pid)
+
+
 class RangeExec(PhysicalPlan):
     """TPU range source (GpuRangeExec analog)."""
 

@@ -238,7 +238,7 @@ def range_exchange_sort(batch: ColumnBatch, orders, n: int, axis: str,
 # --------------------------------------------------------- the executor
 
 _SOURCE_TYPES = (ops.LocalRelationExec, ops.RangeExec, ops.TpuFileScanExec,
-                 ops.ArrowToDeviceExec)
+                 ops.ArrowToDeviceExec, ops.TpuCachedRelationExec)
 
 _SUPPORTED = (ops.TpuProjectExec, ops.TpuFilterExec,
               ops.TpuHashAggregateExec, ops.TpuShuffleExchangeExec,

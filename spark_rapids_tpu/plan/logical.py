@@ -56,6 +56,24 @@ class LocalRelation(LogicalPlan):
         return f"LocalRelation{self._schema.names}"
 
 
+class CachedRelation(LogicalPlan):
+    """Leaf over a device-resident cache entry (Spark InMemoryRelation
+    role; exec/relation_cache.py). Deliberately childless so optimizer
+    rules treat it as an opaque source — the cached subtree was already
+    optimized when the entry materialized."""
+
+    def __init__(self, entry):
+        super().__init__()
+        self.entry = entry
+
+    @property
+    def schema(self):
+        return self.entry.schema
+
+    def _node_string(self):
+        return f"CachedRelation{self.entry.schema.names}"
+
+
 class Range(LogicalPlan):
     def __init__(self, start: int, end: int, step: int = 1,
                  num_partitions: int = 1):

@@ -177,6 +177,8 @@ class TpuOverrides:
         elif isinstance(node, L.LocalRelation):
             meta.cannot_run("in-memory relation stays host-side until "
                             "first device operator")
+        # CachedRelation: always device-capable (the entry IS device
+        # batches), no tagging required
         meta.children = [self.tag(c) for c in node.children]
         self.metas.append(meta)
         return meta
@@ -309,6 +311,9 @@ class TpuOverrides:
 
         if isinstance(node, L.LocalRelation):
             return ops.LocalRelationExec(node.table, node.schema, conf)
+        if isinstance(node, L.CachedRelation):
+            return ops.TpuCachedRelationExec(node.entry, node.schema,
+                                             conf)
         if isinstance(node, L.Range):
             return ops.RangeExec(node.start, node.end, node.step,
                                  node.num_partitions, node.schema, conf)

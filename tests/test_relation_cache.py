@@ -1,0 +1,182 @@
+"""Device-resident relation cache (exec/relation_cache.py): the Spark
+CacheManager + InMemoryRelation pair with HBM as the storage tier.
+
+The load-bearing property: after `df.cache(storage="device")` is
+materialized, derived queries serve the relation from device batches —
+no file re-read, no re-upload. Proven by deleting the source files and
+re-querying.
+"""
+
+import os
+import shutil
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSparkSession
+
+
+@pytest.fixture()
+def data_dir(tmp_path):
+    rng = np.random.default_rng(7)
+    n = 50_000
+    t = pa.table({
+        "store": pa.array(rng.integers(0, 100, n), type=pa.int64()),
+        "amount": pa.array(rng.random(n) * 100.0, type=pa.float64()),
+        "qty": pa.array(rng.integers(1, 50, n), type=pa.int64()),
+    })
+    d = tmp_path / "cache_data"
+    d.mkdir()
+    pq.write_table(t, str(d / "part-0.parquet"), compression="NONE",
+                   use_dictionary=False)
+    return str(d), t
+
+
+def _oracle(t):
+    f = t.filter(pc.greater(t.column("amount"), 20.0))
+    return {int(s): (c,) for s, c in zip(
+        *[f.group_by("store").aggregate([("store", "count")]).column(i)
+          .to_pylist() for i in (0, 1)])}
+
+
+def _engine(df):
+    out = (df.filter(F.col("amount") > 20.0)
+           .groupBy("store").agg(F.count("*").alias("c"))
+           .collect_arrow())
+    return {int(s): (c,) for s, c in zip(
+        out.column("store").to_pylist(), out.column("c").to_pylist())}
+
+
+def test_device_cache_serves_after_source_deleted(data_dir):
+    d, t = data_dir
+    spark = TpuSparkSession({"spark.sql.shuffle.partitions": 2})
+    try:
+        base = spark.read.parquet(d).cache(storage="device")
+        want = _oracle(t)
+        assert _engine(base) == want  # first use materializes
+        shutil.rmtree(d)  # files gone: only the device cache can serve
+        assert not os.path.exists(d)
+        assert _engine(base) == want
+        # a second derived query shape also serves from the entry
+        s = (base.groupBy("store")
+             .agg(F.sum("qty").alias("sq")).collect_arrow())
+        want_sq = {int(k): v for k, v in zip(
+            *[t.group_by("store").aggregate([("qty", "sum")]).column(i)
+              .to_pylist() for i in (0, 1)])}
+        got_sq = {int(k): v for k, v in zip(
+            s.column("store").to_pylist(), s.column("sq").to_pylist())}
+        assert got_sq == want_sq
+    finally:
+        spark.stop()
+
+
+def test_device_cache_eager_engine_path(data_dir):
+    # with whole-stage fusion disabled, the per-operator engine consumes
+    # the cached device parts through TpuCachedRelationExec
+    d, t = data_dir
+    spark = TpuSparkSession({"spark.sql.shuffle.partitions": 2,
+                             "spark.rapids.sql.fusedExec.enabled": False})
+    try:
+        base = spark.read.parquet(d).cache(storage="device")
+        want = _oracle(t)
+        assert _engine(base) == want
+        shutil.rmtree(d)
+        assert _engine(base) == want
+    finally:
+        spark.stop()
+
+
+def test_device_cache_of_derived_plan(data_dir):
+    d, t = data_dir
+    spark = TpuSparkSession({"spark.sql.shuffle.partitions": 2})
+    try:
+        filtered = spark.read.parquet(d).filter(F.col("amount") > 20.0)
+        filtered.cache(storage="device")
+        out = filtered.groupBy("store").agg(
+            F.count("*").alias("c")).collect_arrow()
+        got = {int(s): (c,) for s, c in zip(
+            out.column("store").to_pylist(), out.column("c").to_pylist())}
+        assert got == _oracle(t)
+        shutil.rmtree(d)
+        out2 = filtered.groupBy("store").agg(
+            F.count("*").alias("c")).collect_arrow()
+        got2 = {int(s): (c,) for s, c in zip(
+            out2.column("store").to_pylist(),
+            out2.column("c").to_pylist())}
+        assert got2 == _oracle(t)
+    finally:
+        spark.stop()
+
+
+def test_unpersist_releases_entry(data_dir):
+    d, t = data_dir
+    spark = TpuSparkSession({"spark.sql.shuffle.partitions": 2})
+    try:
+        base = spark.read.parquet(d).cache(storage="device")
+        _ = _engine(base)
+        assert spark.cache_manager.lookup(base._plan) is not None
+        base.unpersist()
+        assert spark.cache_manager.lookup(base._plan) is None
+        # files still exist: the query simply re-reads them
+        assert _engine(base) == _oracle(t)
+    finally:
+        spark.stop()
+
+
+def test_cached_df_collect_itself(data_dir):
+    d, t = data_dir
+    spark = TpuSparkSession({"spark.sql.shuffle.partitions": 2})
+    try:
+        base = spark.read.parquet(d).cache(storage="device")
+        got = base.collect_arrow().sort_by("store")
+        assert got.num_rows == t.num_rows
+        assert (pc.sum(got.column("qty")).as_py()
+                == pc.sum(t.column("qty")).as_py())
+    finally:
+        spark.stop()
+
+
+def test_cold_cache_query_with_single_permit_no_deadlock(data_dir):
+    # entry materialization runs a nested execute with a fresh task id;
+    # with concurrentGpuTasks=1 a nested semaphore acquire under held
+    # permits would deadlock — the fused executor must materialize
+    # BEFORE taking permits
+    import threading
+
+    d, t = data_dir
+    spark = TpuSparkSession({"spark.sql.shuffle.partitions": 2,
+                             "spark.rapids.sql.concurrentGpuTasks": 1})
+    try:
+        base = spark.read.parquet(d).cache(storage="device")
+        result = {}
+
+        def run():
+            result["got"] = _engine(base)
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        th.join(timeout=120)
+        assert not th.is_alive(), "cold cached query deadlocked"
+        assert result["got"] == _oracle(t)
+    finally:
+        spark.stop()
+
+
+def test_host_blob_cache_still_works(data_dir):
+    # the default cache() tier (result-blob, ParquetCachedBatchSerializer
+    # analog) is unchanged
+    d, t = data_dir
+    spark = TpuSparkSession({"spark.sql.shuffle.partitions": 2})
+    try:
+        df = (spark.read.parquet(d).groupBy("store")
+              .agg(F.sum("qty").alias("sq")).cache())
+        a = df.collect_arrow()
+        assert df._cache_blob is not None
+        b = df.collect_arrow()
+        assert a.equals(b)
+    finally:
+        spark.stop()
