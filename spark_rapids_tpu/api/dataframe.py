@@ -538,8 +538,12 @@ class DataFrame:
         if storage == "device":
             self.session.cache_manager.register(
                 self._plan, self.session.rapids_conf)
-        else:
+        elif storage == "host":
             self._cached = True
+        else:
+            raise ValueError(
+                f"unknown cache storage {storage!r}: use 'host' "
+                "(result blob) or 'device' (HBM-resident relation)")
         return self
 
     def persist(self, storage: str = "host", *_a, **_k) -> "DataFrame":

@@ -371,14 +371,37 @@ def device_to_arrow(batch: ColumnBatch) -> pa.Table:
                 None if c.map_values is None else c.map_values[:small])
              for c in batch.columns],
             n)
-    arrays = []
-    names = []
     from spark_rapids_tpu.runtime import host_alloc
 
     with host_alloc.get().reserved(batch.device_size_bytes(),
                                    pinned=True):
         host = jax.device_get(batch)
-    for field, col in zip(batch.schema.fields, host.columns):
+    return _host_batch_to_arrow(batch.schema, host.columns, n)
+
+
+def device_to_arrow_fused(batch: ColumnBatch, extra):
+    """Single-sync D2H variant: fetches (batch, extra) in ONE
+    device_get — no row_count pre-sync, no on-device slice; the row
+    count rides along and slicing happens host-side. On high-latency
+    links (tunneled devices: ~100-180 ms per roundtrip measured) the
+    dead-capacity bytes of a small result are far cheaper than the two
+    extra roundtrips the standard path pays. Callers should keep the
+    standard `device_to_arrow` for large-capacity results.
+
+    Returns (table, host_extra)."""
+    from spark_rapids_tpu.runtime import host_alloc
+
+    with host_alloc.get().reserved(batch.device_size_bytes(),
+                                   pinned=True):
+        host, host_extra = jax.device_get((batch, extra))
+    n = int(np.asarray(host.num_rows))
+    return _host_batch_to_arrow(host.schema, host.columns, n), host_extra
+
+
+def _host_batch_to_arrow(schema, host_columns, n: int) -> pa.Table:
+    arrays = []
+    names = []
+    for field, col in zip(schema.fields, host_columns):
         names.append(field.name)
         validity = np.asarray(col.validity[:n])
         if isinstance(field.dataType, StringType):

@@ -87,11 +87,23 @@ class PhysicalPlan:
 
     # --- driver-side actions ---
 
+    def _premater_cached_entries(self) -> None:
+        """Materialize cold relation-cache entries BEFORE any task takes
+        semaphore permits: materialization runs a nested fused execute
+        with a FRESH task id, and a nested acquire under held permits
+        deadlocks (duck-typed to avoid importing operators here)."""
+        entry = getattr(self, "entry", None)
+        if entry is not None and hasattr(entry, "materialize"):
+            entry.materialize()
+        for c in self.children:
+            c._premater_cached_entries()
+
     def collect(self) -> pa.Table:
         """Run all partitions -> one arrow table (driver collect)."""
         from spark_rapids_tpu.columnar.arrow_bridge import device_to_arrow
         from spark_rapids_tpu.sqltypes.datatypes import to_arrow_type
 
+        self._premater_cached_entries()
         tables: List[Optional[pa.Table]] = [None] * self.num_partitions
 
         def run(pid: int):

@@ -586,9 +586,23 @@ class FusedSingleChipExecutor:
                 return widen_traced(b), jnp.zeros((), bool)
 
             result = run_program("collect1", ("collect1",), one_fn, parts)
+        flags_arr = (jnp.stack([f.reshape(()) for f in flags])
+                     if flags else jnp.zeros((1,), bool))
+        if result.device_size_bytes() <= (16 << 20):
+            # small result: ONE roundtrip for rows+flags+data (the
+            # standard path pays three — row_count, flags, fetch — and
+            # each costs ~100-180 ms on tunneled links)
+            from spark_rapids_tpu.columnar.arrow_bridge import (
+                device_to_arrow_fused,
+            )
+
+            table, host_flags = device_to_arrow_fused(result, flags_arr)
+            if bool(np.any(host_flags)):
+                raise TpuSplitAndRetryOOM(
+                    "fused program capacity overflow; recompiling larger")
+            return table
         # one host sync for all overflow flags before fetching results
-        if flags and bool(np.any(jax.device_get(
-                jnp.stack([f.reshape(()) for f in flags])))):
+        if bool(np.any(jax.device_get(flags_arr))):
             raise TpuSplitAndRetryOOM(
                 "fused program capacity overflow; recompiling larger")
         return device_to_arrow(result)

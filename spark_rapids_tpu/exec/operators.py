@@ -26,7 +26,7 @@ Operator -> reference mapping:
 from __future__ import annotations
 
 import itertools
-from typing import Iterator, List, Optional, Sequence
+from typing import Dict, Iterator, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -891,9 +891,6 @@ class TpuHashAggregateExec(PhysicalPlan):
 
         with segmented.unsorted_gids(), (
                 segmented.binned_bins(stride) if mm_ok else nullcontext()):
-            counts = segmented.seg_count(live, gid, bcap)
-            occupied = counts > 0
-            num_groups = jnp.sum(occupied).astype(jnp.int32)
             out_cols: List[DeviceColumn] = []
             # analytic key decode: bin index -> key values, in bin space
             idx = jnp.arange(bcap, dtype=jnp.int64)
@@ -909,23 +906,115 @@ class TpuHashAggregateExec(PhysicalPlan):
                     col.dtype, (code - 1 + lo).astype(col.data.dtype),
                     code > 0, vrange=(lo - 1, hi)))
             ci = nkeys
-            for a, grp in zip(self.aggs, input_groups):
-                fn: AggregateFunction = a.children[0]
-                k = len(grp)
-                if k == 0:
-                    vals = None
-                elif k == 1:
-                    vals = work.columns[ci]
-                else:
-                    vals = [work.columns[ci + j] for j in range(k)]
-                ci += k
-                out_cols.extend(fn.update(vals, live, gid, bcap))
+            fast = self._binned_all_sums(input_groups, live, gid, bcap,
+                                         work, ci)
+            if fast is not None:
+                counts, agg_cols = fast
+                out_cols.extend(agg_cols)
+            else:
+                counts = segmented.seg_count(live, gid, bcap)
+                for a, grp in zip(self.aggs, input_groups):
+                    fn: AggregateFunction = a.children[0]
+                    k = len(grp)
+                    if k == 0:
+                        vals = None
+                    elif k == 1:
+                        vals = work.columns[ci]
+                    else:
+                        vals = [work.columns[ci + j] for j in range(k)]
+                    ci += k
+                    out_cols.extend(fn.update(vals, live, gid, bcap))
+            occupied = counts > 0
+            num_groups = jnp.sum(occupied).astype(jnp.int32)
         # bins -> dense group positions (front-compacted like the
         # sorted path's segment-id outputs)
         perm = segmented.dense_bin_perm(occupied, bcap)
         out_cols = [c.gather(perm) for c in out_cols]
         return ColumnBatch(_buffer_schema(self.grouping, self.aggs),
                            out_cols, num_groups)
+
+    def _binned_all_sums(self, input_groups, live, gid, bcap, work,
+                         ci0):
+        """ALL reductions of a Sum/Average/Count-only aggregate (the
+        canonical OLAP shape) plus the bin-occupancy count as ONE
+        matmul sweep: each extra weight vector rides the same one-hot
+        tiles (segmented._mm_pass_multi), so the whole partial costs
+        barely more than a single reduction. Returns
+        (occupancy_counts, buffer_cols) or None when the shape doesn't
+        qualify (other aggregate functions, decimal128 sums, unbounded
+        int sums, or no matmul backend) — the generic per-function
+        update loop then runs instead."""
+        from spark_rapids_tpu.expr.aggregates import Average, Count, Sum
+        from spark_rapids_tpu.ops import decimal128 as d128
+
+        b = segmented.mm_bins_active()
+        if b is None:
+            return None
+        fns = [a.children[0] for a in self.aggs]
+        if not all(type(f) in (Sum, Average, Count) for f in fns):
+            return None
+        if any(d128.is_wide(f.buffer_types()[0]) for f in fns
+               if isinstance(f, (Sum, Average))):
+            return None
+        weights: List[jnp.ndarray] = []
+        accs: List = []
+        chunk = segmented._MM_CHUNK
+        guard = False
+        slots = []  # ("sum", w_i, cnt_i, out_t, out_np) | ("count", cnt_i)
+        count_idx_by_id: Dict[int, int] = {}
+
+        def add_count(valid) -> int:
+            i = count_idx_by_id.get(id(valid))
+            if i is None:
+                i = len(weights)
+                weights.append(valid.astype(jnp.float32))
+                accs.append(jnp.int64)
+                count_idx_by_id[id(valid)] = i
+            return i
+
+        ci = ci0
+        for fn in fns:
+            k = len(fn.children)
+            if isinstance(fn, (Sum, Average)):
+                col = work.columns[ci]
+                valid = col.validity & live
+                out_t = fn.buffer_types()[0]
+                vb = segmented.infer_int_vbound(col)
+                data = col.data.astype(out_t.np_dtype)
+                plan = segmented._mm_sum_plan(data, valid, vb)
+                if plan is None:
+                    return None
+                w, c, acc, g = plan
+                chunk = min(chunk, c)
+                guard = guard or g
+                wi = len(weights)
+                weights.append(w)
+                accs.append(acc)
+                slots.append(("sum", wi, add_count(valid), out_t,
+                              data.dtype))
+            else:  # Count
+                valid = live if k == 0 else (
+                    work.columns[ci].validity & live)
+                slots.append(("count", add_count(valid)))
+            ci += k
+        occ_i = add_count(live)
+        outs = segmented._mm_pass_multi(weights, gid, b, chunk, accs,
+                                        guard_nonfinite=guard)
+        outs = [segmented._pad_bins(o, bcap) for o in outs]
+        ones = jnp.ones((bcap,), bool)
+        from spark_rapids_tpu.sqltypes.datatypes import long as _long
+
+        cols: List[DeviceColumn] = []
+        for slot in slots:
+            if slot[0] == "sum":
+                _, wi, cnt_i, out_t, out_np = slot
+                cnt = outs[cnt_i]
+                cols.append(DeviceColumn(
+                    out_t, outs[wi].astype(out_np), cnt > 0))
+                cols.append(DeviceColumn(_long, cnt, ones))
+            else:
+                cols.append(DeviceColumn(_long, outs[slot[1]], ones))
+        return outs[occ_i], cols
 
     def _merge_keys_prefix(self, g, nkeys: int, cap: int
                            ) -> List[DeviceColumn]:

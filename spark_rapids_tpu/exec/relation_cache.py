@@ -43,6 +43,7 @@ class DeviceCacheEntry:
         self.logical = logical
         self.conf = conf
         self._spills: Optional[List] = None
+        self._released = False
         self._lock = threading.Lock()
 
     @property
@@ -58,6 +59,13 @@ class DeviceCacheEntry:
 
     def materialize(self) -> None:
         with self._lock:
+            if self._released:
+                # a released entry must not silently re-run its plan
+                # (source files may be gone; fresh spillables would
+                # leak — nothing owns a released entry anymore)
+                raise RuntimeError(
+                    "cached relation was unpersisted; re-cache the "
+                    "DataFrame to use it again")
             if self._spills is not None:
                 return
             from spark_rapids_tpu.runtime.memory import get_catalog
@@ -96,21 +104,21 @@ class DeviceCacheEntry:
     def device_part(self, i: int):
         """One materialized part (unspilling only that part)."""
         self.materialize()
+        # hold the lock through get_batch: a concurrent release() may
+        # not close handles mid-access (unspill happens under the lock;
+        # it never re-enters this entry)
         with self._lock:
             if self._spills is None or i >= len(self._spills):
                 raise IndexError(f"cached relation part {i} released")
-            sb = self._spills[i]
-        return sb.get_batch()
+            return self._spills[i].get_batch()
 
     def device_parts(self) -> List:
         """Materialized device ColumnBatches (unspilling as needed)."""
         self.materialize()
-        # snapshot under the lock: a concurrent unpersist() must not
-        # turn the list into None mid-iteration
         with self._lock:
             spills = list(self._spills) if self._spills is not None \
                 else []
-        return [sb.get_batch() for sb in spills]
+            return [sb.get_batch() for sb in spills]
 
     def collect(self) -> pa.Table:
         from spark_rapids_tpu.columnar.arrow_bridge import device_to_arrow
@@ -125,6 +133,7 @@ class DeviceCacheEntry:
 
     def release(self) -> None:
         with self._lock:
+            self._released = True
             if self._spills is not None:
                 for sb in self._spills:
                     try:

@@ -104,16 +104,7 @@ class Sum(AggregateFunction):
             sh, sl = d128.seg_sum128(hi, lo, valid, gid, cap)
             return [DeviceColumn(out_t, d128.join(sh, sl), cnt > 0),
                     DeviceColumn(long, cnt, jnp.ones(cnt.shape, bool))]
-        vb = getattr(values, "vrange", None)
-        if (vb is None and values.data.ndim == 1
-                and jnp.issubdtype(values.data.dtype, jnp.integer)
-                and values.data.dtype.itemsize == 1):
-            # 8-bit columns without vrange: the width is a tight enough
-            # bound for exact f32 chunks (16-bit widths force the chunk
-            # below _mm_sum_plan's floor, so computing them is wasted);
-            # taken BEFORE the cast to the i64 sum dtype
-            info = jnp.iinfo(values.data.dtype)
-            vb = (int(info.min), int(info.max))
+        vb = segmented.infer_int_vbound(values)
         data = values.data.astype(out_t.np_dtype)
         s, cnt = segmented.seg_sum_count(data, valid, gid, cap, vbound=vb)
         return [DeviceColumn(out_t, s, cnt > 0),

@@ -125,6 +125,29 @@ def _mm_bins() -> Optional[int]:
     return b
 
 
+def mm_bins_active() -> Optional[int]:
+    """Bin count when the matmul reductions will engage (inside a
+    binned_bins context on a TPU/forced backend), else None."""
+    return _mm_bins()
+
+
+def infer_int_vbound(col) -> Optional[Tuple[int, int]]:
+    """Static |value| bound for a column's matmul sum plan: upload
+    vrange when stamped, else the type width for 8-bit columns (16-bit
+    widths force the chunk below _mm_sum_plan's floor, so computing
+    them is wasted). Must be taken BEFORE any cast to the i64 sum
+    dtype."""
+    vb = getattr(col, "vrange", None)
+    if vb is not None:
+        return vb
+    if (col.data.ndim == 1
+            and jnp.issubdtype(col.data.dtype, jnp.integer)
+            and col.data.dtype.itemsize == 1):
+        info = jnp.iinfo(col.data.dtype)
+        return (int(info.min), int(info.max))
+    return None
+
+
 def _mm_factors(b: int) -> Tuple[int, int]:
     """(GH, GL) with GH*GL >= b. VPU work per row is ~2*GL + GH
     (two one-hot builds + the masked product), so GL ~ sqrt(b/2)."""

@@ -166,6 +166,35 @@ def test_cold_cache_query_with_single_permit_no_deadlock(data_dir):
         spark.stop()
 
 
+def test_cold_cache_eager_single_permit_no_deadlock(data_dir):
+    # same deadlock shape on the PER-OPERATOR engine: operators acquire
+    # permits before pulling their cached-relation child, so base
+    # collect() must pre-materialize entries first
+    import threading
+
+    d, t = data_dir
+    spark = TpuSparkSession({
+        "spark.sql.shuffle.partitions": 2,
+        "spark.rapids.sql.concurrentGpuTasks": 1,
+        "spark.rapids.sql.fusedExec.enabled": False,
+        "spark.sql.adaptive.enabled": False,
+    })
+    try:
+        base = spark.read.parquet(d).cache(storage="device")
+        result = {}
+
+        def run():
+            result["got"] = _engine(base)
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        th.join(timeout=120)
+        assert not th.is_alive(), "cold cached eager query deadlocked"
+        assert result["got"] == _oracle(t)
+    finally:
+        spark.stop()
+
+
 def test_host_blob_cache_still_works(data_dir):
     # the default cache() tier (result-blob, ParquetCachedBatchSerializer
     # analog) is unchanged
