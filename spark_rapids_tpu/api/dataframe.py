@@ -708,8 +708,12 @@ class DataFrameWriter:
         if self._format == "delta":
             from spark_rapids_tpu.lakehouse.delta import write_delta
 
+            # delta.* writer options become table properties
+            props = {k: str(v) for k, v in self._options.items()
+                     if k.startswith("delta.")}
             write_delta(self._df, path, mode=self._mode,
-                        partition_by=self._partition_by)
+                        partition_by=self._partition_by,
+                        properties=props or None)
             return
         from spark_rapids_tpu.io.writers import (
             WriteStats,

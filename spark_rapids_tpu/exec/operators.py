@@ -177,9 +177,10 @@ class TpuFileScanExec(PhysicalPlan):
         self._strategy = conf.get(rc.PARQUET_READER_TYPE)
         coalesce_bytes = 128 << 20
         self._part_spec = self.options.get("partition_spec")
-        if fmt == "iceberg":
-            # per-file tasks: each data file carries its own delete set
-            # and field-id projection (lakehouse/iceberg.py)
+        if fmt in ("iceberg", "delta"):
+            # per-file tasks: each data file carries its own delete
+            # set / deletion vector and column projection
+            # (lakehouse/iceberg.py, lakehouse/delta.py)
             self._tasks = [[p] for p in paths] or [[]]
         elif fmt == "parquet":
             if self._part_spec is not None:
@@ -317,6 +318,11 @@ class TpuFileScanExec(PhysicalPlan):
             from spark_rapids_tpu.lakehouse.iceberg import read_data_file
 
             ctx = self.options["iceberg_ctx"]
+            return iter([read_data_file(ctx, f, cols) for f in files])
+        if self.fmt == "delta":
+            from spark_rapids_tpu.lakehouse.delta import read_data_file
+
+            ctx = self.options["delta_ctx"]
             return iter([read_data_file(ctx, f, cols) for f in files])
         if self.fmt == "parquet":
             if self._strategy == "MULTITHREADED":

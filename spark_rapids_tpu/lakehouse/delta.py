@@ -70,12 +70,41 @@ class Snapshot:
     def __init__(self, version: int, schema_json: Optional[dict],
                  partition_cols: List[str],
                  files: Dict[str, dict],
-                 protocol: Optional[dict] = None):
+                 protocol: Optional[dict] = None,
+                 config: Optional[dict] = None):
         self.version = version
         self.schema_json = schema_json
         self.partition_cols = partition_cols
         self.files = files  # relative path -> add action
         self.protocol = protocol  # last protocol action seen
+        self.config = config or {}  # metaData.configuration
+
+    @property
+    def column_mapping_mode(self) -> str:
+        return self.config.get("delta.columnMapping.mode", "none")
+
+    @property
+    def deletion_vectors_enabled(self) -> bool:
+        return (self.config.get("delta.enableDeletionVectors", "false")
+                .lower() == "true")
+
+    def physical_renames(self) -> Optional[Dict[str, str]]:
+        """physical column name -> logical name under columnMapping
+        ('name'/'id' modes stamp delta.columnMapping.physicalName into
+        each field's metadata; id-mode files also carry parquet field
+        ids, but the physicalName is always present and unique, so name
+        resolution covers both modes)."""
+        if self.column_mapping_mode == "none" or not self.schema_json:
+            return None
+        out = {}
+        for f in self.schema_json["fields"]:
+            meta = f.get("metadata") or {}
+            phys = meta.get("delta.columnMapping.physicalName")
+            out[phys or f["name"]] = f["name"]
+        return out
+
+    def has_deletion_vectors(self) -> bool:
+        return any(a.get("deletionVector") for a in self.files.values())
 
     @property
     def file_paths(self) -> List[str]:
@@ -128,8 +157,12 @@ def load_snapshot(table_path: str) -> Snapshot:
             f"{table_path} is not a Delta table (no {_LOG_DIR})")
     schema_json = None
     protocol = None
-    if meta is not None and meta.get("schemaString"):
-        schema_json = json.loads(meta["schemaString"])
+    config: Dict[str, str] = {}
+    if meta is not None:
+        if meta.get("schemaString"):
+            schema_json = json.loads(meta["schemaString"])
+        if meta.get("configuration"):
+            config = dict(meta["configuration"])
     last = cp_version
     for v in versions:
         last = v
@@ -147,9 +180,10 @@ def load_snapshot(table_path: str) -> Snapshot:
                     m = action["metaData"]
                     schema_json = json.loads(m["schemaString"])
                     parts = list(m.get("partitionColumns") or [])
+                    config = dict(m.get("configuration") or {})
                 elif "protocol" in action:
                     protocol = action["protocol"]
-    return Snapshot(last, schema_json, parts, files, protocol)
+    return Snapshot(last, schema_json, parts, files, protocol, config)
 
 
 _DELTA_TO_ARROW = {
@@ -220,9 +254,61 @@ def _delta_schema_to_arrow(schema_json: dict) -> pa.Schema:
 
 # ------------------------------------------------------------------ read
 
+class DeltaReadContext:
+    """Per-file read state for merge-on-read tables: deletion-vector
+    descriptors and columnMapping physical->logical renames
+    (GpuDeltaParquetFileFormat + GpuDeleteFilter roles)."""
+
+    def __init__(self, table_path: str, snap: "Snapshot"):
+        self.table_path = table_path
+        self.renames = snap.physical_renames()
+        self.dv_by_path = {
+            os.path.join(table_path, p): a["deletionVector"]
+            for p, a in snap.files.items() if a.get("deletionVector")}
+
+    def apply_renames(self, t: pa.Table) -> pa.Table:
+        if not self.renames:
+            return t
+        return t.rename_columns(
+            [self.renames.get(n, n) for n in t.column_names])
+
+    def physical_columns(self, logical) -> Optional[List[str]]:
+        """Requested logical columns -> physical parquet names (for
+        column-projection pushdown into the file read)."""
+        if logical is None:
+            return None
+        inv = {lg: ph for ph, lg in (self.renames or {}).items()}
+        return [inv.get(c, c) for c in logical]
+
+
+def read_data_file(ctx: DeltaReadContext, path: str,
+                   columns) -> pa.Table:
+    """One data file -> logical-schema table with deleted rows dropped.
+    Column projection pushes down to the parquet read (via the
+    physical-name mapping)."""
+    import numpy as np
+
+    from spark_rapids_tpu.lakehouse import deletion_vectors as dvmod
+
+    t = pq.read_table(path, columns=ctx.physical_columns(
+        list(columns) if columns else None))
+    t = ctx.apply_renames(t)
+    dv = ctx.dv_by_path.get(path)
+    if dv is not None:
+        deleted = dvmod.load_descriptor(ctx.table_path, dv)
+        keep = np.ones(t.num_rows, dtype=bool)
+        keep[deleted[deleted < t.num_rows]] = False
+        t = t.filter(pa.array(keep))
+    if columns:
+        t = t.select(list(columns))
+    return t
+
+
 def read_delta(session, path: str):
     """Delta scan: active-file parquet FileScan with the log's schema
-    (GpuDeltaParquetFileFormat role)."""
+    (GpuDeltaParquetFileFormat role). Tables with deletion vectors or
+    column mapping read through the per-file merge-on-read path
+    (fmt='delta'); plain tables keep the chunked parquet readers."""
     from spark_rapids_tpu.api.dataframe import DataFrame
     from spark_rapids_tpu.columnar.arrow_bridge import schema_from_arrow
     from spark_rapids_tpu.plan.logical import FileScan
@@ -242,6 +328,11 @@ def read_delta(session, path: str):
 
         at = _delta_schema_to_arrow(snap.schema_json)
         return DataFrame(LocalRelation(at.empty_table()), session)
+    if snap.has_deletion_vectors() or snap.column_mapping_mode != "none":
+        ctx = DeltaReadContext(path, snap)
+        return DataFrame(
+            FileScan("delta", files, schema, {"delta_ctx": ctx}),
+            session)
     return DataFrame(FileScan("parquet", files, schema, {}), session)
 
 
@@ -321,7 +412,7 @@ def write_checkpoint(table_path: str) -> bool:
             "schemaString": json.dumps(snap.schema_json)
             if snap.schema_json else "{}",
             "partitionColumns": list(snap.partition_cols),
-            "configuration": {},
+            "configuration": dict(snap.config),
             "createdTime": int(time.time() * 1000)}
     rows = [{"protocol": {
                 "minReaderVersion": int(
@@ -356,13 +447,14 @@ def write_checkpoint(table_path: str) -> bool:
     return True
 
 
-def _meta_action(schema: pa.Schema, partition_cols: List[str]) -> dict:
+def _meta_action(schema: pa.Schema, partition_cols: List[str],
+                 configuration: Optional[Dict[str, str]] = None) -> dict:
     return {"metaData": {
         "id": str(uuid.uuid4()),
         "format": {"provider": "parquet", "options": {}},
         "schemaString": _schema_to_delta(schema),
         "partitionColumns": partition_cols,
-        "configuration": {},
+        "configuration": dict(configuration or {}),
         "createdTime": int(time.time() * 1000),
     }}
 
@@ -418,8 +510,11 @@ def _write_data_files(table: pa.Table, table_path: str,
 
 
 def write_delta(df, path: str, mode: str = "error",
-                partition_by: Optional[List[str]] = None):
-    """append / overwrite commit (GpuOptimisticTransaction role)."""
+                partition_by: Optional[List[str]] = None,
+                properties: Optional[Dict[str, str]] = None):
+    """append / overwrite commit (GpuOptimisticTransaction role).
+    `properties` become metaData.configuration (e.g.
+    delta.enableDeletionVectors=true)."""
     if partition_by:
         raise NotImplementedError(
             "partitioned Delta writes are a follow-up")
@@ -433,17 +528,33 @@ def write_delta(df, path: str, mode: str = "error",
     actions: List[dict] = []
     if not exists:
         version = 0
-        actions.append(_meta_action(table.schema, []))
+        actions.append(_meta_action(table.schema, [], properties))
+        if properties and properties.get(
+                "delta.enableDeletionVectors", "").lower() == "true":
+            actions.append({"protocol": {
+                "minReaderVersion": 3, "minWriterVersion": 7,
+                "readerFeatures": ["deletionVectors"],
+                "writerFeatures": ["deletionVectors"]}})
     else:
         snap = load_snapshot(path)
         version = snap.version + 1
+        merged = {**snap.config, **(properties or {})}
         if mode == "overwrite":
             ts = int(time.time() * 1000)
-            actions.append(_meta_action(table.schema, []))
+            actions.append(_meta_action(table.schema, [], merged))
             for p in snap.file_paths:
                 actions.append({"remove": {
                     "path": p, "deletionTimestamp": ts,
                     "dataChange": True}})
+        elif properties:
+            # append with new properties: a metaData action carrying
+            # the merged configuration (schema unchanged)
+            meta = _meta_action(table.schema, list(snap.partition_cols),
+                                merged)
+            if snap.schema_json is not None:
+                meta["metaData"]["schemaString"] = json.dumps(
+                    snap.schema_json)
+            actions.append(meta)
     actions.extend(_write_data_files(table, path))
     actions.append({"commitInfo": {
         "timestamp": int(time.time() * 1000),
@@ -585,6 +696,15 @@ def _read_files(session, path: str, snap: Snapshot,
     if not rel_paths:
         return DataFrame(LocalRelation(at.empty_table()), session)
     files = [os.path.join(path, p) for p in rel_paths]
+    if (snap.column_mapping_mode != "none"
+            or any(snap.files[p].get("deletionVector")
+                   for p in rel_paths)):
+        # DML over merge-on-read files must apply DV masks and
+        # physical->logical renames, or a rewrite would resurrect
+        # deleted rows / miss renamed columns
+        ctx = DeltaReadContext(path, snap)
+        return DataFrame(FileScan("delta", files, schema_from_arrow(at),
+                                  {"delta_ctx": ctx}), session)
     return DataFrame(FileScan("parquet", files, schema_from_arrow(at),
                               {}), session)
 
@@ -624,8 +744,11 @@ class DeltaTable:
                                      _add_stats(snap.files[p]))]
 
     def delete(self, condition=None):
-        """DELETE FROM target WHERE condition — rewrites only candidate
-        files (GpuDeleteCommand's candidate-file selection)."""
+        """DELETE FROM target WHERE condition — with deletion vectors
+        enabled, matched rows are masked via DV sidecars and NO data
+        file is rewritten (merge-on-read; the Delta 2.4 fast path);
+        otherwise only candidate files rewrite (GpuDeleteCommand's
+        candidate-file selection)."""
         from spark_rapids_tpu.api import functions as F
 
         snap = load_snapshot(self.path)
@@ -636,10 +759,99 @@ class DeltaTable:
         cands = self._candidates(snap, condition.expr)
         if not cands:
             return  # provably no matching rows: no-op, no commit
+        if snap.deletion_vectors_enabled:
+            self._delete_via_dv(snap, condition, cands)
+            return
         kept = _read_files(self.session, self.path, snap,
                            cands).filter(~condition)
         self._rewrite(kept.collect_arrow(), "DELETE", snap=snap,
                       only_files=cands)
+
+    def _delete_via_dv(self, snap: Snapshot, condition,
+                       cands: List[str]) -> None:
+        """Write/extend deletion vectors for candidate files instead of
+        rewriting them. Per file: new DV = old DV union rows matching
+        the condition (positions are PHYSICAL file row indexes); a file
+        whose every row is deleted gets a plain remove action."""
+        import numpy as np
+
+        from spark_rapids_tpu.lakehouse import deletion_vectors as dvmod
+
+        ctx = DeltaReadContext(self.path, snap)
+        new_dv: Dict[str, np.ndarray] = {}
+        fully_deleted: List[str] = []
+        for rel in cands:
+            full = os.path.join(self.path, rel)
+            t = ctx.apply_renames(pq.read_table(full))
+            pos = pa.array(np.arange(t.num_rows, dtype=np.int64))
+            df = self.session.createDataFrame(
+                t.append_column("__pos", pos))
+            hit = df.filter(condition).select("__pos").collect_arrow()
+            matched = np.asarray(hit.column("__pos").to_pylist(),
+                                 dtype=np.int64)
+            old = snap.files[rel].get("deletionVector")
+            if old is not None:
+                prev = dvmod.load_descriptor(self.path, old)
+                matched = np.union1d(matched, prev)
+            else:
+                matched = np.unique(matched)
+            if len(matched) == 0:
+                continue
+            if len(matched) >= t.num_rows:
+                fully_deleted.append(rel)
+            else:
+                new_dv[rel] = matched
+        if not new_dv and not fully_deleted:
+            return  # stats said maybe, rows said no: no-op
+        ts = int(time.time() * 1000)
+        actions: List[dict] = []
+        old_proto = snap.protocol or {}
+        rfeats = set(old_proto.get("readerFeatures") or [])
+        wfeats = set(old_proto.get("writerFeatures") or [])
+        if "deletionVectors" not in rfeats:
+            # upgrading to the table-features protocol (3,7) requires
+            # every ACTIVE feature to be listed explicitly — merge the
+            # existing lists and re-declare legacy-implicit features
+            # still active per the metadata, don't replace wholesale
+            rfeats.add("deletionVectors")
+            wfeats.add("deletionVectors")
+            if snap.column_mapping_mode != "none":
+                rfeats.add("columnMapping")
+                wfeats.add("columnMapping")
+            actions.append({"protocol": {
+                "minReaderVersion": 3, "minWriterVersion": 7,
+                "readerFeatures": sorted(rfeats),
+                "writerFeatures": sorted(wfeats)}})
+        # small DVs inline into the commit line itself; larger ones
+        # share one sidecar file
+        descs: Dict[str, dict] = {}
+        to_file: Dict[str, "np.ndarray"] = {}
+        for rel, idx in new_dv.items():
+            inline = dvmod.inline_descriptor(idx)
+            if inline is not None:
+                descs[rel] = inline
+            else:
+                to_file[rel] = idx
+        if to_file:
+            descs.update(dvmod.write_dv_file(self.path, to_file))
+        for rel in fully_deleted:
+            actions.append({"remove": {
+                "path": rel, "deletionTimestamp": ts,
+                "dataChange": True}})
+        for rel, desc in descs.items():
+            add = dict(snap.files[rel])
+            add["deletionVector"] = desc
+            add["modificationTime"] = ts
+            add["dataChange"] = True
+            actions.append({"remove": {
+                "path": rel, "deletionTimestamp": ts,
+                "dataChange": True}})
+            actions.append({"add": add})
+        actions.append({"commitInfo": {
+            "timestamp": ts, "operation": "DELETE",
+            "operationParameters": {"deletionVectors": True},
+            "readVersion": snap.version}})
+        _commit(self.path, snap.version + 1, actions)
 
     def update(self, condition, set_exprs: Dict[str, object]):
         """UPDATE target SET col = expr WHERE condition — candidate

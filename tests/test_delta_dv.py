@@ -1,0 +1,232 @@
+"""Delta deletion vectors + column mapping (merge-on-read depth).
+
+Reference: delta protocol PROTOCOL.md deletion-vector format;
+delta-24x GpuDeleteCommand / GpuDeltaParquetFileFormat; column mapping
+per delta.columnMapping.mode with physicalName field metadata.
+"""
+
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSparkSession
+from spark_rapids_tpu.lakehouse import deletion_vectors as dvmod
+from spark_rapids_tpu.lakehouse.delta import DeltaTable, load_snapshot
+
+
+@pytest.fixture()
+def spark():
+    s = TpuSparkSession({"spark.sql.shuffle.partitions": 2})
+    yield s
+    s.stop()
+
+
+def _mk_dv_table(spark, path, n=1000):
+    t = pa.table({
+        "id": pa.array(np.arange(n), type=pa.int64()),
+        "v": pa.array(np.arange(n) % 7, type=pa.int64()),
+    })
+    (spark.createDataFrame(t).write.format("delta")
+     .option("delta.enableDeletionVectors", "true").save(path))
+    return t
+
+
+def test_dv_roundtrip_foreign_run_container():
+    # parse a hand-built SERIAL_COOKIE (run-container) bitmap — the
+    # layout other writers emit for dense deletes
+    import struct
+
+    size = 1
+    cookie = 12347 | ((size - 1) << 16)
+    buf = struct.pack("<I", cookie)
+    buf += bytes([0b1])              # run flag for container 0
+    buf += struct.pack("<HH", 0, 9)  # key 0, cardinality-1 = 9
+    # size < NO_OFFSET_THRESHOLD and run flags present: no offsets
+    buf += struct.pack("<H", 2)      # 2 runs
+    buf += struct.pack("<HH", 3, 4)  # rows 3..7
+    buf += struct.pack("<HH", 100, 4)  # rows 100..104
+    blob = struct.pack("<iq", dvmod.MAGIC, 1) + buf
+    got = dvmod.parse_blob(blob)
+    want = np.array([3, 4, 5, 6, 7, 100, 101, 102, 103, 104])
+    assert np.array_equal(got, want)
+
+
+def test_dv_bitmap_container_roundtrip():
+    idx = np.arange(0, 30000, 2, dtype=np.int64)  # card > 4096 -> bitmap
+    assert np.array_equal(dvmod.parse_blob(dvmod.serialize_blob(idx)),
+                          idx)
+
+
+def test_dv_empty_2_32_bucket_roundtrip():
+    # indexes spanning a fully-empty 2^32 bucket must serialize a valid
+    # EMPTY bitmap for it (regression: a spurious offset corrupted the
+    # stream -> 'bad roaring cookie')
+    idx = np.array([5, (2 << 32) + 3], dtype=np.int64)
+    assert np.array_equal(dvmod.parse_blob(dvmod.serialize_blob(idx)),
+                          idx)
+
+
+def test_write_properties_merge_on_overwrite_and_append(spark, tmp_path):
+    path = str(tmp_path / "props")
+    t = pa.table({"a": pa.array([1, 2], type=pa.int64())})
+    (spark.createDataFrame(t).write.format("delta")
+     .option("delta.enableDeletionVectors", "true").save(path))
+    # overwrite with a DIFFERENT property must keep the old one
+    (spark.createDataFrame(t).write.format("delta").mode("overwrite")
+     .option("delta.appendOnly", "false").save(path))
+    snap = load_snapshot(path)
+    assert snap.deletion_vectors_enabled
+    assert snap.config.get("delta.appendOnly") == "false"
+    # append carrying a property lands it too
+    (spark.createDataFrame(t).write.format("delta").mode("append")
+     .option("delta.x", "1").save(path))
+    snap = load_snapshot(path)
+    assert snap.config.get("delta.x") == "1"
+    assert snap.deletion_vectors_enabled
+
+
+def test_delete_writes_dv_not_rewrite(spark, tmp_path):
+    path = str(tmp_path / "dvt")
+    _mk_dv_table(spark, path)
+    before_files = sorted(f for f in os.listdir(path)
+                          if f.endswith(".parquet"))
+    dt = DeltaTable.forPath(spark, path)
+    dt.delete(F.col("v") == 3)
+    snap = dt._snapshot() if hasattr(dt, "_snapshot") else \
+        load_snapshot(path)
+    # data files untouched: same parquet set, adds now carry DVs
+    after_files = sorted(f for f in os.listdir(path)
+                         if f.endswith(".parquet"))
+    assert after_files == before_files
+    assert all(a.get("deletionVector") for a in snap.files.values())
+    got = (spark.read.format("delta").load(path)
+           .collect_arrow().sort_by("id"))
+    assert got.num_rows == 1000 - len([i for i in range(1000)
+                                       if i % 7 == 3])
+    assert 3 not in set(got.column("v").to_pylist())
+
+
+def test_second_delete_unions_dv(spark, tmp_path):
+    path = str(tmp_path / "dvt2")
+    _mk_dv_table(spark, path)
+    dt = DeltaTable.forPath(spark, path)
+    dt.delete(F.col("v") == 3)
+    dt.delete(F.col("v") == 5)
+    got = spark.read.format("delta").load(path).collect_arrow()
+    vs = set(got.column("v").to_pylist())
+    assert 3 not in vs and 5 not in vs
+    assert got.num_rows == sum(1 for i in range(1000)
+                               if i % 7 not in (3, 5))
+
+
+def test_full_file_delete_emits_remove(spark, tmp_path):
+    path = str(tmp_path / "dvt3")
+    _mk_dv_table(spark, path)
+    dt = DeltaTable.forPath(spark, path)
+    dt.delete(F.col("id") >= 0)  # everything
+    snap = load_snapshot(path)
+    assert snap.files == {}
+    got = spark.read.format("delta").load(path).collect_arrow()
+    assert got.num_rows == 0
+
+
+def test_update_on_dv_table_does_not_resurrect(spark, tmp_path):
+    path = str(tmp_path / "dvt4")
+    _mk_dv_table(spark, path)
+    dt = DeltaTable.forPath(spark, path)
+    dt.delete(F.col("v") == 3)
+    dt.update(F.col("v") == 1, {"v": F.lit(100)})
+    got = spark.read.format("delta").load(path).collect_arrow()
+    vs = got.column("v").to_pylist()
+    assert 3 not in set(vs), "deleted rows resurrected by UPDATE"
+    assert 1 not in set(vs)
+    assert vs.count(100) == sum(1 for i in range(1000) if i % 7 == 1)
+    assert got.num_rows == sum(1 for i in range(1000) if i % 7 != 3)
+
+
+def test_checkpoint_skips_dv_tables_and_keeps_config(spark, tmp_path):
+    from spark_rapids_tpu.lakehouse.delta import write_checkpoint
+
+    path = str(tmp_path / "dvt5")
+    _mk_dv_table(spark, path)
+    dt = DeltaTable.forPath(spark, path)
+    dt.delete(F.col("v") == 3)
+    # adds carry deletionVector: the checkpoint writer must refuse
+    # rather than silently drop the DV (which would resurrect rows)
+    assert write_checkpoint(path) is False
+    snap = load_snapshot(path)
+    assert snap.deletion_vectors_enabled
+
+
+def _write_mapped_table(path):
+    """Hand-crafted column-mapping table: physical parquet names differ
+    from logical schema names (what Spark writes under
+    delta.columnMapping.mode=name)."""
+    os.makedirs(os.path.join(path, "_delta_log"))
+    t = pa.table({
+        "col-9aab0d": pa.array([1, 2, 3], type=pa.int64()),
+        "col-7ffe11": pa.array(["a", "b", "c"], type=pa.string()),
+    })
+    pq.write_table(t, os.path.join(path, "part-0.parquet"))
+    schema = {"type": "struct", "fields": [
+        {"name": "id", "type": "long", "nullable": True, "metadata": {
+            "delta.columnMapping.id": 1,
+            "delta.columnMapping.physicalName": "col-9aab0d"}},
+        {"name": "name", "type": "string", "nullable": True,
+         "metadata": {
+             "delta.columnMapping.id": 2,
+             "delta.columnMapping.physicalName": "col-7ffe11"}},
+    ]}
+    actions = [
+        {"protocol": {"minReaderVersion": 2, "minWriterVersion": 5}},
+        {"metaData": {
+            "id": "m", "format": {"provider": "parquet", "options": {}},
+            "schemaString": json.dumps(schema), "partitionColumns": [],
+            "configuration": {"delta.columnMapping.mode": "name"},
+            "createdTime": 0}},
+        {"add": {"path": "part-0.parquet", "partitionValues": {},
+                 "size": os.path.getsize(
+                     os.path.join(path, "part-0.parquet")),
+                 "modificationTime": 0, "dataChange": True}},
+    ]
+    with open(os.path.join(path, "_delta_log",
+                           "00000000000000000000.json"), "w") as f:
+        for a in actions:
+            f.write(json.dumps(a) + "\n")
+    return schema
+
+
+def test_column_mapping_read(spark, tmp_path):
+    path = str(tmp_path / "mapped")
+    _write_mapped_table(path)
+    got = spark.read.format("delta").load(path).collect_arrow()
+    assert got.column_names == ["id", "name"]
+    assert got.column("id").to_pylist() == [1, 2, 3]
+    assert got.column("name").to_pylist() == ["a", "b", "c"]
+    # projection + filter through the engine
+    out = (spark.read.format("delta").load(path)
+           .filter(F.col("id") > 1).select("name").collect_arrow())
+    assert sorted(out.column("name").to_pylist()) == ["b", "c"]
+
+
+def test_column_mapping_rename_is_metadata_only(spark, tmp_path):
+    path = str(tmp_path / "mapped2")
+    schema = _write_mapped_table(path)
+    # rename logical column 'name' -> 'label': metaData-only commit
+    schema["fields"][1]["name"] = "label"
+    action = {"metaData": {
+        "id": "m", "format": {"provider": "parquet", "options": {}},
+        "schemaString": json.dumps(schema), "partitionColumns": [],
+        "configuration": {"delta.columnMapping.mode": "name"},
+        "createdTime": 0}}
+    with open(os.path.join(path, "_delta_log",
+                           "00000000000000000001.json"), "w") as f:
+        f.write(json.dumps(action) + "\n")
+    got = spark.read.format("delta").load(path).collect_arrow()
+    assert got.column_names == ["id", "label"]
+    assert got.column("label").to_pylist() == ["a", "b", "c"]
