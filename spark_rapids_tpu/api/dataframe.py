@@ -16,7 +16,7 @@ from spark_rapids_tpu.expr.core import Expression
 from spark_rapids_tpu.plan import logical as L
 
 
-def _resolve(expr, schema) -> Expression:
+def _resolve(expr, schema, session=None) -> Expression:
     """Replace UnresolvedColumn markers with BoundReferences; attempt
     UDF bytecode compilation once argument types are concrete."""
     if isinstance(expr, UnresolvedColumn):
@@ -24,12 +24,28 @@ def _resolve(expr, schema) -> Expression:
         f = schema.fields[i]
         return BoundReference(i, f.dataType, f.nullable)
     if isinstance(expr, Expression):
-        new_children = [_resolve(c, schema) for c in expr.children]
+        new_children = [_resolve(c, schema, session)
+                        for c in expr.children]
         node = expr.with_children(new_children)
         if getattr(node, "_wants_compile", False):
+            from spark_rapids_tpu.config import rapids_conf as _rc
             from spark_rapids_tpu.expr import Cast
             from spark_rapids_tpu.udf import UdfCompileError, compile_udf
 
+            # the OWNING session's conf (fall back to the process
+            # active one only when no session is threaded through)
+            s = session
+            if s is None:
+                from spark_rapids_tpu.api.session import TpuSparkSession
+
+                s = TpuSparkSession.active()
+            if (s is not None and not
+                    s.rapids_conf.get(_rc.UDF_COMPILER_ENABLED)):
+                node.compile_error = (
+                    "udf compiler disabled via "
+                    "spark.rapids.sql.udfCompiler.enabled=false")
+                node._wants_compile = False
+                return node
             try:
                 compiled = compile_udf(node.fn, new_children)
                 if compiled.dtype != node.dtype:
@@ -144,8 +160,9 @@ class DataFrame:
         if isinstance(c, str):
             return _stamp_session(self[c].expr, self.session)
         if isinstance(c, Column):
-            return _stamp_session(_resolve(c.expr, self.schema),
-                                  self.session)
+            return _stamp_session(
+                _resolve(c.expr, self.schema, self.session),
+                self.session)
         raise TypeError(repr(c))
 
     def select(self, *cols) -> "DataFrame":
@@ -483,8 +500,9 @@ class DataFrame:
         for c, asc in zip(cols, asc_list):
             if isinstance(c, SortColumn):
                 orders.append(L.SortOrder(
-                    _stamp_session(_resolve(c.expr, self.schema),
-                                   self.session),
+                    _stamp_session(
+                        _resolve(c.expr, self.schema, self.session),
+                        self.session),
                     c.ascending, c.nulls_first))
                 continue
             a = True if asc is None else bool(asc)

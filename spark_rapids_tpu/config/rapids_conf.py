@@ -69,6 +69,82 @@ def conf(key, default, doc, conf_type=str, **kw) -> ConfEntry:
 # --- Core entries (names follow the reference's spark.rapids.* namespace,
 # --- re-rooted at spark.rapids.tpu where TPU-specific). ---
 
+def _format_read_enable(fmt: str, extra: str = "") -> ConfEntry:
+    return conf(
+        f"spark.rapids.sql.format.{fmt}.read.enabled", True,
+        f"Accelerate {fmt} reads; false falls the scan back to the CPU "
+        f"path (reference per-format enable family).{extra}", bool)
+
+
+PARQUET_READ_ENABLED = _format_read_enable("parquet")
+ORC_READ_ENABLED = _format_read_enable("orc")
+CSV_READ_ENABLED = _format_read_enable("csv")
+JSON_READ_ENABLED = _format_read_enable("json")
+AVRO_READ_ENABLED = _format_read_enable("avro")
+HIVETEXT_READ_ENABLED = _format_read_enable("hive.text")
+DELTA_READ_ENABLED = _format_read_enable(
+    "delta", " Covers merge-on-read (deletion vector / column mapping) "
+    "scans.")
+ICEBERG_READ_ENABLED = _format_read_enable("iceberg")
+_FMT_READ_ENTRIES = {
+    "parquet": PARQUET_READ_ENABLED, "orc": ORC_READ_ENABLED,
+    "csv": CSV_READ_ENABLED, "json": JSON_READ_ENABLED,
+    "avro": AVRO_READ_ENABLED, "hivetext": HIVETEXT_READ_ENABLED,
+    "delta": DELTA_READ_ENABLED, "iceberg": ICEBERG_READ_ENABLED,
+}
+REGEXP_ENABLED = conf(
+    "spark.rapids.sql.regexp.enabled", True,
+    "Transpile Java regular expressions to the device DFA engine "
+    "(regex/transpiler.py); false evaluates all regex expressions on "
+    "the CPU path (reference spark.rapids.sql.regexp.enabled).", bool)
+UDF_COMPILER_ENABLED = conf(
+    "spark.rapids.sql.udfCompiler.enabled", True,
+    "Compile Python UDF bytecode into device expressions "
+    "(udf/compiler.py, the udf-compiler role); false runs every UDF "
+    "as a rowwise host fallback.", bool)
+FUSED_EXPANSION = conf(
+    "spark.rapids.sql.fusedExec.expansionFactor", 4,
+    "Initial output-capacity multiplier for data-dependent fused "
+    "operators (joins, explode); overflow doubles it and re-runs.",
+    int)
+FUSED_MAX_EXPANSION = conf(
+    "spark.rapids.sql.fusedExec.maxExpansionFactor", 256,
+    "Give up (fall to the out-of-core engine) when the expansion "
+    "retry loop reaches this factor.", int)
+FUSED_GROUP_CAP = conf(
+    "spark.rapids.sql.fusedExec.groupCapacity", 1 << 16,
+    "Static capacity bucket fused partial-aggregate outputs shrink "
+    "to; more groups than this overflows into an expansion retry.",
+    int)
+FUSED_SINGLE_SYNC_FETCH_BYTES = conf(
+    "spark.rapids.sql.fusedExec.singleSyncFetchMaxBytes", 16 << 20,
+    "Results at most this large fetch rows+flags+data in ONE link "
+    "roundtrip (host-side slicing); larger results pay the extra "
+    "roundtrips to avoid fetching dead capacity.", int)
+AGG_MATMUL_MAX_BINS = conf(
+    "spark.rapids.sql.agg.matmulSegments.maxBins", 1 << 14,
+    "Largest static bin count lowered to the one-hot matmul "
+    "reductions; larger key spaces use the sorted segmented path.",
+    int, checker=lambda v: 1 <= v <= (1 << 17))
+AGG_MATMUL_CHUNK_ROWS = conf(
+    "spark.rapids.sql.agg.matmulSegments.chunkRows", 1 << 15,
+    "Rows per matmul-reduction chunk (the lax.scan step). Smaller "
+    "chunks tighten f32 accumulation error and int-exactness bounds "
+    "at more scan iterations. Must stay below 2^24: per-chunk counts "
+    "accumulate exactly in f32 only up to that.", int,
+    checker=lambda v: 1024 <= v < (1 << 24))
+READER_COALESCE_BYTES = conf(
+    "spark.rapids.sql.reader.coalesceSizeBytes", 128 << 20,
+    "Target bytes per multi-file reader task (the COALESCING reader's "
+    "stitch size, GpuMultiFileReader role).", int)
+DELTA_CHECKPOINT_INTERVAL = conf(
+    "spark.rapids.lakehouse.delta.checkpointInterval", 10,
+    "Write a parquet checkpoint every N Delta commits (Delta "
+    "_last_checkpoint protocol).", int)
+DELTA_DV_INLINE_MAX_BYTES = conf(
+    "spark.rapids.lakehouse.delta.deletionVector.inlineMaxBytes", 512,
+    "Deletion vectors at most this large inline into the commit line "
+    "(storageType 'i'); larger ones share a sidecar file.", int)
 AGG_MATMUL_ENABLED = conf(
     "spark.rapids.sql.agg.matmulSegments.enabled", True,
     "Lower binned group-by reductions to one-hot matmuls on the MXU "

@@ -190,11 +190,19 @@ class FusedSingleChipExecutor:
     """Compile + run one physical plan as a few fused XLA programs on
     the default (single) device."""
 
-    def __init__(self, conf=None, expansion: int = 4,
-                 group_cap: int = 1 << 16):
+    def __init__(self, conf=None, expansion: Optional[int] = None,
+                 group_cap: Optional[int] = None):
+        from spark_rapids_tpu.config import rapids_conf as rc
+
         self.conf = conf
-        self._expansion = expansion
-        self._group_cap = group_cap
+
+        def c(entry):
+            return conf.get(entry) if conf is not None else entry.default
+
+        self._expansion = expansion or c(rc.FUSED_EXPANSION)
+        self._group_cap = group_cap or c(rc.FUSED_GROUP_CAP)
+        self._max_expansion = c(rc.FUSED_MAX_EXPANSION)
+        self._fetch_fused_bytes = c(rc.FUSED_SINGLE_SYNC_FETCH_BYTES)
 
     # --- source preparation (once; survives expansion retries) ---
 
@@ -343,7 +351,7 @@ class FusedSingleChipExecutor:
                     return self._run(phys, expansion, group_cap,
                                      as_parts=as_parts)
                 except TpuSplitAndRetryOOM:
-                    if expansion >= 256:
+                    if expansion >= self._max_expansion:
                         raise
                     expansion *= 2
                     group_cap *= 4
@@ -588,7 +596,7 @@ class FusedSingleChipExecutor:
             result = run_program("collect1", ("collect1",), one_fn, parts)
         flags_arr = (jnp.stack([f.reshape(()) for f in flags])
                      if flags else jnp.zeros((1,), bool))
-        if result.device_size_bytes() <= (16 << 20):
+        if result.device_size_bytes() <= self._fetch_fused_bytes:
             # small result: ONE roundtrip for rows+flags+data (the
             # standard path pays three — row_count, flags, fetch — and
             # each costs ~100-180 ms on tunneled links)

@@ -175,7 +175,7 @@ class TpuFileScanExec(PhysicalPlan):
         self._batch_rows = conf.get(rc.MAX_READER_BATCH_SIZE_ROWS)
         self._nthreads = conf.get(rc.MULTITHREADED_READ_NUM_THREADS)
         self._strategy = conf.get(rc.PARQUET_READER_TYPE)
-        coalesce_bytes = 128 << 20
+        coalesce_bytes = conf.get(rc.READER_COALESCE_BYTES)
         self._part_spec = self.options.get("partition_spec")
         if fmt in ("iceberg", "delta"):
             # per-file tasks: each data file carries its own delete
@@ -761,7 +761,12 @@ class TpuHashAggregateExec(PhysicalPlan):
         # baked at plan time: `detached` strips conf from the cached
         # bound methods, so trace-time conf reads would always see None
         self._mm_ok = conf is None or conf.get(rc.AGG_MATMUL_ENABLED)
-        base_key = ("agg", mode, self._mm_ok, aliases_key(grouping),
+        self._mm_max_bins = (conf.get(rc.AGG_MATMUL_MAX_BINS)
+                             if conf is not None else None)
+        self._mm_chunk = (conf.get(rc.AGG_MATMUL_CHUNK_ROWS)
+                          if conf is not None else None)
+        base_key = ("agg", mode, self._mm_ok, self._mm_max_bins,
+                    self._mm_chunk, aliases_key(grouping),
                     aliases_key(aggs))
         det = detached(self)
         if any(not a.children[0].jittable for a in aggs):
@@ -896,7 +901,9 @@ class TpuHashAggregateExec(PhysicalPlan):
         mm_ok = self._mm_ok
 
         with segmented.unsorted_gids(), (
-                segmented.binned_bins(stride) if mm_ok else nullcontext()):
+                segmented.binned_bins(stride, self._mm_max_bins,
+                                      self._mm_chunk)
+                if mm_ok else nullcontext()):
             out_cols: List[DeviceColumn] = []
             # analytic key decode: bin index -> key values, in bin space
             idx = jnp.arange(bcap, dtype=jnp.int64)
@@ -964,7 +971,7 @@ class TpuHashAggregateExec(PhysicalPlan):
             return None
         weights: List[jnp.ndarray] = []
         accs: List = []
-        chunk = segmented._MM_CHUNK
+        chunk = segmented.mm_chunk()
         guard = False
         slots = []  # ("sum", w_i, cnt_i, out_t, out_np) | ("count", cnt_i)
         count_idx_by_id: Dict[int, int] = {}

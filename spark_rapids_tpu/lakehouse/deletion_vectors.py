@@ -259,13 +259,14 @@ def write_dv_file(table_path: str, indexes_by_key: Dict[str, np.ndarray]
     return descriptors
 
 
-def inline_descriptor(indexes: np.ndarray) -> Optional[dict]:
+def inline_descriptor(indexes: np.ndarray,
+                      max_bytes: int = 512) -> Optional[dict]:
     """Inline ('i') descriptor when the blob is small enough (the
     protocol caps inline DVs well under a commit line's practical
     size); None -> caller should use a DV file."""
     blob = serialize_blob(indexes)
     pad = (-len(blob)) % 4
-    if len(blob) + pad > 512:
+    if len(blob) + pad > max_bytes:
         return None
     return {
         "storageType": "i",

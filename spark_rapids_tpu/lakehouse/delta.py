@@ -78,6 +78,7 @@ class Snapshot:
         self.files = files  # relative path -> add action
         self.protocol = protocol  # last protocol action seen
         self.config = config or {}  # metaData.configuration
+        self.meta_id = None  # the table's stable metaData.id
 
     @property
     def column_mapping_mode(self) -> str:
@@ -112,12 +113,13 @@ class Snapshot:
 
 
 def _read_checkpoint(table_path: str) -> Tuple[int, Dict[str, dict],
-                                               Optional[dict], List[str]]:
-    """-> (checkpoint version, files, metaData, partition_cols) or
-    (-1, {}, None, [])."""
+                                               Optional[dict], List[str],
+                                               Optional[dict]]:
+    """-> (checkpoint version, files, metaData, partition_cols,
+    protocol) or (-1, {}, None, [], None)."""
     lc = os.path.join(_log_path(table_path), "_last_checkpoint")
     if not os.path.exists(lc):
-        return -1, {}, None, []
+        return -1, {}, None, [], None
     with open(lc) as f:
         info = json.load(f)
     v = int(info["version"])
@@ -125,6 +127,7 @@ def _read_checkpoint(table_path: str) -> Tuple[int, Dict[str, dict],
                       f"{v:020d}.checkpoint.parquet")
     files: Dict[str, dict] = {}
     meta = None
+    protocol = None
     parts: List[str] = []
     t = pq.read_table(cp)
     for row in t.to_pylist():
@@ -146,23 +149,28 @@ def _read_checkpoint(table_path: str) -> Tuple[int, Dict[str, dict],
                 meta["configuration"] = dict(meta["configuration"])
             parts = [c for c in (meta.get("partitionColumns") or [])
                      if c]
-    return v, files, meta, parts
+        if row.get("protocol"):
+            protocol = {k: v2 for k, v2 in dict(row["protocol"]).items()
+                        if v2 is not None}
+    return v, files, meta, parts, protocol
 
 
 def load_snapshot(table_path: str) -> Snapshot:
-    cp_version, files, meta, parts = _read_checkpoint(table_path)
+    cp_version, files, meta, parts, protocol = _read_checkpoint(
+        table_path)
     versions = [v for v in _list_versions(table_path) if v > cp_version]
     if cp_version < 0 and not versions:
         raise FileNotFoundError(
             f"{table_path} is not a Delta table (no {_LOG_DIR})")
     schema_json = None
-    protocol = None
     config: Dict[str, str] = {}
+    meta_id = None
     if meta is not None:
         if meta.get("schemaString"):
             schema_json = json.loads(meta["schemaString"])
         if meta.get("configuration"):
             config = dict(meta["configuration"])
+        meta_id = meta.get("id")
     last = cp_version
     for v in versions:
         last = v
@@ -181,9 +189,12 @@ def load_snapshot(table_path: str) -> Snapshot:
                     schema_json = json.loads(m["schemaString"])
                     parts = list(m.get("partitionColumns") or [])
                     config = dict(m.get("configuration") or {})
+                    meta_id = m.get("id") or meta_id
                 elif "protocol" in action:
                     protocol = action["protocol"]
-    return Snapshot(last, schema_json, parts, files, protocol, config)
+    snap = Snapshot(last, schema_json, parts, files, protocol, config)
+    snap.meta_id = meta_id
+    return snap
 
 
 _DELTA_TO_ARROW = {
@@ -338,10 +349,26 @@ def read_delta(session, path: str):
 
 # ----------------------------------------------------------------- write
 
-CHECKPOINT_INTERVAL = 10
+def _default_ckpt_interval() -> int:
+    from spark_rapids_tpu.config import rapids_conf as rc
+
+    return rc.DELTA_CHECKPOINT_INTERVAL.default
 
 
-def _commit(table_path: str, version: int, actions: List[dict]):
+# module-level alias kept for sessionless callers/tests; the single
+# source of truth is the conf entry's default
+CHECKPOINT_INTERVAL = _default_ckpt_interval()
+
+
+def _ckpt_interval(session) -> Optional[int]:
+    from spark_rapids_tpu.config import rapids_conf as rc
+
+    c = getattr(session, "rapids_conf", None)
+    return c.get(rc.DELTA_CHECKPOINT_INTERVAL) if c is not None else None
+
+
+def _commit(table_path: str, version: int, actions: List[dict],
+            checkpoint_interval: Optional[int] = None):
     """Write one atomic commit file (OptimisticTransaction.commit);
     every CHECKPOINT_INTERVAL versions also writes a parquet checkpoint
     + _last_checkpoint pointer so log replay stays O(interval)."""
@@ -358,14 +385,20 @@ def _commit(table_path: str, version: int, actions: List[dict]):
         raise RuntimeError(
             f"concurrent commit conflict at version {version}")
     os.unlink(tmp)
-    if version > 0 and version % CHECKPOINT_INTERVAL == 0:
+    if checkpoint_interval is None:
+        checkpoint_interval = CHECKPOINT_INTERVAL
+    # interval <= 0 disables checkpointing entirely
+    if (checkpoint_interval > 0 and version > 0
+            and version % checkpoint_interval == 0):
         write_checkpoint(table_path)
 
 
 _CP_MAP = pa.map_(pa.string(), pa.string())
 _CP_SCHEMA = pa.schema([
     ("protocol", pa.struct([("minReaderVersion", pa.int32()),
-                            ("minWriterVersion", pa.int32())])),
+                            ("minWriterVersion", pa.int32()),
+                            ("readerFeatures", pa.list_(pa.string())),
+                            ("writerFeatures", pa.list_(pa.string()))])),
     ("metaData", pa.struct([
         ("id", pa.string()),
         ("format", pa.struct([("provider", pa.string()),
@@ -407,7 +440,7 @@ def write_checkpoint(table_path: str) -> bool:
             return False
     protocol = snap.protocol or {"minReaderVersion": 1,
                                  "minWriterVersion": 2}
-    meta = {"id": str(uuid.uuid4()),
+    meta = {"id": snap.meta_id or str(uuid.uuid4()),
             "format": {"provider": "parquet", "options": {}},
             "schemaString": json.dumps(snap.schema_json)
             if snap.schema_json else "{}",
@@ -418,7 +451,11 @@ def write_checkpoint(table_path: str) -> bool:
                 "minReaderVersion": int(
                     protocol.get("minReaderVersion", 1)),
                 "minWriterVersion": int(
-                    protocol.get("minWriterVersion", 2))},
+                    protocol.get("minWriterVersion", 2)),
+                # feature-based protocols REQUIRE the lists in the
+                # checkpoint too; None for legacy protocols
+                "readerFeatures": protocol.get("readerFeatures"),
+                "writerFeatures": protocol.get("writerFeatures")},
              "metaData": None, "add": None},
             {"protocol": None, "metaData": meta, "add": None}]
     for add in snap.files.values():
@@ -448,9 +485,13 @@ def write_checkpoint(table_path: str) -> bool:
 
 
 def _meta_action(schema: pa.Schema, partition_cols: List[str],
-                 configuration: Optional[Dict[str, str]] = None) -> dict:
+                 configuration: Optional[Dict[str, str]] = None,
+                 table_id: Optional[str] = None) -> dict:
+    # metaData.id is the table's STABLE identity — external consumers
+    # (streaming sources, CDC readers) abort when it changes, so
+    # existing tables must carry theirs forward
     return {"metaData": {
-        "id": str(uuid.uuid4()),
+        "id": table_id or str(uuid.uuid4()),
         "format": {"provider": "parquet", "options": {}},
         "schemaString": _schema_to_delta(schema),
         "partitionColumns": partition_cols,
@@ -541,7 +582,8 @@ def write_delta(df, path: str, mode: str = "error",
         merged = {**snap.config, **(properties or {})}
         if mode == "overwrite":
             ts = int(time.time() * 1000)
-            actions.append(_meta_action(table.schema, [], merged))
+            actions.append(_meta_action(table.schema, [], merged,
+                                        table_id=snap.meta_id))
             for p in snap.file_paths:
                 actions.append({"remove": {
                     "path": p, "deletionTimestamp": ts,
@@ -550,7 +592,7 @@ def write_delta(df, path: str, mode: str = "error",
             # append with new properties: a metaData action carrying
             # the merged configuration (schema unchanged)
             meta = _meta_action(table.schema, list(snap.partition_cols),
-                                merged)
+                                merged, table_id=snap.meta_id)
             if snap.schema_json is not None:
                 meta["metaData"]["schemaString"] = json.dumps(
                     snap.schema_json)
@@ -561,7 +603,8 @@ def write_delta(df, path: str, mode: str = "error",
         "operation": "WRITE",
         "operationParameters": {"mode": mode.upper()},
     }})
-    _commit(path, version, actions)
+    _commit(path, version, actions,
+            _ckpt_interval(getattr(df, "session", None)))
 
 
 # ------------------------------------------------- merge / delete / update
@@ -810,9 +853,23 @@ class DeltaTable:
         wfeats = set(old_proto.get("writerFeatures") or [])
         if "deletionVectors" not in rfeats:
             # upgrading to the table-features protocol (3,7) requires
-            # every ACTIVE feature to be listed explicitly — merge the
-            # existing lists and re-declare legacy-implicit features
-            # still active per the metadata, don't replace wholesale
+            # every ACTIVE feature to be listed explicitly: merge the
+            # existing lists AND re-declare the features the legacy
+            # version numbers implied (Delta spec table-features
+            # upgrade rules), don't replace wholesale
+            _LEGACY_WRITER = {
+                2: ["appendOnly", "invariants"],
+                3: ["checkConstraints"],
+                4: ["changeDataFeed", "generatedColumns"],
+                5: ["columnMapping"],
+                6: ["identityColumns"],
+            }
+            old_w = int(old_proto.get("minWriterVersion", 2))
+            for v, feats in _LEGACY_WRITER.items():
+                if old_w >= v and old_w < 7:
+                    wfeats.update(feats)
+            if int(old_proto.get("minReaderVersion", 1)) == 2:
+                rfeats.add("columnMapping")
             rfeats.add("deletionVectors")
             wfeats.add("deletionVectors")
             if snap.column_mapping_mode != "none":
@@ -824,10 +881,16 @@ class DeltaTable:
                 "writerFeatures": sorted(wfeats)}})
         # small DVs inline into the commit line itself; larger ones
         # share one sidecar file
+        from spark_rapids_tpu.config import rapids_conf as rc
+
+        inline_max = (self.session.rapids_conf.get(
+            rc.DELTA_DV_INLINE_MAX_BYTES)
+            if getattr(self.session, "rapids_conf", None) is not None
+            else rc.DELTA_DV_INLINE_MAX_BYTES.default)
         descs: Dict[str, dict] = {}
         to_file: Dict[str, "np.ndarray"] = {}
         for rel, idx in new_dv.items():
-            inline = dvmod.inline_descriptor(idx)
+            inline = dvmod.inline_descriptor(idx, max_bytes=inline_max)
             if inline is not None:
                 descs[rel] = inline
             else:
@@ -851,7 +914,8 @@ class DeltaTable:
             "timestamp": ts, "operation": "DELETE",
             "operationParameters": {"deletionVectors": True},
             "readVersion": snap.version}})
-        _commit(self.path, snap.version + 1, actions)
+        _commit(self.path, snap.version + 1, actions,
+                _ckpt_interval(self.session))
 
     def update(self, condition, set_exprs: Dict[str, object]):
         """UPDATE target SET col = expr WHERE condition — candidate
@@ -900,7 +964,8 @@ class DeltaTable:
             "readVersion": snap.version,
             "prunedFiles": (len(snap.file_paths) - len(only_files))
             if only_files is not None else 0}})
-        _commit(self.path, snap.version + 1, actions)
+        _commit(self.path, snap.version + 1, actions,
+                _ckpt_interval(self.session))
 
 
 class DeltaOptimizeBuilder:

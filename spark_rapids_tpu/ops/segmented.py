@@ -93,16 +93,27 @@ mm_traced_sweeps = 0
 
 MM_MAX_BINS = 1 << 14
 _MM_CHUNK = 1 << 15
+_MM_LIMITS = contextvars.ContextVar("srtpu_mm_limits", default=None)
+
+
+def mm_chunk() -> int:
+    lim = _MM_LIMITS.get()
+    return lim[1] if lim else _MM_CHUNK
 
 
 @contextmanager
-def binned_bins(b: int):
+def binned_bins(b: int, max_bins: Optional[int] = None,
+                chunk: Optional[int] = None):
     """Declare that gids lie in [0, b) with b static (binned group-by);
-    enables the matmul reductions on TPU backends."""
+    enables the matmul reductions on TPU backends. max_bins/chunk
+    override the defaults (conf spark.rapids.sql.agg.matmulSegments.*;
+    callers must key any program cache on them)."""
     tok = _MM_BINS.set(int(b))
+    tok2 = _MM_LIMITS.set((max_bins or MM_MAX_BINS, chunk or _MM_CHUNK))
     try:
         yield
     finally:
+        _MM_LIMITS.reset(tok2)
         _MM_BINS.reset(tok)
 
 
@@ -118,7 +129,8 @@ def force_matmul_path():
 
 def _mm_bins() -> Optional[int]:
     b = _MM_BINS.get()
-    if b is None or b > MM_MAX_BINS:
+    lim = _MM_LIMITS.get()
+    if b is None or b > (lim[0] if lim else MM_MAX_BINS):
         return None
     if not (_MM_FORCE.get() or jax.default_backend() == "tpu"):
         return None
@@ -244,8 +256,8 @@ def _pad_bins(vals: jnp.ndarray, cap: int) -> jnp.ndarray:
 
 def _mm_seg_count(valid: jnp.ndarray, gid: jnp.ndarray,
                   b: int) -> jnp.ndarray:
-    # chunk counts <= _MM_CHUNK < 2^24: exact in f32; i64 carry exact
-    return _mm_pass(valid.astype(jnp.float32), gid, b, _MM_CHUNK,
+    # chunk counts <= chunk size < 2^24: exact in f32; i64 carry exact
+    return _mm_pass(valid.astype(jnp.float32), gid, b, mm_chunk(),
                     jnp.int64)
 
 
@@ -256,13 +268,13 @@ def _mm_sum_plan(values: jnp.ndarray, valid: jnp.ndarray, vbound):
     dt = values.dtype
     if jnp.issubdtype(dt, jnp.floating):
         w = jnp.where(valid, values, 0).astype(jnp.float32)
-        return w, _MM_CHUNK, jnp.float64, True
+        return w, mm_chunk(), jnp.float64, True
     if jnp.issubdtype(dt, jnp.integer):
         if vbound is None:
             return None  # unbounded int: scatter keeps exact wrapping
         v = max(abs(int(vbound[0])), abs(int(vbound[1])), 1)
         chunk = 1
-        while chunk * 2 * v <= (1 << 24) and chunk < _MM_CHUNK:
+        while chunk * 2 * v <= (1 << 24) and chunk < mm_chunk():
             chunk <<= 1
         if chunk < 2048:
             return None  # bound too loose for exact f32 chunks

@@ -170,6 +170,10 @@ class TpuOverrides:
         elif isinstance(node, L.FileScan):
             from spark_rapids_tpu.plan.typesig import type_supported
 
+            fmt_entry = rc._FMT_READ_ENTRIES.get(node.fmt)
+            if fmt_entry is not None and not self.conf.get(fmt_entry):
+                meta.cannot_run(
+                    f"{node.fmt} reads disabled via {fmt_entry.key}")
             for f in node.schema.fields:
                 r = type_supported(f.dataType)
                 if r:

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Callable, Dict, List, Optional, Type
 
+from spark_rapids_tpu.config import rapids_conf as rc_mod
 from spark_rapids_tpu.expr import Cast
 from spark_rapids_tpu.expr.core import Expression, Literal
 from spark_rapids_tpu.sqltypes import (
@@ -142,6 +143,17 @@ def expr_unsupported_reasons(expr: Expression,
             reasons.append(
                 f"{name} disabled via spark.rapids.sql.expression."
                 f"{name}=false")
+        if conf is not None and not conf.get(rc_mod.REGEXP_ENABLED):
+            from spark_rapids_tpu.expr.regexexpr import (
+                RegexpExtract,
+                RegexpReplace,
+                RLike,
+            )
+
+            if isinstance(e, (RLike, RegexpExtract, RegexpReplace)):
+                reasons.append(
+                    "regex on device disabled via "
+                    "spark.rapids.sql.regexp.enabled=false")
         r = type_supported(e.dtype)
         if r:
             reasons.append(f"{type(e).__name__}: {r}")

@@ -1,0 +1,130 @@
+"""New tuning/disable confs are actually wired (not doc-only entries)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSparkSession
+from spark_rapids_tpu.sqltypes.datatypes import long
+
+
+@pytest.fixture()
+def pq_dir(tmp_path):
+    t = pa.table({"a": pa.array(np.arange(100), type=pa.int64()),
+                  "s": pa.array([f"x{i}" for i in range(100)],
+                                type=pa.string())})
+    d = tmp_path / "data"
+    d.mkdir()
+    pq.write_table(t, str(d / "p.parquet"))
+    return str(d)
+
+
+def test_format_read_disable_falls_back_to_cpu_scan(pq_dir):
+    spark = TpuSparkSession({
+        "spark.rapids.sql.format.parquet.read.enabled": False,
+        "spark.rapids.sql.explain": "NOT_ON_GPU",
+    })
+    try:
+        df = spark.read.parquet(pq_dir).filter(F.col("a") > 10)
+        phys, meta = df._physical()
+        from spark_rapids_tpu.exec.operators import CpuFileScanExec
+
+        def find_scan(n):
+            if isinstance(n, CpuFileScanExec):
+                return n
+            for c in n.children:
+                r = find_scan(c)
+                if r is not None:
+                    return r
+            return None
+
+        assert find_scan(phys) is not None, "scan must be on CPU path"
+        assert df.collect_arrow().num_rows == 89
+    finally:
+        spark.stop()
+
+
+def test_regexp_disable_moves_rlike_to_cpu(pq_dir):
+    spark = TpuSparkSession({"spark.rapids.sql.regexp.enabled": False})
+    try:
+        df = spark.read.parquet(pq_dir).filter(
+            F.col("s").rlike("x[0-9]"))
+        from spark_rapids_tpu.plan.typesig import (
+            expr_unsupported_reasons,
+        )
+
+        got = df.collect_arrow()
+        assert got.num_rows == 100  # all rows match x[0-9]
+        # the type-check engine reports the disable reason
+        from spark_rapids_tpu.expr.core import BoundReference, Literal
+        from spark_rapids_tpu.expr.regexexpr import RLike
+        from spark_rapids_tpu.sqltypes.datatypes import string
+
+        e = RLike(BoundReference(0, string, True), "x1")
+        reasons = expr_unsupported_reasons(e, spark.rapids_conf)
+        assert any("regexp.enabled" in r for r in reasons), reasons
+    finally:
+        spark.stop()
+
+
+def test_udf_compiler_disable_uses_rowwise_fallback(pq_dir):
+    spark = TpuSparkSession(
+        {"spark.rapids.sql.udfCompiler.enabled": False})
+    try:
+        fn = F.udf(lambda x: x * 2 + 1, returnType=long)
+        df = spark.read.parquet(pq_dir).select(
+            fn(F.col("a")).alias("y"))
+        got = df.collect_arrow()
+        assert got.column("y").to_pylist() == [
+            i * 2 + 1 for i in range(100)]
+        # the marker kept its fallback (not compiled to device exprs)
+        from spark_rapids_tpu.udf.pyudf import PythonUDF
+
+        phys, _ = df._physical()
+
+        def has_pyudf(n):
+            for e in getattr(n, "exprs", []) or []:
+                stack = [e]
+                while stack:
+                    x = stack.pop()
+                    if isinstance(x, PythonUDF):
+                        return True
+                    stack.extend(x.children)
+            return any(has_pyudf(c) for c in n.children)
+
+        assert has_pyudf(phys)
+    finally:
+        spark.stop()
+
+
+def test_matmul_knobs_respected(pq_dir):
+    # maxBins below the key space forces the scatter path even when
+    # forced on; chunkRows flows into the plan
+    from spark_rapids_tpu.ops import segmented
+
+    with segmented.force_matmul_path(), \
+            segmented.binned_bins(1000, max_bins=512):
+        assert segmented.mm_bins_active() is None
+    with segmented.force_matmul_path(), \
+            segmented.binned_bins(1000, max_bins=2048, chunk=4096):
+        assert segmented.mm_bins_active() == 1000
+        assert segmented.mm_chunk() == 4096
+
+
+def test_fused_knobs_construct():
+    from spark_rapids_tpu.config import rapids_conf as rc
+    from spark_rapids_tpu.exec.fused import FusedSingleChipExecutor
+
+    conf = rc.RapidsConf({
+        "spark.rapids.sql.fusedExec.expansionFactor": 8,
+        "spark.rapids.sql.fusedExec.groupCapacity": 1 << 12,
+        "spark.rapids.sql.fusedExec.maxExpansionFactor": 32,
+        "spark.rapids.sql.fusedExec.singleSyncFetchMaxBytes": 1 << 10,
+    })
+    ex = FusedSingleChipExecutor(conf)
+    assert ex._expansion == 8
+    assert ex._group_cap == 1 << 12
+    assert ex._max_expansion == 32
+    assert ex._fetch_fused_bytes == 1 << 10
