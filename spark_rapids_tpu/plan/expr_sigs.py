@@ -1,0 +1,338 @@
+"""Per-parameter type signatures — the TypeSig algebra
+(reference TypeChecks.scala:168 `TypeSig`, `ExprChecks` at :757).
+
+Each device expression declares which input types its device lowering
+accepts, per parameter. The planner's type-check walk
+(plan/typesig.py `expr_unsupported_reasons`) enforces these — a
+mismatch tags the expression NOT_ON_TPU with a per-parameter reason,
+exactly like the reference's ExprChecks tagging — and
+tools/gendocs.py renders the registry as the supported_ops matrix.
+
+Signatures describe the CURRENT device lowerings (ops/ + expr/
+device paths); the per-class `register_check` refinements in
+plan/typesig.py still layer on top for value-dependent restrictions
+(regex dialect, ANSI-failable casts, decimal-128 arithmetic corners).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Type
+
+from spark_rapids_tpu.sqltypes import (
+    ArrayType,
+    BooleanType,
+    DataType,
+    DateType,
+    DecimalType,
+    DoubleType,
+    FloatType,
+    IntegralType,
+    MapType,
+    NullType,
+    StringType,
+    TimestampType,
+)
+
+# ------------------------------------------------------------- algebra
+
+_KINDS = ("boolean", "integral", "float", "double", "decimal64",
+          "decimal128", "string", "date", "timestamp", "null", "array",
+          "map")
+
+
+def kind_of(dt: DataType) -> str:
+    if isinstance(dt, BooleanType):
+        return "boolean"
+    if isinstance(dt, IntegralType):
+        return "integral"
+    if isinstance(dt, FloatType):
+        return "float"
+    if isinstance(dt, DoubleType):
+        return "double"
+    if isinstance(dt, DecimalType):
+        return ("decimal128"
+                if dt.precision > DecimalType.MAX_LONG_DIGITS
+                else "decimal64")
+    if isinstance(dt, StringType):
+        return "string"
+    if isinstance(dt, DateType):
+        return "date"
+    if isinstance(dt, TimestampType):
+        return "timestamp"
+    if isinstance(dt, NullType):
+        return "null"
+    if isinstance(dt, ArrayType):
+        return "array"
+    if isinstance(dt, MapType):
+        return "map"
+    return "unsupported"
+
+
+class TypeSig:
+    """An accepted set of type kinds (TypeSig algebra: compose with +)."""
+
+    __slots__ = ("kinds",)
+
+    def __init__(self, *kinds: str):
+        for k in kinds:
+            assert k in _KINDS, k
+        self.kinds = frozenset(kinds)
+
+    def __add__(self, other: "TypeSig") -> "TypeSig":
+        s = TypeSig()
+        s.kinds = self.kinds | other.kinds
+        return s
+
+    def supports(self, dt: DataType) -> Optional[str]:
+        k = kind_of(dt)
+        if k == "null":
+            return None  # null literals coerce everywhere
+        if k in self.kinds:
+            return None
+        return k
+
+    def __contains__(self, kind: str) -> bool:
+        return kind in self.kinds
+
+
+BOOL = TypeSig("boolean")
+INTEGRAL = TypeSig("integral")
+FP = TypeSig("float", "double")
+DECIMAL = TypeSig("decimal64", "decimal128")
+DECIMAL_64 = TypeSig("decimal64")
+NUMERIC = INTEGRAL + FP + DECIMAL
+STRING = TypeSig("string")
+DATE = TypeSig("date")
+TIMESTAMP = TypeSig("timestamp")
+DATETIME = DATE + TIMESTAMP
+ORDERABLE = NUMERIC + STRING + DATETIME + BOOL
+ARRAY = TypeSig("array")
+MAP = TypeSig("map")
+COMMON = ORDERABLE  # the scalar device surface
+ALL = COMMON + ARRAY + MAP
+
+
+class ExprSig:
+    """Per-parameter signature: positional param sigs, an optional
+    variadic sig covering remaining children, and the result sig."""
+
+    __slots__ = ("params", "variadic", "result", "note")
+
+    def __init__(self, params: Sequence[Tuple[str, TypeSig]],
+                 result: TypeSig,
+                 variadic: Optional[Tuple[str, TypeSig]] = None,
+                 note: str = ""):
+        self.params = list(params)
+        self.variadic = variadic
+        self.result = result
+        self.note = note
+
+    def check(self, expr) -> List[str]:
+        reasons = []
+        name = type(expr).__name__
+        for i, child in enumerate(expr.children):
+            if i < len(self.params):
+                pname, sig = self.params[i]
+            elif self.variadic is not None:
+                pname, sig = self.variadic
+            else:
+                continue
+            if child is None:
+                continue
+            bad = sig.supports(child.dtype)
+            if bad:
+                reasons.append(
+                    f"{name} parameter {pname!r}: {bad} input has no "
+                    "device lowering")
+        bad = self.result.supports(expr.dtype)
+        if bad:
+            reasons.append(
+                f"{name}: {bad} output has no device lowering")
+        return reasons
+
+
+# ------------------------------------------------------------ registry
+#
+# Built lazily (expression modules import broadly); class -> ExprSig.
+
+_SIGS: Optional[Dict[Type, ExprSig]] = None
+
+
+def _u(name: str, params, result, variadic=None, note=""):
+    return name, ExprSig(params, result, variadic, note)
+
+
+def _build() -> Dict[Type, ExprSig]:
+    from spark_rapids_tpu.expr import (
+        arith as A,
+        conditional as C,
+        datetimes as D,
+        hashexpr as H,
+        mathexpr as M,
+        predicates as P,
+        strings as S,
+    )
+    from spark_rapids_tpu.expr import generators as G
+    from spark_rapids_tpu.expr import regexexpr as R
+
+    num2 = [("lhs", NUMERIC), ("rhs", NUMERIC)]
+    ord2 = [("lhs", ORDERABLE), ("rhs", ORDERABLE)]
+    str1 = [("str", STRING)]
+    sigs: Dict[Type, ExprSig] = {
+        # arithmetic (reference org/apache/spark/sql/rapids/arithmetic)
+        A.Add: ExprSig(num2, NUMERIC),
+        A.Subtract: ExprSig(num2, NUMERIC),
+        A.Multiply: ExprSig(num2, NUMERIC),
+        A.Divide: ExprSig(num2, FP + DECIMAL),
+        A.IntegralDivide: ExprSig(num2, INTEGRAL),
+        A.Remainder: ExprSig(num2, NUMERIC),
+        A.Pmod: ExprSig(num2, NUMERIC),
+        A.UnaryMinus: ExprSig([("input", NUMERIC)], NUMERIC),
+        A.Abs: ExprSig([("input", NUMERIC)], NUMERIC),
+        # predicates
+        P.EqualTo: ExprSig(ord2, BOOL),
+        P.EqualNullSafe: ExprSig(ord2, BOOL),
+        P.LessThan: ExprSig(ord2, BOOL),
+        P.GreaterThan: ExprSig(ord2, BOOL),
+        P.LessThanOrEqual: ExprSig(ord2, BOOL),
+        P.GreaterThanOrEqual: ExprSig(ord2, BOOL),
+        P.And: ExprSig([("lhs", BOOL), ("rhs", BOOL)], BOOL),
+        P.Or: ExprSig([("lhs", BOOL), ("rhs", BOOL)], BOOL),
+        P.Not: ExprSig([("input", BOOL)], BOOL),
+        P.IsNull: ExprSig([("input", ALL)], BOOL),
+        P.IsNotNull: ExprSig([("input", ALL)], BOOL),
+        P.IsNaN: ExprSig([("input", FP)], BOOL),
+        P.In: ExprSig([("value", ORDERABLE)], BOOL,
+                      variadic=("list", ORDERABLE)),
+        # strings (device byte-matrix kernels, ops/ + expr/strings.py).
+        # Sigs describe CHILD expressions only — scalar arguments
+        # (search/pad/format strings, positions) are constructor
+        # attributes in this engine, enforced at construction.
+        S.Length: ExprSig(str1, INTEGRAL),
+        S.Upper: ExprSig(str1, STRING,
+                         note="ASCII case map (docs/compatibility.md)"),
+        S.Lower: ExprSig(str1, STRING,
+                         note="ASCII case map (docs/compatibility.md)"),
+        S.Substring: ExprSig(str1, STRING),
+        S.Concat: ExprSig([], STRING, variadic=("str", STRING)),
+        S.StartsWith: ExprSig(str1, BOOL),
+        S.EndsWith: ExprSig(str1, BOOL),
+        S.Contains: ExprSig(str1, BOOL),
+        S.StringTrim: ExprSig(str1, STRING),
+        S.StringTrimLeft: ExprSig(str1, STRING),
+        S.StringTrimRight: ExprSig(str1, STRING),
+        S.StringLPad: ExprSig(str1, STRING),
+        S.StringRPad: ExprSig(str1, STRING),
+        S.StringRepeat: ExprSig(str1, STRING),
+        S.StringReverse: ExprSig(str1, STRING),
+        S.InitCap: ExprSig(str1, STRING),
+        S.StringInstr: ExprSig(str1, INTEGRAL),
+        S.StringLocate: ExprSig(str1, INTEGRAL),
+        S.StringTranslate: ExprSig(str1, STRING),
+        S.StringReplace: ExprSig(str1, STRING),
+        S.ConcatWs: ExprSig([], STRING, variadic=("str", STRING)),
+        S.Ascii: ExprSig(str1, INTEGRAL),
+        S.Chr: ExprSig([("n", INTEGRAL)], STRING),
+        S.SubstringIndex: ExprSig(str1, STRING),
+        # datetime (device tz database, ops/tzdb.py). The date-part
+        # lowerings accept timestamps too (_days_of converts); format/
+        # zone/unit arguments are constructor attributes.
+        D.DateAdd: ExprSig([("start", DATE), ("days", INTEGRAL)], DATE),
+        D.DateSub: ExprSig([("start", DATE), ("days", INTEGRAL)], DATE),
+        D.DateDiff: ExprSig([("end", DATETIME), ("start", DATETIME)],
+                            INTEGRAL),
+        D.AddMonths: ExprSig([("start", DATE), ("months", INTEGRAL)],
+                             DATE),
+        D.MonthsBetween: ExprSig(
+            [("end", DATETIME), ("start", DATETIME)], FP),
+        D.NextDay: ExprSig([("start", DATE)], DATE),
+        D.LastDay: ExprSig([("input", DATETIME)], DATE),
+        D.TruncDate: ExprSig([("date", DATE)], DATE),
+        D.DateTrunc: ExprSig([("ts", TIMESTAMP)], TIMESTAMP),
+        D.UnixTimestamp: ExprSig([("time", DATETIME)], INTEGRAL),
+        D.SecondsToTimestamp: ExprSig([("secs", NUMERIC)], TIMESTAMP),
+        D.MakeDate: ExprSig(
+            [("year", INTEGRAL), ("month", INTEGRAL),
+             ("day", INTEGRAL)], DATE),
+        D.FromUtcTimestamp: ExprSig([("ts", TIMESTAMP)], TIMESTAMP),
+        D.ToUtcTimestamp: ExprSig([("ts", TIMESTAMP)], TIMESTAMP),
+        # FromUnixtime wraps its input as SecondsToTimestamp at
+        # construction, so the single child is already a timestamp
+        D.FromUnixtime: ExprSig([("time", TIMESTAMP)], STRING),
+        D.DateFormat: ExprSig([("ts", DATETIME)], STRING),
+        # math (elementwise XLA; inputs promote to double)
+        M.Pow: ExprSig([("lhs", NUMERIC), ("rhs", NUMERIC)], FP),
+        M.Atan2: ExprSig([("y", NUMERIC), ("x", NUMERIC)], FP),
+        M.Hypot: ExprSig([("x", NUMERIC), ("y", NUMERIC)], FP),
+        M.Logarithm: ExprSig([("base", NUMERIC), ("x", NUMERIC)], FP),
+        M.Round: ExprSig([("x", NUMERIC), ("scale", INTEGRAL)],
+                         NUMERIC),
+        M.BRound: ExprSig([("x", NUMERIC), ("scale", INTEGRAL)],
+                          NUMERIC),
+        M.Ceil: ExprSig([("x", NUMERIC)], NUMERIC),
+        M.Floor: ExprSig([("x", NUMERIC)], NUMERIC),
+        M.BitwiseAnd: ExprSig([("lhs", INTEGRAL), ("rhs", INTEGRAL)],
+                              INTEGRAL),
+        M.BitwiseOr: ExprSig([("lhs", INTEGRAL), ("rhs", INTEGRAL)],
+                             INTEGRAL),
+        M.BitwiseXor: ExprSig([("lhs", INTEGRAL), ("rhs", INTEGRAL)],
+                              INTEGRAL),
+        M.BitwiseNot: ExprSig([("input", INTEGRAL)], INTEGRAL),
+        M.ShiftLeft: ExprSig([("value", INTEGRAL), ("bits", INTEGRAL)],
+                             INTEGRAL),
+        M.ShiftRight: ExprSig([("value", INTEGRAL), ("bits", INTEGRAL)],
+                              INTEGRAL),
+        M.ShiftRightUnsigned: ExprSig(
+            [("value", INTEGRAL), ("bits", INTEGRAL)], INTEGRAL),
+        M.Hex: ExprSig([("input", INTEGRAL)], STRING),
+        # conditionals
+        C.If: ExprSig([("predicate", BOOL), ("then", ALL),
+                       ("else", ALL)], ALL),
+        C.Coalesce: ExprSig([], ALL, variadic=("input", ALL)),
+        C.Greatest: ExprSig([], ORDERABLE,
+                            variadic=("input", ORDERABLE)),
+        C.Least: ExprSig([], ORDERABLE, variadic=("input", ORDERABLE)),
+        C.Nvl2: ExprSig([("test", ALL), ("notNull", ALL),
+                         ("isNull", ALL)], ALL),
+        C.NaNvl: ExprSig([("x", FP), ("fallback", FP)], FP),
+        # hash (Spark-exact murmur3/xxhash64 on device, ops/hashing.py)
+        H.Murmur3Hash: ExprSig([], INTEGRAL,
+                               variadic=("input", COMMON)),
+        H.XxHash64: ExprSig([], INTEGRAL, variadic=("input", COMMON)),
+        # regex (device DFA; dialect limits layered by register_check)
+        R.RLike: ExprSig([("str", STRING)], BOOL),
+        R.RegexpExtract: ExprSig([("str", STRING)], STRING),
+        R.RegexpReplace: ExprSig([("str", STRING)], STRING),
+        # generators (map explode has no lowering here)
+        G.Explode: ExprSig([("input", ARRAY)], ALL),
+        G.PosExplode: ExprSig([("input", ARRAY)], ALL),
+    }
+    # elementwise unary double-domain math: one shared signature
+    for cls in (M.Sqrt, M.Exp, M.Expm1, M.Cbrt, M.Rint, M.Signum,
+                M.Sin, M.Cos, M.Tan, M.Cot, M.Asin, M.Acos, M.Atan,
+                M.Sinh, M.Cosh, M.Tanh, M.Asinh, M.Acosh, M.Atanh,
+                M.ToDegrees, M.ToRadians, M.Log, M.Log10, M.Log2,
+                M.Log1p):
+        sigs[cls] = ExprSig([("input", NUMERIC)], FP)
+    # date-part extractors: the device lowering converts timestamps to
+    # local days itself (_days_of), so both kinds are in
+    for cls in (D.Year, D.Month, D.DayOfMonth, D.DayOfWeek, D.WeekDay,
+                D.DayOfYear, D.WeekOfYear, D.Quarter):
+        sigs[cls] = ExprSig([("input", DATETIME)], INTEGRAL)
+    for cls in (D.Hour, D.Minute, D.Second):
+        sigs[cls] = ExprSig([("input", TIMESTAMP)], INTEGRAL)
+    return sigs
+
+
+def signatures() -> Dict[Type, ExprSig]:
+    global _SIGS
+    if _SIGS is None:
+        _SIGS = _build()
+    return _SIGS
+
+
+def check_expr(expr) -> List[str]:
+    sig = signatures().get(type(expr))
+    if sig is None:
+        return []
+    return sig.check(expr)

@@ -157,6 +157,11 @@ def expr_unsupported_reasons(expr: Expression,
         r = type_supported(e.dtype)
         if r:
             reasons.append(f"{type(e).__name__}: {r}")
+        # per-parameter TypeSig enforcement (plan/expr_sigs.py, the
+        # ExprChecks role)
+        from spark_rapids_tpu.plan.expr_sigs import check_expr
+
+        reasons.extend(check_expr(e))
         chk = _checks.get(type(e))
         if chk:
             r = chk(e)

@@ -1,0 +1,114 @@
+"""Per-parameter TypeSig enforcement (plan/expr_sigs.py).
+
+Two invariants: (1) the signatures must NOT regress placement for the
+expression surface the engine actually lowers — a too-narrow sig would
+silently drain plans to the CPU path; (2) genuine mismatches must tag
+with a per-parameter reason.
+"""
+
+import pytest
+
+from spark_rapids_tpu.expr import arith as A
+from spark_rapids_tpu.expr import mathexpr as M
+from spark_rapids_tpu.expr import predicates as P
+from spark_rapids_tpu.expr import strings as S
+from spark_rapids_tpu.expr.core import BoundReference, Literal
+from spark_rapids_tpu.plan import expr_sigs as ES
+from spark_rapids_tpu.plan.typesig import expr_unsupported_reasons
+from spark_rapids_tpu.sqltypes.datatypes import (
+    boolean,
+    date,
+    double,
+    integer,
+    long,
+    string,
+    timestamp,
+)
+
+
+def _b(i, t):
+    return BoundReference(i, t, True)
+
+
+DEVICE_OK = [
+    A.Add(_b(0, long), _b(1, long)),
+    A.Add(_b(0, double), _b(1, double)),
+    A.Multiply(_b(0, double), _b(1, long)),
+    A.Divide(_b(0, double), _b(1, double)),
+    A.Abs(_b(0, long)),
+    P.EqualTo(_b(0, string), _b(1, string)),
+    P.LessThan(_b(0, date), _b(1, date)),
+    P.And(P.IsNotNull(_b(0, long)), P.IsNull(_b(1, string))),
+    P.IsNaN(_b(0, double)),
+    S.Upper(_b(0, string)),
+    S.Concat(_b(0, string), _b(1, string)),
+    S.Length(_b(0, string)),
+    M.Sqrt(_b(0, double)),
+    M.Round(_b(0, double), 2),
+    M.BitwiseAnd(_b(0, long), _b(1, integer)),
+    M.Pow(_b(0, double), _b(1, long)),
+]
+
+
+def _datetime_ok():
+    from spark_rapids_tpu.expr import datetimes as D
+
+    return [
+        D.Year(_b(0, timestamp)),        # extractors take ts too
+        D.Year(_b(0, date)),
+        D.MonthsBetween(_b(0, date), _b(1, date)),
+        D.DateTrunc("day", _b(0, timestamp)),
+        D.TruncDate(_b(0, date), "month"),
+        D.FromUnixtime(_b(0, long), "yyyy-MM-dd"),
+        D.NextDay(_b(0, date), "monday"),
+        D.DateFormat(_b(0, timestamp), "yyyy"),
+        D.LastDay(_b(0, timestamp)),
+    ]
+
+
+DEVICE_OK = DEVICE_OK + _datetime_ok()
+
+
+@pytest.mark.parametrize("e", DEVICE_OK,
+                         ids=lambda e: type(e).__name__)
+def test_signatures_accept_the_lowered_surface(e):
+    reasons = [r for r in expr_unsupported_reasons(e, None)
+               if "device lowering" in r]
+    assert reasons == [], reasons
+
+
+def test_signature_rejects_param_mismatch():
+    # non-boolean into NOT: per-parameter reason names the param
+    e = P.Not(_b(0, long))
+    reasons = ES.check_expr(e)
+    assert reasons and "input" in reasons[0], reasons
+    # non-float into IsNaN; non-string into Upper
+    e2 = P.IsNaN(_b(0, string))
+    assert ES.check_expr(e2)
+    e3 = S.Upper(_b(0, long))
+    assert ES.check_expr(e3)
+    # and the planner walk surfaces it
+    walked = expr_unsupported_reasons(e2, None)
+    assert any("device lowering" in r for r in walked)
+
+
+def test_variadic_signature_covers_tail_params():
+    e = S.ConcatWs(",", _b(0, string), _b(1, string))
+    assert ES.check_expr(e) == []
+    bad = S.ConcatWs(",", _b(0, string), _b(1, long))
+    assert ES.check_expr(bad)
+
+
+def test_null_literals_coerce_everywhere():
+    from spark_rapids_tpu.sqltypes.datatypes import null_t
+
+    e = P.EqualTo(_b(0, long), Literal(None, null_t))
+    assert ES.check_expr(e) == []
+
+
+def test_matrix_doc_contains_signatures():
+    from spark_rapids_tpu.tools.gendocs import supported_ops_md
+
+    md = supported_ops_md()
+    assert "Per-parameter type signatures" in md
+    assert "| Add | lhs |" in md
