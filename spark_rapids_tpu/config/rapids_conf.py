@@ -133,6 +133,20 @@ AGG_MATMUL_CHUNK_ROWS = conf(
     "at more scan iterations. Must stay below 2^24: per-chunk counts "
     "accumulate exactly in f32 only up to that.", int,
     checker=lambda v: 1024 <= v < (1 << 24))
+SKEW_JOIN_ENABLED = conf(
+    "spark.sql.adaptive.skewJoin.enabled", True,
+    "AQE skew handling: probe partitions much larger than the median "
+    "split into row slices, each joined against a re-read of the full "
+    "build partition (OptimizeSkewedJoin role). Inner/left/semi/anti "
+    "joins only.", bool)
+SKEW_JOIN_FACTOR = conf(
+    "spark.sql.adaptive.skewJoin.skewedPartitionFactor", 5,
+    "A partition is skewed when its bytes exceed this multiple of the "
+    "median partition size (and the byte threshold).", int)
+SKEW_JOIN_THRESHOLD = conf(
+    "spark.sql.adaptive.skewJoin.skewedPartitionThresholdInBytes",
+    256 << 20,
+    "Minimum partition bytes to qualify as skewed.", int)
 READER_COALESCE_BYTES = conf(
     "spark.rapids.sql.reader.coalesceSizeBytes", 128 << 20,
     "Target bytes per multi-file reader task (the COALESCING reader's "

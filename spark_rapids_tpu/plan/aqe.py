@@ -83,6 +83,62 @@ class CoalescedShuffleReadExec(PhysicalPlan):
                 f"{self.children[0].num_partitions}")
 
 
+class SkewSliceShuffleReadExec(PhysicalPlan):
+    """One side of a skew-split join (OptimizeSkewedJoin /
+    PartialReducerPartitionSpec role). `specs[i] = (src_pid, j, k)`:
+    output partition i reads source partition src_pid — the PROBE side
+    takes row-slice j of k, the BUILD side re-reads the whole partition
+    for every slice. Both sides of the join share one spec list, so the
+    join's pid pairing stays aligned."""
+
+    def __init__(self, ex: ops.TpuShuffleExchangeExec,
+                 specs: List[Tuple[int, int, int]], slice_rows: bool,
+                 conf):
+        super().__init__([ex], ex.schema, conf)
+        self.specs = specs
+        self.slice_rows = slice_rows
+
+    @property
+    def num_partitions(self):
+        return max(1, len(self.specs))
+
+    def execute_partition(self, pid, ctx):
+        if pid >= len(self.specs):
+            return
+        src, j, k = self.specs[pid]
+        ex = self.children[0]
+        if not self.slice_rows or k == 1:
+            yield from ex.execute_partition(src, ctx)
+            return
+        # probe slice: row-slice the HOST shuffle blocks BEFORE the
+        # device transfer — slicing device batches after the fact would
+        # move the whole skewed partition across the link k times
+        from spark_rapids_tpu.columnar.arrow_bridge import (
+            arrow_to_device,
+        )
+        from spark_rapids_tpu.exec.operators import _acquire
+        from spark_rapids_tpu.shuffle.manager import get_shuffle_manager
+
+        ex._run_map_stage(ctx)
+        tables = get_shuffle_manager().fetch(ex._shuffle_id, src)
+        if not tables:
+            return
+        t = pa.concat_tables(tables, promote_options="none")
+        n = t.num_rows
+        lo = (n * j) // k
+        hi = (n * (j + 1)) // k
+        if hi <= lo:
+            return
+        _acquire(ctx)
+        yield arrow_to_device(t.slice(lo, hi - lo))
+
+    def _node_string(self):
+        splits = sum(1 for _, _, k in self.specs if k > 1)
+        role = "probe-slices" if self.slice_rows else "build-replays"
+        return (f"SkewSliceShuffleReadExec {len(self.specs)} parts "
+                f"({splits} {role})")
+
+
 class AdaptiveQueryExecutor:
     """Stage-by-stage execution with stats-driven re-planning."""
 
@@ -216,6 +272,7 @@ class AdaptiveQueryExecutor:
                         node.right_keys, node.schema, node.conf,
                         node.condition)
             self._coalesce_join_sides(node)
+            self._try_skew_split(node)
         if (isinstance(node, ops.TpuShuffleExchangeExec)
                 and not isinstance(node, ops.TpuRangeShuffleExchangeExec)
                 and node._map_done and node.num_partitions > 1
@@ -256,6 +313,59 @@ class AdaptiveQueryExecutor:
         node.children = [
             CoalescedShuffleReadExec(lc, groups, self.conf),
             CoalescedShuffleReadExec(rc2, groups, self.conf)]
+
+    def _try_skew_split(self, node: "J.TpuShuffledHashJoinExec") -> None:
+        """Split skewed PROBE partitions into row slices, each joined
+        against a re-read of the full build partition (Spark
+        OptimizeSkewedJoin). Only join types whose semantics are
+        per-probe-row survive build duplication (inner/left/semi/anti —
+        right/full would emit unmatched build rows once per slice)."""
+        if node.join_type not in ("inner", "left", "left_semi",
+                                  "left_anti"):
+            return
+        if (self.conf is not None
+                and not self.conf.get(rc.SKEW_JOIN_ENABLED)):
+            return
+        lc, rc2 = node.children
+        if not (isinstance(lc, ops.TpuShuffleExchangeExec)
+                and isinstance(rc2, ops.TpuShuffleExchangeExec)
+                and not isinstance(lc, ops.TpuRangeShuffleExchangeExec)
+                and lc._map_done and rc2._map_done
+                and not lc._device_mode and not rc2._device_mode
+                and lc.num_partitions == rc2.num_partitions
+                and lc.num_partitions > 1
+                and id(lc) in self._stats):
+            return  # device-mode blocks are consumed on read
+        sizes = self._stats[id(lc)]
+        if not any(sizes):
+            return
+        # LOWER median over ALL partitions, zeros included (Spark
+        # OptimizeSkewedJoin): with a single hot partition the median
+        # must be a small/zero size, or the hot partition would be its
+        # own median and never qualify
+        med = sorted(sizes)[(len(sizes) - 1) // 2]
+        factor = (self.conf.get(rc.SKEW_JOIN_FACTOR)
+                  if self.conf is not None else 5)
+        threshold = (self.conf.get(rc.SKEW_JOIN_THRESHOLD)
+                     if self.conf is not None else 256 << 20)
+        specs: List[Tuple[int, int, int]] = []
+        split_info = []
+        for p, s in enumerate(sizes):
+            if s > max(factor * med, threshold):
+                k = max(2, -(-s // max(self._target, 1)))
+                k = min(k, 64)
+                split_info.append((p, k))
+                specs.extend((p, j, k) for j in range(k))
+            else:
+                specs.append((p, 0, 1))
+        if not split_info:
+            return
+        self.decisions.append(
+            "skew split: " + ", ".join(
+                f"partition {p} -> {k} slices" for p, k in split_info))
+        node.children = [
+            SkewSliceShuffleReadExec(lc, specs, True, self.conf),
+            SkewSliceShuffleReadExec(rc2, specs, False, self.conf)]
 
     # --- dynamic partition pruning ---
 
