@@ -27,3 +27,19 @@ def cpu_devices():
     devs = jax.devices()
     assert devs[0].platform == "cpu", devs
     return devs
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jit_cache_between_modules():
+    """Release compiled programs after each test module.
+
+    The full suite compiles 700+ XLA CPU executables in one process;
+    keeping them all loaded segfaulted XLA's JIT late in the run
+    (deterministic SIGSEGV inside backend_compile_and_load at ~97%).
+    Bounding the live-executable set per module avoids the crash and
+    caps memory; programs shared across modules simply recompile."""
+    yield
+    from spark_rapids_tpu.runtime import jit_cache
+
+    jit_cache.clear()
+    jax.clear_caches()
