@@ -144,6 +144,11 @@ class Column:
 
         return Column(RLike(self.expr, pattern))
 
+    def getField(self, name: str) -> "Column":
+        from spark_rapids_tpu.expr.structs import GetStructField
+
+        return Column(GetStructField(self.expr, name), name)
+
     # sort direction / window
 
     def asc(self) -> "SortColumn":

@@ -783,6 +783,24 @@ def get_item(c, index) -> Column:
     return Column(GetArrayItem(expr_of(c), expr_of(index)), "getItem")
 
 
+def struct(*cols) -> Column:
+    """struct(col1, col2, ...) — named after each column's output name
+    (Spark CreateNamedStruct)."""
+    from spark_rapids_tpu.expr.structs import CreateNamedStruct
+
+    names = []
+    exprs = []
+    for i, c in enumerate(cols):
+        if isinstance(c, Column):
+            names.append(c._name or f"col{i + 1}")
+        elif isinstance(c, str):
+            names.append(c)
+        else:
+            names.append(f"col{i + 1}")
+        exprs.append(expr_of(c))
+    return Column(CreateNamedStruct(names, exprs), "struct")
+
+
 def explode(c) -> Column:
     from spark_rapids_tpu.expr.generators import Explode
 

@@ -313,6 +313,21 @@ def column_from_arrow(arr, field, cap: int,
         return make_column(field.dataType, (kmat, vmat),
                            validity, cap, lengths=lengths,
                            elem_validity=vvalid)
+    if isinstance(field.dataType, StructType):
+        # struct-of-arrays: one child DeviceColumn per field, parent
+        # validity for row nullity
+        n = len(arr)
+        validity = np.asarray(arr.is_valid()) if n else np.zeros(0, bool)
+        vpad = np.zeros(cap, dtype=np.bool_)
+        vpad[:n] = validity
+        kids = [
+            column_from_arrow(
+                arr.field(i) if n else pa.array(
+                    [], type=to_arrow_type(f.dataType)),
+                f, cap, string_pad_min)
+            for i, f in enumerate(field.dataType.fields)]
+        return DeviceColumn(field.dataType, np.zeros(cap, np.int8),
+                            vpad, children=kids)
     vals, validity = _primitive_np(arr, field.dataType)
     return make_column(field.dataType, vals, validity, cap)
 
@@ -363,13 +378,7 @@ def device_to_arrow(batch: ColumnBatch) -> pa.Table:
     if small < batch.capacity:
         batch = ColumnBatch(
             batch.schema,
-            [DeviceColumn(
-                c.dtype, c.data[:small], c.validity[:small],
-                None if c.lengths is None else c.lengths[:small],
-                None if c.elem_validity is None
-                else c.elem_validity[:small],
-                None if c.map_values is None else c.map_values[:small])
-             for c in batch.columns],
+            [c.truncate(small) for c in batch.columns],
             n)
     from spark_rapids_tpu.runtime import host_alloc
 
@@ -403,62 +412,70 @@ def _host_batch_to_arrow(schema, host_columns, n: int) -> pa.Table:
     names = []
     for field, col in zip(schema.fields, host_columns):
         names.append(field.name)
-        validity = np.asarray(col.validity[:n])
-        if isinstance(field.dataType, StringType):
-            arrays.append(_matrix_to_string(
-                np.asarray(col.data[:n]), np.asarray(col.lengths[:n]),
-                validity))
-            continue
-        if isinstance(field.dataType, MapType):
-            arrays.append(_matrices_to_map(
-                np.asarray(col.data[:n]),
-                np.asarray(col.map_values[:n]),
-                np.asarray(col.lengths[:n]), validity,
-                np.asarray(col.elem_validity[:n]), field.dataType))
-            continue
-        if isinstance(field.dataType, ArrayType):
-            arrays.append(_matrix_to_list(
-                np.asarray(col.data[:n]), np.asarray(col.lengths[:n]),
-                validity, np.asarray(col.elem_validity[:n]),
-                field.dataType.elementType))
-            continue
-        vals = np.asarray(col.data[:n])
-        at = to_arrow_type(field.dataType)
-        if isinstance(field.dataType, DecimalType):
-            import decimal as _dec
-            s = field.dataType.scale
-            # scaleb rounds at context precision (default 28 digits —
-            # it would corrupt 29+ digit DECIMAL128 values)
-            with _dec.localcontext() as _ctx:
-                _ctx.prec = 50
-                if vals.ndim == 2:  # DECIMAL128 limb matrix (hi, lo)
-                    py = []
-                    for (h, lo_), ok in zip(vals, validity):
-                        if not ok:
-                            py.append(None)
-                            continue
-                        v = (int(h) << 64) | (int(lo_) & ((1 << 64) - 1))
-                        v &= (1 << 128) - 1
-                        if v >= 1 << 127:
-                            v -= 1 << 128
-                        py.append(_dec.Decimal(v).scaleb(-s))
-                else:
-                    py = [
-                        _dec.Decimal(int(v)).scaleb(-s) if ok else None
-                        for v, ok in zip(vals, validity)
-                    ]
-            arrays.append(pa.array(py, type=at))
-            continue
-        mask = None if validity.all() else ~validity
-        if pa.types.is_timestamp(at):
-            arr = pa.array(vals.astype(np.int64), type=pa.int64(), mask=mask)
-            arrays.append(arr.cast(at))
-        elif pa.types.is_date32(at):
-            arr = pa.array(vals.astype(np.int32), type=pa.int32(), mask=mask)
-            arrays.append(arr.cast(at))
-        else:
-            arrays.append(pa.array(vals, type=at, mask=mask))
+        arrays.append(_host_column_to_array(field, col, n))
     return pa.Table.from_arrays(arrays, names=names)
+
+
+def _host_column_to_array(field, col, n: int) -> pa.Array:
+    validity = np.asarray(col.validity[:n])
+    if isinstance(field.dataType, StructType):
+        kids = [_host_column_to_array(f, kid, n)
+                for f, kid in zip(field.dataType.fields, col.children)]
+        return pa.StructArray.from_arrays(
+            kids,
+            fields=[pa.field(f.name, to_arrow_type(f.dataType),
+                             f.nullable)
+                    for f in field.dataType.fields],
+            mask=None if validity.all() else pa.array(~validity))
+    if isinstance(field.dataType, StringType):
+        return _matrix_to_string(
+            np.asarray(col.data[:n]), np.asarray(col.lengths[:n]),
+            validity)
+    if isinstance(field.dataType, MapType):
+        return _matrices_to_map(
+            np.asarray(col.data[:n]),
+            np.asarray(col.map_values[:n]),
+            np.asarray(col.lengths[:n]), validity,
+            np.asarray(col.elem_validity[:n]), field.dataType)
+    if isinstance(field.dataType, ArrayType):
+        return _matrix_to_list(
+            np.asarray(col.data[:n]), np.asarray(col.lengths[:n]),
+            validity, np.asarray(col.elem_validity[:n]),
+            field.dataType.elementType)
+    vals = np.asarray(col.data[:n])
+    at = to_arrow_type(field.dataType)
+    if isinstance(field.dataType, DecimalType):
+        import decimal as _dec
+        s = field.dataType.scale
+        # scaleb rounds at context precision (default 28 digits —
+        # it would corrupt 29+ digit DECIMAL128 values)
+        with _dec.localcontext() as _ctx:
+            _ctx.prec = 50
+            if vals.ndim == 2:  # DECIMAL128 limb matrix (hi, lo)
+                py = []
+                for (h, lo_), ok in zip(vals, validity):
+                    if not ok:
+                        py.append(None)
+                        continue
+                    v = (int(h) << 64) | (int(lo_) & ((1 << 64) - 1))
+                    v &= (1 << 128) - 1
+                    if v >= 1 << 127:
+                        v -= 1 << 128
+                    py.append(_dec.Decimal(v).scaleb(-s))
+            else:
+                py = [
+                    _dec.Decimal(int(v)).scaleb(-s) if ok else None
+                    for v, ok in zip(vals, validity)
+                ]
+        return pa.array(py, type=at)
+    mask = None if validity.all() else ~validity
+    if pa.types.is_timestamp(at):
+        arr = pa.array(vals.astype(np.int64), type=pa.int64(), mask=mask)
+        return arr.cast(at)
+    if pa.types.is_date32(at):
+        arr = pa.array(vals.astype(np.int32), type=pa.int32(), mask=mask)
+        return arr.cast(at)
+    return pa.array(vals, type=at, mask=mask)
 
 
 def arrow_to_pandas(table: pa.Table):

@@ -64,10 +64,11 @@ class DeviceColumn:
     """
 
     __slots__ = ("dtype", "data", "validity", "lengths",
-                 "elem_validity", "map_values", "vrange")
+                 "elem_validity", "map_values", "vrange", "children")
 
     def __init__(self, dtype: DataType, data, validity, lengths=None,
-                 elem_validity=None, map_values=None, vrange=None):
+                 elem_validity=None, map_values=None, vrange=None,
+                 children=None):
         self.dtype = dtype
         self.data = data          # maps: the KEY matrix
         self.validity = validity
@@ -79,6 +80,10 @@ class DeviceColumn:
         # the sort-free direct-binned group-by; ops that change values
         # drop it (None).
         self.vrange = vrange
+        # STRUCT columns: per-field child DeviceColumns (struct-of-
+        # arrays; the cuDF nested-column role). `data` is a [cap] int8
+        # placeholder carrying the capacity; row-level ops recurse.
+        self.children = children
 
     @property
     def is_string(self) -> bool:
@@ -102,6 +107,10 @@ class DeviceColumn:
     def max_elems(self) -> Optional[int]:
         return int(self.data.shape[1]) if self.is_array else None
 
+    @property
+    def is_struct(self) -> bool:
+        return self.children is not None
+
     def truncate(self, cap: int) -> "DeviceColumn":
         """Row-prefix view [:cap] of every per-row leaf (trace-safe;
         static slice). Callers guarantee live rows fit in cap."""
@@ -111,7 +120,9 @@ class DeviceColumn:
             None if self.elem_validity is None
             else self.elem_validity[:cap],
             None if self.map_values is None else self.map_values[:cap],
-            self.vrange)
+            self.vrange,
+            None if self.children is None
+            else [c.truncate(cap) for c in self.children])
 
     def device_size_bytes(self) -> int:
         n = self.data.size * self.data.dtype.itemsize
@@ -122,11 +133,28 @@ class DeviceColumn:
             n += self.elem_validity.size
         if self.map_values is not None:
             n += self.map_values.size * self.map_values.dtype.itemsize
+        if self.children is not None:
+            n += sum(c.device_size_bytes() for c in self.children)
         return n
 
     def with_validity(self, validity) -> "DeviceColumn":
-        return DeviceColumn(self.dtype, self.data, validity, self.lengths,
-                            self.elem_validity, self.map_values)
+        return self.replace(validity=validity)
+
+    def replace(self, **kw) -> "DeviceColumn":
+        """Copy with selected leaves replaced. The ONLY sanctioned way
+        to rebuild a column from an existing one — hand-rolled
+        DeviceColumn(c.dtype, c.data, ...) constructions silently drop
+        leaves added later (struct children taught this the hard way)."""
+        return DeviceColumn(
+            kw.get("dtype", self.dtype),
+            kw.get("data", self.data),
+            kw.get("validity", self.validity),
+            kw.get("lengths", self.lengths),
+            kw.get("elem_validity", self.elem_validity),
+            kw.get("map_values", self.map_values),
+            kw.get("vrange", self.vrange),
+            kw.get("children", self.children),
+        )
 
     def gather(self, indices) -> "DeviceColumn":
         """Row gather; indices must be in [0, capacity). Gathered values
@@ -142,6 +170,8 @@ class DeviceColumn:
             None if self.map_values is None else jnp.take(
                 self.map_values, indices, axis=0),
             vrange=self.vrange,
+            children=None if self.children is None
+            else [c.gather(indices) for c in self.children],
         )
 
     def _tree_flatten(self):
@@ -152,20 +182,28 @@ class DeviceColumn:
             leaves.append(self.elem_validity)
         if self.map_values is not None:
             leaves.append(self.map_values)
+        if self.children is not None:
+            # child DeviceColumns are registered pytree nodes; jax
+            # recurses into them
+            leaves.extend(self.children)
         return tuple(leaves), (self.dtype, self.lengths is not None,
                                self.elem_validity is not None,
-                               self.map_values is not None, self.vrange)
+                               self.map_values is not None, self.vrange,
+                               len(self.children)
+                               if self.children is not None else -1)
 
     @classmethod
     def _tree_unflatten(cls, aux, children):
-        dtype, has_len, has_ev, has_mv, vrange = aux
+        dtype, has_len, has_ev, has_mv, vrange, n_struct = aux
         it = iter(children)
         data = next(it)
         validity = next(it)
         lengths = next(it) if has_len else None
         ev = next(it) if has_ev else None
         mv = next(it) if has_mv else None
-        return cls(dtype, data, validity, lengths, ev, mv, vrange)
+        kids = ([next(it) for _ in range(n_struct)]
+                if n_struct >= 0 else None)
+        return cls(dtype, data, validity, lengths, ev, mv, vrange, kids)
 
 
 jax.tree_util.register_pytree_node(
@@ -321,26 +359,92 @@ def make_column(dtype: DataType, values: np.ndarray,
     return DeviceColumn(dtype, data, vpad)
 
 
+def _empty_column(dataType: DataType, capacity: int,
+                  string_bytes: int) -> DeviceColumn:
+    if isinstance(dataType, StringType):
+        return DeviceColumn(
+            dataType,
+            jnp.zeros((capacity, string_bytes), jnp.uint8),
+            jnp.zeros(capacity, jnp.bool_),
+            jnp.zeros(capacity, jnp.int32))
+    if isinstance(dataType, StructType):
+        return DeviceColumn(
+            dataType, jnp.zeros(capacity, jnp.int8),
+            jnp.zeros(capacity, jnp.bool_),
+            children=[_empty_column(f.dataType, capacity, string_bytes)
+                      for f in dataType.fields])
+    from spark_rapids_tpu.ops import decimal128 as _d128
+
+    shape = ((capacity, 2) if _d128.is_wide(dataType)
+             else (capacity,))
+    return DeviceColumn(
+        dataType,
+        jnp.zeros(shape, dataType.np_dtype),
+        jnp.zeros(capacity, jnp.bool_))
+
+
 def empty_like_schema(schema: StructType, capacity: int,
                       string_bytes: int = 8) -> ColumnBatch:
-    cols = []
-    for f in schema.fields:
-        if isinstance(f.dataType, StringType):
-            cols.append(DeviceColumn(
-                f.dataType,
-                jnp.zeros((capacity, string_bytes), jnp.uint8),
-                jnp.zeros(capacity, jnp.bool_),
-                jnp.zeros(capacity, jnp.int32)))
-        else:
-            from spark_rapids_tpu.ops import decimal128 as _d128
-
-            shape = ((capacity, 2) if _d128.is_wide(f.dataType)
-                     else (capacity,))
-            cols.append(DeviceColumn(
-                f.dataType,
-                jnp.zeros(shape, f.dataType.np_dtype),
-                jnp.zeros(capacity, jnp.bool_)))
+    cols = [_empty_column(f.dataType, capacity, string_bytes)
+            for f in schema.fields]
     return ColumnBatch(schema, cols, 0)
+
+
+def _concat_columns(pieces: List[Tuple[DeviceColumn, int]], cap: int,
+                    total: int, dtype: DataType) -> DeviceColumn:
+    """Concatenate per-batch column prefixes into one [cap] column
+    (recursing into struct children)."""
+    first = pieces[0][0]
+    if first.children is not None:
+        kids = [
+            _concat_columns([(c.children[i], n) for c, n in pieces],
+                            cap, total, first.children[i].dtype)
+            for i in range(len(first.children))
+        ]
+        pad = cap - total
+        val = jnp.pad(jnp.concatenate(
+            [c.validity[:n] for c, n in pieces]), (0, pad))
+        data = jnp.zeros((cap,), jnp.int8)
+        return DeviceColumn(dtype, data, val, children=kids)
+    parts_data = [c.data[:n] for c, n in pieces]
+    parts_val = [c.validity[:n] for c, n in pieces]
+    parts_len = [c.lengths[:n] for c, n in pieces
+                 if c.lengths is not None]
+    parts_ev = [c.elem_validity[:n] for c, n in pieces
+                if c.elem_validity is not None]
+    parts_mv = [c.map_values[:n] for c, n in pieces
+                if c.map_values is not None]
+    if parts_data[0].ndim == 2:  # strings / arrays / maps: align
+        mb = max(int(p.shape[1]) for p in parts_data)
+        parts_data = [
+            jnp.pad(p, ((0, 0), (0, mb - p.shape[1]))) for p in parts_data
+        ]
+        parts_ev = [
+            jnp.pad(p, ((0, 0), (0, mb - p.shape[1]))) for p in parts_ev
+        ]
+        parts_mv = [
+            jnp.pad(p, ((0, 0), (0, mb - p.shape[1]))) for p in parts_mv
+        ]
+    data = jnp.concatenate(parts_data, axis=0)
+    pad = cap - total
+    if pad:
+        pad_width = ((0, pad),) + ((0, 0),) * (data.ndim - 1)
+        data = jnp.pad(data, pad_width)
+    val = jnp.pad(jnp.concatenate(parts_val), (0, pad))
+    lens = None
+    if parts_len:
+        lens = jnp.pad(jnp.concatenate(parts_len), (0, pad))
+    ev = None
+    if parts_ev:
+        ev = jnp.concatenate(parts_ev, axis=0)
+        if pad:
+            ev = jnp.pad(ev, ((0, pad), (0, 0)))
+    mv = None
+    if parts_mv:
+        mv = jnp.concatenate(parts_mv, axis=0)
+        if pad:
+            mv = jnp.pad(mv, ((0, pad), (0, 0)))
+    return DeviceColumn(dtype, data, val, lens, ev, mv)
 
 
 def concat_batches(batches: List[ColumnBatch]) -> ColumnBatch:
@@ -354,49 +458,6 @@ def concat_batches(batches: List[ColumnBatch]) -> ColumnBatch:
     cap = next_capacity(total)
     cols: List[DeviceColumn] = []
     for ci, field in enumerate(schema.fields):
-        parts_data, parts_val, parts_len = [], [], []
-        parts_ev, parts_mv = [], []
-        for b in batches:
-            n = b.row_count()
-            c = b.columns[ci]
-            parts_data.append(c.data[:n])
-            parts_val.append(c.validity[:n])
-            if c.lengths is not None:
-                parts_len.append(c.lengths[:n])
-            if c.elem_validity is not None:
-                parts_ev.append(c.elem_validity[:n])
-            if c.map_values is not None:
-                parts_mv.append(c.map_values[:n])
-        if parts_data[0].ndim == 2:  # strings / arrays / maps: align
-            mb = max(int(p.shape[1]) for p in parts_data)
-            parts_data = [
-                jnp.pad(p, ((0, 0), (0, mb - p.shape[1]))) for p in parts_data
-            ]
-            parts_ev = [
-                jnp.pad(p, ((0, 0), (0, mb - p.shape[1]))) for p in parts_ev
-            ]
-            parts_mv = [
-                jnp.pad(p, ((0, 0), (0, mb - p.shape[1]))) for p in parts_mv
-            ]
-        data = jnp.concatenate(parts_data, axis=0)
-        pad = cap - total
-        if pad:
-            pad_width = ((0, pad),) + ((0, 0),) * (data.ndim - 1)
-            data = jnp.pad(data, pad_width)
-        val = jnp.pad(jnp.concatenate(parts_val), (0, pad))
-        lens = None
-        if parts_len:
-            lens = jnp.pad(jnp.concatenate(parts_len), (0, pad))
-        ev = None
-        if parts_ev:
-            ev = jnp.concatenate(parts_ev, axis=0)
-            if pad:
-                ev = jnp.pad(ev, ((0, pad), (0, 0)))
-        mv = None
-        if parts_mv:
-            mv = jnp.concatenate(parts_mv, axis=0)
-            if pad:
-                mv = jnp.pad(mv, ((0, pad), (0, 0)))
-        cols.append(DeviceColumn(field.dataType, data, val, lens, ev,
-                                 mv))
+        pieces = [(b.columns[ci], b.row_count()) for b in batches]
+        cols.append(_concat_columns(pieces, cap, total, field.dataType))
     return ColumnBatch(schema, cols, total)

@@ -284,6 +284,9 @@ def _ev(e: Expression, t: pa.Table):
         workers = (s.rapids_conf.get(_rc.CONCURRENT_PYTHON_WORKERS)
                    if s else 4)
         return eval_pandas_udf(e, t, num_workers=workers)
+    r = _ev_structs(e, t)
+    if r is not None:
+        return r
     r = _ev_maps(e, t)
     if r is not None:
         return r
@@ -1485,6 +1488,39 @@ def _xxhash64_cpu(e: XxHash64, t: pa.Table):
 
     vals = np.asarray(jax.device_get(col.data))[:t.num_rows]
     return pa.array(vals, type=pa.int64())
+
+
+def _ev_structs(e: Expression, t: pa.Table):
+    """Struct-expression oracle (arrow struct arrays)."""
+    from spark_rapids_tpu.expr.structs import (
+        CreateNamedStruct,
+        GetStructField,
+    )
+
+    if isinstance(e, GetStructField):
+        arr = _ev(e.children[0], t)
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        field = arr.field(e._ordinal)
+        # parent null -> field null
+        if arr.null_count:
+            import pyarrow.compute as _pc
+
+            field = _pc.if_else(arr.is_valid(), field,
+                                pa.scalar(None, type=field.type))
+        return field
+    if isinstance(e, CreateNamedStruct):
+        kids = []
+        for c in e.children:
+            a = _ev(c, t)
+            if isinstance(a, pa.ChunkedArray):
+                a = a.combine_chunks()
+            if isinstance(a, pa.Scalar):
+                a = pa.array([a.as_py()] * t.num_rows, type=a.type)
+            kids.append(a)
+        return pa.StructArray.from_arrays(
+            kids, names=list(e.names))
+    return None
 
 
 def _ev_maps(e: Expression, t: pa.Table):

@@ -233,8 +233,7 @@ class _DeviceJoinBase(PhysicalPlan):
         if jt in ("left", "full"):
             unmatched = (counts == 0)
             row_unmatched = jnp.take(unmatched, pi)
-            rcols = [DeviceColumn(c.dtype, c.data,
-                                  c.validity & ~row_unmatched, c.lengths)
+            rcols = [c.replace(validity=c.validity & ~row_unmatched)
                      for c in rcols]
         out_cols = lcols + rcols
         out_schema = StructType(list(lsch.fields) + list(rsch.fields))

@@ -61,4 +61,8 @@ from spark_rapids_tpu.expr.jsonexpr import (  # noqa: F401
     ParseUrl,
 )
 from spark_rapids_tpu.expr.deviceudf import DeviceUDF  # noqa: F401
+from spark_rapids_tpu.expr.structs import (  # noqa: F401
+    CreateNamedStruct,
+    GetStructField,
+)
 from spark_rapids_tpu.expr.generators import Explode, PosExplode  # noqa: F401

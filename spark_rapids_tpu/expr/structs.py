@@ -1,0 +1,81 @@
+"""Struct expressions — GetStructField / CreateNamedStruct over
+struct-of-arrays device columns (DeviceColumn.children; the cuDF
+nested-column role, reference `complexTypeExtractors` /
+`GpuCreateNamedStruct` rules in GpuOverrides.scala).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import DeviceColumn
+from spark_rapids_tpu.expr.core import Expression
+from spark_rapids_tpu.sqltypes import StructField, StructType
+
+
+class GetStructField(Expression):
+    """struct.field extraction; a parent-null row yields a null field
+    (Spark GetStructField semantics)."""
+
+    def __init__(self, child: Expression, name: str):
+        super().__init__([child])
+        self.name = name
+
+    @property
+    def _ordinal(self) -> int:
+        return self.children[0].dtype.field_index(self.name)
+
+    @property
+    def dtype(self):
+        return self.children[0].dtype.fields[self._ordinal].dataType
+
+    @property
+    def nullable(self):
+        return True
+
+    def key(self):
+        return ("get_struct_field", self.name, self.children[0].key())
+
+    def eval(self, ctx) -> DeviceColumn:
+        col = self.children[0].eval(ctx)
+        kid = col.children[self._ordinal]
+        return kid.with_validity(kid.validity & col.validity)
+
+    def __repr__(self):
+        return f"{self.children[0]!r}.{self.name}"
+
+
+class CreateNamedStruct(Expression):
+    """struct(col1, col2, ...) — field expressions to a struct column.
+    Never null itself, like Spark's CreateNamedStruct."""
+
+    def __init__(self, names: List[str], exprs: List[Expression]):
+        assert len(names) == len(exprs)
+        super().__init__(list(exprs))
+        self.names = list(names)
+
+    @property
+    def dtype(self):
+        return StructType([
+            StructField(n, e.dtype, e.nullable)
+            for n, e in zip(self.names, self.children)])
+
+    @property
+    def nullable(self):
+        return False
+
+    def key(self):
+        return ("create_named_struct", tuple(self.names),
+                tuple(c.key() for c in self.children))
+
+    def eval(self, ctx) -> DeviceColumn:
+        kids = [e.eval(ctx) for e in self.children]
+        cap = kids[0].capacity
+        return DeviceColumn(
+            self.dtype, jnp.zeros((cap,), jnp.int8),
+            jnp.ones((cap,), jnp.bool_), children=kids)
+
+    def __repr__(self):
+        return "struct(" + ", ".join(self.names) + ")"

@@ -99,9 +99,15 @@ def concat_traced(batches: List[ColumnBatch]) -> ColumnBatch:
             [jnp.pad(x, ((0, 0), (0, mb - x.shape[1]))) for x in leaves],
             axis=0)
 
-    cols: List[DeviceColumn] = []
-    for ci, field in enumerate(schema.fields):
-        parts = [b.columns[ci] for b in batches]
+    def cat_col(parts, dtype):
+        if parts[0].children is not None:  # structs: recurse per field
+            kids = [cat_col([p.children[i] for p in parts],
+                            parts[0].children[i].dtype)
+                    for i in range(len(parts[0].children))]
+            return DeviceColumn(
+                dtype, jnp.concatenate([p.data for p in parts]),
+                jnp.concatenate([p.validity for p in parts]),
+                children=kids)
         if parts[0].data.ndim == 2:
             data = cat2d([p.data for p in parts])
         else:
@@ -116,7 +122,12 @@ def concat_traced(batches: List[ColumnBatch]) -> ColumnBatch:
         mv = None
         if parts[0].map_values is not None:
             mv = cat2d([p.map_values for p in parts])
-        cols.append(DeviceColumn(field.dataType, data, val, lens, ev, mv))
+        return DeviceColumn(dtype, data, val, lens, ev, mv)
+
+    cols: List[DeviceColumn] = []
+    for ci, field in enumerate(schema.fields):
+        cols.append(cat_col([b.columns[ci] for b in batches],
+                            field.dataType))
     interim = ColumnBatch(schema, cols, total_cap)
     perm, total = filterops.compact_perm(live, total_cap)
     return interim.gather(perm, total)
@@ -156,8 +167,7 @@ def shard_equi_join(node: J._DeviceJoinBase, left: ColumnBatch,
         rcols = [c.gather(safe_bi) for c in bt.batch.columns]
         if jt in ("left", "full"):
             row_un = jnp.take(counts == 0, pi)
-            rcols = [DeviceColumn(c.dtype, c.data,
-                                  c.validity & ~row_un, c.lengths)
+            rcols = [c.replace(validity=c.validity & ~row_un)
                      for c in rcols]
         out_schema = StructType(list(lsch.fields) + list(rsch.fields))
         out = ColumnBatch(out_schema, lcols + rcols,
@@ -486,6 +496,7 @@ class MeshQueryExecutor:
             # ANSI checks live in the eager engine's per-batch check
             # programs; the SPMD program has no raise points
             raise MeshCompileError("ANSI mode uses the eager engine")
+        self._reject_struct_columns(phys)
         sources: List[PhysicalPlan] = []
         self._collect_sources(phys, sources)
         sharded = []
@@ -511,6 +522,23 @@ class MeshQueryExecutor:
                             "mesh width; eager engine handles it")
                     raise
                 expansion *= 2
+
+    @staticmethod
+    def _reject_struct_columns(phys: PhysicalPlan) -> None:
+        """Struct columns ride DeviceColumn.children; the mesh tier's
+        shard assembly and collectives operate leaf-wise on flat
+        columns and have no children-aware lowering yet — fall back to
+        the single-chip engines rather than silently dropping fields."""
+        from spark_rapids_tpu.sqltypes import StructType as _St
+
+        def walk(n):
+            if any(isinstance(f.dataType, _St) for f in n.schema.fields):
+                raise MeshCompileError(
+                    "struct columns have no mesh lowering yet")
+            for c in n.children:
+                walk(c)
+
+        walk(phys)
 
     @staticmethod
     def _has_static_collect(phys: PhysicalPlan) -> bool:

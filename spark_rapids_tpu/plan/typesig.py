@@ -37,26 +37,39 @@ def _wide_dec(dt: DataType) -> bool:
 
 
 def type_supported(dt: DataType) -> Optional[str]:
-    from spark_rapids_tpu.sqltypes import ArrayType
+    from spark_rapids_tpu.sqltypes import ArrayType, StructType
 
     if isinstance(dt, NullType):
         return None
+    from spark_rapids_tpu.sqltypes import MapType as _MT
+
     if isinstance(dt, ArrayType):
         et = dt.elementType
-        if isinstance(et, (StringType, ArrayType)) or _wide_dec(et):
+        if isinstance(et, (StringType, ArrayType, StructType, _MT)) \
+                or _wide_dec(et):
             return (f"array element type {et.simpleString} runs on CPU "
                     "(device arrays hold primitive/64-bit elements in v1)")
         return type_supported(et)
-    from spark_rapids_tpu.sqltypes import MapType as _MT
-
     if isinstance(dt, _MT):
         for part, t in (("key", dt.keyType), ("value", dt.valueType)):
-            if isinstance(t, (StringType, ArrayType, _MT)) \
+            if isinstance(t, (StringType, ArrayType, _MT, StructType)) \
                     or _wide_dec(t):
                 return (f"map {part} type {t.simpleString} runs on CPU "
                         "(device maps hold primitive/64-bit entries "
                         "in v1)")
             r = type_supported(t)
+            if r:
+                return r
+        return None
+    if isinstance(dt, StructType):
+        # struct-of-arrays device columns (DeviceColumn.children):
+        # primitive/string fields; nested structs stay CPU in v1
+        for f in dt.fields:
+            if isinstance(f.dataType, (ArrayType, _MT, StructType)):
+                return (f"struct field {f.name!r} type "
+                        f"{f.dataType.simpleString} runs on CPU "
+                        "(device structs hold flat fields in v1)")
+            r = type_supported(f.dataType)
             if r:
                 return r
         return None
@@ -67,11 +80,13 @@ def type_supported(dt: DataType) -> Optional[str]:
 
 def key_type_supported(dt: DataType) -> Optional[str]:
     """Grouping/join/sort keys additionally need orderable device keys;
-    arrays have no orderable-key lowering yet."""
-    from spark_rapids_tpu.sqltypes import ArrayType
+    arrays/structs have no orderable-key lowering yet."""
+    from spark_rapids_tpu.sqltypes import ArrayType, StructType
 
     if isinstance(dt, ArrayType):
         return "array-typed keys run on CPU (no orderable device keys)"
+    if isinstance(dt, StructType):
+        return "struct-typed keys run on CPU (no orderable device keys)"
     from spark_rapids_tpu.sqltypes import MapType as _MT2
 
     if isinstance(dt, _MT2):
@@ -171,6 +186,14 @@ def expr_unsupported_reasons(expr: Expression,
                 and not isinstance(e, operator_evaluated)):
             reasons.append(
                 f"{type(e).__name__} has no device implementation")
+        if isinstance(e, AggregateFunction):
+            from spark_rapids_tpu.sqltypes import StructType as _StT
+
+            for c in e.children:
+                if c is not None and isinstance(c.dtype, _StT):
+                    reasons.append(
+                        f"{name} over struct input runs on CPU "
+                        "(segmented kernels take flat columns)")
         for c in e.children:
             walk(c)
 

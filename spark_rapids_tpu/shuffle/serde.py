@@ -5,8 +5,11 @@ tables, header + raw buffers, written to shuffle streams).
 A table serializes to ONE contiguous framed buffer: [schema IPC bytes,
 meta JSON, column buffers...] packed by the native runtime
 (native/sparktpu_runtime.cpp stpu_pack) with 64-byte alignment so
-deserialization is zero-copy buffer slicing. Flat types only (primitives,
-strings, dates/timestamps/decimals) — the engine's device surface.
+deserialization is zero-copy buffer slicing for flat columns
+(primitives, strings, dates/timestamps/decimals). Nested columns
+(list/struct/map) ride as per-column arrow-IPC record batches inside
+the same frame — their child buffers interleave in Array.buffers(),
+so raw slicing cannot reassemble them.
 
 Optional block compression (`codec=`) wraps the packed frame with a
 10-byte header [magic u8, codec u8, raw_len i64] — the
@@ -62,10 +65,23 @@ def serialize_table(table: pa.Table, codec: str = "none") -> np.ndarray:
     schema_buf = np.frombuffer(table.schema.serialize(), dtype=np.uint8)
     bufs: List[np.ndarray] = []
     col_specs = []
-    for col in table.columns:
+    for ci, col in enumerate(table.columns):
         arr = col.combine_chunks()
         if arr.offset != 0:
             arr = arr.take(pa.array(np.arange(len(arr))))
+        if pa.types.is_nested(arr.type):
+            # nested columns (list/struct/map) carry CHILD arrays whose
+            # buffers interleave in Array.buffers(); frame them as one
+            # arrow-IPC record batch instead of raw buffer slices
+            sink = pa.BufferOutputStream()
+            rb = pa.record_batch([arr],
+                                 schema=pa.schema(
+                                     [table.schema.field(ci)]))
+            with pa.ipc.new_stream(sink, rb.schema) as w:
+                w.write_batch(rb)
+            bufs.append(np.frombuffer(sink.getvalue(), dtype=np.uint8))
+            col_specs.append({"ipc": True})
+            continue
         spec = {"nbufs": 0, "present": []}
         for b in arr.buffers():
             if b is None:
@@ -101,6 +117,13 @@ def deserialize_table(data: np.ndarray) -> pa.Table:
     arrays = []
     bi = 2
     for field, spec in zip(schema, meta["cols"]):
+        if spec.get("ipc"):
+            with pa.ipc.open_stream(
+                    pa.py_buffer(parts[bi].tobytes())) as r:
+                rb = r.read_all()
+            bi += 1
+            arrays.append(rb.column(0).combine_chunks())
+            continue
         buffers = []
         for present in spec["present"]:
             if present:

@@ -355,6 +355,12 @@ def from_arrow_type(at) -> DataType:
     if pa.types.is_map(at):
         return MapType(from_arrow_type(at.key_type),
                        from_arrow_type(at.item_type))
+    if pa.types.is_struct(at):
+        return StructType([
+            StructField(at.field(i).name,
+                        from_arrow_type(at.field(i).type),
+                        at.field(i).nullable)
+            for i in range(at.num_fields)])
     if pa.types.is_dictionary(at):
         return from_arrow_type(at.value_type)
     raise TypeError(f"unsupported arrow type {at}")
@@ -383,6 +389,10 @@ def to_arrow_type(dt: DataType):
     if isinstance(dt, MapType):
         return pa.map_(to_arrow_type(dt.keyType),
                        to_arrow_type(dt.valueType))
+    if isinstance(dt, StructType):
+        return pa.struct([
+            pa.field(f.name, to_arrow_type(f.dataType), f.nullable)
+            for f in dt.fields])
     try:
         return mapping[type(dt)]
     except KeyError:
