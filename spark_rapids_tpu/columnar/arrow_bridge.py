@@ -419,6 +419,10 @@ def _host_batch_to_arrow(schema, host_columns, n: int) -> pa.Table:
 def _host_column_to_array(field, col, n: int) -> pa.Array:
     validity = np.asarray(col.validity[:n])
     if isinstance(field.dataType, StructType):
+        if not field.dataType.fields:  # struct() with no fields
+            return pa.array(
+                [{} if ok else None for ok in validity],
+                type=pa.struct([]))
         kids = [_host_column_to_array(f, kid, n)
                 for f, kid in zip(field.dataType.fields, col.children)]
         return pa.StructArray.from_arrays(

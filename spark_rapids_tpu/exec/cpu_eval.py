@@ -1510,6 +1510,8 @@ def _ev_structs(e: Expression, t: pa.Table):
                                 pa.scalar(None, type=field.type))
         return field
     if isinstance(e, CreateNamedStruct):
+        if not e.children:  # struct() with no fields is legal Spark
+            return pa.array([{}] * t.num_rows, type=pa.struct([]))
         kids = []
         for c in e.children:
             a = _ev(c, t)

@@ -72,7 +72,8 @@ class CreateNamedStruct(Expression):
 
     def eval(self, ctx) -> DeviceColumn:
         kids = [e.eval(ctx) for e in self.children]
-        cap = kids[0].capacity
+        # struct() with no fields is legal Spark; size from the batch
+        cap = kids[0].capacity if kids else ctx.batch.capacity
         return DeviceColumn(
             self.dtype, jnp.zeros((cap,), jnp.int8),
             jnp.ones((cap,), jnp.bool_), children=kids)

@@ -30,6 +30,7 @@ from spark_rapids_tpu.sqltypes import (
     MapType,
     NullType,
     StringType,
+    StructType,
     TimestampType,
 )
 
@@ -37,7 +38,7 @@ from spark_rapids_tpu.sqltypes import (
 
 _KINDS = ("boolean", "integral", "float", "double", "decimal64",
           "decimal128", "string", "date", "timestamp", "null", "array",
-          "map")
+          "map", "struct")
 
 
 def kind_of(dt: DataType) -> str:
@@ -65,6 +66,8 @@ def kind_of(dt: DataType) -> str:
         return "array"
     if isinstance(dt, MapType):
         return "map"
+    if isinstance(dt, StructType):
+        return "struct"
     return "unsupported"
 
 
@@ -108,8 +111,9 @@ DATETIME = DATE + TIMESTAMP
 ORDERABLE = NUMERIC + STRING + DATETIME + BOOL
 ARRAY = TypeSig("array")
 MAP = TypeSig("map")
+STRUCT = TypeSig("struct")
 COMMON = ORDERABLE  # the scalar device surface
-ALL = COMMON + ARRAY + MAP
+ALL = COMMON + ARRAY + MAP + STRUCT
 
 
 class ExprSig:
@@ -174,6 +178,7 @@ def _build() -> Dict[Type, ExprSig]:
     )
     from spark_rapids_tpu.expr import generators as G
     from spark_rapids_tpu.expr import regexexpr as R
+    from spark_rapids_tpu.expr import structs as ST
 
     num2 = [("lhs", NUMERIC), ("rhs", NUMERIC)]
     ord2 = [("lhs", ORDERABLE), ("rhs", ORDERABLE)]
@@ -306,6 +311,10 @@ def _build() -> Dict[Type, ExprSig]:
         # generators (map explode has no lowering here)
         G.Explode: ExprSig([("input", ARRAY)], ALL),
         G.PosExplode: ExprSig([("input", ARRAY)], ALL),
+        # structs (expr/structs.py; struct-of-arrays device columns)
+        ST.GetStructField: ExprSig([("struct", STRUCT)], ALL),
+        ST.CreateNamedStruct: ExprSig([], STRUCT,
+                                      variadic=("field", COMMON)),
     }
     # elementwise unary double-domain math: one shared signature
     for cls in (M.Sqrt, M.Exp, M.Expm1, M.Cbrt, M.Rint, M.Signum,

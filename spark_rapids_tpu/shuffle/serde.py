@@ -67,12 +67,13 @@ def serialize_table(table: pa.Table, codec: str = "none") -> np.ndarray:
     col_specs = []
     for ci, col in enumerate(table.columns):
         arr = col.combine_chunks()
-        if arr.offset != 0:
-            arr = arr.take(pa.array(np.arange(len(arr))))
         if pa.types.is_nested(arr.type):
             # nested columns (list/struct/map) carry CHILD arrays whose
             # buffers interleave in Array.buffers(); frame them as one
-            # arrow-IPC record batch instead of raw buffer slices
+            # arrow-IPC record batch instead of raw buffer slices. The
+            # IPC writer handles sliced arrays natively, so no offset
+            # normalization (shuffle map slices make offset != 0 the
+            # common case here)
             sink = pa.BufferOutputStream()
             rb = pa.record_batch([arr],
                                  schema=pa.schema(
@@ -82,6 +83,10 @@ def serialize_table(table: pa.Table, codec: str = "none") -> np.ndarray:
             bufs.append(np.frombuffer(sink.getvalue(), dtype=np.uint8))
             col_specs.append({"ipc": True})
             continue
+        if arr.offset != 0:
+            # flat columns serialize as raw buffer slices, which cannot
+            # express a nonzero offset
+            arr = arr.take(pa.array(np.arange(len(arr))))
         spec = {"nbufs": 0, "present": []}
         for b in arr.buffers():
             if b is None:

@@ -201,3 +201,22 @@ def test_struct_device_concat_and_cache(spark, tmp_path):
                for r in t.column("s").to_pylist()]
     assert sorted(x for x in xs if x is not None) == sorted(
         x for x in want_xs if x is not None)
+
+
+def test_empty_struct_is_legal(spark):
+    # struct() with no fields is legal Spark
+    t = pa.table({"a": pa.array([1, 2, 3], type=pa.int64())})
+    got = (spark.createDataFrame(t)
+           .select(F.struct().alias("s"), F.col("a")).collect_arrow())
+    assert got.column("s").to_pylist() == [{}, {}, {}]
+
+
+def test_sliced_nested_serde_no_copy_path():
+    from spark_rapids_tpu.shuffle import serde
+
+    big = pa.table({"s": pa.array(
+        [{"x": i} for i in range(100)],
+        type=pa.struct([("x", pa.int64())]))})
+    sl = big.slice(37, 20)  # offset != 0: the shuffle map-slice shape
+    r = serde.deserialize_table(serde.serialize_table(sl))
+    assert r.column("s").to_pylist() == sl.column("s").to_pylist()
