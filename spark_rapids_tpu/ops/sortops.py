@@ -47,30 +47,41 @@ def sort_batch(batch: ColumnBatch, orders) -> ColumnBatch:
     return batch.gather(perm, batch.num_rows)
 
 
+def _align_col(ca: DeviceColumn, cb: DeviceColumn
+               ) -> Tuple[DeviceColumn, DeviceColumn]:
+    """Pad a column pair's 2-D leaves to common widths (recursing into
+    struct children) so key structures and scatters line up."""
+    if ca.children is not None:
+        pairs = [_align_col(ka, kb)
+                 for ka, kb in zip(ca.children, cb.children)]
+        return (ca.replace(children=[p[0] for p in pairs]),
+                cb.replace(children=[p[1] for p in pairs]))
+    if ca.data.ndim != 2:
+        return ca, cb
+
+    def pad_to(c: DeviceColumn, w: int) -> DeviceColumn:
+        if c.data.shape[1] >= w:
+            return c
+        data = jnp.pad(c.data, ((0, 0), (0, w - c.data.shape[1])))
+        ev = (None if c.elem_validity is None else jnp.pad(
+            c.elem_validity,
+            ((0, 0), (0, w - c.elem_validity.shape[1]))))
+        mv = (None if c.map_values is None else jnp.pad(
+            c.map_values, ((0, 0), (0, w - c.map_values.shape[1]))))
+        return c.replace(data=data, elem_validity=ev, map_values=mv)
+
+    w = max(int(ca.data.shape[1]), int(cb.data.shape[1]))
+    return pad_to(ca, w), pad_to(cb, w)
+
+
 def align_string_widths(a: ColumnBatch, b: ColumnBatch
                         ) -> Tuple[ColumnBatch, ColumnBatch]:
     """Pad string columns of both batches to a common byte width so key
     structures (packed word counts) and scatters line up."""
-
-    def pad(batch: ColumnBatch, widths: List[int]) -> ColumnBatch:
-        cols = []
-        for c, w in zip(batch.columns, widths):
-            if w and c.data.shape[1] < w:
-                data = jnp.pad(c.data, ((0, 0), (0, w - c.data.shape[1])))
-                ev = (None if c.elem_validity is None else jnp.pad(
-                    c.elem_validity,
-                    ((0, 0), (0, w - c.elem_validity.shape[1]))))
-                cols.append(DeviceColumn(c.dtype, data, c.validity,
-                                         c.lengths, ev))
-            else:
-                cols.append(c)
-        return ColumnBatch(batch.schema, cols, batch.num_rows)
-
-    widths = []
-    for ca, cb in zip(a.columns, b.columns):
-        widths.append(max(int(ca.data.shape[1]), int(cb.data.shape[1]))
-                      if ca.data.ndim == 2 else 0)
-    return pad(a, widths), pad(b, widths)
+    pairs = [_align_col(ca, cb)
+             for ca, cb in zip(a.columns, b.columns)]
+    return (ColumnBatch(a.schema, [p[0] for p in pairs], a.num_rows),
+            ColumnBatch(b.schema, [p[1] for p in pairs], b.num_rows))
 
 
 def merge_sorted(a: ColumnBatch, b: ColumnBatch, orders,
@@ -99,28 +110,38 @@ def merge_sorted(a: ColumnBatch, b: ColumnBatch, orders,
     dest_a = jnp.where(live_a, dest_a, out_cap)
     dest_b = jnp.where(live_b, dest_b, out_cap)
 
-    cols: List[DeviceColumn] = []
-    for fa, fb in zip(a.columns, b.columns):
-        if fa.data.ndim == 2:  # strings / arrays
-            data = jnp.zeros((out_cap, fa.data.shape[1]), fa.data.dtype)
-            data = data.at[dest_b].set(fb.data, mode="drop")
-            data = data.at[dest_a].set(fa.data, mode="drop")
-            lens = jnp.zeros((out_cap,), jnp.int32)
-            lens = lens.at[dest_b].set(fb.lengths, mode="drop")
-            lens = lens.at[dest_a].set(fa.lengths, mode="drop")
+    def scat(xa, xb, width=None, dtype=None):
+        shape = (out_cap,) if width is None else (out_cap, width)
+        out = jnp.zeros(shape, dtype if dtype is not None else xa.dtype)
+        out = out.at[dest_b].set(xb, mode="drop")
+        return out.at[dest_a].set(xa, mode="drop")
+
+    def merge_col(fa: DeviceColumn, fb: DeviceColumn) -> DeviceColumn:
+        # constructs FRESH columns (replace() is for rebuilds of one
+        # source column); vrange is dropped ON PURPOSE — fa's bound
+        # does not bound fb's values
+        val = scat(fa.validity, fb.validity, dtype=jnp.bool_)
+        if fa.children is not None:  # structs: recurse per field
+            kids = [merge_col(ka_, kb_)
+                    for ka_, kb_ in zip(fa.children, fb.children)]
+            return DeviceColumn(fa.dtype,
+                                jnp.zeros((out_cap,), jnp.int8), val,
+                                children=kids)
+        if fa.data.ndim == 2:  # strings / arrays / map keys
+            data = scat(fa.data, fb.data, width=fa.data.shape[1])
+            lens = scat(fa.lengths, fb.lengths, dtype=jnp.int32)
         else:
-            data = jnp.zeros((out_cap,), fa.data.dtype)
-            data = data.at[dest_b].set(fb.data, mode="drop")
-            data = data.at[dest_a].set(fa.data, mode="drop")
+            data = scat(fa.data, fb.data)
             lens = None
         ev = None
         if fa.elem_validity is not None:
-            ev = jnp.zeros((out_cap, fa.elem_validity.shape[1]),
-                           jnp.bool_)
-            ev = ev.at[dest_b].set(fb.elem_validity, mode="drop")
-            ev = ev.at[dest_a].set(fa.elem_validity, mode="drop")
-        val = jnp.zeros((out_cap,), jnp.bool_)
-        val = val.at[dest_b].set(fb.validity, mode="drop")
-        val = val.at[dest_a].set(fa.validity, mode="drop")
-        cols.append(DeviceColumn(fa.dtype, data, val, lens, ev))
+            ev = scat(fa.elem_validity, fb.elem_validity,
+                      width=fa.elem_validity.shape[1], dtype=jnp.bool_)
+        mv = None
+        if fa.map_values is not None:
+            mv = scat(fa.map_values, fb.map_values,
+                      width=fa.map_values.shape[1])
+        return DeviceColumn(fa.dtype, data, val, lens, ev, mv)
+
+    cols = [merge_col(fa, fb) for fa, fb in zip(a.columns, b.columns)]
     return ColumnBatch(a.schema, cols, na + nb)

@@ -113,7 +113,11 @@ ARRAY = TypeSig("array")
 MAP = TypeSig("map")
 STRUCT = TypeSig("struct")
 COMMON = ORDERABLE  # the scalar device surface
-ALL = COMMON + ARRAY + MAP + STRUCT
+# ALL deliberately EXCLUDES struct: the conditional/null lowerings it
+# gates (If/Coalesce/Nvl2/CaseWhen) rebuild columns without the
+# children leaf; ops that do handle structs name STRUCT explicitly
+ALL = COMMON + ARRAY + MAP
+ALL_NESTED = ALL + STRUCT
 
 
 class ExprSig:
@@ -204,8 +208,8 @@ def _build() -> Dict[Type, ExprSig]:
         P.And: ExprSig([("lhs", BOOL), ("rhs", BOOL)], BOOL),
         P.Or: ExprSig([("lhs", BOOL), ("rhs", BOOL)], BOOL),
         P.Not: ExprSig([("input", BOOL)], BOOL),
-        P.IsNull: ExprSig([("input", ALL)], BOOL),
-        P.IsNotNull: ExprSig([("input", ALL)], BOOL),
+        P.IsNull: ExprSig([("input", ALL_NESTED)], BOOL),
+        P.IsNotNull: ExprSig([("input", ALL_NESTED)], BOOL),
         P.IsNaN: ExprSig([("input", FP)], BOOL),
         P.In: ExprSig([("value", ORDERABLE)], BOOL,
                       variadic=("list", ORDERABLE)),
@@ -293,6 +297,7 @@ def _build() -> Dict[Type, ExprSig]:
         # conditionals
         C.If: ExprSig([("predicate", BOOL), ("then", ALL),
                        ("else", ALL)], ALL),
+        C.CaseWhen: ExprSig([], ALL, variadic=("input", ALL)),
         C.Coalesce: ExprSig([], ALL, variadic=("input", ALL)),
         C.Greatest: ExprSig([], ORDERABLE,
                             variadic=("input", ORDERABLE)),

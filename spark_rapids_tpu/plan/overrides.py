@@ -143,16 +143,6 @@ class TpuOverrides:
                 r = key_type_supported(o.expr.dtype)
                 if r:
                     meta.cannot_run(r)
-            from spark_rapids_tpu.sqltypes import StructType as _St
-
-            for f in node.schema.fields:
-                if isinstance(f.dataType, _St):
-                    # the out-of-core MERGE rebuilds columns leaf-wise
-                    # (ops/sortops.py merge_sorted) with no
-                    # children-aware path yet
-                    meta.cannot_run(
-                        f"struct payload column {f.name!r}: device "
-                        "sort-merge has no struct lowering")
         elif isinstance(node, L.Generate):
             for e in node.pass_through:
                 for r in expr_unsupported_reasons(e, self.conf):

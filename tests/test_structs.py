@@ -147,9 +147,9 @@ def test_struct_payload_left_join(spark):
                      (3, {"x": 20, "y": 2.0}), (4, None)]
 
 
-def test_struct_payload_sort_falls_back_correct(spark):
-    # sort with a struct payload column: tagged to the CPU path (no
-    # device sort-merge lowering) but results stay correct
+def test_struct_payload_sort_on_device(spark):
+    # struct payloads ride the device out-of-core sort (merge_sorted
+    # recurses into children)
     t = _struct_table(400, seed=5)
     df = spark.createDataFrame(t).orderBy("k")
     got = df.collect_arrow()
@@ -163,6 +163,35 @@ def test_struct_payload_sort_falls_back_correct(spark):
         == collections.Counter(
             None if r is None else (r["x"], r["name"])
             for r in t.column("s").to_pylist()))
+
+
+def test_struct_payload_multi_run_merge_sort(tmp_path):
+    # small batch rows force MULTIPLE sort runs -> the merge kernel's
+    # children-aware scatter path; rows must keep their struct fields
+    # paired with the sort key
+    s = TpuSparkSession({"spark.sql.shuffle.partitions": 1,
+                         "spark.rapids.sql.batchSizeRows": 128,
+                         "spark.rapids.sql.reader.batchSizeRows": 128})
+    try:
+        t = _struct_table(1000, seed=7)
+        pq.write_table(t, str(tmp_path / "p.parquet"))
+        got = (s.read.parquet(str(tmp_path))
+               .orderBy("k").collect_arrow())
+        ks = got.column("k").to_pylist()
+        assert ks == sorted(t.column("k").to_pylist())
+        # field values stay row-paired through the merge
+        import collections
+
+        want_pairs = collections.Counter(
+            (k, None if r is None else (r["x"], r["name"]))
+            for k, r in zip(t.column("k").to_pylist(),
+                            t.column("s").to_pylist()))
+        got_pairs = collections.Counter(
+            (k, None if r is None else (r["x"], r["name"]))
+            for k, r in zip(ks, got.column("s").to_pylist()))
+        assert got_pairs == want_pairs
+    finally:
+        s.stop()
 
 
 def test_struct_mesh_falls_back(tmp_path):
@@ -220,3 +249,21 @@ def test_sliced_nested_serde_no_copy_path():
     sl = big.slice(37, 20)  # offset != 0: the shuffle map-slice shape
     r = serde.deserialize_table(serde.serialize_table(sl))
     assert r.column("s").to_pylist() == sl.column("s").to_pylist()
+
+
+def test_struct_conditionals_fall_back(spark):
+    # If/Coalesce/CaseWhen device lowerings rebuild columns without
+    # children: struct operands must tag to the CPU path (regression:
+    # the ALL signature briefly admitted structs and crashed)
+    t = pa.table({"a": pa.array([1, None, 3], type=pa.int64()),
+                  "b": pa.array([10, 20, 30], type=pa.int64())})
+    df = spark.createDataFrame(t)
+    s1 = F.struct(F.col("a"))
+    s2 = F.struct(F.col("b").alias("a"))
+    got = df.select(F.coalesce(s1, s2).alias("s")).collect_arrow()
+    assert got.column("s").to_pylist() == [
+        {"a": 1}, {"a": None}, {"a": 3}]
+    got2 = (df.select(F.when(F.col("a").isNull(), s2)
+                      .otherwise(s1).alias("s")).collect_arrow())
+    assert got2.column("s").to_pylist() == [
+        {"a": 1}, {"a": 20}, {"a": 3}]
