@@ -564,7 +564,11 @@ class DataFrame:
                 "(result blob) or 'device' (HBM-resident relation)")
         return self
 
-    def persist(self, storage: str = "host", *_a, **_k) -> "DataFrame":
+    def persist(self, storage="host", *_a, **_k) -> "DataFrame":
+        # PySpark callers pass a StorageLevel positionally; anything
+        # non-string maps to the host tier.
+        if not isinstance(storage, str):
+            storage = "host"
         return self.cache(storage)
 
     def unpersist(self) -> "DataFrame":

@@ -974,15 +974,18 @@ class TpuHashAggregateExec(PhysicalPlan):
         chunk = segmented.mm_chunk()
         guard = False
         slots = []  # ("sum", w_i, cnt_i, out_t, out_np) | ("count", cnt_i)
-        count_idx_by_id: Dict[int, int] = {}
+        # Dedup count reductions on semantic identity (source column
+        # index, or "live" for the bare live mask) — id() of temporary
+        # arrays can alias across frees in eager execution.
+        count_idx_by_key: Dict[object, int] = {}
 
-        def add_count(valid) -> int:
-            i = count_idx_by_id.get(id(valid))
+        def add_count(valid, key) -> int:
+            i = count_idx_by_key.get(key)
             if i is None:
                 i = len(weights)
                 weights.append(valid.astype(jnp.float32))
                 accs.append(jnp.int64)
-                count_idx_by_id[id(valid)] = i
+                count_idx_by_key[key] = i
             return i
 
         ci = ci0
@@ -1003,14 +1006,16 @@ class TpuHashAggregateExec(PhysicalPlan):
                 wi = len(weights)
                 weights.append(w)
                 accs.append(acc)
-                slots.append(("sum", wi, add_count(valid), out_t,
-                              data.dtype))
+                slots.append(("sum", wi, add_count(valid, ("col", ci)),
+                              out_t, data.dtype))
             else:  # Count
-                valid = live if k == 0 else (
-                    work.columns[ci].validity & live)
-                slots.append(("count", add_count(valid)))
+                if k == 0:
+                    slots.append(("count", add_count(live, "live")))
+                else:
+                    valid = work.columns[ci].validity & live
+                    slots.append(("count", add_count(valid, ("col", ci))))
             ci += k
-        occ_i = add_count(live)
+        occ_i = add_count(live, "live")
         outs = segmented._mm_pass_multi(weights, gid, b, chunk, accs,
                                         guard_nonfinite=guard)
         outs = [segmented._pad_bins(o, bcap) for o in outs]

@@ -209,7 +209,9 @@ def load_descriptor(table_path: str, dv: dict) -> np.ndarray:
     """add.deletionVector descriptor -> deleted-row index array."""
     st = dv["storageType"]
     if st == "i":
-        return parse_blob(z85_decode(dv["pathOrInlineDv"]))
+        blob = z85_decode(dv["pathOrInlineDv"])
+        size = int(dv.get("sizeInBytes", len(blob)))
+        return parse_blob(blob[:size])
     if st == "u":
         path = _uuid_file_name(table_path, dv["pathOrInlineDv"])
     elif st == "p":
@@ -271,6 +273,8 @@ def inline_descriptor(indexes: np.ndarray,
     return {
         "storageType": "i",
         "pathOrInlineDv": z85_encode(blob + b"\x00" * pad),
-        "sizeInBytes": len(blob) + pad,
+        # sizeInBytes is the RAW serialized DV size; readers use it to
+        # strip the z85 padding, so it must exclude the pad bytes.
+        "sizeInBytes": len(blob),
         "cardinality": int(len(np.unique(indexes))),
     }
