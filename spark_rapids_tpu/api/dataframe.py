@@ -599,9 +599,32 @@ class DataFrame:
     def collect_arrow(self) -> pa.Table:
         from spark_rapids_tpu.config import rapids_conf as rc
 
+        # Engine-selection record (GpuOverrides NOT_ON_GPU diagnostics
+        # discipline applied to whole-query engine dispatch): which
+        # engine ran, and why each faster engine was skipped. Surfaced
+        # via explain() and session.query_metrics — a fused/mesh compile
+        # error must never silently land a query on the dispatch-bound
+        # eager path.
+        rec = {"engine": None, "fallbacks": []}
+        self._last_exec = rec
+        self.session.last_execution = rec
+
+        def ran(engine: str, out: pa.Table, store: bool = True
+                ) -> pa.Table:
+            rec["engine"] = engine
+            self.session.query_metrics.metric("engine." + engine).add(1)
+            if store and getattr(self, "_cached", False):
+                self._cache_store(out)
+            return out
+
+        def fell_back(engine: str, reason: str) -> None:
+            rec["fallbacks"].append((engine, reason))
+            self.session.query_metrics.metric(
+                "engineFallback." + engine).add(1)
+
         cached = self._cache_load()
         if cached is not None:
-            return cached
+            return ran("hostCache", cached, store=False)
 
         phys, _ = self._physical()
         if self.session.rapids_conf.is_explain_only:
@@ -620,13 +643,11 @@ class DataFrame:
             )
 
             try:
-                out = MeshQueryExecutor.for_devices(
-                    mesh_n, self.session.rapids_conf).execute(phys)
-                if getattr(self, "_cached", False):
-                    self._cache_store(out)
-                return out
-            except MeshCompileError:
-                pass  # operator without a mesh lowering: thread-pool path
+                return ran("mesh", MeshQueryExecutor.for_devices(
+                    mesh_n, self.session.rapids_conf).execute(phys))
+            except MeshCompileError as e:
+                # operator without a mesh lowering: thread-pool path
+                fell_back("mesh", str(e))
         if self.session.rapids_conf.get(rc.FUSED_EXEC):
             from spark_rapids_tpu.exec.fused import (
                 FusedCompileError,
@@ -634,13 +655,11 @@ class DataFrame:
             )
 
             try:
-                out = FusedSingleChipExecutor(
-                    self.session.rapids_conf).execute(phys)
-                if getattr(self, "_cached", False):
-                    self._cache_store(out)
-                return out
-            except FusedCompileError:
-                pass  # no fused lowering / too big: per-operator engine
+                return ran("fused", FusedSingleChipExecutor(
+                    self.session.rapids_conf).execute(phys))
+            except FusedCompileError as e:
+                # no fused lowering / too big: per-operator engine
+                fell_back("fused", str(e))
         if self.session.rapids_conf.get(rc.ADAPTIVE_ENABLED):
             from spark_rapids_tpu.exec.operators import (
                 TpuShuffleExchangeExec,
@@ -652,15 +671,9 @@ class DataFrame:
                     has_exchange(c) for c in n.children)
 
             if has_exchange(phys):
-                out = AdaptiveQueryExecutor(
-                    self.session.rapids_conf).execute(phys)
-                if getattr(self, "_cached", False):
-                    self._cache_store(out)
-                return out
-        out = phys.collect()
-        if getattr(self, "_cached", False):
-            self._cache_store(out)
-        return out
+                return ran("aqe", AdaptiveQueryExecutor(
+                    self.session.rapids_conf).execute(phys))
+        return ran("eager", phys.collect())
 
     def collect(self) -> List[tuple]:
         t = self.collect_arrow()
@@ -688,6 +701,12 @@ class DataFrame:
         if extended:
             print("== Device Placement ==")
             print(meta.explain(only_not_on_device=False))
+        rec = getattr(self, "_last_exec", None)
+        if rec is not None and rec["engine"] is not None:
+            print("== Engine ==")
+            print(rec["engine"])
+            for eng, reason in rec["fallbacks"]:
+                print(f"  fell back from {eng}: {reason}")
 
     def write_parquet(self, path: str):
         self.session.write_parquet(self, path)

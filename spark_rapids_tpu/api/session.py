@@ -186,9 +186,15 @@ class TpuSparkSession:
     def __init__(self, conf: Optional[Dict[str, object]] = None):
         from spark_rapids_tpu.exec.relation_cache import CacheManager
 
+        from spark_rapids_tpu.runtime.metrics import MetricsRegistry
+
         self._settings = dict(conf or {})
         self.rapids_conf = rc.RapidsConf(self._settings)
         self.cache_manager = CacheManager()
+        # engine-dispatch observability (which engine ran each query and
+        # why faster engines fell back — see DataFrame.collect_arrow)
+        self.query_metrics = MetricsRegistry()
+        self.last_execution = None
         self._init_runtime()
         global _active
         with _active_lock:

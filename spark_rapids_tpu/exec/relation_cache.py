@@ -16,11 +16,11 @@ Usage mirrors Spark:
     base = spark.read.parquet(path).cache(storage="device")
     base.filter(...).groupBy(...).agg(...)   # serves from HBM
 
-Matching is by logical-node identity (derived DataFrames share the
-parent's plan object), the common cache-then-derive pattern; Spark's
-canonical-plan matching is wider but identity covers the API this
-engine exposes. Entries are explicitly managed (`unpersist`), like
-Spark's — no file-mtime invalidation.
+Matching is by CANONICAL plan structure (plan/logical.py plan_key),
+Spark CacheManager's canonicalized-plan discipline: a freshly built
+`spark.read.parquet(same_path)` hits a cache registered by an earlier,
+independent DataFrame over the same path. Entries are explicitly
+managed (`unpersist`), like Spark's — no file-mtime invalidation.
 """
 
 from __future__ import annotations
@@ -144,27 +144,44 @@ class DeviceCacheEntry:
 
 
 class CacheManager:
-    """Session-level registry: logical node id -> DeviceCacheEntry."""
+    """Session-level registry: canonical plan key -> DeviceCacheEntry.
+
+    Keys are structural (plan/logical.py plan_key) — Spark's
+    canonicalized-plan matching — so an independently re-built
+    DataFrame over the same source and transforms hits the cache, not
+    just DataFrames derived from the cached object."""
 
     def __init__(self):
-        self._entries: Dict[int, DeviceCacheEntry] = {}
+        self._entries: Dict[tuple, DeviceCacheEntry] = {}
         self._lock = threading.Lock()
 
+    @staticmethod
+    def _key(logical) -> tuple:
+        from spark_rapids_tpu.plan.logical import plan_key
+
+        return plan_key(logical)
+
     def register(self, logical, conf) -> DeviceCacheEntry:
+        key = self._key(logical)
         with self._lock:
-            entry = self._entries.get(id(logical))
+            entry = self._entries.get(key)
             if entry is None:
                 entry = DeviceCacheEntry(logical, conf)
-                self._entries[id(logical)] = entry
+                self._entries[key] = entry
             return entry
 
     def lookup(self, logical) -> Optional[DeviceCacheEntry]:
         with self._lock:
-            return self._entries.get(id(logical))
+            if not self._entries:  # keys are O(plan); skip when empty
+                return None
+        key = self._key(logical)
+        with self._lock:
+            return self._entries.get(key)
 
     def unregister(self, logical) -> None:
+        key = self._key(logical)
         with self._lock:
-            entry = self._entries.pop(id(logical), None)
+            entry = self._entries.pop(key, None)
         if entry is not None:
             entry.release()
 
@@ -178,19 +195,32 @@ class CacheManager:
     def substitute(self, logical):
         """Rewrite a logical tree, replacing registered subtrees with
         CachedRelation leaves (Spark CacheManager.useCachedData role).
-        Identity-based: derived plans share subtree objects."""
-        from spark_rapids_tpu.plan import logical as L
-
-        entry = self.lookup(logical)
-        if entry is not None:
-            return L.CachedRelation(entry)
-        if not logical.children:
-            return logical
-        new_children = [self.substitute(c) for c in logical.children]
-        if all(n is o for n, o in zip(new_children, logical.children)):
-            return logical
+        Structural: any subtree canonically equal to a registered plan
+        serves from the cache, shared object or not. Keys compose
+        bottom-up in ONE pass (plan_own_key), not per-subtree."""
         import copy
 
-        node = copy.copy(logical)
-        node.children = new_children
-        return node
+        from spark_rapids_tpu.plan import logical as L
+        from spark_rapids_tpu.plan.logical import plan_own_key
+
+        with self._lock:
+            if not self._entries:
+                return logical
+
+        def walk(node):
+            """-> (key, possibly-rewritten node)"""
+            results = [walk(c) for c in node.children]
+            key = (type(node).__name__, plan_own_key(node),
+                   tuple(k for k, _ in results))
+            with self._lock:
+                entry = self._entries.get(key)
+            if entry is not None:
+                return key, L.CachedRelation(entry)
+            new_children = [c for _, c in results]
+            if all(n is o for n, o in zip(new_children, node.children)):
+                return key, node
+            node = copy.copy(node)
+            node.children = new_children
+            return key, node
+
+        return walk(logical)[1]
