@@ -49,34 +49,82 @@ class GetStructField(Expression):
 
 class CreateNamedStruct(Expression):
     """struct(col1, col2, ...) — field expressions to a struct column.
-    Never null itself, like Spark's CreateNamedStruct."""
+    Never null itself, like Spark's CreateNamedStruct — unless
+    `valid_from` is given (a final extra child): then the struct's
+    top-level validity copies that child's validity, which is how the
+    struct-key grouping rewrite (plan/struct_keys.py) rebuilds a
+    possibly-null struct key from its expanded NullGate column."""
 
-    def __init__(self, names: List[str], exprs: List[Expression]):
+    def __init__(self, names: List[str], exprs: List[Expression],
+                 valid_from: Expression = None):
         assert len(names) == len(exprs)
-        super().__init__(list(exprs))
+        kids = list(exprs) + ([valid_from] if valid_from is not None
+                              else [])
+        super().__init__(kids)
         self.names = list(names)
+        self._has_gate = valid_from is not None
+
+    @property
+    def _fields(self):
+        return self.children[:-1] if self._has_gate else self.children
 
     @property
     def dtype(self):
         return StructType([
             StructField(n, e.dtype, e.nullable)
-            for n, e in zip(self.names, self.children)])
+            for n, e in zip(self.names, self._fields)])
 
     @property
     def nullable(self):
-        return False
+        return self._has_gate
 
     def key(self):
-        return ("create_named_struct", tuple(self.names),
+        return ("create_named_struct", tuple(self.names), self._has_gate,
                 tuple(c.key() for c in self.children))
 
     def eval(self, ctx) -> DeviceColumn:
-        kids = [e.eval(ctx) for e in self.children]
+        kids = [e.eval(ctx) for e in self._fields]
         # struct() with no fields is legal Spark; size from the batch
         cap = kids[0].capacity if kids else ctx.batch.capacity
+        validity = (self.children[-1].eval(ctx).validity
+                    if self._has_gate else jnp.ones((cap,), jnp.bool_))
         return DeviceColumn(
             self.dtype, jnp.zeros((cap,), jnp.int8),
-            jnp.ones((cap,), jnp.bool_), children=kids)
+            validity, children=kids)
 
     def __repr__(self):
         return "struct(" + ", ".join(self.names) + ")"
+
+
+class NullGate(Expression):
+    """Boolean key column that is TRUE where the child is non-null and
+    NULL where it is null — turns a struct key's TOP-LEVEL nullability
+    into an orderable primitive key: as a join key, a null struct never
+    matches (Spark EqualTo null propagation); as a grouping key, null
+    structs group together, distinct from a non-null struct whose
+    fields are all null (plan/struct_keys.py expansion)."""
+
+    def __init__(self, child: Expression):
+        super().__init__([child])
+
+    @property
+    def dtype(self):
+        from spark_rapids_tpu.sqltypes.datatypes import boolean
+
+        return boolean
+
+    @property
+    def nullable(self):
+        return self.children[0].nullable
+
+    def key(self):
+        return ("null_gate", self.children[0].key())
+
+    def eval(self, ctx) -> DeviceColumn:
+        c = self.children[0].eval(ctx)
+        return DeviceColumn(self.dtype,
+                            jnp.ones((c.capacity,), jnp.bool_),
+                            c.validity)
+
+    def __repr__(self):
+        return f"null_gate({self.children[0]!r})"

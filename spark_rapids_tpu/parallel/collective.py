@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from spark_rapids_tpu.columnar.batch import ColumnBatch, DeviceColumn
+from spark_rapids_tpu.columnar.batch import ColumnBatch
 from spark_rapids_tpu.ops import filterops
 from spark_rapids_tpu.ops.common import sort_permutation
 
@@ -106,16 +106,12 @@ def all_to_all_batch(batch: ColumnBatch, pid: jnp.ndarray, n_dest: int,
         return flat
 
     # compact received rows: row j of source s is live iff
-    # j < recv_counts_per_src[s]
-    new_cols = []
-    for col in batch.columns:
-        data = exchange_leaf(col.data)
-        validity = exchange_leaf(col.validity)
-        lengths = (None if col.lengths is None
-                   else exchange_leaf(col.lengths))
-        from spark_rapids_tpu.columnar.batch import DeviceColumn
-
-        new_cols.append(DeviceColumn(col.dtype, data, validity, lengths))
+    # j < recv_counts_per_src[s]. Every per-row leaf of the column
+    # pytree exchanges the same way — tree_map recurses into string
+    # matrices, array element validity, map values, and struct children
+    # without per-field plumbing.
+    new_cols = [jax.tree_util.tree_map(exchange_leaf, col)
+                for col in batch.columns]
     recv_cap = n_dest * slot
     slot_pos = jnp.tile(jnp.arange(slot, dtype=jnp.int32), n_dest)
     src_id = jnp.repeat(jnp.arange(n_dest, dtype=jnp.int32), slot)
@@ -143,9 +139,7 @@ def all_gather_batch(batch: ColumnBatch, axis_name: str, n: int
         out = lax.all_gather(arr, axis_name)  # [n, cap, ...]
         return out.reshape((n * cap,) + arr.shape[1:])
 
-    new_cols = [DeviceColumn(c.dtype, g(c.data), g(c.validity),
-                             None if c.lengths is None else g(c.lengths))
-                for c in batch.columns]
+    new_cols = [jax.tree_util.tree_map(g, c) for c in batch.columns]
     blk = jnp.repeat(jnp.arange(n, dtype=jnp.int32), cap)
     pos = jnp.tile(jnp.arange(cap, dtype=jnp.int32), n)
     live = pos < jnp.take(counts, blk)

@@ -143,16 +143,14 @@ def make_distributed_agg(mesh: Mesh, template: ColumnBatch,
 
 
 def _local_view(batch: ColumnBatch, n: int) -> ColumnBatch:
-    """Shape template of one device's shard (capacity / n rows)."""
-    cols = []
+    """Shape template of one device's shard (capacity / n rows). Every
+    per-row leaf (incl. struct children) shrinks its leading dim."""
     per = batch.capacity // n
-    for c in batch.columns:
-        cols.append(DeviceColumn(
-            c.dtype,
-            jax.ShapeDtypeStruct((per,) + c.data.shape[1:], c.data.dtype),
-            jax.ShapeDtypeStruct((per,), jnp.bool_),
-            None if c.lengths is None
-            else jax.ShapeDtypeStruct((per,), jnp.int32)))
+
+    def sds(a):
+        return jax.ShapeDtypeStruct((per,) + tuple(a.shape[1:]), a.dtype)
+
+    cols = [jax.tree_util.tree_map(sds, c) for c in batch.columns]
     return ColumnBatch(batch.schema, cols,
                        jax.ShapeDtypeStruct((1,), jnp.int32))
 
@@ -162,16 +160,13 @@ def _shape_stub(b: ColumnBatch, partial_fn, final_fn, n: int, slot: int
     """Shape-equivalent single-device stand-in for eval_shape: the
     all_to_all reshapes every leaf from [cap,...] to [n*slot,...]."""
     part = partial_fn(b)
-    cols = []
-    for c in part.columns:
-        cap = c.data.shape[0]
+
+    def tile_leaf(x):
+        cap = x.shape[0]
         reps = -(-(n * slot) // cap)
-        data = jnp.tile(c.data, (reps,) + (1,) * (c.data.ndim - 1))[
-            :n * slot]
-        validity = jnp.tile(c.validity, reps)[:n * slot]
-        lengths = None if c.lengths is None else jnp.tile(
-            c.lengths, reps)[:n * slot]
-        cols.append(DeviceColumn(c.dtype, data, validity, lengths))
+        return jnp.tile(x, (reps,) + (1,) * (x.ndim - 1))[:n * slot]
+
+    cols = [jax.tree_util.tree_map(tile_leaf, c) for c in part.columns]
     fake = ColumnBatch(part.schema, cols, jnp.int32(0))
     out = final_fn(fake)
     return ColumnBatch(out.schema, out.columns,

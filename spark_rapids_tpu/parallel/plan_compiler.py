@@ -425,24 +425,29 @@ class MeshQueryExecutor:
                 cols.append(column_from_arrow(arr, field, shard_cap))
             shard_cols.append(cols)
         # align string/array/map matrices to the global max width —
-        # EVERY 2-D leaf (data, elem_validity, map_values) must reach
-        # the same width or the global-array assembly rejects the shards
+        # EVERY 2-D leaf (data, elem_validity, map_values, struct
+        # children's matrices) must reach the same width or the
+        # global-array assembly rejects the shards. Leaf-wise over the
+        # column pytree so struct children align too.
         def pad2d(a, mb):
-            if a is None or a.shape[1] >= mb:
+            if a.shape[1] >= mb:
                 return a
             fill = np.zeros((shard_cap, mb - a.shape[1]), dtype=a.dtype)
             return np.concatenate([a, fill], axis=1)
 
         for ci in range(len(scan.schema.fields)):
-            datas = [sc[ci].data for sc in shard_cols]
-            if datas[0].ndim == 2:
-                mb = self._sync_max(max(int(d.shape[1]) for d in datas))
-                for sc in shard_cols:
-                    c = sc[ci]
-                    sc[ci] = DeviceColumn(
-                        c.dtype, pad2d(c.data, mb), c.validity,
-                        c.lengths, pad2d(c.elem_validity, mb),
-                        pad2d(c.map_values, mb))
+            flats = [jax.tree_util.tree_flatten(sc[ci])
+                     for sc in shard_cols]
+            leaves = [list(f[0]) for f in flats]
+            for li in range(len(leaves[0])):
+                if getattr(leaves[0][li], "ndim", 1) != 2:
+                    continue
+                mb = self._sync_max(max(int(l[li].shape[1])
+                                        for l in leaves))
+                for l in leaves:
+                    l[li] = pad2d(l[li], mb)
+            for sc, (_, treedef), l in zip(shard_cols, flats, leaves):
+                sc[ci] = jax.tree_util.tree_unflatten(treedef, l)
         sharding = NamedSharding(self.mesh, P(AXIS))
         local_devs = [devs[s] for s in local_ids]
 
@@ -452,22 +457,14 @@ class MeshQueryExecutor:
             return jax.make_array_from_single_device_arrays(
                 global_shape, sharding, singles)
 
+        def asm_leaf(*per_shard):
+            gshape = (n * shard_cap,) + tuple(per_shard[0].shape[1:])
+            return assemble(list(per_shard), gshape)
+
         out_cols = []
-        for ci, field in enumerate(scan.schema.fields):
+        for ci in range(len(scan.schema.fields)):
             per = [sc[ci] for sc in shard_cols]
-            c0 = per[0]
-            gshape = (n * shard_cap,) + tuple(c0.data.shape[1:])
-            data = assemble([c.data for c in per], gshape)
-            validity = assemble([c.validity for c in per],
-                                (n * shard_cap,))
-            lengths = None if c0.lengths is None else assemble(
-                [c.lengths for c in per], (n * shard_cap,))
-            ev = None if c0.elem_validity is None else assemble(
-                [c.elem_validity for c in per], gshape)
-            mv = None if c0.map_values is None else assemble(
-                [c.map_values for c in per], gshape)
-            out_cols.append(DeviceColumn(field.dataType, data, validity,
-                                         lengths, ev, mv))
+            out_cols.append(jax.tree_util.tree_map(asm_leaf, *per))
         counts = assemble(
             [np.asarray([t.num_rows], dtype=np.int32)
              for t in local_tables],
@@ -496,7 +493,6 @@ class MeshQueryExecutor:
             # ANSI checks live in the eager engine's per-batch check
             # programs; the SPMD program has no raise points
             raise MeshCompileError("ANSI mode uses the eager engine")
-        self._reject_struct_columns(phys)
         sources: List[PhysicalPlan] = []
         self._collect_sources(phys, sources)
         sharded = []
@@ -522,23 +518,6 @@ class MeshQueryExecutor:
                             "mesh width; eager engine handles it")
                     raise
                 expansion *= 2
-
-    @staticmethod
-    def _reject_struct_columns(phys: PhysicalPlan) -> None:
-        """Struct columns ride DeviceColumn.children; the mesh tier's
-        shard assembly and collectives operate leaf-wise on flat
-        columns and have no children-aware lowering yet — fall back to
-        the single-chip engines rather than silently dropping fields."""
-        from spark_rapids_tpu.sqltypes import StructType as _St
-
-        def walk(n):
-            if any(isinstance(f.dataType, _St) for f in n.schema.fields):
-                raise MeshCompileError(
-                    "struct columns have no mesh lowering yet")
-            for c in n.children:
-                walk(c)
-
-        walk(phys)
 
     @staticmethod
     def _has_static_collect(phys: PhysicalPlan) -> bool:
@@ -671,9 +650,12 @@ class MeshQueryExecutor:
         from spark_rapids_tpu.runtime.jit_cache import cached_jit
         from spark_rapids_tpu.shims import get_shim
 
+        # leaf-wise so struct children / string matrices / validity all
+        # participate in the program identity
         shape_key = tuple(
-            tuple((tuple(c.data.shape), str(c.data.dtype))
-                  for c in sb.columns) + ((sb.capacity,),)
+            tuple((tuple(leaf.shape), str(leaf.dtype))
+                  for leaf in jax.tree_util.tree_leaves(tuple(sb.columns)))
+            + ((sb.capacity,),)
             for sb in sharded)
         key = ("mesh_plan", _plan_key(phys), n, expansion, shape_key)
         jitted = cached_jit(

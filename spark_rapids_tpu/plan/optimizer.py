@@ -28,8 +28,11 @@ _FLIP = {"=": "=", "<": ">", "<=": ">=", ">": "<", ">=": "<="}
 
 
 def optimize(plan: L.LogicalPlan) -> L.LogicalPlan:
+    from spark_rapids_tpu.plan.struct_keys import expand_struct_keys
+
     new_children = [optimize(c) for c in plan.children]
     plan = _with_children(plan, new_children)
+    plan = expand_struct_keys(plan)
     plan = _push_filters(plan)
     plan = _prune_scan_columns(plan)
     return plan

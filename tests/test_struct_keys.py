@@ -1,0 +1,182 @@
+"""Struct grouping/join keys (plan/struct_keys.py canonical expansion;
+round-4 verdict item #4 — reference GpuHashJoin.scala:403 nested keys)
+and struct payloads through the MESH tier (collectives/shard assembly
+are leaf-wise over the column pytree, so DeviceColumn.children ride
+all_to_all like any other per-row leaf).
+
+Spark's struct-comparison semantics are the differential contract:
+- null structs GROUP together but never MATCH in a join (EqualTo null
+  propagation);
+- null FIELDS inside non-null structs compare EQUAL both for grouping
+  and for join keys (RowOrdering semantics).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSparkSession
+from spark_rapids_tpu.testing.asserts import (
+    assert_tables_equal,
+    with_cpu_session,
+    with_tpu_session,
+)
+
+MESH = {"spark.rapids.tpu.mesh": 8,
+        "spark.sql.shuffle.partitions": 4}
+
+ST = pa.struct([("a", pa.int64()), ("b", pa.string())])
+
+
+def _struct_table(n=64, seed=3):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        r = rng.random()
+        if r < 0.15:
+            rows.append(None)
+        elif r < 0.3:
+            rows.append({"a": None, "b": f"s{int(rng.integers(3))}"})
+        elif r < 0.45:
+            rows.append({"a": int(rng.integers(4)), "b": None})
+        else:
+            rows.append({"a": int(rng.integers(4)),
+                         "b": f"s{int(rng.integers(3))}"})
+    return pa.table({
+        "s": pa.array(rows, type=ST),
+        "v": pa.array(rng.random(n) * 10),
+    })
+
+
+def _group_oracle(t):
+    acc = {}
+    for s, v in zip(t["s"].to_pylist(), t["v"].to_pylist()):
+        k = None if s is None else (s["a"], s["b"])
+        c = acc.setdefault(k, [0.0, 0])
+        c[0] += v
+        c[1] += 1
+    return {k: (round(v, 6), c) for k, (v, c) in acc.items()}
+
+
+def _group_result(out):
+    return {
+        (None if s is None else (s["a"], s["b"])): (round(v, 6), c)
+        for s, v, c in zip(out["s"].to_pylist(), out["sv"].to_pylist(),
+                           out["c"].to_pylist())}
+
+
+def test_struct_group_key_vs_oracle():
+    t = _struct_table()
+    spark = TpuSparkSession({"spark.sql.shuffle.partitions": 2})
+    try:
+        out = (spark.createDataFrame(t).groupBy("s")
+               .agg(F.sum("v").alias("sv"), F.count("*").alias("c"))
+               .collect_arrow())
+        assert _group_result(out) == _group_oracle(t)
+        # the expansion kept the query on a device engine
+        assert spark.last_execution["engine"] in ("fused", "aqe",
+                                                  "eager")
+    finally:
+        spark.stop()
+
+
+def test_struct_join_key_semantics():
+    lt = pa.table({
+        "k": pa.array([{"a": 1, "b": "x"}, {"a": None, "b": "x"},
+                       None, {"a": 2, "b": None}, {"a": 9, "b": "q"}],
+                      type=ST),
+        "lv": pa.array([1, 2, 3, 4, 5]),
+    })
+    rt = pa.table({
+        "k": pa.array([{"a": 1, "b": "x"}, {"a": None, "b": "x"},
+                       None, {"a": 2, "b": None}],
+                      type=ST),
+        "rv": pa.array([10, 20, 30, 40]),
+    })
+    spark = TpuSparkSession({"spark.sql.shuffle.partitions": 2})
+    try:
+        j = (spark.createDataFrame(lt)
+             .join(spark.createDataFrame(rt), on="k", how="inner")
+             .collect_arrow())
+        pairs = sorted(zip(j["lv"].to_pylist(), j["rv"].to_pylist()))
+        # null struct rows (3/30) never match; null-field rows match
+        assert pairs == [(1, 10), (2, 20), (4, 40)], pairs
+        # left join: unmatched keep null right side
+        lj = (spark.createDataFrame(lt)
+              .join(spark.createDataFrame(rt), on="k", how="left")
+              .collect_arrow())
+        got = dict(zip(lj["lv"].to_pylist(), lj["rv"].to_pylist()))
+        assert got == {1: 10, 2: 20, 3: None, 4: 40, 5: None}, got
+    finally:
+        spark.stop()
+
+
+def test_struct_semi_anti_join_keys():
+    lt = pa.table({
+        "k": pa.array([{"a": 1, "b": "x"}, None, {"a": 7, "b": "z"}],
+                      type=ST),
+        "lv": pa.array([1, 2, 3]),
+    })
+    rt = pa.table({
+        "k": pa.array([{"a": 1, "b": "x"}], type=ST),
+        "rv": pa.array([10]),
+    })
+    spark = TpuSparkSession({"spark.sql.shuffle.partitions": 2})
+    try:
+        semi = (spark.createDataFrame(lt).join(
+            spark.createDataFrame(rt), on="k", how="left_semi")
+            .collect_arrow())
+        assert semi["lv"].to_pylist() == [1]
+        anti = (spark.createDataFrame(lt).join(
+            spark.createDataFrame(rt), on="k", how="left_anti")
+            .collect_arrow())
+        assert sorted(anti["lv"].to_pylist()) == [2, 3]
+    finally:
+        spark.stop()
+
+
+# ------------------------------------------------------------- mesh
+
+def test_mesh_struct_payload_through_shuffle():
+    """Struct columns shard, ride all_to_all, and gather back — the
+    round-4 mesh rejection (plan_compiler._reject_struct_columns) is
+    gone; the collectives exchange every pytree leaf incl. children."""
+    n = 4000
+    rng = np.random.default_rng(5)
+    t = pa.table({
+        "store": pa.array(rng.integers(0, 16, n), type=pa.int64()),
+        "s": pa.array(
+            [{"a": int(a), "b": f"b{int(a) % 5}"}
+             for a in rng.integers(0, 50, n)], type=ST),
+        "v": pa.array(rng.random(n)),
+    })
+
+    def q(s):
+        df = s.createDataFrame(t)
+        # shuffle by store (repartition) then filter on a struct field
+        return (df.repartition(4, "store")
+                .filter(F.col("s").getField("a") > 10)
+                .select("store", "s", "v"))
+
+    got = with_tpu_session(lambda s: q(s).collect_arrow(), MESH)
+    want = with_cpu_session(lambda s: q(s).collect_arrow())
+    assert_tables_equal(got, want, ignore_order=True)
+
+
+def test_mesh_struct_group_key():
+    n = 3000
+    rng = np.random.default_rng(9)
+    rows = [None if rng.random() < 0.1 else
+            {"a": int(rng.integers(5)),
+             "b": None if rng.random() < 0.2 else f"r{int(rng.integers(3))}"}
+            for _ in range(n)]
+    t = pa.table({"s": pa.array(rows, type=ST),
+                  "v": pa.array(rng.random(n))})
+
+    def q(s):
+        return (s.createDataFrame(t).groupBy("s")
+                .agg(F.sum("v").alias("sv"), F.count("*").alias("c")))
+
+    got = with_tpu_session(lambda s: q(s).collect_arrow(), MESH)
+    assert _group_result(got) == _group_oracle(t)
