@@ -1,12 +1,20 @@
-"""Benchmark: the ENGINE end-to-end on the q5-shaped slice over a
-CACHED table — the interactive-analytics loop.
+"""Benchmark: the ENGINE end-to-end on the full q5 shape —
+scan + dimension JOIN + aggregate over a CACHED fact table, with a
+string dimension column — the interactive-analytics loop.
 
 Drives the full stack the way a user query does: session -> optimizer
 -> planner (TpuOverrides) -> cached relation (HBM-resident via
 `df.cache(storage="device")`, exec/relation_cache.py) -> fused
-filter/project/hash-aggregate XLA programs (MXU segmented reductions)
--> final aggregate -> D2H collect, with the semaphore, reservation
-ledger, and spill catalog all live.
+filter/lookup-join/project/hash-aggregate XLA programs (row-preserving
+broadcast join gather + MXU segmented reductions) -> final aggregate
+over the string dim key -> D2H collect, with the semaphore,
+reservation ledger, and spill catalog all live.
+
+Reports BOTH wall time and `compute_s`: the amortized per-iteration
+time of N back-to-back pipeline dispatches with one final sync
+(FusedSingleChipExecutor.execute_repeated), which removes the fixed
+per-query link roundtrip (~100-180 ms on tunneled devices) and so
+tracks the ENGINE, not the tunnel.
 
 Both sides run HOT over resident data: the engine queries the
 device-cached relation; the CPU baseline (pyarrow) queries the same
@@ -35,16 +43,20 @@ import pyarrow as pa
 import pyarrow.compute as pc
 import pyarrow.parquet as pq
 
-ROWS = 36_000_000          # 4 x 8B columns ~= 1.07 GiB
+ROWS = int(os.environ.get("SRTPU_BENCH_ROWS", 36_000_000))
+STORES = 2000              # 4 x 8B columns ~= 1.07 GiB at 36M rows
+REGIONS = 12
 FILES = 8
 REPEATS = 5
+COMPUTE_ITERS = 8
 # v4: PLAIN-encoded uncompressed parquet. The reference decodes parquet
 # ON DEVICE (Table.readParquet, GpuParquetScan.scala:2619) so its host
 # only moves bytes; the TPU engine gets the same property from PLAIN
 # pages (io/parquet_plain.py stitches page payloads as zero-copy typed
 # views — no host decompress/unpack pass on this single-core host).
 # The CPU baseline reads the same files.
-DATA_DIR = "/tmp/srtpu_bench_data_v4"
+DATA_DIR = f"/tmp/srtpu_bench_data_v5_{ROWS}"
+DIM_DIR = f"/tmp/srtpu_bench_data_v5_{ROWS}_dim"
 
 # peak HBM bandwidth per chip, bytes/s (public TPU specs; cpu backend
 # gets a nominal DDR figure so the fraction stays meaningful)
@@ -59,17 +71,22 @@ _PEAK_BW = {
 
 
 def ensure_data() -> int:
-    """Write the dataset once; return total bytes (arrow buffer size)."""
+    """Write the datasets once; return fact bytes (arrow buffer size).
+
+    Fact: 36M sales rows. Dim: one row per store with a STRING region
+    column (the q5 star shape: the aggregate groups by a dimension
+    attribute reached through the join)."""
     marker = os.path.join(DATA_DIR, "_DONE")
     per = ROWS // FILES
     if os.path.exists(marker):
         return int(open(marker).read())
     os.makedirs(DATA_DIR, exist_ok=True)
+    os.makedirs(DIM_DIR, exist_ok=True)
     rng = np.random.default_rng(0)
     total = 0
     for i in range(FILES):
         t = pa.table({
-            "store": pa.array(rng.integers(0, 2000, per),
+            "store": pa.array(rng.integers(0, STORES, per),
                               type=pa.int64()),
             "amount": pa.array(rng.random(per) * 100.0,
                                type=pa.float64()),
@@ -80,33 +97,50 @@ def ensure_data() -> int:
         pq.write_table(t, os.path.join(DATA_DIR, f"part-{i}.parquet"),
                        compression="NONE", use_dictionary=False,
                        row_group_size=per, data_page_size=64 << 20)
+    dim = pa.table({
+        "store": pa.array(np.arange(STORES), type=pa.int64()),
+        "region": pa.array(
+            [f"region_{i % REGIONS:02d}" for i in range(STORES)]),
+        "opened_day": pa.array(rng.integers(0, 3650, STORES),
+                               type=pa.int64()),
+    })
+    pq.write_table(dim, os.path.join(DIM_DIR, "dim-0.parquet"),
+                   compression="NONE", use_dictionary=False)
     with open(marker, "w") as f:
         f.write(str(total))
     return total
 
 
-def engine_query(base):
+def engine_query(base, dim):
+    """q5 shape: fact scan -> filter -> broadcast join to the store
+    dimension -> string-predicate filter on the dim attribute ->
+    group by the STRING region column."""
     from spark_rapids_tpu.api import functions as F
 
     return (base
             .filter(F.col("amount") > 10.0)
-            .select("store",
+            .join(dim, on="store", how="inner")
+            .filter(F.col("region") != f"region_{REGIONS - 1:02d}")
+            .select("region",
                     (F.col("amount") * F.col("qty")).alias("revenue"),
                     "amount")
-            .groupBy("store")
+            .groupBy("region")
             .agg(F.sum("revenue").alias("rev"),
                  F.avg("amount").alias("avg_amount"),
                  F.count("*").alias("sales")))
 
 
-def cpu_query(t):
+def cpu_query(t, dim):
     f = t.filter(pc.greater(t.column("amount"), 10.0))
-    rev = pc.multiply(f.column("amount"),
-                      pc.cast(f.column("qty"), pa.float64()))
-    work = pa.table({"store": f.column("store"), "revenue": rev,
-                     "amount": f.column("amount")})
-    return work.group_by("store").aggregate(
-        [("revenue", "sum"), ("amount", "mean"), ("store", "count")])
+    j = f.join(dim, keys="store", join_type="inner")
+    j = j.filter(pc.not_equal(j.column("region"),
+                              f"region_{REGIONS - 1:02d}"))
+    rev = pc.multiply(j.column("amount"),
+                      pc.cast(j.column("qty"), pa.float64()))
+    work = pa.table({"region": j.column("region"), "revenue": rev,
+                     "amount": j.column("amount")})
+    return work.group_by("region").aggregate(
+        [("revenue", "sum"), ("amount", "mean"), ("region", "count")])
 
 
 def _probe_device_backend():
@@ -155,26 +189,39 @@ def main():
         "spark.rapids.shuffle.mode": "DEVICE",
     })
 
-    # ---- CPU baseline (pyarrow): HOT, over a RAM-resident table ----
+    # ---- CPU baseline (pyarrow): HOT, over RAM-resident tables ----
     t0 = time.perf_counter()
     host_table = pq.read_table(DATA_DIR)
     cpu_cold_s = time.perf_counter() - t0  # decode cost, for reference
+    host_dim = pq.read_table(DIM_DIR)
     cpu_times = []
-    cpu_out = cpu_query(host_table)
+    cpu_out = cpu_query(host_table, host_dim)
     for _ in range(3):
         t0 = time.perf_counter()
-        cpu_out = cpu_query(host_table)
+        cpu_out = cpu_query(host_table, host_dim)
         cpu_times.append(time.perf_counter() - t0)
     cpu_gbps = input_bytes / min(cpu_times) / 1e9
 
-    # ---- engine: HOT, over the device-cached relation ----
+    # ---- engine: HOT, over device-cached relations ----
     base = spark.read.parquet(DATA_DIR).cache(storage="device")
-    df = engine_query(base)
+    dim = spark.read.parquet(DIM_DIR).cache(storage="device")
+    df = engine_query(base, dim)
     t0 = time.perf_counter()
     out = df.collect_arrow()  # cold: decode + upload + compiles
     cold_s = time.perf_counter() - t0
+    engine_used = spark.last_execution["engine"]
     assert out.num_rows == cpu_out.num_rows, (out.num_rows,
                                               cpu_out.num_rows)
+    # correctness spot-check against the pyarrow oracle
+    want = {r: round(v, 2) for r, v in zip(
+        cpu_out.column("region").to_pylist(),
+        cpu_out.column("revenue_sum").to_pylist())}
+    got = {r: round(v, 2) for r, v in zip(
+        out.column("region").to_pylist(), out.column("rev").to_pylist())}
+    assert set(got) == set(want), (sorted(got), sorted(want))
+    for r in want:
+        assert abs(got[r] - want[r]) <= max(1e-6 * abs(want[r]), 1e-2), \
+            (r, got[r], want[r])
     times = []
     for _ in range(REPEATS):
         t0 = time.perf_counter()
@@ -186,6 +233,22 @@ def main():
     q3 = times_sorted[(3 * len(times)) // 4]
     spread_pct = 100.0 * (q1 and (q3 - q1) / med or 0.0)
     dev_gbps = input_bytes / med / 1e9
+
+    # ---- device-timed compute: N pipelined dispatches, one sync ----
+    # (fused-engine-only measurement; if the wall-time query ran on a
+    # different engine, or fused can't lower it, report nulls rather
+    # than dying and losing the wall-time numbers)
+    compute_s = compute_gbps = None
+    if engine_used == "fused":
+        from spark_rapids_tpu.exec.fused import FusedSingleChipExecutor
+
+        try:
+            phys, _ = df._physical()
+            compute_s = FusedSingleChipExecutor(
+                spark.rapids_conf).execute_repeated(phys, COMPUTE_ITERS)
+            compute_gbps = input_bytes / compute_s / 1e9
+        except Exception as e:  # never lose the wall-time report
+            print(f"# compute_s unavailable: {e!r}", flush=True)
 
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", dev.platform)
@@ -209,13 +272,17 @@ def main():
     h2d = big.nbytes / (time.perf_counter() - t0) / 1e9
 
     print(json.dumps({
-        "metric": f"q5-slice engine throughput over device-cached table"
-                  f" ({dev.platform}, {ROWS} rows,"
-                  f" {input_bytes >> 20} MiB)",
+        "metric": f"q5 join+agg engine throughput over device-cached"
+                  f" tables ({dev.platform}, {ROWS} rows x {STORES}-row"
+                  f" string dim, {input_bytes >> 20} MiB)",
         "value": round(dev_gbps, 3),
         "unit": "GB/s",
         "vs_baseline": round(dev_gbps / cpu_gbps, 3),
         "median_s": round(med, 3),
+        "compute_s": None if compute_s is None else round(compute_s, 4),
+        "compute_gbps": (None if compute_gbps is None
+                         else round(compute_gbps, 3)),
+        "engine": engine_used,
         "spread_pct": round(spread_pct, 1),
         "cold_s": round(cold_s, 2),
         "cpu_baseline_gbps": round(cpu_gbps, 3),

@@ -116,6 +116,14 @@ FUSED_GROUP_CAP = conf(
     "Static capacity bucket fused partial-aggregate outputs shrink "
     "to; more groups than this overflows into an expansion retry.",
     int)
+FUSED_AGG_PUSHDOWN = conf(
+    "spark.rapids.sql.fusedExec.aggPushdownThroughJoin", True,
+    "Pre-aggregate the probe side of a fused lookup join by the join "
+    "keys when the aggregate above groups by build-side attributes — "
+    "the join then moves group buffers (thousands of rows) instead of "
+    "fact rows (millions). Falls back automatically when the build "
+    "side has duplicate keys (the lookup join's overflow retry).",
+    bool)
 FUSED_SINGLE_SYNC_FETCH_BYTES = conf(
     "spark.rapids.sql.fusedExec.singleSyncFetchMaxBytes", 16 << 20,
     "Results at most this large fetch rows+flags+data in ONE link "

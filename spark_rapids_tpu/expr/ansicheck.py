@@ -135,34 +135,42 @@ def overflow_masks(e: Expression, ctx: EvalContext
     return out
 
 
+def flags_vec(exprs: List[Expression], batch, live=None) -> jnp.ndarray:
+    """Traced reduction of every checked node's mask to one (3,) bool
+    vector [arith, divzero, cast] over `live` rows. The fused executor
+    accumulates these vectors through its overflow-flag channel
+    (exec/fused.py) so ANSI costs zero extra host roundtrips."""
+    ctx = EvalContext(batch)
+    if live is None:
+        live = batch.live_mask()
+    flags = {_ARITH: jnp.zeros((), bool),
+             _DIVZERO: jnp.zeros((), bool),
+             _CAST: jnp.zeros((), bool)}
+    for e in exprs:
+        for kind, mask in overflow_masks(e, ctx):
+            flags[kind] = flags[kind] | jnp.any(mask & live)
+    return jnp.stack([flags[_ARITH], flags[_DIVZERO], flags[_CAST]])
+
+
 def check_fn(exprs: List[Expression]):
     """Build the jittable check program: batch -> (arith_err, div_err)
     scalars. Caller fetches and raises."""
 
     def run(batch):
-        ctx = EvalContext(batch)
-        live = batch.live_mask()
-        flags = {_ARITH: jnp.zeros((), bool),
-                 _DIVZERO: jnp.zeros((), bool),
-                 _CAST: jnp.zeros((), bool)}
-        for e in exprs:
-            for kind, mask in overflow_masks(e, ctx):
-                flags[kind] = flags[kind] | jnp.any(mask & live)
-        return flags[_ARITH], flags[_DIVZERO], flags[_CAST]
+        v = flags_vec(exprs, batch)
+        return v[0], v[1], v[2]
 
     return run
 
 
-def raise_if_set(flags) -> None:
-    import jax
-
+def raise_host(arith: bool, div: bool, cast: bool) -> None:
+    """Raise the ANSI error for already-fetched host flags."""
     from spark_rapids_tpu.runtime.errors import (
         TpuArithmeticOverflow,
         TpuCastError,
         TpuDivideByZero,
     )
 
-    arith, div, cast = (bool(x) for x in jax.device_get(flags))
     if arith:
         raise TpuArithmeticOverflow(
             "[ARITHMETIC_OVERFLOW] overflow in ANSI mode; set "
@@ -173,3 +181,10 @@ def raise_if_set(flags) -> None:
     if cast:
         raise TpuCastError(
             "[CAST_OVERFLOW] cast overflow in ANSI mode")
+
+
+def raise_if_set(flags) -> None:
+    import jax
+
+    arith, div, cast = (bool(x) for x in jax.device_get(flags))
+    raise_host(arith, div, cast)

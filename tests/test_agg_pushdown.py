@@ -1,0 +1,235 @@
+"""Partial-aggregation pushdown through fused lookup joins
+(exec/agg_pushdown.py): the q5 star shape pre-aggregates the probe
+side by the join key, joins ~|dim| buffer rows, and merges by the dim
+attribute. Oracle is plain Python/pyarrow recomputation; the
+duplicate-build-key case must fall back (lookup overflow retry) and
+stay correct."""
+
+import collections
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSparkSession
+
+
+@pytest.fixture()
+def spark():
+    s = TpuSparkSession({"spark.sql.shuffle.partitions": 4})
+    yield s
+    s.stop()
+
+
+def _data(n=20000, stores=40, seed=2):
+    rng = np.random.default_rng(seed)
+    fact = pa.table({
+        "store": pa.array(rng.integers(0, stores, n), pa.int64()),
+        "amount": pa.array(rng.random(n) * 100),
+        "qty": pa.array(rng.integers(1, 9, n), pa.int64()),
+    })
+    dim = pa.table({
+        "store": pa.array(np.arange(stores), pa.int64()),
+        "region": pa.array([f"R{i % 6}" for i in range(stores)]),
+        "opened": pa.array(rng.integers(0, 100, stores), pa.int64()),
+    })
+    return fact, dim
+
+
+def _oracle(fact, dim, amount_min, skip_region):
+    reg = {int(s): r for s, r in zip(dim["store"].to_pylist(),
+                                     dim["region"].to_pylist())}
+    acc = collections.defaultdict(lambda: [0.0, 0.0, 0])
+    for s, a, q in zip(fact["store"].to_pylist(),
+                       fact["amount"].to_pylist(),
+                       fact["qty"].to_pylist()):
+        r = reg[int(s)]
+        if a > amount_min and r != skip_region:
+            acc[r][0] += a * q
+            acc[r][1] += a
+            acc[r][2] += 1
+    return {r: (round(v[0], 4), round(v[1] / v[2], 6), v[2])
+            for r, v in acc.items()}
+
+
+def _q(spark, fact, dim):
+    f = spark.createDataFrame(fact)
+    d = spark.createDataFrame(dim)
+    return (f.filter(F.col("amount") > 10.0)
+            .join(d, on="store", how="inner")
+            .filter(F.col("region") != "R3")
+            .select("region",
+                    (F.col("amount") * F.col("qty")).alias("rev"),
+                    "amount")
+            .groupBy("region")
+            .agg(F.sum("rev").alias("s"), F.avg("amount").alias("a"),
+                 F.count("*").alias("c")))
+
+
+def _result(out):
+    return {r: (round(s, 4), round(a, 6), c) for r, s, a, c in zip(
+        out["region"].to_pylist(), out["s"].to_pylist(),
+        out["a"].to_pylist(), out["c"].to_pylist())}
+
+
+def test_pushdown_star_query_vs_oracle(spark):
+    fact, dim = _data()
+    out = _q(spark, fact, dim).collect_arrow()
+    assert spark.last_execution["engine"] == "fused"
+    assert _result(out) == _oracle(fact, dim, 10.0, "R3")
+
+
+def test_pushdown_disabled_same_result():
+    fact, dim = _data(seed=7)
+    s = TpuSparkSession({
+        "spark.sql.shuffle.partitions": 4,
+        "spark.rapids.sql.fusedExec.aggPushdownThroughJoin": False})
+    try:
+        out = _q(s, fact, dim).collect_arrow()
+        assert _result(out) == _oracle(fact, dim, 10.0, "R3")
+    finally:
+        s.stop()
+
+
+def test_pushdown_duplicate_build_keys_fall_back(spark):
+    # duplicate dim keys break the unique-build bet; the overflow
+    # retry must re-lower via the expanded join and stay correct
+    fact = pa.table({"store": pa.array([0, 0, 1, 2], pa.int64()),
+                     "v": pa.array([1.0, 2.0, 4.0, 8.0])})
+    dim = pa.table({"store": pa.array([0, 0, 1], pa.int64()),
+                    "region": pa.array(["A", "B", "C"])})
+    out = (spark.createDataFrame(fact)
+           .join(spark.createDataFrame(dim), on="store", how="inner")
+           .groupBy("region").agg(F.sum("v").alias("s"))
+           .collect_arrow())
+    got = dict(zip(out["region"].to_pylist(), out["s"].to_pylist()))
+    assert got == {"A": 3.0, "B": 3.0, "C": 4.0}, got
+
+
+def test_pushdown_left_join_null_extension(spark):
+    # probe rows without a dim match keep a NULL region group
+    fact = pa.table({"store": pa.array([0, 1, 9, 9], pa.int64()),
+                     "v": pa.array([1.0, 2.0, 4.0, 8.0])})
+    dim = pa.table({"store": pa.array([0, 1], pa.int64()),
+                    "region": pa.array(["A", "B"])})
+    out = (spark.createDataFrame(fact)
+           .join(spark.createDataFrame(dim), on="store", how="left")
+           .groupBy("region").agg(F.sum("v").alias("s"))
+           .collect_arrow())
+    got = {r: v for r, v in zip(out["region"].to_pylist(),
+                                out["s"].to_pylist())}
+    assert got == {"A": 1.0, "B": 2.0, None: 12.0}, got
+
+
+def test_pushdown_mixed_grouping_probe_and_build(spark):
+    # grouping by BOTH a probe column and a build column
+    fact = pa.table({"store": pa.array([0, 0, 1, 1, 0], pa.int64()),
+                     "day": pa.array([1, 2, 1, 1, 1], pa.int64()),
+                     "v": pa.array([1.0, 2.0, 4.0, 8.0, 16.0])})
+    dim = pa.table({"store": pa.array([0, 1], pa.int64()),
+                    "region": pa.array(["A", "B"])})
+    out = (spark.createDataFrame(fact)
+           .join(spark.createDataFrame(dim), on="store", how="inner")
+           .groupBy("region", "day").agg(F.sum("v").alias("s"))
+           .collect_arrow())
+    got = {(r, d): v for r, d, v in zip(out["region"].to_pylist(),
+                                        out["day"].to_pylist(),
+                                        out["s"].to_pylist())}
+    assert got == {("A", 1): 17.0, ("A", 2): 2.0, ("B", 1): 12.0}, got
+
+
+def test_pushdown_min_max_buffers(spark):
+    fact, dim = _data(n=5000, seed=4)
+    f = spark.createDataFrame(fact)
+    d = spark.createDataFrame(dim)
+    out = (f.join(d, on="store", how="inner")
+           .groupBy("region")
+           .agg(F.min("amount").alias("lo"), F.max("amount").alias("hi"))
+           .collect_arrow())
+    reg = {int(s): r for s, r in zip(dim["store"].to_pylist(),
+                                     dim["region"].to_pylist())}
+    acc = {}
+    for s, a in zip(fact["store"].to_pylist(),
+                    fact["amount"].to_pylist()):
+        r = reg[int(s)]
+        lo, hi = acc.get(r, (float("inf"), float("-inf")))
+        acc[r] = (min(lo, a), max(hi, a))
+    got = {r: (round(lo, 6), round(hi, 6)) for r, lo, hi in zip(
+        out["region"].to_pylist(), out["lo"].to_pylist(),
+        out["hi"].to_pylist())}
+    want = {r: (round(lo, 6), round(hi, 6)) for r, (lo, hi) in acc.items()}
+    assert got == want
+
+
+def test_duplicate_keys_at_max_expansion_config():
+    # uniqueness loss must NOT ride the capacity-overflow retry: with
+    # expansionFactor == maxExpansionFactor a dup-key broadcast join
+    # still executes (lookup re-lowers via the blocking path at the
+    # SAME factors instead of failing the retry loop)
+    s = TpuSparkSession({
+        "spark.sql.shuffle.partitions": 4,
+        "spark.rapids.sql.fusedExec.expansionFactor": 4,
+        "spark.rapids.sql.fusedExec.maxExpansionFactor": 4})
+    try:
+        fact = pa.table({"store": pa.array([0, 1], pa.int64()),
+                         "v": pa.array([1.0, 2.0])})
+        dim = pa.table({"store": pa.array([0, 0, 1], pa.int64()),
+                        "region": pa.array(["A", "B", "C"])})
+        out = (s.createDataFrame(fact)
+               .join(s.createDataFrame(dim), on="store", how="inner")
+               .groupBy("region").agg(F.sum("v").alias("x"))
+               .collect_arrow())
+        got = dict(zip(out["region"].to_pylist(), out["x"].to_pylist()))
+        assert got == {"A": 1.0, "B": 1.0, "C": 2.0}, got
+        assert s.last_execution["engine"] == "fused"
+    finally:
+        s.stop()
+
+
+def test_high_cardinality_probe_keys_fall_back():
+    # more distinct probe join keys than groupCapacity: the pushdown
+    # bet must re-lower WITHOUT blowing up the plan's own capacities
+    s = TpuSparkSession({
+        "spark.sql.shuffle.partitions": 4,
+        "spark.rapids.sql.fusedExec.groupCapacity": 256})
+    try:
+        n = 4096  # distinct keys >> groupCapacity
+        fact = pa.table({"k": pa.array(np.arange(n), pa.int64()),
+                         "v": pa.array(np.ones(n))})
+        dim = pa.table({"k": pa.array(np.arange(n), pa.int64()),
+                        "g": pa.array([f"g{i % 3}" for i in range(n)])})
+        out = (s.createDataFrame(fact)
+               .join(s.createDataFrame(dim), on="k", how="inner")
+               .groupBy("g").agg(F.sum("v").alias("x"))
+               .collect_arrow())
+        got = dict(zip(out["g"].to_pylist(), out["x"].to_pylist()))
+        want = {"g0": 1366.0, "g1": 1365.0, "g2": 1365.0}
+        assert got == want, got
+    finally:
+        s.stop()
+
+
+def test_ansi_disables_pushdown_join_visibility():
+    # ANSI checks must see POST-join row visibility: the unmatched
+    # probe row's overflowing expression must not raise (the inner
+    # join drops it before the aggregate evaluates its inputs)
+    from spark_rapids_tpu.sqltypes.datatypes import long as _long  # noqa
+
+    big = 1 << 62
+    s = TpuSparkSession({"spark.sql.shuffle.partitions": 4,
+                         "spark.sql.ansi.enabled": True})
+    try:
+        fact = pa.table({"store": pa.array([1, 2, 99], pa.int64()),
+                         "amount": pa.array([10, 20, big], pa.int64())})
+        dim = pa.table({"store": pa.array([1, 2], pa.int64()),
+                        "region": pa.array(["a", "b"])})
+        out = (s.createDataFrame(fact)
+               .join(s.createDataFrame(dim), on="store", how="inner")
+               .groupBy("region")
+               .agg(F.sum(F.col("amount") * F.col("amount")).alias("x"))
+               .collect_arrow())
+        got = dict(zip(out["region"].to_pylist(), out["x"].to_pylist()))
+        assert got == {"a": 100, "b": 400}, got
+    finally:
+        s.stop()
