@@ -116,6 +116,11 @@ FUSED_GROUP_CAP = conf(
     "Static capacity bucket fused partial-aggregate outputs shrink "
     "to; more groups than this overflows into an expansion retry.",
     int)
+WINDOW_STREAMING = conf(
+    "spark.rapids.sql.window.streamingEnabled", True,
+    "Use the streaming window strategies (running-frame carry state, "
+    "two-pass unbounded aggregation) for eligible specs instead of "
+    "materializing whole partitions on device.", bool)
 FUSED_AGG_PUSHDOWN = conf(
     "spark.rapids.sql.fusedExec.aggPushdownThroughJoin", True,
     "Pre-aggregate the probe side of a fused lookup join by the join "

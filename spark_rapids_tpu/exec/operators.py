@@ -53,7 +53,7 @@ from spark_rapids_tpu.plan.logical import SortOrder
 from spark_rapids_tpu.runtime import semaphore as sem
 from spark_rapids_tpu.runtime import metrics as M
 from spark_rapids_tpu.shuffle.manager import get_shuffle_manager
-from spark_rapids_tpu.sqltypes import StructField, StructType
+from spark_rapids_tpu.sqltypes import StringType, StructField, StructType
 from spark_rapids_tpu.sqltypes.datatypes import long, to_arrow_type
 
 
@@ -2204,6 +2204,80 @@ class CpuGenerateExec(PhysicalPlan):
 
 # ----------------------------------------------------------------- window
 
+def window_streaming_mode(window_exprs: List[Alias]) -> Optional[str]:
+    """Streaming strategy for specs the bounded-halo path can't chunk
+    (round-4 verdict item #6; reference GpuRunningWindowExec.scala +
+    GpuUnboundedToUnboundedAggWindowExec.scala):
+
+    - "running": every expression is row_number/rank/dense_rank or a
+      sum/min/max/count over ROWS UNBOUNDED PRECEDING..CURRENT ROW —
+      chunks evaluate independently and a carried per-partition state
+      fixes up the prefix that continues the previous chunk's
+      partition (the scan-fixer pattern).
+    - "u2u": every expression is a jittable aggregate over the WHOLE
+      partition (unbounded..unbounded, or no frame and no order) —
+      two passes: per-chunk partial aggregation by partition key, then
+      a re-scan joining each row to its partition's result.
+
+    None -> whole-partition materialization remains the fallback."""
+    from spark_rapids_tpu.expr import windows as we
+    from spark_rapids_tpu.expr.aggregates import (
+        AggregateFunction,
+        Count,
+        First,
+        Max,
+        Min,
+        Sum,
+    )
+
+    spec = window_exprs[0].children[0].spec
+    fixed_width_keys = all(
+        getattr(e.dtype, "np_dtype", None) is not None
+        and not isinstance(e.dtype, StringType)
+        for e in (list(spec.partitions) +
+                  [o.expr for o in spec.orders]))
+    kinds = set()
+    for a in window_exprs:
+        wexpr = a.children[0]
+        fn = wexpr.function
+        frame = wexpr.spec.frame
+        if isinstance(fn, (we.RowNumber, we.Rank, we.DenseRank)):
+            kinds.add("running")
+            continue
+        if not isinstance(fn, AggregateFunction) or not fn.jittable:
+            return None
+        if isinstance(fn, First):
+            # first/last are ORDER-sensitive; the two-pass aggregate
+            # sees chunk-arrival order, not the spec's ORDER BY
+            return None
+        whole = (frame is not None and frame.lower is None
+                 and frame.upper is None) or (
+            frame is None and not wexpr.spec.orders)
+        if whole:
+            kinds.add("u2u")
+            continue
+        from spark_rapids_tpu.ops import decimal128 as d128
+
+        if (isinstance(fn, (Sum, Min, Max, Count))
+                and frame is not None and frame.frame_type == "rows"
+                and frame.lower is None and frame.upper == 0
+                and not d128.is_wide(fn.dtype)  # 2-limb carry shapes
+                and all(getattr(c.dtype, "np_dtype", None) is not None
+                        and not isinstance(c.dtype, StringType)
+                        and not d128.is_wide(c.dtype)
+                        for c in fn.children)):
+            kinds.add("running")
+            continue
+        return None
+    if kinds == {"running"}:
+        # the carried key state is fixed-shape 1-row arrays; variable-
+        # width (string) keys change shape across chunks
+        return "running" if fixed_width_keys else None
+    if kinds == {"u2u"}:
+        return "u2u"
+    return None  # mixed specs keep the whole-partition path
+
+
 def window_halo(window_exprs: List[Alias]) -> Optional[int]:
     """Rows of context a chunked window evaluation needs on each side, or
     None when the spec is not chunkable (ranking / running / unbounded /
@@ -2245,7 +2319,8 @@ class TpuWindowExec(PhysicalPlan):
     role)."""
 
     def __init__(self, window_exprs: List[Alias], child, conf,
-                 presorted: bool = False, halo: Optional[int] = None):
+                 presorted: bool = False, halo: Optional[int] = None,
+                 mode: Optional[str] = None):
         from spark_rapids_tpu.expr import windows as we
 
         base = child.schema
@@ -2255,6 +2330,7 @@ class TpuWindowExec(PhysicalPlan):
         self.window_exprs = window_exprs
         self.presorted = presorted
         self.halo = halo
+        self.mode = mode  # None | "running" | "u2u" (streaming paths)
         self.spec0: we.WindowSpecDef = window_exprs[0].children[0].spec
         from spark_rapids_tpu.runtime.jit_cache import aliases_key, cached_jit
 
@@ -2422,6 +2498,12 @@ class TpuWindowExec(PhysicalPlan):
             if self.presorted and self.halo is not None:
                 yield from self._execute_batched(pid, ctx)
                 return
+            if self.mode == "running":
+                yield from self._execute_running(pid, ctx)
+                return
+            if self.mode == "u2u":
+                yield from self._execute_u2u(pid, ctx)
+                return
             from spark_rapids_tpu.runtime.memory import get_catalog
             from spark_rapids_tpu.runtime.retry import retry_on_oom
 
@@ -2493,6 +2575,346 @@ class TpuWindowExec(PhysicalPlan):
         if pending is not None:
             yield retry_on_oom(
                 lambda p=prefix, c=pending: self._window_chunk(p, c, None))
+
+    # --- running-window streaming path (GpuRunningWindowExec role) ---
+
+    def _running_plan(self):
+        """Static fixer plan: per window expr, how the carried state
+        adjusts the in-chunk value."""
+        from spark_rapids_tpu.expr import windows as we
+        from spark_rapids_tpu.expr.aggregates import Count, Max, Min, Sum
+
+        plan = []
+        for a in self.window_exprs:
+            fn = a.children[0].function
+            if isinstance(fn, we.RowNumber):
+                plan.append("rownum")
+            elif isinstance(fn, we.DenseRank):
+                plan.append("dense")
+            elif isinstance(fn, we.Rank):
+                plan.append("rank")
+            elif isinstance(fn, Count):
+                plan.append("count")
+            elif isinstance(fn, Sum):
+                plan.append("sum")
+            elif isinstance(fn, Min):
+                plan.append("min")
+            else:
+                assert isinstance(fn, Max), fn
+                plan.append("max")
+        return plan
+
+    @staticmethod
+    def _rows_eq(col: DeviceColumn, ref_data, ref_valid) -> jnp.ndarray:
+        """Per-row null-safe equality of a key column against a 1-row
+        carried reference (null == null, and NaN == NaN — partition
+        membership uses the sort's total order, where NaNs group)."""
+        d = col.data
+        if d.ndim == 2:
+            eq = jnp.all(d == ref_data, axis=1)
+        else:
+            r = ref_data.reshape(())
+            eq = d == r
+            if jnp.issubdtype(d.dtype, jnp.floating):
+                eq = eq | (jnp.isnan(d) & jnp.isnan(r))
+        both_null = ~col.validity & ~ref_valid.reshape(())
+        return both_null | (col.validity & ref_valid.reshape(()) & eq)
+
+    def _running_fix(self, out: ColumnBatch, carry: dict):
+        """Traced: adjust the prefix of a sorted chunk that continues
+        the carried partition, then refresh the carry from the chunk's
+        last row. All state stays on device (1-row arrays)."""
+        ctx = EvalContext(out)
+        spec = self.spec0
+        nbase = len(self.schema.fields) - len(self.window_exprs)
+        live = out.live_mask()
+        nr = jnp.asarray(out.num_rows, jnp.int32).reshape(())
+        last = jnp.maximum(nr - 1, 0)
+
+        pcols = [p.eval(ctx) for p in spec.partitions]
+        ocols = [o.expr.eval(ctx) for o in spec.orders]
+        mask = live & carry["live"].reshape(())
+        for i, c in enumerate(pcols):
+            mask = mask & self._rows_eq(c, carry[f"pk{i}"],
+                                        carry[f"pkv{i}"])
+        peer = mask
+        for j, c in enumerate(ocols):
+            peer = peer & self._rows_eq(c, carry[f"ok{j}"],
+                                        carry[f"okv{j}"])
+
+        plan = self._running_plan()
+        new_cols = list(out.columns)
+        for i, kind in enumerate(plan):
+            col = out.columns[nbase + i]
+            cv, cvv = carry[f"v{i}"], carry[f"vv{i}"]
+            cvs = cv.reshape(cv.shape[1:]) if cv.ndim > 1 else \
+                cv.reshape(())
+            cvvs = cvv.reshape(())
+            if kind == "rownum":
+                d = jnp.where(mask, col.data + carry["n"].reshape(()),
+                              col.data).astype(col.data.dtype)
+                col = col.replace(data=d)
+            elif kind == "rank":
+                shifted = col.data + carry["n"].reshape(())
+                d = jnp.where(peer, cvs.astype(shifted.dtype), shifted)
+                col = col.replace(data=jnp.where(
+                    mask, d, col.data).astype(col.data.dtype))
+            elif kind == "dense":
+                # the chunk's first distinct order-group continues the
+                # carried group iff the first masked row is a peer
+                first_peer = jnp.any(peer & (jnp.cumsum(
+                    mask.astype(jnp.int32)) == 1))
+                off = cvs - jnp.where(first_peer, 1, 0)
+                col = col.replace(data=jnp.where(
+                    mask, col.data + off, col.data)
+                    .astype(col.data.dtype))
+            elif kind == "count":
+                col = col.replace(data=jnp.where(
+                    mask & cvvs, col.data + cvs.astype(col.data.dtype),
+                    col.data))
+            else:  # sum / min / max with null-skipping combine
+                both = mask & cvvs & col.validity
+                c_only = mask & cvvs & ~col.validity
+                if kind == "sum":
+                    comb = col.data + cvs.astype(col.data.dtype)
+                elif kind == "min":
+                    comb = jnp.minimum(col.data,
+                                       cvs.astype(col.data.dtype))
+                else:
+                    comb = jnp.maximum(col.data,
+                                       cvs.astype(col.data.dtype))
+                d = jnp.where(both, comb,
+                              jnp.where(c_only,
+                                        cvs.astype(col.data.dtype),
+                                        col.data))
+                col = col.replace(data=d,
+                                  validity=col.validity | (mask & cvvs))
+            new_cols[nbase + i] = col
+        fixed = ColumnBatch(out.schema, new_cols, out.num_rows)
+
+        # refresh the carry from the FIXED chunk's last row
+        has = nr > 0
+
+        def keep(new, old):
+            return jnp.where(has, new, old)
+
+        nc = dict(carry)
+        nc["live"] = keep(jnp.ones((1,), bool), carry["live"])
+        for i, c in enumerate(pcols):
+            nc[f"pk{i}"] = keep(
+                jnp.take(c.data, last, axis=0)[None], carry[f"pk{i}"])
+            nc[f"pkv{i}"] = keep(jnp.take(c.validity, last)[None],
+                                 carry[f"pkv{i}"])
+        for j, c in enumerate(ocols):
+            nc[f"ok{j}"] = keep(
+                jnp.take(c.data, last, axis=0)[None], carry[f"ok{j}"])
+            nc[f"okv{j}"] = keep(jnp.take(c.validity, last)[None],
+                                 carry[f"okv{j}"])
+        # rows so far in the last row's partition
+        in_last = live
+        for i, c in enumerate(pcols):
+            in_last = in_last & self._rows_eq(
+                c, jnp.take(c.data, last, axis=0),
+                jnp.take(c.validity, last)[None])
+        cnt = jnp.sum(in_last).astype(jnp.int64)
+        cont = jnp.take(mask, last)  # last row still in carry partition
+        nc["n"] = keep((cnt + jnp.where(cont, carry["n"].reshape(()),
+                                        0))[None], carry["n"])
+        for i, kind in enumerate(plan):
+            col = fixed.columns[nbase + i]
+            nc[f"v{i}"] = keep(jnp.take(col.data, last, axis=0)[None],
+                               carry[f"v{i}"])
+            nc[f"vv{i}"] = keep(jnp.take(col.validity, last)[None],
+                                carry[f"vv{i}"])
+        return fixed, nc
+
+    def _running_init_carry(self, batch: ColumnBatch) -> dict:
+        """Zero carry matching the chunk's key/value shapes."""
+        ctx = EvalContext(batch)
+        spec = self.spec0
+        nbase = len(self.schema.fields) - len(self.window_exprs)
+        carry = {"live": jnp.zeros((1,), bool),
+                 "n": jnp.zeros((1,), jnp.int64)}
+
+        def z(c):
+            return (jnp.zeros((1,) + c.data.shape[1:], c.data.dtype),
+                    jnp.zeros((1,), bool))
+
+        for i, p in enumerate(spec.partitions):
+            carry[f"pk{i}"], carry[f"pkv{i}"] = z(p.eval(ctx))
+        for j, o in enumerate(spec.orders):
+            carry[f"ok{j}"], carry[f"okv{j}"] = z(o.expr.eval(ctx))
+        for i, a in enumerate(self.window_exprs):
+            f = self.schema.fields[nbase + i]
+            np_dt = f.dataType.np_dtype
+            carry[f"v{i}"] = jnp.zeros((1,), np_dt)
+            carry[f"vv{i}"] = jnp.zeros((1,), bool)
+        return carry
+
+    def _execute_running(self, pid, ctx):
+        """Sorted chunks + carried per-partition scan state: device
+        residency stays O(chunk) while ranking/running frames stay
+        exact across chunk boundaries."""
+        from spark_rapids_tpu.runtime.jit_cache import (
+            aliases_key,
+            cached_jit,
+            detached,
+        )
+        from spark_rapids_tpu.runtime.retry import retry_on_oom
+
+        det = detached(self)
+
+        def step(batch, carry):
+            return det._running_fix(det._run(batch), carry)
+
+        # cached_jit returns a jax.jit wrapper that retraces per input
+        # shape, so the key needs no shape component
+        jitted = cached_jit(
+            ("window_running", aliases_key(self.window_exprs)),
+            lambda: step)
+        carry = None
+        for batch in self.children[0].execute_partition(pid, ctx):
+            if carry is None:
+                carry = self._running_init_carry(batch)
+            out, carry = retry_on_oom(
+                lambda b=batch, c=carry: jitted(b, c))
+            yield out
+
+    # --- unbounded-to-unbounded two-pass path ---
+
+    @staticmethod
+    def _null_safe_keys(batch: ColumnBatch, key_cols):
+        """Append [IsNull marker, zero-filled value] per key column so
+        null partitions probe-match their own group (the engine's join
+        probe drops null keys; zero-filling invalid rows plus the
+        marker makes every key column non-null while preserving
+        distinctness). -> (work batch, key ordinals)."""
+        from spark_rapids_tpu.sqltypes.datatypes import boolean
+
+        cols = list(batch.columns)
+        fields = list(batch.schema.fields)
+        idxs = []
+        for k, c in enumerate(key_cols):
+            isn = DeviceColumn(boolean, ~c.validity,
+                               jnp.ones((c.capacity,), bool))
+            vb = (c.validity[:, None] if c.data.ndim == 2
+                  else c.validity)
+            coal = c.replace(
+                data=jnp.where(vb, c.data, jnp.zeros_like(c.data)),
+                validity=jnp.ones((c.capacity,), bool),
+                lengths=None if c.lengths is None
+                else jnp.where(c.validity, c.lengths, 0))
+            idxs.append(len(cols))
+            cols.append(isn)
+            fields.append(StructField(f"__wn{k}", boolean, False))
+            idxs.append(len(cols))
+            cols.append(coal)
+            fields.append(StructField(f"__wv{k}", c.dtype, False))
+        return (ColumnBatch(StructType(fields), cols, batch.num_rows),
+                idxs)
+
+    def _execute_u2u(self, pid, ctx):
+        """Two passes (GpuUnboundedToUnboundedAggWindowExec role):
+        (1) park chunks in the spill catalog while folding per-chunk
+        partition partials into one bounded buffer batch; (2) finalize
+        the aggregates and re-scan the parked chunks, each row looking
+        up its partition's result (null-safe key probe). Device
+        residency is O(chunk + #partitions), never the whole input."""
+        from spark_rapids_tpu.ops import joinops
+        from spark_rapids_tpu.runtime.memory import get_catalog
+        from spark_rapids_tpu.runtime.retry import retry_on_oom
+
+        catalog = get_catalog()
+        spec = self.spec0
+        grouping = [Alias(p, f"__wk{i}")
+                    for i, p in enumerate(spec.partitions)]
+        aggs = [Alias(a.children[0].function, a.name)
+                for a in self.window_exprs]
+        child = self.children[0]
+        agg = TpuHashAggregateExec("partial", grouping, aggs, child,
+                                   self.conf)
+        parked, pend_parts = [], []
+        partials = None
+
+        def fold_partials():
+            """Fold parked per-chunk partials into one buffer batch —
+            batched (every FOLD_EVERY chunks) so the concat's host
+            sync and the full-buffer re-merge amortize."""
+            nonlocal partials
+            if not pend_parts:
+                return
+            bs = [] if partials is None else [partials]
+            bs += [retry_on_oom(sb.get_batch) for sb in pend_parts]
+            partials = retry_on_oom(
+                lambda: agg._jit_merge_buffers(concat_batches(bs)))
+            while pend_parts:
+                pend_parts.pop().close()
+
+        FOLD_EVERY = 8
+        try:
+            for batch in child.execute_partition(pid, ctx):
+                parked.append(retry_on_oom(
+                    lambda b=batch: catalog.add_batch(b)))
+                p = retry_on_oom(lambda b=batch: agg._jit_partial(b))
+                pend_parts.append(retry_on_oom(
+                    lambda pp=p: catalog.add_batch(pp)))
+                if len(pend_parts) >= FOLD_EVERY:
+                    fold_partials()
+            if not parked:
+                return
+            fold_partials()
+            # a FINAL-mode twin evaluates buffers -> results (its
+            # schema is the result layout; the partial node's is the
+            # buffer layout)
+            agg_f = TpuHashAggregateExec("final", grouping, aggs,
+                                         child, self.conf)
+            final = retry_on_oom(
+                lambda: agg_f._jit_merge(partials))  # [keys, results]
+            nk = len(grouping)
+            build = None
+            if nk:
+                fwork, fidx = self._null_safe_keys(
+                    final, [final.columns[i] for i in range(nk)])
+                build = retry_on_oom(
+                    lambda: joinops.build_side(fwork, fidx))
+
+            while parked:
+                sb = parked[0]
+                b = retry_on_oom(sb.get_batch)
+                if nk:
+                    ctx2 = EvalContext(b)
+                    key_cols = [g.children[0].eval(ctx2)
+                                for g in grouping]
+                    pwork, pidx = self._null_safe_keys(b, key_cols)
+                    lo, counts = retry_on_oom(
+                        lambda: joinops.probe_ranges(build, pwork,
+                                                     pidx))
+                    safe = jnp.clip(lo, 0, build.batch.capacity - 1)
+                    src = build.batch
+                    matched = counts > 0
+                else:
+                    # single global partition: broadcast row 0
+                    safe = jnp.zeros((b.capacity,), jnp.int32)
+                    src = final
+                    matched = jnp.ones((b.capacity,), bool)
+                res_cols = []
+                for i in range(len(self.window_exprs)):
+                    rc = src.columns[nk + i].gather(safe)
+                    res_cols.append(rc.replace(
+                        validity=rc.validity & matched))
+                out = ColumnBatch(self.schema,
+                                  list(b.columns) + res_cols,
+                                  b.num_rows)
+                parked.pop(0).close()
+                yield out
+        finally:
+            # early exit (LIMIT-closed generator, OOM escalation) must
+            # not leak parked spillables for the query lifetime
+            for sb in parked + pend_parts:
+                try:
+                    sb.close()
+                except Exception:
+                    pass
 
 
 class CpuWindowExec(PhysicalPlan):

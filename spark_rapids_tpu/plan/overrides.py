@@ -629,18 +629,35 @@ class TpuOverrides:
             # suffix halo; frames wider than half a chunk must take the
             # whole-partition path for correctness
             halo = None
-        if halo is not None and (spec.partitions or spec.orders):
-            # bounded-frame batched window: out-of-core sort on the
-            # partition+order keys emitting bounded chunks, evaluated
-            # with halo context (GpuBatchedBoundedWindowExec role)
-            from spark_rapids_tpu.plan.logical import SortOrder
+        from spark_rapids_tpu.plan.logical import SortOrder
 
+        def chunked_sort_child():
+            # out-of-core sort on the partition+order keys emitting
+            # bounded chunks (shared by the halo and running paths)
             orders = ([SortOrder(p, True) for p in spec.partitions] +
                       list(spec.orders))
-            child = ops.TpuSortExec(orders, child, conf,
-                                    chunk_rows=chunk_rows)
-            return ops.TpuWindowExec(node.window_exprs, child, conf,
+            return ops.TpuSortExec(orders, child, conf,
+                                   chunk_rows=chunk_rows)
+
+        if halo is not None and (spec.partitions or spec.orders):
+            # bounded-frame batched window, evaluated with halo
+            # context (GpuBatchedBoundedWindowExec role)
+            return ops.TpuWindowExec(node.window_exprs,
+                                     chunked_sort_child(), conf,
                                      presorted=True, halo=halo)
+        mode = (ops.window_streaming_mode(node.window_exprs)
+                if conf.get(rc.WINDOW_STREAMING) else None)
+        if mode == "running" and spec.orders:
+            # running frames / ranking: sorted chunks + carried scan
+            # state (GpuRunningWindowExec role) — O(chunk) residency
+            return ops.TpuWindowExec(node.window_exprs,
+                                     chunked_sort_child(), conf,
+                                     presorted=True, mode="running")
+        if mode == "u2u":
+            # whole-partition aggregates: two-pass partial+lookup
+            # (GpuUnboundedToUnboundedAggWindowExec role), no sort
+            return ops.TpuWindowExec(node.window_exprs, child, conf,
+                                     mode="u2u")
         return ops.TpuWindowExec(node.window_exprs, child, conf)
 
     def _convert_limit(self, node: L.Limit, child: PhysicalPlan,
