@@ -145,6 +145,59 @@ def _list_to_matrix(arr: pa.Array, elem_dtype: DataType):
     return mat, lengths, ev
 
 
+def _strlist_to_cube(arr: pa.Array):
+    """Arrow list<string> -> ([n, max_elems, max_bytes] uint8 cube,
+    row lengths int32, elem_validity [n, E], elem byte lengths [n, E])
+    — the string padded-matrix layout one level up."""
+    arr = arr.cast(pa.large_list(pa.large_string())) \
+        if not pa.types.is_large_list(arr.type) else arr
+    offsets = np.asarray(arr.offsets).astype(np.int64)
+    lengths = (offsets[1:] - offsets[:-1]).astype(np.int32)
+    n = len(arr)
+    max_e = int(lengths.max()) if len(lengths) else 0
+    me = _round_up_pow2(max(max_e, 1), minimum=2)
+    smat, slens = _string_to_matrix(arr.values)  # flat child strings
+    svalid = np.asarray(arr.values.is_valid()) if len(arr.values) \
+        else np.zeros(0, bool)
+    if len(smat) == 0:
+        smat = np.zeros((1, 1), np.uint8)
+        slens = np.zeros(1, np.int32)
+        svalid = np.zeros(1, bool)
+    idx = offsets[:-1, None] + np.arange(me, dtype=np.int64)[None, :]
+    in_row = np.arange(me, dtype=np.int32)[None, :] < lengths[:, None]
+    safe = np.clip(idx, 0, len(smat) - 1)
+    cube = np.where(in_row[:, :, None], smat[safe], 0)
+    ev = np.where(in_row, svalid[safe], False)
+    el = np.where(in_row, slens[safe], 0).astype(np.int32)
+    return cube, lengths, ev, el
+
+
+def _cube_to_strlist(data: np.ndarray, lengths: np.ndarray,
+                     validity: np.ndarray, ev: np.ndarray,
+                     el: np.ndarray) -> pa.Array:
+    """Device array<string> cube -> Arrow list<string>, vectorized:
+    flatten the in-row elements to one string matrix, reuse the
+    offsets-reconstruction of _matrix_to_string, and wrap with list
+    offsets — no per-element Python."""
+    n = len(lengths)
+    if n == 0:
+        return pa.array([], type=pa.list_(pa.string()))
+    E = data.shape[1]
+    lengths = np.minimum(lengths, E)  # clamp like _matrix_to_list
+    in_row = (np.arange(E, dtype=np.int32)[None, :] < lengths[:, None]
+              ) & validity[:, None]  # null rows contribute no elements
+    ri, ei = np.nonzero(in_row)           # kept elements, row-major
+    flat = data[ri, ei]                   # [m, B] uint8
+    flens = el[ri, ei].astype(np.int32)
+    fvalid = ev[ri, ei]
+    values = _matrix_to_string(flat, flens, fvalid)
+    offsets = np.zeros(n + 1, dtype=np.int32)
+    np.cumsum(np.where(validity, lengths, 0), out=offsets[1:])
+    return pa.ListArray.from_arrays(
+        pa.array(offsets), values,
+        mask=None if validity.all() else pa.array(~validity))
+
+
 def _matrix_to_list(data: np.ndarray, lengths: np.ndarray,
                     validity: np.ndarray, ev: np.ndarray,
                     elem_dtype: DataType) -> pa.Array:
@@ -301,6 +354,11 @@ def column_from_arrow(arr, field, cap: int,
         return make_column(field.dataType, mat, validity, cap,
                            lengths=lengths)
     if isinstance(field.dataType, ArrayType):
+        if isinstance(field.dataType.elementType, StringType):
+            cube, lengths, ev, el = _strlist_to_cube(arr)
+            validity = np.asarray(arr.is_valid())
+            return make_column(field.dataType, (cube, el), validity,
+                               cap, lengths=lengths, elem_validity=ev)
         mat, lengths, ev = _list_to_matrix(
             arr, field.dataType.elementType)
         validity = np.asarray(arr.is_valid())
@@ -442,6 +500,11 @@ def _host_column_to_array(field, col, n: int) -> pa.Array:
             np.asarray(col.lengths[:n]), validity,
             np.asarray(col.elem_validity[:n]), field.dataType)
     if isinstance(field.dataType, ArrayType):
+        if isinstance(field.dataType.elementType, StringType):
+            return _cube_to_strlist(
+                np.asarray(col.data[:n]), np.asarray(col.lengths[:n]),
+                validity, np.asarray(col.elem_validity[:n]),
+                np.asarray(col.elem_lengths[:n]))
         return _matrix_to_list(
             np.asarray(col.data[:n]), np.asarray(col.lengths[:n]),
             validity, np.asarray(col.elem_validity[:n]),

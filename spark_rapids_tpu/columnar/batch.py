@@ -57,24 +57,29 @@ class DeviceColumn:
     """One device column: data (+ lengths for strings/arrays) + validity.
 
     data:     [cap] of dtype.np_dtype; [cap, max_bytes] uint8 for strings;
-              [cap, max_elems] of element np_dtype for arrays
+              [cap, max_elems] of element np_dtype for arrays;
+              [cap, max_elems, max_bytes] uint8 for array<string>
     lengths:  [cap] int32 (strings: byte count; arrays: element count)
     validity: [cap] bool, True = valid (non-null row)
     elem_validity: [cap, max_elems] bool (arrays only): per-element nulls
+    elem_lengths:  [cap, max_elems] int32 (array<string> only): per-
+              element byte counts
     """
 
     __slots__ = ("dtype", "data", "validity", "lengths",
-                 "elem_validity", "map_values", "vrange", "children")
+                 "elem_validity", "map_values", "vrange", "children",
+                 "elem_lengths")
 
     def __init__(self, dtype: DataType, data, validity, lengths=None,
                  elem_validity=None, map_values=None, vrange=None,
-                 children=None):
+                 children=None, elem_lengths=None):
         self.dtype = dtype
         self.data = data          # maps: the KEY matrix
         self.validity = validity
         self.lengths = lengths
         self.elem_validity = elem_validity  # maps: VALUE validity
         self.map_values = map_values        # maps only: value matrix
+        self.elem_lengths = elem_lengths    # array<string> only
         # STATIC (lo, hi) bound on the column's integer values, stamped
         # at upload time (quantized so refills retrace rarely). Enables
         # the sort-free direct-binned group-by; ops that change values
@@ -122,7 +127,9 @@ class DeviceColumn:
             None if self.map_values is None else self.map_values[:cap],
             self.vrange,
             None if self.children is None
-            else [c.truncate(cap) for c in self.children])
+            else [c.truncate(cap) for c in self.children],
+            None if self.elem_lengths is None
+            else self.elem_lengths[:cap])
 
     def device_size_bytes(self) -> int:
         n = self.data.size * self.data.dtype.itemsize
@@ -133,6 +140,8 @@ class DeviceColumn:
             n += self.elem_validity.size
         if self.map_values is not None:
             n += self.map_values.size * self.map_values.dtype.itemsize
+        if self.elem_lengths is not None:
+            n += self.elem_lengths.size * 4
         if self.children is not None:
             n += sum(c.device_size_bytes() for c in self.children)
         return n
@@ -154,6 +163,7 @@ class DeviceColumn:
             kw.get("map_values", self.map_values),
             kw.get("vrange", self.vrange),
             kw.get("children", self.children),
+            kw.get("elem_lengths", self.elem_lengths),
         )
 
     def gather(self, indices) -> "DeviceColumn":
@@ -172,6 +182,8 @@ class DeviceColumn:
             vrange=self.vrange,
             children=None if self.children is None
             else [c.gather(indices) for c in self.children],
+            elem_lengths=None if self.elem_lengths is None
+            else jnp.take(self.elem_lengths, indices, axis=0),
         )
 
     def _tree_flatten(self):
@@ -182,6 +194,8 @@ class DeviceColumn:
             leaves.append(self.elem_validity)
         if self.map_values is not None:
             leaves.append(self.map_values)
+        if self.elem_lengths is not None:
+            leaves.append(self.elem_lengths)
         if self.children is not None:
             # child DeviceColumns are registered pytree nodes; jax
             # recurses into them
@@ -190,20 +204,23 @@ class DeviceColumn:
                                self.elem_validity is not None,
                                self.map_values is not None, self.vrange,
                                len(self.children)
-                               if self.children is not None else -1)
+                               if self.children is not None else -1,
+                               self.elem_lengths is not None)
 
     @classmethod
     def _tree_unflatten(cls, aux, children):
-        dtype, has_len, has_ev, has_mv, vrange, n_struct = aux
+        dtype, has_len, has_ev, has_mv, vrange, n_struct, has_el = aux
         it = iter(children)
         data = next(it)
         validity = next(it)
         lengths = next(it) if has_len else None
         ev = next(it) if has_ev else None
         mv = next(it) if has_mv else None
+        el = next(it) if has_el else None
         kids = ([next(it) for _ in range(n_struct)]
                 if n_struct >= 0 else None)
-        return cls(dtype, data, validity, lengths, ev, mv, vrange, kids)
+        return cls(dtype, data, validity, lengths, ev, mv, vrange, kids,
+                   el)
 
 
 jax.tree_util.register_pytree_node(
@@ -320,6 +337,24 @@ def make_column(dtype: DataType, values: np.ndarray,
         if lengths is not None:
             lpad[:n] = lengths
         return DeviceColumn(dtype, data, vpad, lpad)
+    if isinstance(dtype, ArrayType) and isinstance(dtype.elementType,
+                                                   StringType):
+        # array<string>: (values cube [n, E, B] uint8, per-element byte
+        # lengths [n, E]) arrive as a tuple
+        cube, elens = values
+        assert cube.ndim == 3 and cube.dtype == np.uint8
+        data = np.zeros((capacity,) + cube.shape[1:], dtype=np.uint8)
+        data[:n] = cube
+        lpad = np.zeros(capacity, dtype=np.int32)
+        if lengths is not None:
+            lpad[:n] = lengths
+        ev = np.zeros((capacity, cube.shape[1]), dtype=np.bool_)
+        if elem_validity is not None:
+            ev[:n] = elem_validity
+        el = np.zeros((capacity, cube.shape[1]), dtype=np.int32)
+        el[:n] = elens
+        return DeviceColumn(dtype, data, vpad, lpad, ev,
+                            elem_lengths=el)
     if isinstance(dtype, ArrayType):
         assert values.ndim == 2
         data = np.zeros((capacity, values.shape[1]),
@@ -359,14 +394,59 @@ def make_column(dtype: DataType, values: np.ndarray,
     return DeviceColumn(dtype, data, vpad)
 
 
+def row_select(pred, x, y):
+    """Row-wise where: broadcast a [cap] predicate across every
+    trailing axis of x/y (strings, arrays, array<string> cubes)."""
+    return jnp.where(pred.reshape((-1,) + (1,) * (x.ndim - 1)), x, y)
+
+
+def pad_trailing(x, trailing):
+    """Zero-pad x's trailing axes up to `trailing` (no-op when equal) —
+    the one alignment primitive for variable-width leaves (string
+    bytes, array elems, array<string> elems x bytes)."""
+    if x is None or tuple(x.shape[1:]) == tuple(trailing):
+        return x
+    return jnp.pad(x, ((0, 0),) + tuple(
+        (0, t - s) for s, t in zip(x.shape[1:], trailing)))
+
+
+def align_trailing(leaves):
+    """Pad every leaf's trailing axes to the per-axis max across
+    leaves (all leaves must share ndim)."""
+    nd = leaves[0].ndim
+    if nd == 1:
+        return list(leaves)
+    target = tuple(max(int(x.shape[ax]) for x in leaves)
+                   for ax in range(1, nd))
+    return [pad_trailing(x, target) for x in leaves]
+
+
 def _empty_column(dataType: DataType, capacity: int,
                   string_bytes: int) -> DeviceColumn:
+    from spark_rapids_tpu.sqltypes import ArrayType
+
     if isinstance(dataType, StringType):
         return DeviceColumn(
             dataType,
             jnp.zeros((capacity, string_bytes), jnp.uint8),
             jnp.zeros(capacity, jnp.bool_),
             jnp.zeros(capacity, jnp.int32))
+    if isinstance(dataType, ArrayType):
+        et = dataType.elementType
+        if isinstance(et, StringType):  # array<string> cube layout
+            return DeviceColumn(
+                dataType,
+                jnp.zeros((capacity, 1, string_bytes), jnp.uint8),
+                jnp.zeros(capacity, jnp.bool_),
+                jnp.zeros(capacity, jnp.int32),
+                jnp.zeros((capacity, 1), jnp.bool_),
+                elem_lengths=jnp.zeros((capacity, 1), jnp.int32))
+        return DeviceColumn(
+            dataType,
+            jnp.zeros((capacity, 1), et.np_dtype),
+            jnp.zeros(capacity, jnp.bool_),
+            jnp.zeros(capacity, jnp.int32),
+            jnp.zeros((capacity, 1), jnp.bool_))
     if isinstance(dataType, StructType):
         return DeviceColumn(
             dataType, jnp.zeros(capacity, jnp.int8),
@@ -406,45 +486,31 @@ def _concat_columns(pieces: List[Tuple[DeviceColumn, int]], cap: int,
             [c.validity[:n] for c, n in pieces]), (0, pad))
         data = jnp.zeros((cap,), jnp.int8)
         return DeviceColumn(dtype, data, val, children=kids)
-    parts_data = [c.data[:n] for c, n in pieces]
-    parts_val = [c.validity[:n] for c, n in pieces]
-    parts_len = [c.lengths[:n] for c, n in pieces
-                 if c.lengths is not None]
-    parts_ev = [c.elem_validity[:n] for c, n in pieces
-                if c.elem_validity is not None]
-    parts_mv = [c.map_values[:n] for c, n in pieces
-                if c.map_values is not None]
-    if parts_data[0].ndim == 2:  # strings / arrays / maps: align
-        mb = max(int(p.shape[1]) for p in parts_data)
-        parts_data = [
-            jnp.pad(p, ((0, 0), (0, mb - p.shape[1]))) for p in parts_data
-        ]
-        parts_ev = [
-            jnp.pad(p, ((0, 0), (0, mb - p.shape[1]))) for p in parts_ev
-        ]
-        parts_mv = [
-            jnp.pad(p, ((0, 0), (0, mb - p.shape[1]))) for p in parts_mv
-        ]
-    data = jnp.concatenate(parts_data, axis=0)
+    def align_cat(parts):
+        """Concatenate row prefixes, padding every TRAILING axis to
+        its max across pieces (string bytes, array elems, and both
+        axes of an array<string> cube)."""
+        parts = align_trailing(parts)
+        out = jnp.concatenate(parts, axis=0)
+        if pad:
+            out = jnp.pad(out,
+                          ((0, pad),) + ((0, 0),) * (out.ndim - 1))
+        return out
+
     pad = cap - total
-    if pad:
-        pad_width = ((0, pad),) + ((0, 0),) * (data.ndim - 1)
-        data = jnp.pad(data, pad_width)
-    val = jnp.pad(jnp.concatenate(parts_val), (0, pad))
-    lens = None
-    if parts_len:
-        lens = jnp.pad(jnp.concatenate(parts_len), (0, pad))
-    ev = None
-    if parts_ev:
-        ev = jnp.concatenate(parts_ev, axis=0)
-        if pad:
-            ev = jnp.pad(ev, ((0, pad), (0, 0)))
-    mv = None
-    if parts_mv:
-        mv = jnp.concatenate(parts_mv, axis=0)
-        if pad:
-            mv = jnp.pad(mv, ((0, pad), (0, 0)))
-    return DeviceColumn(dtype, data, val, lens, ev, mv)
+    data = align_cat([c.data[:n] for c, n in pieces])
+    val = align_cat([c.validity[:n] for c, n in pieces])
+    lens = ev = mv = el = None
+    if first.lengths is not None:
+        lens = align_cat([c.lengths[:n] for c, n in pieces])
+    if first.elem_validity is not None:
+        ev = align_cat([c.elem_validity[:n] for c, n in pieces])
+    if first.map_values is not None:
+        mv = align_cat([c.map_values[:n] for c, n in pieces])
+    if first.elem_lengths is not None:
+        el = align_cat([c.elem_lengths[:n] for c, n in pieces])
+    return DeviceColumn(dtype, data, val, lens, ev, mv,
+                        elem_lengths=el)
 
 
 def concat_batches(batches: List[ColumnBatch]) -> ColumnBatch:

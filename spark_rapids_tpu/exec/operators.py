@@ -2130,7 +2130,13 @@ class TpuGenerateExec(PhysicalPlan):
         safe_e = jnp.clip(ei, 0, arr.data.shape[1] - 1)
         vals = arr.data[pi, safe_e]
         ev = arr.elem_validity[pi, safe_e]
-        cols.append(DeviceColumn(self.gen_alias.dtype, vals, ev))
+        if arr.elem_lengths is not None:
+            # array<string>: elements become a padded string column
+            cols.append(DeviceColumn(
+                self.gen_alias.dtype, vals, ev,
+                arr.elem_lengths[pi, safe_e]))
+        else:
+            cols.append(DeviceColumn(self.gen_alias.dtype, vals, ev))
         out = ColumnBatch(self.schema, cols,
                           jnp.minimum(total, out_cap))
         return out, overflow
@@ -2385,22 +2391,38 @@ class TpuWindowExec(PhysicalPlan):
                 sorted_col = col.gather(sw.perm)
                 vals, ok, inside = W.lead_lag(
                     sorted_col.data, sorted_col.validity, sw, fn.offset)
-                lens = None
-                if sorted_col.lengths is not None:
-                    lens, _, _ = W.lead_lag(sorted_col.lengths,
-                                            sorted_col.validity, sw,
-                                            fn.offset)
+
+                def shifted(leaf):
+                    return W.lead_lag(leaf, sorted_col.validity, sw,
+                                      fn.offset)[0]
+
+                from spark_rapids_tpu.columnar.batch import row_select \
+                    as row_sel
+
+                lens = (None if sorted_col.lengths is None
+                        else shifted(sorted_col.lengths))
+                ev = (None if sorted_col.elem_validity is None
+                      else shifted(sorted_col.elem_validity))
+                el = (None if sorted_col.elem_lengths is None
+                      else shifted(sorted_col.elem_lengths))
                 if fn.default is not None:
                     dcol = fn.default.eval(ctx).gather(sw.perm)
-                    vals = jnp.where(
-                        inside if vals.ndim == 1 else inside[:, None],
-                        vals, dcol.data)
+                    vals = row_sel(inside, vals, dcol.data)
                     ok = jnp.where(inside, ok, dcol.validity)
                     if lens is not None:
                         lens = jnp.where(inside, lens, dcol.lengths)
+                    if ev is not None:
+                        ev = row_sel(inside, ev, dcol.elem_validity)
+                    if el is not None:
+                        el = row_sel(inside, el, dcol.elem_lengths)
                 d_o, v_o = to_original(vals, ok)
                 lens_o = None if lens is None else jnp.take(lens, sw.inv)
-                new_cols.append(DeviceColumn(dt, d_o, v_o, lens_o))
+                new_cols.append(DeviceColumn(
+                    dt, d_o, v_o, lens_o,
+                    None if ev is None
+                    else jnp.take(ev, sw.inv, axis=0),
+                    elem_lengths=None if el is None
+                    else jnp.take(el, sw.inv, axis=0)))
                 continue
             else:
                 # aggregate over frames

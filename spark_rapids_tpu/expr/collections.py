@@ -58,7 +58,17 @@ class ArrayContains(Expression):
         in_row = (jnp.arange(me, dtype=jnp.int32)[None, :] <
                   c.lengths[:, None])
         elem_ok = in_row & c.elem_validity
-        hit = jnp.any(elem_ok & (c.data == v.data[:, None]), axis=1)
+        if c.elem_lengths is not None:  # array<string> needle compare
+            nb = v.data.shape[1]
+            eb = c.data.shape[2]
+            w = max(nb, eb)
+            elems = jnp.pad(c.data, ((0, 0), (0, 0), (0, w - eb)))
+            needle = jnp.pad(v.data, ((0, 0), (0, w - nb)))
+            eq = (jnp.all(elems == needle[:, None, :], axis=2) &
+                  (c.elem_lengths == v.lengths[:, None]))
+        else:
+            eq = c.data == v.data[:, None]
+        hit = jnp.any(elem_ok & eq, axis=1)
         has_null_elem = jnp.any(in_row & ~c.elem_validity, axis=1)
         valid = c.validity & v.validity & (hit | ~has_null_elem)
         return DeviceColumn(boolean, hit, valid)
@@ -84,12 +94,16 @@ class GetArrayItem(Expression):
         idx = i.data.astype(jnp.int32)
         in_bounds = (idx >= 0) & (idx < c.lengths)
         safe = jnp.clip(idx, 0, c.data.shape[1] - 1)
-        vals = jnp.take_along_axis(c.data, safe[:, None].astype(jnp.int64),
-                                   axis=1)[:, 0]
         ev = jnp.take_along_axis(c.elem_validity,
                                  safe[:, None].astype(jnp.int64),
                                  axis=1)[:, 0]
         valid = c.validity & i.validity & in_bounds & ev
+        if c.elem_lengths is not None:  # array<string> -> string col
+            rows = jnp.arange(c.capacity)
+            return DeviceColumn(self.dtype, c.data[rows, safe], valid,
+                                c.elem_lengths[rows, safe])
+        vals = jnp.take_along_axis(c.data, safe[:, None].astype(jnp.int64),
+                                   axis=1)[:, 0]
         return DeviceColumn(self.dtype, vals, valid)
 
 
@@ -125,12 +139,16 @@ class ElementAt(Expression):
         idx = jnp.where(raw > 0, raw - 1, c.lengths + raw)
         in_bounds = (idx >= 0) & (idx < c.lengths) & (raw != 0)
         safe = jnp.clip(idx, 0, c.data.shape[1] - 1)
-        vals = jnp.take_along_axis(c.data, safe[:, None].astype(jnp.int64),
-                                   axis=1)[:, 0]
         ev = jnp.take_along_axis(c.elem_validity,
                                  safe[:, None].astype(jnp.int64),
                                  axis=1)[:, 0]
         valid = c.validity & i.validity & in_bounds & ev
+        if c.elem_lengths is not None:  # array<string> -> string col
+            rows = jnp.arange(c.capacity)
+            return DeviceColumn(self.dtype, c.data[rows, safe], valid,
+                                c.elem_lengths[rows, safe])
+        vals = jnp.take_along_axis(c.data, safe[:, None].astype(jnp.int64),
+                                   axis=1)[:, 0]
         return DeviceColumn(self.dtype, vals, valid)
 
 

@@ -17,29 +17,50 @@ from spark_rapids_tpu.sqltypes import StringType
 
 def _select(pred: jnp.ndarray, a: DeviceColumn, b: DeviceColumn
             ) -> DeviceColumn:
-    """Row-wise select; operands must share dtype (and byte width for
-    strings — pad first via _common_width)."""
-    data = jnp.where(pred[:, None] if a.data.ndim == 2 else pred,
-                     a.data, b.data)
+    """Row-wise select; operands must share dtype (and trailing widths
+    — pad first via _common_width)."""
+    from spark_rapids_tpu.columnar.batch import row_select
+
+    def sel(x, y):
+        return row_select(pred, x, y)
+
+    data = sel(a.data, b.data)
     validity = jnp.where(pred, a.validity, b.validity)
     lengths = None
     if a.lengths is not None:
         lengths = jnp.where(pred, a.lengths, b.lengths)
-    return DeviceColumn(a.dtype, data, validity, lengths)
+    ev = (None if a.elem_validity is None
+          else sel(a.elem_validity, b.elem_validity))
+    el = (None if a.elem_lengths is None
+          else sel(a.elem_lengths, b.elem_lengths))
+    mv = None if a.map_values is None else sel(a.map_values,
+                                               b.map_values)
+    return DeviceColumn(a.dtype, data, validity, lengths, ev, mv,
+                        elem_lengths=el)
 
 
 def _common_width(cols):
-    mbs = [c.max_bytes for c in cols if c.is_string]
-    if not mbs or len(set(mbs)) == 1:
+    """Pad variable-width columns (strings, arrays, array<string>
+    cubes) to common trailing dims so _select's wheres line up."""
+    from spark_rapids_tpu.columnar.batch import pad_trailing
+
+    nd = max(c.data.ndim for c in cols)
+    if nd == 1:
         return cols
-    mb = max(mbs)
+    target = tuple(
+        max(int(c.data.shape[ax]) if c.data.ndim > ax else 1
+            for c in cols)
+        for ax in range(1, nd))
     out = []
     for c in cols:
-        if c.is_string and c.max_bytes < mb:
-            c = DeviceColumn(c.dtype,
-                             jnp.pad(c.data, ((0, 0), (0, mb - c.max_bytes))),
-                             c.validity, c.lengths)
-        out.append(c)
+        if c.data.ndim == 1 or tuple(c.data.shape[1:]) == target:
+            out.append(c)
+            continue
+        out.append(c.replace(
+            data=pad_trailing(c.data, target),
+            elem_validity=pad_trailing(c.elem_validity, target[:1]),
+            elem_lengths=pad_trailing(c.elem_lengths, target[:1]),
+            map_values=pad_trailing(c.map_values, target[:1])))
     return out
 
 
@@ -98,11 +119,12 @@ class CaseWhen(Expression):
         if self.has_else:
             els = self.children[-1].eval(ctx)
         else:
-            first = vals[0]
-            els = DeviceColumn(first.dtype, jnp.zeros_like(first.data),
-                               jnp.zeros_like(first.validity),
-                               None if first.lengths is None
-                               else jnp.zeros_like(first.lengths))
+            # all-null column with EVERY leaf of the branch layout
+            # zeroed (validity zeros == all null) — leaf-complete for
+            # strings/arrays/cubes without per-field plumbing
+            import jax
+
+            els = jax.tree_util.tree_map(jnp.zeros_like, vals[0])
         cols = _common_width(vals + [els])
         vals, out = cols[:-1], cols[-1]
         taken = jnp.zeros(conds[0].shape, bool)

@@ -202,6 +202,16 @@ def _infer_literal_type(v: Any) -> DataType:
         sign, digits, exp = v.as_tuple()
         scale = max(0, -exp)
         return DecimalType(max(len(digits), scale), scale)
+    if isinstance(v, (list, tuple)):
+        from spark_rapids_tpu.sqltypes import ArrayType
+
+        elem = next((x for x in v if x is not None), None)
+        if elem is None:
+            return ArrayType(LongType())
+        et = _infer_literal_type(elem)
+        if isinstance(elem, int) and not isinstance(elem, bool):
+            et = LongType()  # match the common array<bigint> columns
+        return ArrayType(et)
     raise TypeError(f"cannot infer literal type for {v!r}")
 
 

@@ -56,22 +56,24 @@ def _align_col(ca: DeviceColumn, cb: DeviceColumn
                  for ka, kb in zip(ca.children, cb.children)]
         return (ca.replace(children=[p[0] for p in pairs]),
                 cb.replace(children=[p[1] for p in pairs]))
-    if ca.data.ndim != 2:
+    if ca.data.ndim < 2:
         return ca, cb
 
-    def pad_to(c: DeviceColumn, w: int) -> DeviceColumn:
-        if c.data.shape[1] >= w:
-            return c
-        data = jnp.pad(c.data, ((0, 0), (0, w - c.data.shape[1])))
-        ev = (None if c.elem_validity is None else jnp.pad(
-            c.elem_validity,
-            ((0, 0), (0, w - c.elem_validity.shape[1]))))
-        mv = (None if c.map_values is None else jnp.pad(
-            c.map_values, ((0, 0), (0, w - c.map_values.shape[1]))))
-        return c.replace(data=data, elem_validity=ev, map_values=mv)
+    from spark_rapids_tpu.columnar.batch import pad_trailing
 
-    w = max(int(ca.data.shape[1]), int(cb.data.shape[1]))
-    return pad_to(ca, w), pad_to(cb, w)
+    def pad_to(c: DeviceColumn, trailing) -> DeviceColumn:
+        if tuple(c.data.shape[1:]) == tuple(trailing):
+            return c
+        ew = trailing[:1]  # elems axis for the 2-D sidecars
+        return c.replace(
+            data=pad_trailing(c.data, trailing),
+            elem_validity=pad_trailing(c.elem_validity, ew),
+            elem_lengths=pad_trailing(c.elem_lengths, ew),
+            map_values=pad_trailing(c.map_values, ew))
+
+    trailing = tuple(max(int(x), int(y)) for x, y in
+                     zip(ca.data.shape[1:], cb.data.shape[1:]))
+    return pad_to(ca, trailing), pad_to(cb, trailing)
 
 
 def align_string_widths(a: ColumnBatch, b: ColumnBatch
@@ -110,9 +112,10 @@ def merge_sorted(a: ColumnBatch, b: ColumnBatch, orders,
     dest_a = jnp.where(live_a, dest_a, out_cap)
     dest_b = jnp.where(live_b, dest_b, out_cap)
 
-    def scat(xa, xb, width=None, dtype=None):
-        shape = (out_cap,) if width is None else (out_cap, width)
-        out = jnp.zeros(shape, dtype if dtype is not None else xa.dtype)
+    def scat(xa, xb):
+        # trailing dims already aligned by align_string_widths
+        shape = (out_cap,) + tuple(xa.shape[1:])
+        out = jnp.zeros(shape, xa.dtype)
         out = out.at[dest_b].set(xb, mode="drop")
         return out.at[dest_a].set(xa, mode="drop")
 
@@ -120,28 +123,24 @@ def merge_sorted(a: ColumnBatch, b: ColumnBatch, orders,
         # constructs FRESH columns (replace() is for rebuilds of one
         # source column); vrange is dropped ON PURPOSE — fa's bound
         # does not bound fb's values
-        val = scat(fa.validity, fb.validity, dtype=jnp.bool_)
+        val = scat(fa.validity, fb.validity)
         if fa.children is not None:  # structs: recurse per field
             kids = [merge_col(ka_, kb_)
                     for ka_, kb_ in zip(fa.children, fb.children)]
             return DeviceColumn(fa.dtype,
                                 jnp.zeros((out_cap,), jnp.int8), val,
                                 children=kids)
-        if fa.data.ndim == 2:  # strings / arrays / map keys
-            data = scat(fa.data, fb.data, width=fa.data.shape[1])
-            lens = scat(fa.lengths, fb.lengths, dtype=jnp.int32)
-        else:
-            data = scat(fa.data, fb.data)
-            lens = None
-        ev = None
-        if fa.elem_validity is not None:
-            ev = scat(fa.elem_validity, fb.elem_validity,
-                      width=fa.elem_validity.shape[1], dtype=jnp.bool_)
-        mv = None
-        if fa.map_values is not None:
-            mv = scat(fa.map_values, fb.map_values,
-                      width=fa.map_values.shape[1])
-        return DeviceColumn(fa.dtype, data, val, lens, ev, mv)
+        data = scat(fa.data, fb.data)
+        lens = (None if fa.lengths is None
+                else scat(fa.lengths, fb.lengths))
+        ev = (None if fa.elem_validity is None
+              else scat(fa.elem_validity, fb.elem_validity))
+        mv = (None if fa.map_values is None
+              else scat(fa.map_values, fb.map_values))
+        el = (None if fa.elem_lengths is None
+              else scat(fa.elem_lengths, fb.elem_lengths))
+        return DeviceColumn(fa.dtype, data, val, lens, ev, mv,
+                            elem_lengths=el)
 
     cols = [merge_col(fa, fb) for fa, fb in zip(a.columns, b.columns)]
     return ColumnBatch(a.schema, cols, na + nb)

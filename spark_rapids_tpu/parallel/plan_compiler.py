@@ -91,13 +91,12 @@ def concat_traced(batches: List[ColumnBatch]) -> ColumnBatch:
     total_cap = sum(caps)
     live = jnp.concatenate([b.live_mask() for b in batches])
 
-    def cat2d(leaves):
-        # width-align 2-D leaves (string bytes / array elements / map
-        # values / element validity) before concatenating rows
-        mb = max(int(x.shape[1]) for x in leaves)
-        return jnp.concatenate(
-            [jnp.pad(x, ((0, 0), (0, mb - x.shape[1]))) for x in leaves],
-            axis=0)
+    def catnd(leaves):
+        # align every TRAILING axis (string bytes / array elements /
+        # array<string> elems x bytes) before concatenating rows
+        from spark_rapids_tpu.columnar.batch import align_trailing
+
+        return jnp.concatenate(align_trailing(leaves), axis=0)
 
     def cat_col(parts, dtype):
         if parts[0].children is not None:  # structs: recurse per field
@@ -108,21 +107,22 @@ def concat_traced(batches: List[ColumnBatch]) -> ColumnBatch:
                 dtype, jnp.concatenate([p.data for p in parts]),
                 jnp.concatenate([p.validity for p in parts]),
                 children=kids)
-        if parts[0].data.ndim == 2:
-            data = cat2d([p.data for p in parts])
-        else:
-            data = jnp.concatenate([p.data for p in parts], axis=0)
+        data = catnd([p.data for p in parts])
         val = jnp.concatenate([p.validity for p in parts])
         lens = None
         if parts[0].lengths is not None:
             lens = jnp.concatenate([p.lengths for p in parts])
         ev = None
         if parts[0].elem_validity is not None:
-            ev = cat2d([p.elem_validity for p in parts])
+            ev = catnd([p.elem_validity for p in parts])
         mv = None
         if parts[0].map_values is not None:
-            mv = cat2d([p.map_values for p in parts])
-        return DeviceColumn(dtype, data, val, lens, ev, mv)
+            mv = catnd([p.map_values for p in parts])
+        el = None
+        if parts[0].elem_lengths is not None:
+            el = catnd([p.elem_lengths for p in parts])
+        return DeviceColumn(dtype, data, val, lens, ev, mv,
+                            elem_lengths=el)
 
     cols: List[DeviceColumn] = []
     for ci, field in enumerate(schema.fields):
@@ -424,28 +424,30 @@ class MeshQueryExecutor:
                        pa.array([], type=t.schema.field(i).type))
                 cols.append(column_from_arrow(arr, field, shard_cap))
             shard_cols.append(cols)
-        # align string/array/map matrices to the global max width —
-        # EVERY 2-D leaf (data, elem_validity, map_values, struct
-        # children's matrices) must reach the same width or the
-        # global-array assembly rejects the shards. Leaf-wise over the
-        # column pytree so struct children align too.
-        def pad2d(a, mb):
-            if a.shape[1] >= mb:
+        # align variable-width leaves to the global max widths — EVERY
+        # trailing axis of every leaf (string bytes, array elems, the
+        # array<string> cube's elems x bytes, struct children's
+        # matrices) must reach the same extent or the global-array
+        # assembly rejects the shards. Leaf-wise over the column
+        # pytree so struct children align too.
+        def pad_axis(a, ax, m):
+            if a.shape[ax] >= m:
                 return a
-            fill = np.zeros((shard_cap, mb - a.shape[1]), dtype=a.dtype)
-            return np.concatenate([a, fill], axis=1)
+            pad_width = [(0, 0)] * a.ndim
+            pad_width[ax] = (0, m - a.shape[ax])
+            return np.pad(a, pad_width)
 
         for ci in range(len(scan.schema.fields)):
             flats = [jax.tree_util.tree_flatten(sc[ci])
                      for sc in shard_cols]
             leaves = [list(f[0]) for f in flats]
             for li in range(len(leaves[0])):
-                if getattr(leaves[0][li], "ndim", 1) != 2:
-                    continue
-                mb = self._sync_max(max(int(l[li].shape[1])
-                                        for l in leaves))
-                for l in leaves:
-                    l[li] = pad2d(l[li], mb)
+                nd = getattr(leaves[0][li], "ndim", 1)
+                for ax in range(1, nd):
+                    m = self._sync_max(max(int(l[li].shape[ax])
+                                           for l in leaves))
+                    for l in leaves:
+                        l[li] = pad_axis(l[li], ax, m)
             for sc, (_, treedef), l in zip(shard_cols, flats, leaves):
                 sc[ci] = jax.tree_util.tree_unflatten(treedef, l)
         sharding = NamedSharding(self.mesh, P(AXIS))

@@ -242,6 +242,15 @@ class TpuOverrides:
                 if fn.input is not None:
                     for r in expr_unsupported_reasons(fn.input, self.conf):
                         meta.cannot_run(r)
+                    if (isinstance(fn.input.dtype,
+                                   (ArrayType, MapType))
+                            and not isinstance(fn, CollectList)):
+                        # frame kernels take flat/2-D inputs; array
+                        # payloads (incl. the array<string> cube) have
+                        # no first/last/min-max frame lowering
+                        meta.cannot_run(
+                            f"window {type(fn).__name__} over "
+                            f"{fn.input.dtype.simpleString} runs on CPU")
                 if (isinstance(fn, (Min, Max)) and
                         isinstance(fn.input.dtype, StringType)):
                     meta.cannot_run(

@@ -45,10 +45,13 @@ def type_supported(dt: DataType) -> Optional[str]:
 
     if isinstance(dt, ArrayType):
         et = dt.elementType
-        if isinstance(et, (StringType, ArrayType, StructType, _MT)) \
+        if isinstance(et, StringType):
+            return None  # [cap, elems, bytes] cube (arrow_bridge)
+        if isinstance(et, (ArrayType, StructType, _MT)) \
                 or _wide_dec(et):
             return (f"array element type {et.simpleString} runs on CPU "
-                    "(device arrays hold primitive/64-bit elements in v1)")
+                    "(device arrays hold primitive/string elements "
+                    "in v1)")
         return type_supported(et)
     if isinstance(dt, _MT):
         for part, t in (("key", dt.keyType), ("value", dt.valueType)):
@@ -194,6 +197,10 @@ def expr_unsupported_reasons(expr: Expression,
                     reasons.append(
                         f"{name} over struct input runs on CPU "
                         "(segmented kernels take flat columns)")
+                if c is not None and _is_cube(c.dtype):
+                    reasons.append(
+                        f"{name} over array<string> runs on CPU "
+                        "(no 3-D cube aggregation in v1)")
         for c in e.children:
             walk(c)
 
@@ -243,3 +250,49 @@ def _multiply_check(e) -> Optional[str]:
         return ("decimal(>18) operand multiplication runs on CPU "
                 "(only 64x64 -> 128 is lowered)")
     return None
+
+
+def _is_cube(dt) -> bool:
+    from spark_rapids_tpu.sqltypes import ArrayType
+
+    return (isinstance(dt, ArrayType)
+            and isinstance(dt.elementType, StringType))
+
+
+def _register_cube_gates():
+    """array<string> rides a 3-D [cap, elems, bytes] cube
+    (DeviceColumn.elem_lengths); only contains/getItem/element_at/
+    size/explode/select/lead-lag/serde/sort-payload paths are
+    cube-aware in v1. Every other array expression falls back to CPU
+    with a reason instead of crashing on the 3-D layout."""
+    from spark_rapids_tpu.expr import collections as C
+
+    def no_cube(e) -> Optional[str]:
+        from spark_rapids_tpu.sqltypes import ArrayType as _AT
+
+        if isinstance(e, Literal) and isinstance(e.dtype, _AT):
+            # Literal.eval builds flat columns only — array literals
+            # of ANY element type evaluate host-side
+            return ("array literal runs on CPU "
+                    "(no device array-literal fill in v1)")
+        if any(_is_cube(c.dtype) for c in e.children) or \
+                _is_cube(e.dtype):
+            return (f"{type(e).__name__} over array<string> runs on "
+                    "CPU (no 3-D cube lowering in v1)")
+        return None
+
+    gated = (C.ArrayTransform, C.ArrayFilter, C.ArrayMax, C.ArrayMin,
+             C.SortArray, C.Slice, C.ArrayPosition, C.ArrayRemove,
+             C.ArrayDistinct, C.Reverse, C.ArrayExists, C.ArrayForall,
+             C.ConcatArrays, C.ArraysOverlap, C.ArrayIntersect,
+             C.ArrayExcept, C.ArrayUnion, C.CreateArray,
+             Literal)  # Literal.eval builds flat columns only
+    for cls in gated:
+        prev = _checks.get(cls)
+        # CHAIN with any earlier registered check — the registry holds
+        # one slot per class and must not silently clobber
+        _checks[cls] = (lambda e, p=prev:
+                        no_cube(e) or (p(e) if p else None))
+
+
+_register_cube_gates()
