@@ -529,10 +529,22 @@ class DataFrame:
 
     # --- actions ---
 
-    def _physical(self):
+    def _physical(self, cpu_oracle: bool = False):
+        from spark_rapids_tpu.config import rapids_conf as rc
         from spark_rapids_tpu.plan.optimizer import optimize
         from spark_rapids_tpu.plan.overrides import plan_query
 
+        if cpu_oracle:
+            # data-shape fallback: all-CPU plan from the ORIGINAL
+            # logical tree — substituting device-cached relations here
+            # would re-materialize them on device and re-raise the very
+            # condition (e.g. StringWidthExceeded) being fallen back
+            # from
+            plan = _pin_query_time(self._plan)
+            conf = rc.RapidsConf({
+                **self.session._settings,
+                "spark.rapids.tpu.test.cpuOracle": True})
+            return plan_query(optimize(plan), conf)
         # serve registered device-cached subtrees from their entries
         # (Spark CacheManager.useCachedData role) BEFORE time pinning:
         # pinning may rebuild nodes, which would break identity matching
@@ -597,8 +609,6 @@ class DataFrame:
         return pq.read_table(_io.BytesIO(blob))
 
     def collect_arrow(self) -> pa.Table:
-        from spark_rapids_tpu.config import rapids_conf as rc
-
         # Engine-selection record (GpuOverrides NOT_ON_GPU diagnostics
         # discipline applied to whole-query engine dispatch): which
         # engine ran, and why each faster engine was skipped. Surfaced
@@ -629,6 +639,23 @@ class DataFrame:
         phys, _ = self._physical()
         if self.session.rapids_conf.is_explain_only:
             return pa.table({})
+        from spark_rapids_tpu.runtime.errors import StringWidthExceeded
+
+        try:
+            return self._dispatch_engines(phys, ran, fell_back)
+        except StringWidthExceeded as e:
+            # DATA-shape fallback: a string column's longest value
+            # exceeds the device padded-width ceiling — re-plan on the
+            # CPU engine, recorded like any other fallback (the
+            # "anything unsupported falls back with a reason" planner
+            # invariant extended to data-dependent shapes)
+            fell_back("device", str(e))
+            phys_cpu, _ = self._physical(cpu_oracle=True)
+            return ran("cpu", phys_cpu.collect())
+
+    def _dispatch_engines(self, phys, ran, fell_back) -> pa.Table:
+        from spark_rapids_tpu.config import rapids_conf as rc
+
         mesh_n = self.session.rapids_conf.get(rc.MESH_SIZE)
         if not mesh_n and self.session.rapids_conf.get(
                 rc.SHUFFLE_MODE) == "ICI":

@@ -71,9 +71,12 @@ def _check_string_ceiling(max_len: int) -> None:
     except Exception:
         pass
     if max_len > ceiling:
-        raise ValueError(
+        from spark_rapids_tpu.runtime.errors import StringWidthExceeded
+
+        raise StringWidthExceeded(
             f"string of {max_len} bytes exceeds the device padded-width "
-            f"ceiling {ceiling}; raise spark.rapids.tpu.string.maxBytes")
+            f"ceiling {ceiling} (spark.rapids.tpu.string.maxBytes); "
+            "query falls back to the CPU engine")
 
 
 def _string_to_matrix(arr: pa.Array, pad_to: Optional[int] = None):

@@ -16,6 +16,15 @@ Search (Spark RLIKE / Matcher.find) semantics are compiled in: a
 self-loop on the start state unless the pattern starts with `^`, and
 absorbing accept states unless it ends with `$`.
 
+Dialect coverage: per-branch anchors with Java binding ("^a|b"
+anchors only the first branch), nested class unions [a[b-c]] and
+intersections [a-z&&[^aeiou]], octal (backslash-0n), hex
+(backslash-xhh), backslash-uXXXX (ASCII), and backslash-cX control
+escapes. A complexity estimator
+(`estimate_states`, the RegexComplexityEstimator role) predicts NFA
+blowup from nested bounded repeats and tags CPU fallback BEFORE paying
+construction; MAX_STATES on the DFA remains the hard backstop.
+
 Unsupported (-> RegexUnsupported, operator falls back to CPU):
 backreferences, lookaround, lazy/possessive quantifiers beyond syntax
 acceptance, inline flags, named groups, unicode classes, and DFAs larger
@@ -89,15 +98,13 @@ _ESCAPES = {
     "s": _SPACE, "S": ~_SPACE,
 }
 _CTRL = {"n": "\n", "t": "\t", "r": "\r", "f": "\f", "a": "\x07",
-         "e": "\x1b", "0": "\0"}
+         "e": "\x1b"}
 
 
 class _Parser:
     def __init__(self, pattern: str):
         self.p = pattern
         self.i = 0
-        self.anchored_start = False
-        self.anchored_end = False
 
     def error(self, msg):
         raise RegexUnsupported(f"{msg} at {self.i} in {self.p!r}")
@@ -110,27 +117,32 @@ class _Parser:
         self.i += 1
         return c
 
-    def parse(self) -> _Node:
-        if self.peek() == "^":
-            self.anchored_start = True
-            self.take()
-        node = self.alt(top=True)
+    def parse_branches(self) -> List[Tuple[_Node, bool, bool]]:
+        """Top-level alternation with JAVA anchor binding: anchors
+        attach per branch ("^a|b" anchors only the first branch).
+        -> [(node, anchored_start, anchored_end)]."""
+        branches: List[Tuple[_Node, bool, bool]] = []
+        while True:
+            a_start = False
+            if self.peek() == "^":
+                a_start = True
+                self.take()
+            self._branch_end = False
+            node = self.concat(top=True)
+            branches.append((node, a_start, self._branch_end))
+            if self.peek() == "|":
+                self.take()
+                continue
+            break
         if self.i < len(self.p):
             self.error("unexpected trailing input")
-        return node
+        return branches
 
-    def alt(self, top=False) -> _Node:
-        options = [self.concat(top)]
+    def alt(self) -> _Node:
+        options = [self.concat()]
         while self.peek() == "|":
             self.take()
-            options.append(self.concat(top))
-        if top and len(options) > 1 and (self.anchored_start or
-                                         self.anchored_end):
-            # Java binds anchors per-branch ("^a|b" = (^a)|b); our global
-            # anchor flags would wrongly anchor every branch
-            raise RegexUnsupported(
-                f"anchors with top-level alternation in {self.p!r} "
-                "(per-branch anchoring)")
+            options.append(self.concat())
         return options[0] if len(options) == 1 else _Alt(options)
 
     def concat(self, top=False) -> _Node:
@@ -140,12 +152,14 @@ class _Parser:
             if c is None or c in "|)":
                 break
             if c == "$":
-                # only supported as the final char of the whole pattern
-                if top and self.i == len(self.p) - 1:
-                    self.anchored_end = True
+                # supported at the end of a TOP-LEVEL branch
+                nxt = (self.p[self.i + 1]
+                       if self.i + 1 < len(self.p) else None)
+                if top and nxt in (None, "|"):
+                    self._branch_end = True
                     self.take()
                     break
-                self.error("'$' only supported at pattern end")
+                self.error("'$' only supported at branch end")
             parts.append(self.repeat())
         if not parts:
             return _Concat([])
@@ -241,6 +255,36 @@ class _Parser:
                 self.error("bad \\x escape")
             self.i += 2
             return _mask_of((int(h, 16), int(h, 16)))
+        if c == "0":
+            # Java octal: \0n, \0nn, \0mnn
+            digits = ""
+            while (len(digits) < 3 and self.peek()
+                   and self.peek() in "01234567"):
+                digits += self.take()
+            if not digits:
+                self.error("bad octal escape")
+            v = int(digits, 8)
+            if v > 255:
+                self.error("octal escape > 0377")
+            return _mask_of((v, v))
+        if c == "u":
+            h = self.p[self.i:self.i + 4]
+            if len(h) != 4 or not all(x in "0123456789abcdefABCDEF"
+                                      for x in h):
+                self.error("bad \\u escape")
+            self.i += 4
+            v = int(h, 16)
+            if v > 127:
+                raise RegexUnsupported(
+                    "non-ASCII \\u escape (byte-oriented matcher)")
+            return _mask_of((v, v))
+        if c == "c":
+            ch = self.peek()
+            if ch is None or not ch.isalpha():
+                self.error("bad \\c escape")
+            self.take()
+            v = ord(ch.upper()) ^ 0x40  # Java control-char escape
+            return _mask_of((v, v))
         if c.isdigit():
             raise RegexUnsupported(f"backreference \\{c} in {self.p!r}")
         if c.isalpha():
@@ -248,10 +292,13 @@ class _Parser:
         return _mask_of(chars=c)  # escaped metachar
 
     def _char_class(self) -> np.ndarray:
+        """Java character class incl. nested unions [a[b-c]] and
+        intersections [a-z&&[^aeiou]]; '^' negates the WHOLE class."""
         negate = False
         if self.peek() == "^":
             negate = True
             self.take()
+        operands: List[np.ndarray] = []  # '&&'-separated, intersected
         mask = np.zeros(256, dtype=bool)
         first = True
         while True:
@@ -262,6 +309,15 @@ class _Parser:
                 self.take()
                 break
             first = False
+            if c == "&" and self.p[self.i:self.i + 2] == "&&":
+                self.i += 2
+                operands.append(mask)
+                mask = np.zeros(256, dtype=bool)
+                continue
+            if c == "[":
+                self.take()
+                mask |= self._char_class()
+                continue
             if c == "\\":
                 self.take()
                 mask |= self._escape()
@@ -288,10 +344,33 @@ class _Parser:
                     raise RegexUnsupported(
                         "non-ASCII in character class")
                 mask[b[0]] = True
+        for m in operands:
+            mask &= m
         return ~mask if negate else mask
 
 
 # ------------------------------------------------------------ NFA -> DFA
+
+COMPLEXITY_LIMIT = 2048  # estimated NFA states
+
+
+def estimate_states(node: _Node) -> int:
+    """Pre-construction size estimate (the RegexComplexityEstimator
+    role): bounded repeats multiply their body, so nested {m,n} blow up
+    combinatorially — predict and tag CPU fallback BEFORE paying the
+    NFA build + determinization."""
+    if isinstance(node, _Chars):
+        return 1
+    if isinstance(node, _Concat):
+        return sum(estimate_states(p) for p in node.parts) + 1
+    if isinstance(node, _Alt):
+        return sum(estimate_states(o) for o in node.options) + 2
+    if isinstance(node, _Repeat):
+        body = estimate_states(node.child)
+        n = node.hi if node.hi is not None else node.lo + 1
+        return body * max(n, 1) + 2
+    raise AssertionError(node)
+
 
 class _NFA:
     def __init__(self):
@@ -384,27 +463,46 @@ class CompiledRegex:
 
 
 def compile_search(pattern: str) -> CompiledRegex:
-    """Compile a pattern with Spark RLIKE (find-anywhere) semantics."""
+    """Compile a pattern with Spark RLIKE (find-anywhere) semantics.
+    Anchors bind PER top-level branch (Java: "^a|b" anchors only the
+    first branch): start-anchored branches enter only at position 0,
+    while unanchored ones also enter from the any-byte search loop;
+    $-anchored branches accept only at end-of-input, others absorb."""
     parser = _Parser(pattern)
-    ast = parser.parse()
+    branches = parser.parse_branches()
+    est = sum(estimate_states(node) for node, _, _ in branches)
+    if est > COMPLEXITY_LIMIT:
+        raise RegexUnsupported(
+            f"estimated NFA size {est} exceeds {COMPLEXITY_LIMIT} for "
+            f"{pattern!r} (complexity gate)")
     nfa = _NFA()
     start = nfa.new_state()
-    if not parser.anchored_start:
-        nfa.trans[start].append((nfa.add_mask(_ANY.copy()), start))
-    final = _build(nfa, ast, start)
-    accept_nfa = {final}
-    if parser.anchored_end:
-        # `$` matches at end-of-input OR just before one final '\n' —
-        # the Python-re semantics the engine's CPU oracle uses. (Java
-        # Matcher additionally treats \r, \r\n and the unicode line
-        # separators U+0085/U+2028/U+2029 as terminators; those stay
-        # outside the transpiled subset, the same caveat class as the
-        # byte-oriented `.` documented above.)
-        nl = np.zeros(256, dtype=bool)
-        nl[0x0A] = True
-        final_nl = nfa.new_state()
-        nfa.trans[final].append((nfa.add_mask(nl), final_nl))
-        accept_nfa.add(final_nl)
+    search = None
+    if any(not a_s for _, a_s, _ in branches):
+        search = nfa.new_state()
+        nfa.trans[search].append((nfa.add_mask(_ANY.copy()), search))
+        nfa.eps[start].append(search)
+    absorbing_accept = set()  # unanchored-end: once found, stays found
+    end_accept = set()        # $-anchored: accept only at end of input
+    for node, a_s, a_e in branches:
+        entry = nfa.new_state()
+        nfa.eps[start if a_s else search].append(entry)
+        final = _build(nfa, node, entry)
+        if a_e:
+            # `$` matches at end-of-input OR just before one final
+            # '\n' — the Python-re semantics the engine's CPU oracle
+            # uses. (Java Matcher additionally treats \r, \r\n and the
+            # unicode line separators U+0085/U+2028/U+2029 as
+            # terminators; those stay outside the transpiled subset,
+            # the same caveat class as the byte-oriented `.`.)
+            nl = np.zeros(256, dtype=bool)
+            nl[0x0A] = True
+            final_nl = nfa.new_state()
+            nfa.trans[final].append((nfa.add_mask(nl), final_nl))
+            end_accept |= {final, final_nl}
+        else:
+            absorbing_accept.add(final)
+    accept_nfa = absorbing_accept | end_accept
     n = len(nfa.eps)
 
     # epsilon closures
@@ -442,13 +540,14 @@ def compile_search(pattern: str) -> CompiledRegex:
     while i < len(order):
         cur = order[i]
         i += 1
-        is_acc = any(s in accept_nfa for s in cur)
+        is_abs = any(s in absorbing_accept for s in cur)
+        is_acc = is_abs or any(s in end_accept for s in cur)
         accept_flags.append(is_acc)
         row = []
         for cl in range(n_classes):
             b = rep[cl]
             nxt = set()
-            if is_acc and not parser.anchored_end:
+            if is_abs:
                 # absorbing accept: once found, stay accepted
                 row.append(-1)  # patched below
                 continue

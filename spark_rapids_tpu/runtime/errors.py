@@ -23,6 +23,14 @@ class TpuSplitAndRetryOOM(TpuOOMError):
     (usually in half by rows) and retry the pieces."""
 
 
+class StringWidthExceeded(ValueError):
+    """A string column's longest value exceeds
+    spark.rapids.tpu.string.maxBytes — the padded-matrix device layout
+    would multiply the column footprint. The engine dispatch catches
+    this and re-runs the query on the CPU plan (a DATA-shape fallback,
+    recorded like any other engine fallback)."""
+
+
 class TpuAnsiError(ValueError):
     """ANSI-mode runtime error (the SparkArithmeticException /
     SparkDateTimeException role): raised when spark.sql.ansi.enabled

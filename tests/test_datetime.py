@@ -239,3 +239,37 @@ def test_make_date_invalid_is_null():
     assert vals[0] is not None       # 2024-02-29 valid (leap)
     assert vals[1] is None           # 2023-02-29 invalid
     assert vals[2] is None           # month 13
+
+
+def test_string_to_timestamp_la_dst_on_device():
+    """string->timestamp under a non-UTC session zone runs ON DEVICE
+    (ops/tzdb.py transition tables; GpuTimeZoneDB role) — differential
+    oracle at America/Los_Angeles across the 2024 DST gap (02:00->
+    03:00 spring-forward) and overlap (fall-back), resolving ambiguous
+    wall-clocks to the EARLIER offset like java.time.ZoneRules."""
+    from datetime import datetime
+    from zoneinfo import ZoneInfo
+
+    from spark_rapids_tpu.api.session import TpuSparkSession
+
+    strs = ["2024-03-10 01:30:00", "2024-03-10 02:30:00",
+            "2024-03-10 03:30:00", "2024-11-03 00:30:00",
+            "2024-11-03 01:30:00", "2024-06-15 12:00:00",
+            "2024-01-15 12:00:00"]
+    s = TpuSparkSession({"spark.sql.session.timeZone": LA,
+                         "spark.sql.shuffle.partitions": 2})
+    try:
+        out = (s.createDataFrame(pa.table({"s": pa.array(strs)}))
+               .select(F.col("s").cast("timestamp").alias("ts"))
+               .collect_arrow())
+        assert s.last_execution["engine"] == "fused"  # stayed on device
+        zi = ZoneInfo(LA)
+        utc = ZoneInfo("UTC")
+        for src, got in zip(strs, out["ts"].to_pylist()):
+            want = (datetime.fromisoformat(src).replace(tzinfo=zi)
+                    .astimezone(utc).replace(tzinfo=None))
+            got_n = (got.astimezone(utc).replace(tzinfo=None)
+                     if got.tzinfo else got)
+            assert got_n == want, (src, got_n, want)
+    finally:
+        s.stop()

@@ -72,7 +72,8 @@ def test_transpiled_dfa_matches_re(pattern):
 
 
 @pytest.mark.parametrize("pattern", [r"(a)\1", r"a{100}", r"\bword",
-                                     r"(?=look)", r"a|b$", r"^a|b",
+                                     r"(?=look)",  # per-branch anchors
+                                     # are SUPPORTED since round 5
                                      r"[À-Ý]", r"\xzz"])
 def test_unsupported_patterns_raise(pattern):
     """Untranspilable shapes (incl. per-branch anchors and non-ASCII
@@ -181,3 +182,71 @@ def test_rlike_with_nulls():
         return df.withColumn("m", df["s"].rlike("abc"))
 
     assert_tpu_and_cpu_are_equal_collect(q)
+
+
+# ------------------------- round-5 dialect breadth (verdict item #10)
+
+class TestDialectBreadth:
+    """Per-branch anchors (Java binding), class intersection/nested
+    union, octal/unicode/control escapes, and the complexity estimator
+    (RegexParser.scala + RegexComplexityEstimator.scala roles)."""
+
+    CASES = [
+        ("^a|b", ["abc", "xb", "xa", "ba", "zzz"], None),
+        ("^foo$|bar", ["foo", "foox", "xbar", "foobar", ""], None),
+        ("a$|^b", ["xa", "ax", "bx", "xb", "a", "b"], None),
+        ("[a-z&&[^aeiou]]+", ["xyz", "aei", "bcd", "a"],
+         "[b-df-hj-np-tv-z]+"),
+        ("[a-c[x-z]]+", ["ax", "m", "byz"], "[a-cx-z]+"),
+        ("\\07", ["\x07", "7", ""], "\\x07"),
+        ("\\013", ["\x0b", "13"], "\\x0b"),
+        ("\\u0041+", ["AAA", "B"], "A+"),
+        ("\\cA", ["\x01", "A"], "\\x01"),
+        ("^a$|^$", ["a", "", "b", "aa"], None),
+    ]
+
+    def test_host_oracle(self):
+        import re
+
+        from spark_rapids_tpu.regex.transpiler import compile_search
+
+        for pat, inputs, oracle in self.CASES:
+            c = compile_search(pat)
+            for s in inputs:
+                got = c.match_host(s.encode())
+                want = re.search(oracle or pat, s) is not None
+                assert got == want, (pat, s, got, want)
+
+    def test_complexity_estimator_gates_before_build(self):
+        from spark_rapids_tpu.regex.transpiler import (
+            RegexUnsupported,
+            compile_search,
+        )
+
+        with pytest.raises(RegexUnsupported, match="complexity gate"):
+            compile_search("(a{50}){50}")
+
+    def test_rlike_per_branch_anchor_vs_cpu(self):
+        import pyarrow as pa
+
+        from spark_rapids_tpu.testing.asserts import (
+            assert_tpu_and_cpu_are_equal_collect,
+        )
+
+        t = pa.table({"s": pa.array(
+            ["abc", "xb", "xa", "ba", "zzz", "", "b"])})
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda spark: spark.createDataFrame(t).select(
+                "s", F.col("s").rlike("^a|b").alias("m")))
+
+    def test_rlike_class_intersection_vs_cpu_fallbackless(self):
+        # python re (the oracle) has no '&&'; diff against the host
+        # reference implementation instead
+        from spark_rapids_tpu.regex.transpiler import compile_search
+
+        vals = ["xyz", "aeiou", "bcdf", "a1b", ""]
+        c = compile_search("[a-z&&[^aeiou]]+")
+        want = [bool(__import__("re").search("[b-df-hj-np-tv-z]+", v))
+                for v in vals]
+        got = [c.match_host(v.encode()) for v in vals]
+        assert got == want

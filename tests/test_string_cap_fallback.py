@@ -1,0 +1,44 @@
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSparkSession
+
+
+def test_string_over_cap_falls_back_to_cpu():
+    s = TpuSparkSession({"spark.sql.shuffle.partitions": 2})
+    try:
+        big = "x" * 10000  # > 8192 default ceiling
+        t = pa.table({"k": pa.array([1, 1, 2], pa.int64()),
+                      "s": pa.array(["a", big, "b"])})
+        df = (s.createDataFrame(t).filter(F.col("k") >= 1)
+              .groupBy("k").agg(F.count("*").alias("c")))
+        out = df.collect_arrow()
+        got = dict(zip(out["k"].to_pylist(), out["c"].to_pylist()))
+        assert got == {1: 2, 2: 1}, got
+        rec = s.last_execution
+        assert rec["engine"] == "cpu", rec
+        assert any(e == "device" and "exceeds" in r
+                   for e, r in rec["fallbacks"]), rec
+        # select of the oversized value itself also round-trips
+        o2 = s.createDataFrame(t).filter(F.col("k") == 1).collect_arrow()
+        assert big in o2["s"].to_pylist()
+    finally:
+        s.stop()
+
+
+def test_device_cached_over_cap_falls_back():
+    # the CPU-fallback re-plan must NOT re-substitute device-cached
+    # relations (their materialization re-raises the ceiling)
+    s = TpuSparkSession({"spark.sql.shuffle.partitions": 2})
+    try:
+        big = "y" * 9000
+        t = pa.table({"k": pa.array([1, 2], pa.int64()),
+                      "s": pa.array(["a", big])})
+        df = s.createDataFrame(t).cache(storage="device")
+        out = df.collect_arrow()
+        assert sorted(out["k"].to_pylist()) == [1, 2]
+        assert big in out["s"].to_pylist()
+        assert s.last_execution["engine"] == "cpu"
+    finally:
+        s.stop()
