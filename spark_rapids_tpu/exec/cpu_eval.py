@@ -1294,6 +1294,23 @@ def _ev_regex(e: Expression, t: pa.Table):
     if cls not in (RLike, RegexpExtract, RegexpReplace):
         return None
     xs = _as_list(_ev(e.children[0], t), t)
+    if cls is RLike:
+        # prefer the transpiled DFA (the DEVICE semantics, incl.
+        # Java-only syntax like \cX / nested classes / '&&' that
+        # Python re mis-parses or rejects): CPU fallback and device
+        # then agree by construction
+        from spark_rapids_tpu.regex.transpiler import (
+            RegexUnsupported,
+            compile_search,
+        )
+
+        try:
+            c = compile_search(e.pattern)
+            return pa.array(
+                [None if v is None else c.match_host(v.encode("utf-8"))
+                 for v in xs], pa.bool_())
+        except RegexUnsupported:
+            pass  # outside the transpilable subset: Python re below
     rx = re.compile(e.pattern)
     if cls is RLike:
         return pa.array([None if v is None else rx.search(v) is not None

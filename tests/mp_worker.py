@@ -71,10 +71,38 @@ def main() -> None:
                 " — ingestion is not process-local: " + json.dumps(stats))
 
         pq.write_table(got, os.path.join(out_dir, f"result_{pid}.parquet"))
+
+        # second scenario, same cluster: HEAVILY SKEWED join keys (90%
+        # of rows share one key) — the all_to_all slot-capacity
+        # overflow + whole-program recompile discipline must converge
+        # cross-process (every process must take the same retry path
+        # or the collectives deadlock)
+        skew = spark.createDataFrame(_skew_table())
+        dim2 = spark.createDataFrame(_dim_table())
+        df2 = (skew.join(dim2, on="k", how="inner")
+                   .groupBy("g")
+                   .agg(F.sum("v").alias("s"), F.count("*").alias("c")))
+        got2 = df2.collect_arrow()
+        pq.write_table(got2,
+                       os.path.join(out_dir, f"result2_{pid}.parquet"))
         with open(os.path.join(out_dir, f"ok_{pid}"), "w") as f:
             json.dump(stats, f)
     finally:
         spark.stop()
+
+
+def _skew_table():
+    """Deterministic (identical on every process — SPMD inputs must
+    agree) skewed fact: 90% of rows carry key 7."""
+    import numpy as np
+    import pyarrow as pa
+
+    rng = np.random.default_rng(3)
+    n = 4000
+    keys = np.where(rng.random(n) < 0.9, 7,
+                    rng.integers(0, 50, n)).astype(np.int64)
+    return pa.table({"k": pa.array(keys),
+                     "v": pa.array(rng.random(n))})
 
 
 def _dim_table():

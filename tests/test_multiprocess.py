@@ -111,3 +111,28 @@ def test_two_process_distributed_query(tmp_path):
     assert [s["files"] for s in stats] == [N_FILES // N_PROC] * N_PROC, stats
     assert [s["local_shards"] for s in stats] == [4, 4], stats
     assert sorted(s["process"] for s in stats) == [0, 1], stats
+
+    # scenario 2: the skewed join (90% hot key) must agree with the
+    # oracle on BOTH processes — the all_to_all slot overflow/retry
+    # path converged cross-process. One source of truth for the data:
+    # the worker's own generator.
+    from tests.mp_worker import _skew_table
+
+    skew = _skew_table()
+    keys = np.asarray(skew.column("k"))
+    vals = np.asarray(skew.column("v"))
+    g = keys % 5
+    want2 = {}
+    for gg, vv in zip(g.tolist(), vals.tolist()):
+        sacc, cacc = want2.get(gg, (0.0, 0))
+        want2[gg] = (sacc + vv, cacc + 1)
+    for pid in range(N_PROC):
+        got2 = pq.read_table(
+            os.path.join(out_dir, f"result2_{pid}.parquet"))
+        gm = {gg: (ss, cc) for gg, ss, cc in zip(
+            got2.column("g").to_pylist(), got2.column("s").to_pylist(),
+            got2.column("c").to_pylist())}
+        assert set(gm) == set(want2), (pid, gm.keys())
+        for gg, (ss, cc) in want2.items():
+            assert gm[gg][1] == cc, (pid, gg, gm[gg], cc)
+            np.testing.assert_allclose(gm[gg][0], ss, rtol=1e-9)

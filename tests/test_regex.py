@@ -72,12 +72,13 @@ def test_transpiled_dfa_matches_re(pattern):
 
 
 @pytest.mark.parametrize("pattern", [r"(a)\1", r"a{100}", r"\bword",
-                                     r"(?=look)",  # per-branch anchors
-                                     # are SUPPORTED since round 5
-                                     r"[À-Ý]", r"\xzz"])
+                                     r"(?=look)", r"[À-Ý]", r"\xzz"])
 def test_unsupported_patterns_raise(pattern):
-    """Untranspilable shapes (incl. per-branch anchors and non-ASCII
-    ranges, which would silently mis-match) raise for CPU fallback."""
+    """Untranspilable shapes (backreferences, word boundaries,
+    lookaround, non-ASCII ranges — which would silently mis-match —
+    and over-bound repeats) raise for CPU fallback. Per-branch anchors
+    ("^a|b") are SUPPORTED since round 5 and covered in
+    TestDialectBreadth."""
     with pytest.raises(RegexUnsupported):
         compile_search(pattern)
 
