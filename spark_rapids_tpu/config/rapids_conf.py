@@ -121,6 +121,30 @@ WINDOW_STREAMING = conf(
     "Use the streaming window strategies (running-frame carry state, "
     "two-pass unbounded aggregation) for eligible specs instead of "
     "materializing whole partitions on device.", bool)
+FUSED_LOOKUP_JOIN = conf(
+    "spark.rapids.sql.fusedExec.lookupJoin.enabled", True,
+    "Lower broadcast equi-joins with unique build keys as "
+    "row-preserving lookup gathers inside fused per-partition chains "
+    "(no expansion buffer); duplicate keys re-lower via the expanded "
+    "blocking path automatically.", bool)
+REGEX_MAX_STATES = conf(
+    "spark.rapids.sql.regexp.maxStates", 192,
+    "DFA state ceiling for device regex; patterns determinizing past "
+    "it fall back to CPU with a reason.", int,
+    checker=lambda v: 2 <= v <= (1 << 14))
+REGEX_COMPLEXITY_LIMIT = conf(
+    "spark.rapids.sql.regexp.complexityLimit", 2048,
+    "Estimated-NFA-size gate (the RegexComplexityEstimator role): "
+    "patterns predicted to exceed it fall back to CPU BEFORE paying "
+    "NFA construction and determinization.", int,
+    checker=lambda v: 2 <= v <= (1 << 20))
+WINDOW_U2U_FOLD = conf(
+    "spark.rapids.sql.window.unboundedFoldEvery", 8,
+    "How many per-chunk partition partials the two-pass unbounded "
+    "window strategy accumulates before folding them into the bounded "
+    "buffer batch (fewer folds = fewer host syncs; more parked "
+    "partials in the spill catalog between folds).", int,
+    checker=lambda v: 1 <= v <= 1024)
 FUSED_AGG_PUSHDOWN = conf(
     "spark.rapids.sql.fusedExec.aggPushdownThroughJoin", True,
     "Pre-aggregate the probe side of a fused lookup join by the join "

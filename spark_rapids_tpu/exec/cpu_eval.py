@@ -1305,7 +1305,11 @@ def _ev_regex(e: Expression, t: pa.Table):
         )
 
         try:
-            c = compile_search(e.pattern)
+            # LOOSE limits on purpose (max of session and default):
+            # neither tightening nor raising the device resource knobs
+            # may shift CPU evaluation off the Java-semantics DFA onto
+            # Python re
+            c = compile_search(e.pattern, loose_limits=True)
             return pa.array(
                 [None if v is None else c.match_host(v.encode("utf-8"))
                  for v in xs], pa.bool_())

@@ -245,6 +245,7 @@ class FusedSingleChipExecutor:
         self._fetch_fused_bytes = c(rc.FUSED_SINGLE_SYNC_FETCH_BYTES)
         self._ansi = c(rc.ANSI_ENABLED)
         self._agg_pushdown = c(rc.FUSED_AGG_PUSHDOWN)
+        self._lookup_conf = c(rc.FUSED_LOOKUP_JOIN)
 
     # --- source preparation (once; survives expansion retries) ---
 
@@ -509,6 +510,8 @@ class FusedSingleChipExecutor:
         expanded blocking path (`emit_blocking`)."""
         if not isinstance(node, J.TpuBroadcastHashJoinExec) \
                 or node.condition is not None:
+            return False
+        if not self._lookup_conf:
             return False
         if node.join_type in ("left_semi", "left_anti", "existence"):
             return True

@@ -2872,7 +2872,10 @@ class TpuWindowExec(PhysicalPlan):
             while pend_parts:
                 pend_parts.pop().close()
 
-        FOLD_EVERY = 8
+        from spark_rapids_tpu.config import rapids_conf as _rc
+
+        FOLD_EVERY = (self.conf.get(_rc.WINDOW_U2U_FOLD)
+                      if self.conf is not None else 8)
         try:
             for batch in child.execute_partition(pid, ctx):
                 parked.append(retry_on_oom(

@@ -39,8 +39,32 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-MAX_STATES = 192
+from spark_rapids_tpu.config import rapids_conf as _rc
+
+#: single source of truth: the conf defaults
+#: (spark.rapids.sql.regexp.maxStates / .complexityLimit)
+MAX_STATES = _rc.REGEX_MAX_STATES.default
+COMPLEXITY_LIMIT = _rc.REGEX_COMPLEXITY_LIMIT.default
 MAX_REPEAT = 64
+
+
+def _conf_limit(entry, loose: bool) -> int:
+    """Read a limit from the ACTIVE session's conf (the transpiler is
+    session-free; same active-session read as the string-ceiling and
+    ANSI checks — a pattern compiled while a DIFFERENT session is
+    active sees that session's limits). `loose=True` returns
+    max(session value, default): the CPU rlike path compiles with the
+    LOOSER bound so neither tightening nor raising the DEVICE resource
+    knobs shifts CPU evaluation off the Java-semantics DFA onto
+    Python re."""
+    v = int(entry.default)
+    from spark_rapids_tpu.api.session import TpuSparkSession
+
+    s = TpuSparkSession.active()
+    if s is not None:
+        sv = int(s.rapids_conf.get(entry))
+        v = max(sv, v) if loose else sv
+    return v
 
 
 class RegexUnsupported(Exception):
@@ -351,9 +375,6 @@ class _Parser:
 
 # ------------------------------------------------------------ NFA -> DFA
 
-COMPLEXITY_LIMIT = 2048  # estimated NFA states
-
-
 def estimate_states(node: _Node) -> int:
     """Pre-construction size estimate (the RegexComplexityEstimator
     role): bounded repeats multiply their body, so nested {m,n} blow up
@@ -462,7 +483,8 @@ class CompiledRegex:
         return bool(self.accept[s])
 
 
-def compile_search(pattern: str) -> CompiledRegex:
+def compile_search(pattern: str,
+                   loose_limits: bool = False) -> CompiledRegex:
     """Compile a pattern with Spark RLIKE (find-anywhere) semantics.
     Anchors bind PER top-level branch (Java: "^a|b" anchors only the
     first branch): start-anchored branches enter only at position 0,
@@ -470,11 +492,13 @@ def compile_search(pattern: str) -> CompiledRegex:
     $-anchored branches accept only at end-of-input, others absorb."""
     parser = _Parser(pattern)
     branches = parser.parse_branches()
+    limit = _conf_limit(_rc.REGEX_COMPLEXITY_LIMIT, loose_limits)
     est = sum(estimate_states(node) for node, _, _ in branches)
-    if est > COMPLEXITY_LIMIT:
+    if est > limit:
         raise RegexUnsupported(
-            f"estimated NFA size {est} exceeds {COMPLEXITY_LIMIT} for "
+            f"estimated NFA size {est} exceeds {limit} for "
             f"{pattern!r} (complexity gate)")
+    max_states = _conf_limit(_rc.REGEX_MAX_STATES, loose_limits)
     nfa = _NFA()
     start = nfa.new_state()
     search = None
@@ -557,9 +581,9 @@ def compile_search(pattern: str) -> CompiledRegex:
                         nxt |= closures[tgt]
             key = frozenset(nxt)
             if key not in dfa_states:
-                if len(dfa_states) >= MAX_STATES:
+                if len(dfa_states) >= max_states:
                     raise RegexUnsupported(
-                        f"DFA exceeds {MAX_STATES} states for "
+                        f"DFA exceeds {max_states} states for "
                         f"{pattern!r}")
                 dfa_states[key] = len(order)
                 order.append(key)

@@ -128,3 +128,106 @@ def test_fused_knobs_construct():
     assert ex._group_cap == 1 << 12
     assert ex._max_expansion == 32
     assert ex._fetch_fused_bytes == 1 << 10
+
+
+def test_round5_knobs_wired():
+    """The round-5 machinery's knobs are real: disabling the lookup
+    join / shrinking the regex limits changes engine behavior."""
+    import pyarrow as pa
+
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSparkSession
+
+    # regex complexity limit: a small limit rejects what the default
+    # accepts — observe the MECHANISM (transpiler raises under the
+    # session conf; compiles fine with conf limits bypassed) and the
+    # end-to-end answer via CPU fallback
+    from spark_rapids_tpu.regex.transpiler import (
+        RegexUnsupported,
+        compile_search,
+    )
+
+    s = TpuSparkSession({"spark.rapids.sql.regexp.complexityLimit": 4})
+    try:
+        import pytest as _pt
+
+        with _pt.raises(RegexUnsupported, match="complexity gate"):
+            compile_search("(ab){2}")  # reads the ACTIVE session conf
+        compile_search("(ab){2}", loose_limits=True)  # default ok
+        t = pa.table({"x": pa.array(["abab", "zz"])})
+        out = (s.createDataFrame(t)
+               .select(F.col("x").rlike("(ab){2}").alias("m"))
+               .collect_arrow())
+        assert out["m"].to_pylist() == [True, False]
+    finally:
+        s.stop()
+
+    # lookup join off: the lowering predicate itself flips (mechanism)
+    # and the star query stays correct via the blocking path
+    s2 = TpuSparkSession({
+        "spark.rapids.sql.fusedExec.lookupJoin.enabled": False,
+        "spark.sql.shuffle.partitions": 2})
+    try:
+        from spark_rapids_tpu.exec.fused import FusedSingleChipExecutor
+
+        assert FusedSingleChipExecutor(
+            s2.rapids_conf)._lookup_conf is False
+        fact = pa.table({"k": pa.array([0, 1, 0], pa.int64()),
+                         "v": pa.array([1.0, 2.0, 4.0])})
+        dim = pa.table({"k": pa.array([0, 1], pa.int64()),
+                        "g": pa.array(["a", "b"])})
+        out = (s2.createDataFrame(fact)
+               .join(s2.createDataFrame(dim), on="k", how="inner")
+               .groupBy("g").agg(F.sum("v").alias("sv"))
+               .collect_arrow())
+        assert dict(zip(out["g"].to_pylist(), out["sv"].to_pylist())) \
+            == {"a": 5.0, "b": 2.0}
+    finally:
+        s2.stop()
+
+
+def test_round5_maxstates_and_fold_knobs():
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.api.session import TpuSparkSession
+    from spark_rapids_tpu.api.window import Window
+    from spark_rapids_tpu.regex.transpiler import (
+        RegexUnsupported,
+        compile_search,
+    )
+
+    # maxStates: a tiny ceiling rejects under the session conf but the
+    # LOOSE (CPU-path) compile still succeeds at the default
+    s = TpuSparkSession({"spark.rapids.sql.regexp.maxStates": 2})
+    try:
+        with pytest.raises(RegexUnsupported, match="states"):
+            compile_search("abc")
+        compile_search("abc", loose_limits=True)
+    finally:
+        s.stop()
+
+    # unboundedFoldEvery=1: fold after EVERY chunk, still exact
+    s2 = TpuSparkSession({
+        "spark.rapids.sql.window.unboundedFoldEvery": 1,
+        "spark.rapids.sql.batchSizeRows": 128,
+        "spark.rapids.sql.reader.batchSizeRows": 128,
+        "spark.rapids.sql.fusedExec.enabled": False})
+    try:
+        n = 600
+        rng = np.random.default_rng(4)
+        t = pa.table({"g": pa.array(rng.integers(0, 3, n), pa.int64()),
+                      "v": pa.array(rng.random(n))})
+        w = Window.partitionBy("g")
+        out = (s2.createDataFrame(t)
+               .select("g", F.sum("v").over(w).alias("ts"))
+               .collect_arrow())
+        import collections
+        acc = collections.defaultdict(float)
+        for g, v in zip(t["g"].to_pylist(), t["v"].to_pylist()):
+            acc[g] += v
+        for g, ts in zip(out["g"].to_pylist(), out["ts"].to_pylist()):
+            assert abs(ts - acc[g]) < 1e-9
+    finally:
+        s2.stop()
