@@ -1,7 +1,47 @@
 #!/usr/bin/env bash
 # Nightly perf job — the jenkins/spark-nightly-build.sh role: run the
-# engine benchmark on real hardware and archive the JSON line.
+# engine benchmark on real hardware, archive the JSON line, and track
+# COLD START (cold_s and warm-persistent-cache cold_warm_cache_s) so a
+# time-to-first-query regression fails the job instead of drifting.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 out="bench-$(date +%Y%m%d).json"
-timeout 900 python bench.py | tee "$out"
+timeout 1800 python bench.py | tee "$out"
+
+python - "$out" <<'PY'
+import json, sys, datetime, os
+
+line = [l for l in open(sys.argv[1]) if l.strip().startswith("{")][-1]
+d = json.loads(line)
+entry = {
+    "date": datetime.date.today().isoformat(),
+    "value_gbps": d.get("value"),
+    "cold_s": d.get("cold_s"),
+    "cold_warm_cache_s": d.get("cold_warm_cache_s"),
+    "compile_cold": d.get("compile_cold"),
+}
+hist = "bench-history.jsonl"
+prev = None
+if os.path.exists(hist):
+    lines = [json.loads(l) for l in open(hist) if l.strip()]
+    prev = lines[-1] if lines else None
+with open(hist, "a") as f:
+    f.write(json.dumps(entry) + "\n")
+
+warm = entry["cold_warm_cache_s"]
+if warm is None:
+    sys.exit("nightly: cold_warm_cache_s missing from bench JSON "
+             "(persistent compile cache probe failed)")
+# regression gates: warm-cache cold start must beat the cold compile
+# path by 4x (the persistent cache's contract), and must not regress
+# >2x against the previous nightly on the same hardware
+if entry["cold_s"] and warm > max(entry["cold_s"] / 4.0, 30.0):
+    sys.exit(f"nightly: warm-cache cold start {warm}s lost the 4x "
+             f"contract vs cold_s={entry['cold_s']}s")
+if prev and prev.get("cold_warm_cache_s") and \
+        warm > 2.0 * prev["cold_warm_cache_s"] + 5.0:
+    sys.exit(f"nightly: warm-cache cold start regressed {warm}s vs "
+             f"previous {prev['cold_warm_cache_s']}s")
+print(f"nightly: cold_s={entry['cold_s']}s "
+      f"cold_warm_cache_s={warm}s (recorded to {hist})")
+PY

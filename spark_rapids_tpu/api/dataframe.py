@@ -615,7 +615,7 @@ class DataFrame:
         # via explain() and session.query_metrics — a fused/mesh compile
         # error must never silently land a query on the dispatch-bound
         # eager path.
-        rec = {"engine": None, "fallbacks": []}
+        rec = {"engine": None, "fallbacks": [], "compile": None}
         self._last_exec = rec
         self.session.last_execution = rec
 
@@ -639,10 +639,18 @@ class DataFrame:
         phys, _ = self._physical()
         if self.session.rapids_conf.is_explain_only:
             return pa.table({})
+        from spark_rapids_tpu.runtime import compile_cache as _cc
         from spark_rapids_tpu.runtime.errors import StringWidthExceeded
 
+        # Compile observability (the tentpole's watch-forever channel):
+        # the process compile ledger is snapshotted around the query and
+        # the delta — programs compiled, structural cache hits, warmup
+        # hits, compile seconds — lands in last_execution["compile"]
+        # and the session metrics, with the fused engine's distinct
+        # program-variant count folded in when it ran.
+        before = _cc.stats.snapshot()
         try:
-            return self._dispatch_engines(phys, ran, fell_back)
+            return self._dispatch_engines(phys, ran, fell_back, rec)
         except StringWidthExceeded as e:
             # DATA-shape fallback: a string column's longest value
             # exceeds the device padded-width ceiling — re-plan on the
@@ -652,8 +660,19 @@ class DataFrame:
             fell_back("device", str(e))
             phys_cpu, _ = self._physical(cpu_oracle=True)
             return ran("cpu", phys_cpu.collect())
+        finally:
+            comp = _cc.stats.delta(before, _cc.stats.snapshot())
+            comp["variantCount"] = rec.pop("_fused_variants", None)
+            rec["compile"] = comp
+            qm = self.session.query_metrics
+            qm.metric("compile.programsCompiled").add(
+                comp["programsCompiled"])
+            qm.metric("compile.cacheHits").add(comp["cacheHits"])
+            qm.metric("compile.warmHits").add(comp["warmHits"])
+            qm.metric("compile.timeMs").add(
+                int(comp["compileSeconds"] * 1000))
 
-    def _dispatch_engines(self, phys, ran, fell_back) -> pa.Table:
+    def _dispatch_engines(self, phys, ran, fell_back, rec) -> pa.Table:
         from spark_rapids_tpu.config import rapids_conf as rc
 
         mesh_n = self.session.rapids_conf.get(rc.MESH_SIZE)
@@ -681,9 +700,13 @@ class DataFrame:
                 FusedSingleChipExecutor,
             )
 
+            ex = FusedSingleChipExecutor(self.session.rapids_conf)
             try:
-                return ran("fused", FusedSingleChipExecutor(
-                    self.session.rapids_conf).execute(phys))
+                out = ex.execute(phys)
+                if ex.last_compile_metrics is not None:
+                    rec["_fused_variants"] = \
+                        ex.last_compile_metrics["variantCount"]
+                return ran("fused", out)
             except FusedCompileError as e:
                 # no fused lowering / too big: per-operator engine
                 fell_back("fused", str(e))

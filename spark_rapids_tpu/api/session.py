@@ -318,10 +318,28 @@ class TpuSparkSession:
 
         profiler.stop_trace()
 
+    @property
+    def compile_cache_stats(self):
+        """Process compile ledger (runtime/compile_cache.py): programs
+        compiled / structural cache hits / warmup hits / compile
+        seconds. Per-query deltas live in last_execution['compile']."""
+        from spark_rapids_tpu.runtime.compile_cache import stats
+
+        return stats.snapshot()
+
     def stop(self):
         global _active
         try:
             self.cache_manager.clear()
+        except Exception:
+            pass
+        try:
+            # drain pending compile-cache index/artifact writes so a
+            # follow-on process (or the warm-cache bench probe) sees
+            # everything this session compiled
+            from spark_rapids_tpu.runtime import compile_cache
+
+            compile_cache.flush()
         except Exception:
             pass
         try:

@@ -449,6 +449,45 @@ FUSED_EXEC = conf(
     "kernel dispatch, which dominates on tunneled devices. Plans or "
     "working sets the fused path cannot handle fall back to the "
     "per-operator out-of-core engine automatically.", bool)
+COMPILE_CACHE_ENABLED = conf(
+    "spark.rapids.tpu.compileCache.enabled", True,
+    "Persist compiled XLA programs across processes "
+    "(runtime/compile_cache.py): jax's persistent compilation cache "
+    "plus the engine's structural key->artifact index, both under "
+    "compileCache.dir and invalidated on any jax/jaxlib/plugin/backend "
+    "version change. A fresh process re-tracing the same query then "
+    "loads serialized executables instead of recompiling — the "
+    "cold-start killer (482 s -> seconds measured on the q5 bench).",
+    bool)
+COMPILE_CACHE_DIR = conf(
+    "spark.rapids.tpu.compileCache.dir", "",
+    "Directory for the persistent compilation cache (default: "
+    "<tmp>/srtpu_compile_cache). Safe to share between concurrent "
+    "sessions: all writes are atomic-rename and entries are "
+    "content-addressed.", str)
+COMPILE_CACHE_WARMUP = conf(
+    "spark.rapids.tpu.compileCache.warmup.enabled", True,
+    "Background-compile the top-K most-used fused programs recorded by "
+    "prior runs (their jax.export artifacts) at session start, "
+    "overlapping the first scan's decode/upload I/O; warmed programs "
+    "serve without even re-tracing.", bool)
+COMPILE_CACHE_WARMUP_TOP_K = conf(
+    "spark.rapids.tpu.compileCache.warmup.topK", 32,
+    "How many prior-run program artifacts the async warmup compiles, "
+    "most-used first.", int, checker=lambda v: 0 <= v <= (1 << 12))
+COMPILE_CACHE_ARTIFACT_MIN_S = conf(
+    "spark.rapids.tpu.compileCache.artifact.minCompileSecs", 0.5,
+    "Only fused programs whose first compile took at least this long "
+    "get a serialized warmup artifact (exporting re-traces the program "
+    "in the background; cheap programs reload fast enough from the "
+    "XLA disk cache alone).", float)
+FUSED_SHAPE_BUCKETS = conf(
+    "spark.rapids.sql.fusedExec.shapeBucketing", True,
+    "Bucket scan-upload capacities to 1/8-power-of-two steps so files "
+    "of similar size share compiled fused programs (each distinct "
+    "padded shape multiplies every downstream program variant); costs "
+    "<= 12.5% pad bytes on the host->device link. false keeps the "
+    "fine-grained 64Ki alignment.", bool)
 CPU_ORACLE_ENABLED = conf(
     "spark.rapids.tpu.test.cpuOracle", False,
     "Internal: route this session through the CPU (pyarrow) backend; used "

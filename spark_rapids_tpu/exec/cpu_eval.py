@@ -1315,7 +1315,17 @@ def _ev_regex(e: Expression, t: pa.Table):
                  for v in xs], pa.bool_())
         except RegexUnsupported:
             pass  # outside the transpilable subset: Python re below
-    rx = re.compile(e.pattern)
+    try:
+        rx = re.compile(e.pattern)
+    except re.error as err:
+        # Java-valid patterns Python re rejects (e.g. \c1) must surface
+        # as a clean unsupported-pattern error, not a raw re.error
+        # traceback out of the middle of a query
+        from spark_rapids_tpu.regex.transpiler import RegexUnsupported
+
+        raise RegexUnsupported(
+            f"pattern {e.pattern!r} is outside both the device "
+            f"transpiler subset and Python re ({err})") from err
     if cls is RLike:
         return pa.array([None if v is None else rx.search(v) is not None
                          for v in xs], pa.bool_())

@@ -145,15 +145,32 @@ def _narrow(vals: np.ndarray):
     return vals, vrange
 
 
+def bucket_capacity(n: int) -> int:
+    """Padded-shape bucket for scan uploads: capacities land on one of
+    16 steps per power-of-two octave (1/16-octave granularity), so
+    files of merely SIMILAR size share one compiled program per stage
+    instead of one per distinct row count — each distinct capacity
+    multiplies every downstream fused program. Padding stays <= 12.5%
+    (a full power-of-two bucket would cost up to 100% across the
+    tunneled link). Below 2^20 rows the _UPLOAD_ALIGN floor dominates
+    and the bucketing is the old alignment exactly."""
+    n = max(int(n), 1)
+    step = max(1 << max(int(n - 1).bit_length() - 4, 0), _UPLOAD_ALIGN)
+    return -(-n // step) * step
+
+
 def upload_narrowed(table: pa.Table, capacity: Optional[int] = None,
-                    narrow: bool = True) -> ColumnBatch:
+                    narrow: bool = True,
+                    bucket: bool = True) -> ColumnBatch:
     """pyarrow Table -> device ColumnBatch with integer columns shipped
     at their observed width (widened back in-trace by `widen_traced`).
     One device_put for the whole batch, like arrow_to_device."""
     table = table.combine_chunks()
     n = table.num_rows
-    cap = capacity or max(_UPLOAD_ALIGN,
-                          -(-max(n, 1) // _UPLOAD_ALIGN) * _UPLOAD_ALIGN)
+    cap = capacity or (
+        bucket_capacity(n) if bucket else
+        max(_UPLOAD_ALIGN,
+            -(-max(n, 1) // _UPLOAD_ALIGN) * _UPLOAD_ALIGN))
     schema = schema_from_arrow(table.schema)
     cols: List[DeviceColumn] = []
     for i, field in enumerate(schema.fields):
@@ -246,6 +263,12 @@ class FusedSingleChipExecutor:
         self._ansi = c(rc.ANSI_ENABLED)
         self._agg_pushdown = c(rc.FUSED_AGG_PUSHDOWN)
         self._lookup_conf = c(rc.FUSED_LOOKUP_JOIN)
+        self._shape_buckets = c(rc.FUSED_SHAPE_BUCKETS)
+        #: compile accounting of the most recent execute()/
+        #: execute_repeated(): variantCount / programsCompiled /
+        #: cacheHits (api/dataframe.py folds it into
+        #: session.last_execution["compile"])
+        self.last_compile_metrics = None
 
     # --- source preparation (once; survives expansion retries) ---
 
@@ -307,7 +330,8 @@ class FusedSingleChipExecutor:
                     rest.append(path)
             if rest or scan.fmt != "parquet":
                 files = rest if scan.fmt == "parquet" else task
-                out.extend(upload_narrowed(t)
+                out.extend(upload_narrowed(t,
+                                           bucket=self._shape_buckets)
                            for t in scan._host_tables(files))
             return out
 
@@ -341,7 +365,7 @@ class FusedSingleChipExecutor:
                 table = s.collect()
                 if table.nbytes * 4 > self._hbm_budget():
                     raise FusedCompileError("source exceeds HBM budget")
-                ps = [upload_narrowed(table)]
+                ps = [upload_narrowed(table, bucket=self._shape_buckets)]
             total += sum(b.device_size_bytes() for b in ps)
             parts[id(s)] = ps
         if total * 4 > self._hbm_budget():
@@ -379,6 +403,8 @@ class FusedSingleChipExecutor:
         ctx = new_task_context(self.conf)
         sem.get().acquire_if_necessary(ctx.task_id)
         self._rewrite_memo = {}  # keyed on node ids: valid per run
+        self._compile_metrics = {"keys": set(), "programsRequested": 0,
+                                 "cacheHits": 0}
         try:
             self._prepare(phys, root_may_be_source=root_may_be_source)
             return body()
@@ -387,6 +413,12 @@ class FusedSingleChipExecutor:
             self._src_parts = None
             self._sources = None
             self._rewrite_memo = {}
+            m = self._compile_metrics
+            self.last_compile_metrics = {
+                "variantCount": len(m["keys"]),
+                "programsCompiled": m["programsRequested"],
+                "cacheHits": m["cacheHits"],
+            }
 
     def _run_with_retry(self, phys: PhysicalPlan, as_parts: bool):
         """One settled run under the retry loop; returns
@@ -546,9 +578,36 @@ class FusedSingleChipExecutor:
                       for leaf in jax.tree_util.tree_leaves(b))
                 for b in batches)
 
-        def run_program(key_tag, nodes_key, fn, inputs):
-            key = ("fused", key_tag, nodes_key, expansion, group_cap,
-                   ansi_on, use_lookup, push_on, shapes_key(inputs))
+        def run_program(key_tag, nodes_key, fn, inputs,
+                        uses_expansion=False, uses_group_cap=False,
+                        uses_ansi=False):
+            # VARIANT DEDUP: the key carries ONLY the parameters the
+            # traced program consumes. The old key stamped every
+            # program with (expansion, group_cap, ansi_on, use_lookup,
+            # push_on), so an expansion retry, a lookup/pushdown
+            # re-lowering, or the ANSI channel recompiled the WHOLE
+            # pipeline; canonically a sort program is identical at any
+            # expansion factor, and the lowering choices are already
+            # structural (they change nodes_key). Round 5 measured the
+            # multiplied variants at 482 s of cold start.
+            key = ("fused", key_tag, nodes_key,
+                   expansion if uses_expansion else None,
+                   group_cap if uses_group_cap else None,
+                   bool(uses_ansi), shapes_key(inputs))
+            from spark_rapids_tpu.runtime import compile_cache as cc
+            from spark_rapids_tpu.runtime import jit_cache as jc
+
+            m = self._compile_metrics
+            if key not in m["keys"]:
+                m["keys"].add(key)
+                if jc.probe(key):
+                    m["cacheHits"] += 1
+                    cc.stats.on_hit()
+                    # keep the disk index's usage ranking honest:
+                    # cross-query reuse counts toward warmup's top-K
+                    cc.record_use(key + jc._env_token(), "fused")
+                else:
+                    m["programsRequested"] += 1
             jitted = cached_jit(key, lambda: fn)
             out, fl, *rest = jitted(*inputs)
             # fl: scalar=[cap] | (3,)=[cap, uniq, push] (chain programs)
@@ -573,11 +632,15 @@ class FusedSingleChipExecutor:
                 return None
             return ansicheck.flags_vec(list(exprs), b, live)
 
-        def chain_traced(nodes, batch, builds=()):
+        def chain_traced(nodes, batch, builds=(), ansi_live=False):
             """Apply a bottom-up list of per-partition operators inside
             one trace; returns (batch, overflow). `builds` holds the
             already-materialized build batch for each lookup join in
-            `nodes`, in chain (bottom-up) order.
+            `nodes`, in chain (bottom-up) order. `ansi_live` is hoisted
+            by the caller (chain_has_ansi): a chain none of whose
+            expressions can raise traces to the SAME program with ANSI
+            on or off, and keying on the hoisted fact instead of the
+            session flag lets the two share the compiled executable.
 
             Filters are carried as a PENDING MASK rather than a physical
             compaction: an aggregation consumes the mask directly (its
@@ -695,7 +758,7 @@ class FusedSingleChipExecutor:
                         ovf = ovf | o
             out = materialized(b, mask)
             fl = jnp.stack([ovf, uniq, push])
-            if ansi_on:
+            if ansi_live:
                 return out, fl, ansi
             return out, fl
 
@@ -747,6 +810,27 @@ class FusedSingleChipExecutor:
                     agg_pushdown.rewrite_chain(nodes)
             return self._rewrite_memo[key]
 
+        def chain_has_ansi(nodes) -> bool:
+            """Hoisted ANSI relevance for one chain: True only when the
+            session flag is on AND some chained expression can actually
+            raise — the dedup axis run_program keys on."""
+            from spark_rapids_tpu.expr import ansicheck
+
+            if not ansi_on:
+                return False
+            for nd in nodes:
+                if isinstance(nd, ops.TpuFilterExec):
+                    exprs = [nd.condition]
+                elif isinstance(nd, ops.TpuProjectExec):
+                    exprs = nd.exprs
+                elif isinstance(nd, ops.TpuHashAggregateExec):
+                    exprs = list(nd.grouping) + list(nd.aggs)
+                else:
+                    continue
+                if any(ansicheck.has_ansi_checks(e) for e in exprs):
+                    return True
+            return False
+
         def run_chain(nodes, base):
             nodes_key = tuple(
                 n.chain_key()
@@ -756,12 +840,20 @@ class FusedSingleChipExecutor:
             # the per-partition programs, and ride in as extra inputs
             builds = [build_table(n) for n in nodes
                       if isinstance(n, J.TpuBroadcastHashJoinExec)]
+            ansi_live = chain_has_ansi(nodes)
 
-            def stage_fn(b, *bs, _nodes=nodes):
-                return chain_traced(_nodes, b, bs)
+            def stage_fn(b, *bs, _nodes=nodes, _al=ansi_live):
+                return chain_traced(_nodes, b, bs, ansi_live=_al)
 
-            return [run_program("chain", nodes_key, stage_fn,
-                                [b] + builds)
+            return [run_program(
+                        "chain", nodes_key, stage_fn, [b] + builds,
+                        uses_expansion=any(
+                            isinstance(n, ops.TpuGenerateExec)
+                            for n in nodes),
+                        uses_group_cap=any(
+                            isinstance(n, ops.TpuHashAggregateExec)
+                            for n in nodes),
+                        uses_ansi=ansi_live)
                     for b in base]
 
         def build_table(jn: PhysicalPlan):
@@ -801,7 +893,8 @@ class FusedSingleChipExecutor:
 
                         return run_program("aggmf",
                                            _plan_key(node)[:2],
-                                           mf_fn, parts)
+                                           mf_fn, parts,
+                                           uses_group_cap=True)
                 parts = emit_parts(node.children[0])
 
                 def agg_fn(*ps):
@@ -820,8 +913,14 @@ class FusedSingleChipExecutor:
                         return out, ovf, av
                     return out, ovf
 
+                from spark_rapids_tpu.expr import ansicheck
+
+                agg_ansi = (ansi_on and mode == "complete" and any(
+                    ansicheck.has_ansi_checks(e)
+                    for e in list(node.grouping) + list(node.aggs)))
                 return run_program("agg", _plan_key(node)[:2], agg_fn,
-                                   parts)
+                                   parts, uses_group_cap=True,
+                                   uses_ansi=agg_ansi)
             if isinstance(node, ops.TpuSortExec):
                 child = node.children[0]
                 if isinstance(child, ops.TpuShuffleExchangeExec):
@@ -874,7 +973,8 @@ class FusedSingleChipExecutor:
                     return shard_equi_join(node, lb, rb, out_cap)
 
                 return run_program("join", _plan_key(node)[:2], join_fn,
-                                   lparts + rparts)
+                                   lparts + rparts,
+                                   uses_expansion=True)
             raise FusedCompileError(type(node).__name__)
 
         def all_flags_arr():

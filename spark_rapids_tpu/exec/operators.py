@@ -1972,15 +1972,26 @@ class CpuSortExec(PhysicalPlan):
         if not tables:
             return
         table = pa.concat_tables(tables, promote_options="none")
-        names = self.children[0].schema.names
-        sort_keys = []
-        for o in self.orders:
+        # arrow's null_placement is GLOBAL, but Spark's nulls_first is
+        # per-key: sort each key as (is_null indicator, value) pairs —
+        # the indicator groups a key's nulls where its order wants
+        # them, making the global placement irrelevant
+        view_cols, view_names, sort_keys = [], [], []
+        for i, o in enumerate(self.orders):
             assert isinstance(o.expr, BoundReference)
+            col = table.column(o.expr.ordinal)
+            view_cols.append(pc.is_null(col))
+            view_names.append(f"__n{i}")
             sort_keys.append((
-                names[o.expr.ordinal],
-                "ascending" if o.ascending else "descending",
-                "at_start" if o.nulls_first else "at_end"))
-        idx = pc.sort_indices(table, sort_keys=sort_keys)
+                f"__n{i}",
+                "descending" if o.nulls_first else "ascending"))
+            view_cols.append(col)
+            view_names.append(f"__v{i}")
+            sort_keys.append((
+                f"__v{i}",
+                "ascending" if o.ascending else "descending"))
+        view = pa.table(dict(zip(view_names, view_cols)))
+        idx = pc.sort_indices(view, sort_keys=sort_keys)
         yield table.take(idx)
 
 

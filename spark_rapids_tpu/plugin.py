@@ -84,11 +84,15 @@ class TpuExecutorPlugin:
 
     def init(self, conf: rc.RapidsConf):
         from spark_rapids_tpu.io import filecache
-        from spark_rapids_tpu.runtime import memory, semaphore
+        from spark_rapids_tpu.runtime import compile_cache, memory, \
+            semaphore
         from spark_rapids_tpu.shuffle.manager import configure_shuffle
 
         self._validate_device()
         filecache.configure(conf)  # FileCache.init (Plugin.scala:545)
+        # persistent compilation layer BEFORE any program compiles, so
+        # the whole session (incl. warmup) rides the disk cache
+        compile_cache.configure(conf)
         memory.initialize_memory(conf, force=True)
         semaphore.initialize(conf.get(rc.CONCURRENT_TPU_TASKS))
         configure_shuffle(
@@ -124,8 +128,9 @@ class TpuExecutorPlugin:
         return fatal
 
     def shutdown(self):
-        from spark_rapids_tpu.runtime import memory
+        from spark_rapids_tpu.runtime import compile_cache, memory
 
+        compile_cache.flush()  # drain pending index/artifact writes
         memory.shutdown_memory()
 
 

@@ -303,11 +303,19 @@ class _Parser:
                     "non-ASCII \\u escape (byte-oriented matcher)")
             return _mask_of((v, v))
         if c == "c":
+            # Java control-char escape: ANY next char is accepted and
+            # XORed raw (Pattern.java `read() ^ 64`) — no uppercasing,
+            # so `\cj` is 0x6A^0x40 = 0x2A ('*'), not Ctrl-J
             ch = self.peek()
-            if ch is None or not ch.isalpha():
+            if ch is None:
                 self.error("bad \\c escape")
             self.take()
-            v = ord(ch.upper()) ^ 0x40  # Java control-char escape
+            v = ord(ch) ^ 0x40
+            if v > 127:
+                # same stance as non-ASCII \u: the matcher is
+                # byte-oriented, a >7-bit code point is not one byte
+                raise RegexUnsupported(
+                    "non-ASCII \\c escape (byte-oriented matcher)")
             return _mask_of((v, v))
         if c.isdigit():
             raise RegexUnsupported(f"backreference \\{c} in {self.p!r}")

@@ -34,6 +34,25 @@ _CODECS = {"none": 0, "zstd": 1, "zlib": 2}
 _CODEC_NAMES = {v: k for k, v in _CODECS.items()}
 
 
+def zstd_available() -> bool:
+    """The zstandard wheel is optional at runtime; environments without
+    it degrade the DEFAULT codec to stdlib zlib rather than failing
+    every shuffle (the stream header records whatever was actually
+    used, so readers never guess)."""
+    try:
+        import zstandard  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def resolve_codec(codec: str) -> str:
+    if codec == "zstd" and not zstd_available():
+        return "zlib"
+    return codec
+
+
 def _compress(raw: bytes, codec: str) -> bytes:
     if codec == "zstd":
         import zstandard
@@ -100,6 +119,7 @@ def serialize_table(table: pa.Table, codec: str = "none") -> np.ndarray:
                        "cols": col_specs}).encode()
     meta_buf = np.frombuffer(meta, dtype=np.uint8)
     packed = native.pack_buffers([schema_buf, meta_buf] + bufs)
+    codec = resolve_codec(codec)
     if codec == "none":
         return packed
     raw = packed.tobytes()

@@ -53,12 +53,15 @@ def _sort_table(t: pa.Table) -> pa.Table:
     # sortable in arrow: key on the sortable subset only.
     uniq = [f"c{i}" for i in range(t.num_columns)]
     view = t.rename_columns(uniq)
-    keys = [(n, "ascending", "at_start") for n, f in zip(uniq, t.schema)
+    # (name, order) pairs; null placement is a SortOptions-level knob
+    # in arrow, not a per-key one
+    keys = [(n, "ascending") for n, f in zip(uniq, t.schema)
             if not pa.types.is_nested(f.type)]
     if not keys:
         return t
     try:
-        return t.take(pc.sort_indices(view, sort_keys=keys))
+        return t.take(pc.sort_indices(view, sort_keys=keys,
+                                      null_placement="at_start"))
     except (pa.ArrowNotImplementedError, pa.ArrowTypeError):
         return t
 

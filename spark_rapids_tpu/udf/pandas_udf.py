@@ -267,8 +267,9 @@ def _group_slices(table: pa.Table, key_names):
 
     if table.num_rows == 0:
         return
-    sort_keys = [(k, "ascending", "at_end") for k in key_names]
-    idx = pc.sort_indices(table, sort_keys=sort_keys)
+    sort_keys = [(k, "ascending") for k in key_names]
+    idx = pc.sort_indices(table, sort_keys=sort_keys,
+                          null_placement="at_end")
     s = table.take(idx)
     import numpy as np
 

@@ -9,11 +9,22 @@ jax.config after import, before any backend initializes.
 """
 
 import os
+import tempfile
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Isolate the persistent compile cache per test run: the layer stays
+# ENABLED (cross-module recompiles load from disk after the per-module
+# jit_cache clear below), but state never leaks between runs — tests
+# asserting compile counts must not see a previous run's artifacts.
+# Explicit per-test dirs (test_compile_cache.py) still win: env-derived
+# conf values are defaults, not overrides.
+os.environ.setdefault(
+    "SPARK_RAPIDS_TPU_CONF_spark__rapids__tpu__compileCache__dir",
+    tempfile.mkdtemp(prefix="srtpu_test_compile_cache_"))
 
 import jax  # noqa: E402
 

@@ -1,0 +1,340 @@
+"""Persistent cross-process compilation layer
+(runtime/compile_cache.py) + fused variant dedup (exec/fused.py
+run_program canonical keys): the round-5 cold-start killer.
+
+Covers the acceptance surface: cross-process executable reuse, warmup
+serving, version-skew invalidation, digest-collision safety, concurrent
+writers, per-query compile metrics, and the canonical-key dedup that
+stops expansion retries / re-lowerings / the ANSI channel from
+recompiling the whole pipeline."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSparkSession
+from spark_rapids_tpu.runtime import compile_cache as cc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def cache_session(tmp_path):
+    """Session bound to an isolated cache dir; deconfigures after.
+    The process jit cache is cleared so earlier tests' structurally
+    identical programs don't turn this test's builds into hits."""
+    from spark_rapids_tpu.runtime import jit_cache
+
+    jit_cache.clear()
+    cc.reset_for_tests()
+    s = TpuSparkSession({
+        "spark.rapids.tpu.compileCache.dir": str(tmp_path / "cache"),
+        "spark.rapids.tpu.compileCache.warmup.enabled": False,
+    })
+    yield s
+    s.stop()
+    cc.reset_for_tests()
+
+
+def _mini_q5(spark):
+    """The bench shape in miniature: scan -> filter -> broadcast
+    lookup join -> string-key aggregate."""
+    fact = spark.createDataFrame(pa.table({
+        "store": pa.array(np.arange(4000) % 50, type=pa.int64()),
+        "amount": pa.array(np.arange(4000, dtype=np.float64)),
+    }))
+    dim = spark.createDataFrame(pa.table({
+        "store": pa.array(np.arange(50), type=pa.int64()),
+        "region": pa.array([f"r{i % 4}" for i in range(50)]),
+    }))
+    return (fact.filter(F.col("amount") > 10.0)
+            .join(dim, on="store", how="inner")
+            .groupBy("region")
+            .agg(F.sum("amount").alias("s"),
+                 F.count("*").alias("n")))
+
+
+# ------------------------------------------------- per-query metrics
+
+def test_compile_metrics_in_last_execution(cache_session):
+    s = cache_session
+    q = _mini_q5(s)
+    out = q.collect_arrow()
+    assert out.num_rows == 4
+    comp = s.last_execution["compile"]
+    assert s.last_execution["engine"] == "fused"
+    assert comp["programsCompiled"] > 0
+    assert comp["cacheHits"] == 0
+    assert comp["variantCount"] == comp["programsCompiled"]
+    assert comp["compileSeconds"] > 0
+    # second run: everything structural-hits, nothing compiles
+    q.collect_arrow()
+    comp2 = s.last_execution["compile"]
+    assert comp2["programsCompiled"] == 0
+    assert comp2["cacheHits"] == comp["variantCount"]
+    assert comp2["variantCount"] == comp["variantCount"]
+    # ledger counters surfaced in session metrics
+    snap = s.query_metrics.snapshot()
+    assert snap["compile.programsCompiled"] == comp["programsCompiled"]
+    assert snap["compile.cacheHits"] >= comp2["cacheHits"]
+
+
+# ---------------------------------------------------- variant dedup
+
+def test_expansion_change_recompiles_nothing_without_consumers(
+        cache_session):
+    """The dedup acceptance: canonical keys carry only consumed
+    parameters, so re-running the bench-shaped query at a DIFFERENT
+    expansion factor (the retry sweep's axis) recompiles zero programs
+    — no program in this plan consumes the expansion factor. The old
+    keys stamped every program with it: the sweep recompiled the
+    whole pipeline."""
+    from spark_rapids_tpu.exec.fused import FusedSingleChipExecutor
+
+    s = cache_session
+    q = _mini_q5(s)
+    phys, _ = q._physical()
+
+    ex1 = FusedSingleChipExecutor(s.rapids_conf, expansion=4)
+    ex1.execute(phys)
+    m1 = ex1.last_compile_metrics
+    assert m1["programsCompiled"] > 0
+
+    ex2 = FusedSingleChipExecutor(s.rapids_conf, expansion=8)
+    ex2.execute(phys)
+    m2 = ex2.last_compile_metrics
+    assert m2["programsCompiled"] == 0, m2
+    assert m2["cacheHits"] == m1["variantCount"]
+
+    # group_cap IS consumed (aggregate shrink): only the agg-bearing
+    # programs recompile, strictly fewer than the whole pipeline
+    ex3 = FusedSingleChipExecutor(s.rapids_conf, expansion=4,
+                                  group_cap=1 << 15)
+    ex3.execute(phys)
+    m3 = ex3.last_compile_metrics
+    assert 0 < m3["programsCompiled"] < m1["variantCount"], m3
+
+
+def test_ansi_flag_without_checks_shares_programs(tmp_path):
+    """ANSI dedup: with no checkable expression in the plan, ANSI on
+    traces byte-identically to ANSI off — the hoisted ansi_live key
+    component lets both share compiled programs (the old key split
+    them)."""
+    from spark_rapids_tpu.runtime import jit_cache
+
+    jit_cache.clear()
+    cc.reset_for_tests()
+    cache = str(tmp_path / "cache")
+    base_conf = {
+        "spark.rapids.tpu.compileCache.dir": cache,
+        "spark.rapids.tpu.compileCache.warmup.enabled": False,
+    }
+    t = pa.table({"k": pa.array(np.arange(512) % 7, type=pa.int64()),
+                  "v": pa.array(np.arange(512, dtype=np.float64))})
+
+    def q(spark):
+        # comparison + sum: nothing here raises under ANSI
+        return (spark.createDataFrame(t)
+                .filter(F.col("v") > 3.0)
+                .groupBy("k").agg(F.min("v").alias("m"))
+                .collect_arrow())
+
+    s1 = TpuSparkSession(base_conf)
+    try:
+        q(s1)
+        n1 = s1.last_execution["compile"]["programsCompiled"]
+        assert n1 > 0
+    finally:
+        s1.stop()
+    s2 = TpuSparkSession({**base_conf, "spark.sql.ansi.enabled": True})
+    try:
+        q(s2)
+        comp = s2.last_execution["compile"]
+        assert comp["programsCompiled"] == 0, comp
+        assert comp["cacheHits"] == comp["variantCount"]
+    finally:
+        s2.stop()
+        cc.reset_for_tests()
+
+
+def test_shape_bucketing_shares_programs_across_similar_sizes():
+    from spark_rapids_tpu.exec.fused import bucket_capacity
+
+    # below the alignment floor: identical to the old 64Ki alignment
+    assert bucket_capacity(1) == 1 << 16
+    assert bucket_capacity((1 << 16) + 1) == 1 << 17
+    # large caps land on 1/8-octave steps: similar sizes -> same bucket
+    a, b = bucket_capacity(4_500_000), bucket_capacity(4_600_000)
+    assert a == b
+    # padding bounded by 12.5% + one step
+    for n in (4_500_000, 9_000_001, 36_000_000):
+        cap = bucket_capacity(n)
+        assert n <= cap <= int(n * 1.126) + (1 << 16), (n, cap)
+
+
+# ------------------------------------------- cross-process + warmup
+
+_PROC_SCRIPT = textwrap.dedent("""
+    import json, sys, time
+    import jax; jax.config.update("jax_platforms", "cpu")
+    import numpy as np, pyarrow as pa
+    from spark_rapids_tpu.api.session import TpuSparkSession
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.runtime import compile_cache as cc
+
+    cache_dir, warm = sys.argv[1], sys.argv[2] == "warm"
+    s = TpuSparkSession({
+        "spark.rapids.tpu.compileCache.dir": cache_dir,
+        "spark.rapids.tpu.compileCache.warmup.enabled": warm,
+        # tiny test programs must still export warmup artifacts
+        "spark.rapids.tpu.compileCache.artifact.minCompileSecs": 0.0,
+    })
+    if warm:
+        cc.warmup_join(120)
+    t = pa.table({"k": pa.array(np.arange(2000) % 11,
+                                type=pa.int64()),
+                  "v": pa.array(np.arange(2000, dtype=np.float64))})
+    out = (s.createDataFrame(t).filter(F.col("v") > 5.0)
+           .groupBy("k").agg(F.sum("v").alias("s"))
+           .collect_arrow())
+    total = sum(out.column("s").to_pylist())
+    cc.flush()
+    print(json.dumps({"engine": s.last_execution["engine"],
+                      "compile": s.last_execution["compile"],
+                      "total": total}))
+    s.stop()
+""")
+
+
+def _run_proc(cache_dir: str, mode: str) -> dict:
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    r = subprocess.run(
+        [sys.executable, "-c", _PROC_SCRIPT, cache_dir, mode],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
+    return json.loads(line)
+
+
+@pytest.mark.slow
+def test_cross_process_warm_start(tmp_path):
+    """The tentpole end-to-end: process 1 compiles cold and persists;
+    process 2 (fresh interpreter, warmup on) serves every fused
+    program from artifacts — zero XLA compile seconds — and produces
+    identical results."""
+    cache = str(tmp_path / "xproc")
+    cold = _run_proc(cache, "cold")
+    assert cold["engine"] == "fused"
+    assert cold["compile"]["programsCompiled"] > 0
+    assert cold["compile"]["warmHits"] == 0
+
+    warm = _run_proc(cache, "warm")
+    assert warm["engine"] == "fused"
+    assert warm["total"] == cold["total"]  # warm executables correct
+    assert warm["compile"]["programsCompiled"] == 0, warm
+    assert warm["compile"]["warmHits"] == \
+        cold["compile"]["programsCompiled"]
+    assert warm["compile"]["compileSeconds"] == 0.0
+
+
+@pytest.mark.slow
+def test_version_skew_invalidates_artifacts(tmp_path):
+    """Stale-artifact invalidation: a VERSION stamp mismatch (jax or
+    plugin upgrade) wipes index + artifacts + XLA entries before any
+    program loads."""
+    cache = str(tmp_path / "skew")
+    _run_proc(cache, "cold")
+    assert os.listdir(os.path.join(cache, "index"))
+    # simulate a plugin upgrade
+    stamp = os.path.join(cache, "VERSION.json")
+    tok = json.load(open(stamp))
+    tok["plugin"] = tok["plugin"] + ".post-upgrade"
+    with open(stamp, "w") as f:
+        json.dump(tok, f)
+    again = _run_proc(cache, "warm")
+    # nothing served stale: the run recompiled from scratch
+    assert again["compile"]["warmHits"] == 0
+    assert again["compile"]["programsCompiled"] > 0
+
+
+# ------------------------------------------------- index unit layer
+
+def test_collision_mismatch_ignores_artifact(tmp_path):
+    cc.reset_for_tests()
+    s = TpuSparkSession({
+        "spark.rapids.tpu.compileCache.dir": str(tmp_path / "c"),
+        "spark.rapids.tpu.compileCache.warmup.enabled": False,
+    })
+    try:
+        adir = os.path.join(cc.cache_dir(), "artifacts")
+        # a digest whose .key sidecar names a DIFFERENT structural key
+        with open(os.path.join(adir, "deadbeef.key"), "wb") as f:
+            f.write(b"('some', 'other', 'key')")
+        with open(os.path.join(adir, "deadbeef.bin"), "wb") as f:
+            f.write(b"garbage")
+        assert cc._load_artifact("deadbeef", "('the', 'real', 'key')") \
+            is None
+    finally:
+        s.stop()
+        cc.reset_for_tests()
+
+
+def test_concurrent_index_writers_never_tear(tmp_path):
+    cc.reset_for_tests()
+    s = TpuSparkSession({
+        "spark.rapids.tpu.compileCache.dir": str(tmp_path / "c"),
+        "spark.rapids.tpu.compileCache.warmup.enabled": False,
+    })
+    try:
+        digest = cc.key_digest(("t", "concurrent"))
+        errs = []
+
+        def hammer(i):
+            try:
+                for _ in range(30):
+                    cc._record_index(digest, repr(("t", "concurrent")),
+                                     "fused", 0.01, False)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=hammer, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        # the entry parses (atomic-rename discipline: no torn JSON);
+        # counts are best-effort last-writer-wins, only >= 1 guaranteed
+        idx = cc.read_index()
+        assert idx[digest]["tag"] == "fused"
+        assert idx[digest]["count"] >= 1
+    finally:
+        s.stop()
+        cc.reset_for_tests()
+
+
+def test_disabled_conf_writes_nothing(tmp_path):
+    cc.reset_for_tests()
+    s = TpuSparkSession({
+        "spark.rapids.tpu.compileCache.enabled": False,
+        "spark.rapids.tpu.compileCache.dir": str(tmp_path / "off"),
+    })
+    try:
+        t = pa.table({"v": pa.array(np.arange(64, dtype=np.float64))})
+        s.createDataFrame(t).filter(F.col("v") > 1.0).collect_arrow()
+        assert not cc.enabled()
+        assert not os.path.exists(str(tmp_path / "off"))
+    finally:
+        s.stop()
+        cc.reset_for_tests()

@@ -33,7 +33,9 @@ def _mesh():
 
 
 def test_all_to_all_routes_rows_to_keyed_device():
-    from jax import shard_map
+    from spark_rapids_tpu.shims import get_shim
+
+    shard_map = get_shim().shard_map
 
     mesh = _mesh()
     cap = 1024
@@ -60,8 +62,7 @@ def test_all_to_all_routes_rows_to_keyed_device():
                        jax.ShapeDtypeStruct((1,), jnp.int32))
     out_specs = mesh_exec.batch_specs(stub, P(mesh_exec.AXIS))
     in_specs = mesh_exec.input_batch_specs(batch, P(mesh_exec.AXIS))
-    fn = shard_map(step, mesh=mesh, in_specs=(in_specs,),
-                   out_specs=out_specs, check_vma=False)
+    fn = shard_map(step, mesh, (in_specs,), out_specs)
     out = jax.jit(fn)(sharded)
     table = device_to_arrow(mesh_exec.gather_result(out, N))
     ks = table.column("k").to_pylist()

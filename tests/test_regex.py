@@ -218,6 +218,50 @@ class TestDialectBreadth:
                 want = re.search(oracle or pat, s) is not None
                 assert got == want, (pat, s, got, want)
 
+    def test_control_escape_lowercase_java_semantics(self):
+        """Java's \\cX is `read() ^ 64` on the RAW character — no
+        uppercasing. \\cj is 0x6A ^ 0x40 = 0x2A ('*'), NOT Ctrl-J
+        (0x0A): uppercasing first would alias \\cj to \\cJ and match
+        newlines. Checked against java.util.regex behavior."""
+        from spark_rapids_tpu.regex.transpiler import compile_search
+
+        c = compile_search("\\cj")
+        assert c.match_host(b"*")          # 0x2A, the Java match
+        assert not c.match_host(b"\n")     # Ctrl-J would be the bug
+        assert not c.match_host(b"j")
+        # uppercase stays a control char: \cJ -> 0x4A ^ 0x40 = 0x0A
+        cj = compile_search("\\cJ")
+        assert cj.match_host(b"\n")
+        assert not cj.match_host(b"*")
+
+    def test_control_escape_accepts_any_char(self):
+        """Java accepts ANY character after \\c (e.g. \\c1 -> 0x71
+        'q'); rejecting non-alpha crashed Spark-valid patterns."""
+        from spark_rapids_tpu.regex.transpiler import compile_search
+
+        c = compile_search("\\c1")  # 0x31 ^ 0x40 = 0x71
+        assert c.match_host(b"q")
+        assert not c.match_host(b"1")
+
+    def test_python_invalid_pattern_clean_error_on_cpu_eval(self):
+        """A Java-valid pattern Python re rejects must produce a clean
+        unsupported-pattern error from the CPU evaluator (regexp_
+        extract has no DFA path), not a raw re.error traceback."""
+        import pyarrow as pa
+
+        from spark_rapids_tpu.regex.transpiler import RegexUnsupported
+        from spark_rapids_tpu.testing.asserts import with_tpu_session
+
+        def q(spark):
+            t = pa.table({"s": pa.array(["q1", "x"])})
+            return (spark.createDataFrame(t)
+                    .select(F.regexp_extract("s", "(\\c1)\\d", 1)
+                            .alias("e"))
+                    .collect_arrow())
+
+        with pytest.raises(RegexUnsupported, match="Python re"):
+            with_tpu_session(q)
+
     def test_complexity_estimator_gates_before_build(self):
         from spark_rapids_tpu.regex.transpiler import (
             RegexUnsupported,
