@@ -453,6 +453,11 @@ def main():
         "device_kind": str(kind),
         "link_roundtrip_ms": round(rt_ms, 1),
         "link_h2d_gbps": round(h2d, 2),
+        # failure-domain counters (PR 2): with chaos disabled these
+        # should be ~zero and wall-clock within 2% of the pre-PR
+        # numbers — BENCH_* history tracks robustness overhead; under
+        # ci/chaos_check.sh they show the recovery machinery working
+        "robustness": spark.robustness_metrics,
     }))
 
 

@@ -12,6 +12,9 @@ export JAX_PLATFORMS=cpu
 echo "== unit + differential suite (virtual 8-device mesh) =="
 python -m pytest tests/ -q
 
+echo "== chaos gate (seeded fault injection at every site) =="
+ci/chaos_check.sh
+
 echo "== multichip dryrun (virtual mesh) =="
 SPARK_RAPIDS_TPU_DRYRUN_REEXEC=1 python - <<'PY'
 import jax

@@ -615,7 +615,8 @@ class DataFrame:
         # via explain() and session.query_metrics — a fused/mesh compile
         # error must never silently land a query on the dispatch-bound
         # eager path.
-        rec = {"engine": None, "fallbacks": [], "compile": None}
+        rec = {"engine": None, "fallbacks": [], "compile": None,
+               "degradations": []}
         self._last_exec = rec
         self.session.last_execution = rec
 
@@ -671,13 +672,34 @@ class DataFrame:
             qm.metric("compile.warmHits").add(comp["warmHits"])
             qm.metric("compile.timeMs").add(
                 int(comp["compileSeconds"] * 1000))
+            qm.metric("compile.artifactsQuarantined").add(
+                comp.get("artifactsQuarantined", 0))
 
     def _dispatch_engines(self, phys, ran, fell_back, rec) -> pa.Table:
+        """Engine dispatch with the DEGRADATION LADDER (PR 2):
+        mesh/fused compile errors fall back as before (a missing
+        lowering is structural), but execution FAILURES — terminal
+        OOMs, injected device.dispatch faults — demote down the ladder
+        fused -> eager -> CPU, each demotion recorded in
+        rec["degradations"] and the degrade.* metrics. A per-program-key
+        circuit breaker (runtime/degrade.py) stops re-trying the fused
+        engine on a plan that keeps dying there."""
         from spark_rapids_tpu.config import rapids_conf as rc
+        from spark_rapids_tpu.runtime import degrade, faults
+        from spark_rapids_tpu.runtime.errors import TpuOOMError
 
-        mesh_n = self.session.rapids_conf.get(rc.MESH_SIZE)
-        if not mesh_n and self.session.rapids_conf.get(
-                rc.SHUFFLE_MODE) == "ICI":
+        conf = self.session.rapids_conf
+        ladder_on = conf.get(rc.DEGRADE_ENABLED)
+        qm = self.session.query_metrics
+
+        def demoted(frm: str, to: str, reason: str) -> None:
+            rec["degradations"].append(
+                {"from": frm, "to": to, "reason": reason})
+            degrade.record_demotion(f"{frm}To{to.capitalize()}")
+            qm.metric(f"degrade.{frm}To{to.capitalize()}").add(1)
+
+        mesh_n = conf.get(rc.MESH_SIZE)
+        if not mesh_n and conf.get(rc.SHUFFLE_MODE) == "ICI":
             # ICI shuffle == the SPMD mesh engine over every local chip
             import jax
 
@@ -690,40 +712,82 @@ class DataFrame:
 
             try:
                 return ran("mesh", MeshQueryExecutor.for_devices(
-                    mesh_n, self.session.rapids_conf).execute(phys))
+                    mesh_n, conf).execute(phys))
             except MeshCompileError as e:
                 # operator without a mesh lowering: thread-pool path
                 fell_back("mesh", str(e))
-        if self.session.rapids_conf.get(rc.FUSED_EXEC):
+        if conf.get(rc.FUSED_EXEC):
             from spark_rapids_tpu.exec.fused import (
                 FusedCompileError,
                 FusedSingleChipExecutor,
             )
 
-            ex = FusedSingleChipExecutor(self.session.rapids_conf)
-            try:
-                out = ex.execute(phys)
-                if ex.last_compile_metrics is not None:
-                    rec["_fused_variants"] = \
-                        ex.last_compile_metrics["variantCount"]
-                return ran("fused", out)
-            except FusedCompileError as e:
-                # no fused lowering / too big: per-operator engine
-                fell_back("fused", str(e))
-        if self.session.rapids_conf.get(rc.ADAPTIVE_ENABLED):
-            from spark_rapids_tpu.exec.operators import (
-                TpuShuffleExchangeExec,
-            )
-            from spark_rapids_tpu.plan.aqe import AdaptiveQueryExecutor
+            fkey = degrade.plan_fingerprint(phys)
+            breaker = degrade.breaker()
+            if conf.get(rc.OOM_INJECTION_MODE) != "none":
+                # the forced-OOM harness targets eager allocation
+                # points; fused inputs route through the eager path
+                # (satellite of the fused.py:453 crash replacement)
+                degrade.record_demotion("fusedOomInjectionFallback")
+                qm.metric("degrade.fusedOomInjectionFallback").add(1)
+                demoted("fused", "eager",
+                        "OOM injection targets the eager engine's "
+                        "allocation points")
+            elif ladder_on and not breaker.allow(fkey):
+                degrade.record_demotion("breakerShortCircuit")
+                qm.metric("degrade.breakerShortCircuit").add(1)
+                demoted("fused", "eager",
+                        f"circuit breaker open after "
+                        f"{breaker.threshold} consecutive fused "
+                        f"failures for this program key")
+            else:
+                ex = FusedSingleChipExecutor(conf)
+                try:
+                    out = ex.execute(phys)
+                    if ex.last_compile_metrics is not None:
+                        rec["_fused_variants"] = \
+                            ex.last_compile_metrics["variantCount"]
+                    breaker.record_success(fkey)
+                    return ran("fused", out)
+                except FusedCompileError as e:
+                    # no fused lowering / too big: per-operator engine
+                    # (structural, not a failure — no breaker state)
+                    fell_back("fused", str(e))
+                except (TpuOOMError, faults.InjectedFault) as e:
+                    if not ladder_on:
+                        raise
+                    n = breaker.record_failure(fkey)
+                    demoted("fused", "eager",
+                            f"{type(e).__name__}: {e} "
+                            f"(failure {n}/{breaker.threshold} for "
+                            f"this program key)")
+        try:
+            if conf.get(rc.ADAPTIVE_ENABLED):
+                from spark_rapids_tpu.exec.operators import (
+                    TpuShuffleExchangeExec,
+                )
+                from spark_rapids_tpu.plan.aqe import (
+                    AdaptiveQueryExecutor,
+                )
 
-            def has_exchange(n):
-                return isinstance(n, TpuShuffleExchangeExec) or any(
-                    has_exchange(c) for c in n.children)
+                def has_exchange(n):
+                    return isinstance(n, TpuShuffleExchangeExec) or any(
+                        has_exchange(c) for c in n.children)
 
-            if has_exchange(phys):
-                return ran("aqe", AdaptiveQueryExecutor(
-                    self.session.rapids_conf).execute(phys))
-        return ran("eager", phys.collect())
+                if has_exchange(phys):
+                    faults.maybe_inject("device.dispatch", detail="aqe")
+                    return ran("aqe", AdaptiveQueryExecutor(
+                        conf).execute(phys))
+            faults.maybe_inject("device.dispatch", detail="eager")
+            return ran("eager", phys.collect())
+        except (TpuOOMError, faults.InjectedFault) as e:
+            if not ladder_on:
+                raise
+            # last rung: the CPU engine (exec/cpu_eval.py lowering via
+            # the cpu-oracle plan) — slow beats dead
+            demoted("eager", "cpu", f"{type(e).__name__}: {e}")
+            phys_cpu, _ = self._physical(cpu_oracle=True)
+            return ran("cpu", phys_cpu.collect())
 
     def collect(self) -> List[tuple]:
         t = self.collect_arrow()
@@ -757,6 +821,9 @@ class DataFrame:
             print(rec["engine"])
             for eng, reason in rec["fallbacks"]:
                 print(f"  fell back from {eng}: {reason}")
+            for d in rec.get("degradations", []):
+                print(f"  degraded {d['from']} -> {d['to']}: "
+                      f"{d['reason']}")
 
     def write_parquet(self, path: str):
         self.session.write_parquet(self, path)

@@ -327,6 +327,31 @@ class TpuSparkSession:
 
         return stats.snapshot()
 
+    @property
+    def robustness_metrics(self):
+        """One snapshot of every failure-domain counter (PR 2): chaos
+        injections per site, backoff retries per domain, shuffle
+        fetch/checksum recoveries, degradation-ladder demotions +
+        circuit-breaker state, quarantined compile artifacts, and
+        semaphore timeouts. bench.py folds this into its JSON so
+        BENCH_* tracks robustness overhead."""
+        from spark_rapids_tpu.runtime import backoff, degrade, faults
+        from spark_rapids_tpu.runtime import semaphore as sem
+        from spark_rapids_tpu.runtime.compile_cache import stats
+        from spark_rapids_tpu.shuffle.manager import get_shuffle_manager
+
+        mgr = get_shuffle_manager()
+        return {
+            "chaos": faults.counters(),
+            "retries": backoff.counters(),
+            "shuffle": {"fetchRetries": mgr.fetch_retries,
+                        "checksumFailures": mgr.checksum_failures},
+            "degrade": degrade.counters(),
+            "artifactsQuarantined":
+                stats.snapshot()["artifactsQuarantined"],
+            "semaphoreTimeouts": sem.get().timeouts,
+        }
+
     def stop(self):
         global _active
         try:
@@ -356,7 +381,9 @@ class TpuSparkSession:
             # GpuSemaphore likewise
             from spark_rapids_tpu.runtime import semaphore as _sem
 
-            _sem.initialize(self.rapids_conf.get(rc.CONCURRENT_TPU_TASKS))
+            _sem.initialize(
+                self.rapids_conf.get(rc.CONCURRENT_TPU_TASKS),
+                self.rapids_conf.get(rc.SEMAPHORE_ACQUIRE_TIMEOUT_MS))
             # the session must deregister even when the leak check
             # raises, or active() keeps returning a dead session
             with _active_lock:

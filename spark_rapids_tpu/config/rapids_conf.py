@@ -518,6 +518,70 @@ TEST_RETRY_OOM_INJECTION_FILTER = conf(
     "spark.rapids.memory.gpu.oomInjection.filter", "",
     "Restrict OOM injection to allocation sites whose tag contains this "
     "substring.", str)
+CHAOS_ENABLED = conf(
+    "spark.rapids.tpu.chaos.enabled", False,
+    "Arm the deterministic fault-injection registry "
+    "(runtime/faults.py): injection sites across every failure domain "
+    "(io.read, shuffle.fetch, shuffle.deserialize, compile.cache_load, "
+    "spill.disk, device.dispatch) raise seeded faults that the "
+    "engine's recovery machinery — backoff retries, quarantine, the "
+    "degradation ladder — must absorb. ci/chaos_check.sh asserts "
+    "results are identical to a clean run.", bool)
+CHAOS_SEED = conf(
+    "spark.rapids.tpu.chaos.seed", 0,
+    "Seed for the per-site injection RNG streams; the same seed "
+    "replays the same fault sequence at each site.", int)
+CHAOS_SITES = conf(
+    "spark.rapids.tpu.chaos.sites", "",
+    "Per-site policies, ';'-separated: 'site:p=0.05' (probability), "
+    "'site:every=7' (every Nth call), 'site:once' (first call only), "
+    "or a bare site name for the default probability. Empty = every "
+    "known site at chaos.defaultProbability.", str)
+CHAOS_DEFAULT_P = conf(
+    "spark.rapids.tpu.chaos.defaultProbability", 0.05,
+    "Injection probability for armed sites without an explicit "
+    "policy.", float, checker=lambda v: 0.0 <= v <= 1.0)
+IO_RETRY_ATTEMPTS = conf(
+    "spark.rapids.tpu.io.retry.attempts", 4,
+    "Attempt budget for transient I/O failure domains (file reads, "
+    "shuffle block fetch/decode, disk spill) before the clean engine "
+    "error surfaces (runtime/backoff.py).", int,
+    checker=lambda v: 1 <= v <= 100)
+IO_RETRY_BACKOFF_MS = conf(
+    "spark.rapids.tpu.io.retry.backoffMs", 50,
+    "Base delay of the exponential backoff between I/O retry "
+    "attempts; each attempt doubles it, with jitter in [0.5x, 1x].",
+    int)
+IO_RETRY_MAX_BACKOFF_MS = conf(
+    "spark.rapids.tpu.io.retry.maxBackoffMs", 2000,
+    "Ceiling on a single backoff delay.", int)
+SHUFFLE_CHECKSUM_ENABLED = conf(
+    "spark.rapids.shuffle.checksum.enabled", True,
+    "Frame every serialized shuffle block with a per-block CRC "
+    "(crc32c when the wheel is present, else zlib crc32; the algorithm "
+    "rides in the frame header) verified on deserialize — torn writes "
+    "and bit rot surface as a retried ShuffleChecksumError instead of "
+    "corrupt query results.", bool)
+SEMAPHORE_ACQUIRE_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.semaphore.acquireTimeoutMs", 600_000,
+    "Task-admission semaphore acquisition timeout; on expiry the "
+    "acquire raises SemaphoreTimeout carrying held-permit diagnostics "
+    "(task ids, permit counts) instead of hanging the process. 0 "
+    "disables the timeout.", int)
+DEGRADE_ENABLED = conf(
+    "spark.rapids.tpu.degrade.enabled", True,
+    "Engine degradation ladder: a fused-engine execution failure "
+    "(terminal OOM, injected dispatch fault) demotes the query to the "
+    "eager out-of-core engine, and an eager failure demotes to the "
+    "CPU engine — each demotion recorded in "
+    "last_execution['degradations'] and the degrade.* session "
+    "metrics. false propagates the failure instead.", bool)
+DEGRADE_CB_THRESHOLD = conf(
+    "spark.rapids.tpu.degrade.circuitBreaker.threshold", 3,
+    "Consecutive fused-engine execution failures for one program key "
+    "before the circuit breaker opens and later queries with that key "
+    "skip straight to the eager engine (a success closes it).", int,
+    checker=lambda v: 1 <= v <= 1000)
 
 
 def conf_entries() -> List[ConfEntry]:

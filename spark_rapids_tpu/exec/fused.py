@@ -63,6 +63,7 @@ from spark_rapids_tpu.exec import joins as J
 from spark_rapids_tpu.exec import operators as ops
 from spark_rapids_tpu.exec.base import PhysicalPlan
 from spark_rapids_tpu.ops import filterops, joinops
+from spark_rapids_tpu.runtime import faults
 from spark_rapids_tpu.runtime.errors import TpuSplitAndRetryOOM
 from spark_rapids_tpu.sqltypes import StringType, StructType
 
@@ -453,11 +454,41 @@ class FusedSingleChipExecutor:
                 and self.conf.get(rc.OOM_INJECTION_MODE) != "none"):
             # forced-OOM fault injection targets the eager engine's
             # allocation points (runtime/retry.py, the RmmSpark-forced
-            # OOM analog) — fused programs have none to inject into
-            raise FusedCompileError("OOM injection uses the eager engine")
+            # OOM analog) — fused programs have none to inject into, so
+            # the inputs ROUTE THROUGH the eager path automatically (a
+            # metric-counted degradation, not an error) and the
+            # injection reaches real allocation sites
+            if as_parts:
+                # parts materialization (relation cache) keeps the
+                # structural fallback its caller already handles
+                raise FusedCompileError(
+                    "OOM injection routes fused inputs through the "
+                    "eager engine")
+            return self._oom_injection_eager_fallback(phys)
         return self._scaffold(
             phys, as_parts,
             lambda: self._run_with_retry(phys, as_parts)[0])
+
+    def _oom_injection_eager_fallback(self, phys: PhysicalPlan):
+        """Run the plan on the per-operator eager engine (whose
+        reservation points honor oomInjection.mode), counting the
+        demotion in the degrade ledger and the active session's
+        metrics + last_execution['degradations']."""
+        from spark_rapids_tpu.api.session import TpuSparkSession
+        from spark_rapids_tpu.runtime import degrade
+
+        reason = ("OOM injection targets the eager engine's "
+                  "allocation points")
+        degrade.record_demotion("fusedOomInjectionFallback")
+        s = TpuSparkSession.active()
+        if s is not None:
+            s.query_metrics.metric(
+                "degrade.fusedOomInjectionFallback").add(1)
+            rec = s.last_execution
+            if isinstance(rec, dict):
+                rec.setdefault("degradations", []).append(
+                    {"from": "fused", "to": "eager", "reason": reason})
+        return phys.collect()
 
     def execute_repeated(self, phys: PhysicalPlan,
                          iters: int = 8) -> float:
@@ -581,6 +612,10 @@ class FusedSingleChipExecutor:
         def run_program(key_tag, nodes_key, fn, inputs,
                         uses_expansion=False, uses_group_cap=False,
                         uses_ansi=False):
+            # chaos site device.dispatch: an injected fault here is the
+            # fused engine "dying mid-dispatch"; the dispatch ladder
+            # (api/dataframe.py) demotes the query to the eager engine
+            faults.maybe_inject("device.dispatch", detail=str(key_tag))
             # VARIANT DEDUP: the key carries ONLY the parameters the
             # traced program consumes. The old key stamped every
             # program with (expansion, group_cap, ansi_on, use_lookup,

@@ -169,8 +169,18 @@ def _read_value(r: _Reader, schema) -> Any:
 def _read_container(path: str):
     """Container framing shared by every reader: -> (schema, iterator of
     (record_count, decoded block _Reader))."""
-    with open(path, "rb") as f:
-        data = f.read()
+    from spark_rapids_tpu.runtime import backoff
+
+    def _read_bytes():
+        with open(path, "rb") as f:
+            return f.read()
+
+    # io.read failure domain: same backoff policy as the pyarrow
+    # readers (io/readers.py), same injection site
+    data = backoff.retry_io(
+        _read_bytes, what=f"avro read {path}", site="io.read",
+        retry_on=(OSError,), no_retry=(FileNotFoundError,),
+        counter="io.read")
     r = _Reader(data)
     if r.read(4) != MAGIC:
         raise AvroError(f"{path}: not an avro container file")

@@ -12,6 +12,13 @@ Host decode is pyarrow (the arrow-cpp path SURVEY.md section 7 step 4
 prescribes); decoded record batches are uploaded via arrow_to_device.
 Column pruning and simple predicate pushdown (parquet row-group stats via
 pyarrow filters) are applied at read time.
+
+Failure domain (PR 2 hardening): every file open/decode funnels
+through `_open_retry`, the shared exponential-backoff policy
+(runtime/backoff.py, conf `spark.rapids.tpu.io.retry.*`) with the
+`io.read` chaos site injected per attempt — transient storage errors
+(and injected faults) are retried before a clean RetryExhausted names
+the file; a missing file still fails immediately (not transient).
 """
 
 from __future__ import annotations
@@ -31,6 +38,18 @@ from spark_rapids_tpu.sqltypes import StructType
 
 _pool: Optional[ThreadPoolExecutor] = None
 _pool_lock = threading.Lock()
+
+
+def _open_retry(fn, what: str):
+    """Run one file open/decode under the io.read backoff policy.
+    FileNotFoundError stays immediate — schema inference and planners
+    rely on fast, clean missing-file errors."""
+    from spark_rapids_tpu.runtime import backoff
+
+    return backoff.retry_io(
+        fn, what=what, site="io.read",
+        retry_on=(OSError,), no_retry=(FileNotFoundError,),
+        counter="io.read")
 
 
 def reader_thread_pool(num_threads: int = 8) -> ThreadPoolExecutor:
@@ -75,7 +94,8 @@ def infer_parquet_schema(paths: List[str]) -> pa.Schema:
     files = expand_paths(paths, ".parquet")
     if not files:
         raise FileNotFoundError(f"no parquet files in {paths}")
-    return pq.read_schema(files[0])
+    return _open_retry(lambda: pq.read_schema(files[0]),
+                       f"parquet schema {files[0]}")
 
 
 def split_parquet_tasks(paths: List[str], coalesce_target_bytes: int
@@ -104,7 +124,8 @@ def read_parquet_task(files: List[str], columns: Optional[List[str]],
     """Decode one task's files, yielding row-capped tables (the chunked
     reader analog, GpuParquetScan.scala:2674)."""
     for f in files:
-        pf = pq.ParquetFile(f)
+        pf = _open_retry(lambda f=f: pq.ParquetFile(f),
+                         f"parquet open {f}")
         for rb in pf.iter_batches(batch_size=batch_rows, columns=columns):
             yield pa.Table.from_batches([rb])
 
@@ -178,12 +199,16 @@ def read_csv(path: str, schema: Optional[pa.Schema] = None,
     copts = pa_csv.ConvertOptions(
         column_types=dict(zip(schema.names, schema.types)) if schema
         else None)
-    return pa_csv.read_csv(path, read_options=ropts, parse_options=popts,
-                           convert_options=copts)
+    return _open_retry(
+        lambda: pa_csv.read_csv(path, read_options=ropts,
+                                parse_options=popts,
+                                convert_options=copts),
+        f"csv read {path}")
 
 
 def read_json(path: str) -> pa.Table:
-    return pa_json.read_json(path)
+    return _open_retry(lambda: pa_json.read_json(path),
+                       f"json read {path}")
 
 
 def write_parquet(table: pa.Table, path: str, **options):
@@ -193,8 +218,9 @@ def write_parquet(table: pa.Table, path: str, **options):
 def read_orc(path: str, columns: Optional[List[str]] = None) -> pa.Table:
     from pyarrow import orc as pa_orc
 
-    t = pa_orc.read_table(path, columns=columns)
-    return t
+    return _open_retry(
+        lambda: pa_orc.read_table(path, columns=columns),
+        f"orc read {path}")
 
 
 def infer_orc_schema(paths: List[str]) -> pa.Schema:
@@ -203,7 +229,8 @@ def infer_orc_schema(paths: List[str]) -> pa.Schema:
     files = expand_paths(paths, ".orc")
     if not files:
         raise FileNotFoundError(f"no orc files in {paths}")
-    return pa_orc.ORCFile(files[0]).schema
+    return _open_retry(lambda: pa_orc.ORCFile(files[0]).schema,
+                       f"orc schema {files[0]}")
 
 
 def infer_avro_schema(paths: List[str]) -> pa.Schema:
@@ -275,7 +302,8 @@ def read_parquet_task_filtered(files: List[str],
         yield from read_parquet_task(files, columns, batch_rows)
         return
     for f in files:
-        pf = pq.ParquetFile(f)
+        pf = _open_retry(lambda f=f: pq.ParquetFile(f),
+                         f"parquet open {f}")
         keep = [i for i in range(pf.num_row_groups)
                 if _row_group_may_match(pf.metadata.row_group(i), filters,
                                         pf.schema_arrow)]

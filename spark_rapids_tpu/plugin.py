@@ -84,23 +84,30 @@ class TpuExecutorPlugin:
 
     def init(self, conf: rc.RapidsConf):
         from spark_rapids_tpu.io import filecache
-        from spark_rapids_tpu.runtime import compile_cache, memory, \
-            semaphore
+        from spark_rapids_tpu.runtime import compile_cache, degrade, \
+            faults, memory, semaphore
         from spark_rapids_tpu.shuffle.manager import configure_shuffle
 
         self._validate_device()
+        # chaos registry FIRST: every later init step is itself a
+        # consumer of an injection site (compile.cache_load, io.read)
+        faults.configure(conf)
+        degrade.configure(conf)
         filecache.configure(conf)  # FileCache.init (Plugin.scala:545)
         # persistent compilation layer BEFORE any program compiles, so
         # the whole session (incl. warmup) rides the disk cache
         compile_cache.configure(conf)
         memory.initialize_memory(conf, force=True)
-        semaphore.initialize(conf.get(rc.CONCURRENT_TPU_TASKS))
+        semaphore.initialize(
+            conf.get(rc.CONCURRENT_TPU_TASKS),
+            conf.get(rc.SEMAPHORE_ACQUIRE_TIMEOUT_MS))
         configure_shuffle(
             conf.get(rc.SHUFFLE_MODE),
             shuffle_dir=conf.get(rc.SPILL_DIR) or None,
             num_threads=conf.get(rc.MULTITHREADED_READ_NUM_THREADS),
             codec=conf.get(rc.SHUFFLE_COMPRESSION_CODEC),
-            spill_threshold=conf.get(rc.SHUFFLE_SPILL_THRESHOLD))
+            spill_threshold=conf.get(rc.SHUFFLE_SPILL_THRESHOLD),
+            checksum=conf.get(rc.SHUFFLE_CHECKSUM_ENABLED))
         self._fatal_exit_code = conf.get(FATAL_ERROR_EXIT)
         self.initialized = True
 

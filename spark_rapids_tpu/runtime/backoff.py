@@ -1,0 +1,128 @@
+"""Bounded exponential backoff with jitter — the shared recovery policy
+for transient I/O failure domains (shuffle block fetch/decode, file
+reads, disk-tier spill). Distributed engines treat data-movement
+failures as normal events to be retried before anything escalates
+(Theseus, PAPERS.md); here every retryable site funnels through ONE
+policy so the attempt budget and delay curve are conf'd once
+(`spark.rapids.tpu.io.retry.*`) and counted once.
+
+`retry_io` also carries the chaos harness: when a fault-injection site
+is named, each ATTEMPT first asks the registry (runtime/faults.py) to
+inject — so the backoff loop is itself the code under test, and an
+injected fault is recovered exactly like a real one. Injected faults
+raised by a DIFFERENT site deeper in `fn` propagate untouched: each
+site's consumer must survive its own faults, not its callees'.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import defaultdict
+from typing import Callable, Dict, Optional, Tuple, TypeVar
+
+from spark_rapids_tpu.runtime.errors import RetryExhausted
+from spark_rapids_tpu.runtime.faults import InjectedFault
+
+T = TypeVar("T")
+
+_counters: Dict[str, int] = defaultdict(int)
+_counters_lock = threading.Lock()
+# jitter decorrelates concurrent retriers; seeded so runs are
+# reproducible enough for the chaos gate's wall-clock budget
+_jitter_rng = random.Random(0x5EED)
+
+
+class BackoffPolicy:
+    """attempts total tries; delay_i = min(max, base * 2^i) * jitter,
+    jitter uniform in [0.5, 1.0] (full-jitter halves herd alignment
+    without ever sleeping longer than the exponential envelope)."""
+
+    __slots__ = ("attempts", "base_ms", "max_ms")
+
+    def __init__(self, attempts: int = 4, base_ms: float = 50.0,
+                 max_ms: float = 2000.0):
+        self.attempts = max(1, int(attempts))
+        self.base_ms = float(base_ms)
+        self.max_ms = float(max_ms)
+
+    def delay_s(self, attempt: int) -> float:
+        raw = min(self.max_ms, self.base_ms * (2 ** attempt))
+        return raw / 1000.0 * (0.5 + 0.5 * _jitter_rng.random())
+
+
+def policy_from_conf(conf=None) -> BackoffPolicy:
+    """Resolve the session's retry policy (falls back to entry defaults
+    when no session is active — component-level callers and tests)."""
+    from spark_rapids_tpu.config import rapids_conf as rc
+
+    if conf is None:
+        from spark_rapids_tpu.api.session import TpuSparkSession
+
+        s = TpuSparkSession.active()
+        conf = s.rapids_conf if s is not None else None
+    if conf is None:
+        return BackoffPolicy(rc.IO_RETRY_ATTEMPTS.default,
+                             rc.IO_RETRY_BACKOFF_MS.default,
+                             rc.IO_RETRY_MAX_BACKOFF_MS.default)
+    return BackoffPolicy(conf.get(rc.IO_RETRY_ATTEMPTS),
+                         conf.get(rc.IO_RETRY_BACKOFF_MS),
+                         conf.get(rc.IO_RETRY_MAX_BACKOFF_MS))
+
+
+def retry_io(fn: Callable[[], T], what: str,
+             site: Optional[str] = None,
+             retry_on: Tuple[type, ...] = (OSError,),
+             no_retry: Tuple[type, ...] = (),
+             absorb_sites: Tuple[str, ...] = (),
+             policy: Optional[BackoffPolicy] = None,
+             counter: Optional[str] = None,
+             on_retry: Optional[Callable[[BaseException], None]] = None,
+             sleep: Callable[[float], None] = time.sleep) -> T:
+    """Run `fn` under the backoff policy. Exceptions in `retry_on` (or
+    an InjectedFault for `site` / one of `absorb_sites` — sites whose
+    recovery point is THIS loop, e.g. shuffle.deserialize faults
+    surfacing inside a shuffle.fetch retry) consume an attempt;
+    `no_retry` classes fail immediately (a missing file is not
+    transient). The final failure raises RetryExhausted chained to the
+    last error — callers convert it to their domain's clean engine
+    error."""
+    from spark_rapids_tpu.runtime import faults
+
+    policy = policy or policy_from_conf()
+    mine = tuple(s for s in ((site,) + tuple(absorb_sites)) if s)
+    last: Optional[BaseException] = None
+    for attempt in range(policy.attempts):
+        try:
+            if site is not None:
+                faults.maybe_inject(site, detail=what)
+            return fn()
+        except no_retry:
+            raise
+        except InjectedFault as e:
+            if e.site not in mine:
+                raise  # a different site's fault: not ours to absorb
+            last = e
+        except retry_on as e:
+            last = e
+        key = counter or site or "io"
+        with _counters_lock:
+            _counters[key] += 1
+        if on_retry is not None:
+            on_retry(last)
+        if attempt < policy.attempts - 1:
+            sleep(policy.delay_s(attempt))
+    raise RetryExhausted(
+        f"{what}: {policy.attempts} attempts exhausted "
+        f"(last: {type(last).__name__}: {last})") from last
+
+
+def counters() -> Dict[str, int]:
+    with _counters_lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    with _counters_lock:
+        _counters.clear()

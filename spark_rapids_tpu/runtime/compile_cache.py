@@ -67,6 +67,7 @@ class CompileStats:
         self.cache_hits = 0            # in-memory structural reuse
         self.warm_hits = 0             # artifact-served programs
         self.compile_seconds = 0.0     # trace+compile time of builds
+        self.artifacts_quarantined = 0  # corrupt entries set aside
 
     def on_compile(self, seconds: float) -> None:
         with self._lock:
@@ -81,6 +82,10 @@ class CompileStats:
         with self._lock:
             self.warm_hits += 1
 
+    def on_quarantine(self) -> None:
+        with self._lock:
+            self.artifacts_quarantined += 1
+
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             return {
@@ -88,6 +93,7 @@ class CompileStats:
                 "cacheHits": self.cache_hits,
                 "warmHits": self.warm_hits,
                 "compileSeconds": round(self.compile_seconds, 3),
+                "artifactsQuarantined": self.artifacts_quarantined,
             }
 
     @staticmethod
@@ -473,28 +479,53 @@ def _warmup_run(top_k: int) -> None:
                 _warm[entry["key"]] = fn
 
 
+def quarantine_artifact(digest: str) -> None:
+    """Set a corrupt artifact's files aside (rename to .quarantine) so
+    the next run neither re-reads the poison nor loses the evidence;
+    count it so metrics surface decay of the cache medium."""
+    adir = _artifact_dir()
+    for ext in (".bin", ".key"):
+        src = os.path.join(adir, digest + ext)
+        try:
+            os.replace(src, src + ".quarantine")
+        except OSError:
+            pass
+    stats.on_quarantine()
+
+
 def _load_artifact(digest: str, key_repr: str) -> Optional[Callable]:
     """Deserialize + AOT-compile one artifact. The .key sidecar must
     equal the index's key repr — a mismatch means a digest collision or
-    a torn write, and the artifact is ignored."""
+    a torn write, and the artifact is ignored.
+
+    Failure contract (PR 2): a corrupt/truncated artifact — or an
+    injected compile.cache_load fault — is a CACHE MISS, never a query
+    failure: the file is quarantined, a metric counts it, and the
+    program recompiles from source as if the entry never existed."""
     import jax
+
+    from spark_rapids_tpu.runtime import faults
 
     adir = _artifact_dir()
     try:
+        faults.maybe_inject("compile.cache_load", detail=digest)
         with open(os.path.join(adir, digest + ".key"), "rb") as f:
             if f.read().decode() != key_repr:
                 return None
         with open(os.path.join(adir, digest + ".bin"), "rb") as f:
             blob = f.read()
-    except OSError:
-        return None
-    import jax.export as jex
+        import jax.export as jex
 
-    _register_export_serialization()
-    exp = jex.deserialize(blob)
-    args, kwargs = jax.tree_util.tree_unflatten(
-        exp.in_tree, exp.in_avals)
-    return jax.jit(exp.call).lower(*args, **kwargs).compile()
+        _register_export_serialization()
+        exp = jex.deserialize(blob)
+        args, kwargs = jax.tree_util.tree_unflatten(
+            exp.in_tree, exp.in_avals)
+        return jax.jit(exp.call).lower(*args, **kwargs).compile()
+    except FileNotFoundError:
+        return None  # plain miss: nothing to quarantine
+    except Exception:
+        quarantine_artifact(digest)
+        return None
 
 
 # ------------------------------------------------------------- admin

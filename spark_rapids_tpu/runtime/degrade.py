@@ -1,0 +1,115 @@
+"""Engine degradation ladder state — circuit breaker + demotion ledger.
+
+The engine dispatch (api/dataframe.py `_dispatch_engines`) runs a
+query on the fastest engine that can take it: fused -> eager
+out-of-core -> CPU. PR 2 turns that chain into an explicit DEGRADATION
+LADDER for execution FAILURES, not just missing lowerings: a fused run
+that dies with a terminal OOM or an injected device.dispatch fault
+demotes to the eager engine (where the OOM retry/split machinery
+lives), and an eager failure demotes to the CPU engine — every
+demotion recorded in `last_execution["degradations"]` and the
+`degrade.*` session metrics, the way memory-oversubscription systems
+(Vortex, PAPERS.md) treat pressure as a normal signal to degrade
+around rather than a crash.
+
+This module holds the cross-query state: a PER-PROGRAM-KEY circuit
+breaker. A plan whose fused execution keeps failing (same structural
+key) stops being retried on the fused engine after
+`spark.rapids.tpu.degrade.circuitBreaker.threshold` consecutive
+failures — later queries skip straight to eager instead of paying the
+doomed compile+run, until one success (e.g. after a conf change or
+smaller input) closes the breaker again.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Tuple
+
+_DEFAULT_THRESHOLD = 3
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker keyed on structural program keys."""
+
+    def __init__(self, threshold: int = _DEFAULT_THRESHOLD):
+        self.threshold = max(1, int(threshold))
+        self._failures: Dict[Tuple, int] = {}
+        self._lock = threading.Lock()
+        self.opens = 0  # times a key crossed the threshold
+
+    def allow(self, key: Tuple) -> bool:
+        with self._lock:
+            return self._failures.get(key, 0) < self.threshold
+
+    def record_failure(self, key: Tuple) -> int:
+        with self._lock:
+            n = self._failures.get(key, 0) + 1
+            self._failures[key] = n
+            if n == self.threshold:
+                self.opens += 1
+            return n
+
+    def record_success(self, key: Tuple) -> None:
+        with self._lock:
+            self._failures.pop(key, None)
+
+    def open_keys(self) -> int:
+        with self._lock:
+            return sum(1 for n in self._failures.values()
+                       if n >= self.threshold)
+
+
+_breaker = CircuitBreaker()
+_counters: Dict[str, int] = {}
+_lock = threading.Lock()
+
+
+def configure(conf=None) -> None:
+    """Session hook: re-thresholds the breaker (state survives —
+    a failing program stays known across sessions in one process)."""
+    from spark_rapids_tpu.config import rapids_conf as rc
+
+    if conf is not None:
+        _breaker.threshold = max(1, conf.get(rc.DEGRADE_CB_THRESHOLD))
+
+
+def breaker() -> CircuitBreaker:
+    return _breaker
+
+
+def enabled(conf=None) -> bool:
+    from spark_rapids_tpu.config import rapids_conf as rc
+
+    return conf is None or bool(conf.get(rc.DEGRADE_ENABLED))
+
+
+def plan_fingerprint(phys) -> Tuple:
+    """Structural key of a physical plan — the breaker's unit of
+    memory. Reuses the mesh/fused program-key discipline so two plans
+    that would trace identical programs share breaker state."""
+    from spark_rapids_tpu.parallel.plan_compiler import _plan_key
+
+    return ("degrade", _plan_key(phys))
+
+
+def record_demotion(kind: str) -> None:
+    """Process-wide demotion counter ('fusedToEager', 'eagerToCpu',
+    'breakerShortCircuit', 'fusedOomInjectionFallback')."""
+    with _lock:
+        _counters[kind] = _counters.get(kind, 0) + 1
+
+
+def counters() -> Dict[str, int]:
+    with _lock:
+        out = dict(_counters)
+    out["breakerOpens"] = _breaker.opens
+    out["breakerOpenKeys"] = _breaker.open_keys()
+    return out
+
+
+def reset_for_tests(threshold: int = _DEFAULT_THRESHOLD) -> None:
+    global _breaker
+    _breaker = CircuitBreaker(threshold)
+    with _lock:
+        _counters.clear()

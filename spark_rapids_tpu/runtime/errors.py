@@ -31,6 +31,50 @@ class StringWidthExceeded(ValueError):
     recorded like any other engine fallback)."""
 
 
+class EngineIOError(RuntimeError):
+    """Base for clean engine-surfaced I/O failures: a failure domain
+    that exhausted its recovery budget reports WHAT failed in engine
+    terms (buffer/block/file identity) instead of leaking a raw
+    OSError/numpy error through an operator."""
+
+
+class RetryExhausted(EngineIOError):
+    """A backoff loop (runtime/backoff.py) ran out of attempts; chained
+    to the last underlying error. Domain consumers convert it to their
+    specific error class below."""
+
+
+class ShuffleChecksumError(EngineIOError):
+    """A shuffle block's per-block CRC did not match on deserialize —
+    torn write, bit rot, or an injected shuffle.deserialize fault. The
+    shuffle manager retries the fetch/decode before surfacing this."""
+
+
+class ShuffleFetchError(EngineIOError):
+    """A shuffle block could not be fetched/decoded after the retry
+    budget; names the (shuffle_id, reduce_pid) block."""
+
+
+class SpillFileError(EngineIOError):
+    """A disk-tier spill file is missing or unreadable; names the
+    buffer id, tier, and path (never a raw numpy/OSError)."""
+
+    def __init__(self, buffer_id: str, tier: str, path: str,
+                 op: str = "read"):
+        self.buffer_id = buffer_id
+        self.tier = tier
+        self.path = path
+        super().__init__(
+            f"spill {op} failed for buffer {buffer_id} "
+            f"(tier {tier}): {path}")
+
+
+class SemaphoreTimeout(RuntimeError):
+    """Task-admission semaphore acquisition exceeded the conf'd
+    timeout; the message carries held-permit diagnostics instead of the
+    process hanging silently."""
+
+
 class TpuAnsiError(ValueError):
     """ANSI-mode runtime error (the SparkArithmeticException /
     SparkDateTimeException role): raised when spark.sql.ansi.enabled

@@ -26,6 +26,7 @@ import os
 import tempfile
 import threading
 import uuid
+import zipfile
 from enum import Enum
 from typing import Dict, List, Optional
 
@@ -33,7 +34,12 @@ import jax
 import numpy as np
 
 from spark_rapids_tpu.columnar.batch import ColumnBatch
-from spark_rapids_tpu.runtime.errors import TpuRetryOOM, TpuSplitAndRetryOOM
+from spark_rapids_tpu.runtime.errors import (
+    RetryExhausted,
+    SpillFileError,
+    TpuRetryOOM,
+    TpuSplitAndRetryOOM,
+)
 
 
 class SpillTier(Enum):
@@ -88,8 +94,12 @@ class SpillableBatch:
                         # num_rows is the LAST pytree leaf
                         self._rows = int(self._host_data[-1])
                     elif self._disk_path is not None:
-                        with np.load(self._disk_path) as z:
-                            self._rows = int(z[z.files[-1]])
+                        def last():
+                            with np.load(self._disk_path) as z:
+                                return int(z[z.files[-1]])
+
+                        self._rows = self._disk_io(
+                            last, "read", self._disk_path)
                     else:
                         raise RuntimeError(
                             "row_count() on a closed SpillableBatch")
@@ -109,21 +119,47 @@ class SpillableBatch:
         self._device_batch = None
         self._tier = SpillTier.HOST
 
+    def _disk_io(self, fn, op: str, path: str):
+        """Run one disk-tier spill read/write under the spill.disk
+        backoff policy; terminal failure surfaces as a SpillFileError
+        naming this buffer's id, tier, and path — never a raw
+        numpy/OSError through an operator. A MISSING spill file is
+        immediate (deleted out from under us: not transient)."""
+        from spark_rapids_tpu.runtime import backoff
+
+        try:
+            return backoff.retry_io(
+                fn, what=f"spill {op} {path}", site="spill.disk",
+                retry_on=(OSError, ValueError, zipfile.BadZipFile,
+                          EOFError),
+                no_retry=(FileNotFoundError,), counter="spill.disk")
+        except FileNotFoundError as e:
+            raise SpillFileError(self.id, self._tier.name, path,
+                                 op=op) from e
+        except RetryExhausted as e:
+            raise SpillFileError(self.id, self._tier.name, path,
+                                 op=op) from e
+
     def _to_disk(self):
         assert self._tier == SpillTier.HOST
         from spark_rapids_tpu.runtime.profiler import annotate
 
         path = os.path.join(self._catalog.spill_dir, f"spill-{self.id}.npz")
         with annotate(f"spill:HOST2DISK:{self.size_bytes}"):
-            np.savez(path, *self._host_data)
+            self._disk_io(lambda: np.savez(path, *self._host_data),
+                          "write", path)
         self._disk_path = path
         self._host_data = None
         self._tier = SpillTier.DISK
 
     def _host_from_disk(self):
         assert self._tier == SpillTier.DISK
-        with np.load(self._disk_path) as z:
-            self._host_data = [z[k] for k in z.files]
+
+        def load():
+            with np.load(self._disk_path) as z:
+                return [z[k] for k in z.files]
+
+        self._host_data = self._disk_io(load, "read", self._disk_path)
         os.unlink(self._disk_path)
         self._disk_path = None
         self._tier = SpillTier.HOST

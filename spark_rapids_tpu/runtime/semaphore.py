@@ -4,6 +4,14 @@ Reference (`GpuSemaphore.scala:100-421`): limits how many tasks hold
 device memory concurrently; permits = 1000 / concurrentGpuTasks; tracks
 wait time for task metrics. Same design: a counted semaphore keyed by
 task id so re-entrant acquires are free, with wait-time accounting.
+
+Hardened (PR 2): acquisition honors a conf'd timeout
+(`spark.rapids.tpu.semaphore.acquireTimeoutMs`). A leaked permit (a
+task that died without releasing) used to hang every later task
+forever with zero diagnostics; now the blocked acquire raises
+SemaphoreTimeout carrying the held-permit table — which task ids hold
+how many permits, for how long — so the operator sees the culprit
+instead of a silent wedge.
 """
 
 from __future__ import annotations
@@ -12,32 +20,68 @@ import threading
 import time
 from typing import Dict, Optional
 
+from spark_rapids_tpu.runtime.errors import SemaphoreTimeout
+
 MAX_PERMITS = 1000
+
+DEFAULT_ACQUIRE_TIMEOUT_MS = 600_000
 
 
 class TpuSemaphore:
-    def __init__(self, concurrent_tasks: int = 2):
+    def __init__(self, concurrent_tasks: int = 2,
+                 acquire_timeout_ms: int = DEFAULT_ACQUIRE_TIMEOUT_MS):
         concurrent_tasks = max(1, concurrent_tasks)
         self._permits_per_task = max(1, MAX_PERMITS // concurrent_tasks)
         self._available = MAX_PERMITS
         self._cv = threading.Condition()
         self._holders: Dict[int, int] = {}
+        self._held_since: Dict[int, float] = {}
+        self._timeout_ms = acquire_timeout_ms
         self.total_wait_ns = 0
+        self.timeouts = 0
 
     def acquire_if_necessary(self, task_id: int):
         with self._cv:
             if task_id in self._holders:
                 return
             start = time.monotonic_ns()
+            deadline = (None if self._timeout_ms <= 0
+                        else time.monotonic() + self._timeout_ms / 1000.0)
             while self._available < self._permits_per_task:
-                self._cv.wait()
+                if deadline is None:
+                    self._cv.wait()
+                    continue
+                remaining = deadline - time.monotonic()
+                if remaining > 0:
+                    self._cv.wait(remaining)
+                    continue  # woken or timed out: re-check permits
+                self.timeouts += 1
+                waited_s = (time.monotonic_ns() - start) / 1e9
+                raise SemaphoreTimeout(
+                    f"task {task_id} timed out after {waited_s:.1f}s "
+                    f"waiting for {self._permits_per_task} device "
+                    f"permits ({self._available}/{MAX_PERMITS} "
+                    f"available); held permits: "
+                    f"{self._holder_diagnostics()}")
             self.total_wait_ns += time.monotonic_ns() - start
             self._available -= self._permits_per_task
             self._holders[task_id] = self._permits_per_task
+            self._held_since[task_id] = time.monotonic()
+
+    def _holder_diagnostics(self) -> str:
+        """Under _cv: the held-permit table a timed-out acquirer dumps
+        (the reference's GpuSemaphore dumpActiveStackTracesToLog
+        role, scoped to what this runtime can see)."""
+        now = time.monotonic()
+        rows = [f"task={tid} permits={p} "
+                f"held_s={now - self._held_since.get(tid, now):.1f}"
+                for tid, p in sorted(self._holders.items())]
+        return "[" + ", ".join(rows) + "]" if rows else "[none]"
 
     def release_if_necessary(self, task_id: int):
         with self._cv:
             permits = self._holders.pop(task_id, None)
+            self._held_since.pop(task_id, None)
             if permits:
                 self._available += permits
                 self._cv.notify_all()
@@ -51,10 +95,12 @@ _instance: Optional[TpuSemaphore] = None
 _lock = threading.Lock()
 
 
-def initialize(concurrent_tasks: int):
+def initialize(concurrent_tasks: int,
+               acquire_timeout_ms: int = DEFAULT_ACQUIRE_TIMEOUT_MS):
     global _instance
     with _lock:
-        old, _instance = _instance, TpuSemaphore(concurrent_tasks)
+        old, _instance = _instance, TpuSemaphore(concurrent_tasks,
+                                                 acquire_timeout_ms)
     if old is not None:
         # wake anyone still blocked on the replaced instance — their
         # releases would otherwise notify only the new one, stranding

@@ -7,6 +7,15 @@ device (`GpuShuffleCoalesceExec`). The UCX device-to-device transport's
 analog is the ICI collective path (parallel/collective.py +
 parallel/plan_compiler.py).
 
+Failure domain (PR 2 hardening): every block carries a per-block CRC
+(shuffle/serde.py, conf spark.rapids.shuffle.checksum.enabled) and
+every fetch/decode of an on-disk block runs under the shared
+exponential-backoff policy (runtime/backoff.py) — torn files, bit
+rot, and injected shuffle.fetch / shuffle.deserialize faults
+(runtime/faults.py) are retried `io.retry.attempts` times before a
+clean ShuffleFetchError names the exact block. Retries are counted
+(`fetch_retries`) so the bench tracks robustness overhead.
+
 Modes here (conf spark.rapids.shuffle.mode):
 - CACHE_ONLY: blocks live as in-process host Arrow tables under a host
   byte ledger; when in-memory block bytes exceed the spill threshold the
@@ -48,10 +57,13 @@ class ShuffleManager:
 
     def __init__(self, mode: str = "CACHE_ONLY", shuffle_dir: str = None,
                  num_threads: int = 8, codec: str = "none",
-                 spill_threshold: int = 2 << 30):
+                 spill_threshold: int = 2 << 30, checksum: bool = True):
         self.mode = mode
         self.codec = codec
+        self.checksum = checksum
         self.spill_threshold = spill_threshold
+        self.fetch_retries = 0
+        self.checksum_failures = 0
         self._blocks: Dict[Tuple[int, int], List[_MemBlock]] = defaultdict(
             list)
         self._files: Dict[Tuple[int, int], List[Future]] = defaultdict(
@@ -88,7 +100,8 @@ class ShuffleManager:
 
         path = os.path.join(self._spill_dir(),
                             f"shuffle-spill-{b.seq}.stpu")
-        serde.serialize_table(b.table, codec=self.codec).tofile(path)
+        serde.serialize_table(b.table, codec=self.codec,
+                              checksum=self.checksum).tofile(path)
         # path BEFORE table: fetch() snapshots (table, path) and
         # must never observe both unset
         b.path = path
@@ -150,7 +163,8 @@ class ShuffleManager:
         def write():
             from spark_rapids_tpu.shuffle import serde
 
-            buf = serde.serialize_table(table, codec=self.codec)
+            buf = serde.serialize_table(table, codec=self.codec,
+                                        checksum=self.checksum)
             with open(path, "wb") as f:
                 buf.tofile(f)
             with self._lock:
@@ -182,9 +196,50 @@ class ShuffleManager:
                     pass
         return out
 
-    def fetch(self, shuffle_id: int, reduce_pid: int) -> List[pa.Table]:
+    def _fetch_block(self, path: str, shuffle_id: int,
+                     reduce_pid: int) -> pa.Table:
+        """Read + decode one on-disk block under the backoff policy:
+        OSError / checksum mismatch / injected shuffle.fetch or
+        shuffle.deserialize faults each consume an attempt (re-reading
+        the file is the repair for all of them); the exhausted budget
+        surfaces as a ShuffleFetchError naming the block."""
+        from spark_rapids_tpu.runtime import backoff
+        from spark_rapids_tpu.runtime.errors import (
+            RetryExhausted,
+            ShuffleChecksumError,
+            ShuffleFetchError,
+        )
         from spark_rapids_tpu.shuffle import serde
 
+        def read_decode():
+            data = np.fromfile(path, dtype=np.uint8)
+            try:
+                return serde.deserialize_table(data)
+            except ShuffleChecksumError:
+                self.checksum_failures += 1
+                raise
+
+        def count_retry(_exc):
+            with self._lock:
+                self.fetch_retries += 1
+
+        try:
+            return backoff.retry_io(
+                read_decode,
+                what=f"shuffle block ({shuffle_id}, {reduce_pid}) "
+                     f"{os.path.basename(path)}",
+                site="shuffle.fetch",
+                retry_on=(OSError, ShuffleChecksumError),
+                absorb_sites=("shuffle.deserialize",),
+                counter="shuffle.fetch",
+                on_retry=count_retry)
+        except RetryExhausted as e:
+            raise ShuffleFetchError(
+                f"shuffle block (shuffle_id={shuffle_id}, "
+                f"reduce_pid={reduce_pid}) unrecoverable after retry "
+                f"budget: {path}") from e
+
+    def fetch(self, shuffle_id: int, reduce_pid: int) -> List[pa.Table]:
         if self.mode != "MULTITHREADED":
             with self._lock:
                 snap = [(b.table, b.path) for b in
@@ -194,16 +249,16 @@ class ShuffleManager:
                 if table is not None:
                     out.append(table)
                 else:
-                    data = np.fromfile(path, dtype=np.uint8)
-                    out.append(serde.deserialize_table(data))
+                    out.append(self._fetch_block(path, shuffle_id,
+                                                 reduce_pid))
             return out
         with self._lock:
             futs = list(self._files.get((shuffle_id, reduce_pid), []))
         tables = []
         for fut in futs:
             path = fut.result()  # blocks on in-flight writes
-            data = np.fromfile(path, dtype=np.uint8)
-            tables.append(serde.deserialize_table(data))
+            tables.append(self._fetch_block(path, shuffle_id,
+                                            reduce_pid))
         return tables
 
     def remove_shuffle(self, shuffle_id: int):
@@ -245,17 +300,18 @@ _mgr_lock = threading.Lock()
 
 def configure_shuffle(mode: str, shuffle_dir: str = None,
                       num_threads: int = 8, codec: str = "none",
-                      spill_threshold: int = 2 << 30):
+                      spill_threshold: int = 2 << 30,
+                      checksum: bool = True):
     """Install a manager for the session's shuffle settings (reference
     GpuShuffleEnv.initShuffleManager, Plugin.scala:531)."""
     global _manager
     with _mgr_lock:
         settings = (mode, shuffle_dir, num_threads, codec,
-                    spill_threshold)
+                    spill_threshold, checksum)
         if getattr(_manager, "_settings", None) != settings:
             _manager.shutdown()
             _manager = ShuffleManager(mode, shuffle_dir, num_threads,
-                                      codec, spill_threshold)
+                                      codec, spill_threshold, checksum)
             _manager._settings = settings
     return _manager
 

@@ -16,22 +16,63 @@ Optional block compression (`codec=`) wraps the packed frame with a
 TableCompressionCodec / NvcompLZ4CompressionCodec role (reference
 compresses shuffle payloads with nvcomp LZ4/ZSTD; here zstd level 1 or
 zlib on the host).
+
+Per-block checksums (`checksum=`, default on) add an outermost
+14-byte envelope [magic u8, algo u8, crc u32, payload_len i64] over
+the whole frame, verified on deserialize: a torn shuffle file or a
+bit flip surfaces as ShuffleChecksumError (which the shuffle manager
+retries with backoff) instead of a corrupt query result. crc32c is
+used when the wheel is installed, else zlib's crc32 — the algorithm id
+rides in the header so readers never guess. Checksum-less frames from
+older writers still deserialize.
 """
 
 from __future__ import annotations
 
 import json
 import struct
+import zlib
 from typing import List
 
 import numpy as np
 import pyarrow as pa
 
 from spark_rapids_tpu import native
+from spark_rapids_tpu.runtime import faults
+from spark_rapids_tpu.runtime.errors import ShuffleChecksumError
 
 _CODEC_MAGIC = 0xC7
 _CODECS = {"none": 0, "zstd": 1, "zlib": 2}
 _CODEC_NAMES = {v: k for k, v in _CODECS.items()}
+
+_CRC_MAGIC = 0xCC
+_ALGO_CRC32C = 1
+_ALGO_CRC32 = 2
+_CRC_HEADER = struct.Struct("<BBIq")
+
+try:
+    import crc32c as _crc32c_mod
+except ImportError:
+    _crc32c_mod = None
+
+
+def _checksum(data) -> tuple:
+    """-> (algo_id, crc) of a bytes-like; crc32c preferred (hardware-
+    accelerated where available, and what the reference storage stack
+    uses), stdlib crc32 otherwise."""
+    if _crc32c_mod is not None:
+        return _ALGO_CRC32C, _crc32c_mod.crc32c(data) & 0xFFFFFFFF
+    return _ALGO_CRC32, zlib.crc32(data) & 0xFFFFFFFF
+
+
+def _checksum_with(algo: int, data) -> int:
+    if algo == _ALGO_CRC32C:
+        if _crc32c_mod is None:
+            raise ShuffleChecksumError(
+                "block checksummed with crc32c but no crc32c module is "
+                "available to verify it")
+        return _crc32c_mod.crc32c(data) & 0xFFFFFFFF
+    return zlib.crc32(data) & 0xFFFFFFFF
 
 
 def zstd_available() -> bool:
@@ -78,9 +119,10 @@ def _decompress(payload: bytes, codec: str, raw_len: int) -> bytes:
     return payload
 
 
-def serialize_table(table: pa.Table, codec: str = "none") -> np.ndarray:
+def serialize_table(table: pa.Table, codec: str = "none",
+                    checksum: bool = True) -> np.ndarray:
     """Arrow table -> one contiguous uint8 buffer (optionally
-    codec-compressed)."""
+    codec-compressed, CRC-framed unless checksum=False)."""
     schema_buf = np.frombuffer(table.schema.serialize(), dtype=np.uint8)
     bufs: List[np.ndarray] = []
     col_specs = []
@@ -120,15 +162,45 @@ def serialize_table(table: pa.Table, codec: str = "none") -> np.ndarray:
     meta_buf = np.frombuffer(meta, dtype=np.uint8)
     packed = native.pack_buffers([schema_buf, meta_buf] + bufs)
     codec = resolve_codec(codec)
-    if codec == "none":
+    if codec != "none":
+        raw = packed.tobytes()
+        payload = _compress(raw, codec)
+        header = struct.pack("<BBq", _CODEC_MAGIC, _CODECS[codec],
+                             len(raw))
+        packed = np.frombuffer(header + payload, dtype=np.uint8)
+    if not checksum:
         return packed
-    raw = packed.tobytes()
-    payload = _compress(raw, codec)
-    header = struct.pack("<BBq", _CODEC_MAGIC, _CODECS[codec], len(raw))
-    return np.frombuffer(header + payload, dtype=np.uint8)
+    body = packed.tobytes()
+    algo, crc = _checksum(body)
+    env = _CRC_HEADER.pack(_CRC_MAGIC, algo, crc, len(body))
+    return np.frombuffer(env + body, dtype=np.uint8)
+
+
+def _unwrap_checksum(data: np.ndarray) -> np.ndarray:
+    """Strip + verify the CRC envelope when present. The magic byte
+    alone could collide with a raw packed frame, so the header only
+    counts when the recorded payload length matches exactly."""
+    if data.size < _CRC_HEADER.size or int(data[0]) != _CRC_MAGIC or \
+            int(data[1]) not in (_ALGO_CRC32C, _ALGO_CRC32):
+        return data
+    magic, algo, want, plen = _CRC_HEADER.unpack(
+        data[:_CRC_HEADER.size].tobytes())
+    if plen != data.size - _CRC_HEADER.size:
+        return data
+    payload = data[_CRC_HEADER.size:]
+    got = _checksum_with(algo, payload.tobytes())
+    if got != want:
+        raise ShuffleChecksumError(
+            f"shuffle block checksum mismatch "
+            f"(algo={'crc32c' if algo == _ALGO_CRC32C else 'crc32'}, "
+            f"expected {want:#010x}, got {got:#010x}, "
+            f"{plen} payload bytes)")
+    return payload
 
 
 def deserialize_table(data: np.ndarray) -> pa.Table:
+    faults.maybe_inject("shuffle.deserialize")
+    data = _unwrap_checksum(data)
     if data.size >= 10 and int(data[0]) == _CODEC_MAGIC and \
             int(data[1]) in (1, 2):
         magic, codec_id, raw_len = struct.unpack("<BBq",

@@ -253,3 +253,88 @@ def test_pending_batches_restore_closes_orphans():
     p.close()
     if base is not None:
         assert catalog.live_handles() == base
+
+
+def test_restore_on_retry_split_storm_no_double_count(tmp_path):
+    """Satellite (PR 2): with_restore_on_retry + PendingBatches under
+    an injected split-and-retry STORM — every input batch suffers
+    retry OOMs after partial appends AND split OOMs that halve it, and
+    the checkpointed accumulator must come out with EXACTLY the input
+    row count (no double counting from re-run attempts) and the spill
+    catalog must be empty afterwards (no leaked entries from aborted
+    attempts)."""
+    from spark_rapids_tpu.runtime.retry import (
+        PendingBatches,
+        with_restore_on_retry,
+    )
+
+    cat = _mk_catalog(1 << 30, tmpdir=str(tmp_path))
+    import spark_rapids_tpu.runtime.memory as mem
+    old = mem._catalog
+    mem._catalog = cat
+    try:
+        total_rows = 1000
+        inputs = [cat.add_batch(_batch(total_rows))]
+        pending = PendingBatches()
+        storm = {"retries_left": 5}
+
+        def body(sb):
+            n = sb.row_count()
+            # partial append FIRST — the state a failed attempt must
+            # not keep
+            pending.append(cat.add_batch(sb.get_batch()), n)
+            if n > 300:
+                raise TpuSplitAndRetryOOM("storm: too big")
+            if storm["retries_left"] > 0:
+                storm["retries_left"] -= 1
+                raise TpuRetryOOM("storm: transient")
+            return n
+
+        done = list(with_retry(
+            inputs, lambda sb: with_restore_on_retry(pending,
+                                                     lambda: body(sb))))
+        assert storm["retries_left"] == 0  # the storm actually fired
+        assert sum(done) == total_rows
+        assert pending.rows == total_rows  # no double-counted appends
+        assert sum(sb.row_count() for sb in pending.items) == total_rows
+        # nothing leaked: only the accumulator's own entries remain...
+        assert cat.buffer_count() == len(pending.items)
+        pending.close()
+        # ...and closing it empties the catalog entirely
+        assert cat.buffer_count() == 0
+        assert cat.check_leaks() == 0
+    finally:
+        mem._catalog = old
+
+
+def test_restore_on_retry_storm_checkpointed_value(tmp_path):
+    """CheckpointedValue under the same storm: a scalar accumulator
+    (e.g. an output-row counter) never counts an aborted attempt."""
+    from spark_rapids_tpu.runtime.retry import (
+        CheckpointedValue,
+        with_restore_on_retry,
+    )
+
+    cat = _mk_catalog(1 << 30, tmpdir=str(tmp_path))
+    import spark_rapids_tpu.runtime.memory as mem
+    old = mem._catalog
+    mem._catalog = cat
+    try:
+        inputs = [cat.add_batch(_batch(800))]
+        counter = CheckpointedValue(0)
+        fails = {"n": 4}
+
+        def body(sb):
+            counter.value += sb.row_count()
+            if fails["n"] > 0:
+                fails["n"] -= 1
+                raise TpuRetryOOM("storm")
+            return True
+
+        list(with_retry(inputs,
+                        lambda sb: with_restore_on_retry(
+                            counter, lambda: body(sb))))
+        assert counter.value == 800  # attempts re-ran, count did not
+        assert cat.buffer_count() == 0
+    finally:
+        mem._catalog = old
