@@ -119,15 +119,29 @@ BASE_EAGER = {"spark.rapids.sql.fusedExec.enabled": False,
 baseline, _ = run_all({})
 baseline_eager, _ = run_all(BASE_EAGER)
 
+# scheduler-domain sites (PR 3) fire in the eager engine's stage
+# scheduler (result + shuffle map stages). worker.crash retries whole
+# task attempts and shuffle.lost_output recomputes map tasks, so these
+# runs are SLOW-AWARE: the task attempt budget is widened and the
+# straggler probability kept low (each injected straggler stalls an
+# attempt ~0.2s before speculation's duplicate wins).
 SITES = ["io.read:p=0.3", "shuffle.fetch:p=0.3",
          "shuffle.deserialize:p=0.2", "compile.cache_load:every=2",
-         "spill.disk:p=0.3", "device.dispatch:once"]
+         "spill.disk:p=0.3", "device.dispatch:once",
+         "worker.crash:p=0.2", "task.straggler:p=0.1",
+         "shuffle.lost_output:once"]
+
+SCHED_CONF = {"spark.rapids.tpu.stage.maxAttempts": 8,
+              "spark.rapids.tpu.speculation.enabled": True,
+              "spark.rapids.tpu.speculation.quantile": 0.5,
+              "spark.rapids.tpu.speculation.multiplier": 1.3,
+              "spark.rapids.tpu.speculation.minTaskRuntimeMs": 40}
 
 failures = 0
 for spec in SITES + [";".join(SITES)]:
     label = spec if len(spec) < 40 else "ALL-SITES"
     for base, want in (({}, baseline), (BASE_EAGER, baseline_eager)):
-        conf = {**base,
+        conf = {**base, **SCHED_CONF,
                 "spark.rapids.tpu.chaos.enabled": True,
                 "spark.rapids.tpu.chaos.seed": 42,
                 "spark.rapids.tpu.chaos.sites": spec,
@@ -141,15 +155,19 @@ for spec in SITES + [";".join(SITES)]:
                 print(f"FAIL {label} [{mode}] {name}: results differ")
                 failures += 1
         inj = sum(v["injected"] for v in robust["chaos"].values())
+        sch = {k: v for k, v in robust["scheduler"].items()
+               if v and k != "tasksLaunched" and k != "stagesRun"}
         print(f"ok   {label} [{mode}]: {inj} faults injected, "
               f"retries={robust['retries']}, "
+              f"sched={sch}, "
               f"degrade={ {k: v for k, v in robust['degrade'].items() if v} }")
 assert failures == 0, f"{failures} chaos mismatches"
 print("chaos equivalence: PASS")
 PY
 
 echo "== targeted fault-injection suite =="
-python -m pytest tests/test_chaos.py tests/test_memory_retry.py -q \
+python -m pytest tests/test_chaos.py tests/test_memory_retry.py \
+    tests/test_scheduler.py tests/test_scheduler_mp.py -q \
     -p no:cacheprovider
 
 echo "CHAOS PASS"

@@ -616,7 +616,7 @@ class DataFrame:
         # error must never silently land a query on the dispatch-bound
         # eager path.
         rec = {"engine": None, "fallbacks": [], "compile": None,
-               "degradations": []}
+               "degradations": [], "scheduler": None}
         self._last_exec = rec
         self.session.last_execution = rec
 
@@ -643,13 +643,19 @@ class DataFrame:
         from spark_rapids_tpu.runtime import compile_cache as _cc
         from spark_rapids_tpu.runtime.errors import StringWidthExceeded
 
+        from spark_rapids_tpu.runtime import scheduler as _sched
+
         # Compile observability (the tentpole's watch-forever channel):
         # the process compile ledger is snapshotted around the query and
         # the delta — programs compiled, structural cache hits, warmup
         # hits, compile seconds — lands in last_execution["compile"]
         # and the session metrics, with the fused engine's distinct
-        # program-variant count folded in when it ran.
+        # program-variant count folded in when it ran. The stage
+        # scheduler's ledger (tasks launched/retried/speculated,
+        # recomputed partitions, evicted workers) rides the same
+        # snapshot-delta channel into last_execution["scheduler"].
         before = _cc.stats.snapshot()
+        sched_before = _sched.stats.snapshot()
         try:
             return self._dispatch_engines(phys, ran, fell_back, rec)
         except StringWidthExceeded as e:
@@ -674,6 +680,14 @@ class DataFrame:
                 int(comp["compileSeconds"] * 1000))
             qm.metric("compile.artifactsQuarantined").add(
                 comp.get("artifactsQuarantined", 0))
+            sch = _sched.stats.delta(sched_before,
+                                     _sched.stats.snapshot())
+            rec["scheduler"] = sch
+            for key in ("tasksLaunched", "tasksRetried",
+                        "tasksSpeculated", "speculativeWins",
+                        "recomputedPartitions", "evictedWorkers"):
+                if sch.get(key):
+                    qm.metric("scheduler." + key).add(sch[key])
 
     def _dispatch_engines(self, phys, ran, fell_back, rec) -> pa.Table:
         """Engine dispatch with the DEGRADATION LADDER (PR 2):
@@ -824,6 +838,17 @@ class DataFrame:
             for d in rec.get("degradations", []):
                 print(f"  degraded {d['from']} -> {d['to']}: "
                       f"{d['reason']}")
+            sch = rec.get("scheduler") or {}
+            if sch.get("tasksLaunched"):
+                detail = ", ".join(
+                    f"{sch[k]} {label}" for k, label in (
+                        ("tasksRetried", "retried"),
+                        ("tasksSpeculated", "speculated"),
+                        ("recomputedPartitions", "recomputed"),
+                        ("evictedWorkers", "workers evicted"))
+                    if sch.get(k))
+                print(f"  scheduler: {sch['tasksLaunched']} task "
+                      f"attempts" + (f" ({detail})" if detail else ""))
 
     def write_parquet(self, path: str):
         self.session.write_parquet(self, path)

@@ -329,13 +329,16 @@ class TpuSparkSession:
 
     @property
     def robustness_metrics(self):
-        """One snapshot of every failure-domain counter (PR 2): chaos
+        """One snapshot of every failure-domain counter (PR 2/3): chaos
         injections per site, backoff retries per domain, shuffle
-        fetch/checksum recoveries, degradation-ladder demotions +
+        fetch/checksum recoveries + orphaned/discarded blocks,
+        stage-scheduler recoveries (retries, speculation, recomputed
+        partitions, evicted workers), degradation-ladder demotions +
         circuit-breaker state, quarantined compile artifacts, and
         semaphore timeouts. bench.py folds this into its JSON so
         BENCH_* tracks robustness overhead."""
         from spark_rapids_tpu.runtime import backoff, degrade, faults
+        from spark_rapids_tpu.runtime import scheduler as _sched
         from spark_rapids_tpu.runtime import semaphore as sem
         from spark_rapids_tpu.runtime.compile_cache import stats
         from spark_rapids_tpu.shuffle.manager import get_shuffle_manager
@@ -345,7 +348,11 @@ class TpuSparkSession:
             "chaos": faults.counters(),
             "retries": backoff.counters(),
             "shuffle": {"fetchRetries": mgr.fetch_retries,
-                        "checksumFailures": mgr.checksum_failures},
+                        "checksumFailures": mgr.checksum_failures,
+                        "orphanedFiles": mgr.orphaned_files,
+                        "speculativeDiscards":
+                            mgr.speculative_discards},
+            "scheduler": _sched.stats.snapshot(),
             "degrade": degrade.counters(),
             "artifactsQuarantined":
                 stats.snapshot()["artifactsQuarantined"],

@@ -582,6 +582,35 @@ DEGRADE_CB_THRESHOLD = conf(
     "before the circuit breaker opens and later queries with that key "
     "skip straight to the eager engine (a success closes it).", int,
     checker=lambda v: 1 <= v <= 1000)
+STAGE_MAX_ATTEMPTS = conf(
+    "spark.rapids.tpu.stage.maxAttempts", 4,
+    "Attempt budget per task of a stage (runtime/scheduler.py): lost "
+    "workers and lost map outputs re-run the owning task up to this "
+    "many total attempts before the stage fails (mirrors Spark's "
+    "spark.stage.maxConsecutiveAttempts / task maxFailures default).",
+    int, checker=lambda v: 1 <= v <= 100)
+SPECULATION_ENABLED = conf(
+    "spark.rapids.tpu.speculation.enabled", False,
+    "Launch a duplicate attempt for tasks running slower than "
+    "speculation.multiplier x the median completed-task duration "
+    "(Spark speculative execution). Attempt-tagged shuffle output and "
+    "commit-once semantics guarantee first-commit-wins — the losing "
+    "attempt's blocks are discarded, never double-counted.", bool)
+SPECULATION_MULTIPLIER = conf(
+    "spark.rapids.tpu.speculation.multiplier", 1.5,
+    "A running task is speculatable when its elapsed time exceeds this "
+    "multiple of the median completed-task duration.", float,
+    checker=lambda v: v >= 1.0)
+SPECULATION_QUANTILE = conf(
+    "spark.rapids.tpu.speculation.quantile", 0.75,
+    "Fraction of a stage's tasks that must have completed before "
+    "speculation considers the rest (the median needs a sample).",
+    float, checker=lambda v: 0.0 < v <= 1.0)
+SPECULATION_MIN_RUNTIME_MS = conf(
+    "spark.rapids.tpu.speculation.minTaskRuntimeMs", 100,
+    "Never speculate a task running for less than this — sub-threshold "
+    "tasks finish faster than a duplicate attempt could launch.", int,
+    checker=lambda v: v >= 0)
 
 
 def conf_entries() -> List[ConfEntry]:

@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import itertools
 import threading
-from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator, List, Optional
 
 import pyarrow as pa
@@ -99,14 +98,26 @@ class PhysicalPlan:
             c._premater_cached_entries()
 
     def collect(self) -> pa.Table:
-        """Run all partitions -> one arrow table (driver collect)."""
+        """Run all partitions -> one arrow table (driver collect).
+
+        The result stage runs as a stage-scheduler TaskSet
+        (runtime/scheduler.py): each partition is a deterministic,
+        re-runnable task, so a crashed (virtual) worker evicts + the
+        partition re-runs elsewhere, and straggling partitions get a
+        speculative duplicate under commit-once — Spark's
+        DAGScheduler/TaskSetManager semantics for the in-process
+        engine."""
         from spark_rapids_tpu.columnar.arrow_bridge import device_to_arrow
+        from spark_rapids_tpu.runtime.scheduler import (
+            StageScheduler,
+            Task,
+            tree_consuming,
+        )
         from spark_rapids_tpu.sqltypes.datatypes import to_arrow_type
 
         self._premater_cached_entries()
-        tables: List[Optional[pa.Table]] = [None] * self.num_partitions
 
-        def run(pid: int):
+        def run(pid: int, _attempt: int) -> Optional[pa.Table]:
             from spark_rapids_tpu.runtime.profiler import (
                 annotate_with_metric,
             )
@@ -135,16 +146,18 @@ class PhysicalPlan:
                 raise
             finally:
                 sem.get().release_if_necessary(task_id)
-            if parts:
-                tables[pid] = pa.concat_tables(parts, promote_options="none")
-                self._maybe_dump(tables[pid], pid)
+            if not parts:
+                return None
+            out = pa.concat_tables(parts, promote_options="none")
+            self._maybe_dump(out, pid)
+            return out
 
         n = self.num_partitions
-        if n == 1:
-            run(0)
-        else:
-            with ThreadPoolExecutor(max_workers=min(8, n)) as pool:
-                list(pool.map(run, range(n)))
+        sched = StageScheduler(self.conf, name="result",
+                               rerunnable=not tree_consuming(self))
+        tables = sched.run(
+            [Task(pid, run=lambda a, p=pid: run(p, a),
+                  lineage=f"result pid={pid}") for pid in range(n)])
         good = [t for t in tables if t is not None and t.num_rows >= 0]
         if not good:
             arrow_schema = pa.schema([

@@ -1467,9 +1467,15 @@ class CpuHashAggregateExec(PhysicalPlan):
 class TpuShuffleExchangeExec(PhysicalPlan):
     """Device hash/round-robin/single partitioning + in-process shuffle.
 
-    Map side runs once (driven by the first reduce task to arrive),
-    device-partitioning each child batch and storing contiguous arrow
-    slices; reduce side fetches + coalesces back to device.
+    Map side runs once as a stage-scheduler TaskSet (driven by the
+    first reduce task to arrive): each map task is a deterministic,
+    re-runnable attempt over one child partition (lineage = child
+    subtree + partition id) whose output blocks stay STAGED under
+    (map_id, attempt) until the scheduler commits them — commit-once
+    makes speculative duplicates safe, and `fetch_blocks` recomputes
+    exactly the map task owning blocks a reducer lost
+    (runtime/scheduler.py). Reduce side fetches + coalesces back to
+    device.
     """
 
     def __init__(self, child, key_exprs: Optional[List], num_partitions,
@@ -1489,7 +1495,12 @@ class TpuShuffleExchangeExec(PhysicalPlan):
         # (RapidsCachingWriter + ShuffleBufferCatalog role)
         self._device_mode = bool(
             conf is not None and conf.get(rc.SHUFFLE_MODE) == "DEVICE")
+        # device-mode reduce fetches CONSUME blocks (closed after the
+        # last partition drains) — the scheduler must not re-run or
+        # duplicate tasks over this subtree (scheduler.tree_consuming)
+        self.consuming = self._device_mode
         self._dev_blocks: List = []  # [(SpillableBatch, np offsets)]
+        self._staged_dev: Dict = {}  # (map_id, attempt) -> blocks
         self._fetches_left = self._nparts
         # separate from _lock: map tasks park blocks WHILE the map-stage
         # coordinator holds _lock
@@ -1526,23 +1537,31 @@ class TpuShuffleExchangeExec(PhysicalPlan):
         pb = partition.round_robin_partition(batch, self._nparts)
         return pb.batch, pb.counts
 
-    def _park_device_block(self, batch: ColumnBatch, offs: np.ndarray):
+    def _park_device_block(self, batch: ColumnBatch, offs: np.ndarray,
+                           staged: List):
         from spark_rapids_tpu.runtime.memory import SpillPriority, \
             get_catalog
         from spark_rapids_tpu.runtime.retry import retry_on_oom
 
         sb = retry_on_oom(lambda: get_catalog().add_batch(
             batch, SpillPriority.INPUT_FROM_SHUFFLE))
-        with self._blocks_lock:
-            self._dev_blocks.append((sb, offs))
+        staged.append((sb, offs))
 
-    def _map_one(self, mgr, cpid: int):
-        """One map task: execute a child partition, device-partition its
-        batches, store contiguous slices (per-map-task parallel, the
-        reference's writer slots —
-        RapidsShuffleInternalManagerBase.scala:238)."""
+    def _map_task(self, mgr, cpid: int, attempt: int):
+        """One map-task ATTEMPT: execute a child partition,
+        device-partition its batches, STAGE contiguous slices under
+        (map_id=cpid, attempt) — invisible to reducers until the
+        scheduler commits this attempt (per-map-task parallel, the
+        reference's writer slots,
+        RapidsShuffleInternalManagerBase.scala:238). Deterministic:
+        the lineage (child subtree + cpid) reproduces identical blocks
+        on any re-run."""
         from spark_rapids_tpu.exec.base import new_task_context
 
+        staged_dev: List = []
+        if self._device_mode:
+            with self._blocks_lock:
+                self._staged_dev[(cpid, attempt)] = staged_dev
         tctx = new_task_context(self.conf)
         try:
             for batch in self.children[0].execute_partition(cpid, tctx):
@@ -1550,53 +1569,130 @@ class TpuShuffleExchangeExec(PhysicalPlan):
                     if self._device_mode:
                         self._park_device_block(
                             batch,
-                            np.array([0, batch.row_count()], np.int64))
+                            np.array([0, batch.row_count()], np.int64),
+                            staged_dev)
                     else:
                         mgr.put(self._shuffle_id, 0,
-                                device_to_arrow(batch))
+                                device_to_arrow(batch),
+                                map_id=cpid, attempt=attempt)
                     continue
                 sorted_batch, counts = self._jit_partition(batch)
                 offs = np.concatenate(
                     [[0], np.cumsum(np.asarray(counts))])
                 if self._device_mode:
-                    self._park_device_block(sorted_batch, offs)
+                    self._park_device_block(sorted_batch, offs,
+                                            staged_dev)
                     continue
                 host = device_to_arrow(sorted_batch)
                 for rp in range(self._nparts):
                     lo, hi = int(offs[rp]), int(offs[rp + 1])
                     if hi > lo:
                         mgr.put(self._shuffle_id, rp,
-                                host.slice(lo, hi - lo))
+                                host.slice(lo, hi - lo),
+                                map_id=cpid, attempt=attempt)
         finally:
             sem.get().release_if_necessary(tctx.task_id)
 
+    def _commit_map(self, mgr, cpid: int, attempt: int,
+                    replace: bool = False):
+        if self._device_mode:
+            with self._blocks_lock:
+                blocks = self._staged_dev.pop((cpid, attempt), [])
+                self._dev_blocks.extend(blocks)
+        else:
+            mgr.commit_map_output(self._shuffle_id, cpid, attempt,
+                                  replace=replace)
+
+    def _abort_map(self, mgr, cpid: int, attempt: int):
+        if self._device_mode:
+            with self._blocks_lock:
+                blocks = self._staged_dev.pop((cpid, attempt), [])
+            for sb, _ in blocks:
+                sb.close()
+        else:
+            mgr.discard_attempt(self._shuffle_id, cpid, attempt)
+
     def _run_map_stage(self, ctx):
+        from spark_rapids_tpu.runtime.scheduler import (
+            StageScheduler,
+            Task,
+            tree_consuming,
+        )
+
         with self._lock:
             if self._map_done:
                 return
             mgr = get_shuffle_manager()
             self._shuffle_id = mgr.new_shuffle_id()
             nchild = self.children[0].num_partitions
+            tasks = [
+                Task(c,
+                     run=lambda attempt, c=c:
+                         self._map_task(mgr, c, attempt),
+                     commit=lambda _res, attempt, c=c:
+                         self._commit_map(mgr, c, attempt),
+                     abort=lambda attempt, c=c:
+                         self._abort_map(mgr, c, attempt),
+                     lineage=f"map shuffle={self._shuffle_id} "
+                             f"cpid={c}")
+                for c in range(nchild)]
+            sched = StageScheduler(
+                self.conf, name=f"shuffle{self._shuffle_id}-map",
+                rerunnable=not tree_consuming(self.children[0]))
             try:
-                if nchild == 1:
-                    self._map_one(mgr, 0)
-                else:
-                    from concurrent.futures import ThreadPoolExecutor
-
-                    with ThreadPoolExecutor(
-                            max_workers=min(8, nchild),
-                            thread_name_prefix="shuffle-map") as pool:
-                        list(pool.map(lambda c: self._map_one(mgr, c),
-                                      range(nchild)))
+                sched.run(tasks)
             except BaseException:
-                # close partially-parked device blocks so a failed map
-                # stage leaks nothing and a retry starts clean
+                # a failed map stage leaks nothing: close committed
+                # device blocks and drop this shuffle's host blocks
+                # (staged attempts included) so a retry starts clean
                 with self._blocks_lock:
                     blocks, self._dev_blocks = self._dev_blocks, []
                 for sb, _ in blocks:
                     sb.close()
+                if not self._device_mode:
+                    mgr.remove_shuffle(self._shuffle_id)
                 raise
             self._map_done = True
+
+    def fetch_blocks(self, pid: int) -> List[pa.Table]:
+        """Reduce-side fetch with LOST-OUTPUT RECOVERY: a
+        ShuffleFetchError that survived the block-level retry budget
+        and names its owning map task re-runs ONLY that task from its
+        lineage (bounded by spark.rapids.tpu.stage.maxAttempts), then
+        retries the fetch — the DAGScheduler's missing-map-output
+        resubmission, scoped to single tasks."""
+        from spark_rapids_tpu.config import rapids_conf as rc
+        from spark_rapids_tpu.runtime.errors import ShuffleFetchError
+
+        mgr = get_shuffle_manager()
+        max_att = (self.conf.get(rc.STAGE_MAX_ATTEMPTS)
+                   if self.conf is not None
+                   else rc.STAGE_MAX_ATTEMPTS.default)
+        for att in range(max(1, max_att)):
+            try:
+                return mgr.fetch(self._shuffle_id, pid)
+            except ShuffleFetchError as e:
+                map_id = getattr(e, "map_id", None)
+                if map_id is None or att + 1 >= max_att:
+                    raise
+                self._recompute_map_output(mgr, map_id)
+        raise AssertionError("unreachable")  # pragma: no cover
+
+    def _recompute_map_output(self, mgr, map_id: int):
+        """Re-run one lost map task from lineage and atomically replace
+        its blocks (identical by determinism, so reducers that already
+        fetched other partitions stay consistent)."""
+        from spark_rapids_tpu.runtime import scheduler as _sched
+
+        with self._lock:  # serialize recomputes across reduce tasks
+            attempt = mgr.recompute_attempt(self._shuffle_id, map_id)
+            try:
+                self._map_task(mgr, map_id, attempt)
+            except BaseException:
+                self._abort_map(mgr, map_id, attempt)
+                raise
+            self._commit_map(mgr, map_id, attempt, replace=True)
+            _sched.stats.add("recomputedPartitions")
 
     def _fetch_device(self, pid) -> Iterator[ColumnBatch]:
         """Reduce-side device fetch: gather this partition's row range
@@ -1657,8 +1753,7 @@ class TpuShuffleExchangeExec(PhysicalPlan):
             _acquire(ctx)
             yield from self._fetch_device(pid)
             return
-        mgr = get_shuffle_manager()
-        tables = mgr.fetch(self._shuffle_id, pid)
+        tables = self.fetch_blocks(pid)
         if not tables:
             return
         merged = pa.concat_tables(tables, promote_options="none")
@@ -1760,7 +1855,12 @@ class TpuRangeShuffleExchangeExec(TpuShuffleExchangeExec):
                 offs = np.concatenate([[0],
                                        np.cumsum(np.asarray(pb.counts))])
                 if self._device_mode:
-                    self._park_device_block(pb.batch, offs)
+                    # range map stage is single-attempt (sampling spans
+                    # every child partition): blocks commit directly
+                    staged: List = []
+                    self._park_device_block(pb.batch, offs, staged)
+                    with self._blocks_lock:
+                        self._dev_blocks.extend(staged)
                     sb.close()
                     continue
                 host = device_to_arrow(pb.batch)
@@ -1789,10 +1889,15 @@ class CpuShuffleExchangeExec(PhysicalPlan):
     def num_partitions(self):
         return self._nparts
 
-    def _map_one(self, mgr, cpid: int, ctx):
+    def _map_task(self, mgr, cpid: int, attempt: int, ctx):
+        """One deterministic CPU map-task attempt: staged, attempt-
+        tagged puts — same commit-once / lost-output lineage discipline
+        as the device exchange, so the CPU-oracle engine recovers
+        identically."""
         for table in self.children[0].execute_partition(cpid, ctx):
             if self._nparts == 1:
-                mgr.put(self._shuffle_id, 0, table)
+                mgr.put(self._shuffle_id, 0, table,
+                        map_id=cpid, attempt=attempt)
                 continue
             if self.key_exprs is None:
                 # round-robin (repartition(n) without keys)
@@ -1800,7 +1905,8 @@ class CpuShuffleExchangeExec(PhysicalPlan):
                 for rp in range(self._nparts):
                     piece = table.filter(pa.array(pid_arr == rp))
                     if piece.num_rows:
-                        mgr.put(self._shuffle_id, rp, piece)
+                        mgr.put(self._shuffle_id, rp, piece,
+                                map_id=cpid, attempt=attempt)
                 continue
             # CPU murmur3 partition matching device partitioning
             # (native murmur3_host kernel via cpu_eval when available)
@@ -1815,31 +1921,70 @@ class CpuShuffleExchangeExec(PhysicalPlan):
                 mask = pa.array(pid_arr == rp)
                 piece = table.filter(mask)
                 if piece.num_rows:
-                    mgr.put(self._shuffle_id, rp, piece)
+                    mgr.put(self._shuffle_id, rp, piece,
+                            map_id=cpid, attempt=attempt)
 
     def _run_map_stage(self, ctx):
+        from spark_rapids_tpu.runtime.scheduler import (
+            StageScheduler,
+            Task,
+        )
+
         with self._lock:
             if self._map_done:
                 return
             mgr = get_shuffle_manager()
             self._shuffle_id = mgr.new_shuffle_id()
             nchild = self.children[0].num_partitions
-            if nchild == 1:
-                self._map_one(mgr, 0, ctx)
-            else:
-                from concurrent.futures import ThreadPoolExecutor
-
-                with ThreadPoolExecutor(
-                        max_workers=min(8, nchild),
-                        thread_name_prefix="shuffle-map") as pool:
-                    list(pool.map(
-                        lambda c: self._map_one(mgr, c, ctx),
-                        range(nchild)))
+            sid = self._shuffle_id
+            tasks = [
+                Task(c,
+                     run=lambda attempt, c=c:
+                         self._map_task(mgr, c, attempt, ctx),
+                     commit=lambda _res, attempt, c=c:
+                         mgr.commit_map_output(sid, c, attempt),
+                     abort=lambda attempt, c=c:
+                         mgr.discard_attempt(sid, c, attempt),
+                     lineage=f"cpu-map shuffle={sid} cpid={c}")
+                for c in range(nchild)]
+            try:
+                StageScheduler(self.conf,
+                               name=f"shuffle{sid}-cpumap").run(tasks)
+            except BaseException:
+                mgr.remove_shuffle(sid)
+                raise
             self._map_done = True
 
     def execute_partition(self, pid, ctx):
+        from spark_rapids_tpu.config import rapids_conf as rc
+        from spark_rapids_tpu.runtime import scheduler as _sched
+        from spark_rapids_tpu.runtime.errors import ShuffleFetchError
+
         self._run_map_stage(ctx)
-        tables = get_shuffle_manager().fetch(self._shuffle_id, pid)
+        mgr = get_shuffle_manager()
+        max_att = (self.conf.get(rc.STAGE_MAX_ATTEMPTS)
+                   if self.conf is not None
+                   else rc.STAGE_MAX_ATTEMPTS.default)
+        for att in range(max(1, max_att)):
+            try:
+                tables = mgr.fetch(self._shuffle_id, pid)
+                break
+            except ShuffleFetchError as e:
+                map_id = getattr(e, "map_id", None)
+                if map_id is None or att + 1 >= max_att:
+                    raise
+                with self._lock:
+                    attempt = mgr.recompute_attempt(self._shuffle_id,
+                                                    map_id)
+                    try:
+                        self._map_task(mgr, map_id, attempt, ctx)
+                    except BaseException:
+                        mgr.discard_attempt(self._shuffle_id, map_id,
+                                            attempt)
+                        raise
+                    mgr.commit_map_output(self._shuffle_id, map_id,
+                                          attempt, replace=True)
+                    _sched.stats.add("recomputedPartitions")
         if tables:
             yield pa.concat_tables(tables, promote_options="none")
 

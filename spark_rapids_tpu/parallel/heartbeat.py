@@ -44,7 +44,12 @@ class HeartbeatManager:
     track the highest seq they have seen and each heartbeat returns the
     live peers with a higher seq. Prunes never move sequence numbers,
     so discovery survives arbitrary death/registration interleavings;
-    a heartbeat from a pruned executor gets `reregister` back."""
+    a heartbeat from a pruned executor gets `reregister` back.
+
+    Dead-peer surface (the stage scheduler's eviction feed,
+    runtime/scheduler.py): expired or explicitly evicted executors land
+    in `dead_peers()` and fire `on_death` callbacks; a re-registering
+    executor gets a FRESH seq and leaves the dead set."""
 
     def __init__(self, timeout_ms: int = 30000):
         self._peers: Dict[str, PeerInfo] = {}
@@ -52,10 +57,41 @@ class HeartbeatManager:
         self._seq = 0
         self._lock = threading.Lock()
         self.timeout_ms = timeout_ms
+        self._dead: Dict[str, float] = {}  # executor_id -> death time
+        self._death_cbs: List[Callable[[str], None]] = []
+
+    def on_death(self, cb: Callable[[str], None]) -> None:
+        """Register a callback fired (outside the registry lock) with
+        each executor id the moment it is pruned or evicted."""
+        with self._lock:
+            self._death_cbs.append(cb)
+
+    def dead_peers(self) -> List[str]:
+        """Snapshot of executors that died (heartbeat expiry or
+        eviction) and have not re-registered since."""
+        newly = self._collect_dead()
+        self._fire(newly)
+        with self._lock:
+            return sorted(self._dead)
+
+    def evict(self, executor_id: str) -> None:
+        """Explicit eviction (scheduler-observed failure): remove from
+        the live registry and mark dead; the executor may re-register
+        later and will get a fresh seq."""
+        with self._lock:
+            was_live = self._peers.pop(executor_id, None) is not None
+            self._last_seen.pop(executor_id, None)
+            if was_live or executor_id not in self._dead:
+                self._dead[executor_id] = time.monotonic()
+                newly = [executor_id]
+            else:
+                newly = []
+        self._fire(newly)
 
     def register(self, executor_id: str, host: str, port: int):
         with self._lock:
             self._seq += 1
+            self._dead.pop(executor_id, None)  # resurrection
             self._peers[executor_id] = PeerInfo(
                 executor_id=executor_id, host=host, port=port,
                 seq=self._seq)
@@ -72,22 +108,45 @@ class HeartbeatManager:
             if executor_id not in self._peers:
                 return None, self._seq
             self._last_seen[executor_id] = time.monotonic()
-            self._prune_locked()
+            newly = self._prune_locked()
             fresh = [p for e, p in self._peers.items()
                      if e != executor_id and p["seq"] > last_seq]
-            return fresh, self._seq
+            result = fresh, self._seq
+        self._fire(newly)
+        return result
 
     def live_peers(self) -> List[PeerInfo]:
+        newly = self._collect_dead()
+        self._fire(newly)
         with self._lock:
-            self._prune_locked()
             return list(self._peers.values())
 
-    def _prune_locked(self):
+    def _collect_dead(self) -> List[str]:
+        with self._lock:
+            return self._prune_locked()
+
+    def _fire(self, newly_dead: List[str]) -> None:
+        """Death callbacks run OUTSIDE the lock: a callback may call
+        back into the registry (eviction bookkeeping) freely."""
+        if not newly_dead:
+            return
+        with self._lock:
+            cbs = list(self._death_cbs)
+        for e in newly_dead:
+            for cb in cbs:
+                try:
+                    cb(e)
+                except Exception:
+                    pass  # a listener must never break the plane
+
+    def _prune_locked(self) -> List[str]:
         deadline = time.monotonic() - self.timeout_ms / 1000.0
         dead = [e for e, ts in self._last_seen.items() if ts < deadline]
         for e in dead:
             self._peers.pop(e, None)
             self._last_seen.pop(e, None)
+            self._dead[e] = time.monotonic()
+        return dead
 
 
 class _Handler(socketserver.StreamRequestHandler):

@@ -1,0 +1,288 @@
+"""Cross-process worker pool — the multi-executor backend of the stage
+scheduler (runtime/scheduler.py).
+
+The SPMD mesh engine (parallel/plan_compiler.py) is all-or-nothing: a
+dead process deadlocks the collectives. This pool is the complementary
+task-parallel transport, shaped like the reference's executor fleet
+(one OS process per executor, driver-side liveness via the heartbeat
+plane): the driver hands each worker picklable task attempts — a
+LINEAGE DESCRIPTOR of (importable fragment function, input split +
+plan-fragment args) — and a `kill -9`'d worker is a NORMAL event:
+
+- liveness: each worker registers with the driver's HeartbeatServer
+  (parallel/heartbeat.py) and beats on a daemon thread; the pool's
+  `check_lost` merges heartbeat expiry (`dead_peers`) with the OS-level
+  process sentinel, so a SIGKILL is noticed within one beat interval.
+- eviction: a lost worker is excluded for the session
+  (`evicted_workers`); its in-flight partitions are re-dispatched to
+  surviving workers by the scheduler (recomputedPartitions).
+- results travel a shared queue; per-worker task queues make
+  reassignment race-free (a dead worker's queued tasks are simply
+  re-sent elsewhere — tasks are deterministic and commit-once).
+
+`run_scan_agg_fragment` is the built-in executable form of a scan →
+filter → grouped-partial-aggregation lineage fragment (pyarrow
+semantics, matching the CPU oracle) used by the multiprocess recovery
+tests and as the reference shape for custom fragments.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import pickle
+import queue as _queue
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+
+def _import_callable(path: str):
+    """'package.module:function' -> callable."""
+    import importlib
+
+    mod, _, fn = path.partition(":")
+    if not fn:
+        raise ValueError(f"fragment path {path!r} is not module:function")
+    return getattr(importlib.import_module(mod), fn)
+
+
+def run_scan_agg_fragment(spec: dict):
+    """Execute one scan->filter->partial-agg lineage fragment.
+
+    spec = {
+      "files":   [parquet paths]          # this task's input split
+      "filter":  (col, pc_fn_name, value) # optional, e.g. ("v","greater",0.2)
+      "derive_mod": (name, src, modulus)  # optional derived group key
+      "keys":    [group column names]
+      "aggs":    [(col, "sum"|"count"|...)]
+      "sleep_s": float                    # optional straggler/testing stall
+    }
+    Returns the PARTIAL pyarrow aggregate for the split; the driver
+    merges partials. Pure + deterministic per spec — safe to re-run on
+    any worker at any time.
+    """
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.compute as pc
+    import pyarrow.parquet as pq
+
+    if spec.get("sleep_s"):
+        time.sleep(float(spec["sleep_s"]))
+    t = pa.concat_tables([pq.read_table(p) for p in spec["files"]])
+    f = spec.get("filter")
+    if f is not None:
+        col, op, val = f
+        t = t.filter(getattr(pc, op)(t.column(col), val))
+    d = spec.get("derive_mod")
+    if d is not None:
+        name, src, modulus = d
+        g = np.asarray(t.column(src)) % int(modulus)
+        t = t.append_column(name, pa.array(g, type=pa.int64()))
+    return t.group_by(list(spec["keys"])).aggregate(
+        [tuple(a) for a in spec["aggs"]])
+
+
+def _worker_main(worker_id: str, task_q, result_q, hb_addr,
+                 hb_interval_ms: int) -> None:
+    """Worker process loop: register with the heartbeat plane, then
+    drain the private task queue until the None sentinel. A task is
+    (stage, task_index, attempt, fragment_path, args); results are
+    pickled so arbitrary fragment outputs travel the shared queue."""
+    client = None
+    if hb_addr is not None:
+        from spark_rapids_tpu.parallel.heartbeat import HeartbeatClient
+
+        try:
+            client = HeartbeatClient(tuple(hb_addr), worker_id,
+                                     "127.0.0.1", 0,
+                                     interval_ms=hb_interval_ms)
+        except OSError:
+            pass  # driver plane gone; the sentinel still covers us
+    result_q.put(("ready", worker_id, None, None, None))
+    while True:
+        item = task_q.get()
+        if item is None:
+            break
+        stage, idx, attempt, fn_path, args = item
+        try:
+            fn = _import_callable(fn_path)
+            out = pickle.dumps(fn(args))
+            result_q.put(("ok", worker_id, stage, idx, attempt, out))
+        except BaseException:
+            result_q.put(("err", worker_id, stage, idx, attempt,
+                          traceback.format_exc()))
+    if client is not None:
+        client.close()
+
+
+class _WorkerHandle:
+    __slots__ = ("proc", "task_q")
+
+    def __init__(self, proc, task_q):
+        self.proc = proc
+        self.task_q = task_q
+
+
+class ProcessWorkerPool:
+    """N worker processes + driver-side heartbeat plane + shared result
+    queue. Survives kill -9 of individual workers; all-workers-dead
+    surfaces as a clean WorkerLost from the scheduler."""
+
+    def __init__(self, num_workers: int = 2,
+                 start_method: Optional[str] = None,
+                 heartbeat: bool = True,
+                 hb_interval_ms: int = 100,
+                 hb_timeout_ms: int = 1500):
+        from spark_rapids_tpu.parallel.heartbeat import HeartbeatServer
+
+        methods = mp.get_all_start_methods()
+        # fork keeps worker startup instant (no re-import of the
+        # engine); workers only run pyarrow fragments, never the jax
+        # backend, so forking under an initialized backend is safe
+        method = start_method or (
+            "fork" if "fork" in methods else "spawn")
+        ctx = mp.get_context(method)
+        self._result_q = ctx.Queue()
+        self._hb_server = HeartbeatServer(timeout_ms=hb_timeout_ms) \
+            if heartbeat else None
+        self._hb_dead: set = set()
+        self._lock = threading.Lock()
+        if self._hb_server is not None:
+            self._hb_server.manager.on_death(self._on_hb_death)
+        self._workers: Dict[str, _WorkerHandle] = {}
+        self._excluded: set = set()
+        hb_addr = (list(self._hb_server.address)
+                   if self._hb_server is not None else None)
+        for i in range(max(1, num_workers)):
+            wid = f"worker-{i}"
+            task_q = ctx.Queue()
+            proc = ctx.Process(
+                target=_worker_main,
+                args=(wid, task_q, self._result_q, hb_addr,
+                      hb_interval_ms),
+                name=f"srtpu-{wid}", daemon=True)
+            proc.start()
+            self._workers[wid] = _WorkerHandle(proc, task_q)
+
+    def _on_hb_death(self, executor_id: str) -> None:
+        with self._lock:
+            if executor_id in self._workers:
+                self._hb_dead.add(executor_id)
+
+    # --- scheduler-facing surface ---
+
+    def live_workers(self) -> List[str]:
+        with self._lock:
+            return [w for w in self._workers if w not in self._excluded]
+
+    def evicted_workers(self) -> List[str]:
+        with self._lock:
+            return sorted(self._excluded)
+
+    def worker_pid(self, worker_id: str) -> int:
+        return self._workers[worker_id].proc.pid
+
+    def submit(self, worker_id: str, item: tuple) -> None:
+        self._workers[worker_id].task_q.put(item)
+
+    def poll(self, timeout: float):
+        try:
+            return self._result_q.get(timeout=timeout)
+        except _queue.Empty:
+            return None
+
+    def check_lost(self) -> List[str]:
+        """Workers newly observed dead: heartbeat expiry (dead_peers
+        triggers the prune) OR the OS process sentinel."""
+        if self._hb_server is not None:
+            self._hb_server.manager.dead_peers()  # prunes + fires cbs
+        lost = []
+        with self._lock:
+            for wid, h in self._workers.items():
+                if wid in self._excluded:
+                    continue
+                if not h.proc.is_alive() or wid in self._hb_dead:
+                    lost.append(wid)
+        return lost
+
+    def evict(self, worker_id: str) -> None:
+        """Exclude for the session; reap the process if still running."""
+        with self._lock:
+            if worker_id in self._excluded:
+                return
+            self._excluded.add(worker_id)
+            h = self._workers.get(worker_id)
+        if self._hb_server is not None:
+            self._hb_server.manager.evict(worker_id)
+        if h is not None and h.proc.is_alive():
+            h.proc.terminate()
+            h.proc.join(timeout=1.0)
+
+    def close(self) -> None:
+        for wid, h in self._workers.items():
+            if wid not in self._excluded and h.proc.is_alive():
+                try:
+                    h.task_q.put(None)
+                except Exception:
+                    pass
+        deadline = time.monotonic() + 2.0
+        for h in self._workers.values():
+            h.proc.join(timeout=max(0.0, deadline - time.monotonic()))
+            if h.proc.is_alive():
+                h.proc.terminate()
+        if self._hb_server is not None:
+            self._hb_server.close()
+
+
+class ProcessBackend:
+    """Adapt a ProcessWorkerPool to the StageScheduler backend API.
+    Tasks MUST carry a picklable `payload` lineage descriptor; the
+    in-memory `run` closure cannot cross a process boundary."""
+
+    def __init__(self, pool: ProcessWorkerPool):
+        self.pool = pool
+
+    def workers(self) -> List[str]:
+        return self.pool.live_workers()
+
+    def parallelism(self) -> int:
+        return max(1, len(self.pool.live_workers()))
+
+    def replacement_worker(self) -> Optional[str]:
+        return None  # real processes: eviction is for the session
+
+    def submit(self, task, attempt: int, worker: str, _fn, _on_orphan,
+               stage: int) -> None:
+        if task.payload is None:
+            raise TypeError(
+                f"task {task.index} has no picklable payload — the "
+                f"process backend needs a (module:function, args) "
+                f"lineage descriptor")
+        fn_path, args = task.payload
+        self.pool.submit(worker, (stage, task.index, attempt, fn_path,
+                                  args))
+
+    def poll(self, timeout: float):
+        ev = self.pool.poll(timeout)
+        if ev is None or ev[0] == "ready":
+            return None
+        kind, wid, stage, idx, attempt = ev[0], ev[1], ev[2], ev[3], \
+            ev[4]
+        value: Any = ev[5]
+        if kind == "ok":
+            value = pickle.loads(value)
+        else:
+            value = RuntimeError(
+                f"task {idx} attempt {attempt} failed on {wid}:\n"
+                f"{value}")
+        return (kind, idx, attempt, wid, value, stage)
+
+    def lost_workers(self) -> List[str]:
+        return self.pool.check_lost()
+
+    def evict(self, worker: str) -> None:
+        self.pool.evict(worker)
+
+    def close(self) -> List[tuple]:
+        return []  # the pool outlives individual stages

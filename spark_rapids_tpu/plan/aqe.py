@@ -117,10 +117,10 @@ class SkewSliceShuffleReadExec(PhysicalPlan):
             arrow_to_device,
         )
         from spark_rapids_tpu.exec.operators import _acquire
-        from spark_rapids_tpu.shuffle.manager import get_shuffle_manager
 
         ex._run_map_stage(ctx)
-        tables = get_shuffle_manager().fetch(ex._shuffle_id, src)
+        # lost-output-recovering fetch (runtime/scheduler.py lineage)
+        tables = ex.fetch_blocks(src)
         if not tables:
             return
         t = pa.concat_tables(tables, promote_options="none")
@@ -424,12 +424,10 @@ class AdaptiveQueryExecutor:
     def _collect_build_keys(self, ex: ops.TpuShuffleExchangeExec,
                             key_expr):
         from spark_rapids_tpu.exec import cpu_eval
-        from spark_rapids_tpu.shuffle.manager import get_shuffle_manager
 
-        mgr = get_shuffle_manager()
         out = set()
         for rp in range(ex.num_partitions):
-            for t in mgr.fetch(ex._shuffle_id, rp):
+            for t in ex.fetch_blocks(rp):
                 try:
                     arr = cpu_eval.eval_expr(key_expr, t)
                 except Exception:

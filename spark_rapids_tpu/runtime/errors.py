@@ -52,7 +52,29 @@ class ShuffleChecksumError(EngineIOError):
 
 class ShuffleFetchError(EngineIOError):
     """A shuffle block could not be fetched/decoded after the retry
-    budget; names the (shuffle_id, reduce_pid) block."""
+    budget; names the (shuffle_id, reduce_pid) block. When the lost
+    block was written by an attempt-tagged map task, `map_id` names the
+    owning map partition so the stage scheduler can recompute exactly
+    that task from its lineage (runtime/scheduler.py)."""
+
+    def __init__(self, msg: str, map_id=None):
+        self.map_id = map_id
+        super().__init__(msg)
+
+
+class WorkerLost(RuntimeError):
+    """A task attempt's worker died under it — process crash, heartbeat
+    expiry, or an injected worker.crash fault. Retryable: the stage
+    scheduler evicts the worker and re-runs the in-flight partitions
+    elsewhere (the FetchFailed/ExecutorLost recovery role of Spark's
+    DAGScheduler)."""
+
+    def __init__(self, worker_id: str, detail: str = ""):
+        self.worker_id = worker_id
+        msg = f"worker {worker_id} lost"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
 
 
 class SpillFileError(EngineIOError):

@@ -16,6 +16,18 @@ locations where the real world fails —
     device.dispatch     fused/eager program dispatch (exec/fused.py,
                         api/dataframe.py) — the site that exercises the
                         degradation ladder end to end
+    worker.crash        task-attempt launch in the stage scheduler
+                        (runtime/scheduler.py) — the attempt dies as if
+                        its worker was kill -9'd; the scheduler evicts
+                        the worker and re-runs the partition
+    task.straggler      task-attempt launch in the stage scheduler —
+                        the attempt stalls instead of dying, exercising
+                        speculative execution's duplicate-attempt +
+                        commit-once path
+    shuffle.lost_output shuffle block reads of attempt-tagged map
+                        output (shuffle/manager.py) — the block is gone
+                        AFTER the block-level retry budget, exercising
+                        lineage recomputation of the owning map task
 
 and every site's CONSUMER survives the injected fault: backoff retries
 (runtime/backoff.py), quarantine-and-recompile, or engine demotion.
@@ -51,6 +63,9 @@ KNOWN_SITES = (
     "compile.cache_load",
     "spill.disk",
     "device.dispatch",
+    "worker.crash",
+    "task.straggler",
+    "shuffle.lost_output",
 )
 
 

@@ -1,0 +1,455 @@
+"""Resilient stage scheduler — the DAGScheduler / TaskSetManager analog.
+
+PR 2 hardened every *intra-process* failure domain (CRC'd blocks,
+backoff, the degradation ladder); this layer recovers *task-shaped*
+failures the way the reference plugin inherits them from Spark's
+DAGScheduler (stage re-attempts, lost-map-output recomputation,
+executor exclusion, speculation — TaskSetManager.scala /
+DAGScheduler.scala roles):
+
+- Each stage is a TaskSet of DETERMINISTIC, re-runnable task attempts.
+  A `Task` carries its lineage (a partition index + a closure over the
+  plan fragment that recomputes it from source), so any partition can
+  be re-produced at any time.
+- **Worker eviction**: an attempt that dies with `WorkerLost` (a real
+  process crash in the process backend, heartbeat expiry, or an
+  injected `worker.crash` fault) evicts its worker for the session and
+  re-runs the in-flight partition on another worker, bounded by
+  `spark.rapids.tpu.stage.maxAttempts`.
+- **Speculation**: once `speculation.quantile` of the stage completed,
+  tasks running longer than `speculation.multiplier` x the median get a
+  duplicate attempt. Output is attempt-tagged (shuffle staging in
+  shuffle/manager.py, the PendingBatches discipline generalized) and
+  COMMIT-ONCE: the first attempt to finish commits, the loser's output
+  is discarded — never double-counted, never leaked.
+- **Lost-output recovery** rides the same Task machinery from the
+  exchange side: `TpuShuffleExchangeExec.fetch_blocks` catches a
+  `ShuffleFetchError` that survived the block-level retry budget and
+  re-runs ONLY the upstream map task owning the missing blocks
+  (`stats.recomputedPartitions`).
+
+Two backends execute attempts: the in-process `ThreadBackend` (virtual
+workers over a thread pool — the default for the single-process
+engine), and `parallel/process_pool.ProcessBackend` (real OS worker
+processes with heartbeat liveness, where `kill -9` is survivable).
+
+Chaos sites `worker.crash` and `task.straggler` (runtime/faults.py)
+inject at attempt launch so ci/chaos_check.sh proves result
+equivalence under crash-retry and speculative duplication.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import queue
+import statistics
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from spark_rapids_tpu.runtime.errors import WorkerLost
+from spark_rapids_tpu.runtime.faults import InjectedFault
+
+# --------------------------------------------------------------- stats
+
+_FIELDS = ("tasksLaunched", "tasksRetried", "tasksSpeculated",
+           "speculativeWins", "recomputedPartitions", "evictedWorkers",
+           "stagesRun")
+
+
+class _SchedulerStats:
+    """Process-wide scheduler ledger (the compile_cache.stats pattern):
+    per-query deltas land in last_execution['scheduler'], totals in
+    session.robustness_metrics['scheduler']."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._v = {f: 0 for f in _FIELDS}
+
+    def add(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            self._v[field] += n
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._v)
+
+    @staticmethod
+    def delta(before: Dict[str, int], after: Dict[str, int]
+              ) -> Dict[str, int]:
+        return {k: after[k] - before.get(k, 0) for k in after}
+
+
+stats = _SchedulerStats()
+
+_stage_token = itertools.count(1)
+
+
+def tree_consuming(plan) -> bool:
+    """True when any node in a physical subtree CONSUMES state on read
+    (e.g. DEVICE-mode exchange fetches close blocks after one pass) —
+    such lineage is not re-runnable, so the scheduler disables
+    speculation and crash-retry for stages over it."""
+    if getattr(plan, "consuming", False):
+        return True
+    return any(tree_consuming(c) for c in getattr(plan, "children", []))
+
+
+# ---------------------------------------------------------------- task
+
+class Task:
+    """One deterministic unit of a stage.
+
+    - `run(attempt) -> result`: execute the lineage (thread backend).
+    - `payload = ("module:function", args)`: picklable form for the
+      process backend; args must fully describe the input split + plan
+      fragment so any worker can recompute the partition.
+    - `commit(result, attempt)`: called EXACTLY ONCE, for the winning
+      attempt (publish staged shuffle output / record the result).
+    - `abort(attempt)`: discard a losing/failed attempt's staged
+      output. Must be idempotent.
+    """
+
+    __slots__ = ("index", "run", "payload", "commit", "abort", "lineage")
+
+    def __init__(self, index: int,
+                 run: Optional[Callable[[int], Any]] = None,
+                 payload: Optional[Tuple[str, Any]] = None,
+                 commit: Optional[Callable[[Any, int], None]] = None,
+                 abort: Optional[Callable[[int], None]] = None,
+                 lineage: str = ""):
+        self.index = index
+        self.run = run
+        self.payload = payload
+        self.commit = commit
+        self.abort = abort
+        self.lineage = lineage
+
+
+# ------------------------------------------------------- thread backend
+
+class ThreadBackend:
+    """Virtual workers over a thread pool — the single-process engine's
+    default. Worker ids are labels for the eviction bookkeeping; the
+    pool itself is shared. `close()` abandons in-flight attempts
+    (shutdown(wait=False)); a late completion self-aborts via the
+    orphan callback, so losing speculative attempts never leak staged
+    output."""
+
+    def __init__(self, max_parallel: int = 8, name: str = "stage"):
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, max_parallel),
+            thread_name_prefix=f"sched-{name}")
+        self._n = max(1, max_parallel)
+        self._repl = itertools.count(0)
+        self._q: "queue.Queue" = queue.Queue()
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def workers(self) -> List[str]:
+        return [f"local-{i}" for i in range(self._n)]
+
+    def parallelism(self) -> int:
+        return self._n
+
+    def replacement_worker(self) -> Optional[str]:
+        # virtual workers are free: an evicted one is replaced so the
+        # stage keeps its concurrency (a cluster manager restarting an
+        # executor elsewhere)
+        return f"local-r{next(self._repl)}"
+
+    def submit(self, task: Task, attempt: int, worker: str,
+               fn: Callable[[], Any], on_orphan: Callable, stage: int
+               ) -> None:
+        def _run():
+            try:
+                ev = ("ok", task.index, attempt, worker, fn(), stage)
+            except WorkerLost as e:
+                ev = ("lost", task.index, attempt, worker, e, stage)
+            except InjectedFault as e:
+                kind = "lost" if e.site == "worker.crash" else "err"
+                ev = (kind, task.index, attempt, worker, e, stage)
+            except BaseException as e:
+                ev = ("err", task.index, attempt, worker, e, stage)
+            with self._lock:
+                if not self._closed:
+                    self._q.put(ev)
+                    return
+            on_orphan(ev)
+
+        self._pool.submit(_run)
+
+    def poll(self, timeout: float):
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def lost_workers(self) -> List[str]:
+        return []
+
+    def evict(self, worker: str) -> None:
+        pass
+
+    def close(self) -> List[tuple]:
+        """Mark closed and return queued-but-unprocessed events (the
+        caller aborts their output); in-flight attempts self-orphan."""
+        with self._lock:
+            self._closed = True
+            drained = []
+            while True:
+                try:
+                    drained.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+        self._pool.shutdown(wait=False)
+        return drained
+
+
+# ------------------------------------------------------------ scheduler
+
+class StageScheduler:
+    """Drive one TaskSet to completion with retry, eviction and
+    speculation. Results return in task-index order. Terminal failures
+    (non-retryable exceptions, or a retryable one past the attempt
+    budget) propagate after all in-flight attempts drain — no attempt
+    outlives the stage with uncommitted side effects unaccounted."""
+
+    _TICK_S = 0.02
+
+    def __init__(self, conf=None, name: str = "stage", backend=None,
+                 max_parallel: int = 8, rerunnable: bool = True):
+        from spark_rapids_tpu.config import rapids_conf as rc
+
+        def get(entry):
+            return conf.get(entry) if conf is not None else entry.default
+
+        self.name = name
+        self.rerunnable = rerunnable
+        self.max_attempts = max(1, int(get(rc.STAGE_MAX_ATTEMPTS)))
+        if not rerunnable:
+            self.max_attempts = 1
+        self.spec_enabled = bool(get(rc.SPECULATION_ENABLED)) and \
+            rerunnable
+        self.spec_multiplier = float(get(rc.SPECULATION_MULTIPLIER))
+        self.spec_quantile = float(get(rc.SPECULATION_QUANTILE))
+        self.spec_min_s = float(get(rc.SPECULATION_MIN_RUNTIME_MS)) \
+            / 1000.0
+        # injected straggler stall: long enough to cross the
+        # speculation threshold of any sanely-conf'd stage
+        self.straggler_s = max(0.2, 2.0 * self.spec_min_s)
+        self._backend = backend
+        self._max_parallel = max(1, max_parallel)
+
+    # --- attempt wrapper (chaos sites live here) ---
+
+    def _attempt_fn(self, task: Task, attempt: int) -> Callable[[], Any]:
+        from spark_rapids_tpu.runtime import faults
+
+        def fn():
+            if self.rerunnable:
+                faults.maybe_inject(
+                    "worker.crash",
+                    detail=f"{self.name}[{task.index}] attempt {attempt}")
+                if faults.should_inject("task.straggler"):
+                    time.sleep(self.straggler_s)
+            return task.run(attempt)
+
+        return fn
+
+    @staticmethod
+    def _commit(task: Task, result, attempt: int) -> None:
+        if task.commit is not None:
+            task.commit(result, attempt)
+
+    @staticmethod
+    def _abort(task: Task, attempt: int) -> None:
+        if task.abort is not None:
+            task.abort(attempt)
+
+    # --- single-task fast path (no pool) ---
+
+    def _run_inline(self, task: Task) -> List[Any]:
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_attempts):
+            stats.add("tasksLaunched")
+            try:
+                result = self._attempt_fn(task, attempt)()
+                self._commit(task, result, attempt)
+                return [result]
+            except BaseException as e:
+                self._abort(task, attempt)
+                lost = isinstance(e, WorkerLost) or (
+                    isinstance(e, InjectedFault)
+                    and e.site == "worker.crash")
+                if not lost or attempt + 1 >= self.max_attempts:
+                    raise
+                last = e
+                stats.add("evictedWorkers")
+                stats.add("tasksRetried")
+                stats.add("recomputedPartitions")
+        raise last  # pragma: no cover (loop always returns or raises)
+
+    # --- main driver ---
+
+    def run(self, tasks: List[Task]) -> List[Any]:
+        if not tasks:
+            return []
+        stats.add("stagesRun")
+        if len(tasks) == 1 and self._backend is None:
+            return self._run_inline(tasks[0])
+        backend = self._backend or ThreadBackend(
+            min(self._max_parallel, len(tasks)), self.name)
+        owns_backend = self._backend is None
+        token = next(_stage_token)
+        n = len(tasks)
+        results: List[Any] = [None] * n
+        committed = [False] * n
+        launched = [0] * n
+        running: Dict[Tuple[int, int], Tuple[str, float]] = {}
+        speculative: set = set()
+        durations: List[float] = []
+        pending = deque(range(n))
+        live = list(backend.workers())
+        evicted: set = set()
+        rr = itertools.count(0)
+        terminal: Optional[BaseException] = None
+
+        def pick_worker() -> Optional[str]:
+            if not live:
+                w = backend.replacement_worker()
+                if w is None:
+                    return None
+                live.append(w)
+            return live[next(rr) % len(live)]
+
+        def launch(idx: int, is_spec: bool = False) -> bool:
+            w = pick_worker()
+            if w is None:
+                return False
+            attempt = launched[idx]
+            launched[idx] += 1
+            running[(idx, attempt)] = (w, time.monotonic())
+            stats.add("tasksLaunched")
+            if is_spec:
+                stats.add("tasksSpeculated")
+                speculative.add((idx, attempt))
+            backend.submit(tasks[idx], attempt, w,
+                           self._attempt_fn(tasks[idx], attempt),
+                           self._on_orphan(tasks), token)
+            return True
+
+        def evict_worker(w: str) -> None:
+            if w in evicted:
+                return
+            evicted.add(w)
+            if w in live:
+                live.remove(w)
+            backend.evict(w)
+            stats.add("evictedWorkers")
+
+        def handle(ev) -> None:
+            nonlocal terminal
+            kind, idx, attempt, w, value, ev_token = ev
+            if ev_token != token:
+                # a previous stage's straggling loser on a shared
+                # backend: its output was already aborted/abandoned
+                return
+            info = running.pop((idx, attempt), None)
+            if kind == "ok":
+                if committed[idx] or terminal is not None:
+                    self._abort(tasks[idx], attempt)
+                    return
+                committed[idx] = True
+                if info is not None:
+                    durations.append(time.monotonic() - info[1])
+                if (idx, attempt) in speculative:
+                    stats.add("speculativeWins")
+                self._commit(tasks[idx], value, attempt)
+                results[idx] = value
+                return
+            # failed attempt: its staged output must go
+            self._abort(tasks[idx], attempt)
+            if kind == "lost":
+                evict_worker(w)
+                if committed[idx] or terminal is not None:
+                    return
+                if any(k[0] == idx for k in running):
+                    return  # a duplicate attempt is still in flight
+                if launched[idx] >= self.max_attempts:
+                    terminal = value if isinstance(value, BaseException) \
+                        else WorkerLost(w, f"task {idx} attempt budget "
+                                           f"exhausted")
+                else:
+                    stats.add("tasksRetried")
+                    stats.add("recomputedPartitions")
+                    pending.append(idx)
+                return
+            # kind == "err": not scheduler-retryable — each error class
+            # has its own recovery owner (backoff, ladder, lost-output
+            # recovery); masking it here would hide real bugs
+            if not committed[idx] and terminal is None:
+                terminal = value
+
+        def maybe_speculate(now: float) -> None:
+            if not self.spec_enabled:
+                return
+            need = max(1, math.ceil(self.spec_quantile * n))
+            if len(durations) < need:
+                return
+            med = statistics.median(durations)
+            threshold = max(self.spec_multiplier * med, self.spec_min_s)
+            for (idx, attempt), (w, t0) in list(running.items()):
+                if committed[idx] or launched[idx] >= self.max_attempts:
+                    continue
+                if sum(1 for k in running if k[0] == idx) > 1:
+                    continue  # already speculated
+                if now - t0 > threshold:
+                    launch(idx, is_spec=True)
+
+        try:
+            while True:
+                while pending and terminal is None and \
+                        len(running) < backend.parallelism():
+                    if not launch(pending.popleft()):
+                        terminal = WorkerLost(
+                            "<none>", "no live workers remain")
+                        break
+                if terminal is None and all(committed):
+                    break
+                if terminal is not None and not running:
+                    break
+                ev = backend.poll(self._TICK_S)
+                now = time.monotonic()
+                for w in backend.lost_workers():
+                    if w in evicted:
+                        continue
+                    attempts_on_w = [
+                        k for k, (wk, _t) in running.items() if wk == w]
+                    evict_worker(w)
+                    for (idx, attempt) in attempts_on_w:
+                        handle(("lost", idx, attempt, w,
+                                WorkerLost(w, "liveness check"), token))
+                if ev is not None:
+                    handle(ev)
+                maybe_speculate(now)
+        finally:
+            if owns_backend:
+                for ev in backend.close():
+                    kind, idx, attempt = ev[0], ev[1], ev[2]
+                    if kind == "ok" and ev[5] == token:
+                        self._abort(tasks[idx], attempt)
+        if terminal is not None:
+            raise terminal
+        return results
+
+    def _on_orphan(self, tasks: List[Task]) -> Callable:
+        def on_orphan(ev) -> None:
+            kind, idx, attempt = ev[0], ev[1], ev[2]
+            if kind == "ok":
+                self._abort(tasks[idx], attempt)
+
+        return on_orphan

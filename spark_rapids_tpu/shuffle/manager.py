@@ -16,6 +16,21 @@ rot, and injected shuffle.fetch / shuffle.deserialize faults
 clean ShuffleFetchError names the exact block. Retries are counted
 (`fetch_retries`) so the bench tracks robustness overhead.
 
+Attempt-tagged map output (PR 3, the stage-scheduler integration —
+Spark's MapStatus/attempt-id discipline): map tasks `put` blocks
+tagged (map_id, attempt) which land STAGED, invisible to reducers,
+until `commit_map_output` publishes them. Commit is FIRST-WINS per
+(shuffle_id, map_id): a losing speculative attempt's staged blocks are
+discarded (`speculative_discards`), never double-counted. Recovery
+commits pass `replace=True` to atomically swap a lost map task's
+blocks with its recomputed output (deterministic lineage makes old and
+new identical, so concurrent readers of other partitions stay
+consistent). A fetch failure that survives the block retry budget
+raises ShuffleFetchError carrying the owning `map_id`, which
+`TpuShuffleExchangeExec.fetch_blocks` uses to re-run exactly that map
+task. Cleanup failures are counted (`orphaned_files`) so leaked spill
+files are visible instead of silently swallowed.
+
 Modes here (conf spark.rapids.shuffle.mode):
 - CACHE_ONLY: blocks live as in-process host Arrow tables under a host
   byte ledger; when in-memory block bytes exceed the spill threshold the
@@ -43,13 +58,29 @@ import pyarrow as pa
 
 
 class _MemBlock:
-    __slots__ = ("table", "path", "nbytes", "seq")
+    __slots__ = ("table", "path", "nbytes", "seq", "map_id", "attempt")
 
-    def __init__(self, table: Optional[pa.Table], nbytes: int, seq: int):
+    def __init__(self, table: Optional[pa.Table], nbytes: int, seq: int,
+                 map_id: Optional[int] = None, attempt: int = 0):
         self.table = table          # None once spilled
         self.path: Optional[str] = None
         self.nbytes = nbytes
         self.seq = seq
+        self.map_id = map_id        # owning map task (None = legacy put)
+        self.attempt = attempt
+
+
+class _FileBlock:
+    """MULTITHREADED-mode block: a writer-pool future resolving to the
+    block's file path, tagged with the owning map task."""
+
+    __slots__ = ("future", "map_id", "attempt")
+
+    def __init__(self, future: Future, map_id: Optional[int] = None,
+                 attempt: int = 0):
+        self.future = future
+        self.map_id = map_id
+        self.attempt = attempt
 
 
 class ShuffleManager:
@@ -64,10 +95,18 @@ class ShuffleManager:
         self.spill_threshold = spill_threshold
         self.fetch_retries = 0
         self.checksum_failures = 0
+        self.orphaned_files = 0
+        self.speculative_discards = 0
         self._blocks: Dict[Tuple[int, int], List[_MemBlock]] = defaultdict(
             list)
-        self._files: Dict[Tuple[int, int], List[Future]] = defaultdict(
+        self._files: Dict[Tuple[int, int], List[_FileBlock]] = defaultdict(
             list)
+        # attempt-staged map output, invisible until committed:
+        # (shuffle_id, map_id, attempt) -> [(reduce_pid, block)]
+        self._staged: Dict[Tuple[int, int, int], List[tuple]] = \
+            defaultdict(list)
+        self._committed: Dict[Tuple[int, int], int] = {}
+        self._recompute_seq = 0
         self._lock = threading.Lock()
         self._next_id = 0
         self.bytes_written = 0
@@ -117,6 +156,10 @@ class ShuffleManager:
         victims: List[_MemBlock] = []
         for blocks in self._blocks.values():
             victims.extend(b for b in blocks if b.table is not None)
+        for staged in self._staged.values():
+            victims.extend(b for _rp, b in staged
+                           if isinstance(b, _MemBlock)
+                           and b.table is not None)
         victims.sort(key=lambda b: b.seq)
         for b in victims:
             if self.bytes_in_memory <= self.spill_threshold:
@@ -125,7 +168,13 @@ class ShuffleManager:
             self.bytes_in_memory -= b.nbytes
             pageable.release(b.nbytes)
 
-    def put(self, shuffle_id: int, reduce_pid: int, table: pa.Table):
+    def put(self, shuffle_id: int, reduce_pid: int, table: pa.Table,
+            map_id: Optional[int] = None, attempt: int = 0):
+        """Store one block. With `map_id` the block is STAGED under
+        (map_id, attempt) — invisible to fetch until commit_map_output
+        publishes the attempt (the scheduler's commit-once discipline).
+        Without it the block commits immediately (legacy single-attempt
+        writers: range exchange, mesh spill paths, tests)."""
         if self.mode != "MULTITHREADED":
             from spark_rapids_tpu.runtime import host_alloc
 
@@ -135,8 +184,14 @@ class ShuffleManager:
             in_mem = host_alloc.get().pageable.try_reserve(table.nbytes)
             with self._lock:
                 self._seq += 1
-                blk = _MemBlock(table, table.nbytes, self._seq)
-                self._blocks[(shuffle_id, reduce_pid)].append(blk)
+                blk = _MemBlock(table, table.nbytes, self._seq,
+                                map_id, attempt)
+                dest_key = (shuffle_id, reduce_pid)
+                if map_id is None:
+                    self._blocks[dest_key].append(blk)
+                else:
+                    self._staged[(shuffle_id, map_id, attempt)].append(
+                        (reduce_pid, blk))
                 self.bytes_written += table.nbytes
                 if in_mem:
                     self.bytes_in_memory += table.nbytes
@@ -150,8 +205,12 @@ class ShuffleManager:
                         # reservation, and remove_shuffle's
                         # table-means-reserved accounting must never
                         # see it
-                        self._blocks[(shuffle_id, reduce_pid)].remove(
-                            blk)
+                        if map_id is None:
+                            self._blocks[dest_key].remove(blk)
+                        else:
+                            self._staged[
+                                (shuffle_id, map_id, attempt)].remove(
+                                (reduce_pid, blk))
                         raise
             return
         with self._lock:
@@ -171,9 +230,122 @@ class ShuffleManager:
                 self.bytes_written += buf.nbytes
             return path
 
-        fut = self._pool.submit(write)
+        fb = _FileBlock(self._pool.submit(write), map_id, attempt)
         with self._lock:
-            self._files[(shuffle_id, reduce_pid)].append(fut)
+            if map_id is None:
+                self._files[(shuffle_id, reduce_pid)].append(fb)
+            else:
+                self._staged[(shuffle_id, map_id, attempt)].append(
+                    (reduce_pid, fb))
+
+    # --- attempt lifecycle (stage-scheduler integration) ---
+
+    def recompute_attempt(self, shuffle_id: int, map_id: int) -> int:
+        """Fresh attempt id for a lost-output recomputation — disjoint
+        from the scheduler's small attempt ordinals so a recompute can
+        never collide with a still-staged speculative attempt."""
+        with self._lock:
+            self._recompute_seq += 1
+            return 1_000_000 + self._recompute_seq
+
+    def commit_map_output(self, shuffle_id: int, map_id: int,
+                          attempt: int, replace: bool = False) -> bool:
+        """Publish a staged attempt's blocks. First commit wins per
+        (shuffle_id, map_id); a later commit's blocks are discarded and
+        False returns (the losing speculative attempt). `replace=True`
+        (lost-output recovery) atomically swaps any previously
+        committed blocks of this map task with the recomputed ones."""
+        discard: List = []
+        with self._lock:
+            staged = self._staged.pop((shuffle_id, map_id, attempt), [])
+            cur = self._committed.get((shuffle_id, map_id))
+            if cur is not None and not replace:
+                self.speculative_discards += len(staged)
+                self._release_blocks_locked(
+                    [b for _rp, b in staged], discard)
+                won = False
+            else:
+                if replace and cur is not None:
+                    for (sid, rp), blks in list(self._blocks.items()):
+                        if sid != shuffle_id:
+                            continue
+                        keep = [b for b in blks if b.map_id != map_id]
+                        gone = [b for b in blks if b.map_id == map_id]
+                        if gone:
+                            self._blocks[(sid, rp)] = keep
+                            self._release_blocks_locked(gone, discard)
+                    for (sid, rp), fbs in list(self._files.items()):
+                        if sid != shuffle_id:
+                            continue
+                        keep = [f for f in fbs if f.map_id != map_id]
+                        gone = [f for f in fbs if f.map_id == map_id]
+                        if gone:
+                            self._files[(sid, rp)] = keep
+                            discard.extend(gone)
+                dest = self._files if self.mode == "MULTITHREADED" \
+                    else self._blocks
+                for rp, blk in staged:
+                    dest[(shuffle_id, rp)].append(blk)
+                self._committed[(shuffle_id, map_id)] = attempt
+                won = True
+        self._dispose_blocks(discard)
+        return won
+
+    def discard_attempt(self, shuffle_id: int, map_id: int,
+                        attempt: int) -> None:
+        """Drop a failed/aborted attempt's staged blocks (idempotent)."""
+        discard: List = []
+        with self._lock:
+            staged = self._staged.pop((shuffle_id, map_id, attempt), [])
+            self._release_blocks_locked([b for _rp, b in staged],
+                                        discard)
+        self._dispose_blocks(discard)
+
+    def _release_blocks_locked(self, blocks, discard: List) -> None:
+        """Under lock: return in-memory bytes to the host ledger; queue
+        on-disk artifacts for out-of-lock disposal."""
+        from spark_rapids_tpu.runtime import host_alloc
+
+        pageable = host_alloc.get().pageable
+        for b in blocks:
+            if isinstance(b, _MemBlock):
+                if b.table is not None:
+                    self.bytes_in_memory -= b.nbytes
+                    pageable.release(b.nbytes)
+                elif b.path:
+                    discard.append(b)
+            else:
+                discard.append(b)
+
+    def _dispose_blocks(self, blocks) -> None:
+        """Outside the lock: unlink spilled/written block files; a
+        writer future still in flight unlinks via callback once done.
+        Failures count as orphaned files instead of vanishing."""
+        def _unlink(path: str) -> None:
+            try:
+                os.unlink(path)
+            except OSError:
+                with self._lock:
+                    self.orphaned_files += 1
+
+        for b in blocks:
+            if isinstance(b, _MemBlock):
+                _unlink(b.path)
+            else:
+                fut = b.future
+                if fut.done():
+                    try:
+                        _unlink(fut.result())
+                    except Exception:
+                        pass  # write failed: no file to remove
+                else:
+                    def _cb(f):
+                        try:
+                            _unlink(f.result())
+                        except Exception:
+                            pass
+
+                    fut.add_done_callback(_cb)
 
     def partition_sizes(self, shuffle_id: int, nparts: int) -> List[int]:
         """Per-reduce-partition byte sizes of a materialized shuffle —
@@ -189,20 +361,23 @@ class ShuffleManager:
         import os as _os
 
         for (sid, rp), fs in futs:
-            for f in fs:
+            for fb in fs:
                 try:
-                    out[rp] += _os.path.getsize(f.result())
-                except OSError:
-                    pass
+                    out[rp] += _os.path.getsize(fb.future.result())
+                except Exception:
+                    pass  # lost/failed block: recovery happens at fetch
         return out
 
     def _fetch_block(self, path: str, shuffle_id: int,
-                     reduce_pid: int) -> pa.Table:
+                     reduce_pid: int,
+                     map_id: Optional[int] = None) -> pa.Table:
         """Read + decode one on-disk block under the backoff policy:
         OSError / checksum mismatch / injected shuffle.fetch or
         shuffle.deserialize faults each consume an attempt (re-reading
         the file is the repair for all of them); the exhausted budget
-        surfaces as a ShuffleFetchError naming the block."""
+        surfaces as a ShuffleFetchError naming the block — and, for
+        attempt-tagged blocks, the owning map task, so the scheduler
+        can recompute it."""
         from spark_rapids_tpu.runtime import backoff
         from spark_rapids_tpu.runtime.errors import (
             RetryExhausted,
@@ -237,28 +412,58 @@ class ShuffleManager:
             raise ShuffleFetchError(
                 f"shuffle block (shuffle_id={shuffle_id}, "
                 f"reduce_pid={reduce_pid}) unrecoverable after retry "
-                f"budget: {path}") from e
+                f"budget: {path}", map_id=map_id) from e
+
+    def _maybe_lose_block(self, shuffle_id: int, reduce_pid: int,
+                          map_id: Optional[int]) -> None:
+        """Chaos site shuffle.lost_output: the block vanished AFTER the
+        block-level retry budget (disk died, peer gone) — modeled only
+        for attempt-tagged blocks, whose lineage the scheduler can
+        recompute."""
+        if map_id is None:
+            return
+        from spark_rapids_tpu.runtime import faults
+        from spark_rapids_tpu.runtime.errors import ShuffleFetchError
+
+        if faults.should_inject("shuffle.lost_output"):
+            raise ShuffleFetchError(
+                f"shuffle block (shuffle_id={shuffle_id}, "
+                f"reduce_pid={reduce_pid}) lost (injected "
+                f"shuffle.lost_output)", map_id=map_id)
 
     def fetch(self, shuffle_id: int, reduce_pid: int) -> List[pa.Table]:
+        from spark_rapids_tpu.runtime.errors import ShuffleFetchError
+
         if self.mode != "MULTITHREADED":
             with self._lock:
-                snap = [(b.table, b.path) for b in
+                snap = [(b.table, b.path, b.map_id) for b in
                         self._blocks.get((shuffle_id, reduce_pid), [])]
             out = []
-            for table, path in snap:
+            for table, path, map_id in snap:
+                self._maybe_lose_block(shuffle_id, reduce_pid, map_id)
                 if table is not None:
                     out.append(table)
                 else:
                     out.append(self._fetch_block(path, shuffle_id,
-                                                 reduce_pid))
+                                                 reduce_pid, map_id))
             return out
         with self._lock:
-            futs = list(self._files.get((shuffle_id, reduce_pid), []))
+            fbs = list(self._files.get((shuffle_id, reduce_pid), []))
         tables = []
-        for fut in futs:
-            path = fut.result()  # blocks on in-flight writes
+        for fb in fbs:
+            self._maybe_lose_block(shuffle_id, reduce_pid, fb.map_id)
+            try:
+                path = fb.future.result()  # blocks on in-flight writes
+            except Exception as e:
+                # a writer-thread failure surfaces as the read path's
+                # clean engine error, not a raw codec/IO traceback
+                raise ShuffleFetchError(
+                    f"shuffle block (shuffle_id={shuffle_id}, "
+                    f"reduce_pid={reduce_pid}) writer failed: "
+                    f"{type(e).__name__}: {e}",
+                    map_id=fb.map_id) from e
             tables.append(self._fetch_block(path, shuffle_id,
-                                            reduce_pid))
+                                            reduce_pid, fb.map_id))
         return tables
 
     def remove_shuffle(self, shuffle_id: int):
@@ -277,17 +482,41 @@ class ShuffleManager:
             futs = []
             for k in [k for k in self._files if k[0] == shuffle_id]:
                 futs.extend(self._files.pop(k))
-        # wait + unlink OUTSIDE the lock so unrelated shuffles proceed
+            # staged attempts that never committed (abandoned
+            # speculative losers, failed map stages) go with the
+            # shuffle too — nothing may outlive remove_shuffle
+            for k in [k for k in self._staged if k[0] == shuffle_id]:
+                for _rp, b in self._staged.pop(k):
+                    if isinstance(b, _MemBlock):
+                        if b.table is not None:
+                            self.bytes_in_memory -= b.nbytes
+                            pageable.release(b.nbytes)
+                        elif b.path:
+                            spilled_paths.append(b.path)
+                    else:
+                        futs.append(b)
+            for k in [k for k in self._committed if k[0] == shuffle_id]:
+                del self._committed[k]
+        # wait + unlink OUTSIDE the lock so unrelated shuffles proceed;
+        # failures are counted (shuffle.orphanedFiles), not swallowed —
+        # a leaked spill file must be visible
         for p in spilled_paths:
             try:
                 os.unlink(p)
             except OSError:
-                pass
-        for fut in futs:
+                with self._lock:
+                    self.orphaned_files += 1
+        for fb in futs:
+            fut = fb.future if isinstance(fb, _FileBlock) else fb
             try:
-                os.unlink(fut.result())
+                path = fut.result()
             except Exception:
-                pass
+                continue  # write never landed: no file to remove
+            try:
+                os.unlink(path)
+            except OSError:
+                with self._lock:
+                    self.orphaned_files += 1
 
     def shutdown(self):
         if self._pool is not None:

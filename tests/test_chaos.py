@@ -218,7 +218,7 @@ def test_shuffle_persistent_corruption_surfaces_cleanly(tmp_path,
                          num_threads=2)
     sid = mgr.new_shuffle_id()
     mgr.put(sid, 0, _table())
-    [f.result() for fs in mgr._files.values() for f in fs]
+    [fb.future.result() for fs in mgr._files.values() for fb in fs]
     blk = next(p for p in os.listdir(tmp_path) if p.endswith(".stpu"))
     path = os.path.join(tmp_path, blk)
     data = bytearray(open(path, "rb").read())

@@ -212,3 +212,54 @@ def test_heartbeat_prunes_dead_executors():
     # e2 discovers the re-registered e1 via seq (prune-safe protocol)
     fresh2, _ = mgr.heartbeat("e2", last_seq=seq)
     assert [p["executor_id"] for p in fresh2] == ["e1"]
+
+
+def test_heartbeat_dead_peers_snapshot_and_death_callbacks():
+    """PR 3 satellite: expired executors surface via dead_peers() and
+    on_death callbacks — the stage scheduler's eviction feed."""
+    from spark_rapids_tpu.parallel.heartbeat import HeartbeatManager
+
+    mgr = HeartbeatManager(timeout_ms=50)
+    deaths = []
+    mgr.on_death(deaths.append)
+    mgr.register("e1", "h1", 1)
+    _, seq = mgr.register("e2", "h2", 2)
+    assert mgr.dead_peers() == []
+    time.sleep(0.08)
+    mgr.heartbeat("e2", last_seq=seq)  # triggers the prune of e1
+    assert mgr.dead_peers() == ["e1"]
+    assert deaths == ["e1"]
+    # dead_peers is a snapshot, not a drain: still dead until rejoin
+    assert mgr.dead_peers() == ["e1"] and deaths == ["e1"]
+
+
+def test_heartbeat_evicted_executor_reregisters_with_fresh_seq():
+    """PR 3 satellite: explicit eviction excludes the executor (fires
+    the death callback once); a re-register RESURRECTS it with a fresh,
+    strictly higher seq so peers re-discover it via the incremental
+    protocol."""
+    from spark_rapids_tpu.parallel.heartbeat import HeartbeatManager
+
+    mgr = HeartbeatManager(timeout_ms=60000)
+    deaths = []
+    mgr.on_death(deaths.append)
+    _, seq1 = mgr.register("e1", "h1", 1)
+    mgr.register("e2", "h2", 2)
+    mgr.evict("e1")
+    assert "e1" in mgr.dead_peers() and deaths == ["e1"]
+    assert [p["executor_id"] for p in mgr.live_peers()] == ["e2"]
+    # an evicted executor's heartbeat gets the re-register signal
+    fresh, _ = mgr.heartbeat("e1", last_seq=seq1)
+    assert fresh is None
+    others, seq2 = mgr.register("e1", "h1", 1)
+    assert seq2 > seq1  # fresh seq: discovery replays it to peers
+    assert [p["executor_id"] for p in others] == ["e2"]
+    assert "e1" not in mgr.dead_peers()
+    assert [p["executor_id"] for p in mgr.live_peers()] \
+        == ["e2", "e1"] or \
+        [p["executor_id"] for p in sorted(
+            mgr.live_peers(), key=lambda p: p["seq"])] == ["e2", "e1"]
+    # evicting an already-dead executor must not re-fire callbacks
+    mgr.evict("e2")
+    mgr.evict("e2")
+    assert deaths == ["e1", "e2"]

@@ -1,0 +1,363 @@
+"""Resilient stage scheduler suite (PR 3): task re-attempts, worker
+eviction, speculative execution with commit-once shuffle staging, and
+lost-map-output lineage recomputation — the DAGScheduler semantics the
+reference plugin inherits from Spark, proven here with deterministic
+fault injection (worker.crash / task.straggler / shuffle.lost_output
+sites) and direct TaskSet drives."""
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.config import rapids_conf as rc
+from spark_rapids_tpu.runtime import faults
+from spark_rapids_tpu.runtime import scheduler as sched
+from spark_rapids_tpu.runtime.errors import ShuffleFetchError, WorkerLost
+from spark_rapids_tpu.runtime.scheduler import StageScheduler, Task
+
+
+@pytest.fixture(autouse=True)
+def _isolated_faults():
+    faults.install(faults.FaultRegistry())
+    yield
+    faults.install(faults.FaultRegistry())
+
+
+def _arm(spec):
+    faults.install(faults.FaultRegistry(
+        42, faults.parse_sites(spec, 0.05)))
+
+
+def _conf(**over):
+    return rc.RapidsConf({k: v for k, v in over.items()})
+
+
+def _delta(fn):
+    before = sched.stats.snapshot()
+    out = fn()
+    return out, sched.stats.delta(before, sched.stats.snapshot())
+
+
+# ----------------------------------------------------------- TaskSets
+
+def test_results_in_task_order():
+    tasks = [Task(i, run=lambda _a, i=i: i * i) for i in range(10)]
+    out, d = _delta(lambda: StageScheduler(None, name="t").run(tasks))
+    assert out == [i * i for i in range(10)]
+    assert d["tasksLaunched"] == 10 and d["stagesRun"] == 1
+
+
+def test_single_task_runs_inline():
+    out, d = _delta(lambda: StageScheduler(None).run(
+        [Task(0, run=lambda _a: "x")]))
+    assert out == ["x"] and d["tasksLaunched"] == 1
+
+
+def test_commit_called_exactly_once_per_task():
+    commits = []
+    tasks = [Task(i, run=lambda _a, i=i: i,
+                  commit=lambda res, att, i=i: commits.append((i, res)))
+             for i in range(6)]
+    StageScheduler(None).run(tasks)
+    assert sorted(commits) == [(i, i) for i in range(6)]
+
+
+def test_nonretryable_error_propagates():
+    def boom(_a):
+        raise ValueError("semantic failure")
+
+    tasks = [Task(0, run=lambda _a: 1), Task(1, run=boom)]
+    with pytest.raises(ValueError, match="semantic failure"):
+        StageScheduler(None, name="err").run(tasks)
+
+
+# -------------------------------------------- worker.crash + eviction
+
+def test_worker_crash_evicts_and_retries():
+    _arm("worker.crash:once")
+    tasks = [Task(i, run=lambda _a, i=i: i) for i in range(5)]
+    out, d = _delta(lambda: StageScheduler(None, name="c").run(tasks))
+    assert out == list(range(5))
+    assert d["tasksRetried"] >= 1
+    assert d["recomputedPartitions"] >= 1
+    assert d["evictedWorkers"] >= 1
+    assert d["tasksLaunched"] == 6  # 5 + the one re-attempt
+
+
+def test_worker_crash_budget_exhaustion_raises():
+    _arm("worker.crash:p=1.0")
+    conf = _conf(**{"spark.rapids.tpu.stage.maxAttempts": 2})
+    with pytest.raises(faults.InjectedFault):
+        StageScheduler(conf, name="doom").run(
+            [Task(i, run=lambda _a, i=i: i) for i in range(3)])
+
+
+def test_worker_lost_exception_is_retryable():
+    seen = []
+
+    def flaky(attempt, i):
+        seen.append((i, attempt))
+        if i == 2 and attempt == 0:
+            raise WorkerLost("w-x", "simulated executor death")
+        return i
+
+    tasks = [Task(i, run=lambda a, i=i: flaky(a, i)) for i in range(4)]
+    out, d = _delta(lambda: StageScheduler(None, name="wl").run(tasks))
+    assert out == list(range(4))
+    assert (2, 1) in seen and d["evictedWorkers"] >= 1
+
+
+def test_non_rerunnable_stage_disables_crash_injection():
+    """Consuming lineage (device-mode blocks) must not be re-run: the
+    scheduler runs single-attempt and skips the crash site."""
+    _arm("worker.crash:p=1.0")
+    tasks = [Task(i, run=lambda _a, i=i: i) for i in range(3)]
+    out = StageScheduler(None, name="nr", rerunnable=False).run(tasks)
+    assert out == [0, 1, 2]
+    assert faults.counters()["worker.crash"]["injected"] == 0
+
+
+# ------------------------------------------------------- speculation
+
+def _spec_conf(**over):
+    base = {"spark.rapids.tpu.speculation.enabled": True,
+            "spark.rapids.tpu.speculation.multiplier": 1.2,
+            "spark.rapids.tpu.speculation.quantile": 0.5,
+            "spark.rapids.tpu.speculation.minTaskRuntimeMs": 30}
+    base.update(over)
+    return rc.RapidsConf(base)
+
+
+def test_speculation_duplicates_straggler_and_commits_once():
+    commits = []
+    lock = threading.Lock()
+
+    def run(attempt, i):
+        # task 0's FIRST attempt stalls; its duplicate returns fast
+        if i == 0 and attempt == 0:
+            time.sleep(2.0)
+        else:
+            time.sleep(0.05)
+        return (i, attempt)
+
+    tasks = [Task(i, run=lambda a, i=i: run(a, i),
+                  commit=lambda res, att, i=i:
+                      commits.append((i, att)) or None)
+             for i in range(4)]
+    t0 = time.monotonic()
+    out, d = _delta(lambda: StageScheduler(
+        _spec_conf(), name="spec").run(tasks))
+    wall = time.monotonic() - t0
+    assert [o[0] for o in out] == list(range(4))
+    assert d["tasksSpeculated"] >= 1
+    assert d["speculativeWins"] >= 1
+    assert wall < 1.9, "stage must finish before the straggler wakes"
+    with lock:
+        assert sorted(c[0] for c in commits) == [0, 1, 2, 3]
+
+
+def test_injected_straggler_speculates():
+    _arm("task.straggler:once")
+    def run(_a):
+        time.sleep(0.05)
+        return 1
+
+    tasks = [Task(i, run=run) for i in range(4)]
+    out, d = _delta(lambda: StageScheduler(
+        _spec_conf(), name="straggle").run(tasks))
+    assert out == [1, 1, 1, 1]
+    assert faults.counters()["task.straggler"]["injected"] == 1
+    assert d["tasksSpeculated"] >= 1
+
+
+# ------------------------- speculation commit-once over real shuffle
+
+def _mk_mgr(tmp_path, mode="CACHE_ONLY"):
+    from spark_rapids_tpu.shuffle.manager import ShuffleManager
+
+    return ShuffleManager(mode, shuffle_dir=str(tmp_path),
+                          num_threads=2)
+
+
+def _rows(mgr, sid, nparts):
+    return sum(t.num_rows for rp in range(nparts)
+               for t in mgr.fetch(sid, rp))
+
+
+@pytest.mark.parametrize("mode", ["CACHE_ONLY", "MULTITHREADED"])
+def test_speculative_duplicate_never_double_counts(tmp_path, mode):
+    """Satellite: two attempts of one map task both stage identical
+    blocks; the first commit wins, the loser's blocks are discarded —
+    row counts stay exact and remove_shuffle leaves NOTHING (no files,
+    no staged entries, no committed markers)."""
+    import os
+
+    mgr = _mk_mgr(tmp_path, mode)
+    sid = mgr.new_shuffle_id()
+    t = pa.table({"a": pa.array(np.arange(100), pa.int64())})
+    for rp in range(2):
+        mgr.put(sid, rp, t, map_id=0, attempt=0)
+        mgr.put(sid, rp, t, map_id=0, attempt=1)  # duplicate attempt
+    assert _rows(mgr, sid, 2) == 0  # staged: invisible pre-commit
+    assert mgr.commit_map_output(sid, 0, attempt=0) is True
+    assert mgr.commit_map_output(sid, 0, attempt=1) is False  # loser
+    assert mgr.speculative_discards >= 2
+    assert _rows(mgr, sid, 2) == 200  # not 400: no double count
+    mgr.remove_shuffle(sid)
+    assert _rows(mgr, sid, 2) == 0
+    assert not mgr._staged and not mgr._committed
+    leftovers = [p for p in os.listdir(tmp_path) if p.endswith(".stpu")]
+    assert leftovers == [], leftovers
+    assert mgr.orphaned_files == 0
+    mgr.shutdown()
+
+
+def test_abandoned_attempt_discard_is_idempotent(tmp_path):
+    mgr = _mk_mgr(tmp_path)
+    sid = mgr.new_shuffle_id()
+    t = pa.table({"a": [1, 2, 3]})
+    mgr.put(sid, 0, t, map_id=3, attempt=0)
+    mgr.discard_attempt(sid, 3, 0)
+    mgr.discard_attempt(sid, 3, 0)  # second call: no-op
+    assert _rows(mgr, sid, 1) == 0 and not mgr._staged
+    mgr.remove_shuffle(sid)
+    mgr.shutdown()
+
+
+# ------------------------------------------- lost-output recomputation
+
+def test_replace_commit_swaps_lost_map_output(tmp_path):
+    mgr = _mk_mgr(tmp_path)
+    sid = mgr.new_shuffle_id()
+    t1 = pa.table({"a": pa.array(np.arange(10), pa.int64())})
+    mgr.put(sid, 0, t1, map_id=0, attempt=0)
+    mgr.commit_map_output(sid, 0, 0)
+    assert _rows(mgr, sid, 1) == 10
+    # recompute: identical data under a recovery attempt REPLACES
+    att = mgr.recompute_attempt(sid, 0)
+    mgr.put(sid, 0, t1, map_id=0, attempt=att)
+    mgr.commit_map_output(sid, 0, att, replace=True)
+    assert _rows(mgr, sid, 1) == 10  # swapped, not appended
+    mgr.remove_shuffle(sid)
+    mgr.shutdown()
+
+
+def _eager_conf(**over):
+    base = {"spark.rapids.sql.fusedExec.enabled": False,
+            "spark.rapids.shuffle.mode": "MULTITHREADED",
+            "spark.sql.shuffle.partitions": 4,
+            "spark.rapids.tpu.io.retry.backoffMs": 1,
+            "spark.rapids.tpu.io.retry.maxBackoffMs": 5}
+    base.update(over)
+    return base
+
+
+def _shuffle_query(s):
+    import spark_rapids_tpu.api.functions as F
+
+    rng = np.random.default_rng(7)
+    df = s.createDataFrame(pa.table({
+        "k": pa.array(rng.integers(0, 50, 4000), pa.int64()),
+        "v": pa.array(rng.random(4000))}))
+    return (df.repartition(4, "k").groupBy("k")
+            .agg(F.sum("v").alias("sv"), F.count("*").alias("c")))
+
+
+def _sorted_dict(t):
+    return t.sort_by([("k", "ascending")]).to_pydict()
+
+
+def test_lost_output_recovery_end_to_end():
+    """A shuffle block lost AFTER the block retry budget re-runs only
+    the owning map task; results equal the clean run and
+    last_execution['scheduler'] reports the recomputation."""
+    from spark_rapids_tpu.api.session import TpuSparkSession
+
+    s0 = TpuSparkSession(_eager_conf())
+    want = _sorted_dict(_shuffle_query(s0).collect_arrow())
+    s0.stop()
+    s = TpuSparkSession(_eager_conf(**{
+        "spark.rapids.tpu.chaos.enabled": True,
+        "spark.rapids.tpu.chaos.sites": "shuffle.lost_output:once"}))
+    try:
+        got = _sorted_dict(_shuffle_query(s).collect_arrow())
+        assert got["k"] == want["k"] and got["c"] == want["c"]
+        np.testing.assert_allclose(got["sv"], want["sv"], rtol=1e-9)
+        rec = s.last_execution
+        assert rec["scheduler"]["recomputedPartitions"] >= 1
+        assert s.query_metrics.metric(
+            "scheduler.recomputedPartitions").value >= 1
+    finally:
+        s.stop()
+
+
+def test_lost_output_without_lineage_raises_cleanly():
+    """A ShuffleFetchError with no owning map id (legacy writer) is
+    NOT recoverable — it must surface, not spin."""
+    from spark_rapids_tpu.exec.operators import TpuShuffleExchangeExec
+
+    class _Mgr:
+        def fetch(self, _sid, _pid):
+            raise ShuffleFetchError("gone", map_id=None)
+
+    ex = TpuShuffleExchangeExec.__new__(TpuShuffleExchangeExec)
+    ex._shuffle_id = 1
+    ex.conf = None
+    import spark_rapids_tpu.exec.operators as ops
+    real = ops.get_shuffle_manager
+    ops.get_shuffle_manager = lambda: _Mgr()
+    try:
+        with pytest.raises(ShuffleFetchError):
+            ex.fetch_blocks(0)
+    finally:
+        ops.get_shuffle_manager = real
+
+
+def test_worker_crash_query_end_to_end():
+    from spark_rapids_tpu.api.session import TpuSparkSession
+
+    s0 = TpuSparkSession(_eager_conf())
+    want = _sorted_dict(_shuffle_query(s0).collect_arrow())
+    s0.stop()
+    s = TpuSparkSession(_eager_conf(**{
+        "spark.rapids.tpu.chaos.enabled": True,
+        "spark.rapids.tpu.chaos.sites": "worker.crash:once"}))
+    try:
+        got = _sorted_dict(_shuffle_query(s).collect_arrow())
+        assert got["k"] == want["k"] and got["c"] == want["c"]
+        rec = s.last_execution
+        assert rec["scheduler"]["tasksRetried"] >= 1
+        assert rec["scheduler"]["evictedWorkers"] >= 1
+        assert s.robustness_metrics["scheduler"]["evictedWorkers"] >= 1
+    finally:
+        s.stop()
+
+
+def test_speculation_query_end_to_end():
+    """Injected straggler + speculation on a real multi-partition
+    result stage (AQE off so partitions stay wide): identical results,
+    speculated counter ticks, no double counts."""
+    from spark_rapids_tpu.api.session import TpuSparkSession
+
+    base = _eager_conf(**{"spark.sql.adaptive.enabled": False})
+    s0 = TpuSparkSession(base)
+    want = _sorted_dict(_shuffle_query(s0).collect_arrow())
+    s0.stop()
+    s = TpuSparkSession({**base,
+                         "spark.rapids.tpu.speculation.enabled": True,
+                         "spark.rapids.tpu.speculation.quantile": 0.5,
+                         "spark.rapids.tpu.speculation.multiplier": 1.2,
+                         "spark.rapids.tpu.speculation.minTaskRuntimeMs":
+                             30,
+                         "spark.rapids.tpu.chaos.enabled": True,
+                         "spark.rapids.tpu.chaos.sites":
+                             "task.straggler:once"})
+    try:
+        got = _sorted_dict(_shuffle_query(s).collect_arrow())
+        assert got["k"] == want["k"] and got["c"] == want["c"]
+        np.testing.assert_allclose(got["sv"], want["sv"], rtol=1e-9)
+    finally:
+        s.stop()
