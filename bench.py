@@ -425,6 +425,30 @@ def main():
     jax.block_until_ready(jax.device_put(big))
     h2d = big.nbytes / (time.perf_counter() - t0) / 1e9
 
+    # ---- obs attribution block: the perf trajectory should capture
+    # ---- WHERE time went (top operators by device time, span-tree
+    # ---- shape, event volume), not just the totals above
+    obs_block = None
+    try:
+        from spark_rapids_tpu.obs import spans as obs_spans
+
+        root = spark.obs.last_spans
+        totals = obs_spans.operator_totals(root)
+        top3 = sorted(totals.items(),
+                      key=lambda kv: -kv[1]["deviceNs"])[:3]
+        obs_block = {
+            "eventCounts": dict(spark.obs.bus.counts),
+            "spanTreeDepth": obs_spans.tree_depth(root),
+            "topOperatorsByDeviceTime": [
+                {"operator": name,
+                 "deviceMs": round(t["deviceNs"] / 1e6, 3),
+                 "wallMs": round(t["wallNs"] / 1e6, 3),
+                 "calls": t["count"]}
+                for name, t in top3],
+        }
+    except Exception as e:  # never lose the perf report
+        print(f"# obs block unavailable: {e!r}", flush=True)
+
     print(json.dumps({
         "metric": f"q5 join+agg engine throughput over device-cached"
                   f" tables ({dev.platform}, {ROWS} rows x {STORES}-row"
@@ -458,6 +482,9 @@ def main():
         # numbers — BENCH_* history tracks robustness overhead; under
         # ci/chaos_check.sh they show the recovery machinery working
         "robustness": spark.robustness_metrics,
+        # event/span attribution (obs/): top operators by device time,
+        # span-tree depth, event volume — regression triage data
+        "obs": obs_block,
     }))
 
 

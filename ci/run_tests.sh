@@ -15,6 +15,9 @@ python -m pytest tests/ -q
 echo "== chaos gate (seeded fault injection at every site) =="
 ci/chaos_check.sh
 
+echo "== event-log gate (schema, round-trip, qualification) =="
+ci/eventlog_check.sh
+
 echo "== multichip dryrun (virtual mesh) =="
 SPARK_RAPIDS_TPU_DRYRUN_REEXEC=1 python - <<'PY'
 import jax

@@ -615,10 +615,28 @@ class DataFrame:
         # via explain() and session.query_metrics — a fused/mesh compile
         # error must never silently land a query on the dispatch-bound
         # eager path.
+        from spark_rapids_tpu.obs import events as obs_events
+
         rec = {"engine": None, "fallbacks": [], "compile": None,
                "degradations": [], "scheduler": None}
         self._last_exec = rec
         self.session.last_execution = rec
+        # the query scope brackets the event stream (query.start /
+        # query.end frame the event log + span tree); nested collects
+        # fold into the outer query's stream
+        qid = obs_events.begin_query()
+        rec["queryId"] = qid
+        try:
+            return self._collect_arrow_traced(rec)
+        finally:
+            obs_events.finish_query(
+                qid, engine=rec["engine"],
+                status="ok" if rec["engine"] is not None else "error",
+                fallbacks=len(rec["fallbacks"]),
+                degradations=len(rec["degradations"]))
+
+    def _collect_arrow_traced(self, rec) -> pa.Table:
+        from spark_rapids_tpu.obs import events as obs_events
 
         def ran(engine: str, out: pa.Table, store: bool = True
                 ) -> pa.Table:
@@ -637,7 +655,11 @@ class DataFrame:
         if cached is not None:
             return ran("hostCache", cached, store=False)
 
-        phys, _ = self._physical()
+        phys, meta = self._physical()
+        # structured twin of the NOT_ON_TPU explain: one placement
+        # event per plan node, with the verbatim fallback reason —
+        # what obs.report.qualification() reads
+        obs_events.emit_plan_placement(meta)
         if self.session.rapids_conf.is_explain_only:
             return pa.table({})
         from spark_rapids_tpu.runtime import compile_cache as _cc
@@ -709,7 +731,8 @@ class DataFrame:
         def demoted(frm: str, to: str, reason: str) -> None:
             rec["degradations"].append(
                 {"from": frm, "to": to, "reason": reason})
-            degrade.record_demotion(f"{frm}To{to.capitalize()}")
+            degrade.record_demotion(f"{frm}To{to.capitalize()}",
+                                    frm=frm, to=to, reason=reason)
             qm.metric(f"degrade.{frm}To{to.capitalize()}").add(1)
 
         mesh_n = conf.get(rc.MESH_SIZE)

@@ -196,6 +196,12 @@ class TpuSparkSession:
         self.query_metrics = MetricsRegistry()
         self.last_execution = None
         self._init_runtime()
+        # the session OWNS the observability wiring (obs/): event bus,
+        # span builder, event history, and the conf-gated event-log
+        # writer; runtime modules emit into it process-wide
+        from spark_rapids_tpu.obs import ObsManager
+
+        self.obs = ObsManager(self.rapids_conf)
         global _active
         with _active_lock:
             _active = self
@@ -335,32 +341,29 @@ class TpuSparkSession:
         stage-scheduler recoveries (retries, speculation, recomputed
         partitions, evicted workers), degradation-ladder demotions +
         circuit-breaker state, quarantined compile artifacts, and
-        semaphore timeouts. bench.py folds this into its JSON so
-        BENCH_* tracks robustness overhead."""
-        from spark_rapids_tpu.runtime import backoff, degrade, faults
-        from spark_rapids_tpu.runtime import scheduler as _sched
-        from spark_rapids_tpu.runtime import semaphore as sem
-        from spark_rapids_tpu.runtime.compile_cache import stats
-        from spark_rapids_tpu.shuffle.manager import get_shuffle_manager
+        semaphore timeouts. A view over the unified registry
+        (obs/registry.py); keys are a stable contract. bench.py folds
+        this into its JSON so BENCH_* tracks robustness overhead."""
+        from spark_rapids_tpu.obs import registry as obs_registry
 
-        mgr = get_shuffle_manager()
-        return {
-            "chaos": faults.counters(),
-            "retries": backoff.counters(),
-            "shuffle": {"fetchRetries": mgr.fetch_retries,
-                        "checksumFailures": mgr.checksum_failures,
-                        "orphanedFiles": mgr.orphaned_files,
-                        "speculativeDiscards":
-                            mgr.speculative_discards},
-            "scheduler": _sched.stats.snapshot(),
-            "degrade": degrade.counters(),
-            "artifactsQuarantined":
-                stats.snapshot()["artifactsQuarantined"],
-            "semaphoreTimeouts": sem.get().timeouts,
-        }
+        return obs_registry.robustness_snapshot()
+
+    def prometheus_metrics(self) -> str:
+        """Every engine counter in Prometheus text exposition
+        (obs/prom.py) — expose behind a scrape endpoint for
+        dashboards."""
+        from spark_rapids_tpu.obs import prom
+
+        return prom.render(self)
 
     def stop(self):
         global _active
+        try:
+            # finalize any in-flight event log + release the bus (a
+            # newer session's bus survives: uninstall is identity-gated)
+            self.obs.close()
+        except Exception:
+            pass
         try:
             self.cache_manager.clear()
         except Exception:

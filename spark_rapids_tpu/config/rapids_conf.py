@@ -611,6 +611,37 @@ SPECULATION_MIN_RUNTIME_MS = conf(
     "Never speculate a task running for less than this — sub-threshold "
     "tasks finish faster than a duplicate attempt could launch.", int,
     checker=lambda v: v >= 0)
+OBS_ENABLED = conf(
+    "spark.rapids.tpu.obs.enabled", True,
+    "Query-event tracing subsystem (obs/): the session installs a "
+    "typed event bus that every layer emits into (query/stage/task "
+    "lifecycle, plan placement, shuffle, spill, compile, degradations, "
+    "chaos injections) and builds query->stage->task->operator span "
+    "trees from it — the substrate of the event log, the "
+    "qualification/profile reports and the Prometheus dump. false "
+    "removes every emitter's work (a None-check per site).", bool)
+OBS_HISTORY_EVENTS = conf(
+    "spark.rapids.tpu.obs.historyEvents", 100_000,
+    "In-memory ring of recent events kept for live-session reports "
+    "(obs/report.py); older events drop off. Sized for a handful of "
+    "queries; event logs are the durable record.", int,
+    checker=lambda v: 100 <= v <= 10_000_000)
+EVENTLOG_ENABLED = conf(
+    "spark.rapids.tpu.eventLog.enabled", False,
+    "Write every query's event stream as JSONL under eventLog.dir "
+    "(the Spark event-log analog): one log per query, opened at "
+    "query start, rotated past eventLog.rotation.maxBytes, and "
+    "atomically finalized (rename off .inprogress) at query end. "
+    "obs.eventlog.load() reconstructs the span tree; the "
+    "qualification/profile reports run offline from it.", bool)
+EVENTLOG_DIR = conf(
+    "spark.rapids.tpu.eventLog.dir", "",
+    "Directory for event logs (default: <tmp>/srtpu_eventlog).", str)
+EVENTLOG_ROTATE_BYTES = conf(
+    "spark.rapids.tpu.eventLog.rotation.maxBytes", 64 << 20,
+    "Roll a query's event log to a new part file past this many "
+    "bytes; all parts finalize together at query end.", int,
+    checker=lambda v: v >= 4096)
 
 
 def conf_entries() -> List[ConfEntry]:

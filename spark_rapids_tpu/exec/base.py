@@ -16,8 +16,10 @@ and spill-aware state. Here:
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import threading
+import time
 from typing import Iterator, List, Optional
 
 import pyarrow as pa
@@ -51,7 +53,9 @@ class PhysicalPlan:
         self.children = children
         self.schema = schema
         self.conf = conf
-        self.metrics = M.MetricsRegistry()
+        # collection level honors spark.rapids.sql.metrics.level:
+        # metrics above it skip collection, not just the snapshot
+        self.metrics = M.MetricsRegistry(M.conf_level(conf))
 
     @property
     def num_partitions(self) -> int:
@@ -59,6 +63,34 @@ class PhysicalPlan:
 
     def execute_partition(self, pid: int, ctx: TaskContext) -> Iterator:
         raise NotImplementedError
+
+    @contextlib.contextmanager
+    def timed(self, metric_name: str, level: int = M.MODERATE):
+        """One scope = the operator metric + a profiler range + an
+        `operator.span` event in the query's span tree (the
+        NvtxWithMetrics coupling, extended to the obs bus). Replaces
+        the ad-hoc `self.metrics[...].ns()` operator timing; rows are
+        attributed from the numOutputRows delta when the operator
+        tracks it."""
+        from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.runtime.profiler import annotate
+
+        name = type(self).__name__
+        m = self.metrics.metric(metric_name, level)
+        rows_before = self.metrics.peek(M.NUM_OUTPUT_ROWS)
+        t0 = time.monotonic_ns()
+        try:
+            with annotate(name):
+                yield
+        finally:
+            dt = time.monotonic_ns() - t0
+            m.add(dt)
+            if obs_events.armed():
+                dr = self.metrics.peek(M.NUM_OUTPUT_ROWS) - rows_before
+                obs_events.emit(
+                    "operator.span", operator=name, metric=metric_name,
+                    wallNs=dt, deviceNs=dt if self.is_tpu else 0,
+                    rows=dr if dr > 0 else None)
 
     def _maybe_dump(self, table: pa.Table, pid: int) -> None:
         """Debug batch dump (DumpUtils.dumpToParquetFile role): when
@@ -130,7 +162,9 @@ class PhysicalPlan:
                 # (the NvtxWithMetrics coupling)
                 with annotate_with_metric(
                         f"{type(self).__name__}.p{pid}",
-                        self.metrics[M.TASK_TIME]):
+                        self.metrics[M.TASK_TIME],
+                        span={"operator": type(self).__name__,
+                              "device": self.is_tpu}):
                     for payload in self.execute_partition(pid, ctx):
                         if isinstance(payload, ColumnBatch):
                             parts.append(device_to_arrow(payload))

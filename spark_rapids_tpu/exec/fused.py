@@ -465,9 +465,28 @@ class FusedSingleChipExecutor:
                     "OOM injection routes fused inputs through the "
                     "eager engine")
             return self._oom_injection_eager_fallback(phys)
-        return self._scaffold(
-            phys, as_parts,
-            lambda: self._run_with_retry(phys, as_parts)[0])
+        from spark_rapids_tpu.obs import events as obs_events
+
+        if not obs_events.armed():
+            return self._scaffold(
+                phys, as_parts,
+                lambda: self._run_with_retry(phys, as_parts)[0])
+        # the fused engine runs whole stages as single XLA programs, so
+        # operator-level spans don't exist; one pipeline-level span
+        # keeps fused queries visible in the tree/report attribution
+        import time as _time
+
+        t0 = _time.monotonic_ns()
+        try:
+            return self._scaffold(
+                phys, as_parts,
+                lambda: self._run_with_retry(phys, as_parts)[0])
+        finally:
+            dt = _time.monotonic_ns() - t0
+            obs_events.emit(
+                "operator.span",
+                operator=f"FusedPipeline({type(phys).__name__})",
+                metric="opTime", wallNs=dt, deviceNs=dt, rows=None)
 
     def _oom_injection_eager_fallback(self, phys: PhysicalPlan):
         """Run the plan on the per-operator eager engine (whose

@@ -421,7 +421,7 @@ class TpuProjectExec(PhysicalPlan):
         return ColumnBatch(self.schema, cols, batch.num_rows)
 
     def execute_partition(self, pid, ctx):
-        with self.metrics[M.OP_TIME].ns():
+        with self.timed(M.OP_TIME):
             for batch in self.children[0].execute_partition(pid, ctx):
                 if self._ansi_jit is not None:
                     from spark_rapids_tpu.expr.ansicheck import raise_if_set
@@ -440,12 +440,14 @@ class CpuProjectExec(PhysicalPlan):
         self.exprs = exprs
 
     def execute_partition(self, pid, ctx):
-        for table in self.children[0].execute_partition(pid, ctx):
-            arrays = [cpu_eval.eval_expr(e, table).combine_chunks()
-                      for e in self.exprs]
-            # from_arrays keeps duplicate output names (legal in Spark)
-            yield pa.Table.from_arrays(arrays,
-                                       names=[e.name for e in self.exprs])
+        with self.timed(M.OP_TIME):
+            for table in self.children[0].execute_partition(pid, ctx):
+                arrays = [cpu_eval.eval_expr(e, table).combine_chunks()
+                          for e in self.exprs]
+                # from_arrays keeps duplicate output names (legal in
+                # Spark)
+                yield pa.Table.from_arrays(
+                    arrays, names=[e.name for e in self.exprs])
 
 
 class TpuExpandExec(PhysicalPlan):
@@ -471,7 +473,7 @@ class TpuExpandExec(PhysicalPlan):
         return ColumnBatch(self.schema, cols, batch.num_rows)
 
     def execute_partition(self, pid, ctx):
-        with self.metrics[M.OP_TIME].ns():
+        with self.timed(M.OP_TIME):
             for batch in self.children[0].execute_partition(pid, ctx):
                 for fn in self._jitted:
                     out = fn(batch)
@@ -540,7 +542,7 @@ class TpuSampleExec(PhysicalPlan):
         return filterops.compact(batch, keep)
 
     def execute_partition(self, pid, ctx):
-        with self.metrics[M.OP_TIME].ns():
+        with self.timed(M.OP_TIME):
             offset = 0
             pid_arr = jnp.int64(pid)
             for batch in self.children[0].execute_partition(pid, ctx):
@@ -696,7 +698,7 @@ class TpuFilterExec(PhysicalPlan):
         return filterops.compact(batch, keep)
 
     def execute_partition(self, pid, ctx):
-        with self.metrics[M.FILTER_TIME].ns():
+        with self.timed(M.FILTER_TIME):
             for batch in self.children[0].execute_partition(pid, ctx):
                 if self._ansi_jit is not None:
                     from spark_rapids_tpu.expr.ansicheck import raise_if_set
@@ -718,9 +720,10 @@ class CpuFilterExec(PhysicalPlan):
     def execute_partition(self, pid, ctx):
         import pyarrow.compute as pc
 
-        for table in self.children[0].execute_partition(pid, ctx):
-            mask = cpu_eval.eval_expr(self.condition, table)
-            yield table.filter(pc.fill_null(mask, False))
+        with self.timed(M.FILTER_TIME):
+            for table in self.children[0].execute_partition(pid, ctx):
+                mask = cpu_eval.eval_expr(self.condition, table)
+                yield table.filter(pc.fill_null(mask, False))
 
 
 # -------------------------------------------------------------- aggregate
@@ -1099,7 +1102,7 @@ class TpuHashAggregateExec(PhysicalPlan):
         def park(b):
             return retry_on_oom(lambda: catalog.add_batch(b))
 
-        with self.metrics[M.AGG_TIME].ns():
+        with self.timed(M.AGG_TIME):
             pending = PendingBatches()  # spillable buffer-schema batches
 
             def reduce_pending():
@@ -1377,6 +1380,10 @@ class CpuHashAggregateExec(PhysicalPlan):
     def execute_partition(self, pid, ctx):
         import pyarrow.compute as pc
 
+        with self.timed(M.AGG_TIME):
+            yield from self._agg_partition(pid, ctx, pc)
+
+    def _agg_partition(self, pid, ctx, pc):
         tables = list(self.children[0].execute_partition(pid, ctx))
         if not tables:
             tables = []
@@ -2038,7 +2045,7 @@ class TpuSortExec(PhysicalPlan):
         from spark_rapids_tpu.runtime.retry import retry_on_oom, with_retry
 
         catalog = get_catalog()
-        with self.metrics[M.SORT_TIME].ns():
+        with self.timed(M.SORT_TIME):
             runs = []  # spillable sorted runs
             for batch in self.children[0].execute_partition(pid, ctx):
                 sb = retry_on_oom(lambda b=batch: catalog.add_batch(b))
@@ -2113,6 +2120,10 @@ class CpuSortExec(PhysicalPlan):
     def execute_partition(self, pid, ctx):
         import pyarrow.compute as pc
 
+        with self.timed(M.SORT_TIME):
+            yield from self._sorted_partition(pid, ctx, pc)
+
+    def _sorted_partition(self, pid, ctx, pc):
         tables = list(self.children[0].execute_partition(pid, ctx))
         if not tables:
             return
@@ -2164,7 +2175,7 @@ class TpuCoalesceBatchesExec(PhysicalPlan):
     def _flush(self, pending):
         if len(pending) == 1:
             return pending[0]
-        with self.metrics[M.OP_TIME].ns():
+        with self.timed(M.OP_TIME):
             return concat_batches(pending)
 
     def execute_partition(self, pid, ctx):
@@ -2671,7 +2682,7 @@ class TpuWindowExec(PhysicalPlan):
                            batch.num_rows)
 
     def execute_partition(self, pid, ctx):
-        with self.metrics[M.WINDOW_TIME].ns():
+        with self.timed(M.WINDOW_TIME):
             _acquire(ctx)
             if self.presorted and self.halo is not None:
                 yield from self._execute_batched(pid, ctx)
@@ -3110,11 +3121,12 @@ class CpuWindowExec(PhysicalPlan):
         self.window_exprs = window_exprs
 
     def execute_partition(self, pid, ctx):
-        tables = list(self.children[0].execute_partition(pid, ctx))
-        if not tables:
-            return
-        table = pa.concat_tables(tables, promote_options="none")
-        yield self._compute(table)
+        with self.timed(M.WINDOW_TIME):
+            tables = list(self.children[0].execute_partition(pid, ctx))
+            if not tables:
+                return
+            table = pa.concat_tables(tables, promote_options="none")
+            yield self._compute(table)
 
     def _compute(self, table: pa.Table) -> pa.Table:
         from spark_rapids_tpu.exec.window_oracle import compute_windows

@@ -4,6 +4,11 @@ Reference: `explainPotentialGpuPlan` (GpuOverrides.scala:4500-4525) and
 the `com.nvidia.spark.rapids.ExplainPlan` entry point let users ask,
 WITHOUT device hardware or execution, how a plan would be placed. Same
 surface here: pass any DataFrame, get the placement report string.
+
+mode="EXECUTED" is the post-run twin: it annotates each physical plan
+node with the wall/device time and output rows its spans accumulated
+in the session's last query (obs/spans.py) — placement tells you where
+operators WOULD run, EXECUTED tells you what they COST.
 """
 
 from __future__ import annotations
@@ -14,9 +19,14 @@ def explain_potential_tpu_plan(df, mode: str = "ALL") -> str:
     executing it.
 
     mode="ALL" reports every operator with its placement;
-    mode="NOT_ON_TPU" reports only operators kept on CPU and why.
+    mode="NOT_ON_TPU" reports only operators kept on CPU and why;
+    mode="EXECUTED" annotates the plan with per-operator wall/device
+    time and output rows from the session's LAST executed query's span
+    tree (run collect() first).
     """
-    assert mode in ("ALL", "NOT_ON_TPU"), mode
+    assert mode in ("ALL", "NOT_ON_TPU", "EXECUTED"), mode
+    if mode == "EXECUTED":
+        return _explain_executed(df)
     from spark_rapids_tpu.plan.optimizer import optimize
     from spark_rapids_tpu.plan.overrides import TpuOverrides
 
@@ -28,3 +38,49 @@ def explain_potential_tpu_plan(df, mode: str = "ALL") -> str:
         cbo.apply_cbo(meta, df.session.rapids_conf)
     txt = meta.explain(only_not_on_device=(mode == "NOT_ON_TPU"))
     return txt or "(every operator runs on device)"
+
+
+def _fmt_ms(ns: int) -> str:
+    return f"{ns / 1e6:.2f}ms"
+
+
+def _explain_executed(df) -> str:
+    from spark_rapids_tpu.obs import spans as S
+
+    obs = getattr(df.session, "obs", None)
+    root = obs.last_spans if obs is not None else None
+    if root is None:
+        return ("(no executed query recorded: run collect() first, or "
+                "enable spark.rapids.tpu.obs.enabled)")
+    totals = S.operator_totals(root)
+    phys, _meta = df._physical()
+    lines = [f"== Executed Plan (query {root.query_id}, "
+             f"engine {root.extra.get('engine')}) =="]
+
+    def walk(node, indent: int) -> None:
+        name = type(node).__name__
+        t = totals.get(name)
+        if t is None:
+            annot = "(no span recorded)"
+        else:
+            annot = (f"wall={_fmt_ms(t['wallNs'])} "
+                     f"device={_fmt_ms(t['deviceNs'])}")
+            if t["rows"]:
+                annot += f" rows={t['rows']}"
+            if t["count"] > 1:
+                annot += f" calls={t['count']}"
+            if t["discardedNs"]:
+                annot += f" discarded={_fmt_ms(t['discardedNs'])}"
+        lines.append("  " * indent + f"{node._node_string()}  [{annot}]")
+        for c in node.children:
+            walk(c, indent + 1)
+
+    walk(phys, 0)
+    out_rows = S.task_rows(root)
+    total_dev = sum(t["deviceNs"] for t in totals.values())
+    total_wall = sum(t["wallNs"] for t in totals.values())
+    lines.append(f"total: wall={_fmt_ms(total_wall)} "
+                 f"device={_fmt_ms(total_dev)}"
+                 + (f" output_rows={out_rows}"
+                    if out_rows is not None else ""))
+    return "\n".join(lines)

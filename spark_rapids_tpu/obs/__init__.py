@@ -1,0 +1,75 @@
+"""Observability subsystem: event bus, span trees, event log, reports.
+
+Layout (see docs/observability.md):
+
+- `events.py`   typed thread-safe event bus + query/task context
+- `spans.py`    query->stage->task->operator span trees from the bus
+- `eventlog.py` conf-gated JSONL event log (rotation, atomic finalize)
+                + loader reconstructing span trees offline
+- `report.py`   qualification + profile reports (live session or log)
+- `prom.py`     Prometheus text-exposition dump
+- `registry.py` unified views over every engine counter
+
+The session owns one `ObsManager` (api/session.py): it wires the bus,
+the span builder, the in-memory history and the optional event-log
+writer, and installs the bus as the process emit target that every
+runtime module's `events.emit(...)` hooks feed.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from spark_rapids_tpu.obs import events as events  # noqa: F401
+from spark_rapids_tpu.obs.events import EventBus, EventHistory
+from spark_rapids_tpu.obs.spans import Span, SpanBuilder
+
+
+class ObsManager:
+    """Session-scoped observability wiring (created in
+    TpuSparkSession.__init__, closed in stop())."""
+
+    def __init__(self, conf=None):
+        from spark_rapids_tpu.config import rapids_conf as rc
+
+        def get(entry):
+            return conf.get(entry) if conf is not None else entry.default
+
+        self.enabled = bool(get(rc.OBS_ENABLED))
+        self.bus: Optional[EventBus] = None
+        self.history: Optional[EventHistory] = None
+        self.spans: Optional[SpanBuilder] = None
+        self.writer = None
+        if not self.enabled:
+            return
+        self.bus = EventBus()
+        self.history = EventHistory(get(rc.OBS_HISTORY_EVENTS))
+        self.spans = SpanBuilder()
+        self.bus.subscribe(self.history)
+        self.bus.subscribe(self.spans)
+        if get(rc.EVENTLOG_ENABLED):
+            from spark_rapids_tpu.obs.eventlog import EventLogWriter
+
+            self.writer = EventLogWriter(
+                get(rc.EVENTLOG_DIR),
+                rotate_bytes=get(rc.EVENTLOG_ROTATE_BYTES))
+            self.bus.subscribe(self.writer)
+        events.install(self.bus)
+
+    @property
+    def last_spans(self) -> Optional[Span]:
+        """Span tree of the most recently completed query."""
+        return self.spans.last if self.spans is not None else None
+
+    def query_events(self, query_id: Optional[int] = None) -> List[dict]:
+        if self.history is None:
+            return []
+        if query_id is None:
+            query_id = self.history.last_query_id()
+        return self.history.events(query_id)
+
+    def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()
+        if self.bus is not None:
+            events.uninstall(self.bus)

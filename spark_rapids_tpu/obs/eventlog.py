@@ -1,0 +1,195 @@
+"""JSONL event log — the Spark event-log analog.
+
+A bus subscriber (conf `spark.rapids.tpu.eventLog.{enabled,dir}`)
+writes every query's event stream to its own JSONL file under the log
+directory: opened as `eventlog-q<N>-p1.jsonl.inprogress` at
+`query.start`, rolled to new part files past
+`eventLog.rotation.maxBytes`, and ATOMICALLY finalized (all parts
+renamed off `.inprogress`) when `query.end` lands — a crashed process
+leaves `.inprogress` files, never a truncated finalized log.
+
+`load()` reads a finalized file, a query's parts, or a whole directory
+back into the event stream (validating the schema envelope per line),
+and `load_spans()` replays it through the same SpanBuilder the live
+session uses — which is why a loaded log reconstructs the IDENTICAL
+span tree (the qualification/profiling tools' offline entry point).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+from typing import List, Optional
+
+from spark_rapids_tpu.obs import events as _events
+from spark_rapids_tpu.obs import spans as _spans
+
+_FINAL_RE = re.compile(r"^eventlog-q(\d+)-p(\d+)\.jsonl$")
+_INPROGRESS_SUFFIX = ".inprogress"
+
+
+class EventLogError(RuntimeError):
+    pass
+
+
+def default_dir() -> str:
+    import tempfile
+
+    return os.path.join(tempfile.gettempdir(), "srtpu_eventlog")
+
+
+class EventLogWriter:
+    """Per-query JSONL writer with rotation + atomic finalize."""
+
+    def __init__(self, log_dir: str, rotate_bytes: int = 64 << 20):
+        self.dir = log_dir or default_dir()
+        self.rotate_bytes = max(4096, int(rotate_bytes))
+        os.makedirs(self.dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._f = None
+        self._qid: Optional[int] = None
+        self._part = 0
+        self._bytes = 0
+        self._open_paths: List[str] = []
+        self.files_written = 0
+        self.events_written = 0
+        self.write_errors = 0
+
+    # --- subscriber entry ---
+
+    def __call__(self, ev: dict) -> None:
+        with self._lock:
+            try:
+                if ev["event"] == "query.start":
+                    self._finalize_locked()  # orphaned previous query
+                    self._qid = ev.get("queryId") or 0
+                    self._part = 0
+                    self._roll_locked()
+                if self._f is None:
+                    return  # events outside any query scope drop
+                line = json.dumps(ev, separators=(",", ":"),
+                                  sort_keys=True)
+                self._f.write(line + "\n")
+                self._bytes += len(line) + 1
+                self.events_written += 1
+                if ev["event"] == "query.end":
+                    self._finalize_locked()
+                elif self._bytes >= self.rotate_bytes:
+                    self._roll_locked()
+            except Exception:
+                self.write_errors += 1
+
+    # --- file lifecycle (under lock) ---
+
+    def _inprogress(self, part: int) -> str:
+        return os.path.join(
+            self.dir,
+            f"eventlog-q{self._qid}-p{part}.jsonl{_INPROGRESS_SUFFIX}")
+
+    def _roll_locked(self) -> None:
+        if self._f is not None:
+            self._f.flush()
+            self._f.close()
+        self._part += 1
+        self._bytes = 0
+        path = self._inprogress(self._part)
+        self._f = open(path, "w")
+        self._open_paths.append(path)
+
+    def _finalize_locked(self) -> None:
+        if self._f is None:
+            return
+        self._f.flush()
+        self._f.close()
+        self._f = None
+        for p in self._open_paths:
+            final = p[:-len(_INPROGRESS_SUFFIX)]
+            try:
+                os.replace(p, final)  # atomic publish
+                self.files_written += 1
+            except OSError:
+                self.write_errors += 1
+        self._open_paths = []
+        self._qid = None
+
+    def close(self) -> None:
+        """Session stop: finalize any open (crashed-query) log so its
+        events survive; the file still finalizes without a query.end
+        line (the loader marks its tree `unfinished`)."""
+        with self._lock:
+            self._finalize_locked()
+
+
+# ----------------------------------------------------------- validation
+
+def validate_event(ev: dict) -> List[str]:
+    """Schema check for one event object; returns error strings."""
+    errs = []
+    for k in _events.REQUIRED_KEYS:
+        if k not in ev:
+            errs.append(f"missing required key {k!r}")
+    v = ev.get("schemaVersion")
+    if v is not None and v != _events.SCHEMA_VERSION:
+        errs.append(f"schemaVersion {v} != {_events.SCHEMA_VERSION}")
+    et = ev.get("event")
+    if et is not None and et not in _events.EVENT_TYPES:
+        errs.append(f"unknown event type {et!r}")
+    return errs
+
+
+# -------------------------------------------------------------- loading
+
+def _load_file(path: str, strict: bool) -> List[dict]:
+    out = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError as e:
+                raise EventLogError(f"{path}:{i}: bad JSON: {e}")
+            errs = validate_event(ev)
+            if errs and strict:
+                raise EventLogError(f"{path}:{i}: {'; '.join(errs)}")
+            out.append(ev)
+    return out
+
+
+def log_files(log_dir: str, query_id: Optional[int] = None) -> List[str]:
+    """Finalized log files under a directory, in (query, part) order."""
+    found = []
+    for name in os.listdir(log_dir):
+        m = _FINAL_RE.match(name)
+        if m and (query_id is None or int(m.group(1)) == query_id):
+            found.append((int(m.group(1)), int(m.group(2)), name))
+    return [os.path.join(log_dir, n) for _q, _p, n in sorted(found)]
+
+
+def load(path: str, query_id: Optional[int] = None,
+         strict: bool = True) -> List[dict]:
+    """Read an event stream back: `path` is a finalized log file or a
+    log directory (optionally narrowed to one query). Events return in
+    write order (parts concatenate in sequence)."""
+    if os.path.isdir(path):
+        files = log_files(path, query_id)
+        if not files:
+            raise EventLogError(
+                f"no finalized event logs under {path!r}"
+                + (f" for query {query_id}" if query_id else ""))
+    else:
+        files = [path]
+    out: List[dict] = []
+    for p in files:
+        out.extend(_load_file(p, strict))
+    return out
+
+
+def load_spans(path: str, query_id: Optional[int] = None
+               ) -> List["_spans.Span"]:
+    """Reconstruct span trees from a saved log — same builder as the
+    live session, so the trees are identical to what it held."""
+    return _spans.build_from_events(load(path, query_id))

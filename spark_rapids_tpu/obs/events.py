@@ -1,0 +1,256 @@
+"""Typed, thread-safe query-event bus — the observability substrate.
+
+The reference plugin threads GpuMetric levels and NVTX ranges through
+every operator and ships standalone qualification/profiling tools that
+read Spark event logs. This module unifies that surface for the engine:
+every layer (planner, scheduler, shuffle, spill catalog, compile cache,
+degradation ladder, chaos harness) emits TYPED events into one process
+bus; span trees (obs/spans.py), the JSONL event log (obs/eventlog.py),
+the qualification/profile reports (obs/report.py) and the Prometheus
+dump (obs/prom.py) are all views over this stream.
+
+Schema: every event is a flat JSON object carrying the envelope keys
+`event` (type name), `seq` (bus-monotonic), `ts` (unix seconds),
+`schemaVersion`, and `queryId` (the enclosing query, 0 outside one),
+plus per-type payload fields. Task-scoped emissions (operator spans
+inside a scheduler attempt) additionally inherit `stage`/`task`/
+`attempt`/`speculative` from the thread's task scope, which is how the
+span builder hangs operator spans under the right task attempt.
+
+Emitters call the module-level `emit(...)`, which is a None-check when
+no session installed a bus (`spark.rapids.tpu.obs.enabled=false`, or no
+session yet) — hot paths pay nothing when tracing is off.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+SCHEMA_VERSION = 1
+
+#: Stable event-type registry: name -> payload field summary (doc'd in
+#: docs/observability.md; eventlog validation accepts only these).
+EVENT_TYPES: Dict[str, str] = {
+    "query.start": "queryId",
+    "query.end": "engine, status, fallbacks, degradations",
+    "plan.placement": "node, depth, onDevice, reason",
+    "stage.start": "stage, name, tasks",
+    "stage.end": "stage, name, status",
+    "task.attempt.start": "stage, task, attempt, worker, speculative",
+    "task.attempt.end": "stage, task, attempt, status, wallMs, rows",
+    "operator.span": "operator, metric, wallNs, deviceNs, rows",
+    "shuffle.write": "shuffleId, reducePid, bytes, staged",
+    "shuffle.fetch": "shuffleId, reducePid, blocks, bytes",
+    "shuffle.retry": "shuffleId, reducePid, block",
+    "spill": "component, direction, fromTier, toTier, bytes",
+    "compile": "kind (miss|hit|warm|quarantine), seconds",
+    "degrade": "kind, from, to, reason",
+    "chaos": "site",
+}
+
+#: Envelope keys present on EVERY event (eventlog validation contract).
+REQUIRED_KEYS = ("event", "seq", "ts", "schemaVersion", "queryId")
+
+
+class EventBus:
+    """Synchronous fan-out bus. Emission is serialized under one lock
+    so subscribers observe a total order matching `seq` — the property
+    the span builder and the event-log writer both rely on. Subscriber
+    exceptions are counted, never propagated into the query."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subs: List[Callable[[dict], None]] = []
+        self._seq = 0
+        self.counts: Dict[str, int] = {}
+        self.subscriber_errors = 0
+
+    def subscribe(self, fn: Callable[[dict], None]) -> Callable:
+        with self._lock:
+            self._subs.append(fn)
+        return fn
+
+    def unsubscribe(self, fn: Callable[[dict], None]) -> None:
+        with self._lock:
+            if fn in self._subs:
+                self._subs.remove(fn)
+
+    def emit(self, event: str, **fields) -> dict:
+        ev = {"event": event, "schemaVersion": SCHEMA_VERSION,
+              "queryId": current_query_id(), "ts": round(time.time(), 6)}
+        ctx = task_context()
+        if ctx:
+            ev.update(ctx)
+        ev.update(fields)
+        with self._lock:
+            self._seq += 1
+            ev["seq"] = self._seq
+            self.counts[event] = self.counts.get(event, 0) + 1
+            for fn in list(self._subs):
+                try:
+                    fn(ev)
+                except Exception:
+                    self.subscriber_errors += 1
+        return ev
+
+
+class EventHistory:
+    """Ring-buffer subscriber retaining recent events so live-session
+    reports (obs/report.py) work without an event log."""
+
+    def __init__(self, capacity: int = 100_000):
+        self._events: deque = deque(maxlen=max(100, int(capacity)))
+        self._lock = threading.Lock()
+
+    def __call__(self, ev: dict) -> None:
+        with self._lock:
+            self._events.append(ev)
+
+    def events(self, query_id: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            evs = list(self._events)
+        if query_id is None:
+            return evs
+        return [e for e in evs if e.get("queryId") == query_id]
+
+    def last_query_id(self) -> Optional[int]:
+        with self._lock:
+            for e in reversed(self._events):
+                if e.get("queryId"):
+                    return e["queryId"]
+        return None
+
+
+# ------------------------------------------------------ process wiring
+
+_bus: Optional[EventBus] = None
+_install_lock = threading.Lock()
+
+
+def install(bus: Optional[EventBus]) -> Optional[EventBus]:
+    """Make `bus` the process emit target (session lifecycle hook)."""
+    global _bus
+    with _install_lock:
+        _bus = bus
+    return bus
+
+
+def uninstall(bus: EventBus) -> None:
+    """Remove `bus` if it is still the active one (a newer session's
+    bus must not be torn down by an older session's stop())."""
+    global _bus
+    with _install_lock:
+        if _bus is bus:
+            _bus = None
+
+
+def get() -> Optional[EventBus]:
+    return _bus
+
+
+def armed() -> bool:
+    return _bus is not None
+
+
+def emit(event: str, **fields) -> None:
+    """Hot-path entry: one None-check when tracing is off."""
+    bus = _bus
+    if bus is not None:
+        bus.emit(event, **fields)
+
+
+# ------------------------------------------------------- query context
+
+_query_counter = itertools.count(1)
+_query_lock = threading.Lock()
+_query_depth = 0
+_query_id = 0
+
+
+def begin_query() -> int:
+    """Enter a query scope; emits `query.start` for the OUTERMOST
+    scope only (nested collects — cache materialization, writes that
+    read — fold into the enclosing query's stream)."""
+    global _query_depth, _query_id
+    with _query_lock:
+        _query_depth += 1
+        if _query_depth == 1:
+            _query_id = next(_query_counter)
+            top = True
+        else:
+            top = False
+        qid = _query_id
+    if top:
+        emit("query.start")
+    return qid
+
+
+def finish_query(qid: int, **fields) -> None:
+    """Leave a query scope; the outermost exit emits `query.end` with
+    the caller's summary fields (engine, status, ...)."""
+    global _query_depth, _query_id
+    with _query_lock:
+        _query_depth = max(0, _query_depth - 1)
+        top = _query_depth == 0
+    if top:
+        # emit BEFORE clearing the id so the end event carries it
+        emit("query.end", **fields)
+        with _query_lock:
+            if _query_depth == 0:
+                _query_id = 0
+
+
+def current_query_id() -> int:
+    return _query_id
+
+
+# -------------------------------------------------------- task context
+
+_task_ctx = threading.local()
+
+
+@contextlib.contextmanager
+def task_scope(stage: int, task: int, attempt: int,
+               speculative: bool = False):
+    """Tag the current thread with a scheduler attempt identity; events
+    emitted inside (operator spans above all) inherit it. Nests: an
+    exchange map stage running inside a result task re-tags to the
+    inner attempt and restores on exit."""
+    prev = getattr(_task_ctx, "ctx", None)
+    _task_ctx.ctx = {"stage": stage, "task": task, "attempt": attempt,
+                     "speculative": bool(speculative)}
+    try:
+        yield
+    finally:
+        _task_ctx.ctx = prev
+
+
+def task_context() -> dict:
+    return getattr(_task_ctx, "ctx", None) or {}
+
+
+# ------------------------------------------------------- plan emission
+
+def emit_plan_placement(meta) -> None:
+    """Walk a tagged PlanMeta tree (plan/overrides.py) and emit one
+    `plan.placement` event per node — the structured twin of
+    explain_potential_tpu_plan: `reason` is the exact '; '-joined
+    string the NOT_ON_TPU report prints, which is what lets
+    obs.report.qualification() match it verbatim."""
+    if not armed():
+        return
+
+    def walk(m, depth: int) -> None:
+        on_dev = m.can_run_on_device
+        emit("plan.placement", node=type(m.node).__name__, depth=depth,
+             onDevice=bool(on_dev),
+             reason=None if on_dev else "; ".join(m.reasons))
+        for c in m.children:
+            walk(c, depth + 1)
+
+    walk(meta, 0)

@@ -1,0 +1,65 @@
+"""Prometheus text-exposition dump of the unified metric registry.
+
+One call renders every engine counter — robustness, compile ledger,
+shuffle/spill bytes, per-session query metrics, bus event counts — in
+the text format a Prometheus scrape (or a pushgateway hook) ingests:
+
+    srtpu_robustness_scheduler_tasksLaunched 42
+    srtpu_events_total{event="operator.span"} 118
+
+The engine has no HTTP server; embedders expose `render()` behind
+whatever endpoint their deployment runs (the dashboards goal of the
+ROADMAP north star). Everything is emitted as gauges: most values are
+monotonic in practice, but cross-session resets (new shuffle manager,
+reconfigured registries) would violate Prometheus counter semantics.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict
+
+from spark_rapids_tpu.obs import registry as _registry
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+PREFIX = "srtpu"
+
+
+def _metric_name(dotted: str) -> str:
+    return f"{PREFIX}_{_NAME_RE.sub('_', dotted)}"
+
+
+def _fmt_value(v: float) -> str:
+    if isinstance(v, float) and not v.is_integer():
+        return repr(v)
+    return str(int(v))
+
+
+def render(session=None) -> str:
+    """The full unified registry as Prometheus text exposition."""
+    snap = _registry.unified_snapshot(session)
+    # labeled families: per-event and per-chaos-site counts read better
+    # as one family with a label than as N families
+    events = snap.pop("events", {})
+    chaos = snap.get("robustness", {}).pop("chaos", {})
+    lines = []
+    flat: Dict[str, float] = _registry.flatten(snap)
+    for name in sorted(flat):
+        mname = _metric_name(name)
+        lines.append(f"# TYPE {mname} gauge")
+        lines.append(f"{mname} {_fmt_value(flat[name])}")
+    if events:
+        mname = f"{PREFIX}_events_total"
+        lines.append(f"# TYPE {mname} gauge")
+        for ev in sorted(events):
+            lines.append(f'{mname}{{event="{ev}"}} '
+                         f"{_fmt_value(events[ev])}")
+    if chaos:
+        for field in ("checked", "injected"):
+            mname = f"{PREFIX}_chaos_{field}_total"
+            lines.append(f"# TYPE {mname} gauge")
+            for site in sorted(chaos):
+                lines.append(
+                    f'{mname}{{site="{site}"}} '
+                    f"{_fmt_value(chaos[site].get(field, 0))}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,292 @@
+"""Query -> stage -> task -> operator span trees, built from the bus.
+
+The reference attributes device work to plan nodes through NVTX ranges
+read back in Nsight; the TPU engine's equivalent is this tree: every
+scheduler attempt is a task span, every timed operator scope inside it
+(PhysicalPlan.timed / profiler.annotate_with_metric) is an operator
+span carrying wall + device nanoseconds, and losing speculative
+attempts keep their spans marked `discarded` so double-counted time is
+visible instead of silently folded in.
+
+The builder is a plain bus subscriber; `build_from_events` replays a
+recorded stream (obs/eventlog.py loader) through the SAME logic, which
+is what makes a loaded log reconstruct the identical tree the live
+session built.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Iterable, List, Optional
+
+
+class Span:
+    """One node of the tree. `kind` is query|stage|task|operator."""
+
+    __slots__ = ("kind", "name", "query_id", "stage", "task", "attempt",
+                 "speculative", "start_ts", "end_ts", "wall_ns",
+                 "device_ns", "rows", "status", "children", "extra")
+
+    def __init__(self, kind: str, name: str, query_id: int = 0,
+                 stage: Optional[int] = None, task: Optional[int] = None,
+                 attempt: Optional[int] = None, speculative: bool = False,
+                 start_ts: Optional[float] = None):
+        self.kind = kind
+        self.name = name
+        self.query_id = query_id
+        self.stage = stage
+        self.task = task
+        self.attempt = attempt
+        self.speculative = speculative
+        self.start_ts = start_ts
+        self.end_ts: Optional[float] = None
+        self.wall_ns: int = 0
+        self.device_ns: int = 0
+        self.rows: Optional[int] = None
+        self.status = "open"
+        self.children: List["Span"] = []
+        self.extra: Dict[str, object] = {}
+
+    def to_dict(self) -> dict:
+        d = {"kind": self.kind, "name": self.name,
+             "queryId": self.query_id, "status": self.status,
+             "startTs": self.start_ts, "endTs": self.end_ts,
+             "wallNs": self.wall_ns, "deviceNs": self.device_ns,
+             "rows": self.rows}
+        if self.stage is not None:
+            d["stage"] = self.stage
+        if self.task is not None:
+            d["task"] = self.task
+        if self.attempt is not None:
+            d["attempt"] = self.attempt
+        if self.speculative:
+            d["speculative"] = True
+        if self.children:
+            d["children"] = [c.to_dict() for c in self.children]
+        return d
+
+    def walk(self) -> Iterable["Span"]:
+        yield self
+        for c in self.children:
+            yield from c.walk()
+
+    def __repr__(self):
+        return (f"Span({self.kind} {self.name!r} status={self.status} "
+                f"children={len(self.children)})")
+
+
+def tree_depth(root: Optional[Span]) -> int:
+    if root is None:
+        return 0
+    return 1 + max((tree_depth(c) for c in root.children), default=0)
+
+
+def operator_totals(root: Optional[Span],
+                    include_discarded: bool = False) -> Dict[str, dict]:
+    """Aggregate operator spans by operator name:
+    {name: {wallNs, deviceNs, rows, count, discardedNs}}. Discarded
+    (losing-attempt) spans contribute only to discardedNs unless
+    `include_discarded`."""
+    out: Dict[str, dict] = {}
+    if root is None:
+        return out
+    for s in root.walk():
+        if s.kind != "operator":
+            continue
+        t = out.setdefault(s.name, {"wallNs": 0, "deviceNs": 0,
+                                    "rows": 0, "count": 0,
+                                    "discardedNs": 0})
+        if s.status == "discarded" and not include_discarded:
+            t["discardedNs"] += s.wall_ns
+            continue
+        t["wallNs"] += s.wall_ns
+        t["deviceNs"] += s.device_ns
+        if s.rows:
+            t["rows"] += s.rows
+        t["count"] += 1
+    return out
+
+
+def task_rows(root: Optional[Span]) -> Optional[int]:
+    """Committed result-stage row total (the query's output rows) when
+    task attempt ends carried row counts."""
+    if root is None:
+        return None
+    total, seen = 0, False
+    for s in root.walk():
+        if s.kind == "task" and s.status == "ok" and s.rows is not None \
+                and s.extra.get("result_stage"):
+            total += s.rows
+            seen = True
+    return total if seen else None
+
+
+class _TreeState:
+    def __init__(self, root: Span):
+        self.root = root
+        self.stages: Dict[int, Span] = {}
+        self.tasks: Dict[tuple, Span] = {}
+
+
+class SpanBuilder:
+    """Bus subscriber incrementally building one tree per query.
+    Thread-safe: the bus serializes delivery, but `build_from_events`
+    and tests may drive it directly, so it keeps its own lock."""
+
+    def __init__(self, on_complete: Optional[Callable[[Span], None]] = None,
+                 keep: int = 4):
+        self._on_complete = on_complete
+        self._keep = max(1, keep)
+        self._live: Dict[int, _TreeState] = {}
+        self.completed: List[Span] = []
+        self.last: Optional[Span] = None
+        self._lock = threading.Lock()
+
+    # --- subscriber entry ---
+
+    def __call__(self, ev: dict) -> None:
+        handler = getattr(self, "_on_" + ev["event"].replace(".", "_"),
+                          None)
+        if handler is None:
+            return
+        with self._lock:
+            handler(ev)
+
+    # --- per-event handlers (called under lock) ---
+
+    def _state(self, ev: dict) -> Optional[_TreeState]:
+        return self._live.get(ev.get("queryId") or 0)
+
+    def _on_query_start(self, ev: dict) -> None:
+        qid = ev.get("queryId") or 0
+        root = Span("query", f"query-{qid}", qid, start_ts=ev["ts"])
+        self._live[qid] = _TreeState(root)
+
+    def _on_query_end(self, ev: dict) -> None:
+        st = self._live.pop(ev.get("queryId") or 0, None)
+        if st is None:
+            return
+        root = st.root
+        root.end_ts = ev["ts"]
+        root.status = ev.get("status", "ok")
+        root.extra["engine"] = ev.get("engine")
+        for s in root.walk():
+            if s.status == "open":
+                s.status = "unfinished"
+        self.completed.append(root)
+        del self.completed[:-self._keep]
+        self.last = root
+        if self._on_complete is not None:
+            try:
+                self._on_complete(root)
+            except Exception:
+                pass
+
+    def _on_stage_start(self, ev: dict) -> None:
+        st = self._state(ev)
+        if st is None:
+            return
+        sp = Span("stage", str(ev.get("name", "stage")),
+                  ev.get("queryId") or 0, stage=ev.get("stage"),
+                  start_ts=ev["ts"])
+        sp.extra["tasks"] = ev.get("tasks")
+        st.stages[ev.get("stage")] = sp
+        st.root.children.append(sp)
+
+    def _on_stage_end(self, ev: dict) -> None:
+        st = self._state(ev)
+        if st is None:
+            return
+        sp = st.stages.get(ev.get("stage"))
+        if sp is not None:
+            sp.end_ts = ev["ts"]
+            sp.status = ev.get("status", "ok")
+
+    def _stage_for(self, st: _TreeState, ev: dict) -> Span:
+        sid = ev.get("stage")
+        sp = st.stages.get(sid)
+        if sp is None:
+            # task events may outrun their stage record on a replay
+            # slice; synthesize a stage container rather than drop them
+            sp = Span("stage", f"stage-{sid}", ev.get("queryId") or 0,
+                      stage=sid, start_ts=ev["ts"])
+            st.stages[sid] = sp
+            st.root.children.append(sp)
+        return sp
+
+    def _on_task_attempt_start(self, ev: dict) -> None:
+        st = self._state(ev)
+        if st is None:
+            return
+        stage_sp = self._stage_for(st, ev)
+        key = (ev.get("stage"), ev.get("task"), ev.get("attempt"))
+        sp = Span("task",
+                  f"{stage_sp.name}[{ev.get('task')}]#{ev.get('attempt')}",
+                  ev.get("queryId") or 0, stage=ev.get("stage"),
+                  task=ev.get("task"), attempt=ev.get("attempt"),
+                  speculative=bool(ev.get("speculative")),
+                  start_ts=ev["ts"])
+        sp.extra["worker"] = ev.get("worker")
+        if stage_sp.name == "result":
+            sp.extra["result_stage"] = True
+        st.tasks[key] = sp
+        stage_sp.children.append(sp)
+
+    def _on_task_attempt_end(self, ev: dict) -> None:
+        st = self._state(ev)
+        if st is None:
+            return
+        key = (ev.get("stage"), ev.get("task"), ev.get("attempt"))
+        sp = st.tasks.get(key)
+        if sp is None:
+            return
+        sp.end_ts = ev["ts"]
+        sp.status = ev.get("status", "ok")
+        if ev.get("wallMs") is not None:
+            sp.wall_ns = int(ev["wallMs"] * 1_000_000)
+        if ev.get("rows") is not None:
+            sp.rows = ev["rows"]
+        if sp.status != "ok":
+            # a losing/failed attempt's operator work is non-result
+            # work: mark the whole subtree so time attribution can
+            # separate it (the speculation-accounting contract)
+            for child in sp.children:
+                for s in child.walk():
+                    s.status = sp.status
+        # accumulate device time upward for committed attempts
+        elif sp.device_ns == 0:
+            sp.device_ns = sum(c.device_ns for c in sp.children)
+
+    def _on_operator_span(self, ev: dict) -> None:
+        st = self._state(ev)
+        if st is None:
+            return
+        key = (ev.get("stage"), ev.get("task"), ev.get("attempt"))
+        parent = st.tasks.get(key) if ev.get("stage") is not None \
+            else None
+        sp = Span("operator", str(ev.get("operator")),
+                  ev.get("queryId") or 0, stage=ev.get("stage"),
+                  task=ev.get("task"), attempt=ev.get("attempt"),
+                  speculative=bool(ev.get("speculative")),
+                  start_ts=ev["ts"])
+        sp.wall_ns = int(ev.get("wallNs") or 0)
+        sp.device_ns = int(ev.get("deviceNs") or 0)
+        sp.rows = ev.get("rows")
+        sp.status = "ok"
+        sp.extra["metric"] = ev.get("metric")
+        (parent if parent is not None else st.root).children.append(sp)
+
+
+def build_from_events(events: Iterable[dict]) -> List[Span]:
+    """Replay a recorded event stream into finished span trees (one per
+    query). Streams cut off before `query.end` still return their
+    partial tree, marked `unfinished`."""
+    done: List[Span] = []
+    builder = SpanBuilder(on_complete=done.append, keep=1_000_000)
+    for ev in events:
+        builder(ev)
+    for st in builder._live.values():
+        root = st.root
+        root.status = "unfinished"
+        done.append(root)
+    return done

@@ -69,22 +69,32 @@ class CompileStats:
         self.compile_seconds = 0.0     # trace+compile time of builds
         self.artifacts_quarantined = 0  # corrupt entries set aside
 
+    @staticmethod
+    def _emit(kind: str, **fields) -> None:
+        from spark_rapids_tpu.obs import events as obs_events
+
+        obs_events.emit("compile", kind=kind, **fields)
+
     def on_compile(self, seconds: float) -> None:
         with self._lock:
             self.programs_compiled += 1
             self.compile_seconds += float(seconds)
+        self._emit("miss", seconds=round(float(seconds), 4))
 
     def on_hit(self) -> None:
         with self._lock:
             self.cache_hits += 1
+        self._emit("hit")
 
     def on_warm_hit(self) -> None:
         with self._lock:
             self.warm_hits += 1
+        self._emit("warm")
 
     def on_quarantine(self) -> None:
         with self._lock:
             self.artifacts_quarantined += 1
+        self._emit("quarantine")
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:

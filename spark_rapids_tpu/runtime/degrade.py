@@ -93,11 +93,24 @@ def plan_fingerprint(phys) -> Tuple:
     return ("degrade", _plan_key(phys))
 
 
-def record_demotion(kind: str) -> None:
+def record_demotion(kind: str, frm: str = None, to: str = None,
+                    reason: str = None) -> None:
     """Process-wide demotion counter ('fusedToEager', 'eagerToCpu',
-    'breakerShortCircuit', 'fusedOomInjectionFallback')."""
+    'breakerShortCircuit', 'fusedOomInjectionFallback'); every
+    demotion also lands on the obs bus (with from/to/reason when the
+    dispatch site supplies them) for the event log and reports."""
     with _lock:
         _counters[kind] = _counters.get(kind, 0) + 1
+    from spark_rapids_tpu.obs import events as obs_events
+
+    fields = {"kind": kind}
+    if frm is not None:
+        fields["from"] = frm
+    if to is not None:
+        fields["to"] = to
+    if reason is not None:
+        fields["reason"] = reason
+    obs_events.emit("degrade", **fields)
 
 
 def counters() -> Dict[str, int]:

@@ -169,7 +169,11 @@ class FaultRegistry:
             hit = pol.decide(self._rngs[site], self._calls[site])
             if hit:
                 self._injected[site] = self._injected.get(site, 0) + 1
-            return hit
+        if hit:
+            from spark_rapids_tpu.obs import events as obs_events
+
+            obs_events.emit("chaos", site=site)
+        return hit
 
     def maybe_inject(self, site: str, detail: str = "") -> None:
         if self.should_inject(site):

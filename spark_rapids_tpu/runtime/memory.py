@@ -335,6 +335,7 @@ class SpillCatalog:
         return freed
 
     def _spill_one(self, b: SpillableBatch):
+        from spark_rapids_tpu.obs import events as obs_events
         from spark_rapids_tpu.runtime import host_alloc
 
         pageable = host_alloc.get().pageable
@@ -344,6 +345,9 @@ class SpillCatalog:
             self.pool.release(b.size_bytes)
             self.host_used += b.size_bytes
             self.metrics["spill_to_host"] += 1
+            obs_events.emit("spill", component="catalog",
+                            direction="down", fromTier="DEVICE",
+                            toTier="HOST", bytes=b.size_bytes)
             return
         # host tier full (own threshold or the GLOBAL host budget,
         # runtime/host_alloc.py): go straight through to disk. The
@@ -358,6 +362,9 @@ class SpillCatalog:
             pageable.release(b.size_bytes)
         self.pool.release(b.size_bytes)
         self.metrics["spill_to_disk"] += 1
+        obs_events.emit("spill", component="catalog", direction="down",
+                        fromTier="DEVICE", toTier="DISK",
+                        bytes=b.size_bytes)
 
     def spill_host_bytes(self, target: int) -> int:
         """Push coldest host-tier buffers to disk until `target`
@@ -379,6 +386,11 @@ class SpillCatalog:
                 self.host_used -= hb.size_bytes
                 pageable.release(hb.size_bytes)
                 self.metrics["spill_to_disk"] += 1
+                from spark_rapids_tpu.obs import events as obs_events
+
+                obs_events.emit("spill", component="catalog",
+                                direction="down", fromTier="HOST",
+                                toTier="DISK", bytes=hb.size_bytes)
                 freed += hb.size_bytes
         return freed
 
@@ -396,6 +408,12 @@ class SpillCatalog:
 
                 host_alloc.get().pageable.release(sb.size_bytes)
             self.metrics["unspill"] += 1
+            from spark_rapids_tpu.obs import events as obs_events
+
+            obs_events.emit(
+                "spill", component="catalog", direction="up",
+                fromTier="HOST" if was_host else "DISK",
+                toTier="DEVICE", bytes=sb.size_bytes)
 
     # --- stats ---
 

@@ -3,6 +3,12 @@
 Levels ESSENTIAL/MODERATE/DEBUG mirror `RapidsConf.scala:674`; standard
 names match the reference so dashboards translate: numOutputRows,
 numOutputBatches, opTime, semaphoreWaitTime, spillToHostTime, ...
+
+`spark.rapids.sql.metrics.level` is honored at COLLECTION time (the
+reference's createMetric gate, GpuExec.scala:229): a registry built at
+ESSENTIAL hands back a shared no-op metric for MODERATE/DEBUG
+requests, so filtered metrics skip the lock + add entirely instead of
+accumulating and being hidden at snapshot.
 """
 
 from __future__ import annotations
@@ -15,6 +21,9 @@ from typing import Dict
 ESSENTIAL = 0
 MODERATE = 1
 DEBUG = 2
+
+_LEVEL_NAMES = {"ESSENTIAL": ESSENTIAL, "MODERATE": MODERATE,
+                "DEBUG": DEBUG}
 
 NUM_OUTPUT_ROWS = "numOutputRows"
 NUM_OUTPUT_BATCHES = "numOutputBatches"
@@ -32,6 +41,23 @@ FILTER_TIME = "filterTime"
 PARTITION_TIME = "partitionTime"
 WINDOW_TIME = "windowTime"
 TASK_TIME = "taskTime"
+
+
+def parse_level(name, default: int = MODERATE) -> int:
+    """'ESSENTIAL'|'MODERATE'|'DEBUG' (or an int) -> level constant."""
+    if isinstance(name, int):
+        return name
+    return _LEVEL_NAMES.get(str(name).upper(), default)
+
+
+def conf_level(conf) -> int:
+    """Collection level of a session conf (metrics.level satellite);
+    plans built without a conf keep the historical MODERATE."""
+    if conf is None:
+        return MODERATE
+    from spark_rapids_tpu.config import rapids_conf as rc
+
+    return parse_level(conf.get(rc.METRICS_LEVEL))
 
 
 class TpuMetric:
@@ -57,20 +83,48 @@ class TpuMetric:
             self.add(time.monotonic_ns() - t0)
 
 
+class _NullMetric:
+    """Shared sink for metrics above the configured collection level:
+    add/ns are no-ops, value pins at 0, and it never lands in a
+    registry snapshot."""
+
+    __slots__ = ()
+    name = "<filtered>"
+    level = DEBUG + 1
+    value = 0
+
+    def add(self, v: int):
+        pass
+
+    @contextmanager
+    def ns(self):
+        yield
+
+
+NULL_METRIC = _NullMetric()
+
+
 class MetricsRegistry:
-    """Per-operator metric set."""
+    """Per-operator metric set, filtered at the registry's level."""
 
     def __init__(self, level: int = MODERATE):
-        self.level = level
+        self.level = parse_level(level)
         self._metrics: Dict[str, TpuMetric] = {}
 
-    def metric(self, name: str, level: int = MODERATE) -> TpuMetric:
+    def metric(self, name: str, level: int = MODERATE):
+        if level > self.level:
+            return NULL_METRIC
         if name not in self._metrics:
             self._metrics[name] = TpuMetric(name, level)
         return self._metrics[name]
 
-    def __getitem__(self, name: str) -> TpuMetric:
+    def __getitem__(self, name: str):
         return self.metric(name)
+
+    def peek(self, name: str) -> int:
+        """Current value without registering the metric."""
+        m = self._metrics.get(name)
+        return m.value if m is not None else 0
 
     def snapshot(self) -> Dict[str, int]:
         return {m.name: m.value for m in self._metrics.values()

@@ -246,17 +246,26 @@ class StageScheduler:
 
     # --- attempt wrapper (chaos sites live here) ---
 
-    def _attempt_fn(self, task: Task, attempt: int) -> Callable[[], Any]:
+    def _attempt_fn(self, task: Task, attempt: int,
+                    stage: int = 0, speculative: bool = False
+                    ) -> Callable[[], Any]:
+        from spark_rapids_tpu.obs import events as obs_events
         from spark_rapids_tpu.runtime import faults
 
         def fn():
-            if self.rerunnable:
-                faults.maybe_inject(
-                    "worker.crash",
-                    detail=f"{self.name}[{task.index}] attempt {attempt}")
-                if faults.should_inject("task.straggler"):
-                    time.sleep(self.straggler_s)
-            return task.run(attempt)
+            # the task scope tags every event emitted during the
+            # attempt (operator spans above all) with its identity, so
+            # the span builder hangs them under this attempt
+            with obs_events.task_scope(stage, task.index, attempt,
+                                       speculative):
+                if self.rerunnable:
+                    faults.maybe_inject(
+                        "worker.crash",
+                        detail=f"{self.name}[{task.index}] "
+                               f"attempt {attempt}")
+                    if faults.should_inject("task.straggler"):
+                        time.sleep(self.straggler_s)
+                return task.run(attempt)
 
         return fn
 
@@ -270,22 +279,51 @@ class StageScheduler:
         if task.abort is not None:
             task.abort(attempt)
 
+    @staticmethod
+    def _result_rows(result) -> Optional[int]:
+        """Row count of a committed result when it is host-side (an
+        arrow table); device payloads would pay a sync — skip them."""
+        rows = getattr(result, "num_rows", None)
+        return rows if isinstance(rows, int) else None
+
     # --- single-task fast path (no pool) ---
 
     def _run_inline(self, task: Task) -> List[Any]:
+        from spark_rapids_tpu.obs import events as obs_events
+
+        token = next(_stage_token)
+        obs_events.emit("stage.start", stage=token, name=self.name,
+                        tasks=1)
         last: Optional[BaseException] = None
         for attempt in range(self.max_attempts):
             stats.add("tasksLaunched")
+            obs_events.emit("task.attempt.start", stage=token,
+                            task=task.index, attempt=attempt,
+                            worker="inline", speculative=False)
+            t0 = time.monotonic()
             try:
-                result = self._attempt_fn(task, attempt)()
+                result = self._attempt_fn(task, attempt, token)()
                 self._commit(task, result, attempt)
+                obs_events.emit(
+                    "task.attempt.end", stage=token, task=task.index,
+                    attempt=attempt, status="ok",
+                    wallMs=round((time.monotonic() - t0) * 1000, 3),
+                    rows=self._result_rows(result))
+                obs_events.emit("stage.end", stage=token,
+                                name=self.name, status="ok")
                 return [result]
             except BaseException as e:
                 self._abort(task, attempt)
                 lost = isinstance(e, WorkerLost) or (
                     isinstance(e, InjectedFault)
                     and e.site == "worker.crash")
+                obs_events.emit(
+                    "task.attempt.end", stage=token, task=task.index,
+                    attempt=attempt, status="lost" if lost else "failed",
+                    wallMs=round((time.monotonic() - t0) * 1000, 3))
                 if not lost or attempt + 1 >= self.max_attempts:
+                    obs_events.emit("stage.end", stage=token,
+                                    name=self.name, status="failed")
                     raise
                 last = e
                 stats.add("evictedWorkers")
@@ -296,6 +334,8 @@ class StageScheduler:
     # --- main driver ---
 
     def run(self, tasks: List[Task]) -> List[Any]:
+        from spark_rapids_tpu.obs import events as obs_events
+
         if not tasks:
             return []
         stats.add("stagesRun")
@@ -305,6 +345,8 @@ class StageScheduler:
             min(self._max_parallel, len(tasks)), self.name)
         owns_backend = self._backend is None
         token = next(_stage_token)
+        obs_events.emit("stage.start", stage=token, name=self.name,
+                        tasks=len(tasks))
         n = len(tasks)
         results: List[Any] = [None] * n
         committed = [False] * n
@@ -337,10 +379,22 @@ class StageScheduler:
             if is_spec:
                 stats.add("tasksSpeculated")
                 speculative.add((idx, attempt))
+            obs_events.emit("task.attempt.start", stage=token,
+                            task=idx, attempt=attempt, worker=w,
+                            speculative=is_spec)
             backend.submit(tasks[idx], attempt, w,
-                           self._attempt_fn(tasks[idx], attempt),
-                           self._on_orphan(tasks), token)
+                           self._attempt_fn(tasks[idx], attempt, token,
+                                            is_spec),
+                           self._on_orphan(tasks, token), token)
             return True
+
+        def emit_end(idx: int, attempt: int, status: str,
+                     info=None, rows=None) -> None:
+            wall = None if info is None else \
+                round((time.monotonic() - info[1]) * 1000, 3)
+            obs_events.emit("task.attempt.end", stage=token, task=idx,
+                            attempt=attempt, status=status,
+                            wallMs=wall, rows=rows)
 
         def evict_worker(w: str) -> None:
             if w in evicted:
@@ -362,6 +416,7 @@ class StageScheduler:
             if kind == "ok":
                 if committed[idx] or terminal is not None:
                     self._abort(tasks[idx], attempt)
+                    emit_end(idx, attempt, "discarded", info)
                     return
                 committed[idx] = True
                 if info is not None:
@@ -370,9 +425,13 @@ class StageScheduler:
                     stats.add("speculativeWins")
                 self._commit(tasks[idx], value, attempt)
                 results[idx] = value
+                emit_end(idx, attempt, "ok", info,
+                         rows=self._result_rows(value))
                 return
             # failed attempt: its staged output must go
             self._abort(tasks[idx], attempt)
+            emit_end(idx, attempt,
+                     "lost" if kind == "lost" else "failed", info)
             if kind == "lost":
                 evict_worker(w)
                 if committed[idx] or terminal is not None:
@@ -442,14 +501,24 @@ class StageScheduler:
                     kind, idx, attempt = ev[0], ev[1], ev[2]
                     if kind == "ok" and ev[5] == token:
                         self._abort(tasks[idx], attempt)
+                        emit_end(idx, attempt, "discarded")
+            obs_events.emit(
+                "stage.end", stage=token, name=self.name,
+                status="ok" if terminal is None else "failed")
         if terminal is not None:
             raise terminal
         return results
 
-    def _on_orphan(self, tasks: List[Task]) -> Callable:
+    def _on_orphan(self, tasks: List[Task], stage: int = 0) -> Callable:
+        from spark_rapids_tpu.obs import events as obs_events
+
         def on_orphan(ev) -> None:
             kind, idx, attempt = ev[0], ev[1], ev[2]
             if kind == "ok":
                 self._abort(tasks[idx], attempt)
+                obs_events.emit("task.attempt.end", stage=stage,
+                                task=idx, attempt=attempt,
+                                status="discarded", wallMs=None,
+                                rows=None)
 
         return on_orphan

@@ -146,6 +146,10 @@ class ShuffleManager:
         b.path = path
         b.table = None
         self.blocks_spilled += 1
+        from spark_rapids_tpu.obs import events as obs_events
+
+        obs_events.emit("spill", component="shuffle", direction="down",
+                        fromTier="HOST", toTier="DISK", bytes=b.nbytes)
 
     def _spill_mem_blocks(self):
         """Under lock: move coldest (oldest) in-memory blocks to
@@ -175,6 +179,11 @@ class ShuffleManager:
         publishes the attempt (the scheduler's commit-once discipline).
         Without it the block commits immediately (legacy single-attempt
         writers: range exchange, mesh spill paths, tests)."""
+        from spark_rapids_tpu.obs import events as obs_events
+
+        obs_events.emit("shuffle.write", shuffleId=shuffle_id,
+                        reducePid=reduce_pid, bytes=table.nbytes,
+                        staged=map_id is not None)
         if self.mode != "MULTITHREADED":
             from spark_rapids_tpu.runtime import host_alloc
 
@@ -395,8 +404,13 @@ class ShuffleManager:
                 raise
 
         def count_retry(_exc):
+            from spark_rapids_tpu.obs import events as obs_events
+
             with self._lock:
                 self.fetch_retries += 1
+            obs_events.emit("shuffle.retry", shuffleId=shuffle_id,
+                            reducePid=reduce_pid,
+                            block=os.path.basename(path))
 
         try:
             return backoff.retry_io(
@@ -432,6 +446,7 @@ class ShuffleManager:
                 f"shuffle.lost_output)", map_id=map_id)
 
     def fetch(self, shuffle_id: int, reduce_pid: int) -> List[pa.Table]:
+        from spark_rapids_tpu.obs import events as obs_events
         from spark_rapids_tpu.runtime.errors import ShuffleFetchError
 
         if self.mode != "MULTITHREADED":
@@ -446,6 +461,9 @@ class ShuffleManager:
                 else:
                     out.append(self._fetch_block(path, shuffle_id,
                                                  reduce_pid, map_id))
+            obs_events.emit("shuffle.fetch", shuffleId=shuffle_id,
+                            reducePid=reduce_pid, blocks=len(out),
+                            bytes=sum(t.nbytes for t in out))
             return out
         with self._lock:
             fbs = list(self._files.get((shuffle_id, reduce_pid), []))
@@ -464,6 +482,9 @@ class ShuffleManager:
                     map_id=fb.map_id) from e
             tables.append(self._fetch_block(path, shuffle_id,
                                             reduce_pid, fb.map_id))
+        obs_events.emit("shuffle.fetch", shuffleId=shuffle_id,
+                        reducePid=reduce_pid, blocks=len(tables),
+                        bytes=sum(t.nbytes for t in tables))
         return tables
 
     def remove_shuffle(self, shuffle_id: int):
