@@ -237,6 +237,92 @@ def _session_conf():
     }
 
 
+def _admission_probe(spark) -> dict:
+    """Governed burst against the live session: 4 concurrent copies of
+    the aggregate query through a 1-slot admission controller with a
+    2-deep queue (so real queueing and a real shed happen), then one
+    mid-flight cancel — reporting queue-wait p50/p99, shed count, and
+    cancel latency. The process controller is restored afterwards."""
+    import statistics
+    import threading
+
+    from spark_rapids_tpu.api import functions as F
+    from spark_rapids_tpu.runtime import admission
+    from spark_rapids_tpu.runtime.errors import (
+        QueryCancelledError,
+        QueryRejectedError,
+    )
+
+    def q():
+        return spark.read.parquet(DATA_DIR).groupBy("store").agg(
+            F.sum("amount").alias("rev"))
+
+    old = admission.get()
+    ctrl = admission.AdmissionController(
+        max_concurrent=1, queue_depth=2, queue_timeout_ms=120_000)
+    admission.install(ctrl)
+    waits_mark = len(admission.stats._waits)
+    shed = [0]
+    try:
+        def worker():
+            try:
+                q().collect_arrow()
+            except QueryRejectedError:
+                shed[0] += 1
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for i, t in enumerate(threads):
+            t.start()
+            time.sleep(0.01)  # deterministic arrival order
+        for t in threads:
+            t.join(600)
+        waits = sorted(list(admission.stats._waits)[waits_mark:])
+
+        # one mid-flight cancel: latency from cancel() to unwound;
+        # earlier cancels when the query outruns the first attempt
+        cancel_ms = None
+        for delay in (0.005, 0.02, 0.08):
+            err = []
+
+            def victim():
+                try:
+                    q().collect_arrow()
+                except QueryCancelledError:
+                    err.append(True)
+
+            t = threading.Thread(target=victim)
+            t.start()
+            time.sleep(delay)
+            running = ctrl.running_table()
+            if running:
+                t0 = time.perf_counter()
+                ctrl.cancel(running[0]["queryId"], "bench probe")
+                t.join(600)
+                if err:
+                    cancel_ms = round(
+                        (time.perf_counter() - t0) * 1000, 1)
+                    break
+            else:
+                t.join(600)
+
+        def pct(v, qq):
+            if not v:
+                return None
+            return round(v[min(len(v) - 1,
+                               int(round(qq * (len(v) - 1))))], 3)
+
+        return {
+            "queueWaitMsP50": pct(waits, 0.50),
+            "queueWaitMsP99": pct(waits, 0.99),
+            "queueWaitMsMean": (round(statistics.mean(waits), 3)
+                                if waits else None),
+            "shedCount": shed[0],
+            "cancelLatencyMs": cancel_ms,
+        }
+    finally:
+        admission.install(old)
+
+
 def cold_probe():
     """--cold-probe: the warm-persistent-cache cold start. Runs in a
     FRESH process after the main bench warmed the compile cache, so it
@@ -425,6 +511,15 @@ def main():
     jax.block_until_ready(jax.device_put(big))
     h2d = big.nbytes / (time.perf_counter() - t0) / 1e9
 
+    # ---- admission/governance block: queue-wait percentiles, shed
+    # ---- count and cancel latency of a governed burst, so the
+    # ---- trajectory tracks what multi-tenant governance costs
+    admission_block = None
+    try:
+        admission_block = _admission_probe(spark)
+    except Exception as e:  # never lose the perf report
+        print(f"# admission block unavailable: {e!r}", flush=True)
+
     # ---- obs attribution block: the perf trajectory should capture
     # ---- WHERE time went (top operators by device time, span-tree
     # ---- shape, event volume), not just the totals above
@@ -482,6 +577,9 @@ def main():
         # numbers — BENCH_* history tracks robustness overhead; under
         # ci/chaos_check.sh they show the recovery machinery working
         "robustness": spark.robustness_metrics,
+        # query-governance overhead (PR 5): queue waits / sheds /
+        # cancel latency of a concurrent governed burst
+        "admission": admission_block,
         # event/span attribution (obs/): top operators by device time,
         # span-tree depth, event volume — regression triage data
         "obs": obs_block,

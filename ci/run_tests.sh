@@ -18,6 +18,9 @@ ci/chaos_check.sh
 echo "== event-log gate (schema, round-trip, qualification) =="
 ci/eventlog_check.sh
 
+echo "== concurrency gate (admission + chaos + cancel storm) =="
+ci/concurrency_check.sh
+
 echo "== multichip dryrun (virtual mesh) =="
 SPARK_RAPIDS_TPU_DRYRUN_REEXEC=1 python - <<'PY'
 import jax

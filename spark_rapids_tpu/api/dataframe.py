@@ -616,24 +616,43 @@ class DataFrame:
         # error must never silently land a query on the dispatch-bound
         # eager path.
         from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.runtime import admission
 
         rec = {"engine": None, "fallbacks": [], "compile": None,
                "degradations": [], "scheduler": None}
         self._last_exec = rec
         self.session.last_execution = rec
-        # the query scope brackets the event stream (query.start /
-        # query.end frame the event log + span tree); nested collects
-        # fold into the outer query's stream
-        qid = obs_events.begin_query()
-        rec["queryId"] = qid
-        try:
-            return self._collect_arrow_traced(rec)
-        finally:
-            obs_events.finish_query(
-                qid, engine=rec["engine"],
-                status="ok" if rec["engine"] is not None else "error",
-                fallbacks=len(rec["fallbacks"]),
-                degradations=len(rec["degradations"]))
+        # admission front door (runtime/admission.py): the OUTERMOST
+        # collect takes a query slot (possibly queueing, possibly shed
+        # with QueryRejectedError before any work), owns the query's
+        # CancelToken for the whole execution, and releases the slot on
+        # exit; nested collects ride the enclosing query's handle
+        scope = admission.AdmissionScope(
+            self.session, description=type(self._plan).__name__)
+        with scope as handle:
+            # the query scope brackets the event stream (query.start /
+            # query.end frame the event log + span tree); nested
+            # collects fold into the outer query's stream
+            qid = obs_events.begin_query(handle.query_id)
+            rec["queryId"] = qid
+            rec["admission"] = {"queueWaitMs": handle.queue_wait_ms}
+            if not scope.nested and handle.queue_wait_ms:
+                # queue wait on the query's span tree (no task scope
+                # here, so the span hangs off the query root)
+                obs_events.emit(
+                    "operator.span", operator="AdmissionQueue",
+                    metric="queueWaitMs",
+                    wallNs=int(handle.queue_wait_ms * 1_000_000),
+                    deviceNs=0)
+            try:
+                return self._collect_arrow_traced(rec)
+            finally:
+                obs_events.finish_query(
+                    qid, engine=rec["engine"],
+                    status="ok" if rec["engine"] is not None
+                    else "error",
+                    fallbacks=len(rec["fallbacks"]),
+                    degradations=len(rec["degradations"]))
 
     def _collect_arrow_traced(self, rec) -> pa.Table:
         from spark_rapids_tpu.obs import events as obs_events
@@ -721,12 +740,15 @@ class DataFrame:
         circuit breaker (runtime/degrade.py) stops re-trying the fused
         engine on a plan that keeps dying there."""
         from spark_rapids_tpu.config import rapids_conf as rc
-        from spark_rapids_tpu.runtime import degrade, faults
+        from spark_rapids_tpu.runtime import cancellation, degrade, faults
         from spark_rapids_tpu.runtime.errors import TpuOOMError
 
         conf = self.session.rapids_conf
         ladder_on = conf.get(rc.DEGRADE_ENABLED)
         qm = self.session.query_metrics
+        # ladder rungs are yield points: a cancelled/expired query must
+        # not start the next (slower) engine
+        cancellation.check_current()
 
         def demoted(frm: str, to: str, reason: str) -> None:
             rec["degradations"].append(
@@ -799,6 +821,7 @@ class DataFrame:
                             f"(failure {n}/{breaker.threshold} for "
                             f"this program key)")
         try:
+            cancellation.check_current()
             if conf.get(rc.ADAPTIVE_ENABLED):
                 from spark_rapids_tpu.exec.operators import (
                     TpuShuffleExchangeExec,
@@ -820,6 +843,7 @@ class DataFrame:
         except (TpuOOMError, faults.InjectedFault) as e:
             if not ladder_on:
                 raise
+            cancellation.check_current()
             # last rung: the CPU engine (exec/cpu_eval.py lowering via
             # the cpu-oracle plan) — slow beats dead
             demoted("eager", "cpu", f"{type(e).__name__}: {e}")

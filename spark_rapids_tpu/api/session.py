@@ -356,6 +356,35 @@ class TpuSparkSession:
 
         return prom.render(self)
 
+    # --- query governance (runtime/admission.py) ---
+
+    def cancel(self, query_id: int,
+               reason: str = "cancelled by user") -> bool:
+        """Cancel a running or queued query by the id reported in
+        last_execution['queryId'] / the admission tables. A queued
+        query leaves the queue immediately; a running one unwinds at
+        its next cooperative yield point, releasing its semaphore
+        permits and spill-catalog buffers. True when the cancel newly
+        latched."""
+        from spark_rapids_tpu.runtime import admission
+
+        return admission.get().cancel(query_id, reason)
+
+    def cancel_all(self, reason: str = "cancelled by user") -> int:
+        """Cancel every running and queued query; returns how many
+        tokens newly latched."""
+        from spark_rapids_tpu.runtime import admission
+
+        return admission.get().cancel_all(reason)
+
+    def admission_status(self) -> dict:
+        """Running + queued query tables (ids, priorities, elapsed
+        time, descriptions) and the conf'd capacity — the table a
+        QueryRejectedError prints, live."""
+        from spark_rapids_tpu.runtime import admission
+
+        return admission.get().status()
+
     def stop(self):
         global _active
         try:

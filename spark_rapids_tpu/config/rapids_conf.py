@@ -642,6 +642,62 @@ EVENTLOG_ROTATE_BYTES = conf(
     "Roll a query's event log to a new part file past this many "
     "bytes; all parts finalize together at query end.", int,
     checker=lambda v: v >= 4096)
+ADMISSION_ENABLED = conf(
+    "spark.rapids.tpu.admission.enabled", True,
+    "Query admission control (runtime/admission.py): every top-level "
+    "collect passes through a bounded queue in front of execution — at "
+    "most admission.maxConcurrentQueries run, queue.maxDepth more "
+    "wait FIFO-within-priority, and anything past that is load-shed "
+    "with a QueryRejectedError naming the running queries. false "
+    "admits everything immediately (deadlines/cancellation still "
+    "work).", bool)
+ADMISSION_MAX_CONCURRENT = conf(
+    "spark.rapids.tpu.admission.maxConcurrentQueries", 4,
+    "Queries allowed to execute concurrently in one process; later "
+    "submissions queue. Sized against the device semaphore: more "
+    "concurrent queries than permit groups just queue inside "
+    "execution with worse diagnostics.", int,
+    checker=lambda v: 1 <= v <= 1024)
+ADMISSION_QUEUE_DEPTH = conf(
+    "spark.rapids.tpu.admission.queue.maxDepth", 16,
+    "Bounded admission-queue depth; a submission arriving past it is "
+    "shed immediately with QueryRejectedError (clean failure beats an "
+    "unbounded wait).", int, checker=lambda v: 0 <= v <= 100_000)
+ADMISSION_QUEUE_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.admission.queue.timeoutMs", 120_000,
+    "How long a queued query waits for a slot before failing with "
+    "QueryQueueTimeout diagnostics naming the running queries holding "
+    "capacity. 0 disables the queue timeout.", int,
+    checker=lambda v: v >= 0)
+ADMISSION_QUARANTINE_CRASHES = conf(
+    "spark.rapids.tpu.admission.quarantine.maxWorkerCrashes", 8,
+    "Poison-query quarantine: a query whose task attempts crash "
+    "workers (scheduler eviction feed) this many times is cancelled "
+    "with QueryQuarantinedError carrying the crash history, instead "
+    "of burning stage.maxAttempts per task forever. 0 disables.", int,
+    checker=lambda v: 0 <= v <= 100_000)
+QUERY_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.query.timeoutMs", 0,
+    "Per-query deadline covering queue wait + execution; past it the "
+    "query's CancelToken cancels and the query unwinds with "
+    "QueryDeadlineExceeded at its next cooperative yield point, "
+    "releasing permits and spill-catalog buffers. 0 = no deadline.",
+    int, checker=lambda v: v >= 0)
+QUERY_PRIORITY = conf(
+    "spark.rapids.tpu.query.priority", 0,
+    "Admission-queue priority of this session's queries (higher "
+    "admits first; FIFO within a priority). Set per session, or per "
+    "query via session.conf.set between submissions.", int,
+    checker=lambda v: -1000 <= v <= 1000)
+QUOTA_DEVICE_BYTES_PER_QUERY = conf(
+    "spark.rapids.tpu.quota.device.maxBytesPerQuery", 0,
+    "Per-query cap on device-pool reservations (SpillCatalog tags "
+    "every reservation with its owning query id): an over-quota "
+    "allocation first spills the OFFENDING query's own device buffers, "
+    "then raises TpuRetryOOM/TpuSplitAndRetryOOM for that query only — "
+    "one runaway query degrades itself instead of pressuring the whole "
+    "session. 0 disables per-query quotas.", int,
+    checker=lambda v: v >= 0)
 
 
 def conf_entries() -> List[ConfEntry]:

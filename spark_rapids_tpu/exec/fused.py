@@ -631,6 +631,14 @@ class FusedSingleChipExecutor:
         def run_program(key_tag, nodes_key, fn, inputs,
                         uses_expansion=False, uses_group_cap=False,
                         uses_ansi=False):
+            # program dispatch = the fused engine's cooperative yield
+            # point (the per-attempt check of the stage scheduler,
+            # scaled to this engine's unit of work): a cancelled query
+            # stops before the next compile/dispatch instead of running
+            # the pipeline to completion
+            from spark_rapids_tpu.runtime import cancellation
+
+            cancellation.check_current()
             # chaos site device.dispatch: an injected fault here is the
             # fused engine "dying mid-dispatch"; the dispatch ladder
             # (api/dataframe.py) demotes the query to the eager engine

@@ -51,6 +51,12 @@ EVENT_TYPES: Dict[str, str] = {
     "compile": "kind (miss|hit|warm|quarantine), seconds",
     "degrade": "kind, from, to, reason",
     "chaos": "site",
+    "admission.queued": "queryId, depth, running",
+    "admission.admitted": "queryId, waitMs",
+    "admission.shed": "queryId, reason, running",
+    "admission.cancelled": "queryId, reason, latencyMs",
+    "admission.deadline": "queryId, reason, latencyMs",
+    "admission.quarantined": "queryId, reason, crashes",
 }
 
 #: Envelope keys present on EVERY event (eventlog validation contract).
@@ -165,48 +171,61 @@ def emit(event: str, **fields) -> None:
 
 
 # ------------------------------------------------------- query context
+#
+# THREAD-LOCAL: each submitting thread owns its query scope, so
+# concurrent queries through one session get distinct ids (the
+# multi-tenant governance unit, runtime/admission.py). Nested collects
+# on the same thread (cache materialization, writes that read) still
+# fold into the enclosing query's stream; scheduler pool threads
+# inherit the id through the task scope below.
 
 _query_counter = itertools.count(1)
-_query_lock = threading.Lock()
-_query_depth = 0
-_query_id = 0
+_query_tls = threading.local()
 
 
-def begin_query() -> int:
-    """Enter a query scope; emits `query.start` for the OUTERMOST
-    scope only (nested collects — cache materialization, writes that
-    read — fold into the enclosing query's stream)."""
-    global _query_depth, _query_id
-    with _query_lock:
-        _query_depth += 1
-        if _query_depth == 1:
-            _query_id = next(_query_counter)
-            top = True
-        else:
-            top = False
-        qid = _query_id
-    if top:
+def allocate_query_id() -> int:
+    """Reserve a query id BEFORE the query scope opens — the admission
+    controller names queued/shed queries by the same id their events
+    and span tree will carry once (if) they run."""
+    return next(_query_counter)
+
+
+def begin_query(qid: Optional[int] = None) -> int:
+    """Enter a query scope on this thread; emits `query.start` for the
+    OUTERMOST scope only. A preallocated `qid` (admission) is honored
+    at the outermost scope; nested scopes keep the enclosing id."""
+    depth = getattr(_query_tls, "depth", 0)
+    _query_tls.depth = depth + 1
+    if depth == 0:
+        _query_tls.qid = qid if qid is not None else next(_query_counter)
         emit("query.start")
-    return qid
+    return _query_tls.qid
 
 
 def finish_query(qid: int, **fields) -> None:
     """Leave a query scope; the outermost exit emits `query.end` with
     the caller's summary fields (engine, status, ...)."""
-    global _query_depth, _query_id
-    with _query_lock:
-        _query_depth = max(0, _query_depth - 1)
-        top = _query_depth == 0
-    if top:
+    depth = max(0, getattr(_query_tls, "depth", 0) - 1)
+    _query_tls.depth = depth
+    if depth == 0:
         # emit BEFORE clearing the id so the end event carries it
         emit("query.end", **fields)
-        with _query_lock:
-            if _query_depth == 0:
-                _query_id = 0
+        _query_tls.qid = 0
 
 
 def current_query_id() -> int:
-    return _query_id
+    return getattr(_query_tls, "qid", 0)
+
+
+def effective_query_id() -> int:
+    """Query attribution for code that may run in a scheduler pool
+    thread: the task scope's captured query id first, else this
+    thread's own query scope (memory quotas and semaphore diagnostics
+    resolve their owner through this)."""
+    ctx = task_context()
+    if ctx and ctx.get("queryId"):
+        return ctx["queryId"]
+    return current_query_id()
 
 
 # -------------------------------------------------------- task context
@@ -216,14 +235,20 @@ _task_ctx = threading.local()
 
 @contextlib.contextmanager
 def task_scope(stage: int, task: int, attempt: int,
-               speculative: bool = False):
+               speculative: bool = False,
+               query_id: Optional[int] = None):
     """Tag the current thread with a scheduler attempt identity; events
     emitted inside (operator spans above all) inherit it. Nests: an
     exchange map stage running inside a result task re-tags to the
-    inner attempt and restores on exit."""
+    inner attempt and restores on exit. `query_id` carries the
+    submitting thread's (thread-local) query scope into pool threads —
+    emit() lets it override the pool thread's own empty scope."""
     prev = getattr(_task_ctx, "ctx", None)
-    _task_ctx.ctx = {"stage": stage, "task": task, "attempt": attempt,
-                     "speculative": bool(speculative)}
+    ctx = {"stage": stage, "task": task, "attempt": attempt,
+           "speculative": bool(speculative)}
+    if query_id:
+        ctx["queryId"] = query_id
+    _task_ctx.ctx = ctx
     try:
         yield
     finally:

@@ -22,6 +22,7 @@ def robustness_snapshot() -> dict:
     stage-scheduler recoveries, degradation-ladder demotions +
     circuit-breaker state, quarantined compile artifacts, and
     semaphore timeouts. Key layout is pinned by existing tests."""
+    from spark_rapids_tpu.runtime import admission as _adm
     from spark_rapids_tpu.runtime import backoff, degrade, faults
     from spark_rapids_tpu.runtime import scheduler as _sched
     from spark_rapids_tpu.runtime import semaphore as sem
@@ -38,6 +39,7 @@ def robustness_snapshot() -> dict:
                     "speculativeDiscards": mgr.speculative_discards},
         "scheduler": _sched.stats.snapshot(),
         "degrade": degrade.counters(),
+        "admission": _adm.stats.snapshot(),
         "artifactsQuarantined":
             stats.snapshot()["artifactsQuarantined"],
         "semaphoreTimeouts": sem.get().timeouts,

@@ -84,8 +84,8 @@ class TpuExecutorPlugin:
 
     def init(self, conf: rc.RapidsConf):
         from spark_rapids_tpu.io import filecache
-        from spark_rapids_tpu.runtime import compile_cache, degrade, \
-            faults, memory, semaphore
+        from spark_rapids_tpu.runtime import admission, compile_cache, \
+            degrade, faults, memory, semaphore
         from spark_rapids_tpu.shuffle.manager import configure_shuffle
 
         self._validate_device()
@@ -93,6 +93,9 @@ class TpuExecutorPlugin:
         # consumer of an injection site (compile.cache_load, io.read)
         faults.configure(conf)
         degrade.configure(conf)
+        # query governance front door (admission queue + cancel
+        # registry) — after faults so admission.slow_drain is armed
+        admission.configure(conf)
         filecache.configure(conf)  # FileCache.init (Plugin.scala:545)
         # persistent compilation layer BEFORE any program compiles, so
         # the whole session (incl. warmup) rides the disk cache

@@ -79,7 +79,7 @@ def retry_io(fn: Callable[[], T], what: str,
              policy: Optional[BackoffPolicy] = None,
              counter: Optional[str] = None,
              on_retry: Optional[Callable[[BaseException], None]] = None,
-             sleep: Callable[[float], None] = time.sleep) -> T:
+             sleep: Optional[Callable[[float], None]] = None) -> T:
     """Run `fn` under the backoff policy. Exceptions in `retry_on` (or
     an InjectedFault for `site` / one of `absorb_sites` — sites whose
     recovery point is THIS loop, e.g. shuffle.deserialize faults
@@ -88,12 +88,18 @@ def retry_io(fn: Callable[[], T], what: str,
     transient). The final failure raises RetryExhausted chained to the
     last error — callers convert it to their domain's clean engine
     error."""
-    from spark_rapids_tpu.runtime import faults
+    from spark_rapids_tpu.runtime import cancellation, faults
 
     policy = policy or policy_from_conf()
+    # default sleep is cancellation-aware: a cancelled query leaves the
+    # backoff loop at the next delay instead of riding it out (callers
+    # passing their own sleep — tests — keep full control)
+    if sleep is None:
+        sleep = cancellation.sleep_interruptible
     mine = tuple(s for s in ((site,) + tuple(absorb_sites)) if s)
     last: Optional[BaseException] = None
     for attempt in range(policy.attempts):
+        cancellation.check_current()
         try:
             if site is not None:
                 faults.maybe_inject(site, detail=what)

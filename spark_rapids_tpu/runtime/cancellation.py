@@ -1,0 +1,221 @@
+"""Cooperative per-query cancellation — the token the governance layer
+(runtime/admission.py) threads through execution.
+
+Spark interrupts tasks with Thread.interrupt + TaskContext.isInterrupted
+checks; Python threads cannot be interrupted, so the engine uses the
+same discipline explicitly: every query owns a `CancelToken`, and the
+natural yield points that already exist — scheduler task-attempt
+boundaries (runtime/scheduler.py), semaphore waits (runtime/semaphore.py),
+backoff sleeps and shuffle fetch/retry loops (runtime/backoff.py,
+shuffle/manager.py), the OOM split-and-retry loop (runtime/retry.py),
+and the engine-dispatch ladder (api/dataframe.py) — call `check()` or
+wait on the token's event. A cancelled or expired query therefore
+unwinds within a bounded latency: the longest stretch of work between
+two yield points, not "whenever the query happens to finish".
+
+Propagation is thread-local (`scope()`); the stage scheduler captures
+the submitting thread's token at `run()` and re-establishes it inside
+every pool-thread attempt, the same way it forwards the query id into
+the task scope. Blocking waiters (the semaphore) register `on_cancel`
+callbacks so a cancel wakes them immediately instead of at the next
+poll tick.
+
+The token doubles as the poison-query ledger: worker crashes attributed
+to the query land in `record_worker_crash`, and crossing the conf'd
+quarantine threshold cancels the token with a QueryQuarantinedError
+carrying the crash history.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+from spark_rapids_tpu.runtime.errors import (
+    QueryCancelledError,
+    QueryDeadlineExceeded,
+    QueryQuarantinedError,
+)
+
+
+class CancelToken:
+    """Per-query cancellation state: a latch + reason + error class,
+    an optional absolute deadline, cancel callbacks, and the
+    worker-crash history feeding quarantine."""
+
+    def __init__(self, query_id: int, timeout_ms: int = 0,
+                 description: str = "",
+                 quarantine_threshold: int = 0):
+        self.query_id = query_id
+        self.description = description
+        self.created_at = time.monotonic()
+        self.deadline: Optional[float] = (
+            self.created_at + timeout_ms / 1000.0 if timeout_ms > 0
+            else None)
+        self.quarantine_threshold = max(0, int(quarantine_threshold))
+        self.cancel_requested_at: Optional[float] = None
+        self.crashes: List[Tuple[float, int, int, str]] = []
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._callbacks: List[Callable[[], None]] = []
+        self._reason: Optional[str] = None
+        self._error_cls = QueryCancelledError
+
+    # --- cancellation ---
+
+    def cancel(self, reason: str = "cancelled",
+               error_cls: type = QueryCancelledError) -> bool:
+        """Latch the token (first cancel wins); fires callbacks outside
+        the lock. Returns False when already cancelled."""
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self._reason = reason
+            self._error_cls = error_cls
+            self.cancel_requested_at = time.monotonic()
+            self._event.set()
+            cbs = list(self._callbacks)
+        for cb in cbs:
+            try:
+                cb()
+            except Exception:
+                pass  # a waiter's wakeup must never poison the canceller
+        return True
+
+    @property
+    def cancelled(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def expired(self) -> bool:
+        return self.deadline is not None and \
+            time.monotonic() > self.deadline
+
+    def remaining_s(self) -> Optional[float]:
+        """Seconds until the deadline (None = no deadline); bounded
+        waiters cap their sleep with this so an expiry wakes them."""
+        if self.deadline is None:
+            return None
+        return max(0.0, self.deadline - time.monotonic())
+
+    def error(self) -> QueryCancelledError:
+        reason = self._reason or "cancelled"
+        return self._error_cls(
+            f"query {self.query_id} {reason}"
+            + (f" ({self.description})" if self.description else ""))
+
+    def check(self) -> None:
+        """The cooperative yield point: raise when cancelled, and turn
+        a passed deadline into a cancel (so every waiter wakes) before
+        raising it."""
+        if not self._event.is_set() and self.expired:
+            elapsed = time.monotonic() - self.created_at
+            self.cancel(
+                f"deadline exceeded after {elapsed:.1f}s "
+                f"(spark.rapids.tpu.query.timeoutMs)",
+                QueryDeadlineExceeded)
+        if self._event.is_set():
+            raise self.error()
+
+    # --- waiter wakeup ---
+
+    def on_cancel(self, cb: Callable[[], None]) -> None:
+        """Register a wakeup callback (fires immediately when already
+        cancelled) — blocking waiters use this to leave their condition
+        variables promptly instead of at a poll tick."""
+        with self._lock:
+            if not self._event.is_set():
+                self._callbacks.append(cb)
+                return
+        cb()
+
+    def remove_on_cancel(self, cb: Callable[[], None]) -> None:
+        with self._lock:
+            try:
+                self._callbacks.remove(cb)
+            except ValueError:
+                pass
+
+    def wait(self, timeout_s: float) -> bool:
+        """Sleep up to timeout_s, waking early on cancel; True when
+        cancelled/deadline-bounded wakeup fired."""
+        t = timeout_s
+        r = self.remaining_s()
+        if r is not None:
+            t = min(t, r + 0.001)
+        return self._event.wait(max(0.0, t))
+
+    # --- poison-query quarantine feed ---
+
+    def record_worker_crash(self, stage: int, task: int,
+                            worker: str) -> None:
+        """One scheduler-observed worker crash attributed to this query
+        (PR 3's eviction feed). Crossing the quarantine threshold
+        cancels the token with the crash history — the query fails fast
+        instead of burning stage.maxAttempts per task forever."""
+        with self._lock:
+            self.crashes.append(
+                (time.monotonic() - self.created_at, stage, task, worker))
+            n = len(self.crashes)
+            history = list(self.crashes)
+        if self.quarantine_threshold and \
+                n >= self.quarantine_threshold and not self.cancelled:
+            rows = ", ".join(
+                f"t+{ts:.2f}s stage={st} task={tk} worker={w}"
+                for ts, st, tk, w in history)
+            self.cancel(
+                f"quarantined after {n} worker crashes "
+                f"(admission.quarantine.maxWorkerCrashes="
+                f"{self.quarantine_threshold}); crash history: [{rows}]",
+                QueryQuarantinedError)
+
+    def unwind_latency_s(self) -> Optional[float]:
+        """Seconds from cancel request to now — admission.finish reads
+        it once the unwind completes (the cancel-latency metric)."""
+        if self.cancel_requested_at is None:
+            return None
+        return time.monotonic() - self.cancel_requested_at
+
+
+# ------------------------------------------------- thread-local scope
+
+_tls = threading.local()
+
+
+def current() -> Optional[CancelToken]:
+    return getattr(_tls, "token", None)
+
+
+@contextlib.contextmanager
+def scope(token: Optional[CancelToken]):
+    """Establish `token` as the thread's query token; nests (an inner
+    scope restores the outer on exit). A None token clears the scope —
+    useful for background work that must not inherit a query's fate."""
+    prev = getattr(_tls, "token", None)
+    _tls.token = token
+    try:
+        yield token
+    finally:
+        _tls.token = prev
+
+
+def check_current() -> None:
+    """Module-level yield point: no-op without a token in scope."""
+    t = getattr(_tls, "token", None)
+    if t is not None:
+        t.check()
+
+
+def sleep_interruptible(delay_s: float) -> None:
+    """time.sleep that a cancel (or deadline) cuts short — the backoff
+    loops' sleep primitive, so a cancelled query never rides out a
+    2-second retry delay before noticing."""
+    t = getattr(_tls, "token", None)
+    if t is None:
+        time.sleep(delay_s)
+        return
+    t.check()
+    t.wait(delay_s)
+    t.check()

@@ -97,6 +97,47 @@ class SemaphoreTimeout(RuntimeError):
     process hanging silently."""
 
 
+class QueryGovernanceError(RuntimeError):
+    """Base of the query-lifecycle governance taxonomy
+    (runtime/admission.py + runtime/cancellation.py): every way the
+    governance layer refuses or unwinds a query is a subclass, so
+    callers can catch the whole family or one verdict."""
+
+
+class QueryRejectedError(QueryGovernanceError):
+    """Load shed at submission: the admission queue is at maxDepth on
+    top of maxConcurrentQueries running. The message carries the
+    running-query table (query ids, elapsed time, descriptions) so the
+    operator sees WHO holds capacity — a shed is always an immediate
+    clean error, never an unbounded wait."""
+
+
+class QueryQueueTimeout(QueryRejectedError):
+    """A queued query waited past admission.queue.timeoutMs without a
+    slot freeing; diagnostics name the running queries that held
+    capacity the whole time."""
+
+
+class QueryCancelledError(QueryGovernanceError):
+    """The query's CancelToken was cancelled (session.cancel(),
+    cancel_all(), or a governance verdict); raised at the next
+    cooperative yield point so the query unwinds within a bounded
+    latency, releasing permits and spill-catalog buffers."""
+
+
+class QueryDeadlineExceeded(QueryCancelledError):
+    """The query ran past spark.rapids.tpu.query.timeoutMs (queue wait
+    counts); cancellation semantics, with the deadline in the message."""
+
+
+class QueryQuarantinedError(QueryCancelledError):
+    """Poison-query quarantine: the query's attempts crashed workers
+    (scheduler eviction feed) more than
+    admission.quarantine.maxWorkerCrashes times — it is failed fast
+    with its crash history instead of burning stage.maxAttempts
+    budgets forever."""
+
+
 class TpuAnsiError(ValueError):
     """ANSI-mode runtime error (the SparkArithmeticException /
     SparkDateTimeException role): raised when spark.sql.ansi.enabled

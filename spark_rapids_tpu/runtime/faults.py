@@ -28,6 +28,15 @@ locations where the real world fails —
                         output (shuffle/manager.py) — the block is gone
                         AFTER the block-level retry budget, exercising
                         lineage recomputation of the owning map task
+    query.cancel_race   query completion in the admission controller
+                        (runtime/admission.py) — a cancel lands exactly
+                        as the query finishes; the result must still
+                        return, permits/slots release exactly once, and
+                        the late cancel must not bleed into the next
+                        query
+    admission.slow_drain admission slot release — the handoff to the
+                        next queued query is delayed, exercising
+                        queue-wait accounting and queue-timeout margins
 
 and every site's CONSUMER survives the injected fault: backoff retries
 (runtime/backoff.py), quarantine-and-recompile, or engine demotion.
@@ -66,6 +75,8 @@ KNOWN_SITES = (
     "worker.crash",
     "task.straggler",
     "shuffle.lost_output",
+    "query.cancel_race",
+    "admission.slow_drain",
 )
 
 

@@ -63,7 +63,7 @@ class SpillableBatch:
     tier moves."""
 
     def __init__(self, catalog: "SpillCatalog", batch: ColumnBatch,
-                 priority: int):
+                 priority: int, query_id: int = 0):
         self._catalog = catalog
         self._priority = priority
         self._tier = SpillTier.DEVICE
@@ -71,6 +71,7 @@ class SpillableBatch:
         self._host_data = None
         self._disk_path: Optional[str] = None
         self._treedef = None
+        self.query_id = query_id  # owning query (0 = unattributed)
         self.size_bytes = batch.device_size_bytes()
         self._rows = None  # lazy: row_count() syncs the device (64ms+
         # per roundtrip on tunneled devices; hundreds of parks per query)
@@ -225,13 +226,20 @@ class SpillCatalog:
                  spill_dir: Optional[str] = None,
                  oom_injection_mode: str = "none",
                  oom_injection_filter: str = "",
-                 oom_dump_dir: str = ""):
+                 oom_dump_dir: str = "",
+                 query_quota_bytes: int = 0):
         self.pool = DeviceMemoryPool(device_limit)
         self.host_limit = host_limit
         self.host_used = 0
         self.spill_dir = spill_dir or tempfile.mkdtemp(prefix="srtpu-spill-")
         self._buffers: Dict[str, SpillableBatch] = {}
         self._lock = threading.RLock()
+        # per-query DEVICE reservation ledger (the quota unit,
+        # spark.rapids.tpu.quota.device.maxBytesPerQuery); its own lock
+        # because reserve() runs outside the catalog lock
+        self.query_quota_bytes = max(0, int(query_quota_bytes))
+        self._q_dev: Dict[int, int] = {}
+        self._q_lock = threading.Lock()
         self._oom_mode = oom_injection_mode
         self._oom_filter = oom_injection_filter
         self._oom_dump_dir = oom_dump_dir
@@ -239,7 +247,7 @@ class SpillCatalog:
                                                  "split_once")
         self.metrics = {
             "spill_to_host": 0, "spill_to_disk": 0, "unspill": 0,
-            "retry_oom_injected": 0,
+            "retry_oom_injected": 0, "quota_oom": 0,
         }
 
     # --- registration ---
@@ -247,8 +255,11 @@ class SpillCatalog:
     def add_batch(self, batch: ColumnBatch,
                   priority: int = SpillPriority.ACTIVE_ON_DECK
                   ) -> SpillableBatch:
-        sb = SpillableBatch(self, batch, priority)
-        self.reserve(sb.size_bytes, tag="add_batch")
+        from spark_rapids_tpu.obs import events as obs_events
+
+        qid = obs_events.effective_query_id()
+        sb = SpillableBatch(self, batch, priority, query_id=qid)
+        self.reserve(sb.size_bytes, tag="add_batch", query_id=qid)
         with self._lock:
             self._buffers[sb.id] = sb
         return sb
@@ -259,6 +270,7 @@ class SpillCatalog:
                 return
             if sb.tier == SpillTier.DEVICE:
                 self.pool.release(sb.size_bytes)
+                self._q_release(sb.query_id, sb.size_bytes)
             elif sb.tier == SpillTier.HOST:
                 self.host_used -= sb.size_bytes
                 from spark_rapids_tpu.runtime import host_alloc
@@ -279,16 +291,82 @@ class SpillCatalog:
             raise TpuSplitAndRetryOOM(f"injected split OOM at {tag}")
         raise TpuRetryOOM(f"injected OOM at {tag}")
 
-    def reserve(self, nbytes: int, tag: str = ""):
+    # --- per-query quota ledger (all under _q_lock) ---
+
+    @staticmethod
+    def _resolve_qid(query_id: Optional[int]) -> int:
+        if query_id is not None:
+            return query_id
+        from spark_rapids_tpu.obs import events as obs_events
+
+        return obs_events.effective_query_id()
+
+    def _q_add(self, qid: int, nbytes: int) -> None:
+        if not qid:
+            return
+        with self._q_lock:
+            self._q_dev[qid] = self._q_dev.get(qid, 0) + nbytes
+
+    def _q_release(self, qid: int, nbytes: int) -> None:
+        if not qid:
+            return
+        with self._q_lock:
+            left = self._q_dev.get(qid, 0) - nbytes
+            if left > 0:
+                self._q_dev[qid] = left
+            else:
+                self._q_dev.pop(qid, None)
+
+    def query_device_reserved(self, query_id: int) -> int:
+        with self._q_lock:
+            return self._q_dev.get(query_id, 0)
+
+    def _quota_admit(self, qid: int, nbytes: int, tag: str) -> None:
+        """Per-query quota gate: an over-quota reservation first spills
+        the OFFENDING query's own device buffers, then raises a
+        retry-class OOM for that query only — session-wide pressure
+        stays untouched (the Vortex capacity-isolation stance)."""
+        quota = self.query_quota_bytes
+        if not qid or quota <= 0:
+            return
+        with self._q_lock:
+            cur = self._q_dev.get(qid, 0)
+        if cur + nbytes <= quota:
+            return
+        freed = self.spill_device_bytes(cur + nbytes - quota,
+                                        query_id=qid)
+        with self._q_lock:
+            cur = self._q_dev.get(qid, 0)
+        if cur + nbytes <= quota:
+            return
+        self.metrics["quota_oom"] += 1
+        if freed > 0:
+            raise TpuRetryOOM(
+                f"query {qid} over device quota reserving {nbytes} "
+                f"(tag={tag}, quota={quota}, reserved={cur}); spilled "
+                f"{freed} of its bytes, retry")
+        raise TpuSplitAndRetryOOM(
+            f"query {qid} device quota {quota} cannot fit {nbytes} "
+            f"(tag={tag}, reserved={cur}); split the input and retry")
+
+    def reserve(self, nbytes: int, tag: str = "",
+                query_id: Optional[int] = None):
         """Reserve device bytes; spill synchronously if needed; raise
         TpuRetryOOM when spilling freed something (caller must retry) or
-        TpuSplitAndRetryOOM when nothing can free enough."""
+        TpuSplitAndRetryOOM when nothing can free enough. Reservations
+        are tagged with the owning query (resolved from the obs task/
+        query scope when not passed) and gated by the per-query quota
+        BEFORE touching the shared pool."""
         self._maybe_inject_oom(tag)
+        qid = self._resolve_qid(query_id)
+        self._quota_admit(qid, nbytes, tag)
         if self.pool.try_reserve(nbytes):
+            self._q_add(qid, nbytes)
             return
         shortfall = max(0, nbytes - (self.pool.limit - self.pool.reserved))
         freed = self.spill_device_bytes(shortfall)
         if self.pool.try_reserve(nbytes):
+            self._q_add(qid, nbytes)
             return
         if freed > 0:
             raise TpuRetryOOM(
@@ -303,29 +381,37 @@ class SpillCatalog:
             f"limit={self.pool.limit}, reserved={self.pool.reserved}); "
             "split the input and retry")
 
-    def release(self, nbytes: int):
+    def release(self, nbytes: int, query_id: Optional[int] = None):
         self.pool.release(nbytes)
+        self._q_release(self._resolve_qid(query_id), nbytes)
 
     @contextlib.contextmanager
     def reserved(self, nbytes: int, tag: str = ""):
         """Scoped reservation — operators wrap device compute whose
         output is ~nbytes so allocation pressure (and injected OOM)
-        surfaces at a retryable point."""
-        self.reserve(nbytes, tag=tag)
+        surfaces at a retryable point. The owning query is captured at
+        entry so the exit releases the same ledger even if the thread's
+        scopes changed."""
+        qid = self._resolve_qid(None)
+        self.reserve(nbytes, tag=tag, query_id=qid)
         try:
             yield
         finally:
-            self.release(nbytes)
+            self.release(nbytes, query_id=qid)
 
-    def spill_device_bytes(self, target: int) -> int:
+    def spill_device_bytes(self, target: int,
+                           query_id: Optional[int] = None) -> int:
         """Spill coldest (lowest priority, largest first) device buffers
         until `target` bytes are freed (RapidsBufferCatalog.synchronousSpill
-        analog)."""
+        analog). With `query_id` only THAT query's buffers are
+        candidates — the quota gate degrades the offending query
+        without disturbing its neighbors."""
         freed = 0
         with self._lock:
             candidates = sorted(
                 (b for b in self._buffers.values()
-                 if b.tier == SpillTier.DEVICE and not b.closed),
+                 if b.tier == SpillTier.DEVICE and not b.closed
+                 and (query_id is None or b.query_id == query_id)),
                 key=lambda b: (b._priority, -b.size_bytes))
             for b in candidates:
                 if freed >= target:
@@ -343,6 +429,7 @@ class SpillCatalog:
                 and pageable.try_reserve(b.size_bytes)):
             b._to_host()
             self.pool.release(b.size_bytes)
+            self._q_release(b.query_id, b.size_bytes)
             self.host_used += b.size_bytes
             self.metrics["spill_to_host"] += 1
             obs_events.emit("spill", component="catalog",
@@ -361,6 +448,7 @@ class SpillCatalog:
         finally:
             pageable.release(b.size_bytes)
         self.pool.release(b.size_bytes)
+        self._q_release(b.query_id, b.size_bytes)
         self.metrics["spill_to_disk"] += 1
         obs_events.emit("spill", component="catalog", direction="down",
                         fromTier="DEVICE", toTier="DISK",
@@ -399,8 +487,11 @@ class SpillCatalog:
             if sb.tier == SpillTier.DEVICE:
                 return
             was_host = sb.tier == SpillTier.HOST
-            # reserve device room first (may cascade-spill others)
-            self.reserve(sb.size_bytes, tag="unspill")
+            # reserve device room first (may cascade-spill others);
+            # the reservation belongs to the buffer's OWNING query, not
+            # whichever query happened to trigger the unspill
+            self.reserve(sb.size_bytes, tag="unspill",
+                         query_id=sb.query_id)
             sb._to_device()
             if was_host:
                 self.host_used -= sb.size_bytes
@@ -478,6 +569,7 @@ def initialize_memory(conf=None, force: bool = False) -> SpillCatalog:
             oom_injection_mode=conf.get(rc.OOM_INJECTION_MODE),
             oom_injection_filter=conf.get(rc.TEST_RETRY_OOM_INJECTION_FILTER),
             oom_dump_dir=conf.get(rc.OOM_DUMP_DIR),
+            query_quota_bytes=conf.get(rc.QUOTA_DEVICE_BYTES_PER_QUERY),
         )
         return _catalog
 

@@ -73,6 +73,9 @@ def with_retry(
     the same SpillableBatch after a TpuRetryOOM) and must not close its
     input — the framework does.
     """
+    from spark_rapids_tpu.runtime import cancellation
+    from spark_rapids_tpu.runtime.errors import QueryCancelledError
+
     if isinstance(inputs, SpillableBatch):
         inputs = [inputs]
     queue = deque(inputs)
@@ -81,10 +84,21 @@ def with_retry(
         splits = 0
         while True:
             try:
+                # split/retry iteration = a cooperative yield point: a
+                # cancelled query must not keep splitting
+                cancellation.check_current()
                 result = fn(sb)
                 sb.close()
                 yield result
                 break
+            except QueryCancelledError:
+                # checked here or raised from a yield point inside fn:
+                # close the current piece AND everything still queued
+                # so the spill catalog stays leak-free on cancel
+                sb.close()
+                for p in queue:
+                    p.close()
+                raise
             except TpuSplitAndRetryOOM:
                 if split_policy is None:
                     sb.close()
@@ -212,8 +226,11 @@ def retry_on_oom(fn: Callable[[], T], max_attempts: int = 8) -> T:
     """Re-attempt a non-splittable device step after TpuRetryOOM (the
     spill already freed memory); propagate split OOMs and give up after
     max_attempts."""
+    from spark_rapids_tpu.runtime import cancellation
+
     attempts = 0
     while True:
+        cancellation.check_current()
         try:
             return fn()
         except TpuRetryOOM as e:

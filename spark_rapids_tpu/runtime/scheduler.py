@@ -250,14 +250,25 @@ class StageScheduler:
                     stage: int = 0, speculative: bool = False
                     ) -> Callable[[], Any]:
         from spark_rapids_tpu.obs import events as obs_events
-        from spark_rapids_tpu.runtime import faults
+        from spark_rapids_tpu.runtime import cancellation, faults
+
+        # capture the SUBMITTING thread's query identity and cancel
+        # token here — pool threads have neither in their own
+        # thread-local scope
+        qid = obs_events.effective_query_id()
+        token = cancellation.current()
 
         def fn():
             # the task scope tags every event emitted during the
             # attempt (operator spans above all) with its identity, so
-            # the span builder hangs them under this attempt
+            # the span builder hangs them under this attempt; the
+            # cancellation scope re-establishes the query token so
+            # every yield point inside the attempt sees it
             with obs_events.task_scope(stage, task.index, attempt,
-                                       speculative):
+                                       speculative, query_id=qid), \
+                    cancellation.scope(token):
+                if token is not None:
+                    token.check()  # attempt boundary = yield point
                 if self.rerunnable:
                     faults.maybe_inject(
                         "worker.crash",
@@ -290,8 +301,10 @@ class StageScheduler:
 
     def _run_inline(self, task: Task) -> List[Any]:
         from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.runtime import cancellation
 
         token = next(_stage_token)
+        ctoken = cancellation.current()
         obs_events.emit("stage.start", stage=token, name=self.name,
                         tasks=1)
         last: Optional[BaseException] = None
@@ -329,13 +342,22 @@ class StageScheduler:
                 stats.add("evictedWorkers")
                 stats.add("tasksRetried")
                 stats.add("recomputedPartitions")
+                if ctoken is not None:
+                    # poison-query feed: a crash-looping query fails
+                    # fast (QueryQuarantinedError) instead of burning
+                    # the rest of its attempt budget
+                    ctoken.record_worker_crash(token, task.index,
+                                               "inline")
+                    ctoken.check()
         raise last  # pragma: no cover (loop always returns or raises)
 
     # --- main driver ---
 
     def run(self, tasks: List[Task]) -> List[Any]:
         from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.runtime import cancellation
 
+        ctoken = cancellation.current()
         if not tasks:
             return []
         stats.add("stagesRun")
@@ -434,6 +456,11 @@ class StageScheduler:
                      "lost" if kind == "lost" else "failed", info)
             if kind == "lost":
                 evict_worker(w)
+                if ctoken is not None:
+                    # poison-query quarantine feed: repeated crashes
+                    # cancel the token; the next tick fails the stage
+                    # fast with the crash history
+                    ctoken.record_worker_crash(token, idx, w)
                 if committed[idx] or terminal is not None:
                     return
                 if any(k[0] == idx for k in running):
@@ -471,6 +498,15 @@ class StageScheduler:
 
         try:
             while True:
+                if ctoken is not None and terminal is None and \
+                        (ctoken.cancelled or ctoken.expired):
+                    # cancelled/expired query: stop launching, drain
+                    # in-flight attempts (their own checks cut them
+                    # short), abort their output, then raise
+                    try:
+                        ctoken.check()
+                    except BaseException as e:
+                        terminal = e
                 while pending and terminal is None and \
                         len(running) < backend.parallelism():
                     if not launch(pending.popleft()):

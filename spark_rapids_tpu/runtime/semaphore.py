@@ -10,14 +10,33 @@ Hardened (PR 2): acquisition honors a conf'd timeout
 task that died without releasing) used to hang every later task
 forever with zero diagnostics; now the blocked acquire raises
 SemaphoreTimeout carrying the held-permit table — which task ids hold
-how many permits, for how long — so the operator sees the culprit
-instead of a silent wedge.
+how many permits, owned by which query, for how long — so the operator
+sees the culprit (and which query to `session.cancel`) instead of a
+silent wedge.
+
+Governance (PR 5):
+
+- **FIFO fairness via ticket ordering**: waiters are served in arrival
+  order. The old wake-and-race grant let a stream of late arrivals
+  repeatedly slip in front of a parked waiter whenever permits freed
+  (each notify_all raced every waiter plus any NEW acquirer that never
+  slept) — a heavy waiter could starve indefinitely behind light
+  traffic. Now every first-time acquirer takes a monotonically
+  increasing ticket and only the front ticket may take permits;
+  re-entrant acquires (already holding) remain free.
+- **Cooperative cancellation**: an acquire under a query CancelToken
+  (runtime/cancellation.py — resolved from the thread scope, or passed
+  explicitly) registers a cancel wakeup and leaves the wait promptly
+  when the query is cancelled or its deadline passes, removing its
+  ticket so the queue never wedges behind a dead waiter.
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
+from collections import deque
 from typing import Dict, Optional
 
 from spark_rapids_tpu.runtime.errors import SemaphoreTimeout
@@ -36,44 +55,98 @@ class TpuSemaphore:
         self._cv = threading.Condition()
         self._holders: Dict[int, int] = {}
         self._held_since: Dict[int, float] = {}
+        self._holder_query: Dict[int, int] = {}
+        self._queue: deque = deque()  # tickets, FIFO
+        self._ticket = itertools.count(1)
         self._timeout_ms = acquire_timeout_ms
         self.total_wait_ns = 0
         self.timeouts = 0
+        self.cancelled_waits = 0
 
-    def acquire_if_necessary(self, task_id: int):
+    def acquire_if_necessary(self, task_id: int, cancel=None):
+        from spark_rapids_tpu.runtime import cancellation
+
+        if cancel is None:
+            cancel = cancellation.current()
+        wake = None
+        if cancel is not None:
+            cancel.check()  # fail fast before taking a ticket
+
+            def wake():
+                with self._cv:
+                    self._cv.notify_all()
+
+            cancel.on_cancel(wake)
+        try:
+            self._acquire(task_id, cancel)
+        finally:
+            if wake is not None:
+                cancel.remove_on_cancel(wake)
+
+    def _acquire(self, task_id: int, cancel):
         with self._cv:
             if task_id in self._holders:
                 return
+            ticket = next(self._ticket)
+            self._queue.append(ticket)
             start = time.monotonic_ns()
             deadline = (None if self._timeout_ms <= 0
                         else time.monotonic() + self._timeout_ms / 1000.0)
-            while self._available < self._permits_per_task:
-                if deadline is None:
-                    self._cv.wait()
-                    continue
-                remaining = deadline - time.monotonic()
-                if remaining > 0:
-                    self._cv.wait(remaining)
-                    continue  # woken or timed out: re-check permits
-                self.timeouts += 1
-                waited_s = (time.monotonic_ns() - start) / 1e9
-                raise SemaphoreTimeout(
-                    f"task {task_id} timed out after {waited_s:.1f}s "
-                    f"waiting for {self._permits_per_task} device "
-                    f"permits ({self._available}/{MAX_PERMITS} "
-                    f"available); held permits: "
-                    f"{self._holder_diagnostics()}")
+            try:
+                while not (self._queue[0] == ticket and
+                           self._available >= self._permits_per_task):
+                    if cancel is not None and \
+                            (cancel.cancelled or cancel.expired):
+                        self.cancelled_waits += 1
+                        cancel.check()  # raises
+                    wait_s: Optional[float] = None
+                    if deadline is not None:
+                        wait_s = deadline - time.monotonic()
+                        if wait_s <= 0:
+                            self.timeouts += 1
+                            waited_s = (time.monotonic_ns() - start) / 1e9
+                            raise SemaphoreTimeout(
+                                f"task {task_id} timed out after "
+                                f"{waited_s:.1f}s waiting for "
+                                f"{self._permits_per_task} device "
+                                f"permits ({self._available}/"
+                                f"{MAX_PERMITS} available, queue "
+                                f"position "
+                                f"{self._queue.index(ticket) + 1}/"
+                                f"{len(self._queue)}); held permits: "
+                                f"{self._holder_diagnostics()}")
+                    if cancel is not None:
+                        r = cancel.remaining_s()
+                        if r is not None:
+                            r += 0.001  # wake just past the deadline
+                            wait_s = r if wait_s is None \
+                                else min(wait_s, r)
+                    self._cv.wait(wait_s)
+            except BaseException:
+                self._queue.remove(ticket)
+                # the next ticket may be eligible right now
+                self._cv.notify_all()
+                raise
+            self._queue.popleft()
             self.total_wait_ns += time.monotonic_ns() - start
             self._available -= self._permits_per_task
             self._holders[task_id] = self._permits_per_task
             self._held_since[task_id] = time.monotonic()
+            from spark_rapids_tpu.obs import events as obs_events
+
+            self._holder_query[task_id] = obs_events.effective_query_id()
+            # permits may remain for the NEW front ticket
+            self._cv.notify_all()
 
     def _holder_diagnostics(self) -> str:
         """Under _cv: the held-permit table a timed-out acquirer dumps
         (the reference's GpuSemaphore dumpActiveStackTracesToLog
-        role, scoped to what this runtime can see)."""
+        role, scoped to what this runtime can see). Each row names the
+        holder's QUERY and its elapsed hold time, so a wedged-query
+        diagnosis reads off which query to session.cancel()."""
         now = time.monotonic()
-        rows = [f"task={tid} permits={p} "
+        rows = [f"task={tid} query={self._holder_query.get(tid, 0)} "
+                f"permits={p} "
                 f"held_s={now - self._held_since.get(tid, now):.1f}"
                 for tid, p in sorted(self._holders.items())]
         return "[" + ", ".join(rows) + "]" if rows else "[none]"
@@ -82,6 +155,7 @@ class TpuSemaphore:
         with self._cv:
             permits = self._holders.pop(task_id, None)
             self._held_since.pop(task_id, None)
+            self._holder_query.pop(task_id, None)
             if permits:
                 self._available += permits
                 self._cv.notify_all()
@@ -89,6 +163,10 @@ class TpuSemaphore:
     def holders(self) -> int:
         with self._cv:
             return len(self._holders)
+
+    def waiting(self) -> int:
+        with self._cv:
+            return len(self._queue)
 
 
 _instance: Optional[TpuSemaphore] = None

@@ -449,12 +449,17 @@ class ShuffleManager:
         from spark_rapids_tpu.obs import events as obs_events
         from spark_rapids_tpu.runtime.errors import ShuffleFetchError
 
+        from spark_rapids_tpu.runtime import cancellation
+
         if self.mode != "MULTITHREADED":
             with self._lock:
                 snap = [(b.table, b.path, b.map_id) for b in
                         self._blocks.get((shuffle_id, reduce_pid), [])]
             out = []
             for table, path, map_id in snap:
+                # per-block yield point: a cancelled query stops
+                # fetching instead of finishing the reduce partition
+                cancellation.check_current()
                 self._maybe_lose_block(shuffle_id, reduce_pid, map_id)
                 if table is not None:
                     out.append(table)
@@ -469,6 +474,7 @@ class ShuffleManager:
             fbs = list(self._files.get((shuffle_id, reduce_pid), []))
         tables = []
         for fb in fbs:
+            cancellation.check_current()
             self._maybe_lose_block(shuffle_id, reduce_pid, fb.map_id)
             try:
                 path = fb.future.result()  # blocks on in-flight writes

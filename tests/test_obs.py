@@ -317,8 +317,9 @@ def test_robustness_metrics_keys_unchanged():
     try:
         rm = s.robustness_metrics
         assert set(rm) == {"chaos", "retries", "shuffle", "scheduler",
-                           "degrade", "artifactsQuarantined",
-                           "semaphoreTimeouts"}
+                           "degrade", "admission",
+                           "artifactsQuarantined", "semaphoreTimeouts"}
+        assert "queriesAdmitted" in rm["admission"]
         assert set(rm["shuffle"]) == {"fetchRetries", "checksumFailures",
                                       "orphanedFiles",
                                       "speculativeDiscards"}
