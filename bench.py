@@ -73,16 +73,12 @@ DATA_DIR = f"/tmp/srtpu_bench_data_v6_{ROWS}"
 DIM_DIR = f"/tmp/srtpu_bench_data_v6_{ROWS}_dim"
 DUP_DIR = f"/tmp/srtpu_bench_data_v6_{ROWS}_dup"
 
-# peak HBM bandwidth per chip, bytes/s (public TPU specs; cpu backend
-# gets a nominal DDR figure so the fraction stays meaningful)
-_PEAK_BW = {
-    "TPU v4": 1.2e12,
-    "TPU v5e": 8.19e11,
-    "TPU v5 lite": 8.19e11,
-    "TPU v5p": 2.765e12,
-    "TPU v6e": 1.64e12,
-    "cpu": 5.0e10,
-}
+# peak HBM bandwidth per chip, bytes/s: one source of truth with the
+# telemetry roofline accounting (obs/telemetry.py DEVICE_PEAK_BW)
+def _peak_bw_table():
+    from spark_rapids_tpu.obs.telemetry import DEVICE_PEAK_BW
+
+    return DEVICE_PEAK_BW
 
 
 def ensure_data() -> int:
@@ -428,6 +424,9 @@ def main():
         t0 = time.perf_counter()
         out = df.collect_arrow()
         times.append(time.perf_counter() - t0)
+    # capture the steady-state movement profile NOW: later probes
+    # (dupjoin, admission burst) overwrite last_execution
+    hot_telemetry = (spark.last_execution or {}).get("telemetry")
     med = statistics.median(times)
     times_sorted = sorted(times)
     q1 = times_sorted[len(times) // 4]
@@ -492,9 +491,10 @@ def main():
 
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", dev.platform)
-    peak = next((v for k, v in _PEAK_BW.items()
+    peak_bw = _peak_bw_table()
+    peak = next((v for k, v in peak_bw.items()
                  if k.lower() in str(kind).lower()),
-                _PEAK_BW["cpu"])
+                peak_bw["cpu"])
     roofline = dev_gbps * 1e9 / peak
 
     # characterize the host<->device link so absolute numbers are
@@ -519,6 +519,37 @@ def main():
         admission_block = _admission_probe(spark)
     except Exception as e:  # never lose the perf report
         print(f"# admission block unavailable: {e!r}", flush=True)
+
+    # ---- data-movement telemetry block (obs/telemetry.py): per-query
+    # ---- bytes moved by direction, device footprint and roofline —
+    # ---- the success metric every bytes-moved optimization (ICI
+    # ---- shuffle, compressed execution, out-of-core) will be judged
+    # ---- against, per ROADMAP item 2
+    telemetry_block = None
+    try:
+        from spark_rapids_tpu.obs import telemetry as _tel
+
+        tel = hot_telemetry or {}
+        telemetry_block = {
+            # last HOT query of the main q5 loop (re-collect of the
+            # device-cached relation: the steady-state movement profile)
+            "bytesMovedByDirection": tel.get("bytesMoved"),
+            "bytesMovedTotal": tel.get("bytesMovedTotal"),
+            "bytesPerOutputRow": tel.get("bytesPerOutputRow"),
+            "queryRooflineFrac": tel.get("rooflineFrac"),
+            "queryLinkFrac": tel.get("linkFrac"),
+            # process-level: the cached relations' device residency is
+            # owned by the materializing (cold) query, so the process
+            # high-water is the number that tracks real HBM pressure
+            "hbmPeakBytes": max(
+                tel.get("hbmPeakBytes") or 0,
+                _tel.ledger.registry_view()["hbm"]["peakBytes"]),
+            "processBytesMoved": _tel.ledger.registry_view()[
+                "bytesMoved"],
+            "linkPeaks": _tel.link_peaks(),
+        }
+    except Exception as e:  # never lose the perf report
+        print(f"# telemetry block unavailable: {e!r}", flush=True)
 
     # ---- obs attribution block: the perf trajectory should capture
     # ---- WHERE time went (top operators by device time, span-tree
@@ -580,6 +611,10 @@ def main():
         # query-governance overhead (PR 5): queue waits / sheds /
         # cancel latency of a concurrent governed burst
         "admission": admission_block,
+        # data-movement ledger (PR 6): per-query bytes moved by
+        # direction, HBM footprint, per-query roofline — BENCH_r06+
+        # records what every bytes-moved optimization must improve
+        "telemetry": telemetry_block,
         # event/span attribution (obs/): top operators by device time,
         # span-tree depth, event volume — regression triage data
         "obs": obs_block,

@@ -21,6 +21,9 @@ ci/eventlog_check.sh
 echo "== concurrency gate (admission + chaos + cancel storm) =="
 ci/concurrency_check.sh
 
+echo "== telemetry gate (ledger/eventlog consistency + HTTP) =="
+ci/telemetry_check.sh
+
 echo "== multichip dryrun (virtual mesh) =="
 SPARK_RAPIDS_TPU_DRYRUN_REEXEC=1 python - <<'PY'
 import jax

@@ -644,9 +644,38 @@ class DataFrame:
                     metric="queueWaitMs",
                     wallNs=int(handle.queue_wait_ms * 1_000_000),
                     deviceNs=0)
+            import time as _time
+
+            from spark_rapids_tpu.obs import telemetry as _tel
+
+            t0 = _time.perf_counter()
+            out_rows = None
             try:
-                return self._collect_arrow_traced(rec)
+                out = self._collect_arrow_traced(rec)
+                out_rows = out.num_rows
+                return out
             finally:
+                # data-movement report for this query: the transfer
+                # ledger's per-query view + roofline fractions over the
+                # measured wall time. The OUTERMOST scope owns the
+                # summary event (nested collects would snapshot the
+                # same qid mid-flight); every rec still carries the
+                # view so callers see bytes for their slice too.
+                tel = _tel.query_summary(
+                    qid, wall_s=_time.perf_counter() - t0,
+                    output_rows=out_rows)
+                rec["telemetry"] = tel or None
+                if tel and not scope.nested:
+                    _tel.ledger.finalize_query(qid, tel)
+                    obs_events.emit(
+                        "telemetry.summary",
+                        bytesMoved=tel.get("bytesMoved"),
+                        bytesMovedTotal=tel.get("bytesMovedTotal"),
+                        hbmPeakBytes=tel.get("hbmPeakBytes"),
+                        rooflineFrac=tel.get("rooflineFrac"),
+                        linkFrac=tel.get("linkFrac"),
+                        bytesPerOutputRow=tel.get("bytesPerOutputRow"),
+                        wallMs=tel.get("wallMs"))
                 obs_events.finish_query(
                     qid, engine=rec["engine"],
                     status="ok" if rec["engine"] is not None

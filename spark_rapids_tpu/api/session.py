@@ -202,6 +202,9 @@ class TpuSparkSession:
         from spark_rapids_tpu.obs import ObsManager
 
         self.obs = ObsManager(self.rapids_conf)
+        # conf-gated live scrape endpoint (/metrics, /queries) — the
+        # first piece of the service front-end (obs/http.py)
+        self.obs.start_http(self, self.rapids_conf)
         global _active
         with _active_lock:
             _active = self

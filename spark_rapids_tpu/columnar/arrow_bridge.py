@@ -15,6 +15,7 @@ backend and the file readers), so the transitions are Arrow<->ColumnBatch:
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional
 
 import jax
@@ -416,11 +417,17 @@ def arrow_to_device(table, capacity: Optional[int] = None,
     # the scope bounds concurrent DISPATCHES, not completion — syncing
     # here would serialize the upload pipeline the engine works hard
     # to keep full on tunneled devices.
+    from spark_rapids_tpu.obs import telemetry
     from spark_rapids_tpu.runtime import host_alloc
 
     nbytes = sum(c.device_size_bytes() for c in cols)
     with host_alloc.get().reserved(nbytes, pinned=True):
+        t0 = time.monotonic_ns()
         out = jax.device_put(ColumnBatch(schema, cols, n))
+        # ns covers the DISPATCH only (device_put is async by design
+        # here) — bytes are exact, per-site GB/s is an upper bound
+        telemetry.record("h2d", "upload.arrow", nbytes,
+                         ns=time.monotonic_ns() - t0)
     out._host_rows = n  # pytree flatten devicified num_rows; keep the
     # known count so the first row_count() is not a device roundtrip
     return out
@@ -441,11 +448,15 @@ def device_to_arrow(batch: ColumnBatch) -> pa.Table:
             batch.schema,
             [c.truncate(small) for c in batch.columns],
             n)
+    from spark_rapids_tpu.obs import telemetry
     from spark_rapids_tpu.runtime import host_alloc
 
-    with host_alloc.get().reserved(batch.device_size_bytes(),
-                                   pinned=True):
+    nbytes = batch.device_size_bytes()
+    with host_alloc.get().reserved(nbytes, pinned=True):
+        t0 = time.monotonic_ns()
         host = jax.device_get(batch)
+        telemetry.record("d2h", "collect", nbytes,
+                         ns=time.monotonic_ns() - t0)
     return _host_batch_to_arrow(batch.schema, host.columns, n)
 
 
@@ -459,11 +470,15 @@ def device_to_arrow_fused(batch: ColumnBatch, extra):
     standard `device_to_arrow` for large-capacity results.
 
     Returns (table, host_extra)."""
+    from spark_rapids_tpu.obs import telemetry
     from spark_rapids_tpu.runtime import host_alloc
 
-    with host_alloc.get().reserved(batch.device_size_bytes(),
-                                   pinned=True):
+    nbytes = batch.device_size_bytes()
+    with host_alloc.get().reserved(nbytes, pinned=True):
+        t0 = time.monotonic_ns()
         host, host_extra = jax.device_get((batch, extra))
+        telemetry.record("d2h", "collect.fused", nbytes,
+                         ns=time.monotonic_ns() - t0)
     n = int(np.asarray(host.num_rows))
     return _host_batch_to_arrow(host.schema, host.columns, n), host_extra
 

@@ -626,6 +626,28 @@ OBS_HISTORY_EVENTS = conf(
     "(obs/report.py); older events drop off. Sized for a handful of "
     "queries; event logs are the durable record.", int,
     checker=lambda v: 100 <= v <= 10_000_000)
+TELEMETRY_ENABLED = conf(
+    "spark.rapids.tpu.telemetry.enabled", True,
+    "Data-movement telemetry (obs/telemetry.py): a process-wide "
+    "transfer ledger records every byte-crossing site (H2D uploads, "
+    "D2H collects, shuffle write/fetch, disk spill/unspill) tagged "
+    "with the owning query, plus an HBM occupancy timeline fed by the "
+    "spill catalog and per-query roofline accounting "
+    "(bytesMoved/hbmPeakBytes/rooflineFrac in "
+    "last_execution['telemetry'], the profile report and Prometheus). "
+    "false reduces every site to one boolean check.", bool)
+OBS_HTTP_ENABLED = conf(
+    "spark.rapids.tpu.obs.http.enabled", False,
+    "Background HTTP endpoint (obs/http.py, bound to 127.0.0.1) "
+    "serving GET /metrics (Prometheus text exposition), GET /queries "
+    "(admission running/queued tables + per-query data-movement "
+    "telemetry JSON) and GET /healthz. Session-owned: started at init, "
+    "shut down leak-free at session.stop().", bool)
+OBS_HTTP_PORT = conf(
+    "spark.rapids.tpu.obs.http.port", 0,
+    "Port for the obs HTTP endpoint; 0 binds an ephemeral port "
+    "(reported as session.obs.http.port).", int,
+    checker=lambda v: 0 <= v <= 65535)
 EVENTLOG_ENABLED = conf(
     "spark.rapids.tpu.eventLog.enabled", False,
     "Write every query's event stream as JSONL under eventLog.dir "

@@ -39,6 +39,7 @@ out-of-core engine, which remains the path for HBM-exceeding inputs.
 from __future__ import annotations
 
 import os
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
@@ -201,7 +202,14 @@ def upload_narrowed(table: pa.Table, capacity: Optional[int] = None,
         )
 
         cols.append(column_from_arrow(arr, field, cap))
-    return jax.device_put(ColumnBatch(schema, cols, n))
+    from spark_rapids_tpu.obs import telemetry
+
+    nbytes = sum(c.device_size_bytes() for c in cols)
+    t0 = time.monotonic_ns()
+    out = jax.device_put(ColumnBatch(schema, cols, n))
+    telemetry.record("h2d", "scan.upload", nbytes,
+                     ns=time.monotonic_ns() - t0)
+    return out
 
 
 def widen_traced(batch: ColumnBatch) -> ColumnBatch:
@@ -307,7 +315,14 @@ class FusedSingleChipExecutor:
             cols.append(DeviceColumn(
                 f.dataType, vals, np.ones(n, dtype=np.bool_),
                 vrange=vrange))
-        return jax.device_put(ColumnBatch(scan.schema, list(cols), n))
+        from spark_rapids_tpu.obs import telemetry
+
+        nbytes = sum(c.device_size_bytes() for c in cols)
+        t0 = time.monotonic_ns()
+        out = jax.device_put(ColumnBatch(scan.schema, list(cols), n))
+        telemetry.record("h2d", "scan.plain", nbytes,
+                         ns=time.monotonic_ns() - t0)
+        return out
 
     def _scan_parts(self, scan: ops.TpuFileScanExec) -> List[ColumnBatch]:
         tasks = [t for t in scan._tasks if t]

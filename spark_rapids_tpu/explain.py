@@ -83,4 +83,18 @@ def _explain_executed(df) -> str:
                  f"device={_fmt_ms(total_dev)}"
                  + (f" output_rows={out_rows}"
                     if out_rows is not None else ""))
+    # data-movement footer (obs/telemetry.py): what the query MOVED,
+    # next to what it computed — the bytes-focused twin of the timings
+    last = getattr(df.session, "last_execution", None) or {}
+    tel = last.get("telemetry") if isinstance(last, dict) else None
+    if tel and tel.get("bytesMoved"):
+        moved = ", ".join(f"{d}={b}" for d, b in
+                          sorted(tel["bytesMoved"].items()))
+        line = (f"data moved: {moved} (total {tel['bytesMovedTotal']} B,"
+                f" hbm peak {tel.get('hbmPeakBytes', 0)} B")
+        if tel.get("rooflineFrac") is not None:
+            line += f", roofline_frac {tel['rooflineFrac']}"
+        if tel.get("bytesPerOutputRow") is not None:
+            line += f", {tel['bytesPerOutputRow']} B/row"
+        lines.append(line + ")")
     return "\n".join(lines)

@@ -8,6 +8,12 @@ directory: opened as `eventlog-q<N>-p1.jsonl.inprogress` at
 renamed off `.inprogress`) when `query.end` lands — a crashed process
 leaves `.inprogress` files, never a truncated finalized log.
 
+The writer keeps ONE OPEN STREAM PER QUERY, keyed by the event's
+`queryId`: concurrent tenants (admission allows several running
+queries, PR 5) interleave on the bus but land in fully isolated
+per-query files — query A's `query.end` finalizes only A's parts while
+B keeps writing. Events outside any query scope (queryId 0) drop.
+
 `load()` reads a finalized file, a query's parts, or a whole directory
 back into the event stream (validating the schema envelope per line),
 and `load_spans()` replays it through the same SpanBuilder the live
@@ -21,7 +27,7 @@ import json
 import os
 import re
 import threading
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from spark_rapids_tpu.obs import events as _events
 from spark_rapids_tpu.obs import spans as _spans
@@ -40,19 +46,30 @@ def default_dir() -> str:
     return os.path.join(tempfile.gettempdir(), "srtpu_eventlog")
 
 
+class _QueryStream:
+    """One query's open log: file handle, part counter, pending paths."""
+
+    __slots__ = ("qid", "f", "part", "bytes", "open_paths")
+
+    def __init__(self, qid: int):
+        self.qid = qid
+        self.f = None
+        self.part = 0
+        self.bytes = 0
+        self.open_paths: List[str] = []
+
+
 class EventLogWriter:
-    """Per-query JSONL writer with rotation + atomic finalize."""
+    """Per-query JSONL writer with rotation + atomic finalize; keeps
+    one independent stream per in-flight queryId so concurrent tenants
+    get isolated logs."""
 
     def __init__(self, log_dir: str, rotate_bytes: int = 64 << 20):
         self.dir = log_dir or default_dir()
         self.rotate_bytes = max(4096, int(rotate_bytes))
         os.makedirs(self.dir, exist_ok=True)
         self._lock = threading.Lock()
-        self._f = None
-        self._qid: Optional[int] = None
-        self._part = 0
-        self._bytes = 0
-        self._open_paths: List[str] = []
+        self._streams: Dict[int, _QueryStream] = {}
         self.files_written = 0
         self.events_written = 0
         self.write_errors = 0
@@ -62,64 +79,73 @@ class EventLogWriter:
     def __call__(self, ev: dict) -> None:
         with self._lock:
             try:
+                qid = ev.get("queryId") or 0
                 if ev["event"] == "query.start":
-                    self._finalize_locked()  # orphaned previous query
-                    self._qid = ev.get("queryId") or 0
-                    self._part = 0
-                    self._roll_locked()
-                if self._f is None:
-                    return  # events outside any query scope drop
+                    # a duplicate start for an open qid (replayed
+                    # stream): finalize the orphan first
+                    self._finalize_locked(self._streams.pop(qid, None))
+                    if not qid:
+                        return  # scope-less stream: nothing to key on
+                    st = self._streams[qid] = _QueryStream(qid)
+                    self._roll_locked(st)
+                st = self._streams.get(qid)
+                if st is None:
+                    return  # events outside any open query scope drop
                 line = json.dumps(ev, separators=(",", ":"),
                                   sort_keys=True)
-                self._f.write(line + "\n")
-                self._bytes += len(line) + 1
+                st.f.write(line + "\n")
+                st.bytes += len(line) + 1
                 self.events_written += 1
                 if ev["event"] == "query.end":
-                    self._finalize_locked()
-                elif self._bytes >= self.rotate_bytes:
-                    self._roll_locked()
+                    self._finalize_locked(self._streams.pop(qid, None))
+                elif st.bytes >= self.rotate_bytes:
+                    self._roll_locked(st)
             except Exception:
                 self.write_errors += 1
 
     # --- file lifecycle (under lock) ---
 
-    def _inprogress(self, part: int) -> str:
+    def _inprogress(self, qid: int, part: int) -> str:
         return os.path.join(
             self.dir,
-            f"eventlog-q{self._qid}-p{part}.jsonl{_INPROGRESS_SUFFIX}")
+            f"eventlog-q{qid}-p{part}.jsonl{_INPROGRESS_SUFFIX}")
 
-    def _roll_locked(self) -> None:
-        if self._f is not None:
-            self._f.flush()
-            self._f.close()
-        self._part += 1
-        self._bytes = 0
-        path = self._inprogress(self._part)
-        self._f = open(path, "w")
-        self._open_paths.append(path)
+    def _roll_locked(self, st: _QueryStream) -> None:
+        if st.f is not None:
+            st.f.flush()
+            st.f.close()
+        st.part += 1
+        st.bytes = 0
+        path = self._inprogress(st.qid, st.part)
+        st.f = open(path, "w")
+        st.open_paths.append(path)
 
-    def _finalize_locked(self) -> None:
-        if self._f is None:
+    def _finalize_locked(self, st: Optional[_QueryStream]) -> None:
+        if st is None or st.f is None:
             return
-        self._f.flush()
-        self._f.close()
-        self._f = None
-        for p in self._open_paths:
+        st.f.flush()
+        st.f.close()
+        st.f = None
+        for p in st.open_paths:
             final = p[:-len(_INPROGRESS_SUFFIX)]
             try:
                 os.replace(p, final)  # atomic publish
                 self.files_written += 1
             except OSError:
                 self.write_errors += 1
-        self._open_paths = []
-        self._qid = None
+        st.open_paths = []
+
+    def open_query_ids(self) -> List[int]:
+        with self._lock:
+            return sorted(self._streams)
 
     def close(self) -> None:
-        """Session stop: finalize any open (crashed-query) log so its
-        events survive; the file still finalizes without a query.end
+        """Session stop: finalize every open (crashed-query) log so
+        its events survive; a file still finalizes without a query.end
         line (the loader marks its tree `unfinished`)."""
         with self._lock:
-            self._finalize_locked()
+            for qid in list(self._streams):
+                self._finalize_locked(self._streams.pop(qid))
 
 
 # ----------------------------------------------------------- validation

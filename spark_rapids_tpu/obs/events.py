@@ -48,6 +48,10 @@ EVENT_TYPES: Dict[str, str] = {
     "shuffle.fetch": "shuffleId, reducePid, blocks, bytes",
     "shuffle.retry": "shuffleId, reducePid, block",
     "spill": "component, direction, fromTier, toTier, bytes",
+    "transfer": "direction (h2d|d2h|spill-disk|shuffle), site, bytes, ns",
+    "telemetry.summary":
+        "bytesMoved, bytesMovedTotal, hbmPeakBytes, rooflineFrac, "
+        "linkFrac, bytesPerOutputRow, wallMs",
     "compile": "kind (miss|hit|warm|quarantine), seconds",
     "degrade": "kind, from, to, reason",
     "chaos": "site",

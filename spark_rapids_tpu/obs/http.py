@@ -1,0 +1,132 @@
+"""Live metrics endpoint — the first piece of the service front-end.
+
+A conf-gated (`spark.rapids.tpu.obs.http.{enabled,port}`) background
+HTTP server exposing the session's observability surface to scrapers
+and operators without any embedder glue:
+
+- `GET /metrics`  -> the Prometheus text exposition `prom.render()`
+  already produces (`session.prometheus_metrics()`), now actually
+  scrape-able.
+- `GET /queries`  -> JSON: the admission controller's live
+  running/queued tables (runtime/admission.py `status()`) joined with
+  the per-query data-movement summaries from the transfer ledger
+  (obs/telemetry.py) and the recent HBM occupancy timeline.
+- `GET /healthz`  -> `ok` (load-balancer probe).
+
+Lifecycle is session-owned (ObsManager): started at session init when
+enabled, shut down leak-free in `close()` — the CI gate
+(ci/telemetry_check.sh) asserts no lingering thread or socket. Binds
+127.0.0.1 only: this is an operator/scrape surface, not an
+authenticated public API. `port=0` binds an ephemeral port, reported
+via `server.port` (and used by tests/CI to avoid collisions).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class ObsHttpServer:
+    """Daemon-thread HTTP server over the session's obs surface."""
+
+    def __init__(self, session, port: int = 0, host: str = "127.0.0.1"):
+        self._session = session
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *_a):  # no stderr chatter per scrape
+                pass
+
+            def do_GET(self):
+                try:
+                    path = self.path.split("?", 1)[0]
+                    if path == "/metrics":
+                        body = outer._metrics().encode()
+                        ctype = ("text/plain; version=0.0.4; "
+                                 "charset=utf-8")
+                    elif path == "/queries":
+                        body = json.dumps(
+                            outer._queries(), default=str).encode()
+                        ctype = "application/json"
+                    elif path == "/healthz":
+                        body, ctype = b"ok\n", "text/plain"
+                    else:
+                        self.send_error(404, "unknown path")
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except BrokenPipeError:
+                    pass  # client went away mid-response
+                except Exception as e:
+                    try:
+                        self.send_error(500, type(e).__name__)
+                    except Exception:
+                        pass
+
+        self._server = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self.host = host
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name="srtpu-obs-http", daemon=True)
+        self._thread.start()
+
+    # --- payload builders ---
+
+    def _metrics(self) -> str:
+        from spark_rapids_tpu.obs import prom
+
+        return prom.render(self._session)
+
+    def _queries(self) -> dict:
+        from spark_rapids_tpu.obs import telemetry
+        from spark_rapids_tpu.runtime import admission
+
+        return {
+            "admission": admission.get().status(),
+            "queries": {
+                str(qid): summary for qid, summary in
+                telemetry.ledger.recent_query_summaries().items()},
+            "hbmTimeline": telemetry.ledger.hbm_timeline(),
+            "linkPeaks": telemetry.link_peaks(),
+        }
+
+    # --- lifecycle ---
+
+    def close(self) -> None:
+        """Stop serving and release the socket + thread (idempotent)."""
+        server, self._server = self._server, None
+        if server is None:
+            return
+        server.shutdown()          # stops serve_forever
+        server.server_close()      # closes the listening socket
+        self._thread.join(timeout=5.0)
+
+
+def maybe_start(session, conf=None) -> Optional[ObsHttpServer]:
+    """Conf gate: an ObsHttpServer when obs.http.enabled, else None.
+    A bind failure (port taken) degrades to a warning — observability
+    must never fail a session."""
+    from spark_rapids_tpu.config import rapids_conf as rc
+
+    def get(entry):
+        return conf.get(entry) if conf is not None else entry.default
+
+    if not get(rc.OBS_HTTP_ENABLED):
+        return None
+    try:
+        return ObsHttpServer(session, port=get(rc.OBS_HTTP_PORT))
+    except OSError as e:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "obs http endpoint failed to bind: %s", e)
+        return None

@@ -1,17 +1,24 @@
 """Prometheus text-exposition dump of the unified metric registry.
 
 One call renders every engine counter — robustness, compile ledger,
-shuffle/spill bytes, per-session query metrics, bus event counts — in
-the text format a Prometheus scrape (or a pushgateway hook) ingests:
+shuffle/spill bytes, data-movement telemetry, per-session query
+metrics, bus event counts — in the text format a Prometheus scrape (or
+a pushgateway hook) ingests:
 
     srtpu_robustness_scheduler_tasksLaunched 42
     srtpu_events_total{event="operator.span"} 118
+    srtpu_transfer_bytes_total{direction="h2d",site="scan.upload"} 9e6
+    srtpu_query_bytes_moved{queryId="7",direction="d2h"} 1024
 
-The engine has no HTTP server; embedders expose `render()` behind
-whatever endpoint their deployment runs (the dashboards goal of the
-ROADMAP north star). Everything is emitted as gauges: most values are
-monotonic in practice, but cross-session resets (new shuffle manager,
-reconfigured registries) would violate Prometheus counter semantics.
+Label VALUES are escaped per the exposition-format rules (backslash,
+double-quote, newline) — queryIds and operator/site names flow in from
+user-visible strings and must never produce unparseable text. The
+engine's own HTTP endpoint (obs/http.py, conf
+`spark.rapids.tpu.obs.http.enabled`) serves `render()` at `/metrics`;
+embedders can also expose it behind their own server. Everything is
+emitted as gauges: most values are monotonic in practice, but
+cross-session resets (new shuffle manager, reconfigured registries)
+would violate Prometheus counter semantics.
 """
 
 from __future__ import annotations
@@ -29,6 +36,17 @@ def _metric_name(dotted: str) -> str:
     return f"{PREFIX}_{_NAME_RE.sub('_', dotted)}"
 
 
+def escape_label(v) -> str:
+    """Escape one label VALUE per the Prometheus text exposition
+    format: backslash first, then double-quote and newline."""
+    return (str(v).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def _labels(**kv) -> str:
+    return ",".join(f'{k}="{escape_label(v)}"' for k, v in kv.items())
+
+
 def _fmt_value(v: float) -> str:
     if isinstance(v, float) and not v.is_integer():
         return repr(v)
@@ -38,10 +56,12 @@ def _fmt_value(v: float) -> str:
 def render(session=None) -> str:
     """The full unified registry as Prometheus text exposition."""
     snap = _registry.unified_snapshot(session)
-    # labeled families: per-event and per-chaos-site counts read better
-    # as one family with a label than as N families
+    # labeled families: per-event, per-chaos-site, per-transfer-site
+    # and per-query counts read better as one family with labels than
+    # as N families
     events = snap.pop("events", {})
     chaos = snap.get("robustness", {}).pop("chaos", {})
+    snap.pop("telemetry", {})  # re-rendered as labeled families below
     lines = []
     flat: Dict[str, float] = _registry.flatten(snap)
     for name in sorted(flat):
@@ -52,7 +72,7 @@ def render(session=None) -> str:
         mname = f"{PREFIX}_events_total"
         lines.append(f"# TYPE {mname} gauge")
         for ev in sorted(events):
-            lines.append(f'{mname}{{event="{ev}"}} '
+            lines.append(f"{mname}{{{_labels(event=ev)}}} "
                          f"{_fmt_value(events[ev])}")
     if chaos:
         for field in ("checked", "injected"):
@@ -60,6 +80,53 @@ def render(session=None) -> str:
             lines.append(f"# TYPE {mname} gauge")
             for site in sorted(chaos):
                 lines.append(
-                    f'{mname}{{site="{site}"}} '
+                    f"{mname}{{{_labels(site=site)}}} "
                     f"{_fmt_value(chaos[site].get(field, 0))}")
+    lines.extend(_telemetry_lines())
     return "\n".join(lines) + "\n"
+
+
+def _telemetry_lines() -> list:
+    """Data-movement families: process totals per (direction, site),
+    HBM occupancy gauges, and the retained per-query summaries —
+    per-query bytes_moved/hbm_peak/roofline_frac straight off a
+    /metrics scrape."""
+    from spark_rapids_tpu.obs import telemetry as _tel
+
+    lines = []
+    rows = _tel.ledger.site_rows()
+    if rows:
+        for field, unit in (("bytes", "bytes"), ("count", "count")):
+            mname = f"{PREFIX}_transfer_{unit}_total"
+            lines.append(f"# TYPE {mname} gauge")
+            for r in rows:
+                lines.append(
+                    f"{mname}{{{_labels(direction=r['direction'], site=r['site'])}}} "
+                    f"{_fmt_value(r[field])}")
+    view = _tel.ledger.registry_view()
+    for k, v in sorted(view["hbm"].items()):
+        mname = f"{PREFIX}_hbm_{k}"
+        lines.append(f"# TYPE {mname} gauge")
+        lines.append(f"{mname} {_fmt_value(v)}")
+    summaries = _tel.ledger.recent_query_summaries()
+    if summaries:
+        families: dict = {f"{PREFIX}_query_bytes_moved": [],
+                          f"{PREFIX}_query_hbm_peak_bytes": [],
+                          f"{PREFIX}_query_roofline_frac": []}
+        for qid, s in summaries.items():
+            for d, b in s.get("bytesMoved", {}).items():
+                families[f"{PREFIX}_query_bytes_moved"].append(
+                    ({"queryId": qid, "direction": d}, b))
+            families[f"{PREFIX}_query_hbm_peak_bytes"].append(
+                ({"queryId": qid}, s.get("hbmPeakBytes", 0)))
+            if s.get("rooflineFrac") is not None:
+                families[f"{PREFIX}_query_roofline_frac"].append(
+                    ({"queryId": qid}, s["rooflineFrac"]))
+        for mname, samples in families.items():
+            if not samples:
+                continue
+            lines.append(f"# TYPE {mname} gauge")
+            for labels, value in samples:
+                lines.append(f"{mname}{{{_labels(**labels)}}} "
+                             f"{_fmt_value(value)}")
+    return lines

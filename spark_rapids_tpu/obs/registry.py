@@ -51,6 +51,7 @@ def unified_snapshot(session=None) -> dict:
     counters, the compile ledger, spill-catalog + shuffle byte
     ledgers, per-session query metrics, and bus event counts."""
     from spark_rapids_tpu.obs import events as _events
+    from spark_rapids_tpu.obs import telemetry as _telemetry
     from spark_rapids_tpu.runtime.compile_cache import stats
     from spark_rapids_tpu.shuffle.manager import get_shuffle_manager
 
@@ -61,6 +62,7 @@ def unified_snapshot(session=None) -> dict:
         "shuffle": {"bytesWritten": mgr.bytes_written,
                     "bytesInMemory": mgr.bytes_in_memory,
                     "blocksSpilled": mgr.blocks_spilled},
+        "telemetry": _telemetry.ledger.registry_view(),
     }
     try:
         from spark_rapids_tpu.runtime.memory import _catalog

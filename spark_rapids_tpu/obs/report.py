@@ -124,6 +124,8 @@ def profile_data(source: Source, top_n: int = 10) -> dict:
     recovery = {"attempts": 0, "retried": 0, "speculated": 0,
                 "discarded": 0, "lost": 0, "failed": 0,
                 "degradations": 0, "chaosInjections": 0}
+    movement: Dict[str, Dict[str, int]] = {}
+    telemetry_summary = None
     for ev in events:
         et = ev["event"]
         counts[et] = counts.get(et, 0) + 1
@@ -160,6 +162,19 @@ def profile_data(source: Source, top_n: int = 10) -> dict:
             recovery["degradations"] += 1
         elif et == "chaos":
             recovery["chaosInjections"] += 1
+        elif et == "transfer":
+            d = movement.setdefault(str(ev.get("direction")),
+                                    {"bytes": 0, "count": 0})
+            d["bytes"] += ev.get("bytes") or 0
+            d["count"] += 1
+        elif et == "telemetry.summary":
+            # end-of-query roofline record (the last one wins: nested
+            # collects never emit it, so there is exactly one per query)
+            telemetry_summary = {
+                k: ev.get(k) for k in
+                ("bytesMoved", "bytesMovedTotal", "hbmPeakBytes",
+                 "rooflineFrac", "linkFrac", "bytesPerOutputRow",
+                 "wallMs") if ev.get(k) is not None}
     served = compile_c["hit"] + compile_c["warm"]
     requests = served + compile_c["miss"]
     return {
@@ -175,6 +190,8 @@ def profile_data(source: Source, top_n: int = 10) -> dict:
                     "cacheServedRatio": (served / requests
                                          if requests else None)},
         "recovery": recovery,
+        "dataMovement": movement,
+        "telemetry": telemetry_summary,
     }
 
 
@@ -211,4 +228,17 @@ def profile(source: Source, top_n: int = 10) -> str:
                  f"speculated, {r['discarded']} discarded, "
                  f"{r['degradations']} degradation(s), "
                  f"{r['chaosInjections']} chaos injection(s)")
+    if d["dataMovement"]:
+        parts = [f"{dd} {v['bytes']} B/{v['count']} transfer(s)"
+                 for dd, v in sorted(d["dataMovement"].items())]
+        lines.append("data movement: " + ", ".join(parts))
+    tel = d.get("telemetry")
+    if tel:
+        rf = tel.get("rooflineFrac")
+        bpr = tel.get("bytesPerOutputRow")
+        lines.append(
+            f"roofline: {tel.get('bytesMovedTotal', 0)} B moved, "
+            f"hbm peak {tel.get('hbmPeakBytes', 0)} B"
+            + (f", roofline_frac {rf}" if rf is not None else "")
+            + (f", {bpr} B/output row" if bpr is not None else ""))
     return "\n".join(lines)

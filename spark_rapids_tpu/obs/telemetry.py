@@ -1,0 +1,393 @@
+"""Data-movement telemetry: transfer ledger, HBM occupancy, roofline.
+
+BENCH_r05 measured roofline_frac ~ 0.006 over a 0.11 GB/s host->device
+link — and every planned optimization (ICI-resident shuffle, compressed
+execution, out-of-core streaming) is a bytes-moved optimization. The
+reference stack's profiling tool attributes transfer volume per
+operator to drive exactly that tuning loop; this module is the engine's
+equivalent measurement substrate:
+
+- **Transfer ledger**: every byte-crossing site (H2D uploads, D2H
+  materialization at collect, shuffle write/fetch, disk spill/unspill)
+  calls `record(direction, site, bytes, ns)`; entries are attributed to
+  the owning query through the obs query/task scope (obs/events.py) and
+  mirrored onto the event bus as `transfer` events so the event log is
+  a complete audit of data movement. Directions are the four physical
+  channels: `h2d`, `d2h`, `spill-disk` (disk I/O of the spill tiers),
+  and `shuffle` (inter-task/inter-process block movement).
+
+- **HBM occupancy timeline**: the SpillCatalog's reservation ledger
+  (runtime/memory.py) feeds `hbm_global` / `hbm_query` on every device
+  reserve/release, so the process keeps a bounded (ts, reservedBytes)
+  timeline, a global high-water mark that tracks the pool's own peak,
+  and a per-query device-footprint peak — a query's peak HBM usage is
+  a reported number, not a guess. Spill pressure (synchronous spills
+  triggered by a failed reservation) is counted per query.
+
+- **Roofline accounting**: `link_peaks()` measures the H2D/D2H link
+  once per process (a timed `device_put`/`device_get` of a fixed
+  buffer) and reads the device HBM peak bandwidth from the public spec
+  table; the result is cached as JSON inside the compile cache's
+  VERSIONED directory (runtime/compile_cache.py) so a backend/version
+  switch re-probes and a warm process never pays the probe.
+  `query_summary()` combines the peaks with the per-query ledger into
+  `rooflineFrac` (achieved bytes/s over the query wall time vs the
+  device HBM peak — the same definition bench.py has always used),
+  `linkFrac` (link-crossing bytes/s vs the measured H2D link), and
+  `bytesPerOutputRow`.
+
+The ledger is deliberately independent of `obs.enabled`: counters keep
+working with the bus off (record() just skips the event emission), and
+`spark.rapids.tpu.telemetry.enabled=false` reduces every site to one
+boolean check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.obs import events as _events
+
+#: The four physical data-movement channels a transfer is tagged with.
+DIRECTIONS = ("h2d", "d2h", "spill-disk", "shuffle")
+
+#: Peak HBM bandwidth per chip, bytes/s (public TPU specs; the cpu
+#: backend gets a nominal DDR figure so fractions stay meaningful).
+#: bench.py reads this table too — one source of truth.
+DEVICE_PEAK_BW = {
+    "TPU v4": 1.2e12,
+    "TPU v5e": 8.19e11,
+    "TPU v5 lite": 8.19e11,
+    "TPU v5p": 2.765e12,
+    "TPU v6e": 1.64e12,
+    "cpu": 5.0e10,
+}
+
+_PROBE_BYTES = 8 << 20          # link probe transfer size
+_QUERY_KEEP = 64                # per-query ledgers retained
+_TIMELINE_KEEP = 4096           # (ts, reservedBytes) samples retained
+
+
+def _cell() -> Dict[str, int]:
+    return {"bytes": 0, "ns": 0, "count": 0}
+
+
+class _QueryLedger:
+    """Per-query accumulation (one per queryId, bounded LRU)."""
+
+    __slots__ = ("by_direction", "by_site", "hbm_peak", "hbm_current",
+                 "spill_pressure", "final")
+
+    def __init__(self):
+        self.by_direction: Dict[str, Dict[str, int]] = {}
+        self.by_site: Dict[str, Dict[str, int]] = {}
+        self.hbm_peak = 0
+        self.hbm_current = 0
+        self.spill_pressure = 0
+        self.final: Optional[dict] = None  # end-of-query summary
+
+
+class TransferLedger:
+    """Process-wide data-movement ledger (the compile_cache.stats
+    pattern: one module singleton, per-query views carved out of it)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.enabled = True
+        self.totals: Dict[str, Dict[str, int]] = {}
+        self.sites: Dict[str, Dict[str, int]] = {}
+        self._site_dir: Dict[str, str] = {}
+        self._queries: "OrderedDict[int, _QueryLedger]" = OrderedDict()
+        # HBM occupancy
+        self.hbm_reserved = 0
+        self.hbm_peak = 0
+        self.pressure_events = 0
+        self.timeline: deque = deque(maxlen=_TIMELINE_KEEP)
+
+    # --- transfer recording ---
+
+    def record(self, direction: str, site: str, nbytes: int,
+               ns: int = 0, query_id: Optional[int] = None,
+               emit: bool = True) -> None:
+        """Account one transfer. `query_id` defaults to the calling
+        thread's effective query (task scope first — pool threads —
+        then the thread's own query scope); `ns` is the wall time the
+        caller measured around the transfer (0 when the site dispatches
+        asynchronously and has no honest number)."""
+        if not self.enabled or nbytes <= 0:
+            return
+        qid = query_id if query_id is not None \
+            else _events.effective_query_id()
+        with self._lock:
+            for cell in (self.totals.setdefault(direction, _cell()),
+                         self.sites.setdefault(site, _cell()),
+                         self._query(qid).by_direction.setdefault(
+                             direction, _cell()),
+                         self._query(qid).by_site.setdefault(
+                             site, _cell())):
+                cell["bytes"] += int(nbytes)
+                cell["ns"] += int(ns)
+                cell["count"] += 1
+            self._site_dir[site] = direction
+        if emit:
+            _events.emit("transfer", direction=direction, site=site,
+                         bytes=int(nbytes), ns=int(ns))
+
+    def record_forwarded(self, fields: dict,
+                         query_id: Optional[int] = None) -> None:
+        """Fold a worker-forwarded `transfer` event (process pool) into
+        the driver ledger and re-emit it on the driver bus under the
+        driver's query attribution."""
+        self.record(str(fields.get("direction", "shuffle")),
+                    str(fields.get("site", "worker")),
+                    int(fields.get("bytes") or 0),
+                    ns=int(fields.get("ns") or 0),
+                    query_id=query_id)
+
+    # --- HBM occupancy (SpillCatalog hooks) ---
+
+    def hbm_global(self, reserved: int) -> None:
+        """Called by the device pool after every reserve/release with
+        its post-op total; keeps the process timeline + high-water."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self.hbm_reserved = reserved
+            if reserved > self.hbm_peak:
+                self.hbm_peak = reserved
+            self.timeline.append((round(time.time(), 6), reserved))
+
+    def hbm_query(self, query_id: int, reserved: int) -> None:
+        """Called by the catalog's per-query quota ledger with the
+        query's post-op device reservation total."""
+        if not self.enabled or not query_id:
+            return
+        with self._lock:
+            q = self._query(query_id)
+            q.hbm_current = reserved
+            if reserved > q.hbm_peak:
+                q.hbm_peak = reserved
+
+    def hbm_pressure(self, target: int, freed: int,
+                     query_id: Optional[int] = None) -> None:
+        """A failed device reservation forced a synchronous spill."""
+        if not self.enabled:
+            return
+        qid = query_id if query_id is not None \
+            else _events.effective_query_id()
+        with self._lock:
+            self.pressure_events += 1
+            if qid:
+                self._query(qid).spill_pressure += 1
+
+    # --- views ---
+
+    def _query(self, qid: int) -> _QueryLedger:
+        """Under lock: the (possibly new) ledger for a query id."""
+        q = self._queries.get(qid)
+        if q is None:
+            q = self._queries[qid] = _QueryLedger()
+            while len(self._queries) > _QUERY_KEEP:
+                self._queries.popitem(last=False)
+        return q
+
+    def query_summary(self, query_id: int,
+                      wall_s: Optional[float] = None,
+                      output_rows: Optional[int] = None) -> dict:
+        """One query's data-movement report: bytes moved by direction
+        and site, HBM footprint peak, and — when the caller supplies
+        the query wall time — rooflineFrac/linkFrac."""
+        if not self.enabled:
+            return {}
+        with self._lock:
+            q = self._queries.get(query_id)
+            by_dir = {} if q is None else {
+                d: dict(c) for d, c in q.by_direction.items()}
+            by_site = {} if q is None else {
+                s: dict(c) for s, c in q.by_site.items()}
+            hbm_peak = 0 if q is None else q.hbm_peak
+            pressure = 0 if q is None else q.spill_pressure
+        total = sum(c["bytes"] for c in by_dir.values())
+        link = sum(by_dir.get(d, _cell())["bytes"]
+                   for d in ("h2d", "d2h"))
+        out = {
+            "bytesMoved": {d: by_dir[d]["bytes"] for d in sorted(by_dir)},
+            "bytesMovedTotal": total,
+            "transfers": sum(c["count"] for c in by_dir.values()),
+            "perSite": by_site,
+            "hbmPeakBytes": hbm_peak,
+            "spillPressureEvents": pressure,
+        }
+        if output_rows:
+            out["bytesPerOutputRow"] = round(total / output_rows, 3)
+        if wall_s and wall_s > 0:
+            peaks = link_peaks()
+            out["wallMs"] = round(wall_s * 1000, 3)
+            out["rooflineFrac"] = round(
+                (total / wall_s) / peaks["devicePeakBytesPerS"], 6)
+            if peaks.get("h2dBytesPerS"):
+                out["linkFrac"] = round(
+                    (link / wall_s) / peaks["h2dBytesPerS"], 6)
+        return out
+
+    def finalize_query(self, query_id: int, summary: dict) -> None:
+        """Retain a query's end-of-run summary (with wall time and
+        roofline fractions) so /metrics and /queries report finished
+        queries with their full numbers."""
+        if not self.enabled or not query_id or not summary:
+            return
+        with self._lock:
+            self._query(query_id).final = dict(summary)
+
+    def recent_query_summaries(self) -> Dict[int, dict]:
+        """Summaries of the retained queries, most recent last (the
+        /queries and /metrics per-query payload): the finalized
+        end-of-run summary (with roofline fractions) for finished
+        queries, the live ledger view for in-flight ones."""
+        with self._lock:
+            finals = {qid: dict(q.final) for qid, q in
+                      self._queries.items() if qid and q.final}
+            live = [qid for qid, q in self._queries.items()
+                    if qid and not q.final]
+        out = {qid: self.query_summary(qid) for qid in live}
+        out.update(finals)
+        return out
+
+    def registry_view(self) -> dict:
+        """Numeric process-level snapshot for the unified registry
+        (obs/registry.py flatten -> plain Prometheus gauges)."""
+        with self._lock:
+            return {
+                "hbm": {"reservedBytes": self.hbm_reserved,
+                        "peakBytes": self.hbm_peak,
+                        "pressureEvents": self.pressure_events},
+                "bytesMoved": {d: c["bytes"]
+                               for d, c in self.totals.items()},
+                "transfers": {d: c["count"]
+                              for d, c in self.totals.items()},
+            }
+
+    def site_rows(self) -> List[dict]:
+        """Per-site process totals for the labeled Prometheus family:
+        [{site, direction, bytes, ns, count}]."""
+        with self._lock:
+            return [{"site": s, "direction": self._site_dir.get(s, ""),
+                     **c} for s, c in sorted(self.sites.items())]
+
+    def hbm_timeline(self, last: int = 512) -> List[list]:
+        """The most recent (ts, reservedBytes) occupancy samples."""
+        with self._lock:
+            return [list(x) for x in list(self.timeline)[-last:]]
+
+
+ledger = TransferLedger()
+
+# module-level aliases: instrumented sites stay one short call
+record = ledger.record
+record_forwarded = ledger.record_forwarded
+hbm_global = ledger.hbm_global
+hbm_query = ledger.hbm_query
+hbm_pressure = ledger.hbm_pressure
+query_summary = ledger.query_summary
+
+
+def configure(conf=None) -> None:
+    """Session-lifecycle hook: honor spark.rapids.tpu.telemetry.enabled
+    (counters persist across sessions like every process ledger)."""
+    from spark_rapids_tpu.config import rapids_conf as rc
+
+    if conf is not None:
+        ledger.enabled = bool(conf.get(rc.TELEMETRY_ENABLED))
+
+
+# ------------------------------------------------------- roofline peaks
+
+_peaks: Optional[dict] = None
+_peaks_lock = threading.Lock()
+_PEAKS_FILE = "telemetry_peaks.json"
+
+
+def _device_peak_bw(kind: str) -> float:
+    return next((v for k, v in DEVICE_PEAK_BW.items()
+                 if k.lower() in str(kind).lower()),
+                DEVICE_PEAK_BW["cpu"])
+
+
+def _peaks_path() -> Optional[str]:
+    from spark_rapids_tpu.runtime import compile_cache
+
+    root = compile_cache.cache_dir()
+    if root is None:
+        return None
+    # the versioned dir: _check_version_stamp wipes it (and this file)
+    # whenever the jax/jaxlib/plugin/backend tuple changes, which is
+    # exactly the set of events that invalidates a link measurement
+    return os.path.join(root, _PEAKS_FILE)
+
+
+def _probe_link() -> dict:
+    """Measure the host<->device link once: a timed device_put (H2D)
+    and device_get (D2H) of a fixed buffer, plus the device HBM peak
+    from the spec table."""
+    import jax
+    import numpy as np
+
+    dev = jax.devices()[0]
+    kind = str(getattr(dev, "device_kind", dev.platform))
+    buf = np.zeros(_PROBE_BYTES // 8, dtype=np.float64)
+    t0 = time.perf_counter()
+    on_dev = jax.block_until_ready(jax.device_put(buf))
+    h2d_s = max(time.perf_counter() - t0, 1e-9)
+    t0 = time.perf_counter()
+    jax.device_get(on_dev)
+    d2h_s = max(time.perf_counter() - t0, 1e-9)
+    return {
+        "deviceKind": kind,
+        "devicePeakBytesPerS": _device_peak_bw(kind),
+        "h2dBytesPerS": round(buf.nbytes / h2d_s, 1),
+        "d2hBytesPerS": round(buf.nbytes / d2h_s, 1),
+        "probeBytes": buf.nbytes,
+    }
+
+
+def link_peaks(refresh: bool = False) -> dict:
+    """Measured link + device peaks, probed once and cached — first in
+    process memory, then (when the compile cache is configured) as JSON
+    in its versioned directory so restarted processes skip the probe."""
+    global _peaks
+    with _peaks_lock:
+        if _peaks is not None and not refresh:
+            return _peaks
+        path = _peaks_path()
+        if path is not None and not refresh:
+            try:
+                with open(path) as f:
+                    loaded = json.load(f)
+                if isinstance(loaded, dict) and "devicePeakBytesPerS" \
+                        in loaded:
+                    _peaks = loaded
+                    return _peaks
+            except (OSError, ValueError):
+                pass
+        try:
+            _peaks = _probe_link()
+        except Exception:
+            # no backend (stubbed jax, probe crash): spec-table only
+            _peaks = {"deviceKind": "unknown",
+                      "devicePeakBytesPerS": DEVICE_PEAK_BW["cpu"],
+                      "h2dBytesPerS": 0.0, "d2hBytesPerS": 0.0,
+                      "probeBytes": 0}
+        if path is not None:
+            try:
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(_peaks, f)
+                os.replace(tmp, path)
+            except OSError:
+                pass
+        return _peaks

@@ -67,8 +67,12 @@ def run_scan_agg_fragment(spec: dict):
     import pyarrow.compute as pc
     import pyarrow.parquet as pq
 
+    from spark_rapids_tpu.obs import events as obs_events
+    from spark_rapids_tpu.obs import telemetry
+
     if spec.get("sleep_s"):
         time.sleep(float(spec["sleep_s"]))
+    t0 = time.monotonic_ns()
     t = pa.concat_tables([pq.read_table(p) for p in spec["files"]])
     f = spec.get("filter")
     if f is not None:
@@ -79,8 +83,26 @@ def run_scan_agg_fragment(spec: dict):
         name, src, modulus = d
         g = np.asarray(t.column(src)) % int(modulus)
         t = t.append_column(name, pa.array(g, type=pa.int64()))
-    return t.group_by(list(spec["keys"])).aggregate(
+    out = t.group_by(list(spec["keys"])).aggregate(
         [tuple(a) for a in spec["aggs"]])
+    # observability parity with in-process attempts: one operator span
+    # for the fragment + the partial-result bytes that will cross the
+    # process boundary back to the driver. Both land on the WORKER's
+    # local bus and are forwarded with the task result (ProcessBackend
+    # re-emits them under the driver's query/task identity).
+    telemetry.record("shuffle", "worker.result", out.nbytes)
+    obs_events.emit("operator.span", operator="ScanAggFragment",
+                    metric="fragmentTime",
+                    wallNs=time.monotonic_ns() - t0, deviceNs=0,
+                    rows=out.num_rows)
+    return out
+
+
+#: Envelope + task-identity keys stripped from forwarded events: the
+#: driver re-emits through its own bus, which reassigns all of them
+#: under the driver's query scope and the attempt's task identity.
+_FWD_STRIP = ("seq", "ts", "schemaVersion", "queryId", "stage", "task",
+              "attempt", "speculative", "worker")
 
 
 def _worker_main(worker_id: str, task_q, result_q, hb_addr,
@@ -88,7 +110,23 @@ def _worker_main(worker_id: str, task_q, result_q, hb_addr,
     """Worker process loop: register with the heartbeat plane, then
     drain the private task queue until the None sentinel. A task is
     (stage, task_index, attempt, fragment_path, args); results are
-    pickled so arbitrary fragment outputs travel the shared queue."""
+    pickled so arbitrary fragment outputs travel the shared queue.
+
+    Observability: the worker installs its OWN event bus — critically
+    replacing any bus inherited across fork(), whose subscribers (span
+    builder, event-log file handle) belong to the DRIVER and must never
+    see worker writes — and collects everything a task emits
+    (operator spans, transfer records). The collected payloads ride the
+    result tuple back; ProcessBackend re-emits them on the driver bus
+    under the proper task scope, so a ProcessBackend run produces the
+    same span trees and transfer ledger as an in-process run."""
+    from spark_rapids_tpu.obs import events as obs_events
+
+    obs_events.install(None)  # drop the fork-inherited driver bus
+    collected: List[dict] = []
+    wbus = obs_events.EventBus()
+    wbus.subscribe(collected.append)
+    obs_events.install(wbus)
     client = None
     if hb_addr is not None:
         from spark_rapids_tpu.parallel.heartbeat import HeartbeatClient
@@ -100,6 +138,13 @@ def _worker_main(worker_id: str, task_q, result_q, hb_addr,
         except OSError:
             pass  # driver plane gone; the sentinel still covers us
     result_q.put(("ready", worker_id, None, None, None))
+
+    def drain_events() -> List[dict]:
+        evs = [{k: v for k, v in e.items() if k not in _FWD_STRIP}
+               for e in collected]
+        collected.clear()
+        return evs
+
     while True:
         item = task_q.get()
         if item is None:
@@ -108,10 +153,11 @@ def _worker_main(worker_id: str, task_q, result_q, hb_addr,
         try:
             fn = _import_callable(fn_path)
             out = pickle.dumps(fn(args))
-            result_q.put(("ok", worker_id, stage, idx, attempt, out))
+            result_q.put(("ok", worker_id, stage, idx, attempt, out,
+                          drain_events()))
         except BaseException:
             result_q.put(("err", worker_id, stage, idx, attempt,
-                          traceback.format_exc()))
+                          traceback.format_exc(), drain_events()))
     if client is not None:
         client.close()
 
@@ -270,6 +316,8 @@ class ProcessBackend:
         kind, wid, stage, idx, attempt = ev[0], ev[1], ev[2], ev[3], \
             ev[4]
         value: Any = ev[5]
+        self._replay_events(ev[6] if len(ev) > 6 else None,
+                            stage, idx, attempt, wid)
         if kind == "ok":
             value = pickle.loads(value)
         else:
@@ -277,6 +325,32 @@ class ProcessBackend:
                 f"task {idx} attempt {attempt} failed on {wid}:\n"
                 f"{value}")
         return (kind, idx, attempt, wid, value, stage)
+
+    @staticmethod
+    def _replay_events(events, stage: int, idx: int, attempt: int,
+                       wid: str) -> None:
+        """Re-emit worker-forwarded events on the driver bus under this
+        attempt's task identity (poll runs on the scheduler's driver
+        thread, so the query scope is the submitting query's) — the
+        cross-process half of the obs contract: span trees and the
+        transfer ledger look the same as an in-process run. Transfer
+        records also fold into the driver's byte ledger."""
+        if not events:
+            return
+        from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.obs import telemetry
+
+        for fe in events:
+            fields = dict(fe)
+            name = fields.pop("event", None)
+            if name is None:
+                continue
+            if name == "transfer":
+                # record() re-emits the bus event itself
+                telemetry.record_forwarded(fields)
+                continue
+            obs_events.emit(name, stage=stage, task=idx,
+                            attempt=attempt, worker=wid, **fields)
 
     def lost_workers(self) -> List[str]:
         return self.pool.check_lost()

@@ -110,12 +110,19 @@ class SpillableBatch:
 
     def _to_host(self):
         assert self._tier == SpillTier.DEVICE
+        import time as _time
+
+        from spark_rapids_tpu.obs import telemetry
         from spark_rapids_tpu.runtime.profiler import annotate
 
         leaves, treedef = jax.tree_util.tree_flatten(self._device_batch)
+        t0 = _time.monotonic_ns()
         with annotate(f"spill:D2H:{self.size_bytes}"):
             self._host_data = [np.asarray(jax.device_get(x))
                                for x in leaves]
+        telemetry.record("d2h", "spill.toHost", self.size_bytes,
+                         ns=_time.monotonic_ns() - t0,
+                         query_id=self.query_id)
         self._treedef = treedef
         self._device_batch = None
         self._tier = SpillTier.HOST
@@ -143,24 +150,38 @@ class SpillableBatch:
 
     def _to_disk(self):
         assert self._tier == SpillTier.HOST
+        import time as _time
+
+        from spark_rapids_tpu.obs import telemetry
         from spark_rapids_tpu.runtime.profiler import annotate
 
         path = os.path.join(self._catalog.spill_dir, f"spill-{self.id}.npz")
+        t0 = _time.monotonic_ns()
         with annotate(f"spill:HOST2DISK:{self.size_bytes}"):
             self._disk_io(lambda: np.savez(path, *self._host_data),
                           "write", path)
+        telemetry.record("spill-disk", "spill.toDisk", self.size_bytes,
+                         ns=_time.monotonic_ns() - t0,
+                         query_id=self.query_id)
         self._disk_path = path
         self._host_data = None
         self._tier = SpillTier.DISK
 
     def _host_from_disk(self):
         assert self._tier == SpillTier.DISK
+        import time as _time
+
+        from spark_rapids_tpu.obs import telemetry
 
         def load():
             with np.load(self._disk_path) as z:
                 return [z[k] for k in z.files]
 
+        t0 = _time.monotonic_ns()
         self._host_data = self._disk_io(load, "read", self._disk_path)
+        telemetry.record("spill-disk", "spill.fromDisk", self.size_bytes,
+                         ns=_time.monotonic_ns() - t0,
+                         query_id=self.query_id)
         os.unlink(self._disk_path)
         self._disk_path = None
         self._tier = SpillTier.HOST
@@ -169,10 +190,17 @@ class SpillableBatch:
         if self._tier == SpillTier.DISK:
             self._host_from_disk()
         if self._tier == SpillTier.HOST:
+            import time as _time
+
+            from spark_rapids_tpu.obs import telemetry
             from spark_rapids_tpu.runtime.profiler import annotate
 
+            t0 = _time.monotonic_ns()
             with annotate(f"unspill:H2D:{self.size_bytes}"):
                 leaves = [jax.device_put(x) for x in self._host_data]
+            telemetry.record("h2d", "spill.unspill", self.size_bytes,
+                             ns=_time.monotonic_ns() - t0,
+                             query_id=self.query_id)
             self._device_batch = jax.tree_util.tree_unflatten(
                 self._treedef, leaves)
             self._host_data = None
@@ -197,7 +225,10 @@ class SpillableBatch:
 
 
 class DeviceMemoryPool:
-    """Reservation ledger for device HBM (the Rmm pool analog)."""
+    """Reservation ledger for device HBM (the Rmm pool analog). Every
+    successful reserve/release feeds the telemetry occupancy timeline
+    (obs/telemetry.py) with the post-op total, so HBM occupancy over
+    time is a recorded series, not a point probe."""
 
     def __init__(self, limit_bytes: int):
         self.limit = limit_bytes
@@ -206,16 +237,22 @@ class DeviceMemoryPool:
         self._lock = threading.RLock()
 
     def try_reserve(self, nbytes: int) -> bool:
+        from spark_rapids_tpu.obs import telemetry
+
         with self._lock:
             if self.reserved + nbytes > self.limit:
                 return False
             self.reserved += nbytes
             self.peak = max(self.peak, self.reserved)
+            telemetry.hbm_global(self.reserved)
             return True
 
     def release(self, nbytes: int):
+        from spark_rapids_tpu.obs import telemetry
+
         with self._lock:
             self.reserved = max(0, self.reserved - nbytes)
+            telemetry.hbm_global(self.reserved)
 
 
 class SpillCatalog:
@@ -304,18 +341,24 @@ class SpillCatalog:
     def _q_add(self, qid: int, nbytes: int) -> None:
         if not qid:
             return
+        from spark_rapids_tpu.obs import telemetry
+
         with self._q_lock:
-            self._q_dev[qid] = self._q_dev.get(qid, 0) + nbytes
+            cur = self._q_dev[qid] = self._q_dev.get(qid, 0) + nbytes
+            telemetry.hbm_query(qid, cur)
 
     def _q_release(self, qid: int, nbytes: int) -> None:
         if not qid:
             return
+        from spark_rapids_tpu.obs import telemetry
+
         with self._q_lock:
             left = self._q_dev.get(qid, 0) - nbytes
             if left > 0:
                 self._q_dev[qid] = left
             else:
                 self._q_dev.pop(qid, None)
+            telemetry.hbm_query(qid, max(0, left))
 
     def query_device_reserved(self, query_id: int) -> int:
         with self._q_lock:
@@ -406,6 +449,9 @@ class SpillCatalog:
         analog). With `query_id` only THAT query's buffers are
         candidates — the quota gate degrades the offending query
         without disturbing its neighbors."""
+        from spark_rapids_tpu.obs import telemetry
+
+        telemetry.hbm_pressure(target, 0, query_id=query_id)
         freed = 0
         with self._lock:
             candidates = sorted(

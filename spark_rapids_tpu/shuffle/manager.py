@@ -147,9 +147,11 @@ class ShuffleManager:
         b.table = None
         self.blocks_spilled += 1
         from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.obs import telemetry
 
         obs_events.emit("spill", component="shuffle", direction="down",
                         fromTier="HOST", toTier="DISK", bytes=b.nbytes)
+        telemetry.record("spill-disk", "shuffle.spill", b.nbytes)
 
     def _spill_mem_blocks(self):
         """Under lock: move coldest (oldest) in-memory blocks to
@@ -180,10 +182,12 @@ class ShuffleManager:
         Without it the block commits immediately (legacy single-attempt
         writers: range exchange, mesh spill paths, tests)."""
         from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.obs import telemetry
 
         obs_events.emit("shuffle.write", shuffleId=shuffle_id,
                         reducePid=reduce_pid, bytes=table.nbytes,
                         staged=map_id is not None)
+        telemetry.record("shuffle", "shuffle.write", table.nbytes)
         if self.mode != "MULTITHREADED":
             from spark_rapids_tpu.runtime import host_alloc
 
@@ -447,6 +451,7 @@ class ShuffleManager:
 
     def fetch(self, shuffle_id: int, reduce_pid: int) -> List[pa.Table]:
         from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.obs import telemetry
         from spark_rapids_tpu.runtime.errors import ShuffleFetchError
 
         from spark_rapids_tpu.runtime import cancellation
@@ -469,6 +474,8 @@ class ShuffleManager:
             obs_events.emit("shuffle.fetch", shuffleId=shuffle_id,
                             reducePid=reduce_pid, blocks=len(out),
                             bytes=sum(t.nbytes for t in out))
+            telemetry.record("shuffle", "shuffle.fetch",
+                             sum(t.nbytes for t in out))
             return out
         with self._lock:
             fbs = list(self._files.get((shuffle_id, reduce_pid), []))
@@ -491,6 +498,8 @@ class ShuffleManager:
         obs_events.emit("shuffle.fetch", shuffleId=shuffle_id,
                         reducePid=reduce_pid, blocks=len(tables),
                         bytes=sum(t.nbytes for t in tables))
+        telemetry.record("shuffle", "shuffle.fetch",
+                         sum(t.nbytes for t in tables))
         return tables
 
     def remove_shuffle(self, shuffle_id: int):

@@ -374,9 +374,10 @@ def test_metrics_level_conf_threads_into_plans():
 # ------------------------------------------------------- overhead guard
 
 def test_obs_overhead_under_5pct_with_eventlog_disabled():
-    """With the event log off, the always-on bus + span builder must
-    cost <5% of query wall time (plus a small absolute allowance for
-    timer noise on shared CI hosts)."""
+    """With the event log off, the always-on bus + span builder + the
+    PR 6 transfer ledger (telemetry enabled, every H2D/D2H/shuffle site
+    recording) must cost <5% of query wall time (plus a small absolute
+    allowance for timer noise on shared CI hosts)."""
 
     def best_time(**conf):
         s = _session(**{"spark.sql.shuffle.partitions": 2, **conf})
@@ -392,11 +393,13 @@ def test_obs_overhead_under_5pct_with_eventlog_disabled():
         finally:
             s.stop()
 
-    t_off = best_time(**{"spark.rapids.tpu.obs.enabled": False})
-    t_on = best_time()
+    t_off = best_time(**{"spark.rapids.tpu.obs.enabled": False,
+                         "spark.rapids.tpu.telemetry.enabled": False})
+    t_on = best_time(**{"spark.rapids.tpu.obs.enabled": True,
+                        "spark.rapids.tpu.telemetry.enabled": True})
     assert t_on <= t_off * 1.05 + 0.05, (
-        f"obs overhead too high: {t_on:.4f}s with bus vs "
-        f"{t_off:.4f}s without")
+        f"obs+telemetry overhead too high: {t_on:.4f}s with bus+ledger "
+        f"vs {t_off:.4f}s without")
 
 
 def test_obs_disabled_session_emits_nothing():
