@@ -319,6 +319,53 @@ def _admission_probe(spark) -> dict:
         admission.install(old)
 
 
+def _sanitizer_probe(iters: int = 100) -> dict:
+    """Correctness-tooling probe: drive constructed two-query permit
+    cycles through a STANDALONE ConcurrencySanitizer (never installed
+    process-wide, so the session under measurement is untouched) and
+    time the closing-edge insertion — detection runs on edge insertion,
+    so that call IS detect + victim-select + cancel-dispatch. Reports
+    the unwind-dispatch p99, the detector counters, and the lint-rule
+    inventory the static gate enforces."""
+    from spark_rapids_tpu.runtime.cancellation import CancelToken
+    from spark_rapids_tpu.runtime.sanitizer import (
+        SEMAPHORE,
+        ConcurrencySanitizer,
+        quota_resource,
+    )
+    from spark_rapids_tpu.tools.lint.rules import all_rules
+
+    san = ConcurrencySanitizer()
+    quota = quota_resource()
+    lat_ms = []
+    for i in range(iters):
+        a, b = 2 * i, 2 * i + 1
+        tok = CancelToken(b)
+        san.acquired(SEMAPHORE, a)
+        san.acquired(quota, b)
+        ra = san.begin_wait(quota, a)
+        t0 = time.perf_counter()
+        rb = san.begin_wait(SEMAPHORE, b, token=tok)  # closes the cycle
+        lat_ms.append((time.perf_counter() - t0) * 1000)
+        assert tok.cancelled, "victim was not unwound"
+        san.end_wait(rb)
+        san.end_wait(ra)
+        san.released(quota, b)
+        san.released(SEMAPHORE, a)
+    san.check_clean()
+    lat_ms.sort()
+    snap = san.snapshot()
+    return {
+        "cyclesDetected": snap["cycles"],
+        "victims": snap["victims"],
+        "inversions": snap["inversions"],
+        "victimUnwindMsP99": round(
+            lat_ms[min(len(lat_ms) - 1,
+                       int(round(0.99 * (len(lat_ms) - 1))))], 4),
+        "lintRuleCount": len(all_rules()),
+    }
+
+
 def cold_probe():
     """--cold-probe: the warm-persistent-cache cold start. Runs in a
     FRESH process after the main bench warmed the compile cache, so it
@@ -575,6 +622,17 @@ def main():
     except Exception as e:  # never lose the perf report
         print(f"# obs block unavailable: {e!r}", flush=True)
 
+    # ---- concurrency-sanitizer block (runtime/sanitizer.py): cycle
+    # ---- detection + victim-unwind latency of constructed deadlocks
+    # ---- and the static-gate rule inventory — BENCH_r07+ tracks what
+    # ---- the correctness tooling costs and covers. Runs AFTER the
+    # ---- obs block so its probe events don't inflate eventCounts.
+    sanitizer_block = None
+    try:
+        sanitizer_block = _sanitizer_probe()
+    except Exception as e:  # never lose the perf report
+        print(f"# sanitizer block unavailable: {e!r}", flush=True)
+
     print(json.dumps({
         "metric": f"q5 join+agg engine throughput over device-cached"
                   f" tables ({dev.platform}, {ROWS} rows x {STORES}-row"
@@ -618,6 +676,9 @@ def main():
         # event/span attribution (obs/): top operators by device time,
         # span-tree depth, event volume — regression triage data
         "obs": obs_block,
+        # correctness tooling (PR 7): deadlock-cycle detection +
+        # victim-unwind latency, order-inversion audit, lint coverage
+        "sanitizer": sanitizer_block,
     }))
 
 

@@ -192,7 +192,107 @@ print("CONCURRENCY PASS")
 os._exit(0)
 PY
 
+echo "== sanitizer deadlock-recovery gate (per-operator concurrency) =="
+python - <<'PY'
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import os
+import tempfile
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+import spark_rapids_tpu.api.functions as F
+from spark_rapids_tpu.api.session import TpuSparkSession
+from spark_rapids_tpu.runtime import sanitizer
+from spark_rapids_tpu.runtime import semaphore as sem_mod
+from spark_rapids_tpu.runtime.errors import DeadlockDetectedError
+from spark_rapids_tpu.runtime.memory import get_catalog
+
+root = tempfile.mkdtemp(prefix="srtpu_deadlock_gate_")
+fact = os.path.join(root, "fact")
+os.makedirs(fact)
+rng = np.random.default_rng(7)
+N = 20_000
+pq.write_table(pa.table({
+    "k": pa.array(rng.integers(0, 50, N), pa.int64()),
+    "v": pa.array(rng.random(N) * 100.0),
+}), os.path.join(fact, "part-0.parquet"))
+
+
+def run_pair(extra_conf):
+    """Two concurrent queries with a forced CPU-fallback Filter +
+    repartition — the shape that WEDGED the device semaphore before
+    this PR (each query's fused scaffold held a permit chunk while its
+    nested per-operator collect starved on the other's). Returns
+    (completed, errors); asserts nobody hangs and nothing leaks."""
+    s = TpuSparkSession({
+        "spark.sql.shuffle.partitions": 4,
+        "spark.rapids.sql.exec.Filter": False,
+        **extra_conf,
+    })
+    results, errors = [], []
+
+    def worker(i):
+        try:
+            df = (s.read.parquet(fact)
+                  .filter(F.col("v") > 10.0)
+                  .repartition(4, "k").groupBy("k")
+                  .agg(F.sum("v").alias("sv")))
+            results.append((i, df.collect_arrow().num_rows))
+        except BaseException as e:
+            errors.append((i, e))
+
+    th = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    for t in th:
+        t.start()
+    for t in th:
+        t.join(120)
+    assert not any(t.is_alive() for t in th), \
+        "DEADLOCK: a per-operator query is still wedged"
+    assert sem_mod.get().holders() == 0, "leaked semaphore permits"
+    get_catalog().check_leaks(raise_on_leak=True)
+    s.stop()
+    return results, errors
+
+
+# 1. the root fix (atomic per-query permit groups, default on):
+#    the historical hang now completes outright
+results, errors = run_pair({})
+assert not errors, f"atomic-group path errored: {errors}"
+assert len(results) == 2, results
+print(f"atomic groups: both queries completed ({results})")
+
+# 2. the sanitizer backstop (legacy acquisition + wait-for graph):
+#    detected cycle, victim unwound leak-free, then either retried to
+#    completion or surfaced as a clean DeadlockDetectedError
+results, errors = run_pair({
+    "spark.rapids.tpu.semaphore.atomicQueryGroups": False,
+    "spark.rapids.tpu.sanitizer.enabled": True,
+})
+for _i, e in errors:
+    assert isinstance(e, DeadlockDetectedError), \
+        f"unexpected error class: {e!r}"
+    assert "wait-for cycle" in str(e), e
+assert len(results) + len(errors) == 2 and results, (results, errors)
+snap = sanitizer.counters()
+assert snap["cycles"] >= 1 and snap["victims"] >= 1, snap
+print(f"sanitizer backstop: {len(results)} completed, "
+      f"{len(errors)} clean deadlock error(s), "
+      f"cycles={snap['cycles']} victims={snap['victims']}")
+print("DEADLOCK RECOVERY PASS")
+os._exit(0)  # pre-existing XLA exit-time abort after session cycling
+PY
+
 echo "== targeted governance suite =="
 python -m pytest tests/test_admission.py -q -p no:cacheprovider
+
+echo "== sanitizer + lint suites =="
+python -m pytest tests/test_sanitizer.py tests/test_lint.py -q \
+    -p no:cacheprovider
 
 echo "CONCURRENCY GATE PASS"

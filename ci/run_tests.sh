@@ -9,6 +9,9 @@ cd "$(dirname "$0")/.."
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 export JAX_PLATFORMS=cpu
 
+echo "== static-analysis gate (srtpu-lint, zero findings) =="
+ci/static_check.sh
+
 echo "== unit + differential suite (virtual 8-device mesh) =="
 python -m pytest tests/ -q
 

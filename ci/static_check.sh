@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Static-analysis gate — srtpu-lint (spark_rapids_tpu/tools/lint) must
+# pass with ZERO findings on the tree: every spark.rapids.tpu.* conf
+# read registered AND documented, no raw time.sleep outside the
+# backoff/cancellation primitives, no unyielding blocking waits in
+# permit-holding modules, every byte-crossing site telemetry-ledgered,
+# every emitted event type schema-registered, no bare excepts.
+# The lint unit suite (fixture files per rule, positive + negative)
+# runs first so a broken rule can never green-light a dirty tree.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== lint-engine unit suite (per-rule fixtures) =="
+python -m pytest tests/test_lint.py -q -p no:cacheprovider
+
+echo "== srtpu-lint over the tree (zero findings required) =="
+python -m spark_rapids_tpu.tools.lint
+
+echo "STATIC CHECK PASS"

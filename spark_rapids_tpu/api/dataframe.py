@@ -609,6 +609,30 @@ class DataFrame:
         return pq.read_table(_io.BytesIO(blob))
 
     def collect_arrow(self) -> pa.Table:
+        from spark_rapids_tpu.config import rapids_conf as rc
+        from spark_rapids_tpu.runtime import admission
+        from spark_rapids_tpu.runtime.errors import (
+            DeadlockDetectedError,
+        )
+
+        try:
+            return self._collect_arrow_admitted()
+        except DeadlockDetectedError:
+            # this query was unwound as a deadlock victim
+            # (runtime/sanitizer.py): every permit/buffer/slot it held
+            # is released, so a single resubmission through admission
+            # serializes behind the cycle's survivors and completes.
+            # Only the OUTERMOST collect retries (a nested collect's
+            # error belongs to the outer query's token), and only once
+            # — a second cycle means something is systemically wedged
+            # and the caller should see it.
+            if admission.current_handle() is not None or \
+                    not self.session.rapids_conf.get(
+                        rc.SANITIZER_VICTIM_RETRY):
+                raise
+            return self._collect_arrow_admitted()
+
+    def _collect_arrow_admitted(self) -> pa.Table:
         # Engine-selection record (GpuOverrides NOT_ON_GPU diagnostics
         # discipline applied to whole-query engine dispatch): which
         # engine ran, and why each faster engine was skipped. Surfaced

@@ -260,7 +260,10 @@ class ColumnBatch:
 
     def row_count(self) -> int:
         if self._host_rows is None:
-            self._host_rows = int(jax.device_get(self.num_rows))
+            from spark_rapids_tpu.obs import telemetry
+
+            self._host_rows = int(telemetry.ledgered_get(
+                self.num_rows, "batch.rowCount"))
         return self._host_rows
 
     def live_mask(self) -> jnp.ndarray:

@@ -711,6 +711,43 @@ QUERY_PRIORITY = conf(
     "admits first; FIFO within a priority). Set per session, or per "
     "query via session.conf.set between submissions.", int,
     checker=lambda v: -1000 <= v <= 1000)
+SEMAPHORE_ATOMIC_QUERY_GROUPS = conf(
+    "spark.rapids.tpu.semaphore.atomicQueryGroups", True,
+    "Deadlock-free device-semaphore discipline: all permits a query "
+    "ever holds form ONE atomic group — the query's first acquire "
+    "waits ticket-FIFO for its permit chunk (holding nothing while it "
+    "waits), and every later acquire by the same query (nested stages, "
+    "sibling tasks) joins the group immediately instead of blocking "
+    "behind other queries' holds. Two concurrent queries can no "
+    "longer interleave partial holds into a wait cycle. false "
+    "restores the legacy per-task acquisition (deadlock-prone under "
+    "concurrent per-operator queries; the sanitizer is the only "
+    "backstop then).", bool)
+SANITIZER_ENABLED = conf(
+    "spark.rapids.tpu.sanitizer.enabled", False,
+    "Runtime concurrency sanitizer (runtime/sanitizer.py): maintains "
+    "a wait-for graph over the blocking resource classes (device "
+    "semaphore permits, per-query device-quota reservations, "
+    "admission slots), detects deadlock cycles on every edge "
+    "insertion, unwinds a victim query through the cancel machinery "
+    "with DeadlockDetectedError naming the cycle, and flags "
+    "permit/lock acquisition-order inversions even when they do not "
+    "deadlock this run. false short-circuits every hook to a "
+    "None-check.", bool)
+SANITIZER_VICTIM_POLICY = conf(
+    "spark.rapids.tpu.sanitizer.deadlock.victimPolicy", "youngest",
+    "Which query in a detected wait-for cycle the sanitizer unwinds: "
+    "'youngest' (highest query id — least work lost) or 'oldest' "
+    "(lowest query id).", str,
+    checker=lambda v: v in ("youngest", "oldest"))
+SANITIZER_VICTIM_RETRY = conf(
+    "spark.rapids.tpu.sanitizer.deadlock.retryVictim", True,
+    "After the sanitizer unwinds this query as a deadlock victim "
+    "(DeadlockDetectedError), the top-level collect resubmits it once "
+    "through admission — by then the cycle's survivors hold the "
+    "contested resources and the retry serializes behind them, so "
+    "both queries complete. false propagates the error to the "
+    "caller.", bool)
 QUOTA_DEVICE_BYTES_PER_QUERY = conf(
     "spark.rapids.tpu.quota.device.maxBytesPerQuery", 0,
     "Per-query cap on device-pool reservations (SpillCatalog tags "

@@ -1060,9 +1060,10 @@ def _murmur3_cpu(e: Murmur3Hash, t: pa.Table):
 
     refs = [BR(i, f.dataType) for i, f in enumerate(b.schema.fields)]
     col = MH(*refs, seed=e.seed).eval(EvalContext(b))
-    import jax
+    from spark_rapids_tpu.obs import telemetry
 
-    vals = np.asarray(jax.device_get(col.data))[:t.num_rows]
+    vals = np.asarray(telemetry.ledgered_get(
+        col.data, "cpu_eval.hashColumn"))[:t.num_rows]
     return pa.array(vals, type=pa.int32())
 
 
@@ -1515,9 +1516,10 @@ def _xxhash64_cpu(e: XxHash64, t: pa.Table):
     b = arrow_to_device(sub)
     refs = [BR(i, f.dataType) for i, f in enumerate(b.schema.fields)]
     col = XH(*refs, seed=e.seed).eval(EvalContext(b))
-    import jax
+    from spark_rapids_tpu.obs import telemetry
 
-    vals = np.asarray(jax.device_get(col.data))[:t.num_rows]
+    vals = np.asarray(telemetry.ledgered_get(
+        col.data, "cpu_eval.hashColumn"))[:t.num_rows]
     return pa.array(vals, type=pa.int64())
 
 

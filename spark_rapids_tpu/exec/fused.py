@@ -547,7 +547,10 @@ class FusedSingleChipExecutor:
                     phys, expansion, group_cap, as_parts=True,
                     defer_flags=True, use_lookup=use_lookup,
                     use_pushdown=use_pushdown)
-            host = jax.device_get(arr)  # one sync drains the pipeline
+            from spark_rapids_tpu.obs import telemetry as _tel
+
+            # one sync drains the pipeline
+            host = _tel.ledgered_get(arr, "fused.flags")
             dt = _time.perf_counter() - t0
             _check_host_flags(host, *ns)
             return dt / iters
@@ -618,6 +621,7 @@ class FusedSingleChipExecutor:
              group_cap: int, as_parts: bool = False,
              defer_flags: bool = False, use_lookup: bool = True,
              use_pushdown: bool = True):
+        from spark_rapids_tpu.obs import telemetry
         from spark_rapids_tpu.parallel.plan_compiler import (
             _plan_key,
             concat_traced,
@@ -1069,8 +1073,8 @@ class FusedSingleChipExecutor:
                 # benchmark path: caller syncs flags itself
                 return parts, arr, (n_ovf, n_uniq, n_push)
             # one host sync for overflow + ANSI; parts stay on device
-            _check_host_flags(jax.device_get(arr), n_ovf, n_uniq,
-                              n_push)
+            _check_host_flags(telemetry.ledgered_get(
+                arr, "fused.flags"), n_ovf, n_uniq, n_push)
             return parts
         if len(parts) > 1:
             def collect_fn(*ps):
@@ -1098,6 +1102,6 @@ class FusedSingleChipExecutor:
                               n_push)
             return table
         # one host sync for all flags before fetching results
-        _check_host_flags(jax.device_get(flags_arr), n_ovf, n_uniq,
-                          n_push)
+        _check_host_flags(telemetry.ledgered_get(
+            flags_arr, "fused.flags"), n_ovf, n_uniq, n_push)
         return device_to_arrow(result)

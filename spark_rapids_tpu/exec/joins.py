@@ -187,7 +187,10 @@ class _DeviceJoinBase(PhysicalPlan):
     def _conditional_equi_join(self, left: ColumnBatch,
                                bt: joinops.BuildTable,
                                lo, counts) -> ColumnBatch:
-        total = int(jax.device_get(jnp.sum(counts)))
+        from spark_rapids_tpu.obs import telemetry
+
+        total = int(telemetry.ledgered_get(jnp.sum(counts),
+                                           "join.counts"))
         cap = next_capacity(max(total, 1))
         pi, bi, _ = joinops.expand_gather_maps(lo, counts, cap)
         pair_live = jnp.arange(cap, dtype=jnp.int32) < total
@@ -218,13 +221,17 @@ class _DeviceJoinBase(PhysicalPlan):
         if jt in ("left", "full"):
             live = left.live_mask()
             eff_counts = jnp.where(live & (counts == 0), 1, counts)
-        total = int(jax.device_get(jnp.sum(eff_counts)))
+        from spark_rapids_tpu.obs import telemetry
+
+        total = int(telemetry.ledgered_get(jnp.sum(eff_counts),
+                                           "join.counts"))
         extra = 0
         matched_build = None
         if jt == "full":
             matched_build = self._matched_build_mask(bt, lo, counts)
-            extra = int(jax.device_get(
-                jnp.sum(~matched_build & bt.batch.live_mask())))
+            extra = int(telemetry.ledgered_get(
+                jnp.sum(~matched_build & bt.batch.live_mask()),
+                "join.counts"))
         cap_out = next_capacity(total + extra)
         pi, bi, _ = joinops.expand_gather_maps(lo, eff_counts, cap_out)
         lcols = [c.gather(pi) for c in left.columns]

@@ -2315,7 +2315,10 @@ class TpuGenerateExec(PhysicalPlan):
         arr = self.gen_alias.children[0].children[0].eval(ectx)
         counts = jnp.where(batch.live_mask() & arr.validity,
                            arr.lengths, 0).astype(jnp.int32)
-        total = int(jax.device_get(jnp.sum(counts)))
+        from spark_rapids_tpu.obs import telemetry
+
+        total = int(telemetry.ledgered_get(jnp.sum(counts),
+                                           "generate.counts"))
         cap_out = next_capacity(max(total, 1))
         row_bytes = batch.device_size_bytes() // max(1, batch.capacity)
         with get_catalog().reserved(cap_out * (row_bytes + 16),

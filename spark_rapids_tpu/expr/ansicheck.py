@@ -184,7 +184,8 @@ def raise_host(arith: bool, div: bool, cast: bool) -> None:
 
 
 def raise_if_set(flags) -> None:
-    import jax
+    from spark_rapids_tpu.obs import telemetry
 
-    arith, div, cast = (bool(x) for x in jax.device_get(flags))
+    arith, div, cast = (bool(x) for x in telemetry.ledgered_get(
+        flags, "ansi.flags"))
     raise_host(arith, div, cast)

@@ -61,6 +61,8 @@ EVENT_TYPES: Dict[str, str] = {
     "admission.cancelled": "queryId, reason, latencyMs",
     "admission.deadline": "queryId, reason, latencyMs",
     "admission.quarantined": "queryId, reason, crashes",
+    "sanitizer.deadlock": "cycle, victim, policy",
+    "sanitizer.inversion": "first, second, detail",
 }
 
 #: Envelope keys present on EVERY event (eventlog validation contract).

@@ -82,6 +82,17 @@ def render(session=None) -> str:
                 lines.append(
                     f"{mname}{{{_labels(site=site)}}} "
                     f"{_fmt_value(chaos[site].get(field, 0))}")
+    # sanitizer counters as first-class *_total families (they also
+    # appear under srtpu_robustness_sanitizer_* via the flatten above;
+    # these are the stable names dashboards alert on)
+    from spark_rapids_tpu.runtime import sanitizer as _san
+
+    for field, value in sorted(_san.counters().items()):
+        if field == "enabled":
+            continue
+        mname = f"{PREFIX}_sanitizer_{field}_total"
+        lines.append(f"# TYPE {mname} gauge")
+        lines.append(f"{mname} {_fmt_value(value)}")
     lines.extend(_telemetry_lines())
     return "\n".join(lines) + "\n"
 

@@ -24,6 +24,7 @@ def robustness_snapshot() -> dict:
     semaphore timeouts. Key layout is pinned by existing tests."""
     from spark_rapids_tpu.runtime import admission as _adm
     from spark_rapids_tpu.runtime import backoff, degrade, faults
+    from spark_rapids_tpu.runtime import sanitizer as _san
     from spark_rapids_tpu.runtime import scheduler as _sched
     from spark_rapids_tpu.runtime import semaphore as sem
     from spark_rapids_tpu.runtime.compile_cache import stats
@@ -40,6 +41,7 @@ def robustness_snapshot() -> dict:
         "scheduler": _sched.stats.snapshot(),
         "degrade": degrade.counters(),
         "admission": _adm.stats.snapshot(),
+        "sanitizer": _san.counters(),
         "artifactsQuarantined":
             stats.snapshot()["artifactsQuarantined"],
         "semaphoreTimeouts": sem.get().timeouts,

@@ -111,8 +111,12 @@ def qualification(source: Source) -> str:
 # ---------------------------------------------------------------- profile
 
 def profile_data(source: Source, top_n: int = 10) -> dict:
-    """Structured profile of the (last) query in `source`."""
-    events = _last_query(_events_from(source))
+    """Structured profile of the (last) query in `source`. Sanitizer
+    verdicts are the exception to last-query scoping: a wait-for cycle
+    spans queries by construction (and the retried victim finishes
+    LAST), so the audit section aggregates over the whole source."""
+    all_events = _events_from(source)
+    events = _last_query(all_events)
     tree = _tree_for(events)
     totals = _spans.operator_totals(tree)
     top = sorted(totals.items(), key=lambda kv: -kv[1]["deviceNs"])
@@ -125,6 +129,8 @@ def profile_data(source: Source, top_n: int = 10) -> dict:
                 "discarded": 0, "lost": 0, "failed": 0,
                 "degradations": 0, "chaosInjections": 0}
     movement: Dict[str, Dict[str, int]] = {}
+    sanitizer = {"deadlocks": 0, "inversions": 0, "victims": 0,
+                 "lastCycle": None}
     telemetry_summary = None
     for ev in events:
         et = ev["event"]
@@ -175,6 +181,15 @@ def profile_data(source: Source, top_n: int = 10) -> dict:
                 ("bytesMoved", "bytesMovedTotal", "hbmPeakBytes",
                  "rooflineFrac", "linkFrac", "bytesPerOutputRow",
                  "wallMs") if ev.get(k) is not None}
+    for ev in all_events:
+        et = ev["event"]
+        if et == "sanitizer.deadlock":
+            sanitizer["deadlocks"] += 1
+            if ev.get("victim") is not None:
+                sanitizer["victims"] += 1
+            sanitizer["lastCycle"] = ev.get("cycle")
+        elif et == "sanitizer.inversion":
+            sanitizer["inversions"] += 1
     served = compile_c["hit"] + compile_c["warm"]
     requests = served + compile_c["miss"]
     return {
@@ -190,6 +205,7 @@ def profile_data(source: Source, top_n: int = 10) -> dict:
                     "cacheServedRatio": (served / requests
                                          if requests else None)},
         "recovery": recovery,
+        "sanitizer": sanitizer,
         "dataMovement": movement,
         "telemetry": telemetry_summary,
     }
@@ -228,6 +244,17 @@ def profile(source: Source, top_n: int = 10) -> str:
                  f"speculated, {r['discarded']} discarded, "
                  f"{r['degradations']} degradation(s), "
                  f"{r['chaosInjections']} chaos injection(s)")
+    sz = d["sanitizer"]
+    if sz["deadlocks"] or sz["inversions"]:
+        lines.append(
+            f"sanitizer: {sz['deadlocks']} deadlock cycle(s) "
+            f"detected, {sz['victims']} victim(s) unwound, "
+            f"{sz['inversions']} order inversion(s)")
+        if sz["lastCycle"]:
+            rows = "; ".join(
+                f"query {r['queryId']} waits on {r['waitsOn']}"
+                for r in sz["lastCycle"])
+            lines.append(f"  last cycle: {rows}")
     if d["dataMovement"]:
         parts = [f"{dd} {v['bytes']} B/{v['count']} transfer(s)"
                  for dd, v in sorted(d["dataMovement"].items())]

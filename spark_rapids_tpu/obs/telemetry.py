@@ -296,6 +296,47 @@ hbm_pressure = ledger.hbm_pressure
 query_summary = ledger.query_summary
 
 
+def _tree_bytes(x) -> int:
+    """Total byte size of a jax pytree's array leaves (0 for leaves
+    without nbytes — python scalars ride along for free)."""
+    import jax
+
+    return sum(getattr(leaf, "nbytes", 0)
+               for leaf in jax.tree_util.tree_leaves(x))
+
+
+def ledgered_put(x, site: str, device=None):
+    """`jax.device_put` with the crossing ledgered — the wrapper the
+    raw-transfer lint rule (tools/lint) steers every H2D site through
+    when it is not already inside an instrumented function."""
+    import time as _time
+
+    import jax
+
+    nbytes = _tree_bytes(x)
+    t0 = _time.monotonic_ns()
+    out = jax.device_put(x) if device is None \
+        else jax.device_put(x, device)
+    record("h2d", site, nbytes, ns=_time.monotonic_ns() - t0)
+    return out
+
+
+def ledgered_get(x, site: str):
+    """`jax.device_get` with the crossing ledgered; covers everything
+    from full-column D2H pulls down to the scalar syncs (row counts,
+    ANSI flags) that would otherwise leak out of the movement
+    accounting."""
+    import time as _time
+
+    import jax
+
+    t0 = _time.monotonic_ns()
+    out = jax.device_get(x)
+    record("d2h", site, _tree_bytes(out),
+           ns=_time.monotonic_ns() - t0)
+    return out
+
+
 def configure(conf=None) -> None:
     """Session-lifecycle hook: honor spark.rapids.tpu.telemetry.enabled
     (counters persist across sessions like every process ledger)."""

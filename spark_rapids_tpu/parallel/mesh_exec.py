@@ -58,12 +58,16 @@ def shard_batch(mesh: Mesh, batch: ColumnBatch) -> ColumnBatch:
     per_shard = np.clip(global_rows - np.arange(n) * shard_cap, 0,
                         shard_cap).astype(np.int32)
 
+    from spark_rapids_tpu.obs import telemetry
+
     def put_rows(leaf):
-        return jax.device_put(leaf, NamedSharding(mesh, P(AXIS)))
+        return telemetry.ledgered_put(
+            leaf, "mesh.shard", device=NamedSharding(mesh, P(AXIS)))
 
     cols = jax.tree_util.tree_map(put_rows, tuple(batch.columns))
-    counts = jax.device_put(jnp.asarray(per_shard),
-                            NamedSharding(mesh, P(AXIS)))
+    counts = telemetry.ledgered_put(
+        jnp.asarray(per_shard), "mesh.shard",
+        device=NamedSharding(mesh, P(AXIS)))
     return ColumnBatch(batch.schema, list(cols), counts)
 
 
@@ -130,7 +134,10 @@ def make_distributed_agg(mesh: Mesh, template: ColumnBatch,
         out, overflow = jitted(sharded_batch)
         import numpy as onp
 
-        if bool(onp.asarray(jax.device_get(overflow)).any()):
+        from spark_rapids_tpu.obs import telemetry
+
+        if bool(onp.asarray(telemetry.ledgered_get(
+                overflow, "mesh.overflow")).any()):
             from spark_rapids_tpu.runtime.errors import TpuSplitAndRetryOOM
 
             raise TpuSplitAndRetryOOM(
@@ -181,7 +188,9 @@ def fetch_host(x) -> np.ndarray:
     of remote blocks (RapidsShuffleClient.scala:174), expressed as an
     XLA collective instead of a socket protocol."""
     if getattr(x, "is_fully_addressable", True):
-        return np.asarray(jax.device_get(x))
+        from spark_rapids_tpu.obs import telemetry
+
+        return np.asarray(telemetry.ledgered_get(x, "mesh.result"))
     from jax.experimental import multihost_utils
 
     return np.asarray(multihost_utils.process_allgather(x, tiled=True))

@@ -454,7 +454,10 @@ class MeshQueryExecutor:
         local_devs = [devs[s] for s in local_ids]
 
         def assemble(leaves_per_shard, global_shape):
-            singles = [jax.device_put(leaf, d)
+            from spark_rapids_tpu.obs import telemetry
+
+            singles = [telemetry.ledgered_put(leaf, "mesh.assemble",
+                                              device=d)
                        for leaf, d in zip(leaves_per_shard, local_devs)]
             return jax.make_array_from_single_device_arrays(
                 global_shape, sharding, singles)

@@ -85,7 +85,7 @@ class TpuExecutorPlugin:
     def init(self, conf: rc.RapidsConf):
         from spark_rapids_tpu.io import filecache
         from spark_rapids_tpu.runtime import admission, compile_cache, \
-            degrade, faults, memory, semaphore
+            degrade, faults, memory, sanitizer, semaphore
         from spark_rapids_tpu.shuffle.manager import configure_shuffle
 
         self._validate_device()
@@ -96,6 +96,9 @@ class TpuExecutorPlugin:
         # query governance front door (admission queue + cancel
         # registry) — after faults so admission.slow_drain is armed
         admission.configure(conf)
+        # concurrency sanitizer BEFORE the semaphore so the very first
+        # acquire is already under wait-for-graph surveillance
+        sanitizer.configure(conf)
         filecache.configure(conf)  # FileCache.init (Plugin.scala:545)
         # persistent compilation layer BEFORE any program compiles, so
         # the whole session (incl. warmup) rides the disk cache
@@ -103,7 +106,9 @@ class TpuExecutorPlugin:
         memory.initialize_memory(conf, force=True)
         semaphore.initialize(
             conf.get(rc.CONCURRENT_TPU_TASKS),
-            conf.get(rc.SEMAPHORE_ACQUIRE_TIMEOUT_MS))
+            conf.get(rc.SEMAPHORE_ACQUIRE_TIMEOUT_MS),
+            atomic_query_groups=conf.get(
+                rc.SEMAPHORE_ATOMIC_QUERY_GROUPS))
         configure_shuffle(
             conf.get(rc.SHUFFLE_MODE),
             shuffle_dir=conf.get(rc.SPILL_DIR) or None,

@@ -216,11 +216,16 @@ class AdmissionController:
         handle = QueryHandle(query_id, token, priority, description)
         stats.add("queriesSubmitted")
         if not self.enabled:
+            from spark_rapids_tpu.runtime import sanitizer as _san
+
             with self._cv:
                 handle.state = "running"
                 handle.admitted_at = time.monotonic()
                 self._running[query_id] = handle
             stats.add("queriesAdmitted")
+            san = _san.active()
+            if san is not None:
+                san.acquired(_san.ADMISSION, query_id)
             return handle
         with self._cv:
             if len(self._running) < self.max_concurrent and \
@@ -250,12 +255,25 @@ class AdmissionController:
                 self._cv.notify_all()
 
         token.on_cancel(wake)
+        # wait-for edge: this queued query waits on the slot class held
+        # by every running query (runtime/sanitizer.py); a cycle
+        # through admission can only close via another resource class,
+        # but the edge makes the full wedge visible when it does
+        from spark_rapids_tpu.runtime import sanitizer as _san
+
+        san = _san.active()
+        wait_rec = None
+        if san is not None:
+            wait_rec = san.begin_wait(_san.ADMISSION, query_id,
+                                      token=token, wake=wake)
         queue_deadline = (
             None if self.queue_timeout_ms <= 0
             else time.monotonic() + self.queue_timeout_ms / 1000.0)
         try:
             with self._cv:
                 while True:
+                    if wait_rec is not None:
+                        wait_rec.check()  # deadlock-victim exit
                     if token.cancelled or token.expired:
                         self._drop_queued_locked(query_id)
                         token.check()  # raises (turns expiry into cancel)
@@ -292,6 +310,8 @@ class AdmissionController:
                 self._cv.notify_all()  # a new front may now be eligible
             raise
         finally:
+            if wait_rec is not None:
+                san.end_wait(wait_rec)
             token.remove_on_cancel(wake)
 
     def _front_locked(self) -> Optional[int]:
@@ -310,6 +330,7 @@ class AdmissionController:
 
     def _admit_locked(self, handle: QueryHandle) -> None:
         from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.runtime import sanitizer as _san
 
         handle.state = "running"
         handle.admitted_at = time.monotonic()
@@ -318,6 +339,9 @@ class AdmissionController:
         self._running[handle.query_id] = handle
         stats.add("queriesAdmitted")
         stats.record_wait(handle.queue_wait_ms)
+        san = _san.active()
+        if san is not None:
+            san.acquired(_san.ADMISSION, handle.query_id)
         obs_events.emit("admission.admitted", queryId=handle.query_id,
                         waitMs=handle.queue_wait_ms)
 
@@ -327,7 +351,7 @@ class AdmissionController:
         """Release the slot and hand it to the next queued query.
         `status`: ok | error | cancelled | deadline | quarantined."""
         from spark_rapids_tpu.obs import events as obs_events
-        from spark_rapids_tpu.runtime import faults
+        from spark_rapids_tpu.runtime import cancellation, faults
 
         token = handle.token
         if status == "ok" and \
@@ -360,7 +384,15 @@ class AdmissionController:
                             crashes=len(token.crashes))
         slow = faults.should_inject("admission.slow_drain")
         if slow:
-            time.sleep(0.02)  # delayed handoff (never under the lock)
+            # delayed handoff (never under the lock); interruptible so
+            # a cancelled query's unwind never rides out chaos latency
+            # (lint rule raw-sleep)
+            cancellation.sleep_interruptible(0.02)
+        from spark_rapids_tpu.runtime import sanitizer as _san
+
+        san = _san.active()
+        if san is not None and handle.state == "running":
+            san.released(_san.ADMISSION, handle.query_id)
         with self._cv:
             handle.state = "done"
             handle.finished_at = time.monotonic()

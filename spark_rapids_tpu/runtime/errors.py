@@ -125,6 +125,16 @@ class QueryCancelledError(QueryGovernanceError):
     latency, releasing permits and spill-catalog buffers."""
 
 
+class DeadlockDetectedError(QueryCancelledError):
+    """The concurrency sanitizer (runtime/sanitizer.py) found this
+    query in a wait-for cycle and selected it as the victim: the
+    message names the full cycle (query ids, the resources each holds
+    and waits on, hold durations). Cancellation semantics — the victim
+    unwinds at its next yield point releasing every permit and buffer —
+    and the collect path may transparently retry it once the cycle's
+    survivors drain (sanitizer.deadlock.retryVictim)."""
+
+
 class QueryDeadlineExceeded(QueryCancelledError):
     """The query ran past spark.rapids.tpu.query.timeoutMs (queue wait
     counts); cancellation semantics, with the deadline in the message."""

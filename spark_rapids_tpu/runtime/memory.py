@@ -25,6 +25,7 @@ import contextlib
 import os
 import tempfile
 import threading
+import time
 import uuid
 import zipfile
 from enum import Enum
@@ -392,6 +393,28 @@ class SpillCatalog:
             f"query {qid} device quota {quota} cannot fit {nbytes} "
             f"(tag={tag}, reserved={cur}); split the input and retry")
 
+    def _note_quota_contention(self, qid: int) -> None:
+        """Sanitizer hook at a failed reservation: sync the quota
+        resource's holder set from the per-query ledger, then insert
+        the transient wait-for edge (cycle detection runs on the
+        insertion). A query spinning in TpuRetryOOM because OTHER
+        queries' reservations fill the device is waiting on them just
+        as surely as a parked semaphore ticket — this is what closes
+        cross-class cycles (hold permits, wait memory / hold memory,
+        wait permits)."""
+        from spark_rapids_tpu.runtime import sanitizer as _san
+
+        san = _san.active()
+        if san is None:
+            return
+        now = time.monotonic()
+        with self._q_lock:
+            owners = {q: now for q, b in self._q_dev.items()
+                      if b > 0 and q != qid}
+        res = _san.quota_resource()
+        san.report_holders(res, owners)
+        san.note_contention(res, qid)
+
     def reserve(self, nbytes: int, tag: str = "",
                 query_id: Optional[int] = None):
         """Reserve device bytes; spill synchronously if needed; raise
@@ -411,6 +434,7 @@ class SpillCatalog:
         if self.pool.try_reserve(nbytes):
             self._q_add(qid, nbytes)
             return
+        self._note_quota_contention(qid)
         if freed > 0:
             raise TpuRetryOOM(
                 f"device pool exhausted reserving {nbytes} (tag={tag}); "
@@ -435,11 +459,23 @@ class SpillCatalog:
         surfaces at a retryable point. The owning query is captured at
         entry so the exit releases the same ledger even if the thread's
         scopes changed."""
+        from spark_rapids_tpu.runtime import sanitizer as _san
+
         qid = self._resolve_qid(None)
         self.reserve(nbytes, tag=tag, query_id=qid)
+        # acquisition-order history: a scoped reservation is a held
+        # resource of class "quota" for the sanitizer's lock-order
+        # audit (e.g. taking semaphore permits while inside one is the
+        # inversion of the usual permits-then-memory order)
+        san = _san.active()
+        res = _san.quota_resource("scoped")
+        if san is not None:
+            san.acquired(res, qid)
         try:
             yield
         finally:
+            if san is not None:
+                san.released(res, qid)
             self.release(nbytes, query_id=qid)
 
     def spill_device_bytes(self, target: int,

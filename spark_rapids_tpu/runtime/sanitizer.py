@@ -1,0 +1,428 @@
+"""Runtime concurrency sanitizer — wait-for-graph deadlock detection,
+victim unwind, and permit acquisition-order auditing.
+
+The engine has three blocking resource classes a query can hold while
+waiting on another: device-semaphore permit chunks
+(runtime/semaphore.py), per-query device-quota reservations
+(runtime/memory.py SpillCatalog), and admission slots
+(runtime/admission.py). A cycle across them is a silent process wedge —
+exactly the failure class an interactive-concurrency accelerator
+service cannot tolerate ("Accelerating Presto with GPUs", PAPERS.md),
+and exactly what two concurrent per-operator queries used to do to the
+semaphore before the atomic-query-group fix.
+
+Design (conf-gated by `spark.rapids.tpu.sanitizer.enabled`):
+
+- **Holders registry**: every instrumented acquire/release reports
+  (resource, owner query id, timestamp); the sanitizer never guesses at
+  ownership from the outside.
+- **Wait-for graph**: every instrumented blocking wait registers an
+  edge `waiter -> resource` before parking; resources map to their
+  holders, so the graph walked for cycles is
+  waiter -> resource -> holder -> (resource that holder waits on) -> …
+  Cycle detection runs ON EDGE INSERTION — a deadlock is detected the
+  moment the closing edge appears, not by a watchdog poll.
+- **Victim unwind**: on a cycle, one WAITING member is selected by
+  `sanitizer.deadlock.victimPolicy` (youngest query id by default) and
+  unwound through the existing cancel machinery: its CancelToken is
+  cancelled with DeadlockDetectedError naming the full cycle, which
+  wakes the parked wait (semaphore waits register on_cancel wakeups)
+  and rides every PR-5 yield point out of execution, releasing permits
+  and spill-catalog buffers leak-free. Waits without a token fall back
+  to a `victim_error` flag + wake callback on the wait record itself.
+- **Order history**: independent of deadlocks, the sanitizer records
+  the global order in which resource CLASSES are acquired while others
+  are held (per-thread hold stacks) and flags an INVERSION the first
+  time both A-before-B and B-before-A are observed — the lock-order
+  lint that catches tomorrow's deadlock in today's clean run.
+
+Observability: `sanitizer.deadlock` / `sanitizer.inversion` obs events,
+counters in `session.robustness_metrics["sanitizer"]`, Prometheus
+`srtpu_sanitizer_{cycles,inversions,victims}_total`, and a line in
+`report.profile()` so recoveries land in the audit trail.
+
+Disabled mode is a None-check: `active()` returns None and no hook
+touches a lock or allocates.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from spark_rapids_tpu.runtime.errors import DeadlockDetectedError
+
+#: Resource identity: (class, key). Classes are the three blocking
+#: families; key distinguishes instances within a class.
+Resource = Tuple[str, str]
+
+SEMAPHORE: Resource = ("semaphore", "device")
+ADMISSION: Resource = ("admission", "slots")
+
+
+def quota_resource(pool: str = "device") -> Resource:
+    return ("quota", pool)
+
+
+class WaitRecord:
+    """One parked (or spinning) wait: who waits, on what, since when,
+    how to wake it, and — when victimized without a CancelToken — the
+    error its wait loop must raise."""
+
+    __slots__ = ("owner", "resource", "since", "token", "wake",
+                 "victim_error", "soft")
+
+    def __init__(self, owner: int, resource: Resource, token=None,
+                 wake: Optional[Callable[[], None]] = None,
+                 soft: bool = False):
+        self.owner = owner
+        self.resource = resource
+        self.since = time.monotonic()
+        self.token = token
+        self.wake = wake
+        self.victim_error: Optional[BaseException] = None
+        self.soft = soft  # retry-loop contention, not a parked thread
+
+    def check(self) -> None:
+        """Called by the instrumented wait loop on every wakeup: a
+        victimized token-less waiter leaves through here."""
+        if self.victim_error is not None:
+            raise self.victim_error
+
+
+class _Counters:
+    def __init__(self):
+        self.cycles = 0
+        self.inversions = 0
+        self.victims = 0
+
+
+class ConcurrencySanitizer:
+    """Process-wide wait-for graph + acquisition-order history."""
+
+    def __init__(self, victim_policy: str = "youngest"):
+        self.victim_policy = victim_policy
+        self._lock = threading.Lock()
+        # resource -> {owner qid -> (hold count, first-held ts)}
+        self._holders: Dict[Resource, Dict[int, Tuple[int, float]]] = {}
+        # owner qid -> live WaitRecords (one thread each, but a query's
+        # pool threads can park on several resources at once)
+        self._waits: Dict[int, List[WaitRecord]] = {}
+        self._tls = threading.local()
+        # acquisition-order history over resource classes:
+        # first-observed edges {(before_cls, after_cls)}, inversions
+        # reported once per unordered pair
+        self._order_edges: Set[Tuple[str, str]] = set()
+        self._inverted_pairs: Set[Tuple[str, str]] = set()
+        self.counters = _Counters()
+        self.last_cycle: Optional[List[dict]] = None
+
+    # ------------------------------------------------------- holders
+
+    def _held_stack(self) -> List[Resource]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def acquired(self, resource: Resource, owner: int) -> None:
+        """Record one granted hold of `resource` by query `owner` and
+        update the global acquisition-order history."""
+        inversion = None
+        with self._lock:
+            holds = self._holders.setdefault(resource, {})
+            n, since = holds.get(owner, (0, time.monotonic()))
+            holds[owner] = (n + 1, since)
+            # order history: per-THREAD stack — order is a property of
+            # one control flow, not of the whole query
+            stack = self._held_stack()
+            for held in stack:
+                if held[0] != resource[0]:
+                    inversion = self._note_order_locked(held[0],
+                                                        resource[0])
+            stack.append(resource)
+        if inversion is not None:
+            self._emit_inversion(*inversion)
+
+    def released(self, resource: Resource, owner: int) -> None:
+        with self._lock:
+            holds = self._holders.get(resource)
+            if holds and owner in holds:
+                n, since = holds[owner]
+                if n <= 1:
+                    del holds[owner]
+                else:
+                    holds[owner] = (n - 1, since)
+            stack = self._held_stack()
+            if resource in stack:
+                # remove the most recent hold of this resource
+                for i in range(len(stack) - 1, -1, -1):
+                    if stack[i] == resource:
+                        del stack[i]
+                        break
+
+    def holders(self, resource: Resource) -> Dict[int, Tuple[int, float]]:
+        with self._lock:
+            return dict(self._holders.get(resource, {}))
+
+    def report_holders(self, resource: Resource,
+                       owners: Dict[int, float]) -> None:
+        """Sync a SOFT resource's holder set from its authoritative
+        external ledger (e.g. the SpillCatalog per-query reservation
+        map) — used by retry-loop resources where per-reservation
+        acquire/release hooks would churn the hot path; callers sync
+        right before `note_contention`, so the graph is fresh exactly
+        when a cycle could close."""
+        with self._lock:
+            self._holders[resource] = {
+                q: (1, ts) for q, ts in owners.items()}
+
+    # ------------------------------------------------- order history
+
+    def _note_order_locked(self, before: str, after: str):
+        """Under _lock: record `before acquired-before after`; return
+        the pair when this completes an inversion (both directions now
+        observed), else None."""
+        edge = (before, after)
+        if edge in self._order_edges:
+            return None
+        self._order_edges.add(edge)
+        if (after, before) in self._order_edges:
+            pair = tuple(sorted((before, after)))
+            if pair not in self._inverted_pairs:
+                self._inverted_pairs.add(pair)
+                self.counters.inversions += 1
+                return (before, after)
+        return None
+
+    def _emit_inversion(self, before: str, after: str) -> None:
+        from spark_rapids_tpu.obs import events as obs_events
+
+        obs_events.emit("sanitizer.inversion", first=after,
+                        second=before,
+                        detail=f"resource classes acquired in both "
+                               f"orders: {after}->{before} and "
+                               f"{before}->{after}")
+
+    def order_history(self) -> Set[Tuple[str, str]]:
+        with self._lock:
+            return set(self._order_edges)
+
+    def inversions(self) -> Set[Tuple[str, str]]:
+        with self._lock:
+            return set(self._inverted_pairs)
+
+    # --------------------------------------------------------- waits
+
+    def begin_wait(self, resource: Resource, owner: int, token=None,
+                   wake: Optional[Callable[[], None]] = None,
+                   soft: bool = False) -> WaitRecord:
+        """Insert the wait-for edge `owner -> resource` and run cycle
+        detection. Returns the WaitRecord the instrumented wait loop
+        must `check()` on wakeups and pass to `end_wait` when done.
+        When the inserted edge closes a cycle the victim is unwound
+        BEFORE this returns — a deadlock never outlives the edge
+        insertion that completed it."""
+        if token is None:
+            from spark_rapids_tpu.runtime import cancellation
+
+            token = cancellation.current()
+        rec = WaitRecord(owner, resource, token=token, wake=wake,
+                         soft=soft)
+        with self._lock:
+            self._waits.setdefault(owner, []).append(rec)
+            cycle = self._find_cycle_locked(owner)
+        if cycle:
+            self._on_cycle(cycle)
+        return rec
+
+    def end_wait(self, rec: WaitRecord) -> None:
+        with self._lock:
+            lst = self._waits.get(rec.owner)
+            if lst and rec in lst:
+                lst.remove(rec)
+                if not lst:
+                    del self._waits[rec.owner]
+
+    def note_contention(self, resource: Resource, owner: int,
+                        token=None) -> None:
+        """Soft wait for retry-loop resources (the quota/pool classes
+        raise TpuRetryOOM and spin rather than parking): insert the
+        edge + cycle-check once, then remove it — the loop re-notes on
+        every failed attempt, so a real cycle re-closes immediately
+        while a transient squeeze leaves no residue."""
+        rec = self.begin_wait(resource, owner, token=token, soft=True)
+        self.end_wait(rec)
+
+    # ---------------------------------------------------- cycle hunt
+
+    def _find_cycle_locked(self, start: int) -> Optional[List[dict]]:
+        """DFS from `start` over waiter -> holders(resource waited on).
+        Returns the cycle as rows of {queryId, resource, heldFor} or
+        None. Runs under _lock; the graph is small (live queries)."""
+        path: List[Tuple[int, Resource]] = []
+        on_path: Set[int] = set()
+
+        def dfs(q: int) -> Optional[int]:
+            on_path.add(q)
+            for rec in self._waits.get(q, ()):  # noqa: B020
+                holds = self._holders.get(rec.resource, {})
+                for holder in holds:
+                    if holder == q:
+                        continue
+                    path.append((q, rec.resource))
+                    if holder in on_path:
+                        path.append((holder, rec.resource))
+                        return holder
+                    got = dfs(holder)
+                    if got is not None:
+                        return got
+                    path.pop()
+            on_path.discard(q)
+            return None
+
+        anchor = dfs(start)
+        if anchor is None:
+            return None
+        # trim the path to the cycle proper (drop any lead-in)
+        idx = next(i for i, (q, _r) in enumerate(path) if q == anchor)
+        now = time.monotonic()
+        rows = []
+        for q, res in path[idx:]:
+            holds = {r: h[q] for r, h in self._holders.items()
+                     if q in h}
+            held_for = max((now - since for _n, since in
+                            holds.values()), default=0.0)
+            rows.append({
+                "queryId": q,
+                "waitsOn": f"{res[0]}:{res[1]}",
+                "holds": sorted(f"{r[0]}:{r[1]}" for r in holds),
+                "heldForS": round(held_for, 3),
+            })
+        # drop the duplicated anchor row at the end
+        if len(rows) > 1 and rows[-1]["queryId"] == rows[0]["queryId"]:
+            rows.pop()
+        return rows
+
+    # ------------------------------------------------ victim unwind
+
+    def _on_cycle(self, cycle: List[dict]) -> None:
+        from spark_rapids_tpu.obs import events as obs_events
+
+        with self._lock:
+            self.counters.cycles += 1
+            self.last_cycle = cycle
+            victim_rec = self._pick_victim_locked(cycle)
+        desc = "; ".join(
+            f"query {r['queryId']} holds {r['holds']} waits on "
+            f"{r['waitsOn']} (held {r['heldForS']}s)" for r in cycle)
+        obs_events.emit(
+            "sanitizer.deadlock",
+            cycle=cycle,
+            victim=victim_rec.owner if victim_rec else None,
+            policy=self.victim_policy)
+        if victim_rec is None:
+            return  # nothing unwindable: surfaced, counted, not fixed
+        with self._lock:
+            self.counters.victims += 1
+        err = DeadlockDetectedError(
+            f"query {victim_rec.owner} unwound as deadlock victim "
+            f"(policy={self.victim_policy}); wait-for cycle: [{desc}]")
+        if victim_rec.token is not None:
+            victim_rec.token.cancel(
+                f"deadlock victim (policy={self.victim_policy}); "
+                f"wait-for cycle: [{desc}]",
+                DeadlockDetectedError)
+        else:
+            victim_rec.victim_error = err
+        if victim_rec.wake is not None:
+            try:
+                victim_rec.wake()
+            except Exception:
+                pass  # a wake failure must not poison the detector
+
+    def _pick_victim_locked(self, cycle: List[dict]
+                            ) -> Optional[WaitRecord]:
+        """Among the cycle's members that are actually WAITING (only a
+        parked wait can be unwound), pick per policy; members whose
+        wait cannot be interrupted (no token, no wake, soft) lose the
+        election to ones that can."""
+        members = [r["queryId"] for r in cycle]
+        ordered = sorted(members,
+                         reverse=(self.victim_policy == "youngest"))
+        best: Optional[WaitRecord] = None
+        for q in ordered:
+            for rec in self._waits.get(q, ()):
+                if rec.token is not None or rec.wake is not None \
+                        or not rec.soft:
+                    return rec
+                if best is None:
+                    best = rec
+        return best
+
+    # -------------------------------------------------- diagnostics
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "cycles": self.counters.cycles,
+                "inversions": self.counters.inversions,
+                "victims": self.counters.victims,
+                "waiting": sum(len(v) for v in self._waits.values()),
+                "trackedResources": len(self._holders),
+            }
+
+    def check_clean(self) -> None:
+        """Test helper: assert no residual waits or holds."""
+        with self._lock:
+            live_holds = {r: h for r, h in self._holders.items() if h}
+            assert not self._waits, f"residual waits: {self._waits}"
+            assert not live_holds, f"residual holds: {live_holds}"
+
+
+# ---------------------------------------------------- process wiring
+
+_instance: Optional[ConcurrencySanitizer] = None
+_lock = threading.Lock()
+
+
+def active() -> Optional[ConcurrencySanitizer]:
+    """The enabled process sanitizer, or None — every hook site is
+    `san = sanitizer.active()` + a None-check, so disabled mode costs
+    one global load per instrumented operation."""
+    return _instance
+
+
+def install(san: Optional[ConcurrencySanitizer]
+            ) -> Optional[ConcurrencySanitizer]:
+    global _instance
+    with _lock:
+        _instance = san
+    return san
+
+
+def configure(conf=None) -> Optional[ConcurrencySanitizer]:
+    """Session-lifecycle hook (plugin.py executor init): build or tear
+    down the process sanitizer from spark.rapids.tpu.sanitizer.*."""
+    from spark_rapids_tpu.config import rapids_conf as rc
+
+    def get_(entry):
+        return conf.get(entry) if conf is not None else entry.default
+
+    if not get_(rc.SANITIZER_ENABLED):
+        return install(None)
+    return install(ConcurrencySanitizer(
+        victim_policy=get_(rc.SANITIZER_VICTIM_POLICY)))
+
+
+def counters() -> dict:
+    """Registry view (obs/registry.py robustness_snapshot): zeros when
+    the sanitizer is disabled so the key layout stays stable."""
+    san = active()
+    if san is None:
+        return {"cycles": 0, "inversions": 0, "victims": 0,
+                "enabled": False}
+    snap = san.snapshot()
+    return {"cycles": snap["cycles"], "inversions": snap["inversions"],
+            "victims": snap["victims"], "enabled": True}

@@ -275,7 +275,10 @@ class StageScheduler:
                         detail=f"{self.name}[{task.index}] "
                                f"attempt {attempt}")
                     if faults.should_inject("task.straggler"):
-                        time.sleep(self.straggler_s)
+                        # interruptible: a cancelled query must not
+                        # ride out injected straggler latency
+                        cancellation.sleep_interruptible(
+                            self.straggler_s)
                 return task.run(attempt)
 
         return fn

@@ -29,6 +29,29 @@ Governance (PR 5):
   explicitly) registers a cancel wakeup and leaves the wait promptly
   when the query is cancelled or its deadline passes, removing its
   ticket so the queue never wedges behind a dead waiter.
+
+Deadlock freedom (PR 7, `semaphore.atomicQueryGroups`):
+
+- **Atomic per-query permit groups**: all permits a query ever holds
+  form ONE group. The query's FIRST acquire is the group leader: it
+  waits ticket-FIFO for a whole permit chunk, holding nothing while it
+  waits (all-or-nothing). Every LATER acquire by the same query — a
+  nested stage materializing a CPU-fallback subtree, sibling result
+  tasks, a shuffle map task under an outer hold — is a group
+  EXPANSION: it joins immediately, consuming a free chunk only when
+  one is available and nobody is queued ahead, else riding the group's
+  existing hold for free. A query therefore NEVER blocks on the
+  semaphore while holding permits, which removes the hold-and-wait
+  ingredient entirely: two concurrent per-operator queries used to
+  interleave partial holds (each scaffold chunk starving the other's
+  nested acquire) into a permanent wedge; now each nested acquire
+  rides its own query's group and both complete. The legacy per-task
+  discipline survives behind the conf (False) so the concurrency
+  sanitizer's detection/recovery path stays regression-testable.
+- **Sanitizer instrumentation** (runtime/sanitizer.py, conf-gated):
+  holds are reported per owning query, a wait-for edge is registered
+  before every park, and each wakeup checks the wait record so a
+  victimized token-less waiter unwinds with DeadlockDetectedError.
 """
 
 from __future__ import annotations
@@ -37,7 +60,7 @@ import itertools
 import threading
 import time
 from collections import deque
-from typing import Dict, Optional
+from typing import Dict, Optional, Set
 
 from spark_rapids_tpu.runtime.errors import SemaphoreTimeout
 
@@ -48,7 +71,8 @@ DEFAULT_ACQUIRE_TIMEOUT_MS = 600_000
 
 class TpuSemaphore:
     def __init__(self, concurrent_tasks: int = 2,
-                 acquire_timeout_ms: int = DEFAULT_ACQUIRE_TIMEOUT_MS):
+                 acquire_timeout_ms: int = DEFAULT_ACQUIRE_TIMEOUT_MS,
+                 atomic_query_groups: bool = True):
         concurrent_tasks = max(1, concurrent_tasks)
         self._permits_per_task = max(1, MAX_PERMITS // concurrent_tasks)
         self._available = MAX_PERMITS
@@ -56,12 +80,16 @@ class TpuSemaphore:
         self._holders: Dict[int, int] = {}
         self._held_since: Dict[int, float] = {}
         self._holder_query: Dict[int, int] = {}
+        self._query_tasks: Dict[int, Set[int]] = {}
         self._queue: deque = deque()  # tickets, FIFO
         self._ticket = itertools.count(1)
         self._timeout_ms = acquire_timeout_ms
+        self._atomic_groups = atomic_query_groups
         self.total_wait_ns = 0
         self.timeouts = 0
         self.cancelled_waits = 0
+        self.group_joins = 0
+        self.group_rides = 0
 
     def acquire_if_necessary(self, task_id: int, cancel=None):
         from spark_rapids_tpu.runtime import cancellation
@@ -83,60 +111,156 @@ class TpuSemaphore:
             if wake is not None:
                 cancel.remove_on_cancel(wake)
 
+    def _grant_locked(self, task_id: int, qid: int, permits: int):
+        self._available -= permits
+        self._holders[task_id] = permits
+        self._held_since[task_id] = time.monotonic()
+        self._holder_query[task_id] = qid
+        if qid:
+            self._query_tasks.setdefault(qid, set()).add(task_id)
+
+    def _try_group_join_locked(self, task_id: int, qid: int) -> bool:
+        """Atomic-group EXPANSION: a query that already holds permits
+        joins its own group without ever blocking — consuming a free
+        chunk when one is available and no ticket is queued ahead,
+        else riding the group's hold for free. The no-block guarantee
+        is what makes the query's permit set atomic: holding members
+        never wait, so cross-query hold-and-wait cycles cannot form."""
+        if not self._atomic_groups or not qid:
+            return False
+        if not self._query_tasks.get(qid):
+            return False
+        if self._available >= self._permits_per_task and \
+                not self._queue:
+            self._grant_locked(task_id, qid, self._permits_per_task)
+            self.group_joins += 1
+        else:
+            self._grant_locked(task_id, qid, 0)
+            self.group_rides += 1
+        return True
+
     def _acquire(self, task_id: int, cancel):
+        from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.runtime import sanitizer as _san
+
+        qid = obs_events.effective_query_id()
+        granted = False
         with self._cv:
             if task_id in self._holders:
                 return
-            ticket = next(self._ticket)
-            self._queue.append(ticket)
-            start = time.monotonic_ns()
-            deadline = (None if self._timeout_ms <= 0
-                        else time.monotonic() + self._timeout_ms / 1000.0)
-            try:
-                while not (self._queue[0] == ticket and
-                           self._available >= self._permits_per_task):
-                    if cancel is not None and \
-                            (cancel.cancelled or cancel.expired):
-                        self.cancelled_waits += 1
-                        cancel.check()  # raises
-                    wait_s: Optional[float] = None
-                    if deadline is not None:
-                        wait_s = deadline - time.monotonic()
-                        if wait_s <= 0:
-                            self.timeouts += 1
-                            waited_s = (time.monotonic_ns() - start) / 1e9
-                            raise SemaphoreTimeout(
-                                f"task {task_id} timed out after "
-                                f"{waited_s:.1f}s waiting for "
-                                f"{self._permits_per_task} device "
-                                f"permits ({self._available}/"
-                                f"{MAX_PERMITS} available, queue "
-                                f"position "
-                                f"{self._queue.index(ticket) + 1}/"
-                                f"{len(self._queue)}); held permits: "
-                                f"{self._holder_diagnostics()}")
-                    if cancel is not None:
-                        r = cancel.remaining_s()
-                        if r is not None:
-                            r += 0.001  # wake just past the deadline
-                            wait_s = r if wait_s is None \
-                                else min(wait_s, r)
-                    self._cv.wait(wait_s)
-            except BaseException:
-                self._queue.remove(ticket)
-                # the next ticket may be eligible right now
-                self._cv.notify_all()
-                raise
-            self._queue.popleft()
-            self.total_wait_ns += time.monotonic_ns() - start
-            self._available -= self._permits_per_task
-            self._holders[task_id] = self._permits_per_task
-            self._held_since[task_id] = time.monotonic()
-            from spark_rapids_tpu.obs import events as obs_events
+            if self._try_group_join_locked(task_id, qid):
+                granted = True
+            elif self._queue_empty_and_free_locked():
+                # uncontended leader fast path: no ticket, no
+                # sanitizer wait edge
+                self._grant_locked(task_id, qid, self._permits_per_task)
+                granted = True
+        if granted:
+            san = _san.active()
+            if san is not None:
+                san.acquired(_san.SEMAPHORE, qid)
+            return
+        self._acquire_slow(task_id, qid, cancel)
 
-            self._holder_query[task_id] = obs_events.effective_query_id()
-            # permits may remain for the NEW front ticket
+    def _queue_empty_and_free_locked(self) -> bool:
+        return not self._queue and \
+            self._available >= self._permits_per_task
+
+    def _acquire_slow(self, task_id: int, qid: int, cancel):
+        """Contended leader acquisition: take a ticket, register the
+        sanitizer wait-for edge, park FIFO. All-or-nothing — nothing is
+        held while waiting, and the grant is one whole chunk."""
+        from spark_rapids_tpu.runtime import sanitizer as _san
+
+        san = _san.active()
+        wait_rec = None
+        if san is not None:
+            # outside _cv: edge insertion may run cycle detection and
+            # cancel a victim token whose wakeup takes _cv
+            wait_rec = san.begin_wait(
+                _san.SEMAPHORE, qid, token=cancel,
+                wake=lambda: self._notify_all())
+        try:
+            with self._cv:
+                if task_id in self._holders:
+                    return
+                if self._try_group_join_locked(task_id, qid):
+                    self._sanitizer_acquired(san, qid)
+                    return
+                ticket = next(self._ticket)
+                self._queue.append(ticket)
+                start = time.monotonic_ns()
+                deadline = (None if self._timeout_ms <= 0
+                            else time.monotonic() +
+                            self._timeout_ms / 1000.0)
+                try:
+                    while not (self._queue[0] == ticket and
+                               self._available >=
+                               self._permits_per_task):
+                        if wait_rec is not None:
+                            wait_rec.check()  # deadlock-victim exit
+                        if cancel is not None and \
+                                (cancel.cancelled or cancel.expired):
+                            self.cancelled_waits += 1
+                            cancel.check()  # raises
+                        # the query may have become a holder through a
+                        # sibling while we queued: expansion never waits
+                        if self._try_group_join_locked(task_id, qid):
+                            self._queue.remove(ticket)
+                            self._cv.notify_all()
+                            self._sanitizer_acquired(san, qid)
+                            return
+                        wait_s: Optional[float] = None
+                        if deadline is not None:
+                            wait_s = deadline - time.monotonic()
+                            if wait_s <= 0:
+                                self.timeouts += 1
+                                waited_s = (time.monotonic_ns() -
+                                            start) / 1e9
+                                raise SemaphoreTimeout(
+                                    f"task {task_id} timed out after "
+                                    f"{waited_s:.1f}s waiting for "
+                                    f"{self._permits_per_task} device "
+                                    f"permits ({self._available}/"
+                                    f"{MAX_PERMITS} available, queue "
+                                    f"position "
+                                    f"{self._queue.index(ticket) + 1}/"
+                                    f"{len(self._queue)}); held "
+                                    f"permits: "
+                                    f"{self._holder_diagnostics()}")
+                        if cancel is not None:
+                            r = cancel.remaining_s()
+                            if r is not None:
+                                r += 0.001  # wake past the deadline
+                                wait_s = r if wait_s is None \
+                                    else min(wait_s, r)
+                        self._cv.wait(wait_s)
+                except BaseException:
+                    self._queue.remove(ticket)
+                    # the next ticket may be eligible right now
+                    self._cv.notify_all()
+                    raise
+                self._queue.popleft()
+                self.total_wait_ns += time.monotonic_ns() - start
+                self._grant_locked(task_id, qid,
+                                   self._permits_per_task)
+                # permits may remain for the NEW front ticket
+                self._cv.notify_all()
+            self._sanitizer_acquired(san, qid)
+        finally:
+            if wait_rec is not None:
+                san.end_wait(wait_rec)
+
+    def _notify_all(self):
+        with self._cv:
             self._cv.notify_all()
+
+    @staticmethod
+    def _sanitizer_acquired(san, qid: int) -> None:
+        if san is not None:
+            from spark_rapids_tpu.runtime import sanitizer as _san
+
+            san.acquired(_san.SEMAPHORE, qid)
 
     def _holder_diagnostics(self) -> str:
         """Under _cv: the held-permit table a timed-out acquirer dumps
@@ -152,13 +276,26 @@ class TpuSemaphore:
         return "[" + ", ".join(rows) + "]" if rows else "[none]"
 
     def release_if_necessary(self, task_id: int):
+        from spark_rapids_tpu.runtime import sanitizer as _san
+
+        qid = None
         with self._cv:
             permits = self._holders.pop(task_id, None)
             self._held_since.pop(task_id, None)
-            self._holder_query.pop(task_id, None)
+            qid = self._holder_query.pop(task_id, None)
+            if qid:
+                group = self._query_tasks.get(qid)
+                if group is not None:
+                    group.discard(task_id)
+                    if not group:
+                        del self._query_tasks[qid]
             if permits:
                 self._available += permits
                 self._cv.notify_all()
+        if permits is not None:
+            san = _san.active()
+            if san is not None:
+                san.released(_san.SEMAPHORE, qid or 0)
 
     def holders(self) -> int:
         with self._cv:
@@ -168,17 +305,25 @@ class TpuSemaphore:
         with self._cv:
             return len(self._queue)
 
+    def query_holds(self, qid: int) -> int:
+        """How many task-level holds (chunk or free-ride) query `qid`'s
+        group currently has — diagnostics + tests."""
+        with self._cv:
+            return len(self._query_tasks.get(qid, ()))
+
 
 _instance: Optional[TpuSemaphore] = None
 _lock = threading.Lock()
 
 
 def initialize(concurrent_tasks: int,
-               acquire_timeout_ms: int = DEFAULT_ACQUIRE_TIMEOUT_MS):
+               acquire_timeout_ms: int = DEFAULT_ACQUIRE_TIMEOUT_MS,
+               atomic_query_groups: bool = True):
     global _instance
     with _lock:
-        old, _instance = _instance, TpuSemaphore(concurrent_tasks,
-                                                 acquire_timeout_ms)
+        old, _instance = _instance, TpuSemaphore(
+            concurrent_tasks, acquire_timeout_ms,
+            atomic_query_groups=atomic_query_groups)
     if old is not None:
         # wake anyone still blocked on the replaced instance — their
         # releases would otherwise notify only the new one, stranding

@@ -1,0 +1,18 @@
+"""srtpu-lint — the repo's AST rule engine for machine-checked
+invariants (see docs/static-analysis.md and ci/static_check.sh).
+
+Five PRs of review-memory invariants (every conf registered and
+documented, every blocking wait cancel-interruptible, every
+byte-crossing site ledgered, every emitted event schema-registered,
+no bare excepts) become static analysis here: `python -m
+spark_rapids_tpu.tools.lint` walks `spark_rapids_tpu/` and exits
+non-zero on any finding. Suppress a single line with an inline
+`# srtpu-lint: disable=<rule-id>` pragma.
+"""
+
+from spark_rapids_tpu.tools.lint.engine import (  # noqa: F401
+    Finding,
+    LintEngine,
+    RepoContext,
+)
+from spark_rapids_tpu.tools.lint.rules import all_rules  # noqa: F401

@@ -1,0 +1,50 @@
+"""CLI: `python -m spark_rapids_tpu.tools.lint [--root DIR] [--json]`.
+
+Exit status 0 = clean tree, 1 = findings (what ci/static_check.sh
+gates on), 2 = engine error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from spark_rapids_tpu.tools.lint.engine import LintEngine, repo_root
+from spark_rapids_tpu.tools.lint.rules import all_rules
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="srtpu-lint")
+    p.add_argument("--root", default=repo_root(),
+                   help="checkout root (contains spark_rapids_tpu/ "
+                        "and docs/)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable findings")
+    p.add_argument("--list-rules", action="store_true")
+    args = p.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.id}: {r.description}")
+        return 0
+
+    engine = LintEngine(args.root, rules)
+    findings = engine.run()
+    if args.json:
+        print(json.dumps({
+            "ruleCount": len(rules),
+            "findingCount": len(findings),
+            "findings": [vars(f) for f in findings],
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"srtpu-lint: {len(findings)} finding(s) across "
+              f"{len(engine.files())} file(s), {len(rules)} rule(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

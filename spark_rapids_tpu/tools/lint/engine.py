@@ -1,0 +1,233 @@
+"""Rule-engine core: file walking, AST parsing, pragma suppression,
+and the shared repo context (declared confs, documented confs,
+registered event types) rules check against.
+
+Design mirrors small linters (flake8 plugins, the reference repo's
+scala-style checks in ci/): a Rule sees one parsed file at a time plus
+a RepoContext of cross-file facts; repo-scoped rules run once over the
+context. Everything is stdlib `ast` — no third-party dependency, so
+the CI gate runs anywhere the engine does.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set
+
+PRAGMA_RE = re.compile(r"#\s*srtpu-lint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str          # repo-relative
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class FileContext:
+    """One parsed source file plus its suppression table."""
+
+    path: str                      # absolute
+    rel: str                       # repo-relative, '/'-separated
+    source: str
+    tree: ast.AST
+    suppressions: Dict[int, Set[str]] = field(default_factory=dict)
+    _func_spans: Optional[List[tuple]] = None
+
+    @classmethod
+    def parse(cls, path: str, rel: str) -> "FileContext":
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        ctx = cls(path=path, rel=rel, source=source,
+                  tree=ast.parse(source, filename=path))
+        for i, line in enumerate(source.splitlines(), 1):
+            m = PRAGMA_RE.search(line)
+            if m:
+                ctx.suppressions[i] = {
+                    r.strip() for r in m.group(1).split(",") if r.strip()}
+        return ctx
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        rules = self.suppressions.get(line)
+        return bool(rules) and (rule in rules or "*" in rules)
+
+    # --- enclosing-function helpers (several rules scope their
+    # --- exemptions to "the function this call lives in") ---
+
+    def _spans(self) -> List[tuple]:
+        if self._func_spans is None:
+            spans = []
+            for node in ast.walk(self.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    spans.append((node.lineno,
+                                  node.end_lineno or node.lineno,
+                                  node))
+            # innermost (shortest) span wins on lookup
+            spans.sort(key=lambda s: (s[0], -(s[1])))
+            self._func_spans = spans
+        return self._func_spans
+
+    def enclosing_function(self, line: int
+                           ) -> Optional[ast.FunctionDef]:
+        fns = self.enclosing_functions(line)
+        return fns[0] if fns else None
+
+    def enclosing_functions(self, line: int) -> List[ast.FunctionDef]:
+        """Every function whose span contains `line`, innermost first
+        — a closure nested in an instrumented function counts as
+        instrumented."""
+        hits = [(hi - lo, node) for lo, hi, node in self._spans()
+                if lo <= line <= hi]
+        hits.sort(key=lambda t: t[0])
+        return [node for _span, node in hits]
+
+
+class RepoContext:
+    """Cross-file facts the rules need: the conf registry (imported
+    from config/rapids_conf.py so dynamically-built keys resolve), the
+    documented-key set (regexed out of docs/configs.md), and the obs
+    event-type registry (statically parsed out of obs/events.py — it
+    is a literal dict)."""
+
+    KEY_RE = re.compile(
+        r"spark\.rapids\.tpu\.[A-Za-z0-9][A-Za-z0-9.]*[A-Za-z0-9]")
+
+    def __init__(self, root: str):
+        self.root = root
+        self.pkg = os.path.join(root, "spark_rapids_tpu")
+        self.declared_confs: Set[str] = set()
+        self.internal_confs: Set[str] = set()
+        self.documented_confs: Set[str] = set()
+        self.event_types: Set[str] = set()
+        self._load_confs()
+        self._load_docs()
+        self._load_event_types()
+
+    def _load_confs(self) -> None:
+        """Import rapids_conf.py standalone (it is stdlib-only) so
+        registry keys built through helpers/f-strings are exact — a
+        static walk would miss every `_format_read_enable`-style
+        constructor."""
+        path = os.path.join(self.pkg, "config", "rapids_conf.py")
+        spec = importlib.util.spec_from_file_location(
+            "_srtpu_lint_rapids_conf", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        for key, entry in mod._REGISTRY.items():
+            self.declared_confs.add(key)
+            if getattr(entry, "internal", False):
+                self.internal_confs.add(key)
+
+    def _load_docs(self) -> None:
+        path = os.path.join(self.root, "docs", "configs.md")
+        if not os.path.exists(path):
+            return
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        self.documented_confs = set(self.KEY_RE.findall(text))
+
+    def _load_event_types(self) -> None:
+        path = os.path.join(self.pkg, "obs", "events.py")
+        tree = ast.parse(open(path, encoding="utf-8").read())
+        for node in ast.walk(tree):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value:
+                targets = [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id == "EVENT_TYPES" \
+                        and isinstance(node.value, ast.Dict):
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Constant) and \
+                                isinstance(k.value, str):
+                            self.event_types.add(k.value)
+
+    def is_registered_or_family(self, key: str) -> bool:
+        """True when `key` is a registered conf OR a strict prefix of
+        one (doc prose references families like
+        `spark.rapids.tpu.admission.queue` without naming a leaf)."""
+        if key in self.declared_confs:
+            return True
+        prefix = key + "."
+        return any(k.startswith(prefix) for k in self.declared_confs)
+
+    def is_documented_or_family(self, key: str) -> bool:
+        if key in self.documented_confs:
+            return True
+        prefix = key + "."
+        return any(k.startswith(prefix) for k in self.documented_confs)
+
+
+class Rule:
+    """One invariant. `check` sees each file; `repo_check` runs once
+    per lint run for cross-file invariants."""
+
+    id: str = "rule"
+    description: str = ""
+
+    def check(self, ctx: FileContext, repo: RepoContext
+              ) -> Iterable[Finding]:
+        return ()
+
+    def repo_check(self, repo: RepoContext) -> Iterable[Finding]:
+        return ()
+
+
+class LintEngine:
+    SKIP_DIRS = {"__pycache__"}
+
+    def __init__(self, root: str, rules: Optional[List[Rule]] = None):
+        from spark_rapids_tpu.tools.lint.rules import all_rules
+
+        self.root = os.path.abspath(root)
+        self.rules = rules if rules is not None else all_rules()
+        self.repo = RepoContext(self.root)
+        self.parse_errors: List[Finding] = []
+
+    def files(self) -> List[str]:
+        out = []
+        for dirpath, dirnames, filenames in os.walk(
+                os.path.join(self.root, "spark_rapids_tpu")):
+            dirnames[:] = [d for d in dirnames
+                           if d not in self.SKIP_DIRS]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+        return out
+
+    def run(self, paths: Optional[List[str]] = None) -> List[Finding]:
+        findings: List[Finding] = []
+        for path in (paths if paths is not None else self.files()):
+            rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+            try:
+                ctx = FileContext.parse(path, rel)
+            except SyntaxError as e:
+                findings.append(Finding("parse-error", rel,
+                                        e.lineno or 0, str(e.msg)))
+                continue
+            for rule in self.rules:
+                for f in rule.check(ctx, self.repo):
+                    if not ctx.suppressed(f.line, f.rule):
+                        findings.append(f)
+        for rule in self.rules:
+            findings.extend(rule.repo_check(self.repo))
+        findings.sort(key=lambda f: (f.path, f.line, f.rule))
+        return findings
+
+
+def repo_root() -> str:
+    """The checkout root, derived from this file's location
+    (spark_rapids_tpu/tools/lint/engine.py -> three levels up)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.abspath(os.path.join(here, "..", "..", ".."))

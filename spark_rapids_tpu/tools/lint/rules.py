@@ -1,0 +1,319 @@
+"""The invariant rules. Each encodes one discipline previous PRs
+enforced only through reviewer memory; docs/static-analysis.md carries
+the id, rationale, and suppression notes for every rule here.
+
+Rule ids are stable (suppressions and commit messages reference them):
+
+- conf-registered    every spark.rapids.tpu.* key read in source is
+                     declared in config/rapids_conf.py
+- conf-documented    every declared key appears in docs/configs.md
+- raw-sleep          no time.sleep outside runtime/backoff.py and
+                     runtime/cancellation.py (use sleep_interruptible)
+- unyielding-wait    no indefinitely-blocking acquire/join/get in
+                     modules that can hold semaphore permits unless a
+                     cancellation yield point is in scope
+- raw-transfer       device_put/device_get (and shuffle-path binary
+                     file writes) only inside telemetry-instrumented
+                     functions
+- unknown-event      emitted event-type literals exist in
+                     obs/events.py EVENT_TYPES
+- bare-except        no `except:` without an exception class
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from spark_rapids_tpu.tools.lint.engine import (
+    Finding,
+    FileContext,
+    RepoContext,
+    Rule,
+)
+
+
+def _call_name(node: ast.Call) -> str:
+    """Dotted-ish name of the called object: 'time.sleep' for
+    time.sleep(...), 'sleep' for sleep(...), '.get' for obj.get(...)
+    where the value is not a plain Name."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        if isinstance(f.value, ast.Name):
+            return f"{f.value.id}.{f.attr}"
+        return f".{f.attr}"
+    return ""
+
+
+def _function_contains(fn: ast.AST, attr_names: set,
+                       name_substrings: set = frozenset()) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and node.attr in attr_names:
+            return True
+        if isinstance(node, ast.Name) and any(
+                s in node.id.lower() for s in name_substrings):
+            return True
+    return False
+
+
+class ConfRegisteredRule(Rule):
+    id = "conf-registered"
+    description = ("every spark.rapids.tpu.* key appearing in a "
+                   "string literal is declared in "
+                   "config/rapids_conf.py")
+    #: the declaration site itself and generated-docs tooling are the
+    #: registry, not readers of it
+    EXEMPT = {"spark_rapids_tpu/config/rapids_conf.py"}
+
+    def check(self, ctx: FileContext, repo: RepoContext
+              ) -> Iterable[Finding]:
+        if ctx.rel in self.EXEMPT:
+            return
+        seen = set()
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            for m in repo.KEY_RE.finditer(node.value):
+                key = m.group(0)
+                # family references ("spark.rapids.tpu.admission.*",
+                # "...sanitizer.{enabled,...}") resolve as prefixes
+                if repo.is_registered_or_family(key):
+                    continue
+                mark = (node.lineno, key)
+                if mark in seen:
+                    continue
+                seen.add(mark)
+                yield Finding(
+                    self.id, ctx.rel, node.lineno,
+                    f"conf key '{key}' is not declared in "
+                    f"config/rapids_conf.py (register it with conf() "
+                    f"so it is typed, defaulted, and documented)")
+
+
+class ConfDocumentedRule(Rule):
+    id = "conf-documented"
+    description = ("every declared, non-internal conf key appears in "
+                   "docs/configs.md (regenerate with "
+                   "python -m spark_rapids_tpu.tools.gendocs)")
+
+    def repo_check(self, repo: RepoContext) -> Iterable[Finding]:
+        for key in sorted(repo.declared_confs - repo.internal_confs):
+            if not key.startswith("spark.rapids.tpu."):
+                continue  # the invariant covers the tpu namespace
+            if not repo.is_documented_or_family(key):
+                yield Finding(
+                    self.id, "docs/configs.md", 1,
+                    f"declared conf key '{key}' is missing from "
+                    f"docs/configs.md — regenerate the doc")
+
+
+class RawSleepRule(Rule):
+    id = "raw-sleep"
+    description = ("time.sleep only inside runtime/backoff.py and "
+                   "runtime/cancellation.py; everything else uses "
+                   "cancellation.sleep_interruptible so a cancelled "
+                   "query never rides out a delay")
+    ALLOWED = {"spark_rapids_tpu/runtime/backoff.py",
+               "spark_rapids_tpu/runtime/cancellation.py"}
+
+    def check(self, ctx: FileContext, repo: RepoContext
+              ) -> Iterable[Finding]:
+        if ctx.rel in self.ALLOWED:
+            return
+        from_time_sleep = any(
+            isinstance(n, ast.ImportFrom) and n.module == "time"
+            and any(a.name == "sleep" for a in n.names)
+            for n in ast.walk(ctx.tree))
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            if name == "time.sleep" or \
+                    (name == "sleep" and from_time_sleep):
+                yield Finding(
+                    self.id, ctx.rel, node.lineno,
+                    "raw time.sleep blocks cancellation — use "
+                    "runtime.cancellation.sleep_interruptible (falls "
+                    "back to time.sleep without a token in scope)")
+
+
+class UnyieldingWaitRule(Rule):
+    id = "unyielding-wait"
+    description = ("no indefinitely-blocking .acquire()/.join()/"
+                   ".get() in modules that can hold semaphore permits "
+                   "unless a cancellation yield point is in scope in "
+                   "the enclosing function")
+    #: modules whose code can run while the query holds device-
+    #: semaphore permits — a blocking wait here is a deadlock
+    #: ingredient (hold-and-wait)
+    PERMIT_MODULES = {
+        "spark_rapids_tpu/exec/base.py",
+        "spark_rapids_tpu/exec/operators.py",
+        "spark_rapids_tpu/exec/fused.py",
+        "spark_rapids_tpu/exec/joins.py",
+        "spark_rapids_tpu/exec/agg_pushdown.py",
+        "spark_rapids_tpu/api/columnar_rdd.py",
+        "spark_rapids_tpu/shuffle/manager.py",
+        "spark_rapids_tpu/runtime/retry.py",
+        "spark_rapids_tpu/runtime/scheduler.py",
+        "spark_rapids_tpu/runtime/memory.py",
+    }
+    BLOCKING_ATTRS = {"acquire", "join", "get"}
+
+    @staticmethod
+    def _queue_like(node: ast.Call) -> bool:
+        """`.get()` is only a blocking wait on queue-like receivers —
+        module singleton getters (`sem.get()`, `host_alloc.get()`) and
+        dict/ContextVar gets are not waits. Receiver names matching
+        q/queue/future conventions count."""
+        import re
+
+        v = node.func.value
+        name = ""
+        if isinstance(v, ast.Name):
+            name = v.id
+        elif isinstance(v, ast.Attribute):
+            name = v.attr
+        return bool(re.search(r"(^|_)(q|queue|future)s?$|queue",
+                              name, re.I))
+
+    @classmethod
+    def _is_blocking(cls, node: ast.Call, attr: str) -> bool:
+        """Heuristic for 'waits indefinitely': a zero-positional-arg
+        call with no timeout= kwarg and no blocking=False. dict.get /
+        str.join style calls always pass positionals and drop out."""
+        if node.args:
+            return False
+        for kw in node.keywords:
+            if kw.arg == "timeout":
+                return False
+            if kw.arg in ("blocking", "block") and \
+                    isinstance(kw.value, ast.Constant) and \
+                    kw.value.value is False:
+                return False
+        if attr == "get":
+            return cls._queue_like(node)
+        return True
+
+    def check(self, ctx: FileContext, repo: RepoContext
+              ) -> Iterable[Finding]:
+        if ctx.rel not in self.PERMIT_MODULES:
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self.BLOCKING_ATTRS):
+                continue
+            if not self._is_blocking(node, node.func.attr):
+                continue
+            if any(_function_contains(
+                    fn, {"check", "on_cancel", "check_current",
+                         "sleep_interruptible"},
+                    {"cancel", "token"})
+                    for fn in ctx.enclosing_functions(node.lineno)):
+                continue  # a yield point is in scope
+            yield Finding(
+                self.id, ctx.rel, node.lineno,
+                f"indefinitely-blocking .{node.func.attr}() in a "
+                f"permit-holding module with no cancellation yield "
+                f"point in scope — pass a timeout, check a "
+                f"CancelToken, or register an on_cancel wakeup")
+
+
+class RawTransferRule(Rule):
+    id = "raw-transfer"
+    description = ("host<->device byte crossings (jax.device_put / "
+                   "jax.device_get) and shuffle/spill binary file "
+                   "writes happen only in telemetry-instrumented "
+                   "functions (obs/telemetry.py record/ledgered_*), "
+                   "so the data-movement ledger stays complete")
+    #: the instrumentation layer itself
+    EXEMPT = {"spark_rapids_tpu/obs/telemetry.py"}
+    RECORDERS = {"record", "ledgered_get", "ledgered_put",
+                 "record_forwarded", "_disk_io"}
+    WRITE_MODULES_PREFIX = ("spark_rapids_tpu/shuffle/",)
+
+    def check(self, ctx: FileContext, repo: RepoContext
+              ) -> Iterable[Finding]:
+        if ctx.rel in self.EXEMPT:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            is_transfer = name.endswith("device_put") or \
+                name.endswith("device_get")
+            is_binary_write = (
+                ctx.rel.startswith(self.WRITE_MODULES_PREFIX)
+                and name == "open" and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)
+                and "b" in node.args[1].value
+                and any(c in node.args[1].value for c in "wa"))
+            if not (is_transfer or is_binary_write):
+                continue
+            if any(_function_contains(fn, self.RECORDERS)
+                    for fn in ctx.enclosing_functions(node.lineno)):
+                continue  # instrumented in this (or an enclosing) fn
+            what = ("byte-crossing transfer" if is_transfer
+                    else "shuffle-path binary file write")
+            yield Finding(
+                self.id, ctx.rel, node.lineno,
+                f"unledgered {what} — route it through the "
+                f"obs.telemetry wrappers (telemetry.record around the "
+                f"crossing, or telemetry.ledgered_put/ledgered_get) "
+                f"so per-query data-movement accounting stays exact")
+
+
+class UnknownEventRule(Rule):
+    id = "unknown-event"
+    description = ("event-type literals passed to emit() exist in "
+                   "obs/events.py EVENT_TYPES (the eventlog validator "
+                   "rejects anything else)")
+    EXEMPT = {"spark_rapids_tpu/obs/events.py"}
+
+    def check(self, ctx: FileContext, repo: RepoContext
+              ) -> Iterable[Finding]:
+        if ctx.rel in self.EXEMPT:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            name = _call_name(node)
+            if not (name == "emit" or name.endswith(".emit")):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and \
+                    isinstance(arg.value, str) and \
+                    arg.value not in repo.event_types:
+                yield Finding(
+                    self.id, ctx.rel, node.lineno,
+                    f"event type '{arg.value}' is not registered in "
+                    f"obs/events.py EVENT_TYPES — the eventlog "
+                    f"validator would reject it; register the type "
+                    f"with its payload summary")
+
+
+class BareExceptRule(Rule):
+    id = "bare-except"
+    description = ("no `except:` — it swallows KeyboardInterrupt and "
+                   "cancellation errors; catch Exception (or the "
+                   "specific class) instead")
+
+    def check(self, ctx: FileContext, repo: RepoContext
+              ) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                yield Finding(
+                    self.id, ctx.rel, node.lineno,
+                    "bare `except:` swallows BaseException (including "
+                    "query cancellation) — name the exception class")
+
+
+def all_rules() -> List[Rule]:
+    return [ConfRegisteredRule(), ConfDocumentedRule(), RawSleepRule(),
+            UnyieldingWaitRule(), RawTransferRule(), UnknownEventRule(),
+            BareExceptRule()]

@@ -1,0 +1,327 @@
+"""srtpu-lint engine suite (PR 7): one positive + one negative fixture
+per rule, pragma suppression, and the engine-level contract that the
+committed tree itself is clean (the ci/static_check.sh gate).
+
+Fixtures are written into a synthetic mini-repo (tmp_path) shaped like
+the real one — a spark_rapids_tpu/ package, docs/configs.md, and an
+obs/events.py EVENT_TYPES — so the rules run exactly as they do in CI,
+including the repo-context loading paths.
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from spark_rapids_tpu.tools.lint.engine import (
+    FileContext,
+    LintEngine,
+    RepoContext,
+    repo_root,
+)
+from spark_rapids_tpu.tools.lint.rules import all_rules
+
+RAPIDS_CONF_STUB = '''
+_REGISTRY = {}
+
+
+class ConfEntry:
+    def __init__(self, key, internal=False):
+        self.key = key
+        self.internal = internal
+
+
+def conf(key, internal=False):
+    _REGISTRY[key] = ConfEntry(key, internal)
+
+
+conf("spark.rapids.tpu.known.enabled")
+conf("spark.rapids.tpu.known.child.timeoutMs")
+conf("spark.rapids.tpu.secret.internalKnob", internal=True)
+'''
+
+EVENTS_STUB = '''
+EVENT_TYPES = {
+    "query.start": "queryId",
+    "sanitizer.deadlock": "cycle",
+}
+'''
+
+CONFIGS_MD = """# configs
+spark.rapids.tpu.known.enabled | desc
+spark.rapids.tpu.known.child.timeoutMs | desc
+"""
+
+
+@pytest.fixture
+def mini_repo(tmp_path):
+    root = tmp_path
+    pkg = root / "spark_rapids_tpu"
+    (pkg / "config").mkdir(parents=True)
+    (pkg / "obs").mkdir()
+    (pkg / "runtime").mkdir()
+    (pkg / "exec").mkdir()
+    (pkg / "shuffle").mkdir()
+    (root / "docs").mkdir()
+    (pkg / "config" / "rapids_conf.py").write_text(RAPIDS_CONF_STUB)
+    (pkg / "obs" / "events.py").write_text(EVENTS_STUB)
+    (root / "docs" / "configs.md").write_text(CONFIGS_MD)
+    return root
+
+
+def _lint_file(root, rel, source):
+    path = os.path.join(root, rel)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(textwrap.dedent(source))
+    engine = LintEngine(str(root), all_rules())
+    return [f for f in engine.run([path])]
+
+
+def _rule_hits(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ---------------------------------------------------------- conf rules
+
+def test_conf_registered_positive_and_negative(mini_repo):
+    bad = _lint_file(mini_repo, "spark_rapids_tpu/runtime/x.py",
+                     'KEY = "spark.rapids.tpu.unregistered.flag"\n')
+    assert len(_rule_hits(bad, "conf-registered")) == 1
+    good = _lint_file(mini_repo, "spark_rapids_tpu/runtime/y.py",
+                      'KEY = "spark.rapids.tpu.known.enabled"\n')
+    assert not _rule_hits(good, "conf-registered")
+
+
+def test_conf_registered_family_prefix_ok(mini_repo):
+    """Doc-prose family references resolve as registered-key
+    prefixes."""
+    good = _lint_file(
+        mini_repo, "spark_rapids_tpu/runtime/fam.py",
+        'DOC = "see spark.rapids.tpu.known.* and '
+        'spark.rapids.tpu.known.child settings"\n')
+    assert not _rule_hits(good, "conf-registered")
+
+
+def test_conf_documented_repo_check(mini_repo):
+    """A registered-but-undocumented key surfaces once, against
+    docs/configs.md; internal keys are exempt."""
+    (mini_repo / "spark_rapids_tpu" / "config" /
+     "rapids_conf.py").write_text(
+        RAPIDS_CONF_STUB +
+        'conf("spark.rapids.tpu.freshly.added")\n')
+    engine = LintEngine(str(mini_repo), all_rules())
+    findings = engine.run([])
+    hits = _rule_hits(findings, "conf-documented")
+    assert len(hits) == 1
+    assert "spark.rapids.tpu.freshly.added" in hits[0].message
+    assert hits[0].path == "docs/configs.md"
+    assert not any("internalKnob" in f.message for f in findings)
+
+
+# ----------------------------------------------------------- raw-sleep
+
+def test_raw_sleep_positive_negative_and_allowlist(mini_repo):
+    bad = _lint_file(mini_repo, "spark_rapids_tpu/runtime/w.py", """
+        import time
+
+        def slow():
+            time.sleep(1.0)
+    """)
+    assert len(_rule_hits(bad, "raw-sleep")) == 1
+    ok = _lint_file(mini_repo, "spark_rapids_tpu/runtime/backoff.py", """
+        import time
+
+        def backoff():
+            time.sleep(0.1)
+    """)
+    assert not _rule_hits(ok, "raw-sleep")
+    aliased = _lint_file(mini_repo, "spark_rapids_tpu/runtime/w2.py", """
+        from time import sleep
+
+        def slow():
+            sleep(1.0)
+    """)
+    assert len(_rule_hits(aliased, "raw-sleep")) == 1
+
+
+def test_pragma_suppression(mini_repo):
+    src = """
+        import time
+
+        def chaos():
+            time.sleep(0.5)  # srtpu-lint: disable=raw-sleep
+    """
+    ok = _lint_file(mini_repo, "spark_rapids_tpu/runtime/w3.py", src)
+    assert not _rule_hits(ok, "raw-sleep")
+
+
+# ----------------------------------------------------- unyielding-wait
+
+def test_unyielding_wait_positive(mini_repo):
+    bad = _lint_file(mini_repo, "spark_rapids_tpu/exec/operators.py", """
+        def fetch(result_q):
+            return result_q.get()
+    """)
+    assert len(_rule_hits(bad, "unyielding-wait")) == 1
+
+
+def test_unyielding_wait_negatives(mini_repo):
+    # timeout'd wait, cancel-aware function, singleton getter, and a
+    # module outside the permit-holding list are all clean
+    ok = _lint_file(mini_repo, "spark_rapids_tpu/exec/base.py", """
+        def fetch_bounded(result_q):
+            return result_q.get(timeout=5)
+
+        def fetch_cancellable(result_q, cancel_token):
+            cancel_token.check()
+            return result_q.get()
+
+        def singleton(sem):
+            return sem.get()
+    """)
+    assert not _rule_hits(ok, "unyielding-wait")
+    elsewhere = _lint_file(mini_repo, "spark_rapids_tpu/io/r.py", """
+        def fetch(result_q):
+            return result_q.get()
+    """)
+    assert not _rule_hits(elsewhere, "unyielding-wait")
+
+
+def test_unyielding_wait_acquire_and_join(mini_repo):
+    bad = _lint_file(mini_repo, "spark_rapids_tpu/shuffle/manager.py", """
+        def wait_all(lock, thread):
+            lock.acquire()
+            thread.join()
+    """)
+    assert len(_rule_hits(bad, "unyielding-wait")) == 2
+    ok = _lint_file(mini_repo, "spark_rapids_tpu/exec/fused.py", """
+        def try_lock(lock, thread):
+            lock.acquire(blocking=False)
+            thread.join(5.0)
+    """)
+    assert not _rule_hits(ok, "unyielding-wait")
+
+
+# -------------------------------------------------------- raw-transfer
+
+def test_raw_transfer_positive_and_instrumented(mini_repo):
+    bad = _lint_file(mini_repo, "spark_rapids_tpu/exec/up.py", """
+        import jax
+
+        def upload(batch):
+            return jax.device_put(batch)
+    """)
+    assert len(_rule_hits(bad, "raw-transfer")) == 1
+    ok = _lint_file(mini_repo, "spark_rapids_tpu/exec/up2.py", """
+        import jax
+        from spark_rapids_tpu.obs import telemetry
+
+        def upload(batch, nbytes):
+            out = jax.device_put(batch)
+            telemetry.record("h2d", "x.upload", nbytes)
+            return out
+    """)
+    assert not _rule_hits(ok, "raw-transfer")
+
+
+def test_raw_transfer_nested_closure_inherits_instrumentation(mini_repo):
+    ok = _lint_file(mini_repo, "spark_rapids_tpu/shuffle/manager.py", """
+        from spark_rapids_tpu.obs import telemetry
+
+        def put(table, path, pool):
+            telemetry.record("shuffle", "shuffle.write", 10)
+
+            def write():
+                with open(path, "wb") as f:
+                    f.write(table)
+
+            return pool.submit(write)
+    """)
+    assert not _rule_hits(ok, "raw-transfer")
+
+
+def test_raw_transfer_shuffle_binary_write_positive(mini_repo):
+    bad = _lint_file(mini_repo, "spark_rapids_tpu/shuffle/spiller.py", """
+        def spill(path, payload):
+            with open(path, "wb") as f:
+                f.write(payload)
+    """)
+    assert len(_rule_hits(bad, "raw-transfer")) == 1
+
+
+# ------------------------------------------------------- unknown-event
+
+def test_unknown_event_positive_and_negative(mini_repo):
+    bad = _lint_file(mini_repo, "spark_rapids_tpu/runtime/e.py", """
+        from spark_rapids_tpu.obs import events as obs_events
+
+        def go():
+            obs_events.emit("sanitizer.oops", a=1)
+    """)
+    assert len(_rule_hits(bad, "unknown-event")) == 1
+    ok = _lint_file(mini_repo, "spark_rapids_tpu/runtime/e2.py", """
+        from spark_rapids_tpu.obs import events as obs_events
+
+        def go():
+            obs_events.emit("sanitizer.deadlock", cycle=[])
+    """)
+    assert not _rule_hits(ok, "unknown-event")
+
+
+# -------------------------------------------------------- bare-except
+
+def test_bare_except_positive_and_negative(mini_repo):
+    bad = _lint_file(mini_repo, "spark_rapids_tpu/runtime/b.py", """
+        def f():
+            try:
+                return 1
+            except:
+                return 2
+    """)
+    assert len(_rule_hits(bad, "bare-except")) == 1
+    ok = _lint_file(mini_repo, "spark_rapids_tpu/runtime/b2.py", """
+        def f():
+            try:
+                return 1
+            except Exception:
+                return 2
+    """)
+    assert not _rule_hits(ok, "bare-except")
+
+
+# ------------------------------------------------------ engine-level
+
+def test_parse_error_is_a_finding(mini_repo):
+    findings = _lint_file(mini_repo, "spark_rapids_tpu/runtime/s.py",
+                          "def broken(:\n")
+    assert _rule_hits(findings, "parse-error")
+
+
+def test_enclosing_function_innermost_first(mini_repo):
+    path = mini_repo / "spark_rapids_tpu" / "runtime" / "nest.py"
+    path.write_text(textwrap.dedent("""
+        def outer():
+            def inner():
+                x = 1
+                return x
+            return inner
+    """))
+    ctx = FileContext.parse(str(path), "spark_rapids_tpu/runtime/nest.py")
+    fns = ctx.enclosing_functions(4)
+    assert [f.name for f in fns] == ["inner", "outer"]
+
+
+def test_real_tree_is_clean():
+    """The committed tree passes with zero findings — the same
+    invariant ci/static_check.sh gates on."""
+    engine = LintEngine(repo_root(), all_rules())
+    findings = engine.run()
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_rule_ids_stable():
+    assert {r.id for r in all_rules()} == {
+        "conf-registered", "conf-documented", "raw-sleep",
+        "unyielding-wait", "raw-transfer", "unknown-event",
+        "bare-except"}

@@ -317,9 +317,11 @@ def test_robustness_metrics_keys_unchanged():
     try:
         rm = s.robustness_metrics
         assert set(rm) == {"chaos", "retries", "shuffle", "scheduler",
-                           "degrade", "admission",
+                           "degrade", "admission", "sanitizer",
                            "artifactsQuarantined", "semaphoreTimeouts"}
         assert "queriesAdmitted" in rm["admission"]
+        assert set(rm["sanitizer"]) == {"cycles", "inversions",
+                                        "victims", "enabled"}
         assert set(rm["shuffle"]) == {"fetchRetries", "checksumFailures",
                                       "orphanedFiles",
                                       "speculativeDiscards"}

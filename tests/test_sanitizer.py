@@ -1,0 +1,417 @@
+"""Concurrency-sanitizer suite (PR 7): wait-for-graph cycle detection
+on edge insertion, victim selection + leak-free unwind through the
+cancel machinery, acquisition-order inversion warnings, the atomic
+per-query permit-group root fix, and disabled-mode inertness.
+
+The acceptance contract: a constructed 2- or 3-query permit cycle is
+detected the moment its closing edge is inserted; the victim unwinds
+with DeadlockDetectedError naming the cycle, leaving holders()==0 and
+check_leaks clean; an A->B then B->A acquisition order is flagged as
+an inversion WITHOUT a deadlock; and with the sanitizer disabled every
+hook is a None-check that records nothing.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.obs import events as obs_events
+from spark_rapids_tpu.runtime import sanitizer
+from spark_rapids_tpu.runtime.cancellation import CancelToken
+from spark_rapids_tpu.runtime.errors import DeadlockDetectedError
+from spark_rapids_tpu.runtime.sanitizer import (
+    ADMISSION,
+    SEMAPHORE,
+    ConcurrencySanitizer,
+    quota_resource,
+)
+from spark_rapids_tpu.runtime.semaphore import TpuSemaphore
+
+
+def _wait_until(pred, timeout_s=10.0, tick=0.002):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(tick)
+    return False
+
+
+@pytest.fixture
+def san():
+    s = ConcurrencySanitizer()
+    sanitizer.install(s)
+    yield s
+    sanitizer.install(None)
+
+
+# ------------------------------------------------ graph-level detection
+
+def test_two_query_cycle_detected_on_edge_insertion(san):
+    """q1 holds A and waits on B; q2 holds B. The cycle closes the
+    MOMENT q2's wait on A is inserted — no polling, no later sweep."""
+    res_a, res_b = ("semaphore", "a"), ("semaphore", "b")
+    t1, t2 = CancelToken(1), CancelToken(2)
+    san.acquired(res_a, 1)
+    san.acquired(res_b, 2)
+    rec1 = san.begin_wait(res_b, 1, token=t1)
+    assert san.counters.cycles == 0  # no cycle yet: q2 isn't waiting
+    san.begin_wait(res_a, 2, token=t2)
+    assert san.counters.cycles == 1
+    assert san.counters.victims == 1
+    # youngest policy: q2 is the victim
+    assert t2.cancelled and not t1.cancelled
+    with pytest.raises(DeadlockDetectedError) as ei:
+        t2.check()
+    msg = str(ei.value)
+    assert "wait-for cycle" in msg and "query 1" in msg \
+        and "query 2" in msg
+    assert san.last_cycle is not None
+    assert {r["queryId"] for r in san.last_cycle} == {1, 2}
+    san.end_wait(rec1)
+
+
+def test_three_query_cycle_detected(san):
+    """q1->B(q2), q2->C(q3), then the closing edge q3->A(q1)."""
+    a, b, c = [("semaphore", k) for k in "abc"]
+    tokens = {q: CancelToken(q) for q in (1, 2, 3)}
+    san.acquired(a, 1)
+    san.acquired(b, 2)
+    san.acquired(c, 3)
+    san.begin_wait(b, 1, token=tokens[1])
+    san.begin_wait(c, 2, token=tokens[2])
+    assert san.counters.cycles == 0
+    san.begin_wait(a, 3, token=tokens[3])
+    assert san.counters.cycles == 1
+    assert tokens[3].cancelled  # youngest
+    assert {r["queryId"] for r in san.last_cycle} == {1, 2, 3}
+
+
+def test_victim_policy_oldest():
+    s = ConcurrencySanitizer(victim_policy="oldest")
+    sanitizer.install(s)
+    try:
+        res_a, res_b = ("semaphore", "a"), ("semaphore", "b")
+        t1, t2 = CancelToken(1), CancelToken(2)
+        s.acquired(res_a, 1)
+        s.acquired(res_b, 2)
+        s.begin_wait(res_b, 1, token=t1)
+        s.begin_wait(res_a, 2, token=t2)
+        assert t1.cancelled and not t2.cancelled
+    finally:
+        sanitizer.install(None)
+
+
+def test_shared_resource_multi_holder_cycle(san):
+    """The real per-operator shape: ONE resource (the device
+    semaphore), both queries holding a chunk and both waiting for
+    more. Cycle detection must see through the shared-resource
+    aliasing."""
+    t1, t2 = CancelToken(1), CancelToken(2)
+    san.acquired(SEMAPHORE, 1)
+    san.acquired(SEMAPHORE, 2)
+    san.begin_wait(SEMAPHORE, 1, token=t1)
+    assert san.counters.cycles == 0
+    san.begin_wait(SEMAPHORE, 2, token=t2)
+    assert san.counters.cycles == 1 and t2.cancelled
+
+
+def test_no_cycle_no_victim(san):
+    """A plain waiter behind a running (non-waiting) holder is NOT a
+    deadlock."""
+    t1, t2 = CancelToken(1), CancelToken(2)
+    san.acquired(SEMAPHORE, 1)
+    rec = san.begin_wait(SEMAPHORE, 2, token=t2)
+    assert san.counters.cycles == 0
+    assert not t1.cancelled and not t2.cancelled
+    san.end_wait(rec)
+    san.released(SEMAPHORE, 1)
+    san.check_clean()
+
+
+def test_quota_soft_wait_closes_cross_class_cycle(san):
+    """Cross-class: q1 holds semaphore + spins on quota; q2 holds
+    quota bytes + waits on the semaphore. The quota side uses the
+    report_holders + note_contention soft path (what
+    SpillCatalog.reserve calls on a failed reservation)."""
+    t1, t2 = CancelToken(1), CancelToken(2)
+    quota = quota_resource()
+    san.acquired(SEMAPHORE, 1)
+    san.report_holders(quota, {2: time.monotonic()})
+    san.begin_wait(SEMAPHORE, 2, token=t2)
+    assert san.counters.cycles == 0
+    san.note_contention(quota, 1, token=t1)
+    assert san.counters.cycles == 1
+    assert t2.cancelled or t1.cancelled
+
+
+# ------------------------------------------------- order inversions
+
+def test_order_inversion_flagged_without_deadlock(san):
+    """semaphore-then-quota on one flow, quota-then-semaphore on
+    another: flagged once as an inversion, no cycle, no victim."""
+    quota = quota_resource("scoped")
+    san.acquired(SEMAPHORE, 1)
+    san.acquired(quota, 1)       # semaphore -> quota
+    san.released(quota, 1)
+    san.released(SEMAPHORE, 1)
+    assert san.counters.inversions == 0
+    san.acquired(quota, 2)
+    san.acquired(SEMAPHORE, 2)   # quota -> semaphore: inversion
+    assert san.counters.inversions == 1
+    assert san.counters.cycles == 0 and san.counters.victims == 0
+    assert ("quota", "semaphore") in {
+        tuple(sorted(p)) for p in san.inversions()}
+    # reported once per pair, not per occurrence
+    san.released(SEMAPHORE, 2)
+    san.released(quota, 2)
+    san.acquired(quota, 3)
+    san.acquired(SEMAPHORE, 3)
+    assert san.counters.inversions == 1
+    san.released(SEMAPHORE, 3)
+    san.released(quota, 3)
+    san.check_clean()
+
+
+# --------------------------------------- semaphore integration (legacy)
+
+def _acquire_as_query(semaphore, qid, task_id, token, errs, done):
+    """Run one acquire inside a query scope on this thread."""
+    from spark_rapids_tpu.runtime import cancellation
+
+    obs_events.begin_query(qid)
+    try:
+        with cancellation.scope(token):
+            semaphore.acquire_if_necessary(task_id)
+        done.append(task_id)
+    except BaseException as e:
+        errs.append((qid, task_id, e))
+    finally:
+        obs_events.finish_query(qid)
+
+
+def test_legacy_semaphore_deadlock_detected_and_unwound(san):
+    """Reconstruct the pre-fix wedge on a real TpuSemaphore (atomic
+    groups OFF): two queries each hold a 500-permit chunk, then each
+    needs a second chunk. The closing edge victimizes the youngest,
+    whose blocked acquire raises DeadlockDetectedError; everything
+    releases, holders()==0, sanitizer graph clean."""
+    semaphore = TpuSemaphore(concurrent_tasks=2, acquire_timeout_ms=0,
+                             atomic_query_groups=False)
+    t1, t2 = CancelToken(1), CancelToken(2)
+    errs, done = [], []
+
+    # each query's first chunk, on its own thread (thread-local scope)
+    th = [threading.Thread(
+        target=_acquire_as_query,
+        args=(semaphore, q, tid, tok, errs, done))
+        for q, tid, tok in ((1, 11, t1), (2, 21, t2))]
+    for t in th:
+        t.start()
+    for t in th:
+        t.join(10)
+    assert sorted(done) == [11, 21] and not errs
+
+    # nested second acquires: q1 blocks (no cycle yet) ...
+    th1 = threading.Thread(target=_acquire_as_query,
+                           args=(semaphore, 1, 12, t1, errs, done))
+    th1.start()
+    assert _wait_until(lambda: semaphore.waiting() == 1)
+    assert san.counters.cycles == 0
+    # ... q2's nested acquire inserts the closing edge
+    th2 = threading.Thread(target=_acquire_as_query,
+                           args=(semaphore, 2, 22, t2, errs, done))
+    th2.start()
+    th2.join(10)
+    assert san.counters.cycles == 1 and san.counters.victims == 1
+    # youngest query (2) was unwound with the cycle in the message
+    assert t2.cancelled and not t1.cancelled
+    assert len(errs) == 1 and errs[0][0] == 2
+    assert isinstance(errs[0][2], DeadlockDetectedError)
+    assert "wait-for cycle" in str(errs[0][2])
+    # survivor q2's FIRST chunk releases on unwind (what the cancel
+    # machinery does for a real query); q1's nested acquire proceeds
+    semaphore.release_if_necessary(21)
+    th1.join(10)
+    assert not th1.is_alive() and 12 in done
+    for tid in (11, 12):
+        semaphore.release_if_necessary(tid)
+    assert semaphore.holders() == 0
+    assert semaphore.waiting() == 0
+    san.check_clean()
+
+
+def test_atomic_groups_prevent_the_same_deadlock(san):
+    """Same schedule, atomic groups ON (the default): nested acquires
+    join the owning query's permit group instead of blocking — no
+    wait edge, no cycle, both queries complete."""
+    semaphore = TpuSemaphore(concurrent_tasks=2, acquire_timeout_ms=0,
+                             atomic_query_groups=True)
+    t1, t2 = CancelToken(1), CancelToken(2)
+    errs, done = [], []
+    for q, tid, tok in ((1, 11, t1), (2, 21, t2),
+                        (1, 12, t1), (2, 22, t2)):
+        th = threading.Thread(
+            target=_acquire_as_query,
+            args=(semaphore, q, tid, tok, errs, done))
+        th.start()
+        th.join(10)
+        assert not th.is_alive()
+    assert sorted(done) == [11, 12, 21, 22] and not errs
+    assert san.counters.cycles == 0 and san.counters.victims == 0
+    assert semaphore.query_holds(1) == 2 and semaphore.query_holds(2) == 2
+    for tid in (11, 12, 21, 22):
+        semaphore.release_if_necessary(tid)
+    assert semaphore.holders() == 0
+    san.check_clean()
+
+
+# ---------------------------------------------- end-to-end (session)
+
+def _fact_dir(tmp_path):
+    rng = np.random.default_rng(7)
+    n = 8_000
+    d = tmp_path / "fact"
+    d.mkdir()
+    pq.write_table(pa.table({
+        "k": pa.array(rng.integers(0, 50, n), pa.int64()),
+        "v": pa.array(rng.random(n) * 100.0),
+    }), str(d / "part-0.parquet"))
+    return str(d)
+
+
+def _concurrent_fallback_queries(s, data):
+    """The historical wedge: two concurrent queries whose plan has a
+    forced CPU-fallback Filter + repartition (per-operator permit
+    churn under the fused scaffold's hold)."""
+    import spark_rapids_tpu.api.functions as F
+
+    results, errs = [], []
+
+    def worker(i):
+        try:
+            df = (s.read.parquet(data)
+                  .filter(F.col("v") > 10.0)
+                  .repartition(4, "k").groupBy("k")
+                  .agg(F.sum("v").alias("sv")))
+            results.append((i, df.collect_arrow().num_rows))
+        except BaseException as e:  # surfaced to the asserting test
+            errs.append((i, e))
+
+    th = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    for t in th:
+        t.start()
+    for t in th:
+        t.join(120)
+    assert not any(t.is_alive() for t in th), \
+        "deadlock: a worker is still wedged"
+    return results, errs
+
+
+def test_e2e_atomic_groups_both_queries_complete(tmp_path):
+    from spark_rapids_tpu.api.session import TpuSparkSession
+    from spark_rapids_tpu.runtime import semaphore as sem_mod
+    from spark_rapids_tpu.runtime.memory import get_catalog
+
+    data = _fact_dir(tmp_path)
+    s = TpuSparkSession({
+        "spark.sql.shuffle.partitions": 4,
+        "spark.rapids.sql.exec.Filter": False,
+    })
+    try:
+        results, errs = _concurrent_fallback_queries(s, data)
+        assert not errs, errs
+        assert len(results) == 2
+        assert sem_mod.get().holders() == 0
+        get_catalog().check_leaks(raise_on_leak=True)
+    finally:
+        s.stop()
+
+
+def test_e2e_legacy_sanitizer_recovers_the_deadlock(tmp_path):
+    """Regression-gate the backstop path: atomic groups OFF, sanitizer
+    ON — the historical hang must end as either both-complete (victim
+    retried) or one clean DeadlockDetectedError, with a detected cycle
+    on the ledger and zero leaks."""
+    from spark_rapids_tpu.api.session import TpuSparkSession
+    from spark_rapids_tpu.runtime import semaphore as sem_mod
+    from spark_rapids_tpu.runtime.memory import get_catalog
+
+    data = _fact_dir(tmp_path)
+    s = TpuSparkSession({
+        "spark.sql.shuffle.partitions": 4,
+        "spark.rapids.sql.exec.Filter": False,
+        "spark.rapids.tpu.semaphore.atomicQueryGroups": False,
+        "spark.rapids.tpu.sanitizer.enabled": True,
+    })
+    try:
+        results, errs = _concurrent_fallback_queries(s, data)
+        for _i, e in errs:
+            assert isinstance(e, DeadlockDetectedError), e
+        assert len(results) + len(errs) == 2 and results
+        snap = sanitizer.counters()
+        assert snap["cycles"] >= 1 and snap["victims"] >= 1
+        assert sem_mod.get().holders() == 0
+        get_catalog().check_leaks(raise_on_leak=True)
+    finally:
+        s.stop()
+
+
+# ------------------------------------------------------ disabled mode
+
+def test_disabled_mode_is_inert():
+    """sanitizer.enabled=false: active() is None, counters stay a
+    zero view, and the semaphore hot path records nothing."""
+    sanitizer.install(None)  # a prior session may have configured one
+    assert sanitizer.active() is None
+    snap = sanitizer.counters()
+    assert snap == {"cycles": 0, "inversions": 0, "victims": 0,
+                    "enabled": False}
+    semaphore = TpuSemaphore(concurrent_tasks=2)
+    obs_events.begin_query(900)
+    try:
+        semaphore.acquire_if_necessary(1)
+        semaphore.release_if_necessary(1)
+    finally:
+        obs_events.finish_query(900)
+    # nothing was installed mid-flight by the instrumented paths
+    assert sanitizer.active() is None
+
+
+def test_disabled_mode_overhead_bounded():
+    """The disabled hook is one global load + None check per acquire;
+    guard the semaphore fast path against a sanitizer-shaped
+    regression with a generous wall-clock bound."""
+    semaphore = TpuSemaphore(concurrent_tasks=2)
+    n = 2_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        semaphore.acquire_if_necessary(i % 2)
+        semaphore.release_if_necessary(i % 2)
+    dt = time.perf_counter() - t0
+    assert dt < 2.0, f"{n} acquire/release pairs took {dt:.3f}s"
+
+
+def test_configure_from_conf():
+    from spark_rapids_tpu.config import rapids_conf as rc
+
+    class FakeConf:
+        def __init__(self, on, policy="oldest"):
+            self._v = {rc.SANITIZER_ENABLED.key: on,
+                       rc.SANITIZER_VICTIM_POLICY.key: policy}
+
+        def get(self, entry):
+            return self._v.get(entry.key, entry.default)
+
+    try:
+        assert sanitizer.configure(FakeConf(False)) is None
+        assert sanitizer.active() is None
+        san = sanitizer.configure(FakeConf(True, "oldest"))
+        assert san is sanitizer.active()
+        assert san.victim_policy == "oldest"
+    finally:
+        sanitizer.install(None)
