@@ -69,9 +69,9 @@ DUP_PER_STORE = 2          # duplicate-key dim: rows per store key
 # pages (io/parquet_plain.py stitches page payloads as zero-copy typed
 # views — no host decompress/unpack pass on this single-core host).
 # The CPU baseline reads the same files.
-DATA_DIR = f"/tmp/srtpu_bench_data_v6_{ROWS}"
-DIM_DIR = f"/tmp/srtpu_bench_data_v6_{ROWS}_dim"
-DUP_DIR = f"/tmp/srtpu_bench_data_v6_{ROWS}_dup"
+DATA_DIR = f"/tmp/srtpu_bench_data_v7_{ROWS}"
+DIM_DIR = f"/tmp/srtpu_bench_data_v7_{ROWS}_dim"
+DUP_DIR = f"/tmp/srtpu_bench_data_v7_{ROWS}_dup"
 
 # peak HBM bandwidth per chip, bytes/s: one source of truth with the
 # telemetry roofline accounting (obs/telemetry.py DEVICE_PEAK_BW)
@@ -116,8 +116,12 @@ def ensure_data() -> int:
         "opened_day": pa.array(rng.integers(0, 3650, STORES),
                                type=pa.int64()),
     })
+    # v7: the string dim column is DICTIONARY-encoded so the encoded
+    # execution path (columnar/encoding.py) engages — the region
+    # payload crosses the link as codes + one 12-entry dictionary,
+    # the canonical ROADMAP-item-2 beneficiary
     pq.write_table(dim, os.path.join(DIM_DIR, "dim-0.parquet"),
-                   compression="NONE", use_dictionary=False)
+                   compression="NONE", use_dictionary=["region"])
     # duplicate-key dimension (DUP_PER_STORE rows per store): an inner
     # join against it is row-EXPANDING, so the lookup-join uniqueness
     # bet loses by construction and the fused engine re-lowers through
@@ -452,6 +456,10 @@ def main():
     t0 = time.perf_counter()
     out = df.collect_arrow()  # cold: decode + upload + compiles
     cold_s = time.perf_counter() - t0
+    # the COLD collect is where the uploads (and the encoded
+    # representation's savings) happen — capture its ledger before the
+    # warm repeats overwrite last_execution
+    cold_telemetry = (spark.last_execution or {}).get("telemetry") or {}
     engine_used = spark.last_execution["engine"]
     cold_compile = spark.last_execution["compile"]
     assert out.num_rows == cpu_out.num_rows, (out.num_rows,
@@ -598,6 +606,67 @@ def main():
     except Exception as e:  # never lose the perf report
         print(f"# telemetry block unavailable: {e!r}", flush=True)
 
+    # ---- encoded-execution block (columnar/encoding.py): the
+    # ---- bytes-moved win of dictionary-resident columns, measured
+    # ---- two ways — the hot query's ledger savings/compression, and
+    # ---- a direct encoded-vs-plain upload of the string dim (the
+    # ---- canonical beneficiary): ROADMAP item 2's bytes-moved and
+    # ---- effective-compression metrics
+    encoded_block = None
+    try:
+        from spark_rapids_tpu.exec.fused import upload_narrowed
+        from spark_rapids_tpu.obs import telemetry as _tel
+
+        def h2d_bytes():
+            with _tel.ledger._lock:
+                cell = _tel.ledger.totals.get("h2d")
+                return cell["bytes"] if cell else 0
+
+        dim_enc_tbl = pq.read_table(DIM_DIR, read_dictionary=["region"])
+        dim_plain_tbl = pq.read_table(DIM_DIR)
+        b0 = h2d_bytes()
+        enc_batch = upload_narrowed(dim_enc_tbl)
+        dim_enc_bytes = h2d_bytes() - b0
+        enc_engaged = any(c.is_encoded for c in enc_batch.columns)
+        b0 = h2d_bytes()
+        upload_narrowed(dim_plain_tbl)
+        dim_plain_bytes = h2d_bytes() - b0
+        tel = hot_telemetry or {}
+        # effective roofline: the cold query DELIVERS the plain-
+        # equivalent bytes while physically moving fewer — the
+        # ROADMAP-item-2 "roofline_frac climbing" view of the win
+        saved = cold_telemetry.get("bytesSavedEncoded")
+        cold_rf = cold_telemetry.get("rooflineFrac")
+        cold_total = cold_telemetry.get("bytesMovedTotal")
+        eff_rf = (round(cold_rf * (cold_total + saved) / cold_total, 6)
+                  if saved and cold_rf and cold_total else None)
+        encoded_block = {
+            "engaged": enc_engaged,
+            # the canonical dim path: same table uploaded encoded vs
+            # decoded (encoded includes the one-time dictionary)
+            "dimUploadBytes": {"encoded": dim_enc_bytes,
+                               "plain": dim_plain_bytes},
+            "dimUploadRatio": (round(dim_plain_bytes
+                                     / dim_enc_bytes, 3)
+                               if dim_enc_bytes else None),
+            # cold-query ledger (where the uploads happen): bytes the
+            # encoded representation kept off the link/shuffle and the
+            # resulting compression of those columns
+            "bytesSavedEncoded": saved,
+            "effectiveCompressionRatio": cold_telemetry.get(
+                "effectiveCompressionRatio"),
+            "coldRooflineFrac": cold_rf,
+            "effectiveRooflineFrac": eff_rf,
+            "rooflineFracDelta": (round(eff_rf - cold_rf, 6)
+                                  if eff_rf is not None
+                                  and cold_rf is not None else None),
+            # steady-state (hot, device-cached) movement profile
+            "bytesMovedByDirection": tel.get("bytesMoved"),
+            "rooflineFrac": tel.get("rooflineFrac"),
+        }
+    except Exception as e:  # never lose the perf report
+        print(f"# encoded block unavailable: {e!r}", flush=True)
+
     # ---- obs attribution block: the perf trajectory should capture
     # ---- WHERE time went (top operators by device time, span-tree
     # ---- shape, event volume), not just the totals above
@@ -673,6 +742,10 @@ def main():
         # direction, HBM footprint, per-query roofline — BENCH_r06+
         # records what every bytes-moved optimization must improve
         "telemetry": telemetry_block,
+        # encoded execution (PR 8): dictionary-resident columns'
+        # bytes-moved win — encoded-vs-plain dim upload, per-query
+        # bytesSavedEncoded and effectiveCompressionRatio
+        "encoded": encoded_block,
         # event/span attribution (obs/): top operators by device time,
         # span-tree depth, event volume — regression triage data
         "obs": obs_block,

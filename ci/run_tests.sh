@@ -27,6 +27,9 @@ ci/concurrency_check.sh
 echo "== telemetry gate (ledger/eventlog consistency + HTTP) =="
 ci/telemetry_check.sh
 
+echo "== encoded-execution gate (bytes moved + oracle equality) =="
+ci/encoded_check.sh
+
 echo "== multichip dryrun (virtual mesh) =="
 SPARK_RAPIDS_TPU_DRYRUN_REEXEC=1 python - <<'PY'
 import jax

@@ -349,9 +349,18 @@ def column_from_arrow(arr, field, cap: int,
                       string_pad_min: int = 8) -> DeviceColumn:
     """One pyarrow array -> one capacity-padded host-numpy DeviceColumn
     (shared by arrow_to_device and the fused executor's narrowed
-    upload)."""
+    upload). THE encoding-aware entry point for dictionary columns:
+    low-cardinality strings upload as codes + a deduplicated device
+    dictionary (columnar/encoding.py); everything else decodes through
+    the ONE shared `encoding.dictionary_decode` so the two upload paths
+    can never disagree on null handling again."""
     if pa.types.is_dictionary(arr.type):
-        arr = arr.dictionary_decode()
+        from spark_rapids_tpu.columnar import encoding as _enc
+
+        enc_col = _enc.encoded_column_from_arrow(arr, field, cap)
+        if enc_col is not None:
+            return enc_col
+        arr = _enc.dictionary_decode(arr)
     if isinstance(field.dataType, StringType):
         mat, lengths = _string_to_matrix(arr, pad_to=string_pad_min)
         validity = np.asarray(arr.is_valid())
@@ -433,14 +442,33 @@ def arrow_to_device(table, capacity: Optional[int] = None,
     return out
 
 
-def device_to_arrow(batch: ColumnBatch) -> pa.Table:
+def _attached_dict_bytes(batch: ColumnBatch) -> int:
+    """Bytes of the DISTINCT dictionaries riding a batch's encoded
+    columns — they cross the link with the batch pytree, so D2H
+    accounting must include them (once per distinct dictionary)."""
+    seen = {}
+    for c in batch.columns:
+        dd = getattr(c, "encoding", None)
+        if dd is not None:
+            seen[dd.dict_id] = dd.size_bytes()
+    return sum(seen.values())
+
+
+def device_to_arrow(batch: ColumnBatch,
+                    encoded: bool = False) -> pa.Table:
     """Device ColumnBatch -> pyarrow Table (device->host boundary).
 
     Slices to the smallest capacity bucket ON DEVICE before the D2H
     copy: operators hand back full-capacity buffers (an aggregate over
     a 4M-row batch returns a 4M-capacity result holding 2K groups), and
     fetching dead capacity dominates wall time on PCIe — and utterly
-    dominates on tunneled devices."""
+    dominates on tunneled devices.
+
+    Encoded columns fetch as CODES + their (small) dictionary and
+    decode host-side — the link never carries decoded strings. With
+    `encoded=True` (the shuffle write path) the arrow output keeps them
+    as DictionaryArrays, so shuffle blocks carry codes + a per-block
+    dictionary reference instead of decoded values."""
     n = batch.row_count()
     small = next_capacity(n)
     if small < batch.capacity:
@@ -451,13 +479,14 @@ def device_to_arrow(batch: ColumnBatch) -> pa.Table:
     from spark_rapids_tpu.obs import telemetry
     from spark_rapids_tpu.runtime import host_alloc
 
-    nbytes = batch.device_size_bytes()
+    nbytes = batch.device_size_bytes() + _attached_dict_bytes(batch)
     with host_alloc.get().reserved(nbytes, pinned=True):
         t0 = time.monotonic_ns()
         host = jax.device_get(batch)
         telemetry.record("d2h", "collect", nbytes,
                          ns=time.monotonic_ns() - t0)
-    return _host_batch_to_arrow(batch.schema, host.columns, n)
+    return _host_batch_to_arrow(batch.schema, host.columns, n,
+                                encoded=encoded)
 
 
 def device_to_arrow_fused(batch: ColumnBatch, extra):
@@ -473,7 +502,7 @@ def device_to_arrow_fused(batch: ColumnBatch, extra):
     from spark_rapids_tpu.obs import telemetry
     from spark_rapids_tpu.runtime import host_alloc
 
-    nbytes = batch.device_size_bytes()
+    nbytes = batch.device_size_bytes() + _attached_dict_bytes(batch)
     with host_alloc.get().reserved(nbytes, pinned=True):
         t0 = time.monotonic_ns()
         host, host_extra = jax.device_get((batch, extra))
@@ -483,17 +512,43 @@ def device_to_arrow_fused(batch: ColumnBatch, extra):
     return _host_batch_to_arrow(host.schema, host.columns, n), host_extra
 
 
-def _host_batch_to_arrow(schema, host_columns, n: int) -> pa.Table:
+def _host_batch_to_arrow(schema, host_columns, n: int,
+                         encoded: bool = False) -> pa.Table:
     arrays = []
     names = []
     for field, col in zip(schema.fields, host_columns):
         names.append(field.name)
-        arrays.append(_host_column_to_array(field, col, n))
+        arrays.append(_host_column_to_array(field, col, n,
+                                            encoded=encoded))
     return pa.Table.from_arrays(arrays, names=names)
 
 
-def _host_column_to_array(field, col, n: int) -> pa.Array:
+def _host_column_to_array(field, col, n: int,
+                          encoded: bool = False) -> pa.Array:
     validity = np.asarray(col.validity[:n])
+    if getattr(col, "encoding", None) is not None:
+        # encoded column: the fetched leaves are [n] codes plus the
+        # shared dictionary — decode host-side (a numpy gather), or
+        # keep the DictionaryArray for the shuffle wire
+        dd = col.encoding
+        ddata = np.asarray(dd.data)
+        dlens = np.asarray(dd.lengths)
+        k = max(ddata.shape[0], 1)
+        codes = np.clip(np.asarray(col.data[:n]).astype(np.int64),
+                        0, k - 1)
+        if encoded:
+            from spark_rapids_tpu.columnar import encoding as _enc
+
+            values = _enc.dictionary_values(dd.dict_id)
+            if values is None:
+                values = _matrix_to_string(ddata, dlens,
+                                           np.ones(len(dlens), bool))
+            idx = pa.array(codes.astype(np.int32),
+                           mask=None if validity.all() else ~validity)
+            return pa.DictionaryArray.from_arrays(idx, values)
+        return _matrix_to_string(
+            ddata[codes], np.where(validity, dlens[codes], 0),
+            validity)
     if isinstance(field.dataType, StructType):
         if not field.dataType.fields:  # struct() with no fields
             return pa.array(

@@ -64,15 +64,20 @@ class DeviceColumn:
     elem_validity: [cap, max_elems] bool (arrays only): per-element nulls
     elem_lengths:  [cap, max_elems] int32 (array<string> only): per-
               element byte counts
+    encoding: DeviceDictionary (columnar/encoding.py) for DICTIONARY-
+              ENCODED string columns: `data` is then a [cap] vector of
+              integer codes into the shared device dictionary and
+              `lengths` is None; decode is deferred to the last
+              operator that needs materialized values.
     """
 
     __slots__ = ("dtype", "data", "validity", "lengths",
                  "elem_validity", "map_values", "vrange", "children",
-                 "elem_lengths")
+                 "elem_lengths", "encoding")
 
     def __init__(self, dtype: DataType, data, validity, lengths=None,
                  elem_validity=None, map_values=None, vrange=None,
-                 children=None, elem_lengths=None):
+                 children=None, elem_lengths=None, encoding=None):
         self.dtype = dtype
         self.data = data          # maps: the KEY matrix
         self.validity = validity
@@ -89,10 +94,17 @@ class DeviceColumn:
         # arrays; the cuDF nested-column role). `data` is a [cap] int8
         # placeholder carrying the capacity; row-level ops recurse.
         self.children = children
+        # DICTIONARY-ENCODED strings: the shared DeviceDictionary
+        # (columnar/encoding.py); data is then [cap] integer codes
+        self.encoding = encoding
 
     @property
     def is_string(self) -> bool:
         return isinstance(self.dtype, StringType)
+
+    @property
+    def is_encoded(self) -> bool:
+        return self.encoding is not None
 
     @property
     def is_array(self) -> bool:
@@ -106,7 +118,8 @@ class DeviceColumn:
 
     @property
     def max_bytes(self) -> Optional[int]:
-        return int(self.data.shape[1]) if self.is_string else None
+        return int(self.data.shape[1]) \
+            if self.is_string and self.data.ndim == 2 else None
 
     @property
     def max_elems(self) -> Optional[int]:
@@ -118,7 +131,9 @@ class DeviceColumn:
 
     def truncate(self, cap: int) -> "DeviceColumn":
         """Row-prefix view [:cap] of every per-row leaf (trace-safe;
-        static slice). Callers guarantee live rows fit in cap."""
+        static slice); the shared dictionary of an encoded column is
+        NOT row-shaped and rides unchanged. Callers guarantee live
+        rows fit in cap."""
         return DeviceColumn(
             self.dtype, self.data[:cap], self.validity[:cap],
             None if self.lengths is None else self.lengths[:cap],
@@ -129,7 +144,8 @@ class DeviceColumn:
             None if self.children is None
             else [c.truncate(cap) for c in self.children],
             None if self.elem_lengths is None
-            else self.elem_lengths[:cap])
+            else self.elem_lengths[:cap],
+            encoding=self.encoding)
 
     def device_size_bytes(self) -> int:
         n = self.data.size * self.data.dtype.itemsize
@@ -144,6 +160,10 @@ class DeviceColumn:
             n += self.elem_lengths.size * 4
         if self.children is not None:
             n += sum(c.device_size_bytes() for c in self.children)
+        # the dictionary of an encoded column is deliberately EXCLUDED:
+        # it is shared across every referencing batch and owned/charged
+        # by the encoding cache's own SpillCatalog reservation
+        # (columnar/encoding.py device_dictionary)
         return n
 
     def with_validity(self, validity) -> "DeviceColumn":
@@ -164,11 +184,15 @@ class DeviceColumn:
             kw.get("vrange", self.vrange),
             kw.get("children", self.children),
             kw.get("elem_lengths", self.elem_lengths),
+            encoding=kw.get("encoding", self.encoding),
         )
 
     def gather(self, indices) -> "DeviceColumn":
         """Row gather; indices must be in [0, capacity). Gathered values
-        are a subset, so the static vrange bound survives."""
+        are a subset, so the static vrange bound survives — and for an
+        encoded column only the [cap] CODES move (the dictionary is
+        shared, which is exactly why join payload gathers over encoded
+        strings are cheap)."""
         return DeviceColumn(
             self.dtype,
             jnp.take(self.data, indices, axis=0),
@@ -184,6 +208,7 @@ class DeviceColumn:
             else [c.gather(indices) for c in self.children],
             elem_lengths=None if self.elem_lengths is None
             else jnp.take(self.elem_lengths, indices, axis=0),
+            encoding=self.encoding,
         )
 
     def _tree_flatten(self):
@@ -196,6 +221,11 @@ class DeviceColumn:
             leaves.append(self.map_values)
         if self.elem_lengths is not None:
             leaves.append(self.elem_lengths)
+        if self.encoding is not None:
+            # DeviceDictionary is a registered pytree node; its aux
+            # carries the dict_id, so a different dictionary means a
+            # different treedef (and a retrace) by construction
+            leaves.append(self.encoding)
         if self.children is not None:
             # child DeviceColumns are registered pytree nodes; jax
             # recurses into them
@@ -205,11 +235,13 @@ class DeviceColumn:
                                self.map_values is not None, self.vrange,
                                len(self.children)
                                if self.children is not None else -1,
-                               self.elem_lengths is not None)
+                               self.elem_lengths is not None,
+                               self.encoding is not None)
 
     @classmethod
     def _tree_unflatten(cls, aux, children):
-        dtype, has_len, has_ev, has_mv, vrange, n_struct, has_el = aux
+        (dtype, has_len, has_ev, has_mv, vrange, n_struct, has_el,
+         has_enc) = aux
         it = iter(children)
         data = next(it)
         validity = next(it)
@@ -217,10 +249,11 @@ class DeviceColumn:
         ev = next(it) if has_ev else None
         mv = next(it) if has_mv else None
         el = next(it) if has_el else None
+        enc = next(it) if has_enc else None
         kids = ([next(it) for _ in range(n_struct)]
                 if n_struct >= 0 else None)
         return cls(dtype, data, validity, lengths, ev, mv, vrange, kids,
-                   el)
+                   el, encoding=enc)
 
 
 jax.tree_util.register_pytree_node(
@@ -476,7 +509,15 @@ def empty_like_schema(schema: StructType, capacity: int,
 def _concat_columns(pieces: List[Tuple[DeviceColumn, int]], cap: int,
                     total: int, dtype: DataType) -> DeviceColumn:
     """Concatenate per-batch column prefixes into one [cap] column
-    (recursing into struct children)."""
+    (recursing into struct children). Encoded pieces stay encoded only
+    when every piece shares ONE dictionary; any identity mismatch
+    decodes first (code spaces are not comparable across
+    dictionaries)."""
+    if any(c.encoding is not None for c, _ in pieces):
+        from spark_rapids_tpu.columnar import encoding as _enc
+
+        aligned = _enc.align_encodings([c for c, _ in pieces])
+        pieces = list(zip(aligned, (n for _, n in pieces)))
     first = pieces[0][0]
     if first.children is not None:
         kids = [
@@ -512,8 +553,14 @@ def _concat_columns(pieces: List[Tuple[DeviceColumn, int]], cap: int,
         mv = align_cat([c.map_values[:n] for c, n in pieces])
     if first.elem_lengths is not None:
         el = align_cat([c.elem_lengths[:n] for c, n in pieces])
-    return DeviceColumn(dtype, data, val, lens, ev, mv,
-                        elem_lengths=el)
+    # encoded columns keep their [0, K) code bound through concat (the
+    # binned group-by depends on it); plain columns keep the historical
+    # drop-vrange-at-concat behavior
+    vr = first.vrange if (
+        first.encoding is not None
+        and all(c.vrange == first.vrange for c, _ in pieces)) else None
+    return DeviceColumn(dtype, data, val, lens, ev, mv, vrange=vr,
+                        elem_lengths=el, encoding=first.encoding)
 
 
 def concat_batches(batches: List[ColumnBatch]) -> ColumnBatch:

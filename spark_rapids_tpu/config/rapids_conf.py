@@ -351,6 +351,35 @@ STRING_MAX_BYTES = conf(
     "device). Columns whose longest string exceeds the ceiling raise "
     "rather than silently truncate — raise the conf for pathological "
     "data.", int)
+ENCODED_ENABLED = conf(
+    "spark.rapids.tpu.encoded.enabled", True,
+    "Compressed (encoded) execution: low-cardinality string columns "
+    "stay DICTIONARY-ENCODED in HBM — the link carries narrow integer "
+    "codes plus one deduplicated device dictionary per distinct "
+    "content, filters/group-bys/joins lower onto codes where value "
+    "semantics allow, and decode defers to the last operator that "
+    "needs materialized strings (D2H collect, string-producing "
+    "expressions). false decodes every dictionary column at upload "
+    "(the pre-encoded behavior).", bool)
+ENCODED_READ_DICTIONARY = conf(
+    "spark.rapids.tpu.encoded.readDictionary.enabled", True,
+    "Request string columns from parquet as DICTIONARY arrays "
+    "(pyarrow read_dictionary) on device-path scans, so dictionary "
+    "pages flow to the device still encoded instead of being decoded "
+    "on the host. Only meaningful with spark.rapids.tpu.encoded."
+    "enabled; CPU-engine scans always read plain.", bool)
+ENCODED_MAX_DICT_ROWS = conf(
+    "spark.rapids.tpu.encoded.maxDictionaryRows", 1 << 16,
+    "Dictionaries with more distinct values than this upload DECODED "
+    "instead of encoded — past ~64K entries the codes stop paying for "
+    "the dictionary residency and the host-side intern/probe "
+    "bookkeeping.", int)
+ENCODED_DICT_CACHE_BYTES = conf(
+    "spark.rapids.tpu.encoded.dictCache.maxBytes", 256 << 20,
+    "Device-byte budget of the deduplicated dictionary cache "
+    "(columnar/encoding.py); each resident dictionary is charged to "
+    "the SpillCatalog's reservation ledger and the least-recently-"
+    "used entries release when the budget is exceeded.", int)
 SHUFFLE_MODE = conf(
     "spark.rapids.shuffle.mode", "MULTITHREADED",
     "MULTITHREADED (host-serialized, thread-pooled — reference "

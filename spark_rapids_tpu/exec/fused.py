@@ -179,8 +179,14 @@ def upload_narrowed(table: pa.Table, capacity: Optional[int] = None,
         col = table.column(i)
         arr = (col.chunk(0) if col.num_chunks else
                pa.array([], type=table.schema.field(i).type))
-        if pa.types.is_dictionary(arr.type):
-            arr = arr.dictionary_decode()
+        if pa.types.is_dictionary(arr.type) and not isinstance(
+                field.dataType, StringType):
+            # non-string dictionaries decode through the ONE shared
+            # entry point (string dictionaries fall through to
+            # column_from_arrow, which uploads them ENCODED)
+            from spark_rapids_tpu.columnar import encoding as _enc
+
+            arr = _enc.dictionary_decode(arr)
         dt = field.dataType
         np_dt = getattr(dt, "np_dtype", None)
         if (narrow and np_dt is not None
@@ -642,9 +648,16 @@ class FusedSingleChipExecutor:
         src_parts = self._src_parts
 
         def shapes_key(batches):
+            from spark_rapids_tpu.columnar import encoding as _enc
+
+            # dictionary identities ride the key: trace-time host
+            # probes (predicate code rewrites, remap tables) bake
+            # dictionary CONTENT into a program, so a persistent/AOT
+            # artifact must never serve a different dictionary
             return tuple(
-                tuple((tuple(leaf.shape), str(leaf.dtype))
-                      for leaf in jax.tree_util.tree_leaves(b))
+                (tuple((tuple(leaf.shape), str(leaf.dtype))
+                       for leaf in jax.tree_util.tree_leaves(b)),
+                 _enc.encoding_key(b))
                 for b in batches)
 
         def run_program(key_tag, nodes_key, fn, inputs,

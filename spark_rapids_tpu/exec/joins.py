@@ -263,6 +263,35 @@ class _DeviceJoinBase(PhysicalPlan):
 
     # --- empty-side handling shared by hash joins ---
 
+    def _encoded_key_rewrite(self, left: ColumnBatch,
+                             right: ColumnBatch):
+        """Encoded-execution join-key lowering: when BOTH sides of an
+        equi-key are dictionary-encoded columns, compare CODES instead
+        of decoded strings. Dictionary identity is checked host-side;
+        a mismatched build dictionary RE-ENCODES into the probe's code
+        space through a host remap table (encoding.CodesOf) — only
+        when neither applies do the keys fall back to the in-device
+        decode inside the key transform. Returns (left_keys,
+        right_keys), possibly rewritten."""
+        from spark_rapids_tpu.columnar import encoding as enc
+
+        lkeys = list(self.left_keys)
+        rkeys = list(self.right_keys)
+        for i, (lk, rk) in enumerate(zip(lkeys, rkeys)):
+            if not (isinstance(lk, BoundReference)
+                    and isinstance(rk, BoundReference)):
+                continue
+            le = getattr(left.columns[lk.ordinal], "encoding", None)
+            re_ = getattr(right.columns[rk.ordinal], "encoding", None)
+            if le is None or re_ is None:
+                continue
+            if re_.dict_id != le.dict_id and \
+                    enc.remap_table(re_.dict_id, le.dict_id) is None:
+                continue  # host dictionary evicted: decode fallback
+            lkeys[i] = enc.CodesOf(lk, le.dict_id)
+            rkeys[i] = enc.CodesOf(rk, le.dict_id)
+        return lkeys, rkeys
+
     def _join_batches(self, left_batches, right_batches,
                       prepared_bt: Optional[joinops.BuildTable] = None
                       ) -> Optional[ColumnBatch]:
@@ -289,10 +318,16 @@ class _DeviceJoinBase(PhysicalPlan):
             if jt in ("left", "full"):
                 return self._right_nulls_batch(left, rsch)
             return None
+        lkeys, rkeys = self.left_keys, self.right_keys
+        if prepared_bt is None:
+            # a shared prepared build table was sorted on the ORIGINAL
+            # key transform; the codes rewrite only applies when this
+            # call builds its own table from both sides in hand
+            lkeys, rkeys = self._encoded_key_rewrite(left, right)
         bt = prepared_bt if prepared_bt is not None \
-            else self._build_table(right)
+            else self._build_table(right, keys=rkeys)
         left = self._bloom_prefilter(left, right, jt)
-        work_l, lk = self._prepare_keys(left, self.left_keys)
+        work_l, lk = self._prepare_keys(left, lkeys)
         lo, counts = joinops.probe_ranges(bt, work_l, lk)
         if self.condition is None:
             return self._fast_equi_join(left, bt, lo, counts)
@@ -346,9 +381,12 @@ class _DeviceJoinBase(PhysicalPlan):
                            [c.truncate(cap2) for c in reduced.columns],
                            n)
 
-    def _build_table(self, right: ColumnBatch) -> joinops.BuildTable:
+    def _build_table(self, right: ColumnBatch,
+                     keys=None) -> joinops.BuildTable:
         rsch = self.children[1].schema
-        work_r, rk = self._prepare_keys(right, self.right_keys)
+        work_r, rk = self._prepare_keys(right,
+                                        keys if keys is not None
+                                        else self.right_keys)
         bt = joinops.build_side(work_r, rk)
         if len(bt.batch.columns) != len(right.columns):
             # strip temp key columns from the (sorted) build batch

@@ -26,6 +26,7 @@ Operator -> reference mapping:
 from __future__ import annotations
 
 import itertools
+from contextlib import closing
 from typing import Dict, Iterator, List, Optional, Sequence
 
 import jax
@@ -175,6 +176,11 @@ class TpuFileScanExec(PhysicalPlan):
         self._batch_rows = conf.get(rc.MAX_READER_BATCH_SIZE_ROWS)
         self._nthreads = conf.get(rc.MULTITHREADED_READ_NUM_THREADS)
         self._strategy = conf.get(rc.PARQUET_READER_TYPE)
+        # encoded execution: request string columns as DICTIONARY
+        # arrays from parquet so low-cardinality columns arrive as
+        # codes and upload encoded (spark.rapids.tpu.encoded.*)
+        self._read_dict = (conf.get(rc.ENCODED_ENABLED)
+                           and conf.get(rc.ENCODED_READ_DICTIONARY))
         coalesce_bytes = conf.get(rc.READER_COALESCE_BYTES)
         self._part_spec = self.options.get("partition_spec")
         if fmt in ("iceberg", "delta"):
@@ -290,12 +296,32 @@ class TpuFileScanExec(PhysicalPlan):
             names.append(name)
         return pa.table(dict(zip(names, arrays)))
 
+    def _dict_columns(self, cols) -> Optional[List[str]]:
+        """String columns to read as parquet DICTIONARY arrays — only
+        on the device path (self.is_tpu): the CPU engine and oracle
+        keep plain string chunks."""
+        from spark_rapids_tpu.sqltypes import StringType as _Str
+
+        if not self._read_dict or not self.is_tpu \
+                or self.fmt != "parquet":
+            return None
+        part_names = (set()
+                      if self._part_spec is None
+                      else {n for n, _ in self._part_spec[0]})
+        out = [f.name for f in self.schema.fields
+               if isinstance(f.dataType, _Str)
+               and f.name not in part_names
+               and (cols is None or f.name in cols)]
+        return out or None
+
     def _host_tables(self, files) -> Iterator[pa.Table]:
         cols = self.pushed_columns
         if self.fmt == "parquet" and self._part_spec is not None:
             part_names = {n for n, _ in self._part_spec[0]}
             data_cols = None if cols is None else [
                 c for c in cols if c not in part_names]
+
+            rd = self._dict_columns(data_cols)
 
             def gen():
                 for f in files:
@@ -306,10 +332,11 @@ class TpuFileScanExec(PhysicalPlan):
                     if self.pushed_filters:
                         it = readers.read_parquet_task_filtered(
                             [f], data_cols, self._batch_rows,
-                            self.pushed_filters)
+                            self.pushed_filters, read_dictionary=rd)
                     else:
                         it = readers.read_parquet_task(
-                            [f], data_cols, self._batch_rows)
+                            [f], data_cols, self._batch_rows,
+                            read_dictionary=rd)
                     for t in it:
                         yield self._append_partition_columns(t, f)
 
@@ -325,14 +352,18 @@ class TpuFileScanExec(PhysicalPlan):
             ctx = self.options["delta_ctx"]
             return iter([read_data_file(ctx, f, cols) for f in files])
         if self.fmt == "parquet":
+            rd = self._dict_columns(cols)
             if self._strategy == "MULTITHREADED":
                 return readers.read_parquet_multithreaded(
                     files, cols, self._batch_rows, self._nthreads,
-                    filters=self.pushed_filters)
+                    filters=self.pushed_filters, read_dictionary=rd)
             if self.pushed_filters:
                 return readers.read_parquet_task_filtered(
-                    files, cols, self._batch_rows, self.pushed_filters)
-            return readers.read_parquet_task(files, cols, self._batch_rows)
+                    files, cols, self._batch_rows, self.pushed_filters,
+                    read_dictionary=rd)
+            return readers.read_parquet_task(files, cols,
+                                             self._batch_rows,
+                                             read_dictionary=rd)
         if self.fmt == "csv":
             return iter([readers.read_csv(f) for f in files])
         if self.fmt == "json":
@@ -416,8 +447,12 @@ class TpuProjectExec(PhysicalPlan):
             conf, [a for a in exprs], ("project", aliases_key(exprs)))
 
     def _run(self, batch: ColumnBatch) -> ColumnBatch:
+        from spark_rapids_tpu.columnar import encoding as _enc
+
         ctx = EvalContext(batch)
-        cols = [e.eval(ctx) for e in self.exprs]
+        # eval_preserving: bare column selections pass dictionary-
+        # encoded columns through UNdecoded (late materialization)
+        cols = [_enc.eval_preserving(e, ctx) for e in self.exprs]
         return ColumnBatch(self.schema, cols, batch.num_rows)
 
     def execute_partition(self, pid, ctx):
@@ -819,10 +854,15 @@ class TpuHashAggregateExec(PhysicalPlan):
         return ranges
 
     def _partial(self, batch: ColumnBatch, live=None) -> ColumnBatch:
+        from spark_rapids_tpu.columnar import encoding as _encoding
+
         nkeys = len(self.grouping)
-        # evaluate grouping + agg inputs into a working batch
+        # evaluate grouping + agg inputs into a working batch;
+        # eval_preserving keeps dictionary-encoded group keys as CODES
+        # (their [0, K) vrange then rides the sort-free binned path)
         ctx = EvalContext(batch)
-        work_cols = [g.eval(ctx) for g in self.grouping]
+        work_cols = [_encoding.eval_preserving(g, ctx)
+                     for g in self.grouping]
         # each aggregate may take 0 (count(*)), 1, or 2+ (corr/covar)
         # input expressions
         input_groups = []
@@ -847,15 +887,17 @@ class TpuHashAggregateExec(PhysicalPlan):
         g = self._grouped(work, list(range(nkeys)), live)
         cap = work.capacity
         out_cols: List[DeviceColumn] = []
-        # group key columns: first row of each segment
+        # group key columns: first row of each segment (gather keeps
+        # every leaf — including the dictionary of an encoded key;
+        # plain keys keep the historical vrange drop so their treedefs
+        # — and the compiled-program cache keyed on them — are stable)
         for ki in range(nkeys):
             col = g.sorted_batch.columns[ki]
             safe = jnp.clip(g.first_pos, 0, cap - 1)
-            out_cols.append(DeviceColumn(
-                col.dtype, jnp.take(col.data, safe, axis=0),
-                jnp.take(col.validity, safe),
-                None if col.lengths is None
-                else jnp.take(col.lengths, safe)))
+            out = col.gather(safe)
+            if out.encoding is None and out.vrange is not None:
+                out = out.replace(vrange=None)
+            out_cols.append(out)
         ci = nkeys
         for a, grp in zip(self.aggs, input_groups):
             fn: AggregateFunction = a.children[0]
@@ -917,10 +959,14 @@ class TpuHashAggregateExec(PhysicalPlan):
                 stride_i *= base
                 col = work.columns[ki]
                 # lo-1 is the null bin's decoded placeholder, so the
-                # stamped bound includes it
+                # stamped bound includes it. An ENCODED key column's
+                # analytic decode is its CODE (vrange [0, K)) — the
+                # dictionary handle rides along so the key stays
+                # encoded until something truly needs the strings.
                 out_cols.append(DeviceColumn(
                     col.dtype, (code - 1 + lo).astype(col.data.dtype),
-                    code > 0, vrange=(lo - 1, hi)))
+                    code > 0, vrange=(lo - 1, hi),
+                    encoding=col.encoding))
             ci = nkeys
             fast = self._binned_all_sums(input_groups, live, gid, bcap,
                                          work, ci)
@@ -1043,10 +1089,13 @@ class TpuHashAggregateExec(PhysicalPlan):
         for ki in range(nkeys):
             col = g.sorted_batch.columns[ki]
             safe = jnp.clip(g.first_pos, 0, cap - 1)
-            out_cols.append(DeviceColumn(
-                col.dtype, jnp.take(col.data, safe, axis=0),
-                jnp.take(col.validity, safe),
-                None if col.lengths is None else jnp.take(col.lengths, safe)))
+            # gather keeps every leaf (dictionary encodings included);
+            # plain keys keep the historical vrange drop (stable
+            # treedefs for the compiled-program cache)
+            out = col.gather(safe)
+            if out.encoding is None and out.vrange is not None:
+                out = out.replace(vrange=None)
+            out_cols.append(out)
         return out_cols
 
     def _merge_final(self, batch: ColumnBatch) -> ColumnBatch:
@@ -1102,8 +1151,15 @@ class TpuHashAggregateExec(PhysicalPlan):
         def park(b):
             return retry_on_oom(lambda: catalog.add_batch(b))
 
-        with self.timed(M.AGG_TIME):
-            pending = PendingBatches()  # spillable buffer-schema batches
+        pending = PendingBatches()  # spillable buffer-schema batches
+        # closing(): a cancel or non-retry failure that unwinds past
+        # the with_restore_on_retry boundary must still unregister the
+        # batches parked in EARLIER iterations (restore only rolls back
+        # to the last input boundary), and an abandoned generator (a
+        # LIMIT that stops consuming) must not strand its parked
+        # batches either. close() is idempotent; the normal paths
+        # close before yielding.
+        with self.timed(M.AGG_TIME), closing(pending):
 
             def reduce_pending():
                 def step():
@@ -1579,8 +1635,11 @@ class TpuShuffleExchangeExec(PhysicalPlan):
                             np.array([0, batch.row_count()], np.int64),
                             staged_dev)
                     else:
+                        # encoded=True: dictionary columns cross the
+                        # shuffle as codes + a per-block dictionary
+                        # reference, not decoded values
                         mgr.put(self._shuffle_id, 0,
-                                device_to_arrow(batch),
+                                device_to_arrow(batch, encoded=True),
                                 map_id=cpid, attempt=attempt)
                     continue
                 sorted_batch, counts = self._jit_partition(batch)
@@ -1590,7 +1649,7 @@ class TpuShuffleExchangeExec(PhysicalPlan):
                     self._park_device_block(sorted_batch, offs,
                                             staged_dev)
                     continue
-                host = device_to_arrow(sorted_batch)
+                host = device_to_arrow(sorted_batch, encoded=True)
                 for rp in range(self._nparts):
                     lo, hi = int(offs[rp]), int(offs[rp + 1])
                     if hi > lo:

@@ -113,7 +113,19 @@ class BoundReference(Expression):
         return self._nullable
 
     def eval(self, ctx: EvalContext) -> DeviceColumn:
-        return ctx.batch.columns[self.ordinal]
+        col = ctx.batch.columns[self.ordinal]
+        if getattr(col, "encoding", None) is not None:
+            # dictionary-encoded columns DECODE here by default, so
+            # every downstream expression sees the standard string
+            # layout without auditing each one. The consumers that can
+            # run on codes (grouping, bare-column projections, the
+            # equality/IN/null predicate probes, CodesOf join keys)
+            # bypass eval() and read the batch column directly
+            # (columnar/encoding.py raw_column / eval_preserving).
+            from spark_rapids_tpu.columnar import encoding as _enc
+
+            return _enc.decode_column(col)
+        return col
 
     def key(self):
         return ("ref", self.ordinal, repr(self._dtype))

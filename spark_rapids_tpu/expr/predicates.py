@@ -163,6 +163,16 @@ def _pad_string(col: DeviceColumn, mb: int) -> DeviceColumn:
 
 class EqualTo(BinaryComparison):
     def eval(self, ctx):
+        from spark_rapids_tpu.columnar import encoding as _enc
+
+        # encoded fast path: `<dictionary column> = <string literal>`
+        # compares CODES against one host-probed code — the filter
+        # lowering that keeps compressed execution compressed (In and
+        # != via Not(EqualTo) compose through this same path)
+        fast = _enc.encoded_equality(self.children[0],
+                                     self.children[1], ctx)
+        if fast is not None:
+            return fast
         lc, rc = self._operands(ctx)
         # Spark EqualTo on floats: NaN == NaN is TRUE (total order), and
         # -0.0 == 0.0 is TRUE (IEEE ==). Use IEEE eq for numerics, key eq
@@ -296,7 +306,13 @@ class IsNull(Expression):
         return False
 
     def eval(self, ctx):
-        c = self.children[0].eval(ctx)
+        from spark_rapids_tpu.columnar import encoding as _enc
+
+        # validity needs no decode: read the raw column when the child
+        # is a bare reference (keeps encoded columns encoded)
+        c = _enc.raw_column(self.children[0], ctx)
+        if c is None:
+            c = self.children[0].eval(ctx)
         return DeviceColumn(boolean, ~c.validity,
                             jnp.ones(c.validity.shape, bool))
 
@@ -314,7 +330,11 @@ class IsNotNull(Expression):
         return False
 
     def eval(self, ctx):
-        c = self.children[0].eval(ctx)
+        from spark_rapids_tpu.columnar import encoding as _enc
+
+        c = _enc.raw_column(self.children[0], ctx)
+        if c is None:
+            c = self.children[0].eval(ctx)
         return DeviceColumn(boolean, c.validity,
                             jnp.ones(c.validity.shape, bool))
 

@@ -11,10 +11,18 @@ buffer per column (a single memcpy), and hand zero-copy typed views to
 the uploader. No pyarrow decode pass, which matters: scan hosts can be
 a single core while the device does the real work.
 
-Column chunks that are compressed, dictionary-encoded, nested, or
-contain nulls fall back to the normal pyarrow reader per chunk — the
-same per-file fallback discipline the reference applies when its native
-footer parser cannot handle a file (GpuParquetScan.scala:221-240).
+Column chunks that are compressed, nested, or contain nulls fall back
+to the normal pyarrow reader per chunk — the same per-file fallback
+discipline the reference applies when its native footer parser cannot
+handle a file (GpuParquetScan.scala:221-240). DICTIONARY-encoded
+chunks also fall back here, but no longer host-decode: the general
+reader requests them as arrow DictionaryArrays
+(io/readers.py read_dictionary, conf
+spark.rapids.tpu.encoded.readDictionary.enabled) and they upload
+ENCODED — codes plus a deduplicated device dictionary
+(columnar/encoding.py) — so only PLAIN pages take this module's
+zero-copy path and dictionary pages take the compressed-execution
+path.
 
 The page-header parser below implements the minimal thrift compact
 protocol subset PageHeader needs; it is written against the parquet

@@ -120,12 +120,19 @@ def split_parquet_tasks(paths: List[str], coalesce_target_bytes: int
 
 
 def read_parquet_task(files: List[str], columns: Optional[List[str]],
-                      batch_rows: int) -> Iterator[pa.Table]:
+                      batch_rows: int,
+                      read_dictionary: Optional[List[str]] = None
+                      ) -> Iterator[pa.Table]:
     """Decode one task's files, yielding row-capped tables (the chunked
-    reader analog, GpuParquetScan.scala:2674)."""
+    reader analog, GpuParquetScan.scala:2674). `read_dictionary` names
+    columns to surface as pyarrow DictionaryArrays — parquet dictionary
+    pages then flow to the device still encoded
+    (spark.rapids.tpu.encoded.readDictionary.enabled)."""
     for f in files:
-        pf = _open_retry(lambda f=f: pq.ParquetFile(f),
-                         f"parquet open {f}")
+        pf = _open_retry(
+            lambda f=f: pq.ParquetFile(f,
+                                       read_dictionary=read_dictionary),
+            f"parquet open {f}")
         for rb in pf.iter_batches(batch_size=batch_rows, columns=columns):
             yield pa.Table.from_batches([rb])
 
@@ -138,7 +145,9 @@ def read_parquet_multithreaded(files: List[str],
                                batch_rows: int,
                                num_threads: int,
                                filters=None,
-                               queue_depth: int = 4) -> Iterator[pa.Table]:
+                               queue_depth: int = 4,
+                               read_dictionary: Optional[List[str]]
+                               = None) -> Iterator[pa.Table]:
     """MULTITHREADED strategy: a shared-pool thread decodes this task's
     batches into a bounded queue so fetch+decode overlaps the consumer's
     device compute (MultiFileCloudParquetPartitionReader analog,
@@ -153,9 +162,12 @@ def read_parquet_multithreaded(files: List[str],
 
     def produce():
         try:
-            src = (read_parquet_task_filtered(files, columns, batch_rows,
-                                              filters) if filters
-                   else read_parquet_task(files, columns, batch_rows))
+            src = (read_parquet_task_filtered(
+                       files, columns, batch_rows, filters,
+                       read_dictionary=read_dictionary) if filters
+                   else read_parquet_task(
+                       files, columns, batch_rows,
+                       read_dictionary=read_dictionary))
             for t in src:
                 # bounded put that gives up if the consumer abandoned the
                 # iterator (e.g. LIMIT stopped early) — otherwise this
@@ -293,17 +305,22 @@ def _row_group_may_match(rg_meta, filters, schema: pa.Schema) -> bool:
 def read_parquet_task_filtered(files: List[str],
                                columns: Optional[List[str]],
                                batch_rows: int,
-                               filters) -> Iterator[pa.Table]:
+                               filters,
+                               read_dictionary: Optional[List[str]]
+                               = None) -> Iterator[pa.Table]:
     """Parquet read with row-group statistics pruning via pushed filter
     tuples (reference predicate pushdown, GpuParquetScan.scala:556).
     Surviving row groups stream through the chunked reader — the whole
     file is never materialized."""
     if not filters:
-        yield from read_parquet_task(files, columns, batch_rows)
+        yield from read_parquet_task(files, columns, batch_rows,
+                                     read_dictionary=read_dictionary)
         return
     for f in files:
-        pf = _open_retry(lambda f=f: pq.ParquetFile(f),
-                         f"parquet open {f}")
+        pf = _open_retry(
+            lambda f=f: pq.ParquetFile(f,
+                                       read_dictionary=read_dictionary),
+            f"parquet open {f}")
         keep = [i for i in range(pf.num_row_groups)
                 if _row_group_may_match(pf.metadata.row_group(i), filters,
                                         pf.schema_arrow)]

@@ -81,7 +81,7 @@ class _QueryLedger:
     """Per-query accumulation (one per queryId, bounded LRU)."""
 
     __slots__ = ("by_direction", "by_site", "hbm_peak", "hbm_current",
-                 "spill_pressure", "final")
+                 "spill_pressure", "final", "enc_actual", "enc_plain")
 
     def __init__(self):
         self.by_direction: Dict[str, Dict[str, int]] = {}
@@ -90,6 +90,10 @@ class _QueryLedger:
         self.hbm_current = 0
         self.spill_pressure = 0
         self.final: Optional[dict] = None  # end-of-query summary
+        # encoded execution: bytes actually staged for encoded columns
+        # vs what the decoded representation would have staged
+        self.enc_actual = 0
+        self.enc_plain = 0
 
 
 class TransferLedger:
@@ -108,6 +112,9 @@ class TransferLedger:
         self.hbm_peak = 0
         self.pressure_events = 0
         self.timeline: deque = deque(maxlen=_TIMELINE_KEEP)
+        # encoded-execution savings (process totals)
+        self.enc_actual = 0
+        self.enc_plain = 0
 
     # --- transfer recording ---
 
@@ -137,6 +144,26 @@ class TransferLedger:
         if emit:
             _events.emit("transfer", direction=direction, site=site,
                          bytes=int(nbytes), ns=int(ns))
+
+    def record_encoded(self, site: str, actual_bytes: int,
+                       plain_bytes: int,
+                       query_id: Optional[int] = None) -> None:
+        """Account one encoded-representation saving: `actual_bytes`
+        is what the encoded column stages for transfer, `plain_bytes`
+        what its decoded padded layout would have staged. Feeds the
+        per-query bytesSavedEncoded / effectiveCompressionRatio
+        summary fields (ROADMAP item 2's effective-compression
+        metric)."""
+        if not self.enabled or plain_bytes <= 0:
+            return
+        qid = query_id if query_id is not None \
+            else _events.effective_query_id()
+        with self._lock:
+            self.enc_actual += int(actual_bytes)
+            self.enc_plain += int(plain_bytes)
+            q = self._query(qid)
+            q.enc_actual += int(actual_bytes)
+            q.enc_plain += int(plain_bytes)
 
     def record_forwarded(self, fields: dict,
                          query_id: Optional[int] = None) -> None:
@@ -212,6 +239,8 @@ class TransferLedger:
                 s: dict(c) for s, c in q.by_site.items()}
             hbm_peak = 0 if q is None else q.hbm_peak
             pressure = 0 if q is None else q.spill_pressure
+            enc_actual = 0 if q is None else q.enc_actual
+            enc_plain = 0 if q is None else q.enc_plain
         total = sum(c["bytes"] for c in by_dir.values())
         link = sum(by_dir.get(d, _cell())["bytes"]
                    for d in ("h2d", "d2h"))
@@ -223,6 +252,13 @@ class TransferLedger:
             "hbmPeakBytes": hbm_peak,
             "spillPressureEvents": pressure,
         }
+        if enc_plain > 0 and enc_actual > 0:
+            # encoded execution's measured win: bytes the dictionary
+            # representation kept OFF the staging/transfer paths, and
+            # the resulting effective compression of those columns
+            out["bytesSavedEncoded"] = enc_plain - enc_actual
+            out["effectiveCompressionRatio"] = round(
+                enc_plain / enc_actual, 3)
         if output_rows:
             out["bytesPerOutputRow"] = round(total / output_rows, 3)
         if wall_s and wall_s > 0:
@@ -270,6 +306,10 @@ class TransferLedger:
                                for d, c in self.totals.items()},
                 "transfers": {d: c["count"]
                               for d, c in self.totals.items()},
+                "encoded": {"actualBytes": self.enc_actual,
+                            "plainBytes": self.enc_plain,
+                            "savedBytes": max(
+                                0, self.enc_plain - self.enc_actual)},
             }
 
     def site_rows(self) -> List[dict]:
@@ -289,6 +329,7 @@ ledger = TransferLedger()
 
 # module-level aliases: instrumented sites stay one short call
 record = ledger.record
+record_encoded = ledger.record_encoded
 record_forwarded = ledger.record_forwarded
 hbm_global = ledger.hbm_global
 hbm_query = ledger.hbm_query

@@ -94,12 +94,35 @@ def normalize_floating(col: DeviceColumn) -> DeviceColumn:
 
 
 def orderable_keys(col: DeviceColumn, ascending: bool, nulls_first: bool,
-                   live: jnp.ndarray) -> List[jnp.ndarray]:
+                   live: jnp.ndarray,
+                   codes_ok: bool = False) -> List[jnp.ndarray]:
     """Lower one column (+ sort direction) to signed-orderable int64 keys.
 
     Returns [null_rank_key, value_key...]; dead rows always rank last
     regardless of direction.
+
+    Dictionary-ENCODED columns: with `codes_ok` (equality-only
+    contexts — grouping, where only tuple EQUALITY matters and interned
+    dictionaries guarantee code equality == value equality) the key is
+    the raw code vector; otherwise the column decodes in-device first
+    so the order is the true lexicographic string order.
     """
+    if getattr(col, "encoding", None) is not None:
+        if codes_ok:
+            valid = col.validity
+            if nulls_first:
+                rank = jnp.where(valid, 1, 0)
+            else:
+                rank = jnp.where(valid, 0, 1)
+            rank = jnp.where(live, rank, 2).astype(jnp.int64)
+            vals = [jnp.where(valid & live,
+                              col.data.astype(jnp.int64), 0)]
+            if not ascending:
+                vals = [~v for v in vals]
+            return [rank] + vals
+        from spark_rapids_tpu.columnar import encoding as _enc
+
+        col = _enc.decode_column(col)
     valid = col.validity
     if nulls_first:
         rank = jnp.where(valid, 1, 0)
@@ -127,13 +150,17 @@ def orderable_keys(col: DeviceColumn, ascending: bool, nulls_first: bool,
     return [rank] + vals
 
 
-def equality_keys(col: DeviceColumn, live: jnp.ndarray) -> List[jnp.ndarray]:
+def equality_keys(col: DeviceColumn, live: jnp.ndarray,
+                  codes_ok: bool = False) -> List[jnp.ndarray]:
     """Keys whose tuple equality == SQL group/join-key equality (null ==
     null for grouping; NaN == NaN, +0.0 == -0.0? No: Spark group keys use
     binary equality where NaN==NaN and -0.0==0.0 normalized — the float
     total-order key satisfies NaN==NaN; -0.0/0.0 map to distinct keys, so
-    normalize zeros first in the caller for float group keys)."""
-    return orderable_keys(col, True, True, live)
+    normalize zeros first in the caller for float group keys).
+    `codes_ok` lets SINGLE-BATCH equality contexts (grouping) key
+    encoded columns by their dictionary codes; cross-batch contexts
+    (join sides prepared in separate programs) must leave it False."""
+    return orderable_keys(col, True, True, live, codes_ok=codes_ok)
 
 
 def rows_equal_adjacent(keys: List[jnp.ndarray]) -> jnp.ndarray:

@@ -111,6 +111,12 @@ def hash_column(col: DeviceColumn, seed: jnp.ndarray) -> jnp.ndarray:
     masks nulls)."""
     dt = col.dtype
     if isinstance(dt, StringType):
+        if getattr(col, "encoding", None) is not None:
+            # hash the VALUES, not the codes: partition/bloom hashes
+            # must agree across batches whose dictionaries differ
+            from spark_rapids_tpu.columnar import encoding as _enc
+
+            col = _enc.decode_column(col)
         return hash_string(col.data, col.lengths, seed)
     if isinstance(dt, BooleanType):
         return hash_int(col.data.astype(jnp.int32), seed)

@@ -316,8 +316,12 @@ def group_by(batch: ColumnBatch, key_idxs: Sequence[int],
         return GroupedBatch(batch, gid, live, jnp.int32(1), first_pos)
     keys: List[jnp.ndarray] = []
     for i in key_idxs:
+        # codes_ok: grouping is a single-batch EQUALITY context, so
+        # dictionary-encoded keys group on their codes (interned
+        # dictionaries make code equality == value equality) instead
+        # of decoding to byte matrices
         keys.extend(equality_keys(normalize_floating(batch.columns[i]),
-                                  live))
+                                  live, codes_ok=True))
     perm = sort_permutation(keys, cap)
     sorted_keys = [jnp.take(k, perm) for k in keys]
     live_s = jnp.take(live, perm)

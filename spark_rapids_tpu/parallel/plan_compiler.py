@@ -99,6 +99,12 @@ def concat_traced(batches: List[ColumnBatch]) -> ColumnBatch:
         return jnp.concatenate(align_trailing(leaves), axis=0)
 
     def cat_col(parts, dtype):
+        if any(getattr(p, "encoding", None) is not None for p in parts):
+            # encoded pieces stay encoded only when they share ONE
+            # dictionary; identity mismatch decodes in-trace first
+            from spark_rapids_tpu.columnar import encoding as _enc
+
+            parts = _enc.align_encodings(parts)
         if parts[0].children is not None:  # structs: recurse per field
             kids = [cat_col([p.children[i] for p in parts],
                             parts[0].children[i].dtype)
@@ -121,8 +127,15 @@ def concat_traced(batches: List[ColumnBatch]) -> ColumnBatch:
         el = None
         if parts[0].elem_lengths is not None:
             el = catnd([p.elem_lengths for p in parts])
-        return DeviceColumn(dtype, data, val, lens, ev, mv,
-                            elem_lengths=el)
+        # encoded columns keep their [0, K) code bound (binned group-by
+        # needs it); plain columns keep the historical drop-at-concat
+        vr = parts[0].vrange if (
+            parts[0].encoding is not None
+            and all(p.vrange == parts[0].vrange for p in parts)) \
+            else None
+        return DeviceColumn(dtype, data, val, lens, ev, mv, vrange=vr,
+                            elem_lengths=el,
+                            encoding=parts[0].encoding)
 
     cols: List[DeviceColumn] = []
     for ci, field in enumerate(schema.fields):
@@ -146,8 +159,12 @@ def shard_equi_join(node: J._DeviceJoinBase, left: ColumnBatch,
     lsch = node.children[0].schema
     rsch = node.children[1].schema
     no_ovf = jnp.zeros((), bool)
-    bt = node._build_table(right)
-    work_l, lk = node._prepare_keys(left, node.left_keys)
+    # encoded execution: both sides are in this ONE trace, so string
+    # equi-keys over dictionary columns compare CODES (identity
+    # checked, re-encode via host remap on mismatch — exec/joins.py)
+    lkeys, rkeys = node._encoded_key_rewrite(left, right)
+    bt = node._build_table(right, keys=rkeys)
+    work_l, lk = node._prepare_keys(left, lkeys)
     lo, counts = joinops.probe_ranges(bt, work_l, lk)
 
     if node.condition is None:

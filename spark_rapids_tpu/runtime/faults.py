@@ -37,6 +37,14 @@ locations where the real world fails —
     admission.slow_drain admission slot release — the handoff to the
                         next queued query is delayed, exercising
                         queue-wait accounting and queue-timeout margins
+    semaphore.partial_hold
+                        device-permit grant (runtime/semaphore.py) —
+                        the granted task keeps holding while stalled
+                        (interruptibly) for a beat, deterministically
+                        widening the hold-and-wait window so the
+                        legacy-acquisition deadlock gates form their
+                        cycle on every run instead of relying on
+                        scheduler timing
 
 and every site's CONSUMER survives the injected fault: backoff retries
 (runtime/backoff.py), quarantine-and-recompile, or engine demotion.
@@ -77,6 +85,7 @@ KNOWN_SITES = (
     "shuffle.lost_output",
     "query.cancel_race",
     "admission.slow_drain",
+    "semaphore.partial_hold",
 )
 
 

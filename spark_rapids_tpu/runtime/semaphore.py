@@ -66,6 +66,17 @@ from spark_rapids_tpu.runtime.errors import SemaphoreTimeout
 
 MAX_PERMITS = 1000
 
+# chaos site `semaphore.partial_hold`: how long a freshly granted task
+# keeps holding before proceeding — long enough that two concurrent
+# legacy-path queries always overlap partial holds, short enough that
+# the deadlock gates stay fast
+PARTIAL_HOLD_S = 0.05
+
+
+def _should_stall() -> bool:
+    from spark_rapids_tpu.runtime import faults
+    return faults.should_inject("semaphore.partial_hold")
+
 DEFAULT_ACQUIRE_TIMEOUT_MS = 600_000
 
 
@@ -107,6 +118,18 @@ class TpuSemaphore:
             cancel.on_cancel(wake)
         try:
             self._acquire(task_id, cancel)
+            if _should_stall():
+                # hold-and-wait widener: keep the fresh grant held
+                # through a beat so concurrent legacy queries' partial
+                # holds reliably overlap and the deadlock gates form
+                # their cycle deterministically. Must not raise while
+                # holding the fresh grant — a cancelled victim wakes
+                # early (token.wait) and surfaces the cancel at the
+                # caller's existing yield points.
+                if cancel is not None:
+                    cancel.wait(PARTIAL_HOLD_S)
+                else:
+                    time.sleep(PARTIAL_HOLD_S)  # srtpu-lint: disable=raw-sleep
         finally:
             if wake is not None:
                 cancel.remove_on_cancel(wake)

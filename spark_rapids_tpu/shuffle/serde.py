@@ -128,13 +128,19 @@ def serialize_table(table: pa.Table, codec: str = "none",
     col_specs = []
     for ci, col in enumerate(table.columns):
         arr = col.combine_chunks()
-        if pa.types.is_nested(arr.type):
+        if pa.types.is_nested(arr.type) or \
+                pa.types.is_dictionary(arr.type):
             # nested columns (list/struct/map) carry CHILD arrays whose
             # buffers interleave in Array.buffers(); frame them as one
             # arrow-IPC record batch instead of raw buffer slices. The
             # IPC writer handles sliced arrays natively, so no offset
             # normalization (shuffle map slices make offset != 0 the
-            # common case here)
+            # common case here). DICTIONARY columns take the same IPC
+            # frame: the block then carries CODES plus one dictionary
+            # reference instead of decoded values (compressed
+            # execution's shuffle representation), and the reduce-side
+            # re-upload re-interns the dictionary by content so the
+            # device copy dedupes across blocks.
             sink = pa.BufferOutputStream()
             rb = pa.record_batch([arr],
                                  schema=pa.schema(

@@ -1,0 +1,341 @@
+"""Encoded (dictionary) execution — columnar/encoding.py and its
+operator lowerings: codes stay compressed in HBM, decode defers to the
+last consumer, and every path diff-tests against the plain (decoded)
+representation and the pyarrow oracle."""
+
+import os
+
+import jax
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSparkSession
+from spark_rapids_tpu.columnar import encoding
+from spark_rapids_tpu.columnar.arrow_bridge import (
+    arrow_to_device,
+    device_to_arrow,
+)
+from spark_rapids_tpu.exec.fused import upload_narrowed
+
+
+@pytest.fixture()
+def spark():
+    s = TpuSparkSession({"spark.sql.shuffle.partitions": 4})
+    yield s
+    s.stop()
+
+
+def _dict_table(values, extra=None):
+    cols = {"s": pa.array(values).dictionary_encode()}
+    if extra:
+        cols.update(extra)
+    return pa.table(cols)
+
+
+# ------------------------------------------------------ representation
+
+def test_upload_roundtrip_with_nulls():
+    vals = ["apple", "banana", None, "apple", "cherry", None, "banana"]
+    b = upload_narrowed(_dict_table(vals))
+    col = b.columns[0]
+    assert col.is_encoded
+    assert col.data.ndim == 1  # codes, not a byte matrix
+    assert col.vrange == (0, 2)
+    out = device_to_arrow(b)
+    assert out.column("s").to_pylist() == vals
+
+
+def test_null_inside_dictionary_roundtrip():
+    # satellite bugfix coverage: a NULL VALUE in the dictionary must
+    # fold into row validity identically on every upload path
+    idx = pa.array([0, 1, 2, 0, None, 1], type=pa.int32())
+    dic = pa.array(["x", None, "y"])
+    arr = pa.DictionaryArray.from_arrays(idx, dic)
+    want = ["x", None, "y", "x", None, None]
+    t = pa.table({"s": arr})
+    for upload in (upload_narrowed, arrow_to_device):
+        b = upload(t)
+        assert device_to_arrow(b).column("s").to_pylist() == want
+    # the encoded column itself carries the folded validity
+    b = upload_narrowed(t)
+    assert np.asarray(b.columns[0].validity[:6]).tolist() == \
+        [True, False, True, True, False, False]
+
+
+def test_duplicate_dictionary_values_canonicalize():
+    idx = pa.array([0, 1, 2, 3], type=pa.int32())
+    dic = pa.array(["a", "b", "a", "c"])  # duplicate "a"
+    arr = pa.DictionaryArray.from_arrays(idx, dic)
+    b = upload_narrowed(pa.table({"s": arr}))
+    col = b.columns[0]
+    codes = np.asarray(col.data[:4])
+    assert codes[0] == codes[2], "duplicate values must share one code"
+    assert device_to_arrow(b).column("s").to_pylist() == \
+        ["a", "b", "a", "c"]
+
+
+def test_empty_dictionary_and_empty_table():
+    # all-null dictionary column and a zero-row table
+    arr = pa.DictionaryArray.from_arrays(
+        pa.array([None, None], type=pa.int32()), pa.array([], pa.string()))
+    b = upload_narrowed(pa.table({"s": arr}))
+    assert device_to_arrow(b).column("s").to_pylist() == [None, None]
+    empty = pa.table({"s": pa.array([], pa.string()).dictionary_encode()})
+    b0 = upload_narrowed(empty)
+    assert device_to_arrow(b0).num_rows == 0
+
+
+def test_dictionary_interning_dedup():
+    vals = ["p", "q", "r"]
+    a1 = pa.array(vals).dictionary_encode()
+    a2 = pa.array(["r", "q", "p", "q"]).dictionary_encode()
+    b1 = upload_narrowed(pa.table({"s": a1}))
+    b2 = upload_narrowed(pa.table({"s": pa.array(vals)
+                                   .dictionary_encode()}))
+    # identical content -> one dict_id, one device-cache entry (the
+    # batch device_put re-unflattens the pytree, so object identity is
+    # not the contract — the interned id and cache slot are)
+    did = b1.columns[0].encoding.dict_id
+    assert b2.columns[0].encoding.dict_id == did
+    assert did in encoding._device_dicts
+    b3 = upload_narrowed(pa.table({"s": a2}))
+    assert b3.columns[0].encoding.dict_id != \
+        b1.columns[0].encoding.dict_id
+
+
+def test_decode_column_traced():
+    vals = ["aa", None, "bbb", "aa"]
+    b = upload_narrowed(_dict_table(vals))
+
+    @jax.jit
+    def dec(batch):
+        from spark_rapids_tpu.columnar.batch import ColumnBatch
+
+        cols = [encoding.decode_column(c) for c in batch.columns]
+        return ColumnBatch(batch.schema, cols, batch.num_rows)
+
+    out = device_to_arrow(dec(b))
+    assert out.column("s").to_pylist() == vals
+
+
+# --------------------------------------------------- operator lowerings
+
+def _write(tmpdir, name, table, **kw):
+    path = os.path.join(str(tmpdir), name)
+    os.makedirs(path, exist_ok=True)
+    pq.write_table(table, os.path.join(path, "part-0.parquet"), **kw)
+    return path
+
+
+@pytest.fixture()
+def dict_data(tmp_path):
+    rng = np.random.default_rng(7)
+    n, stores, regions = 20_000, 100, 6
+    fact = pa.table({
+        "store": pa.array(rng.integers(0, stores, n), pa.int64()),
+        "amount": pa.array(rng.random(n) * 100.0),
+    })
+    region_vals = [None if i % 17 == 0 else f"region_{i % regions:02d}"
+                   for i in range(stores)]
+    dim = pa.table({
+        "store": pa.array(np.arange(stores), pa.int64()),
+        "region": pa.array(region_vals),
+    })
+    return (_write(tmp_path, "fact", fact),
+            _write(tmp_path, "dim", dim, use_dictionary=True))
+
+
+def _canon(t):
+    cols = [c.to_pylist() for c in t.columns]
+    rows = list(zip(*cols)) if cols else []
+    return sorted(
+        (tuple(round(v, 6) if isinstance(v, float) else v for v in r)
+         for r in rows),
+        key=lambda r: tuple((x is None, x) for x in r))
+
+
+def _both_sessions(extra=None):
+    base = {"spark.sql.shuffle.partitions": 4}
+    base.update(extra or {})
+    on = dict(base)
+    off = dict(base)
+    off["spark.rapids.tpu.encoded.enabled"] = False
+    return on, off
+
+
+@pytest.mark.parametrize("engine_conf", [
+    {},  # fused
+    {"spark.rapids.sql.fusedExec.enabled": False},  # per-operator
+])
+def test_filter_groupby_join_oracle(dict_data, engine_conf):
+    fact_dir, dim_dir = dict_data
+
+    def q(s):
+        return (s.read.parquet(fact_dir)
+                .filter(F.col("amount") > 20.0)
+                .join(s.read.parquet(dim_dir), on="store", how="inner")
+                .filter(F.col("region") != "region_02")
+                .groupBy("region")
+                .agg(F.sum("amount").alias("sv"),
+                     F.count("*").alias("n")))
+
+    on_conf, off_conf = _both_sessions(engine_conf)
+    s_on = TpuSparkSession(on_conf)
+    got = q(s_on).collect_arrow()
+    tel = (s_on.last_execution or {}).get("telemetry") or {}
+    s_on.stop()
+    s_off = TpuSparkSession(off_conf)
+    want = q(s_off).collect_arrow()
+    s_off.stop()
+    assert _canon(got) == _canon(want)
+    # the encoded run must report its savings
+    assert tel.get("bytesSavedEncoded", 0) > 0
+    assert tel.get("effectiveCompressionRatio", 0) > 1
+
+
+def test_in_and_isnull_predicates(dict_data, spark):
+    _, dim_dir = dict_data
+    df = spark.read.parquet(dim_dir)
+    got = (df.filter(F.col("region").isin("region_00", "region_01",
+                                          "absent"))
+           .groupBy("region").agg(F.count("*").alias("n"))
+           ).collect_arrow()
+    host = pq.read_table(dim_dir)
+    mask = pc.is_in(host.column("region"),
+                    value_set=pa.array(["region_00", "region_01",
+                                        "absent"]))
+    want = (host.filter(pc.fill_null(mask, False))
+            .group_by("region").aggregate([("region", "count")]))
+    assert _canon(got) == _canon(want)
+
+    got_null = (spark.read.parquet(dim_dir)
+                .filter(F.col("region").isNull())).collect_arrow()
+    n_null = pc.sum(pc.is_null(host.column("region"))).as_py()
+    assert got_null.num_rows == n_null
+
+
+def test_string_key_join_same_and_mismatched_dicts(tmp_path):
+    cats = [f"cat_{i:02d}" for i in range(12)]
+    rng = np.random.default_rng(11)
+    left = pa.table({
+        "k": pa.array([None if i % 19 == 0 else cats[i % 12]
+                       for i in rng.integers(0, 1000, 4000)]),
+        "v": pa.array(rng.random(4000)),
+    })
+    # reversed value order -> same domain, DIFFERENT dictionary content
+    right = pa.table({
+        "k": pa.array([cats[11 - (i % 12)] for i in range(300)]
+                      + ["right_only"]),
+        "w": pa.array(rng.random(301)),
+    })
+    ldir = _write(tmp_path, "l", left, use_dictionary=True)
+    rdir = _write(tmp_path, "r", right, use_dictionary=True)
+
+    def q(s):
+        return (s.read.parquet(ldir)
+                .join(s.read.parquet(rdir), on="k", how="inner")
+                .groupBy("k").agg(F.count("*").alias("n")))
+
+    on_conf, off_conf = _both_sessions(
+        {"spark.rapids.sql.fusedExec.enabled": False})
+    s_on = TpuSparkSession(on_conf)
+    got = q(s_on).collect_arrow()
+    s_on.stop()
+    s_off = TpuSparkSession(off_conf)
+    want = q(s_off).collect_arrow()
+    s_off.stop()
+    assert _canon(got) == _canon(want)
+
+
+def test_codesof_remap_mismatched_dictionaries():
+    # the re-encode fallback in isolation: same values interned from
+    # two different dictionaries remap into one code space
+    a = pa.array(["x", "y", "z"]).dictionary_encode()
+    b = pa.array(["z", "y", "absent"]).dictionary_encode()
+    id_a, _ = encoding.intern_dictionary(a.dictionary)
+    id_b, _ = encoding.intern_dictionary(b.dictionary)
+    table = encoding.remap_table(id_b, id_a)
+    vals_b = b.dictionary.to_pylist()
+    idx_a = {v: i for i, v in enumerate(a.dictionary.to_pylist())}
+    for code_b, v in enumerate(vals_b):
+        assert table[code_b] == idx_a.get(v, -1)
+
+
+def test_sort_on_encoded_is_value_order(dict_data, spark):
+    _, dim_dir = dict_data
+    got = (spark.read.parquet(dim_dir)
+           .filter(F.col("region").isNotNull())
+           .select("region").orderBy("region")).collect_arrow()
+    vals = got.column("region").to_pylist()
+    assert vals == sorted(vals), "sort must use string order, not codes"
+
+
+def test_concat_mismatched_dictionaries_decodes(tmp_path):
+    # two files, same column, different dictionaries -> one scan
+    d = os.path.join(str(tmp_path), "multi")
+    os.makedirs(d)
+    pq.write_table(pa.table({"s": pa.array(["a", "b", "a"])}),
+                   os.path.join(d, "p0.parquet"), use_dictionary=True)
+    pq.write_table(pa.table({"s": pa.array(["c", "b", "c"])}),
+                   os.path.join(d, "p1.parquet"), use_dictionary=True)
+    s = TpuSparkSession({"spark.sql.shuffle.partitions": 2})
+    out = (s.read.parquet(d).groupBy("s")
+           .agg(F.count("*").alias("n"))).collect_arrow()
+    s.stop()
+    assert _canon(out) == [("a", 2), ("b", 2), ("c", 2)]
+
+
+# ----------------------------------------------------- spill round-trip
+
+def test_spill_unspill_preserves_encoding():
+    from spark_rapids_tpu.runtime.memory import get_catalog
+
+    vals = ["alpha", "beta", None, "alpha", "gamma"]
+    b = upload_narrowed(_dict_table(vals))
+    dict_id = b.columns[0].encoding.dict_id
+    catalog = get_catalog()
+    sb = catalog.add_batch(b)
+    try:
+        with catalog._lock:
+            sb._to_host()          # DEVICE -> HOST
+            sb._to_disk()          # HOST -> DISK
+        back = sb.get_batch()      # DISK -> DEVICE (reserves)
+        col = back.columns[0]
+        assert col.is_encoded
+        assert col.encoding.dict_id == dict_id
+        assert device_to_arrow(back).column("s").to_pylist() == vals
+    finally:
+        sb.close()
+
+
+# -------------------------------------------------- shuffle wire format
+
+def test_serde_dictionary_roundtrip():
+    from spark_rapids_tpu.shuffle import serde
+
+    vals = ["u", None, "v", "u", "w"]
+    t = pa.table({"s": pa.array(vals).dictionary_encode(),
+                  "x": pa.array(range(5), pa.int64())})
+    for codec in ("none", "zlib"):
+        buf = serde.serialize_table(t, codec=codec)
+        rt = serde.deserialize_table(buf)
+        assert pa.types.is_dictionary(rt.schema.field("s").type)
+        assert rt.column("s").to_pylist() == vals
+        assert rt.column("x").to_pylist() == list(range(5))
+
+
+def test_device_to_arrow_encoded_wire():
+    vals = ["m", "n", None, "m"]
+    b = upload_narrowed(_dict_table(vals))
+    t = device_to_arrow(b, encoded=True)
+    assert pa.types.is_dictionary(t.schema.field("s").type)
+    assert t.column("s").to_pylist() == vals
+    # and the re-upload re-interns to the SAME dictionary id
+    b2 = arrow_to_device(t)
+    assert b2.columns[0].is_encoded
+    assert b2.columns[0].encoding.dict_id == \
+        b.columns[0].encoding.dict_id

@@ -20,6 +20,7 @@ import pyarrow.parquet as pq
 import pytest
 
 from spark_rapids_tpu.obs import events as obs_events
+from spark_rapids_tpu.runtime import faults
 from spark_rapids_tpu.runtime import sanitizer
 from spark_rapids_tpu.runtime.cancellation import CancelToken
 from spark_rapids_tpu.runtime.errors import DeadlockDetectedError
@@ -347,6 +348,11 @@ def test_e2e_legacy_sanitizer_recovers_the_deadlock(tmp_path):
         "spark.rapids.sql.exec.Filter": False,
         "spark.rapids.tpu.semaphore.atomicQueryGroups": False,
         "spark.rapids.tpu.sanitizer.enabled": True,
+        # deterministic cycle formation: every grant keeps holding for
+        # a beat (semaphore.partial_hold), so the two queries' partial
+        # holds always overlap instead of depending on compile timing
+        "spark.rapids.tpu.chaos.enabled": True,
+        "spark.rapids.tpu.chaos.sites": "semaphore.partial_hold:every=1",
     })
     try:
         results, errs = _concurrent_fallback_queries(s, data)
@@ -359,6 +365,10 @@ def test_e2e_legacy_sanitizer_recovers_the_deadlock(tmp_path):
         get_catalog().check_leaks(raise_on_leak=True)
     finally:
         s.stop()
+        # disarm the process-wide chaos registry: session stop leaves
+        # it installed, and a lingering partial_hold stalls every
+        # later acquire in the suite
+        faults.configure(None)
 
 
 # ------------------------------------------------------ disabled mode
