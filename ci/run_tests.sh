@@ -30,6 +30,9 @@ ci/telemetry_check.sh
 echo "== encoded-execution gate (bytes moved + oracle equality) =="
 ci/encoded_check.sh
 
+echo "== device-failure gate (fence + warm recovery + epoch) =="
+ci/devicefail_check.sh
+
 echo "== multichip dryrun (virtual mesh) =="
 SPARK_RAPIDS_TPU_DRYRUN_REEXEC=1 python - <<'PY'
 import jax

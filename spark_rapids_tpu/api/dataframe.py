@@ -610,12 +610,32 @@ class DataFrame:
 
     def collect_arrow(self) -> pa.Table:
         from spark_rapids_tpu.config import rapids_conf as rc
-        from spark_rapids_tpu.runtime import admission
+        from spark_rapids_tpu.runtime import admission, device_monitor
         from spark_rapids_tpu.runtime.errors import (
             DeadlockDetectedError,
+            DeviceLostError,
         )
 
         try:
+            return self._collect_arrow_admitted()
+        except DeviceLostError:
+            # this query was unwound by device-loss fencing
+            # (runtime/device_monitor.py): its permits/buffers/slot are
+            # released, warm recovery rebuilds the backend and bumps
+            # the device epoch, and ONE resubmission through admission
+            # re-runs the query against the fresh backend (the
+            # retryVictim pattern). Outermost collect only; the wait
+            # for the fence to lift is bounded by
+            # device.recovery.timeoutMs.
+            mon = device_monitor.get()
+            if admission.current_handle() is not None or \
+                    not mon.resubmit or \
+                    not self.session.rapids_conf.get(
+                        rc.DEVICE_RECOVERY_RESUBMIT):
+                raise
+            if not mon.await_ready():
+                raise  # recovery itself is wedged — surface the loss
+            mon.note_resubmit()
             return self._collect_arrow_admitted()
         except DeadlockDetectedError:
             # this query was unwound as a deadlock victim
@@ -810,6 +830,20 @@ class DataFrame:
                                     frm=frm, to=to, reason=reason)
             qm.metric(f"degrade.{frm}To{to.capitalize()}").add(1)
 
+        from spark_rapids_tpu.runtime import device_monitor as _dm
+
+        mon = _dm.get()
+        if mon.enabled and mon.fenced and ladder_on:
+            # engine FENCED for device-loss recovery: the device rungs
+            # are down, but the service is not — serve this query on
+            # the CPU rung (the PR 2 degrade discipline), recorded
+            # like any other demotion
+            demoted("fused", "cpu",
+                    f"device fenced for recovery (epoch {mon.epoch}): "
+                    f"serving on the CPU rung")
+            phys_cpu, _ = self._physical(cpu_oracle=True)
+            return ran("cpu", phys_cpu.collect())
+
         mesh_n = conf.get(rc.MESH_SIZE)
         if not mesh_n and conf.get(rc.SHUFFLE_MODE) == "ICI":
             # ICI shuffle == the SPMD mesh engine over every local chip
@@ -889,10 +923,18 @@ class DataFrame:
 
                 if has_exchange(phys):
                     faults.maybe_inject("device.dispatch", detail="aqe")
-                    return ran("aqe", AdaptiveQueryExecutor(
-                        conf).execute(phys))
+                    with _dm.guard("eager.dispatch", detail="aqe",
+                                   inject=True):
+                        return ran("aqe", AdaptiveQueryExecutor(
+                            conf).execute(phys))
             faults.maybe_inject("device.dispatch", detail="eager")
-            return ran("eager", phys.collect())
+            # fatal-classification + chaos site device.fatal around the
+            # per-operator engine: a dead backend fences for warm
+            # recovery (DeviceLostError rides past the ladder — slow
+            # beats dead does not apply to a resubmittable loss)
+            with _dm.guard("eager.dispatch", detail="eager",
+                           inject=True):
+                return ran("eager", phys.collect())
         except (TpuOOMError, faults.InjectedFault) as e:
             if not ladder_on:
                 raise

@@ -37,6 +37,20 @@ from spark_rapids_tpu.sqltypes import (
 
 MIN_CAPACITY = 1024
 
+# device-epoch stamp source (runtime/device_monitor.py). Lazy module
+# ref: importing device_monitor at module level would cycle through
+# the runtime package __init__ back into this module.
+_dm = None
+
+
+def _current_epoch() -> int:
+    global _dm
+    if _dm is None:
+        from spark_rapids_tpu.runtime import device_monitor
+
+        _dm = device_monitor
+    return _dm._EPOCH
+
 
 def next_capacity(rows: int, minimum: int = MIN_CAPACITY) -> int:
     """Smallest power-of-two capacity bucket holding `rows`."""
@@ -73,11 +87,12 @@ class DeviceColumn:
 
     __slots__ = ("dtype", "data", "validity", "lengths",
                  "elem_validity", "map_values", "vrange", "children",
-                 "elem_lengths", "encoding")
+                 "elem_lengths", "encoding", "epoch")
 
     def __init__(self, dtype: DataType, data, validity, lengths=None,
                  elem_validity=None, map_values=None, vrange=None,
-                 children=None, elem_lengths=None, encoding=None):
+                 children=None, elem_lengths=None, encoding=None,
+                 epoch=None):
         self.dtype = dtype
         self.data = data          # maps: the KEY matrix
         self.validity = validity
@@ -97,6 +112,16 @@ class DeviceColumn:
         # DICTIONARY-ENCODED strings: the shared DeviceDictionary
         # (columnar/encoding.py); data is then [cap] integer codes
         self.encoding = encoding
+        # DEVICE EPOCH stamp (runtime/device_monitor.py): which
+        # generation of the PJRT backend this column's device buffers
+        # belong to. Checked at dispatch/unspill use sites — a column
+        # stamped before a device-loss recovery raises DeviceLostError
+        # instead of touching recycled device memory. Deliberately NOT
+        # part of the pytree aux: treedefs (and thus traced programs)
+        # are epoch-independent; unflattened columns re-stamp at the
+        # current epoch because their leaves were just produced by the
+        # live backend.
+        self.epoch = _current_epoch() if epoch is None else epoch
 
     @property
     def is_string(self) -> bool:
@@ -145,7 +170,7 @@ class DeviceColumn:
             else [c.truncate(cap) for c in self.children],
             None if self.elem_lengths is None
             else self.elem_lengths[:cap],
-            encoding=self.encoding)
+            encoding=self.encoding, epoch=self.epoch)
 
     def device_size_bytes(self) -> int:
         n = self.data.size * self.data.dtype.itemsize
@@ -185,6 +210,7 @@ class DeviceColumn:
             kw.get("children", self.children),
             kw.get("elem_lengths", self.elem_lengths),
             encoding=kw.get("encoding", self.encoding),
+            epoch=kw.get("epoch", self.epoch),
         )
 
     def gather(self, indices) -> "DeviceColumn":
@@ -209,6 +235,7 @@ class DeviceColumn:
             elem_lengths=None if self.elem_lengths is None
             else jnp.take(self.elem_lengths, indices, axis=0),
             encoding=self.encoding,
+            epoch=self.epoch,
         )
 
     def _tree_flatten(self):

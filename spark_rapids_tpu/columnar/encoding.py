@@ -531,6 +531,25 @@ class CodesOf(Expression):
         return DeviceColumn(_int_type, codes, col.validity)
 
 
+def invalidate_device_cache() -> int:
+    """Device-loss recovery hook (runtime/device_monitor.py): every
+    cached DeviceDictionary was uploaded to the backend recovery just
+    tore down — drop the device cache and release its catalog
+    reservations. HOST dictionaries survive: the next
+    `device_dictionary(dict_id)` call re-uploads the same content into
+    the fresh backend (encoded columns re-intern lazily, like the warm
+    executables). Returns how many device entries were dropped."""
+    from spark_rapids_tpu.runtime.memory import _catalog
+
+    with _lock:
+        dev = list(_device_dicts.values())
+        _device_dicts.clear()
+    if _catalog is not None:
+        for _, nbytes in dev:
+            _catalog.release(nbytes, query_id=0)
+    return len(dev)
+
+
 def clear_for_tests() -> None:
     """Drop every interned dictionary (host + device) and release the
     device cache's catalog reservations — test isolation only."""

@@ -584,6 +584,16 @@ IO_RETRY_BACKOFF_MS = conf(
 IO_RETRY_MAX_BACKOFF_MS = conf(
     "spark.rapids.tpu.io.retry.maxBackoffMs", 2000,
     "Ceiling on a single backoff delay.", int)
+IO_RETRY_MAX_TOTAL_MS = conf(
+    "spark.rapids.tpu.io.retry.maxTotalMs", 120_000,
+    "Cumulative per-QUERY retry-delay budget across every backoff "
+    "site (io.read, shuffle fetch/decode, spill.disk, ...): once a "
+    "query's summed backoff sleeps cross it, the next retry fails "
+    "fast with RetryExhausted naming this budget instead of "
+    "multiplying per-site backoffs — the fail-fast valve for chained "
+    "retry storms during a device outage. 0 disables the budget "
+    "(per-site attempt counts still bound each loop).", int,
+    checker=lambda v: v >= 0)
 SHUFFLE_CHECKSUM_ENABLED = conf(
     "spark.rapids.shuffle.checksum.enabled", True,
     "Frame every serialized shuffle block with a per-block CRC "
@@ -777,6 +787,56 @@ SANITIZER_VICTIM_RETRY = conf(
     "contested resources and the retry serializes behind them, so "
     "both queries complete. false propagates the error to the "
     "caller.", bool)
+DEVICE_RECOVERY_ENABLED = conf(
+    "spark.rapids.tpu.device.recovery.enabled", True,
+    "Warm device-loss recovery (runtime/device_monitor.py): a fatal "
+    "TPU runtime error at a dispatch/transfer site fences the engine, "
+    "cancels in-flight queries with a retryable DeviceLostError, bumps "
+    "the process device epoch (stale device handles then raise instead "
+    "of touching dead buffers), rebuilds the PJRT backend, restores "
+    "spillable state from the host/disk tiers and invalidates "
+    "device-only caches (encoded dictionaries, warm executables) — the "
+    "service recovers in one window instead of dying with the process. "
+    "false restores the reference plugin's behavior: the error "
+    "propagates (and spark.rapids.tpu.fatalErrorExitCode may kill the "
+    "process).", bool)
+DEVICE_RECOVERY_FENCED_ADMISSION = conf(
+    "spark.rapids.tpu.device.recovery.fencedAdmission", "degrade",
+    "What happens to queries submitted while the engine is FENCED for "
+    "device recovery: 'degrade' admits them and the dispatch ladder "
+    "serves them on the CPU rung (the service stays up, PR 2's "
+    "degradation discipline), 'queue' parks them in the admission "
+    "queue until the fence lifts (bounded by admission.queue."
+    "timeoutMs), 'shed' rejects them immediately with a "
+    "QueryRejectedError naming the fence.", str,
+    checker=lambda v: v in ("degrade", "queue", "shed"))
+DEVICE_RECOVERY_RESUBMIT = conf(
+    "spark.rapids.tpu.device.recovery.resubmit", True,
+    "After a query is unwound by device-loss fencing "
+    "(DeviceLostError), the outermost collect waits for recovery and "
+    "resubmits it once through admission (the sanitizer retryVictim "
+    "pattern): one fence costs in-flight queries one recovery window, "
+    "not an error surfaced to the caller. false propagates the "
+    "DeviceLostError.", bool)
+DEVICE_RECOVERY_DRAIN_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.device.recovery.drainTimeoutMs", 30_000,
+    "How long recovery waits for fenced queries to unwind (running "
+    "admissions drained, semaphore permits released) before "
+    "proceeding with the epoch bump and backend rebuild anyway — a "
+    "wedged unwind must not hold the whole engine down.", int,
+    checker=lambda v: v >= 0)
+DEVICE_RECOVERY_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.device.recovery.timeoutMs", 60_000,
+    "How long a resubmitting query waits for the fence to lift before "
+    "giving up and propagating its DeviceLostError.", int,
+    checker=lambda v: v >= 1)
+DEVICE_RECOVERY_REBUILD_BACKEND = conf(
+    "spark.rapids.tpu.device.recovery.rebuildBackend", True,
+    "Tear down the PJRT client during recovery "
+    "(jax.extend.backend.clear_backends) so the next dispatch "
+    "initializes a fresh backend; false only clears compilation "
+    "caches and bumps the epoch (for backends whose client survives "
+    "a device reset).", bool)
 QUOTA_DEVICE_BYTES_PER_QUERY = conf(
     "spark.rapids.tpu.quota.device.maxBytesPerQuery", 0,
     "Per-query cap on device-pool reservations (SpillCatalog tags "

@@ -675,6 +675,13 @@ class FusedSingleChipExecutor:
             # fused engine "dying mid-dispatch"; the dispatch ladder
             # (api/dataframe.py) demotes the query to the eager engine
             faults.maybe_inject("device.dispatch", detail=str(key_tag))
+            # device-loss gates (runtime/device_monitor.py): inputs
+            # stamped before the current device epoch must raise here,
+            # not dereference recycled device memory inside XLA
+            from spark_rapids_tpu.runtime import device_monitor as _dm
+
+            for inp in inputs:
+                _dm.check_batch(inp)
             # VARIANT DEDUP: the key carries ONLY the parameters the
             # traced program consumes. The old key stamped every
             # program with (expansion, group_cap, ansi_on, use_lookup,
@@ -703,7 +710,13 @@ class FusedSingleChipExecutor:
                 else:
                     m["programsRequested"] += 1
             jitted = cached_jit(key, lambda: fn)
-            out, fl, *rest = jitted(*inputs)
+            # fatal-classification + chaos site device.fatal: a dead
+            # PJRT client surfacing here fences the engine for warm
+            # recovery instead of leaking an XlaRuntimeError (or being
+            # mistaken for a ladder-demotable dispatch fault)
+            with _dm.guard("fused.dispatch", detail=str(key_tag),
+                           inject=True):
+                out, fl, *rest = jitted(*inputs)
             # fl: scalar=[cap] | (3,)=[cap, uniq, push] (chain programs)
             fl = jnp.asarray(fl).reshape(-1)
             flags.append(fl[0])

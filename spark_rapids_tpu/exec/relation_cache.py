@@ -101,24 +101,59 @@ class DeviceCacheEntry:
         with self._lock:
             return len(self._spills) if self._spills is not None else 0
 
-    def device_part(self, i: int):
-        """One materialized part (unspilling only that part)."""
-        self.materialize()
-        # hold the lock through get_batch: a concurrent release() may
-        # not close handles mid-access (unspill happens under the lock;
-        # it never re-enters this entry)
+    def _drop_lost(self) -> None:
+        """A device-loss recovery invalidated this entry's device-tier
+        spillables (runtime/device_monitor.py): close the stale
+        handles and let the next access re-run the cached plan — the
+        relation cache's lineage is its logical plan, so 'restore' is
+        a rematerialization in the new epoch."""
         with self._lock:
-            if self._spills is None or i >= len(self._spills):
-                raise IndexError(f"cached relation part {i} released")
-            return self._spills[i].get_batch()
+            if self._spills is not None:
+                for sb in self._spills:
+                    try:
+                        sb.close()
+                    except Exception:
+                        pass
+                self._spills = None
+
+    def device_part(self, i: int):
+        """One materialized part (unspilling only that part). A stale
+        entry from before a device-loss recovery rematerializes once."""
+        from spark_rapids_tpu.runtime.errors import DeviceLostError
+
+        for attempt in (0, 1):
+            self.materialize()
+            # hold the lock through get_batch: a concurrent release()
+            # may not close handles mid-access (unspill happens under
+            # the lock; it never re-enters this entry)
+            try:
+                with self._lock:
+                    if self._spills is None or i >= len(self._spills):
+                        raise IndexError(
+                            f"cached relation part {i} released")
+                    return self._spills[i].get_batch()
+            except DeviceLostError:
+                if attempt:
+                    raise
+                self._drop_lost()
 
     def device_parts(self) -> List:
-        """Materialized device ColumnBatches (unspilling as needed)."""
-        self.materialize()
-        with self._lock:
-            spills = list(self._spills) if self._spills is not None \
-                else []
-            return [sb.get_batch() for sb in spills]
+        """Materialized device ColumnBatches (unspilling as needed);
+        a stale entry from before a device-loss recovery
+        rematerializes once."""
+        from spark_rapids_tpu.runtime.errors import DeviceLostError
+
+        for attempt in (0, 1):
+            self.materialize()
+            try:
+                with self._lock:
+                    spills = list(self._spills) \
+                        if self._spills is not None else []
+                    return [sb.get_batch() for sb in spills]
+            except DeviceLostError:
+                if attempt:
+                    raise
+                self._drop_lost()
 
     def collect(self) -> pa.Table:
         from spark_rapids_tpu.columnar.arrow_bridge import device_to_arrow

@@ -63,6 +63,10 @@ EVENT_TYPES: Dict[str, str] = {
     "admission.quarantined": "queryId, reason, crashes",
     "sanitizer.deadlock": "cycle, victim, policy",
     "sanitizer.inversion": "first, second, detail",
+    "device.fatal": "site, epoch, error",
+    "device.fence": "epoch, cause, inFlight",
+    "device.recovery":
+        "epoch, ms, drained, restorableBuffers, droppedBuffers",
 }
 
 #: Envelope keys present on EVERY event (eventlog validation contract).

@@ -24,6 +24,8 @@ def robustness_snapshot() -> dict:
     semaphore timeouts. Key layout is pinned by existing tests."""
     from spark_rapids_tpu.runtime import admission as _adm
     from spark_rapids_tpu.runtime import backoff, degrade, faults
+    from spark_rapids_tpu.runtime import device_monitor as _dm
+    from spark_rapids_tpu.runtime import memory as _mem
     from spark_rapids_tpu.runtime import sanitizer as _san
     from spark_rapids_tpu.runtime import scheduler as _sched
     from spark_rapids_tpu.runtime import semaphore as sem
@@ -31,6 +33,7 @@ def robustness_snapshot() -> dict:
     from spark_rapids_tpu.shuffle.manager import get_shuffle_manager
 
     mgr = get_shuffle_manager()
+    cat = _mem._catalog
     return {
         "chaos": faults.counters(),
         "retries": backoff.counters(),
@@ -42,6 +45,14 @@ def robustness_snapshot() -> dict:
         "degrade": degrade.counters(),
         "admission": _adm.stats.snapshot(),
         "sanitizer": _san.counters(),
+        "device": _dm.counters(),
+        "spill": {
+            "orphanedFilesSwept":
+                0 if cat is None
+                else cat.metrics.get("orphaned_files_swept", 0),
+            "deviceLostBuffers":
+                0 if cat is None
+                else cat.metrics.get("device_lost_buffers", 0)},
         "artifactsQuarantined":
             stats.snapshot()["artifactsQuarantined"],
         "semaphoreTimeouts": sem.get().timeouts,

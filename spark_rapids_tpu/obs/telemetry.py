@@ -112,6 +112,7 @@ class TransferLedger:
         self.hbm_peak = 0
         self.pressure_events = 0
         self.timeline: deque = deque(maxlen=_TIMELINE_KEEP)
+        self.device_epoch = 1  # stamped by hbm_epoch_marker on recovery
         # encoded-execution savings (process totals)
         self.enc_actual = 0
         self.enc_plain = 0
@@ -301,7 +302,8 @@ class TransferLedger:
             return {
                 "hbm": {"reservedBytes": self.hbm_reserved,
                         "peakBytes": self.hbm_peak,
-                        "pressureEvents": self.pressure_events},
+                        "pressureEvents": self.pressure_events,
+                        "deviceEpoch": self.device_epoch},
                 "bytesMoved": {d: c["bytes"]
                                for d, c in self.totals.items()},
                 "transfers": {d: c["count"]
@@ -324,6 +326,20 @@ class TransferLedger:
         with self._lock:
             return [list(x) for x in list(self.timeline)[-last:]]
 
+    def hbm_epoch_marker(self, epoch: int) -> None:
+        """Device-loss recovery marker: stamp the HBM occupancy
+        timeline with the post-recovery reservation level (the lost
+        DEVICE-tier releases have already walked the level down
+        through hbm_global) so a reader sees the reset edge and which
+        epoch owns the samples after it."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self.device_epoch = epoch
+            self.timeline.append(
+                (round(time.time(), 6), self.hbm_reserved,
+                 f"epoch={epoch}"))
+
 
 ledger = TransferLedger()
 
@@ -334,6 +350,7 @@ record_forwarded = ledger.record_forwarded
 hbm_global = ledger.hbm_global
 hbm_query = ledger.hbm_query
 hbm_pressure = ledger.hbm_pressure
+hbm_epoch_marker = ledger.hbm_epoch_marker
 query_summary = ledger.query_summary
 
 
@@ -349,15 +366,21 @@ def _tree_bytes(x) -> int:
 def ledgered_put(x, site: str, device=None):
     """`jax.device_put` with the crossing ledgered — the wrapper the
     raw-transfer lint rule (tools/lint) steers every H2D site through
-    when it is not already inside an instrumented function."""
+    when it is not already inside an instrumented function. Also a
+    device-loss classification point (runtime/device_monitor.py): an
+    upload into a dead backend fences the engine for warm recovery
+    instead of leaking a raw XlaRuntimeError."""
     import time as _time
 
     import jax
 
+    from spark_rapids_tpu.runtime import device_monitor
+
     nbytes = _tree_bytes(x)
     t0 = _time.monotonic_ns()
-    out = jax.device_put(x) if device is None \
-        else jax.device_put(x, device)
+    with device_monitor.guard(f"transfer.h2d:{site}"):
+        out = jax.device_put(x) if device is None \
+            else jax.device_put(x, device)
     record("h2d", site, nbytes, ns=_time.monotonic_ns() - t0)
     return out
 
@@ -366,13 +389,17 @@ def ledgered_get(x, site: str):
     """`jax.device_get` with the crossing ledgered; covers everything
     from full-column D2H pulls down to the scalar syncs (row counts,
     ANSI flags) that would otherwise leak out of the movement
-    accounting."""
+    accounting. Fatal-classified like ledgered_put — a D2H sync is
+    where a wedged device usually first surfaces."""
     import time as _time
 
     import jax
 
+    from spark_rapids_tpu.runtime import device_monitor
+
     t0 = _time.monotonic_ns()
-    out = jax.device_get(x)
+    with device_monitor.guard(f"transfer.d2h:{site}"):
+        out = jax.device_get(x)
     record("d2h", site, _tree_bytes(out),
            ns=_time.monotonic_ns() - t0)
     return out

@@ -439,18 +439,26 @@ class AdaptiveQueryExecutor:
     # --- driver ---
 
     def execute(self, phys: PhysicalPlan) -> pa.Table:
+        from spark_rapids_tpu.runtime import semaphore as _sem
+
         plan = phys
         ctx = new_task_context(self.conf)
-        while True:
-            ready = self._ready(plan)
-            if not ready:
-                break
-            # ONE stage at a time, build sides first: a probe-side
-            # exchange must not run while any build chain is pending,
-            # or its stats can no longer cancel/prune the probe
-            ex = ready[0]
-            ex._run_map_stage(ctx)
-            self._stats[id(ex)] = _exchange_stats(ex)
-            self._mark_join_fed(plan)
-            plan = self._rewrite(plan)
+        try:
+            while True:
+                ready = self._ready(plan)
+                if not ready:
+                    break
+                # ONE stage at a time, build sides first: a probe-side
+                # exchange must not run while any build chain is pending,
+                # or its stats can no longer cancel/prune the probe
+                ex = ready[0]
+                ex._run_map_stage(ctx)
+                self._stats[id(ex)] = _exchange_stats(ex)
+                self._mark_join_fed(plan)
+                plan = self._rewrite(plan)
+        finally:
+            # inlined map stages (range exchanges) acquire device
+            # permits on THIS driver ctx; without a release the AQE
+            # driver held a permit chunk for the rest of the session
+            _sem.get().release_if_necessary(ctx.task_id)
         return plan.collect()

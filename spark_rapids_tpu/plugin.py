@@ -85,7 +85,7 @@ class TpuExecutorPlugin:
     def init(self, conf: rc.RapidsConf):
         from spark_rapids_tpu.io import filecache
         from spark_rapids_tpu.runtime import admission, compile_cache, \
-            degrade, faults, memory, sanitizer, semaphore
+            degrade, device_monitor, faults, memory, sanitizer, semaphore
         from spark_rapids_tpu.shuffle.manager import configure_shuffle
 
         self._validate_device()
@@ -93,6 +93,11 @@ class TpuExecutorPlugin:
         # consumer of an injection site (compile.cache_load, io.read)
         faults.configure(conf)
         degrade.configure(conf)
+        # device-loss monitor before anything that can touch the
+        # backend: the very first dispatch is already fatal-classified
+        # and fence-recoverable (the process device epoch survives
+        # reconfiguration)
+        device_monitor.configure(conf)
         # query governance front door (admission queue + cancel
         # registry) — after faults so admission.slow_drain is armed
         admission.configure(conf)
@@ -151,15 +156,18 @@ class TpuExecutorPlugin:
 
 def _is_fatal_device_error(exc: BaseException) -> bool:
     """Classify unrecoverable device failures (the CudaFatalException
-    analog): XLA runtime INTERNAL/device-lost errors, not OOM/compile
-    issues the retry framework handles."""
-    name = type(exc).__name__
-    msg = str(exc)
-    if name == "XlaRuntimeError":
-        return any(tag in msg for tag in
-                   ("INTERNAL:", "device lost", "DEVICE_LOST",
-                    "hardware", "halted"))
-    return False
+    analog) by delegating to the device monitor's taxonomy
+    (runtime/device_monitor.py) — one classifier for the exit policy
+    and the warm-recovery fence. A DeviceLostError is explicitly NOT
+    process-fatal: it is the already-classified, already-being-
+    recovered form, and killing the process would throw away the warm
+    engine the recovery just saved."""
+    from spark_rapids_tpu.runtime import device_monitor
+    from spark_rapids_tpu.runtime.errors import DeviceLostError
+
+    if isinstance(exc, DeviceLostError):
+        return False
+    return device_monitor.classify(exc) == "fatal"
 
 
 class ColumnarOverrideRules:

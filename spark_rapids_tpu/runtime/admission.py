@@ -190,6 +190,17 @@ class AdmissionController:
                     sorted(self._queued.values(),
                            key=lambda h: h.query_id)]
 
+    @staticmethod
+    def _fence_mode() -> str:
+        """Admission policy while the engine is FENCED for device-loss
+        recovery (runtime/device_monitor.py): '' (not fenced) |
+        'degrade' (admit; dispatch serves the CPU rung) | 'queue'
+        (hold until the fence lifts) | 'shed' (reject at submit)."""
+        from spark_rapids_tpu.runtime import device_monitor
+
+        mon = device_monitor.get()
+        return mon.fenced_admission if mon.fenced else ""
+
     def _capacity_diag(self) -> str:
         rows = ", ".join(
             f"query={r['queryId']} elapsed={r['elapsedS']}s "
@@ -227,9 +238,23 @@ class AdmissionController:
             if san is not None:
                 san.acquired(_san.ADMISSION, query_id)
             return handle
+        fence = self._fence_mode()
+        if fence == "shed":
+            from spark_rapids_tpu.runtime import device_monitor
+
+            stats.add("queriesShed")
+            obs_events.emit("admission.shed", queryId=query_id,
+                            reason="device fenced",
+                            running=len(self._running))
+            raise QueryRejectedError(
+                f"query {query_id} rejected: the engine is FENCED for "
+                f"device-loss recovery (epoch "
+                f"{device_monitor.get().epoch}, "
+                f"device.recovery.fencedAdmission=shed); retry after "
+                f"recovery")
         with self._cv:
             if len(self._running) < self.max_concurrent and \
-                    not self._heap:
+                    not self._heap and fence != "queue":
                 self._admit_locked(handle)
                 return handle
             if len(self._queued) >= self.queue_depth:
@@ -278,7 +303,8 @@ class AdmissionController:
                         self._drop_queued_locked(query_id)
                         token.check()  # raises (turns expiry into cancel)
                     if len(self._running) < self.max_concurrent and \
-                            self._front_locked() == query_id:
+                            self._front_locked() == query_id and \
+                            self._fence_mode() != "queue":
                         self._pop_front_locked()
                         self._queued.pop(query_id, None)
                         self._admit_locked(handle)
@@ -420,6 +446,20 @@ class AdmissionController:
             handles = list(self._running.values()) + \
                 list(self._queued.values())
         return sum(1 for h in handles if h.token.cancel(reason))
+
+    def cancel_running(self, reason: str, error_cls=None) -> int:
+        """Cancel only the RUNNING queries (the device-loss fence:
+        queued queries never touched the dead device — they keep their
+        queue positions and run after recovery). `error_cls` lets the
+        fence unwind them with a retryable DeviceLostError instead of
+        plain QueryCancelledError."""
+        from spark_rapids_tpu.runtime.errors import QueryCancelledError
+
+        with self._cv:
+            handles = list(self._running.values())
+        cls = error_cls or QueryCancelledError
+        return sum(1 for h in handles
+                   if h.token.cancel(reason, error_cls=cls))
 
     def status(self) -> dict:
         return {"running": self.running_table(),

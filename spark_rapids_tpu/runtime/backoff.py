@@ -52,6 +52,22 @@ class BackoffPolicy:
         return raw / 1000.0 * (0.5 + 0.5 * _jitter_rng.random())
 
 
+def total_budget_ms(conf=None) -> int:
+    """The per-query cumulative retry-delay budget
+    (spark.rapids.tpu.io.retry.maxTotalMs; 0 = unlimited), resolved
+    like the policy: session conf first, entry default otherwise."""
+    from spark_rapids_tpu.config import rapids_conf as rc
+
+    if conf is None:
+        from spark_rapids_tpu.api.session import TpuSparkSession
+
+        s = TpuSparkSession.active()
+        conf = s.rapids_conf if s is not None else None
+    if conf is None:
+        return int(rc.IO_RETRY_MAX_TOTAL_MS.default)
+    return int(conf.get(rc.IO_RETRY_MAX_TOTAL_MS))
+
+
 def policy_from_conf(conf=None) -> BackoffPolicy:
     """Resolve the session's retry policy (falls back to entry defaults
     when no session is active — component-level callers and tests)."""
@@ -118,7 +134,26 @@ def retry_io(fn: Callable[[], T], what: str,
         if on_retry is not None:
             on_retry(last)
         if attempt < policy.attempts - 1:
-            sleep(policy.delay_s(attempt))
+            delay_s = policy.delay_s(attempt)
+            # per-QUERY cumulative budget: chained retry storms (every
+            # site backing off at once during a device outage) fail
+            # fast with the budget named, instead of multiplying
+            # per-site backoffs into minutes of stacked sleeps
+            token = cancellation.current()
+            if token is not None:
+                budget = total_budget_ms()
+                if budget > 0:
+                    used = token.charge_retry_ms(delay_s * 1000.0)
+                    if used > budget:
+                        raise RetryExhausted(
+                            f"{what}: per-query cumulative retry "
+                            f"budget spark.rapids.tpu.io.retry."
+                            f"maxTotalMs={budget} exhausted "
+                            f"({used:.0f}ms of backoff across this "
+                            f"query's retry sites; last: "
+                            f"{type(last).__name__}: {last})"
+                        ) from last
+            sleep(delay_s)
     raise RetryExhausted(
         f"{what}: {policy.attempts} attempts exhausted "
         f"(last: {type(last).__name__}: {last})") from last

@@ -62,6 +62,10 @@ class CancelToken:
         self._callbacks: List[Callable[[], None]] = []
         self._reason: Optional[str] = None
         self._error_cls = QueryCancelledError
+        # cumulative backoff-sleep ledger (runtime/backoff.py): the
+        # token is the one per-query object every retry site shares,
+        # so the io.retry.maxTotalMs budget accrues here
+        self.retry_ms_used = 0.0
 
     # --- cancellation ---
 
@@ -170,6 +174,14 @@ class CancelToken:
                 f"(admission.quarantine.maxWorkerCrashes="
                 f"{self.quarantine_threshold}); crash history: [{rows}]",
                 QueryQuarantinedError)
+
+    def charge_retry_ms(self, ms: float) -> float:
+        """Accrue one backoff delay against this query's cumulative
+        retry budget; returns the new total (the caller compares it to
+        spark.rapids.tpu.io.retry.maxTotalMs)."""
+        with self._lock:
+            self.retry_ms_used += ms
+            return self.retry_ms_used
 
     def unwind_latency_s(self) -> Optional[float]:
         """Seconds from cancel request to now — admission.finish reads

@@ -447,6 +447,23 @@ def warm_count() -> int:
         return len(_warm)
 
 
+def invalidate_warm() -> int:
+    """Device-loss recovery hook (runtime/device_monitor.py): warm AOT
+    executables were loaded against the PJRT client the recovery just
+    tore down — drop them all. The disk artifacts they came from stay
+    valid (serialized HLO, epoch-free keys) and re-serve lazily: a
+    later session init re-runs warmup against the fresh backend, and a
+    cache miss simply recompiles. Returns how many were dropped."""
+    global _warmed_dir
+    with _warm_lock:
+        n = len(_warm)
+        _warm.clear()
+    with _lock:
+        # let the next configure() warm up again for the same dir
+        _warmed_dir = None
+    return n
+
+
 def start_warmup(top_k: int = 32) -> None:
     """Layer 3: AOT-compile the top-K most-used prior-run artifacts in
     the background (overlapping the first scan's decode/upload I/O).

@@ -140,6 +140,22 @@ class QueryDeadlineExceeded(QueryCancelledError):
     counts); cancellation semantics, with the deadline in the message."""
 
 
+class DeviceLostError(QueryCancelledError):
+    """The TPU runtime died under this query — a fatal PJRT/XLA error
+    at a dispatch/transfer site, or a stale device handle from a
+    previous device epoch (runtime/device_monitor.py). Cancellation
+    semantics: the query unwinds at its next yield point releasing
+    every permit and buffer, the engine fences and performs warm
+    recovery (epoch bump, backend rebuild, tier restore), and the
+    outermost collect resubmits the query once through admission
+    (device.recovery.resubmit — the sanitizer's retryVictim pattern).
+    `epoch` is the device epoch the failed work was stamped with."""
+
+    def __init__(self, msg: str, epoch: int = None):
+        self.epoch = epoch
+        super().__init__(msg)
+
+
 class QueryQuarantinedError(QueryCancelledError):
     """Poison-query quarantine: the query's attempts crashed workers
     (scheduler eviction feed) more than

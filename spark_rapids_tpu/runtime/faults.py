@@ -45,6 +45,19 @@ locations where the real world fails —
                         legacy-acquisition deadlock gates form their
                         cycle on every run instead of relying on
                         scheduler timing
+    device.fatal        fused/eager program dispatch and unspill H2D
+                        (runtime/device_monitor.py guard sites) — a
+                        FATAL runtime error, as if the PJRT client
+                        died: the engine fences, cancels in-flight
+                        queries with retryable DeviceLostError, warm-
+                        recovers (epoch bump + backend rebuild + tier
+                        restore) and resubmits once through admission
+    device.lost_buffer  spill-catalog batch registration
+                        (runtime/memory.py add_batch) — poisons ONE
+                        device buffer's epoch so its next use hits the
+                        stale-handle gate: the deterministic proof
+                        that pre-epoch handles raise instead of
+                        reading recycled device memory
 
 and every site's CONSUMER survives the injected fault: backoff retries
 (runtime/backoff.py), quarantine-and-recompile, or engine demotion.
@@ -86,6 +99,8 @@ KNOWN_SITES = (
     "query.cancel_race",
     "admission.slow_drain",
     "semaphore.partial_hold",
+    "device.fatal",
+    "device.lost_buffer",
 )
 
 

@@ -33,13 +33,35 @@ _segmented_mod = None
 def _env_token() -> Tuple:
     """Trace-environment facts that change what a structurally identical
     program computes: the backend (kernels branch on it, e.g. the MXU
-    segmented reductions) and the test-only forced-matmul flag."""
+    segmented reductions) and the test-only forced-matmul flag.
+    Deliberately EPOCH-FREE: this token rides into the persistent
+    compile-cache keys, and disk artifacts survive a device-loss
+    recovery (they reload into the rebuilt client) as well as process
+    restarts that reset the epoch to 1."""
     global _segmented_mod
     if _segmented_mod is None:  # lazy: segmented imports columnar.batch
         from spark_rapids_tpu.ops import segmented
 
         _segmented_mod = segmented
     return (jax.default_backend(), _segmented_mod._MM_FORCE.get())
+
+
+_device_monitor_mod = None
+
+
+def _mem_key(full: Tuple) -> Tuple:
+    """In-memory cache key: the persistent key PLUS the device epoch
+    (runtime/device_monitor.py). Executables jitted against a backend
+    that device-loss recovery tore down must never be re-dispatched —
+    the epoch bump makes every pre-recovery entry a miss, and programs
+    re-intern lazily against the fresh client (via the epoch-free disk
+    artifacts when one exists)."""
+    global _device_monitor_mod
+    if _device_monitor_mod is None:  # lazy: avoids an import cycle
+        from spark_rapids_tpu.runtime import device_monitor
+
+        _device_monitor_mod = device_monitor
+    return full + (("deviceEpoch", _device_monitor_mod._EPOCH),)
 
 
 def cached_jit(key: Tuple, build: Callable[[], Callable],
@@ -58,16 +80,16 @@ def cached_jit(key: Tuple, build: Callable[[], Callable],
     module's in-process structural reuse."""
 
     def dispatch(*args, **kwargs):
-        full = key + _env_token()
+        mem = _mem_key(key + _env_token())
         # lock-free fast path: CPython dict reads are atomic, and every
         # per-batch dispatch engine-wide funnels through here
-        fn = _cache.get(full)
+        fn = _cache.get(mem)
         if fn is None:
             with _lock:
-                fn = _cache.get(full)
+                fn = _cache.get(mem)
                 if fn is None:
-                    fn = _make_entry(full, key, build, jit_kwargs)
-                    _cache[full] = fn
+                    fn = _make_entry(mem[:-1], key, build, jit_kwargs)
+                    _cache[mem] = fn
         return fn(*args, **kwargs)
 
     return dispatch
@@ -133,9 +155,9 @@ def detached(op):
 
 def probe(key: Tuple) -> bool:
     """Whether a program for `key` (under the CURRENT trace
-    environment) is already resident — per-query compiled-vs-hit
-    accounting without forcing a build."""
-    return (key + _env_token()) in _cache
+    environment and device epoch) is already resident — per-query
+    compiled-vs-hit accounting without forcing a build."""
+    return _mem_key(key + _env_token()) in _cache
 
 
 def cache_size() -> int:

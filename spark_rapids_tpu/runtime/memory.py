@@ -49,6 +49,16 @@ class SpillTier(Enum):
     DISK = 2
 
 
+#: uids of every SpillCatalog constructed by THIS process. The startup
+#: orphan sweep removes spill files whose embedded catalog uid is not
+#: in this set: a crashed process's leftovers (truncated .inprogress
+#: writes AND completed files nothing references anymore) are garbage,
+#: while a force-rebuilt session's previous catalog — whose live
+#: spillables still reference their files — stays untouched.
+_live_catalog_uids = set()
+_live_uids_lock = threading.Lock()
+
+
 class SpillPriority:
     """Lower spills first (reference SpillPriorities.scala)."""
 
@@ -78,6 +88,14 @@ class SpillableBatch:
         # per roundtrip on tunneled devices; hundreds of parks per query)
         self.id = uuid.uuid4().hex[:12]
         self.closed = False
+        # device-epoch stamp of the DEVICE-tier copy
+        # (runtime/device_monitor.py): a device-loss recovery marks
+        # every device-resident buffer lost; host/disk copies survive
+        # and re-stamp on unspill
+        from spark_rapids_tpu.runtime import device_monitor
+
+        self.device_epoch = device_monitor.current_epoch()
+        self._device_lost = False
 
     @property
     def tier(self) -> SpillTier:
@@ -156,11 +174,26 @@ class SpillableBatch:
         from spark_rapids_tpu.obs import telemetry
         from spark_rapids_tpu.runtime.profiler import annotate
 
-        path = os.path.join(self._catalog.spill_dir, f"spill-{self.id}.npz")
+        path = os.path.join(
+            self._catalog.spill_dir,
+            f"spill-{self._catalog.uid}-{self.id}.npz")
+
+        def write_atomic():
+            # crash consistency: a process dying mid-spill must never
+            # leave a truncated file a later unspill trusts — write to
+            # .inprogress, fsync, then atomically rename into place
+            # (the catalog startup sweep reaps orphaned .inprogress
+            # files of dead processes)
+            tmp = path + ".inprogress"
+            with open(tmp, "wb") as f:
+                np.savez(f, *self._host_data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+
         t0 = _time.monotonic_ns()
         with annotate(f"spill:HOST2DISK:{self.size_bytes}"):
-            self._disk_io(lambda: np.savez(path, *self._host_data),
-                          "write", path)
+            self._disk_io(write_atomic, "write", path)
         telemetry.record("spill-disk", "spill.toDisk", self.size_bytes,
                          ns=_time.monotonic_ns() - t0,
                          query_id=self.query_id)
@@ -194,11 +227,17 @@ class SpillableBatch:
             import time as _time
 
             from spark_rapids_tpu.obs import telemetry
+            from spark_rapids_tpu.runtime import device_monitor
             from spark_rapids_tpu.runtime.profiler import annotate
 
             t0 = _time.monotonic_ns()
-            with annotate(f"unspill:H2D:{self.size_bytes}"):
-                leaves = [jax.device_put(x) for x in self._host_data]
+            # transfer-site fatal classification + device.fatal chaos:
+            # an H2D upload into a dead backend is a fence trigger,
+            # not a raw XlaRuntimeError through an operator
+            with device_monitor.guard("spill.unspill", inject=True):
+                with annotate(f"unspill:H2D:{self.size_bytes}"):
+                    leaves = [jax.device_put(x)
+                              for x in self._host_data]
             telemetry.record("h2d", "spill.unspill", self.size_bytes,
                              ns=_time.monotonic_ns() - t0,
                              query_id=self.query_id)
@@ -206,11 +245,39 @@ class SpillableBatch:
                 self._treedef, leaves)
             self._host_data = None
             self._tier = SpillTier.DEVICE
+            # freshly uploaded: this copy belongs to the live backend
+            from spark_rapids_tpu.runtime import device_monitor
+
+            self.device_epoch = device_monitor.current_epoch()
+            self._device_lost = False
 
     # --- public API ---
 
     def get_batch(self) -> ColumnBatch:
-        """Materialize on device (unspilling if needed; reserves budget)."""
+        """Materialize on device (unspilling if needed; reserves
+        budget). A DEVICE-tier copy from a dead epoch raises
+        DeviceLostError instead of handing out recycled device memory
+        — the buffer was device-only when the device died, so the
+        owner must recompute (lineage scheduler / query resubmit)."""
+        if self._tier == SpillTier.DEVICE:
+            from spark_rapids_tpu.runtime import device_monitor
+
+            if self._device_lost:
+                device_monitor.check_stale(
+                    self.device_epoch, f"spillable buffer {self.id}")
+                # lost flag without an epoch delta cannot happen (the
+                # flag is only set by on_device_lost after a bump),
+                # but never hand out a lost buffer either way
+                from spark_rapids_tpu.runtime.errors import (
+                    DeviceLostError,
+                )
+
+                raise DeviceLostError(
+                    f"spillable buffer {self.id} was device-resident "
+                    f"when the device was lost; recompute it",
+                    epoch=self.device_epoch)
+            device_monitor.check_stale(
+                self.device_epoch, f"spillable buffer {self.id}")
         self._catalog.unspill(self)
         return self._device_batch
 
@@ -270,6 +337,9 @@ class SpillCatalog:
         self.host_limit = host_limit
         self.host_used = 0
         self.spill_dir = spill_dir or tempfile.mkdtemp(prefix="srtpu-spill-")
+        self.uid = uuid.uuid4().hex[:8]
+        with _live_uids_lock:
+            _live_catalog_uids.add(self.uid)
         self._buffers: Dict[str, SpillableBatch] = {}
         self._lock = threading.RLock()
         # per-query DEVICE reservation ledger (the quota unit,
@@ -286,7 +356,42 @@ class SpillCatalog:
         self.metrics = {
             "spill_to_host": 0, "spill_to_disk": 0, "unspill": 0,
             "retry_oom_injected": 0, "quota_oom": 0,
+            "orphaned_files_swept": 0, "device_lost_buffers": 0,
         }
+        self._sweep_orphans()
+
+    def _sweep_orphans(self) -> None:
+        """Catalog-startup crash recovery: remove spill files owned by
+        no live catalog of this process — truncated `.inprogress`
+        writes AND completed files a dead process left behind (a crash
+        loses every in-memory reference, so they are unreachable).
+        Counted in metrics['orphaned_files_swept'] (the
+        spill.orphanedFiles robustness metric)."""
+        try:
+            names = os.listdir(self.spill_dir)
+        except OSError:
+            return
+        with _live_uids_lock:
+            live = set(_live_catalog_uids)
+        swept = 0
+        for name in names:
+            core = name[:-len(".inprogress")] \
+                if name.endswith(".inprogress") else name
+            if not (core.startswith("spill-") and core.endswith(".npz")):
+                continue
+            parts = core[len("spill-"):-len(".npz")].split("-")
+            owner = parts[0] if len(parts) >= 2 else ""
+            if owner in live:
+                # a live catalog's file: completed files are
+                # referenced by its spillables; an .inprogress file
+                # may be a concurrent in-flight write — never touch
+                continue
+            try:
+                os.unlink(os.path.join(self.spill_dir, name))
+                swept += 1
+            except OSError:
+                pass
+        self.metrics["orphaned_files_swept"] = swept
 
     # --- registration ---
 
@@ -298,6 +403,14 @@ class SpillCatalog:
         qid = obs_events.effective_query_id()
         sb = SpillableBatch(self, batch, priority, query_id=qid)
         self.reserve(sb.size_bytes, tag="add_batch", query_id=qid)
+        from spark_rapids_tpu.runtime import faults
+
+        if faults.should_inject("device.lost_buffer"):
+            # chaos site device.lost_buffer: poison THIS buffer's
+            # device epoch so its next use hits the stale-handle gate
+            # deterministically — the proof that pre-epoch handles
+            # raise DeviceLostError instead of reading recycled memory
+            sb.device_epoch -= 1
         with self._lock:
             self._buffers[sb.id] = sb
         return sb
@@ -307,6 +420,11 @@ class SpillCatalog:
             if self._buffers.pop(sb.id, None) is None:
                 return
             if sb.tier == SpillTier.DEVICE:
+                if sb._device_lost:
+                    # reservation already released by on_device_lost
+                    # (the dead backend freed the HBM); a second
+                    # release would corrupt the ledger
+                    return
                 self.pool.release(sb.size_bytes)
                 self._q_release(sb.query_id, sb.size_bytes)
             elif sb.tier == SpillTier.HOST:
@@ -493,6 +611,7 @@ class SpillCatalog:
             candidates = sorted(
                 (b for b in self._buffers.values()
                  if b.tier == SpillTier.DEVICE and not b.closed
+                 and not b._device_lost
                  and (query_id is None or b.query_id == query_id)),
                 key=lambda b: (b._priority, -b.size_bytes))
             for b in candidates:
@@ -587,6 +706,32 @@ class SpillCatalog:
                 "spill", component="catalog", direction="up",
                 fromTier="HOST" if was_host else "DISK",
                 toTier="DEVICE", bytes=sb.size_bytes)
+
+    def on_device_lost(self):
+        """Device-loss recovery hook (runtime/device_monitor.py): the
+        dead backend's HBM is gone, so every DEVICE-tier buffer is
+        marked lost (its owner's next get_batch raises DeviceLostError
+        — recompute via lineage/resubmit) and its pool + per-query
+        reservations are released so the ledger describes the FRESH
+        backend. HOST/DISK-tier buffers are untouched: they restore
+        lazily into the new epoch on their next unspill. Returns
+        (restorable, dropped) buffer counts."""
+        restorable = dropped = 0
+        with self._lock:
+            for b in self._buffers.values():
+                if b.closed:
+                    continue
+                if b.tier == SpillTier.DEVICE:
+                    if not b._device_lost:
+                        b._device_lost = True
+                        b._device_batch = None  # never touch it again
+                        self.pool.release(b.size_bytes)
+                        self._q_release(b.query_id, b.size_bytes)
+                        dropped += 1
+                else:
+                    restorable += 1
+            self.metrics["device_lost_buffers"] += dropped
+        return restorable, dropped
 
     # --- stats ---
 

@@ -296,7 +296,17 @@ class TpuSemaphore:
                 f"permits={p} "
                 f"held_s={now - self._held_since.get(tid, now):.1f}"
                 for tid, p in sorted(self._holders.items())]
-        return "[" + ", ".join(rows) + "]" if rows else "[none]"
+        table = "[" + ", ".join(rows) + "]" if rows else "[none]"
+        # engine fence state + device epoch (runtime/device_monitor.py):
+        # a SemaphoreTimeout during device-loss recovery names the
+        # fence, so the diagnosis is "recovery in progress", not a
+        # mystery wedge
+        from spark_rapids_tpu.runtime import device_monitor
+
+        mon = device_monitor.get()
+        state = "FENCED" if mon.fenced else "RUNNING"
+        return (f"{table}; engine={state} "
+                f"deviceEpoch={mon.epoch}")
 
     def release_if_necessary(self, task_id: int):
         from spark_rapids_tpu.runtime import sanitizer as _san

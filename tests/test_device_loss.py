@@ -1,0 +1,510 @@
+"""Device-loss resilience suite — fatal-TPU detection, device fencing,
+the device epoch, and warm engine recovery (runtime/device_monitor.py).
+
+The acceptance contract under test: a query interrupted by an injected
+`device.fatal` mid-execution completes with oracle-identical results
+after warm recovery (no process restart) on BOTH engines, with the
+epoch bumped exactly once per fence and zero leaked permits/buffers;
+stale pre-epoch device handles deterministically raise DeviceLostError
+instead of touching recycled device memory; a cancel racing the fence
+unwind still yields a single clean error and a leak-free engine; and
+the satellite disciplines hold (crash-consistent spill files with an
+orphan sweep, the per-query cumulative retry budget, fence state in
+the semaphore diagnostics table).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import spark_rapids_tpu.api.functions as F
+from spark_rapids_tpu.api.session import TpuSparkSession
+from spark_rapids_tpu.runtime import (
+    backoff,
+    cancellation,
+    device_monitor,
+    faults,
+)
+from spark_rapids_tpu.runtime import semaphore as sem_mod
+from spark_rapids_tpu.runtime.errors import (
+    DeviceLostError,
+    QueryCancelledError,
+    QueryRejectedError,
+    RetryExhausted,
+)
+from spark_rapids_tpu.runtime.memory import get_catalog
+
+
+def _mk_parquet(tmp_path, rows=20_000, mod=7):
+    rng = np.random.default_rng(11)
+    path = str(tmp_path / "dl")
+    os.makedirs(path, exist_ok=True)
+    pq.write_table(pa.table({
+        "k": pa.array(np.arange(rows) % mod, pa.int64()),
+        "v": pa.array(rng.random(rows)),
+    }), os.path.join(path, "part-0.parquet"))
+    return path
+
+
+def _agg(s, data):
+    return (s.read.parquet(data).repartition(4, "k").groupBy("k")
+            .agg(F.sum("v").alias("sv")).orderBy("k"))
+
+
+def _wait_until(pred, timeout_s=10.0, tick=0.005):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(tick)
+    return False
+
+
+def _assert_clean():
+    assert _wait_until(lambda: sem_mod.get().holders() == 0
+                       and get_catalog().buffer_count() == 0), \
+        sem_mod.get()._holder_diagnostics()
+    get_catalog().check_leaks(raise_on_leak=True)
+
+
+# ------------------------------------------------------ classification
+
+def test_classify_taxonomy():
+    assert device_monitor.classify(
+        faults.InjectedFault("device.fatal")) == "fatal"
+    assert device_monitor.classify(
+        faults.InjectedFault("io.read")) == "other"
+    assert device_monitor.classify(
+        DeviceLostError("x", epoch=1)) == "fatal"
+    from spark_rapids_tpu.runtime.errors import TpuRetryOOM
+
+    assert device_monitor.classify(TpuRetryOOM("oom")) == "oom"
+    assert device_monitor.classify(ValueError("nope")) == "other"
+
+
+def test_plugin_fatal_policy_excludes_recovered_form():
+    from spark_rapids_tpu.plugin import _is_fatal_device_error
+
+    assert not _is_fatal_device_error(DeviceLostError("handled",
+                                                      epoch=1))
+    assert _is_fatal_device_error(faults.InjectedFault("device.fatal"))
+
+
+# -------------------------------------------- warm recovery, end to end
+
+@pytest.mark.parametrize("fused", [True, False],
+                         ids=["fused", "per-operator"])
+def test_device_fatal_recovers_oracle_identical(tmp_path, fused):
+    """A mid-query device.fatal costs one recovery window: the engine
+    fences, bumps the epoch exactly once, rebuilds the backend, and
+    the resubmitted query returns oracle-identical results — no
+    process restart, zero leaked permits/buffers."""
+    data = _mk_parquet(tmp_path)
+    s = TpuSparkSession({"spark.sql.shuffle.partitions": 4})
+    try:
+        want = _agg(s, data).collect_arrow().to_pydict()
+    finally:
+        s.stop()
+    conf = {"spark.sql.shuffle.partitions": 4,
+            "spark.rapids.tpu.chaos.enabled": True,
+            "spark.rapids.tpu.chaos.sites": "device.fatal:once"}
+    if not fused:
+        conf["spark.rapids.sql.fusedExec.enabled"] = False
+    s = TpuSparkSession(conf)
+    try:
+        mon = device_monitor.get()
+        e0 = mon.epoch
+        c0 = mon.counters()
+        got = _agg(s, data).collect_arrow().to_pydict()
+        assert got == want
+        c1 = mon.counters()
+        assert c1["fences"] - c0["fences"] == 1
+        assert c1["epoch"] == e0 + 1, "epoch bumps exactly once"
+        assert c1["recoveries"] - c0["recoveries"] == 1
+        assert c1["resubmits"] - c0["resubmits"] == 1
+        assert not mon.fenced
+        _assert_clean()
+        # recovery is visible as epoch-tagged obs events
+        evs = s.obs.history.events()
+        kinds = [e["event"] for e in evs]
+        assert "device.fatal" in kinds
+        assert "device.fence" in kinds
+        rec = [e for e in evs if e["event"] == "device.recovery"]
+        assert rec and rec[-1]["epoch"] == e0 + 1
+    finally:
+        s.stop()
+
+
+def test_lost_buffer_stale_handle_raises_then_recovers(tmp_path):
+    """Chaos site device.lost_buffer: one poisoned device buffer's
+    next use raises DeviceLostError (stale pre-epoch handle — never a
+    read of recycled memory), the query unwinds cleanly and the
+    resubmission is oracle-identical."""
+    data = _mk_parquet(tmp_path, rows=30_000)
+    base = {"spark.rapids.sql.fusedExec.enabled": False,
+            "spark.sql.shuffle.partitions": 4,
+            "spark.rapids.sql.reader.batchSizeRows": 4096}
+    s = TpuSparkSession(base)
+    try:
+        want = _agg(s, data).collect_arrow().to_pydict()
+    finally:
+        s.stop()
+    s = TpuSparkSession({
+        **base,
+        "spark.rapids.tpu.chaos.enabled": True,
+        "spark.rapids.tpu.chaos.sites": "device.lost_buffer:once"})
+    try:
+        mon = device_monitor.get()
+        stale0 = mon.counters()["staleHandles"]
+        got = _agg(s, data).collect_arrow().to_pydict()
+        assert got == want
+        assert mon.counters()["staleHandles"] == stale0 + 1
+        _assert_clean()
+    finally:
+        s.stop()
+
+
+def test_stale_spillable_raises_deterministically():
+    """Direct stale-handle check: a device-resident spillable stamped
+    with a dead epoch raises DeviceLostError from get_batch."""
+    from spark_rapids_tpu.columnar import arrow_to_device
+
+    s = TpuSparkSession({})
+    try:
+        catalog = get_catalog()
+        b = arrow_to_device(pa.table({"a": list(range(256))}))
+        sb = catalog.add_batch(b)
+        sb.device_epoch -= 1  # as if the device died under it
+        with pytest.raises(DeviceLostError) as ei:
+            sb.get_batch()
+        assert "stale device handle" in str(ei.value)
+        sb.close()
+        _assert_clean()
+    finally:
+        s.stop()
+
+
+def test_host_tier_survives_recovery():
+    """A spilled (host-tier) buffer is restorable: after on_device_lost
+    it unspills into the new epoch with identical contents, while a
+    device-tier buffer is dropped and raises."""
+    from spark_rapids_tpu.columnar import arrow_to_device
+    from spark_rapids_tpu.columnar.arrow_bridge import device_to_arrow
+
+    s = TpuSparkSession({})
+    try:
+        catalog = get_catalog()
+        vals = list(range(512))
+        spilled = catalog.add_batch(
+            arrow_to_device(pa.table({"a": vals})))
+        lost = catalog.add_batch(
+            arrow_to_device(pa.table({"a": vals})))
+        with catalog._lock:
+            catalog._spill_one(spilled)
+        assert spilled.tier.name == "HOST"
+        restorable, dropped = catalog.on_device_lost()
+        assert restorable >= 1 and dropped == 1
+        with pytest.raises(DeviceLostError):
+            lost.get_batch()
+        back = device_to_arrow(spilled.get_batch())
+        assert back.column("a").to_pylist() == vals
+        assert spilled.device_epoch == device_monitor.current_epoch()
+        spilled.close()
+        lost.close()
+        _assert_clean()
+    finally:
+        s.stop()
+
+
+# -------------------------------------------- cancel racing the fence
+
+def test_cancel_racing_fence_single_clean_error(tmp_path):
+    """Satellite acceptance: a user cancel landing WHILE device-loss
+    fencing unwinds the same query yields one clean
+    QueryCancelledError-family error (DeviceLostError is one), zero
+    held permits, zero leaked buffers/reservations — extends the
+    cancel-storm pattern to the fence unwind."""
+    data = _mk_parquet(tmp_path, rows=40_000)
+    s = TpuSparkSession({
+        "spark.rapids.sql.fusedExec.enabled": False,
+        "spark.sql.shuffle.partitions": 4,
+        "spark.rapids.sql.reader.batchSizeRows": 4096,
+        "spark.rapids.tpu.chaos.enabled": True,
+        # every dispatch fatal: the fence always lands mid-unwind, so
+        # the user cancel below always races it; resubmission would
+        # hit the site again, so the error must surface exactly once
+        "spark.rapids.tpu.chaos.sites": "device.fatal:every=3",
+        "spark.rapids.tpu.device.recovery.resubmit": False})
+    try:
+        df = _agg(s, data)
+        outcomes = []
+        for i in range(4):
+            err = []
+
+            def run():
+                try:
+                    df.collect_arrow()
+                    err.append(None)
+                except QueryCancelledError as e:
+                    err.append(e)  # DeviceLostError included
+
+            t = threading.Thread(target=run)
+            t.start()
+            time.sleep(0.005 * i)  # cancel lands at varied depths
+            s.cancel_all("storm racing the fence")
+            t.join(60)
+            assert not t.is_alive()
+            outcomes.append(err[0] if err else "hung")
+            device_monitor.get().await_ready()
+        assert all(o is None or isinstance(o, QueryCancelledError)
+                   for o in outcomes), outcomes
+        assert _wait_until(
+            lambda: s.admission_status()["running"] == [])
+        _assert_clean()
+        # and the engine still serves queries afterwards
+        faults.configure(None)
+        out = df.collect_arrow()
+        assert out.num_rows == 7
+    finally:
+        faults.configure(None)
+        s.stop()
+
+
+# -------------------------------------------------- fenced admission
+
+def test_fenced_admission_degrade_serves_cpu(tmp_path):
+    """While fenced, the degrade ladder serves on the CPU rung: the
+    query completes (engine=cpu) with a recorded demotion naming the
+    fence, and never touches a device rung."""
+    data = _mk_parquet(tmp_path, rows=4_000)
+    s = TpuSparkSession({})
+    try:
+        mon = device_monitor.get()
+        with mon._cv:
+            mon._fenced = True
+        try:
+            out = _agg(s, data).collect_arrow()
+            assert out.num_rows == 7
+            rec = s.last_execution
+            assert rec["engine"] == "cpu"
+            assert any("device fenced" in d["reason"]
+                       for d in rec["degradations"])
+        finally:
+            with mon._cv:
+                mon._fenced = False
+                mon._cv.notify_all()
+    finally:
+        s.stop()
+
+
+def test_fenced_admission_shed_and_queue(tmp_path):
+    data = _mk_parquet(tmp_path, rows=4_000)
+    s = TpuSparkSession({
+        "spark.rapids.tpu.device.recovery.fencedAdmission": "shed"})
+    try:
+        mon = device_monitor.get()
+        with mon._cv:
+            mon._fenced = True
+        try:
+            with pytest.raises(QueryRejectedError) as ei:
+                _agg(s, data).collect_arrow()
+            assert "FENCED" in str(ei.value)
+        finally:
+            with mon._cv:
+                mon._fenced = False
+                mon._cv.notify_all()
+    finally:
+        s.stop()
+    # queue mode: submission parks until the fence lifts, then runs
+    s = TpuSparkSession({
+        "spark.rapids.tpu.device.recovery.fencedAdmission": "queue"})
+    try:
+        mon = device_monitor.get()
+        with mon._cv:
+            mon._fenced = True
+        got = []
+
+        def run():
+            got.append(_agg(s, data).collect_arrow().num_rows)
+
+        t = threading.Thread(target=run)
+        t.start()
+        time.sleep(0.15)
+        assert not got, "queued submission must wait out the fence"
+        with mon._cv:
+            mon._fenced = False
+            mon._cv.notify_all()
+        mon._notify_admission()
+        t.join(30)
+        assert got == [7]
+    finally:
+        s.stop()
+
+
+# ------------------------------------------------- epoch invalidation
+
+def test_epoch_invalidates_jit_and_dict_caches():
+    """An epoch bump makes every in-memory compiled program a miss
+    (old executables reference the torn-down client) and drops the
+    encoded-dictionary device cache, while host dictionaries survive
+    for lazy re-upload."""
+    from spark_rapids_tpu.columnar import encoding
+    from spark_rapids_tpu.runtime import jit_cache
+
+    s = TpuSparkSession({})
+    try:
+        key = ("test-epoch-inval",)
+        fn = jit_cache.cached_jit(key, lambda: (lambda x: x + 1))
+        import jax.numpy as jnp
+
+        assert int(fn(jnp.int32(1))) == 2
+        assert jit_cache.probe(key)
+        arr = pa.array(["a", "b", "a", None]).dictionary_encode()
+        did, _ = encoding.intern_dictionary(arr.dictionary)
+        assert encoding.device_dictionary(did) is not None
+        device_monitor._EPOCH += 1
+        try:
+            assert not jit_cache.probe(key), \
+                "epoch bump must invalidate resident programs"
+            dropped = encoding.invalidate_device_cache()
+            assert dropped >= 1
+            # host dictionary survives; device copy re-uploads lazily
+            assert encoding.dictionary_values(did) is not None
+            assert encoding.device_dictionary(did) is not None
+        finally:
+            encoding.invalidate_device_cache()
+            device_monitor._EPOCH -= 1
+    finally:
+        s.stop()
+
+
+# ------------------------------------------------ satellite: sweeping
+
+def test_crash_consistent_spill_sweep(tmp_path):
+    """A crash mid-spill leaves .inprogress (and orphaned complete)
+    files; catalog startup sweeps anything no live catalog owns and
+    counts it, while the live catalog's own files are untouched."""
+    from spark_rapids_tpu.columnar import arrow_to_device
+    from spark_rapids_tpu.runtime.memory import SpillCatalog
+
+    spill_dir = str(tmp_path / "spill")
+    os.makedirs(spill_dir)
+    # a dead process's leftovers: truncated in-progress + orphan
+    for name in ("spill-deadbeef-aaaaaaaaaaaa.npz.inprogress",
+                 "spill-deadbeef-bbbbbbbbbbbb.npz",
+                 "spill-cccccccccccc.npz"):  # legacy unprefixed
+        with open(os.path.join(spill_dir, name), "wb") as f:
+            f.write(b"truncated")
+    cat = SpillCatalog(device_limit=1 << 24, host_limit=1 << 24,
+                       spill_dir=spill_dir)
+    assert cat.metrics["orphaned_files_swept"] == 3
+    assert os.listdir(spill_dir) == []
+    # a real spill round-trips through .inprogress + atomic rename
+    sb = cat.add_batch(arrow_to_device(
+        pa.table({"a": list(range(128))})))
+    with cat._lock:
+        sb._to_host()
+        sb._to_disk()
+    files = os.listdir(spill_dir)
+    assert len(files) == 1 and files[0].startswith(f"spill-{cat.uid}-")
+    assert not files[0].endswith(".inprogress")
+    # a SECOND catalog in the same process must not sweep the live one
+    cat2 = SpillCatalog(device_limit=1 << 24, host_limit=1 << 24,
+                        spill_dir=spill_dir)
+    assert cat2.metrics["orphaned_files_swept"] == 0
+    assert os.listdir(spill_dir) == files
+    from spark_rapids_tpu.columnar.arrow_bridge import device_to_arrow
+
+    assert device_to_arrow(
+        sb.get_batch()).column("a").to_pylist() == list(range(128))
+    sb.close()
+
+
+# --------------------------------------------- satellite: retry budget
+
+def test_cumulative_retry_budget_fails_fast():
+    """Chained retry storms during an outage: the per-query cumulative
+    budget (io.retry.maxTotalMs) fails fast with the budget named,
+    instead of multiplying per-site backoffs."""
+    s = TpuSparkSession({
+        "spark.rapids.tpu.io.retry.attempts": 50,
+        "spark.rapids.tpu.io.retry.backoffMs": 20,
+        "spark.rapids.tpu.io.retry.maxBackoffMs": 20,
+        "spark.rapids.tpu.io.retry.maxTotalMs": 60})
+    try:
+        token = cancellation.CancelToken(991)
+        calls = {"n": 0}
+
+        def always_fails():
+            calls["n"] += 1
+            raise OSError("outage")
+
+        t0 = time.monotonic()
+        with cancellation.scope(token):
+            with pytest.raises(RetryExhausted) as ei:
+                backoff.retry_io(always_fails, what="site A",
+                                 site=None, retry_on=(OSError,))
+        msg = str(ei.value)
+        assert "maxTotalMs=60" in msg and "cumulative" in msg
+        assert calls["n"] < 50, "budget must cut the attempt loop short"
+        assert time.monotonic() - t0 < 5.0
+        # the budget is per QUERY: a second site under the same token
+        # inherits the spent budget and fails immediately
+        calls["n"] = 0
+        with cancellation.scope(token):
+            with pytest.raises(RetryExhausted):
+                backoff.retry_io(always_fails, what="site B",
+                                 site=None, retry_on=(OSError,))
+        assert calls["n"] <= 2
+    finally:
+        s.stop()
+
+
+def test_retry_budget_disabled_keeps_attempt_loop():
+    s = TpuSparkSession({
+        "spark.rapids.tpu.io.retry.attempts": 4,
+        "spark.rapids.tpu.io.retry.backoffMs": 1,
+        "spark.rapids.tpu.io.retry.maxBackoffMs": 1,
+        "spark.rapids.tpu.io.retry.maxTotalMs": 0})
+    try:
+        token = cancellation.CancelToken(992)
+        calls = {"n": 0}
+
+        def always_fails():
+            calls["n"] += 1
+            raise OSError("outage")
+
+        with cancellation.scope(token):
+            with pytest.raises(RetryExhausted):
+                backoff.retry_io(always_fails, what="site",
+                                 site=None, retry_on=(OSError,))
+        assert calls["n"] == 4
+    finally:
+        s.stop()
+
+
+# -------------------------------------- satellite: semaphore diagnosis
+
+def test_semaphore_diagnostics_name_fence_and_epoch():
+    sem = sem_mod.TpuSemaphore(concurrent_tasks=2)
+    sem.acquire_if_necessary(12345)
+    try:
+        diag = sem._holder_diagnostics()
+        assert "deviceEpoch=" in diag
+        assert "engine=RUNNING" in diag
+        mon = device_monitor.get()
+        with mon._cv:
+            mon._fenced = True
+        try:
+            assert "engine=FENCED" in sem._holder_diagnostics()
+        finally:
+            with mon._cv:
+                mon._fenced = False
+                mon._cv.notify_all()
+    finally:
+        sem.release_if_necessary(12345)

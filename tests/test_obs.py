@@ -318,8 +318,11 @@ def test_robustness_metrics_keys_unchanged():
         rm = s.robustness_metrics
         assert set(rm) == {"chaos", "retries", "shuffle", "scheduler",
                            "degrade", "admission", "sanitizer",
+                           "device", "spill",
                            "artifactsQuarantined", "semaphoreTimeouts"}
         assert "queriesAdmitted" in rm["admission"]
+        assert {"epoch", "fences", "recoveries"} <= set(rm["device"])
+        assert "orphanedFilesSwept" in rm["spill"]
         assert set(rm["sanitizer"]) == {"cycles", "inversions",
                                         "victims", "enabled"}
         assert set(rm["shuffle"]) == {"fetchRetries", "checksumFailures",
