@@ -33,6 +33,9 @@ ci/encoded_check.sh
 echo "== device-failure gate (fence + warm recovery + epoch) =="
 ci/devicefail_check.sh
 
+echo "== multichip gate (SPMD oracle + ICI bytes + chip loss) =="
+ci/multichip_check.sh
+
 echo "== multichip dryrun (virtual mesh) =="
 SPARK_RAPIDS_TPU_DRYRUN_REEXEC=1 python - <<'PY'
 import jax

@@ -966,6 +966,15 @@ class DataFrame:
 
     def explain(self, extended: bool = False):
         phys, meta = self._physical()
+        rec0 = getattr(self, "_last_exec", None)
+        if rec0 is not None and rec0.get("engine") == "mesh":
+            # re-derive the mesh planner's exchange-transport choice on
+            # this fresh plan so pretty() shows [strategy=ici]
+            from spark_rapids_tpu.parallel.plan_compiler import (
+                stamp_exchange_strategies,
+            )
+
+            stamp_exchange_strategies(phys, self.session.rapids_conf)
         print("== Physical Plan ==")
         print(phys.pretty())
         if extended:

@@ -445,6 +445,39 @@ MESH_SIZE = conf(
     "transport (the UCX P2P transport role, SURVEY.md 5.8); 0 = "
     "single-chip thread-pool engine. Plans with no mesh lowering fall "
     "back to the single-chip engine automatically.", int)
+MULTICHIP_RECONCILE_DICTS = conf(
+    "spark.rapids.tpu.multichip.reconcileDictionaries", True,
+    "Reconcile per-shard dictionary-encoded string columns into one "
+    "union dictionary at mesh ingestion (codes remapped host-side, "
+    "dictionary replicated over the mesh) so ICI exchanges move CODES "
+    "only; off = encoded columns decode before sharding.", bool)
+MULTICHIP_ICI_SHUFFLE = conf(
+    "spark.rapids.tpu.multichip.iciShuffle.enabled", True,
+    "Let the planner pick the ICI-resident strategy for hash "
+    "exchanges whose both sides are mesh-lowerable: the exchange "
+    "compiles to an on-device all_to_all with zero host-direction "
+    "bytes. Off = every exchange keeps the host-serialized shuffle "
+    "path (the whole plan falls back to the single-chip engine).",
+    bool)
+MULTICHIP_CHIP_RECOVERY = conf(
+    "spark.rapids.tpu.multichip.chipRecovery.enabled", True,
+    "On single-chip loss (chip.fatal), fence ONLY the lost chip and "
+    "re-execute the query's lineage over the surviving mesh while "
+    "other queries keep serving; off = chip loss propagates as "
+    "DeviceLostError.", bool)
+MULTICHIP_ICI_RETRIES = conf(
+    "spark.rapids.tpu.multichip.collectiveRetries", 2,
+    "Bounded retries for a failed ICI collective (ici.collective "
+    "faults) before the failure escalates to chip-loss handling.",
+    int)
+MULTICHIP_EXPANSION = conf(
+    "spark.rapids.tpu.multichip.expansion", 2,
+    "Skew allowance for per-destination all_to_all slot sizing "
+    "(slot = next_pow2(rows/n * expansion)): larger tolerates more "
+    "hash skew before TpuSplitAndRetryOOM, smaller shrinks the "
+    "exchange buffers and the recompile ladder. Under-provisioned "
+    "slots are caught by the overflow flag and the program recompiles "
+    "doubled, so the default starts lean.", int)
 MULTIHOST_COORDINATOR = conf(
     "spark.rapids.tpu.multihost.coordinator", "",
     "host:port of the jax.distributed coordination service. When set, "

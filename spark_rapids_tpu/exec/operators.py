@@ -1578,6 +1578,20 @@ class TpuShuffleExchangeExec(PhysicalPlan):
             ("exchange_partition", kkey, self._nparts),
             lambda: detached(self)._partition_batch)
 
+    #: planner-chosen shuffle transport: "host" (serialized blocks via
+    #: the in-process shuffle manager) or "ici" (the mesh engine
+    #: compiles this exchange to an on-device all_to_all over the
+    #: interconnect -- set per node by
+    #: MeshQueryExecutor.plan_exchange_strategies when both sides are
+    #: mesh-resident and iciShuffle is enabled)
+    ici_strategy = "host"
+
+    def _node_string(self) -> str:
+        base = type(self).__name__
+        if self.ici_strategy == "ici":
+            return f"{base} [strategy=ici]"
+        return base
+
     @property
     def num_partitions(self):
         return self._nparts

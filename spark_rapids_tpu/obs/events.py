@@ -67,6 +67,10 @@ EVENT_TYPES: Dict[str, str] = {
     "device.fence": "epoch, cause, inFlight",
     "device.recovery":
         "epoch, ms, drained, restorableBuffers, droppedBuffers",
+    "chip.fence": "device, chipEpoch, cause",
+    "chip.unfence": "device, chipEpoch",
+    "chip.recovery": "device, chipEpoch, shards, survivors, ms",
+    "ici.retry": "detail, left",
 }
 
 #: Envelope keys present on EVERY event (eventlog validation contract).

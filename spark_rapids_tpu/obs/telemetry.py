@@ -53,8 +53,12 @@ from typing import Dict, List, Optional
 
 from spark_rapids_tpu.obs import events as _events
 
-#: The four physical data-movement channels a transfer is tagged with.
-DIRECTIONS = ("h2d", "d2h", "spill-disk", "shuffle")
+#: The physical data-movement channels a transfer is tagged with.
+#: `ici` is the inter-chip interconnect: bytes moved by mesh collectives
+#: (all_to_all / all_gather inside SPMD programs) that never touch a
+#: host link — the proof surface for "host bytes went to zero" on an
+#: ICI-resident exchange.
+DIRECTIONS = ("h2d", "d2h", "spill-disk", "shuffle", "ici")
 
 #: Peak HBM bandwidth per chip, bytes/s (public TPU specs; the cpu
 #: backend gets a nominal DDR figure so fractions stay meaningful).
@@ -81,7 +85,8 @@ class _QueryLedger:
     """Per-query accumulation (one per queryId, bounded LRU)."""
 
     __slots__ = ("by_direction", "by_site", "hbm_peak", "hbm_current",
-                 "spill_pressure", "final", "enc_actual", "enc_plain")
+                 "spill_pressure", "final", "enc_actual", "enc_plain",
+                 "ici_host_avoided")
 
     def __init__(self):
         self.by_direction: Dict[str, Dict[str, int]] = {}
@@ -94,6 +99,10 @@ class _QueryLedger:
         # vs what the decoded representation would have staged
         self.enc_actual = 0
         self.enc_plain = 0
+        # host-link bytes an ICI-resident exchange kept off h2d/d2h
+        # (the d2h + h2d round trip of the decoded payload the host
+        # shuffle path would have moved for the same rows)
+        self.ici_host_avoided = 0
 
 
 class TransferLedger:
@@ -116,6 +125,8 @@ class TransferLedger:
         # encoded-execution savings (process totals)
         self.enc_actual = 0
         self.enc_plain = 0
+        # host-link bytes ICI collectives kept off h2d/d2h (process)
+        self.ici_host_avoided = 0
 
     # --- transfer recording ---
 
@@ -165,6 +176,27 @@ class TransferLedger:
             q = self._query(qid)
             q.enc_actual += int(actual_bytes)
             q.enc_plain += int(plain_bytes)
+
+    def record_ici(self, site: str, nbytes: int,
+                   host_equiv_bytes: int = 0,
+                   query_id: Optional[int] = None) -> None:
+        """Account one mesh collective: `nbytes` crossed the ICI
+        fabric inside an SPMD program (static send-buffer bytes x mesh
+        size, derived at trace time — collectives cannot self-report
+        from inside jit); `host_equiv_bytes` is what the host-shuffle
+        path would have moved over h2d+d2h for the same payload (the
+        decoded-layout round trip), feeding the per-query
+        `hostBytesAvoided` summary field."""
+        if not self.enabled or nbytes <= 0:
+            return
+        qid = query_id if query_id is not None \
+            else _events.effective_query_id()
+        self.record("ici", site, nbytes, query_id=qid)
+        if host_equiv_bytes > 0:
+            with self._lock:
+                self.ici_host_avoided += int(host_equiv_bytes)
+                self._query(qid).ici_host_avoided += \
+                    int(host_equiv_bytes)
 
     def record_forwarded(self, fields: dict,
                          query_id: Optional[int] = None) -> None:
@@ -242,6 +274,7 @@ class TransferLedger:
             pressure = 0 if q is None else q.spill_pressure
             enc_actual = 0 if q is None else q.enc_actual
             enc_plain = 0 if q is None else q.enc_plain
+            ici_avoided = 0 if q is None else q.ici_host_avoided
         total = sum(c["bytes"] for c in by_dir.values())
         link = sum(by_dir.get(d, _cell())["bytes"]
                    for d in ("h2d", "d2h"))
@@ -253,6 +286,13 @@ class TransferLedger:
             "hbmPeakBytes": hbm_peak,
             "spillPressureEvents": pressure,
         }
+        ici = by_dir.get("ici", _cell())["bytes"]
+        if ici > 0:
+            # ICI-resident shuffle: bytes that rode the mesh fabric
+            # instead of the host links, and the h2d+d2h round trip
+            # of the decoded payload those collectives displaced
+            out["iciBytes"] = ici
+            out["hostBytesAvoided"] = ici_avoided
         if enc_plain > 0 and enc_actual > 0:
             # encoded execution's measured win: bytes the dictionary
             # representation kept OFF the staging/transfer paths, and
@@ -312,6 +352,9 @@ class TransferLedger:
                             "plainBytes": self.enc_plain,
                             "savedBytes": max(
                                 0, self.enc_plain - self.enc_actual)},
+                "ici": {"bytes": self.totals.get(
+                            "ici", _cell())["bytes"],
+                        "hostBytesAvoided": self.ici_host_avoided},
             }
 
     def site_rows(self) -> List[dict]:
@@ -346,6 +389,7 @@ ledger = TransferLedger()
 # module-level aliases: instrumented sites stay one short call
 record = ledger.record
 record_encoded = ledger.record_encoded
+record_ici = ledger.record_ici
 record_forwarded = ledger.record_forwarded
 hbm_global = ledger.hbm_global
 hbm_query = ledger.hbm_query

@@ -29,15 +29,83 @@ run on N real TPU chips over ICI or N host devices for validation.
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from spark_rapids_tpu.columnar.batch import ColumnBatch
 from spark_rapids_tpu.ops import filterops
-from spark_rapids_tpu.ops.common import sort_permutation
+
+# ------------------------------------------------------- ICI byte tape
+#
+# Collectives run INSIDE jit — they cannot call the transfer ledger at
+# runtime. Instead, the python bodies below note every collective's
+# static per-shard byte movement while they are being TRACED; the mesh
+# executor brackets the tracing call with begin/end, stores the profile
+# per compiled-program key, and replays it into the ledger (direction
+# "ici") on every execution. Entries: (site, wire_bytes_per_shard,
+# host_equiv_bytes_per_shard) — host_equiv is the d2h + h2d round trip
+# of the DECODED payload the host shuffle path would have staged for
+# the same shard, which is what `hostBytesAvoided` reports.
+
+_ici_tape: Optional[List[tuple]] = None
+
+
+def begin_ici_tape() -> None:
+    global _ici_tape
+    _ici_tape = []
+
+
+def end_ici_tape() -> List[tuple]:
+    global _ici_tape
+    tape, _ici_tape = _ici_tape, None
+    return tape or []
+
+
+def _note_ici(site: str, wire_bytes: int, host_equiv: int) -> None:
+    if _ici_tape is not None:
+        _ici_tape.append((site, int(wire_bytes), int(host_equiv)))
+
+
+def _leaf_bytes(shape, dtype) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * np.dtype(dtype).itemsize
+
+
+def _host_equiv_bytes(col, rows: int) -> int:
+    """Per-shard bytes the host shuffle path would move for `rows` of
+    this column: serialize to host (d2h) + re-upload to the reducers
+    (h2d) — 2x the decoded layout. Encoded columns decode to the padded
+    [rows, max_bytes] matrix + lengths + validity on that path."""
+    enc = getattr(col, "encoding", None)
+    if enc is not None:
+        w = int(enc.data.shape[1])
+        return 2 * rows * (w + 4 + 1)
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(col):
+        shape = getattr(leaf, "shape", ())
+        if not shape:
+            continue
+        total += _leaf_bytes((rows,) + tuple(shape[1:]), leaf.dtype)
+    return 2 * total
+
+
+def _exchange_column(col, leaf_fn):
+    """Apply a leaf-wise exchange to one column, holding its
+    dictionary back: encoded columns move CODES over the fabric — the
+    dictionary is replicated on every shard (reconciled at ingestion),
+    so exchanging its rows would be both wrong (its [K, W] leaves are
+    not row-aligned with the batch) and wasteful."""
+    enc = getattr(col, "encoding", None)
+    if enc is None:
+        return jax.tree_util.tree_map(leaf_fn, col)
+    out = jax.tree_util.tree_map(leaf_fn, col.replace(encoding=None))
+    return out.replace(encoding=enc, vrange=col.vrange)
 
 
 def slot_capacity(shard_capacity: int, n_devices: int,
@@ -67,30 +135,32 @@ def _scatter_to_slots(arr: jnp.ndarray, dest: jnp.ndarray,
 
 
 def all_to_all_batch(batch: ColumnBatch, pid: jnp.ndarray, n_dest: int,
-                     slot: int, axis_name: str
+                     slot: int, axis_name: str,
+                     site: str = "ici.all_to_all"
                      ) -> Tuple[ColumnBatch, jnp.ndarray]:
     """Inside shard_map: exchange rows of this device's shard so row i
     lands on device pid[i]. Returns (new shard batch, overflow_flag).
 
-    The received shard's capacity is n_dest * slot.
+    The received shard's capacity is n_dest * slot. Encoded columns
+    exchange their CODES only; the replicated dictionary stays put.
     """
     cap = batch.capacity
     live = batch.live_mask()
     dest = jnp.where(live, pid, n_dest)  # dead rows -> dropped
-    # rank of each row within its destination: stable sort by dest then
-    # rank = position - first_position_of_dest
-    key = dest.astype(jnp.int64)
-    perm = sort_permutation([key], cap)
-    sorted_dest = jnp.take(dest, perm)
-    pos = jnp.arange(cap, dtype=jnp.int32)
+    # rank of each row within its destination: FIFO-stable bucket rank
+    # via one cumsum pass over a [cap, n_dest] one-hot — the
+    # compact_perm discipline generalized to n_dest buckets. A lax.sort
+    # here (the obvious rank construction) is log^2-pass and was the
+    # single most expensive op in every exchange.
     counts_all = jax.ops.segment_sum(
         live.astype(jnp.int32), jnp.clip(dest, 0, n_dest),
         num_segments=n_dest + 1)
-    starts_all = jnp.concatenate([jnp.zeros((1,), jnp.int32),
-                                  jnp.cumsum(counts_all)[:-1]])
-    rank_sorted = pos - jnp.take(starts_all, sorted_dest)
-    # un-permute rank back to original row order
-    rank = jnp.zeros((cap,), jnp.int32).at[perm].set(rank_sorted)
+    dclip = jnp.clip(dest, 0, n_dest - 1)
+    onehot = (dest[:, None]
+              == jnp.arange(n_dest, dtype=dest.dtype)[None, :])
+    cums = jnp.cumsum(onehot.astype(jnp.int32), axis=0)
+    rank = jnp.take_along_axis(cums, dclip[:, None].astype(jnp.int32),
+                               axis=1)[:, 0] - 1
     overflow = jnp.any(jnp.where(live, rank, 0) >= slot)
 
     recv_counts_per_src = lax.all_to_all(
@@ -109,9 +179,22 @@ def all_to_all_batch(batch: ColumnBatch, pid: jnp.ndarray, n_dest: int,
     # j < recv_counts_per_src[s]. Every per-row leaf of the column
     # pytree exchanges the same way — tree_map recurses into string
     # matrices, array element validity, map values, and struct children
-    # without per-field plumbing.
-    new_cols = [jax.tree_util.tree_map(exchange_leaf, col)
+    # without per-field plumbing; dictionaries are held back
+    # (_exchange_column).
+    new_cols = [_exchange_column(col, exchange_leaf)
                 for col in batch.columns]
+    wire = 4 * n_dest  # the recv-count metadata all_to_all
+    host_eq = 0
+    for col in batch.columns:
+        for leaf in jax.tree_util.tree_leaves(
+                col.replace(encoding=None)
+                if getattr(col, "encoding", None) is not None else col):
+            shape = getattr(leaf, "shape", ())
+            if shape:
+                wire += _leaf_bytes((n_dest * slot,) + tuple(shape[1:]),
+                                    leaf.dtype)
+        host_eq += _host_equiv_bytes(col, cap)
+    _note_ici(site, wire, host_eq)
     recv_cap = n_dest * slot
     slot_pos = jnp.tile(jnp.arange(slot, dtype=jnp.int32), n_dest)
     src_id = jnp.repeat(jnp.arange(n_dest, dtype=jnp.int32), slot)
@@ -124,8 +207,8 @@ def all_to_all_batch(batch: ColumnBatch, pid: jnp.ndarray, n_dest: int,
     return out, overflow
 
 
-def all_gather_batch(batch: ColumnBatch, axis_name: str, n: int
-                     ) -> ColumnBatch:
+def all_gather_batch(batch: ColumnBatch, axis_name: str, n: int,
+                     site: str = "ici.all_gather") -> ColumnBatch:
     """Inside shard_map: concatenate every shard's live rows onto every
     device — the broadcast-build transport (GpuBroadcastExchangeExec role
     over ICI instead of a host broadcast). Returns a batch of capacity
@@ -139,7 +222,18 @@ def all_gather_batch(batch: ColumnBatch, axis_name: str, n: int
         out = lax.all_gather(arr, axis_name)  # [n, cap, ...]
         return out.reshape((n * cap,) + arr.shape[1:])
 
-    new_cols = [jax.tree_util.tree_map(g, c) for c in batch.columns]
+    new_cols = [_exchange_column(c, g) for c in batch.columns]
+    wire = 4
+    host_eq = 0
+    for col in batch.columns:
+        for leaf in jax.tree_util.tree_leaves(
+                col.replace(encoding=None)
+                if getattr(col, "encoding", None) is not None else col):
+            shape = getattr(leaf, "shape", ())
+            if shape:
+                wire += _leaf_bytes(tuple(shape), leaf.dtype)
+        host_eq += _host_equiv_bytes(col, cap)
+    _note_ici(site, wire, host_eq)
     blk = jnp.repeat(jnp.arange(n, dtype=jnp.int32), cap)
     pos = jnp.tile(jnp.arange(cap, dtype=jnp.int32), n)
     live = pos < jnp.take(counts, blk)
@@ -154,7 +248,7 @@ def gather_to_one(batch: ColumnBatch, axis_name: str, n: int
     """Single-partition exchange: every row moves to shard 0 (other
     shards end up logically empty). The SPMD analog of the planner's
     TpuShuffleExchangeExec(num_partitions=1)."""
-    out = all_gather_batch(batch, axis_name, n)
+    out = all_gather_batch(batch, axis_name, n, site="ici.gather")
     me = lax.axis_index(axis_name)
     nr = jnp.where(me == 0,
                    jnp.asarray(out.num_rows, jnp.int32), jnp.int32(0))

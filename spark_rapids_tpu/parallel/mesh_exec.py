@@ -32,13 +32,16 @@ from spark_rapids_tpu.parallel.collective import all_to_all_batch
 AXIS = "data"
 
 
-def make_mesh(n_devices: int) -> Mesh:
+def make_mesh(n_devices: int, devices=None) -> Mesh:
+    """Mesh over the first n devices, or over an explicit device list
+    (the per-chip fence path hands in the healthy survivors)."""
     from spark_rapids_tpu.shims import get_shim
 
-    devs = jax.devices()[:n_devices]
+    devs = (list(devices)[:n_devices] if devices is not None
+            else jax.devices()[:n_devices])
     if len(devs) < n_devices:
         raise RuntimeError(
-            f"need {n_devices} devices, have {len(jax.devices())}")
+            f"need {n_devices} devices, have {len(devs)}")
     return get_shim().make_mesh(devs, AXIS)
 
 
@@ -50,7 +53,11 @@ def shard_batch(mesh: Mesh, batch: ColumnBatch) -> ColumnBatch:
     an [n] array): rows are contiguous, so shard s holds
     clip(global_rows - s*shard_cap, 0, shard_cap) live rows. Inside
     shard_map, `local.num_rows` is that shard's own count (shape [1],
-    which broadcasts wherever a scalar is expected)."""
+    which broadcasts wherever a scalar is expected).
+
+    Encoded columns shard their CODES; the dictionary (shared by every
+    row regardless of which shard it lands on) replicates across the
+    mesh — its [K, W] leaves have no row axis to shard."""
     n = mesh.shape[AXIS]
     assert batch.capacity % n == 0, (batch.capacity, n)
     shard_cap = batch.capacity // n
@@ -64,11 +71,67 @@ def shard_batch(mesh: Mesh, batch: ColumnBatch) -> ColumnBatch:
         return telemetry.ledgered_put(
             leaf, "mesh.shard", device=NamedSharding(mesh, P(AXIS)))
 
-    cols = jax.tree_util.tree_map(put_rows, tuple(batch.columns))
+    def put_col(col):
+        enc = getattr(col, "encoding", None)
+        if enc is None:
+            return jax.tree_util.tree_map(put_rows, col)
+        out = jax.tree_util.tree_map(put_rows,
+                                     col.replace(encoding=None))
+        return out.replace(encoding=replicate_dictionary(mesh, enc),
+                           vrange=col.vrange)
+
+    cols = [put_col(c) for c in batch.columns]
     counts = telemetry.ledgered_put(
         jnp.asarray(per_shard), "mesh.shard",
         device=NamedSharding(mesh, P(AXIS)))
     return ColumnBatch(batch.schema, list(cols), counts)
+
+
+def replicate_dictionary(mesh: Mesh, enc):
+    """Upload (or re-place) one DeviceDictionary fully replicated over
+    the mesh — every shard decodes / probes the same [K, W] matrix."""
+    from spark_rapids_tpu.columnar.encoding import DeviceDictionary
+    from spark_rapids_tpu.obs import telemetry
+
+    repl = NamedSharding(mesh, P())
+    return DeviceDictionary(
+        telemetry.ledgered_put(np.asarray(enc.data), "mesh.dict",
+                               device=repl),
+        telemetry.ledgered_put(np.asarray(enc.lengths), "mesh.dict",
+                               device=repl),
+        enc.dict_id)
+
+
+def dictionary_leaf_ids(batch) -> set:
+    """ids of the array leaves belonging to any column's (or struct
+    child's) DeviceDictionary — the leaves whose mesh placement is
+    replicated rather than row-sharded."""
+    out: set = set()
+
+    def mark(col):
+        enc = getattr(col, "encoding", None)
+        if enc is not None:
+            for leaf in jax.tree_util.tree_leaves(enc):
+                out.add(id(leaf))
+        for kid in (getattr(col, "children", None) or ()):
+            mark(kid)
+
+    for c in getattr(batch, "columns", []):
+        mark(c)
+    return out
+
+
+def batch_arg_specs(batch, row_spec):
+    """Per-leaf PartitionSpecs for a shard_map INPUT batch: every leaf
+    shards over the row axis except dictionary leaves, which are
+    replicated (identical on every shard after reconciliation)."""
+    dict_ids = dictionary_leaf_ids(batch)
+    if not dict_ids:
+        return input_batch_specs(batch, row_spec)
+    leaves, treedef = jax.tree_util.tree_flatten(batch)
+    return jax.tree_util.tree_unflatten(
+        treedef,
+        [P() if id(x) in dict_ids else row_spec for x in leaves])
 
 
 def batch_specs(tree, row_spec):

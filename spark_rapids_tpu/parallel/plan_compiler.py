@@ -77,6 +77,15 @@ class MeshCompileError(NotImplementedError):
 #: shard of the file list (never the whole table).
 last_ingest_stats: Dict[str, int] = {}
 
+#: Per-compiled-program trace-time profiles, keyed by the cached_jit
+#: key: the ICI collective byte tape (replayed into the transfer
+#: ledger on every execution — collectives cannot self-report from
+#: inside jit) and the output columns' dictionary ids (encodings are
+#: stripped from the traced output — a replicated dictionary has no
+#: row axis for the P(AXIS) out-spec — and re-attached after the run).
+_ici_profiles: Dict[tuple, list] = {}
+_out_enc_profiles: Dict[tuple, list] = {}
+
 
 # --------------------------------------------------- trace-safe helpers
 
@@ -258,7 +267,8 @@ def range_exchange_sort(batch: ColumnBatch, orders, n: int, axis: str,
     bounds = [jnp.take(k, j) for k in skeys]
     dest = _binary_search(bounds, keys, jnp.int32(n - 1), max(n - 1, 1),
                           upper=True)
-    exchanged, overflow = all_to_all_batch(batch, dest, n, slot, axis)
+    exchanged, overflow = all_to_all_batch(batch, dest, n, slot, axis,
+                                           site="ici.sort")
     return sort_batch(exchanged, orders), overflow
 
 
@@ -335,23 +345,92 @@ def _plan_key(node: PhysicalPlan) -> tuple:
     return (t, own, tuple(_plan_key(c) for c in node.children))
 
 
+def stamp_exchange_strategies(phys: PhysicalPlan, conf=None) -> None:
+    """Stamp each shuffle exchange with its transport strategy — "ici"
+    (compiled to an on-device all_to_all, zero host-direction bytes)
+    when ICI shuffle is enabled and the exchange's producer subtree is
+    mesh-lowerable (the consumer side is by construction: the mesh
+    executor compiles the whole plan as one SPMD program), else
+    "host". A "host" exchange has no mesh lowering, so the plan falls
+    back to the single-chip engine. Needs no mesh — explain() stamps
+    a fresh plan with it so the planner's choice is visible."""
+    from spark_rapids_tpu.config import rapids_conf as rc
+
+    ici_on = conf is None or conf.get(rc.MULTICHIP_ICI_SHUFFLE)
+    probe = MeshQueryExecutor.__new__(MeshQueryExecutor)
+
+    def mesh_resident(node: PhysicalPlan) -> bool:
+        try:
+            probe._collect_sources(node, [])
+        except MeshCompileError:
+            return False
+        return True
+
+    def walk(node: PhysicalPlan) -> None:
+        for c in node.children:
+            walk(c)
+        if isinstance(node, ops.TpuShuffleExchangeExec):
+            node.ici_strategy = ("ici" if ici_on and mesh_resident(node)
+                                 else "host")
+
+    walk(phys)
+
+
+def plan_bears_exchange(phys: PhysicalPlan) -> bool:
+    """True when executing this plan on a mesh would move rows between
+    shards through a hash/range exchange — explicit exchange nodes AND
+    the operators whose mesh lowering materializes one internally
+    (shuffled join co-partitioning, aggregate partial->final hand-off,
+    global sort's range exchange, window partitioning)."""
+
+    def walk(n: PhysicalPlan) -> bool:
+        if isinstance(n, (ops.TpuShuffleExchangeExec,
+                          ops.TpuHashAggregateExec,
+                          ops.TpuSortExec,
+                          ops.TpuWindowExec,
+                          J.TpuShuffledHashJoinExec)):
+            return True
+        return any(walk(c) for c in n.children)
+
+    return walk(phys)
+
+
 class MeshQueryExecutor:
     """Compile + run one physical plan as a single SPMD program."""
 
-    def __init__(self, mesh, conf=None, expansion: int = 4):
+    def __init__(self, mesh, conf=None, expansion: int = 0):
         self.mesh = mesh
         self.conf = conf
         self.n = mesh.shape[AXIS]
-        self._expansion = expansion
+        if expansion <= 0:
+            from spark_rapids_tpu.config import rapids_conf as rc
 
-    _mesh_cache: Dict[int, object] = {}
+            expansion = (conf.get(rc.MULTICHIP_EXPANSION)
+                         if conf is not None
+                         else rc.MULTICHIP_EXPANSION.default)
+        self._expansion = max(1, int(expansion))
+
+    #: (n_devices, chip_epoch) -> Mesh. Keyed by the chip epoch so a
+    #: fence/unfence never hands back a mesh laid out over a dead chip;
+    #: cached_jit programs key on the mesh object identity transitively
+    #: through shard_map, so stale programs die with their mesh.
+    _mesh_cache: Dict[tuple, object] = {}
 
     @classmethod
     def for_devices(cls, n_devices: int, conf=None) -> "MeshQueryExecutor":
-        mesh = cls._mesh_cache.get(n_devices)
+        from spark_rapids_tpu.runtime import device_monitor as dm
+
+        fenced = dm.fenced_chips()
+        healthy = [d for d in jax.devices() if d.id not in fenced]
+        if not healthy:
+            raise MeshCompileError(
+                "every local device is chip-fenced; no mesh possible")
+        n = min(max(1, n_devices), len(healthy))
+        key = (n, dm.chip_epoch())
+        mesh = cls._mesh_cache.get(key)
         if mesh is None:
-            mesh = mesh_exec.make_mesh(n_devices)
-            cls._mesh_cache[n_devices] = mesh
+            mesh = mesh_exec.make_mesh(n, devices=healthy)
+            cls._mesh_cache[key] = mesh
         return cls(mesh, conf)
 
     # --- plan walking ---
@@ -441,6 +520,14 @@ class MeshQueryExecutor:
                        pa.array([], type=t.schema.field(i).type))
                 cols.append(column_from_arrow(arr, field, shard_cap))
             shard_cols.append(cols)
+        # per-shard dictionary reconciliation: each shard decoded its
+        # own files, so encoded columns arrive with per-shard
+        # dictionaries; rewrite every shard's codes onto ONE union
+        # dictionary so codes are value-comparable across shards and
+        # exchanges ship codes over ICI (encodings are stripped here
+        # and the shared dictionary re-attached replicated after the
+        # global-array assembly)
+        col_dicts = self._reconcile_dictionaries(scan, shard_cols)
         # align variable-width leaves to the global max widths — EVERY
         # trailing axis of every leaf (string bytes, array elems, the
         # array<string> cube's elems x bytes, struct children's
@@ -486,12 +573,113 @@ class MeshQueryExecutor:
         out_cols = []
         for ci in range(len(scan.schema.fields)):
             per = [sc[ci] for sc in shard_cols]
-            out_cols.append(jax.tree_util.tree_map(asm_leaf, *per))
+            col = jax.tree_util.tree_map(asm_leaf, *per)
+            dd = col_dicts.get(ci)
+            if dd is not None:
+                col = col.replace(
+                    encoding=mesh_exec.replicate_dictionary(
+                        self.mesh, dd),
+                    vrange=(0, max(dd.num_values - 1, 0)))
+            out_cols.append(col)
         counts = assemble(
             [np.asarray([t.num_rows], dtype=np.int32)
              for t in local_tables],
             (n,))
         return ColumnBatch(scan.schema, out_cols, counts)
+
+    def _reconcile_dictionaries(self, scan, shard_cols):
+        """Rewrite per-shard encoded columns onto one shared dictionary.
+
+        Returns {column_index: host DeviceDictionary} for columns that
+        stay encoded; their shard columns are left holding remapped
+        codes with encoding STRIPPED (the caller re-attaches the shared
+        dictionary replicated over the mesh after assembly). Columns
+        whose shards cannot reconcile — a live plain shard mixed with
+        encoded ones, an evicted host dictionary, a multi-process mesh
+        (dictionary contents are process-local) — decode host-side to
+        the plain padded layout instead (PR 8's fallback discipline)."""
+        from spark_rapids_tpu.columnar import encoding as enc_mod
+        from spark_rapids_tpu.columnar.encoding import DeviceDictionary
+        from spark_rapids_tpu.config import rapids_conf as rc
+
+        reconcile = (jax.process_count() == 1
+                     and (self.conf is None or self.conf.get(
+                         rc.MULTICHIP_RECONCILE_DICTS)))
+        col_dicts: Dict[int, DeviceDictionary] = {}
+        for ci in range(len(scan.schema.fields)):
+            cols = [sc[ci] for sc in shard_cols]
+            encs = [getattr(c, "encoding", None) for c in cols]
+            if all(e is None for e in encs):
+                continue
+            live_plain = any(
+                e is None and int(np.asarray(c.validity).sum()) > 0
+                for c, e in zip(cols, encs))
+            hd = None
+            union_id = None
+            if reconcile and not live_plain:
+                ids = []
+                for e in encs:
+                    if e is not None and e.dict_id not in ids:
+                        ids.append(e.dict_id)
+                if len(ids) == 1:
+                    union_id = ids[0]
+                else:
+                    values: List[str] = []
+                    for did in ids:
+                        v = enc_mod.dictionary_values(did)
+                        if v is None:
+                            values = []
+                            break
+                        values.extend(
+                            x for x in v.to_pylist() if x is not None)
+                    if values:
+                        union_id, _ = enc_mod.intern_dictionary(
+                            pa.array(values, type=pa.large_string()))
+                hd = (enc_mod._host_dict(union_id)
+                      if union_id is not None else None)
+            if hd is None:
+                # decode fallback: plain padded layout on every shard
+                for s, c in enumerate(cols):
+                    if encs[s] is not None:
+                        shard_cols[s][ci] = self._decode_host(c)
+                continue
+            k = max(hd.matrix.shape[0], 1)
+            code_dt = np.int16 if k < (1 << 15) else np.int32
+            for s, (c, e) in enumerate(zip(cols, encs)):
+                if e is None:  # empty plain shard: all-dead codes
+                    shard_cols[s][ci] = c.replace(
+                        data=np.zeros(len(np.asarray(c.validity)),
+                                      dtype=code_dt),
+                        validity=np.zeros_like(np.asarray(c.validity)),
+                        lengths=None, vrange=(0, k - 1), encoding=None)
+                    continue
+                codes = np.asarray(c.data).astype(np.int64)
+                remap = enc_mod.remap_table(e.dict_id, union_id)
+                if remap is not None:
+                    codes = remap[np.clip(codes, 0, len(remap) - 1)]
+                    codes = np.where(codes >= 0, codes, 0)
+                shard_cols[s][ci] = c.replace(
+                    data=codes.astype(code_dt), vrange=(0, k - 1),
+                    encoding=None)
+            col_dicts[ci] = DeviceDictionary(hd.matrix, hd.lengths,
+                                             union_id)
+        return col_dicts
+
+    @staticmethod
+    def _decode_host(col):
+        """Host-side decode of a numpy-leaf encoded column to the
+        plain padded string layout (the pre-upload twin of
+        encoding.decode_column)."""
+        enc = col.encoding
+        dmat = np.asarray(enc.data)
+        dlen = np.asarray(enc.lengths)
+        k = max(dmat.shape[0], 1)
+        codes = np.clip(np.asarray(col.data).astype(np.int64), 0, k - 1)
+        val = np.asarray(col.validity)
+        data = np.where(val[:, None], dmat[codes], 0).astype(np.uint8)
+        lengths = np.where(val, dlen[codes], 0).astype(np.int32)
+        return col.replace(data=data, lengths=lengths, vrange=None,
+                           encoding=None)
 
     @staticmethod
     def _sync_max(v: int) -> int:
@@ -510,11 +698,22 @@ class MeshQueryExecutor:
 
     def execute(self, phys: PhysicalPlan) -> pa.Table:
         from spark_rapids_tpu.config import rapids_conf as rc
+        from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.runtime.faults import InjectedFault
 
         if self.conf is not None and self.conf.get(rc.ANSI_ENABLED):
             # ANSI checks live in the eager engine's per-batch check
             # programs; the SPMD program has no raise points
             raise MeshCompileError("ANSI mode uses the eager engine")
+        if (self.conf is not None
+                and not self.conf.get(rc.MULTICHIP_ICI_SHUFFLE)
+                and self.n > 1 and plan_bears_exchange(phys)):
+            # every exchange is pinned to the host transport — there is
+            # no mesh lowering for a host-staged exchange, so the whole
+            # plan keeps the single-chip engine's serialized shuffle
+            raise MeshCompileError(
+                "ICI shuffle disabled: exchanges keep the host path")
+        self.plan_exchange_strategies(phys)
         sources: List[PhysicalPlan] = []
         self._collect_sources(phys, sources)
         sharded = []
@@ -525,6 +724,9 @@ class MeshQueryExecutor:
                 sharded.append(mesh_exec.shard_batch(
                     self.mesh, self._materialize(s)))
         expansion = self._expansion
+        retries = (self.conf.get(rc.MULTICHIP_ICI_RETRIES)
+                   if self.conf is not None
+                   else rc.MULTICHIP_ICI_RETRIES.default)
         while True:
             try:
                 return self._run(phys, sources, sharded, expansion)
@@ -540,6 +742,59 @@ class MeshQueryExecutor:
                             "mesh width; eager engine handles it")
                     raise
                 expansion *= 2
+            except InjectedFault as e:
+                if e.site == "ici.collective" and retries > 0:
+                    # transient fabric fault: the SPMD program is pure
+                    # over the (still-resident) sharded inputs, so a
+                    # straight re-dispatch is the retry
+                    retries -= 1
+                    obs_events.emit("ici.retry", detail=e.detail,
+                                    left=retries)
+                    continue
+                if e.site == "chip.fatal":
+                    return self._recover_chip_loss(phys, e)
+                raise
+
+    def plan_exchange_strategies(self, phys: PhysicalPlan) -> None:
+        stamp_exchange_strategies(phys, self.conf)
+
+    def _recover_chip_loss(self, phys: PhysicalPlan,
+                           exc) -> pa.Table:
+        """One chip died mid-collective: fence ONLY that chip (the
+        process-wide monitor stays unfenced — other queries on the
+        surviving chips keep serving), rebuild the mesh over the
+        survivors, and recover the lost shards from lineage: sources
+        re-ingest deterministically over the new topology, so
+        re-executing the SPMD program over n-1 chips reconstructs
+        every lost shard's rows (the PR 3 deterministic-attempt
+        discipline applied to shards instead of tasks)."""
+        import time
+
+        from spark_rapids_tpu.config import rapids_conf as rc
+        from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.runtime import device_monitor as dm
+        from spark_rapids_tpu.runtime.errors import DeviceLostError
+
+        recover = (self.conf is None
+                   or self.conf.get(rc.MULTICHIP_CHIP_RECOVERY))
+        # chaos-driven loss carries no PJRT device handle; the victim
+        # is the mesh's last device (deterministic, so the recovery
+        # mesh and its compiled programs are test-stable)
+        victim = list(self.mesh.devices.reshape(-1))[-1]
+        chip_ep = dm.fence_chip(victim.id, cause=str(exc))
+        if not recover or self.n <= 1:
+            raise DeviceLostError(
+                f"chip {victim.id} lost during mesh execution "
+                f"(chip epoch {chip_ep}): {exc}")
+        t0 = time.monotonic()
+        survivor = MeshQueryExecutor.for_devices(self.n - 1, self.conf)
+        out = survivor.execute(phys)
+        dm.note_chip_recovery()
+        obs_events.emit(
+            "chip.recovery", device=victim.id, chipEpoch=chip_ep,
+            shards=self.n, survivors=survivor.n,
+            ms=round((time.monotonic() - t0) * 1000.0, 3))
+        return out
 
     @staticmethod
     def _has_static_collect(phys: PhysicalPlan) -> bool:
@@ -562,6 +817,7 @@ class MeshQueryExecutor:
         n = self.n
         src_index: Dict[int, int] = {id(s): i for i, s in
                                      enumerate(sources)}
+        out_enc: List[tuple] = []
 
         def step(*shards):
             overflow = jnp.zeros((), bool)
@@ -657,15 +913,26 @@ class MeshQueryExecutor:
                     return track(shard_equi_join(node, lb, rb, out_cap))
                 if isinstance(node, J.TpuBroadcastHashJoinExec):
                     lb = emit(node.children[0])
-                    rb = all_gather_batch(emit(node.children[1]), AXIS, n)
+                    rb = all_gather_batch(emit(node.children[1]), AXIS,
+                                          n, site="ici.broadcast")
                     out_cap = next_capacity(
                         expansion * max(lb.capacity, rb.capacity))
                     return track(shard_equi_join(node, lb, rb, out_cap))
                 raise MeshCompileError(type(node).__name__)
 
             out = emit(phys)
+            cols = []
+            for ci, c in enumerate(out.columns):
+                dd = getattr(c, "encoding", None)
+                if dd is not None:
+                    # the dictionary is replicated; only codes ride the
+                    # P(AXIS) out-spec — record which dictionary to
+                    # re-attach host-side (trace-time side channel)
+                    out_enc.append((ci, dd.dict_id))
+                    c = c.replace(encoding=None)
+                cols.append(c)
             out = ColumnBatch(
-                out.schema, out.columns,
+                out.schema, cols,
                 jnp.asarray(out.num_rows, jnp.int32).reshape(1))
             return out, overflow.reshape(1)
 
@@ -673,25 +940,70 @@ class MeshQueryExecutor:
         from spark_rapids_tpu.shims import get_shim
 
         # leaf-wise so struct children / string matrices / validity all
-        # participate in the program identity
+        # participate in the program identity; dictionary ids too —
+        # trace-time host probes (join remap tables) bake per dictionary
         shape_key = tuple(
             tuple((tuple(leaf.shape), str(leaf.dtype))
                   for leaf in jax.tree_util.tree_leaves(tuple(sb.columns)))
             + ((sb.capacity,),)
             for sb in sharded)
-        key = ("mesh_plan", _plan_key(phys), n, expansion, shape_key)
+        enc_key = tuple(
+            tuple((ci, c.encoding.dict_id)
+                  for ci, c in enumerate(sb.columns)
+                  if getattr(c, "encoding", None) is not None)
+            for sb in sharded)
+        key = ("mesh_plan", _plan_key(phys), n, expansion, shape_key,
+               enc_key)
         jitted = cached_jit(
             key,
             lambda: get_shim().shard_map(
                 step, self.mesh,
-                tuple(P(AXIS) for _ in sharded),
+                tuple(mesh_exec.batch_arg_specs(sb, P(AXIS))
+                      for sb in sharded),
                 (P(AXIS), P(AXIS))))
-        out, ovf = jitted(*sharded)
-        jax.block_until_ready(jax.tree_util.tree_leaves(out))
+        from spark_rapids_tpu.obs import telemetry
+        from spark_rapids_tpu.parallel import collective
+        from spark_rapids_tpu.runtime import faults
+
+        # chaos sites: a transient fabric fault (bounded retry in
+        # execute) and a single-chip loss (per-chip fence + lineage
+        # recovery in execute) — both fire host-side at the dispatch
+        # point, the same place a real collective failure surfaces
+        faults.maybe_inject("ici.collective", detail="mesh all_to_all")
+        faults.maybe_inject("chip.fatal",
+                            detail=f"mesh chip {n - 1} of {n}")
+        collective.begin_ici_tape()
+        try:
+            out, ovf = jitted(*sharded)
+            jax.block_until_ready(jax.tree_util.tree_leaves(out))
+        finally:
+            tape = collective.end_ici_tape()
+        if tape:
+            # first call traced the program: persist the static
+            # per-shard collective bytes for replay on cache hits
+            _ici_profiles[key] = tape
+        if out_enc:
+            _out_enc_profiles[key] = list(out_enc)
+        for site, wire, host_eq in _ici_profiles.get(key, ()):
+            telemetry.record_ici(site, wire * n, host_eq * n)
         if bool(mesh_exec.fetch_host(ovf).any()):
             raise TpuSplitAndRetryOOM(
                 "mesh collective slot / join expansion overflowed; "
                 "recompiling with a larger expansion factor")
+        enc_out = _out_enc_profiles.get(key, ())
+        if enc_out:
+            in_dicts = {}
+            for sb in sharded:
+                for c in sb.columns:
+                    dd = getattr(c, "encoding", None)
+                    if dd is not None:
+                        in_dicts.setdefault(dd.dict_id, dd)
+            cols = list(out.columns)
+            for ci, did in enc_out:
+                dd = in_dicts.get(did)
+                if dd is not None:
+                    cols[ci] = cols[ci].replace(encoding=dd)
+            out = ColumnBatch(out.schema, cols, out.num_rows)
         host = mesh_exec.gather_result(out, self.n)
         return device_to_arrow(host)
 
@@ -712,7 +1024,8 @@ class MeshQueryExecutor:
         kcols = [k.eval(ctx) for k in keys]
         dest = pmod(murmur3_columns(kcols), self.n)
         slot = slot_capacity(batch.capacity, self.n, expansion)
-        return track(all_to_all_batch(batch, dest, self.n, slot, AXIS))
+        return track(all_to_all_batch(batch, dest, self.n, slot, AXIS,
+                                      site="ici.exchange"))
 
     def _shard_prefix_limit(self, batch: ColumnBatch,
                             k: int) -> ColumnBatch:
@@ -781,7 +1094,8 @@ class MeshQueryExecutor:
             key_cols = [part.columns[i] for i in range(nk)]
             dest = pmod(murmur3_columns(key_cols), n)
             slot = slot_capacity(part.capacity, n, expansion)
-            ex = track(all_to_all_batch(part, dest, n, slot, AXIS))
+            ex = track(all_to_all_batch(part, dest, n, slot, AXIS,
+                                        site="ici.exchange"))
         else:
             ex = gather_to_one(part, AXIS, n)
         return self._first_shard_only(run_phase(node._merge_final, ex),
@@ -804,16 +1118,24 @@ class MeshQueryExecutor:
     def _emit_exchange(self, node: ops.TpuShuffleExchangeExec,
                        child: ColumnBatch, track,
                        expansion: int) -> ColumnBatch:
+        if getattr(node, "ici_strategy", "ici") == "host":
+            # the planner pinned this exchange to the host shuffle
+            # path (iciShuffle disabled): no mesh lowering for it —
+            # the whole plan falls back to the single-chip engine
+            raise MeshCompileError(
+                "exchange pinned to the host shuffle path")
         n = self.n
         if node.key_exprs:
             ctx = EvalContext(child)
             kcols = [e.eval(ctx) for e in node.key_exprs]
             dest = pmod(murmur3_columns(kcols), n)
             slot = slot_capacity(child.capacity, n, expansion)
-            return track(all_to_all_batch(child, dest, n, slot, AXIS))
+            return track(all_to_all_batch(child, dest, n, slot, AXIS,
+                                          site="ici.exchange"))
         if node.num_partitions == 1:
             return gather_to_one(child, AXIS, n)
         # round-robin repartition: balance rows across shards
         dest = jnp.arange(child.capacity, dtype=jnp.int32) % n
         slot = slot_capacity(child.capacity, n, expansion)
-        return track(all_to_all_batch(child, dest, n, slot, AXIS))
+        return track(all_to_all_batch(child, dest, n, slot, AXIS,
+                                      site="ici.exchange"))

@@ -113,7 +113,7 @@ class DeviceMonitor:
             "fatalErrors": 0, "fences": 0, "recoveries": 0,
             "staleHandles": 0, "drainTimeouts": 0,
             "buffersDropped": 0, "buffersRestorable": 0,
-            "resubmits": 0,
+            "resubmits": 0, "chipFences": 0, "chipRecoveries": 0,
         }
         self.last_recovery_ms = 0.0
 
@@ -133,6 +133,8 @@ class DeviceMonitor:
         out["epoch"] = _EPOCH
         out["fenced"] = int(self._fenced)
         out["lastRecoveryMs"] = round(self.last_recovery_ms, 3)
+        out["fencedChips"] = len(_fenced_chips)
+        out["chipEpoch"] = _chip_epoch
         return out
 
     def note_stale_handle(self) -> None:
@@ -226,6 +228,7 @@ class DeviceMonitor:
         try:
             self._rebuild_backend()
             restorable, dropped = self._invalidate_device_state()
+            clear_chip_fences()
         finally:
             ms = (time.monotonic() - t0) * 1000.0
             with self._cv:
@@ -350,6 +353,82 @@ def configure(conf=None) -> DeviceMonitor:
 
 def counters() -> Dict[str, int]:
     return _monitor.counters()
+
+
+# ------------------------------------------------------ per-chip fence
+#
+# Process-wide fencing (above) is the hammer: ONE dead device takes the
+# whole backend through drain/epoch-bump/rebuild. Multichip meshes
+# deserve a scalpel — when chip k of n dies mid-collective, only its
+# shards are lost; the other chips' HBM, compile cache, and in-flight
+# work on other queries are intact. The mesh engine fences just the
+# lost chip here, rebuilds its mesh over the survivors (keyed by the
+# chip epoch so cached shard_map programs for the old topology are
+# never reused), and recovers the lost shards from lineage by
+# deterministic re-ingestion. A process-wide recovery clears the chip
+# fence — the rebuilt backend starts with every device healthy.
+
+_fenced_chips: set = set()
+_chip_epoch = 0
+
+
+def fence_chip(device_id: int, cause: str = "") -> int:
+    """Fence ONE chip out of mesh execution; returns the new chip
+    epoch. Idempotent per chip (re-fencing a fenced chip does not bump
+    the epoch again)."""
+    global _chip_epoch
+    from spark_rapids_tpu.obs import events as obs_events
+
+    mon = _monitor
+    with mon._cv:
+        if device_id in _fenced_chips:
+            return _chip_epoch
+        _fenced_chips.add(device_id)
+        _chip_epoch += 1
+        mon._stats["chipFences"] += 1
+        epoch = _chip_epoch
+    obs_events.emit("chip.fence", device=device_id, chipEpoch=epoch,
+                    cause=cause)
+    return epoch
+
+
+def unfence_chip(device_id: int) -> None:
+    """Return a chip to mesh service (operator action / post-repair)."""
+    global _chip_epoch
+    from spark_rapids_tpu.obs import events as obs_events
+
+    mon = _monitor
+    with mon._cv:
+        if device_id not in _fenced_chips:
+            return
+        _fenced_chips.discard(device_id)
+        _chip_epoch += 1
+        epoch = _chip_epoch
+    obs_events.emit("chip.unfence", device=device_id, chipEpoch=epoch)
+
+
+def note_chip_recovery() -> None:
+    with _monitor._cv:
+        _monitor._stats["chipRecoveries"] += 1
+
+
+def fenced_chips() -> set:
+    with _monitor._cv:
+        return set(_fenced_chips)
+
+
+def chip_epoch() -> int:
+    return _chip_epoch
+
+
+def clear_chip_fences() -> None:
+    """Process-wide recovery rebuilt the backend: every device is new,
+    so per-chip fences from the old epoch no longer apply."""
+    global _chip_epoch
+    with _monitor._cv:
+        if _fenced_chips:
+            _fenced_chips.clear()
+            _chip_epoch += 1
 
 
 # ------------------------------------------------------- use-site API

@@ -101,6 +101,8 @@ KNOWN_SITES = (
     "semaphore.partial_hold",
     "device.fatal",
     "device.lost_buffer",
+    "ici.collective",
+    "chip.fatal",
 )
 
 

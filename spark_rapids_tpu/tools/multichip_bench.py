@@ -1,0 +1,235 @@
+"""Multichip scaling bench: REAL q5 throughput at 1/2/4/8 shards.
+
+The measurement ROADMAP item 1 asks for: the q5 join+agg shape executed
+at increasing shard counts with the mesh SPMD engine (hash exchanges
+compiled to on-device all-to-all over ICI, encoded codes on the wire),
+against the incumbent single-chip engine at its DEFAULT configuration
+(fused stage compiler, host-serialized MULTITHREADED shuffle).
+Scaling is reported as ``throughput(mesh@n) / throughput(single@1)``:
+the speedup a query sees when its execution spreads over n chips and
+its shuffles stop leaving the device fabric.
+
+On a machine without n real TPU chips the mesh is virtual (XLA host
+devices timesharing the host cores): program shape, collective
+semantics, and byte accounting are identical, but the n per-chip
+programs run serially, so wall-clock measures their SUM where real
+chips run them concurrently. Each mesh row therefore reports both the
+serialized wall-clock (``median_s``) and the per-chip critical-path
+estimate ``chip_est_s = median_s / n`` (q5's hash exchange balances
+shards to within the slot-skew bound, so the per-chip max ~= the
+mean); ``scaling`` uses the estimate on a virtual mesh and raw
+wall-clock when the chips are real. ``virtual_mesh`` in the block says
+which one you are reading.
+
+Runnable in-process (``run_scaling``) when the interpreter already has
+enough devices, or as ``python -m spark_rapids_tpu.tools.multichip_bench``
+which prints one JSON line (bench.py spawns that in a virtual-mesh
+subprocess).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import time
+from typing import Dict, Sequence
+
+ROWS = int(os.environ.get("SRTPU_MULTICHIP_ROWS", 2_000_000))
+FILES = 8
+STORES = 2000
+REGIONS = 12
+REPEATS = 3
+DATA_DIR = f"/tmp/srtpu_multichip_{ROWS}"
+DIM_DIR = f"/tmp/srtpu_multichip_{ROWS}_dim"
+
+
+def ensure_data() -> int:
+    """q5-shaped dataset: FILES fact parquet parts + a string-region
+    dim (dictionary-encoded pages so the encoded path engages and the
+    mesh ingestion must reconcile per-shard dictionaries). Returns
+    fact arrow bytes."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    marker = os.path.join(DATA_DIR, "_DONE")
+    if os.path.exists(marker):
+        return int(open(marker).read())
+    os.makedirs(DATA_DIR, exist_ok=True)
+    os.makedirs(DIM_DIR, exist_ok=True)
+    rng = np.random.default_rng(0)
+    per = ROWS // FILES
+    total = 0
+    for i in range(FILES):
+        t = pa.table({
+            "store": pa.array(rng.integers(0, STORES, per),
+                              type=pa.int64()),
+            "amount": pa.array(rng.random(per) * 100.0,
+                               type=pa.float64()),
+            "qty": pa.array(rng.integers(1, 100, per), type=pa.int64()),
+        })
+        total += t.nbytes
+        pq.write_table(t, os.path.join(DATA_DIR, f"part-{i}.parquet"),
+                       compression="NONE", use_dictionary=False,
+                       row_group_size=per)
+    dim = pa.table({
+        "store": pa.array(np.arange(STORES), type=pa.int64()),
+        "region": pa.array(
+            [f"region_{i % REGIONS:02d}" for i in range(STORES)],
+            type=pa.large_string()),
+    })
+    pq.write_table(dim, os.path.join(DIM_DIR, "dim.parquet"),
+                   use_dictionary=["region"])
+    with open(marker, "w") as f:
+        f.write(str(total))
+    return total
+
+
+def _q5(spark):
+    from spark_rapids_tpu.api import functions as F
+
+    fact = spark.read.parquet(DATA_DIR)
+    dim = spark.read.parquet(DIM_DIR)
+    return (fact.filter(F.col("amount") > 10.0)
+            .join(dim, on="store", how="inner")
+            .groupBy("region")
+            .agg(F.sum("amount").alias("rev"),
+                 F.count("*").alias("sales")))
+
+
+def _session(extra: Dict) -> "object":
+    from spark_rapids_tpu.api.session import TpuSparkSession
+
+    conf = {
+        "spark.sql.shuffle.partitions": 8,
+        # shuffled join on both rows: the exchange IS the measurement
+        "spark.sql.autoBroadcastJoinThreshold": -1,
+    }
+    conf.update(extra)
+    return TpuSparkSession(conf)
+
+
+def _timed_run(spark, repeats: int = REPEATS):
+    df = _q5(spark)
+    out = df.collect_arrow()  # cold: compiles + caches
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = df.collect_arrow()
+        times.append(time.perf_counter() - t0)
+    rec = spark.last_execution or {}
+    return out, statistics.median(times), rec
+
+
+def run_scaling(shards: Sequence[int] = (1, 2, 4, 8),
+                repeats: int = REPEATS) -> Dict:
+    """The MULTICHIP block: q5 throughput per shard count + the ledger's
+    ici-vs-host byte split for the mesh execution."""
+    import jax
+
+    from spark_rapids_tpu.obs import telemetry
+
+    input_bytes = ensure_data()
+    need = max(shards)
+    have = len(jax.devices())
+    if have < need:
+        raise RuntimeError(
+            f"run_scaling needs {need} devices, have {have} "
+            "(spawn under a virtual mesh: "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+
+    virtual = jax.devices()[0].platform == "cpu"
+    rows = {}
+    baseline_thr = None
+    oracle = None
+    # shards=1: the incumbent single-chip engine at its defaults
+    # (fused stage compiler on, host-serialized MULTITHREADED shuffle)
+    spark = _session({})
+    try:
+        out, med, rec = _timed_run(spark, repeats)
+        oracle = {r: (round(v, 2), s) for r, v, s in zip(
+            out.column("region").to_pylist(),
+            out.column("rev").to_pylist(),
+            out.column("sales").to_pylist())}
+        baseline_thr = input_bytes / med / 1e9
+        rows[1] = {
+            "engine": rec.get("engine"),
+            "median_s": round(med, 3),
+            "gbps": round(baseline_thr, 3),
+            "scaling": 1.0,
+        }
+    finally:
+        spark.stop()
+
+    mesh_ledgers = {}
+    for n in shards:
+        if n == 1:
+            continue
+        spark = _session({"spark.rapids.tpu.mesh": n})
+        try:
+            out, med, rec = _timed_run(spark, repeats)
+            got = {r: (round(v, 2), s) for r, v, s in zip(
+                out.column("region").to_pylist(),
+                out.column("rev").to_pylist(),
+                out.column("sales").to_pylist())}
+            assert set(got) == set(oracle), (sorted(got), sorted(oracle))
+            for k in oracle:
+                assert got[k][1] == oracle[k][1], (k, got[k], oracle[k])
+                assert abs(got[k][0] - oracle[k][0]) <= max(
+                    1e-6 * abs(oracle[k][0]), 0.05), (k, got[k],
+                                                      oracle[k])
+            # on a virtual mesh one host core executes the n per-chip
+            # programs serially: the chip critical path is med / n
+            chip_est = med / n if virtual else med
+            thr = input_bytes / chip_est / 1e9
+            tel = (rec.get("telemetry") or {})
+            moved = tel.get("bytesMoved") or {}
+            rows[n] = {
+                "engine": rec.get("engine"),
+                "median_s": round(med, 3),
+                "chip_est_s": round(chip_est, 3),
+                "gbps": round(thr, 3),
+                "scaling": round(thr / baseline_thr, 3),
+                "iciBytes": tel.get("iciBytes"),
+                "hostBytesAvoided": tel.get("hostBytesAvoided"),
+                "shuffleHostBytes": moved.get("shuffle", 0),
+            }
+            mesh_ledgers[n] = moved
+        finally:
+            spark.stop()
+
+    top = max(n for n in shards if n in rows)
+    dev = jax.devices()[0]
+    moved_top = mesh_ledgers.get(top, {})
+    return {
+        "metric": "q5 scan+join+agg throughput by shard count "
+                  "(mesh SPMD over ICI vs default single-chip engine)",
+        "rows": ROWS,
+        "input_mib": input_bytes >> 20,
+        "device_kind": str(getattr(dev, "device_kind", dev.platform)),
+        "virtual_mesh": virtual,
+        "baseline": "single-chip engine, default conf "
+                    "(fused, MULTITHREADED host shuffle)",
+        "shards": {str(k): v for k, v in sorted(rows.items())},
+        "scaling_at_%d" % top: rows[top]["scaling"],
+        "scaling_efficiency_at_%d" % top: round(
+            rows[top]["scaling"] / top, 3),
+        # the proof the exchange left the host: mesh execution moved
+        # ICI bytes and ZERO shuffle-direction (host) bytes
+        "ici_vs_h2d": {
+            "ici": moved_top.get("ici", 0),
+            "h2d": moved_top.get("h2d", 0),
+            "shuffle_host": moved_top.get("shuffle", 0),
+        },
+        "process_ici": telemetry.ledger.registry_view().get("ici"),
+    }
+
+
+def main() -> None:
+    block = run_scaling()
+    print(json.dumps(block))
+
+
+if __name__ == "__main__":
+    main()

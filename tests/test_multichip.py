@@ -1,0 +1,340 @@
+"""Multichip SPMD execution suite (PR 12): planner-native sharding over
+the virtual 8-device CPU mesh (conftest).
+
+Covers the four multichip guarantees end to end:
+- shard_map lowering equivalence: q5-shaped pipelines (filter /
+  group-by / equi-join) oracle-identical, plain AND encoded columns,
+  including mismatched per-shard dictionaries forcing reconciliation;
+- ICI-resident exchange: the planner stamps [strategy=ici], the
+  transfer ledger shows ici-direction bytes and ZERO host-direction
+  shuffle bytes, telemetry reports iciBytes / hostBytesAvoided;
+- transient fabric faults (ici.collective) retry transparently;
+- chip.fatal fences ONE chip and recovers the lost shards from
+  lineage over the surviving mesh — oracle-identical, zero leaked
+  permits/buffers, other chips stay serving.
+"""
+
+import os
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSparkSession
+from spark_rapids_tpu.runtime import device_monitor as dm
+from spark_rapids_tpu.runtime import faults
+from spark_rapids_tpu.runtime import semaphore as sem_mod
+from spark_rapids_tpu.runtime.memory import get_catalog
+from spark_rapids_tpu.testing.asserts import (
+    assert_tables_equal,
+    with_cpu_session,
+    with_tpu_session,
+)
+
+MESH = {"spark.rapids.tpu.mesh": 8,
+        "spark.sql.shuffle.partitions": 4,
+        "spark.sql.autoBroadcastJoinThreshold": -1}
+
+
+@pytest.fixture(autouse=True)
+def _isolated_chip_state():
+    """Chip fences are process-global by design (a dead chip stays
+    dead); tests must not bleed a fenced virtual device into the rest
+    of the suite."""
+    faults.install(faults.FaultRegistry())
+    dm.clear_chip_fences()
+    yield
+    faults.install(faults.FaultRegistry())
+    dm.clear_chip_fences()
+
+
+def _mesh_vs_oracle(df_fn, conf=None, ignore_order=True):
+    mesh_conf = {**MESH, **(conf or {})}
+    got = with_tpu_session(lambda s: df_fn(s).collect_arrow(),
+                           mesh_conf)
+    want = with_cpu_session(lambda s: df_fn(s).collect_arrow(),
+                            conf or {})
+    assert_tables_equal(got, want, ignore_order=ignore_order)
+    return got
+
+
+def _write_sharded_parquet(tmp_path, n_files=8, per=600,
+                           shared=("both_a", "both_b")):
+    """n_files parquet parts whose string column draws from DISJOINT
+    per-file vocabularies plus a small shared core: every file's
+    dictionary page differs, so mesh ingestion (one file per shard)
+    MUST reconcile per-shard dictionaries before codes can meet in an
+    exchange."""
+    path = str(tmp_path / "facts")
+    os.makedirs(path, exist_ok=True)
+    rng = np.random.default_rng(5)
+    for i in range(n_files):
+        vocab = [f"f{i}_v{j}" for j in range(5)] + list(shared)
+        t = pa.table({
+            "cat": pa.array(rng.choice(vocab, per),
+                            type=pa.large_string()),
+            "store": pa.array(rng.integers(0, 50, per),
+                              type=pa.int64()),
+            "amount": pa.array(rng.random(per) * 100,
+                               type=pa.float64()),
+        })
+        pq.write_table(t, os.path.join(path, f"part-{i}.parquet"),
+                       use_dictionary=["cat"], row_group_size=per)
+    return path
+
+
+def _q5(s, fact_rows=4000, seed=3):
+    rng = np.random.default_rng(seed)
+    fact = s.createDataFrame(pa.table({
+        "store": pa.array(rng.integers(0, 40, fact_rows),
+                          type=pa.int64()),
+        "amount": pa.array(rng.random(fact_rows) * 100,
+                           type=pa.float64()),
+    }))
+    dim = s.createDataFrame(pa.table({
+        "store": pa.array(np.arange(0, 60), type=pa.int64()),
+        "region": pa.array([f"region_{i % 7}" for i in range(60)],
+                           type=pa.large_string()),
+    }))
+    return (fact.filter(F.col("amount") > 10.0)
+            .join(dim, on="store", how="inner")
+            .groupBy("region")
+            .agg(F.sum("amount").alias("rev"),
+                 F.count("*").alias("n")))
+
+
+def _wait_until(pred, timeout_s=10.0, tick=0.005):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(tick)
+    return False
+
+
+def _assert_clean():
+    assert _wait_until(lambda: sem_mod.get().holders() == 0
+                       and get_catalog().buffer_count() == 0), \
+        sem_mod.get()._holder_diagnostics()
+    get_catalog().check_leaks(raise_on_leak=True)
+
+
+# -------------------------------------- lowering equivalence (plain)
+
+def test_q5_pipeline_oracle_identical():
+    _mesh_vs_oracle(_q5)
+
+
+def test_encoded_scan_groupby_reconciles_dictionaries(tmp_path):
+    """Per-shard dictionaries differ file to file; the union dictionary
+    + remapped codes must produce the exact oracle group set (a missed
+    reconciliation either collides codes across shards or drops
+    groups)."""
+    data = _write_sharded_parquet(tmp_path)
+
+    def q(s):
+        return (s.read.parquet(data).groupBy("cat")
+                .agg(F.sum("amount").alias("rev"),
+                     F.count("*").alias("n")))
+
+    got = _mesh_vs_oracle(q)
+    # 8 files x 5 private values + 2 shared = 42 distinct groups
+    assert got.num_rows == 42
+
+
+def test_encoded_scan_join_on_plain_key(tmp_path):
+    """Equi-join where the encoded column rides THROUGH the hash
+    exchange as codes (join key is plain int): exercises the
+    hold-the-dictionary-back collective path."""
+    data = _write_sharded_parquet(tmp_path)
+
+    def q(s):
+        dim = s.createDataFrame(pa.table({
+            "store": pa.array(np.arange(0, 50), type=pa.int64()),
+            "w": pa.array((np.arange(0, 50) % 9).astype("float64")),
+        }))
+        return (s.read.parquet(data)
+                .join(dim, on="store", how="inner")
+                .groupBy("cat")
+                .agg(F.sum((F.col("amount") * F.col("w"))
+                           .alias("x")).alias("wrev")))
+
+    _mesh_vs_oracle(q)
+
+
+def test_reconcile_disabled_still_correct(tmp_path):
+    """reconcileDictionaries=false decodes before sharding — slower,
+    still oracle-identical."""
+    data = _write_sharded_parquet(tmp_path)
+
+    def q(s):
+        return (s.read.parquet(data).groupBy("cat")
+                .agg(F.count("*").alias("n")))
+
+    _mesh_vs_oracle(
+        q,
+        conf={"spark.rapids.tpu.multichip.reconcileDictionaries":
+              False})
+
+
+# ------------------------------------------- ICI-resident strategy
+
+def test_exchange_stamped_ici_and_zero_host_shuffle_bytes():
+    s = TpuSparkSession(dict(MESH))
+    try:
+        df = _q5(s)
+        out = df.collect_arrow()
+        rec = s.last_execution
+        assert rec["engine"] == "mesh"
+        tel = rec.get("telemetry") or {}
+        moved = tel.get("bytesMoved") or {}
+        # the exchange never left the fabric: ici bytes moved, zero
+        # host-direction shuffle bytes
+        assert moved.get("ici", 0) > 0
+        assert moved.get("shuffle", 0) == 0
+        assert tel.get("iciBytes", 0) > 0
+        assert tel.get("hostBytesAvoided", 0) > 0
+        assert out.num_rows > 0
+    finally:
+        s.stop()
+
+
+def test_explain_shows_ici_strategy(capsys):
+    """Explicit repartition keeps a TpuShuffleExchangeExec node in the
+    plan (join/agg exchanges are internal to their mesh lowerings);
+    explain() must show the transport the planner chose for it."""
+    s = TpuSparkSession(dict(MESH))
+    try:
+        rng = np.random.default_rng(2)
+        df = (s.createDataFrame(pa.table({
+            "k": pa.array(rng.integers(0, 30, 3000), type=pa.int64()),
+            "v": pa.array(rng.random(3000)),
+        })).repartition(4, "k").groupBy("k")
+            .agg(F.sum("v").alias("sv")))
+        df.collect_arrow()
+        assert s.last_execution["engine"] == "mesh"
+        df.explain()
+        text = capsys.readouterr().out
+        assert "[strategy=ici]" in text
+    finally:
+        s.stop()
+
+
+def test_ici_shuffle_disabled_pins_exchange_to_host():
+    """iciShuffle.enabled=false: exchanges pin to the host strategy,
+    the mesh compiler refuses them, and the plan falls back to the
+    single-chip engine — still oracle-identical."""
+    conf = {**MESH,
+            "spark.rapids.tpu.multichip.iciShuffle.enabled": False}
+    s = TpuSparkSession(conf)
+    try:
+        df = _q5(s)
+        got = df.collect_arrow()
+        assert s.last_execution["engine"] != "mesh"
+    finally:
+        s.stop()
+    want = with_cpu_session(lambda s2: _q5(s2).collect_arrow())
+    assert_tables_equal(got, want, ignore_order=True)
+
+
+# ------------------------------------------------- fault injection
+
+def test_ici_collective_fault_retries_transparently():
+    conf = {**MESH,
+            "spark.rapids.tpu.chaos.enabled": True,
+            "spark.rapids.tpu.chaos.sites": "ici.collective:once"}
+    s = TpuSparkSession(conf)
+    try:
+        got = _q5(s).collect_arrow()
+        assert s.last_execution["engine"] == "mesh"
+        c = faults.counters().get("ici.collective", {})
+        assert c.get("injected", 0) == 1
+    finally:
+        s.stop()
+    want = with_cpu_session(lambda s2: _q5(s2).collect_arrow())
+    assert_tables_equal(got, want, ignore_order=True)
+    _assert_clean()
+
+
+def test_chip_fatal_fences_one_chip_and_recovers():
+    """One chip dies mid-collective: ONLY that chip fences (the
+    process-wide fence never raises), the chip epoch bumps, and the
+    query re-executes its lineage over the 7 survivors —
+    oracle-identical, leak-free."""
+    conf = {**MESH,
+            "spark.rapids.tpu.chaos.enabled": True,
+            "spark.rapids.tpu.chaos.sites": "chip.fatal:once"}
+    before = dm.counters()
+    s = TpuSparkSession(conf)
+    try:
+        got = _q5(s).collect_arrow()
+        rec = s.last_execution
+        assert rec["engine"] == "mesh"
+    finally:
+        s.stop()
+    after = dm.counters()
+    assert after["chipFences"] == before["chipFences"] + 1
+    assert after["chipRecoveries"] == before["chipRecoveries"] + 1
+    assert after["fencedChips"] == 1
+    # the PROCESS-wide fence did not move: other queries kept serving
+    assert after["fences"] == before["fences"]
+    want = with_cpu_session(lambda s2: _q5(s2).collect_arrow())
+    assert_tables_equal(got, want, ignore_order=True)
+    _assert_clean()
+
+
+def test_chip_recovery_disabled_escalates_to_resubmission():
+    """chipRecovery off: the executor still fences the lost chip but
+    raises DeviceLostError instead of recovering in place — the PR 9
+    query-resubmission path handles it (one clean resubmit over the
+    surviving mesh), so the collect succeeds WITHOUT an in-executor
+    chip recovery."""
+    conf = {**MESH,
+            "spark.rapids.tpu.multichip.chipRecovery.enabled": False,
+            "spark.rapids.tpu.chaos.enabled": True,
+            "spark.rapids.tpu.chaos.sites": "chip.fatal:once"}
+    before = dm.counters()
+    s = TpuSparkSession(conf)
+    try:
+        got = _q5(s).collect_arrow()
+    finally:
+        s.stop()
+    after = dm.counters()
+    assert after["chipFences"] == before["chipFences"] + 1
+    assert after["chipRecoveries"] == before["chipRecoveries"]
+    want = with_cpu_session(lambda s2: _q5(s2).collect_arrow())
+    assert_tables_equal(got, want, ignore_order=True)
+    _assert_clean()
+
+
+# -------------------------------------------------- per-chip fencing
+
+def test_fence_chip_api_and_mesh_shrinks():
+    from spark_rapids_tpu.parallel.plan_compiler import (
+        MeshQueryExecutor,
+    )
+
+    ep0 = dm.chip_epoch()
+    import jax
+
+    victim = jax.devices()[-1].id
+    ep1 = dm.fence_chip(victim, cause="test")
+    assert ep1 == ep0 + 1 and victim in dm.fenced_chips()
+    # idempotent: re-fencing the same chip does not bump the epoch
+    assert dm.fence_chip(victim) == ep1
+    ex = MeshQueryExecutor.for_devices(8)
+    assert ex.n == 7  # mesh laid out over healthy chips only
+    dm.unfence_chip(victim)
+    assert victim not in dm.fenced_chips()
+    ex2 = MeshQueryExecutor.for_devices(8)
+    assert ex2.n == 8
+
+
+def test_queries_keep_serving_while_chip_fenced():
+    import jax
+
+    dm.fence_chip(jax.devices()[-1].id, cause="test")
+    _mesh_vs_oracle(_q5)  # mesh engine runs over the 7 healthy chips
