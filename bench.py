@@ -370,6 +370,87 @@ def _sanitizer_probe(iters: int = 100) -> dict:
     }
 
 
+def _serve_probe(spark) -> dict:
+    """Serving-layer probe: a daemon over the live bench session,
+    closed-loop clients across 3 tenants/priority classes sending the
+    SAME parameterized aggregate with rotating bindings — the
+    dashboard-traffic shape the structural plan cache exists for.
+    Reports wire-level qps + latency percentiles, the shed rate, and
+    the plan-cache hit ratio the nightly tracks."""
+    import statistics
+    import threading
+
+    from spark_rapids_tpu.runtime.errors import QueryRejectedError
+    from spark_rapids_tpu.serve.client import ServeClient
+    from spark_rapids_tpu.serve.server import QueryServiceDaemon
+
+    spec = {"op": "agg",
+            "input": {"op": "filter",
+                      "input": {"op": "parquet", "path": DATA_DIR},
+                      "cond": {"fn": ">", "args": [{"col": "amount"},
+                                                   {"param": "lo"}]}},
+            "groupBy": ["store"],
+            "aggs": [{"fn": "sum", "col": "amount", "as": "rev"}]}
+    bindings = [{"lo": 10.0}, {"lo": 50.0}, {"lo": 90.0}]
+    lat_ms, shed = [], [0]
+    lock = threading.Lock()
+    d = QueryServiceDaemon(session=spark).start()
+    try:
+        # warm the cache shape once so the measured loop is the
+        # steady state a resident daemon actually serves
+        with ServeClient.connect(d, "warm", "standard") as c:
+            c.query(spec, params=bindings[0])
+
+        def worker(tenant, pclass, rounds):
+            with ServeClient.connect(d, tenant, pclass) as c:
+                for r in range(rounds):
+                    t0 = time.perf_counter()
+                    try:
+                        c.query(spec, params=bindings[r % 3])
+                    except QueryRejectedError:
+                        with lock:
+                            shed[0] += 1
+                        continue
+                    with lock:
+                        lat_ms.append(
+                            (time.perf_counter() - t0) * 1000.0)
+
+        rounds = 6
+        tenants = [("acme", "interactive"), ("globex", "standard"),
+                   ("initech", "batch")]
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker, args=(t, p, rounds))
+                   for t, p in tenants]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600)
+        wall_s = time.perf_counter() - t0
+        lat_ms.sort()
+
+        def pct(q):
+            if not lat_ms:
+                return None
+            return round(lat_ms[min(len(lat_ms) - 1,
+                                    int(round(q * (len(lat_ms) - 1))))],
+                         1)
+
+        sent = len(lat_ms) + shed[0]
+        return {
+            "qps": round(len(lat_ms) / wall_s, 2) if wall_s else None,
+            "latencyMsP50": pct(0.50),
+            "latencyMsP99": pct(0.99),
+            "latencyMsMean": (round(statistics.mean(lat_ms), 1)
+                              if lat_ms else None),
+            "shedRate": round(shed[0] / sent, 4) if sent else 0.0,
+            "planCacheHitRatio":
+                d.plan_cache.stats.snapshot()["hitRatio"],
+            "tenants": len(tenants),
+        }
+    finally:
+        d.stop()
+
+
 def cold_probe():
     """--cold-probe: the warm-persistent-cache cold start. Runs in a
     FRESH process after the main bench warmed the compile cache, so it
@@ -744,6 +825,16 @@ def main():
     except Exception as e:  # never lose the perf report
         print(f"# multichip block unavailable: {e!r}", flush=True)
 
+    # ---- serving-layer block (serve/): wire-level qps + latency of
+    # ---- a 3-tenant closed loop through the resident daemon, shed
+    # ---- rate and the structural plan-cache hit ratio — the nightly
+    # ---- tracks what a served (vs embedded) query costs
+    serve_block = None
+    try:
+        serve_block = _serve_probe(spark)
+    except Exception as e:  # never lose the perf report
+        print(f"# serve block unavailable: {e!r}", flush=True)
+
     print(json.dumps({
         "metric": f"q5 join+agg engine throughput over device-cached"
                   f" tables ({dev.platform}, {ROWS} rows x {STORES}-row"
@@ -797,6 +888,9 @@ def main():
         # multichip SPMD scaling (PR 12): q5 throughput at 1/2/4/8
         # shards, ici-resident shuffle byte split, scaling efficiency
         "multichip": multichip_block,
+        # serving layer (serve/): daemon qps, wire latency p50/p99,
+        # shed rate, plan-cache hit ratio of a 3-tenant closed loop
+        "serve": serve_block,
     }))
 
 

@@ -13,12 +13,16 @@ import json, sys, datetime, os
 
 line = [l for l in open(sys.argv[1]) if l.strip().startswith("{")][-1]
 d = json.loads(line)
+serve = d.get("serve") or {}
 entry = {
     "date": datetime.date.today().isoformat(),
     "value_gbps": d.get("value"),
     "cold_s": d.get("cold_s"),
     "cold_warm_cache_s": d.get("cold_warm_cache_s"),
     "compile_cold": d.get("compile_cold"),
+    "serve_qps": serve.get("qps"),
+    "serve_p99_ms": serve.get("latencyMsP99"),
+    "serve_plan_cache_hit_ratio": serve.get("planCacheHitRatio"),
 }
 hist = "bench-history.jsonl"
 prev = None

@@ -36,6 +36,9 @@ ci/devicefail_check.sh
 echo "== multichip gate (SPMD oracle + ICI bytes + chip loss) =="
 ci/multichip_check.sh
 
+echo "== serving gate (multi-tenant daemon + plan cache + drain) =="
+ci/serve_check.sh
+
 echo "== multichip dryrun (virtual mesh) =="
 SPARK_RAPIDS_TPU_DRYRUN_REEXEC=1 python - <<'PY'
 import jax

@@ -1,0 +1,286 @@
+#!/usr/bin/env bash
+# Serving gate — the multi-tenant query service daemon under load.
+# A fresh-process daemon serves closed-loop clients across THREE
+# tenants with distinct priority classes while a seeded device.fatal
+# fences the engine mid-soak and a cancel storm rains on the running
+# table. The acceptance contract: every completed result is
+# oracle-identical, the plan cache serves hits (> 0) that skip
+# re-planning, per-tenant billing reconciles exactly with the
+# transfer ledger, /healthz (liveness) stays 200 throughout while
+# /readyz (readiness) flips 503 during the fence, and after drain +
+# stop ZERO permits, buffers, sockets, connections or handler threads
+# leak. Ends with srtpu-lint at zero findings over the tree.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
+
+echo "== serving soak (3 tenants x priorities + device.fatal + cancel storm) =="
+python - <<'PY'
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import json
+import math
+import os
+import random
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu.api.session import TpuSparkSession
+from spark_rapids_tpu.obs import telemetry
+from spark_rapids_tpu.obs.http import ObsHttpServer
+from spark_rapids_tpu.runtime import semaphore as sem_mod
+from spark_rapids_tpu.runtime.errors import (
+    QueryCancelledError,
+    QueryDeadlineExceeded,
+    QueryRejectedError,
+)
+from spark_rapids_tpu.runtime.memory import get_catalog
+from spark_rapids_tpu.serve.client import ServeClient
+from spark_rapids_tpu.serve.server import QueryServiceDaemon
+
+root = tempfile.mkdtemp(prefix="srtpu_serve_gate_")
+rng = np.random.default_rng(11)
+N = 40_000
+data = os.path.join(root, "fact")
+os.makedirs(data)
+for i in range(2):
+    pq.write_table(pa.table({
+        "k": pa.array(rng.integers(0, 64, N // 2), pa.int64()),
+        "v": pa.array(rng.random(N // 2) * 100.0),
+    }), os.path.join(data, f"p{i}.parquet"))
+
+SPECS = {
+    "sum": {"op": "orderBy",
+            "input": {"op": "agg",
+                      "input": {"op": "parquet", "path": data},
+                      "groupBy": ["k"],
+                      "aggs": [{"fn": "sum", "col": "v", "as": "x"}]},
+            "keys": ["k"]},
+    "cnt": {"op": "orderBy",
+            "input": {"op": "agg",
+                      "input": {"op": "filter",
+                                "input": {"op": "parquet",
+                                          "path": data},
+                                "cond": {"fn": ">",
+                                         "args": [{"col": "v"},
+                                                  {"param": "lo"}]}},
+                      "groupBy": ["k"],
+                      "aggs": [{"fn": "count", "col": "*",
+                                "as": "x"}]},
+            "keys": ["k"]},
+    "top": {"op": "limit",
+            "input": {"op": "orderBy",
+                      "input": {"op": "select",
+                                "input": {"op": "parquet",
+                                          "path": data},
+                                "cols": ["k", "v"]},
+                      "keys": [{"col": "v", "asc": False}]},
+            "n": 20},
+}
+PARAMS = {"cnt": [{"lo": 25.0}, {"lo": 50.0}, {"lo": 75.0}]}
+
+
+def bindings(name):
+    return PARAMS.get(name, [None])
+
+
+def same(a, b):
+    if set(a) != set(b):
+        return False
+    for col in a:
+        if len(a[col]) != len(b[col]):
+            return False
+        for x, y in zip(a[col], b[col]):
+            if isinstance(x, float) or isinstance(y, float):
+                if not math.isclose(x, y, rel_tol=1e-6, abs_tol=1e-8):
+                    return False
+            elif x != y:
+                return False
+    return True
+
+
+# --- clean oracle: the SAME specs through an embedded chaos-free
+# session (serve and embedded must agree bit-for-bit) ---
+from spark_rapids_tpu.serve.spec import compile_spec
+
+s0 = TpuSparkSession({})
+want = {}
+for name in SPECS:
+    for p in bindings(name):
+        want[(name, json.dumps(p))] = compile_spec(
+            SPECS[name], s0, p or {}).collect_arrow().to_pydict()
+s0.stop()
+
+# --- the daemon under chaos: one warm session, device.fatal armed ---
+s = TpuSparkSession({
+    "spark.sql.shuffle.partitions": 4,
+    "spark.rapids.tpu.admission.maxConcurrentQueries": 3,
+    "spark.rapids.tpu.admission.queue.maxDepth": 32,
+    "spark.rapids.tpu.chaos.enabled": True,
+    "spark.rapids.tpu.chaos.seed": 17,
+    "spark.rapids.tpu.chaos.sites": "device.fatal:once",
+})
+d = QueryServiceDaemon(session=s).start()
+http = ObsHttpServer(s, port=0)
+
+TENANTS = [("acme", "interactive"), ("globex", "standard"),
+           ("initech", "batch")]
+errors, mismatches = [], []
+completed, cancelled, shed = [0], [0], [0]
+lock = threading.Lock()
+stop_probes = threading.Event()
+not_ready_seen = [0]
+live_failures = [0]
+
+
+def probe_loop():
+    """Liveness must NEVER fail; readiness must flip 503 during the
+    fence window the seeded device.fatal opens."""
+    while not stop_probes.is_set():
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{http.port}/healthz",
+                    timeout=2) as r:
+                if r.status != 200:
+                    live_failures[0] += 1
+        except Exception:
+            live_failures[0] += 1
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{http.port}/readyz",
+                    timeout=2) as r:
+                pass
+        except urllib.error.HTTPError as e:
+            if e.code == 503:
+                not_ready_seen[0] += 1
+        except Exception:
+            pass
+        time.sleep(0.004)
+
+
+def worker(tenant, pclass, rounds, seed):
+    prng = random.Random(seed)
+    try:
+        with ServeClient.connect(d, tenant, pclass) as c:
+            for _ in range(rounds):
+                name = prng.choice(sorted(SPECS))
+                p = prng.choice(bindings(name))
+                try:
+                    got = c.query(SPECS[name], params=p,
+                                  timeout_ms=120_000)
+                    with lock:
+                        completed[0] += 1
+                        if not same(got.to_pydict(),
+                                    want[(name, json.dumps(p))]):
+                            mismatches.append((tenant, name, p))
+                except QueryCancelledError:
+                    with lock:
+                        cancelled[0] += 1
+                except QueryDeadlineExceeded:
+                    with lock:
+                        cancelled[0] += 1
+                except QueryRejectedError:
+                    with lock:
+                        shed[0] += 1
+    except BaseException as e:
+        with lock:
+            errors.append((tenant, repr(e)))
+
+
+probe = threading.Thread(target=probe_loop, daemon=True)
+probe.start()
+threads = [threading.Thread(target=worker, args=(t, p, 8, i))
+           for i, (t, p) in enumerate(TENANTS)]
+# two connections per tenant -> intra-tenant concurrency too
+threads += [threading.Thread(target=worker, args=(t, p, 4, 100 + i))
+            for i, (t, p) in enumerate(TENANTS)]
+for t in threads:
+    t.start()
+
+# cancel storm against the live running table, over the wire
+prng = random.Random(4321)
+with ServeClient.connect(d, "admin", "interactive") as admin:
+    deadline = time.monotonic() + 90
+    while any(t.is_alive() for t in threads) and \
+            time.monotonic() < deadline:
+        time.sleep(prng.uniform(0.05, 0.2))
+        running = s.admission_status()["running"]
+        if running and prng.random() < 0.4:
+            admin.cancel(prng.choice(running)["queryId"])
+for t in threads:
+    t.join(240)
+assert not any(t.is_alive() for t in threads), "serve worker hung"
+stop_probes.set()
+probe.join(10)
+
+assert not errors, f"unexpected client errors: {errors}"
+assert not mismatches, f"serve/embedded result mismatch: {mismatches}"
+assert completed[0] > 0, "storm cancelled literally everything"
+assert live_failures[0] == 0, \
+    f"liveness failed {live_failures[0]}x — the service went DOWN"
+assert not_ready_seen[0] >= 1, \
+    "readiness never flipped 503 during the seeded fence"
+
+# plan cache actually served (the whole point of a resident daemon)
+pc_stats = d.plan_cache.stats.snapshot()
+assert pc_stats["hits"] > 0, pc_stats
+
+# billing reconciles with the transfer ledger, tenant by tenant
+summaries = telemetry.ledger.recent_query_summaries()
+for tenant, _ in TENANTS:
+    snap = d.tenants.snapshot()[tenant]
+    billed = sum(
+        int(summaries[qid].get("bytesMovedTotal", 0) or 0)
+        for qid in d.tenants.query_ids(tenant) if qid in summaries)
+    assert snap["bytesMovedTotal"] == billed, (tenant, snap, billed)
+
+# graceful drain: readiness 503 while draining, then a leak-free stop
+report = d.drain()
+try:
+    urllib.request.urlopen(f"http://127.0.0.1:{http.port}/readyz",
+                           timeout=2)
+    raise AssertionError("readyz not 503 while draining")
+except urllib.error.HTTPError as e:
+    assert e.code == 503 and json.loads(e.read())["draining"], e.code
+d.stop()
+leaks = d.leak_report()
+assert leaks == {"connections": 0, "inFlight": 0,
+                 "handlerThreads": 0, "listener": 0}, leaks
+assert not [t for t in threading.enumerate()
+            if t.name.startswith("srtpu-serve")], "leaked thread"
+assert sem_mod.get().holders() == 0, "leaked semaphore permits"
+get_catalog().check_leaks(raise_on_leak=True)
+assert s.admission_status()["running"] == [], "stuck admission slot"
+assert s.admission_status()["queued"] == [], "stuck queued query"
+assert s.admission_status()["draining"] is False, "valve not reopened"
+# the session survives its daemon: still serving embedded queries
+assert s.range(0, 100).count() == 100
+
+print(f"serve gate: {completed[0]} completed, {cancelled[0]} "
+      f"cancelled, {shed[0]} shed, drain={report}, "
+      f"planCache={pc_stats}, notReadySamples={not_ready_seen[0]}")
+http.close()
+s.stop()
+print("SERVE SOAK PASS")
+os._exit(0)  # pre-existing XLA exit-time abort after session cycling
+PY
+
+echo "== serving suites (daemon + plan cache) =="
+python -m pytest tests/test_serve.py tests/test_plan_cache.py -q \
+    -p no:cacheprovider
+
+echo "== srtpu-lint over the tree (zero findings required) =="
+python -m spark_rapids_tpu.tools.lint
+
+echo "SERVE GATE PASS"

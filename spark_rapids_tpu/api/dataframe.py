@@ -679,7 +679,8 @@ class DataFrame:
             # collects fold into the outer query's stream
             qid = obs_events.begin_query(handle.query_id)
             rec["queryId"] = qid
-            rec["admission"] = {"queueWaitMs": handle.queue_wait_ms}
+            rec["admission"] = {"queueWaitMs": handle.queue_wait_ms,
+                                "priority": handle.priority}
             if not scope.nested and handle.queue_wait_ms:
                 # queue wait on the query's span tree (no task scope
                 # here, so the span hangs off the query root)

@@ -388,6 +388,25 @@ class TpuSparkSession:
 
         return admission.get().status()
 
+    # --- serving (serve/server.py) ---
+
+    def serve(self, conf: Optional[Dict[str, object]] = None
+              ) -> "object":
+        """Start a query-service daemon over THIS session's warm
+        engine and return it (already listening; `.port` carries the
+        bound port). The daemon borrows the session — `daemon.stop()`
+        drains and closes sockets but leaves the session running.
+        `conf` entries are applied to the session settings first (the
+        usual place to pass a fixed `spark.rapids.tpu.serve.port` or
+        tenant caps)."""
+        from spark_rapids_tpu.serve.server import QueryServiceDaemon
+
+        if conf:
+            for k, v in conf.items():
+                self._settings[k] = v
+            self.rapids_conf = rc.RapidsConf(self._settings)
+        return QueryServiceDaemon(session=self).start()
+
     def stop(self):
         global _active
         try:

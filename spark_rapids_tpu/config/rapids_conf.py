@@ -783,6 +783,77 @@ QUERY_PRIORITY = conf(
     "admits first; FIFO within a priority). Set per session, or per "
     "query via session.conf.set between submissions.", int,
     checker=lambda v: -1000 <= v <= 1000)
+SERVE_HOST = conf(
+    "spark.rapids.tpu.serve.host", "127.0.0.1",
+    "Bind address of the query service daemon (serve/server.py). The "
+    "protocol is unauthenticated length-prefixed JSON/Arrow-IPC; keep "
+    "it on loopback or a trusted network segment.", str)
+SERVE_PORT = conf(
+    "spark.rapids.tpu.serve.port", 0,
+    "TCP port of the query service daemon; 0 binds an ephemeral port "
+    "(reported as daemon.port — the tests/CI pattern).", int,
+    checker=lambda v: 0 <= v <= 65535)
+SERVE_MAX_CONNECTIONS = conf(
+    "spark.rapids.tpu.serve.maxConnections", 64,
+    "Concurrent client connections the daemon accepts; a connection "
+    "past this is refused with a `busy` error frame at hello. Each "
+    "connection is one session/tenant binding; per-tenant query "
+    "concurrency is governed separately (serve.tenant.* caps on top "
+    "of the global admission bound).", int,
+    checker=lambda v: 1 <= v <= 100_000)
+SERVE_MAX_FRAME_BYTES = conf(
+    "spark.rapids.tpu.serve.maxFrameBytes", 64 << 20,
+    "Upper bound on one protocol frame (length-prefixed JSON header "
+    "or Arrow-IPC payload); an oversized frame fails the request with "
+    "a clean `protocol` error instead of an unbounded buffer.", int,
+    checker=lambda v: 1 << 10 <= v <= 1 << 34)
+SERVE_DRAIN_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.serve.drain.timeoutMs", 30_000,
+    "Graceful-drain deadline (daemon.drain() / SIGTERM): the daemon "
+    "stops accepting work (admission sheds new submissions with "
+    "reason='draining', readiness flips 503), waits up to this long "
+    "for in-flight queries to finish, then cancels stragglers through "
+    "the admission cancel machinery so the stop is always bounded.",
+    int, checker=lambda v: v >= 0)
+SERVE_PLAN_CACHE_ENABLED = conf(
+    "spark.rapids.tpu.serve.planCache.enabled", True,
+    "Structural plan cache for served queries (serve/plan_cache.py): "
+    "query specs are normalized with literals parameterized out and "
+    "keyed by structural digest + tenant + planning-conf digest, so "
+    "repeated parameterized queries skip spec compilation and "
+    "planning and ride the warm compiled executables.", bool)
+SERVE_PLAN_CACHE_MAX_ENTRIES = conf(
+    "spark.rapids.tpu.serve.planCache.maxEntries", 256,
+    "Structural plan-cache entries retained (LRU); one entry per "
+    "normalized query shape per tenant.", int,
+    checker=lambda v: 1 <= v <= 1_000_000)
+SERVE_PLAN_CACHE_BINDINGS = conf(
+    "spark.rapids.tpu.serve.planCache.bindingsPerEntry", 16,
+    "Fully-planned physical plans retained per structural entry (LRU "
+    "over distinct parameter bindings): an exact-binding repeat "
+    "reuses the physical plan outright; a new binding re-plans from "
+    "the cached template (still skipping spec compilation).", int,
+    checker=lambda v: 1 <= v <= 100_000)
+SERVE_TENANT_MAX_CONCURRENT = conf(
+    "spark.rapids.tpu.serve.tenant.maxConcurrentQueries", 0,
+    "Per-tenant concurrent-query cap on top of the global admission "
+    "bound; a tenant at its cap is shed with QueryRejectedError "
+    "reason='tenant quota' before touching the admission queue. "
+    "0 = no per-tenant cap.", int, checker=lambda v: v >= 0)
+SERVE_TENANT_MAX_DEVICE_BYTES = conf(
+    "spark.rapids.tpu.serve.tenant.maxDeviceBytes", 0,
+    "Per-tenant device-byte budget: once a tenant's billed bytes "
+    "moved (transfer-ledger totals across its queries) exceed this, "
+    "further queries are shed with reason='tenant quota' until the "
+    "ledger is reset (tenants.reset_usage). 0 = unmetered.", int,
+    checker=lambda v: v >= 0)
+SERVE_PRIORITY_CLASSES = conf(
+    "spark.rapids.tpu.serve.priorityClasses",
+    "interactive=100,standard=0,batch=-100",
+    "Named priority classes a connection may bind "
+    "('name=weight,...'); the weight feeds the admission queue's "
+    "priority-then-FIFO ordering (PR 5). An unknown class at hello "
+    "fails the handshake with a clean error.", str)
 SEMAPHORE_ATOMIC_QUERY_GROUPS = conf(
     "spark.rapids.tpu.semaphore.atomicQueryGroups", True,
     "Deadlock-free device-semaphore discipline: all permits a query "

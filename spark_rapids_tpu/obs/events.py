@@ -71,6 +71,12 @@ EVENT_TYPES: Dict[str, str] = {
     "chip.unfence": "device, chipEpoch",
     "chip.recovery": "device, chipEpoch, shards, survivors, ms",
     "ici.retry": "detail, left",
+    "serve.connect": "tenant, priorityClass, addr",
+    "serve.disconnect": "tenant, queries, bytesOut",
+    "serve.query":
+        "tenant, priorityClass, planCache, status, rows, wallMs",
+    "serve.shed": "tenant, reason",
+    "serve.drain": "phase, inFlight, connections",
 }
 
 #: Envelope keys present on EVERY event (eventlog validation contract).

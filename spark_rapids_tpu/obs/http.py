@@ -11,7 +11,16 @@ and operators without any embedder glue:
   running/queued tables (runtime/admission.py `status()`) joined with
   the per-query data-movement summaries from the transfer ledger
   (obs/telemetry.py) and the recent HBM occupancy timeline.
-- `GET /healthz`  -> `ok` (load-balancer probe).
+- `GET /healthz`  -> `ok` (LIVENESS probe: the process is up and the
+  endpoint thread is serving — always 200; a fenced or draining engine
+  is alive, restarting it would only lose the warm state recovery is
+  about to restore).
+- `GET /readyz`   -> READINESS probe: 200 + JSON when the engine can
+  accept new queries; 503 + the same JSON body (`ready`, `fenced`,
+  `fencedChips`, `draining`) while device-loss fencing
+  (runtime/device_monitor.py) or an admission drain
+  (runtime/admission.py begin_drain / serve/server.py) is in effect —
+  load balancers stop ROUTING to the engine instead of killing it.
 
 Lifecycle is session-owned (ObsManager): started at session init when
 enabled, shut down leak-free in `close()` — the CI gate
@@ -43,6 +52,7 @@ class ObsHttpServer:
             def do_GET(self):
                 try:
                     path = self.path.split("?", 1)[0]
+                    code = 200
                     if path == "/metrics":
                         body = outer._metrics().encode()
                         ctype = ("text/plain; version=0.0.4; "
@@ -53,10 +63,15 @@ class ObsHttpServer:
                         ctype = "application/json"
                     elif path == "/healthz":
                         body, ctype = b"ok\n", "text/plain"
+                    elif path == "/readyz":
+                        ready = outer._readiness()
+                        body = json.dumps(ready).encode()
+                        ctype = "application/json"
+                        code = 200 if ready["ready"] else 503
                     else:
                         self.send_error(404, "unknown path")
                         return
-                    self.send_response(200)
+                    self.send_response(code)
                     self.send_header("Content-Type", ctype)
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
@@ -98,6 +113,21 @@ class ObsHttpServer:
             "hbmTimeline": telemetry.ledger.hbm_timeline(),
             "linkPeaks": telemetry.link_peaks(),
         }
+
+    def _readiness(self) -> dict:
+        from spark_rapids_tpu.runtime import admission, device_monitor
+
+        mon = device_monitor.get()
+        ctrl = admission.get()
+        fenced = bool(mon.fenced)
+        chips = sorted(device_monitor.fenced_chips())
+        draining = bool(getattr(ctrl, "draining", False))
+        # a single fenced CHIP degrades capacity but the engine still
+        # serves (survivor remesh / CPU rung) — only a process-wide
+        # fence or a drain flips readiness
+        return {"ready": not (fenced or draining),
+                "fenced": fenced, "fencedChips": chips,
+                "draining": draining}
 
     # --- lifecycle ---
 

@@ -84,6 +84,22 @@ def unified_snapshot(session=None) -> dict:
             out["memory"] = dict(_catalog.metrics)
     except Exception:
         pass
+    try:
+        import sys
+
+        srv = sys.modules.get("spark_rapids_tpu.serve.server")
+        daemon = srv.active_daemon() if srv is not None else None
+        if daemon is not None:
+            st = daemon.status()
+            out["serve"] = {
+                "connections": len(st["connections"]),
+                "inFlight": st["inFlight"],
+                "queriesServed": st["queriesServed"],
+                "planCache": st["planCache"],
+                "tenants": st["tenants"],
+            }
+    except Exception:
+        pass
     bus = _events.get()
     if session is not None and getattr(session, "obs", None) is not None:
         bus = session.obs.bus or bus

@@ -86,7 +86,7 @@ class _QueryLedger:
 
     __slots__ = ("by_direction", "by_site", "hbm_peak", "hbm_current",
                  "spill_pressure", "final", "enc_actual", "enc_plain",
-                 "ici_host_avoided")
+                 "ici_host_avoided", "labels")
 
     def __init__(self):
         self.by_direction: Dict[str, Dict[str, int]] = {}
@@ -95,6 +95,9 @@ class _QueryLedger:
         self.hbm_current = 0
         self.spill_pressure = 0
         self.final: Optional[dict] = None  # end-of-query summary
+        # caller-attached attribution (serve/: tenant, priorityClass);
+        # merged into query_summary so /queries rows carry their owner
+        self.labels: Optional[dict] = None
         # encoded execution: bytes actually staged for encoded columns
         # vs what the decoded representation would have staged
         self.enc_actual = 0
@@ -275,6 +278,8 @@ class TransferLedger:
             enc_actual = 0 if q is None else q.enc_actual
             enc_plain = 0 if q is None else q.enc_plain
             ici_avoided = 0 if q is None else q.ici_host_avoided
+            labels = None if q is None or not q.labels \
+                else dict(q.labels)
         total = sum(c["bytes"] for c in by_dir.values())
         link = sum(by_dir.get(d, _cell())["bytes"]
                    for d in ("h2d", "d2h"))
@@ -286,6 +291,8 @@ class TransferLedger:
             "hbmPeakBytes": hbm_peak,
             "spillPressureEvents": pressure,
         }
+        if labels:
+            out["labels"] = labels
         ici = by_dir.get("ici", _cell())["bytes"]
         if ici > 0:
             # ICI-resident shuffle: bytes that rode the mesh fabric
@@ -312,6 +319,22 @@ class TransferLedger:
                     (link / wall_s) / peaks["h2dBytesPerS"], 6)
         return out
 
+    def label_query(self, query_id: int, **labels) -> None:
+        """Attach attribution labels (serve/server.py: tenant,
+        priorityClass) to a query's ledger; they ride every later
+        query_summary / recent_query_summaries row under `labels`, so
+        /queries shows WHOSE bytes each query moved."""
+        if not self.enabled or not query_id or not labels:
+            return
+        with self._lock:
+            q = self._query(query_id)
+            q.labels = {**(q.labels or {}), **labels}
+
+    def query_labels(self, query_id: int) -> dict:
+        with self._lock:
+            q = self._queries.get(query_id)
+            return dict(q.labels) if q is not None and q.labels else {}
+
     def finalize_query(self, query_id: int, summary: dict) -> None:
         """Retain a query's end-of-run summary (with wall time and
         roofline fractions) so /metrics and /queries report finished
@@ -327,8 +350,12 @@ class TransferLedger:
         end-of-run summary (with roofline fractions) for finished
         queries, the live ledger view for in-flight ones."""
         with self._lock:
-            finals = {qid: dict(q.final) for qid, q in
-                      self._queries.items() if qid and q.final}
+            # labels may land AFTER finalization (serve learns the
+            # query id from the collect record) — merge at read time
+            finals = {qid: ({**q.final, "labels": dict(q.labels)}
+                            if q.labels else dict(q.final))
+                      for qid, q in self._queries.items()
+                      if qid and q.final}
             live = [qid for qid, q in self._queries.items()
                     if qid and not q.final]
         out = {qid: self.query_summary(qid) for qid in live}

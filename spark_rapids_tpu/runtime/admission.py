@@ -42,6 +42,7 @@ completion) harden the drain and finish paths.
 
 from __future__ import annotations
 
+import contextlib
 import heapq
 import itertools
 import threading
@@ -167,6 +168,8 @@ class AdmissionController:
         self.queue_depth = max(0, int(queue_depth))
         self.queue_timeout_ms = max(0, int(queue_timeout_ms))
         self.quarantine_crashes = max(0, int(quarantine_crashes))
+        self.draining = False
+        self.drain_reason = ""
         self._cv = threading.Condition()
         self._running: Dict[int, QueryHandle] = {}
         self._finished: Dict[int, QueryHandle] = {}
@@ -226,6 +229,22 @@ class AdmissionController:
                             quarantine_threshold=self.quarantine_crashes)
         handle = QueryHandle(query_id, token, priority, description)
         stats.add("queriesSubmitted")
+        if self.draining:
+            # drain shed precedes every other admission verdict
+            # (including enabled=False): a draining engine accepts NO
+            # new top-level queries, while already-queued queries keep
+            # their slots/deadlines and in-flight queries' nested
+            # collects ride their enclosing handle (they never reach
+            # submit()).
+            stats.add("queriesShed")
+            obs_events.emit("admission.shed", queryId=query_id,
+                            reason="draining",
+                            running=len(self._running))
+            raise QueryRejectedError(
+                f"query {query_id} rejected: the engine is draining"
+                f"{' (' + self.drain_reason + ')' if self.drain_reason else ''}; "
+                f"no new submissions are accepted (queued queries keep "
+                f"their slots)", reason="draining")
         if not self.enabled:
             from spark_rapids_tpu.runtime import sanitizer as _san
 
@@ -251,7 +270,7 @@ class AdmissionController:
                 f"device-loss recovery (epoch "
                 f"{device_monitor.get().epoch}, "
                 f"device.recovery.fencedAdmission=shed); retry after "
-                f"recovery")
+                f"recovery", reason="device fenced")
         with self._cv:
             if len(self._running) < self.max_concurrent and \
                     not self._heap and fence != "queue":
@@ -265,7 +284,7 @@ class AdmissionController:
                                 running=len(self._running))
                 raise QueryRejectedError(
                     f"query {query_id} rejected (admission queue "
-                    f"full): {diag}")
+                    f"full): {diag}", reason="queue full")
             # enqueue
             self._queued[query_id] = handle
             heapq.heappush(self._heap,
@@ -429,6 +448,29 @@ class AdmissionController:
                     del self._finished[k]
             self._cv.notify_all()
 
+    # --- drain API ---
+
+    def begin_drain(self, reason: str = "") -> None:
+        """Stop accepting NEW top-level submissions (they shed with
+        QueryRejectedError reason='draining'). Already-queued queries
+        keep their slots and deadlines and still admit as capacity
+        frees; running queries (and their nested collects) are
+        untouched. Idempotent; `end_drain` re-opens the front door."""
+        with self._cv:
+            self.draining = True
+            self.drain_reason = reason
+
+    def end_drain(self) -> None:
+        with self._cv:
+            self.draining = False
+            self.drain_reason = ""
+
+    def quiescent(self) -> bool:
+        """True when nothing is running or queued (the drain-complete
+        condition the serving layer polls)."""
+        with self._cv:
+            return not self._running and not self._queued
+
     # --- cancel API ---
 
     def cancel(self, query_id: int, reason: str = "cancelled by user"
@@ -465,7 +507,8 @@ class AdmissionController:
         return {"running": self.running_table(),
                 "queued": self.queued_table(),
                 "maxConcurrentQueries": self.max_concurrent,
-                "queueMaxDepth": self.queue_depth}
+                "queueMaxDepth": self.queue_depth,
+                "draining": self.draining}
 
 
 # ------------------------------------------------------ process wiring
@@ -517,6 +560,36 @@ def configure(conf=None) -> AdmissionController:
 
 # ----------------------------------------------------- session surface
 
+@contextlib.contextmanager
+def request_overrides(priority: Optional[int] = None,
+                      timeout_ms: Optional[int] = None,
+                      description: Optional[str] = None):
+    """Per-REQUEST admission parameters for this thread: the serving
+    layer (serve/server.py) runs many concurrent queries with distinct
+    priority classes through ONE session, so the session-wide
+    query.priority / query.timeoutMs confs would race across
+    connections. AdmissionScope consults the innermost active override
+    before falling back to the session conf. Nests; None fields fall
+    through to the next level."""
+    prev = getattr(_tls, "overrides", None)
+    ov = dict(prev or {})
+    if priority is not None:
+        ov["priority"] = int(priority)
+    if timeout_ms is not None:
+        ov["timeout_ms"] = int(timeout_ms)
+    if description is not None:
+        ov["description"] = str(description)
+    _tls.overrides = ov
+    try:
+        yield ov
+    finally:
+        _tls.overrides = prev
+
+
+def current_overrides() -> dict:
+    return getattr(_tls, "overrides", None) or {}
+
+
 class AdmissionScope:
     """Context manager the collect path enters around a query
     (api/dataframe.py): re-entrant per thread — a nested collect rides
@@ -547,11 +620,13 @@ class AdmissionScope:
         # one while this query runs
         self._ctrl = get()
         qid = obs_events.allocate_query_id()
+        ov = current_overrides()
         self.handle = self._ctrl.submit(
             qid,
-            priority=conf.get(rc.QUERY_PRIORITY),
-            timeout_ms=conf.get(rc.QUERY_TIMEOUT_MS),
-            description=self.description)
+            priority=ov.get("priority", conf.get(rc.QUERY_PRIORITY)),
+            timeout_ms=ov.get("timeout_ms",
+                              conf.get(rc.QUERY_TIMEOUT_MS)),
+            description=ov.get("description", self.description))
         _tls.handle = self.handle
         self._cancel_scope = cancellation.scope(self.handle.token)
         self._cancel_scope.__enter__()

@@ -109,13 +109,24 @@ class QueryRejectedError(QueryGovernanceError):
     top of maxConcurrentQueries running. The message carries the
     running-query table (query ids, elapsed time, descriptions) so the
     operator sees WHO holds capacity — a shed is always an immediate
-    clean error, never an unbounded wait."""
+    clean error, never an unbounded wait. `reason` is a stable
+    machine-readable verdict ("queue full" | "queue timeout" |
+    "draining" | "device fenced" | "tenant quota") so the serving
+    layer (serve/protocol.py) can map sheds onto wire error codes
+    without parsing the human diagnostics."""
+
+    def __init__(self, message: str = "", reason: str = ""):
+        super().__init__(message)
+        self.reason = reason or "rejected"
 
 
 class QueryQueueTimeout(QueryRejectedError):
     """A queued query waited past admission.queue.timeoutMs without a
     slot freeing; diagnostics name the running queries that held
     capacity the whole time."""
+
+    def __init__(self, message: str = "", reason: str = "queue timeout"):
+        super().__init__(message, reason=reason)
 
 
 class QueryCancelledError(QueryGovernanceError):

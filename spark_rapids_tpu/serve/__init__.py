@@ -1,0 +1,26 @@
+"""Multi-tenant query service — the persistent serving layer.
+
+The engine's governance substrate (admission tiers, per-query cancel
+tokens, transfer-ledger billing, device-loss fencing, drain-aware
+readiness) was built bottom-up across prior PRs; this package is the
+server that finally fronts it: ONE warm `TpuSparkSession` multiplexed
+across many concurrent client connections, each bound to a tenant id
+and a named priority class.
+
+- serve/protocol.py — length-prefixed JSON/Arrow-IPC wire protocol
+- serve/spec.py     — the JSON query-spec DSL -> DataFrame compiler
+- serve/plan_cache.py — structural plan cache (literals parameterized
+  out, compile-cache-style digest keying, per-tenant isolation)
+- serve/tenants.py  — per-tenant quota ledgers + billing totals
+- serve/server.py   — the daemon: TCP accept loop, graceful drain,
+  SIGTERM, liveness/readiness integration
+- serve/client.py   — in-process client speaking the same protocol
+"""
+
+from spark_rapids_tpu.serve.client import ServeClient, ServeError
+from spark_rapids_tpu.serve.plan_cache import PlanCache
+from spark_rapids_tpu.serve.server import QueryServiceDaemon
+from spark_rapids_tpu.serve.tenants import TenantLedger
+
+__all__ = ["QueryServiceDaemon", "ServeClient", "ServeError",
+           "PlanCache", "TenantLedger"]

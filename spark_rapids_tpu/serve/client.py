@@ -1,0 +1,156 @@
+"""In-process client for the query service protocol.
+
+`ServeClient` speaks the serve/protocol.py wire format over a plain
+TCP socket: connect, hello-bind a tenant + priority class, then
+`query()` returns arrow tables and raises the same governance
+exception taxonomy the embedded API raises — a served
+QueryRejectedError(reason="draining") and an in-process one look
+identical to caller code, which is what lets the CI soak share its
+oracle with the embedded path.
+
+The client is intentionally dependency-free beyond pyarrow (socket +
+json + the protocol module), one socket per client, thread-unsafe by
+design: a client IS a session. Concurrency = more clients."""
+
+from __future__ import annotations
+
+import itertools
+import socket
+from typing import Dict, Optional
+
+import pyarrow as pa
+
+from spark_rapids_tpu.serve import protocol
+
+
+class ServeError(RuntimeError):
+    """A server error frame that maps onto no governance exception
+    (protocol violations, internal errors); carries the wire code."""
+
+    def __init__(self, code: str, message: str):
+        super().__init__(f"[{code}] {message}")
+        self.code = code
+
+
+def _raise_for(header: dict) -> None:
+    from spark_rapids_tpu.runtime.errors import (
+        QueryCancelledError,
+        QueryDeadlineExceeded,
+        QueryQuarantinedError,
+        QueryRejectedError,
+    )
+
+    code = header.get("code", "internal")
+    msg = header.get("message", "")
+    if code in ("rejected", "draining", "device_fenced",
+                "tenant_quota"):
+        reason = header.get("reason") or {
+            "draining": "draining",
+            "device_fenced": "device fenced",
+            "tenant_quota": "tenant quota"}.get(code, "rejected")
+        raise QueryRejectedError(msg, reason=reason)
+    if code == "deadline":
+        raise QueryDeadlineExceeded(msg)
+    if code == "quarantined":
+        raise QueryQuarantinedError(msg)
+    if code == "cancelled":
+        raise QueryCancelledError(msg)
+    raise ServeError(code, msg)
+
+
+class ServeClient:
+    """One tenant-bound connection to a QueryServiceDaemon."""
+
+    def __init__(self, host: str, port: int, tenant: str,
+                 priority_class: str = "standard",
+                 max_frame_bytes: int = 64 << 20,
+                 connect_timeout_s: float = 10.0):
+        self.tenant = tenant
+        self.priority_class = priority_class
+        self.max_frame_bytes = int(max_frame_bytes)
+        self._ids = itertools.count(1)
+        self._sock = socket.create_connection(
+            (host, int(port)), timeout=connect_timeout_s)
+        self._sock.settimeout(None)  # queries block until served
+        protocol.send_json(self._sock, {
+            "type": "hello", "id": next(self._ids),
+            "version": protocol.PROTOCOL_VERSION,
+            "tenant": tenant, "priorityClass": priority_class})
+        reply, _ = protocol.recv_message(self._sock,
+                                         self.max_frame_bytes)
+        if reply.get("type") != "hello_ok":
+            self.close()
+            _raise_for(reply)
+        self.priority = reply.get("priority", 0)
+
+    @classmethod
+    def connect(cls, daemon, tenant: str,
+                priority_class: str = "standard") -> "ServeClient":
+        """Client for an in-process daemon (tests, bench)."""
+        return cls(daemon.host, daemon.port, tenant,
+                   priority_class=priority_class,
+                   max_frame_bytes=daemon.max_frame_bytes)
+
+    # ------------------------------------------------------- requests
+
+    def query(self, spec: dict,
+              params: Optional[Dict[str, object]] = None,
+              timeout_ms: Optional[int] = None) -> pa.Table:
+        """Run a spec; returns the arrow result or raises the mapped
+        governance error. `self.last_result` keeps the result header
+        (queryId, planCache verdict, rows, wallMs)."""
+        req = {"type": "query", "id": next(self._ids), "spec": spec}
+        if params:
+            req["params"] = params
+        if timeout_ms is not None:
+            req["timeoutMs"] = int(timeout_ms)
+        protocol.send_json(self._sock, req)
+        header, table = protocol.recv_message(self._sock,
+                                              self.max_frame_bytes)
+        if header.get("type") == "error":
+            _raise_for(header)
+        self.last_result = header
+        return table
+
+    def cancel(self, query_id: Optional[int] = None) -> int:
+        """Cancel one engine query id, or EVERYTHING in flight when
+        None (the cancel-storm lever)."""
+        req = {"type": "cancel", "id": next(self._ids)}
+        if query_id is not None:
+            req["queryId"] = int(query_id)
+        protocol.send_json(self._sock, req)
+        reply, _ = protocol.recv_message(self._sock,
+                                         self.max_frame_bytes)
+        if reply.get("type") == "error":
+            _raise_for(reply)
+        return int(reply.get("cancelled", 0))
+
+    def ping(self) -> dict:
+        protocol.send_json(self._sock, {"type": "ping",
+                                        "id": next(self._ids)})
+        reply, _ = protocol.recv_message(self._sock,
+                                         self.max_frame_bytes)
+        return reply
+
+    def close(self) -> None:
+        sock, self._sock = self._sock, None
+        if sock is None:
+            return
+        try:
+            protocol.send_json(sock, {"type": "bye",
+                                      "id": next(self._ids)})
+            sock.settimeout(2.0)
+            protocol.recv_json(sock, self.max_frame_bytes)
+        except (OSError, protocol.ProtocolError, ConnectionError):
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "ServeClient":
+        return self
+
+    def __exit__(self, *_exc) -> bool:
+        self.close()
+        return False

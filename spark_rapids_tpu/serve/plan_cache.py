@@ -1,0 +1,349 @@
+"""Structural plan cache for served queries.
+
+The compile cache (PR 1) made the EXECUTABLE warm; planning itself —
+spec compilation, parquet schema inference off file footers, the
+optimizer, physical overrides — was still paid per request. This
+cache keys served queries the way the compile cache keys programs:
+by a NORMALIZED structural digest with literals parameterized out.
+
+Normalization rewrites every `{"lit": v}` in the spec to an
+auto-parameter, so two requests that differ only in literal values
+share one cache entry. The structural key is
+  sha256(canonical spec JSON + tenant id + param type signature
+         + planning-conf digest)
+— tenant isolation is by construction (tenant A's entries can never
+serve tenant B), and any `spark.*` conf change (a different
+fusedExec/mesh/admission planning posture) changes the digest and
+misses cleanly instead of serving a stale plan.
+
+Each entry caches the fully RESOLVED logical template (built once,
+with ParamLiteral placeholders) plus an LRU of fully planned physical
+plans per distinct parameter binding:
+
+- exact-binding repeat -> checkout of the planned physical: skips
+  spec compile, schema inference, optimize and plan_query outright,
+  and rides the warm compiled executables (`hit` / `hitsExact`).
+- new binding on a known shape -> ParamLiteral substitution into the
+  template then optimize+plan_query only (`hit` / `hitsRebind`):
+  re-planning is REQUIRED for correctness — literal values flow into
+  pushed-down parquet predicates and compiled-program keys — but the
+  serving front-end (spec walk + footer reads + resolution) is
+  skipped.
+- unknown shape -> full build (`miss`).
+
+Physical plans check OUT exclusively: two concurrent requests on the
+same binding never share one physical tree mid-execution (the second
+re-plans from the template); a failed execution drops its binding so
+a poisoned plan is never served twice.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+from spark_rapids_tpu.api.dataframe import DataFrame
+from spark_rapids_tpu.expr.core import Literal
+
+
+class ParamLiteral(Literal):
+    """A literal placeholder in a cached logical template, carrying
+    the parameter name it binds. Never executed — binding substitutes
+    a plain Literal before optimize/plan_query."""
+
+    def __init__(self, name: str, value, dtype=None):
+        super().__init__(value, dtype)
+        self.param_name = name
+
+
+class _PrebuiltDataFrame(DataFrame):
+    """A DataFrame whose physical plan was already built (checkout
+    from the cache): `_physical()` returns it instead of re-planning.
+    The cpu_oracle path still plans fresh from the logical tree — the
+    oracle must never see a cached device plan."""
+
+    def __init__(self, plan, session, prebuilt):
+        super().__init__(plan, session)
+        self._prebuilt = prebuilt
+
+    def _physical(self, cpu_oracle: bool = False):
+        if cpu_oracle or self._prebuilt is None:
+            return super()._physical(cpu_oracle)
+        return self._prebuilt
+
+
+class _CapturingDataFrame(DataFrame):
+    """A DataFrame that remembers the physical plan its collect built,
+    so the cache can store it for the next exact-binding repeat
+    without planning a second time."""
+
+    def __init__(self, plan, session):
+        super().__init__(plan, session)
+        self._built = None
+
+    def _physical(self, cpu_oracle: bool = False):
+        out = super()._physical(cpu_oracle)
+        if not cpu_oracle:
+            self._built = out
+        return out
+
+
+def normalize_spec(spec) -> Tuple[dict, Dict[str, object]]:
+    """Parameterize literals out: every `{"lit": v}` becomes
+    `{"param": "_pN"}` (N in deterministic walk order), returning the
+    normalized spec and the extracted auto-bindings. `isin` value
+    lists stay verbatim — their arity and values are part of the
+    expression SHAPE (a different list is a different plan), so they
+    key structurally instead of parameterizing."""
+    auto: Dict[str, object] = {}
+
+    def walk(node):
+        if isinstance(node, dict):
+            if node.get("fn") == "isin" and \
+                    isinstance(node.get("args"), list) and node["args"]:
+                return {**node,
+                        "args": [walk(node["args"][0])]
+                        + list(node["args"][1:])}
+            if set(node) == {"lit"} or (set(node) == {"lit", "type"}):
+                name = f"_p{len(auto)}"
+                auto[name] = node["lit"]
+                return {"param": name}
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, list):
+            return [walk(v) for v in node]
+        return node
+
+    return walk(spec), auto
+
+
+def _digest(obj) -> str:
+    return hashlib.sha256(
+        json.dumps(obj, sort_keys=True, default=repr).encode()
+    ).hexdigest()
+
+
+def conf_digest(settings: dict) -> str:
+    """Digest of every `spark.*` setting — planning posture; any
+    change invalidates (misses) rather than risking a stale plan."""
+    return _digest(sorted(
+        (k, repr(v)) for k, v in settings.items()
+        if str(k).startswith("spark.")))
+
+
+def binding_key(params: Dict[str, object]) -> str:
+    return _digest(sorted(
+        (k, type(v).__name__, repr(v)) for k, v in params.items()))
+
+
+def type_signature(params: Dict[str, object]) -> list:
+    return sorted((k, type(v).__name__) for k, v in params.items())
+
+
+class _Binding:
+    __slots__ = ("phys", "meta", "logical", "in_use")
+
+    def __init__(self, logical, phys, meta):
+        self.logical = logical
+        self.phys = phys
+        self.meta = meta
+        self.in_use = False
+
+
+class _Entry:
+    __slots__ = ("template", "bindings")
+
+    def __init__(self, template):
+        self.template = template  # resolved logical w/ ParamLiterals
+        self.bindings: "OrderedDict[str, _Binding]" = OrderedDict()
+
+
+class PlanCacheStats:
+    _FIELDS = ("hits", "hitsExact", "hitsRebind", "misses",
+               "evictions")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._v = {f: 0 for f in self._FIELDS}
+
+    def add(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            self._v[field] += n
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self._v)
+        looked = out["hits"] + out["misses"]
+        out["hitRatio"] = round(out["hits"] / looked, 4) if looked \
+            else 0.0
+        return out
+
+
+class PlanCache:
+    """Bounded structural plan cache (LRU entries, LRU bindings)."""
+
+    def __init__(self, max_entries: int = 256,
+                 bindings_per_entry: int = 16, enabled: bool = True):
+        self.enabled = enabled
+        self.max_entries = max(1, int(max_entries))
+        self.bindings_per_entry = max(1, int(bindings_per_entry))
+        self.stats = PlanCacheStats()
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    # --- keying ---
+
+    def structural_key(self, tenant: str, norm_spec: dict,
+                       params: Dict[str, object],
+                       settings: dict) -> str:
+        return _digest({"spec": norm_spec, "tenant": tenant,
+                        "types": type_signature(params),
+                        "conf": conf_digest(settings)})
+
+    # --- the serve-path entry point ---
+
+    def dataframe_for(self, session, tenant: str, spec: dict,
+                      params: Optional[Dict[str, object]] = None):
+        """Resolve `spec` + `params` to an executable DataFrame.
+
+        Returns (df, info, release): `release(success)` MUST be called
+        after execution — it checks a borrowed physical back in (or
+        stores/drops a fresh one). `info` carries the cache verdict
+        ("hit-exact" | "hit-rebind" | "miss" | "bypass") and key
+        digests for diagnostics."""
+        from spark_rapids_tpu.plan import logical as L
+        from spark_rapids_tpu.serve.spec import compile_spec
+
+        norm_spec, auto = normalize_spec(spec)
+        bound = {**auto, **(params or {})}
+        if not self.enabled:
+            df = compile_spec(spec, session, bound)
+            return df, {"planCache": "bypass"}, lambda _ok: None
+        skey = self.structural_key(tenant, norm_spec, bound,
+                                   session._settings)
+        bkey = binding_key(bound)
+        info = {"planCache": "miss", "key": skey[:12]}
+
+        with self._lock:
+            entry = self._entries.get(skey)
+            if entry is not None:
+                self._entries.move_to_end(skey)
+                b = entry.bindings.get(bkey)
+                if b is not None and not b.in_use:
+                    # exact repeat: the planned physical checks out
+                    b.in_use = True
+                    entry.bindings.move_to_end(bkey)
+                    self.stats.add("hits")
+                    self.stats.add("hitsExact")
+                    info["planCache"] = "hit-exact"
+                    df = _PrebuiltDataFrame(b.logical, session,
+                                            (b.phys, b.meta))
+                    return df, info, self._releaser(skey, bkey, b)
+                template = entry.template
+            else:
+                template = None
+
+        if template is not None:
+            # known shape, new (or busy) binding: substitute the
+            # params into the resolved template — no spec walk, no
+            # schema inference — then re-plan physically
+            def bind(e):
+                def sub(node):
+                    if isinstance(node, ParamLiteral):
+                        return Literal(bound[node.param_name])
+                    return node
+                return e.transform(sub)
+
+            plan = L.transform_expressions(template, bind)
+            self.stats.add("hits")
+            self.stats.add("hitsRebind")
+            info["planCache"] = "hit-rebind"
+            df = _CapturingDataFrame(plan, session)
+            return df, info, self._storer(skey, bkey, df)
+
+        # unknown shape: full build, and ALSO keep the ParamLiteral
+        # template so the next binding skips the front-end
+        self.stats.add("misses")
+        from spark_rapids_tpu.serve.spec import SpecError
+
+        try:
+            template = self._build_template(session, norm_spec, bound)
+        except SpecError:
+            # uncacheable construct (e.g. a parameter inside an isin
+            # value list): serve it directly, cache nothing — a
+            # genuinely bad spec raises the same error right here
+            df = compile_spec(spec, session, bound)
+            return df, info, lambda ok=True: None
+
+        def bind_first(e):
+            def sub(node):
+                if isinstance(node, ParamLiteral):
+                    return Literal(bound[node.param_name])
+                return node
+            return e.transform(sub)
+
+        df = _CapturingDataFrame(
+            L.transform_expressions(template, bind_first), session)
+        with self._lock:
+            if skey not in self._entries:
+                self._entries[skey] = _Entry(template)
+                while len(self._entries) > self.max_entries:
+                    self._entries.popitem(last=False)
+                    self.stats.add("evictions")
+        return df, info, self._storer(skey, bkey, df)
+
+    # --- internals ---
+
+    def _build_template(self, session, norm_spec: dict,
+                        bound: Dict[str, object]):
+        """Compile the normalized spec once with ParamLiteral
+        placeholders (carrying real current values, so resolution
+        sees honest dtypes) and keep the resolved logical tree."""
+        from spark_rapids_tpu.api.column import Column
+        from spark_rapids_tpu.serve.spec import compile_spec
+
+        def lit_factory(name, value):
+            return Column(ParamLiteral(name, value))
+
+        df = compile_spec(norm_spec, session, bound,
+                          lit_factory=lit_factory)
+        return df._plan
+
+    def _releaser(self, skey: str, bkey: str, binding: _Binding):
+        def release(success: bool = True) -> None:
+            with self._lock:
+                binding.in_use = False
+                if not success:
+                    entry = self._entries.get(skey)
+                    if entry is not None:
+                        entry.bindings.pop(bkey, None)
+        return release
+
+    def _storer(self, skey: str, bkey: str, df: "_CapturingDataFrame"):
+        """After a miss/rebind executes OK, store the physical plan
+        its collect built for the next exact-binding repeat."""
+        def release(success: bool = True) -> None:
+            built = df._built
+            if not success or built is None:
+                return
+            phys, meta = built
+            with self._lock:
+                entry = self._entries.get(skey)
+                if entry is None:
+                    return
+                entry.bindings[bkey] = _Binding(df._plan, phys, meta)
+                entry.bindings.move_to_end(bkey)
+                while len(entry.bindings) > self.bindings_per_entry:
+                    entry.bindings.popitem(last=False)
+                    self.stats.add("evictions")
+        return release

@@ -1,0 +1,169 @@
+"""Wire protocol of the query service: length-prefixed JSON frames
+with Arrow-IPC result payloads.
+
+Every message is one FRAME: a 4-byte big-endian unsigned length
+followed by that many bytes of UTF-8 JSON. A message whose header
+carries `"payload": "arrow"` is immediately followed by ONE more
+frame holding an Arrow IPC stream (the columnar result — the same
+arrow tables `collect_arrow` returns, so a result crosses the socket
+in its execution layout with no row pivot).
+
+Client -> server message types: `hello` (tenant + priorityClass
+binding, protocol version check), `query` (a serve/spec.py query spec
++ parameter bindings), `cancel`, `ping`, `bye`.
+Server -> client: `hello_ok`, `result`, `error` (stable `code` from
+ERROR_CODES + human `message`), `pong`, `bye_ok`.
+
+Frames are bounded by serve.maxFrameBytes on both sides: an oversized
+header/payload is a clean `protocol` error, never an unbounded
+buffer. The protocol is deliberately dumb — all governance verdicts
+(shed, deadline, quota, drain) travel as error codes mapped from the
+QueryGovernanceError taxonomy (runtime/errors.py), so a thin client
+in any language can speak it with a socket and a JSON parser.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+from typing import Optional, Tuple
+
+import pyarrow as pa
+import pyarrow.ipc as pa_ipc
+
+PROTOCOL_VERSION = 1
+
+_LEN = struct.Struct(">I")
+
+#: Stable wire error codes (docs/serving.md) — the client maps them
+#: back onto the governance exception taxonomy.
+ERROR_CODES = (
+    "rejected",       # admission shed: queue full / queue timeout
+    "draining",       # engine draining: retry against another replica
+    "device_fenced",  # fenced for device-loss recovery: retry later
+    "tenant_quota",   # per-tenant concurrency/byte cap
+    "cancelled",      # cancel() / cancel storm
+    "deadline",       # per-query deadline exceeded
+    "quarantined",    # poison-query quarantine
+    "bad_spec",       # query spec failed to compile
+    "protocol",       # malformed/oversized frame, bad handshake
+    "busy",           # connection limit reached
+    "internal",       # anything else; message carries the type
+)
+
+
+class ProtocolError(RuntimeError):
+    """Framing/handshake violation — the connection is not recoverable
+    past it (the stream offset is unknown), so both sides close."""
+
+
+def send_frame(sock, data: bytes) -> None:
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    import socket as _socket
+
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except _socket.timeout:
+            if buf:
+                # mid-frame stall: keep waiting — giving up here would
+                # desync the stream; a dead peer surfaces as a closed
+                # socket instead
+                continue
+            raise
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_frame(sock, max_bytes: int) -> bytes:
+    n = _LEN.unpack(_recv_exact(sock, _LEN.size))[0]
+    if n > max_bytes:
+        raise ProtocolError(
+            f"frame of {n} bytes exceeds serve.maxFrameBytes "
+            f"({max_bytes})")
+    return _recv_exact(sock, n) if n else b""
+
+
+def send_json(sock, obj: dict) -> None:
+    send_frame(sock, json.dumps(obj).encode("utf-8"))
+
+
+def recv_json(sock, max_bytes: int) -> dict:
+    data = recv_frame(sock, max_bytes)
+    try:
+        obj = json.loads(data.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ProtocolError(f"frame is not valid JSON: {e}")
+    if not isinstance(obj, dict) or "type" not in obj:
+        raise ProtocolError("frame is not a {'type': ...} message")
+    return obj
+
+
+def table_to_ipc(table: pa.Table) -> bytes:
+    sink = io.BytesIO()
+    with pa_ipc.new_stream(sink, table.schema) as writer:
+        writer.write_table(table)
+    return sink.getvalue()
+
+
+def ipc_to_table(data: bytes) -> pa.Table:
+    with pa_ipc.open_stream(io.BytesIO(data)) as reader:
+        return reader.read_all()
+
+
+def send_result(sock, header: dict, table: pa.Table) -> int:
+    """`result` header + Arrow payload frame; returns payload bytes
+    (the per-connection egress the tenant ledger bills)."""
+    payload = table_to_ipc(table)
+    header = {**header, "type": "result", "payload": "arrow",
+              "payloadBytes": len(payload)}
+    send_json(sock, header)
+    send_frame(sock, payload)
+    return len(payload)
+
+
+def recv_message(sock, max_bytes: int
+                 ) -> Tuple[dict, Optional[pa.Table]]:
+    """One full message: the JSON header plus its Arrow payload frame
+    when the header announces one."""
+    header = recv_json(sock, max_bytes)
+    table = None
+    if header.get("payload") == "arrow":
+        table = ipc_to_table(recv_frame(sock, max_bytes))
+    return header, table
+
+
+def error_code_for(exc: BaseException) -> str:
+    """Governance taxonomy -> stable wire code."""
+    from spark_rapids_tpu.runtime.errors import (
+        QueryCancelledError,
+        QueryDeadlineExceeded,
+        QueryQuarantinedError,
+        QueryRejectedError,
+    )
+
+    if isinstance(exc, QueryRejectedError):
+        reason = getattr(exc, "reason", "")
+        if reason == "draining":
+            return "draining"
+        if reason == "device fenced":
+            return "device_fenced"
+        if reason == "tenant quota":
+            return "tenant_quota"
+        return "rejected"
+    if isinstance(exc, QueryQuarantinedError):
+        return "quarantined"
+    if isinstance(exc, QueryDeadlineExceeded):
+        return "deadline"
+    if isinstance(exc, QueryCancelledError):
+        return "cancelled"
+    if isinstance(exc, (ProtocolError, ValueError, KeyError, TypeError)):
+        return "bad_spec"
+    return "internal"

@@ -1,0 +1,572 @@
+"""The query service daemon — one warm engine, many tenants.
+
+`QueryServiceDaemon` multiplexes concurrent client connections onto
+ONE resident `TpuSparkSession`: every connection binds a tenant id
+and a named priority class at hello (serve.priorityClasses), and
+every `query` message runs through the full governance stack —
+per-tenant quota gate (serve/tenants.py), structural plan cache
+(serve/plan_cache.py), admission tiers with the connection's
+priority/timeout threaded via `admission.request_overrides`, the
+engine ladder, and transfer-ledger billing — on the handler thread of
+the connection that sent it (a client wanting intra-tenant
+concurrency opens more connections, the thread-per-query model the
+admission queue already governs).
+
+Lifecycle is production-grade:
+
+- `drain()` — stop accepting (listener closed, admission sheds new
+  submissions with reason='draining', /readyz flips 503 via the
+  obs/http readiness probe), let in-flight queries finish under
+  serve.drain.timeoutMs, then cancel stragglers through the admission
+  cancel machinery. Queued queries keep their slots during the drain
+  window — drain is an intake valve, not a kill switch.
+- `stop()` — drain, close every socket, join every handler thread
+  (leak_report() returns all-zero afterwards), stop the owned
+  session.
+- SIGTERM (install_signal_handlers, main thread only) — graceful
+  stop off the signal, the k8s preStop contract.
+
+Liveness vs readiness: the daemon never dies on a device fence — the
+obs HTTP /healthz stays 200 (process alive) while /readyz reports 503
+with `fenced`/`fencedChips`/`draining`, so a load balancer routes
+around a recovering engine instead of restarting it and losing the
+warm compile cache the whole serving layer exists to keep."""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, Optional
+
+from spark_rapids_tpu.serve import protocol
+from spark_rapids_tpu.serve.tenants import TenantLedger
+
+
+def parse_priority_classes(spec: str) -> Dict[str, int]:
+    """'interactive=100,standard=0,batch=-100' -> {name: weight}."""
+    out: Dict[str, int] = {}
+    for part in str(spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"bad serve.priorityClasses entry {part!r}: "
+                f"expected name=weight")
+        name, weight = part.split("=", 1)
+        out[name.strip()] = int(weight)
+    if not out:
+        raise ValueError("serve.priorityClasses is empty")
+    return out
+
+
+_active_daemon = None
+_active_lock = threading.Lock()
+
+
+def active_daemon() -> Optional["QueryServiceDaemon"]:
+    """The most recently started daemon in this process, or None —
+    the hook obs/registry.unified_snapshot uses to fold serve
+    counters into the unified surface."""
+    return _active_daemon
+
+
+class _Connection:
+    """One accepted client: its socket, tenant binding, and stats."""
+
+    __slots__ = ("sock", "addr", "tenant", "priority_class",
+                 "priority", "queries", "bytes_out", "thread")
+
+    def __init__(self, sock, addr):
+        self.sock = sock
+        self.addr = addr
+        self.tenant = ""
+        self.priority_class = ""
+        self.priority = 0
+        self.queries = 0
+        self.bytes_out = 0
+        self.thread: Optional[threading.Thread] = None
+
+
+class QueryServiceDaemon:
+    """TCP front door over one warm TpuSparkSession."""
+
+    def __init__(self, session=None, conf: Optional[dict] = None):
+        from spark_rapids_tpu.config import rapids_conf as rc
+        from spark_rapids_tpu.serve.plan_cache import PlanCache
+
+        if session is None:
+            from spark_rapids_tpu.api.session import TpuSparkSession
+
+            session = TpuSparkSession(conf or {})
+            self._owns_session = True
+        else:
+            self._owns_session = False
+        self.session = session
+        cget = session.rapids_conf.get
+        self.host = cget(rc.SERVE_HOST)
+        self._conf_port = cget(rc.SERVE_PORT)
+        self.max_connections = cget(rc.SERVE_MAX_CONNECTIONS)
+        self.max_frame_bytes = cget(rc.SERVE_MAX_FRAME_BYTES)
+        self.drain_timeout_ms = cget(rc.SERVE_DRAIN_TIMEOUT_MS)
+        self.priority_classes = parse_priority_classes(
+            cget(rc.SERVE_PRIORITY_CLASSES))
+        self.plan_cache = PlanCache(
+            max_entries=cget(rc.SERVE_PLAN_CACHE_MAX_ENTRIES),
+            bindings_per_entry=cget(rc.SERVE_PLAN_CACHE_BINDINGS),
+            enabled=cget(rc.SERVE_PLAN_CACHE_ENABLED))
+        self.tenants = TenantLedger(
+            max_concurrent=cget(rc.SERVE_TENANT_MAX_CONCURRENT),
+            max_device_bytes=cget(rc.SERVE_TENANT_MAX_DEVICE_BYTES))
+        self.port: Optional[int] = None
+        self._listener: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._conns: Dict[int, _Connection] = {}
+        self._conn_seq = 0
+        self._in_flight = 0
+        self._state = "new"  # new | serving | draining | stopped
+        self._admission = None
+        self._prev_sigterm = None
+        self._queries_served = 0
+
+    # ------------------------------------------------------ lifecycle
+
+    def start(self) -> "QueryServiceDaemon":
+        from spark_rapids_tpu.runtime import admission
+
+        if self._state != "new":
+            raise RuntimeError(f"daemon already {self._state}")
+        self._admission = admission.get()
+        self._listener = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind((self.host, int(self._conf_port)))
+        self._listener.listen(128)
+        self._listener.settimeout(0.2)
+        self.port = self._listener.getsockname()[1]
+        self._state = "serving"
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="srtpu-serve-accept",
+            daemon=True)
+        self._accept_thread.start()
+        global _active_daemon
+        with _active_lock:
+            _active_daemon = self
+        return self
+
+    def install_signal_handlers(self) -> bool:
+        """SIGTERM -> graceful stop. Only possible on the main thread
+        (signal module contract); returns whether it installed."""
+        import signal
+
+        if threading.current_thread() is not threading.main_thread():
+            return False
+
+        def on_term(_sig, _frm):
+            threading.Thread(target=self.stop,
+                             name="srtpu-serve-sigterm",
+                             daemon=True).start()
+
+        self._prev_sigterm = signal.signal(signal.SIGTERM, on_term)
+        return True
+
+    def drain(self, timeout_ms: Optional[int] = None) -> dict:
+        """Graceful intake shutdown; returns the drain report."""
+        from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.runtime import cancellation
+
+        with self._lock:
+            if self._state in ("draining", "stopped"):
+                return {"state": self._state, "cancelled": 0}
+            self._state = "draining"
+            in_flight = self._in_flight
+            n_conns = len(self._conns)
+        obs_events.emit("serve.drain", phase="begin",
+                        inFlight=in_flight, connections=n_conns)
+        self._admission.begin_drain("query service draining")
+        self._close_listener()
+        deadline = time.monotonic() + (
+            self.drain_timeout_ms if timeout_ms is None
+            else timeout_ms) / 1000.0
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._in_flight == 0:
+                    break
+            cancellation.sleep_interruptible(0.02)
+        cancelled = 0
+        with self._lock:
+            stragglers = self._in_flight
+        if stragglers:
+            # past the deadline: unwind survivors through the cancel
+            # machinery (bounded stop beats a wedged one), then give
+            # the handler threads a moment to settle their ledgers
+            cancelled = self._admission.cancel_all(
+                "query service drain deadline")
+            settle_by = time.monotonic() + 5.0
+            while time.monotonic() < settle_by:
+                with self._lock:
+                    if self._in_flight == 0:
+                        break
+                cancellation.sleep_interruptible(0.02)
+        with self._lock:
+            left = self._in_flight
+        obs_events.emit("serve.drain", phase="end", inFlight=left,
+                        connections=len(self._conns))
+        return {"state": "draining", "cancelled": cancelled,
+                "inFlight": left}
+
+    def stop(self) -> None:
+        """Drain, tear every connection down leak-free, and stop the
+        owned session. Idempotent."""
+        import signal
+
+        if self._state == "stopped":
+            return
+        if self._state == "serving":
+            self.drain()
+        self._close_listener()
+        with self._lock:
+            conns = list(self._conns.values())
+        for c in conns:
+            try:
+                c.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.sock.close()
+            except OSError:
+                pass
+        for c in conns:
+            if c.thread is not None:
+                c.thread.join(timeout=5.0)
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5.0)
+        if self._prev_sigterm is not None and \
+                threading.current_thread() is threading.main_thread():
+            signal.signal(signal.SIGTERM, self._prev_sigterm)
+            self._prev_sigterm = None
+        self._state = "stopped"
+        if self._owns_session:
+            self.session.stop()
+        if self._admission is not None:
+            # the intake valve belongs to the controller, not to this
+            # daemon — reopen it so an embedder's session (tests, a
+            # restarted daemon) is usable again
+            self._admission.end_drain()
+        global _active_daemon
+        with _active_lock:
+            if _active_daemon is self:
+                _active_daemon = None
+
+    def __enter__(self) -> "QueryServiceDaemon":
+        return self.start() if self._state == "new" else self
+
+    def __exit__(self, *_exc) -> bool:
+        self.stop()
+        return False
+
+    def _close_listener(self) -> None:
+        with self._lock:
+            listener, self._listener = self._listener, None
+        if listener is not None:
+            try:
+                listener.close()
+            except OSError:
+                pass
+
+    # ---------------------------------------------------- diagnostics
+
+    def status(self) -> dict:
+        with self._lock:
+            conns = [{"tenant": c.tenant,
+                      "priorityClass": c.priority_class,
+                      "queries": c.queries,
+                      "bytesOut": c.bytes_out}
+                     for c in self._conns.values()]
+            state = self._state
+            in_flight = self._in_flight
+        return {"state": state,
+                "port": self.port,
+                "connections": conns,
+                "inFlight": in_flight,
+                "queriesServed": self._queries_served,
+                "planCache": self.plan_cache.stats.snapshot(),
+                "tenants": self.tenants.snapshot(),
+                "priorityClasses": dict(self.priority_classes)}
+
+    def leak_report(self) -> dict:
+        """All-zero after stop() — the CI leak gate."""
+        with self._lock:
+            threads = sum(1 for c in self._conns.values()
+                          if c.thread is not None
+                          and c.thread.is_alive())
+            return {"connections": len(self._conns),
+                    "inFlight": self._in_flight,
+                    "handlerThreads": threads,
+                    "listener": int(self._listener is not None)}
+
+    # ---------------------------------------------------- accept path
+
+    def _accept_loop(self) -> None:
+        while True:
+            with self._lock:
+                listener = self._listener
+                serving = self._state == "serving"
+            if listener is None or not serving:
+                return
+            try:
+                sock, addr = listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed under us (drain/stop)
+            self._admit_connection(sock, addr)
+
+    def _admit_connection(self, sock, addr) -> None:
+        with self._lock:
+            if self._state != "serving" or \
+                    len(self._conns) >= self.max_connections:
+                full = len(self._conns) >= self.max_connections
+                code = "busy" if full else "draining"
+                self._refuse(sock, code)
+                return
+            self._conn_seq += 1
+            cid = self._conn_seq
+            conn = _Connection(sock, addr)
+            self._conns[cid] = conn
+        t = threading.Thread(target=self._serve_connection,
+                             args=(cid, conn),
+                             name=f"srtpu-serve-conn-{cid}",
+                             daemon=True)
+        conn.thread = t
+        t.start()
+
+    @staticmethod
+    def _refuse(sock, code: str) -> None:
+        try:
+            protocol.send_json(sock, {
+                "type": "error", "code": code,
+                "message": f"connection refused: {code}"})
+        except OSError:
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------ connection path
+
+    def _serve_connection(self, cid: int, conn: _Connection) -> None:
+        from spark_rapids_tpu.obs import events as obs_events
+
+        sock = conn.sock
+        sock.settimeout(5.0)  # handshake deadline
+        try:
+            if not self._handshake(conn):
+                return
+            obs_events.emit("serve.connect", tenant=conn.tenant,
+                            priorityClass=conn.priority_class,
+                            addr=f"{conn.addr[0]}:{conn.addr[1]}")
+            while True:
+                with self._lock:
+                    if self._state == "stopped":
+                        return
+                try:
+                    msg = protocol.recv_json(sock,
+                                             self.max_frame_bytes)
+                except socket.timeout:
+                    continue
+                except (ConnectionError, OSError):
+                    return  # client went away / stop() closed us
+                except protocol.ProtocolError as e:
+                    self._send_error(conn, None, "protocol", str(e))
+                    return
+                mtype = msg.get("type")
+                if mtype == "query":
+                    self._handle_query(conn, msg)
+                elif mtype == "cancel":
+                    self._handle_cancel(conn, msg)
+                elif mtype == "ping":
+                    self._send(conn, {"type": "pong",
+                                      "id": msg.get("id"),
+                                      "state": self._state})
+                elif mtype == "bye":
+                    self._send(conn, {"type": "bye_ok",
+                                      "id": msg.get("id")})
+                    return
+                else:
+                    self._send_error(conn, msg.get("id"), "protocol",
+                                     f"unknown message type {mtype!r}")
+        finally:
+            with self._lock:
+                self._conns.pop(cid, None)
+            try:
+                sock.close()
+            except OSError:
+                pass
+            if conn.tenant:
+                obs_events.emit("serve.disconnect", tenant=conn.tenant,
+                                queries=conn.queries,
+                                bytesOut=conn.bytes_out)
+
+    def _handshake(self, conn: _Connection) -> bool:
+        try:
+            hello = protocol.recv_json(conn.sock, self.max_frame_bytes)
+        except (ConnectionError, OSError, protocol.ProtocolError):
+            return False
+        if hello.get("type") != "hello":
+            self._send_error(conn, hello.get("id"), "protocol",
+                             "first message must be hello")
+            return False
+        version = int(hello.get("version", 0))
+        if version > protocol.PROTOCOL_VERSION:
+            self._send_error(
+                conn, hello.get("id"), "protocol",
+                f"protocol version {version} not supported (server "
+                f"speaks {protocol.PROTOCOL_VERSION})")
+            return False
+        tenant = str(hello.get("tenant") or "")
+        if not tenant:
+            self._send_error(conn, hello.get("id"), "protocol",
+                             "hello requires a tenant id")
+            return False
+        pclass = str(hello.get("priorityClass") or "standard")
+        if pclass not in self.priority_classes:
+            self._send_error(
+                conn, hello.get("id"), "protocol",
+                f"unknown priority class {pclass!r}; classes: "
+                f"{sorted(self.priority_classes)}")
+            return False
+        conn.tenant = tenant
+        conn.priority_class = pclass
+        conn.priority = self.priority_classes[pclass]
+        conn.sock.settimeout(0.5)  # poll for stop between messages
+        self._send(conn, {"type": "hello_ok", "id": hello.get("id"),
+                          "version": protocol.PROTOCOL_VERSION,
+                          "tenant": tenant, "priorityClass": pclass,
+                          "priority": conn.priority})
+        return True
+
+    # ----------------------------------------------------- query path
+
+    def _handle_query(self, conn: _Connection, msg: dict) -> None:
+        from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.obs import telemetry
+        from spark_rapids_tpu.runtime import admission
+        from spark_rapids_tpu.runtime.errors import QueryRejectedError
+
+        mid = msg.get("id")
+        tenant = conn.tenant
+        try:
+            self.tenants.admit(tenant)
+        except QueryRejectedError as e:
+            self._send_error(conn, mid, "tenant_quota", str(e))
+            return
+        with self._lock:
+            self._in_flight += 1
+        t0 = time.perf_counter()
+        status, info, qid, payload = "error", {"planCache": "none"}, \
+            None, 0
+        rec, rows = None, None
+        try:
+            df, info, release = self.plan_cache.dataframe_for(
+                self.session, tenant, msg.get("spec"),
+                msg.get("params") or {})
+            ok = False
+            try:
+                with admission.request_overrides(
+                        priority=conn.priority,
+                        timeout_ms=msg.get("timeoutMs"),
+                        description=f"serve:{tenant}:"
+                                    f"{conn.priority_class}"):
+                    table = df.collect_arrow()
+                ok = True
+            finally:
+                release(ok)
+            rec = getattr(df, "_last_exec", None)
+            qid = (rec or {}).get("queryId")
+            status = "ok"
+            rows = table.num_rows
+            wall_ms = round((time.perf_counter() - t0) * 1000.0, 3)
+            payload = protocol.send_result(
+                conn.sock,
+                {"id": mid, "queryId": qid, "rows": rows,
+                 "planCache": info["planCache"], "wallMs": wall_ms},
+                table)
+            conn.queries += 1
+            conn.bytes_out += payload
+        except (ConnectionError, OSError):
+            status = "error"  # client vanished mid-result
+        except BaseException as e:
+            code = protocol.error_code_for(e)
+            if code in ("rejected", "draining", "device_fenced",
+                        "tenant_quota"):
+                status = "shed"
+            elif code in ("cancelled", "deadline", "quarantined"):
+                status = "cancelled"
+            else:
+                status = "error"
+            self._send_error(conn, mid, code, str(e),
+                             reason=getattr(e, "reason", None))
+        finally:
+            wall_s = time.perf_counter() - t0
+            hit = str(info.get("planCache", "")).startswith("hit")
+            serve_rec = {
+                "tenant": tenant,
+                "priorityClass": conn.priority_class,
+                "planCache": info.get("planCache"),
+                "planCacheStats": self.plan_cache.stats.snapshot(),
+            }
+            if rec is not None:
+                rec["serve"] = serve_rec
+            if qid:
+                telemetry.ledger.label_query(
+                    qid, tenant=tenant,
+                    priorityClass=conn.priority_class)
+            self.tenants.settle(
+                tenant, qid, status, wall_s=wall_s,
+                telemetry=(rec or {}).get("telemetry"),
+                plan_cache_hit=hit, payload_bytes=payload)
+            with self._lock:
+                self._in_flight -= 1
+                self._queries_served += 1
+            obs_events.emit(
+                "serve.query", tenant=tenant,
+                priorityClass=conn.priority_class,
+                planCache=info.get("planCache"), status=status,
+                rows=rows, wallMs=round(wall_s * 1000.0, 3))
+
+    def _handle_cancel(self, conn: _Connection, msg: dict) -> None:
+        from spark_rapids_tpu.runtime import admission
+
+        qid = msg.get("queryId")
+        if qid is None:
+            n = self._admission.cancel_all(
+                f"cancelled by tenant {conn.tenant}")
+            self._send(conn, {"type": "cancel_ok",
+                              "id": msg.get("id"), "cancelled": n})
+            return
+        ok = admission.get().cancel(
+            int(qid), f"cancelled by tenant {conn.tenant}")
+        self._send(conn, {"type": "cancel_ok", "id": msg.get("id"),
+                          "cancelled": int(ok)})
+
+    # -------------------------------------------------------- sending
+
+    def _send(self, conn: _Connection, obj: dict) -> None:
+        try:
+            protocol.send_json(conn.sock, obj)
+        except OSError:
+            pass
+
+    def _send_error(self, conn: _Connection, mid, code: str,
+                    message: str, reason: Optional[str] = None
+                    ) -> None:
+        obj = {"type": "error", "id": mid, "code": code,
+               "message": message}
+        if reason:
+            obj["reason"] = reason
+        self._send(conn, obj)

@@ -1,0 +1,231 @@
+"""JSON query-spec DSL -> DataFrame compiler.
+
+The engine has no SQL front-end; served queries arrive as a small
+JSON relational algebra instead — close enough to a logical plan that
+compilation is a direct fold onto the DataFrame API, and regular
+enough that the plan cache (serve/plan_cache.py) can canonicalize a
+spec and parameterize its literals out by a plain tree walk.
+
+Relations (`{"op": ...}` nodes):
+  {"op": "parquet", "path": "<path or [paths]>"}
+  {"op": "range", "start": 0, "end": N, "step": 1}
+  {"op": "filter", "input": R, "cond": E}
+  {"op": "select", "input": R, "cols": ["a", {"expr": E, "as": "x"}]}
+  {"op": "agg", "input": R, "groupBy": ["k", ...],
+   "aggs": [{"fn": "sum", "col": "v", "as": "total"}, ...]}
+  {"op": "join", "left": R, "right": R, "on": ["k"], "how": "inner"}
+  {"op": "orderBy", "input": R,
+   "keys": [{"col": "k", "asc": true}, ...]}
+  {"op": "limit", "input": R, "n": 10}
+
+Expressions:
+  {"col": "name"}            column reference
+  {"lit": value}             literal (parameterized out by the cache)
+  {"param": "name"}          named parameter, bound per request
+  {"fn": "<op>", "args": [E, ...]}   operators/functions (FNS below)
+
+Parameters make repeated traffic cacheable BY CONSTRUCTION: a
+dashboard sends the same spec with different `params` bindings and
+the serving layer recognizes the shape. `{"lit": ...}` is still
+normalized to an auto-parameter, so even literal-embedding clients
+hit the cache.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.column import Column
+
+
+class SpecError(ValueError):
+    """A query spec that cannot compile — wire code `bad_spec`."""
+
+
+_BINOPS = {
+    "+": lambda a, b: a + b,
+    "-": lambda a, b: a - b,
+    "*": lambda a, b: a * b,
+    "/": lambda a, b: a / b,
+    "%": lambda a, b: a % b,
+    "=": lambda a, b: a == b,
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "and": lambda a, b: a & b,
+    "or": lambda a, b: a | b,
+}
+
+_UNOPS = {
+    "not": lambda a: ~a,
+    "neg": lambda a: -a,
+    "abs": F.abs,
+    "upper": F.upper,
+    "lower": F.lower,
+    "length": F.length,
+}
+
+_AGG_FNS = {
+    "sum": F.sum, "count": F.count, "avg": F.avg, "mean": F.avg,
+    "min": F.min, "max": F.max,
+}
+
+
+def compile_expr(node, params: Dict[str, object],
+                 lit_factory=None) -> Column:
+    """One expression node -> a Column, with `params` bound.
+
+    `lit_factory(name, value) -> Column` is the plan cache's template
+    hook: parameter references become ParamLiteral placeholders
+    instead of plain literals, so the resolved tree is rebindable."""
+    if not isinstance(node, dict):
+        raise SpecError(f"expression must be an object, got {node!r}")
+    if "col" in node:
+        return F.col(str(node["col"]))
+    if "lit" in node:
+        return F.lit(node["lit"])
+    if "param" in node:
+        name = str(node["param"])
+        if name not in params:
+            raise SpecError(f"unbound parameter {name!r}; bound: "
+                            f"{sorted(params)}")
+        if lit_factory is not None:
+            return lit_factory(name, params[name])
+        return F.lit(params[name])
+    if "fn" in node:
+        fn = str(node["fn"])
+        args = node.get("args", [])
+        if not isinstance(args, list):
+            raise SpecError(f"fn {fn!r} args must be a list")
+        if fn == "isin":
+            if len(args) < 2:
+                raise SpecError("isin needs a column and >=1 value")
+            if lit_factory is not None and \
+                    any("param" in a for a in args[1:]
+                        if isinstance(a, dict)):
+                # isin value lists embed into the expression shape —
+                # a rebindable template can't carry them (plan cache
+                # treats the spec as uncacheable)
+                raise SpecError("isin values cannot be parameters")
+            vals = []
+            for a in args[1:]:
+                if not isinstance(a, dict):
+                    raise SpecError(f"bad isin value: {a!r}")
+                if "lit" in a:
+                    vals.append(a["lit"])
+                elif "param" in a and a["param"] in params:
+                    vals.append(params[a["param"]])
+                else:
+                    raise SpecError(f"bad isin value: {a!r}")
+            return compile_expr(args[0], params,
+                                lit_factory).isin(*vals)
+        cols = [compile_expr(a, params, lit_factory) for a in args]
+        if fn in _BINOPS:
+            if len(cols) != 2:
+                raise SpecError(f"fn {fn!r} takes 2 args, got "
+                                f"{len(cols)}")
+            return _BINOPS[fn](cols[0], cols[1])
+        if fn in _UNOPS:
+            if len(cols) != 1:
+                raise SpecError(f"fn {fn!r} takes 1 arg, got "
+                                f"{len(cols)}")
+            return _UNOPS[fn](cols[0])
+        raise SpecError(f"unknown function {fn!r}")
+    raise SpecError(f"unknown expression node: {sorted(node)}")
+
+
+def _col_or_expr(c, params, lit_factory=None) -> Column:
+    if isinstance(c, str):
+        return F.col(c)
+    return compile_expr(c, params, lit_factory)
+
+
+def compile_spec(spec: dict, session, params: Dict[str, object],
+                 lit_factory=None):
+    """A relation spec -> DataFrame on `session` with params bound."""
+    if not isinstance(spec, dict) or "op" not in spec:
+        raise SpecError("relation must be an object with an 'op'")
+    op = spec["op"]
+
+    def child(key="input"):
+        if key not in spec:
+            raise SpecError(f"op {op!r} requires {key!r}")
+        return compile_spec(spec[key], session, params, lit_factory)
+
+    if op == "parquet":
+        paths = spec.get("path")
+        if isinstance(paths, str):
+            paths = [paths]
+        if not paths:
+            raise SpecError("parquet op requires 'path'")
+        return session.read.parquet(*[str(p) for p in paths])
+    if op == "range":
+        return session.range(int(spec.get("start", 0)),
+                             int(spec["end"]),
+                             int(spec.get("step", 1)))
+    if op == "filter":
+        return child().filter(
+            compile_expr(spec["cond"], params, lit_factory))
+    if op == "select":
+        cols: List[Column] = []
+        for c in spec.get("cols", []):
+            if isinstance(c, str):
+                cols.append(F.col(c))
+            elif isinstance(c, dict) and "expr" in c:
+                e = compile_expr(c["expr"], params, lit_factory)
+                cols.append(e.alias(c["as"]) if "as" in c else e)
+            else:
+                raise SpecError(f"bad select column: {c!r}")
+        if not cols:
+            raise SpecError("select requires 'cols'")
+        return child().select(*cols)
+    if op == "agg":
+        df = child()
+        keys = [_col_or_expr(k, params, lit_factory)
+                for k in spec.get("groupBy", [])]
+        aggs = []
+        for a in spec.get("aggs", []):
+            fn = _AGG_FNS.get(str(a.get("fn")))
+            if fn is None:
+                raise SpecError(f"unknown agg fn {a.get('fn')!r}")
+            arg = a.get("col", "*" if a.get("fn") == "count" else None)
+            if arg is None:
+                raise SpecError(f"agg {a.get('fn')!r} requires 'col'")
+            c = fn(arg if isinstance(arg, str)
+                   else compile_expr(arg, params, lit_factory))
+            aggs.append(c.alias(a["as"]) if "as" in a else c)
+        if not aggs:
+            raise SpecError("agg requires 'aggs'")
+        return df.groupBy(*keys).agg(*aggs)
+    if op == "join":
+        if "left" not in spec or "right" not in spec:
+            raise SpecError("join requires 'left' and 'right'")
+        left = compile_spec(spec["left"], session, params,
+                            lit_factory)
+        right = compile_spec(spec["right"], session, params,
+                             lit_factory)
+        on = spec.get("on")
+        if not on:
+            raise SpecError("join requires 'on' column names")
+        return left.join(right, on=list(on),
+                         how=str(spec.get("how", "inner")))
+    if op == "orderBy":
+        df = child()
+        orders = []
+        for k in spec.get("keys", []):
+            if isinstance(k, str):
+                orders.append(F.col(k).asc())
+                continue
+            c = (F.col(k["col"]) if "col" in k
+                 else compile_expr(k["expr"], params, lit_factory))
+            orders.append(c.asc() if k.get("asc", True) else c.desc())
+        if not orders:
+            raise SpecError("orderBy requires 'keys'")
+        return df.orderBy(*orders)
+    if op == "limit":
+        return child().limit(int(spec["n"]))
+    raise SpecError(f"unknown relation op {op!r}")

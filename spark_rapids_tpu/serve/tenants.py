@@ -1,0 +1,163 @@
+"""Per-tenant quota ledgers and billing.
+
+The transfer ledger (obs/telemetry.py) accounts every byte a query
+moves and the admission controller (runtime/admission.py) governs
+global concurrency; this module rolls both up PER TENANT — the unit a
+multi-tenant service bills and caps. Each tenant accumulates:
+
+- `queries` / `sheds` / `cancelled` / `errors` — outcome counts
+- `bytesMovedTotal` — billed bytes, summed from each query's
+  transfer-ledger summary (so billing reconciles exactly with
+  telemetry.ledger.recent_query_summaries by query id)
+- `deviceSeconds` — wall seconds of admitted execution
+- `payloadBytesOut` — Arrow result bytes sent over the wire
+- `planCacheHits` — served from the structural plan cache
+
+Caps are enforced at `admit()` — BEFORE the query touches the
+admission queue — with QueryRejectedError(reason="tenant quota"), so
+one tenant's burst degrades its own traffic, never the fleet's:
+
+- serve.tenant.maxConcurrentQueries: in-flight queries per tenant
+- serve.tenant.maxDeviceBytes: cumulative billed-byte budget
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+from spark_rapids_tpu.runtime.errors import QueryRejectedError
+
+
+class _Tenant:
+    __slots__ = ("active", "queries", "sheds", "cancelled", "errors",
+                 "bytes_moved", "device_seconds", "payload_out",
+                 "plan_cache_hits", "query_ids")
+
+    def __init__(self):
+        self.active = 0
+        self.queries = 0
+        self.sheds = 0
+        self.cancelled = 0
+        self.errors = 0
+        self.bytes_moved = 0
+        self.device_seconds = 0.0
+        self.payload_out = 0
+        self.plan_cache_hits = 0
+        self.query_ids: deque = deque(maxlen=1024)
+
+
+class TenantLedger:
+    """Quota + billing ledger for one daemon's tenants."""
+
+    def __init__(self, max_concurrent: int = 0,
+                 max_device_bytes: int = 0):
+        self.max_concurrent = max(0, int(max_concurrent))
+        self.max_device_bytes = max(0, int(max_device_bytes))
+        self._lock = threading.Lock()
+        self._tenants: Dict[str, _Tenant] = {}
+
+    def _get(self, tenant: str) -> _Tenant:
+        t = self._tenants.get(tenant)
+        if t is None:
+            t = self._tenants[tenant] = _Tenant()
+        return t
+
+    # --- quota gate ---
+
+    def admit(self, tenant: str) -> None:
+        """Take one in-flight slot for `tenant` or shed with
+        reason='tenant quota'. Call `settle` exactly once after."""
+        from spark_rapids_tpu.obs import events as obs_events
+
+        with self._lock:
+            t = self._get(tenant)
+            if self.max_concurrent and t.active >= self.max_concurrent:
+                t.sheds += 1
+                obs_events.emit("serve.shed", tenant=tenant,
+                                reason="tenant quota")
+                raise QueryRejectedError(
+                    f"tenant {tenant!r} at its concurrent-query cap "
+                    f"({t.active}/{self.max_concurrent}, "
+                    f"serve.tenant.maxConcurrentQueries)",
+                    reason="tenant quota")
+            if self.max_device_bytes and \
+                    t.bytes_moved >= self.max_device_bytes:
+                t.sheds += 1
+                obs_events.emit("serve.shed", tenant=tenant,
+                                reason="tenant quota")
+                raise QueryRejectedError(
+                    f"tenant {tenant!r} exhausted its device-byte "
+                    f"budget ({t.bytes_moved}/{self.max_device_bytes} "
+                    f"bytes billed, serve.tenant.maxDeviceBytes)",
+                    reason="tenant quota")
+            t.active += 1
+
+    def record_shed(self, tenant: str) -> None:
+        """An admission-layer shed (queue full / draining / fence)
+        after `admit` — settle() with status='shed' does this; this
+        helper covers sheds that never reached admit."""
+        with self._lock:
+            self._get(tenant).sheds += 1
+
+    # --- billing ---
+
+    def settle(self, tenant: str, query_id: Optional[int],
+               status: str, wall_s: float = 0.0,
+               telemetry: Optional[dict] = None,
+               plan_cache_hit: bool = False,
+               payload_bytes: int = 0) -> None:
+        """Release the in-flight slot and bill the query.
+        `status`: ok | error | cancelled | shed."""
+        moved = 0
+        if telemetry:
+            moved = int(telemetry.get("bytesMovedTotal", 0) or 0)
+        with self._lock:
+            t = self._get(tenant)
+            t.active = max(0, t.active - 1)
+            if status == "ok":
+                t.queries += 1
+            elif status == "cancelled":
+                t.cancelled += 1
+            elif status == "shed":
+                t.sheds += 1
+            else:
+                t.errors += 1
+            t.bytes_moved += moved
+            t.device_seconds += max(0.0, wall_s)
+            t.payload_out += max(0, int(payload_bytes))
+            if plan_cache_hit:
+                t.plan_cache_hits += 1
+            if query_id:
+                t.query_ids.append(query_id)
+
+    def reset_usage(self, tenant: str) -> None:
+        """Zero a tenant's billed-byte budget consumption (the
+        operator's quota-reset lever; counts stay)."""
+        with self._lock:
+            self._get(tenant).bytes_moved = 0
+
+    # --- views ---
+
+    def query_ids(self, tenant: str) -> list:
+        with self._lock:
+            t = self._tenants.get(tenant)
+            return list(t.query_ids) if t else []
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Numeric per-tenant billing view (daemon.status(), the
+        registry, and /queries)."""
+        with self._lock:
+            return {
+                name: {
+                    "active": t.active,
+                    "queries": t.queries,
+                    "sheds": t.sheds,
+                    "cancelled": t.cancelled,
+                    "errors": t.errors,
+                    "bytesMovedTotal": t.bytes_moved,
+                    "deviceSeconds": round(t.device_seconds, 3),
+                    "payloadBytesOut": t.payload_out,
+                    "planCacheHits": t.plan_cache_hits,
+                } for name, t in sorted(self._tenants.items())}

@@ -608,3 +608,82 @@ def test_admission_events_and_queue_wait_span(tmp_path):
         assert "AdmissionQueue" in names
     finally:
         s.stop()
+
+
+# ---------------------------------------------------------------------------
+# drain ordering (serve/) — the intake valve vs queued work
+# ---------------------------------------------------------------------------
+
+
+def test_begin_drain_sheds_new_submissions_with_reason():
+    ctrl = AdmissionController(max_concurrent=2, queue_depth=4)
+    h = ctrl.submit(9001, description="pre-drain")
+    ctrl.begin_drain("rolling restart")
+    with pytest.raises(QueryRejectedError) as ei:
+        ctrl.submit(9002, description="post-drain")
+    assert ei.value.reason == "draining"
+    assert "rolling restart" in str(ei.value)
+    assert ctrl.status()["draining"] is True
+    # in-flight work is untouched by the valve
+    ctrl.finish(h)
+    assert ctrl.quiescent()
+    ctrl.end_drain()
+    ok = ctrl.submit(9003)
+    assert ok.state == "running"
+    ctrl.finish(ok)
+    assert ctrl.status()["draining"] is False
+
+
+def test_drain_preserves_queued_queries():
+    """Queries already IN the queue when the drain begins keep their
+    slots and deadlines — drain is an intake valve, not a kill
+    switch."""
+    ctrl = AdmissionController(max_concurrent=1, queue_depth=4,
+                               queue_timeout_ms=30_000)
+    hog = ctrl.submit(9101, description="hog")
+    admitted = []
+
+    def queued_runner():
+        h = ctrl.submit(9102, description="queued-before-drain")
+        admitted.append(h)
+        ctrl.finish(h)
+
+    t = threading.Thread(target=queued_runner)
+    t.start()
+    assert _wait_until(lambda: len(ctrl.queued_table()) == 1, 10.0)
+    ctrl.begin_drain()
+    # a NEW submission sheds immediately...
+    with pytest.raises(QueryRejectedError) as ei:
+        ctrl.submit(9103)
+    assert ei.value.reason == "draining"
+    # ...but the queued query still gets its turn when capacity frees
+    ctrl.finish(hog)
+    t.join(30)
+    assert admitted and admitted[0].query_id == 9102
+    assert ctrl.quiescent()
+    ctrl.end_drain()
+
+
+def test_request_overrides_thread_priority_and_timeout(tmp_path):
+    """serve/ threads a connection's priority class + per-request
+    timeout through admission.request_overrides — thread-local, so
+    concurrent connections on one session can't race each other's
+    conf."""
+    data = _mk_parquet(tmp_path, rows=2_000)
+    s = TpuSparkSession({
+        "spark.rapids.tpu.admission.maxConcurrentQueries": 1,
+    })
+    try:
+        with admission.request_overrides(priority=42,
+                                         description="vip"):
+            got = s.read.parquet(data).groupBy("k").agg(
+                F.count("*").alias("n")).collect_arrow()
+        assert got.num_rows == 64
+        rec = s.last_execution["admission"]
+        assert rec["priority"] == 42
+        # the override is scoped: the next query is back on conf
+        s.range(0, 10).count()
+        assert s.last_execution["admission"]["priority"] == 0
+        assert admission.current_overrides() == {}
+    finally:
+        s.stop()

@@ -1,0 +1,279 @@
+"""Structural plan cache suite (serve/plan_cache.py).
+
+The correctness contract under test: literal-only differences share
+one cache entry (normalization parameterizes them out); an exact
+binding repeat reuses the PLANNED physical; a new binding rebinds the
+template and re-plans (literals flow into pushed-down predicates, so
+results must track the new values); any spark.* conf change and any
+tenant change misses instead of serving a stale or cross-tenant plan.
+"""
+
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.api.session import TpuSparkSession
+from spark_rapids_tpu.serve.plan_cache import (
+    PlanCache,
+    binding_key,
+    conf_digest,
+    normalize_spec,
+)
+
+N_ROWS = 300
+
+
+@pytest.fixture(scope="module")
+def table_path(tmp_path_factory):
+    t = pa.table({
+        "a": pa.array(range(N_ROWS), pa.int64()),
+        "b": pa.array([float(i) for i in range(N_ROWS)],
+                      pa.float64()),
+    })
+    path = str(tmp_path_factory.mktemp("plan_cache") / "t.parquet")
+    pq.write_table(t, path)
+    return path
+
+
+@pytest.fixture(scope="module")
+def session():
+    s = TpuSparkSession({})
+    yield s
+    s.stop()
+
+
+def _spec(path):
+    return {"op": "filter",
+            "input": {"op": "parquet", "path": path},
+            "cond": {"fn": ">=", "args": [{"col": "a"},
+                                          {"param": "lo"}]}}
+
+
+def _lit_spec(path, lo):
+    return {"op": "filter",
+            "input": {"op": "parquet", "path": path},
+            "cond": {"fn": ">=", "args": [{"col": "a"},
+                                          {"lit": lo}]}}
+
+
+def _run(cache, session, tenant, spec, params=None):
+    df, info, release = cache.dataframe_for(session, tenant, spec,
+                                            params or {})
+    ok = False
+    try:
+        table = df.collect_arrow()
+        ok = True
+    finally:
+        release(ok)
+    return table, info
+
+
+# ------------------------------------------------------ normalization
+
+
+def test_normalize_spec_parameterizes_literals(table_path):
+    norm, auto = normalize_spec(_lit_spec(table_path, 42))
+    assert auto == {"_p0": 42}
+    assert norm["cond"]["args"][1] == {"param": "_p0"}
+    # two specs differing only in the literal normalize identically
+    norm2, auto2 = normalize_spec(_lit_spec(table_path, 7))
+    assert norm == norm2
+    assert auto2 == {"_p0": 7}
+
+
+def test_normalize_spec_keeps_isin_values_structural():
+    spec = {"fn": "isin", "args": [{"col": "a"}, {"lit": 1},
+                                   {"lit": 2}]}
+    norm, auto = normalize_spec(spec)
+    # isin values are part of the expression SHAPE — never params
+    assert norm["args"][1:] == [{"lit": 1}, {"lit": 2}]
+    assert auto == {}
+
+
+def test_binding_key_distinguishes_type_and_value():
+    assert binding_key({"x": 1}) != binding_key({"x": 2})
+    assert binding_key({"x": 1}) != binding_key({"x": 1.0})
+    assert binding_key({"x": 1}) == binding_key({"x": 1})
+
+
+def test_conf_digest_only_tracks_spark_keys():
+    base = {"spark.rapids.tpu.sql.enabled": True, "noise": 1}
+    assert conf_digest(base) == conf_digest({**base, "noise": 2})
+    assert conf_digest(base) != conf_digest(
+        {**base, "spark.rapids.tpu.sql.enabled": False})
+
+
+# -------------------------------------------------------- hit & miss
+
+
+def test_exact_hit_then_rebind_results_track(session, table_path):
+    cache = PlanCache()
+    t1, i1 = _run(cache, session, "t", _spec(table_path),
+                  {"lo": 250})
+    assert i1["planCache"] == "miss"
+    t2, i2 = _run(cache, session, "t", _spec(table_path),
+                  {"lo": 250})
+    assert i2["planCache"] == "hit-exact"
+    assert t2.equals(t1)
+    assert t2.num_rows == 50
+    # NEW binding: the template rebinds and RE-PLANS — the pushed-down
+    # predicate must carry the new literal, not the cached one
+    t3, i3 = _run(cache, session, "t", _spec(table_path), {"lo": 10})
+    assert i3["planCache"] == "hit-rebind"
+    assert t3.num_rows == N_ROWS - 10
+    assert pc.min(t3["a"]).as_py() == 10
+    snap = cache.stats.snapshot()
+    assert snap["misses"] == 1
+    assert snap["hitsExact"] == 1
+    assert snap["hitsRebind"] == 1
+    assert snap["hitRatio"] == pytest.approx(2 / 3, abs=1e-4)
+
+
+def test_literal_specs_share_the_entry(session, table_path):
+    """Clients that embed literals instead of params still hit: the
+    normalizer parameterizes `{"lit": v}` out."""
+    cache = PlanCache()
+    _run(cache, session, "t", _lit_spec(table_path, 100))
+    t2, i2 = _run(cache, session, "t", _lit_spec(table_path, 200))
+    assert i2["planCache"] == "hit-rebind"
+    assert t2.num_rows == 100
+    assert len(cache) == 1
+
+
+def test_rebound_binding_is_stored_for_exact_reuse(session,
+                                                   table_path):
+    cache = PlanCache()
+    _run(cache, session, "t", _spec(table_path), {"lo": 1})
+    _run(cache, session, "t", _spec(table_path), {"lo": 2})
+    _, info = _run(cache, session, "t", _spec(table_path), {"lo": 2})
+    assert info["planCache"] == "hit-exact"
+
+
+def test_param_type_change_is_a_different_shape(session, table_path):
+    cache = PlanCache()
+    _run(cache, session, "t", _spec(table_path), {"lo": 10})
+    _, info = _run(cache, session, "t", _spec(table_path),
+                   {"lo": 10.0})
+    # int vs float binding: different type signature, different key
+    assert info["planCache"] == "miss"
+    assert len(cache) == 2
+
+
+# ------------------------------------------------------- invalidation
+
+
+def test_conf_change_invalidates(session, table_path):
+    cache = PlanCache()
+    _run(cache, session, "t", _spec(table_path), {"lo": 5})
+    old = dict(session._settings)
+    session._settings["spark.rapids.tpu.sql.testShim"] = "x"
+    try:
+        _, info = _run(cache, session, "t", _spec(table_path),
+                       {"lo": 5})
+        assert info["planCache"] == "miss"
+    finally:
+        session._settings.clear()
+        session._settings.update(old)
+    _, info = _run(cache, session, "t", _spec(table_path), {"lo": 5})
+    assert info["planCache"] == "hit-exact"
+
+
+def test_per_tenant_isolation(session, table_path):
+    """Tenant A's entries never serve tenant B — the tenant id is part
+    of the structural key."""
+    cache = PlanCache()
+    _run(cache, session, "tenant-a", _spec(table_path), {"lo": 5})
+    _, info = _run(cache, session, "tenant-b", _spec(table_path),
+                   {"lo": 5})
+    assert info["planCache"] == "miss"
+    assert len(cache) == 2
+    _, info = _run(cache, session, "tenant-b", _spec(table_path),
+                   {"lo": 5})
+    assert info["planCache"] == "hit-exact"
+
+
+# ------------------------------------------- bounds & degraded modes
+
+
+def test_entry_lru_eviction(session, table_path):
+    cache = PlanCache(max_entries=1)
+    _run(cache, session, "t", _spec(table_path), {"lo": 1})
+    _run(cache, session, "u", _spec(table_path), {"lo": 1})
+    assert len(cache) == 1
+    assert cache.stats.snapshot()["evictions"] == 1
+    # the evicted tenant misses again
+    _, info = _run(cache, session, "t", _spec(table_path), {"lo": 1})
+    assert info["planCache"] == "miss"
+
+
+def test_binding_lru_bound(session, table_path):
+    cache = PlanCache(bindings_per_entry=2)
+    for lo in (1, 2, 3):
+        _run(cache, session, "t", _spec(table_path), {"lo": lo})
+    # lo=1 was evicted from the binding LRU: exact repeat re-plans
+    _, info = _run(cache, session, "t", _spec(table_path), {"lo": 1})
+    assert info["planCache"] == "hit-rebind"
+    _, info = _run(cache, session, "t", _spec(table_path), {"lo": 3})
+    assert info["planCache"] == "hit-exact"
+
+
+def test_disabled_cache_bypasses(session, table_path):
+    cache = PlanCache(enabled=False)
+    t, info = _run(cache, session, "t", _spec(table_path), {"lo": 5})
+    assert info["planCache"] == "bypass"
+    assert t.num_rows == N_ROWS - 5
+    assert len(cache) == 0
+
+
+def test_param_in_isin_is_uncacheable_but_correct(session,
+                                                  table_path):
+    """A parameter inside an isin VALUE list can't live in a template
+    (the values embed into the expression shape) — the cache degrades
+    to direct compilation and caches nothing."""
+    cache = PlanCache()
+    spec = {"op": "filter",
+            "input": {"op": "parquet", "path": table_path},
+            "cond": {"fn": "isin",
+                     "args": [{"col": "a"}, {"param": "v1"},
+                              {"lit": 7}]}}
+    t, info = _run(cache, session, "t", spec, {"v1": 3})
+    assert info["planCache"] == "miss"
+    assert sorted(t["a"].to_pylist()) == [3, 7]
+    assert len(cache) == 0
+    # and it stays correct (and uncached) on the next binding
+    t2, _ = _run(cache, session, "t", spec, {"v1": 9})
+    assert sorted(t2["a"].to_pylist()) == [7, 9]
+    assert len(cache) == 0
+
+
+def test_failed_execution_drops_its_binding(session, table_path):
+    cache = PlanCache()
+    _run(cache, session, "t", _spec(table_path), {"lo": 4})
+    df, info, release = cache.dataframe_for(
+        session, "t", _spec(table_path), {"lo": 4})
+    assert info["planCache"] == "hit-exact"
+    release(False)  # simulated failed execution: poison the binding
+    _, info = _run(cache, session, "t", _spec(table_path), {"lo": 4})
+    # the poisoned physical was dropped — re-planned, not served again
+    assert info["planCache"] == "hit-rebind"
+
+
+def test_concurrent_same_binding_does_not_share_physical(
+        session, table_path):
+    """While a binding is checked OUT, a second identical request
+    re-plans from the template instead of sharing the physical tree
+    mid-execution."""
+    cache = PlanCache()
+    _run(cache, session, "t", _spec(table_path), {"lo": 4})
+    df1, i1, rel1 = cache.dataframe_for(session, "t",
+                                        _spec(table_path), {"lo": 4})
+    assert i1["planCache"] == "hit-exact"
+    df2, i2, rel2 = cache.dataframe_for(session, "t",
+                                        _spec(table_path), {"lo": 4})
+    assert i2["planCache"] == "hit-rebind"
+    t1 = df1.collect_arrow()
+    t2 = df2.collect_arrow()
+    rel1(True)
+    rel2(True)
+    assert t1.equals(t2)

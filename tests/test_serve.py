@@ -1,0 +1,421 @@
+"""Query service daemon suite (serve/) — the multi-tenant serving
+layer end to end over real sockets.
+
+The acceptance contract under test: a daemon multiplexes >=3
+concurrent tenants with distinct priority classes onto ONE warm
+session with oracle-identical results; the structural plan cache
+serves repeats without re-planning; per-tenant billing reconciles
+exactly with the transfer ledger; drain rejects NEW work with
+reason='draining' while /readyz flips 503; and stop() leaves zero
+leaked connections, threads, permits or sockets.
+"""
+
+import json
+import os
+import socket
+import threading
+import urllib.request
+
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.api.session import TpuSparkSession
+from spark_rapids_tpu.runtime import admission
+from spark_rapids_tpu.runtime.errors import QueryRejectedError
+from spark_rapids_tpu.serve import protocol
+from spark_rapids_tpu.serve.client import ServeClient, ServeError
+from spark_rapids_tpu.serve.server import (
+    QueryServiceDaemon,
+    parse_priority_classes,
+)
+from spark_rapids_tpu.serve.tenants import TenantLedger
+
+N_ROWS = 400
+
+
+@pytest.fixture(scope="module")
+def table_path(tmp_path_factory):
+    t = pa.table({
+        "a": pa.array(range(N_ROWS), pa.int64()),
+        "b": pa.array([float(i) * 0.5 for i in range(N_ROWS)],
+                      pa.float64()),
+        "k": pa.array([i % 7 for i in range(N_ROWS)], pa.int64()),
+    })
+    path = str(tmp_path_factory.mktemp("serve") / "t.parquet")
+    pq.write_table(t, path)
+    return path
+
+
+@pytest.fixture(scope="module")
+def serve_session():
+    s = TpuSparkSession({})
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def daemon(serve_session, table_path):
+    # daemons are cheap (a thread + a socket); the warm session is the
+    # expensive part and stop() contractually leaves a borrowed session
+    # usable, so every test gets a fresh daemon over one shared session
+    d = QueryServiceDaemon(session=serve_session).start()
+    try:
+        yield d
+    finally:
+        d.stop()
+
+
+def _filter_spec(path, key="lo"):
+    return {"op": "filter",
+            "input": {"op": "parquet", "path": path},
+            "cond": {"fn": ">", "args": [{"col": "a"},
+                                         {"param": key}]}}
+
+
+def _oracle_filter(path, lo):
+    t = pq.read_table(path)
+    return t.filter(pc.greater(t["a"], lo))
+
+
+# ---------------------------------------------------------- protocol
+
+
+def test_protocol_frame_roundtrip():
+    a, b = socket.socketpair()
+    try:
+        protocol.send_json(a, {"type": "ping", "id": 7})
+        assert protocol.recv_json(b, 1 << 20) == {"type": "ping",
+                                                  "id": 7}
+        t = pa.table({"x": pa.array([1, 2, 3], pa.int64())})
+        protocol.send_result(a, {"id": 1, "queryId": 42}, t)
+        header, got = protocol.recv_message(b, 1 << 20)
+        assert header["type"] == "result"
+        assert header["queryId"] == 42
+        assert header["payloadBytes"] > 0
+        assert got.equals(t)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_protocol_oversized_frame_is_clean_error():
+    a, b = socket.socketpair()
+    try:
+        protocol.send_frame(a, b"x" * 1024)
+        with pytest.raises(protocol.ProtocolError) as ei:
+            protocol.recv_frame(b, 100)
+        assert "maxFrameBytes" in str(ei.value)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_parse_priority_classes():
+    assert parse_priority_classes("interactive=100,standard=0,"
+                                  "batch=-100") == {
+        "interactive": 100, "standard": 0, "batch": -100}
+    with pytest.raises(ValueError):
+        parse_priority_classes("nope")
+    with pytest.raises(ValueError):
+        parse_priority_classes("")
+
+
+# ------------------------------------------------- multi-tenant serve
+
+
+def test_three_tenants_concurrent_oracle_identical(daemon, table_path):
+    """>=3 tenants with DISTINCT priority classes through one daemon,
+    interleaved; every result must equal the pyarrow oracle."""
+    classes = [("acme", "interactive"), ("globex", "standard"),
+               ("initech", "batch")]
+    errors, results = [], {}
+
+    def run(tenant, pclass, los):
+        try:
+            with ServeClient.connect(daemon, tenant, pclass) as c:
+                assert c.priority == \
+                    daemon.priority_classes[pclass]
+                for lo in los:
+                    got = c.query(_filter_spec(table_path),
+                                  params={"lo": lo})
+                    results[(tenant, lo)] = got
+        except Exception as e:  # surfaced below with context
+            errors.append((tenant, e))
+
+    threads = [threading.Thread(target=run,
+                                args=(t, p, [50 + 10 * i, 300]))
+               for i, (t, p) in enumerate(classes)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    for (tenant, lo), got in results.items():
+        want = _oracle_filter(table_path, lo)
+        assert got.sort_by("a").equals(want.sort_by("a")), \
+            (tenant, lo)
+    snap = daemon.tenants.snapshot()
+    assert set(snap) == {"acme", "globex", "initech"}
+    for t in snap.values():
+        assert t["queries"] == 2
+        assert t["active"] == 0
+        assert t["payloadBytesOut"] > 0
+
+
+def test_plan_cache_hits_over_the_wire(daemon, table_path):
+    """Identical binding repeats serve the CACHED physical plan;
+    new bindings rebind the template; last_execution['serve'] carries
+    the verdict + hit-ratio counters."""
+    with ServeClient.connect(daemon, "acme", "standard") as c:
+        c.query(_filter_spec(table_path), params={"lo": 100})
+        assert c.last_result["planCache"] == "miss"
+        c.query(_filter_spec(table_path), params={"lo": 100})
+        assert c.last_result["planCache"] == "hit-exact"
+        got = c.query(_filter_spec(table_path), params={"lo": 7})
+        assert c.last_result["planCache"] == "hit-rebind"
+        # a rebind re-plans with the NEW literal — results must track
+        assert got.num_rows == N_ROWS - 8
+    serve_rec = daemon.session.last_execution["serve"]
+    assert serve_rec["tenant"] == "acme"
+    assert serve_rec["planCache"] == "hit-rebind"
+    stats = serve_rec["planCacheStats"]
+    assert stats["hitsExact"] >= 1
+    assert stats["hitsRebind"] >= 1
+    assert 0.0 < stats["hitRatio"] <= 1.0
+
+
+def test_billing_reconciles_with_transfer_ledger(daemon, table_path):
+    """Tenant bytesMovedTotal == the sum of the transfer-ledger
+    summaries of exactly that tenant's query ids, and those summaries
+    carry the tenant label."""
+    from spark_rapids_tpu.obs import telemetry
+
+    with ServeClient.connect(daemon, "billing-t", "standard") as c:
+        for lo in (10, 20, 30):
+            c.query(_filter_spec(table_path), params={"lo": lo})
+    qids = daemon.tenants.query_ids("billing-t")
+    assert len(qids) == 3
+    summaries = telemetry.ledger.recent_query_summaries()
+    moved = 0
+    for qid in qids:
+        s = summaries[qid]
+        assert s["labels"]["tenant"] == "billing-t"
+        moved += int(s.get("bytesMovedTotal", 0) or 0)
+    snap = daemon.tenants.snapshot()["billing-t"]
+    assert snap["bytesMovedTotal"] == moved
+    assert snap["deviceSeconds"] > 0
+
+
+def test_registry_unified_snapshot_has_serve_block(daemon,
+                                                  table_path):
+    from spark_rapids_tpu.obs import registry
+
+    with ServeClient.connect(daemon, "acme", "standard") as c:
+        c.query(_filter_spec(table_path), params={"lo": 1})
+    snap = registry.unified_snapshot(daemon.session)
+    assert snap["serve"]["queriesServed"] >= 1
+    flat = registry.flatten(snap)
+    assert flat["serve.queriesServed"] >= 1
+    assert "serve.planCache.hitRatio" in flat
+
+
+def test_bad_spec_is_clean_error(daemon):
+    with ServeClient.connect(daemon, "acme", "standard") as c:
+        with pytest.raises(ServeError) as ei:
+            c.query({"op": "no-such-op"})
+        assert ei.value.code == "bad_spec"
+        # the connection survives a bad spec
+        assert c.ping()["type"] == "pong"
+
+
+def test_unknown_priority_class_refused(daemon):
+    with pytest.raises(ServeError) as ei:
+        ServeClient.connect(daemon, "acme", "platinum")
+    assert ei.value.code == "protocol"
+
+
+def test_cancel_unknown_id_returns_zero(daemon):
+    with ServeClient.connect(daemon, "acme", "standard") as c:
+        assert c.cancel(999_999_999) == 0
+
+
+# ------------------------------------------------------ tenant quotas
+
+
+def test_tenant_concurrency_cap_sheds():
+    led = TenantLedger(max_concurrent=2)
+    led.admit("t")
+    led.admit("t")
+    with pytest.raises(QueryRejectedError) as ei:
+        led.admit("t")
+    assert ei.value.reason == "tenant quota"
+    # another tenant is untouched by t's burst
+    led.admit("other")
+    led.settle("t", 1, "ok")
+    led.admit("t")  # slot released -> admitted again
+    snap = led.snapshot()
+    assert snap["t"]["sheds"] == 1
+
+
+def test_tenant_byte_budget_sheds(table_path):
+    s = TpuSparkSession({
+        "spark.rapids.tpu.serve.tenant.maxDeviceBytes": 1})
+    d = QueryServiceDaemon(session=s).start()
+    try:
+        with ServeClient.connect(d, "meter-t", "standard") as c:
+            c.query(_filter_spec(table_path), params={"lo": 1})
+            with pytest.raises(QueryRejectedError) as ei:
+                c.query(_filter_spec(table_path), params={"lo": 2})
+            assert ei.value.reason == "tenant quota"
+        snap = d.tenants.snapshot()["meter-t"]
+        assert snap["queries"] == 1
+        assert snap["sheds"] == 1
+        assert snap["bytesMovedTotal"] > 1
+        # the operator lever: zero the budget, traffic resumes
+        d.tenants.reset_usage("meter-t")
+        with ServeClient.connect(d, "meter-t", "standard") as c:
+            c.query(_filter_spec(table_path), params={"lo": 3})
+    finally:
+        d.stop()
+        s.stop()
+
+
+# ------------------------------------------------- drain & readiness
+
+
+def test_drain_rejects_new_work_and_stop_restores(daemon,
+                                                  table_path):
+    from spark_rapids_tpu.obs.http import ObsHttpServer
+
+    http = ObsHttpServer(daemon.session, port=0)
+    try:
+        url = f"http://127.0.0.1:{http.port}/readyz"
+        with urllib.request.urlopen(url) as r:
+            assert r.status == 200
+            assert json.loads(r.read())["ready"] is True
+
+        with ServeClient.connect(daemon, "acme", "standard") as c:
+            c.query(_filter_spec(table_path), params={"lo": 1})
+            report = daemon.drain()
+            assert report["cancelled"] == 0  # nothing in flight
+            # the EXISTING connection's new submission sheds cleanly
+            with pytest.raises(QueryRejectedError) as ei:
+                c.query(_filter_spec(table_path), params={"lo": 2})
+            assert ei.value.reason == "draining"
+            # liveness stays 200, readiness flips 503 + draining flag
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{http.port}/healthz") as r:
+                assert r.status == 200
+            try:
+                urllib.request.urlopen(url)
+                assert False, "expected 503"
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                body = json.loads(e.read())
+                assert body["draining"] is True
+                assert body["ready"] is False
+        # NEW connections are refused at the TCP level while draining
+        # (the listener is closed — the LB-visible signal)
+        with pytest.raises(OSError):
+            ServeClient.connect(daemon, "late", "standard")
+        daemon.stop()
+        # stop() reopens the intake valve: the borrowed session is
+        # usable again and readiness recovers
+        assert admission.get().draining is False
+        assert daemon.session.range(0, 10).count() == 10
+        with urllib.request.urlopen(url) as r:
+            assert r.status == 200
+    finally:
+        http.close()
+
+
+def test_readiness_503_while_fenced(daemon):
+    from spark_rapids_tpu.obs.http import ObsHttpServer
+    from spark_rapids_tpu.runtime import device_monitor
+
+    http = ObsHttpServer(daemon.session, port=0)
+    mon = device_monitor.get()
+    try:
+        mon._fenced = True
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{http.port}/readyz")
+            assert False, "expected 503"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert json.loads(e.read())["fenced"] is True
+    finally:
+        mon._fenced = False
+        http.close()
+
+
+def test_stop_leaves_zero_leaks(table_path):
+    s = TpuSparkSession({})
+    d = QueryServiceDaemon(session=s).start()
+    try:
+        clients = [ServeClient.connect(d, f"t{i}", "standard")
+                   for i in range(3)]
+        for i, c in enumerate(clients):
+            c.query(_filter_spec(table_path), params={"lo": i})
+        port = d.port
+        d.stop()
+        assert d.leak_report() == {"connections": 0, "inFlight": 0,
+                                   "handlerThreads": 0,
+                                   "listener": 0}
+        assert not [t for t in threading.enumerate()
+                    if t.name.startswith("srtpu-serve")]
+        # the port is actually released
+        probe = socket.socket()
+        probe.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        probe.bind(("127.0.0.1", port))
+        probe.close()
+        for c in clients:
+            c.close()
+    finally:
+        d.stop()
+        s.stop()
+
+
+def test_session_serve_convenience(table_path):
+    s = TpuSparkSession({})
+    try:
+        d = s.serve()
+        try:
+            assert d.session is s
+            with ServeClient.connect(d, "conv", "batch") as c:
+                got = c.query(_filter_spec(table_path),
+                              params={"lo": 390})
+                assert got.num_rows == N_ROWS - 391
+        finally:
+            d.stop()
+    finally:
+        s.stop()
+
+
+def test_daemon_owned_session_fresh_process_shape(table_path):
+    """The ISSUE acceptance shape: a daemon with its OWN session (the
+    fresh-process deployment), serving immediately."""
+    d = QueryServiceDaemon().start()
+    try:
+        with ServeClient.connect(d, "fresh", "interactive") as c:
+            got = c.query({"op": "agg",
+                           "input": {"op": "parquet",
+                                     "path": table_path},
+                           "groupBy": ["k"],
+                           "aggs": [{"fn": "sum", "col": "a",
+                                     "as": "s"}]})
+            want = pq.read_table(table_path) \
+                .group_by("k").aggregate([("a", "sum")]) \
+                .rename_columns(["k", "s"])
+            assert got.sort_by("k").equals(want.sort_by("k"))
+    finally:
+        d.stop()
+    assert d.leak_report()["connections"] == 0
+
+
+def test_serve_env_smoke():
+    # tests must run on the virtual CPU mesh, same as the rest of CI
+    assert os.environ.get("XLA_FLAGS", "").find(
+        "host_platform_device_count") >= 0
