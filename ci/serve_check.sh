@@ -208,8 +208,14 @@ threads += [threading.Thread(target=worker, args=(t, p, 4, 100 + i))
 for t in threads:
     t.start()
 
-# cancel storm against the live running table, over the wire
+# cancel storm against the live running table. Wire cancels are
+# TENANT-SCOPED — the admin tenant owns none of these queries, so
+# every wire cancel must count 0; the storm itself goes through the
+# in-process operator surface (admission.cancel).
+from spark_rapids_tpu.runtime import admission as adm
+
 prng = random.Random(4321)
+cross_tenant_cancels = [0]
 with ServeClient.connect(d, "admin", "interactive") as admin:
     deadline = time.monotonic() + 90
     while any(t.is_alive() for t in threads) and \
@@ -217,7 +223,9 @@ with ServeClient.connect(d, "admin", "interactive") as admin:
         time.sleep(prng.uniform(0.05, 0.2))
         running = s.admission_status()["running"]
         if running and prng.random() < 0.4:
-            admin.cancel(prng.choice(running)["queryId"])
+            qid = prng.choice(running)["queryId"]
+            cross_tenant_cancels[0] += admin.cancel(qid)
+            adm.get().cancel(qid, "operator cancel storm")
 for t in threads:
     t.join(240)
 assert not any(t.is_alive() for t in threads), "serve worker hung"
@@ -227,6 +235,9 @@ probe.join(10)
 assert not errors, f"unexpected client errors: {errors}"
 assert not mismatches, f"serve/embedded result mismatch: {mismatches}"
 assert completed[0] > 0, "storm cancelled literally everything"
+assert cross_tenant_cancels[0] == 0, \
+    f"wire cancel crossed a tenant boundary " \
+    f"({cross_tenant_cancels[0]} cancels counted)"
 assert live_failures[0] == 0, \
     f"liveness failed {live_failures[0]}x — the service went DOWN"
 assert not_ready_seen[0] >= 1, \

@@ -489,6 +489,17 @@ class AdmissionController:
                 list(self._queued.values())
         return sum(1 for h in handles if h.token.cancel(reason))
 
+    def cancel_where(self, predicate, reason: str = "cancelled by user"
+                     ) -> int:
+        """Cancel the running/queued queries whose handle satisfies
+        `predicate` — the tenant-scoped cancel surface of the serving
+        layer (serve handles carry a `serve:<tenant>:<class>`
+        description, so a tenant can only ever unwind its own work)."""
+        with self._cv:
+            handles = [h for h in list(self._running.values())
+                       + list(self._queued.values()) if predicate(h)]
+        return sum(1 for h in handles if h.token.cancel(reason))
+
     def cancel_running(self, reason: str, error_cls=None) -> int:
         """Cancel only the RUNNING queries (the device-loss fence:
         queued queries never touched the dead device — they keep their

@@ -113,8 +113,11 @@ class ServeClient:
         return table
 
     def cancel(self, query_id: Optional[int] = None) -> int:
-        """Cancel one engine query id, or EVERYTHING in flight when
-        None (the cancel-storm lever)."""
+        """Cancel one engine query id, or everything in flight when
+        None — TENANT-SCOPED either way: the server only unwinds
+        queries this connection's own tenant submitted (another
+        tenant's id counts 0). Cross-tenant cancel is an in-process
+        operator action (admission.get().cancel/cancel_all)."""
         req = {"type": "cancel", "id": next(self._ids)}
         if query_id is not None:
             req["queryId"] = int(query_id)

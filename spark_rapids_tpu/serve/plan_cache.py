@@ -47,6 +47,13 @@ from typing import Dict, Optional, Tuple
 
 from spark_rapids_tpu.api.dataframe import DataFrame
 from spark_rapids_tpu.expr.core import Literal
+from spark_rapids_tpu.serve.spec import SpecError
+
+#: Auto-extracted literals bind under this RESERVED name prefix.
+#: Client-supplied params (and `{"param": ...}` references in specs)
+#: may not use it — otherwise a request param could silently shadow a
+#: spec literal's value and diverge from the cache-disabled path.
+AUTO_PARAM_PREFIX = "__lit"
 
 
 class ParamLiteral(Literal):
@@ -93,11 +100,14 @@ class _CapturingDataFrame(DataFrame):
 
 def normalize_spec(spec) -> Tuple[dict, Dict[str, object]]:
     """Parameterize literals out: every `{"lit": v}` becomes
-    `{"param": "_pN"}` (N in deterministic walk order), returning the
-    normalized spec and the extracted auto-bindings. `isin` value
-    lists stay verbatim — their arity and values are part of the
-    expression SHAPE (a different list is a different plan), so they
-    key structurally instead of parameterizing."""
+    `{"param": "__litN"}` (N in deterministic walk order, under the
+    reserved AUTO_PARAM_PREFIX), returning the normalized spec and
+    the extracted auto-bindings. A spec referencing the reserved
+    prefix itself is rejected (it would collide with an extracted
+    literal). `isin` value lists stay verbatim — their arity and
+    values are part of the expression SHAPE (a different list is a
+    different plan), so they key structurally instead of
+    parameterizing."""
     auto: Dict[str, object] = {}
 
     def walk(node):
@@ -108,9 +118,15 @@ def normalize_spec(spec) -> Tuple[dict, Dict[str, object]]:
                         "args": [walk(node["args"][0])]
                         + list(node["args"][1:])}
             if set(node) == {"lit"} or (set(node) == {"lit", "type"}):
-                name = f"_p{len(auto)}"
+                name = f"{AUTO_PARAM_PREFIX}{len(auto)}"
                 auto[name] = node["lit"]
                 return {"param": name}
+            if "param" in node and \
+                    str(node["param"]).startswith(AUTO_PARAM_PREFIX):
+                raise SpecError(
+                    f"parameter name {node['param']!r} uses the "
+                    f"reserved {AUTO_PARAM_PREFIX!r} prefix (held for "
+                    f"auto-extracted literals)")
             return {k: walk(v) for k, v in node.items()}
         if isinstance(node, list):
             return [walk(v) for v in node]
@@ -224,6 +240,13 @@ class PlanCache:
         from spark_rapids_tpu.plan import logical as L
         from spark_rapids_tpu.serve.spec import compile_spec
 
+        bad = sorted(k for k in (params or {})
+                     if str(k).startswith(AUTO_PARAM_PREFIX))
+        if bad:
+            raise SpecError(
+                f"parameter names {bad} use the reserved "
+                f"{AUTO_PARAM_PREFIX!r} prefix (held for "
+                f"auto-extracted literals); rename them")
         norm_spec, auto = normalize_spec(spec)
         bound = {**auto, **(params or {})}
         if not self.enabled:
@@ -274,8 +297,6 @@ class PlanCache:
         # unknown shape: full build, and ALSO keep the ParamLiteral
         # template so the next binding skips the front-end
         self.stats.add("misses")
-        from spark_rapids_tpu.serve.spec import SpecError
-
         try:
             template = self._build_template(session, norm_spec, bound)
         except SpecError:

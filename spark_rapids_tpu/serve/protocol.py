@@ -148,6 +148,7 @@ def error_code_for(exc: BaseException) -> str:
         QueryQuarantinedError,
         QueryRejectedError,
     )
+    from spark_rapids_tpu.serve.spec import SpecError
 
     if isinstance(exc, QueryRejectedError):
         reason = getattr(exc, "reason", "")
@@ -164,6 +165,13 @@ def error_code_for(exc: BaseException) -> str:
         return "deadline"
     if isinstance(exc, QueryCancelledError):
         return "cancelled"
-    if isinstance(exc, (ProtocolError, ValueError, KeyError, TypeError)):
+    if isinstance(exc, SpecError):
+        # only the compiler's own taxonomy is a spec error —
+        # compile_spec wraps its compile-time ValueError/KeyError/
+        # TypeError in SpecError, so engine internals raising the
+        # same builtins MID-EXECUTION fall through to 'internal'
+        # instead of being misreported to clients as bad specs
         return "bad_spec"
+    if isinstance(exc, ProtocolError):
+        return "protocol"
     return "internal"

@@ -76,7 +76,7 @@ class _Connection:
     """One accepted client: its socket, tenant binding, and stats."""
 
     __slots__ = ("sock", "addr", "tenant", "priority_class",
-                 "priority", "queries", "bytes_out", "thread")
+                 "priority", "queries", "bytes_out", "thread", "dead")
 
     def __init__(self, sock, addr):
         self.sock = sock
@@ -87,6 +87,10 @@ class _Connection:
         self.queries = 0
         self.bytes_out = 0
         self.thread: Optional[threading.Thread] = None
+        # a failed send leaves a possibly-partial frame on the wire;
+        # the length-prefixed stream is unrecoverable past it, so the
+        # message loop closes the connection instead of continuing
+        self.dead = False
 
 
 class QueryServiceDaemon:
@@ -179,7 +183,9 @@ class QueryServiceDaemon:
         from spark_rapids_tpu.runtime import cancellation
 
         with self._lock:
-            if self._state in ("draining", "stopped"):
+            if self._state != "serving":
+                # never started ("new") or already draining/stopped:
+                # there is no intake to close and no admission valve
                 return {"state": self._state, "cancelled": 0}
             self._state = "draining"
             in_flight = self._in_flight
@@ -371,6 +377,8 @@ class QueryServiceDaemon:
                             priorityClass=conn.priority_class,
                             addr=f"{conn.addr[0]}:{conn.addr[1]}")
             while True:
+                if conn.dead:
+                    return
                 with self._lock:
                     if self._state == "stopped":
                         return
@@ -433,6 +441,13 @@ class QueryServiceDaemon:
             self._send_error(conn, hello.get("id"), "protocol",
                              "hello requires a tenant id")
             return False
+        if ":" in tenant:
+            # ':' delimits the serve:<tenant>:<class> admission
+            # description the tenant-scoped cancel matches on — a
+            # tenant id containing it could forge another's prefix
+            self._send_error(conn, hello.get("id"), "protocol",
+                             "tenant id must not contain ':'")
+            return False
         pclass = str(hello.get("priorityClass") or "standard")
         if pclass not in self.priority_classes:
             self._send_error(
@@ -491,15 +506,26 @@ class QueryServiceDaemon:
             status = "ok"
             rows = table.num_rows
             wall_ms = round((time.perf_counter() - t0) * 1000.0, 3)
-            payload = protocol.send_result(
-                conn.sock,
-                {"id": mid, "queryId": qid, "rows": rows,
-                 "planCache": info["planCache"], "wallMs": wall_ms},
-                table)
-            conn.queries += 1
-            conn.bytes_out += payload
-        except (ConnectionError, OSError):
-            status = "error"  # client vanished mid-result
+            try:
+                # lift the idle poll timeout for the send — sendall
+                # treats it as a TOTAL deadline, and a large result to
+                # a slow client would abort after a PARTIAL frame
+                conn.sock.settimeout(None)
+                payload = protocol.send_result(
+                    conn.sock,
+                    {"id": mid, "queryId": qid, "rows": rows,
+                     "planCache": info["planCache"],
+                     "wallMs": wall_ms},
+                    table)
+            except OSError:
+                # client vanished / stalled mid-result; a partial
+                # frame desyncs the stream, so the connection closes
+                conn.dead = True
+                status = "error"
+            else:
+                conn.sock.settimeout(0.5)
+                conn.queries += 1
+                conn.bytes_out += payload
         except BaseException as e:
             code = protocol.error_code_for(e)
             if code in ("rejected", "draining", "device_fenced",
@@ -540,27 +566,44 @@ class QueryServiceDaemon:
                 rows=rows, wallMs=round(wall_s * 1000.0, 3))
 
     def _handle_cancel(self, conn: _Connection, msg: dict) -> None:
-        from spark_rapids_tpu.runtime import admission
-
+        # cancel is TENANT-SCOPED: a connection can only unwind
+        # queries its own tenant submitted — handles carry the
+        # serve:<tenant>:<class> description (':' is banned in tenant
+        # ids, so the prefix is unforgeable), and both the by-id and
+        # the bare cancel-all form filter on it. Cross-tenant cancel
+        # is an operator action: admission.get().cancel/cancel_all
+        # in-process, never the wire.
         qid = msg.get("queryId")
-        if qid is None:
-            n = self._admission.cancel_all(
-                f"cancelled by tenant {conn.tenant}")
-            self._send(conn, {"type": "cancel_ok",
-                              "id": msg.get("id"), "cancelled": n})
-            return
-        ok = admission.get().cancel(
-            int(qid), f"cancelled by tenant {conn.tenant}")
+        if qid is not None:
+            try:
+                qid = int(qid)
+            except (TypeError, ValueError):
+                self._send_error(conn, msg.get("id"), "protocol",
+                                 f"bad queryId {qid!r}")
+                return
+        prefix = f"serve:{conn.tenant}:"
+        n = self._admission.cancel_where(
+            lambda h: h.description.startswith(prefix)
+            and (qid is None or h.query_id == qid),
+            f"cancelled by tenant {conn.tenant}")
         self._send(conn, {"type": "cancel_ok", "id": msg.get("id"),
-                          "cancelled": int(ok)})
+                          "cancelled": n})
 
     # -------------------------------------------------------- sending
 
     def _send(self, conn: _Connection, obj: dict) -> None:
+        """Control-frame send with the 0.5s idle poll timeout lifted:
+        sendall treats a socket timeout as a TOTAL deadline, so a slow
+        peer could otherwise cut a frame in half and desync the
+        stream. A failed send marks the connection dead — the message
+        loop closes it rather than serve a desynced client."""
+        sock = conn.sock
         try:
-            protocol.send_json(conn.sock, obj)
+            sock.settimeout(None)
+            protocol.send_json(sock, obj)
+            sock.settimeout(0.5)
         except OSError:
-            pass
+            conn.dead = True
 
     def _send_error(self, conn: _Connection, mid, code: str,
                     message: str, reason: Optional[str] = None

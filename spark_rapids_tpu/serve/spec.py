@@ -146,7 +146,22 @@ def _col_or_expr(c, params, lit_factory=None) -> Column:
 
 def compile_spec(spec: dict, session, params: Dict[str, object],
                  lit_factory=None):
-    """A relation spec -> DataFrame on `session` with params bound."""
+    """A relation spec -> DataFrame on `session` with params bound.
+
+    Every compile-time failure surfaces as SpecError (wire code
+    `bad_spec`) — including the plain ValueError/KeyError/TypeError
+    that coercions and resolution raise — so engine faults raised
+    AFTER a spec compiled are never misreported as spec errors."""
+    try:
+        return _compile_relation(spec, session, params, lit_factory)
+    except SpecError:
+        raise
+    except (ValueError, KeyError, TypeError) as e:
+        raise SpecError(f"spec failed to compile: {e}") from e
+
+
+def _compile_relation(spec: dict, session, params: Dict[str, object],
+                      lit_factory=None):
     if not isinstance(spec, dict) or "op" not in spec:
         raise SpecError("relation must be an object with an 'op'")
     op = spec["op"]
@@ -154,7 +169,8 @@ def compile_spec(spec: dict, session, params: Dict[str, object],
     def child(key="input"):
         if key not in spec:
             raise SpecError(f"op {op!r} requires {key!r}")
-        return compile_spec(spec[key], session, params, lit_factory)
+        return _compile_relation(spec[key], session, params,
+                                 lit_factory)
 
     if op == "parquet":
         paths = spec.get("path")
@@ -204,10 +220,10 @@ def compile_spec(spec: dict, session, params: Dict[str, object],
     if op == "join":
         if "left" not in spec or "right" not in spec:
             raise SpecError("join requires 'left' and 'right'")
-        left = compile_spec(spec["left"], session, params,
-                            lit_factory)
-        right = compile_spec(spec["right"], session, params,
-                             lit_factory)
+        left = _compile_relation(spec["left"], session, params,
+                                 lit_factory)
+        right = _compile_relation(spec["right"], session, params,
+                                  lit_factory)
         on = spec.get("on")
         if not on:
             raise SpecError("join requires 'on' column names")

@@ -15,11 +15,13 @@ import pytest
 
 from spark_rapids_tpu.api.session import TpuSparkSession
 from spark_rapids_tpu.serve.plan_cache import (
+    AUTO_PARAM_PREFIX,
     PlanCache,
     binding_key,
     conf_digest,
     normalize_spec,
 )
+from spark_rapids_tpu.serve.spec import SpecError
 
 N_ROWS = 300
 
@@ -43,11 +45,11 @@ def session():
     s.stop()
 
 
-def _spec(path):
+def _spec(path, key="lo"):
     return {"op": "filter",
             "input": {"op": "parquet", "path": path},
             "cond": {"fn": ">=", "args": [{"col": "a"},
-                                          {"param": "lo"}]}}
+                                          {"param": key}]}}
 
 
 def _lit_spec(path, lo):
@@ -73,13 +75,21 @@ def _run(cache, session, tenant, spec, params=None):
 
 
 def test_normalize_spec_parameterizes_literals(table_path):
+    p0 = f"{AUTO_PARAM_PREFIX}0"
     norm, auto = normalize_spec(_lit_spec(table_path, 42))
-    assert auto == {"_p0": 42}
-    assert norm["cond"]["args"][1] == {"param": "_p0"}
+    assert auto == {p0: 42}
+    assert norm["cond"]["args"][1] == {"param": p0}
     # two specs differing only in the literal normalize identically
     norm2, auto2 = normalize_spec(_lit_spec(table_path, 7))
     assert norm == norm2
-    assert auto2 == {"_p0": 7}
+    assert auto2 == {p0: 7}
+
+
+def test_reserved_prefix_param_refs_rejected(table_path):
+    # a spec referencing the reserved auto-param namespace would
+    # collide with an extracted literal — rejected, not misbound
+    with pytest.raises(SpecError):
+        normalize_spec(_spec(table_path, key=f"{AUTO_PARAM_PREFIX}0"))
 
 
 def test_normalize_spec_keeps_isin_values_structural():
@@ -216,6 +226,41 @@ def test_binding_lru_bound(session, table_path):
     assert info["planCache"] == "hit-rebind"
     _, info = _run(cache, session, "t", _spec(table_path), {"lo": 3})
     assert info["planCache"] == "hit-exact"
+
+
+def test_reserved_prefix_user_params_rejected(session, table_path):
+    """A client param in the reserved auto-literal namespace could
+    silently override an extracted literal's value (diverging from
+    the cache-disabled path) — both paths reject it up front."""
+    for cache in (PlanCache(), PlanCache(enabled=False)):
+        with pytest.raises(SpecError) as ei:
+            cache.dataframe_for(session, "t", _lit_spec(table_path, 5),
+                                {f"{AUTO_PARAM_PREFIX}0": 99})
+        assert "reserved" in str(ei.value)
+
+
+def test_user_params_and_literals_coexist(session, table_path):
+    """A spec mixing a literal (auto-parameterized) with ordinary
+    user params binds both correctly, identical to the disabled
+    path."""
+    spec = {"op": "filter",
+            "input": {"op": "parquet", "path": table_path},
+            "cond": {"fn": "and", "args": [
+                {"fn": ">=", "args": [{"col": "a"}, {"lit": 100}]},
+                {"fn": "<", "args": [{"col": "a"},
+                                     {"param": "hi"}]}]}}
+    cache = PlanCache()
+    t1, info = _run(cache, session, "t", spec, {"hi": 200})
+    assert info["planCache"] == "miss"
+    assert t1.num_rows == 100
+    assert pc.min(t1["a"]).as_py() == 100
+    t2, info2 = _run(PlanCache(enabled=False), session, "t", spec,
+                     {"hi": 200})
+    assert t2.sort_by("a").equals(t1.sort_by("a"))
+    # and the shape stays cacheable across user-param rebinds
+    t3, info3 = _run(cache, session, "t", spec, {"hi": 150})
+    assert info3["planCache"] == "hit-rebind"
+    assert t3.num_rows == 50
 
 
 def test_disabled_cache_bypasses(session, table_path):

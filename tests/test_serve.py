@@ -230,6 +230,31 @@ def test_bad_spec_is_clean_error(daemon):
         assert c.ping()["type"] == "pong"
 
 
+def test_slow_reader_gets_large_result_intact(daemon):
+    """Regression: the 0.5s idle poll timeout must NOT apply to result
+    sends — sendall treats it as a total deadline, so a client that
+    stalls mid-receive of a multi-MB payload used to desync the
+    stream on a partial frame. The stalled client must receive the
+    full result and the connection must stay usable."""
+    import time
+
+    spec = {"op": "select",
+            "input": {"op": "range", "start": 0, "end": 2_000_000},
+            "cols": ["id"]}
+    with ServeClient.connect(daemon, "slow", "standard") as c:
+        protocol.send_json(c._sock, {"type": "query", "id": 1,
+                                     "spec": spec})
+        # stall well past the old 0.5s send deadline while the ~16MB
+        # Arrow payload backs up in the socket buffers
+        time.sleep(2.0)
+        header, table = protocol.recv_message(c._sock,
+                                              daemon.max_frame_bytes)
+        assert header["type"] == "result"
+        assert table.num_rows == 2_000_000
+        # the stream is still in sync: a ping round-trips
+        assert c.ping()["type"] == "pong"
+
+
 def test_unknown_priority_class_refused(daemon):
     with pytest.raises(ServeError) as ei:
         ServeClient.connect(daemon, "acme", "platinum")
@@ -239,6 +264,45 @@ def test_unknown_priority_class_refused(daemon):
 def test_cancel_unknown_id_returns_zero(daemon):
     with ServeClient.connect(daemon, "acme", "standard") as c:
         assert c.cancel(999_999_999) == 0
+
+
+def test_cancel_is_tenant_scoped(daemon):
+    """A tenant can cancel only its OWN queries: another tenant's id
+    (or a bare cancel-all from another tenant) touches nothing."""
+    from spark_rapids_tpu.obs import events as obs_events
+
+    ctrl = admission.get()
+    qid = obs_events.allocate_query_id()
+    h = ctrl.submit(qid, description="serve:acme:standard")
+    try:
+        with ServeClient.connect(daemon, "globex", "standard") as c:
+            assert c.cancel(qid) == 0  # someone else's query
+            assert c.cancel() == 0     # cancel-all is scoped too
+        with ServeClient.connect(daemon, "acme", "standard") as c:
+            assert c.cancel(qid) == 1  # the owner cancels it
+    finally:
+        ctrl.finish(h, status="cancelled")
+
+
+def test_tenant_id_with_colon_refused(daemon):
+    # ':' delimits the serve:<tenant>:<class> cancel-scoping prefix —
+    # a tenant id containing it could forge another tenant's scope
+    with pytest.raises(ServeError) as ei:
+        ServeClient.connect(daemon, "acme:standard", "standard")
+    assert ei.value.code == "protocol"
+
+
+def test_error_code_taxonomy():
+    from spark_rapids_tpu.serve.spec import SpecError
+
+    assert protocol.error_code_for(SpecError("x")) == "bad_spec"
+    assert protocol.error_code_for(
+        protocol.ProtocolError("x")) == "protocol"
+    # builtins raised by engine internals MID-EXECUTION are not spec
+    # errors — they report (and count) as internal faults
+    assert protocol.error_code_for(ValueError("x")) == "internal"
+    assert protocol.error_code_for(KeyError("x")) == "internal"
+    assert protocol.error_code_for(TypeError("x")) == "internal"
 
 
 # ------------------------------------------------------ tenant quotas
@@ -329,6 +393,17 @@ def test_drain_rejects_new_work_and_stop_restores(daemon,
             assert r.status == 200
     finally:
         http.close()
+
+
+def test_drain_before_start_is_a_noop(serve_session):
+    d = QueryServiceDaemon(session=serve_session)
+    assert d.drain() == {"state": "new", "cancelled": 0}
+    # the daemon is not wedged: it still starts and serves
+    d.start()
+    try:
+        assert d.status()["state"] == "serving"
+    finally:
+        d.stop()
 
 
 def test_readiness_503_while_fenced(daemon):
