@@ -886,7 +886,9 @@ def main():
         # victim-unwind latency, order-inversion audit, lint coverage
         "sanitizer": sanitizer_block,
         # multichip SPMD scaling (PR 12): q5 throughput at 1/2/4/8
-        # shards, ici-resident shuffle byte split, scaling efficiency
+        # shards, ici-resident shuffle byte split, scaling efficiency;
+        # `hosts` sub-block (PR 17): 1x8 flat vs 2x4 host domains —
+        # dcnBytes vs iciBytes and the hierarchical-agg DCN reduction
         "multichip": multichip_block,
         # serving layer (serve/): daemon qps, wire latency p50/p99,
         # shed rate, plan-cache hit ratio of a 3-tenant closed loop

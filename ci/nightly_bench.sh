@@ -14,6 +14,7 @@ import json, sys, datetime, os
 line = [l for l in open(sys.argv[1]) if l.strip().startswith("{")][-1]
 d = json.loads(line)
 serve = d.get("serve") or {}
+hosts = (d.get("multichip") or {}).get("hosts") or {}
 entry = {
     "date": datetime.date.today().isoformat(),
     "value_gbps": d.get("value"),
@@ -23,6 +24,10 @@ entry = {
     "serve_qps": serve.get("qps"),
     "serve_p99_ms": serve.get("latencyMsP99"),
     "serve_plan_cache_hit_ratio": serve.get("planCacheHitRatio"),
+    # DCN placement tracking (PR 17): q5 at 2x4 host domains must keep
+    # cross-host bytes a constant factor below intra-host bytes
+    "multihost_dcn_vs_ici": (hosts.get("q5_2x4") or {}).get("dcn_vs_ici"),
+    "multihost_dcn_reduction": hosts.get("dcn_reduction_factor"),
 }
 hist = "bench-history.jsonl"
 prev = None

@@ -36,6 +36,9 @@ ci/devicefail_check.sh
 echo "== multichip gate (SPMD oracle + ICI bytes + chip loss) =="
 ci/multichip_check.sh
 
+echo "== multi-host gate (gloo cluster + DCN placement + host loss) =="
+ci/multihost_check.sh
+
 echo "== serving gate (multi-tenant daemon + plan cache + drain) =="
 ci/serve_check.sh
 

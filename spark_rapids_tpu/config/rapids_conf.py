@@ -495,6 +495,25 @@ MULTIHOST_PROCESS_ID = conf(
     "spark.rapids.tpu.multihost.processId", -1,
     "This process's id for multihost.coordinator (-1 = auto-detect "
     "from the TPU pod metadata).", int, startup_only=True)
+MULTIHOST_SIMULATED_HOSTS = conf(
+    "spark.rapids.tpu.multihost.simulatedHosts", 0,
+    "Partition a SINGLE process's mesh devices into H simulated host "
+    "groups so the 2D (hosts x chips) topology — DCN-aware exchange "
+    "placement, hierarchical aggregation, host-loss fencing — runs "
+    "and is testable without a real multi-process cluster. 0/1 = no "
+    "simulation (real topology from jax process indices).", int)
+MULTIHOST_DCN_RETRIES = conf(
+    "spark.rapids.tpu.multihost.collectiveRetries", 2,
+    "Bounded retries for a failed cross-host DCN collective "
+    "(dcn.collective faults) before the failure escalates to "
+    "host-loss handling.", int)
+MULTIHOST_HOST_RECOVERY = conf(
+    "spark.rapids.tpu.multihost.hostRecovery.enabled", True,
+    "On host loss (host.fatal / heartbeat-silent host), fence every "
+    "chip of the lost host in one step and re-execute the query's "
+    "lineage over the surviving hosts while the serve layer flips "
+    "only capacity; off = host loss propagates as DeviceLostError.",
+    bool)
 COALESCE_AFTER_SCAN = conf(
     "spark.rapids.sql.coalesceBatches.enabled", True,
     "Concatenate small device batches toward batchSizeRows after "

@@ -48,7 +48,8 @@ EVENT_TYPES: Dict[str, str] = {
     "shuffle.fetch": "shuffleId, reducePid, blocks, bytes",
     "shuffle.retry": "shuffleId, reducePid, block",
     "spill": "component, direction, fromTier, toTier, bytes",
-    "transfer": "direction (h2d|d2h|spill-disk|shuffle), site, bytes, ns",
+    "transfer": "direction (h2d|d2h|spill-disk|shuffle|ici|dcn), "
+                "site, bytes, ns",
     "telemetry.summary":
         "bytesMoved, bytesMovedTotal, hbmPeakBytes, rooflineFrac, "
         "linkFrac, bytesPerOutputRow, wallMs",
@@ -70,7 +71,14 @@ EVENT_TYPES: Dict[str, str] = {
     "chip.fence": "device, chipEpoch, cause",
     "chip.unfence": "device, chipEpoch",
     "chip.recovery": "device, chipEpoch, shards, survivors, ms",
+    "host.fence": "host, devices, chipEpoch, cause",
+    "host.unfence": "host, devices, chipEpoch",
+    "host.recovery":
+        "host, devices, chipEpoch, hosts, survivorHosts, shards, "
+        "survivors, ms",
     "ici.retry": "detail, left",
+    "dcn.retry": "detail, left",
+    "multihost.init": "processes, processIndex, devices, localDevices",
     "serve.connect": "tenant, priorityClass, addr",
     "serve.disconnect": "tenant, queries, bytesOut",
     "serve.query":

@@ -17,7 +17,7 @@ and operators without any embedder glue:
   about to restore).
 - `GET /readyz`   -> READINESS probe: 200 + JSON when the engine can
   accept new queries; 503 + the same JSON body (`ready`, `fenced`,
-  `fencedChips`, `draining`) while device-loss fencing
+  `fencedChips`, `fencedHosts`, `draining`) while device-loss fencing
   (runtime/device_monitor.py) or an admission drain
   (runtime/admission.py begin_drain / serve/server.py) is in effect —
   load balancers stop ROUTING to the engine instead of killing it.
@@ -121,13 +121,14 @@ class ObsHttpServer:
         ctrl = admission.get()
         fenced = bool(mon.fenced)
         chips = sorted(device_monitor.fenced_chips())
+        hosts = device_monitor.fenced_hosts()
         draining = bool(getattr(ctrl, "draining", False))
-        # a single fenced CHIP degrades capacity but the engine still
+        # a fenced CHIP or HOST degrades capacity but the engine still
         # serves (survivor remesh / CPU rung) — only a process-wide
         # fence or a drain flips readiness
         return {"ready": not (fenced or draining),
                 "fenced": fenced, "fencedChips": chips,
-                "draining": draining}
+                "fencedHosts": hosts, "draining": draining}
 
     # --- lifecycle ---
 

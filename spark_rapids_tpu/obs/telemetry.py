@@ -57,8 +57,12 @@ from spark_rapids_tpu.obs import events as _events
 #: `ici` is the inter-chip interconnect: bytes moved by mesh collectives
 #: (all_to_all / all_gather inside SPMD programs) that never touch a
 #: host link — the proof surface for "host bytes went to zero" on an
-#: ICI-resident exchange.
-DIRECTIONS = ("h2d", "d2h", "spill-disk", "shuffle", "ici")
+#: ICI-resident exchange. `dcn` is the cross-host data-center network
+#: tier of a multi-host mesh: bytes moved by collectives over the host
+#: axis (hierarchical-agg finals, broadcast builds, dictionary
+#: reconciliation syncs) — the planner's job is to keep this number
+#: far below `ici`.
+DIRECTIONS = ("h2d", "d2h", "spill-disk", "shuffle", "ici", "dcn")
 
 #: Peak HBM bandwidth per chip, bytes/s (public TPU specs; the cpu
 #: backend gets a nominal DDR figure so fractions stay meaningful).
@@ -201,6 +205,19 @@ class TransferLedger:
                 self._query(qid).ici_host_avoided += \
                     int(host_equiv_bytes)
 
+    def record_dcn(self, site: str, nbytes: int,
+                   query_id: Optional[int] = None) -> None:
+        """Account one CROSS-HOST mesh collective: `nbytes` crossed the
+        DCN tier of a multi-host mesh (collectives over the host axis —
+        per-shard static bytes x shard count, derived at trace time
+        like record_ici). Separate direction so the ici/dcn placement
+        split the topology-aware planner makes is a measured number."""
+        if not self.enabled or nbytes <= 0:
+            return
+        qid = query_id if query_id is not None \
+            else _events.effective_query_id()
+        self.record("dcn", site, nbytes, query_id=qid)
+
     def record_forwarded(self, fields: dict,
                          query_id: Optional[int] = None) -> None:
         """Fold a worker-forwarded `transfer` event (process pool) into
@@ -300,6 +317,12 @@ class TransferLedger:
             # of the decoded payload those collectives displaced
             out["iciBytes"] = ici
             out["hostBytesAvoided"] = ici_avoided
+        dcn = by_dir.get("dcn", _cell())["bytes"]
+        if dcn > 0:
+            # multi-host mesh: bytes that had to cross the slow DCN
+            # tier (hierarchical finals / broadcast builds) — compare
+            # against iciBytes to see the planner's placement win
+            out["dcnBytes"] = dcn
         if enc_plain > 0 and enc_actual > 0:
             # encoded execution's measured win: bytes the dictionary
             # representation kept OFF the staging/transfer paths, and
@@ -382,6 +405,8 @@ class TransferLedger:
                 "ici": {"bytes": self.totals.get(
                             "ici", _cell())["bytes"],
                         "hostBytesAvoided": self.ici_host_avoided},
+                "dcn": {"bytes": self.totals.get(
+                            "dcn", _cell())["bytes"]},
             }
 
     def site_rows(self) -> List[dict]:
@@ -417,6 +442,7 @@ ledger = TransferLedger()
 record = ledger.record
 record_encoded = ledger.record_encoded
 record_ici = ledger.record_ici
+record_dcn = ledger.record_dcn
 record_forwarded = ledger.record_forwarded
 hbm_global = ledger.hbm_global
 hbm_query = ledger.hbm_query

@@ -45,8 +45,10 @@ from spark_rapids_tpu.ops import filterops
 # runtime. Instead, the python bodies below note every collective's
 # static per-shard byte movement while they are being TRACED; the mesh
 # executor brackets the tracing call with begin/end, stores the profile
-# per compiled-program key, and replays it into the ledger (direction
-# "ici") on every execution. Entries: (site, wire_bytes_per_shard,
+# per compiled-program key, and replays it into the ledger on every
+# execution — direction "ici" for intra-host collectives, "dcn" for
+# sites prefixed "dcn." (collectives over the host axis of a 2D
+# multi-host mesh). Entries: (site, wire_bytes_per_shard,
 # host_equiv_bytes_per_shard) — host_equiv is the d2h + h2d round trip
 # of the DECODED payload the host shuffle path would have staged for
 # the same shard, which is what `hostBytesAvoided` reports.
@@ -243,12 +245,12 @@ def all_gather_batch(batch: ColumnBatch, axis_name: str, n: int,
     return interim.gather(perm, total)
 
 
-def gather_to_one(batch: ColumnBatch, axis_name: str, n: int
-                  ) -> ColumnBatch:
-    """Single-partition exchange: every row moves to shard 0 (other
-    shards end up logically empty). The SPMD analog of the planner's
-    TpuShuffleExchangeExec(num_partitions=1)."""
-    out = all_gather_batch(batch, axis_name, n, site="ici.gather")
+def gather_to_one(batch: ColumnBatch, axis_name: str, n: int,
+                  site: str = "ici.gather") -> ColumnBatch:
+    """Single-partition exchange: every row moves to shard 0 of the
+    named axis (other shards end up logically empty). The SPMD analog
+    of the planner's TpuShuffleExchangeExec(num_partitions=1)."""
+    out = all_gather_batch(batch, axis_name, n, site=site)
     me = lax.axis_index(axis_name)
     nr = jnp.where(me == 0,
                    jnp.asarray(out.num_rows, jnp.int32), jnp.int32(0))

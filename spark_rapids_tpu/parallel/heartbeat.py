@@ -32,10 +32,13 @@ from spark_rapids_tpu.config.rapids_conf import (  # noqa: F401
 
 
 class PeerInfo(dict):
-    """{executor_id, host, port, seq} — a dict so it moves through
-    JSON unchanged. `seq` is the monotone registration sequence the
-    incremental-discovery protocol keys on (prune-safe, unlike a
-    positional index)."""
+    """{executor_id, host, port, seq[, host_id]} — a dict so it moves
+    through JSON unchanged. `seq` is the monotone registration sequence
+    the incremental-discovery protocol keys on (prune-safe, unlike a
+    positional index). `host_id` is the executor's failure-domain
+    label (the TPU-pod host it runs on): executors sharing a host_id
+    die together, so the prune path evicts the whole group atomically
+    the moment ANY member goes silent."""
 
 
 class HeartbeatManager:
@@ -49,7 +52,15 @@ class HeartbeatManager:
     Dead-peer surface (the stage scheduler's eviction feed,
     runtime/scheduler.py): expired or explicitly evicted executors land
     in `dead_peers()` and fire `on_death` callbacks; a re-registering
-    executor gets a FRESH seq and leaves the dead set."""
+    executor gets a FRESH seq and leaves the dead set.
+
+    Host failure domains: executors registered with a `host_id` are
+    grouped — one member's heartbeat expiry evicts EVERY member of
+    that host atomically (a silent executor means its host is gone;
+    evicting members one timeout at a time leaves a window where the
+    half-dead host still receives shard assignments) and fires
+    `on_host_death` with the host id. Executors registered without a
+    host_id keep the independent per-executor timeout."""
 
     def __init__(self, timeout_ms: int = 30000):
         self._peers: Dict[str, PeerInfo] = {}
@@ -59,6 +70,7 @@ class HeartbeatManager:
         self.timeout_ms = timeout_ms
         self._dead: Dict[str, float] = {}  # executor_id -> death time
         self._death_cbs: List[Callable[[str], None]] = []
+        self._host_death_cbs: List[Callable[[str], None]] = []
 
     def on_death(self, cb: Callable[[str], None]) -> None:
         """Register a callback fired (outside the registry lock) with
@@ -66,18 +78,25 @@ class HeartbeatManager:
         with self._lock:
             self._death_cbs.append(cb)
 
+    def on_host_death(self, cb: Callable[[str], None]) -> None:
+        """Register a callback fired (outside the registry lock) with
+        each host_id whose executor group was evicted atomically —
+        the device monitor's fence_host feed."""
+        with self._lock:
+            self._host_death_cbs.append(cb)
+
     def dead_peers(self) -> List[str]:
         """Snapshot of executors that died (heartbeat expiry or
         eviction) and have not re-registered since."""
-        newly = self._collect_dead()
-        self._fire(newly)
+        self._fire(*self._collect_dead())
         with self._lock:
             return sorted(self._dead)
 
     def evict(self, executor_id: str) -> None:
         """Explicit eviction (scheduler-observed failure): remove from
         the live registry and mark dead; the executor may re-register
-        later and will get a fresh seq."""
+        later and will get a fresh seq. Single-executor semantics — an
+        observed task failure condemns one worker, not its host."""
         with self._lock:
             was_live = self._peers.pop(executor_id, None) is not None
             self._last_seen.pop(executor_id, None)
@@ -86,15 +105,37 @@ class HeartbeatManager:
                 newly = [executor_id]
             else:
                 newly = []
-        self._fire(newly)
+        self._fire(newly, [])
 
-    def register(self, executor_id: str, host: str, port: int):
+    def condemn_host(self, host_id: str) -> None:
+        """External evidence that a WHOLE host is gone (OS process
+        sentinel, fabric error report) without waiting out a heartbeat
+        timeout: evict every registered member of the group atomically
+        and fire on_host_death — the non-heartbeat twin of the prune
+        path's group eviction. A host with no live members is a no-op
+        (already condemned)."""
+        hid = str(host_id)
+        with self._lock:
+            members = [e for e, p in self._peers.items()
+                       if p.get("host_id") == hid]
+            for e in members:
+                self._peers.pop(e, None)
+                self._last_seen.pop(e, None)
+                self._dead[e] = time.monotonic()
+        if members:
+            self._fire(sorted(members), [hid])
+
+    def register(self, executor_id: str, host: str, port: int,
+                 host_id: Optional[str] = None):
         with self._lock:
             self._seq += 1
             self._dead.pop(executor_id, None)  # resurrection
-            self._peers[executor_id] = PeerInfo(
+            info = PeerInfo(
                 executor_id=executor_id, host=host, port=port,
                 seq=self._seq)
+            if host_id is not None:
+                info["host_id"] = str(host_id)
+            self._peers[executor_id] = info
             self._last_seen[executor_id] = time.monotonic()
             others = [p for e, p in self._peers.items()
                       if e != executor_id]
@@ -108,45 +149,67 @@ class HeartbeatManager:
             if executor_id not in self._peers:
                 return None, self._seq
             self._last_seen[executor_id] = time.monotonic()
-            newly = self._prune_locked()
+            newly, hosts = self._prune_locked()
             fresh = [p for e, p in self._peers.items()
                      if e != executor_id and p["seq"] > last_seq]
             result = fresh, self._seq
-        self._fire(newly)
+        self._fire(newly, hosts)
         return result
 
     def live_peers(self) -> List[PeerInfo]:
-        newly = self._collect_dead()
-        self._fire(newly)
+        self._fire(*self._collect_dead())
         with self._lock:
             return list(self._peers.values())
 
-    def _collect_dead(self) -> List[str]:
+    def _collect_dead(self):
         with self._lock:
             return self._prune_locked()
 
-    def _fire(self, newly_dead: List[str]) -> None:
+    def _fire(self, newly_dead: List[str],
+              dead_hosts: List[str]) -> None:
         """Death callbacks run OUTSIDE the lock: a callback may call
         back into the registry (eviction bookkeeping) freely."""
-        if not newly_dead:
+        if not newly_dead and not dead_hosts:
             return
         with self._lock:
             cbs = list(self._death_cbs)
+            host_cbs = list(self._host_death_cbs)
         for e in newly_dead:
             for cb in cbs:
                 try:
                     cb(e)
                 except Exception:
                     pass  # a listener must never break the plane
+        for h in dead_hosts:
+            for cb in host_cbs:
+                try:
+                    cb(h)
+                except Exception:
+                    pass
 
-    def _prune_locked(self) -> List[str]:
+    def _prune_locked(self):
+        """Expire silent executors; returns (dead executor ids, dead
+        host ids). One expired member of a host_id group condemns the
+        WHOLE group in this same step — recently-seen members included:
+        their host is gone, and waiting out their individual timeouts
+        would keep handing a half-dead host shard assignments."""
         deadline = time.monotonic() - self.timeout_ms / 1000.0
-        dead = [e for e, ts in self._last_seen.items() if ts < deadline]
+        expired = [e for e, ts in self._last_seen.items()
+                   if ts < deadline]
+        if not expired:
+            return [], []
+        hosts = {self._peers[e].get("host_id")
+                 for e in expired if e in self._peers}
+        hosts.discard(None)
+        dead = list(expired)
+        if hosts:
+            dead += [e for e, p in self._peers.items()
+                     if e not in expired and p.get("host_id") in hosts]
         for e in dead:
             self._peers.pop(e, None)
             self._last_seen.pop(e, None)
             self._dead[e] = time.monotonic()
-        return dead
+        return dead, sorted(hosts)
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -157,8 +220,9 @@ class _Handler(socketserver.StreamRequestHandler):
                 msg = json.loads(line)
                 op = msg.get("op")
                 if op == "register":
-                    peers, seq = mgr.register(msg["executor_id"],
-                                              msg["host"], msg["port"])
+                    peers, seq = mgr.register(
+                        msg["executor_id"], msg["host"], msg["port"],
+                        host_id=msg.get("host_id"))
                     resp = {"peers": peers, "seq": seq}
                 elif op == "heartbeat":
                     peers, seq = mgr.heartbeat(msg["executor_id"],
@@ -206,10 +270,12 @@ class HeartbeatClient:
 
     def __init__(self, driver_addr, executor_id: str, host: str,
                  port: int, interval_ms: int = 5000,
-                 on_new_peers: Optional[Callable] = None):
+                 on_new_peers: Optional[Callable] = None,
+                 host_id: Optional[str] = None):
         self.driver_addr = tuple(driver_addr)
         self.executor_id = executor_id
         self.host, self.port = host, port
+        self.host_id = host_id
         self.interval_ms = interval_ms
         self.on_new_peers = on_new_peers
         self._peers_by_id: Dict[str, PeerInfo] = {}
@@ -218,9 +284,7 @@ class HeartbeatClient:
         self._sock = socket.create_connection(self.driver_addr,
                                               timeout=10)
         self._rfile = self._sock.makefile("r")
-        initial = self._call({"op": "register",
-                              "executor_id": executor_id,
-                              "host": host, "port": port})
+        initial = self._call(self._register_msg())
         self._absorb(initial)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"srtpu-hb-{executor_id}")
@@ -229,6 +293,13 @@ class HeartbeatClient:
     @property
     def peers(self) -> List[PeerInfo]:
         return list(self._peers_by_id.values())
+
+    def _register_msg(self) -> dict:
+        msg = {"op": "register", "executor_id": self.executor_id,
+               "host": self.host, "port": self.port}
+        if self.host_id is not None:
+            msg["host_id"] = self.host_id
+        return msg
 
     def _call(self, msg) -> dict:
         self._sock.sendall((json.dumps(msg) + "\n").encode())
@@ -251,9 +322,7 @@ class HeartbeatClient:
                            "seen": self._seen})
         if resp.get("reregister"):
             # pruned (e.g. long GC pause): rejoin with full state
-            resp = self._call({"op": "register",
-                               "executor_id": self.executor_id,
-                               "host": self.host, "port": self.port})
+            resp = self._call(self._register_msg())
         self._absorb(resp)
 
     def _loop(self):

@@ -31,6 +31,46 @@ from spark_rapids_tpu.parallel.collective import all_to_all_batch
 
 AXIS = "data"
 
+#: Host axis of a 2D multi-host mesh (hosts x chips). Collectives over
+#: AXIS stay inside one host (ICI tier); collectives over HOST_AXIS
+#: cross hosts (DCN tier) — the topology split the DCN-aware planner
+#: places traffic by.
+HOST_AXIS = "host"
+
+
+def make_host_mesh(groups) -> Mesh:
+    """2D mesh over host failure domains: axis 0 is HOST_AXIS (one row
+    per host group), axis 1 is AXIS (that host's chips). Groups must be
+    equal-sized — a mesh is a regular grid."""
+    import numpy as np
+
+    chips = len(groups[0])
+    assert all(len(g) == chips for g in groups), \
+        [len(g) for g in groups]
+    return Mesh(np.array([list(g) for g in groups]),
+                (HOST_AXIS, AXIS))
+
+
+def row_axes(mesh: Mesh):
+    """The mesh axes a batch's row dimension shards over: (host, data)
+    host-major on a 2D mesh, (data,) on the classic 1D mesh."""
+    return ((HOST_AXIS, AXIS) if HOST_AXIS in mesh.shape
+            else (AXIS,))
+
+
+def row_spec(mesh: Mesh) -> P:
+    """PartitionSpec sharding dim 0 over every row axis of the mesh."""
+    axes = row_axes(mesh)
+    return P(axes if len(axes) > 1 else axes[0])
+
+
+def total_shards(mesh: Mesh) -> int:
+    """Row shards of the mesh = product of its row axes' sizes."""
+    n = 1
+    for a in row_axes(mesh):
+        n *= mesh.shape[a]
+    return n
+
 
 def make_mesh(n_devices: int, devices=None) -> Mesh:
     """Mesh over the first n devices, or over an explicit device list
@@ -58,7 +98,7 @@ def shard_batch(mesh: Mesh, batch: ColumnBatch) -> ColumnBatch:
     Encoded columns shard their CODES; the dictionary (shared by every
     row regardless of which shard it lands on) replicates across the
     mesh — its [K, W] leaves have no row axis to shard."""
-    n = mesh.shape[AXIS]
+    n = total_shards(mesh)
     assert batch.capacity % n == 0, (batch.capacity, n)
     shard_cap = batch.capacity // n
     global_rows = batch.row_count()
@@ -67,9 +107,11 @@ def shard_batch(mesh: Mesh, batch: ColumnBatch) -> ColumnBatch:
 
     from spark_rapids_tpu.obs import telemetry
 
+    rspec = row_spec(mesh)
+
     def put_rows(leaf):
         return telemetry.ledgered_put(
-            leaf, "mesh.shard", device=NamedSharding(mesh, P(AXIS)))
+            leaf, "mesh.shard", device=NamedSharding(mesh, rspec))
 
     def put_col(col):
         enc = getattr(col, "encoding", None)
@@ -83,7 +125,7 @@ def shard_batch(mesh: Mesh, batch: ColumnBatch) -> ColumnBatch:
     cols = [put_col(c) for c in batch.columns]
     counts = telemetry.ledgered_put(
         jnp.asarray(per_shard), "mesh.shard",
-        device=NamedSharding(mesh, P(AXIS)))
+        device=NamedSharding(mesh, rspec))
     return ColumnBatch(batch.schema, list(cols), counts)
 
 

@@ -9,16 +9,25 @@ and `jax.devices()` then spans EVERY host's chips — the mesh compiler
 routing intra-slice traffic over ICI and cross-slice traffic over DCN.
 No heartbeats, endpoint tables, or bounce buffers to manage.
 
+`host_groups` is the topology oracle the 2D mesh builds on: it groups
+the device list into host-sized failure domains, either from the real
+process indices (one process = one host) or from the
+`spark.rapids.tpu.multihost.simulatedHosts` conf, which splits a
+single process's devices into H contiguous groups so the whole
+multi-host plane (DCN placement, hierarchical agg, host fencing) is
+exercisable on one machine.
+
 Single-host sessions skip initialization (the default path everywhere
 else in the engine)."""
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import List, Optional
 
 import jax
 
 _initialized = False
+_init_args: Optional[tuple] = None
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -27,10 +36,22 @@ def initialize(coordinator_address: Optional[str] = None,
     """Join the multi-host coordination service. On Cloud TPU pods all
     arguments are auto-detected from the metadata server; elsewhere pass
     them explicitly (reference: executors registering with the driver
-    plugin, Plugin.scala:417-437)."""
-    global _initialized
+    plugin, Plugin.scala:417-437).
+
+    Idempotent for identical arguments; a second call with DIFFERENT
+    arguments raises — the coordination service cannot be re-wired in
+    a live process, and silently keeping the stale config (the old
+    behavior) made misconfiguration invisible."""
+    global _initialized, _init_args
+    args = (coordinator_address, num_processes, process_id)
     if _initialized:
-        return
+        if args == _init_args:
+            return
+        raise RuntimeError(
+            "multihost.initialize() called twice with different "
+            f"arguments: first {_init_args}, now {args}. The "
+            "jax.distributed coordination service is wired once per "
+            "process; restart the process to change the topology.")
     kwargs = {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address
@@ -40,6 +61,14 @@ def initialize(coordinator_address: Optional[str] = None,
         kwargs["process_id"] = process_id
     jax.distributed.initialize(**kwargs)
     _initialized = True
+    _init_args = args
+    from spark_rapids_tpu.obs import events as obs_events
+
+    obs_events.emit(
+        "multihost.init", processes=jax.process_count(),
+        processIndex=jax.process_index(),
+        devices=len(jax.devices()),
+        localDevices=len(jax.local_devices()))
 
 
 def global_device_count() -> int:
@@ -52,6 +81,28 @@ def local_device_count() -> int:
 
 def process_index() -> int:
     return jax.process_index()
+
+
+def host_groups(devices, simulated_hosts: int = 0) -> List[list]:
+    """Group a device list into host failure domains, host-major.
+
+    Real multi-process topology (jax.process_count() > 1): one group
+    per owning process, ordered by process index — exactly the unit a
+    process crash takes out. Otherwise, `simulated_hosts` H > 1 splits
+    the list into H contiguous equal groups (trailing remainder
+    dropped so groups stay equal-sized — a mesh axis must be regular).
+    Else one group: the classic single-host 1D mesh."""
+    devs = list(devices)
+    if jax.process_count() > 1:
+        by_proc = {}
+        for d in devs:
+            by_proc.setdefault(int(d.process_index), []).append(d)
+        return [by_proc[p] for p in sorted(by_proc)]
+    h = int(simulated_hosts or 0)
+    if h > 1 and len(devs) >= h:
+        per = len(devs) // h
+        return [devs[i * per:(i + 1) * per] for i in range(h)]
+    return [devs]
 
 
 def make_global_executor(conf=None):

@@ -65,6 +65,7 @@ from spark_rapids_tpu.runtime.errors import TpuSplitAndRetryOOM
 from spark_rapids_tpu.sqltypes import StringType, StructType
 
 AXIS = mesh_exec.AXIS
+HOST_AXIS = mesh_exec.HOST_AXIS
 
 
 class MeshCompileError(NotImplementedError):
@@ -357,6 +358,9 @@ def stamp_exchange_strategies(phys: PhysicalPlan, conf=None) -> None:
     from spark_rapids_tpu.config import rapids_conf as rc
 
     ici_on = conf is None or conf.get(rc.MULTICHIP_ICI_SHUFFLE)
+    sim = (conf.get(rc.MULTIHOST_SIMULATED_HOSTS) if conf is not None
+           else rc.MULTIHOST_SIMULATED_HOSTS.default)
+    multihost = jax.process_count() > 1 or (sim or 0) > 1
     probe = MeshQueryExecutor.__new__(MeshQueryExecutor)
 
     def mesh_resident(node: PhysicalPlan) -> bool:
@@ -372,6 +376,19 @@ def stamp_exchange_strategies(phys: PhysicalPlan, conf=None) -> None:
         if isinstance(node, ops.TpuShuffleExchangeExec):
             node.ici_strategy = ("ici" if ici_on and mesh_resident(node)
                                  else "host")
+            if multihost and node.ici_strategy == "ici":
+                # DCN placement (informational, for explain()): a
+                # partial->final aggregate hand-off reduces per host
+                # BEFORE crossing DCN (_hierarchical_agg_exchange);
+                # any other keyed/round-robin exchange rides the
+                # generic ICI-then-DCN two-stage split
+                c = node.children[0]
+                node.dcn_strategy = (
+                    "reduce-then-dcn"
+                    if (node.key_exprs
+                        and isinstance(c, ops.TpuHashAggregateExec)
+                        and c.mode == "partial" and c.grouping)
+                    else "two-stage")
 
     walk(phys)
 
@@ -401,7 +418,16 @@ class MeshQueryExecutor:
     def __init__(self, mesh, conf=None, expansion: int = 0):
         self.mesh = mesh
         self.conf = conf
-        self.n = mesh.shape[AXIS]
+        # topology: a 1D mesh is (chips,) = the classic single-host
+        # engine; a 2D mesh is (hosts, chips) host failure domains —
+        # collectives over AXIS stay on ICI, collectives over
+        # HOST_AXIS cross DCN, and the lowerings below place traffic
+        # accordingly. self.n is always the TOTAL row-shard count.
+        shape = dict(mesh.shape)
+        self.hosts = int(shape.get(HOST_AXIS, 1))
+        self.chips = int(shape[AXIS])
+        self.n = self.hosts * self.chips
+        self._row_spec = mesh_exec.row_spec(mesh)
         if expansion <= 0:
             from spark_rapids_tpu.config import rapids_conf as rc
 
@@ -418,6 +444,8 @@ class MeshQueryExecutor:
 
     @classmethod
     def for_devices(cls, n_devices: int, conf=None) -> "MeshQueryExecutor":
+        from spark_rapids_tpu.config import rapids_conf as rc
+        from spark_rapids_tpu.parallel import multihost
         from spark_rapids_tpu.runtime import device_monitor as dm
 
         fenced = dm.fenced_chips()
@@ -425,11 +453,29 @@ class MeshQueryExecutor:
         if not healthy:
             raise MeshCompileError(
                 "every local device is chip-fenced; no mesh possible")
-        n = min(max(1, n_devices), len(healthy))
-        key = (n, dm.chip_epoch())
+        sim = (conf.get(rc.MULTIHOST_SIMULATED_HOSTS) if conf is not None
+               else rc.MULTIHOST_SIMULATED_HOSTS.default)
+        if sim and sim > 1:
+            # a fenced simulated host shrinks the host axis (its chips
+            # are already out of `healthy`); real topologies shrink by
+            # losing their process's device group instead
+            sim = max(1, int(sim) - len(dm.fenced_hosts()))
+        groups = multihost.host_groups(healthy, sim)
+        if len(groups) <= 1:
+            n = min(max(1, n_devices), len(healthy))
+            key = (n, dm.chip_epoch())
+            mesh = cls._mesh_cache.get(key)
+            if mesh is None:
+                mesh = mesh_exec.make_mesh(n, devices=healthy)
+                cls._mesh_cache[key] = mesh
+            return cls(mesh, conf)
+        hosts = len(groups)
+        chips = min(min(len(g) for g in groups),
+                    max(1, n_devices // hosts))
+        key = ("2d", hosts, chips, dm.chip_epoch())
         mesh = cls._mesh_cache.get(key)
         if mesh is None:
-            mesh = mesh_exec.make_mesh(n, devices=healthy)
+            mesh = mesh_exec.make_host_mesh([g[:chips] for g in groups])
             cls._mesh_cache[key] = mesh
         return cls(mesh, conf)
 
@@ -554,7 +600,7 @@ class MeshQueryExecutor:
                         l[li] = pad_axis(l[li], ax, m)
             for sc, (_, treedef), l in zip(shard_cols, flats, leaves):
                 sc[ci] = jax.tree_util.tree_unflatten(treedef, l)
-        sharding = NamedSharding(self.mesh, P(AXIS))
+        sharding = NamedSharding(self.mesh, self._row_spec)
         local_devs = [devs[s] for s in local_ids]
 
         def assemble(leaves_per_shard, global_shape):
@@ -595,48 +641,49 @@ class MeshQueryExecutor:
         codes with encoding STRIPPED (the caller re-attaches the shared
         dictionary replicated over the mesh after assembly). Columns
         whose shards cannot reconcile — a live plain shard mixed with
-        encoded ones, an evicted host dictionary, a multi-process mesh
-        (dictionary contents are process-local) — decode host-side to
-        the plain padded layout instead (PR 8's fallback discipline)."""
+        encoded ones, an evicted host dictionary — decode host-side to
+        the plain padded layout instead (PR 8's fallback discipline).
+
+        Multi-process meshes reconcile HIERARCHICALLY: each process
+        unions its own shards' dictionaries locally (free), then ONE
+        cross-host value exchange (_union_dictionary_id) builds the
+        global union; intern_dictionary is content-addressed, so every
+        process arrives at the same dict_id without shipping objects.
+        Every cross-process decision below (live_plain, the decode
+        fallback) is sync'd — processes disagreeing on whether a
+        column stays encoded would deadlock the global assembly."""
         from spark_rapids_tpu.columnar import encoding as enc_mod
         from spark_rapids_tpu.columnar.encoding import DeviceDictionary
         from spark_rapids_tpu.config import rapids_conf as rc
 
-        reconcile = (jax.process_count() == 1
-                     and (self.conf is None or self.conf.get(
-                         rc.MULTICHIP_RECONCILE_DICTS)))
+        multi = jax.process_count() > 1
+        reconcile = (self.conf is None or self.conf.get(
+            rc.MULTICHIP_RECONCILE_DICTS))
         col_dicts: Dict[int, DeviceDictionary] = {}
         for ci in range(len(scan.schema.fields)):
             cols = [sc[ci] for sc in shard_cols]
             encs = [getattr(c, "encoding", None) for c in cols]
-            if all(e is None for e in encs):
+            enc_any = any(e is not None for e in encs)
+            if multi:
+                enc_any = bool(self._sync_max(int(enc_any)))
+            if not enc_any:
                 continue
             live_plain = any(
                 e is None and int(np.asarray(c.validity).sum()) > 0
                 for c, e in zip(cols, encs))
+            if multi:
+                live_plain = bool(self._sync_max(int(live_plain)))
             hd = None
             union_id = None
             if reconcile and not live_plain:
-                ids = []
-                for e in encs:
-                    if e is not None and e.dict_id not in ids:
-                        ids.append(e.dict_id)
-                if len(ids) == 1:
-                    union_id = ids[0]
-                else:
-                    values: List[str] = []
-                    for did in ids:
-                        v = enc_mod.dictionary_values(did)
-                        if v is None:
-                            values = []
-                            break
-                        values.extend(
-                            x for x in v.to_pylist() if x is not None)
-                    if values:
-                        union_id, _ = enc_mod.intern_dictionary(
-                            pa.array(values, type=pa.large_string()))
+                union_id = self._union_dictionary_id(encs)
                 hd = (enc_mod._host_dict(union_id)
                       if union_id is not None else None)
+            if multi and bool(self._sync_max(1 if hd is None else 0)):
+                # any process missing the union dictionary forces the
+                # decode fallback EVERYWHERE — a column half-encoded
+                # across processes cannot assemble
+                hd, union_id = None, None
             if hd is None:
                 # decode fallback: plain padded layout on every shard
                 for s, c in enumerate(cols):
@@ -664,6 +711,90 @@ class MeshQueryExecutor:
             col_dicts[ci] = DeviceDictionary(hd.matrix, hd.lengths,
                                              union_id)
         return col_dicts
+
+    def _union_dictionary_id(self, encs):
+        """dict_id of the union dictionary covering every shard's
+        encoding, or None when any contributing dictionary is gone.
+
+        Single-process: concatenate the distinct dictionaries' values
+        in shard order and intern (the PR 8 behavior, unchanged).
+        Multi-process: union the LOCAL dictionaries first (the
+        per-host rung — free), then allgather each process's value
+        list as one padded JSON blob over DCN and intern the
+        process-order concatenation; intern_dictionary is
+        content-addressed so every process computes the same id from
+        the same bytes."""
+        from spark_rapids_tpu.columnar import encoding as enc_mod
+
+        if jax.process_count() == 1:
+            ids = []
+            for e in encs:
+                if e is not None and e.dict_id not in ids:
+                    ids.append(e.dict_id)
+            if len(ids) == 1:
+                return ids[0]
+            values: List[str] = []
+            for did in ids:
+                v = enc_mod.dictionary_values(did)
+                if v is None:
+                    return None
+                values.extend(x for x in v.to_pylist()
+                              if x is not None)
+            if not values:
+                return None
+            uid, _ = enc_mod.intern_dictionary(
+                pa.array(values, type=pa.large_string()))
+            return uid
+        import json
+
+        local: List[str] = []
+        seen = set()
+        missing = 0
+        for e in encs:
+            if e is None:
+                continue
+            v = enc_mod.dictionary_values(e.dict_id)
+            if v is None:
+                missing = 1
+                break
+            for x in v.to_pylist():
+                if x is not None and x not in seen:
+                    seen.add(x)
+                    local.append(x)
+        # agree on the bail-out BEFORE the collective below: one
+        # process returning early while the rest enter the allgather
+        # would deadlock the pod
+        if self._sync_max(missing):
+            return None
+        try:
+            from jax.experimental import multihost_utils
+
+            from spark_rapids_tpu.obs import telemetry
+
+            blob = np.frombuffer(json.dumps(local).encode(), np.uint8)
+            m = max(self._sync_max(len(blob)), 1)
+            padded = np.zeros((m,), np.uint8)
+            padded[:len(blob)] = blob
+            blobs = np.asarray(
+                multihost_utils.process_allgather(padded))
+            lens = np.asarray(multihost_utils.process_allgather(
+                np.asarray([len(blob)], np.int64))).reshape(-1)
+            telemetry.record_dcn("dcn.dict_union", int(blobs.size))
+            values = []
+            vseen = set()
+            for p in range(blobs.shape[0]):
+                for x in json.loads(
+                        bytes(blobs[p, :int(lens[p])]).decode()):
+                    if x not in vseen:
+                        vseen.add(x)
+                        values.append(x)
+            if not values:
+                return None
+            uid, _ = enc_mod.intern_dictionary(
+                pa.array(values, type=pa.large_string()))
+            return uid
+        except Exception:
+            return None
 
     @staticmethod
     def _decode_host(col):
@@ -714,6 +845,8 @@ class MeshQueryExecutor:
             raise MeshCompileError(
                 "ICI shuffle disabled: exchanges keep the host path")
         self.plan_exchange_strategies(phys)
+        if self.hosts > 1:
+            self._multihost_unsupported(phys)
         sources: List[PhysicalPlan] = []
         self._collect_sources(phys, sources)
         sharded = []
@@ -727,6 +860,9 @@ class MeshQueryExecutor:
         retries = (self.conf.get(rc.MULTICHIP_ICI_RETRIES)
                    if self.conf is not None
                    else rc.MULTICHIP_ICI_RETRIES.default)
+        dcn_retries = (self.conf.get(rc.MULTIHOST_DCN_RETRIES)
+                       if self.conf is not None
+                       else rc.MULTIHOST_DCN_RETRIES.default)
         while True:
             try:
                 return self._run(phys, sources, sharded, expansion)
@@ -751,9 +887,46 @@ class MeshQueryExecutor:
                     obs_events.emit("ici.retry", detail=e.detail,
                                     left=retries)
                     continue
+                if e.site == "dcn.collective" and dcn_retries > 0:
+                    # transient cross-host fault: same purity argument,
+                    # separately budgeted — DCN flakes (a dropped link,
+                    # a slow switch) are far more common than ICI ones
+                    dcn_retries -= 1
+                    obs_events.emit("dcn.retry", detail=e.detail,
+                                    left=dcn_retries)
+                    continue
                 if e.site == "chip.fatal":
                     return self._recover_chip_loss(phys, e)
+                if e.site == "host.fatal":
+                    return self._recover_host_loss(phys, e)
                 raise
+
+    @staticmethod
+    def _multihost_unsupported(phys: PhysicalPlan) -> None:
+        """Operators with no 2D-mesh lowering: global sort and window
+        would need cross-host range/partition exchanges this PR does
+        not place, and a full join's per-host matched-build tracking
+        would double-count unmatched build rows (the build side is
+        host-replicated). MeshCompileError -> thread-pool fallback."""
+
+        def walk(n: PhysicalPlan) -> None:
+            if isinstance(n, ops.TpuSortExec):
+                raise MeshCompileError(
+                    "global sort has no multi-host mesh lowering")
+            if isinstance(n, ops.TpuWindowExec):
+                raise MeshCompileError(
+                    "window has no multi-host mesh lowering")
+            if isinstance(n, (J.TpuShuffledHashJoinExec,
+                              J.TpuBroadcastHashJoinExec)) \
+                    and n.join_type == "full":
+                raise MeshCompileError(
+                    "full join has no multi-host mesh lowering (the "
+                    "host-replicated build side would double-count "
+                    "unmatched build rows)")
+            for c in n.children:
+                walk(c)
+
+        walk(phys)
 
     def plan_exchange_strategies(self, phys: PhysicalPlan) -> None:
         stamp_exchange_strategies(phys, self.conf)
@@ -793,6 +966,60 @@ class MeshQueryExecutor:
         obs_events.emit(
             "chip.recovery", device=victim.id, chipEpoch=chip_ep,
             shards=self.n, survivors=survivor.n,
+            ms=round((time.monotonic() - t0) * 1000.0, 3))
+        return out
+
+    def _host_ids(self) -> List[str]:
+        """Stable failure-domain label per host row of the 2D mesh:
+        the owning process for real multi-host topologies, the row's
+        first device id for simulated hosts (unique and stable across
+        refencing — device ids never reassign)."""
+        if self.hosts <= 1:
+            return ["host0"]
+        rows = [list(r) for r in self.mesh.devices]
+        if jax.process_count() > 1:
+            return [f"proc{r[0].process_index}" for r in rows]
+        return [f"sim{r[0].id}" for r in rows]
+
+    def _recover_host_loss(self, phys: PhysicalPlan,
+                           exc) -> pa.Table:
+        """A whole host died mid-collective: the chip ladder rung
+        scaled up one level. Fence EVERY chip of that host in one
+        epoch step (per-chip fencing would hand the half-dead host
+        shard assignments for n-1 more timeouts), rebuild the mesh
+        over the surviving hosts, and recover the lost shards from
+        lineage exactly as the chip path does — sources re-ingest
+        deterministically over the new topology."""
+        import time
+
+        from spark_rapids_tpu.config import rapids_conf as rc
+        from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.runtime import device_monitor as dm
+        from spark_rapids_tpu.runtime.errors import DeviceLostError
+
+        recover = (self.conf is None
+                   or self.conf.get(rc.MULTIHOST_HOST_RECOVERY))
+        # chaos-driven loss carries no host handle; the victim is the
+        # mesh's last host row (deterministic — same discipline as the
+        # chip path's last-device victim)
+        victims = list(self.mesh.devices[-1]) if self.hosts > 1 \
+            else list(self.mesh.devices.reshape(-1))
+        host_id = self._host_ids()[-1]
+        ids = [d.id for d in victims]
+        chip_ep = dm.fence_host(host_id, ids, cause=str(exc))
+        if not recover or self.hosts <= 1:
+            raise DeviceLostError(
+                f"host {host_id} (devices {ids}) lost during mesh "
+                f"execution (chip epoch {chip_ep}): {exc}")
+        t0 = time.monotonic()
+        survivor = MeshQueryExecutor.for_devices(self.n, self.conf)
+        out = survivor.execute(phys)
+        dm.note_host_recovery()
+        obs_events.emit(
+            "host.recovery", host=host_id, devices=ids,
+            chipEpoch=chip_ep, hosts=self.hosts,
+            survivorHosts=survivor.hosts, shards=self.n,
+            survivors=survivor.n,
             ms=round((time.monotonic() - t0) * 1000.0, 3))
         return out
 
@@ -908,13 +1135,32 @@ class MeshQueryExecutor:
                                             track, expansion)
                     rb = self._key_exchange(emit(rc), node.right_keys,
                                             track, expansion)
+                    if self.hosts > 1:
+                        # both sides are chip-partitioned by the same
+                        # hash % chips; gathering the BUILD side over
+                        # the host axis gives chip (h, c) every global
+                        # build row with hash % chips == c exactly
+                        # once — probe rows never cross DCN, and each
+                        # probe row meets each build row on exactly
+                        # one shard (correct for every non-full type)
+                        rb = all_gather_batch(rb, HOST_AXIS,
+                                              self.hosts,
+                                              site="dcn.broadcast")
                     out_cap = next_capacity(
                         expansion * max(lb.capacity, rb.capacity))
                     return track(shard_equi_join(node, lb, rb, out_cap))
                 if isinstance(node, J.TpuBroadcastHashJoinExec):
                     lb = emit(node.children[0])
-                    rb = all_gather_batch(emit(node.children[1]), AXIS,
-                                          n, site="ici.broadcast")
+                    rb0 = emit(node.children[1])
+                    if self.hosts > 1:
+                        # DCN first (hosts x cap), then ICI fans the
+                        # union out chip-wise — the reverse order
+                        # would push chips x cap across DCN
+                        rb0 = all_gather_batch(rb0, HOST_AXIS,
+                                               self.hosts,
+                                               site="dcn.broadcast")
+                    rb = all_gather_batch(rb0, AXIS, self.chips,
+                                          site="ici.broadcast")
                     out_cap = next_capacity(
                         expansion * max(lb.capacity, rb.capacity))
                     return track(shard_equi_join(node, lb, rb, out_cap))
@@ -952,15 +1198,21 @@ class MeshQueryExecutor:
                   for ci, c in enumerate(sb.columns)
                   if getattr(c, "encoding", None) is not None)
             for sb in sharded)
-        key = ("mesh_plan", _plan_key(phys), n, expansion, shape_key,
-               enc_key)
+        # topology in the key: hosts and the flat device-id layout —
+        # a 1x8 and a 2x4 mesh share n=8 but trace DIFFERENT programs
+        # (the 2D one carries host-axis collectives), and a rebuilt
+        # same-n mesh over different survivors must not reuse programs
+        # compiled against the dead layout
+        key = ("mesh_plan", _plan_key(phys), n, self.hosts,
+               tuple(int(d.id) for d in self.mesh.devices.reshape(-1)),
+               expansion, shape_key, enc_key)
         jitted = cached_jit(
             key,
             lambda: get_shim().shard_map(
                 step, self.mesh,
-                tuple(mesh_exec.batch_arg_specs(sb, P(AXIS))
+                tuple(mesh_exec.batch_arg_specs(sb, self._row_spec)
                       for sb in sharded),
-                (P(AXIS), P(AXIS))))
+                (self._row_spec, self._row_spec)))
         from spark_rapids_tpu.obs import telemetry
         from spark_rapids_tpu.parallel import collective
         from spark_rapids_tpu.runtime import faults
@@ -972,6 +1224,15 @@ class MeshQueryExecutor:
         faults.maybe_inject("ici.collective", detail="mesh all_to_all")
         faults.maybe_inject("chip.fatal",
                             detail=f"mesh chip {n - 1} of {n}")
+        if self.hosts > 1:
+            # the multi-host rungs of the ladder: a transient DCN
+            # flake (bounded retry) and a whole-host loss (fence_host
+            # + survivor remesh + lineage recovery in execute)
+            faults.maybe_inject("dcn.collective",
+                                detail="mesh cross-host collective")
+            faults.maybe_inject(
+                "host.fatal",
+                detail=f"mesh host {self.hosts - 1} of {self.hosts}")
         collective.begin_ici_tape()
         try:
             out, ovf = jitted(*sharded)
@@ -985,7 +1246,13 @@ class MeshQueryExecutor:
         if out_enc:
             _out_enc_profiles[key] = list(out_enc)
         for site, wire, host_eq in _ici_profiles.get(key, ()):
-            telemetry.record_ici(site, wire * n, host_eq * n)
+            if site.startswith("dcn"):
+                # host-axis collectives cross DCN; every one of the n
+                # shards participates (the host axis subgroups span
+                # all chips), so wire*n is total bytes here too
+                telemetry.record_dcn(site, wire * n)
+            else:
+                telemetry.record_ici(site, wire * n, host_eq * n)
         if bool(mesh_exec.fetch_host(ovf).any()):
             raise TpuSplitAndRetryOOM(
                 "mesh collective slot / join expansion overflowed; "
@@ -1018,14 +1285,39 @@ class MeshQueryExecutor:
             return child.children[0]
         return child
 
+    def _global_index(self):
+        """This shard's GLOBAL index in host-major flat order —
+        host_row * chips + chip_col; plain chip index on a 1D mesh.
+        Matches the layout mesh_exec.gather_result reads back."""
+        me = lax.axis_index(AXIS)
+        if self.hosts > 1:
+            me = me + lax.axis_index(HOST_AXIS) * self.chips
+        return me
+
+    def _gather_counts(self, nr):
+        """All shards' scalar `nr` as a [n] vector in host-major flat
+        order (index i belongs to the shard whose _global_index is i).
+        Nested per-axis all_gathers rather than a tuple axis name —
+        explicit about the two fabric tiers and version-safe."""
+        counts = lax.all_gather(nr, AXIS)
+        if self.hosts > 1:
+            counts = lax.all_gather(counts, HOST_AXIS).reshape(-1)
+        return counts
+
     def _key_exchange(self, batch: ColumnBatch, keys, track,
                       expansion: int) -> ColumnBatch:
+        """Intra-host co-partitioning by key hash: row -> chip
+        hash % chips, over the ICI tier only. On a 1D mesh chips == n,
+        byte-identical to the classic lowering. On a 2D mesh each host
+        partitions its own rows the same way, so chip column c of
+        EVERY host holds exactly the keys with hash % chips == c —
+        the invariant the shuffled-join DCN build broadcast relies on."""
         ctx = EvalContext(batch)
         kcols = [k.eval(ctx) for k in keys]
-        dest = pmod(murmur3_columns(kcols), self.n)
-        slot = slot_capacity(batch.capacity, self.n, expansion)
-        return track(all_to_all_batch(batch, dest, self.n, slot, AXIS,
-                                      site="ici.exchange"))
+        dest = pmod(murmur3_columns(kcols), self.chips)
+        slot = slot_capacity(batch.capacity, self.chips, expansion)
+        return track(all_to_all_batch(batch, dest, self.chips, slot,
+                                      AXIS, site="ici.exchange"))
 
     def _shard_prefix_limit(self, batch: ColumnBatch,
                             k: int) -> ColumnBatch:
@@ -1034,8 +1326,8 @@ class MeshQueryExecutor:
         shards (ordered limit) and for gathered single-shard data; always
         yields <= k rows total."""
         nr = jnp.asarray(batch.num_rows, jnp.int32).reshape(())
-        counts = lax.all_gather(nr, AXIS)
-        me = lax.axis_index(AXIS)
+        counts = self._gather_counts(nr)
+        me = self._global_index()
         start = jnp.sum(jnp.where(
             jnp.arange(self.n, dtype=jnp.int32) < me, counts, 0))
         keep = jnp.clip(jnp.int32(k) - start, 0, nr)
@@ -1081,6 +1373,27 @@ class MeshQueryExecutor:
         if node.mode == "partial":
             return run_phase(node._partial, emit(node.children[0]))
         if node.mode == "final":
+            child = node.children[0]
+            while isinstance(child, ops.TpuCoalesceBatchesExec):
+                child = child.children[0]
+            nk = len(node.grouping)
+            if (self.hosts > 1 and nk
+                    and isinstance(child, ops.TpuShuffleExchangeExec)
+                    and child.key_exprs
+                    and len(child.key_exprs) == nk
+                    and isinstance(child.children[0],
+                                   ops.TpuHashAggregateExec)
+                    and child.children[0].mode == "partial"
+                    and len(child.children[0].grouping) == nk):
+                # own the partial->final hand-off exchange so only
+                # per-host REDUCED buffers cross DCN (hierarchical
+                # aggregation) instead of every partial buffer riding
+                # the generic two-stage exchange
+                part = emit(child.children[0])
+                ex = self._hierarchical_agg_exchange(
+                    node, part, track, expansion, run_phase)
+                return self._first_shard_only(
+                    run_phase(node._merge_final, ex), node)
             return self._first_shard_only(
                 run_phase(node._merge_final, emit(node.children[0])),
                 node)
@@ -1091,25 +1404,63 @@ class MeshQueryExecutor:
         part = run_phase(node._partial, child)
         nk = len(node.grouping)
         if nk:
-            key_cols = [part.columns[i] for i in range(nk)]
-            dest = pmod(murmur3_columns(key_cols), n)
-            slot = slot_capacity(part.capacity, n, expansion)
-            ex = track(all_to_all_batch(part, dest, n, slot, AXIS,
-                                        site="ici.exchange"))
+            ex = self._hierarchical_agg_exchange(
+                node, part, track, expansion, run_phase)
         else:
-            ex = gather_to_one(part, AXIS, n)
+            ex = gather_to_one(part, AXIS, self.chips)
+            if self.hosts > 1:
+                # after the ICI gather only each host's chip 0 holds
+                # rows; one host-axis gather lands them all on (0,0)
+                ex = gather_to_one(ex, HOST_AXIS, self.hosts,
+                                   site="dcn.gather")
         return self._first_shard_only(run_phase(node._merge_final, ex),
                                       node)
 
-    @staticmethod
-    def _first_shard_only(out: ColumnBatch,
+    def _hierarchical_agg_exchange(self, node, part: ColumnBatch,
+                                   track, expansion: int,
+                                   run_phase) -> ColumnBatch:
+        """DCN-aware grouped-aggregate hand-off. Global destination
+        shard g = hash(keys) % n decomposes as g = (g // chips) * chips
+        + (g % chips): stage 1 moves rows to chip g % chips over ICI
+        (within each host), a per-host _merge_buffers collapses
+        duplicate keys, and stage 2 moves the REDUCED buffers to host
+        g // chips over DCN — every key group still lands wholly on
+        global shard g, but the expensive tier carries merged rows
+        only. On a 1D mesh chips == n, so stage 1 alone is
+        byte-identical to the classic single-exchange lowering."""
+        nk = len(node.grouping)
+        key_cols = [part.columns[i] for i in range(nk)]
+        g = pmod(murmur3_columns(key_cols), self.n)
+        slot = slot_capacity(part.capacity, self.chips, expansion)
+        ex1 = track(all_to_all_batch(part, g % self.chips, self.chips,
+                                     slot, AXIS, site="ici.exchange"))
+        if self.hosts <= 1:
+            return ex1
+        merged = run_phase(node._merge_buffers, ex1)
+        g2 = pmod(murmur3_columns(
+            [merged.columns[i] for i in range(nk)]), self.n)
+        # The DCN slot BETS on the reduction: each destination host
+        # receives exactly one global shard's worth of MERGED groups,
+        # so the per-dest expectation is a 1/n share of the original
+        # shard — not the 1/hosts share a raw-row exchange would need
+        # (which is statically wire-equal to the ICI stage and would
+        # put as many bytes on the slow fabric as the fast one). A
+        # low-reduction aggregate (near-distinct keys) overflows the
+        # slot and recompiles with doubled expansion, like every slot.
+        slot2 = slot_capacity(part.capacity, self.n, expansion)
+        return track(all_to_all_batch(merged, g2 // self.chips,
+                                      self.hosts, slot2, HOST_AXIS,
+                                      site="dcn.exchange"))
+
+    def _first_shard_only(self, out: ColumnBatch,
                           node: ops.TpuHashAggregateExec) -> ColumnBatch:
         """A global (ungrouped) aggregate emits exactly one row — on
-        shard 0, where gather_to_one put the buffers; the per-shard merge
-        would otherwise emit its 'one row on empty input' everywhere."""
+        global shard 0, where gather_to_one put the buffers; the
+        per-shard merge would otherwise emit its 'one row on empty
+        input' everywhere."""
         if node.grouping:
             return out
-        me = lax.axis_index(AXIS)
+        me = self._global_index()
         nr = jnp.where(me == 0,
                        jnp.asarray(out.num_rows, jnp.int32).reshape(()),
                        jnp.int32(0))
@@ -1124,18 +1475,55 @@ class MeshQueryExecutor:
             # the whole plan falls back to the single-chip engine
             raise MeshCompileError(
                 "exchange pinned to the host shuffle path")
-        n = self.n
         if node.key_exprs:
+            # stage 1: intra-host by hash % chips over ICI (on a 1D
+            # mesh chips == n — the whole exchange, byte-identical to
+            # the classic lowering)
             ctx = EvalContext(child)
             kcols = [e.eval(ctx) for e in node.key_exprs]
-            dest = pmod(murmur3_columns(kcols), n)
-            slot = slot_capacity(child.capacity, n, expansion)
-            return track(all_to_all_batch(child, dest, n, slot, AXIS,
-                                          site="ici.exchange"))
+            g = pmod(murmur3_columns(kcols), self.n)
+            slot = slot_capacity(child.capacity, self.chips, expansion)
+            b1 = track(all_to_all_batch(child, g % self.chips,
+                                        self.chips, slot, AXIS,
+                                        site="ici.exchange"))
+            if self.hosts <= 1:
+                return b1
+            # stage 2: cross-host by hash // chips over DCN. The
+            # exchange preserves the schema, so the keys re-evaluate
+            # on the exchanged rows; g = (g//chips)*chips + (g%chips)
+            # lands every key group wholly on global shard g.
+            ctx1 = EvalContext(b1)
+            k1 = [e.eval(ctx1) for e in node.key_exprs]
+            g2 = pmod(murmur3_columns(k1), self.n)
+            # sized off the ORIGINAL shard capacity (not b1's inflated
+            # chips*slot one) so the DCN tier's static wire bytes stay
+            # below the ICI tier's; skew overflows recompile bigger
+            slot2 = slot_capacity(child.capacity, self.hosts, expansion)
+            return track(all_to_all_batch(b1, g2 // self.chips,
+                                          self.hosts, slot2, HOST_AXIS,
+                                          site="dcn.exchange"))
         if node.num_partitions == 1:
-            return gather_to_one(child, AXIS, n)
-        # round-robin repartition: balance rows across shards
-        dest = jnp.arange(child.capacity, dtype=jnp.int32) % n
-        slot = slot_capacity(child.capacity, n, expansion)
-        return track(all_to_all_batch(child, dest, n, slot, AXIS,
-                                      site="ici.exchange"))
+            out = gather_to_one(child, AXIS, self.chips)
+            if self.hosts > 1:
+                out = gather_to_one(out, HOST_AXIS, self.hosts,
+                                    site="dcn.gather")
+            return out
+        # round-robin repartition: balance rows across shards —
+        # intra-host spread over ICI, then (2D) a host-axis spread of
+        # the received rows over DCN
+        dest = jnp.arange(child.capacity, dtype=jnp.int32) % self.chips
+        slot = slot_capacity(child.capacity, self.chips, expansion)
+        out = track(all_to_all_batch(child, dest, self.chips, slot,
+                                     AXIS, site="ici.exchange"))
+        if self.hosts <= 1:
+            return out
+        # spread by LIVE-row rank (not slot position): stage 1's output
+        # is sparse (n_dest*slot with per-source tails), so a position
+        # modulus could pile live rows on one host; the rank modulus
+        # balances them exactly, which is what lets slot2 size off the
+        # original shard capacity and keep DCN wire bytes below ICI's
+        live2 = out.live_mask().astype(jnp.int32)
+        dest2 = (jnp.cumsum(live2) - 1) % self.hosts
+        slot2 = slot_capacity(child.capacity, self.hosts, expansion)
+        return track(all_to_all_batch(out, dest2, self.hosts, slot2,
+                                      HOST_AXIS, site="dcn.exchange"))

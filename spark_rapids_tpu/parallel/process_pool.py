@@ -108,7 +108,8 @@ _FWD_STRIP = ("seq", "ts", "schemaVersion", "queryId", "stage", "task",
 
 
 def _worker_main(worker_id: str, task_q, result_q, hb_addr,
-                 hb_interval_ms: int) -> None:
+                 hb_interval_ms: int,
+                 host_id: Optional[str] = None) -> None:
     """Worker process loop: register with the heartbeat plane, then
     drain the private task queue until the None sentinel. A task is
     (stage, task_index, attempt, fragment_path, args); results are
@@ -136,7 +137,8 @@ def _worker_main(worker_id: str, task_q, result_q, hb_addr,
         try:
             client = HeartbeatClient(tuple(hb_addr), worker_id,
                                      "127.0.0.1", 0,
-                                     interval_ms=hb_interval_ms)
+                                     interval_ms=hb_interval_ms,
+                                     host_id=host_id)
         except OSError:
             pass  # driver plane gone; the sentinel still covers us
     result_q.put(("ready", worker_id, None, None, None))
@@ -181,7 +183,8 @@ class ProcessWorkerPool:
                  start_method: Optional[str] = None,
                  heartbeat: bool = True,
                  hb_interval_ms: int = 100,
-                 hb_timeout_ms: int = 1500):
+                 hb_timeout_ms: int = 1500,
+                 hosts: int = 0):
         from spark_rapids_tpu.parallel.heartbeat import HeartbeatServer
 
         methods = mp.get_all_start_methods()
@@ -200,15 +203,25 @@ class ProcessWorkerPool:
             self._hb_server.manager.on_death(self._on_hb_death)
         self._workers: Dict[str, _WorkerHandle] = {}
         self._excluded: set = set()
+        # host failure domains: hosts > 1 partitions the workers into
+        # contiguous host groups and registers each with its host_id —
+        # one SIGKILL'd member then evicts the WHOLE group atomically
+        # through the heartbeat plane's host grouping. hosts <= 1
+        # keeps the classic independent per-worker timeouts.
+        nw = max(1, num_workers)
+        self._host_of: Dict[str, Optional[str]] = {}
         hb_addr = (list(self._hb_server.address)
                    if self._hb_server is not None else None)
-        for i in range(max(1, num_workers)):
+        for i in range(nw):
             wid = f"worker-{i}"
+            host_id = (f"host{i * int(hosts) // nw}"
+                       if hosts and int(hosts) > 1 else None)
+            self._host_of[wid] = host_id
             task_q = ctx.Queue()
             proc = ctx.Process(
                 target=_worker_main,
                 args=(wid, task_q, self._result_q, hb_addr,
-                      hb_interval_ms),
+                      hb_interval_ms, host_id),
                 name=f"srtpu-{wid}", daemon=True)
             proc.start()
             self._workers[wid] = _WorkerHandle(proc, task_q)
@@ -217,6 +230,20 @@ class ProcessWorkerPool:
         with self._lock:
             if executor_id in self._workers:
                 self._hb_dead.add(executor_id)
+
+    def on_host_death(self, cb) -> None:
+        """Hook the heartbeat plane's atomic host-group eviction feed
+        (fired with the host_id) — the device monitor's fence_host
+        glue for pool deployments."""
+        if self._hb_server is not None:
+            self._hb_server.manager.on_host_death(cb)
+
+    def worker_host(self, worker_id: str) -> Optional[str]:
+        return self._host_of.get(worker_id)
+
+    def host_workers(self, host_id: str) -> List[str]:
+        return sorted(w for w, h in self._host_of.items()
+                      if h == host_id)
 
     # --- scheduler-facing surface ---
 
@@ -242,7 +269,11 @@ class ProcessWorkerPool:
 
     def check_lost(self) -> List[str]:
         """Workers newly observed dead: heartbeat expiry (dead_peers
-        triggers the prune) OR the OS process sentinel."""
+        triggers the prune) OR the OS process sentinel. Either signal
+        condemns the worker's WHOLE host group when host failure
+        domains are on — the sentinel usually wins the race against
+        the heartbeat timeout, and it must not evict members one at a
+        time while the rest of the half-dead host keeps tasks."""
         if self._hb_server is not None:
             self._hb_server.manager.dead_peers()  # prunes + fires cbs
         lost = []
@@ -252,6 +283,18 @@ class ProcessWorkerPool:
                     continue
                 if not h.proc.is_alive() or wid in self._hb_dead:
                     lost.append(wid)
+        hosts = {self._host_of.get(w) for w in lost} - {None}
+        if hosts:
+            if self._hb_server is not None:
+                for hid in sorted(hosts):
+                    # fires on_death (-> _hb_dead) + on_host_death
+                    # (-> the device monitor's fence_host glue)
+                    self._hb_server.manager.condemn_host(hid)
+            with self._lock:
+                for wid in self._workers:
+                    if (wid not in self._excluded and wid not in lost
+                            and self._host_of.get(wid) in hosts):
+                        lost.append(wid)
         return lost
 
     def evict(self, worker_id: str) -> None:

@@ -114,6 +114,7 @@ class DeviceMonitor:
             "staleHandles": 0, "drainTimeouts": 0,
             "buffersDropped": 0, "buffersRestorable": 0,
             "resubmits": 0, "chipFences": 0, "chipRecoveries": 0,
+            "hostFences": 0, "hostRecoveries": 0,
         }
         self.last_recovery_ms = 0.0
 
@@ -135,6 +136,7 @@ class DeviceMonitor:
         out["lastRecoveryMs"] = round(self.last_recovery_ms, 3)
         out["fencedChips"] = len(_fenced_chips)
         out["chipEpoch"] = _chip_epoch
+        out["fencedHosts"] = len(_fenced_hosts)
         return out
 
     def note_stale_handle(self) -> None:
@@ -423,12 +425,82 @@ def chip_epoch() -> int:
 
 def clear_chip_fences() -> None:
     """Process-wide recovery rebuilt the backend: every device is new,
-    so per-chip fences from the old epoch no longer apply."""
+    so per-chip (and per-host) fences from the old epoch no longer
+    apply."""
     global _chip_epoch
     with _monitor._cv:
-        if _fenced_chips:
+        if _fenced_chips or _fenced_hosts:
             _fenced_chips.clear()
+            _fenced_hosts.clear()
             _chip_epoch += 1
+
+
+# ------------------------------------------------------ per-host fence
+#
+# One rung up from the per-chip scalpel: on a TPU pod the real failure
+# unit is a HOST — one process owns one host's chips, and when that
+# process dies (heartbeat silence, dcn collective failure, kill -9)
+# every chip it owned is gone at once. fence_host evicts the whole
+# group in ONE step (one chip-epoch bump, so the mesh rebuilds exactly
+# once rather than once per chip), the mesh engine re-plans over the
+# surviving hosts, and the serve layer flips only capacity — /readyz
+# stays ready with `fencedHosts` reported. unfence_host is the
+# host-rejoin path (repaired host re-registers): its chips return to
+# service and capacity bumps back.
+
+_fenced_hosts: Dict[str, tuple] = {}  # host_id -> fenced device ids
+
+
+def fence_host(host_id, device_ids, cause: str = "") -> int:
+    """Fence every chip of one host in a single step; returns the new
+    chip epoch. Idempotent per host (re-fencing bumps nothing)."""
+    global _chip_epoch
+    from spark_rapids_tpu.obs import events as obs_events
+
+    hid = str(host_id)
+    mon = _monitor
+    with mon._cv:
+        if hid in _fenced_hosts:
+            return _chip_epoch
+        ids = tuple(int(d) for d in device_ids)
+        _fenced_hosts[hid] = ids
+        _fenced_chips.update(ids)
+        _chip_epoch += 1
+        mon._stats["hostFences"] += 1
+        epoch = _chip_epoch
+    obs_events.emit("host.fence", host=hid, devices=list(ids),
+                    chipEpoch=epoch, cause=cause)
+    return epoch
+
+
+def unfence_host(host_id) -> None:
+    """Return a repaired host's chips to mesh service (the rejoin
+    path: capacity bumps back up on the next mesh build)."""
+    global _chip_epoch
+    from spark_rapids_tpu.obs import events as obs_events
+
+    hid = str(host_id)
+    mon = _monitor
+    with mon._cv:
+        ids = _fenced_hosts.pop(hid, None)
+        if ids is None:
+            return
+        _fenced_chips.difference_update(ids)
+        _chip_epoch += 1
+        epoch = _chip_epoch
+    obs_events.emit("host.unfence", host=hid, devices=list(ids),
+                    chipEpoch=epoch)
+
+
+def note_host_recovery() -> None:
+    with _monitor._cv:
+        _monitor._stats["hostRecoveries"] += 1
+
+
+def fenced_hosts() -> list:
+    """Sorted ids of the currently host-fenced failure domains."""
+    with _monitor._cv:
+        return sorted(_fenced_hosts)
 
 
 # ------------------------------------------------------- use-site API

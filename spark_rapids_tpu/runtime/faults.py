@@ -58,6 +58,18 @@ locations where the real world fails —
                         stale-handle gate: the deterministic proof
                         that pre-epoch handles raise instead of
                         reading recycled device memory
+    dcn.collective      multi-host SPMD dispatch
+                        (parallel/plan_compiler.py) — a transient
+                        cross-host (DCN) collective failure; bounded
+                        retries per spark.rapids.tpu.multihost.
+                        collectiveRetries before escalating to
+                        host-loss handling
+    host.fatal          multi-host SPMD dispatch — an entire HOST
+                        (one process's worth of chips) dies
+                        mid-collective: the mesh engine fences every
+                        chip of that host in one step (fence_host),
+                        rebuilds the mesh over the surviving hosts,
+                        and recovers the lost shards from lineage
 
 and every site's CONSUMER survives the injected fault: backoff retries
 (runtime/backoff.py), quarantine-and-recompile, or engine demotion.
@@ -103,6 +115,8 @@ KNOWN_SITES = (
     "device.lost_buffer",
     "ici.collective",
     "chip.fatal",
+    "dcn.collective",
+    "host.fatal",
 )
 
 

@@ -28,9 +28,13 @@ Lifecycle is production-grade:
 
 Liveness vs readiness: the daemon never dies on a device fence — the
 obs HTTP /healthz stays 200 (process alive) while /readyz reports 503
-with `fenced`/`fencedChips`/`draining`, so a load balancer routes
-around a recovering engine instead of restarting it and losing the
-warm compile cache the whole serving layer exists to keep."""
+with `fenced`/`fencedChips`/`fencedHosts`/`draining`, so a load
+balancer routes around a recovering engine instead of restarting it
+and losing the warm compile cache the whole serving layer exists to
+keep. A fenced chip or HOST only flips capacity (`fencedChips`/
+`fencedHosts` in a still-200 /readyz body): survivors keep serving
+over the rebuilt mesh, and a recovered host rejoining bumps capacity
+back."""
 
 from __future__ import annotations
 

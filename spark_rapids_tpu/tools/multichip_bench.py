@@ -226,8 +226,77 @@ def run_scaling(shards: Sequence[int] = (1, 2, 4, 8),
     }
 
 
+def _agg_only(spark):
+    from spark_rapids_tpu.api import functions as F
+
+    return (spark.read.parquet(DATA_DIR)
+            .groupBy("store")
+            .agg(F.sum("amount").alias("rev"),
+                 F.count("*").alias("sales")))
+
+
+def run_hosts(repeats: int = REPEATS) -> Dict:
+    """The multi-host axis (PR 17): the SAME 8 chips flat (1x8 — every
+    exchange on ICI) vs split into two simulated host failure domains
+    (2x4 — hash exchanges keep their heavy stage on ICI, only the
+    cross-host stage and reduced partial-agg buffers cross DCN). On one
+    machine both fabrics are the same host backplane, so wall-clock is
+    flat by construction; the measurement is the LEDGER split the
+    DCN-aware planner produces: `dcn_vs_ici` for the q5 exchange-bearing
+    plan (must stay < 1), and `dcn_reduction_factor` (ici/dcn) for an
+    agg-only shape — the factor by which the reduce-then-DCN placement
+    keeps traffic on the fast fabric rather than the cross-host links."""
+    ensure_data()
+
+    def ledger(spark, q):
+        out = q(spark).collect_arrow()
+        rec = spark.last_execution or {}
+        tel = rec.get("telemetry") or {}
+        moved = tel.get("bytesMoved") or {}
+        return out, rec.get("engine"), {
+            "iciBytes": moved.get("ici", 0),
+            "dcnBytes": moved.get("dcn", 0),
+        }
+
+    spark = _session({"spark.rapids.tpu.mesh": 8})
+    try:
+        out_flat, eng_flat, flat = ledger(spark, _q5)
+    finally:
+        spark.stop()
+
+    spark = _session({"spark.rapids.tpu.mesh": 8,
+                      "spark.rapids.tpu.multihost.simulatedHosts": 2})
+    try:
+        out_2x4, eng_2x4, q5_2x4 = ledger(spark, _q5)
+        _, _, agg_2x4 = ledger(spark, _agg_only)
+    finally:
+        spark.stop()
+
+    assert eng_flat == "mesh" and eng_2x4 == "mesh", (eng_flat, eng_2x4)
+    flat_rev = {r: round(v, 2) for r, v in zip(
+        out_flat.column("region").to_pylist(),
+        out_flat.column("rev").to_pylist())}
+    rev_2x4 = {r: round(v, 2) for r, v in zip(
+        out_2x4.column("region").to_pylist(),
+        out_2x4.column("rev").to_pylist())}
+    assert set(flat_rev) == set(rev_2x4), (flat_rev, rev_2x4)
+
+    dcn, ici = q5_2x4["dcnBytes"], q5_2x4["iciBytes"]
+    adcn, aici = agg_2x4["dcnBytes"], agg_2x4["iciBytes"]
+    return {
+        "metric": "q5 byte placement, 1x8 flat vs 2x4 host domains "
+                  "(hash exchanges on ICI, reduced traffic on DCN)",
+        "q5_1x8": flat,
+        "q5_2x4": {**q5_2x4,
+                   "dcn_vs_ici": round(dcn / ici, 3) if ici else None},
+        "agg_2x4": agg_2x4,
+        "dcn_reduction_factor": round(aici / adcn, 3) if adcn else None,
+    }
+
+
 def main() -> None:
     block = run_scaling()
+    block["hosts"] = run_hosts()
     print(json.dumps(block))
 
 

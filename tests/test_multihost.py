@@ -1,0 +1,322 @@
+"""Multi-host failure-domain suite (PR 17): 2D (hosts x chips) mesh
+semantics on the virtual 8-device CPU mesh via simulated host groups.
+
+Covers the pod-scale contract end to end:
+- host_groups topology oracle (real process grouping is exercised by
+  tests/test_multiprocess.py; here the simulated split);
+- multihost.initialize(): idempotent for identical args, a clear
+  RuntimeError for different args (the old silent return hid
+  misconfiguration), and a multihost.init obs event on first wiring;
+- heartbeat host failure domains: one silent member evicts its WHOLE
+  host group atomically, fires on_host_death, and a re-registering
+  executor rejoins with a fresh seq;
+- device_monitor.fence_host / unfence_host: one epoch step for the
+  whole host, fencedHosts in counters(), capacity-only semantics;
+- the 2D mesh itself: a simulated two-host query is oracle-identical
+  with DCN bytes ledgered BELOW ICI bytes (hierarchical placement),
+  and host.fatal chaos recovers over the survivor host.
+"""
+
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.obs import events as obs_events
+from spark_rapids_tpu.parallel import multihost
+from spark_rapids_tpu.parallel.heartbeat import HeartbeatManager
+from spark_rapids_tpu.runtime import device_monitor as dm
+from spark_rapids_tpu.runtime import faults
+from spark_rapids_tpu.testing.asserts import (
+    assert_tables_equal,
+    with_cpu_session,
+    with_tpu_session,
+)
+
+MH = {"spark.rapids.tpu.mesh": 8,
+      "spark.rapids.tpu.multihost.simulatedHosts": 2,
+      "spark.sql.shuffle.partitions": 4,
+      "spark.sql.autoBroadcastJoinThreshold": -1}
+
+
+@pytest.fixture(autouse=True)
+def _isolated_host_state():
+    faults.install(faults.FaultRegistry())
+    dm.clear_chip_fences()
+    yield
+    faults.install(faults.FaultRegistry())
+    dm.clear_chip_fences()
+
+
+# ------------------------------------------------------ topology oracle
+
+def test_host_groups_simulated_split(cpu_devices):
+    groups = multihost.host_groups(cpu_devices, simulated_hosts=2)
+    assert len(groups) == 2
+    assert [len(g) for g in groups] == [4, 4]
+    # host-major contiguous: group i is devices [4i, 4i+4)
+    assert [d.id for d in groups[0]] == [d.id for d in cpu_devices[:4]]
+    assert [d.id for d in groups[1]] == [d.id for d in cpu_devices[4:]]
+
+
+def test_host_groups_defaults_to_one(cpu_devices):
+    assert multihost.host_groups(cpu_devices) == [list(cpu_devices)]
+    assert multihost.host_groups(cpu_devices, 0) == [list(cpu_devices)]
+    # more hosts than devices: cannot split, stays 1D
+    assert multihost.host_groups(cpu_devices[:1], 4) \
+        == [list(cpu_devices[:1])]
+
+
+def test_host_groups_drops_ragged_remainder(cpu_devices):
+    groups = multihost.host_groups(cpu_devices[:7], 2)
+    assert [len(g) for g in groups] == [3, 3]
+
+
+# ------------------------------------------------- initialize contract
+
+@pytest.fixture
+def _fresh_multihost(monkeypatch):
+    calls = []
+    monkeypatch.setattr(multihost.jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    monkeypatch.setattr(multihost, "_initialized", False)
+    monkeypatch.setattr(multihost, "_init_args", None)
+    return calls
+
+
+def test_initialize_idempotent_same_args(_fresh_multihost):
+    multihost.initialize("10.0.0.1:8476", 2, 0)
+    multihost.initialize("10.0.0.1:8476", 2, 0)  # silent no-op
+    assert len(_fresh_multihost) == 1
+
+
+def test_initialize_different_args_raises(_fresh_multihost):
+    multihost.initialize("10.0.0.1:8476", 2, 0)
+    with pytest.raises(RuntimeError, match="different arguments"):
+        multihost.initialize("10.0.0.2:8476", 2, 1)
+    assert len(_fresh_multihost) == 1  # never re-wired
+
+
+def test_initialize_emits_obs_event(_fresh_multihost):
+    seen = []
+    bus = obs_events.EventBus()
+    bus.subscribe(seen.append)
+    prev = obs_events.install(bus)
+    try:
+        multihost.initialize("10.0.0.1:8476", 2, 0)
+    finally:
+        obs_events.install(prev)
+    inits = [e for e in seen if e["event"] == "multihost.init"]
+    assert len(inits) == 1
+    ev = inits[0]
+    assert ev["processes"] >= 1 and ev["devices"] >= 1
+    assert ev["localDevices"] >= 1 and ev["processIndex"] >= 0
+
+
+# ------------------------------------------- heartbeat failure domains
+
+def test_host_group_evicts_atomically():
+    mgr = HeartbeatManager(timeout_ms=50)
+    dead, dead_hosts = [], []
+    mgr.on_death(dead.append)
+    mgr.on_host_death(dead_hosts.append)
+    mgr.register("w1", "127.0.0.1", 1001, host_id="hostA")
+    mgr.register("w2", "127.0.0.1", 1002, host_id="hostA")
+    mgr.register("w3", "127.0.0.1", 1003, host_id="hostB")
+    # only w1 goes silent; w2 beat recently — but its host is gone
+    mgr._last_seen["w1"] = time.monotonic() - 10.0
+    assert sorted(mgr.dead_peers()) == ["w1", "w2"]
+    assert dead_hosts == ["hostA"]
+    assert sorted(dead) == ["w1", "w2"]
+    live = [p["executor_id"] for p in mgr.live_peers()]
+    assert live == ["w3"], "hostB must be untouched"
+
+
+def test_no_host_id_keeps_independent_timeouts():
+    mgr = HeartbeatManager(timeout_ms=50)
+    mgr.register("w1", "127.0.0.1", 1001)
+    mgr.register("w2", "127.0.0.1", 1002)
+    mgr._last_seen["w1"] = time.monotonic() - 10.0
+    assert mgr.dead_peers() == ["w1"]
+    assert [p["executor_id"] for p in mgr.live_peers()] == ["w2"]
+
+
+def test_reregister_after_host_eviction_gets_fresh_seq():
+    mgr = HeartbeatManager(timeout_ms=50)
+    _, seq1 = mgr.register("w1", "127.0.0.1", 1001, host_id="hostA")
+    mgr.register("w2", "127.0.0.1", 1002, host_id="hostA")
+    mgr._last_seen["w1"] = time.monotonic() - 10.0
+    assert sorted(mgr.dead_peers()) == ["w1", "w2"]
+    _, seq2 = mgr.register("w1", "127.0.0.1", 1001, host_id="hostA")
+    assert seq2 > seq1
+    assert mgr.dead_peers() == ["w2"]
+    assert [p["executor_id"] for p in mgr.live_peers()] == ["w1"]
+
+
+def test_condemn_host_evicts_group_without_timeout():
+    """External death evidence (OS process sentinel) must not wait out
+    a heartbeat timeout: condemn_host evicts the whole group NOW."""
+    mgr = HeartbeatManager(timeout_ms=60_000)
+    dead, dead_hosts = [], []
+    mgr.on_death(dead.append)
+    mgr.on_host_death(dead_hosts.append)
+    mgr.register("w1", "127.0.0.1", 1001, host_id="hostA")
+    mgr.register("w2", "127.0.0.1", 1002, host_id="hostA")
+    mgr.register("w3", "127.0.0.1", 1003, host_id="hostB")
+    mgr.condemn_host("hostA")
+    assert sorted(mgr.dead_peers()) == ["w1", "w2"]
+    assert dead_hosts == ["hostA"] and sorted(dead) == ["w1", "w2"]
+    mgr.condemn_host("hostA")  # no live members left: no-op
+    assert dead_hosts == ["hostA"]
+    assert [p["executor_id"] for p in mgr.live_peers()] == ["w3"]
+
+
+def test_evict_condemns_one_worker_not_its_host():
+    mgr = HeartbeatManager(timeout_ms=60_000)
+    mgr.register("w1", "127.0.0.1", 1001, host_id="hostA")
+    mgr.register("w2", "127.0.0.1", 1002, host_id="hostA")
+    mgr.evict("w1")  # observed TASK failure: not host evidence
+    assert mgr.dead_peers() == ["w1"]
+    assert [p["executor_id"] for p in mgr.live_peers()] == ["w2"]
+
+
+# --------------------------------------------------- host fence ladder
+
+def test_fence_host_one_epoch_step():
+    ep0 = dm.chip_epoch()
+    before = dm.counters()
+    ep1 = dm.fence_host("simH", [6, 7], cause="test")
+    after = dm.counters()
+    assert ep1 == ep0 + 1, "whole host must fence in ONE epoch step"
+    assert dm.fenced_chips() == {6, 7}
+    assert dm.fenced_hosts() == ["simH"]
+    assert after["fencedHosts"] == 1
+    assert after["hostFences"] == before["hostFences"] + 1
+    assert after["fences"] == before["fences"], \
+        "host fence must not escalate to a process-wide fence"
+    dm.unfence_host("simH")
+    assert dm.fenced_chips() == set()
+    assert dm.fenced_hosts() == []
+    assert dm.chip_epoch() == ep1 + 1
+
+
+def test_fence_host_idempotent():
+    ep1 = dm.fence_host("simH", [7], cause="test")
+    assert dm.fence_host("simH", [7], cause="dup") == ep1
+    assert dm.counters()["fencedHosts"] == 1
+    dm.unfence_host("simH")
+    dm.unfence_host("simH")  # no-op
+    assert dm.fenced_hosts() == []
+
+
+# ----------------------------------------------------- the 2D mesh SQL
+
+def _mk_table(n=4096, seed=11):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": pa.array(rng.integers(0, 40, n), pa.int64()),
+        "v": pa.array(rng.random(n) * 10.0),
+    })
+
+
+def _agg(s, t):
+    return (s.createDataFrame(t)
+            .filter(F.col("v") > 1.0)
+            .groupBy("k")
+            .agg(F.sum("v").alias("s"), F.count("*").alias("c")))
+
+
+def test_two_host_agg_oracle_and_dcn_below_ici():
+    t = _mk_table()
+    captured = {}
+
+    def run(s):
+        out = _agg(s, t).collect_arrow()
+        captured.update(s.last_execution)
+        return out
+
+    got = with_tpu_session(run, MH)
+    want = with_cpu_session(lambda s: _agg(s, t).collect_arrow(), {})
+    assert_tables_equal(got, want, ignore_order=True)
+    assert captured["engine"] == "mesh"
+    tel = captured.get("telemetry") or {}
+    moved = tel.get("bytesMoved") or {}
+    assert moved.get("dcn", 0) > 0, f"no DCN bytes ledgered: {moved}"
+    assert moved.get("ici", 0) > 0, moved
+    assert moved["dcn"] < moved["ici"], \
+        f"hierarchical placement must keep DCN below ICI: {moved}"
+    assert tel.get("dcnBytes") == moved["dcn"]
+
+
+def test_two_host_agg_low_reduction_recompiles_and_agrees():
+    """The DCN slot is sized BETTING on per-host merge reduction (a
+    1/n global-shard share). Near-distinct keys break that bet: the
+    slot overflows and the query must converge through the doubled-
+    expansion recompile ladder, still oracle-identical."""
+    rng = np.random.default_rng(19)
+    n = 4096
+    t = pa.table({
+        "k": pa.array(rng.permutation(n).astype(np.int64)),
+        "v": pa.array(rng.random(n) * 10.0),
+    })
+
+    def q(s):
+        return (s.createDataFrame(t).groupBy("k")
+                .agg(F.sum("v").alias("s"), F.count("*").alias("c")))
+
+    got = with_tpu_session(lambda s: q(s).collect_arrow(), MH)
+    want = with_cpu_session(lambda s: q(s).collect_arrow(), {})
+    assert_tables_equal(got, want, ignore_order=True)
+
+
+def test_host_fatal_recovers_over_survivor():
+    t = _mk_table(seed=13)
+    conf = {**MH,
+            "spark.rapids.tpu.chaos.enabled": True,
+            "spark.rapids.tpu.chaos.seed": 5,
+            "spark.rapids.tpu.chaos.sites": "host.fatal:once"}
+    captured = {}
+
+    def run(s):
+        out = _agg(s, t).collect_arrow()
+        # session init installs a FRESH DeviceMonitor (configure()), so
+        # counters must be read inside THIS session — the CPU-oracle
+        # session below would zero them
+        captured["counters"] = dm.counters()
+        captured["kinds"] = [e["event"] for e in s.obs.history.events()]
+        return out
+
+    got = with_tpu_session(run, conf)
+    want = with_cpu_session(lambda s: _agg(s, t).collect_arrow(), {})
+    assert_tables_equal(got, want, ignore_order=True)
+    after = captured["counters"]
+    assert after["hostFences"] == 1
+    assert after["hostRecoveries"] == 1
+    assert after["fences"] == 0, \
+        "host loss must not escalate to a process-wide fence"
+    assert "host.fence" in captured["kinds"]
+    assert "host.recovery" in captured["kinds"]
+
+
+def test_multihost_unsupported_sort_falls_back():
+    t = _mk_table(seed=17)
+
+    def q(s):
+        return (s.createDataFrame(t)
+                .orderBy("v")
+                .limit(16))
+
+    captured = {}
+
+    def run(s):
+        out = q(s).collect_arrow()
+        captured.update(s.last_execution)
+        return out
+
+    got = with_tpu_session(run, MH)
+    want = with_cpu_session(lambda s: q(s).collect_arrow(), {})
+    assert_tables_equal(got, want, ignore_order=False)
+    assert captured["engine"] != "mesh", \
+        "global sort must fall back off the multi-host mesh"
