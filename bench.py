@@ -451,6 +451,128 @@ def _serve_probe(spark) -> dict:
         d.stop()
 
 
+def _fleet_probe() -> dict:
+    """Fleet probe (opt-in via --fleet): qps of the SAME closed loop
+    through the front door at 1/2/3 replicas, wire p50/p99, the
+    failover blip a kill -9 opens (time from kill to the next
+    completed query), and the affinity hit ratio vs the 1/N random
+    baseline. Replicas are real subprocesses with their own sessions,
+    so this is gated off the default bench run — the nightly passes
+    --fleet and records the block."""
+    import statistics
+    import threading
+
+    from spark_rapids_tpu.serve.client import ServeClient
+    from spark_rapids_tpu.serve.router import FleetRouter
+    from spark_rapids_tpu.serve.supervisor import ReplicaSupervisor
+
+    # a modest dedicated dataset: this probe measures routing, wire
+    # and failover overhead — not scan throughput (the main bench does)
+    fleet_dir = "/tmp/srtpu_bench_fleet_v1"
+    marker = os.path.join(fleet_dir, "_DONE")
+    if not os.path.exists(marker):
+        os.makedirs(fleet_dir, exist_ok=True)
+        rng = np.random.default_rng(7)
+        n = 200_000
+        pq.write_table(pa.table({
+            "store": pa.array(rng.integers(0, 64, n), pa.int64()),
+            "amount": pa.array(rng.random(n) * 100.0),
+        }), os.path.join(fleet_dir, "p0.parquet"))
+        open(marker, "w").write("1")
+    spec = {"op": "agg",
+            "input": {"op": "filter",
+                      "input": {"op": "parquet", "path": fleet_dir},
+                      "cond": {"fn": ">", "args": [{"col": "amount"},
+                                                   {"param": "lo"}]}},
+            "groupBy": ["store"],
+            "aggs": [{"fn": "sum", "col": "amount", "as": "rev"}]}
+    bindings = [{"lo": 10.0}, {"lo": 50.0}, {"lo": 90.0}]
+    tenants = ["acme", "globex", "initech"]
+
+    def closed_loop(port, rounds):
+        lat_ms, lock = [], threading.Lock()
+
+        def worker(tenant):
+            with ServeClient("127.0.0.1", port, tenant,
+                             connect_attempts=10) as c:
+                for r in range(rounds):
+                    t0 = time.perf_counter()
+                    c.query(spec, params=bindings[r % 3])
+                    with lock:
+                        lat_ms.append(
+                            (time.perf_counter() - t0) * 1000.0)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in tenants]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600)
+        return lat_ms, time.perf_counter() - t0
+
+    def pct(sorted_ms, q):
+        if not sorted_ms:
+            return None
+        return round(sorted_ms[min(len(sorted_ms) - 1,
+                                   int(round(q * (len(sorted_ms)
+                                                  - 1))))], 1)
+
+    sup = ReplicaSupervisor(conf={}, replica_confs=[{}, {}, {}])
+    sup.start()
+    out = {"scaling": {}}
+    try:
+        eps = sup.wait_ready(timeout_ms=300_000)
+        # qps at 1/2/3 replicas: same loop, growing member list
+        for n in (1, 2, 3):
+            r = FleetRouter(endpoints=eps[:n]).start()
+            try:
+                closed_loop(r.port, rounds=1)  # warm each plan cache
+                lat, wall = closed_loop(r.port, rounds=4)
+                lat.sort()
+                out["scaling"][str(n)] = {
+                    "qps": round(len(lat) / wall, 2) if wall else None,
+                    "latencyMsP50": pct(lat, 0.50),
+                    "latencyMsP99": pct(lat, 0.99),
+                }
+            finally:
+                r.stop()
+        # affinity: a repeated spec pins to its rendezvous replica
+        r = FleetRouter(
+            supervisor=sup,
+            conf={"spark.rapids.tpu.fleet.health.intervalMs": 100,
+                  "spark.rapids.tpu.fleet.failover.maxAttempts":
+                  6}).start()
+        try:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and \
+                    len(r.health()["routable"]) < 3:
+                time.sleep(0.1)
+            hits = {}
+            with ServeClient("127.0.0.1", r.port, "acme") as c:
+                for _ in range(9):
+                    c.query(spec, params=bindings[0])
+                    rep = c.last_result["replica"]
+                    hits[rep] = hits.get(rep, 0) + 1
+                out["affinityHitRatio"] = round(
+                    max(hits.values()) / sum(hits.values()), 3)
+                out["affinityRandomBaseline"] = round(1 / 3, 3)
+                # failover blip: kill -9 the pinned replica, clock the
+                # gap until the next completed query
+                victim = max(hits, key=hits.get)
+                t0 = time.perf_counter()
+                sup.kill(victim)
+                c.query(spec, params=bindings[0])
+                out["failoverBlipMs"] = round(
+                    (time.perf_counter() - t0) * 1000.0, 1)
+                out["failoverLandedOn"] = c.last_result["replica"]
+        finally:
+            r.stop()
+    finally:
+        sup.stop()
+    return out
+
+
 def cold_probe():
     """--cold-probe: the warm-persistent-cache cold start. Runs in a
     FRESH process after the main bench warmed the compile cache, so it
@@ -835,6 +957,18 @@ def main():
     except Exception as e:  # never lose the perf report
         print(f"# serve block unavailable: {e!r}", flush=True)
 
+    # ---- fleet block (serve/router.py + serve/supervisor.py):
+    # ---- qps at 1/2/3 subprocess replicas behind the front door,
+    # ---- affinity hit ratio vs random, and the kill -9 failover
+    # ---- blip — opt-in (--fleet) because it spawns real replica
+    # ---- processes; the nightly passes it
+    fleet_block = None
+    if "--fleet" in sys.argv:
+        try:
+            fleet_block = _fleet_probe()
+        except Exception as e:  # never lose the perf report
+            print(f"# fleet block unavailable: {e!r}", flush=True)
+
     print(json.dumps({
         "metric": f"q5 join+agg engine throughput over device-cached"
                   f" tables ({dev.platform}, {ROWS} rows x {STORES}-row"
@@ -893,6 +1027,9 @@ def main():
         # serving layer (serve/): daemon qps, wire latency p50/p99,
         # shed rate, plan-cache hit ratio of a 3-tenant closed loop
         "serve": serve_block,
+        # serving fleet (--fleet): front-door qps at 1/2/3 replicas,
+        # affinity hit ratio, kill -9 failover blip
+        "fleet": fleet_block,
     }))
 
 

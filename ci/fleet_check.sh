@@ -1,0 +1,295 @@
+#!/usr/bin/env bash
+# Fleet gate — the fault-tolerant serving fleet under chaos.
+# A 3-replica fleet (process-per-replica supervisor) behind the
+# health-routed front door serves a 3-tenant closed-loop soak. The
+# acceptance contract, in three phases:
+#   1. calm soak: every result oracle-identical, and per-tenant
+#      billing reconciles EXACTLY across the replica ledgers — each
+#      completed query billed once, on exactly one replica; the
+#      idempotency window proves a resubmitted requestId replays
+#      without re-executing or re-billing.
+#   2. chaos soak: kill -9 a ready replica mid-soak — queries shed to
+#      the survivors transparently (ZERO client-visible failures),
+#      results stay oracle-identical, and the supervisor crash-loops
+#      the victim back to ready.
+#   3. rolling restart drill: restart every replica one at a time
+#      under live traffic — zero failed queries, and the router's
+#      plan-cache affinity keeps a repeated spec pinned to one
+#      replica (hit ratio strictly above the 1/N random baseline).
+# Ends leak-free: zero router connections/threads, every replica
+# process reaped, then the fleet pytest suite.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
+
+echo "== fleet soak (3 replicas x 3 tenants + kill -9 + rolling restart) =="
+python - <<'PY'
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import json
+import math
+import os
+import random
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu.api.session import TpuSparkSession
+from spark_rapids_tpu.serve.client import ServeClient
+from spark_rapids_tpu.serve.plan_cache import affinity_key
+from spark_rapids_tpu.serve.router import FleetRouter
+from spark_rapids_tpu.serve.supervisor import ReplicaSupervisor
+
+root = tempfile.mkdtemp(prefix="srtpu_fleet_gate_")
+rng = np.random.default_rng(23)
+N = 20_000
+data = os.path.join(root, "fact")
+os.makedirs(data)
+pq.write_table(pa.table({
+    "k": pa.array(rng.integers(0, 32, N), pa.int64()),
+    "v": pa.array(rng.random(N) * 100.0),
+}), os.path.join(data, "p0.parquet"))
+
+SPECS = {
+    "sum": {"op": "orderBy",
+            "input": {"op": "agg",
+                      "input": {"op": "parquet", "path": data},
+                      "groupBy": ["k"],
+                      "aggs": [{"fn": "sum", "col": "v", "as": "x"}]},
+            "keys": ["k"]},
+    "cnt": {"op": "orderBy",
+            "input": {"op": "agg",
+                      "input": {"op": "filter",
+                                "input": {"op": "parquet",
+                                          "path": data},
+                                "cond": {"fn": ">",
+                                         "args": [{"col": "v"},
+                                                  {"param": "lo"}]}},
+                      "groupBy": ["k"],
+                      "aggs": [{"fn": "count", "col": "*",
+                                "as": "x"}]},
+            "keys": ["k"]},
+}
+PARAMS = {"cnt": [{"lo": 25.0}, {"lo": 75.0}]}
+
+
+def bindings(name):
+    return PARAMS.get(name, [None])
+
+
+def same(a, b):
+    if set(a) != set(b):
+        return False
+    for col in a:
+        if len(a[col]) != len(b[col]):
+            return False
+        for x, y in zip(a[col], b[col]):
+            if isinstance(x, float) or isinstance(y, float):
+                if not math.isclose(x, y, rel_tol=1e-6, abs_tol=1e-8):
+                    return False
+            elif x != y:
+                return False
+    return True
+
+
+# --- oracle: the SAME specs through an embedded session ---
+from spark_rapids_tpu.serve.spec import compile_spec
+
+s0 = TpuSparkSession({})
+want = {}
+for name in SPECS:
+    for p in bindings(name):
+        want[(name, json.dumps(p))] = compile_spec(
+            SPECS[name], s0, p or {}).collect_arrow().to_pydict()
+s0.stop()
+
+# --- the fleet: 3 replica processes behind the front door ---
+REPLICA_CONF = {"spark.sql.shuffle.partitions": 4}
+sup = ReplicaSupervisor(conf={}, replica_confs=[dict(REPLICA_CONF)
+                                                for _ in range(3)])
+sup.start()
+eps = sup.wait_ready(timeout_ms=300_000)
+assert len(eps) == 3, eps
+rtr = FleetRouter(
+    supervisor=sup,
+    conf={"spark.rapids.tpu.fleet.health.intervalMs": 100,
+          "spark.rapids.tpu.fleet.failover.maxAttempts": 6}).start()
+deadline = time.monotonic() + 30
+while time.monotonic() < deadline and \
+        len(rtr.health()["routable"]) < 3:
+    time.sleep(0.1)
+assert len(rtr.health()["routable"]) == 3, rtr.health()
+
+# fleet observability actually flows: srtpu_fleet_* on the prom surface
+from spark_rapids_tpu.obs import prom
+
+text = prom.render(None)
+assert "srtpu_fleet_router_replicas" in text, text[:400]
+assert "srtpu_fleet_supervisor_spawns" in text, text[:400]
+
+TENANTS = ["acme", "globex", "initech"]
+errors, mismatches = [], []
+completed = {t: 0 for t in TENANTS}
+lock = threading.Lock()
+rid_seq = [0]
+
+
+def worker(tenant, rounds, seed, phase):
+    prng = random.Random(seed)
+    try:
+        with ServeClient("127.0.0.1", rtr.port, tenant,
+                         connect_attempts=10) as c:
+            for _ in range(rounds):
+                name = prng.choice(sorted(SPECS))
+                p = prng.choice(bindings(name))
+                with lock:
+                    rid_seq[0] += 1
+                    rid = f"{phase}-{tenant}-{rid_seq[0]}"
+                got = c.query(SPECS[name], params=p, request_id=rid,
+                              timeout_ms=120_000)
+                with lock:
+                    completed[tenant] += 1
+                    if not same(got.to_pydict(),
+                                want[(name, json.dumps(p))]):
+                        mismatches.append((tenant, name, p))
+    except BaseException as e:
+        with lock:
+            errors.append((tenant, repr(e)))
+
+
+def run_phase(phase, rounds, chaos=None):
+    threads = [threading.Thread(target=worker,
+                                args=(t, rounds, i + hash(phase) % 97,
+                                      phase))
+               for i, t in enumerate(TENANTS)
+               for _ in range(2)]
+    for t in threads:
+        t.start()
+    if chaos is not None:
+        chaos()
+    for t in threads:
+        t.join(300)
+    assert not any(t.is_alive() for t in threads), \
+        f"{phase}: fleet worker hung"
+    assert not errors, f"{phase}: client-visible failures: {errors}"
+    assert not mismatches, f"{phase}: result mismatch: {mismatches}"
+
+
+# ---- phase 1: calm soak, then billing reconciliation ----
+run_phase("calm", rounds=4)
+ledgers = {}
+for ep in sup.endpoints():
+    with ServeClient(ep["host"], ep["port"], "auditor") as a:
+        ledgers[ep["name"]] = a.status()["tenants"]
+for t in TENANTS:
+    billed = sum(led.get(t, {}).get("queries", 0)
+                 for led in ledgers.values())
+    assert billed == completed[t], \
+        f"billing skew for {t}: {billed} billed vs " \
+        f"{completed[t]} completed ({ledgers})"
+print(f"fleet calm phase: {dict(completed)} completed, billing "
+      f"reconciles across {len(ledgers)} replica ledgers")
+
+# ---- idempotency: a resubmitted requestId replays, never re-executes
+with ServeClient("127.0.0.1", rtr.port, "acme") as c:
+    t1 = c.query(SPECS["sum"], request_id="idem-ci")
+    first = dict(c.last_result)
+    t2 = c.query(SPECS["sum"], request_id="idem-ci")
+    assert c.last_result.get("dedupe") is True, c.last_result
+    assert c.last_result["replica"] == first["replica"]
+    assert t2.to_pydict() == t1.to_pydict()
+with ServeClient("127.0.0.1",
+                 [e for e in sup.endpoints()
+                  if e["name"] == first["replica"]][0]["port"],
+                 "auditor") as a:
+    st = a.status()
+    assert st["dedupe"]["replays"] >= 1, st["dedupe"]
+    assert st["tenants"]["acme"]["queries"] == \
+        ledgers[first["replica"]].get("acme", {}).get("queries", 0) \
+        + 1, "dedupe replay was billed"
+print("fleet idempotency: replayed once, billed once")
+
+# ---- phase 2: kill -9 a ready replica mid-soak ----
+victims = [0]
+
+
+def kill_one():
+    time.sleep(0.3)
+    name = sup.endpoints()[0]["name"]
+    assert sup.kill(name)
+    victims[0] += 1
+    print(f"fleet chaos: kill -9 {name} mid-soak")
+
+
+run_phase("chaos", rounds=6, chaos=kill_one)
+deadline = time.monotonic() + 300
+while time.monotonic() < deadline and len(sup.endpoints()) < 3:
+    time.sleep(0.2)
+assert len(sup.endpoints()) == 3, sup.stats_snapshot()
+assert sup.stats_snapshot()["restarts"] >= 1, sup.stats_snapshot()
+print(f"fleet chaos phase: {dict(completed)} completed, zero "
+      f"client-visible failures, victim crash-looped back "
+      f"(router: {rtr.stats_snapshot()})")
+
+# ---- phase 3: rolling restart drill under live traffic ----
+# affinity first: a repeated spec must pin to its rendezvous replica
+hits = {}
+with ServeClient("127.0.0.1", rtr.port, "acme") as c:
+    for i in range(12):
+        c.query(SPECS["cnt"], params={"lo": 25.0},
+                request_id=f"aff-{i}")
+        hits[c.last_result["replica"]] = \
+            hits.get(c.last_result["replica"], 0) + 1
+ratio = max(hits.values()) / sum(hits.values())
+assert ratio > 1.0 / 3.0 + 0.2, \
+    f"affinity hit ratio {ratio} not above the random baseline ({hits})"
+
+drill_done = threading.Event()
+
+
+def drill():
+    try:
+        for ep in list(sup.endpoints()):
+            sup.restart_replica(ep["name"], timeout_ms=300_000)
+    finally:
+        drill_done.set()
+
+
+d = threading.Thread(target=drill)
+d.start()
+while not drill_done.is_set():
+    run_phase("drill", rounds=2)
+d.join(600)
+assert not d.is_alive(), "rolling restart drill hung"
+assert len(sup.endpoints()) == 3
+print(f"fleet drill phase: {dict(completed)} completed, rolling "
+      f"restart with zero failures, affinity hit ratio {ratio:.2f} "
+      f"(random baseline 0.33)")
+
+# ---- teardown: leak-free ----
+rtr.stop()
+sup.stop()
+leaks = rtr.leak_report()
+assert leaks == {"connections": 0, "handlerThreads": 0,
+                 "listener": 0}, leaks
+for r in sup._replicas:
+    assert r.proc is not None and r.proc.poll() is not None, \
+        f"leaked replica process {r.name}"
+assert not [t for t in threading.enumerate()
+            if t.name.startswith("srtpu-fleet")], "leaked thread"
+print("FLEET SOAK PASS")
+os._exit(0)  # pre-existing XLA exit-time abort after session cycling
+PY
+
+echo "== fleet suite (router + supervisor + dedupe + escalation) =="
+python -m pytest tests/test_fleet.py -q -p no:cacheprovider
+
+echo "FLEET GATE PASS"

@@ -6,7 +6,7 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 out="bench-$(date +%Y%m%d).json"
-timeout 1800 python bench.py | tee "$out"
+timeout 2400 python bench.py --fleet | tee "$out"
 
 python - "$out" <<'PY'
 import json, sys, datetime, os
@@ -14,6 +14,7 @@ import json, sys, datetime, os
 line = [l for l in open(sys.argv[1]) if l.strip().startswith("{")][-1]
 d = json.loads(line)
 serve = d.get("serve") or {}
+fleet = d.get("fleet") or {}
 hosts = (d.get("multichip") or {}).get("hosts") or {}
 entry = {
     "date": datetime.date.today().isoformat(),
@@ -24,6 +25,14 @@ entry = {
     "serve_qps": serve.get("qps"),
     "serve_p99_ms": serve.get("latencyMsP99"),
     "serve_plan_cache_hit_ratio": serve.get("planCacheHitRatio"),
+    # fleet tracking (PR 18): front-door qps at 1/3 replicas, the
+    # kill -9 failover blip, and affinity routing quality
+    "fleet_qps_1": (fleet.get("scaling") or {}).get("1", {}).get("qps"),
+    "fleet_qps_3": (fleet.get("scaling") or {}).get("3", {}).get("qps"),
+    "fleet_p99_ms_3":
+        (fleet.get("scaling") or {}).get("3", {}).get("latencyMsP99"),
+    "fleet_failover_blip_ms": fleet.get("failoverBlipMs"),
+    "fleet_affinity_hit_ratio": fleet.get("affinityHitRatio"),
     # DCN placement tracking (PR 17): q5 at 2x4 host domains must keep
     # cross-host bytes a constant factor below intra-host bytes
     "multihost_dcn_vs_ici": (hosts.get("q5_2x4") or {}).get("dcn_vs_ici"),

@@ -42,6 +42,9 @@ ci/multihost_check.sh
 echo "== serving gate (multi-tenant daemon + plan cache + drain) =="
 ci/serve_check.sh
 
+echo "== fleet gate (replica supervisor + front door + failover) =="
+ci/fleet_check.sh
+
 echo "== multichip dryrun (virtual mesh) =="
 SPARK_RAPIDS_TPU_DRYRUN_REEXEC=1 python - <<'PY'
 import jax

@@ -873,6 +873,124 @@ SERVE_PRIORITY_CLASSES = conf(
     "('name=weight,...'); the weight feeds the admission queue's "
     "priority-then-FIFO ordering (PR 5). An unknown class at hello "
     "fails the handshake with a clean error.", str)
+SERVE_RETRY_AFTER_MS = conf(
+    "spark.rapids.tpu.serve.retryAfterMs", 250,
+    "Backpressure hint carried on `busy` and `draining` error frames "
+    "(retryAfterMs field): how long a refused client (or the fleet "
+    "router) should wait before retrying this replica instead of "
+    "hot-spinning on it. 0 omits the hint.", int,
+    checker=lambda v: 0 <= v <= 600_000)
+SERVE_CONNECT_ATTEMPTS = conf(
+    "spark.rapids.tpu.serve.client.connect.attempts", 1,
+    "Connection attempts ServeClient makes before surfacing the "
+    "ConnectionError: a replica restarting under the fleet supervisor "
+    "refuses TCP for its boot window, so fleet-facing clients set "
+    "this > 1 and ride the runtime/backoff.py exponential-with-jitter "
+    "curve between attempts (attempts land in the backoff 'serve."
+    "connect' counter). 1 preserves the fail-fast embedded default.",
+    int, checker=lambda v: 1 <= v <= 1000)
+SERVE_CONNECT_BACKOFF_MS = conf(
+    "spark.rapids.tpu.serve.client.connect.backoffMs", 50,
+    "Base delay of ServeClient's connect retry curve (delay_i = "
+    "min(max, base * 2^i) * jitter, the shared runtime/backoff.py "
+    "policy). A `busy`/`draining` refusal frame carrying a larger "
+    "retryAfterMs hint overrides the computed delay for that attempt.",
+    int, checker=lambda v: 1 <= v <= 600_000)
+SERVE_CONNECT_MAX_BACKOFF_MS = conf(
+    "spark.rapids.tpu.serve.client.connect.maxBackoffMs", 2000,
+    "Cap on one ServeClient connect-retry delay.", int,
+    checker=lambda v: 1 <= v <= 600_000)
+FLEET_REPLICAS = conf(
+    "spark.rapids.tpu.fleet.replicas", 2,
+    "Replica daemons the ReplicaSupervisor (serve/supervisor.py) "
+    "spawns: one OS process per replica, each owning its own warm "
+    "TpuSparkSession (and a chip subset when fleet.replica.mesh "
+    "assigns one), crash-looped with backoff and SIGTERM-drained on "
+    "shutdown.", int, checker=lambda v: 1 <= v <= 1024)
+FLEET_REPLICA_MESH = conf(
+    "spark.rapids.tpu.fleet.replica.mesh", 0,
+    "Chip-subset size each replica's session claims "
+    "(spark.rapids.tpu.mesh in the replica conf): N replicas x this "
+    "many chips partition the host's devices. 0 leaves the replica "
+    "conf untouched (every replica sees the session default).", int,
+    checker=lambda v: 0 <= v <= 4096)
+FLEET_SPAWN_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.fleet.spawn.timeoutMs", 180_000,
+    "How long ReplicaSupervisor.wait_ready waits for a spawned "
+    "replica to write its ready file (session init + daemon bind) "
+    "before giving up on the fleet start.", int,
+    checker=lambda v: 1000 <= v <= 3_600_000)
+FLEET_RESTART_MAX = conf(
+    "spark.rapids.tpu.fleet.restart.maxRestarts", 8,
+    "Consecutive crash-loop restarts the supervisor grants one "
+    "replica before declaring it failed (fleet.replica phase="
+    "'giveup'); a clean exit or a served ready file resets the "
+    "count. 0 disables restarts entirely.", int,
+    checker=lambda v: 0 <= v <= 10_000)
+FLEET_RESTART_BACKOFF_MS = conf(
+    "spark.rapids.tpu.fleet.restart.backoffMs", 200,
+    "Base delay of the supervisor's crash-loop restart curve "
+    "(runtime/backoff.py policy shape: min(max, base * 2^crashes) "
+    "* jitter).", int, checker=lambda v: 1 <= v <= 600_000)
+FLEET_RESTART_MAX_BACKOFF_MS = conf(
+    "spark.rapids.tpu.fleet.restart.maxBackoffMs", 5000,
+    "Cap on one crash-loop restart delay.", int,
+    checker=lambda v: 1 <= v <= 3_600_000)
+FLEET_DRAIN_TIMEOUT_MS = conf(
+    "spark.rapids.tpu.fleet.drain.timeoutMs", 45_000,
+    "Supervisor shutdown budget per replica: SIGTERM (graceful drain "
+    "inside the replica), then SIGKILL past this deadline so fleet "
+    "stop is always bounded.", int,
+    checker=lambda v: 100 <= v <= 3_600_000)
+FLEET_ROUTER_HOST = conf(
+    "spark.rapids.tpu.fleet.router.host", "127.0.0.1",
+    "Bind address of the fleet front door (serve/router.py). Same "
+    "trust model as serve.host: loopback or a trusted segment.", str)
+FLEET_ROUTER_PORT = conf(
+    "spark.rapids.tpu.fleet.router.port", 0,
+    "TCP port of the fleet router; 0 binds an ephemeral port "
+    "(router.port).", int, checker=lambda v: 0 <= v <= 65535)
+FLEET_ROUTER_HTTP_PORT = conf(
+    "spark.rapids.tpu.fleet.router.httpPort", 0,
+    "Port of the router's own health endpoint (obs/http.py "
+    "FleetHttpServer): /healthz liveness, /readyz aggregating member "
+    "health (200 while >= 1 replica routable), /metrics with the "
+    "srtpu_fleet_* families. 0 binds ephemeral.", int,
+    checker=lambda v: 0 <= v <= 65535)
+FLEET_HEALTH_INTERVAL_MS = conf(
+    "spark.rapids.tpu.fleet.health.intervalMs", 200,
+    "Router health-poll cadence: each replica's /readyz (or a TCP "
+    "probe when the replica exposes no HTTP endpoint) is sampled this "
+    "often; the member-health table drives routing and the router's "
+    "own aggregated /readyz.", int, checker=lambda v: 10 <= v <= 60_000)
+FLEET_HEALTH_MAX_FAILURES = conf(
+    "spark.rapids.tpu.fleet.health.maxConsecutiveFailures", 2,
+    "Consecutive failed health probes before a replica is routed "
+    "around (one flaky poll must not evict a healthy replica; a dead "
+    "one is also discovered synchronously by a failed send).", int,
+    checker=lambda v: 1 <= v <= 100)
+FLEET_FAILOVER_ATTEMPTS = conf(
+    "spark.rapids.tpu.fleet.failover.maxAttempts", 4,
+    "Replicas one routed request may be offered to before the router "
+    "returns a clean `unavailable` error: a replica dying mid-query "
+    "(connection break) or refusing with busy/draining/device_fenced "
+    "consumes an attempt and the request — under its idempotency "
+    "key — moves to the next candidate.", int,
+    checker=lambda v: 1 <= v <= 64)
+FLEET_DEDUPE_ENTRIES = conf(
+    "spark.rapids.tpu.fleet.dedupe.entries", 512,
+    "Per-replica idempotency window: completed request ids (and their "
+    "result frames) retained so a resubmitted in-flight query — the "
+    "router's failover retry, or a client retrying a lost router — is "
+    "answered from the window and billed exactly once instead of "
+    "executing twice. LRU; 0 disables deduplication.", int,
+    checker=lambda v: 0 <= v <= 1_000_000)
+FLEET_DEDUPE_MAX_BYTES = conf(
+    "spark.rapids.tpu.fleet.dedupe.maxResultBytes", 256 << 20,
+    "Total result-payload bytes the dedupe window retains; oldest "
+    "entries evict past it (an evicted id re-executes on resubmit, "
+    "trading the bounded window for at-least-once on very large "
+    "results).", int, checker=lambda v: 1 << 20 <= v <= 1 << 40)
 SEMAPHORE_ATOMIC_QUERY_GROUPS = conf(
     "spark.rapids.tpu.semaphore.atomicQueryGroups", True,
     "Deadlock-free device-semaphore discipline: all permits a query "

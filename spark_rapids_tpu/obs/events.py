@@ -85,6 +85,15 @@ EVENT_TYPES: Dict[str, str] = {
         "tenant, priorityClass, planCache, status, rows, wallMs",
     "serve.shed": "tenant, reason",
     "serve.drain": "phase, inFlight, connections",
+    "serve.dedupe": "tenant, requestId, outcome (replay|joined|evicted)",
+    "serve.escalate": "inFlight, connections",
+    "serve.retry": "site, attempt, delayMs",
+    "fleet.replica": "name, phase (spawn|ready|exit|restart|giveup), "
+                     "pid, port, restarts",
+    "fleet.health": "replica, ready, consecutiveFailures",
+    "fleet.failover":
+        "requestId, tenant, fromReplica, toReplica, reason",
+    "fleet.drain": "phase, replicas",
 }
 
 #: Envelope keys present on EVERY event (eventlog validation contract).

@@ -125,10 +125,14 @@ class ObsHttpServer:
         draining = bool(getattr(ctrl, "draining", False))
         # a fenced CHIP or HOST degrades capacity but the engine still
         # serves (survivor remesh / CPU rung) — only a process-wide
-        # fence or a drain flips readiness
+        # fence or a drain flips readiness. `load` is the admission
+        # controller's shed signal (running/queued/queriesShed): the
+        # fleet router reads it off this body to steer toward the
+        # least-loaded replica
         return {"ready": not (fenced or draining),
                 "fenced": fenced, "fencedChips": chips,
-                "fencedHosts": hosts, "draining": draining}
+                "fencedHosts": hosts, "draining": draining,
+                "load": ctrl.load()}
 
     # --- lifecycle ---
 
@@ -139,6 +143,75 @@ class ObsHttpServer:
             return
         server.shutdown()          # stops serve_forever
         server.server_close()      # closes the listening socket
+        self._thread.join(timeout=5.0)
+
+
+class FleetHttpServer:
+    """The ROUTER's health endpoint: /healthz is process liveness,
+    /readyz aggregates member health — 200 while at least one replica
+    is routable (the fleet can take a query), 503 when none is; the
+    JSON body carries the per-replica table so an operator sees
+    degraded-then-recovered capacity, not just a bit. /metrics renders
+    the unified prom surface of the router process (srtpu_fleet_*)."""
+
+    def __init__(self, router, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self._router = router
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *_a):
+                pass
+
+            def do_GET(self):
+                try:
+                    path = self.path.split("?", 1)[0]
+                    code = 200
+                    if path == "/healthz":
+                        body, ctype = b"ok\n", "text/plain"
+                    elif path == "/readyz":
+                        snap = outer._router.health()
+                        body = json.dumps(snap, default=str).encode()
+                        ctype = "application/json"
+                        code = 200 if snap["ready"] else 503
+                    elif path == "/metrics":
+                        from spark_rapids_tpu.obs import prom
+
+                        body = prom.render(None).encode()
+                        ctype = ("text/plain; version=0.0.4; "
+                                 "charset=utf-8")
+                    else:
+                        self.send_error(404, "unknown path")
+                        return
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:
+                    try:
+                        self.send_error(500, type(e).__name__)
+                    except Exception:
+                        pass
+
+        self._server = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self.host = host
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name="srtpu-fleet-http", daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        server, self._server = self._server, None
+        if server is None:
+            return
+        server.shutdown()
+        server.server_close()
         self._thread.join(timeout=5.0)
 
 

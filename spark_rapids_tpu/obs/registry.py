@@ -98,6 +98,30 @@ def unified_snapshot(session=None) -> dict:
                 "planCache": st["planCache"],
                 "tenants": st["tenants"],
             }
+            if st.get("dedupe"):
+                out["serve"]["dedupe"] = st["dedupe"]
+    except Exception:
+        pass
+    try:
+        import sys
+
+        # fleet block: router + supervisor counters fold in when this
+        # process hosts them (same sys.modules pattern as serve — no
+        # import cost when the fleet layer never loaded), flattening
+        # into the srtpu_fleet_* prom families
+        fleet = {}
+        rtr_mod = sys.modules.get("spark_rapids_tpu.serve.router")
+        rtr = rtr_mod.active_router() if rtr_mod is not None else None
+        if rtr is not None:
+            fleet["router"] = rtr.stats_snapshot()
+        sup_mod = sys.modules.get(
+            "spark_rapids_tpu.serve.supervisor")
+        sup = sup_mod.active_supervisor() if sup_mod is not None \
+            else None
+        if sup is not None:
+            fleet["supervisor"] = sup.stats_snapshot()
+        if fleet:
+            out["fleet"] = fleet
     except Exception:
         pass
     bus = _events.get()

@@ -521,6 +521,20 @@ class AdmissionController:
                 "queueMaxDepth": self.queue_depth,
                 "draining": self.draining}
 
+    def load(self) -> dict:
+        """Cheap numeric load signal for the fleet layer: surfaced
+        through /readyz so the router can shed toward the least-loaded
+        replica and back off one that is saturating (queriesShed is
+        cumulative — the router watches its derivative)."""
+        with self._cv:
+            running = len(self._running)
+            queued = len(self._queued)
+        return {"running": running, "queued": queued,
+                "maxConcurrentQueries": self.max_concurrent,
+                "queueMaxDepth": self.queue_depth,
+                "queriesShed": stats.snapshot().get("queriesShed", 0),
+                "draining": bool(self.draining)}
+
 
 # ------------------------------------------------------ process wiring
 

@@ -159,6 +159,14 @@ def retry_io(fn: Callable[[], T], what: str,
         f"(last: {type(last).__name__}: {last})") from last
 
 
+def record_retry(key: str, n: int = 1) -> None:
+    """Count a retry attempt made OUTSIDE retry_io (e.g. ServeClient's
+    connect loop, the fleet router's failover resubmits) under the same
+    counters surface, so obs snapshots see every backoff consumer."""
+    with _counters_lock:
+        _counters[key] += int(n)
+
+
 def counters() -> Dict[str, int]:
     with _counters_lock:
         return dict(_counters)

@@ -5,22 +5,38 @@ tokens, transfer-ledger billing, device-loss fencing, drain-aware
 readiness) was built bottom-up across prior PRs; this package is the
 server that finally fronts it: ONE warm `TpuSparkSession` multiplexed
 across many concurrent client connections, each bound to a tenant id
-and a named priority class.
+and a named priority class — and, above that, the FLEET layer that
+turns one survivable daemon into a survivable service: N process-per-
+replica daemons under a supervisor, behind a health-routed front door
+with idempotent failover.
 
 - serve/protocol.py — length-prefixed JSON/Arrow-IPC wire protocol
+  (+ requestId idempotency keys, retryAfterMs backpressure hints)
 - serve/spec.py     — the JSON query-spec DSL -> DataFrame compiler
 - serve/plan_cache.py — structural plan cache (literals parameterized
-  out, compile-cache-style digest keying, per-tenant isolation)
+  out, compile-cache-style digest keying, per-tenant isolation) +
+  affinity_key, the router's cross-process hash-ring input
 - serve/tenants.py  — per-tenant quota ledgers + billing totals
-- serve/server.py   — the daemon: TCP accept loop, graceful drain,
-  SIGTERM, liveness/readiness integration
-- serve/client.py   — in-process client speaking the same protocol
+- serve/server.py   — the daemon: TCP accept loop, graceful drain +
+  second-SIGTERM escalation, request-id dedupe window,
+  liveness/readiness integration
+- serve/client.py   — in-process client speaking the same protocol,
+  with conf'd connect retry/backoff
+- serve/replica.py  — subprocess entry: one replica process = one
+  session + one daemon + ready-file handshake
+- serve/supervisor.py — ReplicaSupervisor: spawn/monitor/crash-loop/
+  drain the replica processes
+- serve/router.py   — FleetRouter: health-gated, affinity-routed
+  front door with exactly-once failover
 """
 
 from spark_rapids_tpu.serve.client import ServeClient, ServeError
-from spark_rapids_tpu.serve.plan_cache import PlanCache
+from spark_rapids_tpu.serve.plan_cache import PlanCache, affinity_key
+from spark_rapids_tpu.serve.router import FleetRouter
 from spark_rapids_tpu.serve.server import QueryServiceDaemon
+from spark_rapids_tpu.serve.supervisor import ReplicaSupervisor
 from spark_rapids_tpu.serve.tenants import TenantLedger
 
 __all__ = ["QueryServiceDaemon", "ServeClient", "ServeError",
-           "PlanCache", "TenantLedger"]
+           "PlanCache", "TenantLedger", "FleetRouter",
+           "ReplicaSupervisor", "affinity_key"]

@@ -43,19 +43,48 @@ def _raise_for(header: dict) -> None:
     code = header.get("code", "internal")
     msg = header.get("message", "")
     if code in ("rejected", "draining", "device_fenced",
-                "tenant_quota"):
+                "tenant_quota", "unavailable"):
         reason = header.get("reason") or {
             "draining": "draining",
             "device_fenced": "device fenced",
-            "tenant_quota": "tenant quota"}.get(code, "rejected")
-        raise QueryRejectedError(msg, reason=reason)
-    if code == "deadline":
-        raise QueryDeadlineExceeded(msg)
-    if code == "quarantined":
-        raise QueryQuarantinedError(msg)
-    if code == "cancelled":
-        raise QueryCancelledError(msg)
-    raise ServeError(code, msg)
+            "tenant_quota": "tenant quota",
+            "unavailable": "unavailable"}.get(code, "rejected")
+        exc: BaseException = QueryRejectedError(msg, reason=reason)
+    elif code == "deadline":
+        exc = QueryDeadlineExceeded(msg)
+    elif code == "quarantined":
+        exc = QueryQuarantinedError(msg)
+    elif code == "cancelled":
+        exc = QueryCancelledError(msg)
+    else:
+        exc = ServeError(code, msg)
+    # backpressure hint from busy/draining frames rides the exception
+    # so callers (and the fleet router) can honor it
+    exc.retry_after_ms = int(header.get("retryAfterMs") or 0)
+    raise exc
+
+
+def _connect_policy(attempts, base_ms, max_ms):
+    """Resolve the connect-retry knobs: explicit args > active session
+    conf > entry defaults (a bare client in a fresh process still gets
+    sane retry behavior)."""
+    from spark_rapids_tpu.api.session import TpuSparkSession
+    from spark_rapids_tpu.config import rapids_conf as rc
+    from spark_rapids_tpu.runtime.backoff import BackoffPolicy
+
+    s = TpuSparkSession.active()
+    conf = s.rapids_conf if s is not None else None
+
+    def pick(explicit, entry):
+        if explicit is not None:
+            return int(explicit)
+        return int(conf.get(entry)) if conf is not None \
+            else int(entry.default)
+
+    attempts = max(1, pick(attempts, rc.SERVE_CONNECT_ATTEMPTS))
+    return attempts, BackoffPolicy(
+        attempts, pick(base_ms, rc.SERVE_CONNECT_BACKOFF_MS),
+        pick(max_ms, rc.SERVE_CONNECT_MAX_BACKOFF_MS))
 
 
 class ServeClient:
@@ -64,23 +93,82 @@ class ServeClient:
     def __init__(self, host: str, port: int, tenant: str,
                  priority_class: str = "standard",
                  max_frame_bytes: int = 64 << 20,
-                 connect_timeout_s: float = 10.0):
+                 connect_timeout_s: float = 10.0,
+                 connect_attempts: Optional[int] = None,
+                 connect_backoff_ms: Optional[int] = None,
+                 connect_max_backoff_ms: Optional[int] = None):
+        from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.runtime import backoff, cancellation
+        from spark_rapids_tpu.runtime.errors import QueryRejectedError
+
         self.tenant = tenant
         self.priority_class = priority_class
         self.max_frame_bytes = int(max_frame_bytes)
         self._ids = itertools.count(1)
-        self._sock = socket.create_connection(
+        self._sock = None
+        # a replica restarting under the fleet supervisor refuses TCP
+        # for its boot window — ride the shared backoff curve instead
+        # of surfacing ConnectionRefusedError on the first slam
+        attempts, policy = _connect_policy(
+            connect_attempts, connect_backoff_ms,
+            connect_max_backoff_ms)
+        hint_ms = 0
+        last_exc: Optional[BaseException] = None
+        for attempt in range(attempts):
+            if attempt:
+                delay_s = max(policy.delay_s(attempt - 1),
+                              hint_ms / 1000.0)
+                backoff.record_retry("serve.connect")
+                obs_events.emit(
+                    "serve.retry", site="serve.connect",
+                    attempt=attempt,
+                    delayMs=round(delay_s * 1000.0, 1))
+                cancellation.sleep_interruptible(delay_s)
+            try:
+                self._connect_once(host, port, connect_timeout_s)
+                return
+            except (ConnectionError, OSError, socket.timeout) as e:
+                last_exc, hint_ms = e, 0
+            except QueryRejectedError as e:
+                # a draining replica refused cleanly: retryable, and
+                # its retryAfterMs hint floors the next delay
+                if getattr(e, "reason", "") != "draining":
+                    raise
+                last_exc = e
+                hint_ms = getattr(e, "retry_after_ms", 0)
+            except ServeError as e:
+                if e.code != "busy":
+                    raise
+                last_exc = e
+                hint_ms = getattr(e, "retry_after_ms", 0)
+        raise last_exc
+
+    def _connect_once(self, host: str, port: int,
+                      connect_timeout_s: float) -> None:
+        sock = socket.create_connection(
             (host, int(port)), timeout=connect_timeout_s)
-        self._sock.settimeout(None)  # queries block until served
-        protocol.send_json(self._sock, {
-            "type": "hello", "id": next(self._ids),
-            "version": protocol.PROTOCOL_VERSION,
-            "tenant": tenant, "priorityClass": priority_class})
-        reply, _ = protocol.recv_message(self._sock,
-                                         self.max_frame_bytes)
+        try:
+            sock.settimeout(None)  # queries block until served
+            protocol.send_json(sock, {
+                "type": "hello", "id": next(self._ids),
+                "version": protocol.PROTOCOL_VERSION,
+                "tenant": self.tenant,
+                "priorityClass": self.priority_class})
+            reply, _ = protocol.recv_message(sock,
+                                             self.max_frame_bytes)
+        except BaseException:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
         if reply.get("type") != "hello_ok":
-            self.close()
+            try:
+                sock.close()
+            except OSError:
+                pass
             _raise_for(reply)
+        self._sock = sock
         self.priority = reply.get("priority", 0)
 
     @classmethod
@@ -95,15 +183,22 @@ class ServeClient:
 
     def query(self, spec: dict,
               params: Optional[Dict[str, object]] = None,
-              timeout_ms: Optional[int] = None) -> pa.Table:
+              timeout_ms: Optional[int] = None,
+              request_id: Optional[str] = None) -> pa.Table:
         """Run a spec; returns the arrow result or raises the mapped
         governance error. `self.last_result` keeps the result header
-        (queryId, planCache verdict, rows, wallMs)."""
+        (queryId, planCache verdict, rows, wallMs). `request_id` is
+        the idempotency key: resubmitting the same id replays the
+        retained result (header carries `dedupe: true`) instead of
+        re-executing — how a caller retries a lost connection without
+        risking double execution or double billing."""
         req = {"type": "query", "id": next(self._ids), "spec": spec}
         if params:
             req["params"] = params
         if timeout_ms is not None:
             req["timeoutMs"] = int(timeout_ms)
+        if request_id is not None:
+            req["requestId"] = str(request_id)
         protocol.send_json(self._sock, req)
         header, table = protocol.recv_message(self._sock,
                                               self.max_frame_bytes)
@@ -134,6 +229,17 @@ class ServeClient:
         reply, _ = protocol.recv_message(self._sock,
                                          self.max_frame_bytes)
         return reply
+
+    def status(self) -> dict:
+        """The daemon's status() snapshot over the wire (fleet CI
+        reconciles billing/dedupe across replicas through this)."""
+        protocol.send_json(self._sock, {"type": "status",
+                                        "id": next(self._ids)})
+        reply, _ = protocol.recv_message(self._sock,
+                                         self.max_frame_bytes)
+        if reply.get("type") == "error":
+            _raise_for(reply)
+        return reply.get("status") or {}
 
     def close(self) -> None:
         sock, self._sock = self._sock, None

@@ -158,6 +158,22 @@ def type_signature(params: Dict[str, object]) -> list:
     return sorted((k, type(v).__name__) for k, v in params.items())
 
 
+def affinity_key(tenant: str, spec, params: Optional[dict] = None
+                 ) -> str:
+    """The fleet router's hash-ring input: the structural identity of
+    a request WITHOUT the per-replica planning conf (replicas may run
+    different confs) and WITHOUT literal binding values (repeat shapes
+    with different literals should land on the replica whose plan
+    cache already holds the shape's template). Byte-stable across
+    processes and sessions — it is normalize_spec + _digest over
+    canonical JSON, nothing machine-local — which is what makes
+    router-side affinity line up with replica-side structural keys."""
+    norm_spec, auto = normalize_spec(spec)
+    bound = {**auto, **(params or {})}
+    return _digest({"spec": norm_spec, "tenant": tenant,
+                    "types": type_signature(bound)})
+
+
 class _Binding:
     __slots__ = ("phys", "meta", "logical", "in_use")
 

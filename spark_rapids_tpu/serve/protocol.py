@@ -10,9 +10,23 @@ in its execution layout with no row pivot).
 
 Client -> server message types: `hello` (tenant + priorityClass
 binding, protocol version check), `query` (a serve/spec.py query spec
-+ parameter bindings), `cancel`, `ping`, `bye`.
++ parameter bindings), `cancel`, `ping`, `status` (daemon status
+snapshot — the fleet gate reconciles billing/dedupe remotely), `bye`.
 Server -> client: `hello_ok`, `result`, `error` (stable `code` from
-ERROR_CODES + human `message`), `pong`, `bye_ok`.
+ERROR_CODES + human `message`), `pong`, `status_ok`, `bye_ok`.
+
+Idempotency: a `query` message MAY carry a `requestId` string — the
+idempotency key of the fleet layer. A replica remembers recently
+completed (and currently in-flight) request ids in a bounded dedupe
+window; a resubmitted id is answered from the window (same result
+frames, `dedupe: true` on the header) without re-executing or
+re-billing. The fleet router mints one per routed request when the
+client didn't, which is what makes kill-mid-query failover exactly
+-once: the resubmit to a survivor either re-executes (the dead
+replica never finished) or replays (it finished but the ack was
+lost). `busy`/`draining` error frames MAY carry `retryAfterMs` — a
+backpressure hint clients and the router honor instead of
+hot-spinning.
 
 Frames are bounded by serve.maxFrameBytes on both sides: an oversized
 header/payload is a clean `protocol` error, never an unbounded
@@ -49,6 +63,7 @@ ERROR_CODES = (
     "bad_spec",       # query spec failed to compile
     "protocol",       # malformed/oversized frame, bad handshake
     "busy",           # connection limit reached
+    "unavailable",    # fleet router: no routable replica survived
     "internal",       # anything else; message carries the type
 )
 

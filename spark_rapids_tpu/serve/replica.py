@@ -1,0 +1,65 @@
+"""Replica process entry point — `python -m spark_rapids_tpu.serve.replica`.
+
+One fleet replica is one OS process owning one warm TpuSparkSession
+behind one QueryServiceDaemon. The ReplicaSupervisor
+(serve/supervisor.py) spawns this module with three env vars:
+
+- SRTPU_REPLICA_NAME   replica name (events, status, ready file)
+- SRTPU_REPLICA_CONF   JSON settings dict for the owned session —
+                       serve.port=0 (ephemeral; the real port travels
+                       back via the ready file) and, when the fleet
+                       partitions chips, the replica's
+                       spark.rapids.tpu.mesh subset
+- SRTPU_REPLICA_READY  path the replica atomically writes (tmp +
+                       rename) once it is accepting:
+                       {"name", "pid", "port", "httpPort"}
+
+Lifecycle: session + daemon come up, the obs HTTP endpoint binds an
+ephemeral port (its /readyz carries the admission `load` block the
+router polls), signal handlers install (first SIGTERM drains
+gracefully, a second escalates — server.py handle_term_signal), the
+ready file lands, and the main thread parks until the daemon reaches
+`stopped`. Crash-looping, restarts and SIGKILL escalation live in the
+supervisor; this process only has to serve and die cleanly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    name = os.environ.get("SRTPU_REPLICA_NAME") or \
+        f"replica-{os.getpid()}"
+    settings = json.loads(os.environ.get("SRTPU_REPLICA_CONF") or "{}")
+    ready_path = os.environ.get("SRTPU_REPLICA_READY") or ""
+
+    from spark_rapids_tpu.obs.http import ObsHttpServer
+    from spark_rapids_tpu.runtime import cancellation
+    from spark_rapids_tpu.serve.server import QueryServiceDaemon
+
+    daemon = QueryServiceDaemon(conf=settings, name=name)
+    daemon.start()
+    daemon.install_signal_handlers()
+    try:
+        http = ObsHttpServer(daemon.session, port=0)
+    except OSError:
+        http = None  # health falls back to the router's TCP probe
+    if ready_path:
+        tmp = f"{ready_path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"name": name, "pid": os.getpid(),
+                       "port": daemon.port,
+                       "httpPort": http.port if http else None}, f)
+        os.replace(tmp, ready_path)
+    while daemon.state != "stopped":
+        cancellation.sleep_interruptible(0.1)
+    if http is not None:
+        http.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

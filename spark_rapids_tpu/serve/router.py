@@ -1,0 +1,768 @@
+"""FleetRouter — the health-routed front door of the serving fleet.
+
+The router is a daemon speaking the SAME length-prefixed protocol as a
+replica (serve/protocol.py): existing clients point at it unchanged.
+Behind it, every `query` message is routed across the replica set:
+
+- **Health-gated**: a poll loop samples each replica's /readyz (the
+  obs/http endpoint, whose body carries the admission `load` shed
+  signal), falling back to a TCP probe when a replica exposes no HTTP
+  port. fleet.health.maxConsecutiveFailures failed probes route
+  around a replica; a dead one is also discovered synchronously by a
+  failed send, so the poll interval bounds STALENESS, not failover
+  latency.
+- **Affinity-routed**: the hash-ring input is
+  plan_cache.affinity_key(tenant, spec, params) — the structural
+  identity minus conf and literal values — rendezvous-hashed over the
+  routable replicas, so repeat shapes land on the replica whose plan
+  cache already holds their template. Ties and fallbacks go to the
+  least-loaded routable replica.
+- **Idempotent failover**: every routed request carries a requestId
+  (client-supplied or router-minted). A replica dying mid-query
+  (connection break) or refusing with busy/draining/device_fenced
+  consumes one of fleet.failover.maxAttempts and the SAME requestId
+  resubmits to the next candidate — the replica-side dedupe window
+  (server.py) makes the retry exactly-once: re-execute if the first
+  never finished, replay if only the ack was lost. busy/draining
+  refusals also cool the replica down for its retryAfterMs hint.
+  When every attempt is spent the client gets a clean `unavailable`
+  error frame, never a hang.
+
+The router holds per-client-connection backend sockets (hello'd with
+the client's tenant/priorityClass, so replica-side tenant governance
+sees the true tenant), relays result frames verbatim (no Arrow
+re-parse on the hot path), forwards `cancel` to every replica the
+client touched, and exposes /healthz + aggregated /readyz + /metrics
+via obs/http.FleetHttpServer. Counters surface via stats_snapshot()
+-> the srtpu_fleet_router_* prom family.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import socket
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.serve import protocol
+
+_active_router = None
+_active_lock = threading.Lock()
+
+
+def active_router() -> Optional["FleetRouter"]:
+    """The most recently started router in this process (the
+    obs/registry fleet-block hook)."""
+    return _active_router
+
+
+class _Member:
+    """One replica as the router sees it."""
+
+    __slots__ = ("name", "host", "port", "http_port", "ready",
+                 "failures", "cooldown_until", "load", "routed")
+
+    def __init__(self, name: str, host: str, port: int,
+                 http_port: Optional[int]):
+        self.name = name
+        self.host = host
+        self.port = port
+        self.http_port = http_port
+        self.ready = True  # optimistic until a probe says otherwise
+        self.failures = 0
+        self.cooldown_until = 0.0
+        self.load: dict = {}
+        self.routed = 0
+
+    def snapshot(self) -> dict:
+        return {"host": self.host, "port": self.port,
+                "httpPort": self.http_port, "ready": self.ready,
+                "consecutiveFailures": self.failures,
+                "coolingDown": self.cooldown_until > time.monotonic(),
+                "load": self.load, "routed": self.routed}
+
+
+class _ClientConn:
+    """One accepted client and its hello'd backend sockets."""
+
+    __slots__ = ("sock", "addr", "tenant", "priority_class",
+                 "backends", "dead", "thread")
+
+    def __init__(self, sock, addr):
+        self.sock = sock
+        self.addr = addr
+        self.tenant = ""
+        self.priority_class = "standard"
+        self.backends: Dict[str, socket.socket] = {}
+        self.dead = False
+        self.thread: Optional[threading.Thread] = None
+
+
+class FleetRouter:
+    """Front-door daemon load-balancing a replica fleet."""
+
+    def __init__(self, endpoints: Optional[List[dict]] = None,
+                 supervisor=None, conf: Optional[dict] = None):
+        from spark_rapids_tpu.config import rapids_conf as rc
+
+        rconf = rc.RapidsConf(dict(conf or {}))
+        self.host = rconf.get(rc.FLEET_ROUTER_HOST)
+        self._conf_port = rconf.get(rc.FLEET_ROUTER_PORT)
+        self._http_port_conf = rconf.get(rc.FLEET_ROUTER_HTTP_PORT)
+        self.max_frame_bytes = rconf.get(rc.SERVE_MAX_FRAME_BYTES)
+        self.retry_after_ms = rconf.get(rc.SERVE_RETRY_AFTER_MS)
+        self.health_interval_ms = rconf.get(rc.FLEET_HEALTH_INTERVAL_MS)
+        self.max_health_failures = rconf.get(rc.FLEET_HEALTH_MAX_FAILURES)
+        self.max_attempts = rconf.get(rc.FLEET_FAILOVER_ATTEMPTS)
+        self._supervisor = supervisor
+        self._members: Dict[str, _Member] = {}
+        for ep in (endpoints or []):
+            self._add_member(ep)
+        self.port: Optional[int] = None
+        self.http_port: Optional[int] = None
+        self._http = None
+        self._listener: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._health_thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self._lock = threading.Lock()
+        self._conns: Dict[int, _ClientConn] = {}
+        self._conn_seq = 0
+        self._state = "new"
+        self._rid_base = uuid.uuid4().hex[:12]
+        self._rid_counter = itertools.count(1)
+        self._stats = {"queriesRouted": 0, "failovers": 0,
+                       "rerouted": 0, "unavailable": 0,
+                       "mintedRequestIds": 0, "replays": 0}
+
+    # ------------------------------------------------------ lifecycle
+
+    def start(self) -> "FleetRouter":
+        from spark_rapids_tpu.obs.http import FleetHttpServer
+
+        if self._state != "new":
+            raise RuntimeError(f"router already {self._state}")
+        self._listener = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind((self.host, int(self._conf_port)))
+        self._listener.listen(128)
+        self._listener.settimeout(0.2)
+        self.port = self._listener.getsockname()[1]
+        self._state = "serving"
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="srtpu-fleet-accept",
+            daemon=True)
+        self._accept_thread.start()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="srtpu-fleet-health",
+            daemon=True)
+        self._health_thread.start()
+        try:
+            self._http = FleetHttpServer(self,
+                                         port=self._http_port_conf)
+            self.http_port = self._http.port
+        except OSError:
+            self._http = None
+        global _active_router
+        with _active_lock:
+            _active_router = self
+        return self
+
+    def stop(self) -> None:
+        if self._state == "stopped":
+            return
+        self._state = "stopped"
+        self._stop_evt.set()
+        listener, self._listener = self._listener, None
+        if listener is not None:
+            try:
+                listener.close()
+            except OSError:
+                pass
+        with self._lock:
+            conns = list(self._conns.values())
+        for c in conns:
+            for b in list(c.backends.values()):
+                try:
+                    b.close()
+                except OSError:
+                    pass
+            try:
+                c.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.sock.close()
+            except OSError:
+                pass
+        for c in conns:
+            if c.thread is not None:
+                c.thread.join(timeout=5.0)
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5.0)
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=5.0)
+        if self._http is not None:
+            self._http.close()
+            self._http = None
+        global _active_router
+        with _active_lock:
+            if _active_router is self:
+                _active_router = None
+
+    def __enter__(self) -> "FleetRouter":
+        return self.start() if self._state == "new" else self
+
+    def __exit__(self, *_exc) -> bool:
+        self.stop()
+        return False
+
+    # ---------------------------------------------------- diagnostics
+
+    def health(self) -> dict:
+        """The aggregated readiness body FleetHttpServer serves:
+        ready while >= 1 replica is routable."""
+        now = time.monotonic()
+        with self._lock:
+            members = {n: m.snapshot()
+                       for n, m in self._members.items()}
+            routable = [n for n, m in self._members.items()
+                        if self._routable(m, now)]
+        return {"ready": bool(routable),
+                "routable": sorted(routable),
+                "replicas": members}
+
+    def stats_snapshot(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            routable = sum(1 for m in self._members.values()
+                           if self._routable(m, now))
+            return {**self._stats,
+                    "replicas": len(self._members),
+                    "routable": routable,
+                    "connections": len(self._conns)}
+
+    def leak_report(self) -> dict:
+        with self._lock:
+            threads = sum(1 for c in self._conns.values()
+                          if c.thread is not None
+                          and c.thread.is_alive())
+            return {"connections": len(self._conns),
+                    "handlerThreads": threads,
+                    "listener": int(self._listener is not None)}
+
+    # ------------------------------------------------------ membership
+
+    def _add_member(self, ep: dict) -> None:
+        self._members[ep["name"]] = _Member(
+            ep["name"], ep.get("host", "127.0.0.1"),
+            int(ep["port"]), ep.get("httpPort"))
+
+    def _refresh_members(self) -> None:
+        """Fold the supervisor's current endpoints in: restarted
+        replicas come back on NEW ports; gone replicas drop."""
+        if self._supervisor is None:
+            return
+        eps = {ep["name"]: ep for ep in self._supervisor.endpoints()}
+        with self._lock:
+            for name, ep in eps.items():
+                m = self._members.get(name)
+                if m is None:
+                    self._add_member(ep)
+                elif (m.host, m.port) != (ep.get("host", "127.0.0.1"),
+                                          int(ep["port"])):
+                    self._add_member(ep)  # replaces: fresh state
+            for name in list(self._members):
+                if name not in eps:
+                    del self._members[name]
+
+    def _routable(self, m: _Member, now: float) -> bool:
+        return m.ready and m.cooldown_until <= now
+
+    def _candidates(self, affinity: str) -> List[str]:
+        """Routable replica names, affinity-ranked: rendezvous hash
+        (highest-random-weight) of the affinity key over the members,
+        so a repeat spec consistently prefers the same replica while
+        every spec still spreads across the fleet; equal-rank fallback
+        order is by reported load."""
+        now = time.monotonic()
+        with self._lock:
+            live = [(n, m) for n, m in self._members.items()
+                    if self._routable(m, now)]
+
+        def rank(item):
+            name, m = item
+            w = hashlib.sha256(
+                f"{affinity}|{name}".encode()).hexdigest()
+            return w
+
+        def load_of(m: _Member) -> int:
+            return int(m.load.get("running", 0)) + \
+                int(m.load.get("queued", 0))
+
+        ranked = sorted(live, key=rank, reverse=True)
+        if len(ranked) > 1:
+            # affinity picks the head; the FALLBACK order (failover
+            # targets) prefers the least-loaded survivors
+            head, rest = ranked[0], ranked[1:]
+            rest.sort(key=lambda it: load_of(it[1]))
+            ranked = [head] + rest
+        return [n for n, _m in ranked]
+
+    def _mark_dead(self, name: str) -> None:
+        with self._lock:
+            m = self._members.get(name)
+            if m is not None:
+                m.ready = False
+                m.failures = max(m.failures,
+                                 self.max_health_failures)
+
+    def _cooldown(self, name: str, ms: int) -> None:
+        with self._lock:
+            m = self._members.get(name)
+            if m is not None:
+                m.cooldown_until = max(
+                    m.cooldown_until,
+                    time.monotonic() + max(0, ms) / 1000.0)
+
+    # ---------------------------------------------------- health loop
+
+    def _health_loop(self) -> None:
+        from spark_rapids_tpu.obs import events as obs_events
+
+        interval = max(0.01, self.health_interval_ms / 1000.0)
+        while not self._stop_evt.wait(timeout=interval):
+            self._refresh_members()
+            with self._lock:
+                members = list(self._members.items())
+            for name, m in members:
+                ready, load = self._probe(m)
+                with self._lock:
+                    cur = self._members.get(name)
+                    if cur is not m:
+                        continue  # replaced mid-probe
+                    was = m.ready
+                    if ready:
+                        m.failures = 0
+                        m.ready = True
+                        m.load = load or m.load
+                    else:
+                        m.failures += 1
+                        if m.failures >= self.max_health_failures:
+                            m.ready = False
+                    flipped = was != m.ready
+                if flipped:
+                    obs_events.emit("fleet.health", replica=name,
+                                    ready=m.ready,
+                                    consecutiveFailures=m.failures)
+
+    def _probe(self, m: _Member):
+        """(ready, load) for one member: /readyz when it has an HTTP
+        port (503 -> not ready; body carries the shed signal), else a
+        bare TCP connect to the serve port."""
+        if m.http_port:
+            import json
+            import urllib.error
+            import urllib.request
+
+            try:
+                with urllib.request.urlopen(
+                        f"http://{m.host}:{m.http_port}/readyz",
+                        timeout=1.0) as resp:
+                    body = json.loads(resp.read().decode())
+                    return True, body.get("load") or {}
+            except urllib.error.HTTPError as e:
+                if e.code == 503:
+                    try:
+                        body = json.loads(e.read().decode())
+                        return False, body.get("load") or {}
+                    except (ValueError, OSError):
+                        return False, {}
+                return False, {}
+            except (OSError, ValueError):
+                return False, {}
+        try:
+            s = socket.create_connection((m.host, m.port),
+                                         timeout=1.0)
+            s.close()
+            return True, {}
+        except OSError:
+            return False, {}
+
+    # ---------------------------------------------------- accept path
+
+    def _accept_loop(self) -> None:
+        while True:
+            with self._lock:
+                listener = self._listener
+                serving = self._state == "serving"
+            if listener is None or not serving:
+                return
+            try:
+                sock, addr = listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with self._lock:
+                self._conn_seq += 1
+                cid = self._conn_seq
+                conn = _ClientConn(sock, addr)
+                self._conns[cid] = conn
+            t = threading.Thread(target=self._serve_client,
+                                 args=(cid, conn),
+                                 name=f"srtpu-fleet-conn-{cid}",
+                                 daemon=True)
+            conn.thread = t
+            t.start()
+
+    # ------------------------------------------------- client session
+
+    def _serve_client(self, cid: int, conn: _ClientConn) -> None:
+        sock = conn.sock
+        sock.settimeout(5.0)
+        try:
+            if not self._client_hello(conn):
+                return
+            sock.settimeout(0.5)
+            while True:
+                if conn.dead:
+                    return
+                if self._state != "serving":
+                    return
+                try:
+                    msg = protocol.recv_json(sock,
+                                             self.max_frame_bytes)
+                except socket.timeout:
+                    continue
+                except (ConnectionError, OSError):
+                    return
+                except protocol.ProtocolError as e:
+                    self._send(conn, {"type": "error", "id": None,
+                                      "code": "protocol",
+                                      "message": str(e)})
+                    return
+                mtype = msg.get("type")
+                if mtype == "query":
+                    self._route_query(conn, msg)
+                elif mtype == "cancel":
+                    self._route_cancel(conn, msg)
+                elif mtype == "ping":
+                    self._send(conn, {"type": "pong",
+                                      "id": msg.get("id"),
+                                      "state": self._state,
+                                      "router": True})
+                elif mtype == "status":
+                    self._send(conn, {"type": "status_ok",
+                                      "id": msg.get("id"),
+                                      "status": {
+                                          "router": self.health(),
+                                          "stats":
+                                              self.stats_snapshot()}})
+                elif mtype == "bye":
+                    self._send(conn, {"type": "bye_ok",
+                                      "id": msg.get("id")})
+                    return
+                else:
+                    self._send(conn, {
+                        "type": "error", "id": msg.get("id"),
+                        "code": "protocol",
+                        "message": f"unknown message type {mtype!r}"})
+        finally:
+            for name, b in list(conn.backends.items()):
+                try:
+                    protocol.send_json(b, {"type": "bye", "id": 0})
+                except OSError:
+                    pass
+                try:
+                    b.close()
+                except OSError:
+                    pass
+            conn.backends.clear()
+            with self._lock:
+                self._conns.pop(cid, None)
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+
+    def _client_hello(self, conn: _ClientConn) -> bool:
+        """Terminate the hello at the router: tenant/class bind here
+        and re-play against each backend the client's queries touch.
+        Validation of the class itself is deferred to the first
+        backend hello (the router doesn't know the classes)."""
+        try:
+            hello = protocol.recv_json(conn.sock,
+                                       self.max_frame_bytes)
+        except (ConnectionError, OSError, protocol.ProtocolError):
+            return False
+        mid = hello.get("id")
+        if hello.get("type") != "hello":
+            self._send(conn, {"type": "error", "id": mid,
+                              "code": "protocol",
+                              "message": "first message must be hello"})
+            return False
+        version = int(hello.get("version", 0))
+        if version > protocol.PROTOCOL_VERSION:
+            self._send(conn, {
+                "type": "error", "id": mid, "code": "protocol",
+                "message": f"protocol version {version} not supported "
+                           f"(router speaks "
+                           f"{protocol.PROTOCOL_VERSION})"})
+            return False
+        tenant = str(hello.get("tenant") or "")
+        if not tenant or ":" in tenant:
+            self._send(conn, {"type": "error", "id": mid,
+                              "code": "protocol",
+                              "message": "hello requires a tenant id "
+                                         "without ':'"})
+            return False
+        conn.tenant = tenant
+        conn.priority_class = str(hello.get("priorityClass")
+                                  or "standard")
+        # bind a first backend NOW so a bad priority class (or an
+        # unavailable fleet) fails the handshake exactly like the
+        # single-daemon path would
+        names = self._candidates(tenant)
+        reply = None
+        for name in names[:self.max_attempts]:
+            try:
+                sock, reply = self._backend_hello(conn, name)
+            except (ConnectionError, OSError):
+                self._mark_dead(name)
+                continue
+            if reply.get("type") == "hello_ok":
+                conn.backends[name] = sock
+                self._send(conn, {**reply, "id": mid})
+                return True
+            break  # a clean refusal/validation error: relay it
+        if reply is not None:
+            self._send(conn, {**reply, "id": mid})
+        else:
+            self._send_unavailable(conn, mid)
+        return False
+
+    def _backend_hello(self, conn: _ClientConn, name: str):
+        with self._lock:
+            m = self._members.get(name)
+        if m is None:
+            raise ConnectionError(f"no member {name}")
+        sock = socket.create_connection((m.host, m.port), timeout=5.0)
+        try:
+            sock.settimeout(None)
+            protocol.send_json(sock, {
+                "type": "hello", "id": 0,
+                "version": protocol.PROTOCOL_VERSION,
+                "tenant": conn.tenant,
+                "priorityClass": conn.priority_class})
+            reply = protocol.recv_json(sock, self.max_frame_bytes)
+        except BaseException:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+        if reply.get("type") != "hello_ok":
+            try:
+                sock.close()
+            except OSError:
+                pass
+        return sock, reply
+
+    def _backend_for(self, conn: _ClientConn, name: str):
+        sock = conn.backends.get(name)
+        if sock is not None:
+            return sock
+        sock, reply = self._backend_hello(conn, name)
+        if reply.get("type") != "hello_ok":
+            # governance refusal at hello time (e.g. draining):
+            # surface it like a refused query so failover handles it
+            raise _BackendRefused(reply)
+        conn.backends[name] = sock
+        return sock
+
+    # ----------------------------------------------------- query path
+
+    def _route_query(self, conn: _ClientConn, msg: dict) -> None:
+        from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.runtime import backoff, cancellation
+        from spark_rapids_tpu.serve.plan_cache import affinity_key
+
+        mid = msg.get("id")
+        rid = msg.get("requestId")
+        if rid is None:
+            # mint the idempotency key that makes failover resubmits
+            # exactly-once against the replica dedupe windows
+            rid = f"rt-{self._rid_base}-{next(self._rid_counter)}"
+            self._stats["mintedRequestIds"] += 1
+        msg = {**msg, "requestId": str(rid)}
+        try:
+            akey = affinity_key(conn.tenant, msg.get("spec"),
+                                msg.get("params") or {})
+        except Exception:
+            # a spec the normalizer rejects still routes (the replica
+            # will answer bad_spec with the real diagnostic)
+            akey = conn.tenant
+        last_refusal: Optional[dict] = None
+        attempted: set = set()
+        prev_name: Optional[str] = None
+        for attempt in range(self.max_attempts):
+            names = [n for n in self._candidates(akey)
+                     if n not in attempted]
+            if not names:
+                # nothing routable right now: honor the last refusal's
+                # retryAfterMs (or one default beat) before giving up,
+                # instead of hot-spinning or failing early
+                hint = int((last_refusal or {}).get("retryAfterMs")
+                           or self.retry_after_ms or 100)
+                cancellation.sleep_interruptible(
+                    min(hint, 1000) / 1000.0)
+                attempted.clear()
+                names = [n for n in self._candidates(akey)]
+                if not names:
+                    break
+            name = names[0]
+            attempted.add(name)
+            if attempt:
+                self._stats["failovers"] += 1
+                backoff.record_retry("fleet.failover")
+                obs_events.emit(
+                    "fleet.failover", requestId=str(rid),
+                    tenant=conn.tenant, fromReplica=prev_name,
+                    toReplica=name,
+                    reason=(last_refusal or {}).get("code",
+                                                    "connection"))
+            prev_name = name
+            try:
+                sock = self._backend_for(conn, name)
+            except _BackendRefused as e:
+                last_refusal = e.reply
+                self._note_refusal(name, e.reply)
+                continue
+            except (ConnectionError, OSError):
+                self._mark_dead(name)
+                last_refusal = None
+                continue
+            try:
+                protocol.send_json(sock, msg)
+                header = protocol.recv_json(sock,
+                                            self.max_frame_bytes)
+                payload = None
+                if header.get("payload") == "arrow":
+                    payload = protocol.recv_frame(
+                        sock, self.max_frame_bytes)
+            except (ConnectionError, OSError,
+                    protocol.ProtocolError):
+                # replica died (or desynced) mid-query: drop the
+                # backend, resubmit the SAME requestId to a survivor —
+                # its dedupe window guarantees single execution
+                conn.backends.pop(name, None)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                self._mark_dead(name)
+                last_refusal = None
+                continue
+            code = header.get("code")
+            if header.get("type") == "error" and \
+                    code in ("busy", "draining", "device_fenced"):
+                # transparent reroute: the refusal cools this replica
+                # down and the request moves on
+                self._stats["rerouted"] += 1
+                last_refusal = header
+                self._note_refusal(name, header)
+                continue
+            with self._lock:
+                m = self._members.get(name)
+                if m is not None:
+                    m.routed += 1
+            self._stats["queriesRouted"] += 1
+            if header.get("dedupe"):
+                self._stats["replays"] += 1
+            self._relay(conn, {**header, "id": mid,
+                               "requestId": str(rid),
+                               "replica": name}, payload)
+            return
+        self._stats["unavailable"] += 1
+        self._send_unavailable(conn, mid, last_refusal)
+
+    def _note_refusal(self, name: str, header: dict) -> None:
+        hint = int(header.get("retryAfterMs")
+                   or self.retry_after_ms or 0)
+        if header.get("code") == "device_fenced" and \
+                not header.get("retryAfterMs"):
+            # fences clear on recovery, not on a client's beat —
+            # poll-scale cooldown, not a single retryAfter
+            hint = max(hint, self.health_interval_ms * 2)
+        self._cooldown(name, hint)
+
+    def _route_cancel(self, conn: _ClientConn, msg: dict) -> None:
+        """Fan the (tenant-scoped) cancel out to every replica this
+        client has touched; the summed count comes back."""
+        mid = msg.get("id")
+        total = 0
+        for name, sock in list(conn.backends.items()):
+            try:
+                protocol.send_json(sock, {**msg, "id": 0})
+                reply = protocol.recv_json(sock,
+                                           self.max_frame_bytes)
+                total += int(reply.get("cancelled", 0))
+            except (ConnectionError, OSError,
+                    protocol.ProtocolError):
+                conn.backends.pop(name, None)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                self._mark_dead(name)
+        self._send(conn, {"type": "cancel_ok", "id": mid,
+                          "cancelled": total})
+
+    # -------------------------------------------------------- sending
+
+    def _relay(self, conn: _ClientConn, header: dict,
+               payload: Optional[bytes]) -> None:
+        sock = conn.sock
+        try:
+            sock.settimeout(None)
+            protocol.send_json(sock, header)
+            if payload is not None:
+                protocol.send_frame(sock, payload)
+            sock.settimeout(0.5)
+        except OSError:
+            conn.dead = True
+
+    def _send(self, conn: _ClientConn, obj: dict) -> None:
+        sock = conn.sock
+        try:
+            sock.settimeout(None)
+            protocol.send_json(sock, obj)
+            sock.settimeout(0.5)
+        except OSError:
+            conn.dead = True
+
+    def _send_unavailable(self, conn: _ClientConn, mid,
+                          last_refusal: Optional[dict] = None) -> None:
+        obj = {"type": "error", "id": mid, "code": "unavailable",
+               "message": "no routable replica (fleet exhausted "
+                          "failover attempts)"}
+        if last_refusal is not None:
+            obj["message"] += \
+                f"; last refusal: {last_refusal.get('code')}"
+        if self.retry_after_ms > 0:
+            obj["retryAfterMs"] = self.retry_after_ms
+        self._send(conn, obj)
+
+
+class _BackendRefused(Exception):
+    """A backend hello answered with a governance refusal frame."""
+
+    def __init__(self, reply: dict):
+        super().__init__(reply.get("message", ""))
+        self.reply = reply

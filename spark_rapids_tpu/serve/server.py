@@ -97,10 +97,106 @@ class _Connection:
         self.dead = False
 
 
+class _DedupeEntry:
+    """One idempotency-key slot: inflight while its owning handler
+    executes, done once the result frames are retained for replay."""
+
+    __slots__ = ("key", "state", "header", "payload", "event")
+
+    def __init__(self, key):
+        self.key = key
+        self.state = "inflight"  # inflight | done | failed
+        self.header: Optional[dict] = None
+        self.payload: bytes = b""
+        self.event = threading.Event()
+
+
+class _DedupeWindow:
+    """Bounded per-replica idempotency window (protocol.py contract):
+    a resubmitted request id is answered from here — same result
+    frames, no re-execution, no re-billing. Keys are (tenant,
+    requestId) so one tenant can never replay (or observe) another's
+    results by guessing ids. Only COMPLETED results are retained;
+    a failed execution abandons its slot so the resubmit re-runs —
+    exactly-once applies to results, errors stay retryable."""
+
+    def __init__(self, max_entries: int, max_bytes: int):
+        self.max_entries = int(max_entries)
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._inflight: Dict[tuple, _DedupeEntry] = {}
+        from collections import OrderedDict
+
+        self._done: "OrderedDict[tuple, _DedupeEntry]" = OrderedDict()
+        self._bytes = 0
+        self.replays = 0
+        self.joins = 0
+        self.evictions = 0
+        self.completed = 0
+
+    def claim(self, tenant: str, rid: str):
+        """-> ('run', entry) caller owns execution; ('wait', entry)
+        another handler is executing it; ('replay', entry) done."""
+        key = (tenant, rid)
+        with self._lock:
+            e = self._done.get(key)
+            if e is not None:
+                self._done.move_to_end(key)
+                self.replays += 1
+                return "replay", e
+            e = self._inflight.get(key)
+            if e is not None:
+                self.joins += 1
+                return "wait", e
+            e = _DedupeEntry(key)
+            self._inflight[key] = e
+            return "run", e
+
+    def complete(self, entry: _DedupeEntry, header: dict,
+                 payload: bytes) -> int:
+        """Retain the result for replay; returns evictions made."""
+        evicted = 0
+        with self._lock:
+            entry.header = dict(header)
+            entry.payload = payload
+            entry.state = "done"
+            self._inflight.pop(entry.key, None)
+            self._done[entry.key] = entry
+            self._bytes += len(payload)
+            self.completed += 1
+            while self._done and (
+                    len(self._done) > self.max_entries
+                    or self._bytes > self.max_bytes):
+                _, old = self._done.popitem(last=False)
+                self._bytes -= len(old.payload)
+                old.payload = b""
+                self.evictions += 1
+                evicted += 1
+        entry.event.set()
+        return evicted
+
+    def abandon(self, entry: _DedupeEntry) -> None:
+        with self._lock:
+            self._inflight.pop(entry.key, None)
+            entry.state = "failed"
+        entry.event.set()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._done),
+                    "inflight": len(self._inflight),
+                    "bytes": self._bytes,
+                    "completed": self.completed,
+                    "replays": self.replays,
+                    "joins": self.joins,
+                    "evictions": self.evictions}
+
+
 class QueryServiceDaemon:
     """TCP front door over one warm TpuSparkSession."""
 
-    def __init__(self, session=None, conf: Optional[dict] = None):
+    def __init__(self, session=None, conf: Optional[dict] = None,
+                 name: str = ""):
         from spark_rapids_tpu.config import rapids_conf as rc
         from spark_rapids_tpu.serve.plan_cache import PlanCache
 
@@ -112,12 +208,18 @@ class QueryServiceDaemon:
         else:
             self._owns_session = False
         self.session = session
+        self.name = str(name or "")
         cget = session.rapids_conf.get
         self.host = cget(rc.SERVE_HOST)
         self._conf_port = cget(rc.SERVE_PORT)
         self.max_connections = cget(rc.SERVE_MAX_CONNECTIONS)
         self.max_frame_bytes = cget(rc.SERVE_MAX_FRAME_BYTES)
         self.drain_timeout_ms = cget(rc.SERVE_DRAIN_TIMEOUT_MS)
+        self.retry_after_ms = cget(rc.SERVE_RETRY_AFTER_MS)
+        dedupe_entries = cget(rc.FLEET_DEDUPE_ENTRIES)
+        self._dedupe = _DedupeWindow(
+            dedupe_entries, cget(rc.FLEET_DEDUPE_MAX_BYTES)) \
+            if dedupe_entries > 0 else None
         self.priority_classes = parse_priority_classes(
             cget(rc.SERVE_PRIORITY_CLASSES))
         self.plan_cache = PlanCache(
@@ -138,6 +240,7 @@ class QueryServiceDaemon:
         self._admission = None
         self._prev_sigterm = None
         self._queries_served = 0
+        self._drain_abort = threading.Event()
 
     # ------------------------------------------------------ lifecycle
 
@@ -166,20 +269,44 @@ class QueryServiceDaemon:
         return self
 
     def install_signal_handlers(self) -> bool:
-        """SIGTERM -> graceful stop. Only possible on the main thread
-        (signal module contract); returns whether it installed."""
+        """SIGTERM -> graceful stop; a SECOND SIGTERM while the drain
+        is still waiting escalates (handle_term_signal). Only possible
+        on the main thread (signal module contract); returns whether
+        it installed."""
         import signal
 
         if threading.current_thread() is not threading.main_thread():
             return False
 
         def on_term(_sig, _frm):
-            threading.Thread(target=self.stop,
-                             name="srtpu-serve-sigterm",
-                             daemon=True).start()
+            self.handle_term_signal()
 
         self._prev_sigterm = signal.signal(signal.SIGTERM, on_term)
         return True
+
+    def handle_term_signal(self) -> None:
+        """First TERM: graceful stop on a helper thread. A repeat TERM
+        during the drain is an operator (or supervisor) saying 'now':
+        it cancels the stragglers immediately and aborts the drain
+        waits instead of being swallowed by the already-draining
+        guard — before this, a wedged drain could only be killed -9.
+        Signal-safe: nothing here blocks."""
+        from spark_rapids_tpu.obs import events as obs_events
+
+        with self._lock:
+            draining = self._state == "draining"
+            in_flight = self._in_flight
+            n_conns = len(self._conns)
+        if not draining:
+            threading.Thread(target=self.stop,
+                             name="srtpu-serve-sigterm",
+                             daemon=True).start()
+            return
+        obs_events.emit("serve.escalate", inFlight=in_flight,
+                        connections=n_conns)
+        self._drain_abort.set()
+        if self._admission is not None:
+            self._admission.cancel_all("drain escalated by signal")
 
     def drain(self, timeout_ms: Optional[int] = None) -> dict:
         """Graceful intake shutdown; returns the drain report."""
@@ -201,7 +328,8 @@ class QueryServiceDaemon:
         deadline = time.monotonic() + (
             self.drain_timeout_ms if timeout_ms is None
             else timeout_ms) / 1000.0
-        while time.monotonic() < deadline:
+        while time.monotonic() < deadline \
+                and not self._drain_abort.is_set():
             with self._lock:
                 if self._in_flight == 0:
                     break
@@ -210,9 +338,10 @@ class QueryServiceDaemon:
         with self._lock:
             stragglers = self._in_flight
         if stragglers:
-            # past the deadline: unwind survivors through the cancel
-            # machinery (bounded stop beats a wedged one), then give
-            # the handler threads a moment to settle their ledgers
+            # past the deadline (or escalated by a second SIGTERM):
+            # unwind survivors through the cancel machinery (bounded
+            # stop beats a wedged one), then give the handler threads
+            # a moment to settle their ledgers
             cancelled = self._admission.cancel_all(
                 "query service drain deadline")
             settle_by = time.monotonic() + 5.0
@@ -289,6 +418,10 @@ class QueryServiceDaemon:
 
     # ---------------------------------------------------- diagnostics
 
+    @property
+    def state(self) -> str:
+        return self._state
+
     def status(self) -> dict:
         with self._lock:
             conns = [{"tenant": c.tenant,
@@ -299,12 +432,15 @@ class QueryServiceDaemon:
             state = self._state
             in_flight = self._in_flight
         return {"state": state,
+                "name": self.name,
                 "port": self.port,
                 "connections": conns,
                 "inFlight": in_flight,
                 "queriesServed": self._queries_served,
                 "planCache": self.plan_cache.stats.snapshot(),
                 "tenants": self.tenants.snapshot(),
+                "dedupe": (self._dedupe.snapshot()
+                           if self._dedupe is not None else None),
                 "priorityClasses": dict(self.priority_classes)}
 
     def leak_report(self) -> dict:
@@ -354,12 +490,13 @@ class QueryServiceDaemon:
         conn.thread = t
         t.start()
 
-    @staticmethod
-    def _refuse(sock, code: str) -> None:
+    def _refuse(self, sock, code: str) -> None:
+        obj = {"type": "error", "code": code,
+               "message": f"connection refused: {code}"}
+        if code in ("busy", "draining") and self.retry_after_ms > 0:
+            obj["retryAfterMs"] = self.retry_after_ms
         try:
-            protocol.send_json(sock, {
-                "type": "error", "code": code,
-                "message": f"connection refused: {code}"})
+            protocol.send_json(sock, obj)
         except OSError:
             pass
         try:
@@ -405,6 +542,13 @@ class QueryServiceDaemon:
                     self._send(conn, {"type": "pong",
                                       "id": msg.get("id"),
                                       "state": self._state})
+                elif mtype == "status":
+                    # remote status snapshot — how the fleet gate
+                    # reconciles billing and dedupe across replicas
+                    # it can only reach over the wire
+                    self._send(conn, {"type": "status_ok",
+                                      "id": msg.get("id"),
+                                      "status": self.status()})
                 elif mtype == "bye":
                     self._send(conn, {"type": "bye_ok",
                                       "id": msg.get("id")})
@@ -479,9 +623,35 @@ class QueryServiceDaemon:
 
         mid = msg.get("id")
         tenant = conn.tenant
+        entry = None
+        rid = msg.get("requestId")
+        if rid is not None and self._dedupe is not None:
+            rid = str(rid)
+            while True:
+                verdict, entry = self._dedupe.claim(tenant, rid)
+                if verdict == "run":
+                    break  # we own the execution of this id
+                if verdict == "replay":
+                    # answered from the window: same result frames,
+                    # no re-execution, no re-billing
+                    self._replay(conn, mid, entry, "replay")
+                    return
+                # another handler is executing this id right now (a
+                # failover resubmit raced the original): wait for its
+                # outcome instead of double-executing
+                if not self._await_entry(conn, entry):
+                    return  # connection died / daemon stopped
+                if entry.state == "done":
+                    self._replay(conn, mid, entry, "joined")
+                    return
+                # the owner abandoned (execution failed): reclaim and
+                # run it ourselves — errors stay retryable
         try:
             self.tenants.admit(tenant)
         except QueryRejectedError as e:
+            if entry is not None:
+                self._dedupe.abandon(entry)
+                entry = None
             self._send_error(conn, mid, "tenant_quota", str(e))
             return
         with self._lock:
@@ -510,17 +680,32 @@ class QueryServiceDaemon:
             status = "ok"
             rows = table.num_rows
             wall_ms = round((time.perf_counter() - t0) * 1000.0, 3)
+            ipc = protocol.table_to_ipc(table)
+            header = {"queryId": qid, "rows": rows,
+                      "planCache": info["planCache"],
+                      "wallMs": wall_ms, "payloadBytes": len(ipc)}
+            # billing keys off EXECUTION, not delivery: the execution
+            # completed, so the bytes bill now — a replay of this id
+            # (lost ack, failover resubmit) then bills nothing, which
+            # is what lets fleet billing reconcile to exactly one
+            # charge per executed query
+            payload = len(ipc)
+            if entry is not None:
+                # retain for replay BEFORE the send: if the client or
+                # router dies mid-result, the resubmitted id replays
+                # instead of re-executing
+                self._dedupe.complete(entry, header, ipc)
+                entry = None
             try:
                 # lift the idle poll timeout for the send — sendall
                 # treats it as a TOTAL deadline, and a large result to
                 # a slow client would abort after a PARTIAL frame
                 conn.sock.settimeout(None)
-                payload = protocol.send_result(
-                    conn.sock,
-                    {"id": mid, "queryId": qid, "rows": rows,
-                     "planCache": info["planCache"],
-                     "wallMs": wall_ms},
-                    table)
+                protocol.send_json(conn.sock,
+                                   {**header, "id": mid,
+                                    "type": "result",
+                                    "payload": "arrow"})
+                protocol.send_frame(conn.sock, ipc)
             except OSError:
                 # client vanished / stalled mid-result; a partial
                 # frame desyncs the stream, so the connection closes
@@ -542,6 +727,10 @@ class QueryServiceDaemon:
             self._send_error(conn, mid, code, str(e),
                              reason=getattr(e, "reason", None))
         finally:
+            if entry is not None:
+                # execution did not complete: free the slot so a
+                # resubmit of this id re-runs instead of wedging
+                self._dedupe.abandon(entry)
             wall_s = time.perf_counter() - t0
             hit = str(info.get("planCache", "")).startswith("hit")
             serve_rec = {
@@ -568,6 +757,40 @@ class QueryServiceDaemon:
                 priorityClass=conn.priority_class,
                 planCache=info.get("planCache"), status=status,
                 rows=rows, wallMs=round(wall_s * 1000.0, 3))
+
+    def _await_entry(self, conn: _Connection,
+                     entry: _DedupeEntry) -> bool:
+        """Wait (bounded polls) for another handler's execution of the
+        same request id; False when this connection/daemon went away
+        first."""
+        while not entry.event.wait(timeout=0.2):
+            if conn.dead:
+                return False
+            with self._lock:
+                if self._state == "stopped":
+                    return False
+        return True
+
+    def _replay(self, conn: _Connection, mid,
+                entry: _DedupeEntry, outcome: str) -> None:
+        """Re-send a retained result under the current message id.
+        No admit, no settle: the execution already billed."""
+        from spark_rapids_tpu.obs import events as obs_events
+
+        sock = conn.sock
+        try:
+            sock.settimeout(None)
+            protocol.send_json(sock, {**entry.header, "id": mid,
+                                      "type": "result",
+                                      "payload": "arrow",
+                                      "dedupe": True})
+            protocol.send_frame(sock, entry.payload)
+            sock.settimeout(0.5)
+            conn.queries += 1
+        except OSError:
+            conn.dead = True
+        obs_events.emit("serve.dedupe", tenant=conn.tenant,
+                        requestId=entry.key[1], outcome=outcome)
 
     def _handle_cancel(self, conn: _Connection, msg: dict) -> None:
         # cancel is TENANT-SCOPED: a connection can only unwind
@@ -616,4 +839,8 @@ class QueryServiceDaemon:
                "message": message}
         if reason:
             obj["reason"] = reason
+        if code in ("busy", "draining") and self.retry_after_ms > 0:
+            # backpressure hint: retry THIS replica no sooner than
+            # this — clients sleep it, the router cools us down
+            obj["retryAfterMs"] = self.retry_after_ms
         self._send(conn, obj)

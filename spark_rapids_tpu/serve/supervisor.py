@@ -1,0 +1,386 @@
+"""ReplicaSupervisor — process-per-replica fleet lifecycle.
+
+One supervisor owns N replica daemons (serve/replica.py subprocesses),
+each with its own warm session and, when `fleet.replica.mesh` > 0, its
+own chip subset via the existing multichip conf. The supervisor is the
+part of the fleet that makes replica death BORING:
+
+- spawn: per-replica env (name, JSON conf, ready-file path) + `python
+  -m spark_rapids_tpu.serve.replica`; readiness is the atomically
+  renamed ready file carrying the ephemeral serve/http ports.
+- monitor: a poll loop reaps exits. An exit while serving is a crash —
+  the replica crash-loops back up under the shared backoff curve
+  (fleet.restart.{backoffMs,maxBackoffMs}), up to
+  fleet.restart.maxRestarts consecutive failures before `giveup`
+  (a replica that came back to ready resets its crash count).
+- stop: SIGTERM every replica (graceful drain inside — server.py),
+  SIGKILL past fleet.drain.timeoutMs, reap everything, delete ready
+  files. Bounded shutdown is a contract: the fleet gate asserts zero
+  leaked processes.
+
+`restart_replica` is the rolling-restart primitive (drain one, respawn
+it, wait ready) and `kill` is the chaos primitive (the fleet gate's
+kill -9). Every transition emits a `fleet.replica` event; counters
+surface via stats_snapshot() -> the srtpu_fleet_supervisor_* prom
+family (obs/registry.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+_active_supervisor = None
+_active_lock = threading.Lock()
+
+
+def active_supervisor() -> Optional["ReplicaSupervisor"]:
+    """The most recently started supervisor in this process (the
+    obs/registry fleet-block hook)."""
+    return _active_supervisor
+
+
+class _Replica:
+    __slots__ = ("name", "conf", "proc", "ready_path", "generation",
+                 "port", "http_port", "pid", "state", "crashes",
+                 "restarts", "restart_at")
+
+    def __init__(self, name: str, conf: dict):
+        self.name = name
+        self.conf = conf
+        self.proc: Optional[subprocess.Popen] = None
+        self.ready_path = ""
+        self.generation = 0
+        self.port: Optional[int] = None
+        self.http_port: Optional[int] = None
+        self.pid: Optional[int] = None
+        # spawning | ready | restarting | giveup | stopped
+        self.state = "stopped"
+        self.crashes = 0          # consecutive, reset on ready
+        self.restarts = 0         # lifetime
+        self.restart_at = 0.0     # monotonic deadline for respawn
+
+    def endpoint(self) -> dict:
+        return {"name": self.name, "host": "127.0.0.1",
+                "port": self.port, "httpPort": self.http_port,
+                "pid": self.pid, "state": self.state,
+                "restarts": self.restarts}
+
+
+class ReplicaSupervisor:
+    """Spawn/monitor/restart a fleet of replica daemons."""
+
+    def __init__(self, conf: Optional[dict] = None,
+                 replica_confs: Optional[List[dict]] = None):
+        from spark_rapids_tpu.config import rapids_conf as rc
+        from spark_rapids_tpu.runtime.backoff import BackoffPolicy
+
+        self._settings = dict(conf or {})
+        rconf = rc.RapidsConf(self._settings)
+        self.max_restarts = rconf.get(rc.FLEET_RESTART_MAX)
+        self.spawn_timeout_ms = rconf.get(rc.FLEET_SPAWN_TIMEOUT_MS)
+        self.drain_timeout_ms = rconf.get(rc.FLEET_DRAIN_TIMEOUT_MS)
+        self._restart_policy = BackoffPolicy(
+            max(1, self.max_restarts),
+            rconf.get(rc.FLEET_RESTART_BACKOFF_MS),
+            rconf.get(rc.FLEET_RESTART_MAX_BACKOFF_MS))
+        mesh = rconf.get(rc.FLEET_REPLICA_MESH)
+        if replica_confs is None:
+            n = rconf.get(rc.FLEET_REPLICAS)
+            replica_confs = [dict(self._settings) for _ in range(n)]
+        self._replicas: List[_Replica] = []
+        for i, rcnf in enumerate(replica_confs):
+            per = dict(rcnf)
+            # the replica's daemon must bind its OWN ephemeral port —
+            # the conf'd serve.port belongs to the router, not to N
+            # replicas racing for it
+            per["spark.rapids.tpu.serve.port"] = 0
+            if mesh > 0 and "spark.rapids.tpu.mesh" not in per:
+                per["spark.rapids.tpu.mesh"] = mesh
+            self._replicas.append(_Replica(f"replica-{i}", per))
+        self._dir = tempfile.mkdtemp(prefix="srtpu-fleet-")
+        self._lock = threading.Lock()
+        self._stopping = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self._stats = {"spawns": 0, "restarts": 0, "exits": 0,
+                       "giveups": 0, "kills": 0}
+        self._state = "new"
+
+    # ------------------------------------------------------ lifecycle
+
+    def start(self) -> "ReplicaSupervisor":
+        if self._state != "new":
+            raise RuntimeError(f"supervisor already {self._state}")
+        self._state = "running"
+        for r in self._replicas:
+            self._spawn(r)
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="srtpu-fleet-monitor",
+            daemon=True)
+        self._monitor.start()
+        global _active_supervisor
+        with _active_lock:
+            _active_supervisor = self
+        return self
+
+    def wait_ready(self, timeout_ms: Optional[int] = None,
+                   min_ready: Optional[int] = None) -> List[dict]:
+        """Block until `min_ready` (default: all non-giveup) replicas
+        are accepting; returns their endpoints. TimeoutError past the
+        spawn budget."""
+        from spark_rapids_tpu.runtime import cancellation
+
+        deadline = time.monotonic() + (
+            self.spawn_timeout_ms if timeout_ms is None
+            else timeout_ms) / 1000.0
+        while True:
+            with self._lock:
+                live = [r for r in self._replicas
+                        if r.state != "giveup"]
+                ready = [r for r in live if r.state == "ready"]
+                need = len(live) if min_ready is None \
+                    else min(min_ready, len(live))
+            if live and len(ready) >= need:
+                return [r.endpoint() for r in ready]
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"fleet spawn: {len(ready)}/{need} replicas ready "
+                    f"within {self.spawn_timeout_ms}ms")
+            cancellation.sleep_interruptible(0.05)
+
+    def stop(self) -> None:
+        """SIGTERM everything (graceful drain), SIGKILL stragglers
+        past fleet.drain.timeoutMs, reap, clean up. Idempotent."""
+        from spark_rapids_tpu.obs import events as obs_events
+
+        if self._state == "stopped":
+            return
+        self._state = "stopped"
+        self._stopping.set()
+        if self._monitor is not None:
+            # park the monitor FIRST so no respawn races the
+            # teardown into a leaked process
+            self._monitor.join(timeout=5.0)
+        with self._lock:
+            procs = [(r, r.proc) for r in self._replicas
+                     if r.proc is not None]
+        obs_events.emit("fleet.drain", phase="begin",
+                        replicas=len(procs))
+        for _r, p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        deadline = time.monotonic() + self.drain_timeout_ms / 1000.0
+        for r, p in procs:
+            left = max(0.05, deadline - time.monotonic())
+            try:
+                p.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+                p.wait(timeout=10.0)
+            r.state = "stopped"
+        for r in self._replicas:
+            if r.ready_path and os.path.exists(r.ready_path):
+                try:
+                    os.remove(r.ready_path)
+                except OSError:
+                    pass
+        obs_events.emit("fleet.drain", phase="end",
+                        replicas=len(procs))
+        global _active_supervisor
+        with _active_lock:
+            if _active_supervisor is self:
+                _active_supervisor = None
+
+    def __enter__(self) -> "ReplicaSupervisor":
+        return self.start() if self._state == "new" else self
+
+    def __exit__(self, *_exc) -> bool:
+        self.stop()
+        return False
+
+    # ------------------------------------------------------ fleet ops
+
+    def endpoints(self) -> List[dict]:
+        with self._lock:
+            return [r.endpoint() for r in self._replicas
+                    if r.state == "ready"]
+
+    def kill(self, name: str, sig: int = signal.SIGKILL) -> bool:
+        """Chaos/ops primitive: signal one replica by name (the fleet
+        gate's kill -9 lands here). The monitor reaps and crash-loops
+        it like any other death."""
+        with self._lock:
+            r = self._by_name(name)
+            proc = r.proc if r is not None else None
+        if proc is None or proc.poll() is not None:
+            return False
+        self._stats["kills"] += 1
+        try:
+            proc.send_signal(sig)
+        except OSError:
+            return False
+        return True
+
+    def restart_replica(self, name: str,
+                        timeout_ms: Optional[int] = None) -> dict:
+        """Rolling-restart primitive: drain one replica (SIGTERM),
+        reap it, respawn it, wait for its ready file. Returns the new
+        endpoint. The caller restarts replicas ONE at a time so the
+        fleet never loses more than one member of capacity."""
+        from spark_rapids_tpu.runtime import cancellation
+
+        with self._lock:
+            r = self._by_name(name)
+            if r is None:
+                raise KeyError(f"unknown replica {name!r}")
+            proc = r.proc
+            r.state = "restarting"
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+            try:
+                proc.wait(timeout=self.drain_timeout_ms / 1000.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10.0)
+        with self._lock:
+            self._stats["restarts"] += 1
+            r.restarts += 1
+            r.crashes = 0  # operator-intended, not a crash loop
+            self._spawn_locked(r)
+        deadline = time.monotonic() + (
+            self.spawn_timeout_ms if timeout_ms is None
+            else timeout_ms) / 1000.0
+        while time.monotonic() < deadline:
+            with self._lock:
+                if r.state == "ready":
+                    return r.endpoint()
+            cancellation.sleep_interruptible(0.05)
+        raise TimeoutError(f"replica {name!r} did not come back ready")
+
+    def stats_snapshot(self) -> dict:
+        with self._lock:
+            states = [r.state for r in self._replicas]
+            return {**self._stats,
+                    "replicas": len(self._replicas),
+                    "ready": states.count("ready"),
+                    "giveup": states.count("giveup")}
+
+    # ------------------------------------------------------ internals
+
+    def _by_name(self, name: str) -> Optional[_Replica]:
+        for r in self._replicas:
+            if r.name == name:
+                return r
+        return None
+
+    def _spawn(self, r: _Replica) -> None:
+        with self._lock:
+            self._spawn_locked(r)
+
+    def _spawn_locked(self, r: _Replica) -> None:
+        from spark_rapids_tpu.obs import events as obs_events
+
+        r.generation += 1
+        r.ready_path = os.path.join(
+            self._dir, f"ready-{r.name}-{r.generation}.json")
+        env = dict(os.environ)
+        env["SRTPU_REPLICA_NAME"] = r.name
+        env["SRTPU_REPLICA_CONF"] = json.dumps(r.conf)
+        env["SRTPU_REPLICA_READY"] = r.ready_path
+        # the replica runs with cwd in the fleet scratch dir — make
+        # sure the package stays importable from a repo checkout
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else "")
+        r.proc = subprocess.Popen(
+            [sys.executable, "-m", "spark_rapids_tpu.serve.replica"],
+            env=env, cwd=self._dir,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        r.pid = r.proc.pid
+        r.port = None
+        r.http_port = None
+        r.state = "spawning"
+        self._stats["spawns"] += 1
+        obs_events.emit("fleet.replica", name=r.name, phase="spawn",
+                        pid=r.pid, port=None, restarts=r.restarts)
+
+    def _monitor_loop(self) -> None:
+        from spark_rapids_tpu.obs import events as obs_events
+
+        while not self._stopping.wait(timeout=0.05):
+            now = time.monotonic()
+            with self._lock:
+                replicas = list(self._replicas)
+            for r in replicas:
+                with self._lock:
+                    state, proc = r.state, r.proc
+                if state == "spawning" and \
+                        os.path.exists(r.ready_path):
+                    try:
+                        with open(r.ready_path) as f:
+                            info = json.load(f)
+                    except (OSError, ValueError):
+                        continue  # racing the atomic rename
+                    with self._lock:
+                        r.port = info.get("port")
+                        r.http_port = info.get("httpPort")
+                        r.state = "ready"
+                        r.crashes = 0
+                    obs_events.emit(
+                        "fleet.replica", name=r.name, phase="ready",
+                        pid=r.pid, port=r.port, restarts=r.restarts)
+                    continue
+                if state in ("spawning", "ready") and \
+                        proc is not None and proc.poll() is not None:
+                    # died under us: crash-loop it back up
+                    self._stats["exits"] += 1
+                    obs_events.emit(
+                        "fleet.replica", name=r.name, phase="exit",
+                        pid=r.pid, port=r.port, restarts=r.restarts)
+                    with self._lock:
+                        r.crashes += 1
+                        r.port = None
+                        r.http_port = None
+                        if self.max_restarts <= 0 or \
+                                r.crashes > self.max_restarts:
+                            r.state = "giveup"
+                            self._stats["giveups"] += 1
+                        else:
+                            r.state = "restarting"
+                            r.restart_at = now + \
+                                self._restart_policy.delay_s(
+                                    r.crashes - 1)
+                    if r.state == "giveup":
+                        obs_events.emit(
+                            "fleet.replica", name=r.name,
+                            phase="giveup", pid=r.pid, port=None,
+                            restarts=r.restarts)
+                    continue
+                if state == "restarting" and r.restart_at and \
+                        now >= r.restart_at:
+                    with self._lock:
+                        r.restart_at = 0.0
+                        self._stats["restarts"] += 1
+                        r.restarts += 1
+                        self._spawn_locked(r)
+                    obs_events.emit(
+                        "fleet.replica", name=r.name, phase="restart",
+                        pid=r.pid, port=None, restarts=r.restarts)

@@ -1,0 +1,554 @@
+"""Fleet layer suite (serve/router.py, serve/supervisor.py, server
+dedupe window, client connect retry) — the fault-tolerant serving
+fleet end to end.
+
+Router routing/failover semantics run against STUB replicas (tiny
+socket servers speaking serve/protocol.py with canned behaviors):
+the process-global admission controller means two real daemons in one
+process would share a drain valve, and stubs make death/refusal
+deterministic. Real-execution fleet correctness (subprocess replicas,
+kill -9 mid-soak, billing reconciliation) is covered by the
+supervisor test here plus ci/fleet_check.sh.
+"""
+
+import hashlib
+import socket
+import threading
+import time
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.api.session import TpuSparkSession
+from spark_rapids_tpu.runtime import admission, backoff
+from spark_rapids_tpu.runtime.errors import (
+    QueryCancelledError,
+    QueryRejectedError,
+)
+from spark_rapids_tpu.serve import protocol
+from spark_rapids_tpu.serve.client import ServeClient, ServeError
+from spark_rapids_tpu.serve.plan_cache import affinity_key
+from spark_rapids_tpu.serve.router import FleetRouter
+from spark_rapids_tpu.serve.server import QueryServiceDaemon
+
+STUB_TABLE = pa.table({"x": pa.array([1, 2, 3], pa.int64())})
+
+
+class StubReplica:
+    """A minimal protocol-speaking replica with a canned behavior:
+    'ok' serves STUB_TABLE, 'busy'/'draining' refuse with a
+    retryAfterMs hint, 'die' drops the connection mid-query (the
+    kill -9 shape as the router sees it)."""
+
+    def __init__(self, behavior: str = "ok"):
+        self.behavior = behavior
+        self.retry_after_ms = 40
+        self.requests = []
+        self.hellos = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._conns = []
+        self._listener = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(16)
+        self._listener.settimeout(0.2)
+        self.port = self._listener.getsockname()[1]
+        self._threads = [threading.Thread(target=self._accept,
+                                          daemon=True)]
+        self._threads[0].start()
+
+    def _accept(self):
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with self._lock:
+                self._conns.append(sock)
+            t = threading.Thread(target=self._serve, args=(sock,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, sock):
+        try:
+            hello = protocol.recv_json(sock, 1 << 20)
+            with self._lock:
+                self.hellos += 1
+            protocol.send_json(sock, {
+                "type": "hello_ok", "id": hello.get("id"),
+                "version": 1, "tenant": hello.get("tenant"),
+                "priorityClass": hello.get("priorityClass"),
+                "priority": 0})
+            sock.settimeout(0.2)
+            while not self._stop.is_set():
+                try:
+                    msg = protocol.recv_json(sock, 1 << 20)
+                except socket.timeout:
+                    continue
+                mtype = msg.get("type")
+                if mtype == "query":
+                    with self._lock:
+                        self.requests.append(msg)
+                    b = self.behavior
+                    if b == "die":
+                        sock.close()
+                        return
+                    if b in ("busy", "draining"):
+                        protocol.send_json(sock, {
+                            "type": "error", "id": msg.get("id"),
+                            "code": b, "message": f"stub {b}",
+                            "retryAfterMs": self.retry_after_ms})
+                        continue
+                    protocol.send_result(sock, {
+                        "id": msg.get("id"), "queryId": 1,
+                        "rows": STUB_TABLE.num_rows,
+                        "planCache": "miss", "wallMs": 1.0},
+                        STUB_TABLE)
+                elif mtype == "cancel":
+                    protocol.send_json(sock, {
+                        "type": "cancel_ok", "id": msg.get("id"),
+                        "cancelled": 1})
+                elif mtype == "bye":
+                    protocol.send_json(sock, {"type": "bye_ok",
+                                              "id": msg.get("id")})
+                    return
+        except (ConnectionError, OSError, protocol.ProtocolError):
+            return
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+        for s in conns:
+            try:
+                s.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+
+def _endpoints(stubs):
+    return [{"name": name, "host": "127.0.0.1", "port": s.port,
+             "httpPort": None} for name, s in stubs.items()]
+
+
+def _winner(akey, names):
+    """The router's rendezvous choice for this affinity key (same
+    formula as FleetRouter._candidates)."""
+    return max(names, key=lambda n: hashlib.sha256(
+        f"{akey}|{n}".encode()).hexdigest())
+
+
+RANGE_SPEC = {"op": "range", "end": 10}
+
+
+@pytest.fixture()
+def stub_pair():
+    stubs = {"a": StubReplica(), "b": StubReplica()}
+    try:
+        yield stubs
+    finally:
+        for s in stubs.values():
+            s.stop()
+
+
+@pytest.fixture()
+def router(stub_pair):
+    r = FleetRouter(endpoints=_endpoints(stub_pair),
+                    conf={"spark.rapids.tpu.fleet.health.intervalMs":
+                          100}).start()
+    try:
+        yield r
+    finally:
+        r.stop()
+
+
+# ----------------------------------------------------------- routing
+
+
+def test_router_routes_and_relays(router, stub_pair):
+    with ServeClient("127.0.0.1", router.port, "acme") as c:
+        t = c.query(RANGE_SPEC)
+        assert t.equals(STUB_TABLE)
+        assert c.last_result["replica"] in stub_pair
+        assert c.last_result["requestId"].startswith("rt-")
+    snap = router.stats_snapshot()
+    assert snap["queriesRouted"] == 1
+    assert snap["mintedRequestIds"] == 1
+
+
+def test_router_forwards_client_request_id(router, stub_pair):
+    with ServeClient("127.0.0.1", router.port, "acme") as c:
+        c.query(RANGE_SPEC, request_id="my-idem-key")
+        assert c.last_result["requestId"] == "my-idem-key"
+    got = [m["requestId"] for s in stub_pair.values()
+           for m in s.requests]
+    assert got == ["my-idem-key"]
+
+
+def test_router_affinity_consistent_and_spread(router, stub_pair):
+    """Repeat specs pin to the rendezvous winner; distinct specs
+    spread across the fleet."""
+    akey = affinity_key("acme", RANGE_SPEC, {})
+    w = _winner(akey, list(stub_pair))
+    with ServeClient("127.0.0.1", router.port, "acme") as c:
+        for _ in range(5):
+            c.query(RANGE_SPEC)
+            assert c.last_result["replica"] == w
+        for n in range(30):
+            c.query({"op": "range", "end": 100 + n})
+    counts = {name: len(s.requests)
+              for name, s in stub_pair.items()}
+    assert counts[w] >= 5
+    assert all(v > 0 for v in counts.values()), counts
+
+
+def test_router_failover_on_dead_replica(router, stub_pair):
+    """The rendezvous winner dies mid-query: the SAME requestId
+    resubmits to the survivor and the client never sees the death."""
+    akey = affinity_key("acme", RANGE_SPEC, {})
+    w = _winner(akey, list(stub_pair))
+    other = next(n for n in stub_pair if n != w)
+    stub_pair[w].behavior = "die"
+    with ServeClient("127.0.0.1", router.port, "acme") as c:
+        t = c.query(RANGE_SPEC, request_id="failover-1")
+        assert t.equals(STUB_TABLE)
+        assert c.last_result["replica"] == other
+    assert router.stats_snapshot()["failovers"] >= 1
+    # both replicas saw the SAME idempotency key — that is what makes
+    # the resubmit safe against a replica that died after executing
+    assert [m["requestId"] for m in stub_pair[w].requests] == \
+        ["failover-1"]
+    assert [m["requestId"] for m in stub_pair[other].requests] == \
+        ["failover-1"]
+
+
+def test_router_reroutes_draining_with_cooldown(router, stub_pair):
+    akey = affinity_key("acme", RANGE_SPEC, {})
+    w = _winner(akey, list(stub_pair))
+    other = next(n for n in stub_pair if n != w)
+    stub_pair[w].behavior = "draining"
+    stub_pair[w].retry_after_ms = 5000
+    with ServeClient("127.0.0.1", router.port, "acme") as c:
+        t = c.query(RANGE_SPEC)
+        assert t.equals(STUB_TABLE)
+        assert c.last_result["replica"] == other
+    snap = router.stats_snapshot()
+    assert snap["rerouted"] >= 1
+    # the refusal's retryAfterMs hint cooled the drainer down
+    assert router.health()["replicas"][w]["coolingDown"]
+
+
+def test_router_unavailable_when_fleet_refuses(stub_pair):
+    for s in stub_pair.values():
+        s.behavior = "draining"
+    r = FleetRouter(
+        endpoints=_endpoints(stub_pair),
+        conf={"spark.rapids.tpu.fleet.failover.maxAttempts": 2,
+              "spark.rapids.tpu.serve.retryAfterMs": 30}).start()
+    try:
+        with ServeClient("127.0.0.1", r.port, "acme") as c:
+            with pytest.raises(QueryRejectedError) as ei:
+                c.query(RANGE_SPEC)
+        assert getattr(ei.value, "reason", "") == "unavailable"
+        assert getattr(ei.value, "retry_after_ms", 0) > 0
+        assert r.stats_snapshot()["unavailable"] == 1
+    finally:
+        r.stop()
+
+
+def test_router_readyz_aggregates_members(router, stub_pair):
+    import json
+    import urllib.request
+
+    assert router.http_port
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{router.http_port}/readyz",
+            timeout=5.0) as resp:
+        body = json.loads(resp.read().decode())
+    assert body["ready"] is True
+    assert set(body["replicas"]) == set(stub_pair)
+    # kill every stub: readiness degrades to 503 once probes notice
+    for s in stub_pair.values():
+        s.stop()
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if not router.health()["ready"]:
+            break
+        time.sleep(0.05)
+    assert not router.health()["ready"]
+
+
+def test_router_fans_cancel_out(router, stub_pair):
+    with ServeClient("127.0.0.1", router.port, "acme") as c:
+        c.query(RANGE_SPEC)
+        assert c.cancel() >= 1  # every touched replica answered
+
+
+def test_router_leak_free_stop(stub_pair):
+    r = FleetRouter(endpoints=_endpoints(stub_pair)).start()
+    c = ServeClient("127.0.0.1", r.port, "acme")
+    c.query(RANGE_SPEC)
+    r.stop()
+    assert r.leak_report() == {"connections": 0,
+                               "handlerThreads": 0, "listener": 0}
+    c.close()
+
+
+# ------------------------------------------------- dedupe (real daemon)
+
+
+@pytest.fixture(scope="module")
+def fleet_session():
+    s = TpuSparkSession({})
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def daemon(fleet_session):
+    d = QueryServiceDaemon(session=fleet_session).start()
+    try:
+        yield d
+    finally:
+        d.stop()
+
+
+def test_dedupe_replays_exactly_once(daemon):
+    """Resubmitting a completed requestId answers from the window:
+    identical result, dedupe-flagged header, ONE execution, ONE bill."""
+    with ServeClient.connect(daemon, "acme") as c:
+        t1 = c.query(RANGE_SPEC, request_id="idem-1")
+        assert not c.last_result.get("dedupe")
+        served = daemon.status()["queriesServed"]
+        t2 = c.query(RANGE_SPEC, request_id="idem-1")
+        assert c.last_result["dedupe"] is True
+        assert t2.equals(t1)
+    st = daemon.status()
+    assert st["queriesServed"] == served  # no second execution
+    assert st["dedupe"]["replays"] == 1
+    assert st["dedupe"]["completed"] == 1
+    # billed once: the tenant ledger saw exactly one query
+    assert st["tenants"]["acme"]["queries"] == 1
+
+
+def test_dedupe_is_tenant_scoped(daemon):
+    """The same requestId from two tenants is two executions — one
+    tenant can never replay (or observe) another's results."""
+    with ServeClient.connect(daemon, "acme") as a:
+        a.query(RANGE_SPEC, request_id="shared-key")
+    with ServeClient.connect(daemon, "globex") as b:
+        b.query(RANGE_SPEC, request_id="shared-key")
+        assert not b.last_result.get("dedupe")
+    st = daemon.status()["dedupe"]
+    assert st["completed"] == 2
+    assert st["replays"] == 0
+
+
+def test_dedupe_window_bounded():
+    from spark_rapids_tpu.serve.server import _DedupeWindow
+
+    w = _DedupeWindow(max_entries=2, max_bytes=1 << 20)
+    for i in range(4):
+        verdict, e = w.claim("t", f"k{i}")
+        assert verdict == "run"
+        w.complete(e, {"rows": 1}, b"x" * 10)
+    snap = w.snapshot()
+    assert snap["entries"] == 2
+    assert snap["evictions"] == 2
+    # an evicted id re-executes (claim says run, not replay)
+    verdict, _e = w.claim("t", "k0")
+    assert verdict == "run"
+    # a retained id replays
+    verdict, _e = w.claim("t", "k3")
+    assert verdict == "replay"
+
+
+# ------------------------------------------- SIGTERM drain escalation
+
+
+def test_second_sigterm_escalates_wedged_drain(daemon):
+    """Regression: a second TERM during an active drain cancels the
+    stragglers and aborts the drain wait instead of being swallowed
+    by the already-draining guard."""
+    from spark_rapids_tpu.obs import events as obs_events
+
+    daemon.drain_timeout_ms = 60_000  # a wedged drain would sit here
+    ctrl = admission.get()
+    holds = [ctrl.submit(obs_events.allocate_query_id(),
+                         description="test:hold")
+             for _ in range(ctrl.max_concurrent)]
+    errors = []
+
+    def submit_wedged():
+        try:
+            with ServeClient.connect(daemon, "acme") as c:
+                c.query(RANGE_SPEC)
+        except (QueryRejectedError, QueryCancelledError,
+                ServeError, ConnectionError, OSError) as e:
+            errors.append(e)
+
+    t = threading.Thread(target=submit_wedged, daemon=True)
+    try:
+        t.start()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and \
+                daemon.status()["inFlight"] == 0:
+            time.sleep(0.02)
+        assert daemon.status()["inFlight"] == 1
+        t0 = time.monotonic()
+        daemon.handle_term_signal()  # first TERM: graceful stop
+        while time.monotonic() < deadline and \
+                daemon.state != "draining":
+            time.sleep(0.02)
+        assert daemon.state == "draining"
+        daemon.handle_term_signal()  # second TERM: escalate
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and \
+                daemon.state != "stopped":
+            time.sleep(0.05)
+        elapsed = time.monotonic() - t0
+        assert daemon.state == "stopped"
+        assert elapsed < 15.0  # nowhere near the 60s drain window
+        t.join(timeout=5.0)
+        assert errors, "the wedged query must have been unwound"
+    finally:
+        for h in holds:
+            ctrl.finish(h, status="cancelled")
+        ctrl.end_drain()
+
+
+# --------------------------------------------- client connect retry
+
+
+def test_connect_retry_rides_out_replica_boot(fleet_session):
+    """satellite: a replica that is still booting (connection refused)
+    must not surface ConnectionRefusedError when retry is conf'd."""
+    probe = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    late = QueryServiceDaemon(session=fleet_session)
+    late._conf_port = port
+    before = backoff.counters().get("serve.connect", 0)
+
+    def start_late():
+        time.sleep(0.7)
+        late.start()
+
+    t = threading.Thread(target=start_late, daemon=True)
+    t.start()
+    try:
+        with ServeClient("127.0.0.1", port, "acme",
+                         connect_attempts=40,
+                         connect_backoff_ms=100,
+                         connect_max_backoff_ms=200) as c:
+            assert c.ping()["type"] == "pong"
+    finally:
+        t.join(timeout=5.0)
+        late.stop()
+    # the retries landed in the shared backoff counter surface
+    assert backoff.counters().get("serve.connect", 0) > before
+
+
+def test_connect_exhaustion_surfaces_original_error():
+    with pytest.raises(OSError):
+        ServeClient("127.0.0.1", 1, "acme", connect_attempts=2,
+                    connect_backoff_ms=10, connect_max_backoff_ms=20)
+
+
+# --------------------------------------------- retryAfterMs hints
+
+
+def test_draining_frames_carry_retry_after_hint(daemon):
+    with ServeClient.connect(daemon, "acme") as c:
+        daemon.drain(timeout_ms=500)
+        with pytest.raises(QueryRejectedError) as ei:
+            c.query(RANGE_SPEC)
+        assert getattr(ei.value, "reason", "") == "draining"
+        assert ei.value.retry_after_ms == 250  # the conf default
+
+
+def test_busy_refusal_carries_retry_after_hint(daemon):
+    daemon.max_connections = 0
+    with pytest.raises(ServeError) as ei:
+        ServeClient.connect(daemon, "acme")
+    assert ei.value.code == "busy"
+    assert ei.value.retry_after_ms == 250
+
+
+def test_status_over_the_wire(daemon):
+    with ServeClient.connect(daemon, "acme") as c:
+        c.query(RANGE_SPEC, request_id="s1")
+        st = c.status()
+    assert st["queriesServed"] == 1
+    assert st["dedupe"]["completed"] == 1
+
+
+# ------------------------------------- real subprocess fleet (e2e)
+
+
+@pytest.mark.slow
+def test_supervisor_fleet_end_to_end():
+    """Two real replica processes under a supervisor behind a router:
+    serve, kill -9 the affinity target mid-stream, fail over with the
+    same requestId, crash-loop the victim back, stop leak-free."""
+    from spark_rapids_tpu.serve.supervisor import ReplicaSupervisor
+
+    sup = ReplicaSupervisor(conf={}, replica_confs=[{}, {}]).start()
+    rtr = None
+    try:
+        sup.wait_ready(timeout_ms=180_000)
+        rtr = FleetRouter(
+            supervisor=sup,
+            conf={"spark.rapids.tpu.fleet.health.intervalMs": 100,
+                  "spark.rapids.tpu.fleet.failover.maxAttempts": 6}
+        ).start()
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and \
+                len(rtr.health()["routable"]) < 2:
+            time.sleep(0.1)
+        assert len(rtr.health()["routable"]) == 2
+        with ServeClient("127.0.0.1", rtr.port, "acme",
+                         connect_attempts=10) as c:
+            t = c.query(RANGE_SPEC, request_id="e2e-1")
+            assert t.num_rows == 10
+            victim = c.last_result["replica"]
+            assert sup.kill(victim)  # SIGKILL, the chaos shape
+            # same spec, same affinity target — now dead: the router
+            # must fail over to the survivor transparently
+            t2 = c.query(RANGE_SPEC, request_id="e2e-2")
+            assert t2.num_rows == 10
+            assert c.last_result["replica"] != victim
+        # the supervisor crash-loops the victim back to ready
+        deadline = time.monotonic() + 180.0
+        while time.monotonic() < deadline and \
+                len(sup.endpoints()) < 2:
+            time.sleep(0.2)
+        assert len(sup.endpoints()) == 2
+        assert sup.stats_snapshot()["restarts"] >= 1
+    finally:
+        if rtr is not None:
+            rtr.stop()
+        sup.stop()
+    # zero leaks: every replica process reaped
+    for r in sup._replicas:
+        assert r.proc is not None and r.proc.poll() is not None
+    if rtr is not None:
+        assert rtr.leak_report()["connections"] == 0

@@ -304,6 +304,110 @@ def test_failed_execution_drops_its_binding(session, table_path):
     assert info["planCache"] == "hit-rebind"
 
 
+# ------------------------------- fleet affinity & byte-stability
+
+
+def _affinity_cases(path):
+    from spark_rapids_tpu.serve.plan_cache import affinity_key
+
+    return {
+        "param": affinity_key("t", _spec(path), {"lo": 5}),
+        "param-other-value": affinity_key("t", _spec(path),
+                                          {"lo": 99}),
+        "lit": affinity_key("t", _lit_spec(path, 5)),
+        "lit-other-value": affinity_key("t", _lit_spec(path, 99)),
+        "float-binding": affinity_key("t", _spec(path), {"lo": 5.0}),
+        "other-tenant": affinity_key("u", _spec(path), {"lo": 5}),
+    }
+
+
+def test_affinity_key_is_structural_not_literal(table_path):
+    """The router's hash-ring input must pin repeat SHAPES to one
+    replica: binding values don't move it, types and tenants do."""
+    k = _affinity_cases(table_path)
+    assert k["param"] == k["param-other-value"]
+    assert k["lit"] == k["lit-other-value"]
+    # a {"lit": v} spec and its {"param": ...} twin differ only in
+    # the param NAME (__lit0 vs lo) — structurally distinct, and
+    # that is fine: each client style still self-affines
+    assert k["param"] != k["float-binding"]  # type signature counts
+    assert k["param"] != k["other-tenant"]   # tenant isolation
+
+
+def test_affinity_key_ignores_planning_conf(table_path):
+    """Replicas may run different confs; the conf digest belongs to
+    the replica-side structural key, never to routing affinity."""
+    from spark_rapids_tpu.serve.plan_cache import (
+        PlanCache,
+        affinity_key,
+    )
+
+    a = affinity_key("t", _spec(table_path), {"lo": 5})
+    assert a == affinity_key("t", _spec(table_path), {"lo": 5})
+    cache = PlanCache()
+    norm, auto = normalize_spec(_spec(table_path))
+    s1 = cache.structural_key("t", norm, {"lo": 5}, {})
+    s2 = cache.structural_key(
+        "t", norm, {"lo": 5}, {"spark.rapids.tpu.sql.x": "1"})
+    # replica-side keys DO invalidate on spark.* conf change...
+    assert s1 != s2
+    # ...while the router-side affinity key is conf-free by
+    # construction (no settings input at all) — s1/s2 divergence
+    # cannot split a tenant's affinity
+
+
+def test_keys_are_byte_stable_across_processes(table_path):
+    """satellite: affinity routing only works if a FRESH process (a
+    restarted router, a respawned replica) digests the same spec to
+    the same bytes — no dict-order, hash-seed or repr drift."""
+    import json
+    import subprocess
+    import sys
+
+    prog = (
+        "import json,sys\n"
+        "from spark_rapids_tpu.serve.plan_cache import (\n"
+        "    PlanCache, affinity_key, normalize_spec)\n"
+        "path = sys.argv[1]\n"
+        "spec = {'op': 'filter',\n"
+        "        'input': {'op': 'parquet', 'path': path},\n"
+        "        'cond': {'fn': '>=', 'args': [{'col': 'a'},\n"
+        "                                      {'lit': 42}]}}\n"
+        "norm, auto = normalize_spec(spec)\n"
+        "print(json.dumps({\n"
+        "    'affinity': affinity_key('acme', spec),\n"
+        "    'structural': PlanCache().structural_key(\n"
+        "        'acme', norm, auto,\n"
+        "        {'spark.rapids.tpu.sql.enabled': True})}))\n")
+    out = subprocess.run(
+        [sys.executable, "-c", prog, table_path],
+        capture_output=True, text=True, timeout=120,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONHASHSEED": "0"},
+        check=True)
+    theirs = json.loads(out.stdout.strip().splitlines()[-1])
+    spec = _lit_spec(table_path, 42)
+    norm, auto = normalize_spec(spec)
+    from spark_rapids_tpu.serve.plan_cache import (
+        PlanCache,
+        affinity_key,
+    )
+
+    assert theirs["affinity"] == affinity_key("acme", spec)
+    assert theirs["structural"] == PlanCache().structural_key(
+        "acme", norm, auto, {"spark.rapids.tpu.sql.enabled": True})
+
+
+def test_lit_normalization_feeds_affinity_types(table_path):
+    """__lit auto-params contribute their TYPE to the affinity key:
+    an int-literal shape and a float-literal shape route apart, just
+    as their plan-cache entries differ."""
+    from spark_rapids_tpu.serve.plan_cache import affinity_key
+
+    assert affinity_key("t", _lit_spec(table_path, 5)) != \
+        affinity_key("t", _lit_spec(table_path, 5.0))
+
+
 def test_concurrent_same_binding_does_not_share_physical(
         session, table_path):
     """While a binding is checked OUT, a second identical request
