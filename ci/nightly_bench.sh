@@ -37,6 +37,14 @@ entry = {
     # cross-host bytes a constant factor below intra-host bytes
     "multihost_dcn_vs_ici": (hosts.get("q5_2x4") or {}).get("dcn_vs_ici"),
     "multihost_dcn_reduction": hosts.get("dcn_reduction_factor"),
+    # out-of-core streaming (PR 19): streamed q5 GB/s at a forced
+    # window plus the pipeline overlap fraction — the trajectory
+    # tracks whether tables >> HBM keep running at link speed
+    "streaming_gbps": (d.get("streaming") or {}).get("streamed_gbps"),
+    "streaming_overlap":
+        (d.get("streaming") or {}).get("overlapFraction"),
+    "streaming_window_peak_bytes":
+        (d.get("streaming") or {}).get("windowPeakBytes"),
 }
 hist = "bench-history.jsonl"
 prev = None

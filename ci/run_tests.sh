@@ -30,6 +30,9 @@ ci/telemetry_check.sh
 echo "== encoded-execution gate (bytes moved + oracle equality) =="
 ci/encoded_check.sh
 
+echo "== streaming gate (out-of-core window + overlap + chaos) =="
+ci/streaming_check.sh
+
 echo "== device-failure gate (fence + warm recovery + epoch) =="
 ci/devicefail_check.sh
 

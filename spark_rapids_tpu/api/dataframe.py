@@ -863,7 +863,41 @@ class DataFrame:
             except MeshCompileError as e:
                 # operator without a mesh lowering: thread-pool path
                 fell_back("mesh", str(e))
-        if conf.get(rc.FUSED_EXEC):
+        skip_fused = False
+        if conf.get(rc.STREAM_ENABLED):
+            from spark_rapids_tpu.runtime.errors import DeviceLostError
+            from spark_rapids_tpu.stream import (
+                StreamCompileError,
+                StreamExecutor,
+                stream_selected,
+            )
+
+            if stream_selected(phys, conf):
+                # a scan's working set exceeds the window quota of free
+                # HBM: the resident engines would OOM or thrash, so the
+                # out-of-core rung runs FIRST for this plan
+                try:
+                    return ran("stream", StreamExecutor(conf)
+                               .execute(phys))
+                except StreamCompileError as e:
+                    # selected scan has no streamable prefix worth
+                    # running: structural, not a failure
+                    fell_back("stream", str(e))
+                except DeviceLostError:
+                    # mid-stream device loss: retired partitions are
+                    # lineage-cached; the outermost collect's one-shot
+                    # resubmit resumes the stream past them
+                    raise
+                except (TpuOOMError, faults.InjectedFault) as e:
+                    if not ladder_on:
+                        raise
+                    demoted("stream", "eager",
+                            f"{type(e).__name__}: {e}")
+                    # this plan was SELECTED because its working set
+                    # exceeds HBM — the fused rung would refuse it at
+                    # the same gate, so demote straight to eager
+                    skip_fused = True
+        if conf.get(rc.FUSED_EXEC) and not skip_fused:
             from spark_rapids_tpu.exec.fused import (
                 FusedCompileError,
                 FusedSingleChipExecutor,
@@ -976,6 +1010,12 @@ class DataFrame:
             )
 
             stamp_exchange_strategies(phys, self.session.rapids_conf)
+        if rec0 is not None and rec0.get("engine") == "stream":
+            # re-derive the streaming selection on this fresh plan so
+            # pretty() shows TpuFileScanExec [strategy=stream]
+            from spark_rapids_tpu.stream import stamp_stream_strategy
+
+            stamp_stream_strategy(phys, self.session.rapids_conf)
         print("== Physical Plan ==")
         print(phys.pretty())
         if extended:

@@ -1087,6 +1087,54 @@ QUOTA_DEVICE_BYTES_PER_QUERY = conf(
     "one runaway query degrades itself instead of pressuring the whole "
     "session. 0 disables per-query quotas.", int,
     checker=lambda v: v >= 0)
+STREAM_ENABLED = conf(
+    "spark.rapids.tpu.stream.enabled", True,
+    "Out-of-core streaming executor (stream/): when a parquet scan's "
+    "estimated working set exceeds stream.window.quotaFraction of "
+    "free HBM, the dispatch ladder runs the eligible operator chain "
+    "(scan -> filter/project/broadcast-join/partial-agg) through a "
+    "bounded device window instead of materializing the whole table: "
+    "prefetch threads decode row-group units into a host staging "
+    "queue, a double-buffered uploader fills window slots, compute "
+    "retires each slot to host partials, and the final merge runs on "
+    "the retired partials — tables larger than HBM run at link speed. "
+    "false removes the stream rung; oversized scans fall back to the "
+    "eager engine's per-partition path.", bool)
+STREAM_WINDOW_MAX_BYTES = conf(
+    "spark.rapids.tpu.stream.window.maxBytes", 0,
+    "Hard cap on the streaming device window (bytes of in-flight "
+    "window slots, charged to the SpillCatalog under the owning "
+    "query's quota). 0 derives the window purely from "
+    "stream.window.quotaFraction x free HBM; a nonzero value is "
+    "min'd with that derivation (CI uses a tiny cap to force many "
+    "windows over a small table).", int,
+    checker=lambda v: v >= 0)
+STREAM_PREFETCH_THREADS = conf(
+    "spark.rapids.tpu.stream.prefetch.threads", 4,
+    "Parquet prefetch threads feeding the streaming executor's host "
+    "staging queue. Each thread decodes one row-group unit at a time "
+    "under the io.retry/backoff policy; the staging queue is bounded "
+    "at 2x this count so decode never runs unboundedly ahead of "
+    "upload.", int,
+    checker=lambda v: 1 <= v <= 64)
+STREAM_WINDOW_QUOTA_FRACTION = conf(
+    "spark.rapids.tpu.stream.window.quotaFraction", 0.5,
+    "Fraction of free HBM (pool limit minus current reservations) the "
+    "streaming window may occupy, and the selection threshold: a scan "
+    "whose estimated device working set exceeds this fraction of free "
+    "HBM streams instead of materializing. The resulting budget is "
+    "additionally min'd with stream.window.maxBytes and the per-query "
+    "device quota, then scaled by the admission priority class "
+    "(negative-priority 'batch' tenants get half a window) so a "
+    "10x-HBM batch stream cannot starve interactive tenants.", float,
+    checker=lambda v: 0.0 < v <= 1.0)
+STREAM_MESH_ENABLED = conf(
+    "spark.rapids.tpu.stream.mesh.enabled", False,
+    "Stretch (dry-run): plan window slots round-robin across the "
+    "mesh's chips so the aggregate fleet HBM is the window and ingest "
+    "parallelizes across per-chip links. Currently emits the "
+    "placement plan as stream.window events without routing data; "
+    "execution stays single-chip.", bool)
 
 
 def conf_entries() -> List[ConfEntry]:

@@ -217,6 +217,13 @@ class TpuFileScanExec(PhysicalPlan):
     def num_partitions(self):
         return max(1, len(self._tasks))
 
+    def _node_string(self) -> str:
+        # stamped by stream.stamp_stream_strategy for explain() after
+        # a streaming run (the mesh [strategy=ici] discipline)
+        st = getattr(self, "stream_strategy", None)
+        s = type(self).__name__
+        return f"{s} [strategy={st}]" if st else s
+
     def _prune_partition_files(self, files: List[str]) -> List[str]:
         """Drop files whose partition values contradict pushed filters
         (static partition pruning; dynamic pruning calls

@@ -27,7 +27,7 @@ import glob as globlib
 import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from typing import Iterator, List, Optional
+from typing import Iterator, List, NamedTuple, Optional, Tuple
 
 import pyarrow as pa
 import pyarrow.csv as pa_csv
@@ -119,22 +119,112 @@ def split_parquet_tasks(paths: List[str], coalesce_target_bytes: int
     return tasks or [[]]
 
 
-def read_parquet_task(files: List[str], columns: Optional[List[str]],
-                      batch_rows: int,
-                      read_dictionary: Optional[List[str]] = None
-                      ) -> Iterator[pa.Table]:
-    """Decode one task's files, yielding row-capped tables (the chunked
-    reader analog, GpuParquetScan.scala:2674). `read_dictionary` names
-    columns to surface as pyarrow DictionaryArrays — parquet dictionary
-    pages then flow to the device still encoded
-    (spark.rapids.tpu.encoded.readDictionary.enabled)."""
+class ScanUnit(NamedTuple):
+    """The partition unit shared by every parquet read strategy: a
+    contiguous run of row groups inside one file. PERFILE/COALESCING
+    read whole-file units (row_groups=None); the streaming prefetcher
+    splits files into sub-file units so its device window admits work
+    smaller than a file. `est_bytes` is the parquet-metadata
+    (uncompressed) total_byte_size of the covered row groups — the
+    planning estimate for window packing, not the decoded arrow size."""
+
+    path: str
+    row_groups: Optional[Tuple[int, ...]]  # None = whole file
+    est_bytes: int
+
+
+def split_scan_units(files: List[str], unit_bytes: int = 0,
+                     filters=None,
+                     read_dictionary: Optional[List[str]] = None
+                     ) -> List[ScanUnit]:
+    """Split files into row-group-granular ScanUnits. With
+    `unit_bytes=0` each file is one whole-file unit and no metadata is
+    opened (exactly the legacy per-file behavior); with a positive
+    target, row groups (optionally stats-pruned by pushed `filters`)
+    are packed into units up to `unit_bytes` each, so a 10x-window
+    file becomes many window-sized admissions."""
+    units: List[ScanUnit] = []
     for f in files:
+        if unit_bytes <= 0 and not filters:
+            try:
+                sz = os.path.getsize(f)
+            except OSError:
+                sz = 0
+            units.append(ScanUnit(f, None, sz))
+            continue
         pf = _open_retry(
             lambda f=f: pq.ParquetFile(f,
                                        read_dictionary=read_dictionary),
             f"parquet open {f}")
-        for rb in pf.iter_batches(batch_size=batch_rows, columns=columns):
-            yield pa.Table.from_batches([rb])
+        meta = pf.metadata
+        keep = [i for i in range(pf.num_row_groups)
+                if not filters
+                or _row_group_may_match(meta.row_group(i), filters,
+                                        pf.schema_arrow)]
+        if not keep:
+            continue
+        if unit_bytes <= 0:
+            units.append(ScanUnit(
+                f, tuple(keep),
+                sum(meta.row_group(i).total_byte_size for i in keep)))
+            continue
+        cur: List[int] = []
+        cur_bytes = 0
+        for i in keep:
+            sz = meta.row_group(i).total_byte_size
+            if cur and cur_bytes + sz > unit_bytes:
+                units.append(ScanUnit(f, tuple(cur), cur_bytes))
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            cur_bytes += sz
+        if cur:
+            units.append(ScanUnit(f, tuple(cur), cur_bytes))
+    return units
+
+
+def read_scan_unit(unit: ScanUnit, columns: Optional[List[str]],
+                   batch_rows: int,
+                   read_dictionary: Optional[List[str]] = None
+                   ) -> Iterator[pa.Table]:
+    """Decode one ScanUnit, yielding row-capped tables (the chunked
+    reader analog, GpuParquetScan.scala:2674). `read_dictionary` names
+    columns to surface as pyarrow DictionaryArrays — parquet dictionary
+    pages then flow to the device still encoded
+    (spark.rapids.tpu.encoded.readDictionary.enabled)."""
+    pf = _open_retry(
+        lambda: pq.ParquetFile(unit.path,
+                               read_dictionary=read_dictionary),
+        f"parquet open {unit.path}")
+    kwargs = {}
+    if unit.row_groups is not None:
+        kwargs["row_groups"] = list(unit.row_groups)
+    for rb in pf.iter_batches(batch_size=batch_rows, columns=columns,
+                              **kwargs):
+        yield pa.Table.from_batches([rb])
+
+
+def iter_scan_batches(files: List[str], columns: Optional[List[str]],
+                      batch_rows: int, unit_bytes: int = 0,
+                      filters=None,
+                      read_dictionary: Optional[List[str]] = None
+                      ) -> Iterator[pa.Table]:
+    """Row-group-granular bounded-batch scan: the one iterator all
+    three read strategies (and the streaming prefetcher) compose —
+    split into units, decode each under the io.read backoff policy."""
+    for unit in split_scan_units(files, unit_bytes, filters,
+                                 read_dictionary=read_dictionary):
+        yield from read_scan_unit(unit, columns, batch_rows,
+                                  read_dictionary=read_dictionary)
+
+
+def read_parquet_task(files: List[str], columns: Optional[List[str]],
+                      batch_rows: int,
+                      read_dictionary: Optional[List[str]] = None
+                      ) -> Iterator[pa.Table]:
+    """Decode one task's files as whole-file ScanUnits (PERFILE /
+    COALESCING strategies)."""
+    yield from iter_scan_batches(files, columns, batch_rows,
+                                 read_dictionary=read_dictionary)
 
 
 _PREFETCH_DONE = object()
@@ -312,23 +402,9 @@ def read_parquet_task_filtered(files: List[str],
     tuples (reference predicate pushdown, GpuParquetScan.scala:556).
     Surviving row groups stream through the chunked reader — the whole
     file is never materialized."""
-    if not filters:
-        yield from read_parquet_task(files, columns, batch_rows,
-                                     read_dictionary=read_dictionary)
-        return
-    for f in files:
-        pf = _open_retry(
-            lambda f=f: pq.ParquetFile(f,
-                                       read_dictionary=read_dictionary),
-            f"parquet open {f}")
-        keep = [i for i in range(pf.num_row_groups)
-                if _row_group_may_match(pf.metadata.row_group(i), filters,
-                                        pf.schema_arrow)]
-        if not keep:
-            continue
-        for rb in pf.iter_batches(batch_size=batch_rows, row_groups=keep,
-                                  columns=columns):
-            yield pa.Table.from_batches([rb])
+    yield from iter_scan_batches(files, columns, batch_rows,
+                                 filters=filters,
+                                 read_dictionary=read_dictionary)
 
 
 # ------------------------- hive-style partition directories (col=val/)

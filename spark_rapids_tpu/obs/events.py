@@ -94,6 +94,12 @@ EVENT_TYPES: Dict[str, str] = {
     "fleet.failover":
         "requestId, tenant, fromReplica, toReplica, reason",
     "fleet.drain": "phase, replicas",
+    "stream.start": "partitions, windowBytes, prefetchThreads",
+    "stream.partition": "unit, rows, bytes, retired",
+    "stream.window": "action (admit|evict|spill|recover|mesh), bytes, "
+                     "inUse",
+    "stream.end": "partitions, retired, recoveries, windowPeakBytes, "
+                  "overlapFraction",
 }
 
 #: Envelope keys present on EVERY event (eventlog validation contract).

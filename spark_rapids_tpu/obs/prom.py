@@ -123,7 +123,10 @@ def _telemetry_lines() -> list:
     if summaries:
         families: dict = {f"{PREFIX}_query_bytes_moved": [],
                           f"{PREFIX}_query_hbm_peak_bytes": [],
-                          f"{PREFIX}_query_roofline_frac": []}
+                          f"{PREFIX}_query_roofline_frac": [],
+                          f"{PREFIX}_query_stream_window_peak_bytes": [],
+                          f"{PREFIX}_query_stream_partitions": [],
+                          f"{PREFIX}_query_stream_overlap_frac": []}
         for qid, s in summaries.items():
             for d, b in s.get("bytesMoved", {}).items():
                 families[f"{PREFIX}_query_bytes_moved"].append(
@@ -133,6 +136,17 @@ def _telemetry_lines() -> list:
             if s.get("rooflineFrac") is not None:
                 families[f"{PREFIX}_query_roofline_frac"].append(
                     ({"queryId": qid}, s["rooflineFrac"]))
+            # streaming-executor families (stream/executor.py): only
+            # queries that ran the out-of-core rung carry them
+            if s.get("partitionsStreamed"):
+                families[
+                    f"{PREFIX}_query_stream_window_peak_bytes"].append(
+                    ({"queryId": qid}, s.get("windowPeakBytes", 0)))
+                families[f"{PREFIX}_query_stream_partitions"].append(
+                    ({"queryId": qid}, s["partitionsStreamed"]))
+            if s.get("overlapFraction") is not None:
+                families[f"{PREFIX}_query_stream_overlap_frac"].append(
+                    ({"queryId": qid}, s["overlapFraction"]))
         for mname, samples in families.items():
             if not samples:
                 continue

@@ -79,6 +79,46 @@ DEVICE_PEAK_BW = {
 _PROBE_BYTES = 8 << 20          # link probe transfer size
 _QUERY_KEEP = 64                # per-query ledgers retained
 _TIMELINE_KEEP = 4096           # (ts, reservedBytes) samples retained
+_INTERVAL_KEEP = 4096           # per-query busy intervals per kind
+
+
+def _busy_union(spans) -> List[tuple]:
+    """Merge (t0, t1) spans into a sorted disjoint union."""
+    out: List[tuple] = []
+    for t0, t1 in sorted(spans):
+        if t1 <= t0:
+            continue
+        if out and t0 <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], t1))
+        else:
+            out.append((t0, t1))
+    return out
+
+
+def _overlap_fraction(a_spans, b_spans) -> Optional[float]:
+    """|union(a) ∩ union(b)| over the shorter busy total — the
+    pipelining figure of merit: 1.0 means the cheaper stage ran
+    entirely under the cover of the other; 0.0 means fully
+    serialized. None when either timeline is empty."""
+    a, b = _busy_union(a_spans), _busy_union(b_spans)
+    if not a or not b:
+        return None
+    inter = 0.0
+    i = j = 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            inter += hi - lo
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    shorter = min(sum(t1 - t0 for t0, t1 in a),
+                  sum(t1 - t0 for t0, t1 in b))
+    if shorter <= 0:
+        return None
+    return max(0.0, min(1.0, inter / shorter))
 
 
 def _cell() -> Dict[str, int]:
@@ -90,7 +130,7 @@ class _QueryLedger:
 
     __slots__ = ("by_direction", "by_site", "hbm_peak", "hbm_current",
                  "spill_pressure", "final", "enc_actual", "enc_plain",
-                 "ici_host_avoided", "labels")
+                 "ici_host_avoided", "labels", "stream", "intervals")
 
     def __init__(self):
         self.by_direction: Dict[str, Dict[str, int]] = {}
@@ -110,6 +150,12 @@ class _QueryLedger:
         # (the d2h + h2d round trip of the decoded payload the host
         # shuffle path would have moved for the same rows)
         self.ici_host_avoided = 0
+        # streaming executor stats (stream/): windowPeakBytes is a max,
+        # partitionsStreamed/recoveries are sums
+        self.stream: Dict[str, int] = {}
+        # busy-interval timeline per kind ("h2d" | "compute"): bounded
+        # (t0, t1) monotonic spans feeding overlapFraction
+        self.intervals: Dict[str, List[tuple]] = {}
 
 
 class TransferLedger:
@@ -218,6 +264,45 @@ class TransferLedger:
             else _events.effective_query_id()
         self.record("dcn", site, nbytes, query_id=qid)
 
+    def record_interval(self, kind: str, t0: float, t1: float,
+                        query_id: Optional[int] = None) -> None:
+        """Account one busy interval of a pipelined stage ("h2d" |
+        "compute", monotonic seconds) on the owning query's timeline —
+        the substrate for overlapFraction (streaming executor's proof
+        that transfer and compute actually overlapped)."""
+        if not self.enabled or t1 <= t0:
+            return
+        qid = query_id if query_id is not None \
+            else _events.effective_query_id()
+        if not qid:
+            return
+        with self._lock:
+            spans = self._query(qid).intervals.setdefault(kind, [])
+            spans.append((float(t0), float(t1)))
+            if len(spans) > _INTERVAL_KEEP:
+                del spans[:len(spans) - _INTERVAL_KEEP]
+
+    def record_stream(self, query_id: Optional[int] = None,
+                      **fields) -> None:
+        """Fold streaming-executor stats into the owning query's
+        ledger: *Peak*/*Bytes-max keys (windowPeakBytes) keep the max,
+        counters (partitionsStreamed, recoveries) accumulate."""
+        if not self.enabled:
+            return
+        qid = query_id if query_id is not None \
+            else _events.effective_query_id()
+        if not qid:
+            return
+        with self._lock:
+            st = self._query(qid).stream
+            for k, v in fields.items():
+                if v is None:
+                    continue
+                if k.endswith("PeakBytes") or k.endswith("Budget"):
+                    st[k] = max(st.get(k, 0), int(v))
+                else:
+                    st[k] = st.get(k, 0) + int(v)
+
     def record_forwarded(self, fields: dict,
                          query_id: Optional[int] = None) -> None:
         """Fold a worker-forwarded `transfer` event (process pool) into
@@ -297,6 +382,9 @@ class TransferLedger:
             ici_avoided = 0 if q is None else q.ici_host_avoided
             labels = None if q is None or not q.labels \
                 else dict(q.labels)
+            stream = {} if q is None else dict(q.stream)
+            intervals = {} if q is None else {
+                k: list(v) for k, v in q.intervals.items()}
         total = sum(c["bytes"] for c in by_dir.values())
         link = sum(by_dir.get(d, _cell())["bytes"]
                    for d in ("h2d", "d2h"))
@@ -323,6 +411,22 @@ class TransferLedger:
             # tier (hierarchical finals / broadcast builds) — compare
             # against iciBytes to see the planner's placement win
             out["dcnBytes"] = dcn
+        if stream:
+            # streaming executor (stream/): window high-water, how many
+            # partition units streamed through it, and the measured
+            # H2D/compute busy-interval overlap — the out-of-core
+            # pipelining proof (overlapFraction > 0 means transfer hid
+            # under compute or vice versa; None when a stage timeline
+            # is empty)
+            out["windowPeakBytes"] = stream.get("windowPeakBytes", 0)
+            out["partitionsStreamed"] = stream.get(
+                "partitionsStreamed", 0)
+            if stream.get("recoveries"):
+                out["streamRecoveries"] = stream["recoveries"]
+            frac = _overlap_fraction(intervals.get("h2d", ()),
+                                     intervals.get("compute", ()))
+            if frac is not None:
+                out["overlapFraction"] = round(frac, 4)
         if enc_plain > 0 and enc_actual > 0:
             # encoded execution's measured win: bytes the dictionary
             # representation kept OFF the staging/transfer paths, and
@@ -444,6 +548,8 @@ record_encoded = ledger.record_encoded
 record_ici = ledger.record_ici
 record_dcn = ledger.record_dcn
 record_forwarded = ledger.record_forwarded
+record_interval = ledger.record_interval
+record_stream = ledger.record_stream
 hbm_global = ledger.hbm_global
 hbm_query = ledger.hbm_query
 hbm_pressure = ledger.hbm_pressure

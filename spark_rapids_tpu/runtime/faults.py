@@ -70,6 +70,17 @@ locations where the real world fails —
                         chip of that host in one step (fence_host),
                         rebuilds the mesh over the surviving hosts,
                         and recovers the lost shards from lineage
+    stream.prefetch     staging-queue read in the streaming executor
+                        (stream/executor.py) — a prefetched unit is
+                        lost between decode and upload; the executor
+                        re-enqueues that ScanUnit (bounded retries)
+                        and the stream continues, proving partition-
+                        granular retry without restarting the query
+    stream.window_evict window-slot consume in the streaming executor
+                        — the slot is forcibly spilled to host before
+                        compute touches it, exercising the SpillCatalog
+                        round trip (unspill-on-use) under window
+                        pressure
 
 and every site's CONSUMER survives the injected fault: backoff retries
 (runtime/backoff.py), quarantine-and-recompile, or engine demotion.
@@ -117,6 +128,8 @@ KNOWN_SITES = (
     "chip.fatal",
     "dcn.collective",
     "host.fatal",
+    "stream.prefetch",
+    "stream.window_evict",
 )
 
 

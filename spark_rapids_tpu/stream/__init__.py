@@ -1,0 +1,32 @@
+"""Out-of-core streaming executor (spark.rapids.tpu.stream.*).
+
+Partition-granular pipeline for scans whose working set exceeds the
+device window quota: prefetch -> double-buffered H2D upload into a
+bounded device window -> streamable operator chain, with retirement
+lineage for mid-stream device-loss resume. See stream/executor.py.
+"""
+
+from spark_rapids_tpu.stream.executor import (
+    StreamedSourceExec,
+    StreamExecutor,
+)
+from spark_rapids_tpu.stream.planner import (
+    StreamCompileError,
+    StreamPlan,
+    plan_stream,
+    stamp_stream_strategy,
+    stream_selected,
+)
+from spark_rapids_tpu.stream.window import DeviceWindow, window_budget
+
+__all__ = [
+    "DeviceWindow",
+    "StreamCompileError",
+    "StreamedSourceExec",
+    "StreamExecutor",
+    "StreamPlan",
+    "plan_stream",
+    "stamp_stream_strategy",
+    "stream_selected",
+    "window_budget",
+]

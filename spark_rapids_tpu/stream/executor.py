@@ -1,0 +1,513 @@
+"""Out-of-core streaming executor: bounded device windows with
+double-buffered H2D prefetch.
+
+Three pipelined stages with NO global barrier, so a table many times
+larger than HBM runs at link speed:
+
+1. PREFETCH (stream/prefetch.py): reader threads decode row-group
+   ScanUnits into a bounded host staging queue (io.read backoff,
+   stream.prefetch chaos re-enqueues the unit).
+2. UPLOAD (one thread here): double-buffered async H2D — each staged
+   table admits into the DeviceWindow (stream/window.py byte budget),
+   uploads via the fused engine's `upload_narrowed` (ints narrowed to
+   their value range, low-cardinality strings streamed as dictionary
+   CODES), registers with the SpillCatalog, and hands the slot to
+   compute through a depth-2 queue: one slot uploading while one
+   computes.
+3. COMPUTE (caller's thread): runs the streamable operator chain
+   (filter/project/partial-or-complete agg/broadcast-join probe) over
+   each window slot, retires the result to host, releases the slot.
+
+Recovery: `device.fatal` mid-stream fences the device
+(runtime/device_monitor.py) and cancels this query — the executor
+unwinds CLEANLY (threads stopped, slots closed, permit released) and
+re-raises DeviceLostError so the outermost collect's one-shot
+resubmit (api/dataframe.py collect_arrow) re-runs the query after
+warm recovery. Retired partitions are NOT lost: a plan-fingerprint
+lineage cache keeps each retired host table (host memory survives
+device loss), and the resubmitted run skips straight past them —
+resume from the last retired partition, not from byte zero.
+
+Telemetry: h2d and compute busy intervals feed the per-query
+`overlapFraction` (obs/telemetry.py), with `windowPeakBytes` /
+`partitionsStreamed` / `streamRecoveries` on the query summary and
+`stream.{start,partition,window,end}` on the event bus.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import pyarrow as pa
+
+from spark_rapids_tpu.exec.base import PhysicalPlan, new_task_context
+from spark_rapids_tpu.io import readers
+from spark_rapids_tpu.stream import prefetch as _prefetch
+from spark_rapids_tpu.stream.planner import StreamPlan, plan_stream
+from spark_rapids_tpu.stream.window import DeviceWindow, window_budget
+
+# ------------------------------------------------- mid-stream lineage
+#
+# fingerprint -> {"units": [ScanUnit...], "retired": {unit_key: table}}
+# An entry is POPPED at execution start and re-stored ONLY when the
+# run unwinds on DeviceLostError — the resubmitted run (same logical
+# plan, same fingerprint) resumes from the retired set, and any other
+# outcome (success, demotion, cancel) drops the entry so a later
+# identical query always streams fresh data. Bounded: an orphaned
+# entry (loss with resubmit disabled) ages out.
+
+_LINEAGE_KEEP = 4
+_lineage_lock = threading.Lock()
+_lineage: "OrderedDict[tuple, dict]" = OrderedDict()
+
+
+def _lineage_pop(key):
+    with _lineage_lock:
+        return _lineage.pop(key, None)
+
+
+def _lineage_store(key, entry) -> None:
+    with _lineage_lock:
+        _lineage[key] = entry
+        _lineage.move_to_end(key)
+        while len(_lineage) > _LINEAGE_KEEP:
+            _lineage.popitem(last=False)
+
+
+def _unit_key(unit: readers.ScanUnit) -> tuple:
+    return (unit.path, unit.row_groups)
+
+
+def _arrow_schema(schema) -> pa.Schema:
+    from spark_rapids_tpu.sqltypes.datatypes import to_arrow_type
+
+    return pa.schema([
+        pa.field(f.name, to_arrow_type(f.dataType), f.nullable)
+        for f in schema.fields])
+
+
+def _empty_table(schema) -> pa.Table:
+    return _arrow_schema(schema).empty_table()
+
+
+class StreamedSourceExec(PhysicalPlan):
+    """Source node substituting retired host partitions for the
+    streamed chain top: once the out-of-core prefix has retired, the
+    ordinary engines run the plan REMAINDER (shuffles, final aggs,
+    sorts) over these partitions like any other scan output."""
+
+    is_tpu = True
+
+    def __init__(self, tables: List[pa.Table], schema, conf):
+        super().__init__([], schema, conf)
+        self._tables = tables
+
+    @property
+    def num_partitions(self) -> int:
+        return max(1, len(self._tables))
+
+    def execute_partition(self, pid, ctx):
+        from spark_rapids_tpu.columnar.arrow_bridge import arrow_to_device
+        from spark_rapids_tpu.exec.operators import _acquire
+
+        if pid >= len(self._tables):
+            return
+        t = self._tables[pid]
+        if t.num_rows == 0:
+            return
+        _acquire(ctx)
+        yield arrow_to_device(t)
+
+
+class StreamExecutor:
+    """Drive one query through the streaming pipeline."""
+
+    def __init__(self, conf):
+        self.conf = conf
+
+    # ------------------------------------------------------ planning
+
+    def execute(self, phys) -> pa.Table:
+        from spark_rapids_tpu.config import rapids_conf as rc
+        from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.runtime import admission, degrade
+        from spark_rapids_tpu.runtime.errors import DeviceLostError
+
+        sp = plan_stream(phys, self.conf)  # StreamCompileError rides up
+        scan = sp.scan
+        handle = admission.current_handle()
+        priority = handle.priority if handle is not None else 0
+        budget = window_budget(self.conf, priority)
+        cols = scan.pushed_columns
+        read_dict = scan._dict_columns(cols)
+        fkey = ("stream",) + degrade.plan_fingerprint(phys)
+
+        lineage = _lineage_pop(fkey)
+        if lineage is None:
+            # unit size ~ a quarter window: 2 staged + 1 uploading +
+            # 1 computing keeps the window full without one unit
+            # monopolizing it. The packing target is in parquet
+            # METADATA bytes (page-encoded), which undercount the
+            # decoded+padded arrow size by ~DECODE_EXPANSION.
+            from spark_rapids_tpu.stream.planner import DECODE_EXPANSION
+
+            units = readers.split_scan_units(
+                [f for task in scan._tasks for f in task],
+                unit_bytes=max(64 << 10,
+                               budget // (4 * DECODE_EXPANSION)),
+                filters=scan.pushed_filters,
+                read_dictionary=read_dict)
+            retired: Dict[tuple, pa.Table] = {}
+        else:
+            # resume: the SAME unit boundaries (a fresh split under
+            # post-recovery free-HBM could shift them, orphaning the
+            # retired set) and the retired host tables survive
+            units = lineage["units"]
+            retired = lineage["retired"]
+
+        todo = [u for u in units if _unit_key(u) not in retired]
+        resumed = len(units) - len(todo)
+        obs_events.emit("stream.start", partitions=len(units),
+                        windowBytes=budget,
+                        prefetchThreads=self.conf.get(
+                            rc.STREAM_PREFETCH_THREADS))
+        if resumed:
+            obs_events.emit("stream.window", action="recover",
+                            bytes=0, inUse=resumed)
+        if self.conf.get(rc.STREAM_MESH_ENABLED):
+            from spark_rapids_tpu.stream.mesh import plan_mesh_slots
+
+            plan_mesh_slots(units)
+
+        try:
+            ordered = self._stream(sp, units, todo, retired, budget,
+                                   cols, read_dict, resumed)
+        except DeviceLostError:
+            # host-resident retirements survive the loss; the one-shot
+            # resubmit (collect_arrow) resumes from them
+            _lineage_store(fkey, {"units": units, "retired": retired})
+            raise
+        # remainder (shuffles, final aggs, ...) runs AFTER the stream's
+        # device permit released — base.collect drives its own tasks
+        if sp.parent is None:
+            good = [t for t in ordered if t.num_rows > 0]
+            if not good:
+                return _empty_table(sp.chain_top.schema)
+            return pa.concat_tables(good, promote_options="none")
+        return self._run_remainder(sp, ordered, phys)
+
+    # ------------------------------------------------------ pipeline
+
+    def _stream(self, sp: StreamPlan, units, todo, retired, budget,
+                cols, read_dict, resumed) -> List[pa.Table]:
+        from spark_rapids_tpu.config import rapids_conf as rc
+        from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.obs import telemetry
+        from spark_rapids_tpu.runtime import cancellation
+        from spark_rapids_tpu.runtime import semaphore as sem
+        from spark_rapids_tpu.runtime.memory import get_catalog
+
+        conf = self.conf
+        qid = obs_events.current_query_id()
+        token = cancellation.current()
+        catalog = get_catalog()
+        window = DeviceWindow(budget)
+        ctx = new_task_context(conf)
+        chain_top = sp.chain_top
+
+        prefetcher = _prefetch.Prefetcher(
+            todo, cols, scan_batch_rows(sp.scan),
+            num_threads=conf.get(rc.STREAM_PREFETCH_THREADS),
+            read_dictionary=read_dict, cancel_token=token)
+        # depth 2 = the DOUBLE buffer: one slot computing, one uploaded
+        # and on deck, prefetch decode running ahead of both
+        compute_q: "queue.Queue" = queue.Queue(maxsize=2)
+        upload_done = object()
+        h2d_spans: List[tuple] = []
+        compute_spans: List[tuple] = []
+
+        def cq_put(item) -> bool:
+            # never wedge on a consumer that already unwound: the
+            # depth-2 queue is only drained while the main loop lives
+            while not prefetcher.abandoned.is_set():
+                try:
+                    compute_q.put(item, timeout=0.2)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def uploader():
+            from spark_rapids_tpu.exec.fused import upload_narrowed
+
+            with cancellation.scope(token), obs_events.task_scope(
+                    stage=0, task=ctx.task_id, attempt=0, query_id=qid):
+                try:
+                    while not prefetcher.abandoned.is_set():
+                        try:
+                            item = prefetcher.staging.get(timeout=0.2)
+                        except queue.Empty:
+                            continue
+                        if item is _prefetch.PREFETCH_DONE:
+                            cq_put(upload_done)
+                            return
+                        if isinstance(item, BaseException):
+                            cq_put(item)
+                            return
+                        idx, unit, table = item
+                        if table.num_rows == 0:
+                            cq_put((idx, unit, None, 0))
+                            continue
+                        admitted = window.admit(table.nbytes)
+                        obs_events.emit("stream.window", action="admit",
+                                        bytes=admitted,
+                                        inUse=window.in_use)
+                        t0 = time.monotonic()
+                        cb = upload_narrowed(table)
+                        t1 = time.monotonic()
+                        h2d_spans.append((t0, t1))
+                        telemetry.record_interval("h2d", t0, t1,
+                                                  query_id=qid)
+                        sb = catalog.add_batch(cb)
+                        if not cq_put((idx, unit, sb, admitted)):
+                            sb.close()
+                            window.release(admitted)
+                            return
+                except BaseException as e:  # noqa: BLE001 - surfaced
+                    cq_put(e)
+
+        up_thread = threading.Thread(target=uploader, daemon=True,
+                                     name="stream-upload")
+        pending_close: List = []
+        streamed = 0
+        sem.get().acquire_if_necessary(ctx.task_id)
+        try:
+            prefetcher.start()
+            up_thread.start()
+            build_args = self._prepare_builds(sp, ctx)
+            while True:
+                cancellation.check_current()
+                item = compute_q.get()
+                if item is upload_done:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                idx, unit, sb, admitted = item
+                if sb is None:
+                    retired[_unit_key(unit)] = _empty_table(
+                        chain_top.schema)
+                    continue
+                pending_close.append((sb, admitted))
+                out_table = self._consume_slot(sp, sb, build_args,
+                                               compute_spans, qid)
+                pending_close.pop()
+                sb.close()
+                window.release(admitted)
+                retired[_unit_key(unit)] = out_table
+                streamed += 1
+                telemetry.record_stream(
+                    query_id=qid, partitionsStreamed=1)
+                obs_events.emit("stream.partition",
+                                unit=f"{unit.path}:{unit.row_groups}",
+                                rows=out_table.num_rows,
+                                bytes=out_table.nbytes,
+                                retired=len(retired))
+            ordered = [retired[_unit_key(u)] for u in units]
+            result = self._finish(sp, ordered)
+        finally:
+            prefetcher.abandon()
+            window.abort()
+            up_thread.join(timeout=5.0)
+            prefetcher.join()
+            self._drain(compute_q, pending_close)
+            sem.get().release_if_necessary(ctx.task_id)
+        frac = _overlap(h2d_spans, compute_spans)
+        telemetry.record_stream(query_id=qid,
+                                windowPeakBytes=window.peak,
+                                recoveries=1 if resumed else 0)
+        obs_events.emit("stream.end", partitions=len(units),
+                        retired=len(retired),
+                        recoveries=1 if resumed else 0,
+                        windowPeakBytes=window.peak,
+                        overlapFraction=frac)
+        return result
+
+    @staticmethod
+    def _drain(compute_q, pending_close) -> None:
+        """Unwind path: close every slot still registered with the
+        catalog (queued for compute, or mid-compute when the chain
+        raised) so the spill ledger ends leak-free."""
+        for sb, _ in pending_close:
+            try:
+                sb.close()
+            except Exception:
+                pass
+        pending_close.clear()
+        while True:
+            try:
+                item = compute_q.get_nowait()
+            except queue.Empty:
+                return
+            if isinstance(item, tuple) and len(item) == 4 and \
+                    item[2] is not None:
+                try:
+                    item[2].close()
+                except Exception:
+                    pass
+
+    # ------------------------------------------------- chain compute
+
+    def _prepare_builds(self, sp: StreamPlan, ctx) -> dict:
+        """Materialize every broadcast build side in the chain ONCE
+        (window-fitting by planner construction: build sides are
+        broadcast children, small by the same planner rule that chose
+        a broadcast join)."""
+        from spark_rapids_tpu.exec.joins import TpuBroadcastHashJoinExec
+
+        builds = {}
+        for node in sp.chain:
+            if isinstance(node, TpuBroadcastHashJoinExec):
+                builds[id(node)] = node._broadcast_build_table(ctx)
+        return builds
+
+    def _consume_slot(self, sp: StreamPlan, sb, build_args,
+                      compute_spans, qid) -> pa.Table:
+        """Run the operator chain over one window slot and retire the
+        result to host. stream.window_evict chaos spills the slot
+        before compute touches it, proving the unspill-on-use round
+        trip; device.fatal at the stream.dispatch guard classifies a
+        dead backend and fences (DeviceLostError rides up)."""
+        from spark_rapids_tpu.columnar.arrow_bridge import device_to_arrow
+        from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.obs import telemetry
+        from spark_rapids_tpu.runtime import device_monitor, faults
+        from spark_rapids_tpu.runtime.memory import get_catalog
+
+        catalog = get_catalog()
+        if faults.should_inject("stream.window_evict"):
+            from spark_rapids_tpu.runtime.memory import SpillTier
+
+            # the catalog's own spill path, not a raw _to_host(): the
+            # device reservation and host-pageable ledger must move
+            # with the bytes or the eviction leaks pool.reserved
+            with catalog._lock:
+                if sb._tier == SpillTier.DEVICE:
+                    catalog._spill_one(sb)
+            obs_events.emit("stream.window", action="spill",
+                            bytes=sb.size_bytes, inUse=None)
+        t0 = time.monotonic()
+        with device_monitor.guard("stream.dispatch", inject=True):
+            batch = sb.get_batch()  # unspills an evicted slot
+            out = self._run_chain(sp, batch, build_args)
+            out_table = (device_to_arrow(out) if out is not None
+                         else _empty_table(sp.chain_top.schema))
+        t1 = time.monotonic()
+        compute_spans.append((t0, t1))
+        telemetry.record_interval("compute", t0, t1, query_id=qid)
+        return out_table
+
+    def _run_chain(self, sp: StreamPlan, batch, build_args):
+        """One unit through the streamable chain. Returns the chain
+        top's device batch, or None when the unit vanishes (filtered
+        out / no probe matches)."""
+        from spark_rapids_tpu.exec.joins import TpuBroadcastHashJoinExec
+        from spark_rapids_tpu.exec.operators import (
+            TpuCoalesceBatchesExec,
+            TpuFilterExec,
+            TpuHashAggregateExec,
+            TpuProjectExec,
+        )
+        from spark_rapids_tpu.expr.ansicheck import raise_if_set
+
+        out = batch
+        for node in sp.chain:
+            if out is None:
+                return None
+            if isinstance(node, TpuCoalesceBatchesExec):
+                continue  # identity: units are already window-sized
+            if isinstance(node, TpuFilterExec):
+                if node._ansi_jit is not None:
+                    raise_if_set(node._ansi_jit(out))
+                out = node._run_jit(out)
+            elif isinstance(node, TpuProjectExec):
+                if node._ansi_jit is not None:
+                    raise_if_set(node._ansi_jit(out))
+                out = node._jitted(out)
+            elif isinstance(node, TpuBroadcastHashJoinExec):
+                build, bt = build_args[id(node)]
+                out = node._join_batches([out], build, prepared_bt=bt)
+            elif isinstance(node, TpuHashAggregateExec):
+                if node._ansi_jit is not None:
+                    raise_if_set(node._ansi_jit(out))
+                out = node._jit_partial(out)
+            else:  # planner admitted it; this executor must know it
+                from spark_rapids_tpu.stream.planner import (
+                    StreamCompileError,
+                )
+
+                raise StreamCompileError(
+                    f"no streaming lowering for {type(node).__name__}")
+        return out
+
+    # ------------------------------------------------------- finish
+
+    def _finish(self, sp: StreamPlan,
+                ordered: List[pa.Table]) -> List[pa.Table]:
+        """Device-side finish while the stream's permit is still held:
+        a complete-mode agg chain top collapses every retired partial
+        into one final table. Returns the partition tables that stand
+        in for the chain top."""
+        from spark_rapids_tpu.exec.operators import TpuHashAggregateExec
+
+        top = sp.chain_top
+        if isinstance(top, TpuHashAggregateExec) and \
+                top.mode == "complete":
+            return [self._merge_complete(top, ordered)]
+        return ordered
+
+    def _merge_complete(self, node, ordered: List[pa.Table]) -> pa.Table:
+        """complete-mode agg: every unit retired PARTIAL buffers; one
+        merge+finalize over their concatenation yields the final rows
+        (operators.py _merge_final — associative by construction)."""
+        from spark_rapids_tpu.columnar.arrow_bridge import (
+            arrow_to_device,
+            device_to_arrow,
+        )
+
+        good = [t for t in ordered if t.num_rows > 0]
+        if not good:
+            if not node.grouping:
+                return device_to_arrow(node._empty_global_result())
+            return _empty_table(node.schema)
+        merged = pa.concat_tables(good, promote_options="none")
+        return device_to_arrow(node._jit_merge(arrow_to_device(merged)))
+
+    def _run_remainder(self, sp: StreamPlan, ordered: List[pa.Table],
+                       phys) -> pa.Table:
+        """Substitute retired partitions for the chain top and run the
+        surrounding plan on the ordinary eager engine. The parent's
+        child list is restored even on failure — the plan object is
+        also the dispatch ladder's fallback input."""
+        top = sp.chain_top
+        idx = sp.parent.children.index(top)
+        sp.parent.children[idx] = StreamedSourceExec(
+            ordered, top.schema, self.conf)
+        try:
+            return phys.collect()
+        finally:
+            sp.parent.children[idx] = top
+
+
+def scan_batch_rows(scan) -> int:
+    return scan._batch_rows
+
+
+def _overlap(a_spans, b_spans) -> Optional[float]:
+    from spark_rapids_tpu.obs.telemetry import _overlap_fraction
+
+    f = _overlap_fraction(a_spans, b_spans)
+    return round(f, 4) if f is not None else None

@@ -1,0 +1,40 @@
+"""Stretch (dry-run): route window slots across the device mesh.
+
+Gated by `spark.rapids.tpu.stream.mesh.enabled` (default false). The
+full design — each ScanUnit's window slot uploaded to a distinct mesh
+device and consumed by the SPMD engine's per-device shards
+(parallel/plan_compiler.py) — needs the mesh engine's exchange
+planner to accept externally-placed shards; until then this module
+emits the PLACEMENT PLAN ONLY: one `stream.window` event with
+action="mesh" per unit, carrying the device each slot WOULD land on
+(round-robin over the local mesh), and moves no data. CI and the
+event log can therefore already validate slot->device fan-out shape
+against the future router.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from spark_rapids_tpu.io import readers
+
+
+def plan_mesh_slots(units: List[readers.ScanUnit]) -> List[int]:
+    """Dry-run placement: unit i -> device (i mod n_devices). Emits
+    one stream.window(action="mesh") event per unit; returns the
+    device index per unit for tests."""
+    import jax
+
+    from spark_rapids_tpu.obs import events as obs_events
+
+    try:
+        n = max(1, len(jax.devices()))
+    except Exception:
+        n = 1
+    placement = []
+    for i, u in enumerate(units):
+        dev = i % n
+        placement.append(dev)
+        obs_events.emit("stream.window", action="mesh",
+                        bytes=u.est_bytes, inUse=dev)
+    return placement

@@ -1,0 +1,217 @@
+"""Streaming strategy selection + chain extraction.
+
+The out-of-core streaming executor (stream/executor.py) runs a
+partition-granular pipeline over ONE oversized parquet scan: prefetch
+threads decode row-group units into a host staging queue, a
+double-buffered uploader fills a bounded device window, and the chain
+of streamable operators above the scan consumes window slots one unit
+at a time. This module decides WHEN that engine engages and WHICH
+prefix of the physical plan it can stream.
+
+Selection mirrors the fused engine's working-set gate
+(exec/fused.py _scan_parts: file bytes x ~6 decode/pad expansion vs
+the HBM budget) but inverts it: where fused REFUSES a scan whose
+working set exceeds HBM, streaming VOLUNTEERS for a scan whose
+estimated decoded bytes exceed `window.quotaFraction` of FREE HBM —
+exactly the queries the resident engines would either OOM on or
+demote to the dispatch-bound eager path batch by batch.
+
+The streamable chain is the maximal plan prefix above the scan where
+every operator consumes exactly the streamed child's batches with no
+cross-batch state EXCEPT a terminal partial/complete aggregation
+(whose merge phase is associative over retired partials) and
+broadcast joins whose build side fits the window (materialized once,
+probed per unit). Anything else (sorts, shuffles, final aggs over
+other inputs) terminates the chain; retired partitions substitute for
+the chain top and the ordinary engines run the remainder.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+#: join types where probing one streamed batch against the broadcast
+#: build side is independent of every other batch (no build-side
+#: tracking as full/right outer would need; existence rides the
+#: probe-side semantics)
+STREAM_JOIN_TYPES = ("inner", "left", "left_semi", "left_anti",
+                     "existence")
+
+#: decoded-working-set expansion over on-disk parquet bytes — the same
+#: heuristic constant as the fused engine's scan gate (decode +
+#: capacity padding + operator temporaries)
+DECODE_EXPANSION = 6
+
+
+class StreamCompileError(NotImplementedError):
+    """Plan (or this scan) has no streaming lowering — structural, so
+    dispatch records a fallback, not a degradation."""
+
+
+class StreamPlan:
+    """One selected scan + the streamable operator chain above it.
+
+    `chain` is bottom-up and EXCLUDES the scan; empty means the scan's
+    own batches retire directly. `parent` is the node whose child list
+    contains the chain top (None when the chain top is the plan root,
+    in which case retired partitions concatenate into the result)."""
+
+    def __init__(self, scan, chain: List, parent, est_bytes: int):
+        self.scan = scan
+        self.chain = chain
+        self.parent = parent
+        self.est_bytes = est_bytes
+
+    @property
+    def chain_top(self):
+        return self.chain[-1] if self.chain else self.scan
+
+
+def _scan_files(scan) -> List[str]:
+    return [f for task in scan._tasks for f in task]
+
+
+def estimate_scan_bytes(scan) -> int:
+    total = 0
+    for f in _scan_files(scan):
+        try:
+            total += os.path.getsize(f)
+        except OSError:
+            pass
+    return total
+
+
+def free_hbm() -> int:
+    """HBM not currently reserved by resident queries — the pool the
+    window budget is carved from."""
+    from spark_rapids_tpu.runtime.memory import get_catalog
+
+    pool = get_catalog().pool
+    return max(0, pool.limit - pool.reserved)
+
+
+def _eligible_scans(phys) -> List:
+    """Parquet device scans the streaming reader can drive: row-group
+    addressable (no hive partition-value injection, no lakehouse
+    delete-set semantics) with at least one file."""
+    from spark_rapids_tpu.exec.operators import TpuFileScanExec
+
+    out = []
+
+    def walk(node):
+        if (isinstance(node, TpuFileScanExec) and node.is_tpu
+                and node.fmt == "parquet" and node._part_spec is None
+                and _scan_files(node)):
+            out.append(node)
+        for c in node.children:
+            walk(c)
+
+    walk(phys)
+    return out
+
+
+def select_scan(phys, conf) -> Optional[Tuple]:
+    """The largest eligible scan whose estimated decoded working set
+    exceeds the window quota fraction of free HBM, or None when every
+    scan fits residently (the resident engines are strictly faster
+    when the table fits — streaming only pays off out of core)."""
+    from spark_rapids_tpu.config import rapids_conf as rc
+
+    scans = _eligible_scans(phys)
+    if not scans:
+        return None
+    sized = sorted(((estimate_scan_bytes(s), s) for s in scans),
+                   key=lambda p: -p[0])
+    est, scan = sized[0]
+    frac = conf.get(rc.STREAM_WINDOW_QUOTA_FRACTION)
+    if est * DECODE_EXPANSION <= frac * free_hbm():
+        return None
+    return est, scan
+
+
+def stream_selected(phys, conf) -> bool:
+    """Cheap dispatch-time gate (no plan mutation)."""
+    return select_scan(phys, conf) is not None
+
+
+def _parent_map(phys) -> dict:
+    parents = {}
+
+    def walk(node):
+        for c in node.children:
+            parents[id(c)] = node
+            walk(c)
+
+    walk(phys)
+    return parents
+
+
+def _streamable_parent(parent, child) -> Optional[str]:
+    """Is `parent` streamable over `child`'s batches? Returns
+    "extend" (keep walking up), "terminal" (include, then stop), or
+    None (chain stops below `parent`)."""
+    from spark_rapids_tpu.exec.joins import TpuBroadcastHashJoinExec
+    from spark_rapids_tpu.exec.operators import (
+        TpuCoalesceBatchesExec,
+        TpuFilterExec,
+        TpuHashAggregateExec,
+        TpuProjectExec,
+    )
+
+    if isinstance(parent, (TpuFilterExec, TpuProjectExec,
+                           TpuCoalesceBatchesExec)):
+        return "extend"
+    if isinstance(parent, TpuBroadcastHashJoinExec):
+        # only the PROBE side streams; the build side must be the
+        # broadcast child so it materializes once per query
+        if (parent.children and parent.children[0] is child
+                and parent.join_type in STREAM_JOIN_TYPES):
+            return "extend"
+        return None
+    if isinstance(parent, TpuHashAggregateExec):
+        # partial: per-unit update, retire buffer rows (the shuffle
+        # above merges). complete: per-unit update + ONE merge/finalize
+        # over all retired partials inside the executor. final mode
+        # consumes post-shuffle buffers — not this scan's stream.
+        if parent.children[0] is child and parent.mode in (
+                "partial", "complete"):
+            return "terminal"
+        return None
+    return None
+
+
+def plan_stream(phys, conf) -> StreamPlan:
+    """Select the scan and extract its maximal streamable chain.
+    Raises StreamCompileError when no scan qualifies."""
+    sel = select_scan(phys, conf)
+    if sel is None:
+        raise StreamCompileError(
+            "no out-of-core parquet scan in this plan "
+            "(every scan's working set fits resident HBM)")
+    est, scan = sel
+    parents = _parent_map(phys)
+    chain: List = []
+    cur = scan
+    while True:
+        parent = parents.get(id(cur))
+        if parent is None:
+            break
+        kind = _streamable_parent(parent, cur)
+        if kind is None:
+            break
+        chain.append(parent)
+        cur = parent
+        if kind == "terminal":
+            break
+    top = chain[-1] if chain else scan
+    return StreamPlan(scan, chain, parents.get(id(top)), est)
+
+
+def stamp_stream_strategy(phys, conf) -> None:
+    """explain() support: mark the selected scan so pretty() renders
+    `TpuFileScanExec [strategy=stream]` — the streaming twin of the
+    mesh planner's stamp_exchange_strategies."""
+    sel = select_scan(phys, conf)
+    if sel is not None:
+        sel[1].stream_strategy = "stream"

@@ -1,0 +1,151 @@
+"""Host-side parquet prefetcher for the streaming executor.
+
+N reader threads (spark.rapids.tpu.stream.prefetch.threads) decode
+ScanUnits (io/readers.py split_scan_units: row-group-granular,
+stats-pruned, packed to ~window/4 bytes) into ONE bounded staging
+queue, riding the same abandoned-Event discipline as the
+multithreaded eager reader (io/readers.py read_parquet_multithreaded):
+a consumer that stops pulling unblocks every producer promptly, and
+file opens retry transient I/O faults through the io.read backoff
+site.
+
+Chaos site `stream.prefetch` fires INSIDE a worker around a unit's
+decode: the unit is re-enqueued onto the shared work queue (bounded
+per-unit retries) and the stream continues — partition-granular retry
+without restarting the query. Exhausted retries and real decode
+errors surface to the consumer through the staging queue as the
+exception itself, preserving the pipeline's ordering guarantees
+(everything staged before the error is still consumable).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List, Optional
+
+import pyarrow as pa
+
+from spark_rapids_tpu.io import readers
+
+#: staging-queue item marking all units drained (every worker exited)
+PREFETCH_DONE = object()
+
+#: per-unit budget for stream.prefetch chaos re-enqueues
+_UNIT_RETRIES = 3
+
+
+class Prefetcher:
+    """Decode `units` into `staging` from a pool of reader threads.
+
+    Items on `staging` are (unit_index, unit, pa.Table) tuples, an
+    Exception instance (fatal — consumer should raise), or
+    PREFETCH_DONE (exactly once, after the last unit). One unit decodes
+    to ONE concatenated host table: unit size is already bounded to a
+    fraction of the device window, and unit-granular staging is what
+    makes retirement lineage (mid-stream recovery) partition-exact."""
+
+    def __init__(self, units: List[readers.ScanUnit],
+                 columns: Optional[List[str]], batch_rows: int,
+                 num_threads: int,
+                 read_dictionary: Optional[List[str]] = None,
+                 cancel_token=None):
+        self._columns = columns
+        self._batch_rows = batch_rows
+        self._read_dictionary = read_dictionary
+        self._cancel_token = cancel_token
+        self._work: "queue.Queue" = queue.Queue()
+        for i, u in enumerate(units):
+            self._work.put((i, u, 0))  # (index, unit, retry_count)
+        self._remaining = len(units)
+        self._rlock = threading.Lock()
+        self.abandoned = threading.Event()
+        nthreads = max(1, min(int(num_threads), max(1, len(units))))
+        self.staging: "queue.Queue" = queue.Queue(maxsize=2 * nthreads)
+        self._done_emitted = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"stream-prefetch-{i}")
+            for i in range(nthreads)]
+
+    def start(self) -> None:
+        for t in self._threads:
+            t.start()
+        if not self._threads:
+            self._emit_done()
+
+    def abandon(self) -> None:
+        """Consumer is leaving (error, cancel, device loss): unblock
+        every producer; staged tables are garbage-collected."""
+        self.abandoned.set()
+
+    def join(self, timeout_s: float = 5.0) -> None:
+        for t in self._threads:
+            t.join(timeout=timeout_s)
+
+    # --- internals ---
+
+    def _emit_done(self) -> None:
+        if not self._done_emitted.is_set():
+            self._done_emitted.set()
+            self._put(PREFETCH_DONE)
+
+    def _put(self, item) -> bool:
+        while not self.abandoned.is_set():
+            try:
+                self.staging.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _decode(self, unit: readers.ScanUnit) -> pa.Table:
+        parts = [t for t in readers.read_scan_unit(
+            unit, self._columns, self._batch_rows,
+            read_dictionary=self._read_dictionary)]
+        if not parts:
+            # stats-pruned-to-empty unit: stage a zero-row table so the
+            # retirement ledger still covers it
+            schema = readers._open_retry(
+                lambda: readers.pq.read_schema(unit.path), unit.path)
+            empty = schema.empty_table()
+            return empty if self._columns is None \
+                else empty.select(self._columns)
+        return pa.concat_tables(parts, promote_options="none")
+
+    def _worker(self) -> None:
+        from spark_rapids_tpu.runtime import cancellation, faults
+
+        with cancellation.scope(self._cancel_token):
+            while not self.abandoned.is_set():
+                try:
+                    idx, unit, tries = self._work.get(timeout=0.1)
+                except queue.Empty:
+                    with self._rlock:
+                        if self._remaining == 0:
+                            self._emit_done()
+                            return
+                    continue
+                try:
+                    faults.maybe_inject("stream.prefetch",
+                                        detail=unit.path)
+                    table = self._decode(unit)
+                except faults.InjectedFault as e:
+                    if tries + 1 >= _UNIT_RETRIES:
+                        self._put(e)
+                        return
+                    # partition-granular retry: the unit goes back on
+                    # the shared work queue; any worker may pick it up
+                    self._work.put((idx, unit, tries + 1))
+                    continue
+                except BaseException as e:  # noqa: BLE001 - surfaced
+                    self._put(e)
+                    return
+                if not self._put((idx, unit, table)):
+                    return
+                with self._rlock:
+                    self._remaining -= 1
+                    last = self._remaining == 0
+                if last:
+                    self._emit_done()
+                    return

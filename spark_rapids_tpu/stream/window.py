@@ -1,0 +1,97 @@
+"""Bounded device window — the HBM budget a streaming query may hold.
+
+The window is the streaming executor's admission unit: the uploader
+blocks in `admit()` until the in-flight device bytes fit the budget,
+compute releases a slot's bytes when its unit retires, and the peak
+high-water mark feeds telemetry (`windowPeakBytes`) and the
+window-bounded CI assertion. Single-condition-variable accounting:
+slots are admitted in arrival order, which is exactly the pipeline's
+unit order.
+
+Budget derivation (`window_budget`): quotaFraction x free HBM, capped
+by `stream.window.maxBytes` when set and by the per-query device
+quota (runtime/memory.py SpillCatalog.query_quota_bytes) so a
+streaming query charges the SAME ledger as a resident one — then
+scaled by the admission priority class: a negative-priority `batch`
+tenant gets HALF a window, so a 10x-HBM batch stream cannot starve
+`interactive` queries of upload bandwidth or HBM headroom.
+"""
+
+from __future__ import annotations
+
+import threading
+
+#: never derive a window below this — a single capacity bucket of a
+#: narrow batch; below it the stream would thrash on per-row uploads
+MIN_WINDOW_BYTES = 64 * 1024
+
+
+def window_budget(conf, priority: int = 0) -> int:
+    """Derive this query's window byte budget (see module doc)."""
+    from spark_rapids_tpu.config import rapids_conf as rc
+    from spark_rapids_tpu.runtime.memory import get_catalog
+
+    cat = get_catalog()
+    free = max(0, cat.pool.limit - cat.pool.reserved)
+    budget = int(free * conf.get(rc.STREAM_WINDOW_QUOTA_FRACTION))
+    max_bytes = conf.get(rc.STREAM_WINDOW_MAX_BYTES)
+    if max_bytes > 0:
+        budget = min(budget, max_bytes)
+    if cat.query_quota_bytes > 0:
+        budget = min(budget, cat.query_quota_bytes)
+    if priority < 0:
+        # batch-class tenants ride half a window (serve admission
+        # SERVE_PRIORITY_CLASSES: interactive=100, standard=0,
+        # batch=-100)
+        budget //= 2
+    return max(budget, MIN_WINDOW_BYTES)
+
+
+class StreamAborted(RuntimeError):
+    """The window was aborted while a thread waited for admission —
+    the pipeline is unwinding (error, cancel, or device loss)."""
+
+
+class DeviceWindow:
+    """Condition-variable byte window with peak tracking."""
+
+    def __init__(self, budget_bytes: int):
+        self.budget = max(1, int(budget_bytes))
+        self._cv = threading.Condition()
+        self.in_use = 0
+        self.peak = 0
+        self._aborted = False
+
+    def admit(self, nbytes: int, poll_s: float = 0.2) -> int:
+        """Block until `nbytes` fits the window (an EMPTY window always
+        admits, so one unit larger than the whole budget still makes
+        progress — estimate slack must not wedge the stream). Returns
+        the admitted byte count; raises StreamAborted if abort() lands
+        while waiting. Polls so the executor's cancellation check in
+        the waiter's loop stays responsive."""
+        from spark_rapids_tpu.runtime import cancellation
+
+        nbytes = max(0, int(nbytes))
+        with self._cv:
+            while True:
+                if self._aborted:
+                    raise StreamAborted("window aborted")
+                if self.in_use == 0 or self.in_use + nbytes <= self.budget:
+                    self.in_use += nbytes
+                    self.peak = max(self.peak, self.in_use)
+                    return nbytes
+                self._cv.wait(timeout=poll_s)
+                # a cancelled query must not keep waiting for slots the
+                # compute side will never release
+                cancellation.check_current()
+
+    def release(self, nbytes: int) -> None:
+        with self._cv:
+            self.in_use = max(0, self.in_use - max(0, int(nbytes)))
+            self._cv.notify_all()
+
+    def abort(self) -> None:
+        """Unblock every admit() waiter with StreamAborted."""
+        with self._cv:
+            self._aborted = True
+            self._cv.notify_all()

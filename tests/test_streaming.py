@@ -1,0 +1,233 @@
+"""Out-of-core streaming executor (spark_rapids_tpu/stream/): window
+bounding, encoded-codes row capacity, priority-scaled window quotas,
+and mid-stream cancellation hygiene."""
+
+import os
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.api import functions as F
+from spark_rapids_tpu.api.session import TpuSparkSession
+
+WINDOW = 2 << 20  # forced-small device window for every test here
+
+
+def _write_dataset(tmp_path, files=4, rows=120_000, seed=0):
+    rng = np.random.default_rng(seed)
+    d = tmp_path / "ds"
+    d.mkdir(exist_ok=True)
+    for i in range(files):
+        t = pa.table({
+            "store": pa.array(rng.integers(0, 50, rows), pa.int64()),
+            "amount": pa.array(rng.integers(0, 100, rows), pa.int64()),
+            "region": pa.array(
+                rng.choice(["east", "west", "north", "south"], rows)),
+        })
+        pq.write_table(t, str(d / f"part{i}.parquet"),
+                       row_group_size=20_000)
+    return str(d)
+
+
+def _stream_conf(**extra):
+    conf = {
+        "spark.sql.shuffle.partitions": 4,
+        "spark.rapids.tpu.stream.enabled": "true",
+        "spark.rapids.tpu.stream.window.maxBytes": str(WINDOW),
+        # make the selection gate trip for any test-sized table
+        "spark.rapids.tpu.stream.window.quotaFraction": "0.0001",
+    }
+    conf.update(extra)
+    return conf
+
+
+def _canon(t):
+    cols = [t.column(i).to_pylist() for i in range(t.num_columns)]
+    return sorted(map(tuple, zip(*cols))) if cols else []
+
+
+def _query(spark, path):
+    return (spark.read.parquet(path)
+            .filter(F.col("amount") > 15)
+            .groupBy("region")
+            .agg(F.sum("amount").alias("s"), F.count("*").alias("c")))
+
+
+# ------------------------------------------------- window high-water
+
+def test_window_bounded_high_water(tmp_path):
+    """A table many times the window streams oracle-identically with
+    the catalog's device high-water inside the window budget plus
+    slack — the out-of-core contract."""
+    from spark_rapids_tpu.runtime.memory import get_catalog
+
+    path = _write_dataset(tmp_path)
+    s = TpuSparkSession(_stream_conf())
+    try:
+        out = _query(s, path).collect_arrow()
+        rec = s.last_execution
+        tel = rec.get("telemetry") or {}
+        assert rec["engine"] == "stream"
+        # many window-sized admissions, not one table-sized one
+        assert tel.get("partitionsStreamed", 0) >= 8
+        # window accounting bounded by the budget (estimate-based, so
+        # a capacity-padding slack rides on top)
+        assert tel.get("windowPeakBytes", 0) <= 2 * WINDOW
+        # the REAL device high-water must also stay window-shaped:
+        # well under the decoded table size (~4x window here), with
+        # slack for padding, spill scratch and the final merge
+        assert get_catalog().pool.peak <= 4 * WINDOW
+        assert tel.get("overlapFraction") is not None
+    finally:
+        s.stop()
+    s2 = TpuSparkSession({"spark.sql.shuffle.partitions": 4,
+                          "spark.rapids.tpu.stream.enabled": "false"})
+    try:
+        want = _query(s2, path).collect_arrow()
+    finally:
+        s2.stop()
+    assert _canon(out) == _canon(want)
+
+
+# ------------------------------------------- encoded codes in window
+
+def test_encoded_codes_shrink_window(tmp_path):
+    """Low-cardinality strings stream as dictionary CODES: the same
+    row count admits strictly fewer window bytes encoded than with
+    decoded strings, so each window slot holds more rows."""
+    path = _write_dataset(tmp_path, files=2)
+
+    def peak(encoded):
+        s = TpuSparkSession(_stream_conf(**{
+            "spark.rapids.tpu.encoded.enabled": str(encoded).lower(),
+        }))
+        try:
+            out = _query(s, path).collect_arrow()
+            tel = (s.last_execution or {}).get("telemetry") or {}
+            assert s.last_execution["engine"] == "stream"
+            return _canon(out), tel.get("windowPeakBytes", 0)
+        finally:
+            s.stop()
+
+    rows_enc, peak_enc = peak(True)
+    rows_plain, peak_plain = peak(False)
+    assert rows_enc == rows_plain
+    assert peak_enc > 0 and peak_plain > 0
+    assert peak_enc < peak_plain
+
+
+# --------------------------------------------- priority-scaled quota
+
+def test_priority_scales_window_budget():
+    """A batch-class (negative priority) tenant derives HALF the
+    window of an interactive one under identical memory conditions —
+    the starvation guard for 10x-HBM batch streams (regression for
+    the quota-scaling rule, not a timing test)."""
+    from spark_rapids_tpu.stream import window_budget
+
+    # quotaFraction=1.0 so the conf'd maxBytes is the binding term and
+    # the expected budgets are deterministic regardless of free HBM
+    s = TpuSparkSession(_stream_conf(**{
+        "spark.rapids.tpu.stream.window.quotaFraction": "1.0",
+    }))
+    try:
+        conf = s.rapids_conf
+        interactive = window_budget(conf, priority=100)
+        standard = window_budget(conf, priority=0)
+        batch = window_budget(conf, priority=-100)
+        assert interactive == standard == WINDOW
+        assert batch == WINDOW // 2
+        assert batch < interactive
+    finally:
+        s.stop()
+
+
+def test_window_budget_floor_and_quota_cap():
+    from spark_rapids_tpu.stream import window_budget
+    from spark_rapids_tpu.stream.window import MIN_WINDOW_BYTES
+
+    s = TpuSparkSession(_stream_conf(**{
+        "spark.rapids.tpu.stream.window.maxBytes": "1",
+    }))
+    try:
+        assert window_budget(s.rapids_conf) == MIN_WINDOW_BYTES
+        # the floor is priority-independent: even a batch tenant's
+        # halved budget cannot drop below one usable slot
+        assert window_budget(s.rapids_conf,
+                             priority=-100) == MIN_WINDOW_BYTES
+    finally:
+        s.stop()
+
+
+# ------------------------------------------------- mid-stream cancel
+
+def test_midstream_cancel_leak_free(tmp_path):
+    """A query deadline landing mid-stream unwinds leak-free: no
+    spillable buffers, no device reservation, no admission slot left
+    behind — and the session still serves the next query."""
+    from spark_rapids_tpu.runtime import admission
+    from spark_rapids_tpu.runtime.errors import (
+        QueryCancelledError,
+        QueryDeadlineExceeded,
+    )
+    from spark_rapids_tpu.runtime.memory import get_catalog
+
+    path = _write_dataset(tmp_path)
+    s = TpuSparkSession(_stream_conf(**{
+        "spark.rapids.tpu.query.timeoutMs": "1",
+    }))
+    try:
+        with pytest.raises((QueryDeadlineExceeded, QueryCancelledError)):
+            _query(s, path).collect_arrow()
+        # prefetch/upload threads unwind asynchronously; give the
+        # pipeline a bounded quiesce before asserting hygiene
+        cat = get_catalog()
+        deadline = time.time() + 10
+        while time.time() < deadline and (
+                cat.buffer_count() or cat.pool.reserved):
+            time.sleep(0.1)
+        assert cat.check_leaks() == 0
+        assert cat.buffer_count() == 0
+        assert cat.pool.reserved == 0
+        assert admission.current_handle() is None
+        # the lane is clear: the next (undeadlined) query runs
+        s.conf.set("spark.rapids.tpu.query.timeoutMs", "0")
+        out = _query(s, path).collect_arrow()
+        assert out.num_rows == 4
+    finally:
+        s.stop()
+
+
+# ------------------------------------------------ planner selection
+
+def test_small_scan_not_selected(tmp_path):
+    """A scan that fits residently must NOT stream — the resident
+    engines are faster in core."""
+    path = _write_dataset(tmp_path, files=1, rows=1_000)
+    s = TpuSparkSession({
+        "spark.sql.shuffle.partitions": 4,
+        "spark.rapids.tpu.stream.enabled": "true",
+        # default quota fraction: a 1k-row table is far under it
+    })
+    try:
+        _query(s, path).collect_arrow()
+        assert s.last_execution["engine"] != "stream"
+    finally:
+        s.stop()
+
+
+def test_explain_stamps_stream_strategy(tmp_path, capsys):
+    path = _write_dataset(tmp_path, files=2)
+    s = TpuSparkSession(_stream_conf())
+    try:
+        df = _query(s, path)
+        df.collect_arrow()
+        assert s.last_execution["engine"] == "stream"
+        df.explain()
+        text = capsys.readouterr().out
+        assert "TpuFileScanExec [strategy=stream]" in text
+    finally:
+        s.stop()
