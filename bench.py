@@ -685,6 +685,73 @@ def _streaming_probe(spark, input_bytes: int) -> dict:
                 spark.conf.set(k, v)
 
 
+def _write_probe(spark) -> dict:
+    """Transactional write path (io/commit.py): steady-state GB/s per
+    format pushing one in-memory table through the two-phase committer
+    (attempt staging + fsync + rename + manifest publish), plus the
+    job-commit latency p50/p99 over a burst of tiny jobs — the fixed
+    publish cost every exactly-once job pays at the _SUCCESS point.
+    GB/s is logical Arrow bytes over wall time, the same denominator
+    convention as the read-side numbers."""
+    import shutil
+    import tempfile
+
+    from spark_rapids_tpu.obs import events as obs_events
+
+    n = 2_000_000
+    rng = np.random.default_rng(11)
+    t = pa.table({
+        "a": pa.array(rng.integers(0, 1 << 40, n), type=pa.int64()),
+        "b": pa.array(rng.random(n), type=pa.float64()),
+        "s": pa.array([f"g{i % 97}" for i in range(n)],
+                      type=pa.string()),
+    })
+    df = spark.createDataFrame(t)
+    nbytes = t.nbytes
+    root = tempfile.mkdtemp(prefix="srtpu_bench_write_")
+    gbps = {}
+    try:
+        for fmt in ("parquet", "orc", "csv", "json", "avro",
+                    "hivetext"):
+            p = os.path.join(root, fmt)
+            t0 = time.perf_counter()
+            df.write.format(fmt).save(p)
+            gbps[fmt] = round(
+                nbytes / (time.perf_counter() - t0) / 1e9, 3)
+        # publish latency: the write.commit event's commitMs covers
+        # task promotion + manifest fsync alone, not data volume
+        lat = []
+
+        def tap(ev):
+            if ev.get("event") == "write.commit":
+                lat.append(float(ev.get("commitMs") or 0.0))
+
+        bus = obs_events.get()
+        if bus is not None:
+            bus.subscribe(tap)
+        small = spark.createDataFrame(t.slice(0, 10_000))
+        try:
+            for i in range(24):
+                small.write.parquet(os.path.join(root, f"job{i}"))
+        finally:
+            if bus is not None:
+                bus.unsubscribe(tap)
+        lat.sort()
+
+        def pct(q):
+            return round(lat[min(len(lat) - 1, int(q * len(lat)))], 3)
+
+        return {
+            "tableMiB": round(nbytes / 2**20, 1),
+            "gbps": gbps,
+            "commitJobs": len(lat),
+            "commit_p50_ms": pct(0.50) if lat else None,
+            "commit_p99_ms": pct(0.99) if lat else None,
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _multichip_probe() -> dict:
     """Spawn the multichip scaling bench in its own process: q5 at
     1/2/4/8 shards on the mesh SPMD engine vs the default single-chip
@@ -975,6 +1042,16 @@ def main():
     except Exception as e:  # never lose the perf report
         print(f"# streaming block unavailable: {e!r}", flush=True)
 
+    # ---- transactional write block (io/commit.py): GB/s per output
+    # ---- format through the exactly-once committer and the
+    # ---- job-commit (publish) latency p50/p99 — the nightly tracks
+    # ---- what the two-phase protocol costs over plain file writes
+    write_block = None
+    try:
+        write_block = _write_probe(spark)
+    except Exception as e:  # never lose the perf report
+        print(f"# write block unavailable: {e!r}", flush=True)
+
     # ---- obs attribution block: the perf trajectory should capture
     # ---- WHERE time went (top operators by device time, span-tree
     # ---- shape, event volume), not just the totals above
@@ -1090,6 +1167,9 @@ def main():
         # forced below the table — streamed GB/s, window high-water,
         # partitions streamed, prefetch/compute overlap fraction
         "streaming": streaming_block,
+        # transactional writes (io/commit.py): per-format GB/s through
+        # the two-phase committer + job-commit latency p50/p99
+        "write": write_block,
         # event/span attribution (obs/): top operators by device time,
         # span-tree depth, event volume — regression triage data
         "obs": obs_block,

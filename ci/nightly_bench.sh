@@ -45,6 +45,15 @@ entry = {
         (d.get("streaming") or {}).get("overlapFraction"),
     "streaming_window_peak_bytes":
         (d.get("streaming") or {}).get("windowPeakBytes"),
+    # transactional writes (PR 20): per-format GB/s through the
+    # exactly-once committer and the job-commit publish latency — the
+    # trajectory tracks what the two-phase protocol costs
+    "write_gbps_parquet":
+        ((d.get("write") or {}).get("gbps") or {}).get("parquet"),
+    "write_gbps_csv":
+        ((d.get("write") or {}).get("gbps") or {}).get("csv"),
+    "write_commit_p50_ms": (d.get("write") or {}).get("commit_p50_ms"),
+    "write_commit_p99_ms": (d.get("write") or {}).get("commit_p99_ms"),
 }
 hist = "bench-history.jsonl"
 prev = None

@@ -33,6 +33,9 @@ ci/encoded_check.sh
 echo "== streaming gate (out-of-core window + overlap + chaos) =="
 ci/streaming_check.sh
 
+echo "== write gate (exactly-once commit + crash-safe overwrite + Delta OCC) =="
+ci/write_check.sh
+
 echo "== device-failure gate (fence + warm recovery + epoch) =="
 ci/devicefail_check.sh
 

@@ -1080,29 +1080,101 @@ class DataFrameWriter:
         return self
 
     def save(self, path: str):
-        if self._format == "delta":
-            from spark_rapids_tpu.lakehouse.delta import write_delta
+        """Transactional save: the whole write — the reading collect
+        included — runs inside ONE query scope, so write.* events and
+        the telemetry `write` block attribute to the same queryId the
+        read side reported under."""
+        from spark_rapids_tpu.obs import events as obs_events
 
-            # delta.* writer options become table properties
-            props = {k: str(v) for k, v in self._options.items()
-                     if k.startswith("delta.")}
-            write_delta(self._df, path, mode=self._mode,
-                        partition_by=self._partition_by,
-                        properties=props or None)
-            return
-        from spark_rapids_tpu.io.writers import (
-            WriteStats,
-            prepare_dir,
-            write_task,
+        qid = obs_events.begin_query()
+        status = "error"
+        try:
+            if self._format == "delta":
+                from spark_rapids_tpu.lakehouse.delta import write_delta
+
+                # delta.* writer options become table properties
+                props = {k: str(v) for k, v in self._options.items()
+                         if k.startswith("delta.")}
+                write_delta(self._df, path, mode=self._mode,
+                            partition_by=self._partition_by,
+                            properties=props or None)
+                status = "ok"
+                return
+            out = self._save_committed(path, qid)
+            status = "ok"
+            return out
+        finally:
+            obs_events.finish_query(qid, engine=None, status=status,
+                                    fallbacks=0, degradations=0)
+
+    def _save_committed(self, path: str, qid: int):
+        """File-format save through the two-phase commit protocol
+        (io/commit.py): N write tasks stage under the scheduler's
+        retry/speculation discipline (first task commit wins), the job
+        commit publishes atomically (_SUCCESS last; overwrite = the
+        deferred dir swap), and any failure aborts leak-free with
+        pre-existing data untouched."""
+        from spark_rapids_tpu.config import rapids_conf as rc
+        from spark_rapids_tpu.io import commit as iocommit
+        from spark_rapids_tpu.io.writers import WriteStats, write_task
+        from spark_rapids_tpu.runtime.scheduler import (
+            StageScheduler,
+            Task,
         )
 
-        if not prepare_dir(path, self._mode):
-            return
-        table = self._df.collect_arrow()
+        session = self._df.session
+        conf = getattr(session, "rapids_conf", None)
+        committer = iocommit.JobCommitter(
+            path, mode=self._mode, fmt=self._format, conf=conf,
+            partition_by=self._partition_by or None,
+            options=self._options)
+        if not committer.setup_job():
+            return None  # mode=ignore with existing output
         stats = WriteStats()
-        write_task(self._format, table, path, 0,
-                   self._partition_by or None, stats,
-                   options=self._options)
+        try:
+            table = self._df.collect_arrow()
+            n = (conf.get(rc.WRITE_TASKS) if conf is not None
+                 else rc.WRITE_TASKS.default)
+            n = max(1, min(int(n), table.num_rows or 1))
+            step = -(-max(table.num_rows, 1) // n)  # ceil division
+
+            def make_run(i: int, piece):
+                def run(attempt):
+                    adir = committer.attempt_dir(i, attempt)
+                    recs: list = []
+
+                    def stage(rel, write_fn, rows):
+                        recs.append(iocommit.stage_file(
+                            adir, rel, rows, write_fn))
+
+                    write_task(self._format, piece, adir, i,
+                               self._partition_by or None, None,
+                               options=self._options, stage=stage,
+                               file_tag=committer.job_id)
+                    return adir, recs
+
+                return run
+
+            tasks = [
+                Task(i, run=make_run(i, table.slice(i * step, step)),
+                     commit=lambda res, att, i=i:
+                         committer.commit_task(i, res, stats),
+                     abort=lambda att, i=i:
+                         committer.abort_task(i, att),
+                     lineage=f"write {self._format} task {i}")
+                for i in range(n)]
+            StageScheduler(conf, name=f"write-{self._format}",
+                           max_parallel=n).run(tasks)
+            committer.commit_job()
+        except BaseException:
+            committer.abort_job(reason="write failed")
+            raise
+        from spark_rapids_tpu.obs import telemetry as _tel
+
+        _tel.merge_final(qid, {"write": {
+            "bytes": stats.num_bytes, "files": stats.num_files,
+            "rows": stats.num_rows, "jobs": 1,
+            "commitMs": int(committer.commit_ms)}})
         return stats
 
     def parquet(self, path: str):

@@ -48,6 +48,14 @@ class DataFrameReader:
         return self
 
     def schema(self, s):
+        if not hasattr(s, "fields"):
+            # a pyarrow.Schema normalizes to the engine StructType here
+            # so every format reader sees one schema shape
+            from spark_rapids_tpu.columnar.arrow_bridge import (
+                schema_from_arrow,
+            )
+
+            s = schema_from_arrow(s)
         self._schema = s
         return self
 
@@ -132,11 +140,17 @@ class DataFrameReader:
     def csv(self, path: str, header: bool = True, **kw):
         from spark_rapids_tpu.api.dataframe import DataFrame
         from spark_rapids_tpu.columnar.arrow_bridge import schema_from_arrow
-        from spark_rapids_tpu.io.readers import read_csv
+        from spark_rapids_tpu.io.readers import expand_paths, read_csv
         from spark_rapids_tpu.plan.logical import FileScan
 
-        sample = read_csv(path, header=header, **kw)
-        schema = self._schema or schema_from_arrow(sample.schema)
+        if self._schema is not None:
+            schema = self._schema
+        else:
+            # schema inference samples ONE file — committed write
+            # output is a directory of part files
+            sample_path = (expand_paths([path], ".csv") or [path])[0]
+            sample = read_csv(sample_path, header=header, **kw)
+            schema = schema_from_arrow(sample.schema)
         opts = dict(self._options)
         opts["header"] = header
         return DataFrame(FileScan("csv", [path], schema, opts),
@@ -145,11 +159,15 @@ class DataFrameReader:
     def json(self, path: str):
         from spark_rapids_tpu.api.dataframe import DataFrame
         from spark_rapids_tpu.columnar.arrow_bridge import schema_from_arrow
-        from spark_rapids_tpu.io.readers import read_json
+        from spark_rapids_tpu.io.readers import expand_paths, read_json
         from spark_rapids_tpu.plan.logical import FileScan
 
-        sample = read_json(path)
-        schema = self._schema or schema_from_arrow(sample.schema)
+        if self._schema is not None:
+            schema = self._schema
+        else:
+            sample_path = (expand_paths([path], ".json") or [path])[0]
+            sample = read_json(sample_path)
+            schema = schema_from_arrow(sample.schema)
         return DataFrame(FileScan("json", [path], schema, self._options),
                          self.session)
 

@@ -1135,6 +1135,45 @@ STREAM_MESH_ENABLED = conf(
     "parallelizes across per-chip links. Currently emits the "
     "placement plan as stream.window events without routing data; "
     "execution stays single-chip.", bool)
+WRITE_TASKS = conf(
+    "spark.rapids.tpu.write.tasks", 1,
+    "Task fan-out of a file write job (io/commit.py): the collected "
+    "result is sliced into this many write tasks, each running as a "
+    "scheduler task attempt with its own attempt-tagged staging dir — "
+    "so worker-crash re-attempts and speculative duplicates ride the "
+    "same retry/first-commit-wins machinery as compute tasks.", int,
+    checker=lambda v: 1 <= v <= 4096)
+WRITE_MANIFEST_ENABLED = conf(
+    "spark.rapids.tpu.write.manifest.enabled", True,
+    "Publish a _SUCCESS manifest (file list + sizes + crc32 checksums) "
+    "as the LAST step of job commit — its presence is the commit "
+    "point readers can gate on, and what "
+    "write.manifest.validateOnRead checks files against. false writes "
+    "no marker (files still publish via atomic renames).", bool)
+WRITE_VALIDATE_ON_READ = conf(
+    "spark.rapids.tpu.write.manifest.validateOnRead", False,
+    "When a scanned input directory carries a _SUCCESS manifest, "
+    "verify every listed file's existence, size and crc32 before the "
+    "scan plans (io/readers.py expand_paths) — torn or bit-rotted "
+    "output fails fast with ManifestMismatch instead of decoding "
+    "garbage. Off by default: it re-reads every data file.", bool)
+WRITE_SWEEP_TTL_S = conf(
+    "spark.rapids.tpu.write.staging.sweepTtlSeconds", 3600,
+    "Orphaned-staging reclamation age: job setup sweeps "
+    "_temporary/<jobId> dirs (and crashed overwrite-swap debris) whose "
+    "owner pid is dead, or — when the owner is unknowable (another "
+    "host, unreadable marker) — whose newest file is older than this. "
+    "A live job's staging (owner pid alive) is never touched.", int,
+    checker=lambda v: v >= 0)
+WRITE_DELTA_COMMIT_ATTEMPTS = conf(
+    "spark.rapids.tpu.write.delta.commitAttempts", 10,
+    "Optimistic-concurrency attempt budget for a lakehouse commit "
+    "(Delta / Iceberg version-file claim): a loser re-reads the "
+    "snapshot, re-runs append-vs-overwrite conflict semantics and "
+    "retries under the shared backoff policy (billed to the query's "
+    "io.retry.maxTotalMs budget) up to this many tries before "
+    "RetryExhausted surfaces.", int,
+    checker=lambda v: 1 <= v <= 100)
 
 
 def conf_entries() -> List[ConfEntry]:

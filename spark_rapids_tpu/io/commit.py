@@ -1,0 +1,604 @@
+"""Transactional file-output commit protocol — the
+HadoopMapReduceCommitProtocol / SQLHadoopMapReduceCommitProtocol analog
+(reference GpuFileFormatWriter's commit discipline), giving every
+format sink (io/writers.py) exactly-once, crash-safe output:
+
+* Task attempts write into attempt-tagged staging dirs
+  (`<out>/_temporary/<jobId>/task-<task>-<attempt>/`), each physical
+  file via tmp + fsync + atomic rename — like the crash-consistent
+  spill path (runtime/memory.py), a partial file can never carry a
+  final name, even inside staging.
+* Task commit promotes the attempt dir to `committed-<task>` with ONE
+  atomic rename, first-commit-wins: a speculative duplicate
+  (runtime/scheduler.py) or a crash re-attempt racing a slow original
+  loses the rename and its staging is discarded — output never
+  double-counts.
+* Job commit publishes atomically: committed files move into the final
+  tree with per-file atomic renames (complete files only, names made
+  job-unique by the committer's tag), then the `_SUCCESS` manifest —
+  file list + sizes + crc32 checksums — lands LAST via atomic rename.
+  Manifest presence is the commit point; readers can gate on it and
+  optionally validate against it
+  (`spark.rapids.tpu.write.manifest.validateOnRead`).
+* `mode=overwrite` is a DEFERRED swap: the new tree is assembled in a
+  sibling `.__new-<jobId>` dir and swapped in only after it is fully
+  built — pre-existing data survives byte-identical through any
+  mid-job failure. (The swap itself is two directory renames; the
+  startup sweep restores the `.__old` side if a crash lands exactly
+  between them.)
+* Abort unwinds staging leak-free, and `sweep_orphans` (run at every
+  job setup) reclaims `_temporary` dirs whose owner process is dead —
+  never a live job's staging (owner pid is checked first, age TTL is
+  the fallback for unknowable owners).
+
+Chaos sites `io.write` (staged file write), `commit.task` (promotion
+rename) and `commit.job` (publish) run the whole surface under
+fault injection; the lakehouse optimistic-transaction site
+`commit.conflict` lives with the version-file claims in
+lakehouse/delta.py and lakehouse/iceberg.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import socket
+import threading
+import time
+import uuid
+import zlib
+from typing import Callable, Dict, List, Optional
+
+TEMP_DIR = "_temporary"
+MANIFEST = "_SUCCESS"
+OWNER_FILE = "_OWNER"
+_OLD_TAG = ".__old-"
+_NEW_TAG = ".__new-"
+
+
+class ManifestMismatch(RuntimeError):
+    """Output disagrees with its _SUCCESS manifest (missing file, size
+    or checksum drift) — torn output surfaced before the scan plans."""
+
+
+# ------------------------------------------------- process write totals
+
+_totals_lock = threading.Lock()
+_TOTALS: Dict[str, float] = {
+    "jobs": 0, "files": 0, "bytes": 0, "rows": 0,
+    "commitMs": 0.0, "aborts": 0, "conflicts": 0,
+}
+
+
+def _add_totals(**fields) -> None:
+    with _totals_lock:
+        for k, v in fields.items():
+            _TOTALS[k] = _TOTALS.get(k, 0) + v
+
+
+def note_conflict(n: int = 1) -> None:
+    """Count a lakehouse optimistic-commit conflict retry (delta/
+    iceberg loser) into the process write totals (srtpu_write_*)."""
+    _add_totals(conflicts=n)
+
+
+def write_totals() -> Dict[str, float]:
+    with _totals_lock:
+        return dict(_TOTALS)
+
+
+# ------------------------------------------------------- fs primitives
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    # directory fsync persists the rename itself; not all filesystems
+    # support it — best-effort like the spill path
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _crc32(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                return crc & 0xFFFFFFFF
+            crc = zlib.crc32(chunk, crc)
+
+
+def visible_entries(path: str) -> List[str]:
+    """Entries a reader would see: everything not underscore/dot
+    prefixed (the Spark hidden-file convention `_temporary`, `_SUCCESS`
+    and staging debris ride under)."""
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return []
+    return sorted(n for n in names if not n.startswith(("_", ".")))
+
+
+def stage_file(attempt_dir: str, rel: str, rows: int,
+               write_fn: Callable[[str], None]) -> dict:
+    """Write ONE physical file into a task attempt's staging dir with
+    the crash-consistent discipline: write_fn targets a tmp name, the
+    tmp is fsync'd, then atomically renamed to `rel` — retried under
+    the shared backoff policy at chaos site `io.write`. Returns the
+    manifest record, with bytes taken AFTER the rename (the file is
+    guaranteed present — no silent stat miss) and its crc32."""
+    from spark_rapids_tpu.runtime import backoff
+
+    final = os.path.join(attempt_dir, rel)
+    os.makedirs(os.path.dirname(final), exist_ok=True)
+    tmp = final + f".inprogress-{uuid.uuid4().hex[:8]}"
+
+    def _write():
+        write_fn(tmp)  # re-creates from scratch on retry
+        _fsync_file(tmp)
+        os.replace(tmp, final)
+
+    try:
+        backoff.retry_io(_write, what=f"stage {rel}", site="io.write")
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    return {"path": rel, "bytes": os.path.getsize(final),
+            "rows": int(rows), "crc32": _crc32(final)}
+
+
+# ----------------------------------------------------------- committer
+
+class JobCommitter:
+    """One write job's two-phase commit (driver-side object; worker
+    processes stage through the module-level `stage_file` and hand
+    their records back as the task result)."""
+
+    def __init__(self, path: str, mode: str = "error",
+                 fmt: str = "parquet", conf=None,
+                 partition_by: Optional[List[str]] = None,
+                 options: Optional[Dict] = None):
+        self.path = os.path.abspath(path)
+        self.mode = mode
+        self.fmt = fmt
+        self.conf = conf
+        self.partition_by = list(partition_by or [])
+        self.options = dict(options or {})
+        self.job_id = uuid.uuid4().hex[:12]
+        self.staging = os.path.join(self.path, TEMP_DIR, self.job_id)
+        self.commit_ms = 0.0
+        self._tasks: Dict[int, List[dict]] = {}
+        self._lock = threading.Lock()
+        self._done = False
+        self._swapped = False
+        self._aborted = False
+
+    def _conf(self, entry):
+        return self.conf.get(entry) if self.conf is not None \
+            else entry.default
+
+    # --- job setup ---
+
+    def setup_job(self) -> bool:
+        """Mode gate + staging creation. Returns False when mode=ignore
+        skips the write. NOTHING pre-existing is deleted here — the
+        overwrite swap is deferred to commit_job."""
+        from spark_rapids_tpu.obs import events as obs_events
+
+        if os.path.isdir(self.path) and visible_entries(self.path):
+            if self.mode == "error":
+                raise FileExistsError(
+                    f"path {self.path} already exists (mode=error)")
+            if self.mode == "ignore":
+                return False
+        sweep_orphans(self.path, conf=self.conf)
+        os.makedirs(self.staging, exist_ok=True)
+        owner = os.path.join(self.staging, OWNER_FILE)
+        with open(owner, "w") as f:
+            json.dump({"pid": os.getpid(), "host": socket.gethostname(),
+                       "ts": time.time(), "mode": self.mode,
+                       "format": self.fmt}, f)
+        _fsync_file(owner)
+        # unknown-option check ONCE per job (the per-file warnings.warn
+        # this replaces drowned real signals on wide writes)
+        from spark_rapids_tpu.io.writers import unknown_options
+
+        ignored = unknown_options(self.fmt, self.options)
+        if ignored:
+            obs_events.emit("write.options", format=self.fmt,
+                            ignored=ignored)
+        obs_events.emit("write.start", jobId=self.job_id,
+                        path=self.path, format=self.fmt, mode=self.mode,
+                        tasks=None)
+        return True
+
+    # --- task phase ---
+
+    def attempt_dir(self, task: int, attempt) -> str:
+        d = os.path.join(self.staging, f"task-{task:05d}-{attempt}")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def stage(self, attempt_dir: str, rel: str, rows: int,
+              write_fn: Callable[[str], None]) -> dict:
+        return stage_file(attempt_dir, rel, rows, write_fn)
+
+    def commit_task(self, task: int, result,
+                    stats=None) -> bool:
+        """Promote a finished attempt (result = (attempt_dir, recs))
+        to `committed-<task>` with one atomic rename. First commit
+        wins: a racing duplicate attempt loses the rename, its staging
+        is discarded, and its files never reach the manifest. Stats
+        are applied only for the winner (exactly-once counting)."""
+        from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.runtime import backoff
+
+        adir, recs = result
+        dst = os.path.join(self.staging, f"committed-{task:05d}")
+        with self._lock:
+            if task in self._tasks:  # in-process duplicate commit
+                shutil.rmtree(adir, ignore_errors=True)
+                return False
+
+        def _promote():
+            if os.path.isdir(dst):
+                return False
+            try:
+                os.rename(adir, dst)
+            except OSError:
+                if os.path.isdir(dst):
+                    return False  # lost the race cross-process
+                raise
+            _fsync_dir(self.staging)
+            return True
+
+        won = backoff.retry_io(_promote,
+                               what=f"commit task {task} of job "
+                                    f"{self.job_id}",
+                               site="commit.task")
+        if not won:
+            shutil.rmtree(adir, ignore_errors=True)
+            return False
+        with self._lock:
+            self._tasks[task] = list(recs)
+        if stats is not None:
+            for r in recs:
+                stats.file_written(os.path.join(dst, r["path"]),
+                                   r["rows"], nbytes=r["bytes"])
+        obs_events.emit("write.task", jobId=self.job_id, task=task,
+                        files=len(recs),
+                        bytes=sum(r["bytes"] for r in recs),
+                        rows=sum(r["rows"] for r in recs))
+        return True
+
+    def abort_task(self, task: int, attempt) -> None:
+        """Discard a losing/failed attempt's staging. Idempotent."""
+        shutil.rmtree(os.path.join(
+            self.staging, f"task-{task:05d}-{attempt}"),
+            ignore_errors=True)
+
+    # --- job phase ---
+
+    def commit_job(self) -> dict:
+        """Publish every committed task atomically and return the
+        manifest. Retried as a unit at chaos site `commit.job`; every
+        step before the overwrite swap is restart-safe, and nothing is
+        reader-visible until it runs."""
+        from spark_rapids_tpu.obs import events as obs_events
+        from spark_rapids_tpu.obs import telemetry
+        from spark_rapids_tpu.runtime import backoff
+
+        t0 = time.perf_counter()
+        with self._lock:
+            files = [dict(r) for t in sorted(self._tasks)
+                     for r in self._tasks[t]]
+        manifest = {
+            "jobId": self.job_id, "format": self.fmt,
+            "mode": self.mode, "partitionBy": self.partition_by,
+            "tasks": len(self._tasks), "ts": time.time(),
+            "files": [{k: r[k] for k in
+                       ("path", "bytes", "rows", "crc32")}
+                      for r in files],
+        }
+        swap = self.mode == "overwrite" and \
+            bool(visible_entries(self.path))
+
+        def _publish():
+            if swap:
+                self._publish_swap(manifest)
+            else:
+                self._publish_in_place(manifest)
+
+        try:
+            backoff.retry_io(
+                _publish, what=f"commit write job {self.job_id}",
+                site="commit.job")
+        except BaseException:
+            self.abort_job(reason="job commit failed")
+            raise
+        self._done = True
+        self.commit_ms = round((time.perf_counter() - t0) * 1000, 3)
+        nbytes = sum(r["bytes"] for r in files)
+        nrows = sum(r["rows"] for r in files)
+        _add_totals(jobs=1, files=len(files), bytes=nbytes, rows=nrows,
+                    commitMs=self.commit_ms)
+        telemetry.record_write(bytes=nbytes, files=len(files),
+                               rows=nrows, jobs=1,
+                               commitMs=int(self.commit_ms))
+        obs_events.emit("write.commit", jobId=self.job_id,
+                        files=len(files), bytes=nbytes, rows=nrows,
+                        commitMs=self.commit_ms, swapped=swap)
+        return manifest
+
+    def _manifest_into(self, d: str, manifest: dict) -> None:
+        from spark_rapids_tpu.config import rapids_conf as rc
+
+        if not self._conf(rc.WRITE_MANIFEST_ENABLED):
+            return
+        target = os.path.join(d, MANIFEST)
+        tmp = target + f".inprogress-{uuid.uuid4().hex[:8]}"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        _fsync_file(tmp)
+        os.replace(tmp, target)
+        _fsync_dir(d)
+
+    def _move_committed(self, dest_root: str) -> None:
+        """Move every committed task's files under dest_root with
+        per-file atomic renames. Restart-safe: a file already at its
+        destination (prior attempt of this publish) is skipped."""
+        with self._lock:
+            items = [(t, r) for t in sorted(self._tasks)
+                     for r in self._tasks[t]]
+        for task, rec in items:
+            src = os.path.join(self.staging, f"committed-{task:05d}",
+                               rec["path"])
+            dst = os.path.join(dest_root, rec["path"])
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            if os.path.exists(src):
+                os.replace(src, dst)
+            elif not os.path.exists(dst):
+                raise FileNotFoundError(
+                    f"committed file lost from staging: {src}")
+        _fsync_dir(dest_root)
+
+    def _publish_in_place(self, manifest: dict) -> None:
+        os.makedirs(self.path, exist_ok=True)
+        self._move_committed(self.path)
+        # _SUCCESS LAST: its appearance means every listed file is
+        # complete and in place
+        self._manifest_into(self.path, manifest)
+        self._cleanup_staging()
+
+    def _publish_swap(self, manifest: dict) -> None:
+        """Deferred overwrite: assemble the full new tree in a sibling
+        dir, then swap directories. Old data stays intact (and
+        reader-visible) until the swap instant. Restart-safe under the
+        commit.job retry loop: already-moved files are skipped and the
+        swap itself runs at most once."""
+        new_dir = self.path + _NEW_TAG + self.job_id
+        old_dir = self.path + _OLD_TAG + self.job_id
+        if not self._swapped:
+            os.makedirs(new_dir, exist_ok=True)
+            self._move_committed(new_dir)
+            self._manifest_into(new_dir, manifest)
+            # two renames; sweep_orphans restores .__old if a crash
+            # lands between them (the output dir briefly not existing
+            # is the one window readers must tolerate)
+            if os.path.exists(self.path):
+                os.rename(self.path, old_dir)  # carries _temporary
+            os.rename(new_dir, self.path)
+            self._swapped = True
+            _fsync_dir(os.path.dirname(self.path))
+        shutil.rmtree(old_dir, ignore_errors=True)
+
+    def _cleanup_staging(self) -> None:
+        shutil.rmtree(self.staging, ignore_errors=True)
+        tmp_root = os.path.join(self.path, TEMP_DIR)
+        try:
+            os.rmdir(tmp_root)  # only if no other job is staging
+        except OSError:
+            pass
+
+    def abort_job(self, reason: str = "aborted") -> None:
+        """Unwind leak-free: staging and any half-built .__new sibling
+        vanish; published/pre-existing output is never touched.
+        Idempotent — a failed commit_job aborts itself and the caller's
+        unwinding may abort again."""
+        from spark_rapids_tpu.obs import events as obs_events
+
+        if self._done or self._aborted:
+            return
+        self._aborted = True
+        old_dir = self.path + _OLD_TAG + self.job_id
+        if not self._swapped and os.path.isdir(old_dir) and \
+                not os.path.exists(self.path):
+            # failed between the swap renames: the old tree IS the data
+            os.rename(old_dir, self.path)
+        shutil.rmtree(self.path + _NEW_TAG + self.job_id,
+                      ignore_errors=True)
+        self._cleanup_staging()
+        _add_totals(aborts=1)
+        obs_events.emit("write.abort", jobId=self.job_id, reason=reason)
+
+
+# -------------------------------------------------------- orphan sweep
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except (PermissionError, OSError):
+        return True
+    return True
+
+
+def _newest_mtime(root: str) -> float:
+    newest = 0.0
+    for dirpath, _dirs, names in os.walk(root):
+        for n in names + [os.path.basename(dirpath)]:
+            try:
+                newest = max(newest, os.path.getmtime(
+                    os.path.join(dirpath, n)))
+            except OSError:
+                pass
+    return newest
+
+
+def _job_live(job_dir: str, ttl_s: float) -> bool:
+    """Is this staging dir owned by a live job? Owner pid on this host
+    decides outright; otherwise (foreign host, unreadable marker) age
+    under the TTL is treated as live — the sweep NEVER takes a dir it
+    cannot prove dead or expired."""
+    try:
+        with open(os.path.join(job_dir, OWNER_FILE)) as f:
+            owner = json.load(f)
+        if owner.get("host") == socket.gethostname():
+            return _pid_alive(int(owner["pid"]))
+    except (OSError, ValueError, KeyError, TypeError):
+        pass
+    return time.time() - _newest_mtime(job_dir) < ttl_s
+
+
+def sweep_orphans(path: str, ttl_s: Optional[float] = None,
+                  conf=None) -> int:
+    """Startup sweep (run at every job setup, callable standalone):
+    reclaim `_temporary/<jobId>` staging left by dead processes and
+    crashed overwrite-swap debris (`.__new-*` siblings; a `.__old-*`
+    with no surviving output dir is RESTORED, not deleted — that is
+    the pre-overwrite data after a crash between the swap's two
+    renames). Live jobs — owner pid alive, or age within the TTL —
+    are never touched. Returns the number of dirs reclaimed."""
+    if ttl_s is None:
+        from spark_rapids_tpu.config import rapids_conf as rc
+
+        ttl_s = (conf.get(rc.WRITE_SWEEP_TTL_S) if conf is not None
+                 else rc.WRITE_SWEEP_TTL_S.default)
+    path = os.path.abspath(path)
+    swept = 0
+    tmp_root = os.path.join(path, TEMP_DIR)
+    if os.path.isdir(tmp_root):
+        for name in sorted(os.listdir(tmp_root)):
+            job_dir = os.path.join(tmp_root, name)
+            if not os.path.isdir(job_dir) or _job_live(job_dir, ttl_s):
+                continue
+            shutil.rmtree(job_dir, ignore_errors=True)
+            swept += 1
+        try:
+            os.rmdir(tmp_root)
+        except OSError:
+            pass
+    parent, base = os.path.split(path)
+    if os.path.isdir(parent):
+        for name in sorted(os.listdir(parent)):
+            full = os.path.join(parent, name)
+            if name.startswith(base + _OLD_TAG):
+                if not os.path.exists(path):
+                    # crash between the swap renames: the old tree IS
+                    # the data — put it back
+                    os.rename(full, path)
+                    swept += 1
+                elif not _job_live(full, ttl_s):
+                    shutil.rmtree(full, ignore_errors=True)
+                    swept += 1
+            elif name.startswith(base + _NEW_TAG) and \
+                    not _job_live(full, ttl_s):
+                shutil.rmtree(full, ignore_errors=True)
+                swept += 1
+    return swept
+
+
+# ------------------------------------------------------ reader surface
+
+def read_manifest(path: str) -> Optional[dict]:
+    try:
+        with open(os.path.join(path, MANIFEST)) as f:
+            return json.load(f)
+    except OSError:
+        return None
+    except ValueError as e:
+        raise ManifestMismatch(
+            f"unreadable manifest {os.path.join(path, MANIFEST)}: {e}")
+
+
+def validate_output(path: str, check_crc: bool = True) -> int:
+    """Verify a committed directory against its _SUCCESS manifest:
+    every listed file present with the recorded size (and crc32 when
+    `check_crc`). Returns the number of files verified; raises
+    ManifestMismatch on any drift. No-op (0) without a manifest."""
+    manifest = read_manifest(path)
+    if manifest is None:
+        return 0
+    for rec in manifest.get("files", ()):
+        full = os.path.join(path, rec["path"])
+        try:
+            size = os.path.getsize(full)
+        except OSError:
+            raise ManifestMismatch(
+                f"{path}: manifest file missing: {rec['path']}")
+        if size != rec["bytes"]:
+            raise ManifestMismatch(
+                f"{path}: size drift on {rec['path']}: "
+                f"{size} != {rec['bytes']}")
+        if check_crc and _crc32(full) != rec["crc32"]:
+            raise ManifestMismatch(
+                f"{path}: checksum drift on {rec['path']}")
+    return len(manifest.get("files", ()))
+
+
+# ------------------------------------------- process-pool write fragment
+
+def run_write_fragment(spec: dict):
+    """Picklable write-task lineage fragment (the
+    run_scan_agg_fragment shape, parallel/process_pool.py): read a row
+    slice of the job's source parquet, stage it into a fresh
+    worker-unique attempt dir under the job's staging root, and return
+    (attempt_dir, records) for the driver's commit_task. A kill -9
+    mid-write leaves only staging debris the job commit never
+    publishes and the orphan sweep reclaims."""
+    import pyarrow.parquet as pq
+
+    from spark_rapids_tpu.io.writers import write_task
+
+    if spec.get("sleep_s"):  # test hook: hold the worker mid-task so
+        from spark_rapids_tpu.runtime.cancellation import (  # noqa: I001
+            sleep_interruptible,
+        )
+
+        sleep_interruptible(float(spec["sleep_s"]))  # kill lands in-flight
+    table = pq.read_table(spec["src"])
+    piece = table.slice(int(spec["offset"]), int(spec["count"]))
+    adir = os.path.join(
+        spec["staging"],
+        f"task-{int(spec['task']):05d}-w{os.getpid()}."
+        f"{uuid.uuid4().hex[:8]}")
+    os.makedirs(adir, exist_ok=True)
+    recs: List[dict] = []
+
+    def stage(rel, write_fn, rows):
+        recs.append(stage_file(adir, rel, rows, write_fn))
+
+    write_task(spec["fmt"], piece, adir, int(spec["task"]),
+               spec.get("partition_by"), None,
+               options=spec.get("options"), stage=stage,
+               file_tag=spec.get("file_tag", ""))
+    return adir, recs

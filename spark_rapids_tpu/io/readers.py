@@ -75,14 +75,42 @@ def resolve_input_paths(paths: List[str]) -> List[str]:
     return filecache.localize_paths(paths)
 
 
+def _hidden(base: str, f: str) -> bool:
+    """Spark's hidden-file convention: any path segment below the
+    scanned root starting with `_` or `.` is invisible to scans —
+    which is what keeps the commit protocol's `_temporary/<jobId>`
+    staging (io/commit.py), `_SUCCESS` manifests and `_delta_log`
+    dirs out of a directory read while a write is in flight."""
+    rel = os.path.relpath(f, base)
+    return any(seg.startswith(("_", "."))
+               for seg in rel.split(os.sep))
+
+
+def _maybe_validate_manifest(p: str) -> None:
+    """spark.rapids.tpu.write.manifest.validateOnRead: before a
+    directory scan plans, check its files against the _SUCCESS
+    manifest the commit protocol published (sizes + crc32) — torn
+    output raises ManifestMismatch instead of decoding garbage."""
+    from spark_rapids_tpu.api.session import TpuSparkSession
+    from spark_rapids_tpu.config import rapids_conf as rc
+
+    s = TpuSparkSession.active()
+    if s is None or not s.rapids_conf.get(rc.WRITE_VALIDATE_ON_READ):
+        return
+    from spark_rapids_tpu.io import commit as iocommit
+
+    iocommit.validate_output(p)
+
+
 def expand_paths(paths: List[str], suffix: str) -> List[str]:
     out: List[str] = []
     for p in resolve_input_paths(paths):
         if os.path.isdir(p):
+            _maybe_validate_manifest(p)
             out.extend(sorted(
                 f for f in globlib.glob(os.path.join(p, "**", "*"),
                                         recursive=True)
-                if f.endswith(suffix)))
+                if f.endswith(suffix) and not _hidden(p, f)))
         elif any(ch in p for ch in "*?["):
             out.extend(sorted(globlib.glob(p)))
         else:
@@ -440,6 +468,10 @@ def discover_partitions(files: List[str],
         for seg in below_base(f).split(os.sep)[:-1]:
             if "=" in seg and not seg.startswith("="):
                 k, _, v = seg.partition("=")
+                # symmetric with the write-side escaping
+                # (io/writers.py escape_partition_value): both the
+                # column name and the value are percent-decoded
+                k = urllib.parse.unquote(k)
                 vals[k] = urllib.parse.unquote(v)
                 if k not in col_order:
                     col_order.append(k)

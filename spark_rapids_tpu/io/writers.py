@@ -3,39 +3,64 @@ GpuFileFormatDataWriter analog (reference ColumnarOutputWriter.scala:251,
 GpuFileFormatDataWriter.scala, GpuWriteStatsTracker.scala).
 
 One output file per task partition (part-{pid:05d}); hive-style
-`partitionBy` directory layout (`col=value/`); per-job stats trackers
-(files/rows/bytes) the caller can surface as metrics.
+`partitionBy` directory layout (`col=value/`, values percent-escaped
+like Spark's ExternalCatalogUtils so `/`, `=` and `%` round-trip);
+per-job stats trackers (files/rows/bytes) the caller can surface as
+metrics. Durability — staging dirs, fsync+atomic-rename, task/job
+commit — lives in io/commit.py; `write_task` hands each physical file
+to the committer through the `stage` callback when one is given.
 """
 
 from __future__ import annotations
 
 import os
-import shutil
 import threading
-from typing import Dict, List, Optional
+import urllib.parse
+from typing import Callable, Dict, List, Optional
 
 import pyarrow as pa
 import pyarrow.csv as pa_csv
 import pyarrow.parquet as pq
 
+HIVE_DEFAULT_PARTITION = "__HIVE_DEFAULT_PARTITION__"
+
+
+def escape_partition_value(v) -> str:
+    """Hive-layout directory segment for one partition value: percent-
+    encoding (ExternalCatalogUtils.escapePathName role) so separators
+    and escape chars (`/`, `=`, `%`, ...) produce a flat, decodable
+    segment instead of a traversing/broken layout. The read side
+    (io/readers.py discover_partitions) unquotes symmetrically."""
+    if v is None:
+        return HIVE_DEFAULT_PARTITION
+    return urllib.parse.quote(str(v), safe="")
+
 
 class WriteStats:
-    """GpuWriteStatsTracker analog."""
+    """GpuWriteStatsTracker analog. Sizes are recorded at staged-rename
+    time (io/commit.py), where the file is guaranteed present —
+    `stat_failures` counts the legacy stat-at-write path's misses
+    instead of silently dropping them."""
 
     def __init__(self):
         self.num_files = 0
         self.num_rows = 0
         self.num_bytes = 0
+        self.stat_failures = 0
         self._lock = threading.Lock()
 
-    def file_written(self, path: str, rows: int):
+    def file_written(self, path: str, rows: int,
+                     nbytes: Optional[int] = None):
         with self._lock:
             self.num_files += 1
             self.num_rows += rows
-            try:
-                self.num_bytes += os.path.getsize(path)
-            except OSError:
-                pass
+            if nbytes is None:
+                try:
+                    nbytes = os.path.getsize(path)
+                except OSError:
+                    self.stat_failures += 1
+                    return
+            self.num_bytes += int(nbytes)
 
 
 _KNOWN_OPTIONS = {
@@ -48,15 +73,23 @@ _KNOWN_OPTIONS = {
 }
 
 
-def _write_one(fmt: str, table: pa.Table, path: str,
-               options: Optional[Dict] = None):
-    options = options or {}
-    unknown = set(options) - _KNOWN_OPTIONS.get(fmt, set())
-    if unknown:
-        import warnings
+def unknown_options(fmt: str, options: Optional[Dict]) -> List[str]:
+    """Writer options the format sink will ignore — checked ONCE per
+    job by the committer (emitted as a single write.options event)
+    rather than warned per file."""
+    return sorted(set(options or {}) - _KNOWN_OPTIONS.get(fmt, set()))
 
-        warnings.warn(f"ignoring unsupported {fmt} writer options: "
-                      f"{sorted(unknown)}")
+
+def _write_one(fmt: str, table: pa.Table, path: str,
+               options: Optional[Dict] = None, warn: bool = True):
+    options = options or {}
+    if warn:
+        unknown = unknown_options(fmt, options)
+        if unknown:
+            import warnings
+
+            warnings.warn(f"ignoring unsupported {fmt} writer options: "
+                          f"{unknown}")
     if fmt == "parquet":
         kw = {k: options[k] for k in ("compression", "row_group_size")
               if k in options}
@@ -95,13 +128,17 @@ _EXT = {"parquet": ".parquet", "orc": ".orc", "csv": ".csv",
 
 
 def prepare_dir(path: str, mode: str):
-    if os.path.exists(path):
-        if mode == "overwrite":
-            shutil.rmtree(path)
-        elif mode == "error":
+    """Mode gate ONLY — `overwrite` no longer destroys here: existing
+    data survives until a job commit succeeds, when the deferred swap
+    (io/commit.py commit_job) atomically replaces it. Returns False
+    when mode=ignore should skip the write."""
+    from spark_rapids_tpu.io.commit import visible_entries
+
+    if os.path.isdir(path) and visible_entries(path):
+        if mode == "error":
             raise FileExistsError(
                 f"path {path} already exists (mode=error)")
-        elif mode == "ignore":
+        if mode == "ignore":
             return False
     os.makedirs(path, exist_ok=True)
     return True
@@ -109,20 +146,38 @@ def prepare_dir(path: str, mode: str):
 
 def write_task(fmt: str, table: pa.Table, out_dir: str, pid: int,
                partition_by: Optional[List[str]],
-               stats: WriteStats,
-               options: Optional[Dict] = None) -> None:
+               stats: Optional[WriteStats],
+               options: Optional[Dict] = None,
+               stage: Optional[Callable] = None,
+               file_tag: str = "") -> None:
     """Write one task partition's data (GpuDynamicPartitionDataWriter
-    when partition_by is set)."""
+    when partition_by is set). With `stage(rel_path, write_fn, rows)`
+    the physical write is delegated to the commit protocol (tmp +
+    fsync + atomic rename into the attempt's staging dir, sizes
+    recorded post-rename); without it, files land directly in
+    `out_dir` (legacy path — stats stat the file after the write).
+    `file_tag` (the committer's job id) makes part-file names unique
+    across jobs so append mode and concurrent writers never collide."""
+    tag = f"-{file_tag}" if file_tag else ""
+    fname = f"part-{pid:05d}{tag}{_EXT[fmt]}"
+
+    def put(rel: str, piece: pa.Table):
+        if stage is not None:
+            stage(rel, lambda p: _write_one(fmt, piece, p, options,
+                                            warn=False), piece.num_rows)
+            return
+        path = os.path.join(out_dir, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        _write_one(fmt, piece, path, options)
+        if stats is not None:
+            stats.file_written(path, piece.num_rows)
+
     if table.num_rows == 0:
         return
     if not partition_by:
-        path = os.path.join(out_dir, f"part-{pid:05d}{_EXT[fmt]}")
-        _write_one(fmt, table, path, options)
-        stats.file_written(path, table.num_rows)
+        put(fname, table)
         return
     # hive-style dynamic partitioning: group rows by partition tuple
-    import pyarrow.compute as pc
-
     keys = [table.column(c) for c in partition_by]
     data_cols = [c for c in table.column_names if c not in partition_by]
     combos: Dict[tuple, List[int]] = {}
@@ -131,11 +186,7 @@ def write_task(fmt: str, table: pa.Table, out_dir: str, pid: int,
         combos.setdefault(combo, []).append(i)
     for combo, idxs in combos.items():
         sub = table.take(pa.array(idxs)).select(data_cols)
-        parts = [
-            f"{c}={'__HIVE_DEFAULT_PARTITION__' if v is None else v}"
-            for c, v in zip(partition_by, combo)]
-        d = os.path.join(out_dir, *parts)
-        os.makedirs(d, exist_ok=True)
-        path = os.path.join(d, f"part-{pid:05d}{_EXT[fmt]}")
-        _write_one(fmt, sub, path, options)
-        stats.file_written(path, sub.num_rows)
+        parts = [f"{escape_partition_value(c)}="
+                 f"{escape_partition_value(v)}"
+                 for c, v in zip(partition_by, combo)]
+        put(os.path.join(*parts, fname), sub)

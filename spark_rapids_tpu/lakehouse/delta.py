@@ -367,23 +367,48 @@ def _ckpt_interval(session) -> Optional[int]:
     return c.get(rc.DELTA_CHECKPOINT_INTERVAL) if c is not None else None
 
 
+class DeltaCommitConflict(RuntimeError):
+    """Another writer claimed this log version first. RETRYABLE: the
+    optimistic-transaction loop (_commit_txn) re-reads the snapshot,
+    re-runs conflict semantics and re-claims the next version."""
+
+    def __init__(self, table_path: str, version: int):
+        self.version = version
+        super().__init__(
+            f"concurrent commit conflict at version {version} "
+            f"of {table_path}")
+
+
+class DeltaConcurrentModification(RuntimeError):
+    """A concurrent commit invalidated what this transaction READ
+    (files it rewrites were removed, or a blind overwrite raced new
+    data it cannot preserve). NOT retryable — retrying would silently
+    drop the other writer's rows; the caller must re-run its DML
+    against the new snapshot."""
+
+
 def _commit(table_path: str, version: int, actions: List[dict],
             checkpoint_interval: Optional[int] = None):
-    """Write one atomic commit file (OptimisticTransaction.commit);
-    every CHECKPOINT_INTERVAL versions also writes a parquet checkpoint
-    + _last_checkpoint pointer so log replay stays O(interval)."""
+    """Write one atomic commit file (OptimisticTransaction.commit's
+    write path): the full content lands fsync'd in a tmp file, then an
+    O_EXCL-equivalent hard link claims the version — exactly one
+    writer wins a given version, and a claimed commit file is never
+    partial. Every CHECKPOINT_INTERVAL versions also writes a parquet
+    checkpoint + _last_checkpoint pointer so log replay stays
+    O(interval)."""
     os.makedirs(_log_path(table_path), exist_ok=True)
     target = _commit_file(table_path, version)
     tmp = target + f".tmp-{uuid.uuid4().hex[:8]}"
     with open(tmp, "w") as f:
         for a in actions:
             f.write(json.dumps(a) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
     try:
         os.link(tmp, target)  # fails if the version already exists
     except FileExistsError:
         os.unlink(tmp)
-        raise RuntimeError(
-            f"concurrent commit conflict at version {version}")
+        raise DeltaCommitConflict(table_path, version)
     os.unlink(tmp)
     if checkpoint_interval is None:
         checkpoint_interval = CHECKPOINT_INTERVAL
@@ -391,6 +416,83 @@ def _commit(table_path: str, version: int, actions: List[dict],
     if (checkpoint_interval > 0 and version > 0
             and version % checkpoint_interval == 0):
         write_checkpoint(table_path)
+
+
+def _occ_policy(session):
+    """Backoff policy for the optimistic-commit retry loop: the shared
+    delay curve (io.retry.backoffMs) with its own attempt budget
+    (write.delta.commitAttempts)."""
+    from spark_rapids_tpu.config import rapids_conf as rc
+    from spark_rapids_tpu.runtime import backoff
+
+    c = getattr(session, "rapids_conf", None)
+
+    def get(entry):
+        return c.get(entry) if c is not None else entry.default
+
+    return backoff.BackoffPolicy(get(rc.WRITE_DELTA_COMMIT_ATTEMPTS),
+                                 get(rc.IO_RETRY_BACKOFF_MS),
+                                 get(rc.IO_RETRY_MAX_BACKOFF_MS))
+
+
+def _commit_txn(table_path: str, build, session=None,
+                what: str = "delta commit"):
+    """Optimistic transaction driver: `build()` re-reads the snapshot
+    and returns (version, actions) — or None to skip — and the claim
+    runs under the shared backoff policy at chaos site
+    `commit.conflict` (billed to the query's retry budget like every
+    other backoff site). A DeltaCommitConflict loser re-enters build()
+    against the NEW snapshot; DeltaConcurrentModification (conflict
+    semantics say retrying would lose data) fails immediately."""
+    from spark_rapids_tpu.obs import events as obs_events
+    from spark_rapids_tpu.runtime import backoff
+
+    def attempt():
+        built = build()
+        if built is None:
+            return None
+        version, actions = built
+        _commit(table_path, version, actions, _ckpt_interval(session))
+        return version
+
+    def on_retry(err):
+        from spark_rapids_tpu.io import commit as iocommit
+
+        iocommit.note_conflict()
+        obs_events.emit("write.conflict", path=table_path,
+                        kind="delta", error=str(err)[:200])
+
+    return backoff.retry_io(
+        attempt, what=what, site="commit.conflict",
+        retry_on=(DeltaCommitConflict,),
+        no_retry=(DeltaConcurrentModification,),
+        policy=_occ_policy(session), counter="commit.conflict",
+        on_retry=on_retry)
+
+
+def _check_rewrite_conflict(read_version: int, cur: "Snapshot",
+                            read_set: set, full_table: bool,
+                            op: str) -> None:
+    """Append-vs-rewrite conflict semantics for a read-dependent
+    transaction (DML, overwrite-of-candidates) retrying on top of
+    interim commits: files this transaction read and rewrites must
+    still be live, and a FULL-table rewrite cannot preserve rows a
+    concurrent append added after its read — both raise
+    DeltaConcurrentModification. Pure concurrent appends against a
+    partial rewrite are compatible (the new files stay live alongside
+    the rewrite)."""
+    live = set(cur.file_paths)
+    gone = read_set - live
+    if gone:
+        raise DeltaConcurrentModification(
+            f"{op}: {len(gone)} file(s) this transaction read at "
+            f"version {read_version} were removed by a concurrent "
+            f"commit (now at {cur.version}): {sorted(gone)[:3]}")
+    if full_table and (live - read_set):
+        raise DeltaConcurrentModification(
+            f"{op}: a concurrent commit added files after this "
+            f"full-table transaction's read at version "
+            f"{read_version}; retrying would drop those rows")
 
 
 _CP_MAP = pa.map_(pa.string(), pa.string())
@@ -560,51 +662,79 @@ def write_delta(df, path: str, mode: str = "error",
         raise NotImplementedError(
             "partitioned Delta writes are a follow-up")
     table = df.collect_arrow()
-    exists = bool(_list_versions(path)) or os.path.isdir(_log_path(path))
-    if exists and mode == "error":
+    session = getattr(df, "session", None)
+    existed = bool(_list_versions(path)) or os.path.isdir(_log_path(path))
+    if existed and mode == "error":
         raise FileExistsError(f"Delta table {path} exists (mode=error)")
-    if exists and mode == "ignore":
+    if existed and mode == "ignore":
         return
     os.makedirs(path, exist_ok=True)
-    actions: List[dict] = []
-    if not exists:
-        version = 0
-        actions.append(_meta_action(table.schema, [], properties))
-        if properties and properties.get(
-                "delta.enableDeletionVectors", "").lower() == "true":
-            actions.append({"protocol": {
-                "minReaderVersion": 3, "minWriterVersion": 7,
-                "readerFeatures": ["deletionVectors"],
-                "writerFeatures": ["deletionVectors"]}})
-    else:
-        snap = load_snapshot(path)
-        version = snap.version + 1
-        merged = {**snap.config, **(properties or {})}
-        if mode == "overwrite":
-            ts = int(time.time() * 1000)
-            actions.append(_meta_action(table.schema, [], merged,
-                                        table_id=snap.meta_id))
-            for p in snap.file_paths:
-                actions.append({"remove": {
-                    "path": p, "deletionTimestamp": ts,
-                    "dataChange": True}})
-        elif properties:
-            # append with new properties: a metaData action carrying
-            # the merged configuration (schema unchanged)
-            meta = _meta_action(table.schema, list(snap.partition_cols),
-                                merged, table_id=snap.meta_id)
-            if snap.schema_json is not None:
-                meta["metaData"]["schemaString"] = json.dumps(
-                    snap.schema_json)
-            actions.append(meta)
-    actions.extend(_write_data_files(table, path))
-    actions.append({"commitInfo": {
-        "timestamp": int(time.time() * 1000),
-        "operation": "WRITE",
-        "operationParameters": {"mode": mode.upper()},
-    }})
-    _commit(path, version, actions,
-            _ckpt_interval(getattr(df, "session", None)))
+    # data files land ONCE, before the optimistic loop: their names are
+    # uuid-unique so the same add actions are safe to re-offer on every
+    # commit attempt — only the log claim retries
+    adds = _write_data_files(table, path)
+
+    def build():
+        actions: List[dict] = []
+        now_exists = bool(_list_versions(path))
+        if now_exists and not existed:
+            # creation race: someone committed version 0 between our
+            # pre-check and the claim
+            if mode == "error":
+                raise DeltaConcurrentModification(
+                    f"Delta table {path} was created concurrently "
+                    f"(mode=error)")
+            if mode == "ignore":
+                for a in adds:  # our staged data files are now orphans
+                    try:
+                        os.unlink(os.path.join(path, a["add"]["path"]))
+                    except OSError:
+                        pass
+                return None
+        if not now_exists:
+            version = 0
+            actions.append(_meta_action(table.schema, [], properties))
+            if properties and properties.get(
+                    "delta.enableDeletionVectors", "").lower() == "true":
+                actions.append({"protocol": {
+                    "minReaderVersion": 3, "minWriterVersion": 7,
+                    "readerFeatures": ["deletionVectors"],
+                    "writerFeatures": ["deletionVectors"]}})
+        else:
+            snap = load_snapshot(path)
+            version = snap.version + 1
+            merged = {**snap.config, **(properties or {})}
+            if mode == "overwrite":
+                # removes are recomputed from the FRESH snapshot each
+                # attempt, so a lost race replaces the other writer's
+                # output too: last-overwrite-wins (documented in
+                # docs/writes.md)
+                ts = int(time.time() * 1000)
+                actions.append(_meta_action(table.schema, [], merged,
+                                            table_id=snap.meta_id))
+                for p in snap.file_paths:
+                    actions.append({"remove": {
+                        "path": p, "deletionTimestamp": ts,
+                        "dataChange": True}})
+            elif properties:
+                # append with new properties: a metaData action carrying
+                # the merged configuration (schema unchanged)
+                meta = _meta_action(table.schema,
+                                    list(snap.partition_cols),
+                                    merged, table_id=snap.meta_id)
+                if snap.schema_json is not None:
+                    meta["metaData"]["schemaString"] = json.dumps(
+                        snap.schema_json)
+                actions.append(meta)
+        actions.extend(adds)
+        actions.append({"commitInfo": {
+            "timestamp": int(time.time() * 1000),
+            "operation": "WRITE",
+            "operationParameters": {"mode": mode.upper()},
+        }})
+        return version, actions
+
+    _commit_txn(path, build, session, what=f"delta write ({mode})")
 
 
 # ------------------------------------------------- merge / delete / update
@@ -914,8 +1044,27 @@ class DeltaTable:
             "timestamp": ts, "operation": "DELETE",
             "operationParameters": {"deletionVectors": True},
             "readVersion": snap.version}})
-        _commit(self.path, snap.version + 1, actions,
-                _ckpt_interval(self.session))
+        read_set = set(fully_deleted) | set(descs)
+
+        def build():
+            cur = load_snapshot(self.path)
+            if cur.version != snap.version:
+                _check_rewrite_conflict(snap.version, cur, read_set,
+                                        False, "DELETE(dv)")
+                for rel in descs:
+                    # the DV we unioned with must still be the one on
+                    # the table: an interim commit that re-vectored the
+                    # file would be silently undone by our stale add
+                    if (cur.files[rel].get("deletionVector")
+                            != snap.files[rel].get("deletionVector")):
+                        raise DeltaConcurrentModification(
+                            f"DELETE(dv): deletion vector of {rel} "
+                            f"changed concurrently (read version "
+                            f"{snap.version}, now {cur.version})")
+            return cur.version + 1, actions
+
+        _commit_txn(self.path, build, self.session,
+                    what="delta delete (dv)")
 
     def update(self, condition, set_exprs: Dict[str, object]):
         """UPDATE target SET col = expr WHERE condition — candidate
@@ -948,13 +1097,19 @@ class DeltaTable:
                  snap: Optional[Snapshot] = None,
                  only_files: Optional[List[str]] = None):
         """Commit remove(only_files or all) + add(new files). Files not
-        in only_files keep their add actions (file-level pruning)."""
+        in only_files keep their add actions (file-level pruning).
+        Optimistic: the claim retries under commit.conflict, and each
+        retry re-checks that the files this rewrite READ are still live
+        (and, for a full-table rewrite, that nothing was appended)."""
         if snap is None:
             snap = load_snapshot(self.path)
         ts = int(time.time() * 1000)
+        full_table = only_files is None
+        removes = list(only_files) if only_files is not None \
+            else list(snap.file_paths)
+        read_set = set(removes)
         actions: List[dict] = []
-        for p in (only_files if only_files is not None
-                  else snap.file_paths):
+        for p in removes:
             actions.append({"remove": {
                 "path": p, "deletionTimestamp": ts, "dataChange": True}})
         actions.extend(_write_data_files(table, self.path))
@@ -964,8 +1119,16 @@ class DeltaTable:
             "readVersion": snap.version,
             "prunedFiles": (len(snap.file_paths) - len(only_files))
             if only_files is not None else 0}})
-        _commit(self.path, snap.version + 1, actions,
-                _ckpt_interval(self.session))
+
+        def build():
+            cur = load_snapshot(self.path)
+            if cur.version != snap.version:
+                _check_rewrite_conflict(snap.version, cur, read_set,
+                                        full_table, op)
+            return cur.version + 1, actions
+
+        _commit_txn(self.path, build, self.session,
+                    what=f"delta {op.lower()}")
 
 
 class DeltaOptimizeBuilder:

@@ -36,6 +36,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import uuid
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -57,6 +58,12 @@ class IcebergError(Exception):
     pass
 
 
+class IcebergCommitConflict(IcebergError):
+    """Another writer claimed the next metadata version first.
+    RETRYABLE: commit_metadata re-reads the now-current metadata and
+    re-runs the caller's build() against it."""
+
+
 def _ice_type_to_arrow(t) -> pa.DataType:
     if isinstance(t, str):
         if t in _ICE_PRIMS:
@@ -73,21 +80,95 @@ def _ice_type_to_arrow(t) -> pa.DataType:
     raise IcebergError(f"iceberg type {t!r}")
 
 
+def _scan_version(mdir: str) -> int:
+    """Highest committed vN.metadata.json by DIRECTORY SCAN — the
+    source of truth for the current version. version-hint.text is only
+    an advisory fast path: a writer that crashed between claiming the
+    metadata file and replacing the hint leaves the hint one behind."""
+    try:
+        names = os.listdir(mdir)
+    except FileNotFoundError:
+        return 0
+    return max((int(f[1:].split(".")[0]) for f in names
+                if re.match(r"v\d+\.metadata\.json$", f)), default=0)
+
+
 def _load_metadata(table_path: str) -> dict:
     mdir = os.path.join(table_path, "metadata")
     hint = os.path.join(mdir, "version-hint.text")
+    v = _scan_version(mdir)
     if os.path.exists(hint):
-        v = int(open(hint).read().strip())
-        path = os.path.join(mdir, f"v{v}.metadata.json")
-    else:
-        cands = [f for f in os.listdir(mdir)
-                 if re.match(r"v\d+\.metadata\.json$", f)]
-        if not cands:
-            raise IcebergError(f"{table_path}: no iceberg metadata")
-        path = os.path.join(
-            mdir, max(cands, key=lambda f: int(f[1:].split(".")[0])))
-    with open(path) as f:
+        # a stale hint (crash before the hint replace) must not hide a
+        # claimed commit: take the newer of hint and scan
+        v = max(v, int(open(hint).read().strip()))
+    if v <= 0:
+        raise IcebergError(f"{table_path}: no iceberg metadata")
+    with open(os.path.join(mdir, f"v{v}.metadata.json")) as f:
         return json.load(f)
+
+
+def commit_metadata(table_path: str, build, session=None,
+                    what: str = "iceberg commit"):
+    """Optimistic metadata-version swap (the HadoopTableOperations
+    commit analog). `build(current_meta_or_None)` returns the full new
+    metadata dict — or None to skip — and the next version file
+    v{N+1}.metadata.json is claimed with an O_EXCL-equivalent hard
+    link of an fsync'd tmp file: exactly one writer wins a version and
+    a claimed file is never partial. The loser re-reads the NEW
+    current metadata and re-runs build() under the shared backoff
+    policy at chaos site commit.conflict; version-hint.text is
+    replaced atomically afterwards (advisory — readers fall back to a
+    dir scan). Returns the committed version, or None if skipped."""
+    from spark_rapids_tpu.lakehouse.delta import _occ_policy
+    from spark_rapids_tpu.runtime import backoff
+
+    mdir = os.path.join(table_path, "metadata")
+    os.makedirs(mdir, exist_ok=True)
+
+    def attempt():
+        cur_v = _scan_version(mdir)
+        cur = None
+        if cur_v > 0:
+            with open(os.path.join(
+                    mdir, f"v{cur_v}.metadata.json")) as f:
+                cur = json.load(f)
+        new_meta = build(cur)
+        if new_meta is None:
+            return None
+        target = os.path.join(mdir, f"v{cur_v + 1}.metadata.json")
+        tmp = target + f".tmp-{uuid.uuid4().hex[:8]}"
+        with open(tmp, "w") as f:
+            json.dump(new_meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            os.link(tmp, target)  # fails if the version exists
+        except FileExistsError:
+            os.unlink(tmp)
+            raise IcebergCommitConflict(
+                f"concurrent iceberg commit at v{cur_v + 1} "
+                f"of {table_path}")
+        os.unlink(tmp)
+        hint = os.path.join(mdir, "version-hint.text")
+        htmp = hint + f".tmp-{uuid.uuid4().hex[:8]}"
+        with open(htmp, "w") as f:
+            f.write(str(cur_v + 1))
+        os.replace(htmp, hint)
+        return cur_v + 1
+
+    def on_retry(err):
+        from spark_rapids_tpu.io import commit as iocommit
+        from spark_rapids_tpu.obs import events as obs_events
+
+        iocommit.note_conflict()
+        obs_events.emit("write.conflict", path=table_path,
+                        kind="iceberg", error=str(err)[:200])
+
+    return backoff.retry_io(
+        attempt, what=what, site="commit.conflict",
+        retry_on=(IcebergCommitConflict,),
+        policy=_occ_policy(session), counter="commit.conflict",
+        on_retry=on_retry)
 
 
 def _resolve(table_path: str, location: str) -> str:

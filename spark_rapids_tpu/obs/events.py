@@ -100,6 +100,12 @@ EVENT_TYPES: Dict[str, str] = {
                      "inUse",
     "stream.end": "partitions, retired, recoveries, windowPeakBytes, "
                   "overlapFraction",
+    "write.start": "jobId, path, format, mode, tasks",
+    "write.task": "jobId, task, files, bytes, rows",
+    "write.commit": "jobId, files, bytes, rows, commitMs, swapped",
+    "write.abort": "jobId, reason",
+    "write.options": "format, ignored",
+    "write.conflict": "path, kind, error",
 }
 
 #: Envelope keys present on EVERY event (eventlog validation contract).

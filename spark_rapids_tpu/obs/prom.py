@@ -119,6 +119,18 @@ def _telemetry_lines() -> list:
         mname = f"{PREFIX}_hbm_{k}"
         lines.append(f"# TYPE {mname} gauge")
         lines.append(f"{mname} {_fmt_value(v)}")
+    # commit-protocol write families (io/commit.py process totals):
+    # jobs/files/bytes/rows published, cumulative job-commit wall
+    # time, aborts and lakehouse optimistic-commit conflicts
+    from spark_rapids_tpu.io import commit as _iocommit
+
+    wt = _iocommit.write_totals()
+    if wt.get("jobs") or wt.get("aborts") or wt.get("conflicts"):
+        _wname = {"commitMs": "commit_ms"}
+        for k in sorted(wt):
+            mname = f"{PREFIX}_write_{_wname.get(k, k)}_total"
+            lines.append(f"# TYPE {mname} gauge")
+            lines.append(f"{mname} {_fmt_value(wt[k])}")
     summaries = _tel.ledger.recent_query_summaries()
     if summaries:
         families: dict = {f"{PREFIX}_query_bytes_moved": [],
@@ -126,7 +138,10 @@ def _telemetry_lines() -> list:
                           f"{PREFIX}_query_roofline_frac": [],
                           f"{PREFIX}_query_stream_window_peak_bytes": [],
                           f"{PREFIX}_query_stream_partitions": [],
-                          f"{PREFIX}_query_stream_overlap_frac": []}
+                          f"{PREFIX}_query_stream_overlap_frac": [],
+                          f"{PREFIX}_query_write_bytes": [],
+                          f"{PREFIX}_query_write_files": [],
+                          f"{PREFIX}_query_write_commit_ms": []}
         for qid, s in summaries.items():
             for d, b in s.get("bytesMoved", {}).items():
                 families[f"{PREFIX}_query_bytes_moved"].append(
@@ -147,6 +162,15 @@ def _telemetry_lines() -> list:
             if s.get("overlapFraction") is not None:
                 families[f"{PREFIX}_query_stream_overlap_frac"].append(
                     ({"queryId": qid}, s["overlapFraction"]))
+            # write block (io/commit.py): queries that published output
+            w = s.get("write")
+            if w:
+                families[f"{PREFIX}_query_write_bytes"].append(
+                    ({"queryId": qid}, w.get("bytes", 0)))
+                families[f"{PREFIX}_query_write_files"].append(
+                    ({"queryId": qid}, w.get("files", 0)))
+                families[f"{PREFIX}_query_write_commit_ms"].append(
+                    ({"queryId": qid}, w.get("commitMs", 0)))
         for mname, samples in families.items():
             if not samples:
                 continue

@@ -130,7 +130,8 @@ class _QueryLedger:
 
     __slots__ = ("by_direction", "by_site", "hbm_peak", "hbm_current",
                  "spill_pressure", "final", "enc_actual", "enc_plain",
-                 "ici_host_avoided", "labels", "stream", "intervals")
+                 "ici_host_avoided", "labels", "stream", "intervals",
+                 "write")
 
     def __init__(self):
         self.by_direction: Dict[str, Dict[str, int]] = {}
@@ -156,6 +157,9 @@ class _QueryLedger:
         # busy-interval timeline per kind ("h2d" | "compute"): bounded
         # (t0, t1) monotonic spans feeding overlapFraction
         self.intervals: Dict[str, List[tuple]] = {}
+        # commit-protocol write stats (io/commit.py): bytes/files/rows
+        # published and job-commit wall time, all sums
+        self.write: Dict[str, int] = {}
 
 
 class TransferLedger:
@@ -303,6 +307,25 @@ class TransferLedger:
                 else:
                     st[k] = st.get(k, 0) + int(v)
 
+    def record_write(self, query_id: Optional[int] = None,
+                     **fields) -> None:
+        """Fold one committed write job's stats (io/commit.py
+        commit_job: bytes, files, rows, jobs, commitMs) into the
+        owning query's ledger — the per-query `write` block of
+        query_summary."""
+        if not self.enabled:
+            return
+        qid = query_id if query_id is not None \
+            else _events.effective_query_id()
+        if not qid:
+            return
+        with self._lock:
+            w = self._query(qid).write
+            for k, v in fields.items():
+                if v is None:
+                    continue
+                w[k] = w.get(k, 0) + int(v)
+
     def record_forwarded(self, fields: dict,
                          query_id: Optional[int] = None) -> None:
         """Fold a worker-forwarded `transfer` event (process pool) into
@@ -383,6 +406,7 @@ class TransferLedger:
             labels = None if q is None or not q.labels \
                 else dict(q.labels)
             stream = {} if q is None else dict(q.stream)
+            write = {} if q is None else dict(q.write)
             intervals = {} if q is None else {
                 k: list(v) for k, v in q.intervals.items()}
         total = sum(c["bytes"] for c in by_dir.values())
@@ -427,6 +451,10 @@ class TransferLedger:
                                      intervals.get("compute", ()))
             if frac is not None:
                 out["overlapFraction"] = round(frac, 4)
+        if write:
+            # commit-protocol writes (io/commit.py): what this query
+            # published and how long the job commit(s) took
+            out["write"] = write
         if enc_plain > 0 and enc_actual > 0:
             # encoded execution's measured win: bytes the dictionary
             # representation kept OFF the staging/transfer paths, and
@@ -461,6 +489,18 @@ class TransferLedger:
         with self._lock:
             q = self._queries.get(query_id)
             return dict(q.labels) if q is not None and q.labels else {}
+
+    def merge_final(self, query_id: int, patch: dict) -> None:
+        """Patch keys into an already-finalized query summary — the
+        write path's hook: a save() collects (which finalizes the
+        read-side summary) and only THEN commits its output, so the
+        `write` block lands by merge instead of racing finalization."""
+        if not self.enabled or not query_id or not patch:
+            return
+        with self._lock:
+            q = self._queries.get(query_id)
+            if q is not None and q.final:
+                q.final.update(patch)
 
     def finalize_query(self, query_id: int, summary: dict) -> None:
         """Retain a query's end-of-run summary (with wall time and
@@ -550,6 +590,8 @@ record_dcn = ledger.record_dcn
 record_forwarded = ledger.record_forwarded
 record_interval = ledger.record_interval
 record_stream = ledger.record_stream
+record_write = ledger.record_write
+merge_final = ledger.merge_final
 hbm_global = ledger.hbm_global
 hbm_query = ledger.hbm_query
 hbm_pressure = ledger.hbm_pressure

@@ -81,6 +81,28 @@ locations where the real world fails —
                         compute touches it, exercising the SpillCatalog
                         round trip (unspill-on-use) under window
                         pressure
+    io.write            staged file write in the commit protocol
+                        (io/commit.py stage_file) — the physical write
+                        into a task attempt's staging dir fails; the
+                        backoff loop re-writes the tmp file and the
+                        atomic rename only ever publishes a complete
+                        file into staging
+    commit.task         task-commit promotion (io/commit.py) — the
+                        rename of an attempt dir to its committed name
+                        fails transiently; retried under backoff, and
+                        first-commit-wins means a racing speculative
+                        attempt can never double-publish
+    commit.job          job-commit publish (io/commit.py commit_job) —
+                        injected BEFORE any file becomes reader-visible;
+                        an exhausted retry budget aborts the job with
+                        staging unwound and pre-existing output (the
+                        deferred overwrite swap) byte-identical
+    commit.conflict     lakehouse version-file claim (lakehouse/delta.py
+                        _commit, lakehouse/iceberg.py commit_metadata) —
+                        a synthetic concurrent-commit conflict; the
+                        optimistic-transaction loser re-reads the
+                        snapshot, re-runs conflict semantics and retries
+                        under backoff, billed to the query retry budget
 
 and every site's CONSUMER survives the injected fault: backoff retries
 (runtime/backoff.py), quarantine-and-recompile, or engine demotion.
@@ -130,6 +152,10 @@ KNOWN_SITES = (
     "host.fatal",
     "stream.prefetch",
     "stream.window_evict",
+    "io.write",
+    "commit.task",
+    "commit.job",
+    "commit.conflict",
 )
 
 

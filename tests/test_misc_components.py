@@ -1,6 +1,7 @@
 """Columnar cache (df.cache), z-order OPTIMIZE, Hive text serde, and
 generated docs — the remaining small inventory components."""
 
+import glob
 import os
 
 import numpy as np
@@ -109,7 +110,9 @@ def test_hive_text_roundtrip(spark, tmp_path):
     df = _df(spark, n=300)
     p = str(tmp_path / "ht")
     df.write.format("hivetext").save(p)
-    raw = open(os.path.join(p, "part-00000.txt")).readline()
+    # part files carry the committer's job-unique tag
+    [part] = glob.glob(os.path.join(p, "part-00000-*.txt"))
+    raw = open(part).readline()
     assert "\x01" in raw  # LazySimpleSerDe delimiter
     import pyarrow as _pa
 
@@ -128,7 +131,8 @@ def test_hive_text_nulls(spark, tmp_path):
     df = spark.createDataFrame(t)
     p = str(tmp_path / "htn")
     df.write.format("hivetext").save(p)
-    content = open(os.path.join(p, "part-00000.txt")).read()
+    [part] = glob.glob(os.path.join(p, "part-00000-*.txt"))
+    content = open(part).read()
     assert "\\N" in content
     import pyarrow as _pa
 
